@@ -1,0 +1,30 @@
+"""cruise_control_tpu — a TPU-native cluster-rebalancing framework.
+
+A ground-up rebuild of the capabilities of LinkedIn Cruise Control
+(reference: /root/reference, pure Java) designed JAX-first:
+
+- The cluster workload model is a dense, padded pytree of arrays
+  (``model.ClusterTensor``) instead of a mutable object graph
+  (reference: cruise-control/.../model/ClusterModel.java).
+- The multi-goal greedy optimizer is a batched, vectorized candidate
+  scorer + masked-argmax loop compiled by XLA
+  (reference: analyzer/GoalOptimizer.java:417, analyzer/goals/AbstractGoal.java:98).
+- The host Python side owns config, monitoring, anomaly detection,
+  execution and the REST API; the TPU owns candidate scoring.
+
+Package layout mirrors the reference's layer map (SURVEY.md §1):
+
+- ``config``    — typed config schema + pluggable registry (ConfigDef analogue)
+- ``common``    — Resource taxonomy, shared types
+- ``model``     — ClusterTensor, stats, sanity checks, fixtures
+- ``analyzer``  — goal kernels + GoalOptimizer orchestration
+- ``monitor``   — windowed metric aggregation, samplers, capacity resolution
+- ``executor``  — proposal execution against a pluggable ClusterBackend
+- ``detector``  — anomaly detection + self-healing
+- ``server``    — REST API, user tasks, purgatory
+- ``client``    — Python client + CLI
+- ``parallel``  — device-mesh sharding of the candidate scorer
+- ``ops``       — low-level JAX/Pallas kernels (segment ops, masked top-k)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from cruise_control_tpu.common.resources import Resource, RESOURCES, NUM_RESOURCES
+
+__all__ = ["Resource", "RESOURCES", "NUM_RESOURCES"]

@@ -1,0 +1,57 @@
+"""Resource taxonomy.
+
+Reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/common/Resource.java:18-26
+defines CPU, NW_IN, NW_OUT, DISK with host/broker scoping and epsilon-tolerant
+comparison (Resource.java:92-94). Here each resource is also an index into the
+trailing resource axis of every load/capacity tensor, so goal kernels can slice
+one resource column without gather ops.
+"""
+from __future__ import annotations
+
+import enum
+
+
+class Resource(enum.IntEnum):
+    """A balanceable resource; the value is the tensor column index."""
+
+    CPU = 0
+    NW_IN = 1
+    NW_OUT = 2
+    DISK = 3
+
+    @property
+    def is_host_resource(self) -> bool:
+        # CPU and network are shared at host level; disk is per-broker.
+        # Reference: Resource.java (isHostResource flags).
+        return self in (Resource.CPU, Resource.NW_IN, Resource.NW_OUT)
+
+    @property
+    def is_broker_resource(self) -> bool:
+        return True
+
+    def epsilon(self, v1: float, v2: float) -> float:
+        """Scale-aware comparison tolerance (Resource.java:92-94).
+
+        The reference notes float precision matters at ~800k replicas
+        (Resource.java:30-32); we accumulate in float64 on host and float32
+        on device, keeping the same epsilon contract.
+        """
+        return max(_EPSILON_ABS[self], EPSILON_PERCENT * (v1 + v2))
+
+
+# Absolute epsilon per resource (reference Resource.java enum constants:
+# CPU 0.001, NW 10 KB, DISK 100 MB — units: CPU %, KB/s, MB).
+_EPSILON_ABS = {
+    Resource.CPU: 0.001,
+    Resource.NW_IN: 10.0,
+    Resource.NW_OUT: 10.0,
+    Resource.DISK: 100.0,
+}
+EPSILON_PERCENT = 0.0008
+
+RESOURCES = tuple(Resource)
+NUM_RESOURCES = len(RESOURCES)
+
+# Priority order used by BalancingConstraint.setResources (descending balancing
+# priority: DISK, CPU, NW_IN, NW_OUT per reference defaults).
+DEFAULT_RESOURCE_PRIORITY = (Resource.DISK, Resource.CPU, Resource.NW_IN, Resource.NW_OUT)

@@ -1,0 +1,329 @@
+"""Framework config surface.
+
+Analogue of the reference's 8 config-constants classes
+(cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/config/constants/
+AnalyzerConfig.java, MonitorConfig.java, ExecutorConfig.java,
+AnomalyDetectorConfig.java, WebServerConfig.java, UserTaskManagerConfig.java, …),
+which together `.define(...)` ~245 keys. The subset here covers everything the
+current framework consumes; defaults mirror the reference's documented defaults
+so behavior parity holds out of the box (e.g. AnalyzerConfig.java:52-219 for
+balance/capacity thresholds).
+"""
+from __future__ import annotations
+
+from cruise_control_tpu.config.configdef import (
+    ConfigDef, ConfigKey, Importance, Type, at_least, between,
+)
+
+# --------------------------------------------------------------------------
+# Goal catalog names (priority order = reference AnalyzerConfig DEFAULT_GOALS).
+# --------------------------------------------------------------------------
+DEFAULT_GOALS = [
+    "RackAwareGoal",
+    "RackAwareDistributionGoal",
+    "MinTopicLeadersPerBrokerGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "PreferredLeaderElectionGoal",
+]
+
+DEFAULT_HARD_GOALS = [
+    "RackAwareGoal",
+    "MinTopicLeadersPerBrokerGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+]
+
+DEFAULT_INTRA_BROKER_GOALS = [
+    "IntraBrokerDiskCapacityGoal",
+    "IntraBrokerDiskUsageDistributionGoal",
+]
+
+DEFAULT_ANOMALY_DETECTION_GOALS = [
+    "RackAwareGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+]
+
+_D = ConfigDef()
+
+# --------------------------------------------------------------------------
+# Analyzer (reference: config/constants/AnalyzerConfig.java)
+# --------------------------------------------------------------------------
+for _res, _bal in (("cpu", 1.10), ("disk", 1.10), ("network.inbound", 1.10), ("network.outbound", 1.10)):
+    _D.define(name=f"{_res}.balance.threshold", type=Type.DOUBLE, default=_bal,
+              validator=at_least(1.0), validator_doc=">= 1",
+              doc=f"Max allowed ratio of {_res} utilization vs cluster average (1.10 = 10% slack).")
+for _res, _cap in (("cpu", 0.7), ("disk", 0.8), ("network.inbound", 0.8), ("network.outbound", 0.8)):
+    _D.define(name=f"{_res}.capacity.threshold", type=Type.DOUBLE, default=_cap,
+              validator=lambda v: 0.0 < v <= 1.0, validator_doc="in (0, 1]",
+              doc=f"Fraction of {_res} capacity usable before the capacity goal flags a broker.")
+for _res in ("cpu", "disk", "network.inbound", "network.outbound"):
+    _D.define(name=f"{_res}.low.utilization.threshold", type=Type.DOUBLE, default=0.0,
+              validator=between(0.0, 1.0), validator_doc="in [0, 1]",
+              doc=f"Below this avg utilization the {_res} distribution goal treats the cluster as low-utilization.")
+
+_D.define(name="max.replicas.per.broker", type=Type.LONG, default=10000, validator=at_least(1),
+          doc="ReplicaCapacityGoal limit (AnalyzerConfig.java:219).")
+_D.define(name="replica.count.balance.threshold", type=Type.DOUBLE, default=1.10, validator=at_least(1.0),
+          doc="ReplicaDistributionGoal balance percentage.")
+_D.define(name="leader.replica.count.balance.threshold", type=Type.DOUBLE, default=1.10, validator=at_least(1.0),
+          doc="LeaderReplicaDistributionGoal balance percentage.")
+_D.define(name="topic.replica.count.balance.threshold", type=Type.DOUBLE, default=3.00, validator=at_least(1.0),
+          doc="TopicReplicaDistributionGoal balance percentage.")
+_D.define(name="topic.replica.count.balance.min.gap", type=Type.INT, default=2, validator=at_least(0),
+          doc="Min gap between per-broker topic replica count limits.")
+_D.define(name="topic.replica.count.balance.max.gap", type=Type.INT, default=40, validator=at_least(0),
+          doc="Max gap between per-broker topic replica count limits.")
+_D.define(name="goal.violation.distribution.threshold.multiplier", type=Type.DOUBLE, default=1.0,
+          validator=at_least(1.0),
+          doc="Extra leniency on distribution goals when triggered by the goal-violation detector.")
+_D.define(name="goals", type=Type.LIST, default=DEFAULT_GOALS, importance=Importance.HIGH,
+          doc="Inter-broker goals in descending priority (AnalyzerConfig DEFAULT_GOALS order).")
+_D.define(name="hard.goals", type=Type.LIST, default=DEFAULT_HARD_GOALS, importance=Importance.HIGH,
+          doc="Goals that must be satisfied (skip only with skip_hard_goal_check).")
+_D.define(name="default.goals", type=Type.LIST, default=None,
+          doc="Goals used for proposal precomputation; when unset, falls back to `goals`.")
+_D.define(name="intra.broker.goals", type=Type.LIST, default=DEFAULT_INTRA_BROKER_GOALS,
+          doc="Intra-broker (cross-disk) goals in priority order.")
+_D.define(name="min.topic.leaders.per.broker", type=Type.INT, default=1, validator=at_least(0),
+          doc="MinTopicLeadersPerBrokerGoal per-broker minimum for matching topics.")
+_D.define(name="topics.with.min.leaders.per.broker", type=Type.STRING, default="",
+          doc="Regex of topics that must keep a minimum leader count on each broker.")
+_D.define(name="proposal.expiration.ms", type=Type.LONG, default=900_000, validator=at_least(0),
+          doc="Precomputed proposal freshness budget (AnalyzerConfig.java:208-209).")
+_D.define(name="max.proposal.candidates", type=Type.INT, default=10, validator=at_least(1),
+          doc="Precompute candidates retained.")
+_D.define(name="num.proposal.precompute.threads", type=Type.INT, default=1, validator=at_least(1),
+          doc="Proposal precompute workers (host-side).")
+_D.define(name="analyzer.max.iterations", type=Type.INT, default=4096, validator=at_least(1),
+          doc="TPU-specific: hard cap on greedy-engine iterations per goal per round.")
+_D.define(name="analyzer.candidate.replicas.per.broker", type=Type.INT, default=64, validator=at_least(1),
+          doc="TPU-specific: top-K replicas per source broker considered per engine iteration "
+              "(replaces the reference's sorted-replica scan, SortedReplicas.java).")
+_D.define(name="analyzer.batched.moves", type=Type.BOOLEAN, default=True,
+          doc="TPU-specific: apply one non-conflicting move per violating broker per iteration "
+              "instead of a single global move (faster, same violation contract).")
+
+# --------------------------------------------------------------------------
+# Monitor (reference: config/constants/MonitorConfig.java)
+# --------------------------------------------------------------------------
+_D.define(name="num.metrics.windows", type=Type.INT, default=5, validator=at_least(1),
+          doc="Number of load-history windows retained (partition metrics).")
+_D.define(name="metrics.window.ms", type=Type.LONG, default=300_000, validator=at_least(1),
+          doc="Window span in ms.")
+_D.define(name="min.samples.per.metrics.window", type=Type.INT, default=3, validator=at_least(1),
+          doc="Samples required for a window to be valid without extrapolation.")
+_D.define(name="num.broker.metrics.windows", type=Type.INT, default=20, validator=at_least(1),
+          doc="Broker-metric window count (broker aggregator).")
+_D.define(name="broker.metrics.window.ms", type=Type.LONG, default=300_000, validator=at_least(1))
+_D.define(name="min.samples.per.broker.metrics.window", type=Type.INT, default=1, validator=at_least(1))
+_D.define(name="max.allowed.extrapolations.per.partition", type=Type.INT, default=5, validator=at_least(0),
+          doc="Per-entity extrapolation budget before samples are invalid.")
+_D.define(name="max.allowed.extrapolations.per.broker", type=Type.INT, default=5, validator=at_least(0))
+_D.define(name="partition.metrics.window.holding.capacity", type=Type.INT, default=5, validator=at_least(1))
+_D.define(name="metric.sampling.interval.ms", type=Type.LONG, default=120_000, validator=at_least(1),
+          doc="Sampler period.")
+_D.define(name="metric.sampler.class", type=Type.CLASS,
+          default="cruise_control_tpu.monitor.sampling.samplers.SimulatedMetricSampler",
+          doc="MetricSampler plugin (reference default consumes the metrics-reporter topic).")
+_D.define(name="sample.store.class", type=Type.CLASS,
+          default="cruise_control_tpu.monitor.sampling.sample_store.FileSampleStore",
+          doc="Durable sample history; replayed on startup (KafkaSampleStore analogue).")
+_D.define(name="sample.store.path", type=Type.STRING, default="",
+          doc="Directory for FileSampleStore ('' disables persistence).")
+_D.define(name="broker.capacity.config.resolver.class", type=Type.CLASS,
+          default="cruise_control_tpu.monitor.capacity.FileCapacityResolver",
+          doc="BrokerCapacityConfigResolver plugin.")
+_D.define(name="capacity.config.file", type=Type.STRING, default="",
+          doc="JSON capacity file (config/capacity.json / capacityJBOD.json analogue).")
+_D.define(name="default.broker.capacity.cpu", type=Type.DOUBLE, default=100.0,
+          doc="Fallback per-broker CPU capacity (percent, 100 = all cores).")
+_D.define(name="default.broker.capacity.disk", type=Type.DOUBLE, default=500_000.0,
+          doc="Fallback per-broker disk capacity (MB).")
+_D.define(name="default.broker.capacity.nw.in", type=Type.DOUBLE, default=50_000.0,
+          doc="Fallback network-in capacity (KB/s).")
+_D.define(name="default.broker.capacity.nw.out", type=Type.DOUBLE, default=50_000.0,
+          doc="Fallback network-out capacity (KB/s).")
+_D.define(name="monitor.state.update.interval.ms", type=Type.LONG, default=30_000)
+_D.define(name="min.valid.partition.ratio", type=Type.DOUBLE, default=0.95, validator=between(0.0, 1.0),
+          doc="Default completeness: min fraction of monitored partitions with valid samples.")
+_D.define(name="min.monitored.partition.percentage", type=Type.DOUBLE, default=0.995,
+          validator=between(0.0, 1.0))
+_D.define(name="leader.network.inbound.weight.for.cpu.util", type=Type.DOUBLE, default=0.6,
+          doc="Static CPU attribution weights (ModelUtils.java:61-141).")
+_D.define(name="follower.network.inbound.weight.for.cpu.util", type=Type.DOUBLE, default=0.3)
+_D.define(name="leader.network.outbound.weight.for.cpu.util", type=Type.DOUBLE, default=0.1)
+_D.define(name="use.linear.regression.model", type=Type.BOOLEAN, default=False,
+          doc="Experimental linear-regression CPU model (LinearRegressionModelParameters.java).")
+
+# --------------------------------------------------------------------------
+# Executor (reference: config/constants/ExecutorConfig.java)
+# --------------------------------------------------------------------------
+_D.define(name="num.concurrent.partition.movements.per.broker", type=Type.INT, default=5,
+          validator=at_least(1), doc="Per-broker in-flight inter-broker replica move cap.")
+_D.define(name="max.num.cluster.partition.movements", type=Type.INT, default=1250, validator=at_least(1),
+          doc="Cluster-wide in-flight inter-broker move cap.")
+_D.define(name="num.concurrent.intra.broker.partition.movements", type=Type.INT, default=2,
+          validator=at_least(1))
+_D.define(name="num.concurrent.leader.movements", type=Type.INT, default=1000, validator=at_least(1))
+_D.define(name="max.num.cluster.movements", type=Type.INT, default=1250, validator=at_least(1),
+          doc="Upper bound of total ongoing movements.")
+_D.define(name="execution.progress.check.interval.ms", type=Type.LONG, default=10_000, validator=at_least(1))
+_D.define(name="default.replication.throttle", type=Type.LONG, default=-1,
+          doc="Bytes/sec replication throttle applied during execution (-1 = none).")
+_D.define(name="replica.movement.strategies", type=Type.LIST,
+          default=["BaseReplicaMovementStrategy"],
+          doc="Composable strategy chain ordering inter-broker moves (executor/strategy/).")
+_D.define(name="default.replica.movement.strategies", type=Type.LIST,
+          default=["BaseReplicaMovementStrategy"])
+_D.define(name="concurrency.adjuster.enabled", type=Type.BOOLEAN, default=False,
+          doc="Dynamic concurrency adjustment from broker metrics (Executor.java:335-448).")
+_D.define(name="concurrency.adjuster.interval.ms", type=Type.LONG, default=360_000)
+_D.define(name="concurrency.adjuster.max.partition.movements.per.broker", type=Type.INT, default=12,
+          validator=at_least(1))
+_D.define(name="concurrency.adjuster.min.partition.movements.per.broker", type=Type.INT, default=1,
+          validator=at_least(1))
+_D.define(name="concurrency.adjuster.max.leadership.movements", type=Type.INT, default=1125,
+          validator=at_least(1))
+_D.define(name="leader.movement.timeout.ms", type=Type.LONG, default=180_000)
+_D.define(name="task.execution.alerting.threshold.ms", type=Type.LONG, default=90_000)
+_D.define(name="executor.backend.class", type=Type.CLASS,
+          default="cruise_control_tpu.executor.backends.SimulatedClusterBackend",
+          doc="ClusterBackend plugin: simulated (tests/dev) or adapter to a real cluster "
+              "(the reference actuates via ZK znodes + AdminClient, Executor.java:1272).")
+_D.define(name="remove.recently.removed.brokers.grace.ms", type=Type.LONG, default=0)
+_D.define(name="demotion.history.retention.time.ms", type=Type.LONG, default=86_400_000)
+_D.define(name="removal.history.retention.time.ms", type=Type.LONG, default=86_400_000)
+
+# --------------------------------------------------------------------------
+# Anomaly detector (reference: config/constants/AnomalyDetectorConfig.java)
+# --------------------------------------------------------------------------
+_D.define(name="anomaly.detection.interval.ms", type=Type.LONG, default=300_000, validator=at_least(1))
+_D.define(name="goal.violation.detection.interval.ms", type=Type.LONG, default=-1,
+          doc="-1 = use anomaly.detection.interval.ms.")
+_D.define(name="metric.anomaly.detection.interval.ms", type=Type.LONG, default=-1)
+_D.define(name="disk.failure.detection.interval.ms", type=Type.LONG, default=-1)
+_D.define(name="topic.anomaly.detection.interval.ms", type=Type.LONG, default=-1)
+_D.define(name="broker.failure.detection.backoff.ms", type=Type.LONG, default=300_000)
+_D.define(name="anomaly.notifier.class", type=Type.CLASS,
+          default="cruise_control_tpu.detector.notifier.SelfHealingNotifier",
+          doc="AnomalyNotifier plugin returning FIX/CHECK/IGNORE.")
+_D.define(name="anomaly.detection.goals", type=Type.LIST, default=DEFAULT_ANOMALY_DETECTION_GOALS,
+          doc="Goals the GoalViolationDetector re-checks.")
+_D.define(name="self.healing.enabled", type=Type.BOOLEAN, default=False,
+          doc="Master switch for self-healing (per-type switches in the notifier).")
+_D.define(name="self.healing.exclude.recently.demoted.brokers", type=Type.BOOLEAN, default=True)
+_D.define(name="self.healing.exclude.recently.removed.brokers", type=Type.BOOLEAN, default=True)
+_D.define(name="broker.failures.self.healing.enabled", type=Type.BOOLEAN, default=False)
+_D.define(name="goal.violations.self.healing.enabled", type=Type.BOOLEAN, default=False)
+_D.define(name="disk.failures.self.healing.enabled", type=Type.BOOLEAN, default=False)
+_D.define(name="metric.anomaly.self.healing.enabled", type=Type.BOOLEAN, default=False)
+_D.define(name="topic.anomaly.self.healing.enabled", type=Type.BOOLEAN, default=False)
+_D.define(name="maintenance.event.self.healing.enabled", type=Type.BOOLEAN, default=False)
+_D.define(name="broker.failure.alert.threshold.ms", type=Type.LONG, default=900_000,
+          doc="SelfHealingNotifier grace: alert after this long.")
+_D.define(name="broker.failure.self.healing.threshold.ms", type=Type.LONG, default=1_800_000,
+          doc="SelfHealingNotifier grace: fix after this long.")
+_D.define(name="metric.anomaly.finder.class", type=Type.CLASS,
+          default="cruise_control_tpu.detector.metric_anomaly.PercentileMetricAnomalyFinder",
+          doc="MetricAnomalyFinder plugin (core SPI).")
+_D.define(name="metric.anomaly.percentile.upper.threshold", type=Type.DOUBLE, default=95.0,
+          validator=between(0.0, 100.0))
+_D.define(name="metric.anomaly.percentile.lower.threshold", type=Type.DOUBLE, default=2.0,
+          validator=between(0.0, 100.0))
+_D.define(name="slow.broker.bytes.rate.detection.threshold", type=Type.DOUBLE, default=1024.0)
+_D.define(name="slow.broker.log.flush.time.threshold.ms", type=Type.DOUBLE, default=1000.0)
+_D.define(name="slow.broker.demotion.score", type=Type.INT, default=5)
+_D.define(name="slow.broker.decommission.score", type=Type.INT, default=50)
+_D.define(name="slow.broker.self.healing.unfixable.action", type=Type.STRING, default="DEMOTE")
+_D.define(name="provisioner.class", type=Type.CLASS,
+          default="cruise_control_tpu.detector.provisioner.NoopProvisioner",
+          doc="Provisioner SPI for cluster right-sizing.")
+_D.define(name="provision.partition.size.threshold.mb", type=Type.DOUBLE, default=1_000_000.0)
+_D.define(name="topic.anomaly.finder.class", type=Type.LIST,
+          default=["cruise_control_tpu.detector.topic_anomaly.TopicReplicationFactorAnomalyFinder"])
+_D.define(name="self.healing.target.topic.replication.factor", type=Type.INT, default=3)
+_D.define(name="maintenance.event.reader.class", type=Type.CLASS,
+          default="cruise_control_tpu.detector.maintenance.FileMaintenanceEventReader",
+          doc="MaintenanceEventReader plugin (reference reads a Kafka topic).")
+_D.define(name="maintenance.event.path", type=Type.STRING, default="",
+          doc="Spool directory for FileMaintenanceEventReader.")
+_D.define(name="maintenance.event.idempotence.retention.ms", type=Type.LONG, default=180_000)
+
+# --------------------------------------------------------------------------
+# Web server + user tasks (reference: WebServerConfig.java, UserTaskManagerConfig.java)
+# --------------------------------------------------------------------------
+_D.define(name="webserver.http.port", type=Type.INT, default=9090, validator=between(0, 65535))
+_D.define(name="webserver.http.address", type=Type.STRING, default="127.0.0.1")
+_D.define(name="webserver.api.urlprefix", type=Type.STRING, default="/kafkacruisecontrol/*")
+_D.define(name="webserver.session.maxExpiryTime", type=Type.LONG, default=60_000)
+_D.define(name="webserver.request.maxBlockTimeMs", type=Type.LONG, default=10_000)
+_D.define(name="max.active.user.tasks", type=Type.INT, default=5, validator=at_least(1))
+_D.define(name="completed.user.task.retention.time.ms", type=Type.LONG, default=86_400_000)
+_D.define(name="max.cached.completed.user.tasks", type=Type.INT, default=100)
+_D.define(name="two.step.verification.enabled", type=Type.BOOLEAN, default=False,
+          doc="Park POSTs in the purgatory for review (servlet/purgatory/Purgatory.java).")
+_D.define(name="two.step.purgatory.retention.time.ms", type=Type.LONG, default=1_209_600_000)
+_D.define(name="two.step.purgatory.max.requests", type=Type.INT, default=25)
+_D.define(name="webserver.security.enable", type=Type.BOOLEAN, default=False)
+_D.define(name="webserver.auth.credentials.file", type=Type.STRING, default="")
+_D.define(name="webserver.ssl.enable", type=Type.BOOLEAN, default=False)
+
+# --------------------------------------------------------------------------
+# TPU placement / parallelism (no reference analogue — TPU-native surface)
+# --------------------------------------------------------------------------
+_D.define(name="tpu.mesh.axis.brokers", type=Type.INT, default=1, validator=at_least(1),
+          doc="Device-mesh size along the candidate-destination (broker) axis for sharded scoring.")
+_D.define(name="tpu.donate.state", type=Type.BOOLEAN, default=True,
+          doc="Donate engine state buffers between iterations to avoid HBM copies.")
+
+CRUISE_CONTROL_CONFIG_DEF = _D
+
+
+def cruise_control_config(props=None, ignore_unknown: bool = False):
+    """Build a validated framework Config (KafkaCruiseControlConfig analogue)."""
+    from cruise_control_tpu.config.configdef import Config
+    cfg = Config(CRUISE_CONTROL_CONFIG_DEF, props or {}, ignore_unknown=ignore_unknown)
+    _sanity_check(cfg)
+    return cfg
+
+
+def effective_default_goals(cfg) -> list:
+    """Goals for proposal precompute: `default.goals`, falling back to `goals`
+    (reference: AnalyzerConfig default.goals falls back to the configured goals)."""
+    return cfg.get_list("default.goals") or cfg.get_list("goals")
+
+
+def _sanity_check(cfg) -> None:
+    """Cross-key checks (reference: config/KafkaCruiseControlConfig.java sanityCheck*)."""
+    from cruise_control_tpu.config.configdef import ConfigException
+    goals = cfg.get_list("goals")
+    hard = cfg.get_list("hard.goals")
+    missing = [g for g in hard if g not in goals]
+    if missing:
+        raise ConfigException(f"hard.goals {missing} not in goals list")
+    default_goals = cfg.get_list("default.goals")
+    bad_defaults = [g for g in default_goals if g not in goals]
+    if bad_defaults:
+        raise ConfigException(f"default.goals {bad_defaults} not in goals list")
+    if cfg.get_int("num.metrics.windows") < 1:
+        raise ConfigException("num.metrics.windows must be >= 1")
+    if cfg.get_int("max.num.cluster.movements") < cfg.get_int("num.concurrent.leader.movements"):
+        # mirrors sanityCheckConcurrency: cluster cap must cover leadership concurrency
+        raise ConfigException("max.num.cluster.movements < num.concurrent.leader.movements")

@@ -1,0 +1,249 @@
+"""Host-side cluster model assembly.
+
+Fills the role of the reference's model-population path: LoadMonitor builds a
+ClusterModel by creating brokers with capacities and then
+``createReplica``/``setReplicaLoad`` per partition
+(monitor/LoadMonitor.java:539-591, model/ClusterModel.java:803, :741). Here a
+``ClusterModelBuilder`` accumulates plain-Python topology + loads and ``build()``
+emits the padded numeric ``ClusterTensor`` plus the name-mapping ``ClusterMeta``.
+
+Load convention (matches reference units): CPU in percent of one broker's total
+(0..100), NW in KB/s, DISK in MB. ``leader_load`` vs ``follower_load`` encode
+the leadership-dependent split the reference applies in
+ClusterModel.relocateLeadership + ModelUtils CPU attribution: followers carry no
+NW_OUT and a reduced CPU share, identical NW_IN and DISK.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax.numpy as jnp
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model.cluster_tensor import ClusterMeta, ClusterTensor
+
+
+@dataclasses.dataclass
+class _BrokerSpec:
+    broker_id: int
+    rack: str
+    capacity: dict  # Resource -> float
+    alive: bool = True
+    new: bool = False
+    demoted: bool = False
+    logdirs: list = dataclasses.field(default_factory=lambda: ["/logdir0"])
+    disk_capacity: list = dataclasses.field(default_factory=list)  # per logdir, MB
+    dead_disks: set = dataclasses.field(default_factory=set)       # logdir names
+
+
+@dataclasses.dataclass
+class _ReplicaSpec:
+    topic: str
+    partition: int
+    broker_id: int
+    is_leader: bool
+    leader_load: np.ndarray     # [M]
+    follower_load: np.ndarray   # [M]
+    logdir: str | None = None
+    offline: bool = False
+
+
+# Default follower CPU share vs leader when caller supplies only a single load
+# row: mirrors ModelUtils' static leader/follower network weights for CPU
+# attribution (model/ModelUtils.java:61-141 with default weights 0.6/0.3/0.1).
+FOLLOWER_CPU_FRACTION = 0.5
+
+
+def split_leader_follower(load: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Derive (leader_load, follower_load) from one combined load row."""
+    leader = np.asarray(load, dtype=np.float64).copy()
+    follower = leader.copy()
+    follower[Resource.NW_OUT] = 0.0
+    follower[Resource.CPU] = leader[Resource.CPU] * FOLLOWER_CPU_FRACTION
+    return leader, follower
+
+
+class ClusterModelBuilder:
+    def __init__(self):
+        self._brokers: dict[int, _BrokerSpec] = {}
+        self._replicas: list[_ReplicaSpec] = []
+        self._excluded_topics: set[str] = set()
+        self._excluded_brokers_for_move: set[int] = set()
+        self._excluded_brokers_for_leadership: set[int] = set()
+
+    # ---- topology ----
+    def add_broker(self, broker_id: int, rack: str, capacity: dict | None = None,
+                   alive: bool = True, new: bool = False, demoted: bool = False,
+                   logdirs: list | None = None, disk_capacity: list | None = None,
+                   dead_disks: set | None = None) -> "ClusterModelBuilder":
+        if broker_id in self._brokers:
+            raise ValueError(f"duplicate broker {broker_id}")
+        cap = {Resource.CPU: 100.0, Resource.DISK: 500_000.0,
+               Resource.NW_IN: 50_000.0, Resource.NW_OUT: 50_000.0}
+        if capacity:
+            cap.update(capacity)
+        spec = _BrokerSpec(broker_id=broker_id, rack=str(rack), capacity=cap,
+                           alive=alive, new=new, demoted=demoted,
+                           logdirs=list(logdirs) if logdirs else ["/logdir0"],
+                           disk_capacity=list(disk_capacity) if disk_capacity else [],
+                           dead_disks=set(dead_disks or ()))
+        if not spec.disk_capacity:
+            # split broker disk capacity evenly across logdirs
+            per = cap[Resource.DISK] / len(spec.logdirs)
+            spec.disk_capacity = [per] * len(spec.logdirs)
+        self._brokers[broker_id] = spec
+        return self
+
+    def add_replica(self, topic: str, partition: int, broker_id: int, is_leader: bool,
+                    load: np.ndarray | list | None = None,
+                    leader_load: np.ndarray | list | None = None,
+                    follower_load: np.ndarray | list | None = None,
+                    logdir: str | None = None, offline: bool = False) -> "ClusterModelBuilder":
+        """Add one replica. Either a combined ``load`` row [cpu, nw_in, nw_out, disk]
+        (split per leadership by :func:`split_leader_follower`) or explicit
+        leader/follower rows."""
+        if broker_id not in self._brokers:
+            raise ValueError(f"unknown broker {broker_id}")
+        if load is not None:
+            lead, foll = split_leader_follower(np.asarray(load, dtype=np.float64))
+        else:
+            if leader_load is None or follower_load is None:
+                raise ValueError("need either load= or leader_load= and follower_load=")
+            lead = np.asarray(leader_load, dtype=np.float64)
+            foll = np.asarray(follower_load, dtype=np.float64)
+        self._replicas.append(_ReplicaSpec(topic=topic, partition=int(partition),
+                                           broker_id=broker_id, is_leader=bool(is_leader),
+                                           leader_load=lead, follower_load=foll,
+                                           logdir=logdir, offline=offline))
+        return self
+
+    def exclude_topics(self, *topics: str) -> "ClusterModelBuilder":
+        self._excluded_topics.update(topics)
+        return self
+
+    def exclude_brokers_for_replica_move(self, *broker_ids: int) -> "ClusterModelBuilder":
+        self._excluded_brokers_for_move.update(broker_ids)
+        return self
+
+    def exclude_brokers_for_leadership(self, *broker_ids: int) -> "ClusterModelBuilder":
+        self._excluded_brokers_for_leadership.update(broker_ids)
+        return self
+
+    # ---- assembly ----
+    def build(self, pad_replicas_to: int | None = None) -> tuple[ClusterTensor, ClusterMeta]:
+        if not self._brokers:
+            raise ValueError("no brokers")
+        broker_ids = sorted(self._brokers)
+        bidx = {b: i for i, b in enumerate(broker_ids)}
+        racks = sorted({s.rack for s in self._brokers.values()})
+        ridx = {r: i for i, r in enumerate(racks)}
+        topics = sorted({r.topic for r in self._replicas} | self._excluded_topics)
+        tidx = {t: i for i, t in enumerate(topics)}
+        partitions = sorted({(r.topic, r.partition) for r in self._replicas})
+        pidx = {tp: i for i, tp in enumerate(partitions)}
+
+        B = len(broker_ids)
+        R_valid = len(self._replicas)
+        R = pad_replicas_to or max(R_valid, 1)
+        if R < R_valid:
+            raise ValueError(f"pad_replicas_to={R} < {R_valid} replicas")
+        T = max(len(topics), 1)
+        P = max(len(partitions), 1)
+        D = max(len(s.logdirs) for s in self._brokers.values())
+        M = NUM_RESOURCES
+
+        specs = self._brokers
+        broker_capacity = np.zeros((B, M), np.float32)
+        broker_rack = np.zeros(B, np.int32)
+        broker_alive = np.zeros(B, bool)
+        broker_new = np.zeros(B, bool)
+        broker_demoted = np.zeros(B, bool)
+        broker_excl_move = np.zeros(B, bool)
+        broker_excl_lead = np.zeros(B, bool)
+        broker_disk_capacity = np.zeros((B, D), np.float32)
+        broker_disk_alive = np.zeros((B, D), bool)
+        logdirs_per_broker: list[list[str]] = []
+        for b_id in broker_ids:
+            i = bidx[b_id]
+            s = specs[b_id]
+            for res in Resource:
+                broker_capacity[i, res] = s.capacity[res]
+            broker_rack[i] = ridx[s.rack]
+            broker_alive[i] = s.alive
+            broker_new[i] = s.new
+            broker_demoted[i] = s.demoted
+            broker_excl_move[i] = b_id in self._excluded_brokers_for_move
+            broker_excl_lead[i] = b_id in self._excluded_brokers_for_leadership
+            for d, ld in enumerate(s.logdirs):
+                broker_disk_capacity[i, d] = s.disk_capacity[d]
+                broker_disk_alive[i, d] = s.alive and (ld not in s.dead_disks)
+            logdirs_per_broker.append(list(s.logdirs))
+
+        replica_broker = np.zeros(R, np.int32)
+        replica_disk = np.zeros(R, np.int32)
+        replica_partition = np.zeros(R, np.int32)
+        replica_topic = np.zeros(R, np.int32)
+        replica_is_leader = np.zeros(R, bool)
+        replica_valid = np.zeros(R, bool)
+        replica_offline = np.zeros(R, bool)
+        leader_load = np.zeros((R, M), np.float32)
+        follower_load = np.zeros((R, M), np.float32)
+
+        leaders_seen: dict[int, int] = {}
+        for j, r in enumerate(self._replicas):
+            s = specs[r.broker_id]
+            replica_broker[j] = bidx[r.broker_id]
+            if r.logdir is not None:
+                replica_disk[j] = s.logdirs.index(r.logdir)
+            p = pidx[(r.topic, r.partition)]
+            replica_partition[j] = p
+            replica_topic[j] = tidx[r.topic]
+            replica_is_leader[j] = r.is_leader
+            if r.is_leader:
+                if p in leaders_seen:
+                    raise ValueError(f"two leaders for {r.topic}-{r.partition}")
+                leaders_seen[p] = j
+            replica_valid[j] = True
+            dead_disk = s.logdirs[replica_disk[j]] in s.dead_disks
+            replica_offline[j] = r.offline or (not s.alive) or dead_disk
+            leader_load[j] = r.leader_load
+            follower_load[j] = r.follower_load
+        # padded rows point at broker 0 but are masked everywhere by replica_valid
+
+        partition_topic = np.zeros(P, np.int32)
+        for (t, _p), i in pidx.items():
+            partition_topic[i] = tidx[t]
+        topic_excluded = np.zeros(T, bool)
+        for t in self._excluded_topics:
+            if t in tidx:
+                topic_excluded[tidx[t]] = True
+
+        ct = ClusterTensor(
+            replica_broker=jnp.asarray(replica_broker),
+            replica_disk=jnp.asarray(replica_disk),
+            replica_partition=jnp.asarray(replica_partition),
+            replica_topic=jnp.asarray(replica_topic),
+            replica_is_leader=jnp.asarray(replica_is_leader),
+            replica_valid=jnp.asarray(replica_valid),
+            replica_offline=jnp.asarray(replica_offline),
+            replica_original_broker=jnp.asarray(replica_broker.copy()),
+            leader_load=jnp.asarray(leader_load),
+            follower_load=jnp.asarray(follower_load),
+            broker_capacity=jnp.asarray(broker_capacity),
+            broker_rack=jnp.asarray(broker_rack),
+            broker_alive=jnp.asarray(broker_alive),
+            broker_new=jnp.asarray(broker_new),
+            broker_demoted=jnp.asarray(broker_demoted),
+            broker_excluded_for_replica_move=jnp.asarray(broker_excl_move),
+            broker_excluded_for_leadership=jnp.asarray(broker_excl_lead),
+            broker_disk_capacity=jnp.asarray(broker_disk_capacity),
+            broker_disk_alive=jnp.asarray(broker_disk_alive),
+            topic_excluded=jnp.asarray(topic_excluded),
+            partition_topic=jnp.asarray(partition_topic),
+        )
+        meta = ClusterMeta(topic_names=topics, partition_ids=partitions,
+                           broker_ids=broker_ids, rack_ids=racks,
+                           logdirs=logdirs_per_broker, num_racks=len(racks),
+                           num_valid_replicas=R_valid)
+        return ct, meta

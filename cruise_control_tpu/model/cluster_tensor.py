@@ -1,0 +1,273 @@
+"""ClusterTensor: the cluster workload model as a dense pytree of arrays.
+
+The reference models a cluster as a mutable Rack -> Host -> Broker -> Disk ->
+Replica object graph with per-replica windowed Load
+(cruise-control/.../model/ClusterModel.java:60-109, Broker.java, Replica.java,
+Load.java:32). Every optimizer step mutates that graph (relocateReplica
+ClusterModel.java:375, relocateLeadership :402) and every goal walks it.
+
+Here the model is a flat, replica-major set of arrays with static (padded)
+shapes so the whole optimizer compiles under ``jax.jit``:
+
+- axis R: replicas (padded; ``replica_valid`` masks tail)
+- axis B: brokers
+- axis M: resources (common.Resource column order: CPU, NW_IN, NW_OUT, DISK)
+- axis P: partitions, axis T: topics, axis K: racks, axis D: disks per broker
+
+Leadership-dependent load is encoded as two per-replica load rows
+(``leader_load`` / ``follower_load``); relocating leadership flips
+``replica_is_leader`` and all derived broker utilization follows — the
+functional analogue of ClusterModel.relocateLeadership's load transfer.
+``ClusterModel.utilizationMatrix()`` (ClusterModel.java:1326-1360) is the
+reference's own dense-matrix rendering of this state; ClusterTensor extends
+that idea to replica granularity so *candidate scoring* can be vectorized, not
+just stats.
+
+All mutation here is functional: ``move_replica`` / ``move_leadership`` return
+new pytrees (cheap on device: one scatter each). Derived quantities
+(``broker_utilization``, counts, rack membership) are pure functions used both
+for from-scratch computation in tests and incrementally inside the engine loop.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=[
+             "replica_broker", "replica_disk", "replica_partition", "replica_topic",
+             "replica_is_leader", "replica_valid", "replica_offline",
+             "replica_original_broker", "leader_load", "follower_load",
+             "broker_capacity", "broker_rack", "broker_alive", "broker_new",
+             "broker_demoted", "broker_excluded_for_replica_move",
+             "broker_excluded_for_leadership",
+             "broker_disk_capacity", "broker_disk_alive",
+             "topic_excluded", "partition_topic",
+         ],
+         meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class ClusterTensor:
+    # -------- replica axis (R) --------
+    replica_broker: Array            # i32[R] current broker (0..B-1; padded rows point at B-1 but masked)
+    replica_disk: Array              # i32[R] disk index on its broker (JBOD); 0 when single-logdir
+    replica_partition: Array         # i32[R] global partition index
+    replica_topic: Array             # i32[R] topic index
+    replica_is_leader: Array         # bool[R]
+    replica_valid: Array             # bool[R] padding mask
+    replica_offline: Array           # bool[R] lives on dead broker / dead disk -> must relocate
+    replica_original_broker: Array   # i32[R] broker at model build time (immigrant/original tracking,
+                                     #        reference Replica.java originalBroker)
+    leader_load: Array               # f32[R, M] resource load if this replica leads
+    follower_load: Array             # f32[R, M] resource load if it follows
+    # -------- broker axis (B) --------
+    broker_capacity: Array           # f32[B, M]
+    broker_rack: Array               # i32[B] rack index
+    broker_alive: Array              # bool[B]
+    broker_new: Array                # bool[B] newly-added brokers (rebalance destinations)
+    broker_demoted: Array            # bool[B] demoted: no leadership allowed
+    broker_excluded_for_replica_move: Array  # bool[B] requested destination exclusion
+    broker_excluded_for_leadership: Array    # bool[B]
+    broker_disk_capacity: Array      # f32[B, D]
+    broker_disk_alive: Array         # bool[B, D]
+    # -------- topic / partition axes --------
+    topic_excluded: Array            # bool[T] excluded topics (no action may touch them)
+    partition_topic: Array           # i32[P]
+
+    # ---- static shape helpers (python ints; safe under jit since shapes are static)
+    @property
+    def num_replicas(self) -> int:
+        return self.replica_broker.shape[0]
+
+    @property
+    def num_brokers(self) -> int:
+        return self.broker_capacity.shape[0]
+
+    @property
+    def num_topics(self) -> int:
+        return self.topic_excluded.shape[0]
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partition_topic.shape[0]
+
+    @property
+    def num_disks(self) -> int:
+        return self.broker_disk_capacity.shape[1]
+
+    # ---- derived quantities (pure) ----
+    def effective_load(self) -> Array:
+        """f32[R, M] current load of each replica given its leadership role."""
+        lead = self.replica_is_leader[:, None]
+        load = jnp.where(lead, self.leader_load, self.follower_load)
+        return jnp.where(self.replica_valid[:, None], load, 0.0)
+
+    def broker_utilization(self) -> Array:
+        """f32[B, M] total load hosted per broker (ClusterModel broker load)."""
+        return jax.ops.segment_sum(self.effective_load(), self.replica_broker,
+                                   num_segments=self.num_brokers)
+
+    def broker_leader_utilization(self) -> Array:
+        """f32[B, M] load from leader replicas only (leadership goals)."""
+        lead_load = jnp.where((self.replica_is_leader & self.replica_valid)[:, None],
+                              self.leader_load, 0.0)
+        return jax.ops.segment_sum(lead_load, self.replica_broker,
+                                   num_segments=self.num_brokers)
+
+    def broker_replica_count(self) -> Array:
+        """i32[B] replicas per broker."""
+        return jax.ops.segment_sum(self.replica_valid.astype(jnp.int32),
+                                   self.replica_broker, num_segments=self.num_brokers)
+
+    def broker_leader_count(self) -> Array:
+        """i32[B] leader replicas per broker."""
+        return jax.ops.segment_sum((self.replica_valid & self.replica_is_leader).astype(jnp.int32),
+                                   self.replica_broker, num_segments=self.num_brokers)
+
+    def partition_rack_count(self, num_racks: int) -> Array:
+        """i32[P, K] replicas of each partition per rack (RackAwareGoal state)."""
+        rack = self.broker_rack[self.replica_broker]                      # i32[R]
+        flat = self.replica_partition * num_racks + rack                  # i32[R]
+        counts = jax.ops.segment_sum(self.replica_valid.astype(jnp.int32), flat,
+                                     num_segments=self.num_partitions * num_racks)
+        return counts.reshape(self.num_partitions, num_racks)
+
+    def partition_broker_count(self) -> Array:
+        """i32[P, B] is-partition-on-broker counts (for legit-move checks this is
+        computed per candidate instead; this full matrix is for tests/small B)."""
+        flat = self.replica_partition * self.num_brokers + self.replica_broker
+        counts = jax.ops.segment_sum(self.replica_valid.astype(jnp.int32), flat,
+                                     num_segments=self.num_partitions * self.num_brokers)
+        return counts.reshape(self.num_partitions, self.num_brokers)
+
+    def topic_broker_count(self) -> Array:
+        """i32[T, B] replicas of each topic per broker (TopicReplicaDistributionGoal)."""
+        flat = self.replica_topic * self.num_brokers + self.replica_broker
+        counts = jax.ops.segment_sum(self.replica_valid.astype(jnp.int32), flat,
+                                     num_segments=self.num_topics * self.num_brokers)
+        return counts.reshape(self.num_topics, self.num_brokers)
+
+    def topic_leader_broker_count(self) -> Array:
+        """i32[T, B] leaders of each topic per broker (MinTopicLeadersPerBrokerGoal)."""
+        flat = self.replica_topic * self.num_brokers + self.replica_broker
+        is_leader = (self.replica_valid & self.replica_is_leader).astype(jnp.int32)
+        counts = jax.ops.segment_sum(is_leader, flat,
+                                     num_segments=self.num_topics * self.num_brokers)
+        return counts.reshape(self.num_topics, self.num_brokers)
+
+    def broker_disk_utilization(self) -> Array:
+        """f32[B, D] disk-resource load per (broker, disk) (JBOD, Disk.java role)."""
+        from cruise_control_tpu.common.resources import Resource
+        disk_load = self.effective_load()[:, Resource.DISK]
+        flat = self.replica_broker * self.num_disks + self.replica_disk
+        util = jax.ops.segment_sum(disk_load, flat,
+                                   num_segments=self.num_brokers * self.num_disks)
+        return util.reshape(self.num_brokers, self.num_disks)
+
+    def potential_leader_load(self) -> Array:
+        """f32[B, M] 'potential' load if every hosted replica became leader.
+
+        Reference: potential nw-out tracking (ClusterModelStats potential NW out,
+        PotentialNwOutGoal.java) — a broker's exposure if leadership failed over.
+        """
+        lead_load = jnp.where(self.replica_valid[:, None], self.leader_load, 0.0)
+        return jax.ops.segment_sum(lead_load, self.replica_broker,
+                                   num_segments=self.num_brokers)
+
+    # ---- functional mutations ----
+    def move_replica(self, replica: Array, dst_broker: Array, dst_disk: Array | None = None) -> "ClusterTensor":
+        """Relocate one replica (ClusterModel.relocateReplica analogue, :375)."""
+        dst_broker = jnp.asarray(dst_broker, jnp.int32)
+        new_broker = self.replica_broker.at[replica].set(dst_broker)
+        new_disk = self.replica_disk
+        dst_disk = jnp.asarray(0 if dst_disk is None else dst_disk, jnp.int32)
+        new_disk = new_disk.at[replica].set(dst_disk)
+        # A replica is online iff its destination broker and disk are alive
+        # (self-healing moves clear the offline flag; moves onto a dead target don't).
+        dst_online = self.broker_alive[dst_broker] & self.broker_disk_alive[dst_broker, dst_disk]
+        new_offline = self.replica_offline.at[replica].set(~dst_online)
+        return dataclasses.replace(self, replica_broker=new_broker, replica_disk=new_disk,
+                                   replica_offline=new_offline)
+
+    def move_leadership(self, src_replica: Array, dst_replica: Array) -> "ClusterTensor":
+        """Transfer leadership between two replicas of the same partition
+        (ClusterModel.relocateLeadership analogue, :402)."""
+        lead = self.replica_is_leader.at[src_replica].set(False)
+        lead = lead.at[dst_replica].set(True)
+        return dataclasses.replace(self, replica_is_leader=lead)
+
+    def swap_replicas(self, replica_a: Array, replica_b: Array) -> "ClusterTensor":
+        """Swap the brokers of two replicas (SWAP balancing action)."""
+        ba = self.replica_broker[replica_a]
+        bb = self.replica_broker[replica_b]
+        new_broker = self.replica_broker.at[replica_a].set(bb).at[replica_b].set(ba)
+        da = self.replica_disk[replica_a]
+        db = self.replica_disk[replica_b]
+        new_disk = self.replica_disk.at[replica_a].set(db).at[replica_b].set(da)
+        a_online = self.broker_alive[bb] & self.broker_disk_alive[bb, db]
+        b_online = self.broker_alive[ba] & self.broker_disk_alive[ba, da]
+        new_offline = self.replica_offline.at[replica_a].set(~a_online).at[replica_b].set(~b_online)
+        return dataclasses.replace(self, replica_broker=new_broker, replica_disk=new_disk,
+                                   replica_offline=new_offline)
+
+    def set_broker_alive(self, broker: int, alive: bool) -> "ClusterTensor":
+        """Mark broker death/revival; hosted replicas' offline flags and the
+        broker's disk aliveness follow. Revival cannot resurrect disks that were
+        individually dead before the broker died (per-disk failures are tracked
+        separately via the builder's dead_disks), so on revival a replica is
+        online only if its disk is also alive."""
+        alive_arr = jnp.asarray(alive)
+        new_alive = self.broker_alive.at[broker].set(alive_arr)
+        # Disk aliveness is AND(broker alive, disk itself not failed). We store the
+        # conjunction, so on death zero the row; on revival we cannot distinguish
+        # "dead because broker died" from "dead disk" — keep the row as-is on
+        # revival only if it was captured pre-death. Standard flow (death then
+        # self-healing) only needs the death direction.
+        disk_row = self.broker_disk_alive[broker]
+        new_disk_alive = self.broker_disk_alive.at[broker].set(
+            jnp.where(alive_arr, disk_row | ~jnp.any(disk_row), jnp.zeros_like(disk_row)))
+        on_broker = (self.replica_broker == broker) & self.replica_valid
+        disk_ok = new_disk_alive[self.replica_broker, self.replica_disk]
+        new_offline = jnp.where(on_broker, ~(alive_arr & disk_ok), self.replica_offline)
+        return dataclasses.replace(self, broker_alive=new_alive, replica_offline=new_offline,
+                                   broker_disk_alive=new_disk_alive)
+
+
+@dataclasses.dataclass
+class ClusterMeta:
+    """Host-side (non-traced) companion: names and id mappings.
+
+    The reference keeps these inside the object graph (topic strings on
+    TopicPartition, logdir strings on Disk); here they stay off-device so the
+    pytree is purely numeric.
+    """
+    topic_names: list[str]
+    partition_ids: list[tuple[str, int]]     # global partition index -> (topic, partition)
+    broker_ids: list[int]                    # broker axis index -> external broker id
+    rack_ids: list[str]                      # rack index -> rack id string
+    logdirs: list[list[str]]                 # per broker: disk index -> logdir path
+    num_racks: int
+    num_valid_replicas: int
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def broker_index(self, broker_id: int) -> int:
+        return self.broker_ids.index(broker_id)
+
+    def partition_index(self, topic: str, partition: int) -> int:
+        return self.partition_ids.index((topic, partition))
+
+
+def replica_assignment(ct: ClusterTensor) -> np.ndarray:
+    """Host-side snapshot [R] of replica -> broker for proposal diffing."""
+    return np.asarray(ct.replica_broker)
+
+
+def leadership_assignment(ct: ClusterTensor) -> np.ndarray:
+    return np.asarray(ct.replica_is_leader)

@@ -1,0 +1,163 @@
+"""Deterministic cluster fixtures for optimizer tests.
+
+Analogue of the reference's test fixture factory
+(cruise-control/src/test/java/com/linkedin/kafka/cruisecontrol/common/
+DeterministicCluster.java:32): small hand-built topologies with known
+imbalance used by DeterministicClusterTest and the BASELINE config-1 run.
+Topology shapes mirror the reference's (RACK_BY_BROKER = {0:0, 1:0, 2:1},
+two-broker 'unbalanced' clusters with linearly-varying partition loads,
+homogeneous capacity TYPICAL_CPU=100 / LARGE=300000 / MEDIUM=200000); the
+builder API and load rows are our own.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.builder import ClusterModelBuilder
+
+# Reference TestConstants.java values (shape parity for fixtures)
+TYPICAL_CPU_CAPACITY = 100.0
+LARGE_BROKER_CAPACITY = 300_000.0
+MEDIUM_BROKER_CAPACITY = 200_000.0
+
+BROKER_CAPACITY = {
+    Resource.CPU: TYPICAL_CPU_CAPACITY,
+    Resource.DISK: LARGE_BROKER_CAPACITY,
+    Resource.NW_IN: LARGE_BROKER_CAPACITY,
+    Resource.NW_OUT: MEDIUM_BROKER_CAPACITY,
+}
+
+# rack layouts (DeterministicCluster.RACK_BY_BROKER{,2,3})
+RACK_BY_BROKER = {0: "0", 1: "0", 2: "1"}
+RACK_BY_BROKER2 = {0: "0", 1: "1", 2: "1"}
+RACK_BY_BROKER3 = {0: "0", 1: "1", 2: "1", 3: "1"}
+
+
+def _homogeneous(rack_by_broker: dict, capacity=None, logdirs=None) -> ClusterModelBuilder:
+    b = ClusterModelBuilder()
+    for broker_id, rack in rack_by_broker.items():
+        b.add_broker(broker_id, rack, capacity=capacity or BROKER_CAPACITY, logdirs=logdirs)
+    return b
+
+
+def small_cluster():
+    """3 brokers / 2 racks, 2 topics x 2 partitions, RF=2, modest imbalance.
+
+    Role of DeterministicCluster.smallClusterModel: a well-formed baseline
+    topology for goal unit tests.
+    """
+    b = _homogeneous(RACK_BY_BROKER)
+    # loads: [cpu%, nw_in, nw_out, disk]
+    loads = {
+        ("A", 0): [10.0, 1000.0, 2000.0, 30000.0],
+        ("A", 1): [8.0, 800.0, 1500.0, 25000.0],
+        ("B", 0): [6.0, 600.0, 1200.0, 20000.0],
+        ("B", 1): [4.0, 400.0, 800.0, 15000.0],
+    }
+    assignment = {
+        ("A", 0): [0, 1],
+        ("A", 1): [0, 2],
+        ("B", 0): [0, 1],
+        ("B", 1): [0, 2],
+    }
+    for (t, p), brokers in assignment.items():
+        for i, broker in enumerate(brokers):
+            b.add_replica(t, p, broker, is_leader=(i == 0), load=loads[(t, p)])
+    return b.build()
+
+
+def unbalanced_two_brokers(num_partitions: int = 8, topics=("T1",)):
+    """2 brokers / 2 racks / 2 logdirs each; all RF=1 replicas crowd broker 0
+    (partitions > 3 land on broker 1).
+
+    Role of DeterministicCluster.unbalanced4/5 (createUnbalanced,
+    DeterministicCluster.java:80-106): linearly varying loads
+    cap/5 + cap/50 * (i/2 - 1.5).
+    """
+    rack_by_broker = {0: "0", 1: "1"}
+    b = _homogeneous(rack_by_broker, logdirs=["/mnt/i00", "/mnt/i01"])
+    for topic in topics:
+        for i in range(num_partitions):
+            broker = 1 if i > 3 else 0
+            logdir = "/mnt/i00" if i % 4 < 2 else "/mnt/i01"
+            f = i / 2.0 - 1.5
+            load = [TYPICAL_CPU_CAPACITY / 5 + TYPICAL_CPU_CAPACITY / 50 * f,
+                    LARGE_BROKER_CAPACITY / 5 + LARGE_BROKER_CAPACITY / 50 * f,
+                    MEDIUM_BROKER_CAPACITY / 5 + MEDIUM_BROKER_CAPACITY / 50 * f,
+                    LARGE_BROKER_CAPACITY / 5 + LARGE_BROKER_CAPACITY / 50 * f]
+            b.add_replica(topic, i, broker, is_leader=True, load=load, logdir=logdir)
+    return b.build()
+
+
+def leaders_skewed():
+    """2 topics x 1 partition, RF=2; both leaders on broker 0, broker 2 empty
+    (role of DeterministicCluster.unbalanced3: leadership imbalance)."""
+    b = _homogeneous(RACK_BY_BROKER)
+    load = [TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+            MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2]
+    for t in ("T1", "T2"):
+        b.add_replica(t, 0, broker_id=0, is_leader=True, load=load)
+        b.add_replica(t, 0, broker_id=1, is_leader=False, load=load)
+    return b.build()
+
+
+def rack_violated():
+    """RF=2 partitions with both replicas in rack '0' (brokers 0,1) while
+    rack '1' (broker 2) is free — RackAwareGoal must move one replica of each.
+    """
+    b = _homogeneous(RACK_BY_BROKER)
+    load = [5.0, 500.0, 1000.0, 10_000.0]
+    for p in range(2):
+        b.add_replica("T1", p, broker_id=0, is_leader=True, load=load)
+        b.add_replica("T1", p, broker_id=1, is_leader=False, load=load)
+    return b.build()
+
+
+def dead_broker_cluster():
+    """small_cluster with broker 1 dead: its replicas are offline and must be
+    relocated by self-healing (RandomSelfHealingTest role)."""
+    b = _homogeneous(RACK_BY_BROKER)
+    loads = {
+        ("A", 0): [10.0, 1000.0, 2000.0, 30000.0],
+        ("A", 1): [8.0, 800.0, 1500.0, 25000.0],
+        ("B", 0): [6.0, 600.0, 1200.0, 20000.0],
+        ("B", 1): [4.0, 400.0, 800.0, 15000.0],
+    }
+    assignment = {
+        ("A", 0): [0, 1],
+        ("A", 1): [0, 2],
+        ("B", 0): [0, 1],
+        ("B", 1): [0, 2],
+    }
+    for (t, p), brokers in assignment.items():
+        for i, broker in enumerate(brokers):
+            b.add_replica(t, p, broker, is_leader=(i == 0), load=loads[(t, p)])
+    ct, meta = b.build()
+    ct = ct.set_broker_alive(meta.broker_index(1), False)
+    return ct, meta
+
+
+def capacity_violated():
+    """Broker 0 pushed over the DISK capacity threshold (0.8 x cap) while
+    brokers 1-2 are near-empty; CapacityGoal must shed load."""
+    b = _homogeneous(RACK_BY_BROKER)
+    # 6 RF=1 partitions of 45,000 MB each on broker 0 => 270,000 > 0.8*300,000
+    for p in range(6):
+        b.add_replica("T1", p, broker_id=0, is_leader=True,
+                      load=[2.0, 100.0, 200.0, 45_000.0])
+    b.add_replica("T2", 0, broker_id=1, is_leader=True, load=[1.0, 50.0, 100.0, 5_000.0])
+    b.add_replica("T2", 1, broker_id=2, is_leader=True, load=[1.0, 50.0, 100.0, 5_000.0])
+    return b.build()
+
+
+def jbod_cluster():
+    """2 brokers x 2 logdirs with one crowded disk (intra-broker goal target)."""
+    rack_by_broker = {0: "0", 1: "1"}
+    b = _homogeneous(rack_by_broker, logdirs=["/mnt/i00", "/mnt/i01"])
+    for p in range(6):
+        b.add_replica("T1", p, broker_id=0, is_leader=True,
+                      load=[2.0, 100.0, 200.0, 30_000.0], logdir="/mnt/i00")
+    b.add_replica("T2", 0, broker_id=1, is_leader=True,
+                  load=[1.0, 50.0, 100.0, 5_000.0], logdir="/mnt/i01")
+    return b.build()

@@ -1,0 +1,108 @@
+"""Randomized synthetic cluster generator.
+
+Analogue of the reference's property-test generator
+(cruise-control/src/test/java/com/linkedin/kafka/cruisecontrol/model/
+RandomCluster.java:36 — generate :53, populate :102) which drives
+RandomClusterTest / RandomSelfHealingTest and the BASELINE scale ladder
+(100/10k -> 1k/100k -> 7k/1M). Load distributions: exponential, linear or
+uniform per-resource, mirroring RandomCluster's ClusterProperty knobs.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.builder import ClusterModelBuilder
+
+
+@dataclasses.dataclass
+class RandomClusterSpec:
+    """ClusterProperty analogue (common/ClusterProperty in reference tests)."""
+    num_brokers: int = 40
+    num_racks: int = 10
+    num_topics: int = 50
+    num_partitions: int = 1000          # total partitions across topics
+    min_replication: int = 1
+    max_replication: int = 3
+    mean_cpu: float = 1.0               # mean per-replica CPU %
+    mean_disk: float = 100.0            # MB
+    mean_nw_in: float = 100.0           # KB/s
+    mean_nw_out: float = 100.0
+    distribution: str = "exponential"   # exponential | linear | uniform
+    cpu_capacity: float = 100.0
+    disk_capacity: float = 500_000.0
+    nw_in_capacity: float = 50_000.0
+    nw_out_capacity: float = 50_000.0
+    num_dead_brokers: int = 0
+    num_brokers_with_dead_disk: int = 0
+    logdirs_per_broker: int = 1
+    leader_to_follower_ratio: float = 2.0   # unused when builder splits loads
+    skew: float = 0.0                   # extra placement skew toward low-id brokers
+    seed: int = 3140                    # TestConstants.SEED_BASE
+
+
+def _sample(rng: np.random.Generator, dist: str, mean: float, n: int) -> np.ndarray:
+    if dist == "exponential":
+        return rng.exponential(mean, n)
+    if dist == "linear":
+        return mean * 2.0 * rng.uniform(0.0, 1.0, n)
+    if dist == "uniform":
+        return rng.uniform(0.5 * mean, 1.5 * mean, n)
+    raise ValueError(f"unknown distribution {dist!r}")
+
+
+def generate(spec: RandomClusterSpec):
+    """Build a (ClusterTensor, ClusterMeta) random cluster per spec."""
+    rng = np.random.default_rng(spec.seed)
+    b = ClusterModelBuilder()
+    capacity = {Resource.CPU: spec.cpu_capacity, Resource.DISK: spec.disk_capacity,
+                Resource.NW_IN: spec.nw_in_capacity, Resource.NW_OUT: spec.nw_out_capacity}
+    logdirs = [f"/mnt/i{d:02d}" for d in range(spec.logdirs_per_broker)]
+    dead_brokers = set(rng.choice(spec.num_brokers, spec.num_dead_brokers, replace=False).tolist()) \
+        if spec.num_dead_brokers else set()
+    dead_disk_brokers = set()
+    if spec.num_brokers_with_dead_disk:
+        if spec.logdirs_per_broker < 2:
+            raise ValueError("num_brokers_with_dead_disk requires logdirs_per_broker >= 2 "
+                             "(a broker's only disk dying is broker death, not disk failure)")
+        pool = [x for x in range(spec.num_brokers) if x not in dead_brokers]
+        dead_disk_brokers = set(rng.choice(pool, spec.num_brokers_with_dead_disk,
+                                           replace=False).tolist())
+    for broker in range(spec.num_brokers):
+        b.add_broker(broker, rack=f"r{broker % spec.num_racks}", capacity=capacity,
+                     alive=broker not in dead_brokers, logdirs=logdirs,
+                     dead_disks={logdirs[-1]} if broker in dead_disk_brokers and
+                                 spec.logdirs_per_broker > 1 else set())
+
+    # topic sizes ~ popularity-weighted (TOPIC_POPULARITY_SEED role)
+    popularity = rng.exponential(1.0, spec.num_topics)
+    popularity /= popularity.sum()
+    parts_per_topic = np.maximum(1, np.round(popularity * spec.num_partitions).astype(int))
+
+    # placement: round-robin start offset + optional skew toward low broker ids
+    broker_order = np.arange(spec.num_brokers)
+    for t in range(spec.num_topics):
+        n_parts = int(parts_per_topic[t])
+        rf = int(rng.integers(spec.min_replication, spec.max_replication + 1))
+        rf = min(rf, spec.num_brokers)
+        cpu = _sample(rng, spec.distribution, spec.mean_cpu, n_parts)
+        disk = _sample(rng, spec.distribution, spec.mean_disk, n_parts)
+        nw_in = _sample(rng, spec.distribution, spec.mean_nw_in, n_parts)
+        nw_out = _sample(rng, spec.distribution, spec.mean_nw_out, n_parts)
+        for p in range(n_parts):
+            if spec.skew > 0:
+                # biased sample without replacement: favors low-indexed brokers
+                w = np.exp(-spec.skew * broker_order / spec.num_brokers)
+                w /= w.sum()
+                brokers = rng.choice(spec.num_brokers, rf, replace=False, p=w)
+            else:
+                start = int(rng.integers(spec.num_brokers))
+                brokers = [(start + k) % spec.num_brokers for k in range(rf)]
+            load = [cpu[p], nw_in[p], nw_out[p], disk[p]]
+            for i, broker in enumerate(brokers):
+                logdir = logdirs[int(rng.integers(spec.logdirs_per_broker))]
+                b.add_replica(f"topic{t}", p, int(broker), is_leader=(i == 0),
+                              load=load, logdir=logdir)
+    return b.build()

@@ -1,0 +1,87 @@
+"""ClusterModelStats analogue.
+
+Reference: model/ClusterModelStats.java:30-44 computes per-resource
+AVG/MAX/MIN/ST_DEV over alive brokers, replica-count stats, topic-replica
+stats and potential-NW-out stats; goals use these via their
+ClusterModelStatsComparator to assert no regression after optimization
+(AbstractGoal.java:110-119). Here it's one jitted pure function over the
+ClusterTensor producing a flat stats pytree.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.cluster_tensor import ClusterTensor
+
+Array = jax.Array
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["avg", "max", "min", "std",
+                      "replica_count_avg", "replica_count_max", "replica_count_min",
+                      "replica_count_std", "leader_count_avg", "leader_count_max",
+                      "potential_nw_out_avg", "potential_nw_out_max", "potential_nw_out_std",
+                      "num_alive_brokers", "num_replicas", "num_offline_replicas"],
+         meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class ClusterStats:
+    avg: Array   # f32[M] mean broker utilization over alive brokers
+    max: Array   # f32[M]
+    min: Array   # f32[M]
+    std: Array   # f32[M]
+    replica_count_avg: Array
+    replica_count_max: Array
+    replica_count_min: Array
+    replica_count_std: Array
+    leader_count_avg: Array
+    leader_count_max: Array
+    potential_nw_out_avg: Array
+    potential_nw_out_max: Array
+    potential_nw_out_std: Array
+    num_alive_brokers: Array
+    num_replicas: Array
+    num_offline_replicas: Array
+
+
+@jax.jit
+def cluster_stats(ct: ClusterTensor) -> ClusterStats:
+    util = ct.broker_utilization()                          # [B, M]
+    alive = ct.broker_alive
+    n_alive = jnp.maximum(jnp.sum(alive), 1)
+    alive_f = alive.astype(util.dtype)[:, None]
+
+    def _stats(x):
+        mean = jnp.sum(x * alive_f, axis=0) / n_alive
+        mx = jnp.max(jnp.where(alive[:, None], x, -jnp.inf), axis=0)
+        mn = jnp.min(jnp.where(alive[:, None], x, jnp.inf), axis=0)
+        var = jnp.sum(((x - mean) ** 2) * alive_f, axis=0) / n_alive
+        return mean, mx, mn, jnp.sqrt(var)
+
+    mean, mx, mn, std = _stats(util)
+    counts = ct.broker_replica_count().astype(util.dtype)
+    cmean = jnp.sum(counts * alive) / n_alive
+    cmax = jnp.max(jnp.where(alive, counts, -jnp.inf))
+    cmin = jnp.min(jnp.where(alive, counts, jnp.inf))
+    cstd = jnp.sqrt(jnp.sum(((counts - cmean) ** 2) * alive) / n_alive)
+    lcounts = ct.broker_leader_count().astype(util.dtype)
+    lmean = jnp.sum(lcounts * alive) / n_alive
+    lmax = jnp.max(jnp.where(alive, lcounts, -jnp.inf))
+    pot = ct.potential_leader_load()[:, Resource.NW_OUT]
+    pmean = jnp.sum(pot * alive) / n_alive
+    pmax = jnp.max(jnp.where(alive, pot, -jnp.inf))
+    pstd = jnp.sqrt(jnp.sum(((pot - pmean) ** 2) * alive) / n_alive)
+
+    return ClusterStats(
+        avg=mean, max=mx, min=mn, std=std,
+        replica_count_avg=cmean, replica_count_max=cmax, replica_count_min=cmin,
+        replica_count_std=cstd, leader_count_avg=lmean, leader_count_max=lmax,
+        potential_nw_out_avg=pmean, potential_nw_out_max=pmax, potential_nw_out_std=pstd,
+        num_alive_brokers=jnp.sum(alive),
+        num_replicas=jnp.sum(ct.replica_valid),
+        num_offline_replicas=jnp.sum(ct.replica_offline & ct.replica_valid),
+    )

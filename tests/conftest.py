@@ -1,0 +1,11 @@
+"""Test harness: force an 8-device virtual CPU platform so sharding/pjit
+paths are exercised without TPU hardware (the driver separately dry-runs
+multichip via __graft_entry__.dryrun_multichip)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep compile times sane in CI: 64-bit off (f32 everywhere, matching TPU).
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,54 @@
+import pytest
+
+from cruise_control_tpu.config import (
+    Config, ConfigDef, ConfigException, Type, cruise_control_config,
+)
+
+
+def test_defaults_parse():
+    cfg = cruise_control_config()
+    assert cfg.get_double("cpu.balance.threshold") == 1.10
+    assert cfg.get_double("cpu.capacity.threshold") == 0.7
+    assert cfg.get_double("disk.capacity.threshold") == 0.8
+    assert cfg.get_int("max.replicas.per.broker") == 10000
+    assert cfg.get_list("goals")[0] == "RackAwareGoal"
+    assert "ReplicaCapacityGoal" in cfg.get_list("hard.goals")
+
+
+def test_override_and_coercion():
+    cfg = cruise_control_config({"cpu.balance.threshold": "1.3",
+                                 "max.replicas.per.broker": "500",
+                                 "self.healing.enabled": "true"})
+    assert cfg.get_double("cpu.balance.threshold") == 1.3
+    assert cfg.get_int("max.replicas.per.broker") == 500
+    assert cfg.get_boolean("self.healing.enabled") is True
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ConfigException):
+        cruise_control_config({"not.a.key": 1})
+
+
+def test_validator_rejects():
+    with pytest.raises(ConfigException):
+        cruise_control_config({"cpu.balance.threshold": 0.5})  # < 1.0
+
+
+def test_hard_goals_must_be_subset():
+    with pytest.raises(ConfigException):
+        cruise_control_config({"goals": "RackAwareGoal",
+                               "hard.goals": "RackAwareGoal,DiskCapacityGoal"})
+
+
+def test_pluggable_instance_loading():
+    d = ConfigDef().define(name="x.class", type=Type.CLASS,
+                           default="collections.OrderedDict")
+    cfg = Config(d)
+    inst = cfg.get_configured_instance("x.class")
+    from collections import OrderedDict
+    assert isinstance(inst, OrderedDict)
+
+
+def test_list_parsing():
+    d = ConfigDef().define(name="l", type=Type.LIST, default="a, b,c")
+    assert Config(d)["l"] == ["a", "b", "c"]

@@ -1,0 +1,135 @@
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model import cluster_stats, sanity_check
+from cruise_control_tpu.model.fixtures import (
+    BROKER_CAPACITY, capacity_violated, dead_broker_cluster, jbod_cluster,
+    leaders_skewed, rack_violated, small_cluster, unbalanced_two_brokers,
+)
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate
+from cruise_control_tpu.model.sanity import SanityCheckError
+
+
+def test_small_cluster_shapes():
+    ct, meta = small_cluster()
+    assert ct.num_brokers == 3
+    assert ct.num_partitions == 4
+    assert int(ct.replica_valid.sum()) == 8
+    assert meta.num_racks == 2
+    sanity_check(ct)
+
+
+def test_broker_utilization_and_leadership():
+    ct, meta = small_cluster()
+    util = np.asarray(ct.broker_utilization())
+    # all leaders are on broker 0; broker 0 carries full leader loads
+    total_cpu_leaders = 10.0 + 8.0 + 6.0 + 4.0
+    assert util[0, Resource.CPU] == pytest.approx(total_cpu_leaders, rel=1e-5)
+    # followers carry no NW_OUT
+    assert util[1, Resource.NW_OUT] == pytest.approx(0.0, abs=1e-6)
+    assert util[2, Resource.NW_OUT] == pytest.approx(0.0, abs=1e-6)
+    # DISK identical for leader and follower
+    assert util[1, Resource.DISK] == pytest.approx(30000.0 + 20000.0, rel=1e-5)
+
+
+def test_move_replica_updates_util():
+    ct, meta = small_cluster()
+    util0 = np.asarray(ct.broker_utilization())
+    # replica 0 = (A,0) leader on broker 0; move to broker 2 is illegal (dup partition?
+    # (A,0) lives on brokers 0,1 so broker 2 is legal)
+    ct2 = ct.move_replica(0, 2)
+    util1 = np.asarray(ct2.broker_utilization())
+    assert util1[0, Resource.DISK] == pytest.approx(util0[0, Resource.DISK] - 30000.0, rel=1e-5)
+    assert util1[2, Resource.DISK] == pytest.approx(util0[2, Resource.DISK] + 30000.0, rel=1e-5)
+    sanity_check(ct2)
+
+
+def test_move_leadership_transfers_nw_out():
+    ct, meta = leaders_skewed()
+    util0 = np.asarray(ct.broker_utilization())
+    assert util0[1, Resource.NW_OUT] == pytest.approx(0.0, abs=1e-6)
+    # leadership of T1-0: replica 0 (broker 0, leader) -> replica 1 (broker 1)
+    ct2 = ct.move_leadership(0, 1)
+    util1 = np.asarray(ct2.broker_utilization())
+    assert util1[1, Resource.NW_OUT] > 0
+    assert util1[0, Resource.NW_OUT] < util0[0, Resource.NW_OUT]
+    sanity_check(ct2)
+
+
+def test_swap_replicas():
+    ct, meta = unbalanced_two_brokers()
+    r_on_0 = int(np.flatnonzero(np.asarray(ct.replica_broker) == 0)[0])
+    r_on_1 = int(np.flatnonzero(np.asarray(ct.replica_broker) == 1)[0])
+    ct2 = ct.swap_replicas(r_on_0, r_on_1)
+    assert int(ct2.replica_broker[r_on_0]) == 1
+    assert int(ct2.replica_broker[r_on_1]) == 0
+    sanity_check(ct2)
+
+
+def test_dead_broker_offline_replicas():
+    ct, meta = dead_broker_cluster()
+    offline = np.asarray(ct.replica_offline & ct.replica_valid)
+    broker = np.asarray(ct.replica_broker)
+    b1 = meta.broker_index(1)
+    assert offline.sum() == (broker[np.asarray(ct.replica_valid)] == b1).sum()
+    sanity_check(ct)
+    # moving an offline replica away clears its offline flag
+    r = int(np.flatnonzero(offline)[0])
+    ct2 = ct.move_replica(r, 0)
+    assert not bool(ct2.replica_offline[r])
+
+
+def test_partition_rack_count():
+    ct, meta = rack_violated()
+    prc = np.asarray(ct.partition_rack_count(meta.num_racks))
+    # both replicas of each partition in rack 0
+    assert (prc[:2, 0] == 2).all()
+    assert (prc[:2, 1] == 0).all()
+
+
+def test_topic_broker_counts():
+    ct, meta = small_cluster()
+    tbc = np.asarray(ct.topic_broker_count())
+    assert tbc.sum() == 8
+    tlbc = np.asarray(ct.topic_leader_broker_count())
+    assert tlbc.sum() == 4   # 4 partitions, 1 leader each
+
+
+def test_jbod_disk_utilization():
+    ct, meta = jbod_cluster()
+    du = np.asarray(ct.broker_disk_utilization())
+    assert du[0, 0] == pytest.approx(6 * 30_000.0, rel=1e-5)
+    assert du[0, 1] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_cluster_stats():
+    ct, meta = capacity_violated()
+    st = cluster_stats(ct)
+    assert float(st.num_alive_brokers) == 3
+    assert float(st.max[Resource.DISK]) == pytest.approx(270_000.0, rel=1e-5)
+    assert float(st.replica_count_max) == 6
+
+
+def test_sanity_catches_double_leader():
+    ct, meta = small_cluster()
+    bad = ct.move_leadership(1, 1)  # makes replica 1 leader while replica 0 still leads A-0
+    with pytest.raises(SanityCheckError):
+        sanity_check(bad)
+
+
+def test_random_cluster_generation():
+    ct, meta = generate(RandomClusterSpec(num_brokers=10, num_racks=3, num_topics=5,
+                                          num_partitions=50, seed=42))
+    sanity_check(ct)
+    st = cluster_stats(ct)
+    assert float(st.num_alive_brokers) == 10
+    assert int(st.num_replicas) > 50
+
+
+def test_random_cluster_dead_brokers():
+    ct, meta = generate(RandomClusterSpec(num_brokers=10, num_racks=3, num_topics=5,
+                                          num_partitions=50, num_dead_brokers=2, seed=7))
+    sanity_check(ct)
+    assert int(np.asarray(ct.broker_alive).sum()) == 8
+    assert int(cluster_stats(ct).num_offline_replicas) > 0
