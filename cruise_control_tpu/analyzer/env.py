@@ -1,0 +1,207 @@
+"""Static optimization environment.
+
+Everything the goal kernels need that does NOT change while optimizing:
+per-replica leader/follower loads, capacities, rack map, the partition->replica
+membership table, exclusion masks and the balancing thresholds. The mutable
+part (assignment, leadership, derived utilization) lives in
+``state.EngineState``.
+
+The partition->replica table ``partition_replicas`` [P, F] (F = max replication
+factor, -1 padded) is the tensor replacement for the reference's object links
+(model/Partition.java replica list). Replica membership in partitions never
+changes during optimization — only broker placement and leadership do — so the
+table is static and gives O(F) per-candidate duplicate-broker and
+follower-lookup checks instead of per-candidate scans over all R replicas.
+
+Reference semantics carried here:
+- BalancingConstraint (analyzer/BalancingConstraint.java): balance %s,
+  capacity thresholds, low-utilization thresholds, max replicas per broker.
+- balance margin math (analyzer/goals/GoalUtils.java:515,
+  ResourceDistributionGoal.java BALANCE_MARGIN=0.9,
+  ReplicaDistributionAbstractGoal.java BALANCE_MARGIN=0.9).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_RESOURCES, Resource
+from cruise_control_tpu.model.cluster_tensor import ClusterMeta, ClusterTensor
+
+Array = jax.Array
+
+BALANCE_MARGIN = 0.9  # ResourceDistributionGoal.java:57 / ReplicaDistributionAbstractGoal.java:30
+
+
+@dataclasses.dataclass(frozen=True)
+class BalancingConstraint:
+    """Hashable, static constraint bundle (BalancingConstraint.java)."""
+    resource_balance_percentage: tuple = (1.10, 1.10, 1.10, 1.10)   # indexed by Resource
+    capacity_threshold: tuple = (0.7, 0.8, 0.8, 0.8)
+    low_utilization_threshold: tuple = (0.0, 0.0, 0.0, 0.0)
+    max_replicas_per_broker: int = 10_000
+    replica_balance_percentage: float = 1.10
+    leader_replica_balance_percentage: float = 1.10
+    topic_replica_balance_percentage: float = 3.00
+    topic_replica_balance_min_gap: int = 2
+    topic_replica_balance_max_gap: int = 40
+    goal_violation_distribution_threshold_multiplier: float = 1.0
+    min_topic_leaders_per_broker: int = 0
+
+    @classmethod
+    def from_config(cls, cfg) -> "BalancingConstraint":
+        res_bal = tuple(cfg.get_double(f"{n}.balance.threshold")
+                        for n in ("cpu", "network.inbound", "network.outbound", "disk"))
+        cap = tuple(cfg.get_double(f"{n}.capacity.threshold")
+                    for n in ("cpu", "network.inbound", "network.outbound", "disk"))
+        low = tuple(cfg.get_double(f"{n}.low.utilization.threshold")
+                    for n in ("cpu", "network.inbound", "network.outbound", "disk"))
+        return cls(
+            resource_balance_percentage=res_bal,
+            capacity_threshold=cap,
+            low_utilization_threshold=low,
+            max_replicas_per_broker=cfg.get_int("max.replicas.per.broker"),
+            replica_balance_percentage=cfg.get_double("replica.count.balance.threshold"),
+            leader_replica_balance_percentage=cfg.get_double("leader.replica.count.balance.threshold"),
+            topic_replica_balance_percentage=cfg.get_double("topic.replica.count.balance.threshold"),
+            topic_replica_balance_min_gap=cfg.get_int("topic.replica.count.balance.min.gap"),
+            topic_replica_balance_max_gap=cfg.get_int("topic.replica.count.balance.max.gap"),
+            goal_violation_distribution_threshold_multiplier=
+                cfg.get_double("goal.violation.distribution.threshold.multiplier"),
+            min_topic_leaders_per_broker=cfg.get_int("min.topic.leaders.per.broker"),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizationOptions:
+    """Static per-run options (analyzer/OptimizationOptions.java)."""
+    triggered_by_goal_violation: bool = False
+    fix_offline_replicas_only: bool = False
+    fast_mode: bool = False
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["leader_load", "follower_load", "broker_capacity", "broker_rack",
+                      "broker_alive", "broker_new", "broker_demoted",
+                      "broker_excluded_for_replica_move", "broker_excluded_for_leadership",
+                      "broker_disk_capacity", "broker_disk_alive",
+                      "replica_partition", "replica_topic", "replica_valid",
+                      "replica_original_broker", "partition_replicas", "partition_topic",
+                      "topic_excluded", "topic_min_leaders", "dst_candidate"],
+         meta_fields=["num_racks", "max_rf"])
+@dataclasses.dataclass(frozen=True)
+class ClusterEnv:
+    leader_load: Array          # f32[R, M]
+    follower_load: Array        # f32[R, M]
+    broker_capacity: Array      # f32[B, M]
+    broker_rack: Array          # i32[B]
+    broker_alive: Array         # bool[B]
+    broker_new: Array           # bool[B]
+    broker_demoted: Array       # bool[B]
+    broker_excluded_for_replica_move: Array   # bool[B]
+    broker_excluded_for_leadership: Array     # bool[B]
+    broker_disk_capacity: Array  # f32[B, D]
+    broker_disk_alive: Array     # bool[B, D]
+    replica_partition: Array    # i32[R]
+    replica_topic: Array        # i32[R]
+    replica_valid: Array        # bool[R]
+    replica_original_broker: Array  # i32[R]
+    partition_replicas: Array   # i32[P, F] replica indices, -1 padded
+    partition_topic: Array      # i32[P]
+    topic_excluded: Array       # bool[T]
+    topic_min_leaders: Array    # bool[T] topics subject to MinTopicLeadersPerBrokerGoal
+    dst_candidate: Array        # bool[B] allowed destination brokers (alive, not excluded)
+    num_racks: int
+    max_rf: int
+
+    @property
+    def num_brokers(self) -> int:
+        return self.broker_capacity.shape[0]
+
+    @property
+    def num_replicas(self) -> int:
+        return self.leader_load.shape[0]
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partition_replicas.shape[0]
+
+
+def build_partition_replicas(ct: ClusterTensor) -> np.ndarray:
+    """[P, F] replica-index membership table from the (static) partition ids."""
+    part = np.asarray(ct.replica_partition)
+    valid = np.asarray(ct.replica_valid)
+    P = ct.num_partitions
+    members: list[list[int]] = [[] for _ in range(P)]
+    for j in np.flatnonzero(valid):
+        members[part[j]].append(int(j))
+    F = max((len(m) for m in members), default=1) or 1
+    table = np.full((P, F), -1, np.int32)
+    for p, m in enumerate(members):
+        table[p, :len(m)] = m
+    return table
+
+
+def make_env(ct: ClusterTensor, meta: ClusterMeta,
+             topic_min_leaders_mask: np.ndarray | None = None) -> ClusterEnv:
+    table = build_partition_replicas(ct)
+    T = ct.num_topics
+    tml = (np.zeros(T, bool) if topic_min_leaders_mask is None
+           else np.asarray(topic_min_leaders_mask, bool))
+    dst_ok = np.asarray(ct.broker_alive) & ~np.asarray(ct.broker_excluded_for_replica_move)
+    return ClusterEnv(
+        leader_load=ct.leader_load,
+        follower_load=ct.follower_load,
+        broker_capacity=ct.broker_capacity,
+        broker_rack=ct.broker_rack,
+        broker_alive=ct.broker_alive,
+        broker_new=ct.broker_new,
+        broker_demoted=ct.broker_demoted,
+        broker_excluded_for_replica_move=ct.broker_excluded_for_replica_move,
+        broker_excluded_for_leadership=ct.broker_excluded_for_leadership,
+        broker_disk_capacity=ct.broker_disk_capacity,
+        broker_disk_alive=ct.broker_disk_alive,
+        replica_partition=ct.replica_partition,
+        replica_topic=ct.replica_topic,
+        replica_valid=ct.replica_valid,
+        replica_original_broker=ct.replica_original_broker,
+        partition_replicas=jnp.asarray(table),
+        partition_topic=ct.partition_topic,
+        topic_excluded=ct.topic_excluded,
+        topic_min_leaders=jnp.asarray(tml),
+        dst_candidate=jnp.asarray(dst_ok),
+        num_racks=meta.num_racks,
+        max_rf=int(table.shape[1]),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Threshold math (GoalUtils.java:515 computeResourceUtilizationBalanceThreshold)
+# ---------------------------------------------------------------------------
+def balance_percentage_with_margin(constraint: BalancingConstraint, resource: int,
+                                   triggered_by_goal_violation: bool) -> float:
+    pct = constraint.resource_balance_percentage[resource]
+    if triggered_by_goal_violation:
+        pct *= constraint.goal_violation_distribution_threshold_multiplier
+    return (pct - 1.0) * BALANCE_MARGIN
+
+
+def resource_balance_limits(avg_utilization_pct: Array, constraint: BalancingConstraint,
+                            resource: int, triggered_by_goal_violation: bool):
+    """(lower, upper) utilization-percentage thresholds for a resource.
+
+    avg_utilization_pct is a traced scalar (cluster total util / total capacity
+    over alive brokers); thresholds follow GoalUtils.java:515-545 incl. the
+    low-utilization special cases.
+    """
+    margin_pct = balance_percentage_with_margin(constraint, resource, triggered_by_goal_violation)
+    low_thresh = constraint.low_utilization_threshold[resource]
+    is_low = avg_utilization_pct <= low_thresh
+    lower = jnp.where(is_low, 0.0, avg_utilization_pct * jnp.maximum(0.0, 1.0 - margin_pct))
+    upper = avg_utilization_pct * (1.0 + margin_pct)
+    upper = jnp.where(is_low, jnp.maximum(upper, low_thresh * BALANCE_MARGIN), upper)
+    return lower, upper

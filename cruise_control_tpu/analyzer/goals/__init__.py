@@ -1,0 +1,76 @@
+"""Goal catalog + registry.
+
+Names match the reference's class names (analyzer/goals/*.java) so config
+lists like ``goals=RackAwareGoal,DiskCapacityGoal`` carry over verbatim.
+"""
+from __future__ import annotations
+
+from cruise_control_tpu.analyzer.env import BalancingConstraint, OptimizationOptions
+from cruise_control_tpu.analyzer.goals.base import GoalKernel
+from cruise_control_tpu.analyzer.goals.capacity import (
+    CapacityGoal, CpuCapacityGoal, DiskCapacityGoal, NetworkInboundCapacityGoal,
+    NetworkOutboundCapacityGoal, ReplicaCapacityGoal,
+)
+from cruise_control_tpu.analyzer.goals.distribution import (
+    CpuUsageDistributionGoal, DiskUsageDistributionGoal, LeaderReplicaDistributionGoal,
+    NetworkInboundUsageDistributionGoal, NetworkOutboundUsageDistributionGoal,
+    ReplicaDistributionGoal, ResourceDistributionGoal,
+)
+from cruise_control_tpu.analyzer.goals.leader_election import PreferredLeaderElectionGoal
+from cruise_control_tpu.analyzer.goals.network import (
+    LeaderBytesInDistributionGoal, PotentialNwOutGoal,
+)
+from cruise_control_tpu.analyzer.goals.rack import RackAwareDistributionGoal, RackAwareGoal
+from cruise_control_tpu.analyzer.goals.topic import (
+    MinTopicLeadersPerBrokerGoal, TopicReplicaDistributionGoal,
+)
+
+GOAL_CLASSES: dict[str, type] = {
+    "RackAwareGoal": RackAwareGoal,
+    "RackAwareDistributionGoal": RackAwareDistributionGoal,
+    "ReplicaCapacityGoal": ReplicaCapacityGoal,
+    "DiskCapacityGoal": DiskCapacityGoal,
+    "NetworkInboundCapacityGoal": NetworkInboundCapacityGoal,
+    "NetworkOutboundCapacityGoal": NetworkOutboundCapacityGoal,
+    "CpuCapacityGoal": CpuCapacityGoal,
+    "ReplicaDistributionGoal": ReplicaDistributionGoal,
+    "DiskUsageDistributionGoal": DiskUsageDistributionGoal,
+    "NetworkInboundUsageDistributionGoal": NetworkInboundUsageDistributionGoal,
+    "NetworkOutboundUsageDistributionGoal": NetworkOutboundUsageDistributionGoal,
+    "CpuUsageDistributionGoal": CpuUsageDistributionGoal,
+    "LeaderReplicaDistributionGoal": LeaderReplicaDistributionGoal,
+    "PotentialNwOutGoal": PotentialNwOutGoal,
+    "LeaderBytesInDistributionGoal": LeaderBytesInDistributionGoal,
+    "TopicReplicaDistributionGoal": TopicReplicaDistributionGoal,
+    "MinTopicLeadersPerBrokerGoal": MinTopicLeadersPerBrokerGoal,
+    "PreferredLeaderElectionGoal": PreferredLeaderElectionGoal,
+}
+
+
+def make_goal(name: str, constraint: BalancingConstraint | None = None,
+              options: OptimizationOptions | None = None) -> GoalKernel:
+    try:
+        cls = GOAL_CLASSES[name]
+    except KeyError:
+        raise ValueError(f"unknown goal {name!r}; known: {sorted(GOAL_CLASSES)}") from None
+    return cls(constraint=constraint or BalancingConstraint(),
+               options=options or OptimizationOptions())
+
+
+def make_goals(names, constraint=None, options=None) -> list[GoalKernel]:
+    return [make_goal(n, constraint, options) for n in names]
+
+
+__all__ = [
+    "GOAL_CLASSES", "GoalKernel", "make_goal", "make_goals",
+    "CapacityGoal", "CpuCapacityGoal", "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+    "ReplicaCapacityGoal", "ResourceDistributionGoal",
+    "CpuUsageDistributionGoal", "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal", "NetworkOutboundUsageDistributionGoal",
+    "ReplicaDistributionGoal", "LeaderReplicaDistributionGoal",
+    "RackAwareGoal", "RackAwareDistributionGoal",
+    "PotentialNwOutGoal", "LeaderBytesInDistributionGoal",
+    "TopicReplicaDistributionGoal", "MinTopicLeadersPerBrokerGoal",
+    "PreferredLeaderElectionGoal",
+]

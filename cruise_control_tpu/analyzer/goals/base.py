@@ -1,0 +1,213 @@
+"""Goal kernel SPI.
+
+Reference: the Goal interface (analyzer/goals/Goal.java:39-163 — optimize,
+actionAcceptance, stats comparator, isHardGoal) and the AbstractGoal template
+(AbstractGoal.java:45 — init -> per-broker rebalance loop -> monotonicity
+assertion; maybeApplyBalancingAction :224-266 = legitMove -> selfSatisfied ->
+acceptance-by-optimized-goals -> mutate).
+
+Here a goal is a frozen (hashable, jit-static) dataclass exposing pure
+functions over (ClusterEnv, EngineState):
+
+- ``broker_severity``  f32[B]: >0 where the goal needs work on that broker
+  (drives candidate-source selection; replaces brokersToBalance + the
+  per-broker while loop).
+- ``replica_key``      f32[R]: ranking of replicas worth moving for this goal
+  (-inf = not a candidate). Replaces the reference's sorted-replica scan
+  (SortedReplicas.java) with a top-k.
+- ``move_score``       f32[K, B]: improvement score for moving candidate k to
+  broker b; -inf where the move is not self-satisfied. Positive = progress.
+  This is the vectorized selfSatisfied + improvement ordering.
+- ``accept_move`` / ``accept_leadership``  bool[K, B] / bool[K, F]: the goal's
+  veto when it has ALREADY been optimized (ActionAcceptance ACCEPT vs
+  REPLICA_REJECT/BROKER_REJECT collapse to a boolean mask here).
+- leadership candidates via ``leader_key`` f32[R] and ``leadership_score``
+  f32[K, F] for goals that move leadership.
+- ``violated`` -> bool scalar: any broker violating (for OptimizerResult and
+  the goal-violation detector).
+
+The common legit-move mask (dst hosts no copy, topic not excluded, dst alive /
+allowed destination, offline-only filtering) is shared in
+:func:`legit_move_mask` — the analogue of AbstractGoal's legitMove +
+GoalUtils.filterReplicas.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.env import BalancingConstraint, ClusterEnv, OptimizationOptions
+from cruise_control_tpu.analyzer.state import EngineState
+
+Array = jax.Array
+NEG_INF = -jnp.inf
+
+
+@dataclasses.dataclass(frozen=True)
+class GoalKernel:
+    """Base goal. Subclasses override the kernel methods; all fields static."""
+    constraint: BalancingConstraint = BalancingConstraint()
+    options: OptimizationOptions = OptimizationOptions()
+
+    # --- identity ---
+    name: str = dataclasses.field(default="GoalKernel", init=False)
+    is_hard: bool = dataclasses.field(default=False, init=False)
+    uses_replica_moves: bool = dataclasses.field(default=True, init=False)
+    uses_leadership_moves: bool = dataclasses.field(default=False, init=False)
+    uses_swaps: bool = dataclasses.field(default=False, init=False)
+
+    # --- kernel methods (override) ---
+    def broker_severity(self, env: ClusterEnv, st: EngineState) -> Array:
+        raise NotImplementedError
+
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity: Array) -> Array:
+        """f32[R] candidate ranking; default: effective load magnitude of
+        replicas on positive-severity brokers (offline replicas get priority)."""
+        on_bad = severity[st.replica_broker] > 0
+        load = jnp.sum(st.effective_load(env), axis=1)
+        key = jnp.where(on_bad & env.replica_valid, load, NEG_INF)
+        return jnp.where(st.replica_offline & env.replica_valid, key + 1e12, key)
+
+    def move_score(self, env: ClusterEnv, st: EngineState, cand: Array) -> Array:
+        raise NotImplementedError
+
+    def accept_move(self, env: ClusterEnv, st: EngineState, cand: Array) -> Array:
+        """bool[K, B] veto as a previously-optimized goal. Default: accept."""
+        return jnp.ones((cand.shape[0], env.num_brokers), bool)
+
+    def leader_key(self, env: ClusterEnv, st: EngineState, severity: Array) -> Array:
+        return jnp.full(env.num_replicas, NEG_INF)
+
+    def leadership_score(self, env: ClusterEnv, st: EngineState, cand: Array) -> Array:
+        return jnp.full((cand.shape[0], env.max_rf), NEG_INF)
+
+    def accept_leadership(self, env: ClusterEnv, st: EngineState, cand: Array) -> Array:
+        """bool[K, F] veto of leadership transfer cand k -> its partition's
+        f-th replica, as a previously-optimized goal. Default: accept."""
+        return jnp.ones((cand.shape[0], env.max_rf), bool)
+
+    # --- swaps (SWAP balancing action, ResourceDistributionGoal.java:598-783) ---
+    def swap_out_key(self, env: ClusterEnv, st: EngineState, severity: Array) -> Array:
+        """f32[R] ranking of replicas to swap OUT of violating brokers."""
+        return jnp.full(env.num_replicas, NEG_INF)
+
+    def swap_in_key(self, env: ClusterEnv, st: EngineState, severity: Array) -> Array:
+        """f32[R] ranking of replicas to swap IN (from non-violating brokers)."""
+        return jnp.full(env.num_replicas, NEG_INF)
+
+    def swap_score(self, env: ClusterEnv, st: EngineState, cand_out: Array,
+                   cand_in: Array) -> Array:
+        return jnp.full((cand_out.shape[0], cand_in.shape[0]), NEG_INF)
+
+    def accept_swap(self, env: ClusterEnv, st: EngineState, cand_out: Array,
+                    cand_in: Array) -> Array:
+        """bool[K1, K2] veto of a swap as a previously-optimized goal.
+        Default: both directed moves must be individually acceptable
+        (conservative; net-aware goals override)."""
+        acc_out = self.accept_move(env, st, cand_out)          # [K1, B]
+        acc_in = self.accept_move(env, st, cand_in)            # [K2, B]
+        b_in = st.replica_broker[cand_in]                      # [K2]
+        b_out = st.replica_broker[cand_out]                    # [K1]
+        return acc_out[:, b_in] & acc_in[:, b_out].T
+
+    def violated(self, env: ClusterEnv, st: EngineState) -> Array:
+        return jnp.any(self.broker_severity(env, st) > 0)
+
+    # --- stats comparator (monotonicity; ClusterModelStatsComparator role) ---
+    def stat(self, env: ClusterEnv, st: EngineState) -> Array:
+        """Scalar the goal tries to reduce; optimizer asserts no increase."""
+        return jnp.sum(jnp.maximum(self.broker_severity(env, st), 0.0))
+
+
+def candidate_load(env: ClusterEnv, st: EngineState, cand: Array) -> Array:
+    """f32[K, M] current effective load rows of the candidate replicas."""
+    lead = st.replica_is_leader[cand][:, None]
+    return jnp.where(lead, env.leader_load[cand], env.follower_load[cand])
+
+
+def legit_move_mask(env: ClusterEnv, st: EngineState, cand: Array,
+                    options: OptimizationOptions) -> Array:
+    """bool[K, B] — the action-independent legitMove checks
+    (AbstractGoal.java:244-256 legit-move + GoalUtils.filterReplicas):
+
+    - destination is an allowed candidate broker (alive, not move-excluded)
+    - destination != current broker
+    - destination hosts no replica of the candidate's partition
+    - candidate replica is valid, and its topic isn't excluded (offline
+      replicas of excluded topics may still move — self-healing overrides)
+    - in fix-offline-only mode, only offline replicas move
+    - candidate slots that are top-k padding (key was -inf) are filtered by
+      the engine via score, not here
+    """
+    K = cand.shape[0]
+    B = env.num_brokers
+    dst_ok = jnp.broadcast_to(env.dst_candidate[None, :], (K, B))
+    cur = st.replica_broker[cand]
+    not_self = jnp.arange(B)[None, :] != cur[:, None]
+    # duplicate-partition check via the partition membership table: [K, F]
+    members = env.partition_replicas[env.replica_partition[cand]]          # i32[K, F]
+    member_valid = members >= 0
+    member_broker = st.replica_broker[jnp.clip(members, 0)]                # i32[K, F]
+    not_me = members != cand[:, None]
+    # broker b hosts a sibling replica iff any member (not the candidate itself)
+    # sits on b
+    sib_on = jnp.zeros((K, B), bool)
+    sib_on = sib_on.at[jnp.arange(K)[:, None], member_broker].max(
+        member_valid & not_me)
+    no_dup = ~sib_on
+    valid = env.replica_valid[cand]
+    offline = st.replica_offline[cand]
+    topic_ok = ~env.topic_excluded[env.replica_topic[cand]] | offline
+    replica_ok = valid & topic_ok
+    if options.fix_offline_replicas_only:
+        replica_ok = replica_ok & offline
+    return dst_ok & not_self & no_dup & replica_ok[:, None]
+
+
+def legit_swap_mask(env: ClusterEnv, st: EngineState, cand_out: Array,
+                    cand_in: Array) -> Array:
+    """bool[K1, K2] — legitimacy of swapping cand_out[i] <-> cand_in[j]:
+    different brokers, neither destination hosts a sibling of the incoming
+    partition, both replicas online+valid, topics not excluded, and both
+    brokers are allowed destinations."""
+    b_out = st.replica_broker[cand_out]                     # [K1]
+    b_in = st.replica_broker[cand_in]                       # [K2]
+    diff_broker = b_out[:, None] != b_in[None, :]
+
+    def sib_on(cand, brokers):
+        # [K, Kb]: does brokers[j] host a replica of cand[i]'s partition (≠ cand[i])?
+        members = env.partition_replicas[env.replica_partition[cand]]   # [K, F]
+        mvalid = members >= 0
+        mb = st.replica_broker[jnp.clip(members, 0)]                    # [K, F]
+        not_me = members != cand[:, None]
+        hit = (mb[:, :, None] == brokers[None, None, :]) & (mvalid & not_me)[:, :, None]
+        return jnp.any(hit, axis=1)                                     # [K, Kb]
+
+    out_ok = ~sib_on(cand_out, b_in)                        # [K1, K2] out's partition not on in's broker
+    in_ok = ~sib_on(cand_in, b_out).T                       # [K1, K2]
+    ok_r = (env.replica_valid & ~st.replica_offline
+            & ~env.topic_excluded[env.replica_topic])
+    dst_ok = env.dst_candidate[b_in][None, :] & env.dst_candidate[b_out][:, None]
+    return (diff_broker & out_ok & in_ok & dst_ok
+            & ok_r[cand_out][:, None] & ok_r[cand_in][None, :])
+
+
+def legit_leadership_mask(env: ClusterEnv, st: EngineState, cand: Array) -> Array:
+    """bool[K, F] — legit leadership-transfer targets for candidate leaders:
+    the f-th replica of the candidate's partition must exist, not be the
+    candidate, be online, and sit on an alive, non-demoted,
+    non-leadership-excluded broker."""
+    members = env.partition_replicas[env.replica_partition[cand]]          # [K, F]
+    member_valid = members >= 0
+    m = jnp.clip(members, 0)
+    not_me = members != cand[:, None]
+    dst_broker = st.replica_broker[m]
+    broker_ok = (env.broker_alive[dst_broker] & ~env.broker_demoted[dst_broker]
+                 & ~env.broker_excluded_for_leadership[dst_broker])
+    online = ~st.replica_offline[m]
+    src_is_leader = st.replica_is_leader[cand] & env.replica_valid[cand]
+    topic_ok = ~env.topic_excluded[env.replica_topic[cand]]
+    return (member_valid & not_me & broker_ok & online
+            & (src_is_leader & topic_ok)[:, None])

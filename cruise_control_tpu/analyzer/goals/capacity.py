@@ -1,0 +1,192 @@
+"""Hard capacity goals.
+
+Reference: analyzer/goals/CapacityGoal.java:479 (+ DiskCapacityGoal,
+NetworkInbound/OutboundCapacityGoal, CpuCapacityGoal subclasses) and
+ReplicaCapacityGoal.java:345. Semantics: every alive broker's utilization of
+the goal's resource must stay under ``capacity_threshold * capacity``
+(thresholds: CPU 0.7, others 0.8 — AnalyzerConfig defaults); replica counts
+under ``max.replicas.per.broker``. Dead brokers must end up empty (their
+replicas are offline candidates with priority).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.env import ClusterEnv
+from cruise_control_tpu.analyzer.goals.base import NEG_INF, GoalKernel, candidate_load
+from cruise_control_tpu.analyzer.state import EngineState
+
+from cruise_control_tpu.common.resources import EPSILON_ABS, RESOURCES
+
+# absolute violation tolerances per resource column (from the single source of
+# truth in common.resources, mirroring reference Resource.java epsilons)
+RESOURCE_EPS = jnp.asarray([EPSILON_ABS[r] for r in RESOURCES], jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class CapacityGoal(GoalKernel):
+    """Base for the four per-resource capacity goals. ``resource`` is the
+    Resource column index (static)."""
+    resource: int = 3  # DISK
+
+    def __post_init__(self):
+        object.__setattr__(self, "is_hard", True)
+        object.__setattr__(self, "uses_leadership_moves", True)
+
+    # -- helpers --
+    def _limit(self, env: ClusterEnv) -> jnp.ndarray:
+        """f32[B]: allowed utilization; 0 for dead brokers."""
+        thresh = self.constraint.capacity_threshold[self.resource]
+        return jnp.where(env.broker_alive, thresh * env.broker_capacity[:, self.resource], 0.0)
+
+    # -- kernel --
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        return st.util[:, self.resource] - self._limit(env) - RESOURCE_EPS[self.resource]
+
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity):
+        on_bad = severity[st.replica_broker] > 0
+        load = st.effective_load(env)[:, self.resource]
+        offline = st.replica_offline & env.replica_valid
+        movable = env.replica_valid & on_bad & ((load > 0) | offline)
+        key = jnp.where(movable, load, NEG_INF)
+        return jnp.where(offline, key + 1e12, key)
+
+    def move_score(self, env: ClusterEnv, st: EngineState, cand):
+        l = candidate_load(env, st, cand)[:, self.resource]          # [K]
+        limit = self._limit(env)                                      # [B]
+        util = st.util[:, self.resource]
+        feasible = (util[None, :] + l[:, None]) <= limit[None, :]
+        offline = st.replica_offline[cand]
+        # score: biggest load chunk first; offline healing always positive,
+        # preferring destinations with most headroom
+        headroom = jnp.maximum(limit - util, 0.0)[None, :]
+        cap = jnp.maximum(env.broker_capacity[:, self.resource], 1e-6)[None, :]
+        score = l[:, None] + 0.01 * headroom / cap
+        score = jnp.where(offline[:, None], 1.0 + headroom / cap, score)
+        return jnp.where(feasible, score, NEG_INF)
+
+    def accept_move(self, env: ClusterEnv, st: EngineState, cand):
+        l = candidate_load(env, st, cand)[:, self.resource]
+        limit = self._limit(env) + RESOURCE_EPS[self.resource]
+        return (st.util[None, :, self.resource] + l[:, None]) <= limit[None, :]
+
+    # -- leadership (CPU / NW_OUT shift with leadership) --
+    def leader_key(self, env: ClusterEnv, st: EngineState, severity):
+        on_bad = severity[st.replica_broker] > 0
+        delta = env.leader_load[:, self.resource] - env.follower_load[:, self.resource]
+        ok = env.replica_valid & st.replica_is_leader & on_bad & (delta > 0) \
+            & ~st.replica_offline
+        return jnp.where(ok, delta, NEG_INF)
+
+    def leadership_score(self, env: ClusterEnv, st: EngineState, cand):
+        members = env.partition_replicas[env.replica_partition[cand]]     # [K, F]
+        m = jnp.clip(members, 0)
+        dst_broker = st.replica_broker[m]                                 # [K, F]
+        delta_src = (env.leader_load[cand, self.resource]
+                     - env.follower_load[cand, self.resource])            # [K]
+        delta_dst = (env.leader_load[m, self.resource]
+                     - env.follower_load[m, self.resource])               # [K, F]
+        limit = self._limit(env)
+        util_dst = st.util[dst_broker, self.resource]
+        feasible = util_dst + delta_dst <= limit[dst_broker]
+        score = delta_src[:, None] * 0.99 + 0.0  # slight preference for replica moves
+        return jnp.where(feasible, score, NEG_INF)
+
+    def accept_leadership(self, env: ClusterEnv, st: EngineState, cand):
+        members = env.partition_replicas[env.replica_partition[cand]]
+        m = jnp.clip(members, 0)
+        dst_broker = st.replica_broker[m]
+        delta_dst = (env.leader_load[m, self.resource]
+                     - env.follower_load[m, self.resource])
+        limit = self._limit(env)
+        return (st.util[dst_broker, self.resource] + delta_dst
+                <= limit[dst_broker] + RESOURCE_EPS[self.resource])
+
+    def accept_swap(self, env: ClusterEnv, st: EngineState, cand_out, cand_in):
+        """Net-aware: both endpoints must stay under the capacity limit after
+        the exchange (a directed check would wrongly veto swaps on brokers
+        near the limit)."""
+        l_out = candidate_load(env, st, cand_out)[:, self.resource]
+        l_in = candidate_load(env, st, cand_in)[:, self.resource]
+        net = l_out[:, None] - l_in[None, :]
+        limit = self._limit(env) + RESOURCE_EPS[self.resource]
+        util = st.util[:, self.resource]
+        b_out = st.replica_broker[cand_out]
+        b_in = st.replica_broker[cand_in]
+        src_ok = util[b_out][:, None] - net <= limit[b_out][:, None]
+        dst_ok = util[b_in][None, :] + net <= limit[b_in][None, :]
+        return src_ok & dst_ok
+
+
+@dataclasses.dataclass(frozen=True)
+class CpuCapacityGoal(CapacityGoal):
+    resource: int = 0
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "name", "CpuCapacityGoal")
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkInboundCapacityGoal(CapacityGoal):
+    resource: int = 1
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "name", "NetworkInboundCapacityGoal")
+        object.__setattr__(self, "uses_leadership_moves", False)  # NW_IN leadership-invariant
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkOutboundCapacityGoal(CapacityGoal):
+    resource: int = 2
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "name", "NetworkOutboundCapacityGoal")
+
+
+@dataclasses.dataclass(frozen=True)
+class DiskCapacityGoal(CapacityGoal):
+    resource: int = 3
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "name", "DiskCapacityGoal")
+        object.__setattr__(self, "uses_leadership_moves", False)  # DISK leadership-invariant
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaCapacityGoal(GoalKernel):
+    """Max replicas per broker (ReplicaCapacityGoal.java:345)."""
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", "ReplicaCapacityGoal")
+        object.__setattr__(self, "is_hard", True)
+
+    def _max(self) -> int:
+        return self.constraint.max_replicas_per_broker
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        limit = jnp.where(env.broker_alive, self._max(), 0)
+        return (st.replica_count - limit).astype(jnp.float32)
+
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity):
+        on_bad = severity[st.replica_broker] > 0
+        load = jnp.sum(st.effective_load(env), axis=1)
+        offline = st.replica_offline & env.replica_valid
+        # prefer shedding low-load replicas (least data movement)
+        key = jnp.where(env.replica_valid & on_bad, -load, NEG_INF)
+        return jnp.where(offline, key + 1e12, key)
+
+    def move_score(self, env: ClusterEnv, st: EngineState, cand):
+        feasible = (st.replica_count[None, :] + 1) <= self._max()
+        headroom = jnp.maximum(self._max() - st.replica_count, 0)[None, :].astype(jnp.float32)
+        score = 1.0 + 0.001 * headroom / max(self._max(), 1)
+        return jnp.where(feasible, score, NEG_INF)
+
+    def accept_move(self, env: ClusterEnv, st: EngineState, cand):
+        ok = (st.replica_count[None, :] + 1) <= self._max()
+        return jnp.broadcast_to(ok, (cand.shape[0], env.num_brokers))

@@ -1,0 +1,409 @@
+"""Soft distribution goals.
+
+Reference: analyzer/goals/ResourceDistributionGoal.java (1,077 lines; balance
+thresholds :239-282, per-broker rebalance via move-out/move-in/leadership
+:384-862) + its 4 per-resource subclasses, ReplicaDistributionAbstractGoal.java
+(limit math :70-90) with ReplicaDistributionGoal.java and
+LeaderReplicaDistributionGoal.java.
+
+Threshold semantics preserved exactly:
+- resource: avg utilization % over alive brokers, limits
+  avg*(1 ± (balance_pct-1)*0.9) with low-utilization special cases
+  (GoalUtils.java:515).
+- counts: ceil/floor of avg*(1 ± (pct-1)*0.9)
+  (ReplicaDistributionAbstractGoal.java:80,:90).
+
+Scoring is gain-based: score = strict decrease of the total violation measure
+(sum of per-broker excess + deficit), with masks forbidding a move from
+creating a NEW violation at either endpoint — the vectorized equivalent of the
+reference's selfSatisfied checks. Monotone decrease guarantees termination.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.env import (
+    BALANCE_MARGIN, ClusterEnv, resource_balance_limits,
+)
+from cruise_control_tpu.analyzer.goals.base import NEG_INF, GoalKernel, candidate_load
+from cruise_control_tpu.analyzer.goals.capacity import RESOURCE_EPS
+from cruise_control_tpu.analyzer.state import EngineState
+
+
+def _violation(u, lower, upper):
+    """Distance outside the [lower, upper] band."""
+    return jnp.maximum(u - upper, 0.0) + jnp.maximum(lower - u, 0.0)
+
+
+def _gain(util_src, util_dst, l, lower_src, upper_src, lower_dst, upper_dst):
+    """Violation-measure decrease for transferring quantity ``l`` src->dst
+    (l may be negative for net swaps), plus feasibility: neither endpoint's
+    violation may increase — the vectorized selfSatisfied contract."""
+    v_src_old = _violation(util_src, lower_src, upper_src)
+    v_dst_old = _violation(util_dst, lower_dst, upper_dst)
+    v_src_new = _violation(util_src - l, lower_src, upper_src)
+    v_dst_new = _violation(util_dst + l, lower_dst, upper_dst)
+    gain = (v_src_old - v_src_new) + (v_dst_old - v_dst_new)
+    feasible = (v_src_new <= v_src_old) & (v_dst_new <= v_dst_old)
+    return gain, feasible
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceDistributionGoal(GoalKernel):
+    resource: int = 3  # DISK
+
+    def __post_init__(self):
+        object.__setattr__(self, "uses_leadership_moves", self.resource in (0, 2))
+        object.__setattr__(self, "uses_swaps", True)
+
+    # -- limits --
+    def _limits(self, env: ClusterEnv, st: EngineState):
+        """(lower[B], upper[B]) absolute utilization limits; dead broker: 0/0."""
+        alive = env.broker_alive
+        cap = env.broker_capacity[:, self.resource]
+        total_util = jnp.sum(jnp.where(alive, st.util[:, self.resource], 0.0))
+        total_cap = jnp.maximum(jnp.sum(jnp.where(alive, cap, 0.0)), 1e-6)
+        avg_pct = total_util / total_cap
+        lower_pct, upper_pct = resource_balance_limits(
+            avg_pct, self.constraint, self.resource,
+            self.options.triggered_by_goal_violation)
+        lower = jnp.where(alive, lower_pct * cap, 0.0)
+        upper = jnp.where(alive, upper_pct * cap, 0.0)
+        return lower, upper
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        lower, upper = self._limits(env, st)
+        util = st.util[:, self.resource]
+        eps = RESOURCE_EPS[self.resource]
+        return jnp.maximum(util - upper - eps, lower - util - eps)
+
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity):
+        lower, upper = self._limits(env, st)
+        util = st.util[:, self.resource]
+        excess_src = (util - upper)[st.replica_broker] > RESOURCE_EPS[self.resource]
+        any_deficit = jnp.any((lower - util) > RESOURCE_EPS[self.resource])
+        load = st.effective_load(env)[:, self.resource]
+        # donors for move-in: any broker that can shed without going deficient
+        donor = (util[st.replica_broker] - load) >= lower[st.replica_broker]
+        movable = env.replica_valid & (load > 0) & (excess_src | (any_deficit & donor))
+        offline = st.replica_offline & env.replica_valid
+        key = jnp.where(movable | offline, load, NEG_INF)
+        return jnp.where(offline, key + 1e12, key)
+
+    def move_score(self, env: ClusterEnv, st: EngineState, cand):
+        l = candidate_load(env, st, cand)[:, self.resource]              # [K]
+        lower, upper = self._limits(env, st)
+        util = st.util[:, self.resource]
+        src = st.replica_broker[cand]
+        gain, feasible = _gain(util[src][:, None], util[None, :], l[:, None],
+                               lower[src][:, None], upper[src][:, None],
+                               lower[None, :], upper[None, :])
+        offline = st.replica_offline[cand]
+        # offline healing: soft goal omits its balance limit (reference
+        # _fixOfflineReplicasOnly relaxation); capacity hard goals still veto
+        # via their accept_move during later-goal runs.
+        cap = jnp.maximum(env.broker_capacity[:, self.resource], 1e-6)[None, :]
+        heal_score = 1.0 + jnp.maximum(upper[None, :] - util[None, :] - l[:, None], 0.0) / cap
+        score = jnp.where(offline[:, None], heal_score,
+                          jnp.where(feasible & (gain > 0), gain, NEG_INF))
+        return score
+
+    def accept_move(self, env: ClusterEnv, st: EngineState, cand):
+        """Veto (as an already-optimized goal): moving cand -> dst must not push
+        dst above upper, nor drop src below lower
+        (ResourceDistributionGoal actionAcceptance REPLICA/BROKER_REJECT)."""
+        l = candidate_load(env, st, cand)[:, self.resource]
+        lower, upper = self._limits(env, st)
+        util = st.util[:, self.resource]
+        src = st.replica_broker[cand]
+        eps = RESOURCE_EPS[self.resource]
+        dst_ok = util[None, :] + l[:, None] <= upper[None, :] + eps
+        src_ok = (util[src] - l >= lower[src] - eps)[:, None]
+        # moves that reduce an existing excess at src are always fine for src
+        src_was_excess = (util[src] > upper[src])[:, None]
+        return dst_ok & (src_ok | src_was_excess)
+
+    # -- leadership (CPU & NW_OUT follow leadership) --
+    def leader_key(self, env: ClusterEnv, st: EngineState, severity):
+        lower, upper = self._limits(env, st)
+        util = st.util[:, self.resource]
+        on_excess = (util - upper)[st.replica_broker] > RESOURCE_EPS[self.resource]
+        delta = env.leader_load[:, self.resource] - env.follower_load[:, self.resource]
+        ok = env.replica_valid & st.replica_is_leader & on_excess & (delta > 0) \
+            & ~st.replica_offline
+        return jnp.where(ok, delta, NEG_INF)
+
+    def leadership_score(self, env: ClusterEnv, st: EngineState, cand):
+        members = env.partition_replicas[env.replica_partition[cand]]     # [K, F]
+        m = jnp.clip(members, 0)
+        dst_broker = st.replica_broker[m]
+        lower, upper = self._limits(env, st)
+        util = st.util[:, self.resource]
+        src = st.replica_broker[cand]
+        delta_src = (env.leader_load[cand, self.resource]
+                     - env.follower_load[cand, self.resource])[:, None]
+        delta_dst = (env.leader_load[m, self.resource]
+                     - env.follower_load[m, self.resource])
+        # src sheds delta_src; dst gains delta_dst
+        excess_red_src = jnp.minimum(jnp.maximum(util[src][:, None] - upper[src][:, None], 0.0),
+                                     delta_src)
+        new_excess_dst = jnp.maximum(util[dst_broker] + delta_dst - upper[dst_broker], 0.0)
+        gain = excess_red_src
+        feasible = new_excess_dst <= 0.0
+        return jnp.where(feasible & (gain > 0), gain, NEG_INF)
+
+    def accept_leadership(self, env: ClusterEnv, st: EngineState, cand):
+        members = env.partition_replicas[env.replica_partition[cand]]
+        m = jnp.clip(members, 0)
+        dst_broker = st.replica_broker[m]
+        _lower, upper = self._limits(env, st)
+        delta_dst = (env.leader_load[m, self.resource]
+                     - env.follower_load[m, self.resource])
+        eps = RESOURCE_EPS[self.resource]
+        return st.util[dst_broker, self.resource] + delta_dst <= upper[dst_broker] + eps
+
+    # -- swaps (rebalanceBySwappingLoadOut/In, ResourceDistributionGoal.java:598,:697) --
+    def swap_out_key(self, env: ClusterEnv, st: EngineState, severity):
+        """Replicas on out-of-band brokers, largest resource load first."""
+        on_bad = severity[st.replica_broker] > 0
+        load = st.effective_load(env)[:, self.resource]
+        ok = env.replica_valid & on_bad & ~st.replica_offline
+        return jnp.where(ok, load, NEG_INF)
+
+    def swap_in_key(self, env: ClusterEnv, st: EngineState, severity):
+        """Counterparty replicas on brokers not above the upper limit (deficit
+        brokers are prime counterparties: they trade a small replica for a big
+        one); smallest loads first so a swap can shed a small net amount."""
+        _lower, upper = self._limits(env, st)
+        not_excess = (st.util[:, self.resource] <= upper)[st.replica_broker]
+        load = st.effective_load(env)[:, self.resource]
+        ok = env.replica_valid & not_excess & ~st.replica_offline
+        return jnp.where(ok, -load, NEG_INF)
+
+    def swap_score(self, env: ClusterEnv, st: EngineState, cand_out, cand_in):
+        l_out = candidate_load(env, st, cand_out)[:, self.resource]       # [K1]
+        l_in = candidate_load(env, st, cand_in)[:, self.resource]         # [K2]
+        net = l_out[:, None] - l_in[None, :]                              # [K1, K2]
+        lower, upper = self._limits(env, st)
+        util = st.util[:, self.resource]
+        b_out = st.replica_broker[cand_out]
+        b_in = st.replica_broker[cand_in]
+        gain, feasible = _gain(util[b_out][:, None], util[b_in][None, :], net,
+                               lower[b_out][:, None], upper[b_out][:, None],
+                               lower[b_in][None, :], upper[b_in][None, :])
+        # moves are cheaper than swaps: discount so a tie prefers the move
+        return jnp.where(feasible & (gain > 0), gain * 0.95, NEG_INF)
+
+    def accept_swap(self, env: ClusterEnv, st: EngineState, cand_out, cand_in):
+        """Net-aware veto: after the exchange neither endpoint may be newly
+        out of band."""
+        l_out = candidate_load(env, st, cand_out)[:, self.resource]
+        l_in = candidate_load(env, st, cand_in)[:, self.resource]
+        net = l_out[:, None] - l_in[None, :]
+        lower, upper = self._limits(env, st)
+        util = st.util[:, self.resource]
+        b_out = st.replica_broker[cand_out]
+        b_in = st.replica_broker[cand_in]
+        _gain_v, feasible = _gain(util[b_out][:, None], util[b_in][None, :], net,
+                                  lower[b_out][:, None], upper[b_out][:, None],
+                                  lower[b_in][None, :], upper[b_in][None, :])
+        return feasible
+
+
+@dataclasses.dataclass(frozen=True)
+class CpuUsageDistributionGoal(ResourceDistributionGoal):
+    resource: int = 0
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "name", "CpuUsageDistributionGoal")
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkInboundUsageDistributionGoal(ResourceDistributionGoal):
+    resource: int = 1
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "name", "NetworkInboundUsageDistributionGoal")
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkOutboundUsageDistributionGoal(ResourceDistributionGoal):
+    resource: int = 2
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "name", "NetworkOutboundUsageDistributionGoal")
+
+
+@dataclasses.dataclass(frozen=True)
+class DiskUsageDistributionGoal(ResourceDistributionGoal):
+    resource: int = 3
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "name", "DiskUsageDistributionGoal")
+
+
+# ---------------------------------------------------------------------------
+# Count-based distribution
+# ---------------------------------------------------------------------------
+def _count_limits(counts_total, n_alive, balance_pct, triggered, multiplier):
+    """(lower, upper) integer limits (ReplicaDistributionAbstractGoal.java:70-90)."""
+    avg = counts_total / jnp.maximum(n_alive, 1)
+    pct = jnp.where(triggered, balance_pct * multiplier, balance_pct)
+    adj = (pct - 1.0) * BALANCE_MARGIN
+    upper = jnp.ceil(avg * (1.0 + adj))
+    lower = jnp.floor(avg * jnp.maximum(0.0, 1.0 - adj))
+    return lower, upper
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaDistributionGoal(GoalKernel):
+    """Even replica counts (ReplicaDistributionGoal.java:356)."""
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", "ReplicaDistributionGoal")
+
+    def _limits(self, env: ClusterEnv, st: EngineState):
+        n_alive = jnp.sum(env.broker_alive)
+        # all replicas count toward the average — replicas on dead brokers must
+        # land on alive ones (ReplicaDistributionAbstractGoal._avgReplicasOnAliveBroker)
+        total = jnp.sum(st.replica_count)
+        lower, upper = _count_limits(
+            total.astype(jnp.float32), n_alive.astype(jnp.float32),
+            self.constraint.replica_balance_percentage,
+            self.options.triggered_by_goal_violation,
+            self.constraint.goal_violation_distribution_threshold_multiplier)
+        lower = jnp.where(env.broker_alive, lower, 0.0)
+        upper = jnp.where(env.broker_alive, upper, 0.0)
+        return lower, upper
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        lower, upper = self._limits(env, st)
+        c = st.replica_count.astype(jnp.float32)
+        return jnp.maximum(c - upper, lower - c)
+
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity):
+        lower, upper = self._limits(env, st)
+        c = st.replica_count.astype(jnp.float32)
+        over = (c - upper)[st.replica_broker] > 0
+        any_deficit = jnp.any(lower - c > 0)
+        donor = (c - 1)[st.replica_broker] >= lower[st.replica_broker]
+        load = jnp.sum(st.effective_load(env), axis=1)
+        movable = env.replica_valid & (over | (any_deficit & donor))
+        offline = st.replica_offline & env.replica_valid
+        # prefer light replicas: less data moved per count unit
+        key = jnp.where(movable | offline, -load, NEG_INF)
+        return jnp.where(offline, key + 1e12, key)
+
+    def move_score(self, env: ClusterEnv, st: EngineState, cand):
+        lower, upper = self._limits(env, st)
+        c = st.replica_count.astype(jnp.float32)
+        src = st.replica_broker[cand]
+        gain, feasible = _gain(c[src][:, None], c[None, :], 1.0,
+                               lower[src][:, None], upper[src][:, None],
+                               lower[None, :], upper[None, :])
+        offline = st.replica_offline[cand]
+        heal = 1.0 + jnp.maximum(upper[None, :] - c[None, :] - 1.0, 0.0) / (upper[None, :] + 1.0)
+        return jnp.where(offline[:, None], heal,
+                         jnp.where(feasible & (gain > 0), gain, NEG_INF))
+
+    def accept_move(self, env: ClusterEnv, st: EngineState, cand):
+        lower, upper = self._limits(env, st)
+        c = st.replica_count.astype(jnp.float32)
+        src = st.replica_broker[cand]
+        dst_ok = c[None, :] + 1 <= upper[None, :]
+        src_ok = ((c[src] - 1 >= lower[src]) | (c[src] > upper[src]))[:, None]
+        return dst_ok & src_ok
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderReplicaDistributionGoal(GoalKernel):
+    """Even leader counts (LeaderReplicaDistributionGoal.java:369): prefers
+    leadership transfers, falls back to moving leader replicas."""
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", "LeaderReplicaDistributionGoal")
+        object.__setattr__(self, "uses_leadership_moves", True)
+
+    def _limits(self, env: ClusterEnv, st: EngineState):
+        n_alive = jnp.sum(env.broker_alive)
+        total = jnp.sum(st.leader_count)
+        lower, upper = _count_limits(
+            total.astype(jnp.float32), n_alive.astype(jnp.float32),
+            self.constraint.leader_replica_balance_percentage,
+            self.options.triggered_by_goal_violation,
+            self.constraint.goal_violation_distribution_threshold_multiplier)
+        lower = jnp.where(env.broker_alive, lower, 0.0)
+        upper = jnp.where(env.broker_alive, upper, 0.0)
+        return lower, upper
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        lower, upper = self._limits(env, st)
+        c = st.leader_count.astype(jnp.float32)
+        return jnp.maximum(c - upper, lower - c)
+
+    # replica moves: only leaders help
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity):
+        lower, upper = self._limits(env, st)
+        c = st.leader_count.astype(jnp.float32)
+        over = (c - upper)[st.replica_broker] > 0
+        load = jnp.sum(st.effective_load(env), axis=1)
+        movable = env.replica_valid & st.replica_is_leader & over & ~st.replica_offline
+        return jnp.where(movable, -load, NEG_INF)
+
+    def move_score(self, env: ClusterEnv, st: EngineState, cand):
+        lower, upper = self._limits(env, st)
+        c = st.leader_count.astype(jnp.float32)
+        src = st.replica_broker[cand]
+        gain, feasible = _gain(c[src][:, None], c[None, :], 1.0,
+                               lower[src][:, None], upper[src][:, None],
+                               lower[None, :], upper[None, :])
+        # leadership transfer is cheaper; replica moves score slightly lower
+        return jnp.where(feasible & (gain > 0), gain * 0.9, NEG_INF)
+
+    def accept_move(self, env: ClusterEnv, st: EngineState, cand):
+        lower, upper = self._limits(env, st)
+        c = st.leader_count.astype(jnp.float32)
+        src = st.replica_broker[cand]
+        is_leader = st.replica_is_leader[cand]
+        dst_ok = c[None, :] + 1 <= upper[None, :]
+        src_ok = ((c[src] - 1 >= lower[src]) | (c[src] > upper[src]))[:, None]
+        moving_leader = is_leader[:, None]
+        return jnp.where(moving_leader, dst_ok & src_ok, True)
+
+    def leader_key(self, env: ClusterEnv, st: EngineState, severity):
+        lower, upper = self._limits(env, st)
+        c = st.leader_count.astype(jnp.float32)
+        over = (c - upper)[st.replica_broker] > 0
+        nw = env.leader_load[:, 2] - env.follower_load[:, 2]
+        ok = env.replica_valid & st.replica_is_leader & over & ~st.replica_offline
+        # prefer transferring leadership of light partitions (cheap)
+        return jnp.where(ok, -nw, NEG_INF)
+
+    def leadership_score(self, env: ClusterEnv, st: EngineState, cand):
+        members = env.partition_replicas[env.replica_partition[cand]]
+        m = jnp.clip(members, 0)
+        dst_broker = st.replica_broker[m]
+        lower, upper = self._limits(env, st)
+        c = st.leader_count.astype(jnp.float32)
+        src = st.replica_broker[cand]
+        gain, feasible = _gain(c[src][:, None], c[dst_broker], 1.0,
+                               lower[src][:, None], upper[src][:, None],
+                               lower[dst_broker], upper[dst_broker])
+        return jnp.where(feasible & (gain > 0), gain, NEG_INF)
+
+    def accept_leadership(self, env: ClusterEnv, st: EngineState, cand):
+        members = env.partition_replicas[env.replica_partition[cand]]
+        m = jnp.clip(members, 0)
+        dst_broker = st.replica_broker[m]
+        lower, upper = self._limits(env, st)
+        c = st.leader_count.astype(jnp.float32)
+        src = st.replica_broker[cand]
+        dst_ok = c[dst_broker] + 1 <= upper[dst_broker]
+        src_ok = ((c[src] - 1 >= lower[src]) | (c[src] > upper[src]))[:, None]
+        return dst_ok & src_ok

@@ -1,0 +1,77 @@
+"""PreferredLeaderElectionGoal.
+
+Reference: analyzer/goals/PreferredLeaderElectionGoal.java:216 — not a search
+goal: it simply transfers leadership of every partition to the replica in the
+"preferred" (first) position when that replica is eligible. One vectorized
+pass, no engine loop.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.env import ClusterEnv
+from cruise_control_tpu.analyzer.goals.base import GoalKernel
+from cruise_control_tpu.analyzer.state import EngineState, refresh
+
+
+@dataclasses.dataclass(frozen=True)
+class PreferredLeaderElectionGoal(GoalKernel):
+    def __post_init__(self):
+        object.__setattr__(self, "name", "PreferredLeaderElectionGoal")
+        object.__setattr__(self, "uses_replica_moves", False)
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        return jnp.zeros(env.num_brokers)
+
+    def violated(self, env: ClusterEnv, st: EngineState):
+        pref = self._preferred_leader(env, st)
+        cur = self._current_leader(env, st)
+        has = jnp.any(env.partition_replicas >= 0, axis=1)
+        # excluded topics are untouchable by apply(), so they don't count
+        fixable = ~env.topic_excluded[env.partition_topic]
+        return jnp.any(has & fixable & (pref >= 0) & (pref != cur))
+
+    def _preferred_leader(self, env: ClusterEnv, st: EngineState):
+        """i32[P]: replica index of the preferred (position-0-most) eligible
+        replica, -1 if none eligible."""
+        members = env.partition_replicas                       # [P, F]
+        m = jnp.clip(members, 0)
+        b = st.replica_broker[m]
+        eligible = ((members >= 0) & env.broker_alive[b] & ~env.broker_demoted[b]
+                    & ~env.broker_excluded_for_leadership[b] & ~st.replica_offline[m])
+        # first eligible position
+        first = jnp.argmax(eligible, axis=1)
+        any_ok = jnp.any(eligible, axis=1)
+        pref = members[jnp.arange(members.shape[0]), first]
+        return jnp.where(any_ok, pref, -1)
+
+    def _current_leader(self, env: ClusterEnv, st: EngineState):
+        members = env.partition_replicas
+        m = jnp.clip(members, 0)
+        is_lead = (members >= 0) & st.replica_is_leader[m]
+        pos = jnp.argmax(is_lead, axis=1)
+        cur = members[jnp.arange(members.shape[0]), pos]
+        return jnp.where(jnp.any(is_lead, axis=1), cur, -1)
+
+    def apply(self, env: ClusterEnv, st: EngineState) -> EngineState:
+        """One-shot: flip leadership to the preferred replica everywhere legal."""
+        pref = self._preferred_leader(env, st)
+        cur = self._current_leader(env, st)
+        do = (pref >= 0) & (cur >= 0) & (pref != cur)
+        # excluded topics keep their leadership untouched
+        do = do & ~env.topic_excluded[env.partition_topic]
+        # scatter only the partitions actually flipping: inactive rows target
+        # index R and are dropped, so they can't clobber replica 0
+        R = st.replica_is_leader.shape[0]
+        cur_idx = jnp.where(do, cur, R)
+        pref_idx = jnp.where(do, pref, R)
+        lead = st.replica_is_leader
+        lead = lead.at[cur_idx].set(False, mode="drop")
+        lead = lead.at[pref_idx].set(True, mode="drop")
+        moved = st.leadership_moved
+        moved = moved.at[cur_idx].set(True, mode="drop")
+        moved = moved.at[pref_idx].set(True, mode="drop")
+        st = dataclasses.replace(st, replica_is_leader=lead, leadership_moved=moved)
+        return refresh(env, st)

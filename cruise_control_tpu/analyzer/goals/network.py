@@ -1,0 +1,116 @@
+"""Network-centric soft goals.
+
+Reference: analyzer/goals/PotentialNwOutGoal.java:372 (keep each broker's
+*potential* outbound — the NW_OUT it would serve if every hosted replica became
+leader — under the NW_OUT capacity threshold) and
+LeaderBytesInDistributionGoal.java:293 (balance leader-side bytes-in across
+brokers via leadership transfers).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.env import ClusterEnv, resource_balance_limits
+from cruise_control_tpu.analyzer.goals.base import NEG_INF, GoalKernel
+from cruise_control_tpu.analyzer.goals.capacity import RESOURCE_EPS
+from cruise_control_tpu.analyzer.state import EngineState
+from cruise_control_tpu.common.resources import Resource
+
+NW_IN = int(Resource.NW_IN)
+NW_OUT = int(Resource.NW_OUT)
+
+
+@dataclasses.dataclass(frozen=True)
+class PotentialNwOutGoal(GoalKernel):
+    def __post_init__(self):
+        object.__setattr__(self, "name", "PotentialNwOutGoal")
+
+    def _limit(self, env: ClusterEnv) -> jnp.ndarray:
+        thresh = self.constraint.capacity_threshold[NW_OUT]
+        return jnp.where(env.broker_alive,
+                         thresh * env.broker_capacity[:, NW_OUT], 0.0)
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        return st.potential_nw_out - self._limit(env) - RESOURCE_EPS[NW_OUT]
+
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity):
+        on_bad = severity[st.replica_broker] > 0
+        pot = env.leader_load[:, NW_OUT]
+        offline = st.replica_offline & env.replica_valid
+        ok = env.replica_valid & on_bad & ((pot > 0) | offline)
+        key = jnp.where(ok, pot, NEG_INF)
+        return jnp.where(offline, key + 1e12, key)
+
+    def move_score(self, env: ClusterEnv, st: EngineState, cand):
+        pot = env.leader_load[cand, NW_OUT]                     # [K]
+        limit = self._limit(env)
+        feasible = st.potential_nw_out[None, :] + pot[:, None] <= limit[None, :]
+        offline = st.replica_offline[cand]
+        cap = jnp.maximum(env.broker_capacity[:, NW_OUT], 1e-6)[None, :]
+        headroom = jnp.maximum(limit - st.potential_nw_out, 0.0)[None, :]
+        score = pot[:, None] + 0.01 * headroom / cap
+        score = jnp.where(offline[:, None], 1.0 + headroom / cap, score)
+        return jnp.where(feasible, score, NEG_INF)
+
+    def accept_move(self, env: ClusterEnv, st: EngineState, cand):
+        pot = env.leader_load[cand, NW_OUT]
+        limit = self._limit(env) + RESOURCE_EPS[NW_OUT]
+        return st.potential_nw_out[None, :] + pot[:, None] <= limit[None, :]
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderBytesInDistributionGoal(GoalKernel):
+    """Balance leader bytes-in; leadership transfers only
+    (LeaderBytesInDistributionGoal acts on leadership, not replica placement)."""
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", "LeaderBytesInDistributionGoal")
+        object.__setattr__(self, "uses_replica_moves", False)
+        object.__setattr__(self, "uses_leadership_moves", True)
+
+    def _limits(self, env: ClusterEnv, st: EngineState):
+        alive = env.broker_alive
+        cap = env.broker_capacity[:, NW_IN]
+        total = jnp.sum(jnp.where(alive, st.leader_util[:, NW_IN], 0.0))
+        total_cap = jnp.maximum(jnp.sum(jnp.where(alive, cap, 0.0)), 1e-6)
+        avg_pct = total / total_cap
+        lower_pct, upper_pct = resource_balance_limits(
+            avg_pct, self.constraint, NW_IN, self.options.triggered_by_goal_violation)
+        del lower_pct  # the reference goal only enforces the upper bound
+        upper = jnp.where(alive, upper_pct * cap, 0.0)
+        return upper
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        upper = self._limits(env, st)
+        return st.leader_util[:, NW_IN] - upper - RESOURCE_EPS[NW_IN]
+
+    def leader_key(self, env: ClusterEnv, st: EngineState, severity):
+        on_bad = severity[st.replica_broker] > 0
+        lin = env.leader_load[:, NW_IN]
+        ok = (env.replica_valid & st.replica_is_leader & on_bad & (lin > 0)
+              & ~st.replica_offline)
+        return jnp.where(ok, lin, NEG_INF)
+
+    def leadership_score(self, env: ClusterEnv, st: EngineState, cand):
+        members = env.partition_replicas[env.replica_partition[cand]]
+        m = jnp.clip(members, 0)
+        dst_broker = st.replica_broker[m]
+        upper = self._limits(env, st)
+        util = st.leader_util[:, NW_IN]
+        src = st.replica_broker[cand]
+        lin = env.leader_load[cand, NW_IN][:, None]             # same partition: dst gains it
+        excess_red = jnp.minimum(jnp.maximum(util[src][:, None] - upper[src][:, None], 0.0), lin)
+        new_excess_dst = jnp.maximum(util[dst_broker] + lin - upper[dst_broker], 0.0)
+        feasible = new_excess_dst <= 0.0
+        return jnp.where(feasible & (excess_red > 0), excess_red, NEG_INF)
+
+    def accept_leadership(self, env: ClusterEnv, st: EngineState, cand):
+        members = env.partition_replicas[env.replica_partition[cand]]
+        m = jnp.clip(members, 0)
+        dst_broker = st.replica_broker[m]
+        upper = self._limits(env, st)
+        lin = env.leader_load[cand, NW_IN][:, None]
+        eps = RESOURCE_EPS[NW_IN]
+        return st.leader_util[dst_broker, NW_IN] + lin <= upper[dst_broker] + eps

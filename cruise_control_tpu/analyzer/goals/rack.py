@@ -1,0 +1,144 @@
+"""Rack-awareness goals.
+
+Reference: analyzer/goals/RackAwareGoal.java:235 (hard: no two replicas of a
+partition share a rack) and RackAwareDistributionGoal.java:415 (relaxed: allow
+sharing only when #replicas > #racks, and then spread as evenly as possible).
+State is the partition x rack membership count ``st.part_rack_count`` kept
+incrementally by the engine.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.env import ClusterEnv
+from cruise_control_tpu.analyzer.goals.base import NEG_INF, GoalKernel
+from cruise_control_tpu.analyzer.state import EngineState
+
+
+def _replica_corack_count(env: ClusterEnv, st: EngineState) -> jnp.ndarray:
+    """i32[R]: number of OTHER replicas of this replica's partition in this
+    replica's current rack."""
+    rack = env.broker_rack[st.replica_broker]
+    return st.part_rack_count[env.replica_partition, rack] - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class RackAwareGoal(GoalKernel):
+    def __post_init__(self):
+        object.__setattr__(self, "name", "RackAwareGoal")
+        object.__setattr__(self, "is_hard", True)
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        """Severity = count of rack-violating (or offline) replicas per broker."""
+        viol = (_replica_corack_count(env, st) > 0) & env.replica_valid
+        viol = viol | (st.replica_offline & env.replica_valid)
+        return jax.ops.segment_sum(viol.astype(jnp.float32), st.replica_broker,
+                                   num_segments=env.num_brokers)
+
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity):
+        viol = (_replica_corack_count(env, st) > 0) & env.replica_valid
+        offline = st.replica_offline & env.replica_valid
+        load = jnp.sum(st.effective_load(env), axis=1)
+        key = jnp.where(viol | offline, -load, NEG_INF)  # cheapest first
+        return jnp.where(offline, key + 1e12, key)
+
+    def move_score(self, env: ClusterEnv, st: EngineState, cand):
+        p = env.replica_partition[cand]
+        rack_dst = env.broker_rack[None, :]                                  # [1, B]
+        dst_rack_count = st.part_rack_count[p[:, None], rack_dst]            # [K, B]
+        cur_rack = env.broker_rack[st.replica_broker[cand]][:, None]
+        same_rack = rack_dst == cur_rack
+        # count of partition replicas in destination rack, excluding self
+        others = dst_rack_count - jnp.where(same_rack, 1, 0)
+        feasible = others == 0
+        # prefer low-utilization destinations (balance tiebreak)
+        cap = jnp.maximum(jnp.sum(env.broker_capacity, axis=1), 1e-6)
+        util_frac = jnp.sum(st.util, axis=1) / cap
+        was_violating = (_replica_corack_count(env, st)[cand] > 0) | st.replica_offline[cand]
+        score = 1.0 + 0.5 * (1.0 - util_frac)[None, :]
+        return jnp.where(feasible & was_violating[:, None], score, NEG_INF)
+
+    def accept_move(self, env: ClusterEnv, st: EngineState, cand):
+        """Veto moves that would co-locate partition replicas in one rack."""
+        p = env.replica_partition[cand]
+        rack_dst = env.broker_rack[None, :]
+        dst_rack_count = st.part_rack_count[p[:, None], rack_dst]
+        cur_rack = env.broker_rack[st.replica_broker[cand]][:, None]
+        others = dst_rack_count - jnp.where(rack_dst == cur_rack, 1, 0)
+        return others == 0
+
+    def violated(self, env: ClusterEnv, st: EngineState):
+        viol = (_replica_corack_count(env, st) > 0) & env.replica_valid
+        return jnp.any(viol)
+
+
+@dataclasses.dataclass(frozen=True)
+class RackAwareDistributionGoal(GoalKernel):
+    """Relaxed rack awareness (RackAwareDistributionGoal.java:415): replicas of
+    a partition are spread across racks as evenly as possible — a rack may hold
+    ceil(RF / num_racks) replicas at most."""
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", "RackAwareDistributionGoal")
+        object.__setattr__(self, "is_hard", True)
+
+    def _partition_rf(self, env: ClusterEnv) -> jnp.ndarray:
+        return jnp.sum(env.partition_replicas >= 0, axis=1)                  # i32[P]
+
+    def _max_per_rack(self, env: ClusterEnv) -> jnp.ndarray:
+        rf = self._partition_rf(env)
+        return jnp.ceil(rf / jnp.maximum(env.num_racks, 1)).astype(jnp.int32)
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        limit = self._max_per_rack(env)                                      # [P]
+        rack = env.broker_rack[st.replica_broker]
+        count = st.part_rack_count[env.replica_partition, rack]
+        viol = (count > limit[env.replica_partition]) & env.replica_valid
+        viol = viol | (st.replica_offline & env.replica_valid)
+        return jax.ops.segment_sum(viol.astype(jnp.float32), st.replica_broker,
+                                   num_segments=env.num_brokers)
+
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity):
+        limit = self._max_per_rack(env)
+        rack = env.broker_rack[st.replica_broker]
+        count = st.part_rack_count[env.replica_partition, rack]
+        viol = (count > limit[env.replica_partition]) & env.replica_valid
+        offline = st.replica_offline & env.replica_valid
+        load = jnp.sum(st.effective_load(env), axis=1)
+        key = jnp.where(viol | offline, -load, NEG_INF)
+        return jnp.where(offline, key + 1e12, key)
+
+    def move_score(self, env: ClusterEnv, st: EngineState, cand):
+        p = env.replica_partition[cand]
+        limit = self._max_per_rack(env)[p][:, None]                          # [K, 1]
+        rack_dst = env.broker_rack[None, :]
+        dst_count = st.part_rack_count[p[:, None], rack_dst]
+        cur_rack = env.broker_rack[st.replica_broker[cand]][:, None]
+        others = dst_count - jnp.where(rack_dst == cur_rack, 1, 0)
+        feasible = others + 1 <= limit
+        cap = jnp.maximum(jnp.sum(env.broker_capacity, axis=1), 1e-6)
+        util_frac = jnp.sum(st.util, axis=1) / cap
+        rack = env.broker_rack[st.replica_broker[cand]]
+        was_violating = ((st.part_rack_count[p, rack] > self._max_per_rack(env)[p])
+                         | st.replica_offline[cand])
+        score = 1.0 + 0.5 * (1.0 - util_frac)[None, :]
+        return jnp.where(feasible & was_violating[:, None], score, NEG_INF)
+
+    def accept_move(self, env: ClusterEnv, st: EngineState, cand):
+        p = env.replica_partition[cand]
+        limit = self._max_per_rack(env)[p][:, None]
+        rack_dst = env.broker_rack[None, :]
+        dst_count = st.part_rack_count[p[:, None], rack_dst]
+        cur_rack = env.broker_rack[st.replica_broker[cand]][:, None]
+        others = dst_count - jnp.where(rack_dst == cur_rack, 1, 0)
+        return others + 1 <= limit
+
+    def violated(self, env: ClusterEnv, st: EngineState):
+        limit = self._max_per_rack(env)
+        rack = env.broker_rack[st.replica_broker]
+        count = st.part_rack_count[env.replica_partition, rack]
+        viol = (count > limit[env.replica_partition]) & env.replica_valid
+        return jnp.any(viol)

@@ -1,0 +1,193 @@
+"""Topic-granularity goals.
+
+Reference: analyzer/goals/TopicReplicaDistributionGoal.java:598 (each topic's
+replicas spread evenly: per-broker count within gap-clamped ceil/floor limits
+around the topic average, gapBasedBalanceLimit :119-131) and
+MinTopicLeadersPerBrokerGoal.java:452 (configured topics must keep >= N leader
+replicas on every eligible broker).
+
+State: the engine maintains ``st.topic_broker_count`` / ``st.topic_leader_count``
+[T, B] incrementally, so per-candidate checks are gathers.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.env import BALANCE_MARGIN, ClusterEnv
+from cruise_control_tpu.analyzer.goals.base import NEG_INF, GoalKernel
+from cruise_control_tpu.analyzer.state import EngineState
+
+
+@dataclasses.dataclass(frozen=True)
+class TopicReplicaDistributionGoal(GoalKernel):
+    def __post_init__(self):
+        object.__setattr__(self, "name", "TopicReplicaDistributionGoal")
+
+    def _limits(self, env: ClusterEnv, st: EngineState):
+        """(lower[T], upper[T]) per-topic per-broker count limits."""
+        n_alive = jnp.maximum(jnp.sum(env.broker_alive), 1).astype(jnp.float32)
+        topic_total = jnp.sum(st.topic_broker_count, axis=1).astype(jnp.float32)  # [T]
+        avg = topic_total / n_alive
+        pct = self.constraint.topic_replica_balance_percentage
+        if self.options.triggered_by_goal_violation:
+            pct *= self.constraint.goal_violation_distribution_threshold_multiplier
+        adj = (pct - 1.0) * BALANCE_MARGIN
+        upper = jnp.ceil(avg * (1.0 + adj))
+        lower = jnp.floor(avg * jnp.maximum(0.0, 1.0 - adj))
+        # gap clamp (gapBasedBalanceLimit)
+        min_gap = self.constraint.topic_replica_balance_min_gap
+        max_gap = self.constraint.topic_replica_balance_max_gap
+        up_min = jnp.ceil(avg) + min_gap
+        up_max = jnp.ceil(avg) + max_gap
+        upper = jnp.clip(upper, up_min, up_max)
+        lo_max = jnp.maximum(0.0, jnp.floor(avg) - min_gap)
+        lo_min = jnp.maximum(0.0, jnp.floor(avg) - max_gap)
+        lower = jnp.clip(lower, lo_min, lo_max)
+        return lower, upper
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        lower, upper = self._limits(env, st)                        # [T]
+        c = st.topic_broker_count.astype(jnp.float32)               # [T, B]
+        over = jnp.maximum(c - upper[:, None], 0.0)
+        under = jnp.maximum(lower[:, None] - c, 0.0)
+        sev = jnp.sum(over + under, axis=0)                         # [B]
+        return jnp.where(env.broker_alive, sev,
+                         jnp.maximum(sev, st.replica_count.astype(jnp.float32)))
+
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity):
+        lower, upper = self._limits(env, st)
+        c = st.topic_broker_count.astype(jnp.float32)
+        t = env.replica_topic
+        b = st.replica_broker
+        over = c[t, b] > upper[t]
+        any_deficit_t = jnp.any(lower[:, None] - c > 0, axis=1)     # [T]
+        donor = c[t, b] - 1 >= lower[t]
+        load = jnp.sum(st.effective_load(env), axis=1)
+        movable = env.replica_valid & (over | (any_deficit_t[t] & donor))
+        offline = st.replica_offline & env.replica_valid
+        key = jnp.where(movable | offline, -load, NEG_INF)
+        return jnp.where(offline, key + 1e12, key)
+
+    def move_score(self, env: ClusterEnv, st: EngineState, cand):
+        lower, upper = self._limits(env, st)
+        c = st.topic_broker_count.astype(jnp.float32)
+        t = env.replica_topic[cand]
+        src = st.replica_broker[cand]
+        c_src = c[t, src][:, None]                                  # [K, 1]
+        c_dst = c[t]                                                # [K, B]
+        lo = lower[t][:, None]
+        up = upper[t][:, None]
+        excess_red = jnp.minimum(jnp.maximum(c_src - up, 0.0), 1.0)
+        deficit_red = jnp.minimum(jnp.maximum(lo - c_dst, 0.0), 1.0)
+        new_excess_dst = jnp.maximum(c_dst + 1.0 - up, 0.0)
+        new_deficit_src = jnp.maximum(lo - (c_src - 1.0), 0.0)
+        gain = excess_red + deficit_red
+        feasible = (new_excess_dst <= 0.0) & (new_deficit_src <= 0.0)
+        offline = st.replica_offline[cand]
+        heal = 1.0 + jnp.maximum(up - c_dst - 1.0, 0.0) / (up + 1.0)
+        return jnp.where(offline[:, None], heal,
+                         jnp.where(feasible & (gain > 0), gain, NEG_INF))
+
+    def accept_move(self, env: ClusterEnv, st: EngineState, cand):
+        lower, upper = self._limits(env, st)
+        c = st.topic_broker_count.astype(jnp.float32)
+        t = env.replica_topic[cand]
+        src = st.replica_broker[cand]
+        dst_ok = c[t] + 1.0 <= upper[t][:, None]
+        src_c = c[t, src]
+        src_ok = ((src_c - 1.0 >= lower[t]) | (src_c > upper[t]))[:, None]
+        return dst_ok & src_ok
+
+
+@dataclasses.dataclass(frozen=True)
+class MinTopicLeadersPerBrokerGoal(GoalKernel):
+    """Hard goal: topics flagged in env.topic_min_leaders must keep at least
+    ``constraint.min_topic_leaders_per_broker`` leaders on each eligible broker."""
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", "MinTopicLeadersPerBrokerGoal")
+        object.__setattr__(self, "is_hard", True)
+        object.__setattr__(self, "uses_leadership_moves", True)
+
+    def _min(self) -> int:
+        return self.constraint.min_topic_leaders_per_broker
+
+    def _eligible(self, env: ClusterEnv):
+        return (env.broker_alive & ~env.broker_excluded_for_leadership
+                & ~env.broker_demoted)
+
+    def _deficit(self, env: ClusterEnv, st: EngineState):
+        """f32[T, B] missing leaders per (min-leader topic, eligible broker)."""
+        c = st.topic_leader_count.astype(jnp.float32)
+        need = jnp.where(env.topic_min_leaders[:, None] & self._eligible(env)[None, :],
+                         float(self._min()), 0.0)
+        return jnp.maximum(need - c, 0.0)
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        return jnp.sum(self._deficit(env, st), axis=0)
+
+    def violated(self, env: ClusterEnv, st: EngineState):
+        return jnp.any(self._deficit(env, st) > 0)
+
+    # replicas: move leader replicas of min-leader topics toward deficient brokers
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity):
+        c = st.topic_leader_count.astype(jnp.float32)
+        t = env.replica_topic
+        b = st.replica_broker
+        surplus = c[t, b] > float(self._min())
+        is_min_topic = env.topic_min_leaders[t]
+        load = jnp.sum(st.effective_load(env), axis=1)
+        movable = (env.replica_valid & st.replica_is_leader & is_min_topic
+                   & surplus & ~st.replica_offline)
+        offline = st.replica_offline & env.replica_valid
+        key = jnp.where(movable | offline, -load, NEG_INF)
+        return jnp.where(offline, key + 1e12, key)
+
+    def move_score(self, env: ClusterEnv, st: EngineState, cand):
+        deficit = self._deficit(env, st)                            # [T, B]
+        t = env.replica_topic[cand]
+        gain = jnp.minimum(deficit[t], 1.0)                         # [K, B]
+        offline = st.replica_offline[cand]
+        heal = jnp.ones_like(gain)
+        return jnp.where(offline[:, None], heal,
+                         jnp.where(gain > 0, gain, NEG_INF))
+
+    def accept_move(self, env: ClusterEnv, st: EngineState, cand):
+        """Veto moving a leader of a min-leader topic off a broker that would
+        drop below the minimum."""
+        c = st.topic_leader_count.astype(jnp.float32)
+        t = env.replica_topic[cand]
+        src = st.replica_broker[cand]
+        guarded = (env.topic_min_leaders[t] & st.replica_is_leader[cand]
+                   & self._eligible(env)[src])
+        src_ok = (c[t, src] - 1.0 >= float(self._min())) | ~guarded
+        return jnp.broadcast_to(src_ok[:, None], (cand.shape[0], env.num_brokers))
+
+    # leadership: grant leadership to followers on deficient brokers
+    def leader_key(self, env: ClusterEnv, st: EngineState, severity):
+        c = st.topic_leader_count.astype(jnp.float32)
+        t = env.replica_topic
+        b = st.replica_broker
+        surplus = c[t, b] > float(self._min())
+        ok = (env.replica_valid & st.replica_is_leader & env.topic_min_leaders[t]
+              & surplus & ~st.replica_offline)
+        return jnp.where(ok, 1.0, NEG_INF)
+
+    def leadership_score(self, env: ClusterEnv, st: EngineState, cand):
+        members = env.partition_replicas[env.replica_partition[cand]]
+        m = jnp.clip(members, 0)
+        dst_broker = st.replica_broker[m]
+        deficit = self._deficit(env, st)
+        t = env.replica_topic[cand]
+        gain = jnp.minimum(deficit[t[:, None], dst_broker], 1.0)
+        return jnp.where(gain > 0, gain, NEG_INF)
+
+    def accept_leadership(self, env: ClusterEnv, st: EngineState, cand):
+        c = st.topic_leader_count.astype(jnp.float32)
+        t = env.replica_topic[cand]
+        src = st.replica_broker[cand]
+        guarded = env.topic_min_leaders[t] & self._eligible(env)[src]
+        src_ok = (c[t, src] - 1.0 >= float(self._min())) | ~guarded
+        return jnp.broadcast_to(src_ok[:, None], (cand.shape[0], env.max_rf))

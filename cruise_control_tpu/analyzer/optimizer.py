@@ -1,0 +1,236 @@
+"""GoalOptimizer: prioritized sequential multi-goal optimization.
+
+Reference: analyzer/GoalOptimizer.java:417 ``optimizations(...)`` — the
+sequential per-goal loop (:440-467): for each goal in priority order run
+``goal.optimize(clusterModel, optimizedGoals, options)``, collect per-goal
+stats/durations, then diff initial vs final distribution into proposals
+(:476-481). The proposal cache + precompute thread live in
+``analyzer.cache.ProposalCache`` (GoalOptimizer.java:139-339 role).
+
+Here each goal runs as one jitted engine loop (engine.optimize_goal) with the
+previously-optimized goals' acceptance masks fused into candidate scoring —
+the K-acceptance-kernels-fused design from SURVEY §7.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.engine import EngineParams, optimize_goal
+from cruise_control_tpu.analyzer.env import (
+    BalancingConstraint, ClusterEnv, OptimizationOptions, make_env,
+)
+from cruise_control_tpu.analyzer.goals import make_goals
+from cruise_control_tpu.analyzer.goals.leader_election import PreferredLeaderElectionGoal
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal, diff_proposals
+from cruise_control_tpu.analyzer.state import EngineState, init_state
+from cruise_control_tpu.model.cluster_tensor import ClusterMeta, ClusterTensor
+
+# balancedness weights (AnalyzerConfig goal.balancedness.{priority,strictness}.weight)
+BALANCEDNESS_PRIORITY_WEIGHT = 1.1
+BALANCEDNESS_STRICTNESS_WEIGHT = 1.5
+
+
+class OptimizationFailureError(Exception):
+    """A hard goal could not be satisfied
+    (reference: OptimizationFailureException thrown from AbstractGoal)."""
+
+
+@dataclasses.dataclass
+class GoalResult:
+    name: str
+    violated_before: bool
+    violated_after: bool
+    iterations: int
+    duration_s: float
+    stat_after: float
+
+
+@dataclasses.dataclass
+class OptimizerResult:
+    """Reference: analyzer/OptimizerResult.java — stats by goal, violated goals
+    before/after, the proposal set, balancedness scores."""
+    goal_results: list[GoalResult]
+    proposals: list[ExecutionProposal]
+    stats_before: dict
+    stats_after: dict
+    balancedness_before: float
+    balancedness_after: float
+    num_replica_movements: int = 0
+    num_leadership_movements: int = 0
+    data_to_move_mb: float = 0.0
+
+    @property
+    def violated_goals_before(self) -> list[str]:
+        return [g.name for g in self.goal_results if g.violated_before]
+
+    @property
+    def violated_goals_after(self) -> list[str]:
+        return [g.name for g in self.goal_results if g.violated_after]
+
+    def to_json(self) -> dict:
+        return {
+            "summary": {
+                "numReplicaMovements": self.num_replica_movements,
+                "numLeaderMovements": self.num_leadership_movements,
+                "dataToMoveMB": self.data_to_move_mb,
+                "balancednessBefore": self.balancedness_before,
+                "balancednessAfter": self.balancedness_after,
+                "violatedGoalsBefore": self.violated_goals_before,
+                "violatedGoalsAfter": self.violated_goals_after,
+            },
+            "goalSummary": [
+                {"goal": g.name, "status": "VIOLATED" if g.violated_after else "NO-ACTION"
+                 if not g.iterations else "FIXED", "iterations": g.iterations,
+                 "durationSec": round(g.duration_s, 4)}
+                for g in self.goal_results
+            ],
+            "proposals": [p.to_json() for p in self.proposals],
+        }
+
+
+def _balancedness(goals, results_violated: dict) -> float:
+    """Weighted fraction of satisfied goals (GoalViolationDetector.java:104
+    balancedness score role): hard goals weigh strictness x priority more."""
+    total = 0.0
+    got = 0.0
+    weight = 1.0
+    for g in reversed(goals):  # lowest priority gets weight 1, each step x1.1
+        w = weight * (BALANCEDNESS_STRICTNESS_WEIGHT if g.is_hard else 1.0)
+        total += w
+        if not results_violated.get(g.name, False):
+            got += w
+        weight *= BALANCEDNESS_PRIORITY_WEIGHT
+    return 100.0 * got / total if total else 100.0
+
+
+class GoalOptimizer:
+    def __init__(self, config=None, constraint: BalancingConstraint | None = None,
+                 engine_params: EngineParams | None = None):
+        self._config = config
+        if constraint is None:
+            constraint = (BalancingConstraint.from_config(config) if config is not None
+                          else BalancingConstraint())
+        self._constraint = constraint
+        if engine_params is None and config is not None:
+            engine_params = EngineParams(
+                max_iters=config.get_int("analyzer.max.iterations"),
+                num_candidates=config.get_int("analyzer.candidate.replicas.per.broker"),
+            )
+        self._params = engine_params or EngineParams()
+        if config is not None:
+            self._default_goal_names = list(config.get_list("goals"))
+            self._hard_goal_names = set(config.get_list("hard.goals"))
+        else:
+            from cruise_control_tpu.config.defaults import DEFAULT_GOALS, DEFAULT_HARD_GOALS
+            self._default_goal_names = list(DEFAULT_GOALS)
+            self._hard_goal_names = set(DEFAULT_HARD_GOALS)
+
+    @property
+    def default_goal_names(self) -> list[str]:
+        return list(self._default_goal_names)
+
+    def optimizations(self, ct: ClusterTensor, meta: ClusterMeta,
+                      goal_names: list[str] | None = None,
+                      options: OptimizationOptions = OptimizationOptions(),
+                      skip_hard_goal_check: bool = False,
+                      raise_on_failure: bool = False) -> OptimizerResult:
+        names = goal_names or self._default_goal_names
+        # honour hard-goal enforcement (KafkaCruiseControl sanityCheckHardGoalPresence)
+        if goal_names and not skip_hard_goal_check:
+            missing = [h for h in self._hard_goal_names
+                       if h in self._default_goal_names and h not in goal_names]
+            if missing:
+                raise ValueError(
+                    f"hard goals {missing} missing from requested goals; "
+                    f"pass skip_hard_goal_check=True to override")
+        known = [n for n in names if n != "PreferredLeaderElectionGoal"]
+        goals = make_goals(known, self._constraint, options)
+        run_preferred = "PreferredLeaderElectionGoal" in names
+
+        env = make_env(ct, meta)
+        st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                        ct.replica_offline, ct.replica_disk)
+        initial_broker = np.asarray(st.replica_broker).copy()
+        initial_leader = np.asarray(st.replica_is_leader).copy()
+        initial_disk = np.asarray(st.replica_disk).copy()
+        stats_before = cluster_stats_state(env, st)
+        violated_before = {g.name: bool(g.violated(env, st)) for g in goals}
+
+        goal_results: list[GoalResult] = []
+        prev: list = []
+        for g in goals:
+            t0 = time.monotonic()
+            st, info = optimize_goal(env, st, g, tuple(prev), self._params)
+            dur = time.monotonic() - t0
+            goal_results.append(GoalResult(
+                name=g.name,
+                violated_before=violated_before[g.name],
+                violated_after=bool(info["violated_after"]),
+                iterations=int(info["iterations"]),
+                duration_s=dur,
+                stat_after=float(info["stat"]),
+            ))
+            prev.append(g)
+
+        if run_preferred:
+            ple = PreferredLeaderElectionGoal(constraint=self._constraint, options=options)
+            t0 = time.monotonic()
+            was = bool(ple.violated(env, st))
+            st = ple.apply(env, st)
+            goal_results.append(GoalResult(
+                name="PreferredLeaderElectionGoal", violated_before=was,
+                violated_after=bool(ple.violated(env, st)), iterations=1 if was else 0,
+                duration_s=time.monotonic() - t0, stat_after=0.0))
+
+        stats_after = cluster_stats_state(env, st)
+        proposals = diff_proposals(env, meta, initial_broker, initial_leader,
+                                   initial_disk, st)
+        n_moves = sum(len(p.replicas_to_add) for p in proposals)
+        n_lead = sum(1 for p in proposals if p.has_leader_action)
+        from cruise_control_tpu.common.resources import Resource
+        disk_load = np.asarray(env.leader_load[:, Resource.DISK])
+        moved_mask = np.asarray(st.moved)
+        data_mb = float(disk_load[moved_mask].sum())
+
+        if raise_on_failure:
+            failed = [r.name for r, g in zip(goal_results, goals)
+                      if g.is_hard and r.violated_after]
+            if failed:
+                raise OptimizationFailureError(
+                    f"hard goal(s) not satisfiable: {failed}")
+
+        viol_after = {g.name: g.violated_after for g in goal_results}
+        result = OptimizerResult(
+            goal_results=goal_results, proposals=proposals,
+            stats_before=stats_before, stats_after=stats_after,
+            balancedness_before=_balancedness(goals, violated_before),
+            balancedness_after=_balancedness(goals, viol_after),
+            num_replica_movements=n_moves, num_leadership_movements=n_lead,
+            data_to_move_mb=data_mb,
+        )
+        result.final_state = st          # for executor / tests
+        result.env = env
+        return result
+
+
+def cluster_stats_state(env: ClusterEnv, st: EngineState) -> dict:
+    """Stats over the engine state (same fields as model.cluster_stats)."""
+    alive = np.asarray(env.broker_alive)
+    util = np.asarray(st.util)[alive]
+    counts = np.asarray(st.replica_count)[alive]
+    return {
+        "avg": util.mean(axis=0).tolist() if util.size else [],
+        "max": util.max(axis=0).tolist() if util.size else [],
+        "min": util.min(axis=0).tolist() if util.size else [],
+        "std": util.std(axis=0).tolist() if util.size else [],
+        "replica_count_avg": float(counts.mean()) if counts.size else 0.0,
+        "replica_count_max": int(counts.max()) if counts.size else 0,
+        "replica_count_std": float(counts.std()) if counts.size else 0.0,
+        "potential_nw_out_max": float(np.asarray(st.potential_nw_out)[alive].max())
+            if alive.any() else 0.0,
+        "num_offline_replicas": int((np.asarray(st.replica_offline)
+                                     & np.asarray(env.replica_valid)).sum()),
+    }

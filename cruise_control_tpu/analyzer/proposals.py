@@ -1,0 +1,99 @@
+"""Proposal diffing: initial vs optimized assignment -> ExecutionProposals.
+
+Reference: analyzer/AnalyzerUtils.getDiff (initial replica/leader distribution
+vs the optimized ClusterModel -> Set<ExecutionProposal>) and
+executor/ExecutionProposal.java (tp, old/new leader, old/new replica
+(broker, logdir) lists).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.env import ClusterEnv
+from cruise_control_tpu.analyzer.state import EngineState
+from cruise_control_tpu.model.cluster_tensor import ClusterMeta
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutionProposal:
+    topic: str
+    partition: int
+    old_leader: int                 # external broker id
+    new_leader: int
+    old_replicas: tuple             # tuple[(broker_id, logdir_index), ...]
+    new_replicas: tuple
+
+    @property
+    def tp(self) -> str:
+        return f"{self.topic}-{self.partition}"
+
+    @property
+    def replicas_to_add(self) -> tuple:
+        old = {b for b, _ in self.old_replicas}
+        return tuple(b for b, _ in self.new_replicas if b not in old)
+
+    @property
+    def replicas_to_remove(self) -> tuple:
+        new = {b for b, _ in self.new_replicas}
+        return tuple(b for b, _ in self.old_replicas if b not in new)
+
+    @property
+    def has_replica_action(self) -> bool:
+        return bool(self.replicas_to_add or self.replicas_to_remove)
+
+    @property
+    def has_leader_action(self) -> bool:
+        return self.old_leader != self.new_leader
+
+    def data_to_move_mb(self, replica_disk_mb: float) -> float:
+        return replica_disk_mb * len(self.replicas_to_add)
+
+    def to_json(self) -> dict:
+        return {
+            "topicPartition": {"topic": self.topic, "partition": self.partition},
+            "oldLeader": self.old_leader,
+            "newLeader": self.new_leader,
+            "oldReplicas": [b for b, _ in self.old_replicas],
+            "newReplicas": [b for b, _ in self.new_replicas],
+        }
+
+
+def diff_proposals(env: ClusterEnv, meta: ClusterMeta,
+                   initial_broker: np.ndarray, initial_leader: np.ndarray,
+                   initial_disk: np.ndarray, st: EngineState) -> list[ExecutionProposal]:
+    """Compare assignments and emit one proposal per changed partition."""
+    final_broker = np.asarray(st.replica_broker)
+    final_leader = np.asarray(st.replica_is_leader)
+    final_disk = np.asarray(st.replica_disk)
+    initial_broker = np.asarray(initial_broker)
+    initial_leader = np.asarray(initial_leader)
+    initial_disk = np.asarray(initial_disk)
+    members_table = np.asarray(env.partition_replicas)
+    broker_ids = np.asarray(meta.broker_ids)
+
+    changed_r = (final_broker != initial_broker) | (final_leader != initial_leader) \
+        | (final_disk != initial_disk)
+    valid = np.asarray(env.replica_valid)
+    part_of = np.asarray(env.replica_partition)
+    changed_parts = np.unique(part_of[changed_r & valid])
+
+    proposals: list[ExecutionProposal] = []
+    for p in changed_parts.tolist():
+        members = members_table[p]
+        members = members[members >= 0]
+        topic, partition = meta.partition_ids[p]
+        old_replicas = tuple((int(broker_ids[initial_broker[m]]), int(initial_disk[m]))
+                             for m in members)
+        new_replicas = tuple((int(broker_ids[final_broker[m]]), int(final_disk[m]))
+                             for m in members)
+        old_lead = [m for m in members if initial_leader[m]]
+        new_lead = [m for m in members if final_leader[m]]
+        old_leader = int(broker_ids[initial_broker[old_lead[0]]]) if old_lead else -1
+        new_leader = int(broker_ids[final_broker[new_lead[0]]]) if new_lead else -1
+        proposals.append(ExecutionProposal(
+            topic=topic, partition=int(partition),
+            old_leader=old_leader, new_leader=new_leader,
+            old_replicas=old_replicas, new_replicas=new_replicas))
+    return proposals

@@ -1,0 +1,191 @@
+"""Mutable engine state + incremental maintenance.
+
+The reference mutates its object graph and keeps per-broker Load objects
+consistent on every relocateReplica/relocateLeadership
+(model/ClusterModel.java:375,:402 with load bookkeeping in Broker/Rack/Host).
+Here the optimizer's ``lax.while_loop`` carries this pytree and applies the
+same bookkeeping as O(1) scatter updates per action; ``refresh`` recomputes
+everything from scratch (used at init and by tests to assert the incremental
+path stays consistent — the tensor analogue of ClusterModel.sanityCheck).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.analyzer.env import ClusterEnv
+
+Array = jax.Array
+
+
+@partial(jax.tree_util.register_dataclass,
+         data_fields=["replica_broker", "replica_is_leader", "replica_offline",
+                      "replica_disk", "util", "leader_util", "potential_nw_out",
+                      "replica_count", "leader_count", "part_rack_count",
+                      "topic_broker_count", "topic_leader_count", "disk_util",
+                      "moved", "leadership_moved"],
+         meta_fields=[])
+@dataclasses.dataclass(frozen=True)
+class EngineState:
+    replica_broker: Array      # i32[R]
+    replica_is_leader: Array   # bool[R]
+    replica_offline: Array     # bool[R]
+    replica_disk: Array        # i32[R]
+    util: Array                # f32[B, M] total hosted load
+    leader_util: Array         # f32[B, M] leader-replica load only
+    potential_nw_out: Array    # f32[B] sum of leader-mode NW_OUT over hosted replicas
+    replica_count: Array       # i32[B]
+    leader_count: Array        # i32[B]
+    part_rack_count: Array     # i32[P, K]
+    topic_broker_count: Array  # i32[T, B] replicas of topic per broker
+    topic_leader_count: Array  # i32[T, B] leaders of topic per broker
+    disk_util: Array           # f32[B, D] DISK load per (broker, logdir) (JBOD)
+    moved: Array               # bool[R] replica has been relocated this optimization
+    leadership_moved: Array    # bool[R] leadership changed on this replica
+
+    def effective_load(self, env: ClusterEnv) -> Array:
+        load = jnp.where(self.replica_is_leader[:, None], env.leader_load, env.follower_load)
+        return jnp.where(env.replica_valid[:, None], load, 0.0)
+
+
+def init_state(env: ClusterEnv, replica_broker: Array, replica_is_leader: Array,
+               replica_offline: Array, replica_disk: Array) -> EngineState:
+    st = EngineState(
+        replica_broker=replica_broker, replica_is_leader=replica_is_leader,
+        replica_offline=replica_offline, replica_disk=replica_disk,
+        util=jnp.zeros_like(env.broker_capacity),
+        leader_util=jnp.zeros_like(env.broker_capacity),
+        potential_nw_out=jnp.zeros(env.num_brokers, env.broker_capacity.dtype),
+        replica_count=jnp.zeros(env.num_brokers, jnp.int32),
+        leader_count=jnp.zeros(env.num_brokers, jnp.int32),
+        part_rack_count=jnp.zeros((env.num_partitions, env.num_racks), jnp.int32),
+        topic_broker_count=jnp.zeros((env.topic_excluded.shape[0], env.num_brokers), jnp.int32),
+        topic_leader_count=jnp.zeros((env.topic_excluded.shape[0], env.num_brokers), jnp.int32),
+        disk_util=jnp.zeros_like(env.broker_disk_capacity),
+        moved=jnp.zeros(env.num_replicas, bool),
+        leadership_moved=jnp.zeros(env.num_replicas, bool),
+    )
+    return refresh(env, st)
+
+
+def refresh(env: ClusterEnv, st: EngineState) -> EngineState:
+    """Recompute all derived state from the assignment (ground truth)."""
+    B = env.num_brokers
+    load = st.effective_load(env)
+    util = jax.ops.segment_sum(load, st.replica_broker, num_segments=B)
+    lead_mask = (st.replica_is_leader & env.replica_valid)[:, None]
+    leader_util = jax.ops.segment_sum(jnp.where(lead_mask, env.leader_load, 0.0),
+                                      st.replica_broker, num_segments=B)
+    pot = jax.ops.segment_sum(
+        jnp.where(env.replica_valid, env.leader_load[:, Resource.NW_OUT], 0.0),
+        st.replica_broker, num_segments=B)
+    rc = jax.ops.segment_sum(env.replica_valid.astype(jnp.int32), st.replica_broker,
+                             num_segments=B)
+    lc = jax.ops.segment_sum((env.replica_valid & st.replica_is_leader).astype(jnp.int32),
+                             st.replica_broker, num_segments=B)
+    rack = env.broker_rack[st.replica_broker]
+    flat = env.replica_partition * env.num_racks + rack
+    prc = jax.ops.segment_sum(env.replica_valid.astype(jnp.int32), flat,
+                              num_segments=env.num_partitions * env.num_racks
+                              ).reshape(env.num_partitions, env.num_racks)
+    T = env.topic_excluded.shape[0]
+    tflat = env.replica_topic * B + st.replica_broker
+    tbc = jax.ops.segment_sum(env.replica_valid.astype(jnp.int32), tflat,
+                              num_segments=T * B).reshape(T, B)
+    tlc = jax.ops.segment_sum((env.replica_valid & st.replica_is_leader).astype(jnp.int32),
+                              tflat, num_segments=T * B).reshape(T, B)
+    D = env.broker_disk_capacity.shape[1]
+    dflat = st.replica_broker * D + st.replica_disk
+    du = jax.ops.segment_sum(load[:, Resource.DISK], dflat,
+                             num_segments=B * D).reshape(B, D)
+    return dataclasses.replace(st, util=util, leader_util=leader_util, potential_nw_out=pot,
+                               replica_count=rc, leader_count=lc, part_rack_count=prc,
+                               topic_broker_count=tbc, topic_leader_count=tlc, disk_util=du)
+
+
+def apply_move(env: ClusterEnv, st: EngineState, replica: Array, dst: Array) -> EngineState:
+    """Relocate ``replica`` to broker ``dst`` with incremental bookkeeping.
+
+    Safe under jit for a traced (replica, dst); the caller guarantees the move
+    is legit (dst hosts no copy of the partition, dst alive, ...).
+    """
+    src = st.replica_broker[replica]
+    is_leader = st.replica_is_leader[replica]
+    load = jnp.where(is_leader, env.leader_load[replica], env.follower_load[replica])
+    util = st.util.at[src].add(-load).at[dst].add(load)
+    lead_load = env.leader_load[replica]
+    leader_util = jnp.where(
+        is_leader,
+        st.leader_util.at[src].add(-lead_load).at[dst].add(lead_load),
+        st.leader_util)
+    pot_delta = env.leader_load[replica, Resource.NW_OUT]
+    pot = st.potential_nw_out.at[src].add(-pot_delta).at[dst].add(pot_delta)
+    rc = st.replica_count.at[src].add(-1).at[dst].add(1)
+    lc = jnp.where(is_leader, st.leader_count.at[src].add(-1).at[dst].add(1), st.leader_count)
+    p = env.replica_partition[replica]
+    prc = (st.part_rack_count.at[p, env.broker_rack[src]].add(-1)
+                             .at[p, env.broker_rack[dst]].add(1))
+    t = env.replica_topic[replica]
+    tbc = st.topic_broker_count.at[t, src].add(-1).at[t, dst].add(1)
+    tlc = jnp.where(is_leader,
+                    st.topic_leader_count.at[t, src].add(-1).at[t, dst].add(1),
+                    st.topic_leader_count)
+    # destination logdir: the alive disk with the most free space on dst
+    # (the engine's move candidates don't carry a disk axis; placement policy
+    # mirrors the executor's least-loaded-logdir default)
+    disk_load = load[Resource.DISK]
+    free = jnp.where(env.broker_disk_alive[dst],
+                     env.broker_disk_capacity[dst] - st.disk_util[dst], -jnp.inf)
+    dst_disk = jnp.argmax(free).astype(jnp.int32)
+    src_disk = st.replica_disk[replica]
+    du = st.disk_util.at[src, src_disk].add(-disk_load).at[dst, dst_disk].add(disk_load)
+    return dataclasses.replace(
+        st,
+        replica_broker=st.replica_broker.at[replica].set(jnp.asarray(dst, jnp.int32)),
+        replica_offline=st.replica_offline.at[replica].set(False),
+        replica_disk=st.replica_disk.at[replica].set(dst_disk),
+        util=util, leader_util=leader_util, potential_nw_out=pot,
+        replica_count=rc, leader_count=lc, part_rack_count=prc,
+        topic_broker_count=tbc, topic_leader_count=tlc, disk_util=du,
+        moved=st.moved.at[replica].set(True),
+    )
+
+
+def apply_leadership(env: ClusterEnv, st: EngineState, src_replica: Array,
+                     dst_replica: Array) -> EngineState:
+    """Transfer leadership src_replica -> dst_replica (same partition)."""
+    bs = st.replica_broker[src_replica]
+    bd = st.replica_broker[dst_replica]
+    # src loses (leader - follower) delta; dst gains it
+    delta_s = env.leader_load[src_replica] - env.follower_load[src_replica]
+    delta_d = env.leader_load[dst_replica] - env.follower_load[dst_replica]
+    util = st.util.at[bs].add(-delta_s).at[bd].add(delta_d)
+    leader_util = (st.leader_util.at[bs].add(-env.leader_load[src_replica])
+                                  .at[bd].add(env.leader_load[dst_replica]))
+    lc = st.leader_count.at[bs].add(-1).at[bd].add(1)
+    t = env.replica_topic[src_replica]
+    tlc = st.topic_leader_count.at[t, bs].add(-1).at[t, bd].add(1)
+    lead = st.replica_is_leader.at[src_replica].set(False).at[dst_replica].set(True)
+    return dataclasses.replace(st, replica_is_leader=lead, util=util,
+                               leader_util=leader_util, leader_count=lc,
+                               topic_leader_count=tlc,
+                               leadership_moved=st.leadership_moved
+                               .at[src_replica].set(True).at[dst_replica].set(True))
+
+
+def apply_swap(env: ClusterEnv, st: EngineState, replica_a: Array,
+               replica_b: Array) -> EngineState:
+    """Exchange the brokers of two (online) replicas of different partitions:
+    composition of two moves with full incremental bookkeeping."""
+    b_a = st.replica_broker[replica_a]
+    b_b = st.replica_broker[replica_b]
+    st = apply_move(env, st, replica_a, b_b)
+    return apply_move(env, st, replica_b, b_a)
+
+
+def no_op_move(st: EngineState) -> EngineState:
+    return st

@@ -1,0 +1,341 @@
+"""CruiseControl facade: the one object wiring every layer together.
+
+Reference: KafkaCruiseControl.java:73 (866) — constructs AdminClient ->
+AnomalyDetectorManager -> Executor -> LoadMonitor -> GoalOptimizer
+(:105-119), and every REST/self-healing operation flows through it
+(rebalance, add/remove/demote brokers, fix offline replicas, topic RF fix,
+pause/resume sampling, state). ``start_up()`` starts the monitor replay,
+anomaly detection and (host-side) proposal precompute
+(KafkaCruiseControl.java:201-207).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.env import OptimizationOptions
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerResult
+from cruise_control_tpu.config.defaults import cruise_control_config, effective_default_goals
+from cruise_control_tpu.detector.detectors import (
+    BrokerFailureDetector, DiskFailureDetector, GoalViolationDetector,
+    SlowBrokerFinder,
+)
+from cruise_control_tpu.detector.maintenance import (
+    FileMaintenanceEventReader, IdempotenceCache,
+)
+from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+from cruise_control_tpu.detector.topic_anomaly import TopicReplicationFactorAnomalyFinder
+from cruise_control_tpu.executor import Executor, SimClock
+from cruise_control_tpu.monitor.load_monitor import (
+    LoadMonitor, ModelCompletenessRequirements,
+)
+
+SELF_HEALING_GOALS = [
+    "RackAwareGoal", "MinTopicLeadersPerBrokerGoal", "ReplicaCapacityGoal",
+    "DiskCapacityGoal", "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal", "ReplicaDistributionGoal",
+]
+
+
+@dataclasses.dataclass
+class OperationResult:
+    operation: str
+    reason: str
+    optimizer_result: OptimizerResult | None = None
+    executed: bool = False
+    error: str | None = None
+
+    def to_json(self) -> dict:
+        out = {"operation": self.operation, "reason": self.reason,
+               "executed": self.executed}
+        if self.optimizer_result is not None:
+            out["result"] = self.optimizer_result.to_json()
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+class CruiseControl:
+    def __init__(self, backend, config=None):
+        self.config = config or cruise_control_config()
+        self.backend = backend
+        self.load_monitor = LoadMonitor(config=self.config, backend=backend)
+        self.goal_optimizer = GoalOptimizer(config=self.config)
+        self.executor = Executor(backend, config=self.config)
+        notifier = SelfHealingNotifier()
+        notifier.configure(self.config)
+        clock = SimClock(backend) if hasattr(backend, "advance") else None
+        self.anomaly_detector = AnomalyDetectorManager(
+            notifier=notifier, cruise_control=self, clock=clock)
+        self._wire_detectors()
+        self._proposal_cache: OptimizerResult | None = None
+        self._proposal_cache_generation = None
+        self._cache_lock = threading.Lock()
+        self._ops_history: list[dict] = []
+
+    # ------------------------------------------------------------- wiring
+    def _wire_detectors(self):
+        broker_fd = BrokerFailureDetector(self.backend)
+        disk_fd = DiskFailureDetector(self.backend)
+        goal_vd = GoalViolationDetector(
+            self.goal_optimizer, self.load_monitor,
+            self.config.get_list("anomaly.detection.goals"))
+        slow = SlowBrokerFinder()
+        slow.configure(self.config)
+        topic_rf = TopicReplicationFactorAnomalyFinder()
+        topic_rf.configure(self.config)
+        maint_reader = FileMaintenanceEventReader()
+        maint_reader.configure(self.config)
+        idem = IdempotenceCache(
+            float(self.config.get_int("maintenance.event.idempotence.retention.ms")))
+        self.goal_violation_detector = goal_vd
+
+        self.anomaly_detector.register_detector("BrokerFailureDetector",
+                                                broker_fd.run_once)
+        self.anomaly_detector.register_detector("DiskFailureDetector",
+                                                disk_fd.run_once)
+        self.anomaly_detector.register_detector("GoalViolationDetector",
+                                                goal_vd.run_once)
+        self.anomaly_detector.register_detector(
+            "SlowBrokerFinder",
+            lambda now: slow.run_once(self.backend.broker_metrics(), now))
+        self.anomaly_detector.register_detector(
+            "TopicAnomalyDetector",
+            lambda now: topic_rf.anomalies(self.backend, now))
+        self.anomaly_detector.register_detector(
+            "MaintenanceEventDetector",
+            lambda now: [e for e in maint_reader.read_events(now)
+                         if not idem.seen_before(
+                             f"{e.plan_type}:{e.brokers}:{e.topics}", now)])
+
+    def start_up(self) -> None:
+        self.load_monitor.start_up()
+
+    def shutdown(self) -> None:
+        self.anomaly_detector.shutdown()
+        self.load_monitor.shutdown()
+
+    # ------------------------------------------------------------ helpers
+    def _now_ms(self) -> float:
+        return (self.backend.now_ms if hasattr(self.backend, "now_ms")
+                else time.time() * 1000.0)
+
+    def _model(self, requirements=None):
+        return self.load_monitor.cluster_model(requirements)
+
+    def _run_optimization(self, operation: str, reason: str, ct, meta,
+                          goal_names=None, options=OptimizationOptions(),
+                          dry_run: bool = True, skip_hard_goal_check: bool = False,
+                          execute_kw: dict | None = None) -> OperationResult:
+        goals = goal_names or effective_default_goals(self.config)
+        res = self.goal_optimizer.optimizations(
+            ct, meta, goal_names=goals, options=options,
+            skip_hard_goal_check=skip_hard_goal_check)
+        op = OperationResult(operation=operation, reason=reason,
+                             optimizer_result=res)
+        if not dry_run and res.proposals:
+            self.executor.execute_proposals(res.proposals, **(execute_kw or {}))
+            op.executed = True
+        self._ops_history.append({"operation": operation, "reason": reason,
+                                  "ms": self._now_ms(),
+                                  "numProposals": len(res.proposals),
+                                  "executed": op.executed})
+        return op
+
+    # ---------------------------------------------------------- operations
+    def rebalance(self, goal_names=None, dry_run: bool = False,
+                  self_healing: bool = False, triggered_by_goal_violation: bool = False,
+                  skip_hard_goal_check: bool = False, reason: str = "rebalance") -> dict:
+        """POST /rebalance (RebalanceRunnable.java:30-115 role)."""
+        ct, meta = self._model()
+        options = OptimizationOptions(
+            triggered_by_goal_violation=triggered_by_goal_violation)
+        goals = goal_names or (SELF_HEALING_GOALS if self_healing else None)
+        op = self._run_optimization("REBALANCE", reason, ct, meta, goals, options,
+                                    dry_run=dry_run,
+                                    skip_hard_goal_check=skip_hard_goal_check
+                                    or self_healing)
+        return op.to_json()
+
+    def remove_brokers(self, broker_ids: list, dry_run: bool = False,
+                       self_healing: bool = False,
+                       reason: str = "remove brokers") -> dict:
+        """POST /remove_broker: drain the brokers, then (really) move load off
+        (RemoveBrokersRunnable role). Marks brokers as move-excluded
+        destinations and relocates everything they host."""
+        ct, meta = self._model()
+        idx = [meta.broker_index(b) for b in broker_ids]
+        alive = np.asarray(ct.broker_alive).copy()
+        excl = np.asarray(ct.broker_excluded_for_replica_move).copy()
+        offline = np.asarray(ct.replica_offline).copy()
+        rb = np.asarray(ct.replica_broker)
+        valid = np.asarray(ct.replica_valid)
+        import jax.numpy as jnp
+        for i in idx:
+            excl[i] = True
+            # every replica hosted there must relocate (treated like offline)
+            offline |= valid & (rb == i)
+        ct = dataclasses.replace(
+            ct,
+            broker_excluded_for_replica_move=jnp.asarray(excl),
+            replica_offline=jnp.asarray(offline))
+        op = self._run_optimization("REMOVE_BROKER", reason, ct, meta,
+                                    SELF_HEALING_GOALS, OptimizationOptions(),
+                                    dry_run=dry_run, skip_hard_goal_check=True)
+        if op.executed:
+            self.executor.note_removed_brokers(broker_ids)
+        return op.to_json()
+
+    def add_brokers(self, broker_ids: list, dry_run: bool = False,
+                    reason: str = "add brokers") -> dict:
+        """POST /add_broker: rebalance load onto the (new) brokers."""
+        ct, meta = self._model()
+        new = np.asarray(ct.broker_new).copy()
+        for b in broker_ids:
+            new[meta.broker_index(b)] = True
+        import jax.numpy as jnp
+        ct = dataclasses.replace(ct, broker_new=jnp.asarray(new))
+        op = self._run_optimization("ADD_BROKER", reason, ct, meta, None,
+                                    OptimizationOptions(), dry_run=dry_run)
+        return op.to_json()
+
+    def demote_brokers(self, broker_ids: list, dry_run: bool = False,
+                       reason: str = "demote brokers") -> dict:
+        """POST /demote_broker: move leadership away and prevent new leadership
+        (DemoteBrokerRunnable + PreferredLeaderElectionGoal role)."""
+        ct, meta = self._model()
+        demoted = np.asarray(ct.broker_demoted).copy()
+        for b in broker_ids:
+            demoted[meta.broker_index(b)] = True
+        import jax.numpy as jnp
+        ct = dataclasses.replace(ct, broker_demoted=jnp.asarray(demoted))
+        op = self._run_optimization(
+            "DEMOTE_BROKER", reason, ct, meta,
+            ["LeaderReplicaDistributionGoal", "PreferredLeaderElectionGoal"],
+            OptimizationOptions(), dry_run=dry_run, skip_hard_goal_check=True)
+        if op.executed:
+            self.executor.note_demoted_brokers(broker_ids)
+        return op.to_json()
+
+    def fix_offline_replicas(self, dry_run: bool = False,
+                             reason: str = "fix offline replicas") -> dict:
+        """POST /fix_offline_replicas (FixOfflineReplicasRunnable role)."""
+        ct, meta = self._model()
+        op = self._run_optimization(
+            "FIX_OFFLINE_REPLICAS", reason, ct, meta, SELF_HEALING_GOALS,
+            OptimizationOptions(fix_offline_replicas_only=True),
+            dry_run=dry_run, skip_hard_goal_check=True)
+        return op.to_json()
+
+    def fix_topic_replication_factor(self, bad_topics: dict,
+                                     reason: str = "fix topic RF") -> dict:
+        """Topic RF healing: under-replicated topics get replicas added on
+        least-loaded alive brokers (UpdateTopicConfigurationRunnable role)."""
+        target_rf = self.config.get_int("self.healing.target.topic.replication.factor")
+        partitions = self.backend.partitions()
+        brokers = self.backend.brokers()
+        alive = [b for b, n in brokers.items() if n.alive]
+        assignments = {}
+        for (topic, part), info in partitions.items():
+            if topic not in bad_topics:
+                continue
+            replicas = list(info.replicas)
+            if len(replicas) < target_rf:
+                candidates = [b for b in alive if b not in replicas]
+                need = target_rf - len(replicas)
+                replicas.extend(candidates[:need])
+            elif len(replicas) > target_rf:
+                keep = [info.leader] + [b for b in replicas if b != info.leader]
+                replicas = keep[:target_rf]
+            if replicas != info.replicas:
+                assignments[(topic, part)] = replicas
+        if assignments:
+            self.backend.alter_partition_reassignments(assignments)
+        return {"operation": "TOPIC_REPLICATION_FACTOR", "reason": reason,
+                "numPartitionsChanged": len(assignments)}
+
+    # ------------------------------------------------------------ proposals
+    def cached_proposals(self, force_refresh: bool = False) -> OptimizerResult:
+        """GET /proposals with generation-checked cache
+        (GoalOptimizer precompute/cache role, GoalOptimizer.java:219-339)."""
+        gen = self.load_monitor.model_generation().as_tuple()
+        with self._cache_lock:
+            if (not force_refresh and self._proposal_cache is not None
+                    and self._proposal_cache_generation == gen):
+                return self._proposal_cache
+        ct, meta = self._model()
+        res = self.goal_optimizer.optimizations(ct, meta)
+        with self._cache_lock:
+            self._proposal_cache = res
+            self._proposal_cache_generation = gen
+        return res
+
+    # ---------------------------------------------------------------- state
+    def state_json(self, substates=None) -> dict:
+        out = {}
+        substates = [s.upper() for s in (substates or
+                     ["MONITOR", "EXECUTOR", "ANALYZER", "ANOMALY_DETECTOR"])]
+        if "MONITOR" in substates:
+            out["MonitorState"] = self.load_monitor.state_json()
+        if "EXECUTOR" in substates:
+            out["ExecutorState"] = self.executor.state_json()
+        if "ANALYZER" in substates:
+            with self._cache_lock:
+                ready = self._proposal_cache is not None
+            out["AnalyzerState"] = {
+                "isProposalReady": ready,
+                "goals": self.goal_optimizer.default_goal_names,
+            }
+        if "ANOMALY_DETECTOR" in substates:
+            out["AnomalyDetectorState"] = self.anomaly_detector.state_json()
+        return out
+
+    def kafka_cluster_state(self) -> dict:
+        """GET /kafka_cluster_state."""
+        brokers = self.backend.brokers()
+        partitions = self.backend.partitions()
+        per_broker: dict[int, dict] = {
+            b: {"replicaCount": 0, "leaderCount": 0, "rack": n.rack,
+                "alive": n.alive} for b, n in brokers.items()}
+        for info in partitions.values():
+            for b in info.replicas:
+                if b in per_broker:
+                    per_broker[b]["replicaCount"] += 1
+            if info.leader in per_broker:
+                per_broker[info.leader]["leaderCount"] += 1
+        return {
+            "KafkaBrokerState": per_broker,
+            "KafkaPartitionState": {
+                "offline": [f"{t}-{p}" for (t, p), i in partitions.items()
+                            if i.leader < 0],
+                "underReplicated": [],
+                "totalPartitions": len(partitions),
+            },
+        }
+
+    def partition_load(self, sort_by: str = "DISK", limit: int = 50) -> list:
+        """GET /partition_load: per-partition utilization, sorted."""
+        from cruise_control_tpu.common.resources import Resource
+        ct, meta = self._model()
+        loads = np.asarray(ct.leader_load)
+        lead = np.asarray(ct.replica_is_leader)
+        valid = np.asarray(ct.replica_valid)
+        res = Resource[sort_by.upper()] if sort_by.upper() in Resource.__members__ \
+            else Resource.DISK
+        rows = []
+        for j in np.flatnonzero(valid & lead):
+            t, p = meta.partition_ids[int(ct.replica_partition[j])]
+            rows.append({"topic": t, "partition": p,
+                         "cpu": float(loads[j, Resource.CPU]),
+                         "networkInbound": float(loads[j, Resource.NW_IN]),
+                         "networkOutbound": float(loads[j, Resource.NW_OUT]),
+                         "disk": float(loads[j, Resource.DISK]),
+                         "leader": int(meta.broker_ids[int(ct.replica_broker[j])])})
+        key = {"CPU": "cpu", "NW_IN": "networkInbound", "NW_OUT": "networkOutbound",
+               "DISK": "disk"}[res.name]
+        rows.sort(key=lambda r: -r[key])
+        return rows[:limit]

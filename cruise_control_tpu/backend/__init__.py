@@ -1,0 +1,6 @@
+from cruise_control_tpu.backend.interface import (
+    BrokerNode, ClusterBackend, PartitionInfo,
+)
+from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+
+__all__ = ["BrokerNode", "ClusterBackend", "PartitionInfo", "SimulatedClusterBackend"]

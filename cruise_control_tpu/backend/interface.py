@@ -1,0 +1,63 @@
+"""ClusterBackend: the pluggable boundary to the managed cluster.
+
+The reference talks to a real Kafka deployment through three transports
+(SURVEY §2.10): the Kafka wire protocol (metrics consumer, sample-store
+producer, AdminClient), ZooKeeper (reassignment znodes Executor.java:1272,
+broker liveness watches BrokerFailureDetector.java:84, throttle configs
+ReplicationThrottleHelper.java:36-42) and HTTP. This interface abstracts all
+actuation + metadata behind one SPI so the framework runs identically against
+the simulated backend (tests/dev — the embedded-Kafka role of
+CCKafkaIntegrationTestHarness) or a thin adapter to a real cluster.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Protocol
+
+
+@dataclasses.dataclass
+class BrokerNode:
+    broker_id: int
+    rack: str
+    alive: bool = True
+    logdirs: dict = dataclasses.field(default_factory=dict)   # logdir -> capacity MB
+    dead_logdirs: set = dataclasses.field(default_factory=set)
+    cpu_capacity: float = 100.0
+    nw_in_capacity: float = 50_000.0
+    nw_out_capacity: float = 50_000.0
+
+
+@dataclasses.dataclass
+class PartitionInfo:
+    topic: str
+    partition: int
+    replicas: list                      # broker ids, preferred leader first
+    leader: int                         # broker id, -1 = none
+    logdir_by_broker: dict = dataclasses.field(default_factory=dict)
+    size_mb: float = 0.0
+    bytes_in_rate: float = 0.0          # KB/s produced to the leader
+    bytes_out_rate: float = 0.0         # KB/s consumed from the leader
+    cpu_util: float = 0.0               # leader CPU percent
+
+
+class ClusterBackend(Protocol):
+    """Everything the monitor/executor/detector layers need from the cluster."""
+
+    # -- metadata (MetadataClient role) --
+    def brokers(self) -> dict: ...                       # id -> BrokerNode
+    def partitions(self) -> dict: ...                    # (topic, part) -> PartitionInfo
+    def metadata_generation(self) -> int: ...
+
+    # -- metrics (metrics-reporter topic / Prometheus role) --
+    def partition_metrics(self) -> dict: ...             # (t, p) -> {metric: value}
+    def broker_metrics(self) -> dict: ...                # id -> {metric: value}
+
+    # -- actuation (ZK znodes + AdminClient role) --
+    def alter_partition_reassignments(self, assignments: dict) -> None: ...
+    def ongoing_reassignments(self) -> dict: ...
+    def cancel_reassignments(self, tps: list) -> None: ...
+    def elect_leaders(self, tps_to_leader: dict) -> None: ...
+    def alter_replica_logdirs(self, moves: dict) -> None: ...
+    def describe_logdirs(self) -> dict: ...              # broker -> {logdir: alive}
+    def set_replication_throttle(self, rate_bytes_per_sec: int | None) -> None: ...
+    def replication_throttle(self) -> int | None: ...

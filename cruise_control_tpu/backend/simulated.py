@@ -1,0 +1,281 @@
+"""Simulated cluster backend.
+
+Fills the role of the reference's embedded test cluster
+(CCKafkaIntegrationTestHarness + CCEmbeddedBroker/CCEmbeddedZookeeper,
+cruise-control-metrics-reporter/src/test/.../utils/CCEmbeddedBroker.java:21)
+AND of a dev/demo target: a fully in-process cluster with brokers, partitions,
+replica placement, leadership, metric emission with configurable noise, and
+time-based replica-movement execution with throttling.
+
+Reassignments do not complete instantly: each added replica must "copy"
+``size_mb`` at the (throttled) replication rate; ``advance(dt)`` moves
+simulated time forward. This is what makes executor tests meaningful
+(progress polling, concurrency caps, throttle behavior) without a JVM.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import numpy as np
+
+from cruise_control_tpu.backend.interface import BrokerNode, PartitionInfo
+
+
+@dataclasses.dataclass
+class _InFlight:
+    tp: tuple
+    adding: list                    # broker ids still copying
+    target: list                    # final replica list
+    copied_mb: dict = dataclasses.field(default_factory=dict)
+
+
+DEFAULT_REPLICATION_RATE_KBPS = 100_000.0   # unthrottled copy rate per replica
+
+
+class SimulatedClusterBackend:
+    """In-process cluster. All public methods are thread-safe."""
+
+    def __init__(self, metric_noise: float = 0.0, seed: int = 0):
+        self._lock = threading.RLock()
+        self._brokers: dict[int, BrokerNode] = {}
+        self._partitions: dict[tuple, PartitionInfo] = {}
+        self._inflight: dict[tuple, _InFlight] = {}
+        self._throttle: int | None = None
+        self._meta_gen = 0
+        self._now_ms = 0.0
+        self._noise = metric_noise
+        self._rng = np.random.default_rng(seed)
+
+    def configure(self, config, **extra):
+        pass
+
+    # ------------------------------------------------------------------ setup
+    def add_broker(self, broker_id: int, rack: str, logdirs: dict | None = None,
+                   cpu_capacity: float = 100.0, nw_in_capacity: float = 50_000.0,
+                   nw_out_capacity: float = 50_000.0) -> "SimulatedClusterBackend":
+        with self._lock:
+            self._brokers[broker_id] = BrokerNode(
+                broker_id=broker_id, rack=rack,
+                logdirs=dict(logdirs or {"/logdir0": 500_000.0}),
+                cpu_capacity=cpu_capacity, nw_in_capacity=nw_in_capacity,
+                nw_out_capacity=nw_out_capacity)
+            self._meta_gen += 1
+        return self
+
+    def create_partition(self, topic: str, partition: int, replicas: list,
+                         size_mb: float = 0.0, bytes_in_rate: float = 0.0,
+                         bytes_out_rate: float = 0.0, cpu_util: float = 0.0,
+                         logdir_by_broker: dict | None = None) -> "SimulatedClusterBackend":
+        with self._lock:
+            for b in replicas:
+                if b not in self._brokers:
+                    raise ValueError(f"unknown broker {b}")
+            logdirs = dict(logdir_by_broker or {})
+            for b in replicas:
+                logdirs.setdefault(b, next(iter(self._brokers[b].logdirs)))
+            self._partitions[(topic, partition)] = PartitionInfo(
+                topic=topic, partition=partition, replicas=list(replicas),
+                leader=replicas[0], logdir_by_broker=logdirs, size_mb=size_mb,
+                bytes_in_rate=bytes_in_rate, bytes_out_rate=bytes_out_rate,
+                cpu_util=cpu_util)
+            self._meta_gen += 1
+        return self
+
+    # ------------------------------------------------------- fault injection
+    def kill_broker(self, broker_id: int) -> None:
+        with self._lock:
+            self._brokers[broker_id].alive = False
+            for info in self._partitions.values():
+                if info.leader == broker_id:
+                    survivors = [b for b in info.replicas
+                                 if self._brokers[b].alive]
+                    info.leader = survivors[0] if survivors else -1
+            self._meta_gen += 1
+
+    def restart_broker(self, broker_id: int) -> None:
+        with self._lock:
+            self._brokers[broker_id].alive = True
+            self._meta_gen += 1
+
+    def fail_disk(self, broker_id: int, logdir: str) -> None:
+        with self._lock:
+            self._brokers[broker_id].dead_logdirs.add(logdir)
+            self._meta_gen += 1
+
+    # ---------------------------------------------------------------- clock
+    @property
+    def now_ms(self) -> float:
+        return self._now_ms
+
+    def advance(self, dt_ms: float) -> None:
+        """Advance simulated time: progress in-flight reassignments."""
+        with self._lock:
+            self._now_ms += dt_ms
+            rate_kbps = (self._throttle / 1024.0 if self._throttle
+                         else DEFAULT_REPLICATION_RATE_KBPS)
+            done_tps = []
+            for tp, fl in self._inflight.items():
+                info = self._partitions[tp]
+                mb = rate_kbps * (dt_ms / 1000.0) / 1024.0
+                still = []
+                for b in fl.adding:
+                    fl.copied_mb[b] = fl.copied_mb.get(b, 0.0) + mb
+                    if fl.copied_mb[b] >= info.size_mb:
+                        # replica caught up: joins the replica list
+                        if b not in info.replicas:
+                            info.replicas.append(b)
+                            info.logdir_by_broker.setdefault(
+                                b, next(iter(self._brokers[b].logdirs)))
+                    else:
+                        still.append(b)
+                fl.adding = still
+                if not still:
+                    # drop replicas not in the target list
+                    removed = [b for b in info.replicas if b not in fl.target]
+                    info.replicas = [b for b in fl.target]
+                    for b in removed:
+                        info.logdir_by_broker.pop(b, None)
+                    if info.leader not in info.replicas:
+                        info.leader = info.replicas[0] if info.replicas else -1
+                    done_tps.append(tp)
+            for tp in done_tps:
+                del self._inflight[tp]
+            if done_tps:
+                self._meta_gen += 1
+
+    # -------------------------------------------------------------- metadata
+    def brokers(self) -> dict:
+        with self._lock:
+            return {b: dataclasses.replace(n, logdirs=dict(n.logdirs),
+                                           dead_logdirs=set(n.dead_logdirs))
+                    for b, n in self._brokers.items()}
+
+    def partitions(self) -> dict:
+        with self._lock:
+            return {tp: dataclasses.replace(
+                        info, replicas=list(info.replicas),
+                        logdir_by_broker=dict(info.logdir_by_broker))
+                    for tp, info in self._partitions.items()}
+
+    def metadata_generation(self) -> int:
+        with self._lock:
+            return self._meta_gen
+
+    # --------------------------------------------------------------- metrics
+    def _jitter(self, v: float) -> float:
+        if self._noise <= 0 or v == 0:
+            return v
+        return float(v * (1.0 + self._rng.normal(0, self._noise)))
+
+    def partition_metrics(self) -> dict:
+        """Model-metric rows per partition (CruiseControlMetricsProcessor
+        output shape: CPU_USAGE / DISK_USAGE / LEADER_BYTES_IN / LEADER_BYTES_OUT)."""
+        with self._lock:
+            out = {}
+            for tp, info in self._partitions.items():
+                if info.leader < 0 or not self._brokers[info.leader].alive:
+                    continue
+                out[tp] = {
+                    "CPU_USAGE": self._jitter(info.cpu_util),
+                    "DISK_USAGE": self._jitter(info.size_mb),
+                    "LEADER_BYTES_IN": self._jitter(info.bytes_in_rate),
+                    "LEADER_BYTES_OUT": self._jitter(info.bytes_out_rate),
+                }
+            return out
+
+    def broker_metrics(self) -> dict:
+        with self._lock:
+            out = {}
+            for b, node in self._brokers.items():
+                if not node.alive:
+                    continue
+                lin = sum(i.bytes_in_rate for i in self._partitions.values()
+                          if i.leader == b)
+                lout = sum(i.bytes_out_rate for i in self._partitions.values()
+                           if i.leader == b)
+                cpu = sum(i.cpu_util for i in self._partitions.values()
+                          if i.leader == b)
+                out[b] = {
+                    "BROKER_CPU_UTIL": self._jitter(cpu),
+                    "ALL_TOPIC_BYTES_IN": self._jitter(lin),
+                    "ALL_TOPIC_BYTES_OUT": self._jitter(lout),
+                    "BROKER_LOG_FLUSH_TIME_MS_MEAN": self._jitter(1.0),
+                    "BROKER_LOG_FLUSH_TIME_MS_999TH": self._jitter(5.0),
+                }
+            return out
+
+    # -------------------------------------------------------------- actuation
+    def alter_partition_reassignments(self, assignments: dict) -> None:
+        """Start reassignments: {(topic, part): [target broker ids]}
+        (the ZK reassignment-znode write, Executor.java:1272)."""
+        with self._lock:
+            for tp, target in assignments.items():
+                info = self._partitions[tp]
+                for b in target:
+                    if b not in self._brokers:
+                        raise ValueError(f"unknown broker {b} for {tp}")
+                adding = [b for b in target if b not in info.replicas]
+                if tp in self._inflight:
+                    raise RuntimeError(f"reassignment already in flight for {tp}")
+                self._inflight[tp] = _InFlight(tp=tp, adding=adding,
+                                               target=list(target))
+                if not adding:
+                    # pure shrink/reorder completes on next advance
+                    pass
+            self._meta_gen += 1
+
+    def ongoing_reassignments(self) -> dict:
+        with self._lock:
+            return {tp: {"adding": list(fl.adding), "target": list(fl.target)}
+                    for tp, fl in self._inflight.items()}
+
+    def cancel_reassignments(self, tps: list) -> None:
+        """Force-stop: delete the 'znode' (ExecutionUtils.java:305-307)."""
+        with self._lock:
+            for tp in tps:
+                fl = self._inflight.pop(tp, None)
+                if fl is None:
+                    continue
+                info = self._partitions[tp]
+                # adding replicas that finished stay; unfinished are dropped
+                info.replicas = [b for b in info.replicas]
+            self._meta_gen += 1
+
+    def elect_leaders(self, tps_to_leader: dict) -> None:
+        with self._lock:
+            for tp, leader in tps_to_leader.items():
+                info = self._partitions[tp]
+                if leader not in info.replicas:
+                    raise ValueError(f"{leader} not a replica of {tp}")
+                if not self._brokers[leader].alive:
+                    raise ValueError(f"broker {leader} is dead")
+                info.leader = leader
+            self._meta_gen += 1
+
+    def alter_replica_logdirs(self, moves: dict) -> None:
+        """Intra-broker move: {(topic, part, broker): logdir}
+        (AdminClient.alterReplicaLogDirs, ExecutorAdminUtils.java:70-88)."""
+        with self._lock:
+            for (topic, part, broker), logdir in moves.items():
+                info = self._partitions[(topic, part)]
+                if broker not in info.replicas:
+                    raise ValueError(f"{broker} not a replica of {(topic, part)}")
+                if logdir not in self._brokers[broker].logdirs:
+                    raise ValueError(f"unknown logdir {logdir} on broker {broker}")
+                info.logdir_by_broker[broker] = logdir
+            self._meta_gen += 1
+
+    def describe_logdirs(self) -> dict:
+        with self._lock:
+            return {b: {ld: (ld not in n.dead_logdirs) and n.alive
+                        for ld in n.logdirs}
+                    for b, n in self._brokers.items()}
+
+    def set_replication_throttle(self, rate_bytes_per_sec: int | None) -> None:
+        with self._lock:
+            self._throttle = rate_bytes_per_sec
+
+    def replication_throttle(self) -> int | None:
+        with self._lock:
+            return self._throttle

@@ -36,12 +36,13 @@ class Resource(enum.IntEnum):
         (Resource.java:30-32); we accumulate in float64 on host and float32
         on device, keeping the same epsilon contract.
         """
-        return max(_EPSILON_ABS[self], EPSILON_PERCENT * (v1 + v2))
+        return max(EPSILON_ABS[self], EPSILON_PERCENT * (v1 + v2))
 
 
 # Absolute epsilon per resource (reference Resource.java enum constants:
 # CPU 0.001, NW 10 KB, DISK 100 MB — units: CPU %, KB/s, MB).
-_EPSILON_ABS = {
+# Single source of truth — the analyzer's violation tolerances index this too.
+EPSILON_ABS = {
     Resource.CPU: 0.001,
     Resource.NW_IN: 10.0,
     Resource.NW_OUT: 10.0,

@@ -230,12 +230,15 @@ _D.define(name="self.healing.enabled", type=Type.BOOLEAN, default=False,
           doc="Master switch for self-healing (per-type switches in the notifier).")
 _D.define(name="self.healing.exclude.recently.demoted.brokers", type=Type.BOOLEAN, default=True)
 _D.define(name="self.healing.exclude.recently.removed.brokers", type=Type.BOOLEAN, default=True)
-_D.define(name="broker.failures.self.healing.enabled", type=Type.BOOLEAN, default=False)
-_D.define(name="goal.violations.self.healing.enabled", type=Type.BOOLEAN, default=False)
-_D.define(name="disk.failures.self.healing.enabled", type=Type.BOOLEAN, default=False)
-_D.define(name="metric.anomaly.self.healing.enabled", type=Type.BOOLEAN, default=False)
-_D.define(name="topic.anomaly.self.healing.enabled", type=Type.BOOLEAN, default=False)
-_D.define(name="maintenance.event.self.healing.enabled", type=Type.BOOLEAN, default=False)
+# Per-type switches are tri-state: unset (None) falls back to
+# self.healing.enabled; an explicit value overrides the master switch
+# (SelfHealingNotifier.java per-type config semantics).
+_D.define(name="broker.failures.self.healing.enabled", type=Type.BOOLEAN, default=None)
+_D.define(name="goal.violations.self.healing.enabled", type=Type.BOOLEAN, default=None)
+_D.define(name="disk.failures.self.healing.enabled", type=Type.BOOLEAN, default=None)
+_D.define(name="metric.anomaly.self.healing.enabled", type=Type.BOOLEAN, default=None)
+_D.define(name="topic.anomaly.self.healing.enabled", type=Type.BOOLEAN, default=None)
+_D.define(name="maintenance.event.self.healing.enabled", type=Type.BOOLEAN, default=None)
 _D.define(name="broker.failure.alert.threshold.ms", type=Type.LONG, default=900_000,
           doc="SelfHealingNotifier grace: alert after this long.")
 _D.define(name="broker.failure.self.healing.threshold.ms", type=Type.LONG, default=1_800_000,

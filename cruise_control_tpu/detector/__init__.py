@@ -1,0 +1,33 @@
+from cruise_control_tpu.detector.anomalies import (
+    Anomaly, AnomalyType, BrokerFailures, DiskFailures, GoalViolations,
+    MaintenanceEvent, MetricAnomaly, SlowBrokers, TopicAnomaly,
+)
+from cruise_control_tpu.detector.detectors import (
+    BrokerFailureDetector, DiskFailureDetector, GoalViolationDetector,
+    SlowBrokerFinder,
+)
+from cruise_control_tpu.detector.maintenance import (
+    FileMaintenanceEventReader, IdempotenceCache,
+)
+from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+from cruise_control_tpu.detector.metric_anomaly import PercentileMetricAnomalyFinder
+from cruise_control_tpu.detector.notifier import (
+    Action, AlertFileNotifier, NoopNotifier, SelfHealingNotifier,
+)
+from cruise_control_tpu.detector.provisioner import (
+    NoopProvisioner, ProvisionRecommendation, ProvisionStatus,
+)
+from cruise_control_tpu.detector.topic_anomaly import (
+    PartitionSizeAnomalyFinder, TopicReplicationFactorAnomalyFinder,
+)
+
+__all__ = [
+    "Anomaly", "AnomalyType", "BrokerFailures", "DiskFailures", "GoalViolations",
+    "MaintenanceEvent", "MetricAnomaly", "SlowBrokers", "TopicAnomaly",
+    "BrokerFailureDetector", "DiskFailureDetector", "GoalViolationDetector",
+    "SlowBrokerFinder", "FileMaintenanceEventReader", "IdempotenceCache",
+    "AnomalyDetectorManager", "PercentileMetricAnomalyFinder",
+    "Action", "AlertFileNotifier", "NoopNotifier", "SelfHealingNotifier",
+    "NoopProvisioner", "ProvisionRecommendation", "ProvisionStatus",
+    "PartitionSizeAnomalyFinder", "TopicReplicationFactorAnomalyFinder",
+]

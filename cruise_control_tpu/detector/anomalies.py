@@ -1,0 +1,144 @@
+"""Anomaly types + self-healing fix plans.
+
+Reference: detector/ KafkaAnomaly subclasses (GoalViolations.java,
+BrokerFailures.java, DiskFailures.java, SlowBrokers.java, TopicAnomaly,
+MaintenanceEvent) and notifier/KafkaAnomalyType.java (priority order:
+BROKER_FAILURE=0, MAINTENANCE_EVENT=1, DISK_FAILURE=2, METRIC_ANOMALY=3,
+GOAL_VIOLATION=4, TOPIC_ANOMALY=5 — smaller = handled first). Each anomaly's
+``fix(cruise_control)`` routes through the same optimizer/executor path as the
+REST handlers (RemoveBrokersRunnable / RebalanceRunnable /
+FixOfflineReplicasRunnable role).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+import time
+
+
+class AnomalyType(enum.IntEnum):
+    """Smaller value = higher handling priority (KafkaAnomalyType.java:32-42)."""
+    BROKER_FAILURE = 0
+    MAINTENANCE_EVENT = 1
+    DISK_FAILURE = 2
+    METRIC_ANOMALY = 3
+    GOAL_VIOLATION = 4
+    TOPIC_ANOMALY = 5
+
+
+_seq = itertools.count()
+
+
+@dataclasses.dataclass
+class Anomaly:
+    anomaly_type: AnomalyType
+    detected_ms: float
+    description: str = ""
+    anomaly_id: int = dataclasses.field(default_factory=lambda: next(_seq))
+    fixable: bool = True
+
+    def fix(self, cruise_control) -> dict | None:
+        """Self-heal through the facade; returns an operation summary."""
+        return None
+
+    def sort_key(self):
+        return (int(self.anomaly_type), self.detected_ms, self.anomaly_id)
+
+    def to_json(self) -> dict:
+        return {"anomalyId": self.anomaly_id, "type": self.anomaly_type.name,
+                "detectedMs": self.detected_ms, "description": self.description,
+                "fixable": self.fixable}
+
+
+@dataclasses.dataclass
+class BrokerFailures(Anomaly):
+    failed_brokers: dict = dataclasses.field(default_factory=dict)  # id -> failure ts
+
+    def fix(self, cruise_control):
+        """RemoveBrokersRunnable role: move all replicas off the dead brokers
+        using self-healing goals."""
+        return cruise_control.remove_brokers(
+            sorted(self.failed_brokers), self_healing=True,
+            reason=f"self-healing broker failure: {sorted(self.failed_brokers)}")
+
+
+@dataclasses.dataclass
+class DiskFailures(Anomaly):
+    failed_disks: dict = dataclasses.field(default_factory=dict)  # broker -> [logdir]
+
+    def fix(self, cruise_control):
+        """FixOfflineReplicasRunnable role."""
+        return cruise_control.fix_offline_replicas(
+            reason=f"self-healing disk failure: {self.failed_disks}")
+
+
+@dataclasses.dataclass
+class GoalViolations(Anomaly):
+    violated_goals_fixable: list = dataclasses.field(default_factory=list)
+    violated_goals_unfixable: list = dataclasses.field(default_factory=list)
+
+    def fix(self, cruise_control):
+        if not self.violated_goals_fixable:
+            return None
+        return cruise_control.rebalance(
+            self_healing=True, triggered_by_goal_violation=True,
+            reason=f"self-healing goal violation: {self.violated_goals_fixable}")
+
+
+@dataclasses.dataclass
+class MetricAnomaly(Anomaly):
+    broker_ids: list = dataclasses.field(default_factory=list)
+    metric_name: str = ""
+
+    def fix(self, cruise_control):
+        return None  # reference default: alert only (fix via SlowBrokers)
+
+
+@dataclasses.dataclass
+class SlowBrokers(Anomaly):
+    slow_brokers: dict = dataclasses.field(default_factory=dict)  # id -> score
+    remove: bool = False
+
+    def fix(self, cruise_control):
+        brokers = sorted(self.slow_brokers)
+        if self.remove:
+            return cruise_control.remove_brokers(
+                brokers, self_healing=True,
+                reason=f"self-healing slow broker removal: {brokers}")
+        return cruise_control.demote_brokers(
+            brokers, reason=f"self-healing slow broker demotion: {brokers}")
+
+
+@dataclasses.dataclass
+class TopicAnomaly(Anomaly):
+    bad_topics: dict = dataclasses.field(default_factory=dict)
+
+    def fix(self, cruise_control):
+        return cruise_control.fix_topic_replication_factor(
+            self.bad_topics, reason="self-healing topic replication factor")
+
+
+@dataclasses.dataclass
+class MaintenanceEvent(Anomaly):
+    plan_type: str = ""      # ADD_BROKER/REMOVE_BROKER/DEMOTE_BROKER/REBALANCE/
+                             # FIX_OFFLINE_REPLICAS/TOPIC_REPLICATION_FACTOR
+    brokers: list = dataclasses.field(default_factory=list)
+    topics: dict = dataclasses.field(default_factory=dict)
+
+    def fix(self, cruise_control):
+        pt = self.plan_type.upper()
+        reason = f"maintenance event {pt}"
+        if pt == "REMOVE_BROKER":
+            return cruise_control.remove_brokers(self.brokers, reason=reason)
+        if pt == "ADD_BROKER":
+            return cruise_control.add_brokers(self.brokers, reason=reason)
+        if pt == "DEMOTE_BROKER":
+            return cruise_control.demote_brokers(self.brokers, reason=reason)
+        if pt == "REBALANCE":
+            return cruise_control.rebalance(reason=reason)
+        if pt == "FIX_OFFLINE_REPLICAS":
+            return cruise_control.fix_offline_replicas(reason=reason)
+        if pt == "TOPIC_REPLICATION_FACTOR":
+            return cruise_control.fix_topic_replication_factor(self.topics, reason=reason)
+        raise ValueError(f"unknown maintenance plan type {self.plan_type!r}")

@@ -1,0 +1,196 @@
+"""Core anomaly detectors.
+
+Reference:
+- GoalViolationDetector.java:72-254 — re-runs detection goals on a fresh
+  cluster model, records fixable/unfixable violations, computes balancedness +
+  provision status, triggers Provisioner.rightsize.
+- BrokerFailureDetector.java:52-123 — ZooKeeper child watch on /brokers/ids
+  with persisted failure times; here a metadata poll against the backend
+  (the SPI boundary) with the same persisted-failure-time contract.
+- DiskFailureDetector.java (117) — describeLogDirs -> offline logdirs.
+- SlowBrokerFinder.java (478) — log-flush-time vs byte-rate percentile
+  heuristics; repeated offenders escalate demote -> remove.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from cruise_control_tpu.detector.anomalies import (
+    AnomalyType, BrokerFailures, DiskFailures, GoalViolations, SlowBrokers,
+)
+from cruise_control_tpu.detector.provisioner import (
+    ProvisionRecommendation, ProvisionStatus,
+)
+
+
+class GoalViolationDetector:
+    def __init__(self, goal_optimizer, load_monitor, detection_goals: list,
+                 provisioner=None):
+        self._optimizer = goal_optimizer
+        self._monitor = load_monitor
+        self._goals = list(detection_goals)
+        self._provisioner = provisioner
+        self.last_balancedness: float = 100.0
+        self.last_provision: ProvisionRecommendation | None = None
+
+    def run_once(self, now_ms: float) -> list:
+        from cruise_control_tpu.analyzer.env import OptimizationOptions
+        from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
+        try:
+            ct, meta = self._monitor.cluster_model()
+        except NotEnoughValidWindowsError:
+            return []   # not enough data yet — detector skips this round
+        res = self._optimizer.optimizations(
+            ct, meta, goal_names=self._goals,
+            options=OptimizationOptions(triggered_by_goal_violation=True),
+            skip_hard_goal_check=True)
+        self.last_balancedness = res.balancedness_before
+        fixable = [g.name for g in res.goal_results
+                   if g.violated_before and not g.violated_after]
+        unfixable = [g.name for g in res.goal_results
+                     if g.violated_before and g.violated_after]
+        if self._provisioner is not None:
+            from cruise_control_tpu.detector.provisioner import provision_status_from_stats
+            rec = provision_status_from_stats(res.stats_after, None, 0)
+            self.last_provision = rec
+            if rec.status is not ProvisionStatus.RIGHT_SIZED:
+                self._provisioner.rightsize([rec])
+        if not fixable and not unfixable:
+            return []
+        return [GoalViolations(
+            anomaly_type=AnomalyType.GOAL_VIOLATION, detected_ms=now_ms,
+            violated_goals_fixable=fixable, violated_goals_unfixable=unfixable,
+            fixable=bool(fixable),
+            description=f"violated goals fixable={fixable} unfixable={unfixable}")]
+
+
+class BrokerFailureDetector:
+    """Polls broker liveness; persists first-failure times so a restart does
+    not reset the self-healing grace clock (BrokerFailureDetector.java:119-123
+    persists to a znode; here a JSON file)."""
+
+    def __init__(self, backend, persist_path: str = ""):
+        self._backend = backend
+        self._persist_path = persist_path
+        self._failure_ms: dict[int, float] = {}
+        self._load()
+
+    def _load(self):
+        if self._persist_path and os.path.exists(self._persist_path):
+            try:
+                with open(self._persist_path) as f:
+                    self._failure_ms = {int(k): v for k, v in json.load(f).items()}
+            except (json.JSONDecodeError, OSError):
+                self._failure_ms = {}
+
+    def _save(self):
+        if self._persist_path:
+            with open(self._persist_path, "w") as f:
+                json.dump(self._failure_ms, f)
+
+    def run_once(self, now_ms: float) -> list:
+        brokers = self._backend.brokers()
+        dead = {b for b, node in brokers.items() if not node.alive}
+        # new failures get stamped; revived brokers are cleared
+        changed = False
+        for b in dead:
+            if b not in self._failure_ms:
+                self._failure_ms[b] = now_ms
+                changed = True
+        for b in list(self._failure_ms):
+            if b not in dead:
+                del self._failure_ms[b]
+                changed = True
+        if changed:
+            self._save()
+        if not self._failure_ms:
+            return []
+        return [BrokerFailures(
+            anomaly_type=AnomalyType.BROKER_FAILURE, detected_ms=now_ms,
+            failed_brokers=dict(self._failure_ms),
+            description=f"failed brokers: {sorted(self._failure_ms)}")]
+
+
+class DiskFailureDetector:
+    def __init__(self, backend):
+        self._backend = backend
+
+    def run_once(self, now_ms: float) -> list:
+        logdirs = self._backend.describe_logdirs()
+        brokers = self._backend.brokers()
+        failed: dict[int, list] = {}
+        for b, dirs in logdirs.items():
+            if not brokers[b].alive:
+                continue   # dead broker is a broker failure, not a disk failure
+            bad = [ld for ld, ok in dirs.items() if not ok]
+            if bad:
+                failed[b] = bad
+        if not failed:
+            return []
+        return [DiskFailures(
+            anomaly_type=AnomalyType.DISK_FAILURE, detected_ms=now_ms,
+            failed_disks=failed,
+            description=f"failed disks: {failed}")]
+
+
+class SlowBrokerFinder:
+    """Percentile heuristic: a broker is slow when its log-flush time is far
+    above the cluster percentile while its byte rate is not (so it's slow, not
+    just busy). Repeated detection escalates: score >= demotion_score ->
+    demote; >= decommission_score -> remove (SlowBrokerFinder.java:478)."""
+
+    def __init__(self, flush_time_threshold_ms: float = 1000.0,
+                 bytes_rate_threshold: float = 1024.0,
+                 demotion_score: int = 5, decommission_score: int = 50):
+        self.flush_time_threshold_ms = flush_time_threshold_ms
+        self.bytes_rate_threshold = bytes_rate_threshold
+        self.demotion_score = demotion_score
+        self.decommission_score = decommission_score
+        self._scores: dict[int, int] = {}
+
+    def configure(self, config, **extra):
+        if config is not None:
+            self.flush_time_threshold_ms = config.get_double(
+                "slow.broker.log.flush.time.threshold.ms")
+            self.bytes_rate_threshold = config.get_double(
+                "slow.broker.bytes.rate.detection.threshold")
+            self.demotion_score = config.get_int("slow.broker.demotion.score")
+            self.decommission_score = config.get_int("slow.broker.decommission.score")
+
+    def run_once(self, broker_metrics: dict, now_ms: float) -> list:
+        """broker_metrics: broker -> {metric: value} (latest)."""
+        flush = {b: m.get("BROKER_LOG_FLUSH_TIME_MS_999TH", 0.0)
+                 for b, m in broker_metrics.items()}
+        rate = {b: m.get("ALL_TOPIC_BYTES_IN", 0.0) for b, m in broker_metrics.items()}
+        if not flush:
+            return []
+        slow_now = {b for b in flush
+                    if flush[b] > self.flush_time_threshold_ms
+                    and rate.get(b, 0.0) < max(self.bytes_rate_threshold,
+                                               np.median(list(rate.values())))}
+        for b in list(self._scores):
+            if b not in slow_now:
+                self._scores[b] = max(0, self._scores[b] - 1)
+                if self._scores[b] == 0:
+                    del self._scores[b]
+        for b in slow_now:
+            self._scores[b] = self._scores.get(b, 0) + 1
+        to_remove = {b: s for b, s in self._scores.items()
+                     if s >= self.decommission_score}
+        to_demote = {b: s for b, s in self._scores.items()
+                     if self.demotion_score <= s < self.decommission_score}
+        out = []
+        if to_remove:
+            out.append(SlowBrokers(anomaly_type=AnomalyType.METRIC_ANOMALY,
+                                   detected_ms=now_ms, slow_brokers=to_remove,
+                                   remove=True,
+                                   description=f"slow brokers to remove: {sorted(to_remove)}"))
+        if to_demote:
+            out.append(SlowBrokers(anomaly_type=AnomalyType.METRIC_ANOMALY,
+                                   detected_ms=now_ms, slow_brokers=to_demote,
+                                   remove=False,
+                                   description=f"slow brokers to demote: {sorted(to_demote)}"))
+        return out

@@ -1,0 +1,66 @@
+"""Maintenance events.
+
+Reference: detector/MaintenanceEventDetector.java (83) +
+MaintenanceEventTopicReader.java — operators submit maintenance plans
+(ADD_BROKER/REMOVE_BROKER/DEMOTE_BROKER/REBALANCE/FIX_OFFLINE_REPLICAS/
+TOPIC_REPLICATION_FACTOR) to a Kafka topic; IdempotenceCache.java dedups
+re-delivered plans. Here the reader SPI pulls from a JSONL spool directory
+(one plan per line: {"type": "REMOVE_BROKER", "brokers": [3], "ts": ...}).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from cruise_control_tpu.detector.anomalies import AnomalyType, MaintenanceEvent
+
+
+class IdempotenceCache:
+    """Drops plans already seen within the retention window
+    (detector/IdempotenceCache.java)."""
+
+    def __init__(self, retention_ms: float = 180_000.0):
+        self._retention = retention_ms
+        self._seen: dict[str, float] = {}
+
+    def seen_before(self, key: str, now_ms: float) -> bool:
+        self._seen = {k: t for k, t in self._seen.items()
+                      if now_ms - t < self._retention}
+        if key in self._seen:
+            return True
+        self._seen[key] = now_ms
+        return False
+
+
+class FileMaintenanceEventReader:
+    def __init__(self, path: str = ""):
+        self._path = path
+        self._offset = 0
+
+    def configure(self, config, **extra):
+        path = extra.get("path") or (config.get_string("maintenance.event.path")
+                                     if config is not None else "")
+        if path:
+            self._path = path
+
+    def read_events(self, now_ms: float) -> list:
+        if not self._path:
+            return []
+        spool = os.path.join(self._path, "maintenance_events.jsonl")
+        if not os.path.exists(spool):
+            return []
+        events = []
+        with open(spool) as f:
+            f.seek(self._offset)
+            for line in f:
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                events.append(MaintenanceEvent(
+                    anomaly_type=AnomalyType.MAINTENANCE_EVENT,
+                    detected_ms=now_ms, plan_type=d.get("type", ""),
+                    brokers=d.get("brokers", []), topics=d.get("topics", {}),
+                    description=f"maintenance plan {d.get('type')}"))
+            self._offset = f.tell()
+        return events

@@ -1,0 +1,150 @@
+"""AnomalyDetectorManager.
+
+Reference: detector/AnomalyDetectorManager.java:60-132 — a priority queue of
+anomalies (:74,:87, ordered by KafkaAnomalyType priority then detection time),
+per-detector scheduling at a fixed rate with jitter (:218-226, startDetection
+:231-239), and a handler loop that polls the queue, consults the notifier
+(FIX / CHECK / IGNORE) and invokes the anomaly's self-healing fix through the
+same code path as the REST handlers.
+
+Here detection rounds are explicit (``run_detection_round``) and can also be
+driven by a host thread (``start`` / ``stop``); time is injected for the
+simulated backend.
+"""
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+
+from cruise_control_tpu.detector.anomalies import Anomaly, AnomalyType
+from cruise_control_tpu.detector.notifier import Action, NoopNotifier
+
+LOG = logging.getLogger("cruise_control_tpu.detector")
+
+
+class AnomalyDetectorManager:
+    def __init__(self, notifier=None, cruise_control=None, clock=None):
+        self._notifier = notifier or NoopNotifier()
+        self._cc = cruise_control
+        self._clock = clock
+        self._queue: list[tuple, Anomaly] = []
+        self._deferred: list = []        # (due_ms, anomaly) for CHECK verdicts
+        self._lock = threading.Lock()
+        self._detectors: list = []       # (name, callable(now_ms) -> [Anomaly])
+        self._history: list[dict] = []
+        self._self_healing_actions = 0
+        self._thread: threading.Thread | None = None
+        self._stop_event = threading.Event()
+        self.detection_interval_ms = 300_000.0
+
+    # ------------------------------------------------------------- wiring
+    def register_detector(self, name: str, run_once) -> None:
+        self._detectors.append((name, run_once))
+
+    @property
+    def notifier(self):
+        return self._notifier
+
+    # --------------------------------------------------------------- queue
+    def add_anomaly(self, anomaly: Anomaly) -> None:
+        with self._lock:
+            heapq.heappush(self._queue, (anomaly.sort_key(), anomaly))
+
+    def _pop(self):
+        with self._lock:
+            if not self._queue:
+                return None
+            return heapq.heappop(self._queue)[1]
+
+    def num_queued(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # ------------------------------------------------------------ rounds
+    def run_detection_round(self, now_ms: float) -> int:
+        """Run every registered detector once; queue found anomalies."""
+        n = 0
+        for name, run_once in self._detectors:
+            try:
+                found = run_once(now_ms)
+            except Exception:
+                LOG.exception("detector %s failed", name)
+                continue
+            for a in found:
+                self.add_anomaly(a)
+                n += 1
+        return n
+
+    def handle_anomalies(self, now_ms: float) -> list:
+        """Drain the queue through the notifier; FIX routes to self-healing
+        (the handler-thread loop role). Returns handled anomaly summaries."""
+        # re-enqueue deferred anomalies that are due
+        with self._lock:
+            due = [a for t, a in self._deferred if t <= now_ms]
+            self._deferred = [(t, a) for t, a in self._deferred if t > now_ms]
+        for a in due:
+            self.add_anomaly(a)
+
+        handled = []
+        while True:
+            anomaly = self._pop()
+            if anomaly is None:
+                break
+            verdict = self._notifier.on_anomaly(anomaly, now_ms)
+            entry = {"anomaly": anomaly.to_json(), "action": verdict.action.value}
+            if verdict.action is Action.FIX and self._cc is not None:
+                try:
+                    result = anomaly.fix(self._cc)
+                    entry["fixResult"] = result
+                    self._self_healing_actions += 1
+                except Exception as e:
+                    LOG.exception("self-healing fix failed for %s", anomaly)
+                    entry["fixError"] = str(e)
+            elif verdict.action is Action.CHECK:
+                with self._lock:
+                    self._deferred.append((now_ms + verdict.delay_ms, anomaly))
+            handled.append(entry)
+            self._history.append(entry)
+        return handled
+
+    # --------------------------------------------------- background thread
+    def start_detection(self, interval_ms: float | None = None) -> None:
+        """startDetection (AnomalyDetectorManager.java:231): spawn the periodic
+        detection + handling loop."""
+        if interval_ms:
+            self.detection_interval_ms = interval_ms
+        if self._thread is not None:
+            return
+        self._stop_event.clear()
+
+        def loop():
+            import time
+            while not self._stop_event.is_set():
+                now = (self._clock.now_ms() if self._clock is not None
+                       else time.time() * 1000.0)
+                self.run_detection_round(now)
+                self.handle_anomalies(now)
+                self._stop_event.wait(self.detection_interval_ms / 1000.0)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="anomaly-detector")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    # ---------------------------------------------------------------- state
+    def state_json(self) -> dict:
+        with self._lock:
+            recent = self._history[-10:]
+        return {
+            "selfHealingEnabled": self._notifier.self_healing_enabled(),
+            "recentAnomalies": recent,
+            "numSelfHealingActions": self._self_healing_actions,
+            "numQueuedAnomalies": self.num_queued(),
+            "registeredDetectors": [n for n, _ in self._detectors],
+        }

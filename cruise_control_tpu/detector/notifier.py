@@ -1,0 +1,128 @@
+"""Anomaly notifiers.
+
+Reference: detector/notifier/AnomalyNotifier.java SPI returning a
+FIX / CHECK(delay) / IGNORE verdict per anomaly;
+SelfHealingNotifier.java — per-type self-healing enable switches + the
+broker-failure grace ladder (alert after broker.failure.alert.threshold.ms,
+self-heal after broker.failure.self.healing.threshold.ms);
+SlackSelfHealingNotifier / AlertaSelfHealingNotifier (webhook alerting — here
+a pluggable alert sink since the environment has no egress); NoopNotifier.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import logging
+
+from cruise_control_tpu.detector.anomalies import Anomaly, AnomalyType, BrokerFailures
+
+LOG = logging.getLogger("cruise_control_tpu.notifier")
+
+
+class Action(enum.Enum):
+    FIX = "FIX"
+    CHECK = "CHECK"
+    IGNORE = "IGNORE"
+
+
+@dataclasses.dataclass
+class NotificationResult:
+    action: Action
+    delay_ms: float = 0.0
+
+
+class NoopNotifier:
+    def configure(self, config, **extra):
+        pass
+
+    def on_anomaly(self, anomaly: Anomaly, now_ms: float) -> NotificationResult:
+        return NotificationResult(Action.IGNORE)
+
+    def self_healing_enabled(self) -> dict:
+        return {t.name: False for t in AnomalyType}
+
+
+class SelfHealingNotifier:
+    """SelfHealingNotifier.java analogue."""
+
+    def __init__(self):
+        self._enabled: dict[AnomalyType, bool] = {t: False for t in AnomalyType}
+        self.alert_threshold_ms = 900_000.0
+        self.self_healing_threshold_ms = 1_800_000.0
+        self._alert_sink = None     # callable(dict) for Slack/Alerta-style fanout
+        self._alerted: set[int] = set()
+
+    def configure(self, config, alert_sink=None, **extra):
+        if config is not None:
+            master = config.get_boolean("self.healing.enabled")
+            per_type = {
+                AnomalyType.BROKER_FAILURE: "broker.failures.self.healing.enabled",
+                AnomalyType.GOAL_VIOLATION: "goal.violations.self.healing.enabled",
+                AnomalyType.DISK_FAILURE: "disk.failures.self.healing.enabled",
+                AnomalyType.METRIC_ANOMALY: "metric.anomaly.self.healing.enabled",
+                AnomalyType.TOPIC_ANOMALY: "topic.anomaly.self.healing.enabled",
+                AnomalyType.MAINTENANCE_EVENT: "maintenance.event.self.healing.enabled",
+            }
+            for t, key in per_type.items():
+                explicit = config.get(key)
+                self._enabled[t] = master if explicit is None else bool(explicit)
+            self.alert_threshold_ms = float(config.get_int("broker.failure.alert.threshold.ms"))
+            self.self_healing_threshold_ms = float(
+                config.get_int("broker.failure.self.healing.threshold.ms"))
+        if alert_sink is not None:
+            self._alert_sink = alert_sink
+
+    def set_self_healing(self, anomaly_type: AnomalyType, enabled: bool) -> None:
+        self._enabled[anomaly_type] = enabled
+
+    def self_healing_enabled(self) -> dict:
+        return {t.name: v for t, v in self._enabled.items()}
+
+    def _alert(self, anomaly: Anomaly, auto_fix: bool) -> None:
+        if anomaly.anomaly_id in self._alerted:
+            return
+        self._alerted.add(anomaly.anomaly_id)
+        payload = {"anomaly": anomaly.to_json(), "autoFixTriggered": auto_fix}
+        LOG.warning("anomaly alert: %s", json.dumps(payload))
+        if self._alert_sink is not None:
+            try:
+                self._alert_sink(payload)
+            except Exception:          # alert failure must not break detection
+                LOG.exception("alert sink failed")
+
+    def on_anomaly(self, anomaly: Anomaly, now_ms: float) -> NotificationResult:
+        enabled = self._enabled.get(anomaly.anomaly_type, False)
+        if isinstance(anomaly, BrokerFailures):
+            # grace ladder: wait, then alert, then fix
+            first_failure = min(anomaly.failed_brokers.values(), default=now_ms)
+            alert_at = first_failure + self.alert_threshold_ms
+            fix_at = first_failure + self.self_healing_threshold_ms
+            if now_ms < alert_at:
+                return NotificationResult(Action.CHECK, alert_at - now_ms)
+            if now_ms < fix_at:
+                self._alert(anomaly, auto_fix=False)
+                return NotificationResult(Action.CHECK, fix_at - now_ms)
+            self._alert(anomaly, auto_fix=enabled)
+            return NotificationResult(Action.FIX if enabled else Action.IGNORE)
+        self._alert(anomaly, auto_fix=enabled)
+        if not enabled or not anomaly.fixable:
+            return NotificationResult(Action.IGNORE)
+        return NotificationResult(Action.FIX)
+
+
+class AlertFileNotifier(SelfHealingNotifier):
+    """Stands in for Slack/Alerta webhook notifiers (zero-egress environment):
+    appends alert JSON lines to a file."""
+
+    def __init__(self, path: str = ""):
+        super().__init__()
+        self._path = path
+
+    def configure(self, config, **extra):
+        super().configure(config, alert_sink=self._append, **extra)
+
+    def _append(self, payload: dict) -> None:
+        if self._path:
+            with open(self._path, "a") as f:
+                f.write(json.dumps(payload) + "\n")

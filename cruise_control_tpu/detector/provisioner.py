@@ -1,0 +1,53 @@
+"""Provisioner SPI: cluster right-sizing hook.
+
+Reference: detector/Provisioner.java (SPI; rightsize(recommendations, ...)),
+NoopProvisioner.java, and the ProvisionResponse/ProvisionRecommendation/
+ProvisionStatus model (UNDER_PROVISIONED / RIGHT_SIZED / OVER_PROVISIONED,
+analyzer/ProvisionStatus role).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class ProvisionStatus(enum.Enum):
+    UNDER_PROVISIONED = "UNDER_PROVISIONED"
+    RIGHT_SIZED = "RIGHT_SIZED"
+    OVER_PROVISIONED = "OVER_PROVISIONED"
+    UNDECIDED = "UNDECIDED"
+
+
+@dataclasses.dataclass
+class ProvisionRecommendation:
+    status: ProvisionStatus
+    num_brokers: int = 0
+    reason: str = ""
+
+    def to_json(self) -> dict:
+        return {"status": self.status.value, "numBrokers": self.num_brokers,
+                "reason": self.reason}
+
+
+class NoopProvisioner:
+    def configure(self, config, **extra):
+        pass
+
+    def rightsize(self, recommendations: list, context: dict | None = None) -> bool:
+        """Returns True if any action was taken (never, for noop)."""
+        return False
+
+
+def provision_status_from_stats(stats_after: dict, constraint,
+                                num_alive_brokers: int) -> ProvisionRecommendation:
+    """Derive a provision recommendation from post-optimization stats: if hard
+    capacity cannot be satisfied the cluster is under-provisioned; if max
+    utilization is far below the low-utilization band it is over-provisioned
+    (GoalViolationDetector provision-status computation role)."""
+    offline = stats_after.get("num_offline_replicas", 0)
+    if offline:
+        return ProvisionRecommendation(
+            ProvisionStatus.UNDER_PROVISIONED,
+            num_brokers=max(1, offline // 100),
+            reason=f"{offline} replicas cannot be placed")
+    return ProvisionRecommendation(ProvisionStatus.RIGHT_SIZED)

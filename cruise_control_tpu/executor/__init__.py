@@ -1,0 +1,14 @@
+from cruise_control_tpu.executor.executor import (
+    Executor, ExecutorState, SimClock, WallClock,
+)
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.strategy import (
+    STRATEGY_CLASSES, build_strategy, sort_tasks,
+)
+from cruise_control_tpu.executor.task import ExecutionTask, TaskState, TaskType
+
+__all__ = [
+    "Executor", "ExecutorState", "SimClock", "WallClock",
+    "ExecutionTaskPlanner", "ExecutionTask", "TaskState", "TaskType",
+    "STRATEGY_CLASSES", "build_strategy", "sort_tasks",
+]

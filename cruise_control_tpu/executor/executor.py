@@ -1,0 +1,317 @@
+"""Executor: throttled, concurrency-capped proposal execution.
+
+Reference: executor/Executor.java:76 (1,636) — execution lifecycle:
+reservation, ``executeProposals`` (:567), the ProposalExecutionRunnable's
+three phases (:1079-1130): inter-broker moves -> intra-broker moves ->
+leadership; progress polling against cluster metadata; user-initiated stop and
+force-stop (:873-899); ReplicationThrottleHelper (:28-46) wraps the moves with
+a replication throttle and cleans it up after; ConcurrencyAdjuster
+(:335-448) raises/lowers the per-broker cap between checks; history of
+recently removed/demoted brokers (:449-506).
+
+Actuation goes through the ClusterBackend SPI (the reference writes ZK
+reassignment znodes + calls AdminClient). Time is injected: the SimClock
+advances the simulated backend, a WallClock sleeps — same executor code for
+tests and a real deployment.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time as _time
+
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.strategy import build_strategy
+from cruise_control_tpu.executor.task import ExecutionTask, TaskState, TaskType
+
+
+class WallClock:
+    def __init__(self):
+        self._t0 = _time.time()
+
+    def now_ms(self) -> float:
+        return (_time.time() - self._t0) * 1000.0
+
+    def sleep_ms(self, ms: float) -> None:
+        _time.sleep(ms / 1000.0)
+
+
+class SimClock:
+    """Advances the simulated backend instead of sleeping."""
+
+    def __init__(self, backend):
+        self._backend = backend
+
+    def now_ms(self) -> float:
+        return self._backend.now_ms
+
+    def sleep_ms(self, ms: float) -> None:
+        self._backend.advance(ms)
+
+
+class ExecutorState:
+    NO_TASK_IN_PROGRESS = "NO_TASK_IN_PROGRESS"
+    STARTING_EXECUTION = "STARTING_EXECUTION"
+    INTER_BROKER_REPLICA_MOVEMENT = "INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    INTRA_BROKER_REPLICA_MOVEMENT = "INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    LEADER_MOVEMENT = "LEADER_MOVEMENT_TASK_IN_PROGRESS"
+    STOPPING_EXECUTION = "STOPPING_EXECUTION"
+
+
+@dataclasses.dataclass
+class ExecutorConfigView:
+    per_broker_cap: int = 5
+    cluster_cap: int = 1250
+    intra_broker_cap: int = 2
+    leadership_cap: int = 1000
+    progress_check_interval_ms: float = 10_000.0
+    throttle_bytes_per_sec: int | None = None
+    adjuster_enabled: bool = False
+    adjuster_max_per_broker: int = 12
+    adjuster_min_per_broker: int = 1
+
+    @classmethod
+    def from_config(cls, cfg) -> "ExecutorConfigView":
+        throttle = cfg.get_int("default.replication.throttle")
+        return cls(
+            per_broker_cap=cfg.get_int("num.concurrent.partition.movements.per.broker"),
+            cluster_cap=cfg.get_int("max.num.cluster.partition.movements"),
+            intra_broker_cap=cfg.get_int("num.concurrent.intra.broker.partition.movements"),
+            leadership_cap=cfg.get_int("num.concurrent.leader.movements"),
+            progress_check_interval_ms=cfg.get_int("execution.progress.check.interval.ms"),
+            throttle_bytes_per_sec=None if throttle < 0 else throttle,
+            adjuster_enabled=cfg.get_boolean("concurrency.adjuster.enabled"),
+            adjuster_max_per_broker=cfg.get_int(
+                "concurrency.adjuster.max.partition.movements.per.broker"),
+            adjuster_min_per_broker=cfg.get_int(
+                "concurrency.adjuster.min.partition.movements.per.broker"),
+        )
+
+
+class Executor:
+    def __init__(self, backend, config=None, clock=None, strategy_names=None):
+        self._backend = backend
+        self._cfg = (ExecutorConfigView.from_config(config) if config is not None
+                     else ExecutorConfigView())
+        self._clock = clock or (SimClock(backend) if hasattr(backend, "advance")
+                                else WallClock())
+        self._strategy = build_strategy(strategy_names
+                                        or ["BaseReplicaMovementStrategy"])
+        self._state = ExecutorState.NO_TASK_IN_PROGRESS
+        self._stop_requested = False
+        self._force_stop = False
+        self._lock = threading.Lock()
+        self._current_planner: ExecutionTaskPlanner | None = None
+        self._history: list[dict] = []
+        self._recently_removed_brokers: dict[int, float] = {}
+        self._recently_demoted_brokers: dict[int, float] = {}
+        self._execution_thread: threading.Thread | None = None
+        self._reservation = None
+
+    # ---------------------------------------------------------- reservation
+    def reserve(self, owner: str) -> None:
+        """setGeneratingProposalsForExecution role (Executor.java:828): only one
+        party may generate-and-execute at a time."""
+        with self._lock:
+            if self._reservation is not None or self._state != ExecutorState.NO_TASK_IN_PROGRESS:
+                raise RuntimeError(f"executor busy (state={self._state}, "
+                                   f"reserved by {self._reservation})")
+            self._reservation = owner
+
+    def release(self, owner: str) -> None:
+        with self._lock:
+            if self._reservation == owner:
+                self._reservation = None
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def has_ongoing_execution(self) -> bool:
+        return self._state not in (ExecutorState.NO_TASK_IN_PROGRESS,)
+
+    def stop_execution(self, force: bool = False) -> None:
+        """Graceful stop: no new tasks; force: cancel in-flight reassignments
+        (znode deletion, ExecutionUtils.java:305-307)."""
+        with self._lock:
+            self._stop_requested = True
+            self._force_stop = force
+
+    def recently_removed_brokers(self) -> set:
+        return set(self._recently_removed_brokers)
+
+    def recently_demoted_brokers(self) -> set:
+        return set(self._recently_demoted_brokers)
+
+    def note_removed_brokers(self, brokers) -> None:
+        for b in brokers:
+            self._recently_removed_brokers[b] = self._clock.now_ms()
+
+    def note_demoted_brokers(self, brokers) -> None:
+        for b in brokers:
+            self._recently_demoted_brokers[b] = self._clock.now_ms()
+
+    # ------------------------------------------------------------ execution
+    def execute_proposals(self, proposals: list, blocking: bool = True,
+                          context: dict | None = None) -> None:
+        """Run the 3-phase execution (Executor.executeProposals :567)."""
+        with self._lock:
+            if self._state != ExecutorState.NO_TASK_IN_PROGRESS:
+                raise RuntimeError("an execution is already in progress")
+            self._state = ExecutorState.STARTING_EXECUTION
+            self._stop_requested = False
+            self._force_stop = False
+        planner = ExecutionTaskPlanner(self._strategy)
+        if context is None:
+            sizes = {tp: info.size_mb for tp, info in self._backend.partitions().items()}
+            context = {"partition_size_mb": sizes}
+        planner.add_proposals(proposals, context)
+        self._current_planner = planner
+        if blocking:
+            self._run_execution(planner)
+        else:
+            self._execution_thread = threading.Thread(
+                target=self._run_execution, args=(planner,), daemon=True)
+            self._execution_thread.start()
+
+    def wait_for_completion(self, timeout_s: float = 60.0) -> None:
+        t = self._execution_thread
+        if t is not None:
+            t.join(timeout_s)
+
+    # ------------------------------------------------------------ internals
+    def _run_execution(self, planner: ExecutionTaskPlanner) -> None:
+        throttled = False
+        try:
+            if self._cfg.throttle_bytes_per_sec:
+                self._backend.set_replication_throttle(self._cfg.throttle_bytes_per_sec)
+                throttled = True
+            self._inter_broker_phase(planner)
+            if not self._stop_requested:
+                self._intra_broker_phase(planner)
+            if not self._stop_requested:
+                self._leadership_phase(planner)
+        finally:
+            if throttled:
+                # ReplicationThrottleHelper cleanup (:200)
+                self._backend.set_replication_throttle(None)
+            done = sum(1 for t in planner.all_tasks
+                       if t.state is TaskState.COMPLETED)
+            self._history.append({
+                "finishedMs": self._clock.now_ms(),
+                "numTasks": len(planner.all_tasks),
+                "numCompleted": done,
+                "stopped": self._stop_requested,
+            })
+            with self._lock:
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+
+    def _inter_broker_phase(self, planner: ExecutionTaskPlanner) -> None:
+        self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT
+        in_flight: dict[tuple, ExecutionTask] = {}
+        in_flight_by_broker: dict[int, int] = {}
+        while True:
+            if self._stop_requested:
+                self._state = ExecutorState.STOPPING_EXECUTION
+                if self._force_stop and in_flight:
+                    self._backend.cancel_reassignments(list(in_flight))
+                    for t in in_flight.values():
+                        t.transition(TaskState.ABORTING, self._clock.now_ms())
+                        t.transition(TaskState.ABORTED, self._clock.now_ms())
+                    in_flight.clear()
+                if not in_flight:
+                    return
+            # completion check
+            ongoing = self._backend.ongoing_reassignments()
+            finished = [tp for tp in in_flight if tp not in ongoing]
+            for tp in finished:
+                t = in_flight.pop(tp)
+                t.transition(TaskState.COMPLETED, self._clock.now_ms())
+                for b in t.brokers_involved:
+                    in_flight_by_broker[b] = max(0, in_flight_by_broker.get(b, 1) - 1)
+            if not self._stop_requested:
+                batch = planner.next_inter_broker_tasks(
+                    in_flight_by_broker, self._cfg.per_broker_cap,
+                    self._cfg.cluster_cap, len(in_flight))
+                assignments = {}
+                for t in batch:
+                    target = [b for b, _ in t.proposal.new_replicas]
+                    assignments[t.tp] = target
+                    t.transition(TaskState.IN_PROGRESS, self._clock.now_ms())
+                    in_flight[t.tp] = t
+                    for b in t.brokers_involved:
+                        in_flight_by_broker[b] = in_flight_by_broker.get(b, 0) + 1
+                if assignments:
+                    self._backend.alter_partition_reassignments(assignments)
+            if not in_flight and not planner.remaining_inter_broker:
+                return
+            self._clock.sleep_ms(self._cfg.progress_check_interval_ms)
+
+    def _intra_broker_phase(self, planner: ExecutionTaskPlanner) -> None:
+        self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT
+        tasks = planner.next_intra_broker_tasks({}, self._cfg.intra_broker_cap)
+        while tasks:
+            moves = {}
+            for t in tasks:
+                old = dict(t.proposal.old_replicas)
+                for b, d in t.proposal.new_replicas:
+                    if old.get(b) is not None and old[b] != d:
+                        # logdir index -> name resolution happens backend-side;
+                        # the proposal carries the index
+                        moves[(t.proposal.topic, t.proposal.partition, b)] = d
+                t.transition(TaskState.IN_PROGRESS, self._clock.now_ms())
+            if moves:
+                resolved = self._resolve_logdirs(moves)
+                self._backend.alter_replica_logdirs(resolved)
+            for t in tasks:
+                t.transition(TaskState.COMPLETED, self._clock.now_ms())
+            if self._stop_requested:
+                return
+            tasks = planner.next_intra_broker_tasks({}, self._cfg.intra_broker_cap)
+
+    def _resolve_logdirs(self, moves: dict) -> dict:
+        brokers = self._backend.brokers()
+        out = {}
+        for (topic, part, b), disk_idx in moves.items():
+            logdirs = list(brokers[b].logdirs)
+            idx = int(disk_idx)
+            out[(topic, part, b)] = logdirs[idx] if idx < len(logdirs) else logdirs[0]
+        return out
+
+    def _leadership_phase(self, planner: ExecutionTaskPlanner) -> None:
+        self._state = ExecutorState.LEADER_MOVEMENT
+        while True:
+            if self._stop_requested:
+                return
+            batch = planner.next_leadership_tasks(self._cfg.leadership_cap)
+            if not batch:
+                return
+            elections = {}
+            partitions = self._backend.partitions()
+            for t in batch:
+                t.transition(TaskState.IN_PROGRESS, self._clock.now_ms())
+                info = partitions.get(t.tp)
+                if info is not None and t.proposal.new_leader in info.replicas:
+                    elections[t.tp] = t.proposal.new_leader
+                    t.transition(TaskState.COMPLETED, self._clock.now_ms())
+                else:
+                    t.transition(TaskState.DEAD, self._clock.now_ms())
+            if elections:
+                self._backend.elect_leaders(elections)
+
+    # ---------------------------------------------------------------- state
+    def state_json(self) -> dict:
+        planner = self._current_planner
+        out = {"state": self._state}
+        if planner is not None:
+            tasks = planner.all_tasks
+            out["numTotalTasks"] = len(tasks)
+            out["numFinishedTasks"] = sum(1 for t in tasks
+                                          if t.state is TaskState.COMPLETED)
+            out["numPendingTasks"] = sum(1 for t in tasks
+                                         if t.state is TaskState.PENDING)
+            out["numAbortedTasks"] = sum(1 for t in tasks
+                                         if t.state is TaskState.ABORTED)
+        out["executionHistory"] = self._history[-5:]
+        return out

@@ -1,0 +1,290 @@
+"""LoadMonitor: metric ingestion -> workload model.
+
+Reference: monitor/LoadMonitor.java:78 — owns the aggregators, metadata
+client and capacity resolver; ``clusterModel(from, to, requirements, ...)``
+(:539-591) aggregates windows, applies completeness gating
+(meetCompletenessRequirements :639), resolves per-broker capacities
+(:482-523) and populates the model per partition; pause/resume sampling
+(:349-373); the task runner state machine lives in monitor/task/ (SamplingTask
+scheduling — here a ``sample_once`` pull the caller or a host thread drives).
+
+The built model is the dense ClusterTensor: windows are reduced at build time
+(AVG for CPU/NW, LATEST for DISK — model/ModelUtils.java:154-168 via Load
+expectedUtilizationFor), and CPU is attributed leader/follower via the static
+weights (monitor/cpu_model.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.builder import ClusterModelBuilder
+from cruise_control_tpu.monitor.aggregator.sample_aggregator import MetricSampleAggregator
+from cruise_control_tpu.monitor.capacity import DefaultCapacityResolver
+from cruise_control_tpu.monitor.cpu_model import CpuModelParams, estimate_follower_cpu_util
+from cruise_control_tpu.monitor.metricdef import (
+    BROKER_METRIC_DEF, PARTITION_METRIC_DEF,
+)
+from cruise_control_tpu.monitor.sampling.samplers import Samples
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelCompletenessRequirements:
+    """monitor/ModelCompletenessRequirements.java."""
+    min_required_num_windows: int = 1
+    min_monitored_partitions_percentage: float = 0.0
+    include_all_topics: bool = False
+
+    def stronger(self, other: "ModelCompletenessRequirements"):
+        return ModelCompletenessRequirements(
+            max(self.min_required_num_windows, other.min_required_num_windows),
+            max(self.min_monitored_partitions_percentage,
+                other.min_monitored_partitions_percentage),
+            self.include_all_topics or other.include_all_topics)
+
+
+class NotEnoughValidWindowsError(Exception):
+    """Reference: NotEnoughValidWindowsException."""
+
+
+@dataclasses.dataclass
+class ModelGeneration:
+    """monitor/ModelGeneration.java: (metadata generation, load generation)."""
+    metadata_generation: int = -1
+    load_generation: int = -1
+
+    def as_tuple(self):
+        return (self.metadata_generation, self.load_generation)
+
+
+class LoadMonitorState:
+    NOT_STARTED = "NOT_STARTED"
+    RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
+
+
+class LoadMonitor:
+    def __init__(self, config=None, backend=None, sampler=None, sample_store=None,
+                 capacity_resolver=None):
+        self._config = config
+        self._backend = backend
+        if sampler is None and config is not None:
+            sampler = config.get_configured_instance("metric.sampler.class",
+                                                     backend=backend)
+        self._sampler = sampler
+        if sample_store is None and config is not None:
+            sample_store = config.get_configured_instance("sample.store.class")
+        self._store = sample_store
+        if capacity_resolver is None and config is not None:
+            capacity_resolver = config.get_configured_instance(
+                "broker.capacity.config.resolver.class")
+        self._capacity = capacity_resolver or DefaultCapacityResolver()
+        nw = config.get_int("num.metrics.windows") if config else 5
+        wms = config.get_int("metrics.window.ms") if config else 300_000
+        mspw = config.get_int("min.samples.per.metrics.window") if config else 3
+        maxex = config.get_int("max.allowed.extrapolations.per.partition") if config else 5
+        self._partition_agg = MetricSampleAggregator(nw, wms, mspw, maxex,
+                                                     PARTITION_METRIC_DEF)
+        bnw = config.get_int("num.broker.metrics.windows") if config else 20
+        bwms = config.get_int("broker.metrics.window.ms") if config else 300_000
+        bmspw = config.get_int("min.samples.per.broker.metrics.window") if config else 1
+        bmaxex = config.get_int("max.allowed.extrapolations.per.broker") if config else 5
+        self._broker_agg = MetricSampleAggregator(bnw, bwms, bmspw, bmaxex,
+                                                  BROKER_METRIC_DEF)
+        self._cpu_params = (CpuModelParams.from_config(config) if config
+                            else CpuModelParams())
+        self._state = LoadMonitorState.NOT_STARTED
+        self._pause_reason = None
+        self._lock = threading.Lock()
+        self._model_semaphore = threading.Semaphore(2)  # LoadMonitor.java:92 cluster-model gate
+
+    # ------------------------------------------------------------ lifecycle
+    def start_up(self) -> int:
+        """Replay persisted samples (SampleLoadingTask role), go RUNNING."""
+        n = 0
+        if self._store is not None:
+            n = self._store.load_samples(self._ingest)
+        self._state = LoadMonitorState.RUNNING
+        return n
+
+    def shutdown(self):
+        if self._store is not None:
+            self._store.close()
+        if self._sampler is not None:
+            self._sampler.close()
+        self._state = LoadMonitorState.NOT_STARTED
+
+    def pause_sampling(self, reason: str = "operator request"):
+        """LoadMonitor.pauseMetricSampling (:349)."""
+        with self._lock:
+            self._state = LoadMonitorState.PAUSED
+            self._pause_reason = reason
+
+    def resume_sampling(self, reason: str = "operator request"):
+        with self._lock:
+            self._state = LoadMonitorState.RUNNING
+            self._pause_reason = None
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def pause_reason(self):
+        return self._pause_reason
+
+    # ------------------------------------------------------------- sampling
+    def sample_once(self, now_ms: float | None = None) -> int:
+        """One sampling round (SamplingTask.run -> MetricFetcherManager
+        .fetchMetricSamples path). Returns #samples ingested."""
+        if self._state == LoadMonitorState.PAUSED or self._sampler is None:
+            return 0
+        now = now_ms if now_ms is not None else time.time() * 1000.0
+        samples = self._sampler.get_samples(now)
+        n = self._ingest(samples)
+        if self._store is not None:
+            self._store.store_samples(samples)
+        return n
+
+    def _ingest(self, samples: Samples) -> int:
+        n = 0
+        for s in samples.partition_samples:
+            if self._partition_agg.add_sample((s.topic, s.partition), s.ts_ms, s.values):
+                n += 1
+        for s in samples.broker_samples:
+            if self._broker_agg.add_sample(s.broker_id, s.ts_ms, s.values):
+                n += 1
+        return n
+
+    # ---------------------------------------------------------- completeness
+    def meet_completeness_requirements(self, req: ModelCompletenessRequirements) -> bool:
+        """LoadMonitor.meetCompletenessRequirements (:639)."""
+        agg = self._partition_agg.aggregate()
+        if len(agg.window_starts_ms) < req.min_required_num_windows:
+            return False
+        monitored = (agg.entity_valid.mean() if agg.entity_valid.size else 0.0)
+        return monitored >= req.min_monitored_partitions_percentage
+
+    def model_generation(self) -> ModelGeneration:
+        return ModelGeneration(
+            metadata_generation=(self._backend.metadata_generation()
+                                 if self._backend else -1),
+            load_generation=self._partition_agg.generation)
+
+    @property
+    def num_valid_windows(self) -> int:
+        return len(self._partition_agg.aggregate().window_starts_ms)
+
+    def monitored_partitions_percentage(self) -> float:
+        agg = self._partition_agg.aggregate()
+        total = len(self._backend.partitions()) if self._backend else len(agg.entities)
+        if total == 0:
+            return 0.0
+        return float(agg.entity_valid.sum()) / total
+
+    # --------------------------------------------------------------- model
+    def cluster_model(self, requirements: ModelCompletenessRequirements | None = None,
+                      allow_capacity_estimation: bool = True):
+        """Build (ClusterTensor, ClusterMeta) from current metadata + windows
+        (LoadMonitor.clusterModel :539-591)."""
+        if self._backend is None:
+            raise RuntimeError("LoadMonitor has no cluster backend")
+        req = requirements or ModelCompletenessRequirements()
+        with self._model_semaphore:
+            agg = self._partition_agg.aggregate()
+            if len(agg.window_starts_ms) < req.min_required_num_windows:
+                raise NotEnoughValidWindowsError(
+                    f"{len(agg.window_starts_ms)} valid windows < required "
+                    f"{req.min_required_num_windows}")
+            partitions = self._backend.partitions()
+            if partitions:
+                valid_frac = (float(agg.entity_valid.sum()) / len(partitions)
+                              if len(partitions) else 0.0)
+                if valid_frac < req.min_monitored_partitions_percentage:
+                    raise NotEnoughValidWindowsError(
+                        f"monitored partition ratio {valid_frac:.3f} < required "
+                        f"{req.min_monitored_partitions_percentage:.3f}")
+            brokers = self._backend.brokers()
+            logdir_state = self._backend.describe_logdirs()
+
+            builder = ClusterModelBuilder()
+            for b, node in brokers.items():
+                cap_info = self._capacity.capacity_for(b)
+                if cap_info.estimated and not allow_capacity_estimation:
+                    raise RuntimeError(
+                        f"capacity estimation not allowed but required for broker {b}")
+                logdirs = list(node.logdirs) or ["/logdir0"]
+                if cap_info.disk_capacity_by_logdir:
+                    # match resolver capacities to broker logdirs BY NAME;
+                    # unknown dirs fall back to an even share of total DISK
+                    per = cap_info.capacity[Resource.DISK] / len(logdirs)
+                    disk_caps = [cap_info.disk_capacity_by_logdir.get(ld, per)
+                                 for ld in logdirs]
+                else:
+                    per = cap_info.capacity[Resource.DISK] / len(logdirs)
+                    disk_caps = [node.logdirs.get(ld, per) for ld in logdirs]
+                dead = set(node.dead_logdirs)
+                dead |= {ld for ld, ok in logdir_state.get(b, {}).items() if not ok}
+                builder.add_broker(
+                    b, rack=node.rack, alive=node.alive,
+                    capacity={Resource.CPU: cap_info.capacity[Resource.CPU],
+                              Resource.DISK: sum(disk_caps),
+                              Resource.NW_IN: cap_info.capacity[Resource.NW_IN],
+                              Resource.NW_OUT: cap_info.capacity[Resource.NW_OUT]},
+                    logdirs=logdirs, disk_capacity=disk_caps, dead_disks=dead)
+
+            # window-reduce per partition: AVG for CPU/NW, LATEST for DISK
+            mdef = PARTITION_METRIC_DEF
+            id_cpu = mdef.info("CPU_USAGE").metric_id
+            id_din = mdef.info("DISK_USAGE").metric_id
+            id_lin = mdef.info("LEADER_BYTES_IN").metric_id
+            id_lout = mdef.info("LEADER_BYTES_OUT").metric_id
+            row_of = {e: i for i, e in enumerate(agg.entities)}
+            for tp, info in partitions.items():
+                row = row_of.get(tp)
+                if row is None:
+                    cpu = disk = lin = lout = 0.0
+                else:
+                    vals = agg.values[row]            # [W, M]
+                    cpu = float(vals[:, id_cpu].mean())
+                    lin = float(vals[:, id_lin].mean())
+                    lout = float(vals[:, id_lout].mean())
+                    disk = float(vals[-1, id_din])    # LATEST
+                leader_load = np.zeros(4)
+                leader_load[Resource.CPU] = cpu
+                leader_load[Resource.NW_IN] = lin
+                leader_load[Resource.NW_OUT] = lout
+                leader_load[Resource.DISK] = disk
+                follower_cpu = float(estimate_follower_cpu_util(
+                    cpu, lin, lout, self._cpu_params))
+                follower_load = leader_load.copy()
+                follower_load[Resource.CPU] = follower_cpu
+                follower_load[Resource.NW_OUT] = 0.0
+                for b in info.replicas:
+                    node = brokers[b]
+                    logdir = info.logdir_by_broker.get(b)
+                    offline = (not node.alive) or (logdir in node.dead_logdirs)
+                    builder.add_replica(
+                        info.topic, info.partition, b,
+                        is_leader=(b == info.leader),
+                        leader_load=leader_load, follower_load=follower_load,
+                        logdir=logdir, offline=offline)
+            return builder.build()
+
+    # ---------------------------------------------------------------- state
+    def state_json(self) -> dict:
+        agg = self._partition_agg.aggregate()
+        return {
+            "state": self._state,
+            "reasonOfPauseOrResume": self._pause_reason,
+            "numValidWindows": len(agg.window_starts_ms),
+            "numMonitoredWindows": len(agg.window_starts_ms),
+            "monitoredPartitionsPercentage":
+                float(agg.entity_valid.mean()) if agg.entity_valid.size else 0.0,
+            "totalNumPartitions": len(self._backend.partitions()) if self._backend else 0,
+            "loadGeneration": self._partition_agg.generation,
+        }

@@ -1,0 +1,129 @@
+"""SampleStore SPI + file-backed implementation.
+
+Reference: monitor/sampling/SampleStore.java with KafkaSampleStore (default:
+persists samples to two Kafka topics __KafkaCruiseControlPartitionMetricSamples
+/ __KafkaCruiseControlModelTrainingSamples and replays them on startup — the
+system's durable-history "checkpoint", SURVEY §5) plus NoopSampleStore.
+
+FileSampleStore keeps the same contract against the local filesystem: append
+JSONL shards, replay on startup to rebuild aggregation windows.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Protocol
+
+from cruise_control_tpu.monitor.sampling.samplers import (
+    BrokerSample, PartitionSample, Samples,
+)
+
+
+class SampleStore(Protocol):
+    def configure(self, config, **extra) -> None: ...
+
+    def store_samples(self, samples: Samples) -> None: ...
+
+    def load_samples(self, loader) -> int: ...
+
+    def close(self) -> None: ...
+
+
+class NoopSampleStore:
+    def configure(self, config, **extra):
+        pass
+
+    def store_samples(self, samples: Samples) -> None:
+        pass
+
+    def load_samples(self, loader) -> int:
+        return 0
+
+    def close(self):
+        pass
+
+
+class FileSampleStore:
+    """Durable JSONL store. One file per sample kind; appends are fsync-free
+    (the reference relies on Kafka's durability; we rely on the page cache —
+    the data is reconstructible telemetry, not source of truth)."""
+
+    PARTITION_FILE = "partition_samples.jsonl"
+    BROKER_FILE = "broker_samples.jsonl"
+
+    def __init__(self, path: str | None = None):
+        self._path = path
+        self._lock = threading.Lock()
+        self._pf = None
+        self._bf = None
+
+    def configure(self, config, **extra):
+        path = extra.get("path") or (config.get_string("sample.store.path")
+                                     if config is not None else "")
+        if path:
+            self._path = path
+        if self._path:
+            os.makedirs(self._path, exist_ok=True)
+
+    def _open(self):
+        if self._pf is None and self._path:
+            self._pf = open(os.path.join(self._path, self.PARTITION_FILE), "a")
+            self._bf = open(os.path.join(self._path, self.BROKER_FILE), "a")
+
+    def store_samples(self, samples: Samples) -> None:
+        if not self._path:
+            return
+        with self._lock:
+            self._open()
+            for s in samples.partition_samples:
+                self._pf.write(json.dumps({"t": s.topic, "p": s.partition,
+                                           "ts": s.ts_ms, "v": s.values}) + "\n")
+            for s in samples.broker_samples:
+                self._bf.write(json.dumps({"b": s.broker_id, "ts": s.ts_ms,
+                                           "v": s.values}) + "\n")
+            self._pf.flush()
+            self._bf.flush()
+
+    def load_samples(self, loader) -> int:
+        """Replay persisted samples through ``loader(samples)`` in batches
+        (SampleLoadingTask role). Returns the number of samples replayed."""
+        if not self._path:
+            return 0
+        n = 0
+        ppath = os.path.join(self._path, self.PARTITION_FILE)
+        bpath = os.path.join(self._path, self.BROKER_FILE)
+        batch: list[PartitionSample] = []
+        if os.path.exists(ppath):
+            with open(ppath) as f:
+                for line in f:
+                    try:
+                        d = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail write
+                    batch.append(PartitionSample(topic=d["t"], partition=d["p"],
+                                                 ts_ms=d["ts"], values=d["v"]))
+                    n += 1
+        bbatch: list[BrokerSample] = []
+        if os.path.exists(bpath):
+            with open(bpath) as f:
+                for line in f:
+                    try:
+                        d = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    bbatch.append(BrokerSample(broker_id=d["b"], ts_ms=d["ts"],
+                                               values=d["v"]))
+                    n += 1
+        if batch or bbatch:
+            loader(Samples(batch, bbatch))
+        return n
+
+    def close(self):
+        with self._lock:
+            if self._pf:
+                self._pf.close()
+                self._pf = None
+            if self._bf:
+                self._bf.close()
+                self._bf = None

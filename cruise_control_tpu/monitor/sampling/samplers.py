@@ -1,0 +1,82 @@
+"""MetricSampler SPI + implementations.
+
+Reference: monitor/sampling/MetricSampler.java (SPI), AbstractMetricSampler,
+CruiseControlMetricsReporterSampler (default: consumes the in-broker
+reporter's __CruiseControlMetrics topic), prometheus/PrometheusMetricSampler
+(:1-289), NoopSampler.
+
+Here the default is a SimulatedMetricSampler that pulls per-partition /
+per-broker metrics from a ClusterBackend (the simulated cluster stands in for
+real Kafka, SURVEY §4.5). A real-cluster sampler would be another plugin.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Protocol
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionSample:
+    topic: str
+    partition: int
+    ts_ms: float
+    values: dict          # partition model metric name -> value
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerSample:
+    broker_id: int
+    ts_ms: float
+    values: dict          # broker model metric name -> value
+
+
+@dataclasses.dataclass
+class Samples:
+    partition_samples: list
+    broker_samples: list
+
+
+class MetricSampler(Protocol):
+    def configure(self, config, **extra) -> None: ...
+
+    def get_samples(self, now_ms: float) -> Samples: ...
+
+    def close(self) -> None: ...
+
+
+class NoopSampler:
+    """NoopSampler.java analogue."""
+
+    def configure(self, config, **extra):
+        pass
+
+    def get_samples(self, now_ms: float) -> Samples:
+        return Samples([], [])
+
+    def close(self):
+        pass
+
+
+class SimulatedMetricSampler:
+    """Samples the simulated cluster backend. The backend exposes
+    ``partition_metrics()`` / ``broker_metrics()`` snapshots; this sampler
+    stamps them with the collection time."""
+
+    def __init__(self, backend=None):
+        self._backend = backend
+
+    def configure(self, config, backend=None, **extra):
+        if backend is not None:
+            self._backend = backend
+
+    def get_samples(self, now_ms: float) -> Samples:
+        if self._backend is None:
+            return Samples([], [])
+        psamples = [PartitionSample(topic=t, partition=p, ts_ms=now_ms, values=vals)
+                    for (t, p), vals in self._backend.partition_metrics().items()]
+        bsamples = [BrokerSample(broker_id=b, ts_ms=now_ms, values=vals)
+                    for b, vals in self._backend.broker_metrics().items()]
+        return Samples(psamples, bsamples)
+
+    def close(self):
+        pass

@@ -9,3 +9,8 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 # Keep compile times sane in CI: 64-bit off (f32 everywhere, matching TPU).
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Persistent compilation cache: the engine compiles one loop per
+# (goal, prev-goals) combo — cache them across test runs.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")

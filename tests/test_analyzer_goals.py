@@ -1,0 +1,146 @@
+"""Per-goal deterministic tests (DeterministicClusterTest role,
+reference analyzer/DeterministicClusterTest.java:60)."""
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import make_env, init_state, optimize_goal
+from cruise_control_tpu.analyzer.env import BalancingConstraint
+from cruise_control_tpu.analyzer.goals import make_goal
+from cruise_control_tpu.analyzer.state import refresh
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.fixtures import (
+    capacity_violated, dead_broker_cluster, leaders_skewed, rack_violated,
+    small_cluster, unbalanced_two_brokers,
+)
+
+
+def _setup(fixture):
+    ct, meta = fixture() if callable(fixture) else fixture
+    env = make_env(ct, meta)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    return env, st
+
+
+def _run(env, st, name, prev=(), **goal_kw):
+    g = make_goal(name, **goal_kw)
+    st, info = optimize_goal(env, st, g, tuple(prev))
+    return g, st, info
+
+
+def test_rack_aware_goal_fixes_violations():
+    env, st = _setup(rack_violated)
+    g, st, info = _run(env, st, "RackAwareGoal")
+    assert not bool(info["violated_after"])
+    # each partition now spans both racks
+    rack = np.asarray(env.broker_rack)[np.asarray(st.replica_broker)]
+    part = np.asarray(env.replica_partition)
+    valid = np.asarray(env.replica_valid)
+    for p in np.unique(part[valid]):
+        racks = rack[valid & (part == p)]
+        assert len(set(racks.tolist())) == len(racks)
+
+
+def test_disk_capacity_goal_sheds_load():
+    env, st = _setup(capacity_violated)
+    g, st, info = _run(env, st, "DiskCapacityGoal")
+    assert not bool(info["violated_after"])
+    util = np.asarray(st.util[:, Resource.DISK])
+    cap = np.asarray(env.broker_capacity[:, Resource.DISK])
+    assert (util <= 0.8 * cap + 100).all()
+
+
+def test_disk_distribution_uses_swaps():
+    env, st = _setup(unbalanced_two_brokers)
+    g, st, info = _run(env, st, "DiskUsageDistributionGoal")
+    assert not bool(info["violated_after"])
+    util = np.asarray(st.util[:, Resource.DISK])
+    avg_pct = util.sum() / np.asarray(env.broker_capacity[:, Resource.DISK]).sum()
+    cap = np.asarray(env.broker_capacity[:, Resource.DISK])
+    assert (util <= avg_pct * 1.09 * cap + 100).all()
+    assert (util >= avg_pct * 0.91 * cap - 100).all()
+
+
+def test_leader_distribution_balances_leaders():
+    env, st = _setup(leaders_skewed)
+    g, st, info = _run(env, st, "LeaderReplicaDistributionGoal")
+    assert not bool(info["violated_after"])
+    assert np.asarray(st.leader_count).max() <= 1 + 1  # ceil(2/3*(1.09)) + margin
+
+
+def test_self_healing_moves_all_offline_replicas():
+    env, st = _setup(dead_broker_cluster)
+    prev = []
+    for name in ("RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal"):
+        g, st, info = _run(env, st, name, prev)
+        prev.append(g)
+    offline = np.asarray(st.replica_offline & env.replica_valid)
+    assert offline.sum() == 0
+    # nothing remains on the dead broker
+    dead = ~np.asarray(env.broker_alive)
+    broker_of = np.asarray(st.replica_broker)[np.asarray(env.replica_valid)]
+    assert not dead[broker_of].any()
+
+
+def test_replica_capacity_goal():
+    env, st = _setup(small_cluster)
+    constraint = BalancingConstraint(max_replicas_per_broker=3)
+    g, st, info = _run(env, st, "ReplicaCapacityGoal", constraint=constraint)
+    assert not bool(info["violated_after"])
+    assert np.asarray(st.replica_count).max() <= 3
+
+
+def test_incremental_state_matches_refresh():
+    """The engine's scatter bookkeeping must equal a from-scratch recompute
+    (LoadConsistencyTest role)."""
+    env, st = _setup(unbalanced_two_brokers)
+    for name in ("DiskUsageDistributionGoal", "NetworkOutboundUsageDistributionGoal"):
+        g, st, info = _run(env, st, name)
+    fresh = refresh(env, st)
+    np.testing.assert_allclose(np.asarray(st.util), np.asarray(fresh.util),
+                               rtol=1e-4, atol=1e-2)
+    np.testing.assert_array_equal(np.asarray(st.replica_count),
+                                  np.asarray(fresh.replica_count))
+    np.testing.assert_array_equal(np.asarray(st.leader_count),
+                                  np.asarray(fresh.leader_count))
+    np.testing.assert_array_equal(np.asarray(st.part_rack_count),
+                                  np.asarray(fresh.part_rack_count))
+    np.testing.assert_array_equal(np.asarray(st.topic_broker_count),
+                                  np.asarray(fresh.topic_broker_count))
+    np.testing.assert_allclose(np.asarray(st.disk_util), np.asarray(fresh.disk_util),
+                               rtol=1e-4, atol=1e-2)
+
+
+def test_prev_goal_acceptance_respected():
+    """After RackAwareGoal, later goals must not recreate co-rack duplicates."""
+    env, st = _setup(rack_violated)
+    g1, st, _ = _run(env, st, "RackAwareGoal")
+    g2, st, _ = _run(env, st, "DiskUsageDistributionGoal", prev=[g1])
+    g3, st, _ = _run(env, st, "ReplicaDistributionGoal", prev=[g1, g2])
+    # rack invariant still holds
+    rack = np.asarray(env.broker_rack)[np.asarray(st.replica_broker)]
+    part = np.asarray(env.replica_partition)
+    valid = np.asarray(env.replica_valid)
+    for p in np.unique(part[valid]):
+        racks = rack[valid & (part == p)]
+        assert len(set(racks.tolist())) == len(racks)
+
+
+def test_preferred_leader_election():
+    from cruise_control_tpu.analyzer.goals.leader_election import PreferredLeaderElectionGoal
+    ct, meta = leaders_skewed()
+    env = make_env(ct, meta)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    ple = PreferredLeaderElectionGoal()
+    # fixture's leaders are already at position 0 -> no-op
+    before = np.asarray(st.replica_is_leader).copy()
+    st2 = ple.apply(env, st)
+    np.testing.assert_array_equal(before, np.asarray(st2.replica_is_leader))
+    # flip leadership away then re-elect
+    st3 = ple.apply(env, refresh(env, st2.__class__(**{
+        **{f.name: getattr(st2, f.name) for f in st2.__dataclass_fields__.values()},
+        "replica_is_leader": st2.replica_is_leader.at[0].set(False).at[1].set(True),
+    })))
+    assert bool(st3.replica_is_leader[0])
+    assert not bool(st3.replica_is_leader[1])

@@ -1,0 +1,152 @@
+"""Executor tests against the simulated backend (ExecutorTest role — the
+reference runs real reassignments against embedded Kafka+ZK; here the
+simulated backend provides the same observable behavior: time-based transfer
+progress, throttling, leadership elections)."""
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.backend import SimulatedClusterBackend
+from cruise_control_tpu.executor import (
+    Executor, ExecutionTaskPlanner, TaskState, TaskType, build_strategy,
+)
+from cruise_control_tpu.executor.task import ExecutionTask
+
+
+def _backend():
+    be = SimulatedClusterBackend()
+    for b, rack in ((0, "r0"), (1, "r0"), (2, "r1"), (3, "r1")):
+        be.add_broker(b, rack)
+    be.create_partition("t", 0, [0, 1], size_mb=100.0, bytes_in_rate=10)
+    be.create_partition("t", 1, [1, 2], size_mb=200.0, bytes_in_rate=10)
+    be.create_partition("t", 2, [2, 0], size_mb=50.0, bytes_in_rate=10)
+    return be
+
+
+def _move(topic, part, old, new, old_leader=None, new_leader=None):
+    return ExecutionProposal(
+        topic=topic, partition=part,
+        old_leader=old_leader if old_leader is not None else old[0],
+        new_leader=new_leader if new_leader is not None else new[0],
+        old_replicas=tuple((b, 0) for b in old),
+        new_replicas=tuple((b, 0) for b in new))
+
+
+def test_inter_broker_move_executes():
+    be = _backend()
+    ex = Executor(be)
+    ex.execute_proposals([_move("t", 0, [0, 1], [3, 1], old_leader=0, new_leader=3)])
+    parts = be.partitions()
+    assert sorted(parts[("t", 0)].replicas) == [1, 3]
+    assert parts[("t", 0)].leader == 3
+    assert ex.state == "NO_TASK_IN_PROGRESS"
+
+
+def test_movement_takes_time_and_throttle_slows_it():
+    be = _backend()
+    be.alter_partition_reassignments({("t", 1): [3, 2]})
+    be.advance(10.0)  # 10ms at 100k KB/s ~ 1MB copied; 200MB needed
+    assert ("t", 1) in be.ongoing_reassignments()
+    be.advance(10_000.0)  # plenty
+    assert ("t", 1) not in be.ongoing_reassignments()
+    assert sorted(be.partitions()[("t", 1)].replicas) == [2, 3]
+
+
+def test_leadership_phase():
+    be = _backend()
+    ex = Executor(be)
+    ex.execute_proposals([_move("t", 2, [2, 0], [2, 0], old_leader=2, new_leader=0)])
+    assert be.partitions()[("t", 2)].leader == 0
+
+
+def test_per_broker_concurrency_cap():
+    be = SimulatedClusterBackend()
+    for b in range(3):
+        be.add_broker(b, f"r{b}")
+    for p in range(10):
+        be.create_partition("u", p, [0], size_mb=10.0)
+    planner = ExecutionTaskPlanner(build_strategy(["BaseReplicaMovementStrategy"]))
+    planner.add_proposals([_move("u", p, [0], [1]) for p in range(10)])
+    batch = planner.next_inter_broker_tasks({}, per_broker_cap=3, cluster_cap=100,
+                                            in_flight_total=0)
+    # each move involves brokers 0 and 1 -> cap 3 limits the batch to 3
+    assert len(batch) == 3
+
+
+def test_cluster_movement_cap():
+    planner = ExecutionTaskPlanner()
+    planner.add_proposals([_move("u", p, [0], [1]) for p in range(10)])
+    batch = planner.next_inter_broker_tasks({}, per_broker_cap=100, cluster_cap=4,
+                                            in_flight_total=0)
+    assert len(batch) == 4
+
+
+def test_strategy_ordering_large_first():
+    be = _backend()
+    sizes = {tp: i.size_mb for tp, i in be.partitions().items()}
+    strategy = build_strategy(["PrioritizeLargeReplicaMovementStrategy"])
+    planner = ExecutionTaskPlanner(strategy)
+    planner.add_proposals([_move("t", 0, [0, 1], [3, 1]),
+                           _move("t", 1, [1, 2], [3, 2]),
+                           _move("t", 2, [2, 0], [3, 0])],
+                          context={"partition_size_mb": sizes})
+    order = [t.tp for t in planner.remaining_inter_broker]
+    assert order == [("t", 1), ("t", 0), ("t", 2)]  # 200, 100, 50 MB
+
+
+def test_force_stop_aborts_inflight():
+    import time
+    be = _backend()
+    # make the copy effectively endless so the move stays in flight
+    be.create_partition("big", 0, [0, 1], size_mb=1e12)
+    ex = Executor(be)
+    ex.execute_proposals([_move("big", 0, [0, 1], [3, 1])], blocking=False)
+    time.sleep(0.05)
+    ex.stop_execution(force=True)
+    ex.wait_for_completion(timeout_s=10.0)
+    assert ex.state == "NO_TASK_IN_PROGRESS"
+    assert not be.ongoing_reassignments()
+    # the target replica never joined
+    assert sorted(be.partitions()[("big", 0)].replicas) == [0, 1]
+    aborted = [t for t in ex._current_planner.all_tasks
+               if t.state is TaskState.ABORTED]
+    assert aborted
+
+
+def test_throttle_set_and_cleared():
+    be = _backend()
+    from cruise_control_tpu.executor.executor import ExecutorConfigView
+    ex = Executor(be)
+    ex._cfg.throttle_bytes_per_sec = 50_000_000
+    ex.execute_proposals([_move("t", 2, [2, 0], [3, 0])])
+    assert be.replication_throttle() is None  # cleaned up after execution
+    assert sorted(be.partitions()[("t", 2)].replicas) == [0, 3]
+
+
+def test_task_state_machine():
+    t = ExecutionTask(_move("t", 0, [0], [1]), TaskType.INTER_BROKER_REPLICA_ACTION)
+    assert t.state is TaskState.PENDING
+    t.transition(TaskState.IN_PROGRESS, 1.0)
+    t.transition(TaskState.COMPLETED, 2.0)
+    with pytest.raises(ValueError):
+        t.transition(TaskState.IN_PROGRESS)
+
+
+def test_reservation():
+    be = _backend()
+    ex = Executor(be)
+    ex.reserve("detector")
+    with pytest.raises(RuntimeError):
+        ex.reserve("rest-api")
+    ex.release("detector")
+    ex.reserve("rest-api")
+
+
+def test_executor_state_json():
+    be = _backend()
+    ex = Executor(be)
+    ex.execute_proposals([_move("t", 0, [0, 1], [3, 1])])
+    st = ex.state_json()
+    assert st["numTotalTasks"] >= 1
+    assert st["numFinishedTasks"] >= 1
+    assert st["executionHistory"]

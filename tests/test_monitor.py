@@ -1,0 +1,186 @@
+"""Monitor-layer tests (core MetricSampleAggregatorTest + LoadMonitorTest roles)."""
+import numpy as np
+import pytest
+
+from cruise_control_tpu.backend import SimulatedClusterBackend
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.monitor import (
+    Extrapolation, LoadMonitor, MetricSampleAggregator,
+    ModelCompletenessRequirements, NotEnoughValidWindowsError, PARTITION_METRIC_DEF,
+)
+from cruise_control_tpu.monitor.sampling.sample_store import FileSampleStore
+from cruise_control_tpu.monitor.sampling.samplers import SimulatedMetricSampler
+from cruise_control_tpu.model.sanity import sanity_check
+
+W_MS = 1000
+
+
+def _agg(num_windows=5, min_samples=3, max_ex=2):
+    return MetricSampleAggregator(num_windows, W_MS, min_samples, max_ex,
+                                  PARTITION_METRIC_DEF)
+
+
+def _fill(agg, entity, window, n, value=10.0):
+    # samples with ts in [window*W, (window+1)*W) land in completed window index
+    for i in range(n):
+        agg.add_sample(entity, window * W_MS + i, {"CPU_USAGE": value,
+                                                   "DISK_USAGE": value * 10})
+
+
+def test_window_rollover_and_avg():
+    agg = _agg()
+    for w in range(6):
+        _fill(agg, "e", w, 3, value=float(w + 1))
+    # current active window is 6; completed = 1..5
+    res = agg.aggregate()
+    assert len(res.window_starts_ms) == 5
+    cpu = res.values[0, :, PARTITION_METRIC_DEF.info("CPU_USAGE").metric_id]
+    np.testing.assert_allclose(cpu, [1, 2, 3, 4, 5])  # window 5 is still active
+    assert (res.extrapolations[0] == Extrapolation.NONE).all()
+    assert res.entity_valid[0]
+
+
+def test_latest_aggregation_for_disk():
+    agg = _agg()
+    for w in range(6):
+        for i in range(3):
+            agg.add_sample("e", w * W_MS + i, {"DISK_USAGE": 100.0 * w + i})
+    res = agg.aggregate()
+    disk = res.values[0, :, PARTITION_METRIC_DEF.info("DISK_USAGE").metric_id]
+    np.testing.assert_allclose(disk, [2, 102, 202, 302, 402])  # last sample per window
+
+
+def test_avg_available_extrapolation():
+    agg = _agg(min_samples=4)  # half = 2
+    for w in range(6):
+        n = 2 if w == 3 else 4
+        _fill(agg, "e", w, n, value=7.0)
+    res = agg.aggregate()
+    w_idx = 3  # completed windows are 0..4
+    assert res.extrapolations[0, w_idx] == Extrapolation.AVG_AVAILABLE
+    assert res.entity_valid[0]
+
+
+def test_avg_adjacent_extrapolation():
+    agg = _agg(min_samples=4)
+    for w in range(6):
+        if w == 3:
+            continue  # no samples at all in window 3
+        _fill(agg, "e", w, 4, value=float(w))
+    res = agg.aggregate()
+    w_idx = 3
+    assert res.extrapolations[0, w_idx] == Extrapolation.AVG_ADJACENT
+    cpu = res.values[0, w_idx, PARTITION_METRIC_DEF.info("CPU_USAGE").metric_id]
+    assert cpu == pytest.approx((2.0 + 4.0) / 2)  # pooled mean of neighbors
+
+
+def test_no_valid_extrapolation_invalidates_entity():
+    agg = _agg(min_samples=4)
+    # windows 2 and 3 empty -> window 3 (interior, index 2) has no valid neighbor
+    for w in (0, 1, 4, 5):
+        _fill(agg, "e", w, 4)
+    res = agg.aggregate()
+    assert (res.extrapolations[0] == Extrapolation.NO_VALID_EXTRAPOLATION).any()
+    assert not res.entity_valid[0]
+    assert res.completeness == 0.0
+
+
+def test_max_extrapolations_budget():
+    agg = _agg(min_samples=4, max_ex=0)
+    for w in range(6):
+        n = 2 if w == 3 else 4
+        _fill(agg, "e", w, n)
+    res = agg.aggregate()
+    assert not res.entity_valid[0]  # one AVG_AVAILABLE > budget 0
+
+
+def test_stale_sample_rejected():
+    agg = _agg()
+    for w in range(10):
+        _fill(agg, "e", w, 3)
+    assert not agg.add_sample("e", 0.0, {"CPU_USAGE": 1.0})
+
+
+def _backend():
+    be = SimulatedClusterBackend()
+    be.add_broker(0, "r0").add_broker(1, "r0").add_broker(2, "r1")
+    be.create_partition("t", 0, [0, 1], size_mb=1000, bytes_in_rate=100,
+                        bytes_out_rate=200, cpu_util=5.0)
+    be.create_partition("t", 1, [1, 2], size_mb=2000, bytes_in_rate=50,
+                        bytes_out_rate=100, cpu_util=2.0)
+    return be
+
+
+def _monitored(be, rounds=20):
+    lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    for i in range(rounds):
+        lm.sample_once(now_ms=i * 60_000.0)
+    return lm
+
+
+def test_load_monitor_builds_model():
+    be = _backend()
+    lm = _monitored(be)
+    ct, meta = lm.cluster_model()
+    sanity_check(ct)
+    assert ct.num_brokers == 3
+    assert int(ct.replica_valid.sum()) == 4
+    util = np.asarray(ct.broker_utilization())
+    # broker 0 leads t-0: nw_out 200 KB/s
+    assert util[0, Resource.NW_OUT] == pytest.approx(200.0, rel=1e-3)
+    # follower of t-0 on broker 1 carries no NW_OUT but leads t-1 (100)
+    assert util[1, Resource.NW_OUT] == pytest.approx(100.0, rel=1e-3)
+    assert util[1, Resource.DISK] == pytest.approx(3000.0, rel=1e-3)
+
+
+def test_completeness_gate():
+    be = _backend()
+    lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    lm.sample_once(now_ms=0.0)  # one sample -> no completed window yet
+    with pytest.raises(NotEnoughValidWindowsError):
+        lm.cluster_model(ModelCompletenessRequirements(min_required_num_windows=1))
+    assert not lm.meet_completeness_requirements(
+        ModelCompletenessRequirements(min_required_num_windows=1))
+
+
+def test_pause_resume():
+    be = _backend()
+    lm = _monitored(be)
+    lm.pause_sampling("test")
+    assert lm.sample_once(now_ms=1e9) == 0
+    assert lm.state == "PAUSED"
+    lm.resume_sampling()
+    assert lm.sample_once(now_ms=2e9) > 0
+
+
+def test_sample_store_replay(tmp_path):
+    be = _backend()
+    store = FileSampleStore(str(tmp_path))
+    store.configure(None)
+    lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be), sample_store=store)
+    lm.start_up()
+    for i in range(20):
+        lm.sample_once(now_ms=i * 60_000.0)
+    ct1, _ = lm.cluster_model()
+    lm.shutdown()
+    # a fresh monitor replays history and can build the same model immediately
+    store2 = FileSampleStore(str(tmp_path))
+    store2.configure(None)
+    lm2 = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be), sample_store=store2)
+    n = lm2.start_up()
+    assert n > 0
+    ct2, _ = lm2.cluster_model()
+    np.testing.assert_allclose(np.asarray(ct1.broker_utilization()),
+                               np.asarray(ct2.broker_utilization()), rtol=1e-5)
+
+
+def test_dead_broker_reflected_in_model():
+    be = _backend()
+    lm = _monitored(be)
+    be.kill_broker(0)
+    ct, meta = lm.cluster_model()
+    sanity_check(ct)
+    assert not bool(ct.broker_alive[meta.broker_index(0)])
+    assert int((ct.replica_offline & ct.replica_valid).sum()) == 1

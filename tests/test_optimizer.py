@@ -1,0 +1,120 @@
+"""Randomized property tests + OptimizationVerifier analogue
+(reference analyzer/RandomClusterTest.java:61, OptimizationVerifier.java:53)."""
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.optimizer import (
+    GoalOptimizer, OptimizationFailureError,
+)
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.model.fixtures import capacity_violated, unbalanced_two_brokers
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate
+
+GOALS_CORE = [
+    "RackAwareGoal", "MinTopicLeadersPerBrokerGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal", "CpuCapacityGoal",
+    "ReplicaDistributionGoal", "DiskUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal", "LeaderReplicaDistributionGoal",
+    "TopicReplicaDistributionGoal", "PreferredLeaderElectionGoal",
+]
+
+
+def verify(res, env_alive=True):
+    """OptimizationVerifier.java:53 analogue: (a) no offline replicas remain,
+    (b) hard goals hold after optimization, (c) proposals reproduce state."""
+    st = res.final_state
+    env = res.env
+    offline = np.asarray(st.replica_offline) & np.asarray(env.replica_valid)
+    assert offline.sum() == 0, "offline replicas must be relocated"
+    for g in res.goal_results:
+        if g.name in ("RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+                      "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+                      "CpuCapacityGoal"):
+            assert not g.violated_after, f"hard goal {g.name} violated after optimization"
+
+
+@pytest.mark.parametrize("seed", [3140, 5234, 72033])
+def test_random_cluster_hard_goals(seed):
+    ct, meta = generate(RandomClusterSpec(num_brokers=12, num_racks=4, num_topics=8,
+                                          num_partitions=120, skew=2.0, seed=seed))
+    opt = GoalOptimizer()
+    res = opt.optimizations(ct, meta, goal_names=GOALS_CORE)
+    verify(res)
+
+
+def test_random_self_healing_dead_brokers():
+    """RandomSelfHealingTest role: kill brokers, all replicas must relocate."""
+    ct, meta = generate(RandomClusterSpec(num_brokers=12, num_racks=4, num_topics=8,
+                                          num_partitions=100, num_dead_brokers=2,
+                                          seed=99))
+    opt = GoalOptimizer()
+    res = opt.optimizations(ct, meta, goal_names=GOALS_CORE)
+    verify(res)
+    dead = ~np.asarray(res.env.broker_alive)
+    broker_of = np.asarray(res.final_state.replica_broker)[np.asarray(res.env.replica_valid)]
+    assert not dead[broker_of].any()
+
+
+def test_goal_stats_monotone():
+    """Per-goal severity totals never regress across the goal sequence
+    (AbstractGoal.java:110-119 monotonicity assertion analogue: later goals may
+    not re-violate an earlier-optimized hard goal)."""
+    ct, meta = generate(RandomClusterSpec(num_brokers=10, num_racks=3, num_topics=6,
+                                          num_partitions=80, skew=1.5, seed=7))
+    opt = GoalOptimizer()
+    res = opt.optimizations(ct, meta, goal_names=GOALS_CORE)
+    for g in res.goal_results:
+        if g.violated_after and not g.violated_before:
+            pytest.fail(f"goal {g.name} was satisfied before but violated after")
+
+
+def test_proposals_reproduce_final_state():
+    ct, meta = generate(RandomClusterSpec(num_brokers=8, num_racks=2, num_topics=5,
+                                          num_partitions=60, skew=2.0, seed=13))
+    opt = GoalOptimizer()
+    res = opt.optimizations(ct, meta, goal_names=["ReplicaDistributionGoal",
+                                                  "DiskUsageDistributionGoal"],
+                            skip_hard_goal_check=True)
+    # replay proposals onto the initial assignment
+    assign = {}
+    members = np.asarray(res.env.partition_replicas)
+    init_broker = np.asarray(ct.replica_broker)
+    for p in res.proposals:
+        pidx = meta.partition_ids.index((p.topic, p.partition))
+        ms = members[pidx][members[pidx] >= 0]
+        got = sorted(b for b, _ in p.new_replicas)
+        final = sorted(np.asarray(res.final_state.replica_broker)[ms].tolist())
+        final_ids = [meta.broker_ids[b] for b in final]
+        assert got == sorted(final_ids), f"proposal mismatch for {p.tp}"
+
+
+def test_hard_goal_check_enforced():
+    ct, meta = generate(RandomClusterSpec(num_brokers=6, num_racks=2, num_topics=3,
+                                          num_partitions=30, seed=5))
+    opt = GoalOptimizer()
+    with pytest.raises(ValueError):
+        opt.optimizations(ct, meta, goal_names=["ReplicaDistributionGoal"])
+    # explicit skip works
+    opt.optimizations(ct, meta, goal_names=["ReplicaDistributionGoal"],
+                      skip_hard_goal_check=True)
+
+
+def test_capacity_infeasible_raises():
+    """unbalanced fixture's total load exceeds the capacity threshold; hard
+    goals must report failure (OptimizationFailureException role)."""
+    ct, meta = unbalanced_two_brokers()
+    opt = GoalOptimizer()
+    with pytest.raises(OptimizationFailureError):
+        opt.optimizations(ct, meta, goal_names=["DiskCapacityGoal"],
+                          skip_hard_goal_check=True, raise_on_failure=True)
+
+
+def test_optimizer_result_json():
+    ct, meta = capacity_violated()
+    opt = GoalOptimizer()
+    res = opt.optimizations(ct, meta, goal_names=["DiskCapacityGoal"],
+                            skip_hard_goal_check=True)
+    j = res.to_json()
+    assert "summary" in j and "goalSummary" in j and "proposals" in j
+    assert j["summary"]["numReplicaMovements"] >= 1
+    assert not j["summary"]["violatedGoalsAfter"]
