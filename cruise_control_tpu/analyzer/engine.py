@@ -56,6 +56,7 @@ class EngineParams:
     num_leader_candidates: int = 32   # KL: leadership candidates per iteration
     num_swap_candidates: int = 32     # K1/K2: swap-out / swap-in candidates
     min_gain: float = 1e-9            # scores below this count as no progress
+    batch_moves: bool = True          # apply many non-conflicting moves per scoring pass
 
 
 def _move_branch(env: ClusterEnv, st: EngineState, goal: GoalKernel,
@@ -85,6 +86,52 @@ def _leadership_branch(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     k, f = jnp.unravel_index(flat, lscore.shape)
     dst_replica = env.partition_replicas[env.replica_partition[lcand[k]], f]
     return lscore.reshape(-1)[flat], lcand[k], jnp.clip(dst_replica, 0)
+
+
+def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                         prev_goals: tuple, params: EngineParams, severity: Array):
+    """Score once, apply MANY moves: the scored [K, B] matrix is reused for up
+    to K independent moves under three conflict rules — at most one move out
+    of any source broker, one into any destination broker, and one per
+    partition. Under those rules every accepted move's scored feasibility and
+    acceptance stay exact (balance limits depend only on cluster totals, which
+    moves preserve; per-broker state changes by at most the one scored move).
+    This is the main lever that turns ~N sequential scoring passes into
+    ~N/K passes at 7k-broker scale."""
+    key = goal.replica_key(env, st, severity)
+    kv, cand = jax.lax.top_k(key, min(params.num_candidates, env.num_replicas))
+    mask = legit_move_mask(env, st, cand, goal.options)
+    for g in prev_goals:
+        mask = mask & g.accept_move(env, st, cand)
+    score = goal.move_score(env, st, cand)
+    score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
+
+    K = score.shape[0]
+    best_dst = jnp.argmax(score, axis=1).astype(jnp.int32)          # [K]
+    best_val = jnp.max(score, axis=1)                               # [K]
+    order = jnp.argsort(-best_val)                                  # best first
+
+    def body(i, carry):
+        st, used_src, used_dst, used_part, n_applied = carry
+        k = order[i]
+        r = cand[k]
+        d = best_dst[k]
+        v = best_val[k]
+        src = st.replica_broker[r]
+        p = env.replica_partition[r]
+        ok = ((v > params.min_gain) & ~used_src[src] & ~used_dst[d]
+              & ~used_part[p])
+        st = jax.lax.cond(ok, lambda s: apply_move(env, s, r, d), lambda s: s, st)
+        used_src = used_src.at[src].set(used_src[src] | ok)
+        used_dst = used_dst.at[d].set(used_dst[d] | ok)
+        used_part = used_part.at[p].set(used_part[p] | ok)
+        return st, used_src, used_dst, used_part, n_applied + ok.astype(jnp.int32)
+
+    B = env.num_brokers
+    init = (st, jnp.zeros(B, bool), jnp.zeros(B, bool),
+            jnp.zeros(env.num_partitions, bool), jnp.int32(0))
+    st, _, _, _, n_applied = jax.lax.fori_loop(0, K, body, init)
+    return st, n_applied
 
 
 def _swap_branch(env: ClusterEnv, st: EngineState, goal: GoalKernel,
@@ -127,10 +174,24 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple, params: En
         def step(carry):
             st, it, n_applied, _progress = carry
             severity = goal.broker_severity(env, st)
-            if goal.uses_replica_moves:
-                mscore, mrep, mdst = _move_branch(env, st, goal, prev_goals, params, severity)
+
+            n_moves = jnp.int32(0)
+            if goal.uses_replica_moves and params.batch_moves:
+                st_moved, n_moves = _move_branch_batched(env, st, goal, prev_goals,
+                                                         params, severity)
+            elif goal.uses_replica_moves:
+                mscore, mrep, mdst = _move_branch(env, st, goal, prev_goals,
+                                                  params, severity)
+                do_move = jnp.asarray(mscore, jnp.float32) > params.min_gain
+                st_moved = jax.lax.cond(do_move,
+                                        lambda s: apply_move(env, s, mrep, mdst),
+                                        lambda s: s, st)
+                n_moves = do_move.astype(jnp.int32)
             else:
-                mscore, mrep, mdst = NEG_INF, jnp.int32(0), jnp.int32(0)
+                st_moved = st
+
+            # leadership/swap scores were computed against the pre-move state,
+            # so they only apply when no replica move landed this pass
             if goal.uses_leadership_moves:
                 lscore, lsrc, ldst = _leadership_branch(env, st, goal, prev_goals,
                                                         params, severity)
@@ -142,28 +203,23 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple, params: En
             else:
                 sscore, sout, sin_ = NEG_INF, jnp.int32(0), jnp.int32(0)
 
-            mscore = jnp.asarray(mscore, jnp.float32)
             lscore = jnp.asarray(lscore, jnp.float32)
             sscore = jnp.asarray(sscore, jnp.float32)
-            best = jnp.maximum(jnp.maximum(mscore, lscore), sscore)
-            do_move = (mscore >= best) & (mscore > params.min_gain)
-            do_lead = (~do_move) & (lscore >= best) & (lscore > params.min_gain)
-            do_swap = (~do_move) & (~do_lead) & (sscore > params.min_gain)
+            no_move = n_moves == 0
+            do_lead = no_move & (lscore >= sscore) & (lscore > params.min_gain)
+            do_swap = no_move & (~do_lead) & (sscore > params.min_gain)
 
             st = jax.lax.cond(
-                do_move,
-                lambda s: apply_move(env, s, mrep, mdst),
+                do_lead,
+                lambda s: apply_leadership(env, s, lsrc, ldst),
                 lambda s: jax.lax.cond(
-                    do_lead,
-                    lambda s2: apply_leadership(env, s2, lsrc, ldst),
-                    lambda s2: jax.lax.cond(
-                        do_swap,
-                        lambda s3: apply_swap(env, s3, sout, sin_),
-                        lambda s3: s3, s2),
-                    s),
-                st)
-            progress = do_move | do_lead | do_swap
-            return st, it + 1, n_applied + progress.astype(jnp.int32), progress
+                    do_swap,
+                    lambda s2: apply_swap(env, s2, sout, sin_),
+                    lambda s2: s2, s),
+                st_moved)
+            applied = n_moves + do_lead.astype(jnp.int32) + do_swap.astype(jnp.int32)
+            progress = applied > 0
+            return st, it + 1, n_applied + applied, progress
 
         def cond_fn(carry):
             _st, it, _n, progress = carry

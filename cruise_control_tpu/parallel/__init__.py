@@ -1,0 +1,5 @@
+from cruise_control_tpu.parallel.sharding import (
+    BROKER_AXIS, make_mesh, shard_cluster,
+)
+
+__all__ = ["BROKER_AXIS", "make_mesh", "shard_cluster"]

@@ -1,0 +1,98 @@
+"""Device-mesh sharding of the optimization engine.
+
+The reference scales by threading on one JVM (SURVEY §2.10); the TPU-native
+scale-out axis is the candidate-destination (broker) dimension: every
+per-iteration kernel in the engine is either
+
+- [B]- or [B, M]-shaped broker state (utilization, counts, limits),
+- [K, B] candidate x destination score/mask matrices, or
+- [R]-shaped replica state reduced into broker bins via segment ops,
+
+so sharding the broker axis across a 1-D ``Mesh(("brokers",))`` splits the
+scoring work and state while XLA inserts the collectives (argmax over the
+sharded axis becomes a cross-device reduce; scatter updates stay local to the
+owning shard). Replica-axis arrays are replicated in v1 — at the 7k-broker /
+1M-replica north star the [K, B] scoring and [B]-state dominate; replica
+sharding (segment-sum via reduce_scatter) is the next step up.
+
+This module only *places* data: the engine code is unchanged — jit propagates
+input shardings through the whole while_loop (GSPMD), which is exactly the
+"annotate shardings, let XLA insert collectives" recipe.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cruise_control_tpu.analyzer.env import ClusterEnv
+from cruise_control_tpu.analyzer.state import EngineState
+
+BROKER_AXIS = "brokers"
+
+# env leaves sharded along their broker dimension (axis index given)
+_ENV_BROKER_AXES = {
+    "broker_capacity": 0, "broker_rack": 0, "broker_alive": 0, "broker_new": 0,
+    "broker_demoted": 0, "broker_excluded_for_replica_move": 0,
+    "broker_excluded_for_leadership": 0, "broker_disk_capacity": 0,
+    "broker_disk_alive": 0, "dst_candidate": 0,
+}
+_STATE_BROKER_AXES = {
+    "util": 0, "leader_util": 0, "potential_nw_out": 0, "replica_count": 0,
+    "leader_count": 0, "topic_broker_count": 1, "topic_leader_count": 1,
+    "disk_util": 0,
+}
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (BROKER_AXIS,))
+
+
+def _spec_for(ndim: int, axis: int | None) -> P:
+    if axis is None:
+        return P()
+    parts = [None] * ndim
+    parts[axis] = BROKER_AXIS
+    return P(*parts)
+
+
+def _place(obj, axes_map: dict, mesh: Mesh):
+    updates = {}
+    for f in dataclasses.fields(obj):
+        val = getattr(obj, f.name)
+        if not hasattr(val, "ndim"):
+            continue
+        axis = axes_map.get(f.name)
+        sharding = NamedSharding(mesh, _spec_for(val.ndim, axis))
+        updates[f.name] = jax.device_put(val, sharding)
+    return dataclasses.replace(obj, **updates)
+
+
+def pad_brokers(ct_arrays_factory, num_brokers: int, multiple: int) -> int:
+    """Brokers must pad to a multiple of the mesh size; dead padded brokers
+    are invisible to every goal (alive=False, capacity=0)."""
+    rem = num_brokers % multiple
+    return num_brokers if rem == 0 else num_brokers + (multiple - rem)
+
+
+def shard_cluster(env: ClusterEnv, st: EngineState, mesh: Mesh):
+    """Place (env, state) on the mesh: broker-dim leaves sharded, rest
+    replicated. The broker count must divide evenly by the mesh size."""
+    B = env.num_brokers
+    n = mesh.devices.size
+    if B % n != 0:
+        raise ValueError(f"num_brokers={B} must be a multiple of mesh size {n}; "
+                         f"pad the cluster with dead brokers (pad_brokers)")
+    env_s = _place(env, _ENV_BROKER_AXES, mesh)
+    st_s = _place(st, _STATE_BROKER_AXES, mesh)
+    return env_s, st_s
+
+
+def replicate(tree, mesh: Mesh):
+    return jax.device_put(tree, NamedSharding(mesh, P()))
