@@ -1,0 +1,21 @@
+import time, numpy as np, jax
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate_scale
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+
+t0=time.monotonic()
+ct, meta = generate_scale(RandomClusterSpec(
+    num_brokers=7000, num_racks=40, num_topics=2000,
+    num_partitions=500000, max_replication=3, skew=1.0, seed=3142, mean_cpu=0.4))
+print("gen", round(time.monotonic()-t0,1), "replicas", meta.num_valid_replicas, flush=True)
+opt = GoalOptimizer()
+t0=time.monotonic()
+res = opt.optimizations(ct, meta, raise_on_failure=False)
+print("COLD", round(time.monotonic()-t0,1), flush=True)
+for g in res.goal_results:
+    print(f"{g.name:42s} before={g.violated_before!s:5} after={g.violated_after!s:5} it={g.iterations:7d} {g.duration_s:7.3f}s maxed={g.hit_max_iters}", flush=True)
+t0=time.monotonic()
+res = opt.optimizations(ct, meta, raise_on_failure=False)
+print("WARM WALL", round(time.monotonic()-t0,2), flush=True)
+for g in res.goal_results:
+    print(f"{g.name:42s} before={g.violated_before!s:5} after={g.violated_after!s:5} it={g.iterations:7d} {g.duration_s:7.3f}s maxed={g.hit_max_iters}", flush=True)
+print("moves", res.num_replica_movements, "leads", res.num_leadership_movements, flush=True)

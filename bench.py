@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""BASELINE ladder benchmark (see BASELINE.json / BASELINE.md).
+
+Runs the full default-goal-chain rebalance proposal on the config ladder:
+
+  1. DeterministicCluster-style 3-broker fixture
+  2. RandomCluster 100 brokers / 10k replicas
+  3. RandomCluster 1,000 brokers / 100k replicas (skewed, rack-aware)
+  4. 7,000 brokers / ~1M replicas, all goals   <- the north-star rung
+  5. 7,000-broker JBOD with offline replicas (self-healing + intra-broker)
+
+Per rung it reports cold (includes compile; persistent compilation cache
+applies) and warm wall-clock plus goal-violation counts before/after — the
+measurement mirror of the reference's proposal-computation-timer
+(analyzer/GoalOptimizer.java:125).
+
+Prints ONE final JSON line on stdout:
+  {"metric": ..., "value": warm_wall_s_at_7k_1M, "unit": "s",
+   "vs_baseline": 10.0 / value, "rungs": [...]}
+vs_baseline > 1 means faster than the BASELINE.json <10 s target.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import numpy as np  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2) -> dict:
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+
+    opt = GoalOptimizer()
+    walls = []
+    res = None
+    for i in range(repeats):
+        t0 = time.monotonic()
+        res = opt.optimizations(ct, meta, goal_names=goal_names,
+                                raise_on_failure=False)
+        walls.append(time.monotonic() - t0)
+        log(f"  [{name}] run {i}: {walls[-1]:.2f}s")
+    rung = {
+        "config": name,
+        "wall_s_cold": round(walls[0], 3),
+        "wall_s": round(min(walls[1:] or walls), 3),
+        "violations_before": len(res.violated_goals_before),
+        "violations_after": len(res.violated_goals_after),
+        "violated_goals_after": res.violated_goals_after,
+        "budget_exhausted": [g.name for g in res.goal_results if g.hit_max_iters],
+        "num_replica_movements": res.num_replica_movements,
+        "num_leadership_movements": res.num_leadership_movements,
+        "goal_seconds": {g.name: round(g.duration_s, 3) for g in res.goal_results},
+    }
+    log(f"  [{name}] violations {rung['violations_before']} -> "
+        f"{rung['violations_after']}  moves={rung['num_replica_movements']} "
+        f"warm={rung['wall_s']}s")
+    return rung
+
+
+def main() -> None:
+    from cruise_control_tpu.model.fixtures import small_cluster
+    from cruise_control_tpu.model.random_cluster import (
+        RandomClusterSpec, generate, generate_scale,
+    )
+
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    rungs = []
+
+    t_all = time.monotonic()
+
+    if only in (None, "1"):
+        log("rung 1: deterministic 3-broker fixture")
+        ct, meta = small_cluster()
+        rungs.append(run_rung("deterministic-3broker", ct, meta,
+                              goal_names=["DiskUsageDistributionGoal"]))
+
+    if only in (None, "2"):
+        log("rung 2: 100 brokers / 10k replicas")
+        ct, meta = generate(RandomClusterSpec(
+            num_brokers=100, num_racks=10, num_topics=40, num_partitions=5000,
+            max_replication=3, skew=1.0, seed=3140))
+        log(f"  generated {meta.num_valid_replicas} replicas")
+        rungs.append(run_rung("100b-10k", ct, meta))
+
+    if only in (None, "3"):
+        log("rung 3: 1,000 brokers / 100k replicas (skewed)")
+        ct, meta = generate_scale(RandomClusterSpec(
+            num_brokers=1000, num_racks=20, num_topics=200, num_partitions=50000,
+            max_replication=3, skew=1.5, seed=3141))
+        log(f"  generated {meta.num_valid_replicas} replicas")
+        rungs.append(run_rung("1000b-100k", ct, meta))
+
+    headline = None
+    if only in (None, "4"):
+        log("rung 4: 7,000 brokers / 1M replicas (north star)")
+        ct, meta = generate_scale(RandomClusterSpec(
+            num_brokers=7000, num_racks=40, num_topics=2000,
+            num_partitions=500000, max_replication=3, skew=1.0, seed=3142))
+        log(f"  generated {meta.num_valid_replicas} replicas")
+        headline = run_rung("7000b-1M", ct, meta)
+        rungs.append(headline)
+
+    log(f"total bench time {time.monotonic() - t_all:.1f}s")
+
+    value = headline["wall_s"] if headline else rungs[-1]["wall_s"]
+    out = {
+        "metric": "full-default-goal-chain rebalance proposal wall-clock "
+                  "@ 7k brokers / 1M replicas",
+        "value": value,
+        "unit": "s",
+        "vs_baseline": round(10.0 / value, 3) if value else None,
+        "rungs": rungs,
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -56,11 +56,35 @@ class EngineParams:
     num_leader_candidates: int = 32   # KL: leadership candidates per iteration
     num_swap_candidates: int = 32     # K1/K2: swap-out / swap-in candidates
     min_gain: float = 1e-9            # scores below this count as no progress
-    batch_moves: bool = True          # apply many non-conflicting moves per scoring pass
 
 
-def _move_branch(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-                 prev_goals: tuple, params: EngineParams, severity: Array):
+def _rescore_move_row(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                      prev_goals: tuple, r: Array) -> Array:
+    """f32[B]: the candidate replica's move score against the CURRENT state —
+    full legitimacy + self-satisfaction + prev-goal acceptance, one row."""
+    c1 = r[None]
+    m1 = legit_move_mask(env, st, c1, goal.options)
+    for g in prev_goals:
+        m1 = m1 & g.accept_move(env, st, c1)
+    s1 = goal.move_score(env, st, c1)
+    return jnp.where(m1, s1, NEG_INF)[0]
+
+
+def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                         prev_goals: tuple, params: EngineParams, severity: Array):
+    """Score once to ORDER candidates, then apply up to K moves per pass,
+    re-validating each against the running state.
+
+    The [K, B] scoring pass picks and orders candidates; the per-move
+    re-score (`_rescore_move_row`, a [1, B] row: legitimacy + self-score +
+    prev-goal acceptance, all against the state with earlier moves of this
+    pass applied) makes every applied move exactly as valid as a fresh
+    scoring pass would — multiple moves may share a source or destination
+    broker, because the second move sees the first move's utilization. The
+    re-score row costs O(B·(1+|prev|)) vs the O(R·logK + K·B) full pass, so
+    a pass lands up to K moves for ~2x the cost of landing one — the lever
+    that replaces ~N sequential scoring passes with ~N/K at 7k-broker scale
+    (reference hot loop: ResourceDistributionGoal.java:384-862)."""
     key = goal.replica_key(env, st, severity)
     kv, cand = jax.lax.top_k(key, min(params.num_candidates, env.num_replicas))
     mask = legit_move_mask(env, st, cand, goal.options)
@@ -68,74 +92,86 @@ def _move_branch(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         mask = mask & g.accept_move(env, st, cand)
     score = goal.move_score(env, st, cand)
     score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
-    flat = jnp.argmax(score)
-    k, b = jnp.unravel_index(flat, score.shape)
-    return score.reshape(-1)[flat], cand[k], jnp.asarray(b, jnp.int32)
+    best_val = jnp.max(score, axis=1)                               # [K]
+    order = jnp.argsort(-best_val)                                  # best first
+
+    def body(i, carry):
+        st, n_applied = carry
+        k = order[i]
+        r = cand[k]
+        row = _rescore_move_row(env, st, goal, prev_goals, r)
+        d = jnp.argmax(row).astype(jnp.int32)
+        ok = (best_val[k] > params.min_gain) & (row[d] > params.min_gain)
+        st = jax.lax.cond(ok, lambda s: apply_move(env, s, r, d), lambda s: s, st)
+        return st, n_applied + ok.astype(jnp.int32)
+
+    K = score.shape[0]
+    # skip the K-step apply loop entirely on a stall pass (nothing scored > 0)
+    st, n_applied = jax.lax.cond(
+        jnp.max(best_val) > params.min_gain,
+        lambda s: jax.lax.fori_loop(0, K, body, (s, jnp.int32(0))),
+        lambda s: (s, jnp.int32(0)), st)
+    return st, n_applied
 
 
-def _leadership_branch(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-                       prev_goals: tuple, params: EngineParams, severity: Array):
+def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                               prev_goals: tuple, params: EngineParams,
+                               severity: Array):
+    """Leadership analogue of _move_branch_batched: order candidates by a
+    [KL, F] scoring pass, then apply up to KL transfers, re-scoring each
+    [1, F] row against the running state."""
     lkey = goal.leader_key(env, st, severity)
-    lkv, lcand = jax.lax.top_k(lkey, min(params.num_leader_candidates, env.num_replicas))
+    lkv, lcand = jax.lax.top_k(lkey, min(params.num_leader_candidates,
+                                         env.num_replicas))
     lmask = legit_leadership_mask(env, st, lcand)
     for g in prev_goals:
         lmask = lmask & g.accept_leadership(env, st, lcand)
     lscore = goal.leadership_score(env, st, lcand)
     lscore = jnp.where(lmask & (lkv > NEG_INF)[:, None], lscore, NEG_INF)
-    flat = jnp.argmax(lscore)
-    k, f = jnp.unravel_index(flat, lscore.shape)
-    dst_replica = env.partition_replicas[env.replica_partition[lcand[k]], f]
-    return lscore.reshape(-1)[flat], lcand[k], jnp.clip(dst_replica, 0)
-
-
-def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-                         prev_goals: tuple, params: EngineParams, severity: Array):
-    """Score once, apply MANY moves: the scored [K, B] matrix is reused for up
-    to K independent moves under three conflict rules — at most one move out
-    of any source broker, one into any destination broker, and one per
-    partition. Under those rules every accepted move's scored feasibility and
-    acceptance stay exact (balance limits depend only on cluster totals, which
-    moves preserve; per-broker state changes by at most the one scored move).
-    This is the main lever that turns ~N sequential scoring passes into
-    ~N/K passes at 7k-broker scale."""
-    key = goal.replica_key(env, st, severity)
-    kv, cand = jax.lax.top_k(key, min(params.num_candidates, env.num_replicas))
-    mask = legit_move_mask(env, st, cand, goal.options)
-    for g in prev_goals:
-        mask = mask & g.accept_move(env, st, cand)
-    score = goal.move_score(env, st, cand)
-    score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
-
-    K = score.shape[0]
-    best_dst = jnp.argmax(score, axis=1).astype(jnp.int32)          # [K]
-    best_val = jnp.max(score, axis=1)                               # [K]
-    order = jnp.argsort(-best_val)                                  # best first
+    best_val = jnp.max(lscore, axis=1)
+    order = jnp.argsort(-best_val)
 
     def body(i, carry):
-        st, used_src, used_dst, used_part, n_applied = carry
+        st, n_applied = carry
         k = order[i]
-        r = cand[k]
-        d = best_dst[k]
-        v = best_val[k]
-        src = st.replica_broker[r]
-        p = env.replica_partition[r]
-        ok = ((v > params.min_gain) & ~used_src[src] & ~used_dst[d]
-              & ~used_part[p])
-        st = jax.lax.cond(ok, lambda s: apply_move(env, s, r, d), lambda s: s, st)
-        used_src = used_src.at[src].set(used_src[src] | ok)
-        used_dst = used_dst.at[d].set(used_dst[d] | ok)
-        used_part = used_part.at[p].set(used_part[p] | ok)
-        return st, used_src, used_dst, used_part, n_applied + ok.astype(jnp.int32)
+        r = lcand[k]
+        c1 = r[None]
+        m1 = legit_leadership_mask(env, st, c1)
+        for g in prev_goals:
+            m1 = m1 & g.accept_leadership(env, st, c1)
+        s1 = jnp.where(m1, goal.leadership_score(env, st, c1), NEG_INF)[0]
+        f = jnp.argmax(s1)
+        dst = env.partition_replicas[env.replica_partition[r], f]
+        ok = (best_val[k] > params.min_gain) & (s1[f] > params.min_gain)
+        st = jax.lax.cond(
+            ok, lambda s: apply_leadership(env, s, r, jnp.clip(dst, 0)),
+            lambda s: s, st)
+        return st, n_applied + ok.astype(jnp.int32)
 
-    B = env.num_brokers
-    init = (st, jnp.zeros(B, bool), jnp.zeros(B, bool),
-            jnp.zeros(env.num_partitions, bool), jnp.int32(0))
-    st, _, _, _, n_applied = jax.lax.fori_loop(0, K, body, init)
+    KL = lscore.shape[0]
+    st, n_applied = jax.lax.cond(
+        jnp.max(best_val) > params.min_gain,
+        lambda s: jax.lax.fori_loop(0, KL, body, (s, jnp.int32(0))),
+        lambda s: (s, jnp.int32(0)), st)
     return st, n_applied
 
 
-def _swap_branch(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-                 prev_goals: tuple, params: EngineParams, severity: Array):
+def _rescore_swap_pair(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                       prev_goals: tuple, r_out: Array, r_in: Array) -> Array:
+    """f32 scalar: the swap's score against the CURRENT state."""
+    co, ci = r_out[None], r_in[None]
+    m = legit_swap_mask(env, st, co, ci)
+    for g in prev_goals:
+        m = m & g.accept_swap(env, st, co, ci)
+    s = goal.swap_score(env, st, co, ci)
+    return jnp.where(m, s, NEG_INF)[0, 0]
+
+
+def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                         prev_goals: tuple, params: EngineParams, severity: Array):
+    """Swap analogue of _move_branch_batched: one [K1, K2] scoring pass
+    orders candidate pairs, then up to K1 swaps apply per pass, each
+    re-validated as a pair against the running state."""
     k = min(params.num_swap_candidates, env.num_replicas)
     okey = goal.swap_out_key(env, st, severity)
     ikey = goal.swap_in_key(env, st, severity)
@@ -147,9 +183,25 @@ def _swap_branch(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     score = goal.swap_score(env, st, cand_out, cand_in)
     score = jnp.where(mask & (okv > NEG_INF)[:, None] & (ikv > NEG_INF)[None, :],
                       score, NEG_INF)
-    flat = jnp.argmax(score)
-    i, j = jnp.unravel_index(flat, score.shape)
-    return score.reshape(-1)[flat], cand_out[i], cand_in[j]
+    # order the top-k1 pairs by scored value (flattened)
+    S = score.shape[0]
+    best_flat, flat_idx = jax.lax.top_k(score.reshape(-1), S)
+
+    def body(i, carry):
+        st, n_applied = carry
+        oi, ij = jnp.unravel_index(flat_idx[i], score.shape)
+        r_out, r_in = cand_out[oi], cand_in[ij]
+        v = _rescore_swap_pair(env, st, goal, prev_goals, r_out, r_in)
+        ok = (best_flat[i] > params.min_gain) & (v > params.min_gain)
+        st = jax.lax.cond(ok, lambda s: apply_swap(env, s, r_out, r_in),
+                          lambda s: s, st)
+        return st, n_applied + ok.astype(jnp.int32)
+
+    st, n_applied = jax.lax.cond(
+        best_flat[0] > params.min_gain,
+        lambda s: jax.lax.fori_loop(0, S, body, (s, jnp.int32(0))),
+        lambda s: (s, jnp.int32(0)), st)
+    return st, n_applied
 
 
 def optimize_goal(env: ClusterEnv, st: EngineState, goal: GoalKernel,
@@ -175,49 +227,36 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple, params: En
             st, it, n_applied, _progress = carry
             severity = goal.broker_severity(env, st)
 
+            # 1. replica moves (cheapest per unit of work on TPU: one scoring
+            #    pass lands up to K moves)
             n_moves = jnp.int32(0)
-            if goal.uses_replica_moves and params.batch_moves:
-                st_moved, n_moves = _move_branch_batched(env, st, goal, prev_goals,
-                                                         params, severity)
-            elif goal.uses_replica_moves:
-                mscore, mrep, mdst = _move_branch(env, st, goal, prev_goals,
-                                                  params, severity)
-                do_move = jnp.asarray(mscore, jnp.float32) > params.min_gain
-                st_moved = jax.lax.cond(do_move,
-                                        lambda s: apply_move(env, s, mrep, mdst),
-                                        lambda s: s, st)
-                n_moves = do_move.astype(jnp.int32)
-            else:
-                st_moved = st
+            if goal.uses_replica_moves:
+                st, n_moves = _move_branch_batched(env, st, goal, prev_goals,
+                                                   params, severity)
 
-            # leadership/swap scores were computed against the pre-move state,
-            # so they only apply when no replica move landed this pass
+            # 2. leadership transfers — only when no move landed (lazy cond:
+            #    the scoring usually never runs), batched like moves
+            n_leads = jnp.int32(0)
             if goal.uses_leadership_moves:
-                lscore, lsrc, ldst = _leadership_branch(env, st, goal, prev_goals,
-                                                        params, severity)
-            else:
-                lscore, lsrc, ldst = NEG_INF, jnp.int32(0), jnp.int32(0)
+                st, n_leads = jax.lax.cond(
+                    n_moves == 0,
+                    lambda s: _leadership_branch_batched(
+                        env, s, goal, prev_goals, params,
+                        goal.broker_severity(env, s)),
+                    lambda s: (s, jnp.int32(0)), st)
+
+            # 3. swaps — last resort when neither moves nor transfers progress
+            #    (rebalanceBySwappingLoadOut/In role), batched like moves
+            n_swaps = jnp.int32(0)
             if goal.uses_swaps:
-                sscore, sout, sin_ = _swap_branch(env, st, goal, prev_goals,
-                                                  params, severity)
-            else:
-                sscore, sout, sin_ = NEG_INF, jnp.int32(0), jnp.int32(0)
+                st, n_swaps = jax.lax.cond(
+                    (n_moves + n_leads) == 0,
+                    lambda s: _swap_branch_batched(env, s, goal, prev_goals,
+                                                   params,
+                                                   goal.broker_severity(env, s)),
+                    lambda s: (s, jnp.int32(0)), st)
 
-            lscore = jnp.asarray(lscore, jnp.float32)
-            sscore = jnp.asarray(sscore, jnp.float32)
-            no_move = n_moves == 0
-            do_lead = no_move & (lscore >= sscore) & (lscore > params.min_gain)
-            do_swap = no_move & (~do_lead) & (sscore > params.min_gain)
-
-            st = jax.lax.cond(
-                do_lead,
-                lambda s: apply_leadership(env, s, lsrc, ldst),
-                lambda s: jax.lax.cond(
-                    do_swap,
-                    lambda s2: apply_swap(env, s2, sout, sin_),
-                    lambda s2: s2, s),
-                st_moved)
-            applied = n_moves + do_lead.astype(jnp.int32) + do_swap.astype(jnp.int32)
+            applied = n_moves + n_leads + n_swaps
             progress = applied > 0
             return st, it + 1, n_applied + applied, progress
 
@@ -225,10 +264,15 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple, params: En
             _st, it, _n, progress = carry
             return progress & (it < params.max_iters)
 
-        st, _iters, n_applied, _ = jax.lax.while_loop(
+        st, iters, n_applied, progress = jax.lax.while_loop(
             cond_fn, step, (st, jnp.int32(0), jnp.int32(0), jnp.bool_(True)))
         violated = goal.violated(env, st)
-        return st, {"iterations": n_applied, "violated_after": violated,
+        # progress still true at the iteration cap = budget exhausted, NOT
+        # converged — downstream must not treat the state as final
+        hit_max_iters = progress & (iters >= params.max_iters)
+        return st, {"iterations": n_applied, "passes": iters,
+                    "violated_after": violated,
+                    "hit_max_iters": hit_max_iters,
                     "stat": goal.stat(env, st)}
 
     return run

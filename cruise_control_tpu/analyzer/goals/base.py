@@ -121,6 +121,31 @@ class GoalKernel:
         return jnp.sum(jnp.maximum(self.broker_severity(env, st), 0.0))
 
 
+def rank_within_broker(broker: Array, value: Array) -> Array:
+    """i32[R]: dense rank (0 = first) of each replica among the replicas of
+    its own broker, ordered by descending ``value``.
+
+    Used to SPREAD top-k candidate selection across source brokers: keys of
+    the form ``-rank + tiebreak`` put every broker's best replica ahead of any
+    broker's second-best, so one pathological broker cannot monopolize the
+    candidate set (the tensor analogue of the reference's per-broker
+    rebalancing loop visiting each broker, AbstractGoal.java:98-103).
+
+    Two stable argsorts (sort by value, then stably by broker) produce a
+    (broker, value-desc) grouping without composite integer keys — avoids
+    int32 overflow at B*R scale with x64 disabled.
+    """
+    idx = jnp.arange(broker.shape[0])
+    order1 = jnp.argsort(-value)                    # value desc (stable)
+    order = order1[jnp.argsort(broker[order1])]     # broker asc, value desc
+    sb = broker[order]
+    is_start = jnp.concatenate([jnp.ones(1, bool), sb[1:] != sb[:-1]])
+    group_start = jax.lax.associative_scan(jnp.maximum,
+                                           jnp.where(is_start, idx, 0))
+    rank_sorted = idx - group_start
+    return jnp.zeros_like(idx).at[order].set(rank_sorted).astype(jnp.int32)
+
+
 def candidate_load(env: ClusterEnv, st: EngineState, cand: Array) -> Array:
     """f32[K, M] current effective load rows of the candidate replicas."""
     lead = st.replica_is_leader[cand][:, None]

@@ -190,3 +190,8 @@ class ReplicaCapacityGoal(GoalKernel):
     def accept_move(self, env: ClusterEnv, st: EngineState, cand):
         ok = (st.replica_count[None, :] + 1) <= self._max()
         return jnp.broadcast_to(ok, (cand.shape[0], env.num_brokers))
+
+    def accept_swap(self, env: ClusterEnv, st: EngineState, cand_out, cand_in):
+        """Swaps are count-neutral -> always accepted
+        (ReplicaCapacityGoal.java:76 INTER_BROKER_REPLICA_SWAP: ACCEPT)."""
+        return jnp.ones((cand_out.shape[0], cand_in.shape[0]), bool)

@@ -27,7 +27,9 @@ import jax.numpy as jnp
 from cruise_control_tpu.analyzer.env import (
     BALANCE_MARGIN, ClusterEnv, resource_balance_limits,
 )
-from cruise_control_tpu.analyzer.goals.base import NEG_INF, GoalKernel, candidate_load
+from cruise_control_tpu.analyzer.goals.base import (
+    NEG_INF, GoalKernel, candidate_load, rank_within_broker,
+)
 from cruise_control_tpu.analyzer.goals.capacity import RESOURCE_EPS
 from cruise_control_tpu.analyzer.state import EngineState
 
@@ -82,14 +84,29 @@ class ResourceDistributionGoal(GoalKernel):
     def replica_key(self, env: ClusterEnv, st: EngineState, severity):
         lower, upper = self._limits(env, st)
         util = st.util[:, self.resource]
-        excess_src = (util - upper)[st.replica_broker] > RESOURCE_EPS[self.resource]
-        any_deficit = jnp.any((lower - util) > RESOURCE_EPS[self.resource])
+        eps = RESOURCE_EPS[self.resource]
+        excess_src = (util - upper)[st.replica_broker] > eps
+        any_deficit = jnp.any((lower - util) > eps)
         load = st.effective_load(env)[:, self.resource]
         # donors for move-in: any broker that can shed without going deficient
         donor = (util[st.replica_broker] - load) >= lower[st.replica_broker]
-        movable = env.replica_valid & (load > 0) & (excess_src | (any_deficit & donor))
+        # only replicas that can actually LAND somewhere: a replica larger
+        # than every destination's remaining band headroom scores -inf for all
+        # dsts, and a top-k full of such replicas stalls the goal — filter
+        # them out so smaller, feasible replicas become candidates instead
+        headroom = jnp.where(env.dst_candidate, upper - util, NEG_INF)
+        fits = load <= jnp.max(headroom) + eps
+        movable = (env.replica_valid & (load > 0) & fits
+                   & (excess_src | (any_deficit & donor)))
         offline = st.replica_offline & env.replica_valid
-        key = jnp.where(movable | offline, load, NEG_INF)
+        # spread candidates across source brokers (largest replica of every
+        # violating broker before any broker's second-largest); rank over the
+        # ELIGIBLE set only, so padded/ineligible replicas can't displace a
+        # broker's real candidates
+        rank_val = jnp.where(movable | offline, load, NEG_INF)
+        rank = rank_within_broker(st.replica_broker, rank_val).astype(jnp.float32)
+        tiebreak = load / (jnp.max(load) + 1e-9)      # in (0, 1]
+        key = jnp.where(movable | offline, tiebreak - rank, NEG_INF)
         return jnp.where(offline, key + 1e12, key)
 
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
@@ -296,8 +313,12 @@ class ReplicaDistributionGoal(GoalKernel):
         load = jnp.sum(st.effective_load(env), axis=1)
         movable = env.replica_valid & (over | (any_deficit & donor))
         offline = st.replica_offline & env.replica_valid
-        # prefer light replicas: less data moved per count unit
-        key = jnp.where(movable | offline, -load, NEG_INF)
+        # spread across source brokers; prefer light replicas within a broker
+        # (less data moved per count unit); rank over the eligible set only
+        rank_val = jnp.where(movable | offline, -load, NEG_INF)
+        rank = rank_within_broker(st.replica_broker, rank_val).astype(jnp.float32)
+        tiebreak = 1.0 - load / (jnp.max(load) + 1e-9)
+        key = jnp.where(movable | offline, tiebreak - rank, NEG_INF)
         return jnp.where(offline, key + 1e12, key)
 
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
@@ -319,6 +340,11 @@ class ReplicaDistributionGoal(GoalKernel):
         dst_ok = c[None, :] + 1 <= upper[None, :]
         src_ok = ((c[src] - 1 >= lower[src]) | (c[src] > upper[src]))[:, None]
         return dst_ok & src_ok
+
+    def accept_swap(self, env: ClusterEnv, st: EngineState, cand_out, cand_in):
+        """Swaps are count-neutral -> always accepted
+        (ReplicaDistributionGoal.java:122 INTER_BROKER_REPLICA_SWAP: ACCEPT)."""
+        return jnp.ones((cand_out.shape[0], cand_in.shape[0]), bool)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -382,8 +408,12 @@ class LeaderReplicaDistributionGoal(GoalKernel):
         over = (c - upper)[st.replica_broker] > 0
         nw = env.leader_load[:, 2] - env.follower_load[:, 2]
         ok = env.replica_valid & st.replica_is_leader & over & ~st.replica_offline
-        # prefer transferring leadership of light partitions (cheap)
-        return jnp.where(ok, -nw, NEG_INF)
+        # spread across source brokers; light partitions first within a broker
+        # (rank over the eligible set only)
+        rank_val = jnp.where(ok, -nw, NEG_INF)
+        rank = rank_within_broker(st.replica_broker, rank_val).astype(jnp.float32)
+        tiebreak = 1.0 - nw / (jnp.max(jnp.abs(nw)) + 1e-9)
+        return jnp.where(ok, tiebreak - rank, NEG_INF)
 
     def leadership_score(self, env: ClusterEnv, st: EngineState, cand):
         members = env.partition_replicas[env.replica_partition[cand]]

@@ -26,26 +26,45 @@ class PreferredLeaderElectionGoal(GoalKernel):
         return jnp.zeros(env.num_brokers)
 
     def violated(self, env: ClusterEnv, st: EngineState):
+        # topic exclusion is intentionally ignored: this goal moves no
+        # partitions (PreferredLeaderElectionGoal.java:109 comment)
         pref = self._preferred_leader(env, st)
         cur = self._current_leader(env, st)
         has = jnp.any(env.partition_replicas >= 0, axis=1)
-        # excluded topics are untouchable by apply(), so they don't count
-        fixable = ~env.topic_excluded[env.partition_topic]
-        return jnp.any(has & fixable & (pref >= 0) & (pref != cur))
+        return jnp.any(has & (pref >= 0) & (pref != cur))
 
     def _preferred_leader(self, env: ClusterEnv, st: EngineState):
-        """i32[P]: replica index of the preferred (position-0-most) eligible
-        replica, -1 if none eligible."""
+        """i32[P]: replica index leadership should land on, -1 for no change.
+
+        Mirrors PreferredLeaderElectionGoal.java:108-152: with no demoted
+        broker in the cluster only the position-0 replica is considered (break
+        after i==0); when demotion is in progress, demoted replicas are pushed
+        to the end of the replica list and only partitions hosting a demoted
+        replica are touched — the first eligible (alive, online, not
+        leadership-excluded) replica in that reordered list wins, which may be
+        a demoted broker if every alive replica is demoted.
+        """
         members = env.partition_replicas                       # [P, F]
+        P, F = members.shape
         m = jnp.clip(members, 0)
         b = st.replica_broker[m]
-        eligible = ((members >= 0) & env.broker_alive[b] & ~env.broker_demoted[b]
+        valid = members >= 0
+        eligible = (valid & env.broker_alive[b]
                     & ~env.broker_excluded_for_leadership[b] & ~st.replica_offline[m])
-        # first eligible position
-        first = jnp.argmax(eligible, axis=1)
+        demoted = valid & env.broker_demoted[b]
+        demotion_in_progress = jnp.any(env.broker_demoted)
+
+        # demotion mode: demoted replicas sort after the rest, first eligible wins
+        pos = jnp.broadcast_to(jnp.arange(F)[None, :], (P, F))
+        order = jnp.where(eligible, pos + jnp.where(demoted, F, 0), 2 * F + 1)
+        first = jnp.argmin(order, axis=1)
         any_ok = jnp.any(eligible, axis=1)
-        pref = members[jnp.arange(members.shape[0]), first]
-        return jnp.where(any_ok, pref, -1)
+        pref_demo = jnp.where(any_ok & jnp.any(demoted, axis=1),
+                              m[jnp.arange(P), first], -1)
+
+        # steady state: position-0 replica only
+        pref_pos0 = jnp.where(eligible[:, 0] & ~(demoted[:, 0]), m[:, 0], -1)
+        return jnp.where(demotion_in_progress, pref_demo, pref_pos0)
 
     def _current_leader(self, env: ClusterEnv, st: EngineState):
         members = env.partition_replicas
@@ -60,8 +79,6 @@ class PreferredLeaderElectionGoal(GoalKernel):
         pref = self._preferred_leader(env, st)
         cur = self._current_leader(env, st)
         do = (pref >= 0) & (cur >= 0) & (pref != cur)
-        # excluded topics keep their leadership untouched
-        do = do & ~env.topic_excluded[env.partition_topic]
         # scatter only the partitions actually flipping: inactive rows target
         # index R and are dropped, so they can't clobber replica 0
         R = st.replica_is_leader.shape[0]

@@ -48,7 +48,9 @@ class RackAwareGoal(GoalKernel):
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
         p = env.replica_partition[cand]
         rack_dst = env.broker_rack[None, :]                                  # [1, B]
-        dst_rack_count = st.part_rack_count[p[:, None], rack_dst]            # [K, B]
+        # row-gather then take-along-axis: a direct [K, B] fancy gather from
+        # the [P, Kr] table materializes poorly inside the engine loop
+        dst_rack_count = st.part_rack_count[p][:, env.broker_rack]           # [K, B]
         cur_rack = env.broker_rack[st.replica_broker[cand]][:, None]
         same_rack = rack_dst == cur_rack
         # count of partition replicas in destination rack, excluding self
@@ -57,7 +59,10 @@ class RackAwareGoal(GoalKernel):
         # prefer low-utilization destinations (balance tiebreak)
         cap = jnp.maximum(jnp.sum(env.broker_capacity, axis=1), 1e-6)
         util_frac = jnp.sum(st.util, axis=1) / cap
-        was_violating = (_replica_corack_count(env, st)[cand] > 0) | st.replica_offline[cand]
+        # per-candidate corack count (NOT the full [R] gather: move_score runs
+        # once per applied move inside the engine's re-scoring loop)
+        corack = st.part_rack_count[p, cur_rack[:, 0]] - 1                   # [K]
+        was_violating = (corack > 0) | st.replica_offline[cand]
         score = 1.0 + 0.5 * (1.0 - util_frac)[None, :]
         return jnp.where(feasible & was_violating[:, None], score, NEG_INF)
 
@@ -65,7 +70,7 @@ class RackAwareGoal(GoalKernel):
         """Veto moves that would co-locate partition replicas in one rack."""
         p = env.replica_partition[cand]
         rack_dst = env.broker_rack[None, :]
-        dst_rack_count = st.part_rack_count[p[:, None], rack_dst]
+        dst_rack_count = st.part_rack_count[p][:, env.broker_rack]
         cur_rack = env.broker_rack[st.replica_broker[cand]][:, None]
         others = dst_rack_count - jnp.where(rack_dst == cur_rack, 1, 0)
         return others == 0
@@ -111,27 +116,32 @@ class RackAwareDistributionGoal(GoalKernel):
         key = jnp.where(viol | offline, -load, NEG_INF)
         return jnp.where(offline, key + 1e12, key)
 
+    def _max_per_rack_for(self, env: ClusterEnv, p):
+        """i32[K] per-candidate rack limit (avoids the full [P] computation in
+        the engine's per-move re-scoring loop)."""
+        rf = jnp.sum(env.partition_replicas[p] >= 0, axis=1)                 # [K]
+        return jnp.ceil(rf / jnp.maximum(env.num_racks, 1)).astype(jnp.int32)
+
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
         p = env.replica_partition[cand]
-        limit = self._max_per_rack(env)[p][:, None]                          # [K, 1]
+        limit = self._max_per_rack_for(env, p)[:, None]                      # [K, 1]
         rack_dst = env.broker_rack[None, :]
-        dst_count = st.part_rack_count[p[:, None], rack_dst]
+        dst_count = st.part_rack_count[p][:, env.broker_rack]
         cur_rack = env.broker_rack[st.replica_broker[cand]][:, None]
         others = dst_count - jnp.where(rack_dst == cur_rack, 1, 0)
         feasible = others + 1 <= limit
         cap = jnp.maximum(jnp.sum(env.broker_capacity, axis=1), 1e-6)
         util_frac = jnp.sum(st.util, axis=1) / cap
-        rack = env.broker_rack[st.replica_broker[cand]]
-        was_violating = ((st.part_rack_count[p, rack] > self._max_per_rack(env)[p])
+        was_violating = ((st.part_rack_count[p, cur_rack[:, 0]] > limit[:, 0])
                          | st.replica_offline[cand])
         score = 1.0 + 0.5 * (1.0 - util_frac)[None, :]
         return jnp.where(feasible & was_violating[:, None], score, NEG_INF)
 
     def accept_move(self, env: ClusterEnv, st: EngineState, cand):
         p = env.replica_partition[cand]
-        limit = self._max_per_rack(env)[p][:, None]
+        limit = self._max_per_rack_for(env, p)[:, None]
         rack_dst = env.broker_rack[None, :]
-        dst_count = st.part_rack_count[p[:, None], rack_dst]
+        dst_count = st.part_rack_count[p][:, env.broker_rack]
         cur_rack = env.broker_rack[st.replica_broker[cand]][:, None]
         others = dst_count - jnp.where(rack_dst == cur_rack, 1, 0)
         return others + 1 <= limit

@@ -70,15 +70,35 @@ class TopicReplicaDistributionGoal(GoalKernel):
         key = jnp.where(movable | offline, -load, NEG_INF)
         return jnp.where(offline, key + 1e12, key)
 
+    def _limits_from_avg(self, avg):
+        """Per-topic limits from the topic's per-alive-broker average; same
+        math as _limits but over an already-gathered [K] average, so the
+        per-candidate path never touches the full [T, B] table."""
+        pct = self.constraint.topic_replica_balance_percentage
+        if self.options.triggered_by_goal_violation:
+            pct *= self.constraint.goal_violation_distribution_threshold_multiplier
+        adj = (pct - 1.0) * BALANCE_MARGIN
+        upper = jnp.ceil(avg * (1.0 + adj))
+        lower = jnp.floor(avg * jnp.maximum(0.0, 1.0 - adj))
+        min_gap = self.constraint.topic_replica_balance_min_gap
+        max_gap = self.constraint.topic_replica_balance_max_gap
+        upper = jnp.clip(upper, jnp.ceil(avg) + min_gap, jnp.ceil(avg) + max_gap)
+        lower = jnp.clip(lower, jnp.maximum(0.0, jnp.floor(avg) - max_gap),
+                         jnp.maximum(0.0, jnp.floor(avg) - min_gap))
+        return lower, upper
+
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
-        lower, upper = self._limits(env, st)
-        c = st.topic_broker_count.astype(jnp.float32)
         t = env.replica_topic[cand]
         src = st.replica_broker[cand]
-        c_src = c[t, src][:, None]                                  # [K, 1]
-        c_dst = c[t]                                                # [K, B]
-        lo = lower[t][:, None]
-        up = upper[t][:, None]
+        rows = st.topic_broker_count[t].astype(jnp.float32)         # [K, B]
+        n_alive = jnp.maximum(jnp.sum(env.broker_alive), 1).astype(jnp.float32)
+        # topic totals are invariant under moves -> row sums are exact
+        lower, upper = self._limits_from_avg(jnp.sum(rows, axis=1) / n_alive)
+        K = cand.shape[0]
+        c_src = rows[jnp.arange(K), src][:, None]                   # [K, 1]
+        c_dst = rows                                                # [K, B]
+        lo = lower[:, None]
+        up = upper[:, None]
         excess_red = jnp.minimum(jnp.maximum(c_src - up, 0.0), 1.0)
         deficit_red = jnp.minimum(jnp.maximum(lo - c_dst, 0.0), 1.0)
         new_excess_dst = jnp.maximum(c_dst + 1.0 - up, 0.0)
@@ -91,13 +111,15 @@ class TopicReplicaDistributionGoal(GoalKernel):
                          jnp.where(feasible & (gain > 0), gain, NEG_INF))
 
     def accept_move(self, env: ClusterEnv, st: EngineState, cand):
-        lower, upper = self._limits(env, st)
-        c = st.topic_broker_count.astype(jnp.float32)
         t = env.replica_topic[cand]
         src = st.replica_broker[cand]
-        dst_ok = c[t] + 1.0 <= upper[t][:, None]
-        src_c = c[t, src]
-        src_ok = ((src_c - 1.0 >= lower[t]) | (src_c > upper[t]))[:, None]
+        rows = st.topic_broker_count[t].astype(jnp.float32)         # [K, B]
+        n_alive = jnp.maximum(jnp.sum(env.broker_alive), 1).astype(jnp.float32)
+        lower, upper = self._limits_from_avg(jnp.sum(rows, axis=1) / n_alive)
+        K = cand.shape[0]
+        dst_ok = rows + 1.0 <= upper[:, None]
+        src_c = rows[jnp.arange(K), src]
+        src_ok = ((src_c - 1.0 >= lower) | (src_c > upper))[:, None]
         return dst_ok & src_ok
 
 
@@ -133,10 +155,9 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
 
     # replicas: move leader replicas of min-leader topics toward deficient brokers
     def replica_key(self, env: ClusterEnv, st: EngineState, severity):
-        c = st.topic_leader_count.astype(jnp.float32)
         t = env.replica_topic
         b = st.replica_broker
-        surplus = c[t, b] > float(self._min())
+        surplus = st.topic_leader_count[t, b].astype(jnp.float32) > float(self._min())
         is_min_topic = env.topic_min_leaders[t]
         load = jnp.sum(st.effective_load(env), axis=1)
         movable = (env.replica_valid & st.replica_is_leader & is_min_topic
@@ -145,10 +166,17 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
         key = jnp.where(movable | offline, -load, NEG_INF)
         return jnp.where(offline, key + 1e12, key)
 
+    def _deficit_rows(self, env: ClusterEnv, st: EngineState, t):
+        """f32[K, B] deficit rows for candidate topics (gather-first: never
+        materializes a full [T, B] float table in per-candidate paths)."""
+        c = st.topic_leader_count[t].astype(jnp.float32)            # [K, B]
+        need = jnp.where(env.topic_min_leaders[t][:, None]
+                         & self._eligible(env)[None, :], float(self._min()), 0.0)
+        return jnp.maximum(need - c, 0.0)
+
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
-        deficit = self._deficit(env, st)                            # [T, B]
         t = env.replica_topic[cand]
-        gain = jnp.minimum(deficit[t], 1.0)                         # [K, B]
+        gain = jnp.minimum(self._deficit_rows(env, st, t), 1.0)     # [K, B]
         offline = st.replica_offline[cand]
         heal = jnp.ones_like(gain)
         return jnp.where(offline[:, None], heal,
@@ -157,20 +185,19 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
     def accept_move(self, env: ClusterEnv, st: EngineState, cand):
         """Veto moving a leader of a min-leader topic off a broker that would
         drop below the minimum."""
-        c = st.topic_leader_count.astype(jnp.float32)
         t = env.replica_topic[cand]
         src = st.replica_broker[cand]
+        c_ts = st.topic_leader_count[t, src].astype(jnp.float32)    # [K]
         guarded = (env.topic_min_leaders[t] & st.replica_is_leader[cand]
                    & self._eligible(env)[src])
-        src_ok = (c[t, src] - 1.0 >= float(self._min())) | ~guarded
+        src_ok = (c_ts - 1.0 >= float(self._min())) | ~guarded
         return jnp.broadcast_to(src_ok[:, None], (cand.shape[0], env.num_brokers))
 
     # leadership: grant leadership to followers on deficient brokers
     def leader_key(self, env: ClusterEnv, st: EngineState, severity):
-        c = st.topic_leader_count.astype(jnp.float32)
         t = env.replica_topic
         b = st.replica_broker
-        surplus = c[t, b] > float(self._min())
+        surplus = st.topic_leader_count[t, b].astype(jnp.float32) > float(self._min())
         ok = (env.replica_valid & st.replica_is_leader & env.topic_min_leaders[t]
               & surplus & ~st.replica_offline)
         return jnp.where(ok, 1.0, NEG_INF)
@@ -179,15 +206,16 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
         members = env.partition_replicas[env.replica_partition[cand]]
         m = jnp.clip(members, 0)
         dst_broker = st.replica_broker[m]
-        deficit = self._deficit(env, st)
         t = env.replica_topic[cand]
-        gain = jnp.minimum(deficit[t[:, None], dst_broker], 1.0)
+        rows = self._deficit_rows(env, st, t)                       # [K, B]
+        K = cand.shape[0]
+        gain = jnp.minimum(rows[jnp.arange(K)[:, None], dst_broker], 1.0)
         return jnp.where(gain > 0, gain, NEG_INF)
 
     def accept_leadership(self, env: ClusterEnv, st: EngineState, cand):
-        c = st.topic_leader_count.astype(jnp.float32)
         t = env.replica_topic[cand]
         src = st.replica_broker[cand]
+        c_ts = st.topic_leader_count[t, src].astype(jnp.float32)    # [K]
         guarded = env.topic_min_leaders[t] & self._eligible(env)[src]
-        src_ok = (c[t, src] - 1.0 >= float(self._min())) | ~guarded
+        src_ok = (c_ts - 1.0 >= float(self._min())) | ~guarded
         return jnp.broadcast_to(src_ok[:, None], (cand.shape[0], env.max_rf))

@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from functools import lru_cache
 
+import jax
 import numpy as np
 
 from cruise_control_tpu.analyzer.engine import EngineParams, optimize_goal
@@ -26,7 +28,7 @@ from cruise_control_tpu.analyzer.goals import make_goals
 from cruise_control_tpu.analyzer.goals.leader_election import PreferredLeaderElectionGoal
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal, diff_proposals
 from cruise_control_tpu.analyzer.state import EngineState, init_state
-from cruise_control_tpu.model.cluster_tensor import ClusterMeta, ClusterTensor
+from cruise_control_tpu.model.cluster_tensor import ClusterMeta, ClusterTensor, pad_cluster
 
 # balancedness weights (AnalyzerConfig goal.balancedness.{priority,strictness}.weight)
 BALANCEDNESS_PRIORITY_WEIGHT = 1.1
@@ -46,6 +48,7 @@ class GoalResult:
     iterations: int
     duration_s: float
     stat_after: float
+    hit_max_iters: bool = False   # iteration budget exhausted while progressing
 
 
 @dataclasses.dataclass
@@ -84,6 +87,7 @@ class OptimizerResult:
             "goalSummary": [
                 {"goal": g.name, "status": "VIOLATED" if g.violated_after else "NO-ACTION"
                  if not g.iterations else "FIXED", "iterations": g.iterations,
+                 "budgetExhausted": g.hit_max_iters,
                  "durationSec": round(g.duration_s, 4)}
                 for g in self.goal_results
             ],
@@ -104,6 +108,28 @@ def _balancedness(goals, results_violated: dict) -> float:
             got += w
         weight *= BALANCEDNESS_PRIORITY_WEIGHT
     return 100.0 * got / total if total else 100.0
+
+
+@lru_cache(maxsize=256)
+def _compiled_violations(goals_tuple: tuple):
+    """One jitted program evaluating every goal's violated() — replaces G
+    eager per-goal evaluations (each dozens of dispatched host ops)."""
+    @jax.jit
+    def f(env, st):
+        return [g.violated(env, st) for g in goals_tuple]
+    return f
+
+
+@lru_cache(maxsize=16)
+def _compiled_ple(ple):
+    """Jitted PreferredLeaderElectionGoal pass: (violated-before, new state,
+    violated-after) in one compiled program."""
+    @jax.jit
+    def f(env, st):
+        was = ple.violated(env, st)
+        st2 = ple.apply(env, st)
+        return was, st2, ple.violated(env, st2)
+    return f
 
 
 class GoalOptimizer:
@@ -136,7 +162,7 @@ class GoalOptimizer:
                       goal_names: list[str] | None = None,
                       options: OptimizationOptions = OptimizationOptions(),
                       skip_hard_goal_check: bool = False,
-                      raise_on_failure: bool = False) -> OptimizerResult:
+                      raise_on_failure: bool = True) -> OptimizerResult:
         names = goal_names or self._default_goal_names
         # honour hard-goal enforcement (KafkaCruiseControl sanityCheckHardGoalPresence)
         if goal_names and not skip_hard_goal_check:
@@ -150,53 +176,84 @@ class GoalOptimizer:
         goals = make_goals(known, self._constraint, options)
         run_preferred = "PreferredLeaderElectionGoal" in names
 
+        # bucket-pad shapes so similar clusters share compiled engine programs
+        ct, meta = pad_cluster(ct, meta)
+        # scale the candidate set with cluster size: a pass lands up to K
+        # moves, so K ~ B/8 keeps pass count (and wall clock) roughly flat
+        params = dataclasses.replace(
+            self._params,
+            num_candidates=min(512, max(self._params.num_candidates,
+                                        ct.num_brokers // 8)),
+            num_leader_candidates=min(512, max(self._params.num_leader_candidates,
+                                               ct.num_brokers // 8)))
+
         env = make_env(ct, meta)
         st = init_state(env, ct.replica_broker, ct.replica_is_leader,
                         ct.replica_offline, ct.replica_disk)
-        initial_broker = np.asarray(st.replica_broker).copy()
-        initial_leader = np.asarray(st.replica_is_leader).copy()
-        initial_disk = np.asarray(st.replica_disk).copy()
+        # ONE device->host batch for everything needed up front: each
+        # individual sync (bool()/np.asarray) is a full round-trip, which
+        # dominates wall clock on a tunneled/remote device
+        initial_broker, initial_leader, initial_disk = (
+            jax.device_get((st.replica_broker, st.replica_is_leader,
+                            st.replica_disk)))
         stats_before = cluster_stats_state(env, st)
-        violated_before = {g.name: bool(g.violated(env, st)) for g in goals}
+        viol0 = jax.device_get(_compiled_violations(tuple(goals))(env, st))
+        violated_before = {g.name: bool(v) for g, v in zip(goals, viol0)}
 
-        goal_results: list[GoalResult] = []
+        infos = []
+        durations = []
         prev: list = []
         for g in goals:
             t0 = time.monotonic()
-            st, info = optimize_goal(env, st, g, tuple(prev), self._params)
-            dur = time.monotonic() - t0
-            goal_results.append(GoalResult(
+            st, info = optimize_goal(env, st, g, tuple(prev), params)
+            jax.block_until_ready(st.util)   # dispatch is async: time honestly
+            durations.append(time.monotonic() - t0)
+            infos.append(info)               # stays on device until one batch get
+            prev.append(g)
+
+        if run_preferred:
+            ple = PreferredLeaderElectionGoal(constraint=self._constraint, options=options)
+            t0 = time.monotonic()
+            was, st, still = _compiled_ple(ple)(env, st)
+            jax.block_until_ready(st.replica_is_leader)
+            ple_dur = time.monotonic() - t0
+
+        infos = jax.device_get(infos)
+        goal_results = [
+            GoalResult(
                 name=g.name,
                 violated_before=violated_before[g.name],
                 violated_after=bool(info["violated_after"]),
                 iterations=int(info["iterations"]),
                 duration_s=dur,
                 stat_after=float(info["stat"]),
-            ))
-            prev.append(g)
-
+                hit_max_iters=bool(info.get("hit_max_iters", False)),
+            )
+            for g, info, dur in zip(goals, infos, durations)
+        ]
         if run_preferred:
-            ple = PreferredLeaderElectionGoal(constraint=self._constraint, options=options)
-            t0 = time.monotonic()
-            was = bool(ple.violated(env, st))
-            st = ple.apply(env, st)
+            was, still = jax.device_get((was, still))
             goal_results.append(GoalResult(
-                name="PreferredLeaderElectionGoal", violated_before=was,
-                violated_after=bool(ple.violated(env, st)), iterations=1 if was else 0,
-                duration_s=time.monotonic() - t0, stat_after=0.0))
+                name="PreferredLeaderElectionGoal", violated_before=bool(was),
+                violated_after=bool(still), iterations=1 if bool(was) else 0,
+                duration_s=ple_dur, stat_after=0.0))
 
         stats_after = cluster_stats_state(env, st)
+        from cruise_control_tpu.common.resources import Resource
+        final_broker, final_leader, final_disk, moved_mask, disk_load = (
+            jax.device_get((st.replica_broker, st.replica_is_leader,
+                            st.replica_disk, st.moved,
+                            env.leader_load[:, Resource.DISK])))
         proposals = diff_proposals(env, meta, initial_broker, initial_leader,
-                                   initial_disk, st)
+                                   initial_disk, st,
+                                   final=(final_broker, final_leader, final_disk))
         n_moves = sum(len(p.replicas_to_add) for p in proposals)
         n_lead = sum(1 for p in proposals if p.has_leader_action)
-        from cruise_control_tpu.common.resources import Resource
-        disk_load = np.asarray(env.leader_load[:, Resource.DISK])
-        moved_mask = np.asarray(st.moved)
         data_mb = float(disk_load[moved_mask].sum())
 
         if raise_on_failure:
-            failed = [r.name for r, g in zip(goal_results, goals)
+            failed = [r.name + (" (iteration budget exhausted)" if r.hit_max_iters else "")
+                      for r, g in zip(goal_results, goals)
                       if g.is_hard and r.violated_after]
             if failed:
                 raise OptimizationFailureError(
@@ -218,9 +275,11 @@ class GoalOptimizer:
 
 def cluster_stats_state(env: ClusterEnv, st: EngineState) -> dict:
     """Stats over the engine state (same fields as model.cluster_stats)."""
-    alive = np.asarray(env.broker_alive)
-    util = np.asarray(st.util)[alive]
-    counts = np.asarray(st.replica_count)[alive]
+    alive, util, counts, pot, offline, valid = jax.device_get(
+        (env.broker_alive, st.util, st.replica_count, st.potential_nw_out,
+         st.replica_offline, env.replica_valid))
+    util = util[alive]
+    counts = counts[alive]
     return {
         "avg": util.mean(axis=0).tolist() if util.size else [],
         "max": util.max(axis=0).tolist() if util.size else [],
@@ -229,8 +288,6 @@ def cluster_stats_state(env: ClusterEnv, st: EngineState) -> dict:
         "replica_count_avg": float(counts.mean()) if counts.size else 0.0,
         "replica_count_max": int(counts.max()) if counts.size else 0,
         "replica_count_std": float(counts.std()) if counts.size else 0.0,
-        "potential_nw_out_max": float(np.asarray(st.potential_nw_out)[alive].max())
-            if alive.any() else 0.0,
-        "num_offline_replicas": int((np.asarray(st.replica_offline)
-                                     & np.asarray(env.replica_valid)).sum()),
+        "potential_nw_out_max": float(pot[alive].max()) if alive.any() else 0.0,
+        "num_offline_replicas": int((offline & valid).sum()),
     }

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import numpy as np
 
 from cruise_control_tpu.analyzer.env import ClusterEnv
@@ -62,21 +63,27 @@ class ExecutionProposal:
 
 def diff_proposals(env: ClusterEnv, meta: ClusterMeta,
                    initial_broker: np.ndarray, initial_leader: np.ndarray,
-                   initial_disk: np.ndarray, st: EngineState) -> list[ExecutionProposal]:
-    """Compare assignments and emit one proposal per changed partition."""
-    final_broker = np.asarray(st.replica_broker)
-    final_leader = np.asarray(st.replica_is_leader)
-    final_disk = np.asarray(st.replica_disk)
+                   initial_disk: np.ndarray, st: EngineState,
+                   final: tuple | None = None) -> list[ExecutionProposal]:
+    """Compare assignments and emit one proposal per changed partition.
+
+    ``final`` lets the caller pass already-fetched (broker, leader, disk) host
+    arrays to avoid extra device round-trips.
+    """
+    if final is not None:
+        final_broker, final_leader, final_disk = (np.asarray(a) for a in final)
+    else:
+        final_broker, final_leader, final_disk = jax.device_get(
+            (st.replica_broker, st.replica_is_leader, st.replica_disk))
     initial_broker = np.asarray(initial_broker)
     initial_leader = np.asarray(initial_leader)
     initial_disk = np.asarray(initial_disk)
-    members_table = np.asarray(env.partition_replicas)
+    members_table, valid, part_of = jax.device_get(
+        (env.partition_replicas, env.replica_valid, env.replica_partition))
     broker_ids = np.asarray(meta.broker_ids)
 
     changed_r = (final_broker != initial_broker) | (final_leader != initial_leader) \
         | (final_disk != initial_disk)
-    valid = np.asarray(env.replica_valid)
-    part_of = np.asarray(env.replica_partition)
     changed_parts = np.unique(part_of[changed_r & valid])
 
     proposals: list[ExecutionProposal] = []

@@ -72,6 +72,7 @@ def init_state(env: ClusterEnv, replica_broker: Array, replica_is_leader: Array,
     return refresh(env, st)
 
 
+@jax.jit
 def refresh(env: ClusterEnv, st: EngineState) -> EngineState:
     """Recompute all derived state from the assignment (ground truth)."""
     B = env.num_brokers

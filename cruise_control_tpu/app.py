@@ -268,7 +268,9 @@ class CruiseControl:
                     and self._proposal_cache_generation == gen):
                 return self._proposal_cache
         ct, meta = self._model()
-        res = self.goal_optimizer.optimizations(ct, meta)
+        # the precompute path records violations instead of failing the cache
+        # refresh (GoalOptimizer.java precompute thread logs + retries)
+        res = self.goal_optimizer.optimizations(ct, meta, raise_on_failure=False)
         with self._cache_lock:
             self._proposal_cache = res
             self._proposal_cache_generation = gen

@@ -43,10 +43,12 @@ class GoalViolationDetector:
             ct, meta = self._monitor.cluster_model()
         except NotEnoughValidWindowsError:
             return []   # not enough data yet — detector skips this round
+        # raise_on_failure=False: the detector *assesses* violations — an
+        # unsatisfiable hard goal is a detection outcome, not an error
         res = self._optimizer.optimizations(
             ct, meta, goal_names=self._goals,
             options=OptimizationOptions(triggered_by_goal_violation=True),
-            skip_hard_goal_check=True)
+            skip_hard_goal_check=True, raise_on_failure=False)
         self.last_balancedness = res.balancedness_before
         fixable = [g.name for g in res.goal_results
                    if g.violated_before and not g.violated_after]

@@ -106,3 +106,145 @@ def generate(spec: RandomClusterSpec):
                 b.add_replica(f"topic{t}", p, int(broker), is_leader=(i == 0),
                               load=load, logdir=logdir)
     return b.build()
+
+
+def generate_scale(spec: RandomClusterSpec):
+    """Vectorized generator for the BASELINE scale ladder (1k/100k, 7k/1M).
+
+    Same knobs and semantics as :func:`generate` (RandomCluster.java:53
+    analogue) but builds the ClusterTensor arrays directly with numpy — the
+    per-replica builder path is O(R) Python and takes minutes at the
+    1M-replica north star.
+
+    Placement draws each partition's rf brokers from a (optionally skewed)
+    categorical distribution, re-drawing any within-partition duplicates; with
+    B >> rf the redraw loop converges in a handful of vectorized rounds.
+    """
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.model.cluster_tensor import ClusterMeta, ClusterTensor
+
+    rng = np.random.default_rng(spec.seed)
+    B = spec.num_brokers
+    M = 4
+
+    # ---- topics / partitions ----
+    popularity = rng.exponential(1.0, spec.num_topics)
+    popularity /= popularity.sum()
+    parts_per_topic = np.maximum(1, np.round(popularity * spec.num_partitions).astype(int))
+    P = int(parts_per_topic.sum())
+    partition_topic = np.repeat(np.arange(spec.num_topics, dtype=np.int32),
+                                parts_per_topic)
+    rf_per_topic = rng.integers(spec.min_replication, spec.max_replication + 1,
+                                spec.num_topics)
+    rf_per_topic = np.minimum(rf_per_topic, B)
+    rf_per_part = rf_per_topic[partition_topic]                  # [P]
+    R = int(rf_per_part.sum())
+    F = int(rf_per_part.max())
+
+    # ---- per-replica partition / topic / leadership ----
+    replica_partition = np.repeat(np.arange(P, dtype=np.int32), rf_per_part)
+    replica_topic = partition_topic[replica_partition]
+    first_of_part = np.zeros(R, bool)
+    first_of_part[np.concatenate([[0], np.cumsum(rf_per_part)[:-1]])] = True
+    replica_is_leader = first_of_part
+    pos_in_part = np.arange(R) - np.repeat(
+        np.concatenate([[0], np.cumsum(rf_per_part)[:-1]]), rf_per_part)
+
+    # ---- placement: weighted categorical + duplicate redraw ----
+    if spec.skew > 0:
+        w = np.exp(-spec.skew * np.arange(B) / B)
+        w /= w.sum()
+    else:
+        w = np.full(B, 1.0 / B)
+    replica_broker = rng.choice(B, size=R, p=w).astype(np.int32)
+    # resolve duplicates within a partition: a replica collides if an earlier
+    # position in the same partition already sits on its broker
+    for _ in range(64):
+        key = replica_partition.astype(np.int64) * B + replica_broker
+        order = np.lexsort((pos_in_part, key))
+        sk = key[order]
+        dup_sorted = np.zeros(R, bool)
+        dup_sorted[1:] = sk[1:] == sk[:-1]
+        dup = np.zeros(R, bool)
+        dup[order] = dup_sorted
+        n_dup = int(dup.sum())
+        if n_dup == 0:
+            break
+        replica_broker[dup] = rng.choice(B, size=n_dup, p=w).astype(np.int32)
+    else:
+        raise RuntimeError("placement redraw did not converge")
+
+    # ---- loads (per partition, shared by its replicas) ----
+    loads = np.stack([
+        _sample(rng, spec.distribution, spec.mean_cpu, P),
+        _sample(rng, spec.distribution, spec.mean_nw_in, P),
+        _sample(rng, spec.distribution, spec.mean_nw_out, P),
+        _sample(rng, spec.distribution, spec.mean_disk, P),
+    ], axis=1).astype(np.float32)                                 # [P, M] CPU,NWIN,NWOUT,DISK
+    leader_load = loads[replica_partition]
+    follower_load = leader_load.copy()
+    follower_load[:, Resource.NW_OUT] = 0.0
+    follower_load[:, Resource.CPU] *= 0.5        # builder FOLLOWER_CPU_FRACTION
+
+    # ---- brokers ----
+    dead = np.zeros(B, bool)
+    if spec.num_dead_brokers:
+        dead[rng.choice(B, spec.num_dead_brokers, replace=False)] = True
+    D = spec.logdirs_per_broker
+    disk_cap = np.full((B, D), spec.disk_capacity / D, np.float32)
+    disk_alive = np.ones((B, D), bool) & ~dead[:, None]
+    dead_disk = np.zeros(B, bool)
+    if spec.num_brokers_with_dead_disk:
+        if D < 2:
+            raise ValueError("dead disks require logdirs_per_broker >= 2")
+        pool = np.flatnonzero(~dead)
+        chosen = rng.choice(pool, spec.num_brokers_with_dead_disk, replace=False)
+        dead_disk[chosen] = True
+        disk_alive[chosen, D - 1] = False
+    replica_disk = rng.integers(0, D, R).astype(np.int32)
+    replica_offline = (dead[replica_broker]
+                       | ~disk_alive[replica_broker, replica_disk])
+
+    capacity = np.tile(np.array([[spec.cpu_capacity, spec.nw_in_capacity,
+                                  spec.nw_out_capacity, spec.disk_capacity]],
+                                np.float32), (B, 1))
+
+    ct = ClusterTensor(
+        replica_broker=jnp.asarray(replica_broker),
+        replica_disk=jnp.asarray(replica_disk),
+        replica_partition=jnp.asarray(replica_partition),
+        replica_topic=jnp.asarray(replica_topic),
+        replica_is_leader=jnp.asarray(replica_is_leader),
+        replica_valid=jnp.ones(R, bool),
+        replica_offline=jnp.asarray(replica_offline),
+        replica_original_broker=jnp.asarray(replica_broker.copy()),
+        leader_load=jnp.asarray(leader_load),
+        follower_load=jnp.asarray(follower_load),
+        broker_capacity=jnp.asarray(capacity),
+        broker_rack=jnp.asarray((np.arange(B) % spec.num_racks).astype(np.int32)),
+        broker_alive=jnp.asarray(~dead),
+        broker_new=jnp.zeros(B, bool),
+        broker_demoted=jnp.zeros(B, bool),
+        broker_excluded_for_replica_move=jnp.zeros(B, bool),
+        broker_excluded_for_leadership=jnp.zeros(B, bool),
+        broker_disk_capacity=jnp.asarray(disk_cap),
+        broker_disk_alive=jnp.asarray(disk_alive),
+        topic_excluded=jnp.zeros(spec.num_topics, bool),
+        partition_topic=jnp.asarray(partition_topic),
+    )
+    part_counter = np.zeros(spec.num_topics, np.int64)
+    partition_ids = []
+    for t in partition_topic:
+        partition_ids.append((f"topic{t}", int(part_counter[t])))
+        part_counter[t] += 1
+    meta = ClusterMeta(
+        topic_names=[f"topic{t}" for t in range(spec.num_topics)],
+        partition_ids=partition_ids,
+        broker_ids=list(range(B)),
+        rack_ids=[f"r{k}" for k in range(spec.num_racks)],
+        logdirs=[[f"/mnt/i{d:02d}" for d in range(D)]] * B,
+        num_racks=spec.num_racks,
+        num_valid_replicas=R,
+    )
+    return ct, meta

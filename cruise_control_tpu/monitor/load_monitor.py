@@ -244,16 +244,28 @@ class LoadMonitor:
             id_lin = mdef.info("LEADER_BYTES_IN").metric_id
             id_lout = mdef.info("LEADER_BYTES_OUT").metric_id
             row_of = {e: i for i, e in enumerate(agg.entities)}
+            from cruise_control_tpu.monitor.aggregator.sample_aggregator import (
+                Extrapolation,
+            )
             for tp, info in partitions.items():
                 row = row_of.get(tp)
                 if row is None:
                     cpu = disk = lin = lout = 0.0
                 else:
                     vals = agg.values[row]            # [W, M]
-                    cpu = float(vals[:, id_cpu].mean())
-                    lin = float(vals[:, id_lin].mean())
-                    lout = float(vals[:, id_lout].mean())
-                    disk = float(vals[-1, id_din])    # LATEST
+                    # zero-filled NO_VALID_EXTRAPOLATION windows would dilute
+                    # the mean (and LATEST could read a hole): reduce over
+                    # valid windows only (RawMetricValues.isValid :166 role)
+                    wmask = (agg.extrapolations[row]
+                             != Extrapolation.NO_VALID_EXTRAPOLATION)
+                    if not wmask.any():
+                        cpu = disk = lin = lout = 0.0
+                    else:
+                        v = vals[wmask]
+                        cpu = float(v[:, id_cpu].mean())
+                        lin = float(v[:, id_lin].mean())
+                        lout = float(v[:, id_lout].mean())
+                        disk = float(v[-1, id_din])   # LATEST valid window
                 leader_load = np.zeros(4)
                 leader_load[Resource.CPU] = cpu
                 leader_load[Resource.NW_IN] = lin
