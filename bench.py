@@ -46,7 +46,8 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2) -> dict:
     for i in range(repeats):
         t0 = time.monotonic()
         res = opt.optimizations(ct, meta, goal_names=goal_names,
-                                raise_on_failure=False)
+                                raise_on_failure=False,
+                                skip_hard_goal_check=True)
         walls.append(time.monotonic() - t0)
         log(f"  [{name}] run {i}: {walls[-1]:.2f}s")
     rung = {

@@ -1,0 +1,13 @@
+"""REST API layer: endpoint registry, HTTP server, user task tracking,
+two-step purgatory and security.
+
+Reference: cruise-control/src/main/java/com/linkedin/kafka/cruisecontrol/servlet/
+(KafkaCruiseControlServlet.java dispatch, CruiseControlEndPoint.java enum,
+UserTaskManager.java, purgatory/Purgatory.java, security/).
+"""
+from cruise_control_tpu.api.endpoints import EndPoint, EndpointType
+from cruise_control_tpu.api.server import CruiseControlServer
+from cruise_control_tpu.api.user_tasks import UserTaskManager, TaskState
+
+__all__ = ["EndPoint", "EndpointType", "CruiseControlServer",
+           "UserTaskManager", "TaskState"]

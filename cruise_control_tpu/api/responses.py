@@ -1,0 +1,93 @@
+"""Response schema renderers.
+
+Reference: servlet/response/ (23 classes). Every JSON body carries a
+``version`` field (servlet/response/JsonResponseField.java convention); the
+``/load`` body mirrors ClusterLoad/BrokerStats (response/stats/BrokerStats.java)
+with per-broker and per-host rows.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+JSON_VERSION = 1
+
+
+def wrap(body: dict) -> dict:
+    out = {"version": JSON_VERSION}
+    out.update(body)
+    return out
+
+
+def error_json(message: str, stack_trace: str | None = None) -> dict:
+    out = wrap({"errorMessage": message})
+    if stack_trace:
+        out["stackTrace"] = stack_trace
+    return out
+
+
+def broker_stats_json(ct, meta, populate_disk_info: bool = False,
+                      capacity_only: bool = False) -> dict:
+    """GET /load body (response/stats/BrokerStats.java role).
+
+    Rows: one per broker with leader/follower network split, CPU %, disk MB
+    and percentage-of-capacity columns; plus host-level aggregation (broker ==
+    host here: the tensor model carries no separate host axis)."""
+    from cruise_control_tpu.common.resources import Resource
+
+    cap = np.asarray(ct.broker_capacity, dtype=np.float64)
+    alive = np.asarray(ct.broker_alive)
+    rows = []
+    if capacity_only:
+        util = np.zeros_like(cap)
+        lead_util = util
+        pnw = util
+        nrep = np.zeros(cap.shape[0], dtype=np.int64)
+        nlead = nrep
+    else:
+        util = np.asarray(ct.broker_utilization(), dtype=np.float64)
+        lead_util = np.asarray(ct.broker_leader_utilization(), dtype=np.float64)
+        pnw = np.asarray(ct.potential_leader_load(), dtype=np.float64)
+        nrep = np.asarray(ct.broker_replica_count())
+        nlead = np.asarray(ct.broker_leader_count())
+    disk_cap = np.asarray(ct.broker_disk_capacity, dtype=np.float64)
+    disk_util = (np.asarray(ct.broker_disk_utilization(), dtype=np.float64)
+                 if populate_disk_info and not capacity_only else None)
+
+    for i, bid in enumerate(meta.broker_ids):
+        disk_mb = float(util[i, Resource.DISK])
+        disk_cap_mb = float(cap[i, Resource.DISK])
+        row = {
+            "Broker": int(bid),
+            "Host": f"host-{bid}",
+            "Rack": meta.rack_ids[int(ct.broker_rack[i])],
+            "BrokerState": "ALIVE" if bool(alive[i]) else "DEAD",
+            "DiskMB": round(disk_mb, 3),
+            "DiskPct": round(100.0 * disk_mb / disk_cap_mb, 3) if disk_cap_mb else 0.0,
+            "CpuPct": round(float(util[i, Resource.CPU]), 3),
+            "LeaderNwInRate": round(float(lead_util[i, Resource.NW_IN]), 3),
+            "FollowerNwInRate": round(
+                float(util[i, Resource.NW_IN] - lead_util[i, Resource.NW_IN]), 3),
+            "NwOutRate": round(float(util[i, Resource.NW_OUT]), 3),
+            "PnwOutRate": round(float(pnw[i, Resource.NW_OUT]), 3),
+            "Leaders": int(nlead[i]),
+            "Replicas": int(nrep[i]),
+            # capacity columns make capacity_only responses meaningful
+            "DiskCapacityMB": round(disk_cap_mb, 3),
+            "CpuCapacity": round(float(cap[i, Resource.CPU]), 3),
+            "NwInCapacity": round(float(cap[i, Resource.NW_IN]), 3),
+            "NwOutCapacity": round(float(cap[i, Resource.NW_OUT]), 3),
+        }
+        if disk_util is not None:
+            row["DiskState"] = {
+                meta.logdirs[i][d] if d < len(meta.logdirs[i]) else f"disk-{d}": {
+                    "DiskMB": round(float(disk_util[i, d]), 3),
+                    "DiskPct": round(100.0 * float(disk_util[i, d])
+                                     / float(disk_cap[i, d]), 3)
+                    if disk_cap[i, d] else 0.0,
+                }
+                for d in range(disk_cap.shape[1]) if disk_cap[i, d] > 0
+            }
+        rows.append(row)
+
+    hosts = [dict(r, Host=r["Host"]) for r in rows]  # broker==host aggregation
+    return wrap({"brokers": rows, "hosts": hosts})

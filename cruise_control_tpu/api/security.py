@@ -1,0 +1,98 @@
+"""HTTP security: pluggable provider, basic auth, role-based authorization.
+
+Reference: servlet/security/ — SecurityProvider SPI, BasicSecurityProvider
+(htpasswd-style credential file), DefaultRoleSecurityProvider with roles
+VIEWER/USER/ADMIN, and trusted-proxy support. JWT/SPNEGO providers are
+Jetty-specific and are represented here by the same SPI seam (a provider maps
+request credentials -> (principal, role)); the default deployment is
+unauthenticated, matching the reference's webserver.security.enable=false
+default (WebServerConfig.java).
+
+Role semantics (DefaultRoleSecurityProvider):
+  VIEWER — monitor-type endpoints (STATE, LOAD, PROPOSALS, ...)
+  USER   — viewer + CRUISE_CONTROL_MONITOR admin-reads (REVIEW_BOARD, USER_TASKS)
+  ADMIN  — everything, including KAFKA_ADMIN / CRUISE_CONTROL_ADMIN POSTs.
+"""
+from __future__ import annotations
+
+import base64
+import binascii
+
+from cruise_control_tpu.api.endpoints import EndPoint, EndpointType
+
+ROLE_VIEWER = "VIEWER"
+ROLE_USER = "USER"
+ROLE_ADMIN = "ADMIN"
+_ROLE_RANK = {ROLE_VIEWER: 0, ROLE_USER: 1, ROLE_ADMIN: 2}
+
+
+def required_role(endpoint: EndPoint, method: str) -> str:
+    if method == "POST" or endpoint.endpoint_type in (
+            EndpointType.KAFKA_ADMIN, EndpointType.CRUISE_CONTROL_ADMIN):
+        return ROLE_ADMIN
+    if endpoint in (EndPoint.USER_TASKS, EndPoint.REVIEW_BOARD):
+        return ROLE_USER
+    return ROLE_VIEWER
+
+
+class AuthError(Exception):
+    def __init__(self, message: str, status: int = 401):
+        super().__init__(message)
+        self.status = status
+
+
+class SecurityProvider:
+    """SPI: authenticate a request, returning (principal, role)."""
+
+    def authenticate(self, headers) -> tuple[str, str]:
+        raise NotImplementedError
+
+    def authorize(self, role: str, endpoint: EndPoint, method: str) -> bool:
+        need = required_role(endpoint, method)
+        return _ROLE_RANK.get(role, -1) >= _ROLE_RANK[need]
+
+
+class NoopSecurityProvider(SecurityProvider):
+    """Security disabled: everyone is ADMIN (webserver.security.enable=false)."""
+
+    def authenticate(self, headers) -> tuple[str, str]:
+        return ("anonymous", ROLE_ADMIN)
+
+
+class BasicSecurityProvider(SecurityProvider):
+    """HTTP Basic auth against a credentials map.
+
+    Credentials come from config ``webserver.auth.credentials.file`` with
+    htpasswd-ish lines ``user: password, ROLE`` (the reference's Jetty
+    HashLoginService realm file format).
+    """
+
+    def __init__(self, credentials: dict[str, tuple[str, str]]):
+        self._creds = credentials  # user -> (password, role)
+
+    @classmethod
+    def from_file(cls, path: str) -> "BasicSecurityProvider":
+        creds = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                user, rest = line.split(":", 1)
+                password, role = (x.strip() for x in rest.rsplit(",", 1))
+                creds[user.strip()] = (password, role.upper())
+        return cls(creds)
+
+    def authenticate(self, headers) -> tuple[str, str]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Basic "):
+            raise AuthError("authentication required", 401)
+        try:
+            user, _, password = base64.b64decode(
+                auth[6:].strip()).decode("utf-8").partition(":")
+        except (binascii.Error, UnicodeDecodeError):
+            raise AuthError("malformed Basic credentials", 401) from None
+        entry = self._creds.get(user)
+        if entry is None or entry[0] != password:
+            raise AuthError("bad credentials", 401)
+        return (user, entry[1])

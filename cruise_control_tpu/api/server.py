@@ -1,0 +1,354 @@
+"""HTTP server: endpoint dispatch onto the CruiseControl facade.
+
+Reference: servlet/KafkaCruiseControlServlet.java:40-120 (doGetOrPost
+dispatch), KafkaCruiseControlApp.java:36-62 (server bootstrap; Jetty there,
+stdlib ThreadingHTTPServer here — the control plane is host-side Python, the
+TPU only ever sees the optimizer kernels), handler/sync + handler/async
+(async ops respond 202 + progress until the future completes, resumable via
+the User-Task-ID header), UserTaskManager.java, purgatory/Purgatory.java.
+
+URL shape matches the reference: /kafkacruisecontrol/<endpoint>?... (the
+prefix is optional here).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from cruise_control_tpu.api.endpoints import (
+    ASYNC_ENDPOINTS, GET_ENDPOINTS, POST_ENDPOINTS, EndPoint, ParameterError,
+    parse_params,
+)
+from cruise_control_tpu.api.progress import (
+    GENERATING_CLUSTER_MODEL, OPTIMIZATION_FOR_GOAL, PENDING,
+)
+from cruise_control_tpu.api.purgatory import Purgatory
+from cruise_control_tpu.api.responses import error_json, wrap
+from cruise_control_tpu.api.security import AuthError, NoopSecurityProvider
+from cruise_control_tpu.api.user_tasks import USER_TASK_HEADER_NAME, UserTaskManager
+
+URL_PREFIX = "/kafkacruisecontrol"
+
+
+class CruiseControlServer:
+    """Serves the 20 endpoints over HTTP against a CruiseControl facade."""
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 0,
+                 security_provider=None, two_step_verification: bool = False,
+                 max_block_ms: float = 10_000.0, max_active_user_tasks: int = 25,
+                 completed_user_task_retention_ms: float = 24 * 3600 * 1000.0):
+        self.app = app
+        self.security = security_provider or NoopSecurityProvider()
+        self.two_step = two_step_verification
+        self.purgatory = Purgatory() if two_step_verification else None
+        self.user_tasks = UserTaskManager(
+            max_active_tasks=max_active_user_tasks,
+            completed_task_retention_ms=completed_user_task_retention_ms)
+        self.max_block_ms = max_block_ms
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def base_url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}{URL_PREFIX}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="cc-http", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self.user_tasks.close()
+
+    # ----------------------------------------------------------- dispatch
+    def handle(self, method: str, endpoint: EndPoint, params: dict,
+               client: str, task_id_header: str | None):
+        """Returns (status_code, body_dict, extra_headers)."""
+        headers: dict[str, str] = {}
+
+        # two-step verification: POSTs (except /review) must be reviewed
+        # first. A request resuming an async task via User-Task-ID already
+        # passed review when it was first submitted — re-submitting it to the
+        # purgatory would dead-end the poll (SUBMITTED -> SUBMITTED).
+        reviewed_rid = None
+        if (self.purgatory is not None and method == "POST"
+                and endpoint is not EndPoint.REVIEW
+                and not (endpoint in ASYNC_ENDPOINTS and task_id_header)):
+            rid = params.get("review_id")
+            if rid is None:
+                info = self.purgatory.add(endpoint, params, client)
+                return 202, wrap({"reviewResult": info.to_json()}), headers
+            # only consume the approval (APPROVED -> SUBMITTED) once the
+            # operation is actually dispatched; a failed dispatch stays
+            # APPROVED and can be retried
+            self.purgatory.ensure_approved(rid, endpoint)
+            params = {**self.purgatory.request_params(rid), "review_id": rid}
+            reviewed_rid = rid
+
+        if endpoint in ASYNC_ENDPOINTS:
+            result = self._handle_async(method, endpoint, params, client,
+                                        task_id_header, headers)
+            if reviewed_rid is not None and result[0] in (200, 202):
+                self.purgatory.submit(reviewed_rid, endpoint)
+            return result
+        result = 200, self._run_sync(endpoint, params), headers
+        if reviewed_rid is not None:
+            self.purgatory.submit(reviewed_rid, endpoint)
+        return result
+
+    # ------------------------------------------------------------- async
+    def _handle_async(self, method, endpoint, params, client, task_id_header,
+                      headers):
+        # parameter problems must 400 before a task slot is consumed
+        if endpoint is EndPoint.TOPIC_CONFIGURATION and (
+                not params["topic"] or params["replication_factor"] is None):
+            raise ParameterError(
+                "topic_configuration requires topic and replication_factor")
+        work = self._async_work(endpoint, params)
+        # non-dry-run ops mutate the cluster: a completed one must not be
+        # replayed from the session cache for a fresh request
+        idempotent = method == "GET" or params.get("dryrun", True) is True
+        task = self.user_tasks.get_or_create_task(
+            client, endpoint, method, params, work, task_id=task_id_header,
+            idempotent=idempotent)
+        headers[USER_TASK_HEADER_NAME] = task.task_id
+        try:
+            result = task.future.result(timeout=self.max_block_ms / 1000.0)
+            return 200, result, headers
+        except TimeoutError:
+            return 202, wrap({"progress": task.progress.to_json(),
+                              "operation": endpoint.path}), headers
+        except Exception as e:  # noqa: BLE001 — rendered as the error body
+            return 500, error_json(f"{type(e).__name__}: {e}",
+                                   traceback.format_exc()), headers
+
+    def _async_work(self, endpoint: EndPoint, p: dict):
+        """Build the callable for an async endpoint: runs on the user-task
+        pool, reports progress, returns the response body dict."""
+        app = self.app
+
+        def run(progress):
+            progress.add_step(PENDING)
+            try:
+                if endpoint is EndPoint.LOAD:
+                    progress.add_step(GENERATING_CLUSTER_MODEL)
+                    return app.broker_load_json(
+                        populate_disk_info=p["populate_disk_info"],
+                        capacity_only=p["capacity_only"])
+                if endpoint is EndPoint.PARTITION_LOAD:
+                    progress.add_step(GENERATING_CLUSTER_MODEL)
+                    return wrap({"records": app.partition_load(
+                        sort_by=p["resource"], limit=p["entries"])})
+                if endpoint is EndPoint.PROPOSALS:
+                    progress.add_step(OPTIMIZATION_FOR_GOAL)
+                    res = app.cached_proposals(
+                        force_refresh=p["ignore_proposal_cache"],
+                        goal_names=p["goals"] or None)
+                    return wrap({"summary": res.to_json()})
+                if endpoint is EndPoint.REBALANCE:
+                    progress.add_step(OPTIMIZATION_FOR_GOAL)
+                    return wrap(app.rebalance(
+                        goal_names=p["goals"] or None, dry_run=p["dryrun"],
+                        skip_hard_goal_check=p["skip_hard_goal_check"],
+                        reason=p["reason"] or "rebalance request"))
+                if endpoint is EndPoint.ADD_BROKER:
+                    progress.add_step(OPTIMIZATION_FOR_GOAL)
+                    return wrap(app.add_brokers(
+                        p["brokerid"] or [], dry_run=p["dryrun"],
+                        reason=p["reason"] or "add brokers"))
+                if endpoint is EndPoint.REMOVE_BROKER:
+                    progress.add_step(OPTIMIZATION_FOR_GOAL)
+                    return wrap(app.remove_brokers(
+                        p["brokerid"] or [], dry_run=p["dryrun"],
+                        reason=p["reason"] or "remove brokers"))
+                if endpoint is EndPoint.DEMOTE_BROKER:
+                    progress.add_step(OPTIMIZATION_FOR_GOAL)
+                    return wrap(app.demote_brokers(
+                        p["brokerid"] or [], dry_run=p["dryrun"],
+                        reason=p["reason"] or "demote brokers"))
+                if endpoint is EndPoint.FIX_OFFLINE_REPLICAS:
+                    progress.add_step(OPTIMIZATION_FOR_GOAL)
+                    return wrap(app.fix_offline_replicas(
+                        dry_run=p["dryrun"],
+                        reason=p["reason"] or "fix offline replicas"))
+                if endpoint is EndPoint.TOPIC_CONFIGURATION:
+                    return wrap(app.fix_topic_replication_factor(
+                        {p["topic"]: p["replication_factor"]},
+                        reason=p["reason"] or "topic configuration"))
+                raise AssertionError(f"unhandled async endpoint {endpoint}")
+            finally:
+                progress.finish()
+
+        return run
+
+    # -------------------------------------------------------------- sync
+    def _run_sync(self, endpoint: EndPoint, p: dict) -> dict:
+        app = self.app
+        if endpoint is EndPoint.STATE:
+            return wrap(app.state_json(substates=p["substates"] or None))
+        if endpoint is EndPoint.KAFKA_CLUSTER_STATE:
+            return wrap(app.kafka_cluster_state())
+        if endpoint is EndPoint.PAUSE_SAMPLING:
+            return wrap(app.pause_sampling(p["reason"] or "operator request"))
+        if endpoint is EndPoint.RESUME_SAMPLING:
+            return wrap(app.resume_sampling(p["reason"] or "operator request"))
+        if endpoint is EndPoint.STOP_PROPOSAL_EXECUTION:
+            return wrap(app.stop_proposal_execution(force=p["force_stop"]))
+        if endpoint is EndPoint.BOOTSTRAP:
+            return wrap(app.bootstrap(p["start"], p["end"],
+                                      clear_metrics=p["clearmetrics"]))
+        if endpoint is EndPoint.TRAIN:
+            return wrap(app.train(p["start"], p["end"]))
+        if endpoint is EndPoint.ADMIN:
+            return wrap(app.admin(
+                disable_self_healing_for=p["disable_self_healing_for"],
+                enable_self_healing_for=p["enable_self_healing_for"],
+                concurrent_partition_movements_per_broker=
+                p["concurrent_partition_movements_per_broker"],
+                concurrent_intra_broker_partition_movements=
+                p["concurrent_intra_broker_partition_movements"],
+                concurrent_leader_movements=p["concurrent_leader_movements"],
+                execution_progress_check_interval_ms=
+                p["execution_progress_check_interval_ms"],
+                drop_recently_removed_brokers=p["drop_recently_removed_brokers"],
+                drop_recently_demoted_brokers=p["drop_recently_demoted_brokers"]))
+        if endpoint is EndPoint.USER_TASKS:
+            tasks = self.user_tasks.all_tasks()
+            wanted_ids = set(p["user_task_ids"] or [])
+            wanted_clients = set(p["client_ids"] or [])
+            wanted_eps = {e.lower() for e in (p["endpoints"] or [])}
+            wanted_types = {t.lower() for t in (p["types"] or [])}
+            rows = []
+            for t in tasks:
+                row = t.to_json()
+                if wanted_ids and t.task_id not in wanted_ids:
+                    continue
+                if wanted_clients and t.client not in wanted_clients:
+                    continue
+                if wanted_eps and t.endpoint.path not in wanted_eps:
+                    continue
+                if wanted_types and row["Status"].lower() not in wanted_types:
+                    continue
+                if p["fetch_completed_task"] and t.done and not t.future.exception():
+                    row["originalResponse"] = t.result_json()
+                rows.append(row)
+            return wrap({"userTasks": rows[:p["entries"]]})
+        if endpoint is EndPoint.REVIEW_BOARD:
+            if self.purgatory is None:
+                raise ParameterError("two-step verification is not enabled")
+            return wrap({"RequestInfo": self.purgatory.board(p["review_ids"])})
+        if endpoint is EndPoint.REVIEW:
+            if self.purgatory is None:
+                raise ParameterError("two-step verification is not enabled")
+            rows = []
+            for rid in (p["approve"] or []):
+                rows.append(self.purgatory.approve(
+                    rid, p["reason"] or "approved").to_json())
+            for rid in (p["discard"] or []):
+                rows.append(self.purgatory.discard(
+                    rid, p["reason"] or "discarded").to_json())
+            return wrap({"RequestInfo": rows})
+        raise AssertionError(f"unhandled sync endpoint {endpoint}")
+
+
+def _make_handler(server: CruiseControlServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # NCSA access log -> quiet in-process
+            pass
+
+        def _send(self, status: int, body: dict, headers: dict[str, str]):
+            payload = json.dumps(body, indent=2).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _dispatch(self, method: str):
+            parsed = urllib.parse.urlparse(self.path)
+            path = parsed.path
+            if path.startswith(URL_PREFIX):
+                path = path[len(URL_PREFIX):]
+            name = path.strip("/").split("/")[0]
+            endpoint = EndPoint.from_path(name)
+            if endpoint is None:
+                self._send(404, error_json(f"unknown endpoint {name!r}"), {})
+                return
+            allowed = GET_ENDPOINTS if method == "GET" else POST_ENDPOINTS
+            if endpoint not in allowed:
+                other = "POST" if method == "GET" else "GET"
+                self._send(405, error_json(
+                    f"{endpoint.path} only supports {other}"), {})
+                return
+            try:
+                principal, role = server.security.authenticate(self.headers)
+                if not server.security.authorize(role, endpoint, method):
+                    raise AuthError(f"role {role} may not access "
+                                    f"{method} /{endpoint.path}", 403)
+            except AuthError as e:
+                hdrs = {"WWW-Authenticate": 'Basic realm="cruise-control"'} \
+                    if e.status == 401 else {}
+                self._send(e.status, error_json(str(e)), hdrs)
+                return
+            query = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+            if method == "POST":
+                # form-encoded POST bodies fold into the query params
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    if length:
+                        body = self.rfile.read(length).decode("utf-8")
+                        ctype = self.headers.get("Content-Type", "")
+                        if "json" in ctype:
+                            for k, v in json.loads(body or "{}").items():
+                                sval = (",".join(str(x) for x in v)
+                                        if isinstance(v, list) else str(v))
+                                query.setdefault(k, [sval])
+                        else:
+                            for k, vs in urllib.parse.parse_qs(
+                                    body, keep_blank_values=True).items():
+                                query.setdefault(k, vs)
+                except (ValueError, UnicodeDecodeError) as e:
+                    self._send(400, error_json(f"malformed request body: {e}"), {})
+                    return
+            try:
+                params = parse_params(endpoint, query)
+            except ParameterError as e:
+                self._send(400, error_json(str(e)), {})
+                return
+            client = f"{principal}@{self.client_address[0]}"
+            try:
+                status, body, headers = server.handle(
+                    method, endpoint, params, client,
+                    self.headers.get(USER_TASK_HEADER_NAME))
+            except (ParameterError, KeyError, ValueError) as e:
+                self._send(400, error_json(str(e)), {})
+                return
+            except Exception as e:  # noqa: BLE001
+                self._send(500, error_json(f"{type(e).__name__}: {e}",
+                                           traceback.format_exc()), {})
+                return
+            self._send(status, body, headers)
+
+        def do_GET(self):
+            self._dispatch("GET")
+
+        def do_POST(self):
+            self._dispatch("POST")
+
+    return Handler

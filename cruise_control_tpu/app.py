@@ -235,7 +235,7 @@ class CruiseControl:
                                      reason: str = "fix topic RF") -> dict:
         """Topic RF healing: under-replicated topics get replicas added on
         least-loaded alive brokers (UpdateTopicConfigurationRunnable role)."""
-        target_rf = self.config.get_int("self.healing.target.topic.replication.factor")
+        default_rf = self.config.get_int("self.healing.target.topic.replication.factor")
         partitions = self.backend.partitions()
         brokers = self.backend.brokers()
         alive = [b for b, n in brokers.items() if n.alive]
@@ -243,6 +243,16 @@ class CruiseControl:
         for (topic, part), info in partitions.items():
             if topic not in bad_topics:
                 continue
+            # per-topic target RF when the caller supplied one (the
+            # TOPIC_CONFIGURATION endpoint passes {topic: rf}; the detector
+            # passes {topic: {"targetRF": rf, ...}}), else the healing default
+            spec = bad_topics[topic]
+            if isinstance(spec, int):
+                target_rf = spec
+            elif isinstance(spec, dict) and "targetRF" in spec:
+                target_rf = int(spec["targetRF"])
+            else:
+                target_rf = default_rf
             replicas = list(info.replicas)
             if len(replicas) < target_rf:
                 candidates = [b for b in alive if b not in replicas]
@@ -258,10 +268,98 @@ class CruiseControl:
         return {"operation": "TOPIC_REPLICATION_FACTOR", "reason": reason,
                 "numPartitionsChanged": len(assignments)}
 
+    # ------------------------------------------------------- admin surface
+    def pause_sampling(self, reason: str = "operator request") -> dict:
+        """POST /pause_sampling."""
+        self.load_monitor.pause_sampling(reason)
+        return {"operation": "PAUSE_SAMPLING", "reason": reason,
+                "monitorState": self.load_monitor.state}
+
+    def resume_sampling(self, reason: str = "operator request") -> dict:
+        """POST /resume_sampling."""
+        self.load_monitor.resume_sampling(reason)
+        return {"operation": "RESUME_SAMPLING", "reason": reason,
+                "monitorState": self.load_monitor.state}
+
+    def stop_proposal_execution(self, force: bool = False) -> dict:
+        """POST /stop_proposal_execution (Executor stop/force-stop :873-899)."""
+        was_ongoing = self.executor.has_ongoing_execution()
+        self.executor.stop_execution(force=force)
+        return {"operation": "STOP_PROPOSAL_EXECUTION", "forceStop": force,
+                "wasOngoingExecution": was_ongoing}
+
+    def bootstrap(self, start_ms=None, end_ms=None, clear_metrics: bool = True) -> dict:
+        """GET /bootstrap (BootstrapTask role)."""
+        out = self.load_monitor.bootstrap(start_ms, end_ms, clear_metrics)
+        out["operation"] = "BOOTSTRAP"
+        return out
+
+    def train(self, start_ms=None, end_ms=None) -> dict:
+        """GET /train (TrainingTask + LinearRegressionModelParameters role)."""
+        out = self.load_monitor.train(start_ms, end_ms)
+        out["operation"] = "TRAIN"
+        return out
+
+    def admin(self, disable_self_healing_for=None, enable_self_healing_for=None,
+              concurrent_partition_movements_per_broker=None,
+              concurrent_intra_broker_partition_movements=None,
+              concurrent_leader_movements=None,
+              execution_progress_check_interval_ms=None,
+              drop_recently_removed_brokers=None,
+              drop_recently_demoted_brokers=None) -> dict:
+        """POST /admin (AdminParameters.java surface): toggle self-healing per
+        anomaly type, adjust movement concurrency, un-blocklist brokers."""
+        from cruise_control_tpu.detector.anomalies import AnomalyType
+        notifier = self.anomaly_detector.notifier
+        out: dict = {"operation": "ADMIN"}
+        changed = {}
+        for name in (disable_self_healing_for or []):
+            notifier.set_self_healing(AnomalyType[name.upper()], False)
+            changed[name.upper()] = False
+        for name in (enable_self_healing_for or []):
+            notifier.set_self_healing(AnomalyType[name.upper()], True)
+            changed[name.upper()] = True
+        if changed:
+            out["selfHealingEnabledChanged"] = changed
+        if any(x is not None for x in (concurrent_partition_movements_per_broker,
+                                       concurrent_intra_broker_partition_movements,
+                                       concurrent_leader_movements,
+                                       execution_progress_check_interval_ms)):
+            out["concurrency"] = self.executor.set_concurrency(
+                per_broker=concurrent_partition_movements_per_broker,
+                intra_broker=concurrent_intra_broker_partition_movements,
+                leadership=concurrent_leader_movements,
+                progress_check_interval_ms=execution_progress_check_interval_ms)
+        if drop_recently_removed_brokers:
+            out["droppedRecentlyRemovedBrokers"] = \
+                self.executor.drop_recently_removed_brokers(drop_recently_removed_brokers)
+        if drop_recently_demoted_brokers:
+            out["droppedRecentlyDemotedBrokers"] = \
+                self.executor.drop_recently_demoted_brokers(drop_recently_demoted_brokers)
+        return out
+
+    def broker_load_json(self, populate_disk_info: bool = False,
+                         capacity_only: bool = False) -> dict:
+        """GET /load (ClusterLoad/BrokerStats response)."""
+        from cruise_control_tpu.api.responses import broker_stats_json
+        ct, meta = self._model()
+        return broker_stats_json(ct, meta, populate_disk_info=populate_disk_info,
+                                 capacity_only=capacity_only)
+
     # ------------------------------------------------------------ proposals
-    def cached_proposals(self, force_refresh: bool = False) -> OptimizerResult:
+    def cached_proposals(self, force_refresh: bool = False,
+                         goal_names=None) -> OptimizerResult:
         """GET /proposals with generation-checked cache
-        (GoalOptimizer precompute/cache role, GoalOptimizer.java:219-339)."""
+        (GoalOptimizer precompute/cache role, GoalOptimizer.java:219-339).
+        A custom goal list bypasses the cache, like the reference does when
+        ProposalsParameters carries non-default goals."""
+        if goal_names:
+            # dry-run-only path: custom goal lists need not include every hard
+            # goal (precompute always runs the full default chain)
+            ct, meta = self._model()
+            return self.goal_optimizer.optimizations(
+                ct, meta, goal_names=goal_names, raise_on_failure=False,
+                skip_hard_goal_check=True)
         gen = self.load_monitor.model_generation().as_tuple()
         with self._cache_lock:
             if (not force_refresh and self._proposal_cache is not None

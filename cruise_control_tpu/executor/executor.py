@@ -144,6 +144,46 @@ class Executor:
     def recently_demoted_brokers(self) -> set:
         return set(self._recently_demoted_brokers)
 
+    def drop_recently_removed_brokers(self, brokers) -> list:
+        """POST /admin?drop_recently_removed_brokers (Executor.java
+        drop*Brokers): un-blocklist brokers so proposals may target them."""
+        dropped = [b for b in brokers if b in self._recently_removed_brokers]
+        for b in dropped:
+            del self._recently_removed_brokers[b]
+        return dropped
+
+    def drop_recently_demoted_brokers(self, brokers) -> list:
+        dropped = [b for b in brokers if b in self._recently_demoted_brokers]
+        for b in dropped:
+            del self._recently_demoted_brokers[b]
+        return dropped
+
+    def set_concurrency(self, per_broker: int | None = None,
+                        intra_broker: int | None = None,
+                        leadership: int | None = None,
+                        progress_check_interval_ms: float | None = None) -> dict:
+        """POST /admin concurrency overrides (Executor.setRequestedMovementConcurrency)."""
+        for name, v in (("concurrent_partition_movements_per_broker", per_broker),
+                        ("concurrent_intra_broker_partition_movements", intra_broker),
+                        ("concurrent_leader_movements", leadership),
+                        ("execution_progress_check_interval_ms",
+                         progress_check_interval_ms)):
+            if v is not None and v <= 0:
+                # a 0 cap would stall the execution loop forever
+                raise ValueError(f"{name} must be > 0, got {v}")
+        if per_broker is not None:
+            self._cfg.per_broker_cap = int(per_broker)
+        if intra_broker is not None:
+            self._cfg.intra_broker_cap = int(intra_broker)
+        if leadership is not None:
+            self._cfg.leadership_cap = int(leadership)
+        if progress_check_interval_ms is not None:
+            self._cfg.progress_check_interval_ms = float(progress_check_interval_ms)
+        return {"perBroker": self._cfg.per_broker_cap,
+                "intraBroker": self._cfg.intra_broker_cap,
+                "leadership": self._cfg.leadership_cap,
+                "progressCheckIntervalMs": self._cfg.progress_check_interval_ms}
+
     def note_removed_brokers(self, brokers) -> None:
         for b in brokers:
             self._recently_removed_brokers[b] = self._clock.now_ms()

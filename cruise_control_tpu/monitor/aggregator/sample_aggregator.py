@@ -88,6 +88,30 @@ class MetricSampleAggregator:
         return int(ts_ms // self._window_ms)
 
     @property
+    def num_windows(self) -> int:
+        return self._num_windows
+
+    @property
+    def window_ms(self) -> int:
+        return self._window_ms
+
+    def clear(self) -> None:
+        """Drop all samples and windows (MetricSampleAggregator.clear —
+        the bootstrap-with-clearmetrics path)."""
+        with self._lock:
+            M = self._metric_def.num_metrics
+            W1 = self._num_windows + 1
+            self._entities = {}
+            self._sum = np.zeros((0, W1, M))
+            self._max = np.full((0, W1, M), -np.inf)
+            self._latest = np.zeros((0, W1, M))
+            self._counts = np.zeros((0, W1), np.int32)
+            self._oldest_window = None
+            self._current_window = None
+            self._first_window = None
+            self._generation += 1
+
+    @property
     def generation(self) -> int:
         return self._generation
 

@@ -25,7 +25,9 @@ from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model.builder import ClusterModelBuilder
 from cruise_control_tpu.monitor.aggregator.sample_aggregator import MetricSampleAggregator
 from cruise_control_tpu.monitor.capacity import DefaultCapacityResolver
-from cruise_control_tpu.monitor.cpu_model import CpuModelParams, estimate_follower_cpu_util
+from cruise_control_tpu.monitor.cpu_model import (
+    CpuModelParams, LinearRegressionCpuModel, estimate_follower_cpu_util,
+)
 from cruise_control_tpu.monitor.metricdef import (
     BROKER_METRIC_DEF, PARTITION_METRIC_DEF,
 )
@@ -62,9 +64,16 @@ class ModelGeneration:
 
 
 class LoadMonitorState:
+    """Task-runner states (monitor/task/LoadMonitorTaskRunner.java
+    LoadMonitorTaskRunnerState): NOT_STARTED/RUNNING/SAMPLING/PAUSED/
+    BOOTSTRAPPING/TRAINING/LOADING."""
     NOT_STARTED = "NOT_STARTED"
     RUNNING = "RUNNING"
+    SAMPLING = "SAMPLING"
     PAUSED = "PAUSED"
+    BOOTSTRAPPING = "BOOTSTRAPPING"
+    TRAINING = "TRAINING"
+    LOADING = "LOADING"
 
 
 class LoadMonitor:
@@ -101,15 +110,97 @@ class LoadMonitor:
         self._pause_reason = None
         self._lock = threading.Lock()
         self._model_semaphore = threading.Semaphore(2)  # LoadMonitor.java:92 cluster-model gate
+        self.lr_cpu_model = LinearRegressionCpuModel()
+        self._bootstrap_progress = 0.0
 
     # ------------------------------------------------------------ lifecycle
     def start_up(self) -> int:
         """Replay persisted samples (SampleLoadingTask role), go RUNNING."""
         n = 0
         if self._store is not None:
+            self._state = LoadMonitorState.LOADING
             n = self._store.load_samples(self._ingest)
         self._state = LoadMonitorState.RUNNING
         return n
+
+    # --------------------------------------------------- bootstrap/training
+    def bootstrap(self, start_ms: float | None = None, end_ms: float | None = None,
+                  clear_metrics: bool = True) -> dict:
+        """Backfill metric windows by sampling over [start, end] at window
+        granularity (monitor/task/BootstrapTask.java role). With no range
+        given, bootstraps the full partition-window history ending now."""
+        with self._lock:
+            if self._state in (LoadMonitorState.BOOTSTRAPPING,
+                               LoadMonitorState.TRAINING):
+                raise RuntimeError(f"load monitor is busy ({self._state})")
+            prev = self._state
+            self._state = LoadMonitorState.BOOTSTRAPPING
+        wms = self._partition_agg.window_ms
+        if end_ms is None:
+            end_ms = time.time() * 1000.0
+        # samples older than the ring depth are discarded on ingest, so a
+        # wider range would only burn sampler calls: clamp to the window span
+        horizon = end_ms - self._partition_agg.num_windows * wms
+        start_ms = horizon if start_ms is None else max(start_ms, horizon)
+        if clear_metrics:
+            self._partition_agg.clear()
+            self._broker_agg.clear()
+        try:
+            steps = 0
+            t = start_ms
+            while t <= end_ms:
+                self._bootstrap_progress = (t - start_ms) / max(end_ms - start_ms, 1.0)
+                if self._sampler is not None:
+                    self._ingest(self._sampler.get_samples(t))
+                t += wms
+                steps += 1
+            self._bootstrap_progress = 1.0
+        finally:
+            with self._lock:
+                # a concurrent pause/resume may have changed the state while
+                # bootstrapping; only restore it if it is still ours
+                if self._state == LoadMonitorState.BOOTSTRAPPING:
+                    self._state = prev if prev != LoadMonitorState.NOT_STARTED \
+                        else LoadMonitorState.RUNNING
+        return {"numWindowsSampled": steps, "startMs": int(start_ms),
+                "endMs": int(end_ms), "clearedMetrics": bool(clear_metrics)}
+
+    def train(self, start_ms: float | None = None, end_ms: float | None = None) -> dict:
+        """Fit the linear-regression CPU attribution model from broker samples
+        (monitor/task/TrainingTask.java + LinearRegressionModelParameters.java
+        role): regress broker CPU on total bytes-in/bytes-out over the sampled
+        range, making estimate_leader_cpu_util's static weights replaceable."""
+        with self._lock:
+            if self._state in (LoadMonitorState.BOOTSTRAPPING,
+                               LoadMonitorState.TRAINING):
+                raise RuntimeError(f"load monitor is busy ({self._state})")
+            prev = self._state
+            self._state = LoadMonitorState.TRAINING
+        try:
+            wms = self._broker_agg.window_ms
+            if end_ms is None:
+                end_ms = time.time() * 1000.0
+            horizon = end_ms - self._broker_agg.num_windows * wms
+            start_ms = horizon if start_ms is None else max(start_ms, horizon)
+            cpu, b_in, b_out = [], [], []
+            t = start_ms
+            while t <= end_ms:
+                if self._sampler is not None:
+                    for s in self._sampler.get_samples(t).broker_samples:
+                        cpu.append(s.values.get("BROKER_CPU_UTIL", 0.0))
+                        b_in.append(s.values.get("ALL_TOPIC_BYTES_IN", 0.0)
+                                    + s.values.get("ALL_TOPIC_REPLICATION_BYTES_IN", 0.0))
+                        b_out.append(s.values.get("ALL_TOPIC_BYTES_OUT", 0.0))
+                t += wms
+            if cpu:
+                self.lr_cpu_model.train(np.asarray(b_in), np.asarray(b_out),
+                                        np.asarray(cpu))
+        finally:
+            with self._lock:
+                if self._state == LoadMonitorState.TRAINING:
+                    self._state = prev if prev != LoadMonitorState.NOT_STARTED \
+                        else LoadMonitorState.RUNNING
+        return {"numTrainingSamples": len(cpu), "trained": self.lr_cpu_model.trained}
 
     def shutdown(self):
         if self._store is not None:
@@ -238,6 +329,12 @@ class LoadMonitor:
                     logdirs=logdirs, disk_capacity=disk_caps, dead_disks=dead)
 
             # window-reduce per partition: AVG for CPU/NW, LATEST for DISK
+            # experimental LR CPU model (use.linear.regression.model +
+            # LinearRegressionModelParameters role): when trained + enabled,
+            # leader CPU comes from the fitted cpu ~ a*bytes_in + b*bytes_out
+            use_lr = (self._config is not None
+                      and self._config.get_boolean("use.linear.regression.model")
+                      and self.lr_cpu_model.trained)
             mdef = PARTITION_METRIC_DEF
             id_cpu = mdef.info("CPU_USAGE").metric_id
             id_din = mdef.info("DISK_USAGE").metric_id
@@ -266,6 +363,9 @@ class LoadMonitor:
                         lin = float(v[:, id_lin].mean())
                         lout = float(v[:, id_lout].mean())
                         disk = float(v[-1, id_din])   # LATEST valid window
+                        if use_lr:
+                            cpu = max(0.0, float(
+                                self.lr_cpu_model.predict(lin, lout)))
                 leader_load = np.zeros(4)
                 leader_load[Resource.CPU] = cpu
                 leader_load[Resource.NW_IN] = lin
@@ -290,7 +390,7 @@ class LoadMonitor:
     # ---------------------------------------------------------------- state
     def state_json(self) -> dict:
         agg = self._partition_agg.aggregate()
-        return {
+        out = {
             "state": self._state,
             "reasonOfPauseOrResume": self._pause_reason,
             "numValidWindows": len(agg.window_starts_ms),
@@ -300,3 +400,7 @@ class LoadMonitor:
             "totalNumPartitions": len(self._backend.partitions()) if self._backend else 0,
             "loadGeneration": self._partition_agg.generation,
         }
+        if self._state == LoadMonitorState.BOOTSTRAPPING:
+            # LoadMonitorState.java reports bootstrap progress while active
+            out["bootstrapProgressPct"] = round(100.0 * self._bootstrap_progress, 1)
+        return out

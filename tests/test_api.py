@@ -1,0 +1,382 @@
+"""REST API tests: real HTTP against the simulated backend.
+
+Reference test role: servlet/ tests + CruiseControlIntegrationTestHarness
+(boots the full app + Jetty for end-to-end REST tests) — here the full
+facade + CruiseControlServer on an ephemeral port.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.api import CruiseControlServer
+from cruise_control_tpu.api.endpoints import EndPoint, ParameterError, parse_params
+from cruise_control_tpu.api.security import BasicSecurityProvider
+from cruise_control_tpu.api.user_tasks import USER_TASK_HEADER_NAME
+from cruise_control_tpu.app import CruiseControl
+from cruise_control_tpu.backend import SimulatedClusterBackend
+from cruise_control_tpu.config import cruise_control_config
+
+
+def _backend(n_brokers=4, rf=2, n_parts=12):
+    be = SimulatedClusterBackend()
+    for b in range(n_brokers):
+        be.add_broker(b, f"r{b % 2}")
+    for p in range(n_parts):
+        replicas = [(p + i) % n_brokers for i in range(rf)]
+        be.create_partition("t", p, replicas, size_mb=100.0 + 40 * (p % 3),
+                            bytes_in_rate=50.0, bytes_out_rate=100.0,
+                            cpu_util=2.0)
+    return be
+
+
+def _request(method, url, headers=None, body=None):
+    req = urllib.request.Request(url, method=method, data=body,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return resp.status, json.loads(resp.read().decode()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}"), dict(e.headers)
+
+
+@pytest.fixture(scope="module")
+def server():
+    be = _backend()
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+    cc.start_up()
+    for i in range(12):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    # 120 s block budget: first-touch JAX dispatch can take ~15 s cold
+    srv = CruiseControlServer(cc, port=0, max_block_ms=120_000.0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_state_endpoint(server):
+    status, body, _ = _request("GET", f"{server.base_url}/state")
+    assert status == 200
+    assert body["version"] == 1
+    for key in ("MonitorState", "ExecutorState", "AnalyzerState",
+                "AnomalyDetectorState"):
+        assert key in body
+    # substates filter
+    status, body, _ = _request("GET", f"{server.base_url}/state?substates=monitor")
+    assert status == 200 and "MonitorState" in body and "ExecutorState" not in body
+
+
+def test_kafka_cluster_state(server):
+    status, body, _ = _request("GET", f"{server.base_url}/kafka_cluster_state")
+    assert status == 200
+    assert body["KafkaPartitionState"]["totalPartitions"] == 12
+    assert len(body["KafkaBrokerState"]) == 4
+
+
+def test_load_endpoint(server):
+    status, body, _ = _request("GET", f"{server.base_url}/load")
+    assert status == 200
+    assert len(body["brokers"]) == 4
+    row = body["brokers"][0]
+    for col in ("Broker", "DiskMB", "DiskPct", "CpuPct", "LeaderNwInRate",
+                "FollowerNwInRate", "NwOutRate", "Leaders", "Replicas"):
+        assert col in row
+    assert sum(r["Replicas"] for r in body["brokers"]) == 24  # 12 parts x rf2
+
+
+def test_partition_load(server):
+    status, body, _ = _request(
+        "GET", f"{server.base_url}/partition_load?resource=disk&entries=5")
+    assert status == 200
+    recs = body["records"]
+    assert len(recs) == 5
+    disks = [r["disk"] for r in recs]
+    assert disks == sorted(disks, reverse=True)
+
+
+def test_proposals(server):
+    status, body, _ = _request(
+        "GET", f"{server.base_url}/proposals"
+               "?goals=DiskUsageDistributionGoal,ReplicaDistributionGoal")
+    assert status == 200
+    assert "summary" in body
+
+
+def _poll_until_done(url, first_status, first_body, first_headers,
+                     timeout_s=600):
+    """Follow the async contract: re-request with User-Task-ID until 200."""
+    status, body, headers = first_status, first_body, first_headers
+    tid = headers.get(USER_TASK_HEADER_NAME)
+    deadline = time.time() + timeout_s
+    while status == 202 and time.time() < deadline:
+        time.sleep(0.5)
+        status, body, headers = _request(
+            "POST", url, headers={USER_TASK_HEADER_NAME: tid})
+    return status, body, headers
+
+
+def test_rebalance_dryrun_and_task_id(server):
+    url = f"{server.base_url}/rebalance?dryrun=true"
+    status, body, headers = _poll_until_done(url, *_request("POST", url))
+    assert status == 200
+    assert body["operation"] == "REBALANCE" and body["executed"] is False
+    tid = headers.get(USER_TASK_HEADER_NAME)
+    assert tid
+    # same client + same params within session expiry -> same task resumed
+    status2, body2, headers2 = _request("POST", url)
+    assert headers2.get(USER_TASK_HEADER_NAME) == tid
+    # explicit User-Task-ID fetch also resumes it
+    status3, _, headers3 = _request(
+        "POST", url, headers={USER_TASK_HEADER_NAME: tid})
+    assert status3 == 200 and headers3.get(USER_TASK_HEADER_NAME) == tid
+
+
+def test_user_tasks_listing(server):
+    _request("POST", f"{server.base_url}/rebalance?dryrun=true")
+    status, body, _ = _request("GET", f"{server.base_url}/user_tasks")
+    assert status == 200
+    assert any(t["RequestURL"].endswith("rebalance") for t in body["userTasks"])
+    assert all(t["Status"] in ("Active", "InExecution", "Completed",
+                               "CompletedWithError") for t in body["userTasks"])
+
+
+def test_unknown_param_is_400(server):
+    status, body, _ = _request("POST", f"{server.base_url}/rebalance?bogus=1")
+    assert status == 400 and "bogus" in body["errorMessage"]
+
+
+def test_bad_value_is_400(server):
+    status, body, _ = _request(
+        "POST", f"{server.base_url}/rebalance?dryrun=maybe")
+    assert status == 400 and "dryrun" in body["errorMessage"]
+
+
+def test_method_mismatch_is_405(server):
+    status, _, _ = _request("GET", f"{server.base_url}/rebalance")
+    assert status == 405
+    status, _, _ = _request("POST", f"{server.base_url}/state")
+    assert status == 405
+
+
+def test_unknown_endpoint_is_404(server):
+    status, _, _ = _request("GET", f"{server.base_url}/nope")
+    assert status == 404
+
+
+def test_pause_resume_sampling(server):
+    status, body, _ = _request("POST", f"{server.base_url}/pause_sampling?reason=maint")
+    assert status == 200 and body["monitorState"] == "PAUSED"
+    _, state, _ = _request("GET", f"{server.base_url}/state?substates=monitor")
+    assert state["MonitorState"]["state"] == "PAUSED"
+    status, body, _ = _request("POST", f"{server.base_url}/resume_sampling")
+    assert status == 200 and body["monitorState"] == "RUNNING"
+
+
+def test_stop_proposal_execution(server):
+    status, body, _ = _request(
+        "POST", f"{server.base_url}/stop_proposal_execution?force_stop=true")
+    assert status == 200 and body["forceStop"] is True
+
+
+def test_admin_self_healing_and_concurrency(server):
+    status, body, _ = _request(
+        "POST", f"{server.base_url}/admin?disable_self_healing_for=broker_failure"
+                "&concurrent_leader_movements=77")
+    assert status == 200
+    assert body["selfHealingEnabledChanged"] == {"BROKER_FAILURE": False}
+    assert body["concurrency"]["leadership"] == 77
+    _, state, _ = _request("GET",
+                           f"{server.base_url}/state?substates=anomaly_detector")
+    assert state["AnomalyDetectorState"]["selfHealingEnabled"]["BROKER_FAILURE"] is False
+    status, body, _ = _request(
+        "POST", f"{server.base_url}/admin?enable_self_healing_for=broker_failure")
+    assert body["selfHealingEnabledChanged"] == {"BROKER_FAILURE": True}
+
+
+def test_bootstrap_and_train(server):
+    status, body, _ = _request(
+        "GET", f"{server.base_url}/bootstrap?start=0&end=1500000&clearmetrics=false")
+    assert status == 200 and body["numWindowsSampled"] >= 5
+    status, body, _ = _request("GET", f"{server.base_url}/train?start=0&end=1500000")
+    assert status == 200 and body["trained"] is True
+
+
+def test_async_progress_then_result():
+    """A slow op returns 202 + progress, then 200 via User-Task-ID polling
+    (UserTaskManager.java contract)."""
+    be = _backend()
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+    cc.start_up()
+    for i in range(12):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    srv = CruiseControlServer(cc, port=0, max_block_ms=1.0)
+    srv.start()
+    try:
+        status, body, headers = _request(
+            "POST", f"{srv.base_url}/rebalance?dryrun=true")
+        tid = headers.get(USER_TASK_HEADER_NAME)
+        assert tid is not None
+        if status == 202:
+            assert "progress" in body
+        deadline = time.time() + 60
+        while status == 202 and time.time() < deadline:
+            time.sleep(0.2)
+            status, body, headers = _request(
+                "POST", f"{srv.base_url}/rebalance?dryrun=true",
+                headers={USER_TASK_HEADER_NAME: tid})
+        assert status == 200 and body["operation"] == "REBALANCE"
+    finally:
+        srv.stop()
+
+
+def test_two_step_verification_flow():
+    be = _backend()
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+    cc.start_up()
+    for i in range(12):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    srv = CruiseControlServer(cc, port=0, two_step_verification=True,
+                              max_block_ms=120_000.0)
+    srv.start()
+    try:
+        # 1. POST parks the request
+        status, body, _ = _request("POST", f"{srv.base_url}/rebalance?dryrun=true")
+        assert status == 202
+        rid = body["reviewResult"]["Id"]
+        assert body["reviewResult"]["Status"] == "PENDING_REVIEW"
+        # 2. not approved yet -> re-submission fails
+        status, body, _ = _request(
+            "POST", f"{srv.base_url}/rebalance?dryrun=true&review_id={rid}")
+        assert status == 400
+        # 3. approve via /review
+        status, body, _ = _request("POST", f"{srv.base_url}/review?approve={rid}")
+        assert status == 200
+        assert body["RequestInfo"][0]["Status"] == "APPROVED"
+        # 4. resubmit with review_id -> executes
+        status, body, _ = _request(
+            "POST", f"{srv.base_url}/rebalance?dryrun=true&review_id={rid}")
+        assert status == 200 and body["operation"] == "REBALANCE"
+        # 5. board shows SUBMITTED
+        status, body, _ = _request("GET", f"{srv.base_url}/review_board")
+        assert body["RequestInfo"][0]["Status"] == "SUBMITTED"
+        # 6. discarding a submitted request is an illegal transition
+        status, body, _ = _request("POST", f"{srv.base_url}/review?discard={rid}")
+        assert status == 400
+    finally:
+        srv.stop()
+
+
+def test_basic_auth_roles():
+    be = _backend()
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+    cc.start_up()
+    for i in range(12):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    provider = BasicSecurityProvider({
+        "alice": ("s3cret", "ADMIN"), "bob": ("hunter2", "VIEWER")})
+    srv = CruiseControlServer(cc, port=0, security_provider=provider,
+                              max_block_ms=120_000.0)
+    srv.start()
+    import base64
+
+    def basic(user, pw):
+        return {"Authorization": "Basic "
+                + base64.b64encode(f"{user}:{pw}".encode()).decode()}
+    try:
+        status, _, headers = _request("GET", f"{srv.base_url}/state")
+        assert status == 401 and "WWW-Authenticate" in headers
+        status, _, _ = _request("GET", f"{srv.base_url}/state",
+                                headers=basic("bob", "wrong"))
+        assert status == 401
+        status, _, _ = _request("GET", f"{srv.base_url}/state",
+                                headers=basic("bob", "hunter2"))
+        assert status == 200
+        status, _, _ = _request("POST", f"{srv.base_url}/rebalance?dryrun=true",
+                                headers=basic("bob", "hunter2"))
+        assert status == 403
+        status, _, _ = _request("POST", f"{srv.base_url}/rebalance?dryrun=true",
+                                headers=basic("alice", "s3cret"))
+        assert status == 200
+    finally:
+        srv.stop()
+
+
+def test_load_capacity_only_carries_capacity(server):
+    status, body, _ = _request("GET", f"{server.base_url}/load?capacity_only=true")
+    assert status == 200
+    row = body["brokers"][0]
+    assert row["DiskCapacityMB"] > 0 and row["NwInCapacity"] > 0
+    assert row["DiskMB"] == 0.0  # utilization suppressed
+
+
+def test_user_tasks_filters(server):
+    _request("POST", f"{server.base_url}/rebalance?dryrun=true")
+    status, body, _ = _request(
+        "GET", f"{server.base_url}/user_tasks?endpoints=rebalance"
+               "&types=completed&fetch_completed_task=true")
+    assert status == 200
+    assert body["userTasks"], "expected at least the rebalance task"
+    for t in body["userTasks"]:
+        assert t["RequestURL"].endswith("rebalance")
+        assert t["Status"] == "Completed"
+        assert t["originalResponse"]["operation"] == "REBALANCE"
+    status, body, _ = _request(
+        "GET", f"{server.base_url}/user_tasks?endpoints=add_broker")
+    assert body["userTasks"] == []
+
+
+def test_malformed_json_body_is_400(server):
+    status, body, _ = _request(
+        "POST", f"{server.base_url}/admin",
+        headers={"Content-Type": "application/json",
+                 "Content-Length": "4"},
+        body=b"{bad")
+    assert status == 400 and "malformed" in body["errorMessage"]
+
+
+def test_two_step_async_poll_does_not_repark():
+    """Polling an approved async op via User-Task-ID must bypass the
+    purgatory (regression: SUBMITTED -> SUBMITTED dead end)."""
+    be = _backend()
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+    cc.start_up()
+    for i in range(12):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    srv = CruiseControlServer(cc, port=0, two_step_verification=True,
+                              max_block_ms=1.0)
+    srv.start()
+    try:
+        _, body, _ = _request("POST", f"{srv.base_url}/rebalance?dryrun=true")
+        rid = body["reviewResult"]["Id"]
+        _request("POST", f"{srv.base_url}/review?approve={rid}")
+        status, body, headers = _request(
+            "POST", f"{srv.base_url}/rebalance?dryrun=true&review_id={rid}")
+        tid = headers.get(USER_TASK_HEADER_NAME)
+        assert tid is not None
+        deadline = time.time() + 120
+        while status == 202 and time.time() < deadline:
+            time.sleep(0.2)
+            status, body, headers = _request(
+                "POST", f"{srv.base_url}/rebalance?dryrun=true&review_id={rid}",
+                headers={USER_TASK_HEADER_NAME: tid})
+        assert status == 200 and body["operation"] == "REBALANCE"
+    finally:
+        srv.stop()
+
+
+def test_parse_params_defaults_and_types():
+    p = parse_params(EndPoint.REBALANCE, {})
+    assert p["dryrun"] is True and p["json"] is True and p["goals"] is None
+    p = parse_params(EndPoint.ADD_BROKER, {"brokerid": ["1,2,3"]})
+    assert p["brokerid"] == [1, 2, 3]
+    with pytest.raises(ParameterError):
+        parse_params(EndPoint.STATE, {"nope": ["1"]})
+    with pytest.raises(ParameterError):
+        parse_params(EndPoint.ADD_BROKER, {"brokerid": ["x"]})

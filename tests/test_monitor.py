@@ -184,3 +184,42 @@ def test_dead_broker_reflected_in_model():
     sanity_check(ct)
     assert not bool(ct.broker_alive[meta.broker_index(0)])
     assert int((ct.replica_offline & ct.replica_valid).sum()) == 1
+
+
+def test_task_runner_bootstrap_and_train():
+    """BootstrapTask/TrainingTask state machine (LoadMonitorTaskRunner role)."""
+    be = _backend()
+    lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    out = lm.bootstrap(start_ms=0.0, end_ms=1_500_000.0, clear_metrics=True)
+    assert out["numWindowsSampled"] >= 5
+    assert lm.state == "RUNNING"
+    ct, meta = lm.cluster_model()
+    assert int(ct.replica_valid.sum()) == 4
+    out = lm.train(start_ms=0.0, end_ms=1_500_000.0)
+    assert out["trained"] is True
+
+
+def test_linear_regression_cpu_model_used_when_enabled():
+    """use.linear.regression.model routes leader CPU through the fitted model
+    (LinearRegressionModelParameters.java role)."""
+    from cruise_control_tpu.config import cruise_control_config
+    be = _backend()
+    cfg = cruise_control_config({"use.linear.regression.model": True})
+    lm = LoadMonitor(config=cfg, backend=be, sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    for i in range(20):
+        lm.sample_once(now_ms=i * 300_000.0)
+    ct_static, _ = lm.cluster_model()
+    # train on synthetic exactly-linear data: cpu = 0.01*in + 0.02*out
+    bi = np.array([100.0, 200.0, 50.0, 400.0])
+    bo = np.array([10.0, 300.0, 80.0, 20.0])
+    lm.lr_cpu_model.train(bi, bo, 0.01 * bi + 0.02 * bo)
+    ct_lr, _ = lm.cluster_model()
+    lead = np.asarray(ct_lr.replica_is_leader) & np.asarray(ct_lr.replica_valid)
+    cpu_lr = np.asarray(ct_lr.leader_load)[lead][:, Resource.CPU]
+    lin = np.asarray(ct_lr.leader_load)[lead][:, Resource.NW_IN]
+    lout = np.asarray(ct_lr.leader_load)[lead][:, Resource.NW_OUT]
+    np.testing.assert_allclose(cpu_lr, 0.01 * lin + 0.02 * lout, rtol=1e-5)
+    cpu_static = np.asarray(ct_static.leader_load)[lead][:, Resource.CPU]
+    assert not np.allclose(cpu_lr, cpu_static)
