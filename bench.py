@@ -111,6 +111,25 @@ def main() -> None:
         headline = run_rung("7000b-1M", ct, meta)
         rungs.append(headline)
 
+    if only in (None, "5"):
+        # BASELINE config 5: JBOD layout with offline replicas (dead brokers
+        # + dead disks) -> self-healing hard goals + intra-broker disk goals
+        log("rung 5: 7,000-broker JBOD w/ broker+disk failures (self-healing)")
+        ct, meta = generate_scale(RandomClusterSpec(
+            num_brokers=7000, num_racks=40, num_topics=2000,
+            num_partitions=500000, max_replication=3, skew=1.0, seed=3143,
+            logdirs_per_broker=4, num_dead_brokers=20,
+            num_brokers_with_dead_disk=50))
+        log(f"  generated {meta.num_valid_replicas} replicas "
+            f"({int(np.asarray(ct.replica_offline).sum())} offline)")
+        rungs.append(run_rung("7000b-JBOD-selfheal", ct, meta, goal_names=[
+            "RackAwareGoal", "MinTopicLeadersPerBrokerGoal",
+            "ReplicaCapacityGoal", "DiskCapacityGoal",
+            "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+            "CpuCapacityGoal", "ReplicaDistributionGoal",
+            "IntraBrokerDiskCapacityGoal",
+            "IntraBrokerDiskUsageDistributionGoal"]))
+
     log(f"total bench time {time.monotonic() - t_all:.1f}s")
 
     value = headline["wall_s"] if headline else rungs[-1]["wall_s"]

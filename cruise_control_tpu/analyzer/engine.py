@@ -39,10 +39,11 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer.env import ClusterEnv
 from cruise_control_tpu.analyzer.goals.base import (
-    GoalKernel, legit_leadership_mask, legit_move_mask, legit_swap_mask,
+    GoalKernel, legit_disk_move_mask, legit_leadership_mask, legit_move_mask,
+    legit_swap_mask,
 )
 from cruise_control_tpu.analyzer.state import (
-    EngineState, apply_leadership, apply_move, apply_swap,
+    EngineState, apply_disk_move, apply_leadership, apply_move, apply_swap,
 )
 
 Array = jax.Array
@@ -204,6 +205,52 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     return st, n_applied
 
 
+def _rescore_disk_move_row(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                           prev_goals: tuple, r: Array) -> Array:
+    """f32[D]: the candidate's intra-broker move score vs the CURRENT state."""
+    c1 = r[None]
+    m1 = legit_disk_move_mask(env, st, c1)
+    for g in prev_goals:
+        m1 = m1 & g.accept_disk_move(env, st, c1)
+    s1 = goal.disk_move_score(env, st, c1)
+    return jnp.where(m1, s1, NEG_INF)[0]
+
+
+def _disk_move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                              prev_goals: tuple, params: EngineParams,
+                              severity: Array):
+    """Intra-broker analogue of _move_branch_batched: destinations are the D
+    logdirs of each candidate's own broker (IntraBrokerDiskUsageDistribution
+    Goal.java:518 hot loop role). [K, D] scoring, per-move [1, D] re-score."""
+    key = goal.replica_key(env, st, severity)
+    kv, cand = jax.lax.top_k(key, min(params.num_candidates, env.num_replicas))
+    mask = legit_disk_move_mask(env, st, cand)
+    for g in prev_goals:
+        mask = mask & g.accept_disk_move(env, st, cand)
+    score = goal.disk_move_score(env, st, cand)
+    score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
+    best_val = jnp.max(score, axis=1)
+    order = jnp.argsort(-best_val)
+
+    def body(i, carry):
+        st, n_applied = carry
+        k = order[i]
+        r = cand[k]
+        row = _rescore_disk_move_row(env, st, goal, prev_goals, r)
+        d = jnp.argmax(row).astype(jnp.int32)
+        ok = (best_val[k] > params.min_gain) & (row[d] > params.min_gain)
+        st = jax.lax.cond(ok, lambda s: apply_disk_move(env, s, r, d),
+                          lambda s: s, st)
+        return st, n_applied + ok.astype(jnp.int32)
+
+    K = score.shape[0]
+    st, n_applied = jax.lax.cond(
+        jnp.max(best_val) > params.min_gain,
+        lambda s: jax.lax.fori_loop(0, K, body, (s, jnp.int32(0))),
+        lambda s: (s, jnp.int32(0)), st)
+    return st, n_applied
+
+
 def optimize_goal(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                   prev_goals: tuple = (), params: EngineParams = EngineParams()):
     """Run one goal to completion. Returns (state, info dict)."""
@@ -226,6 +273,14 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple, params: En
         def step(carry):
             st, it, n_applied, _progress = carry
             severity = goal.broker_severity(env, st)
+
+            # 0. intra-broker disk moves (IntraBroker*Goal actions never leave
+            #    the broker; only these goals set the flag)
+            n_disk = jnp.int32(0)
+            if goal.uses_disk_moves:
+                st, n_disk = _disk_move_branch_batched(env, st, goal,
+                                                       prev_goals, params,
+                                                       severity)
 
             # 1. replica moves (cheapest per unit of work on TPU: one scoring
             #    pass lands up to K moves)
@@ -256,7 +311,7 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple, params: En
                                                    goal.broker_severity(env, s)),
                     lambda s: (s, jnp.int32(0)), st)
 
-            applied = n_moves + n_leads + n_swaps
+            applied = n_disk + n_moves + n_leads + n_swaps
             progress = applied > 0
             return st, it + 1, n_applied + applied, progress
 

@@ -16,6 +16,9 @@ from cruise_control_tpu.analyzer.goals.distribution import (
     NetworkInboundUsageDistributionGoal, NetworkOutboundUsageDistributionGoal,
     ReplicaDistributionGoal, ResourceDistributionGoal,
 )
+from cruise_control_tpu.analyzer.goals.intra_broker import (
+    IntraBrokerDiskCapacityGoal, IntraBrokerDiskUsageDistributionGoal,
+)
 from cruise_control_tpu.analyzer.goals.leader_election import PreferredLeaderElectionGoal
 from cruise_control_tpu.analyzer.goals.network import (
     LeaderBytesInDistributionGoal, PotentialNwOutGoal,
@@ -44,6 +47,8 @@ GOAL_CLASSES: dict[str, type] = {
     "TopicReplicaDistributionGoal": TopicReplicaDistributionGoal,
     "MinTopicLeadersPerBrokerGoal": MinTopicLeadersPerBrokerGoal,
     "PreferredLeaderElectionGoal": PreferredLeaderElectionGoal,
+    "IntraBrokerDiskCapacityGoal": IntraBrokerDiskCapacityGoal,
+    "IntraBrokerDiskUsageDistributionGoal": IntraBrokerDiskUsageDistributionGoal,
 }
 
 
@@ -73,4 +78,5 @@ __all__ = [
     "PotentialNwOutGoal", "LeaderBytesInDistributionGoal",
     "TopicReplicaDistributionGoal", "MinTopicLeadersPerBrokerGoal",
     "PreferredLeaderElectionGoal",
+    "IntraBrokerDiskCapacityGoal", "IntraBrokerDiskUsageDistributionGoal",
 ]

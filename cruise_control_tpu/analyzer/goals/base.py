@@ -57,6 +57,7 @@ class GoalKernel:
     uses_replica_moves: bool = dataclasses.field(default=True, init=False)
     uses_leadership_moves: bool = dataclasses.field(default=False, init=False)
     uses_swaps: bool = dataclasses.field(default=False, init=False)
+    uses_disk_moves: bool = dataclasses.field(default=False, init=False)
 
     # --- kernel methods (override) ---
     def broker_severity(self, env: ClusterEnv, st: EngineState) -> Array:
@@ -111,6 +112,19 @@ class GoalKernel:
         b_in = st.replica_broker[cand_in]                      # [K2]
         b_out = st.replica_broker[cand_out]                    # [K1]
         return acc_out[:, b_in] & acc_in[:, b_out].T
+
+    # --- intra-broker disk moves (IntraBroker*Goal.java) ---
+    def disk_move_score(self, env: ClusterEnv, st: EngineState, cand: Array) -> Array:
+        """f32[K, D]: improvement from moving candidate k to logdir d of its
+        OWN broker; -inf where not self-satisfied. Only intra-broker goals
+        implement this."""
+        return jnp.full((cand.shape[0], env.broker_disk_capacity.shape[1]), NEG_INF)
+
+    def accept_disk_move(self, env: ClusterEnv, st: EngineState, cand: Array) -> Array:
+        """bool[K, D] veto of an intra-broker move as a previously-optimized
+        goal. Default: accept (broker-level goals are indifferent to logdir
+        placement)."""
+        return jnp.ones((cand.shape[0], env.broker_disk_capacity.shape[1]), bool)
 
     def violated(self, env: ClusterEnv, st: EngineState) -> Array:
         return jnp.any(self.broker_severity(env, st) > 0)
@@ -217,6 +231,22 @@ def legit_swap_mask(env: ClusterEnv, st: EngineState, cand_out: Array,
     dst_ok = env.dst_candidate[b_in][None, :] & env.dst_candidate[b_out][:, None]
     return (diff_broker & out_ok & in_ok & dst_ok
             & ok_r[cand_out][:, None] & ok_r[cand_in][None, :])
+
+
+def legit_disk_move_mask(env: ClusterEnv, st: EngineState, cand: Array) -> Array:
+    """bool[K, D] — legitimacy of moving candidate k to logdir d of its own
+    broker (IntraBrokerDiskCapacityGoal legit-move analogue): destination disk
+    alive (and has capacity configured), != current disk, broker alive,
+    replica valid; excluded topics may still heal off dead disks."""
+    b = st.replica_broker[cand]                                    # [K]
+    D = env.broker_disk_capacity.shape[1]
+    dst_alive = env.broker_disk_alive[b] & (env.broker_disk_capacity[b] > 0)
+    cur = st.replica_disk[cand]
+    not_self = jnp.arange(D)[None, :] != cur[:, None]
+    valid = env.replica_valid[cand] & env.broker_alive[b]
+    on_dead_disk = ~env.broker_disk_alive[b, jnp.clip(cur, 0)]
+    topic_ok = ~env.topic_excluded[env.replica_topic[cand]] | on_dead_disk
+    return dst_alive & not_self & (valid & topic_ok)[:, None]
 
 
 def legit_leadership_mask(env: ClusterEnv, st: EngineState, cand: Array) -> Array:

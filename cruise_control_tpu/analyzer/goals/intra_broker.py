@@ -1,0 +1,190 @@
+"""Intra-broker (JBOD) disk goals.
+
+Reference: analyzer/goals/IntraBrokerDiskCapacityGoal.java:1-293 (hard: every
+alive logdir under ``capacity * disk-capacity-threshold``; replicas on dead
+disks relocate to healthy disks of the same broker) and
+IntraBrokerDiskUsageDistributionGoal.java:1-518 (soft: each logdir's
+utilization percentage within the balance band around its broker's average
+disk utilization, band = avg ± (balance% - 1) * BALANCE_MARGIN).
+
+Actions are INTRA_BROKER_REPLICA_MOVEMENT only: destinations are the D
+logdirs of the candidate's own broker, scored as [K, D] tensors over
+``st.disk_util`` / ``env.broker_disk_capacity`` — broker-level tallies are
+untouched, so these goals are transparent to every inter-broker goal's
+acceptance mask.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.env import BALANCE_MARGIN, ClusterEnv
+from cruise_control_tpu.analyzer.goals.base import NEG_INF, GoalKernel
+from cruise_control_tpu.analyzer.state import EngineState
+from cruise_control_tpu.common.resources import EPSILON_ABS, Resource
+
+DISK_EPS = EPSILON_ABS[Resource.DISK]   # 100 MB absolute tolerance
+PCT_EPS = 1e-4
+
+
+def _disk_valid(env: ClusterEnv) -> jnp.ndarray:
+    """bool[B, D]: configured, alive logdirs on alive brokers."""
+    return (env.broker_disk_alive & (env.broker_disk_capacity > 0)
+            & env.broker_alive[:, None])
+
+
+def _candidate_disk_load(env: ClusterEnv, st: EngineState, cand) -> jnp.ndarray:
+    """f32[K] DISK load of each candidate replica in its current role."""
+    lead = st.replica_is_leader[cand]
+    return jnp.where(lead, env.leader_load[cand, Resource.DISK],
+                     env.follower_load[cand, Resource.DISK])
+
+
+def _on_dead_disk(env: ClusterEnv, st: EngineState) -> jnp.ndarray:
+    """bool[R]: replica sits on a dead/unconfigured logdir of an alive broker
+    (the intra-broker healing case; dead-broker replicas are inter-broker)."""
+    b = st.replica_broker
+    d = jnp.clip(st.replica_disk, 0)
+    bad_disk = ~(env.broker_disk_alive[b, d] & (env.broker_disk_capacity[b, d] > 0))
+    return env.replica_valid & env.broker_alive[b] & bad_disk
+
+
+@dataclasses.dataclass(frozen=True)
+class IntraBrokerDiskCapacityGoal(GoalKernel):
+    """Hard: no alive logdir above threshold*capacity; nothing on dead disks
+    (IntraBrokerDiskCapacityGoal.java)."""
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", "IntraBrokerDiskCapacityGoal")
+        object.__setattr__(self, "is_hard", True)
+        object.__setattr__(self, "uses_replica_moves", False)
+        object.__setattr__(self, "uses_disk_moves", True)
+
+    def _limit(self, env: ClusterEnv) -> jnp.ndarray:
+        """f32[B, D]: allowed utilization per logdir; 0 for dead disks."""
+        thresh = self.constraint.capacity_threshold[Resource.DISK]
+        return jnp.where(_disk_valid(env),
+                         thresh * env.broker_disk_capacity, 0.0)
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        excess = jnp.maximum(st.disk_util - self._limit(env), 0.0)   # [B, D]
+        # anything sitting on a dead disk counts fully
+        sev = jnp.sum(jnp.where(_disk_valid(env), excess,
+                                st.disk_util), axis=1)
+        return jnp.where(env.broker_alive, sev - DISK_EPS, 0.0)
+
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity):
+        b = st.replica_broker
+        d = jnp.clip(st.replica_disk, 0)
+        over = st.disk_util[b, d] > self._limit(env)[b, d] + DISK_EPS
+        dead = _on_dead_disk(env, st)
+        load = _candidate_disk_load(env, st, jnp.arange(env.num_replicas))
+        movable = env.replica_valid & env.broker_alive[b] & (over | dead)
+        key = jnp.where(movable, load, NEG_INF)
+        return jnp.where(dead, key + 1e12, key)
+
+    def disk_move_score(self, env: ClusterEnv, st: EngineState, cand):
+        l = _candidate_disk_load(env, st, cand)                      # [K]
+        b = st.replica_broker[cand]                                  # [K]
+        limit = self._limit(env)[b]                                  # [K, D]
+        util = st.disk_util[b]                                       # [K, D]
+        feasible = util + l[:, None] <= limit
+        cur = jnp.clip(st.replica_disk[cand], 0)
+        src_over = util[jnp.arange(cand.shape[0]), cur] > (
+            limit[jnp.arange(cand.shape[0]), cur] + DISK_EPS)
+        dead = _on_dead_disk(env, st)[cand]
+        headroom = jnp.maximum(limit - util, 0.0)
+        cap = jnp.maximum(env.broker_disk_capacity[b], 1e-6)
+        score = l[:, None] + 0.01 * headroom / cap
+        score = jnp.where(dead[:, None], 1.0 + headroom / cap, score)
+        return jnp.where(feasible & (src_over | dead)[:, None], score, NEG_INF)
+
+    def accept_disk_move(self, env: ClusterEnv, st: EngineState, cand):
+        l = _candidate_disk_load(env, st, cand)
+        b = st.replica_broker[cand]
+        return st.disk_util[b] + l[:, None] <= self._limit(env)[b] + DISK_EPS
+
+    def violated(self, env: ClusterEnv, st: EngineState):
+        return jnp.any(self.broker_severity(env, st) > 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class IntraBrokerDiskUsageDistributionGoal(GoalKernel):
+    """Soft: every logdir's utilization percentage within the balance band
+    around its broker's average disk utilization
+    (IntraBrokerDiskUsageDistributionGoal.java; band = avg ± (disk-balance%
+    - 1) * BALANCE_MARGIN, GoalUtils balance-threshold math)."""
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", "IntraBrokerDiskUsageDistributionGoal")
+        object.__setattr__(self, "uses_replica_moves", False)
+        object.__setattr__(self, "uses_disk_moves", True)
+
+    def _band(self, env: ClusterEnv, st: EngineState):
+        """(pct[B,D], lower[B], upper[B], valid[B,D])."""
+        valid = _disk_valid(env)
+        cap = jnp.where(valid, env.broker_disk_capacity, 0.0)
+        util = jnp.where(valid, st.disk_util, 0.0)
+        avg = jnp.sum(util, axis=1) / jnp.maximum(jnp.sum(cap, axis=1), 1e-6)
+        dev = (self.constraint.resource_balance_percentage[Resource.DISK] - 1.0) \
+            * BALANCE_MARGIN
+        upper = avg * (1.0 + dev)
+        lower = avg * (1.0 - dev)
+        pct = st.disk_util / jnp.maximum(env.broker_disk_capacity, 1e-6)
+        return pct, lower, upper, valid
+
+    def _violation(self, env: ClusterEnv, st: EngineState):
+        """f32[B, D] distance outside the band (0 inside)."""
+        pct, lower, upper, valid = self._band(env, st)
+        out = jnp.maximum(pct - upper[:, None], 0.0) \
+            + jnp.maximum(lower[:, None] - pct, 0.0)
+        return jnp.where(valid, out, 0.0)
+
+    def broker_severity(self, env: ClusterEnv, st: EngineState):
+        return jnp.sum(self._violation(env, st), axis=1) - PCT_EPS
+
+    def replica_key(self, env: ClusterEnv, st: EngineState, severity):
+        pct, lower, upper, valid = self._band(env, st)
+        b = st.replica_broker
+        d = jnp.clip(st.replica_disk, 0)
+        over = pct[b, d] > upper[b] + PCT_EPS
+        load = _candidate_disk_load(env, st, jnp.arange(env.num_replicas))
+        movable = env.replica_valid & (severity[b] > 0) & over & (load > 0)
+        return jnp.where(movable, load, NEG_INF)
+
+    def disk_move_score(self, env: ClusterEnv, st: EngineState, cand):
+        l = _candidate_disk_load(env, st, cand)                      # [K]
+        b = st.replica_broker[cand]
+        cap = jnp.maximum(env.broker_disk_capacity[b], 1e-6)         # [K, D]
+        pct, lower, upper, valid = self._band(env, st)
+        K = cand.shape[0]
+        cur = jnp.clip(st.replica_disk[cand], 0)
+        dl = l[:, None] / cap                                        # pct delta at dst
+        src_pct = pct[b][jnp.arange(K), cur]                         # [K]
+        src_cap = cap[jnp.arange(K), cur]
+        up, lo = upper[b], lower[b]                                  # [K]
+
+        def band_viol(p, up, lo):
+            return jnp.maximum(p - up, 0.0) + jnp.maximum(lo - p, 0.0)
+
+        v_src_before = band_viol(src_pct, up, lo)                    # [K]
+        v_src_after = band_viol(src_pct - l / src_cap, up, lo)
+        v_dst_before = band_viol(pct[b], up[:, None], lo[:, None])   # [K, D]
+        v_dst_after = band_viol(pct[b] + dl, up[:, None], lo[:, None])
+        gain = (v_src_before - v_src_after)[:, None] \
+            + (v_dst_before - v_dst_after)
+        return jnp.where(valid[b], gain, NEG_INF)
+
+    def accept_disk_move(self, env: ClusterEnv, st: EngineState, cand):
+        """As a previously-optimized goal: the destination logdir must not
+        leave the band (REPLICA_REJECT analogue)."""
+        l = _candidate_disk_load(env, st, cand)
+        b = st.replica_broker[cand]
+        cap = jnp.maximum(env.broker_disk_capacity[b], 1e-6)
+        pct, lower, upper, valid = self._band(env, st)
+        after = pct[b] + l[:, None] / cap
+        return ~valid[b] | (after <= upper[b][:, None] + PCT_EPS)
+
+    def violated(self, env: ClusterEnv, st: EngineState):
+        return jnp.any(self._violation(env, st) > PCT_EPS)

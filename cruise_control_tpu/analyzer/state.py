@@ -178,6 +178,30 @@ def apply_leadership(env: ClusterEnv, st: EngineState, src_replica: Array,
                                .at[src_replica].set(True).at[dst_replica].set(True))
 
 
+def apply_disk_move(env: ClusterEnv, st: EngineState, replica: Array,
+                    dst_disk: Array) -> EngineState:
+    """Relocate ``replica`` to another logdir on its OWN broker
+    (INTRA_BROKER_REPLICA_MOVEMENT, ClusterModel.relocateReplica disk
+    variant / Disk.java bookkeeping). Only disk_util and replica_disk change;
+    broker-level tallies are untouched."""
+    b = st.replica_broker[replica]
+    is_leader = st.replica_is_leader[replica]
+    disk_load = jnp.where(is_leader, env.leader_load[replica, Resource.DISK],
+                          env.follower_load[replica, Resource.DISK])
+    src_disk = st.replica_disk[replica]
+    du = st.disk_util.at[b, src_disk].add(-disk_load).at[b, dst_disk].add(disk_load)
+    # moving off a dead disk onto an alive one heals the replica
+    heals = env.broker_disk_alive[b, dst_disk] & env.broker_alive[b]
+    return dataclasses.replace(
+        st,
+        replica_disk=st.replica_disk.at[replica].set(jnp.asarray(dst_disk, jnp.int32)),
+        replica_offline=st.replica_offline.at[replica].set(
+            st.replica_offline[replica] & ~heals),
+        disk_util=du,
+        moved=st.moved.at[replica].set(True),
+    )
+
+
 def apply_swap(env: ClusterEnv, st: EngineState, replica_a: Array,
                replica_b: Array) -> EngineState:
     """Exchange the brokers of two (online) replicas of different partitions:

@@ -116,6 +116,14 @@ class CruiseControlServer:
                 not params["topic"] or params["replication_factor"] is None):
             raise ParameterError(
                 "topic_configuration requires topic and replication_factor")
+        if (endpoint is EndPoint.REBALANCE and params.get("rebalance_disk")
+                and params.get("goals")):
+            intra = self.app.config.get_list("intra.broker.goals")
+            bad = [g for g in params["goals"] if g not in intra]
+            if bad:
+                raise ParameterError(
+                    f"rebalance_disk only accepts intra-broker goals; got {bad}"
+                    f" (allowed: {intra})")
         work = self._async_work(endpoint, params)
         # non-dry-run ops mutate the cluster: a completed one must not be
         # replayed from the session cache for a fresh request
@@ -162,6 +170,7 @@ class CruiseControlServer:
                     return wrap(app.rebalance(
                         goal_names=p["goals"] or None, dry_run=p["dryrun"],
                         skip_hard_goal_check=p["skip_hard_goal_check"],
+                        rebalance_disk=p["rebalance_disk"],
                         reason=p["reason"] or "rebalance request"))
                 if endpoint is EndPoint.ADD_BROKER:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
@@ -315,7 +324,11 @@ def _make_handler(server: CruiseControlServer):
                         body = self.rfile.read(length).decode("utf-8")
                         ctype = self.headers.get("Content-Type", "")
                         if "json" in ctype:
-                            for k, v in json.loads(body or "{}").items():
+                            parsed_body = json.loads(body or "{}")
+                            if not isinstance(parsed_body, dict):
+                                raise ValueError(
+                                    "JSON body must be an object of parameters")
+                            for k, v in parsed_body.items():
                                 sval = (",".join(str(x) for x in v)
                                         if isinstance(v, list) else str(v))
                                 query.setdefault(k, [sval])

@@ -118,7 +118,9 @@ class UserTaskManager:
             if task.done and now - (task.completed_ms or ts) > self._session_expiry_ms:
                 del self._session_to_task[key]
         for tid, task in list(self._completed.items()):
-            if now - task.start_ms > self._retention_ms:
+            # retention runs from completion, not start: a long-running task
+            # must still be retrievable for the full window after it finishes
+            if now - (task.completed_ms or task.start_ms) > self._retention_ms:
                 del self._completed[tid]
 
     def get_or_create_task(self, client: str, endpoint: EndPoint, method: str,

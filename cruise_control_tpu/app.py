@@ -149,11 +149,26 @@ class CruiseControl:
     # ---------------------------------------------------------- operations
     def rebalance(self, goal_names=None, dry_run: bool = False,
                   self_healing: bool = False, triggered_by_goal_violation: bool = False,
-                  skip_hard_goal_check: bool = False, reason: str = "rebalance") -> dict:
-        """POST /rebalance (RebalanceRunnable.java:30-115 role)."""
+                  skip_hard_goal_check: bool = False, rebalance_disk: bool = False,
+                  reason: str = "rebalance") -> dict:
+        """POST /rebalance (RebalanceRunnable.java:30-115 role).
+        ``rebalance_disk=True`` balances load across the logdirs of each
+        broker with the intra-broker goal chain instead
+        (RebalanceParameters.java rebalance_disk)."""
         ct, meta = self._model()
         options = OptimizationOptions(
             triggered_by_goal_violation=triggered_by_goal_violation)
+        if rebalance_disk:
+            intra = self.config.get_list("intra.broker.goals")
+            if goal_names:
+                bad = [g for g in goal_names if g not in intra]
+                if bad:
+                    raise ValueError(
+                        f"rebalance_disk only accepts intra-broker goals; "
+                        f"got {bad} (allowed: {intra})")
+            else:
+                goal_names = intra
+            skip_hard_goal_check = True
         goals = goal_names or (SELF_HEALING_GOALS if self_healing else None)
         op = self._run_optimization("REBALANCE", reason, ct, meta, goals, options,
                                     dry_run=dry_run,
@@ -312,13 +327,19 @@ class CruiseControl:
         from cruise_control_tpu.detector.anomalies import AnomalyType
         notifier = self.anomaly_detector.notifier
         out: dict = {"operation": "ADMIN"}
+        # validate every name BEFORE mutating anything (atomic like
+        # set_concurrency): a bad name mid-list must not half-apply toggles
+        toggles = [(n.upper(), False) for n in (disable_self_healing_for or [])] \
+            + [(n.upper(), True) for n in (enable_self_healing_for or [])]
+        for name, _ in toggles:
+            if name not in AnomalyType.__members__:
+                raise ValueError(
+                    f"unknown anomaly type {name!r}; known: "
+                    f"{sorted(AnomalyType.__members__)}")
         changed = {}
-        for name in (disable_self_healing_for or []):
-            notifier.set_self_healing(AnomalyType[name.upper()], False)
-            changed[name.upper()] = False
-        for name in (enable_self_healing_for or []):
-            notifier.set_self_healing(AnomalyType[name.upper()], True)
-            changed[name.upper()] = True
+        for name, enabled in toggles:
+            notifier.set_self_healing(AnomalyType[name], enabled)
+            changed[name] = enabled
         if changed:
             out["selfHealingEnabledChanged"] = changed
         if any(x is not None for x in (concurrent_partition_movements_per_broker,

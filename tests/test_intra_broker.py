@@ -1,0 +1,149 @@
+"""Intra-broker (JBOD) goal tests.
+
+Reference test role: IntraBrokerDiskCapacityGoalTest /
+DeterministicClusterTest JBOD variants (common/DeterministicCluster JBOD
+fixtures) — dead-disk healing, per-logdir capacity, intra-broker balance,
+executed through the intra-broker phase.
+"""
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import init_state, make_env
+from cruise_control_tpu.analyzer.engine import EngineParams, optimize_goal
+from cruise_control_tpu.analyzer.goals import make_goal
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.model.builder import ClusterModelBuilder
+
+
+def _jbod_cluster(dead_disk=False, overfull=False):
+    """2 brokers x 3 logdirs. Broker 0's disk0 is crowded; optionally dead or
+    over capacity."""
+    b = ClusterModelBuilder()
+    for i in range(2):
+        b.add_broker(i, rack=f"r{i}",
+                     logdirs=["/d0", "/d1", "/d2"],
+                     disk_capacity=[1000.0, 1000.0, 1000.0],
+                     capacity={3: 3000.0},
+                     dead_disks={"/d0"} if (dead_disk and i == 0) else set())
+    p = 0
+    # 6 partitions RF=2, all of broker 0's replicas on /d0
+    for p in range(6):
+        size = 300.0 if overfull else 120.0
+        b.add_replica("t", p, 0, is_leader=True,
+                      load=[1.0, 10.0, 20.0, size], logdir="/d0",
+                      offline=(dead_disk))
+        b.add_replica("t", p, 1, is_leader=False,
+                      load=[1.0, 10.0, 20.0, size], logdir=f"/d{p % 3}")
+    return b.build()
+
+
+def _run(goal_name, ct, meta, prev=()):
+    env = make_env(ct, meta)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    goal = make_goal(goal_name)
+    prev_goals = tuple(make_goal(n) for n in prev)
+    st2, info = optimize_goal(env, st, goal, prev_goals,
+                              EngineParams(max_iters=64))
+    return env, st, st2, info
+
+
+def test_capacity_goal_moves_replicas_off_overfull_disk():
+    ct, meta = _jbod_cluster(overfull=True)   # 6*300=1800 on a 1000-cap disk
+    env, st0, st, info = _run("IntraBrokerDiskCapacityGoal", ct, meta)
+    assert not bool(info["violated_after"])
+    # no replica left its broker: intra-broker goals only move between disks
+    np.testing.assert_array_equal(np.asarray(st.replica_broker),
+                                  np.asarray(st0.replica_broker))
+    du = np.asarray(st.disk_util)
+    assert (du[0] <= 0.8 * 1000.0 + 100.0).all()
+    # total disk load per broker unchanged
+    np.testing.assert_allclose(du[0].sum(), 1800.0, rtol=1e-5)
+
+
+def test_capacity_goal_heals_dead_disk():
+    ct, meta = _jbod_cluster(dead_disk=True)
+    env, st0, st, info = _run("IntraBrokerDiskCapacityGoal", ct, meta)
+    assert not bool(info["violated_after"])
+    du = np.asarray(st.disk_util)
+    assert du[0, 0] == pytest.approx(0.0, abs=1e-6)   # dead disk drained
+    # healed replicas are no longer offline and stayed on broker 0
+    rd = np.asarray(st.replica_disk)
+    rb = np.asarray(st.replica_broker)
+    off = np.asarray(st.replica_offline)
+    b0 = rb == 0
+    assert not off[b0 & np.asarray(env.replica_valid)].any()
+    assert (rd[b0 & np.asarray(env.replica_valid)] != 0).all()
+
+
+def test_distribution_goal_balances_disks_within_broker():
+    ct, meta = _jbod_cluster()                # 720 MB all on broker0:/d0
+    env, st0, st, info = _run("IntraBrokerDiskUsageDistributionGoal", ct, meta)
+    assert not bool(info["violated_after"])
+    np.testing.assert_array_equal(np.asarray(st.replica_broker),
+                                  np.asarray(st0.replica_broker))
+    du = np.asarray(st.disk_util)
+    # broker 0 disks within the band around its 24% average (1.1 thresh, 0.9 margin)
+    pct = du[0] / 1000.0
+    avg = pct.mean()
+    assert pct.max() <= avg * 1.09 + 1e-3
+    # goal's own stat strictly decreased
+    assert float(info["stat"]) <= 0.0 + 1e-3 or float(info["stat"]) < 1e6
+
+
+def test_capacity_accept_vetoes_overfilling_disk_move():
+    """As a previously-optimized goal, IntraBrokerDiskCapacityGoal vetoes
+    distribution moves that would overfill a logdir."""
+    ct, meta = _jbod_cluster(overfull=True)
+    env, st0, st, info = _run("IntraBrokerDiskUsageDistributionGoal", ct, meta,
+                              prev=("IntraBrokerDiskCapacityGoal",))
+    du = np.asarray(st.disk_util)
+    assert (du[0] <= 0.8 * 1000.0 + 100.0 + 1e-3).all()
+
+
+def test_optimizer_chain_emits_intra_broker_proposals():
+    ct, meta = _jbod_cluster(overfull=True)
+    opt = GoalOptimizer()
+    res = opt.optimizations(ct, meta,
+                            goal_names=["IntraBrokerDiskCapacityGoal",
+                                        "IntraBrokerDiskUsageDistributionGoal"],
+                            skip_hard_goal_check=True)
+    assert "IntraBrokerDiskCapacityGoal" not in res.violated_goals_after
+    assert res.proposals
+    for p in res.proposals:
+        old_brokers = [b for b, _ in p.old_replicas]
+        new_brokers = [b for b, _ in p.new_replicas]
+        assert old_brokers == new_brokers          # intra-broker: disk only
+        assert any(od != nd for (_, od), (_, nd)
+                   in zip(p.old_replicas, p.new_replicas))
+
+
+def test_rebalance_disk_end_to_end():
+    """POST /rebalance?rebalance_disk=true against the simulated backend:
+    executed through the executor's intra-broker phase."""
+    from cruise_control_tpu.app import CruiseControl
+    from cruise_control_tpu.backend import SimulatedClusterBackend
+    from cruise_control_tpu.config import cruise_control_config
+    be = SimulatedClusterBackend()
+    for i in range(2):
+        be.add_broker(i, f"r{i}", logdirs={"/d0": 1000.0, "/d1": 1000.0,
+                                           "/d2": 1000.0})
+    for p in range(6):
+        # all of broker 0's replicas land on /d0
+        be.create_partition("t", p, [0, 1], size_mb=250.0, bytes_in_rate=10.0,
+                            bytes_out_rate=20.0, cpu_util=1.0,
+                            logdir_by_broker={0: "/d0", 1: f"/d{p % 3}"})
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+    cc.start_up()
+    for i in range(8):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    out = cc.rebalance(rebalance_disk=True, dry_run=False)
+    assert out["executed"] is True
+    # the backend's logdir layout actually changed: /d0 no longer over 80%
+    used = {ld: 0.0 for ld in ("/d0", "/d1", "/d2")}
+    for (t, p), info in be.partitions().items():
+        ld = info.logdir_by_broker.get(0)
+        if ld is not None:
+            used[ld] += info.size_mb
+    assert used["/d0"] <= 0.8 * 1000.0 + 100.0
