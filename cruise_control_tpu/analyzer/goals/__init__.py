@@ -19,6 +19,10 @@ from cruise_control_tpu.analyzer.goals.distribution import (
 from cruise_control_tpu.analyzer.goals.intra_broker import (
     IntraBrokerDiskCapacityGoal, IntraBrokerDiskUsageDistributionGoal,
 )
+from cruise_control_tpu.analyzer.goals.kafka_assigner import (
+    KafkaAssignerDiskUsageDistributionGoal, KafkaAssignerEvenRackAwareGoal,
+    kafka_assigner_goal_names,
+)
 from cruise_control_tpu.analyzer.goals.leader_election import PreferredLeaderElectionGoal
 from cruise_control_tpu.analyzer.goals.network import (
     LeaderBytesInDistributionGoal, PotentialNwOutGoal,
@@ -49,6 +53,8 @@ GOAL_CLASSES: dict[str, type] = {
     "PreferredLeaderElectionGoal": PreferredLeaderElectionGoal,
     "IntraBrokerDiskCapacityGoal": IntraBrokerDiskCapacityGoal,
     "IntraBrokerDiskUsageDistributionGoal": IntraBrokerDiskUsageDistributionGoal,
+    "KafkaAssignerEvenRackAwareGoal": KafkaAssignerEvenRackAwareGoal,
+    "KafkaAssignerDiskUsageDistributionGoal": KafkaAssignerDiskUsageDistributionGoal,
 }
 
 
@@ -79,4 +85,6 @@ __all__ = [
     "TopicReplicaDistributionGoal", "MinTopicLeadersPerBrokerGoal",
     "PreferredLeaderElectionGoal",
     "IntraBrokerDiskCapacityGoal", "IntraBrokerDiskUsageDistributionGoal",
+    "KafkaAssignerEvenRackAwareGoal", "KafkaAssignerDiskUsageDistributionGoal",
+    "kafka_assigner_goal_names",
 ]

@@ -148,9 +148,15 @@ class IntraBrokerDiskUsageDistributionGoal(GoalKernel):
         pct, lower, upper, valid = self._band(env, st)
         b = st.replica_broker
         d = jnp.clip(st.replica_disk, 0)
-        over = pct[b, d] > upper[b] + PCT_EPS
+        avg = (lower + upper) / 2.0
+        # candidates: any replica on an above-AVERAGE disk of a violating
+        # broker — not only above-upper ones, because a below-lower disk is
+        # filled by draining in-band disks that sit above the mean (the
+        # reference's rebalanceByMovingLoadIn path); the score function
+        # rejects moves with no band-violation gain
+        donor = pct[b, d] > avg[b] + PCT_EPS
         load = _candidate_disk_load(env, st, jnp.arange(env.num_replicas))
-        movable = env.replica_valid & (severity[b] > 0) & over & (load > 0)
+        movable = env.replica_valid & (severity[b] > 0) & donor & (load > 0)
         return jnp.where(movable, load, NEG_INF)
 
     def disk_move_score(self, env: ClusterEnv, st: EngineState, cand):

@@ -1,0 +1,68 @@
+"""Kafka-assigner mode goals.
+
+Reference: analyzer/kafkaassigner/ — KafkaAssignerEvenRackAwareGoal.java
+(509: replicas of each partition spread position-by-position round-robin
+across racks => an even rack distribution) and
+KafkaAssignerDiskUsageDistributionGoal.java (693: disk balancing that
+preserves each broker's replica count by SWAPPING replicas between broker
+pairs instead of moving them). The ``kafka_assigner`` request parameter
+substitutes these for their standard counterparts
+(GoalBasedOperationRunnable kafka-assigner mode).
+
+The contract kept here is the outcome, not the scan order: even rack spread
+== at most ceil(RF / num_racks) replicas per rack (the fixed point of the
+reference's round-robin), and swap-only disk balancing == replica-count-
+preserving actions.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from cruise_control_tpu.analyzer.goals.distribution import DiskUsageDistributionGoal
+from cruise_control_tpu.analyzer.goals.rack import RackAwareDistributionGoal
+
+
+@dataclasses.dataclass(frozen=True)
+class KafkaAssignerEvenRackAwareGoal(RackAwareDistributionGoal):
+    """Even rack spread (the round-robin fixed point), hard."""
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "name", "KafkaAssignerEvenRackAwareGoal")
+
+
+@dataclasses.dataclass(frozen=True)
+class KafkaAssignerDiskUsageDistributionGoal(DiskUsageDistributionGoal):
+    """Disk balancing by swaps only: per-broker replica counts are preserved,
+    matching the kafka-assigner tool's semantics
+    (KafkaAssignerDiskUsageDistributionGoal.java swapReplicas)."""
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "name", "KafkaAssignerDiskUsageDistributionGoal")
+        object.__setattr__(self, "uses_replica_moves", False)
+        object.__setattr__(self, "uses_leadership_moves", False)
+        object.__setattr__(self, "uses_swaps", True)
+
+
+# GoalBasedOperationRunnable's kafka-assigner substitution table
+KAFKA_ASSIGNER_SUBSTITUTION = {
+    "RackAwareGoal": "KafkaAssignerEvenRackAwareGoal",
+    "RackAwareDistributionGoal": "KafkaAssignerEvenRackAwareGoal",
+    "DiskUsageDistributionGoal": "KafkaAssignerDiskUsageDistributionGoal",
+}
+
+
+def kafka_assigner_goal_names(names: list[str]) -> list[str]:
+    """Map a goal list into kafka-assigner mode, dropping goals with no
+    assigner equivalent beyond the substitution (the reference mode runs
+    exactly its two goals when none are requested)."""
+    if not names:
+        return ["KafkaAssignerEvenRackAwareGoal",
+                "KafkaAssignerDiskUsageDistributionGoal"]
+    out = []
+    for n in names:
+        mapped = KAFKA_ASSIGNER_SUBSTITUTION.get(n, n)
+        if mapped not in out:
+            out.append(mapped)
+    return out

@@ -116,8 +116,8 @@ class CruiseControlServer:
                 not params["topic"] or params["replication_factor"] is None):
             raise ParameterError(
                 "topic_configuration requires topic and replication_factor")
-        if (endpoint is EndPoint.REBALANCE and params.get("rebalance_disk")
-                and params.get("goals")):
+        if (endpoint in (EndPoint.REBALANCE, EndPoint.PROPOSALS)
+                and params.get("rebalance_disk") and params.get("goals")):
             intra = self.app.config.get_list("intra.broker.goals")
             bad = [g for g in params["goals"] if g not in intra]
             if bad:
@@ -161,9 +161,18 @@ class CruiseControlServer:
                         sort_by=p["resource"], limit=p["entries"])})
                 if endpoint is EndPoint.PROPOSALS:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
+                    goals = p["goals"] or None
+                    # mode flags preview the same goal chain /rebalance runs
+                    if p["rebalance_disk"] and not goals:
+                        goals = app.config.get_list("intra.broker.goals")
+                    if p["kafka_assigner"]:
+                        from cruise_control_tpu.analyzer.goals import (
+                            kafka_assigner_goal_names,
+                        )
+                        goals = kafka_assigner_goal_names(goals or [])
                     res = app.cached_proposals(
                         force_refresh=p["ignore_proposal_cache"],
-                        goal_names=p["goals"] or None)
+                        goal_names=goals)
                     return wrap({"summary": res.to_json()})
                 if endpoint is EndPoint.REBALANCE:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
@@ -171,6 +180,7 @@ class CruiseControlServer:
                         goal_names=p["goals"] or None, dry_run=p["dryrun"],
                         skip_hard_goal_check=p["skip_hard_goal_check"],
                         rebalance_disk=p["rebalance_disk"],
+                        kafka_assigner=p["kafka_assigner"],
                         reason=p["reason"] or "rebalance request"))
                 if endpoint is EndPoint.ADD_BROKER:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)

@@ -150,14 +150,20 @@ class CruiseControl:
     def rebalance(self, goal_names=None, dry_run: bool = False,
                   self_healing: bool = False, triggered_by_goal_violation: bool = False,
                   skip_hard_goal_check: bool = False, rebalance_disk: bool = False,
-                  reason: str = "rebalance") -> dict:
+                  kafka_assigner: bool = False, reason: str = "rebalance") -> dict:
         """POST /rebalance (RebalanceRunnable.java:30-115 role).
         ``rebalance_disk=True`` balances load across the logdirs of each
         broker with the intra-broker goal chain instead
-        (RebalanceParameters.java rebalance_disk)."""
+        (RebalanceParameters.java rebalance_disk); ``kafka_assigner=True``
+        substitutes the kafka-assigner mode goals
+        (analyzer/kafkaassigner/ role)."""
         ct, meta = self._model()
         options = OptimizationOptions(
             triggered_by_goal_violation=triggered_by_goal_violation)
+        if kafka_assigner:
+            from cruise_control_tpu.analyzer.goals import kafka_assigner_goal_names
+            goal_names = kafka_assigner_goal_names(goal_names or [])
+            skip_hard_goal_check = True
         if rebalance_disk:
             intra = self.config.get_list("intra.broker.goals")
             if goal_names:

@@ -46,6 +46,7 @@ class SimulatedClusterBackend:
         self._now_ms = 0.0
         self._noise = metric_noise
         self._rng = np.random.default_rng(seed)
+        self._metric_overrides: dict[int, dict[str, float]] = {}
 
     def configure(self, config, **extra):
         pass
@@ -203,7 +204,18 @@ class SimulatedClusterBackend:
                     "BROKER_LOG_FLUSH_TIME_MS_MEAN": self._jitter(1.0),
                     "BROKER_LOG_FLUSH_TIME_MS_999TH": self._jitter(5.0),
                 }
+                out[b].update(self._metric_overrides.get(b, {}))
             return out
+
+    def override_broker_metric(self, broker_id: int, metric: str,
+                               value: float | None) -> None:
+        """Fault injection: pin a broker metric (None clears the override) —
+        drives slow-broker / concurrency-adjuster scenarios in tests."""
+        with self._lock:
+            if value is None:
+                self._metric_overrides.get(broker_id, {}).pop(metric, None)
+            else:
+                self._metric_overrides.setdefault(broker_id, {})[metric] = value
 
     # -------------------------------------------------------------- actuation
     def alter_partition_reassignments(self, assignments: dict) -> None:

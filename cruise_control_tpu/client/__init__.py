@@ -1,0 +1,8 @@
+"""Python client + CLI for the Cruise Control REST API.
+
+Reference: cruise-control-client/ (cruisecontrolclient.client — cccli.py,
+Endpoint.py, CCParameter/, Query.py, Responder.py, Display.py; 1,991 LoC).
+"""
+from cruise_control_tpu.client.client import CruiseControlClient, CruiseControlClientError
+
+__all__ = ["CruiseControlClient", "CruiseControlClientError"]

@@ -201,6 +201,25 @@ _D.define(name="concurrency.adjuster.min.partition.movements.per.broker", type=T
           validator=at_least(1))
 _D.define(name="concurrency.adjuster.max.leadership.movements", type=Type.INT, default=1125,
           validator=at_least(1))
+_D.define(name="concurrency.adjuster.min.leadership.movements", type=Type.INT, default=100,
+          validator=at_least(1))
+# AIMD limits per broker metric (ExecutorConfig DEFAULT_CONCURRENCY_ADJUSTER_LIMIT_*)
+_D.define(name="concurrency.adjuster.limit.log.flush.time.ms", type=Type.DOUBLE, default=2000.0)
+_D.define(name="concurrency.adjuster.limit.follower.fetch.local.time.ms", type=Type.DOUBLE,
+          default=500.0)
+_D.define(name="concurrency.adjuster.limit.produce.local.time.ms", type=Type.DOUBLE,
+          default=1000.0)
+_D.define(name="concurrency.adjuster.limit.consumer.fetch.local.time.ms", type=Type.DOUBLE,
+          default=500.0)
+_D.define(name="concurrency.adjuster.limit.request.queue.size", type=Type.DOUBLE, default=1000.0)
+_D.define(name="concurrency.adjuster.additive.increase.inter.broker.replica", type=Type.INT,
+          default=1, validator=at_least(1))
+_D.define(name="concurrency.adjuster.additive.increase.leadership", type=Type.INT,
+          default=100, validator=at_least(1))
+_D.define(name="concurrency.adjuster.multiplicative.decrease.inter.broker.replica",
+          type=Type.INT, default=2, validator=at_least(2))
+_D.define(name="concurrency.adjuster.multiplicative.decrease.leadership", type=Type.INT,
+          default=2, validator=at_least(2))
 _D.define(name="leader.movement.timeout.ms", type=Type.LONG, default=180_000)
 _D.define(name="task.execution.alerting.threshold.ms", type=Type.LONG, default=90_000)
 _D.define(name="executor.backend.class", type=Type.CLASS,

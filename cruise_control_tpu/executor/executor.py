@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time as _time
+from collections import deque
 
 from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
 from cruise_control_tpu.executor.strategy import build_strategy
@@ -69,6 +70,19 @@ class ExecutorConfigView:
     adjuster_enabled: bool = False
     adjuster_max_per_broker: int = 12
     adjuster_min_per_broker: int = 1
+    adjuster_max_leadership: int = 1125
+    adjuster_min_leadership: int = 100
+    adjuster_limits: tuple = (
+        ("BROKER_LOG_FLUSH_TIME_MS_999TH", 2000.0),
+        ("BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_999TH", 500.0),
+        ("BROKER_PRODUCE_LOCAL_TIME_MS_999TH", 1000.0),
+        ("BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_999TH", 500.0),
+        ("BROKER_REQUEST_QUEUE_SIZE", 1000.0),
+    )
+    adjuster_add_replica: int = 1
+    adjuster_add_leadership: int = 100
+    adjuster_div_replica: int = 2
+    adjuster_div_leadership: int = 2
 
     @classmethod
     def from_config(cls, cfg) -> "ExecutorConfigView":
@@ -85,7 +99,84 @@ class ExecutorConfigView:
                 "concurrency.adjuster.max.partition.movements.per.broker"),
             adjuster_min_per_broker=cfg.get_int(
                 "concurrency.adjuster.min.partition.movements.per.broker"),
+            adjuster_max_leadership=cfg.get_int(
+                "concurrency.adjuster.max.leadership.movements"),
+            adjuster_min_leadership=cfg.get_int(
+                "concurrency.adjuster.min.leadership.movements"),
+            adjuster_limits=(
+                ("BROKER_LOG_FLUSH_TIME_MS_999TH",
+                 cfg.get_double("concurrency.adjuster.limit.log.flush.time.ms")),
+                ("BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_999TH",
+                 cfg.get_double("concurrency.adjuster.limit.follower.fetch.local.time.ms")),
+                ("BROKER_PRODUCE_LOCAL_TIME_MS_999TH",
+                 cfg.get_double("concurrency.adjuster.limit.produce.local.time.ms")),
+                ("BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_999TH",
+                 cfg.get_double("concurrency.adjuster.limit.consumer.fetch.local.time.ms")),
+                ("BROKER_REQUEST_QUEUE_SIZE",
+                 cfg.get_double("concurrency.adjuster.limit.request.queue.size")),
+            ),
+            adjuster_add_replica=cfg.get_int(
+                "concurrency.adjuster.additive.increase.inter.broker.replica"),
+            adjuster_add_leadership=cfg.get_int(
+                "concurrency.adjuster.additive.increase.leadership"),
+            adjuster_div_replica=cfg.get_int(
+                "concurrency.adjuster.multiplicative.decrease.inter.broker.replica"),
+            adjuster_div_leadership=cfg.get_int(
+                "concurrency.adjuster.multiplicative.decrease.leadership"),
         )
+
+
+class ConcurrencyAdjuster:
+    """AIMD movement-concurrency control from live broker metrics.
+
+    Reference: Executor.java:335-448 (inner ConcurrencyAdjuster) +
+    ExecutionUtils.recommendedConcurrency — if ANY alive broker exceeds a
+    configured limit for one of the watched 999th-percentile latency / queue
+    metrics, the concurrency is divided (multiplicative decrease, clamped to
+    the configured min); if all brokers are healthy it is increased additively
+    (clamped to the max). The reference's (At/Under)MinISR-based cancel check
+    needs topic minIsr configs, which the backend SPI does not expose yet —
+    metrics-based adjustment is the part carried here.
+    """
+
+    def __init__(self, cfg: ExecutorConfigView):
+        self._cfg = cfg
+        self.history: deque = deque(maxlen=100)
+
+    def _over_limit(self, broker_metrics: dict) -> list:
+        over = []
+        for b, metrics in broker_metrics.items():
+            for name, limit in self._cfg.adjuster_limits:
+                v = metrics.get(name)
+                if v is not None and v > limit:
+                    over.append((b, name, v, limit))
+        return over
+
+    def recommend_replica_concurrency(self, current: int, broker_metrics: dict) -> int:
+        over = self._over_limit(broker_metrics)
+        if over:
+            new = max(self._cfg.adjuster_min_per_broker,
+                      current // self._cfg.adjuster_div_replica)
+        else:
+            new = min(self._cfg.adjuster_max_per_broker,
+                      current + self._cfg.adjuster_add_replica)
+        if new != current:
+            self.history.append({"type": "INTER_BROKER_REPLICA", "from": current,
+                                 "to": new, "overLimit": over[:3]})
+        return new
+
+    def recommend_leadership_concurrency(self, current: int, broker_metrics: dict) -> int:
+        over = self._over_limit(broker_metrics)
+        if over:
+            new = max(self._cfg.adjuster_min_leadership,
+                      current // self._cfg.adjuster_div_leadership)
+        else:
+            new = min(self._cfg.adjuster_max_leadership,
+                      current + self._cfg.adjuster_add_leadership)
+        if new != current:
+            self.history.append({"type": "LEADERSHIP", "from": current,
+                                 "to": new, "overLimit": over[:3]})
+        return new
 
 
 class Executor:
@@ -107,6 +198,7 @@ class Executor:
         self._recently_demoted_brokers: dict[int, float] = {}
         self._execution_thread: threading.Thread | None = None
         self._reservation = None
+        self._adjuster = ConcurrencyAdjuster(self._cfg)
 
     # ---------------------------------------------------------- reservation
     def reserve(self, owner: str) -> None:
@@ -270,6 +362,11 @@ class Executor:
                 t.transition(TaskState.COMPLETED, self._clock.now_ms())
                 for b in t.brokers_involved:
                     in_flight_by_broker[b] = max(0, in_flight_by_broker.get(b, 1) - 1)
+            # dynamic concurrency: AIMD on live broker metrics each progress
+            # tick (ConcurrencyAdjuster role, Executor.java:335-448)
+            if self._cfg.adjuster_enabled:
+                self._cfg.per_broker_cap = self._adjuster.recommend_replica_concurrency(
+                    self._cfg.per_broker_cap, self._backend.broker_metrics())
             if not self._stop_requested:
                 batch = planner.next_inter_broker_tasks(
                     in_flight_by_broker, self._cfg.per_broker_cap,
@@ -324,6 +421,10 @@ class Executor:
         while True:
             if self._stop_requested:
                 return
+            if self._cfg.adjuster_enabled:
+                self._cfg.leadership_cap = \
+                    self._adjuster.recommend_leadership_concurrency(
+                        self._cfg.leadership_cap, self._backend.broker_metrics())
             batch = planner.next_leadership_tasks(self._cfg.leadership_cap)
             if not batch:
                 return
@@ -354,4 +455,10 @@ class Executor:
             out["numAbortedTasks"] = sum(1 for t in tasks
                                          if t.state is TaskState.ABORTED)
         out["executionHistory"] = self._history[-5:]
+        if self._cfg.adjuster_enabled:
+            out["concurrencyAdjuster"] = {
+                "perBrokerCap": self._cfg.per_broker_cap,
+                "leadershipCap": self._cfg.leadership_cap,
+                "recentAdjustments": list(self._adjuster.history)[-5:],
+            }
         return out

@@ -150,3 +150,59 @@ def test_executor_state_json():
     assert st["numTotalTasks"] >= 1
     assert st["numFinishedTasks"] >= 1
     assert st["executionHistory"]
+
+
+def test_concurrency_adjuster_aimd():
+    """ConcurrencyAdjuster (Executor.java:335-448): caps fall multiplicatively
+    under broker latency pressure and recover additively when healthy."""
+    from cruise_control_tpu.executor.executor import (
+        ConcurrencyAdjuster, ExecutorConfigView,
+    )
+    cfg = ExecutorConfigView(per_broker_cap=8, adjuster_enabled=True)
+    adj = ConcurrencyAdjuster(cfg)
+    healthy = {0: {"BROKER_LOG_FLUSH_TIME_MS_999TH": 5.0},
+               1: {"BROKER_LOG_FLUSH_TIME_MS_999TH": 8.0}}
+    slow = {0: {"BROKER_LOG_FLUSH_TIME_MS_999TH": 5.0},
+            1: {"BROKER_LOG_FLUSH_TIME_MS_999TH": 9000.0}}
+    # decrease: 8 -> 4 -> 2 -> 1 -> clamped at min
+    c = 8
+    for expect in (4, 2, 1, 1):
+        c = adj.recommend_replica_concurrency(c, slow)
+        assert c == expect
+    # recovery: +1 per healthy check up to the max (12)
+    for expect in (2, 3, 4):
+        c = adj.recommend_replica_concurrency(c, healthy)
+        assert c == expect
+    # leadership: x/2 down to min 100, +100 up to max
+    lc = adj.recommend_leadership_concurrency(1000, slow)
+    assert lc == 500
+    lc = adj.recommend_leadership_concurrency(150, slow)
+    assert lc == 100
+    lc = adj.recommend_leadership_concurrency(lc, healthy)
+    assert lc == 200
+    assert adj.history and adj.history[0]["overLimit"]
+
+
+def test_concurrency_adjuster_in_execution():
+    """With the adjuster enabled and a slow broker injected, the per-broker
+    cap drops during an execution (integration through _inter_broker_phase)."""
+    from cruise_control_tpu.config import cruise_control_config
+    be = _backend()
+    cfg = cruise_control_config({
+        "concurrency.adjuster.enabled": True,
+        "num.concurrent.partition.movements.per.broker": 8,
+        "execution.progress.check.interval.ms": 10,
+    })
+    be.override_broker_metric(2, "BROKER_PRODUCE_LOCAL_TIME_MS_999TH", 50_000.0)
+    ex = Executor(be, config=cfg)
+    ex.execute_proposals([
+        _move("t", 0, [0, 1], [3, 1], old_leader=0, new_leader=3),
+        _move("t", 1, [1, 2], [3, 2], old_leader=1, new_leader=3),
+    ])
+    assert ex._cfg.per_broker_cap < 8
+    assert ex.state_json()["concurrencyAdjuster"]["recentAdjustments"]
+    # healthy metrics recover the cap on a later execution
+    be.override_broker_metric(2, "BROKER_PRODUCE_LOCAL_TIME_MS_999TH", None)
+    before = ex._cfg.per_broker_cap
+    ex.execute_proposals([_move("t", 2, [2, 0], [1, 0], old_leader=2, new_leader=1)])
+    assert ex._cfg.per_broker_cap > before

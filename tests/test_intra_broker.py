@@ -87,8 +87,8 @@ def test_distribution_goal_balances_disks_within_broker():
     pct = du[0] / 1000.0
     avg = pct.mean()
     assert pct.max() <= avg * 1.09 + 1e-3
-    # goal's own stat strictly decreased
-    assert float(info["stat"]) <= 0.0 + 1e-3 or float(info["stat"]) < 1e6
+    # the violation measure is (near) zero once balanced
+    assert float(info["stat"]) <= 1e-3
 
 
 def test_capacity_accept_vetoes_overfilling_disk_move():
@@ -147,3 +147,32 @@ def test_rebalance_disk_end_to_end():
         if ld is not None:
             used[ld] += info.size_mb
     assert used["/d0"] <= 0.8 * 1000.0 + 100.0
+
+
+def test_distribution_goal_fills_underutilized_disk():
+    """Regression: a below-lower-band logdir must be fillable by draining
+    in-band above-average disks (not only above-upper ones)."""
+    b = ClusterModelBuilder()
+    b.add_broker(0, rack="r0", logdirs=[f"/d{i}" for i in range(4)],
+                 disk_capacity=[1000.0] * 4, capacity={3: 4000.0})
+    b.add_broker(1, rack="r1", logdirs=["/d0"], disk_capacity=[1000.0])
+    p = 0
+    # disks 0-2 at 550 MB (many small replicas), disk 3 at 100 MB
+    for d in range(3):
+        for _ in range(11):
+            b.add_replica("t", p, 0, is_leader=True,
+                          load=[0.1, 1.0, 1.0, 50.0], logdir=f"/d{d}")
+            b.add_replica("t", p, 1, is_leader=False,
+                          load=[0.1, 1.0, 1.0, 50.0])
+            p += 1
+    for _ in range(2):
+        b.add_replica("t", p, 0, is_leader=True,
+                      load=[0.1, 1.0, 1.0, 50.0], logdir="/d3")
+        p += 1
+    ct, meta = b.build()
+    env, st0, st, info = _run("IntraBrokerDiskUsageDistributionGoal", ct, meta)
+    du0 = np.asarray(st0.disk_util)[0]
+    du1 = np.asarray(st.disk_util)[0]
+    assert du1.std() < du0.std()          # cold disk got filled
+    assert du1[3] > du0[3]
+    assert not bool(info["violated_after"])

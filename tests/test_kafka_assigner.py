@@ -1,0 +1,103 @@
+"""Kafka-assigner mode goal tests.
+
+Reference test role: analyzer/kafkaassigner/KafkaAssigner*GoalTest — swap-only
+disk balancing preserves replica counts; even rack-aware spread.
+"""
+import numpy as np
+
+from cruise_control_tpu.analyzer import init_state, make_env
+from cruise_control_tpu.analyzer.engine import EngineParams, optimize_goal
+from cruise_control_tpu.analyzer.goals import make_goal
+from cruise_control_tpu.analyzer.goals.kafka_assigner import kafka_assigner_goal_names
+from cruise_control_tpu.model.builder import ClusterModelBuilder
+
+
+def _disk_skewed_cluster():
+    """4 brokers, equal replica counts, wildly unequal disk load."""
+    b = ClusterModelBuilder()
+    for i in range(4):
+        b.add_broker(i, rack=f"r{i % 2}")
+    p = 0
+    # each broker leads 4 partitions; broker 0's are huge, broker 3's tiny
+    sizes = {0: 900.0, 1: 500.0, 2: 120.0, 3: 30.0}
+    for broker, size in sizes.items():
+        for _ in range(4):
+            b.add_replica("t", p, broker, is_leader=True,
+                          load=[1.0, 10.0, 0.0, size])
+            p += 1
+    return b.build()
+
+
+def _rack_skewed_cluster():
+    """RF=2 partitions all packed into rack r0 (brokers 0,1); r1 empty."""
+    b = ClusterModelBuilder()
+    for i in range(4):
+        b.add_broker(i, rack=f"r{i % 2}")   # 0,2 -> r0 / 1,3 -> r1
+    for p in range(6):
+        b.add_replica("t", p, 0, is_leader=True, load=[1.0, 10.0, 20.0, 100.0])
+        b.add_replica("t", p, 2, is_leader=False, load=[1.0, 10.0, 20.0, 100.0])
+    return b.build()
+
+
+def _run(goal_name, ct, meta):
+    env = make_env(ct, meta)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    goal = make_goal(goal_name)
+    st2, info = optimize_goal(env, st, goal, (), EngineParams(max_iters=64))
+    return env, st, st2, info
+
+
+def test_assigner_disk_goal_swaps_only():
+    ct, meta = _disk_skewed_cluster()
+    env, st0, st, info = _run("KafkaAssignerDiskUsageDistributionGoal", ct, meta)
+    # replica counts preserved on every broker (the assigner-mode contract)
+    np.testing.assert_array_equal(np.asarray(st.replica_count),
+                                  np.asarray(st0.replica_count))
+    # disk imbalance strictly reduced
+    du0 = np.asarray(st0.util)[:, 3]
+    du1 = np.asarray(st.util)[:, 3]
+    assert du1.std() < du0.std()
+    assert int(np.asarray(st.moved).sum()) > 0
+
+
+def test_assigner_even_rack_aware_goal():
+    ct, meta = _rack_skewed_cluster()
+    env, st0, st, info = _run("KafkaAssignerEvenRackAwareGoal", ct, meta)
+    assert not bool(info["violated_after"])
+    # every partition now has replicas in 2 racks (RF=2, 2 racks -> 1 each)
+    prc = np.asarray(st.part_rack_count)
+    assert (prc.max(axis=1) <= 1).all()
+
+
+def test_goal_name_substitution():
+    assert kafka_assigner_goal_names([]) == [
+        "KafkaAssignerEvenRackAwareGoal",
+        "KafkaAssignerDiskUsageDistributionGoal"]
+    out = kafka_assigner_goal_names(
+        ["RackAwareGoal", "DiskUsageDistributionGoal", "ReplicaDistributionGoal"])
+    assert out == ["KafkaAssignerEvenRackAwareGoal",
+                   "KafkaAssignerDiskUsageDistributionGoal",
+                   "ReplicaDistributionGoal"]
+
+
+def test_rebalance_kafka_assigner_mode():
+    from cruise_control_tpu.app import CruiseControl
+    from cruise_control_tpu.backend import SimulatedClusterBackend
+    from cruise_control_tpu.config import cruise_control_config
+    be = SimulatedClusterBackend()
+    for i in range(4):
+        be.add_broker(i, f"r{i % 2}")
+    for p in range(8):
+        be.create_partition("t", p, [p % 2 * 2, p % 2 * 2 + 1], size_mb=100.0 * (1 + p % 4),
+                            bytes_in_rate=10.0, bytes_out_rate=5.0, cpu_util=1.0)
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+    cc.start_up()
+    for i in range(8):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    out = cc.rebalance(kafka_assigner=True, dry_run=True)
+    assert out["operation"] == "REBALANCE"
+    goals_run = [g["goal"] for g in out["result"]["goalSummary"]]
+    assert goals_run == ["KafkaAssignerEvenRackAwareGoal",
+                         "KafkaAssignerDiskUsageDistributionGoal"]
