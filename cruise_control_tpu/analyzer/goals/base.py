@@ -183,6 +183,15 @@ def legit_move_mask(env: ClusterEnv, st: EngineState, cand: Array,
     K = cand.shape[0]
     B = env.num_brokers
     dst_ok = jnp.broadcast_to(env.dst_candidate[None, :], (K, B))
+    # new-broker mode (OptimizationVerifier NEW_BROKERS contract, reference
+    # GoalUtils.eligibleBrokers): when the cluster has new brokers, a replica
+    # may only move ONTO a new broker — unless its ORIGINAL broker is new, in
+    # which case it may move anywhere (e.g. shedding load off an over-full
+    # re-added broker stays legal)
+    new_any = jnp.any(env.broker_new)
+    orig_new = env.broker_new[env.replica_original_broker[cand]]      # [K]
+    new_ok = (~new_any) | env.broker_new[None, :] | orig_new[:, None]
+    dst_ok = dst_ok & new_ok
     cur = st.replica_broker[cand]
     not_self = jnp.arange(B)[None, :] != cur[:, None]
     # duplicate-partition check via the partition membership table: [K, F]
@@ -229,7 +238,15 @@ def legit_swap_mask(env: ClusterEnv, st: EngineState, cand_out: Array,
     ok_r = (env.replica_valid & ~st.replica_offline
             & ~env.topic_excluded[env.replica_topic])
     dst_ok = env.dst_candidate[b_in][None, :] & env.dst_candidate[b_out][:, None]
-    return (diff_broker & out_ok & in_ok & dst_ok
+    # new-broker mode: each directed leg must target a new broker unless the
+    # moving replica's original broker is new (same rule as legit_move_mask)
+    new_any = jnp.any(env.broker_new)
+    orig_new_out = env.broker_new[env.replica_original_broker[cand_out]]  # [K1]
+    orig_new_in = env.broker_new[env.replica_original_broker[cand_in]]   # [K2]
+    new_ok = ((~new_any)
+              | ((env.broker_new[b_in][None, :] | orig_new_out[:, None])
+                 & (env.broker_new[b_out][:, None] | orig_new_in[None, :])))
+    return (diff_broker & out_ok & in_ok & dst_ok & new_ok
             & ok_r[cand_out][:, None] & ok_r[cand_in][None, :])
 
 

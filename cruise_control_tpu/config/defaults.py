@@ -142,6 +142,18 @@ _D.define(name="metric.sampling.interval.ms", type=Type.LONG, default=120_000, v
 _D.define(name="metric.sampler.class", type=Type.CLASS,
           default="cruise_control_tpu.monitor.sampling.samplers.SimulatedMetricSampler",
           doc="MetricSampler plugin (reference default consumes the metrics-reporter topic).")
+_D.define(name="num.metric.fetchers", type=Type.INT, default=1, validator=at_least(1),
+          doc="Parallel sampling fetchers (MetricFetcherManager.java:37 thread pool).")
+_D.define(name="prometheus.server.endpoint", type=Type.STRING, default="",
+          doc="Prometheus HTTP endpoint for PrometheusMetricSampler "
+              "(PrometheusMetricSampler.java PROMETHEUS_SERVER_ENDPOINT_CONFIG).")
+_D.define(name="prometheus.query.resolution.step.ms", type=Type.INT, default=60_000,
+          validator=at_least(1000))
+_D.define(name="prometheus.query.supplier", type=Type.STRING, default="",
+          doc="Custom PrometheusQuerySupplier class ('' = default node/JMX exporter map).")
+_D.define(name="prometheus.broker.id.by.instance", type=Type.STRING, default="",
+          doc='JSON map of Prometheus instance label -> broker id, e.g. '
+              '{"kafka-3.prod:7071": 3}; empty = host-<id> convention.')
 _D.define(name="sample.store.class", type=Type.CLASS,
           default="cruise_control_tpu.monitor.sampling.sample_store.FileSampleStore",
           doc="Durable sample history; replayed on startup (KafkaSampleStore analogue).")

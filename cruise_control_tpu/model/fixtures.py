@@ -151,6 +151,87 @@ def capacity_violated():
     return b.build()
 
 
+def unbalanced():
+    """DeterministicCluster.unbalanced (:206-229): 2 racks / 3 brokers, T1-0
+    and T2-0 (RF=1) both led from broker 0 at half-capacity loads — brokers 1
+    and 2 idle."""
+    b = _homogeneous(RACK_BY_BROKER)
+    load = [TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+            MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2]
+    b.add_replica("T1", 0, broker_id=0, is_leader=True, load=load)
+    b.add_replica("T2", 0, broker_id=0, is_leader=True, load=load)
+    return b.build()
+
+
+def unbalanced2():
+    """DeterministicCluster.unbalanced2 (:157-183): unbalanced() + four more
+    RF=1 partitions, three of them also crowding broker 0 (replica counts
+    5/1/0)."""
+    b = _homogeneous(RACK_BY_BROKER)
+    load = [TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+            MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2]
+    for t, p, broker in (("T1", 0, 0), ("T2", 0, 0), ("T1", 1, 1),
+                         ("T2", 1, 0), ("T1", 2, 0), ("T2", 2, 0)):
+        b.add_replica(t, p, broker_id=broker, is_leader=True, load=load)
+    return b.build()
+
+
+def unbalanced_with_a_follower():
+    """DeterministicCluster.unbalancedWithAFollower (:186-199): unbalanced()
+    plus a follower of T1-0 on broker 2."""
+    b = _homogeneous(RACK_BY_BROKER)
+    load = [TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+            MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2]
+    foll = [TYPICAL_CPU_CAPACITY / 8, LARGE_BROKER_CAPACITY / 2, 0.0,
+            LARGE_BROKER_CAPACITY / 2]
+    b.add_replica("T1", 0, broker_id=0, is_leader=True, load=load)
+    b.add_replica("T2", 0, broker_id=0, is_leader=True, load=load)
+    b.add_replica("T1", 0, broker_id=2, is_leader=False,
+                  leader_load=foll, follower_load=foll)
+    return b.build()
+
+
+def preferred_leader_skewed():
+    """DeterministicCluster.unbalanced3 (:128-150): RF=2, the position-0
+    (preferred) replica of each partition sits on broker 1 but leadership is
+    held by the position-1 replica on broker 0 — PreferredLeaderElectionGoal
+    must move leadership to broker 1."""
+    b = _homogeneous(RACK_BY_BROKER)
+    load = [TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+            MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2]
+    for t in ("T1", "T2"):
+        # insertion order defines replica-list position: broker 1 first
+        b.add_replica(t, 0, broker_id=1, is_leader=False, load=load)
+        b.add_replica(t, 0, broker_id=0, is_leader=True, load=load)
+    return b.build()
+
+
+def rack_aware_satisfiable():
+    """DeterministicCluster.rackAwareSatisfiable (:235-258): one RF=2
+    partition on brokers 0 and 1 — both in rack '0', while broker 2 (rack
+    '1') is free, so RackAwareGoal is satisfiable by one move."""
+    b = _homogeneous(RACK_BY_BROKER)
+    b.add_replica("T1", 0, broker_id=0, is_leader=True,
+                  load=[40.0, 100.0, 130.0, 75.0])
+    b.add_replica("T1", 0, broker_id=1, is_leader=False,
+                  load=[5.0, 100.0, 0.0, 75.0])
+    return b.build()
+
+
+def rack_aware_unsatisfiable():
+    """DeterministicCluster.rackAwareUnsatisfiable (:291-301):
+    rack_aware_satisfiable + a third replica on broker 2 — RF=3 > 2 racks, so
+    RackAwareGoal must fail (OptimizationFailureException parity)."""
+    b = _homogeneous(RACK_BY_BROKER)
+    b.add_replica("T1", 0, broker_id=0, is_leader=True,
+                  load=[40.0, 100.0, 130.0, 75.0])
+    b.add_replica("T1", 0, broker_id=1, is_leader=False,
+                  load=[5.0, 100.0, 0.0, 75.0])
+    b.add_replica("T1", 0, broker_id=2, is_leader=False,
+                  load=[60.0, 100.0, 130.0, 75.0])
+    return b.build()
+
+
 def jbod_cluster():
     """2 brokers x 2 logdirs with one crowded disk (intra-broker goal target)."""
     rack_by_broker = {0: "0", 1: "1"}

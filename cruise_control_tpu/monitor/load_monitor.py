@@ -25,6 +25,7 @@ from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.model.builder import ClusterModelBuilder
 from cruise_control_tpu.monitor.aggregator.sample_aggregator import MetricSampleAggregator
 from cruise_control_tpu.monitor.capacity import DefaultCapacityResolver
+from cruise_control_tpu.monitor.fetcher import MetricFetcherManager
 from cruise_control_tpu.monitor.cpu_model import (
     CpuModelParams, LinearRegressionCpuModel, estimate_follower_cpu_util,
 )
@@ -112,6 +113,9 @@ class LoadMonitor:
         self._model_semaphore = threading.Semaphore(2)  # LoadMonitor.java:92 cluster-model gate
         self.lr_cpu_model = LinearRegressionCpuModel()
         self._bootstrap_progress = 0.0
+        num_fetchers = config.get_int("num.metric.fetchers") if config else 1
+        self._fetchers = MetricFetcherManager(self._sampler, num_fetchers) \
+            if self._sampler is not None else None
 
     # ------------------------------------------------------------ lifecycle
     def start_up(self) -> int:
@@ -205,6 +209,8 @@ class LoadMonitor:
     def shutdown(self):
         if self._store is not None:
             self._store.close()
+        if self._fetchers is not None:
+            self._fetchers.close()
         if self._sampler is not None:
             self._sampler.close()
         self._state = LoadMonitorState.NOT_STARTED
@@ -235,7 +241,13 @@ class LoadMonitor:
         if self._state == LoadMonitorState.PAUSED or self._sampler is None:
             return 0
         now = now_ms if now_ms is not None else time.time() * 1000.0
-        samples = self._sampler.get_samples(now)
+        # the fetcher pool splits the partition universe across concurrent
+        # fetchers (MetricFetcherManager + partition assignor role)
+        if self._fetchers is not None and self._backend is not None:
+            samples = self._fetchers.fetch_once(
+                now, list(self._backend.partitions()))
+        else:
+            samples = self._sampler.get_samples(now)
         n = self._ingest(samples)
         if self._store is not None:
             self._store.store_samples(samples)
@@ -315,9 +327,16 @@ class LoadMonitor:
                     per = cap_info.capacity[Resource.DISK] / len(logdirs)
                     disk_caps = [cap_info.disk_capacity_by_logdir.get(ld, per)
                                  for ld in logdirs]
-                else:
+                elif cap_info.estimated:
+                    # estimation fallback: the backend's reported logdir sizes
+                    # stand in for unknown real capacities
                     per = cap_info.capacity[Resource.DISK] / len(logdirs)
                     disk_caps = [node.logdirs.get(ld, per) for ld in logdirs]
+                else:
+                    # a configured resolver entry is authoritative
+                    # (BrokerCapacityConfigFileResolver precedence)
+                    per = cap_info.capacity[Resource.DISK] / len(logdirs)
+                    disk_caps = [per] * len(logdirs)
                 dead = set(node.dead_logdirs)
                 dead |= {ld for ld, ok in logdir_state.get(b, {}).items() if not ok}
                 builder.add_broker(

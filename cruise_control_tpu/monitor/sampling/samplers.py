@@ -39,7 +39,13 @@ class Samples:
 class MetricSampler(Protocol):
     def configure(self, config, **extra) -> None: ...
 
-    def get_samples(self, now_ms: float) -> Samples: ...
+    def get_samples(self, now_ms: float, partitions=None,
+                    include_broker_samples: bool = True) -> Samples:
+        """``partitions`` (optional list of (topic, partition)) restricts the
+        fetch to a fetcher's assigned subset (MetricFetcherManager role);
+        None = everything. ``include_broker_samples=False`` skips the broker-
+        level fetch (only one fetcher per round collects it)."""
+        ...
 
     def close(self) -> None: ...
 
@@ -50,7 +56,8 @@ class NoopSampler:
     def configure(self, config, **extra):
         pass
 
-    def get_samples(self, now_ms: float) -> Samples:
+    def get_samples(self, now_ms: float, partitions=None,
+                    include_broker_samples: bool = True) -> Samples:
         return Samples([], [])
 
     def close(self):
@@ -69,13 +76,17 @@ class SimulatedMetricSampler:
         if backend is not None:
             self._backend = backend
 
-    def get_samples(self, now_ms: float) -> Samples:
+    def get_samples(self, now_ms: float, partitions=None,
+                    include_broker_samples: bool = True) -> Samples:
         if self._backend is None:
             return Samples([], [])
+        wanted = set(partitions) if partitions is not None else None
         psamples = [PartitionSample(topic=t, partition=p, ts_ms=now_ms, values=vals)
-                    for (t, p), vals in self._backend.partition_metrics().items()]
+                    for (t, p), vals in self._backend.partition_metrics().items()
+                    if wanted is None or (t, p) in wanted]
         bsamples = [BrokerSample(broker_id=b, ts_ms=now_ms, values=vals)
-                    for b, vals in self._backend.broker_metrics().items()]
+                    for b, vals in self._backend.broker_metrics().items()] \
+            if include_broker_samples else []
         return Samples(psamples, bsamples)
 
     def close(self):

@@ -1,0 +1,76 @@
+"""OptimizationVerifier analogue.
+
+Reference: analyzer/OptimizationVerifier.java:53 — after an optimization,
+assert (NEW_BROKERS) a new-broker rebalance only moves replicas TO the new
+brokers, (BROKEN_BROKERS) dead brokers end up empty with no offline replicas,
+(REGRESSION, :94-117) no per-resource distribution statistic regresses, plus
+goal-specific invariants handled by the per-goal tests.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def verify_new_brokers(ct, meta, res) -> None:
+    """Replicas may only move onto brokers flagged new (OptimizationVerifier
+    NEW_BROKERS)."""
+    new_ids = {meta.broker_ids[i]
+               for i in np.flatnonzero(np.asarray(ct.broker_new))}
+    for p in res.proposals:
+        added = set(p.replicas_to_add)
+        assert added <= new_ids, (
+            f"{p.tp}: replicas moved to non-new brokers {added - new_ids}")
+
+
+def verify_broken_brokers(ct, meta, res) -> None:
+    """Dead brokers end up empty; nothing remains offline (BROKEN_BROKERS)."""
+    st = res.final_state
+    alive = np.asarray(res.env.broker_alive)
+    rb = np.asarray(st.replica_broker)
+    valid = np.asarray(res.env.replica_valid)
+    on_dead = valid & ~alive[rb]
+    assert not on_dead.any(), f"{int(on_dead.sum())} replicas left on dead brokers"
+    assert not (np.asarray(st.replica_offline) & valid).any(), \
+        "offline replicas remain after optimization"
+
+
+_DIST_GOAL_BY_RESOURCE = {
+    0: "CpuUsageDistributionGoal",
+    1: "NetworkInboundUsageDistributionGoal",
+    2: "NetworkOutboundUsageDistributionGoal",
+    3: "DiskUsageDistributionGoal",
+}
+
+
+def verify_no_regression(res) -> None:
+    """Distribution statistics must not regress (OptimizationVerifier
+    :94-117: every goal's stats-comparator must rate the post state >= the
+    pre state). A higher std is only a regression when the owning
+    distribution goal also ends VIOLATED — earlier hard goals may legally
+    trade balance for feasibility as long as the state stays in-band."""
+    before, after = res.stats_before, res.stats_after
+    violated = set(res.violated_goals_after)
+    for r, goal_name in _DIST_GOAL_BY_RESOURCE.items():
+        if not before["std"] or goal_name not in {g.name for g in res.goal_results}:
+            continue
+        b, a = before["std"][r], after["std"][r]
+        assert not (a > b * 1.0001 + 1e-6 and goal_name in violated), \
+            f"resource {r} std regressed {b:.4f} -> {a:.4f} with {goal_name} violated"
+    if "ReplicaDistributionGoal" in {g.name for g in res.goal_results}:
+        b, a = before["replica_count_std"], after["replica_count_std"]
+        assert not (a > b * 1.0001 + 1e-6
+                    and "ReplicaDistributionGoal" in violated), \
+            f"replica-count std regressed {b:.4f} -> {a:.4f} while violated"
+    assert after["num_offline_replicas"] <= before["num_offline_replicas"]
+
+
+def verify(ct, meta, res, verifications=("REGRESSION",)) -> None:
+    for v in verifications:
+        if v == "NEW_BROKERS":
+            verify_new_brokers(ct, meta, res)
+        elif v == "BROKEN_BROKERS":
+            verify_broken_brokers(ct, meta, res)
+        elif v == "REGRESSION":
+            verify_no_regression(res)
+        else:
+            raise ValueError(f"unknown verification {v}")

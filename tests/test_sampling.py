@@ -1,0 +1,257 @@
+"""Prometheus sampler, parallel fetcher manager and capacity-file tests.
+
+Reference test roles: PrometheusMetricSamplerTest (canned query responses),
+MetricFetcherManager partition assignment, BrokerCapacityConfigFileResolver
+capacity*.json parsing.
+"""
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from cruise_control_tpu.backend import SimulatedClusterBackend
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.monitor import LoadMonitor
+from cruise_control_tpu.monitor.capacity import FileCapacityResolver
+from cruise_control_tpu.monitor.fetcher import MetricFetcherManager, assign_partitions
+from cruise_control_tpu.monitor.sampling.prometheus import (
+    PrometheusAdapter, PrometheusMetricSampler,
+)
+from cruise_control_tpu.monitor.sampling.samplers import SimulatedMetricSampler
+
+
+# --------------------------------------------------------------- prometheus
+def _series(instance, values, topic=None, partition=None):
+    metric = {"instance": instance}
+    if topic is not None:
+        metric.update(topic=topic, partition=str(partition))
+    return {"metric": metric, "values": [[i * 60, str(v)]
+                                         for i, v in enumerate(values)]}
+
+
+class _FakePrometheus(BaseHTTPRequestHandler):
+    """Serves canned /api/v1/query_range responses keyed by query content."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+        query = q["query"][0]
+        if "node_cpu_seconds_total" in query:
+            result = [_series("host-0:7071", [20.0, 40.0]),
+                      _series("host-1:7071", [10.0, 10.0])]
+        elif 'name="BytesInPerSec",topic=""' in query:
+            result = [_series("host-0:7071", [1000.0]),
+                      _series("host-1:7071", [500.0])]
+        elif 'name="Size"' in query:
+            result = [_series("host-0:7071", [4096.0], topic="t", partition=0),
+                      _series("host-1:7071", [8192.0], topic="t", partition=1)]
+        elif 'name="BytesInPerSec",topic!=""' in query:
+            result = [_series("host-0:7071", [100.0, 200.0], topic="t", partition=0)]
+        else:
+            result = []
+        body = json.dumps({"status": "success",
+                           "data": {"resultType": "matrix", "result": result}})
+        payload = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+@pytest.fixture()
+def prometheus_url():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _FakePrometheus)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_prometheus_adapter_query_range(prometheus_url):
+    adapter = PrometheusAdapter(prometheus_url)
+    result = adapter.query_range('up{name="Size"}', 0, 120, 60)
+    assert result and result[0]["metric"]["topic"] == "t"
+
+
+def test_prometheus_sampler_maps_instances_to_brokers(prometheus_url):
+    sampler = PrometheusMetricSampler(
+        endpoint=prometheus_url,
+        broker_id_by_host={"host-0": 0, "host-1": 1})
+    samples = sampler.get_samples(now_ms=240_000.0)
+    by_broker = {s.broker_id: s.values for s in samples.broker_samples}
+    assert by_broker[0]["BROKER_CPU_UTIL"] == pytest.approx(30.0)  # avg 20,40
+    assert by_broker[1]["ALL_TOPIC_BYTES_IN"] == pytest.approx(500.0)
+    by_tp = {(s.topic, s.partition): s.values for s in samples.partition_samples}
+    assert by_tp[("t", 0)]["DISK_USAGE"] == pytest.approx(4096.0)
+    assert by_tp[("t", 0)]["LEADER_BYTES_IN"] == pytest.approx(150.0)
+    assert by_tp[("t", 1)]["DISK_USAGE"] == pytest.approx(8192.0)
+
+
+def test_prometheus_sampler_partition_subset(prometheus_url):
+    sampler = PrometheusMetricSampler(
+        endpoint=prometheus_url, broker_id_by_host={"host-0": 0, "host-1": 1})
+    samples = sampler.get_samples(now_ms=240_000.0, partitions=[("t", 1)])
+    assert {(s.topic, s.partition) for s in samples.partition_samples} == {("t", 1)}
+
+
+def test_prometheus_sampler_feeds_load_monitor(prometheus_url):
+    """Full path: Prometheus -> aggregator -> cluster model."""
+    be = SimulatedClusterBackend()
+    be.add_broker(0, "r0").add_broker(1, "r1")
+    be.create_partition("t", 0, [0, 1])
+    be.create_partition("t", 1, [1, 0])
+    sampler = PrometheusMetricSampler(
+        endpoint=prometheus_url, broker_id_by_host={"host-0": 0, "host-1": 1})
+    lm = LoadMonitor(backend=be, sampler=sampler)
+    lm.start_up()
+    for i in range(8):
+        lm.sample_once(now_ms=i * 300_000.0)
+    ct, meta = lm.cluster_model()
+    import numpy as np
+    util = np.asarray(ct.broker_utilization())
+    assert util[0, Resource.DISK] == pytest.approx(4096.0 + 8192.0, rel=1e-3)
+
+
+# ------------------------------------------------------------ fetcher pool
+def test_assign_partitions_round_robin():
+    tps = [("t", i) for i in range(10)]
+    groups = assign_partitions(tps, 4)
+    assert len(groups) == 4
+    assert sorted(sum(groups, [])) == sorted(tps)
+    sizes = sorted(len(g) for g in groups)
+    assert sizes == [2, 2, 3, 3]
+
+
+class _CountingSampler(SimulatedMetricSampler):
+    def __init__(self, backend):
+        super().__init__(backend)
+        self.calls = []
+        self._lock = threading.Lock()
+
+    def get_samples(self, now_ms, partitions=None, include_broker_samples=True):
+        with self._lock:
+            self.calls.append(partitions)
+        return super().get_samples(
+            now_ms, partitions=partitions,
+            include_broker_samples=include_broker_samples)
+
+
+def test_fetcher_manager_parallel_merge():
+    be = SimulatedClusterBackend()
+    for b in range(2):
+        be.add_broker(b, f"r{b}")
+    for p in range(9):
+        be.create_partition("t", p, [p % 2, (p + 1) % 2], size_mb=10.0)
+    sampler = _CountingSampler(be)
+    mgr = MetricFetcherManager(sampler, num_fetchers=3)
+    samples = mgr.fetch_once(1000.0, list(be.partitions()))
+    assert len(sampler.calls) == 3                      # one call per fetcher
+    assert all(c is not None for c in sampler.calls)    # each got a subset
+    tps = {(s.topic, s.partition) for s in samples.partition_samples}
+    assert len(tps) == 9                                # merged, no loss
+    brokers = [s.broker_id for s in samples.broker_samples]
+    assert sorted(brokers) == [0, 1]                    # deduped
+    mgr.close()
+
+
+def test_load_monitor_with_fetcher_pool():
+    from cruise_control_tpu.config import cruise_control_config
+    be = SimulatedClusterBackend()
+    for b in range(3):
+        be.add_broker(b, f"r{b}")
+    for p in range(7):
+        be.create_partition("t", p, [p % 3, (p + 1) % 3], size_mb=100.0,
+                            bytes_in_rate=10.0)
+    cfg = cruise_control_config({"num.metric.fetchers": 4,
+                                 "min.samples.per.metrics.window": 1})
+    lm = LoadMonitor(config=cfg, backend=be, sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    for i in range(8):
+        lm.sample_once(now_ms=i * 300_000.0)
+    ct, meta = lm.cluster_model()
+    assert int(ct.replica_valid.sum()) == 14
+    lm.shutdown()
+
+
+# --------------------------------------------------------- capacity files
+def test_file_capacity_resolver_jbod(tmp_path):
+    path = tmp_path / "capacityJBOD.json"
+    path.write_text(json.dumps({"brokerCapacities": [
+        {"brokerId": "-1", "capacity": {
+            "CPU": "100", "NW_IN": "10000", "NW_OUT": "10000",
+            "DISK": {"/a": "250000", "/b": "250000"}}},
+        {"brokerId": "0", "capacity": {
+            "CPU": "200", "NW_IN": "20000", "NW_OUT": "20000",
+            "DISK": {"/a": "100000", "/b": "300000", "/c": "100000"}}},
+    ]}))
+    r = FileCapacityResolver(str(path))
+    info0 = r.capacity_for(0)
+    assert info0.capacity[Resource.CPU] == 200.0
+    assert info0.capacity[Resource.DISK] == 500_000.0
+    assert info0.disk_capacity_by_logdir == {"/a": 100_000.0, "/b": 300_000.0,
+                                             "/c": 100_000.0}
+    # unknown broker falls through to the -1 default entry
+    info9 = r.capacity_for(9)
+    assert info9.capacity[Resource.NW_IN] == 10_000.0
+    assert info9.disk_capacity_by_logdir == {"/a": 250_000.0, "/b": 250_000.0}
+
+
+def test_file_capacity_resolver_via_config_plugin(tmp_path):
+    from cruise_control_tpu.config import cruise_control_config
+    path = tmp_path / "capacity.json"
+    path.write_text(json.dumps({"brokerCapacities": [
+        {"brokerId": "-1", "capacity": {"CPU": "100", "NW_IN": "9999",
+                                        "NW_OUT": "9999", "DISK": "777"}}]}))
+    cfg = cruise_control_config({"capacity.config.file": str(path),
+                                 "min.samples.per.metrics.window": 1})
+    be = SimulatedClusterBackend()
+    be.add_broker(0, "r0")
+    be.create_partition("t", 0, [0], size_mb=10.0)
+    lm = LoadMonitor(config=cfg, backend=be)
+    lm.start_up()
+    for i in range(6):
+        lm.sample_once(now_ms=i * 300_000.0)
+    ct, meta = lm.cluster_model()
+    import numpy as np
+    cap = np.asarray(ct.broker_capacity)
+    assert cap[0, Resource.DISK] == pytest.approx(777.0)
+    assert cap[0, Resource.NW_IN] == pytest.approx(9999.0)
+
+
+def test_fetcher_manager_isolates_failures():
+    """One failing fetcher must not discard the other fetchers' samples
+    (SamplingFetcher per-task error isolation)."""
+    be = SimulatedClusterBackend()
+    be.add_broker(0, "r0")
+    for p in range(6):
+        be.create_partition("t", p, [0], size_mb=10.0)
+
+    class Flaky(SimulatedMetricSampler):
+        def get_samples(self, now_ms, partitions=None,
+                        include_broker_samples=True):
+            if partitions and ("t", 1) in partitions:
+                raise ConnectionError("transient fetch failure")
+            return super().get_samples(
+                now_ms, partitions=partitions,
+                include_broker_samples=include_broker_samples)
+
+    mgr = MetricFetcherManager(Flaky(be), num_fetchers=3)
+    samples = mgr.fetch_once(1000.0, list(be.partitions()))
+    got = {(s.topic, s.partition) for s in samples.partition_samples}
+    assert got and ("t", 1) not in got          # partial, not empty
+    mgr.close()
+
+    class AlwaysBroken(SimulatedMetricSampler):
+        def get_samples(self, *a, **kw):
+            raise ConnectionError("down")
+
+    mgr2 = MetricFetcherManager(AlwaysBroken(be), num_fetchers=2)
+    with pytest.raises(RuntimeError, match="all metric fetchers failed"):
+        mgr2.fetch_once(1000.0, list(be.partitions()))
+    mgr2.close()
