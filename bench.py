@@ -37,7 +37,8 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2) -> dict:
+def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
+             profile: bool = False) -> dict:
     from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
 
     opt = GoalOptimizer()
@@ -45,9 +46,12 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2) -> dict:
     res = None
     for i in range(repeats):
         t0 = time.monotonic()
+        # default: async-pipelined chain (one device round-trip); --profile
+        # blocks per goal for honest goal_seconds at the cost of wall clock
         res = opt.optimizations(ct, meta, goal_names=goal_names,
                                 raise_on_failure=False,
-                                skip_hard_goal_check=True)
+                                skip_hard_goal_check=True,
+                                measure_goal_durations=profile)
         walls.append(time.monotonic() - t0)
         log(f"  [{name}] run {i}: {walls[-1]:.2f}s")
     rung = {
@@ -60,8 +64,10 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2) -> dict:
         "budget_exhausted": [g.name for g in res.goal_results if g.hit_max_iters],
         "num_replica_movements": res.num_replica_movements,
         "num_leadership_movements": res.num_leadership_movements,
-        "goal_seconds": {g.name: round(g.duration_s, 3) for g in res.goal_results},
     }
+    if profile:
+        rung["goal_seconds"] = {g.name: round(g.duration_s, 3)
+                                for g in res.goal_results}
     log(f"  [{name}] violations {rung['violations_before']} -> "
         f"{rung['violations_after']}  moves={rung['num_replica_movements']} "
         f"warm={rung['wall_s']}s")
@@ -74,7 +80,17 @@ def main() -> None:
         RandomClusterSpec, generate, generate_scale,
     )
 
-    only = sys.argv[1] if len(sys.argv) > 1 else None
+    args = [a for a in sys.argv[1:] if a != "--profile"]
+    profile = "--profile" in sys.argv[1:]
+    if profile:
+        # per-goal blocking for goal_seconds: threads through every rung
+        global run_rung
+        _orig = run_rung
+
+        def run_rung(*a, **kw):  # noqa: F811
+            kw.setdefault("profile", True)
+            return _orig(*a, **kw)
+    only = args[0] if args else None
     rungs = []
 
     t_all = time.monotonic()

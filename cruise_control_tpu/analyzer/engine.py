@@ -43,11 +43,25 @@ from cruise_control_tpu.analyzer.goals.base import (
     legit_swap_mask,
 )
 from cruise_control_tpu.analyzer.state import (
-    EngineState, apply_disk_move, apply_leadership, apply_move, apply_swap,
+    EngineState, apply_disk_move, apply_leadership, apply_move,
+    apply_moves_batched, apply_swap,
 )
 
 Array = jax.Array
 NEG_INF = -jnp.inf
+
+
+def _top_candidates(key: Array, k: int, exact: bool = False):
+    """Candidate selection. Soft goals use approximate top-k
+    (jax.lax.approx_max_k, recall 0.95) — the TPU-native partial reduction is
+    far cheaper than the exact variadic sort at R ~ 1M, and a soft goal
+    plateauing slightly early is within its contract. HARD goals pass
+    ``exact=True``: an approx selection could deterministically drop the sole
+    fixing candidate at a stall fixpoint, turning a satisfiable hard goal
+    into a spurious OptimizationFailureError."""
+    if exact or k >= key.shape[0]:
+        return jax.lax.top_k(key, k)
+    return jax.lax.approx_max_k(key, k, recall_target=0.95)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,6 +70,7 @@ class EngineParams:
     num_candidates: int = 64          # K: replica-move candidates per iteration
     num_leader_candidates: int = 32   # KL: leadership candidates per iteration
     num_swap_candidates: int = 32     # K1/K2: swap-out / swap-in candidates
+    num_dst_choices: int = 16         # T: per-row destination spread (wave width)
     min_gain: float = 1e-9            # scores below this count as no progress
 
 
@@ -73,21 +88,35 @@ def _rescore_move_row(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
 def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                          prev_goals: tuple, params: EngineParams, severity: Array):
-    """Score once to ORDER candidates, then apply up to K moves per pass,
-    re-validating each against the running state.
+    """Score once, wave-apply the independent winners, re-score leftovers.
 
-    The [K, B] scoring pass picks and orders candidates; the per-move
-    re-score (`_rescore_move_row`, a [1, B] row: legitimacy + self-score +
-    prev-goal acceptance, all against the state with earlier moves of this
-    pass applied) makes every applied move exactly as valid as a fresh
-    scoring pass would — multiple moves may share a source or destination
-    broker, because the second move sees the first move's utilization. The
-    re-score row costs O(B·(1+|prev|)) vs the O(R·logK + K·B) full pass, so
-    a pass lands up to K moves for ~2x the cost of landing one — the lever
-    that replaces ~N sequential scoring passes with ~N/K at 7k-broker scale
-    (reference hot loop: ResourceDistributionGoal.java:384-862)."""
+    A pass is three stages:
+
+    1. SCORE [K, B]: rank candidate replicas (top-k of the goal's key),
+       mask by legitimacy + prev-goal acceptance, score every destination.
+    2. WAVE (vectorized): each sorted candidate is assigned one of its top-T
+       destinations by position (row j takes its (j mod T)-th best) — goals
+       whose destination ranking is row-independent (capacity headroom, rack
+       utilization) would otherwise point every row at the SAME best broker
+       and starve the wave. A candidate WINS iff, in score order, it is the
+       FIRST use of its source broker, the first use of its assigned
+       destination (in either role) and the first touch of its partition.
+       Winners are mutually independent — every broker participates at most
+       once, in one role — so each is exactly as valid as it scored; they
+       all apply in ONE batched scatter update (`apply_moves_batched`).
+       First-use is a scatter-min, not a scan, so the whole wave costs a
+       handful of vector ops.
+    3. LEFTOVERS (sequential, dynamically bounded): positively-scored
+       non-winners are re-validated one at a time against the running state
+       (`_rescore_move_row`) — the path that matters when severity is
+       concentrated on few brokers and waves are thin.
+
+    Compared to one-move-per-pass, a pass lands up to K moves for little
+    more than one scoring sweep (reference hot loop it replaces:
+    ResourceDistributionGoal.java:384-862)."""
     key = goal.replica_key(env, st, severity)
-    kv, cand = jax.lax.top_k(key, min(params.num_candidates, env.num_replicas))
+    kv, cand = _top_candidates(key, min(params.num_candidates, env.num_replicas),
+                               exact=goal.is_hard)
     mask = legit_move_mask(env, st, cand, goal.options)
     for g in prev_goals:
         mask = mask & g.accept_move(env, st, cand)
@@ -95,23 +124,70 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
     best_val = jnp.max(score, axis=1)                               # [K]
     order = jnp.argsort(-best_val)                                  # best first
+    K = score.shape[0]
+    n_pos = jnp.sum(best_val > params.min_gain).astype(jnp.int32)
+
+    # ---- stage 2: independent-wave selection in score order ----
+    r_sorted = cand[order]                                          # [K]
+    src_s = st.replica_broker[r_sorted]
+    p_s = env.replica_partition[r_sorted]
+    posn = jnp.arange(K, dtype=jnp.int32)
+    # per-row destination spread: row at sorted position j prefers its best
+    # destination within column class (j mod T) whenever that class holds ANY
+    # positive-scoring destination, else falls back to its global best — rows
+    # with identical preference rankings (capacity headroom, rack utilization)
+    # fan out across T destination classes instead of all colliding on one
+    # broker and starving the wave; correctness is untouched because the
+    # applied value is the REAL score at the chosen destination
+    T = min(params.num_dst_choices, env.num_brokers)
+    score_s = score[order]                                          # [K, B]
+    colid = jnp.arange(env.num_brokers, dtype=jnp.int32)[None, :]
+    affinity = (colid % T) == (posn[:, None] % T)
+    aff_score = jnp.where(affinity, score_s, NEG_INF)
+    aff_dst = jnp.argmax(aff_score, axis=1).astype(jnp.int32)
+    aff_val = aff_score[posn, aff_dst]
+    glob_dst = jnp.argmax(score_s, axis=1).astype(jnp.int32)
+    use_aff = aff_val > params.min_gain
+    dst_s = jnp.where(use_aff, aff_dst, glob_dst)
+    val_s = jnp.where(use_aff, aff_val, score_s[posn, glob_dst])
+    wave_ok = val_s > params.min_gain
+    INF = jnp.int32(K + 1)
+    guarded = jnp.where(wave_ok, posn, INF)
+    B = env.num_brokers
+    first_broker = (jnp.full(B, INF, jnp.int32)
+                    .at[src_s].min(guarded).at[dst_s].min(guarded))
+    first_part = jnp.full(env.num_partitions, INF, jnp.int32).at[p_s].min(guarded)
+    win = (wave_ok & (first_broker[src_s] == posn)
+           & (first_broker[dst_s] == posn) & (first_part[p_s] == posn))
+    st = apply_moves_batched(env, st, r_sorted, dst_s, win)
+    n_applied = jnp.sum(win).astype(jnp.int32)
+
+    # ---- stage 3: sequential leftovers, re-scored against the live state.
+    # Only worth running when the wave was THIN (severity concentrated on few
+    # brokers, where waves land ~1 move): a fat wave means the next pass will
+    # re-score everything anyway, so leftovers just wait for it. Leftover
+    # positions are compacted to the front so the loop runs exactly as many
+    # steps as there are leftovers.
+    pos_ok = best_val[order] > params.min_gain
+    leftover = pos_ok & ~win
+    n_lo = jnp.sum(leftover).astype(jnp.int32)
+    lo_order = jnp.argsort(~leftover)            # leftover positions first
 
     def body(i, carry):
-        st, n_applied = carry
-        k = order[i]
-        r = cand[k]
+        st, n = carry
+        r = r_sorted[lo_order[i]]
         row = _rescore_move_row(env, st, goal, prev_goals, r)
         d = jnp.argmax(row).astype(jnp.int32)
-        ok = (best_val[k] > params.min_gain) & (row[d] > params.min_gain)
-        st = jax.lax.cond(ok, lambda s: apply_move(env, s, r, d), lambda s: s, st)
-        return st, n_applied + ok.astype(jnp.int32)
+        ok = row[d] > params.min_gain
+        st = apply_move(env, st, r, d, enabled=ok)
+        return st, n + ok.astype(jnp.int32)
 
-    K = score.shape[0]
-    # skip the K-step apply loop entirely on a stall pass (nothing scored > 0)
-    st, n_applied = jax.lax.cond(
-        jnp.max(best_val) > params.min_gain,
-        lambda s: jax.lax.fori_loop(0, K, body, (s, jnp.int32(0))),
-        lambda s: (s, jnp.int32(0)), st)
+    # gate via a zero trip count, NOT lax.cond: a cond carrying the full
+    # EngineState defeats XLA's buffer aliasing and copies ~hundreds of MB
+    # per pass at 1M-replica scale; a while-loop with 0 iterations aliases
+    wave_thin = n_applied * 8 < n_pos
+    trip = jnp.where(wave_thin, jnp.minimum(n_lo, K), 0)
+    st, n_applied = jax.lax.fori_loop(0, trip, body, (st, n_applied))
     return st, n_applied
 
 
@@ -122,8 +198,9 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
     [KL, F] scoring pass, then apply up to KL transfers, re-scoring each
     [1, F] row against the running state."""
     lkey = goal.leader_key(env, st, severity)
-    lkv, lcand = jax.lax.top_k(lkey, min(params.num_leader_candidates,
-                                         env.num_replicas))
+    lkv, lcand = _top_candidates(lkey, min(params.num_leader_candidates,
+                                           env.num_replicas),
+                                 exact=goal.is_hard)
     lmask = legit_leadership_mask(env, st, lcand)
     for g in prev_goals:
         lmask = lmask & g.accept_leadership(env, st, lcand)
@@ -144,16 +221,13 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
         f = jnp.argmax(s1)
         dst = env.partition_replicas[env.replica_partition[r], f]
         ok = (best_val[k] > params.min_gain) & (s1[f] > params.min_gain)
-        st = jax.lax.cond(
-            ok, lambda s: apply_leadership(env, s, r, jnp.clip(dst, 0)),
-            lambda s: s, st)
+        st = apply_leadership(env, st, r, jnp.clip(dst, 0), enabled=ok)
         return st, n_applied + ok.astype(jnp.int32)
 
     KL = lscore.shape[0]
-    st, n_applied = jax.lax.cond(
-        jnp.max(best_val) > params.min_gain,
-        lambda s: jax.lax.fori_loop(0, KL, body, (s, jnp.int32(0))),
-        lambda s: (s, jnp.int32(0)), st)
+    n_pos = jnp.sum(best_val > params.min_gain).astype(jnp.int32)
+    st, n_applied = jax.lax.fori_loop(0, jnp.minimum(n_pos, KL), body,
+                                      (st, jnp.int32(0)))
     return st, n_applied
 
 
@@ -176,8 +250,8 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     k = min(params.num_swap_candidates, env.num_replicas)
     okey = goal.swap_out_key(env, st, severity)
     ikey = goal.swap_in_key(env, st, severity)
-    okv, cand_out = jax.lax.top_k(okey, k)
-    ikv, cand_in = jax.lax.top_k(ikey, k)
+    okv, cand_out = _top_candidates(okey, k, exact=goal.is_hard)
+    ikv, cand_in = _top_candidates(ikey, k, exact=goal.is_hard)
     mask = legit_swap_mask(env, st, cand_out, cand_in)
     for g in prev_goals:
         mask = mask & g.accept_swap(env, st, cand_out, cand_in)
@@ -194,14 +268,12 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         r_out, r_in = cand_out[oi], cand_in[ij]
         v = _rescore_swap_pair(env, st, goal, prev_goals, r_out, r_in)
         ok = (best_flat[i] > params.min_gain) & (v > params.min_gain)
-        st = jax.lax.cond(ok, lambda s: apply_swap(env, s, r_out, r_in),
-                          lambda s: s, st)
+        st = apply_swap(env, st, r_out, r_in, enabled=ok)
         return st, n_applied + ok.astype(jnp.int32)
 
-    st, n_applied = jax.lax.cond(
-        best_flat[0] > params.min_gain,
-        lambda s: jax.lax.fori_loop(0, S, body, (s, jnp.int32(0))),
-        lambda s: (s, jnp.int32(0)), st)
+    n_pos = jnp.sum(best_flat > params.min_gain).astype(jnp.int32)
+    st, n_applied = jax.lax.fori_loop(0, jnp.minimum(n_pos, S), body,
+                                      (st, jnp.int32(0)))
     return st, n_applied
 
 
@@ -223,7 +295,8 @@ def _disk_move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel
     logdirs of each candidate's own broker (IntraBrokerDiskUsageDistribution
     Goal.java:518 hot loop role). [K, D] scoring, per-move [1, D] re-score."""
     key = goal.replica_key(env, st, severity)
-    kv, cand = jax.lax.top_k(key, min(params.num_candidates, env.num_replicas))
+    kv, cand = _top_candidates(key, min(params.num_candidates, env.num_replicas),
+                               exact=goal.is_hard)
     mask = legit_disk_move_mask(env, st, cand)
     for g in prev_goals:
         mask = mask & g.accept_disk_move(env, st, cand)
@@ -239,27 +312,34 @@ def _disk_move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel
         row = _rescore_disk_move_row(env, st, goal, prev_goals, r)
         d = jnp.argmax(row).astype(jnp.int32)
         ok = (best_val[k] > params.min_gain) & (row[d] > params.min_gain)
-        st = jax.lax.cond(ok, lambda s: apply_disk_move(env, s, r, d),
-                          lambda s: s, st)
+        st = apply_disk_move(env, st, r, d, enabled=ok)
         return st, n_applied + ok.astype(jnp.int32)
 
     K = score.shape[0]
-    st, n_applied = jax.lax.cond(
-        jnp.max(best_val) > params.min_gain,
-        lambda s: jax.lax.fori_loop(0, K, body, (s, jnp.int32(0))),
-        lambda s: (s, jnp.int32(0)), st)
+    n_pos = jnp.sum(best_val > params.min_gain).astype(jnp.int32)
+    st, n_applied = jax.lax.fori_loop(0, jnp.minimum(n_pos, K), body,
+                                      (st, jnp.int32(0)))
     return st, n_applied
 
 
 def optimize_goal(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-                  prev_goals: tuple = (), params: EngineParams = EngineParams()):
-    """Run one goal to completion. Returns (state, info dict)."""
-    fn = _compiled_optimize(type(goal), goal, tuple(prev_goals), params)
+                  prev_goals: tuple = (), params: EngineParams = EngineParams(),
+                  donate_state: bool = False):
+    """Run one goal to completion. Returns (state, info dict).
+
+    ``donate_state=True`` donates the input state's buffers to the program —
+    the caller must not touch ``st`` afterwards. The optimizer chain passes
+    it because each goal consumes the previous goal's output; without
+    donation XLA preserves the inputs, which costs a full state copy
+    (~hundreds of MB) per goal at 1M-replica scale."""
+    fn = _compiled_optimize(type(goal), goal, tuple(prev_goals), params,
+                            donate_state)
     return fn(env, st)
 
 
 @lru_cache(maxsize=256)
-def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple, params: EngineParams):
+def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
+                       params: EngineParams, donate_state: bool = False):
     """Build + cache the jitted loop for a (goal, prev_goals, params) combo.
 
     Goals are frozen dataclasses, hashable by value, so the cache key is the
@@ -268,7 +348,7 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple, params: En
     """
     del goal_cls  # participates in the cache key only
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(1,) if donate_state else ())
     def run(env: ClusterEnv, st: EngineState):
         def step(carry):
             st, it, n_applied, _progress = carry
@@ -289,27 +369,32 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple, params: En
                 st, n_moves = _move_branch_batched(env, st, goal, prev_goals,
                                                    params, severity)
 
-            # 2. leadership transfers — only when no move landed (lazy cond:
-            #    the scoring usually never runs), batched like moves
+            # 2. leadership transfers — only when no move landed; gated by a
+            #    zero/one trip count, NOT lax.cond (a cond carrying the full
+            #    EngineState defeats XLA aliasing and copies it wholesale)
             n_leads = jnp.int32(0)
             if goal.uses_leadership_moves:
-                st, n_leads = jax.lax.cond(
-                    n_moves == 0,
-                    lambda s: _leadership_branch_batched(
+                def lead_body(_i, carry):
+                    s, _n = carry
+                    return _leadership_branch_batched(
                         env, s, goal, prev_goals, params,
-                        goal.broker_severity(env, s)),
-                    lambda s: (s, jnp.int32(0)), st)
+                        goal.broker_severity(env, s))
+                st, n_leads = jax.lax.fori_loop(
+                    0, jnp.where(n_moves == 0, 1, 0), lead_body,
+                    (st, jnp.int32(0)))
 
             # 3. swaps — last resort when neither moves nor transfers progress
             #    (rebalanceBySwappingLoadOut/In role), batched like moves
             n_swaps = jnp.int32(0)
             if goal.uses_swaps:
-                st, n_swaps = jax.lax.cond(
-                    (n_moves + n_leads) == 0,
-                    lambda s: _swap_branch_batched(env, s, goal, prev_goals,
-                                                   params,
-                                                   goal.broker_severity(env, s)),
-                    lambda s: (s, jnp.int32(0)), st)
+                def swap_body(_i, carry):
+                    s, _n = carry
+                    return _swap_branch_batched(env, s, goal, prev_goals,
+                                                params,
+                                                goal.broker_severity(env, s))
+                st, n_swaps = jax.lax.fori_loop(
+                    0, jnp.where((n_moves + n_leads) == 0, 1, 0), swap_body,
+                    (st, jnp.int32(0)))
 
             applied = n_disk + n_moves + n_leads + n_swaps
             progress = applied > 0

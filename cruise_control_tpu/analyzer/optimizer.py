@@ -37,7 +37,14 @@ BALANCEDNESS_STRICTNESS_WEIGHT = 1.5
 
 class OptimizationFailureError(Exception):
     """A hard goal could not be satisfied
-    (reference: OptimizationFailureException thrown from AbstractGoal)."""
+    (reference: OptimizationFailureException thrown from AbstractGoal; like it,
+    carries an optional ProvisionRecommendation so callers can surface how many
+    brokers the cluster is short)."""
+
+    def __init__(self, message: str, recommendation=None, result=None):
+        super().__init__(message)
+        self.recommendation = recommendation
+        self.result = result
 
 
 @dataclasses.dataclass
@@ -64,6 +71,7 @@ class OptimizerResult:
     num_replica_movements: int = 0
     num_leadership_movements: int = 0
     data_to_move_mb: float = 0.0
+    durations_measured: bool = False   # duration_s is honest only when True
 
     @property
     def violated_goals_before(self) -> list[str]:
@@ -88,7 +96,10 @@ class OptimizerResult:
                 {"goal": g.name, "status": "VIOLATED" if g.violated_after else "NO-ACTION"
                  if not g.iterations else "FIXED", "iterations": g.iterations,
                  "budgetExhausted": g.hit_max_iters,
-                 "durationSec": round(g.duration_s, 4)}
+                 # async-pipelined runs record dispatch time, not device time:
+                 # only emit the field when it was honestly measured
+                 **({"durationSec": round(g.duration_s, 4)}
+                    if self.durations_measured else {})}
                 for g in self.goal_results
             ],
             "proposals": [p.to_json() for p in self.proposals],
@@ -158,11 +169,23 @@ class GoalOptimizer:
     def default_goal_names(self) -> list[str]:
         return list(self._default_goal_names)
 
+    @property
+    def constraint(self) -> BalancingConstraint:
+        """The balancing constraint this optimizer runs under (public: the
+        goal-violation detector derives provision recommendations from it)."""
+        return self._constraint
+
     def optimizations(self, ct: ClusterTensor, meta: ClusterMeta,
                       goal_names: list[str] | None = None,
                       options: OptimizationOptions = OptimizationOptions(),
                       skip_hard_goal_check: bool = False,
-                      raise_on_failure: bool = True) -> OptimizerResult:
+                      raise_on_failure: bool = True,
+                      measure_goal_durations: bool = False) -> OptimizerResult:
+        """``measure_goal_durations=True`` blocks after every goal to time it
+        honestly (proposal-computation-timer per goal); the default pipelines
+        all goal programs asynchronously — one device round-trip for the whole
+        chain instead of one per goal, which dominates wall clock on a
+        tunneled/remote TPU."""
         names = goal_names or self._default_goal_names
         # honour hard-goal enforcement (KafkaCruiseControl sanityCheckHardGoalPresence)
         if goal_names and not skip_hard_goal_check:
@@ -178,14 +201,16 @@ class GoalOptimizer:
 
         # bucket-pad shapes so similar clusters share compiled engine programs
         ct, meta = pad_cluster(ct, meta)
-        # scale the candidate set with cluster size: a pass lands up to K
-        # moves, so K ~ B/8 keeps pass count (and wall clock) roughly flat
+        # scale the candidate set with cluster size: a wave lands up to K
+        # moves, so K ~ B/4 keeps pass count (and wall clock) roughly flat;
+        # candidate selection is an approx_max_k partial reduction, so a
+        # larger K costs [K, B] scoring, not a bigger sort
         params = dataclasses.replace(
             self._params,
-            num_candidates=min(512, max(self._params.num_candidates,
-                                        ct.num_brokers // 8)),
-            num_leader_candidates=min(512, max(self._params.num_leader_candidates,
-                                               ct.num_brokers // 8)))
+            num_candidates=min(2048, max(self._params.num_candidates,
+                                         ct.num_brokers // 4)),
+            num_leader_candidates=min(1024, max(self._params.num_leader_candidates,
+                                                ct.num_brokers // 8)))
 
         env = make_env(ct, meta)
         st = init_state(env, ct.replica_broker, ct.replica_is_leader,
@@ -205,8 +230,12 @@ class GoalOptimizer:
         prev: list = []
         for g in goals:
             t0 = time.monotonic()
+            # NOTE: donate_state measured SLOWER here — buffer ownership
+            # transfer serializes the async dispatch pipeline on the tunneled
+            # TPU; the non-donating chain keeps all 18 goal programs in flight
             st, info = optimize_goal(env, st, g, tuple(prev), params)
-            jax.block_until_ready(st.util)   # dispatch is async: time honestly
+            if measure_goal_durations:
+                jax.block_until_ready(st.util)   # block per goal: honest timing
             durations.append(time.monotonic() - t0)
             infos.append(info)               # stays on device until one batch get
             prev.append(g)
@@ -215,7 +244,8 @@ class GoalOptimizer:
             ple = PreferredLeaderElectionGoal(constraint=self._constraint, options=options)
             t0 = time.monotonic()
             was, st, still = _compiled_ple(ple)(env, st)
-            jax.block_until_ready(st.replica_is_leader)
+            if measure_goal_durations:
+                jax.block_until_ready(st.replica_is_leader)
             ple_dur = time.monotonic() - t0
 
         infos = jax.device_get(infos)
@@ -251,14 +281,6 @@ class GoalOptimizer:
         n_lead = sum(1 for p in proposals if p.has_leader_action)
         data_mb = float(disk_load[moved_mask].sum())
 
-        if raise_on_failure:
-            failed = [r.name + (" (iteration budget exhausted)" if r.hit_max_iters else "")
-                      for r, g in zip(goal_results, goals)
-                      if g.is_hard and r.violated_after]
-            if failed:
-                raise OptimizationFailureError(
-                    f"hard goal(s) not satisfiable: {failed}")
-
         viol_after = {g.name: g.violated_after for g in goal_results}
         result = OptimizerResult(
             goal_results=goal_results, proposals=proposals,
@@ -267,9 +289,26 @@ class GoalOptimizer:
             balancedness_after=_balancedness(goals, viol_after),
             num_replica_movements=n_moves, num_leadership_movements=n_lead,
             data_to_move_mb=data_mb,
+            durations_measured=measure_goal_durations,
         )
         result.final_state = st          # for executor / tests
         result.env = env
+
+        if raise_on_failure:
+            failed = [r.name + (" (iteration budget exhausted)" if r.hit_max_iters else "")
+                      for r, g in zip(goal_results, goals)
+                      if g.is_hard and r.violated_after]
+            if failed:
+                # attach how many brokers are missing (reference:
+                # OptimizationFailureException carries ProvisionRecommendation)
+                from cruise_control_tpu.detector.provisioner import (
+                    recommendation_from_result,
+                )
+                rec = recommendation_from_result(result, self._constraint)
+                raise OptimizationFailureError(
+                    f"hard goal(s) not satisfiable: {failed} "
+                    f"[{rec.status.value}: {rec.reason}]",
+                    recommendation=rec, result=result)
         return result
 
 

@@ -108,33 +108,38 @@ def refresh(env: ClusterEnv, st: EngineState) -> EngineState:
                                topic_broker_count=tbc, topic_leader_count=tlc, disk_util=du)
 
 
-def apply_move(env: ClusterEnv, st: EngineState, replica: Array, dst: Array) -> EngineState:
+def apply_move(env: ClusterEnv, st: EngineState, replica: Array, dst: Array,
+               enabled: Array | bool = True) -> EngineState:
     """Relocate ``replica`` to broker ``dst`` with incremental bookkeeping.
 
     Safe under jit for a traced (replica, dst); the caller guarantees the move
     is legit (dst hosts no copy of the partition, dst alive, ...).
+
+    ``enabled`` masks the whole update to a no-op — engine loop bodies use it
+    instead of wrapping apply in ``lax.cond``: a cond carrying the full
+    EngineState defeats XLA buffer aliasing and copies hundreds of MB per
+    call at 1M-replica scale, while masked scatter-adds alias in place.
     """
+    en = jnp.asarray(enabled, bool)
     src = st.replica_broker[replica]
     is_leader = st.replica_is_leader[replica]
     load = jnp.where(is_leader, env.leader_load[replica], env.follower_load[replica])
+    load = jnp.where(en, load, 0.0)
     util = st.util.at[src].add(-load).at[dst].add(load)
-    lead_load = env.leader_load[replica]
-    leader_util = jnp.where(
-        is_leader,
-        st.leader_util.at[src].add(-lead_load).at[dst].add(lead_load),
-        st.leader_util)
-    pot_delta = env.leader_load[replica, Resource.NW_OUT]
+    lead_load = jnp.where(en & is_leader, env.leader_load[replica], 0.0)
+    leader_util = st.leader_util.at[src].add(-lead_load).at[dst].add(lead_load)
+    pot_delta = jnp.where(en, env.leader_load[replica, Resource.NW_OUT], 0.0)
     pot = st.potential_nw_out.at[src].add(-pot_delta).at[dst].add(pot_delta)
-    rc = st.replica_count.at[src].add(-1).at[dst].add(1)
-    lc = jnp.where(is_leader, st.leader_count.at[src].add(-1).at[dst].add(1), st.leader_count)
+    one = en.astype(jnp.int32)
+    lone = (en & is_leader).astype(jnp.int32)
+    rc = st.replica_count.at[src].add(-one).at[dst].add(one)
+    lc = st.leader_count.at[src].add(-lone).at[dst].add(lone)
     p = env.replica_partition[replica]
-    prc = (st.part_rack_count.at[p, env.broker_rack[src]].add(-1)
-                             .at[p, env.broker_rack[dst]].add(1))
+    prc = (st.part_rack_count.at[p, env.broker_rack[src]].add(-one)
+                             .at[p, env.broker_rack[dst]].add(one))
     t = env.replica_topic[replica]
-    tbc = st.topic_broker_count.at[t, src].add(-1).at[t, dst].add(1)
-    tlc = jnp.where(is_leader,
-                    st.topic_leader_count.at[t, src].add(-1).at[t, dst].add(1),
-                    st.topic_leader_count)
+    tbc = st.topic_broker_count.at[t, src].add(-one).at[t, dst].add(one)
+    tlc = st.topic_leader_count.at[t, src].add(-lone).at[t, dst].add(lone)
     # destination logdir: the alive disk with the most free space on dst
     # (the engine's move candidates don't carry a disk axis; placement policy
     # mirrors the executor's least-loaded-logdir default)
@@ -146,70 +151,140 @@ def apply_move(env: ClusterEnv, st: EngineState, replica: Array, dst: Array) -> 
     du = st.disk_util.at[src, src_disk].add(-disk_load).at[dst, dst_disk].add(disk_load)
     return dataclasses.replace(
         st,
-        replica_broker=st.replica_broker.at[replica].set(jnp.asarray(dst, jnp.int32)),
-        replica_offline=st.replica_offline.at[replica].set(False),
-        replica_disk=st.replica_disk.at[replica].set(dst_disk),
+        replica_broker=st.replica_broker.at[replica].set(
+            jnp.where(en, jnp.asarray(dst, jnp.int32), src)),
+        replica_offline=st.replica_offline.at[replica].set(
+            st.replica_offline[replica] & ~en),
+        replica_disk=st.replica_disk.at[replica].set(
+            jnp.where(en, dst_disk, src_disk)),
         util=util, leader_util=leader_util, potential_nw_out=pot,
         replica_count=rc, leader_count=lc, part_rack_count=prc,
         topic_broker_count=tbc, topic_leader_count=tlc, disk_util=du,
-        moved=st.moved.at[replica].set(True),
+        moved=st.moved.at[replica].set(st.moved[replica] | en),
     )
 
 
 def apply_leadership(env: ClusterEnv, st: EngineState, src_replica: Array,
-                     dst_replica: Array) -> EngineState:
-    """Transfer leadership src_replica -> dst_replica (same partition)."""
+                     dst_replica: Array,
+                     enabled: Array | bool = True) -> EngineState:
+    """Transfer leadership src_replica -> dst_replica (same partition).
+    ``enabled`` masks to a no-op (see apply_move)."""
+    en = jnp.asarray(enabled, bool)
+    enf = en.astype(st.util.dtype)
     bs = st.replica_broker[src_replica]
     bd = st.replica_broker[dst_replica]
     # src loses (leader - follower) delta; dst gains it
-    delta_s = env.leader_load[src_replica] - env.follower_load[src_replica]
-    delta_d = env.leader_load[dst_replica] - env.follower_load[dst_replica]
+    delta_s = (env.leader_load[src_replica] - env.follower_load[src_replica]) * enf
+    delta_d = (env.leader_load[dst_replica] - env.follower_load[dst_replica]) * enf
     util = st.util.at[bs].add(-delta_s).at[bd].add(delta_d)
-    leader_util = (st.leader_util.at[bs].add(-env.leader_load[src_replica])
-                                  .at[bd].add(env.leader_load[dst_replica]))
-    lc = st.leader_count.at[bs].add(-1).at[bd].add(1)
+    leader_util = (st.leader_util.at[bs].add(-env.leader_load[src_replica] * enf)
+                                  .at[bd].add(env.leader_load[dst_replica] * enf))
+    one = en.astype(jnp.int32)
+    lc = st.leader_count.at[bs].add(-one).at[bd].add(one)
     t = env.replica_topic[src_replica]
-    tlc = st.topic_leader_count.at[t, bs].add(-1).at[t, bd].add(1)
-    lead = st.replica_is_leader.at[src_replica].set(False).at[dst_replica].set(True)
+    tlc = st.topic_leader_count.at[t, bs].add(-one).at[t, bd].add(one)
+    lead = (st.replica_is_leader
+            .at[src_replica].set(st.replica_is_leader[src_replica] & ~en)
+            .at[dst_replica].set(st.replica_is_leader[dst_replica] | en))
     return dataclasses.replace(st, replica_is_leader=lead, util=util,
                                leader_util=leader_util, leader_count=lc,
                                topic_leader_count=tlc,
                                leadership_moved=st.leadership_moved
-                               .at[src_replica].set(True).at[dst_replica].set(True))
+                               .at[src_replica].set(st.leadership_moved[src_replica] | en)
+                               .at[dst_replica].set(st.leadership_moved[dst_replica] | en))
+
+
+def apply_moves_batched(env: ClusterEnv, st: EngineState, replicas: Array,
+                        dsts: Array, mask: Array) -> EngineState:
+    """Apply a WAVE of mutually-independent moves in one set of scatter
+    updates: ``replicas[W]`` (unique indices) relocate to ``dsts[W]`` where
+    ``mask[W]``; masked-off rows are no-ops. The caller guarantees wave
+    members touch disjoint brokers (each broker at most once, in one role)
+    and disjoint partitions, so every move is exactly as valid as it scored
+    against the pre-wave state. Scatter-adds are duplicate-safe regardless.
+
+    This is the engine's bulk path: one wave lands ~K moves for ~15 vector
+    ops instead of K sequential re-score iterations."""
+    is_leader = st.replica_is_leader[replicas]
+    src = st.replica_broker[replicas]
+    load = jnp.where(is_leader[:, None], env.leader_load[replicas],
+                     env.follower_load[replicas])
+    load = jnp.where(mask[:, None], load, 0.0)
+    util = st.util.at[src].add(-load).at[dsts].add(load)
+    lead_load = jnp.where((mask & is_leader)[:, None],
+                          env.leader_load[replicas], 0.0)
+    leader_util = st.leader_util.at[src].add(-lead_load).at[dsts].add(lead_load)
+    pot_delta = jnp.where(mask, env.leader_load[replicas, Resource.NW_OUT], 0.0)
+    pot = st.potential_nw_out.at[src].add(-pot_delta).at[dsts].add(pot_delta)
+    one = mask.astype(jnp.int32)
+    lone = (mask & is_leader).astype(jnp.int32)
+    rc = st.replica_count.at[src].add(-one).at[dsts].add(one)
+    lc = st.leader_count.at[src].add(-lone).at[dsts].add(lone)
+    pidx = env.replica_partition[replicas]
+    prc = (st.part_rack_count.at[pidx, env.broker_rack[src]].add(-one)
+                             .at[pidx, env.broker_rack[dsts]].add(one))
+    tidx = env.replica_topic[replicas]
+    tbc = st.topic_broker_count.at[tidx, src].add(-one).at[tidx, dsts].add(one)
+    tlc = st.topic_leader_count.at[tidx, src].add(-lone).at[tidx, dsts].add(lone)
+    # destination logdir: most-free alive disk on dst at pre-wave state
+    free = jnp.where(env.broker_disk_alive[dsts],
+                     env.broker_disk_capacity[dsts] - st.disk_util[dsts],
+                     -jnp.inf)                                      # [W, D]
+    dst_disk = jnp.argmax(free, axis=1).astype(jnp.int32)
+    dl = load[:, Resource.DISK]
+    du = (st.disk_util.at[src, st.replica_disk[replicas]].add(-dl)
+                      .at[dsts, dst_disk].add(dl))
+    new_broker = jnp.where(mask, jnp.asarray(dsts, jnp.int32),
+                           st.replica_broker[replicas])
+    new_disk = jnp.where(mask, dst_disk, st.replica_disk[replicas])
+    return dataclasses.replace(
+        st,
+        replica_broker=st.replica_broker.at[replicas].set(new_broker),
+        replica_disk=st.replica_disk.at[replicas].set(new_disk),
+        replica_offline=st.replica_offline.at[replicas].set(
+            st.replica_offline[replicas] & ~mask),
+        util=util, leader_util=leader_util, potential_nw_out=pot,
+        replica_count=rc, leader_count=lc, part_rack_count=prc,
+        topic_broker_count=tbc, topic_leader_count=tlc, disk_util=du,
+        moved=st.moved.at[replicas].set(st.moved[replicas] | mask),
+    )
 
 
 def apply_disk_move(env: ClusterEnv, st: EngineState, replica: Array,
-                    dst_disk: Array) -> EngineState:
+                    dst_disk: Array, enabled: Array | bool = True) -> EngineState:
     """Relocate ``replica`` to another logdir on its OWN broker
     (INTRA_BROKER_REPLICA_MOVEMENT, ClusterModel.relocateReplica disk
     variant / Disk.java bookkeeping). Only disk_util and replica_disk change;
-    broker-level tallies are untouched."""
+    broker-level tallies are untouched. ``enabled`` masks to a no-op."""
+    en = jnp.asarray(enabled, bool)
     b = st.replica_broker[replica]
     is_leader = st.replica_is_leader[replica]
     disk_load = jnp.where(is_leader, env.leader_load[replica, Resource.DISK],
                           env.follower_load[replica, Resource.DISK])
+    disk_load = jnp.where(en, disk_load, 0.0)
     src_disk = st.replica_disk[replica]
     du = st.disk_util.at[b, src_disk].add(-disk_load).at[b, dst_disk].add(disk_load)
     # moving off a dead disk onto an alive one heals the replica
-    heals = env.broker_disk_alive[b, dst_disk] & env.broker_alive[b]
+    heals = env.broker_disk_alive[b, dst_disk] & env.broker_alive[b] & en
     return dataclasses.replace(
         st,
-        replica_disk=st.replica_disk.at[replica].set(jnp.asarray(dst_disk, jnp.int32)),
+        replica_disk=st.replica_disk.at[replica].set(
+            jnp.where(en, jnp.asarray(dst_disk, jnp.int32), src_disk)),
         replica_offline=st.replica_offline.at[replica].set(
             st.replica_offline[replica] & ~heals),
         disk_util=du,
-        moved=st.moved.at[replica].set(True),
+        moved=st.moved.at[replica].set(st.moved[replica] | en),
     )
 
 
 def apply_swap(env: ClusterEnv, st: EngineState, replica_a: Array,
-               replica_b: Array) -> EngineState:
+               replica_b: Array, enabled: Array | bool = True) -> EngineState:
     """Exchange the brokers of two (online) replicas of different partitions:
     composition of two moves with full incremental bookkeeping."""
     b_a = st.replica_broker[replica_a]
     b_b = st.replica_broker[replica_b]
-    st = apply_move(env, st, replica_a, b_b)
-    return apply_move(env, st, replica_b, b_a)
+    st = apply_move(env, st, replica_a, b_b, enabled)
+    return apply_move(env, st, replica_b, b_a, enabled)
 
 
 def no_op_move(st: EngineState) -> EngineState:

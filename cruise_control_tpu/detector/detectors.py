@@ -55,8 +55,10 @@ class GoalViolationDetector:
         unfixable = [g.name for g in res.goal_results
                      if g.violated_before and g.violated_after]
         if self._provisioner is not None:
-            from cruise_control_tpu.detector.provisioner import provision_status_from_stats
-            rec = provision_status_from_stats(res.stats_after, None, 0)
+            from cruise_control_tpu.detector.provisioner import (
+                recommendation_from_result,
+            )
+            rec = recommendation_from_result(res, self._optimizer.constraint)
             self.last_provision = rec
             if rec.status is not ProvisionStatus.RIGHT_SIZED:
                 self._provisioner.rightsize([rec])

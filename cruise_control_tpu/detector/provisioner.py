@@ -38,16 +38,59 @@ class NoopProvisioner:
         return False
 
 
-def provision_status_from_stats(stats_after: dict, constraint,
-                                num_alive_brokers: int) -> ProvisionRecommendation:
-    """Derive a provision recommendation from post-optimization stats: if hard
-    capacity cannot be satisfied the cluster is under-provisioned; if max
-    utilization is far below the low-utilization band it is over-provisioned
-    (GoalViolationDetector provision-status computation role)."""
-    offline = stats_after.get("num_offline_replicas", 0)
-    if offline:
+def recommendation_from_result(res, constraint) -> ProvisionRecommendation:
+    """Capacity-math provision recommendation from an OptimizerResult
+    (GoalViolationDetector.java:228 -> Provisioner.rightsize path, and the
+    ProvisionRecommendation attached to OptimizationFailureException by the
+    capacity goals): per resource, total load vs total allowed capacity
+    decides how many brokers of average capacity are missing (or spare)."""
+    import math
+
+    import numpy as np
+
+    env, st = res.env, res.final_state
+    alive = np.asarray(env.broker_alive)
+    if not alive.any():
+        return ProvisionRecommendation(ProvisionStatus.UNDER_PROVISIONED,
+                                       num_brokers=1, reason="no alive brokers")
+    util = np.asarray(st.util)[alive]                       # [B, M]
+    cap = np.asarray(env.broker_capacity)[alive]
+    thresh = np.asarray(constraint.capacity_threshold)
+    total_load = util.sum(axis=0)
+    avg_cap = cap.mean(axis=0)
+    allowed = (cap * thresh[None, :]).sum(axis=0)
+    deficit = total_load - allowed                          # [M] >0 = missing
+    if (deficit > 0).any():
+        from cruise_control_tpu.common.resources import Resource
+        r = int(np.argmax(deficit / np.maximum(avg_cap * thresh, 1e-9)))
+        need = math.ceil(deficit[r] / max(avg_cap[r] * thresh[r], 1e-9))
         return ProvisionRecommendation(
-            ProvisionStatus.UNDER_PROVISIONED,
-            num_brokers=max(1, offline // 100),
-            reason=f"{offline} replicas cannot be placed")
+            ProvisionStatus.UNDER_PROVISIONED, num_brokers=max(1, need),
+            reason=f"{Resource(r).name} load {total_load[r]:.1f} exceeds "
+                   f"allowed capacity {allowed[r]:.1f}: add >= {max(1, need)} "
+                   f"broker(s) of average capacity")
+    offline = res.stats_after.get("num_offline_replicas", 0)
+    if offline or any(g.violated_after for g in res.goal_results
+                      if g.name.endswith("CapacityGoal")):
+        return ProvisionRecommendation(
+            ProvisionStatus.UNDER_PROVISIONED, num_brokers=1,
+            reason="capacity goals unsatisfiable despite aggregate headroom "
+                   "(placement infeasibility)")
+    low = np.asarray(constraint.low_utilization_threshold)
+    n = int(alive.sum())
+    active = low > 0
+    if active.any() and n > 1:
+        avg_util_frac = total_load / np.maximum(cap.sum(axis=0), 1e-9)
+        if (avg_util_frac[active] < low[active]).all():
+            # brokers removable while every resource stays under its allowed
+            # aggregate capacity (reference low-utilization OVER_PROVISIONED)
+            keep = n
+            while keep > 1 and (total_load
+                                <= avg_cap * thresh * (keep - 1) - 1e-9).all():
+                keep -= 1
+            if keep < n:
+                return ProvisionRecommendation(
+                    ProvisionStatus.OVER_PROVISIONED, num_brokers=n - keep,
+                    reason=f"{n - keep} broker(s) removable under the "
+                           f"low-utilization thresholds")
     return ProvisionRecommendation(ProvisionStatus.RIGHT_SIZED)

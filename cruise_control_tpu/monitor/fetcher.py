@@ -38,7 +38,11 @@ class MetricFetcherManager:
                       if self._num_fetchers > 1 else None)
 
     def fetch_once(self, now_ms: float, partitions: list) -> Samples:
-        if self._pool is None:
+        # samplers that cannot scope a fetch to a partition subset (each call
+        # would sweep the whole metric source, multiplying load by N) opt out
+        # of fan-out and run one full fetch instead
+        if self._pool is None or not getattr(
+                self._sampler, "supports_partition_scoped_fetch", True):
             return self._sampler.get_samples(now_ms)
         groups = [g for g in assign_partitions(partitions, self._num_fetchers) if g]
         if not groups:

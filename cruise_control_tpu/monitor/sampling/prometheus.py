@@ -98,7 +98,14 @@ def _avg_value(series_values: list) -> float:
 
 
 class PrometheusMetricSampler:
-    """MetricSampler plugin backed by Prometheus."""
+    """MetricSampler plugin backed by Prometheus.
+
+    A partition-scoped fetch still sweeps every PromQL series and filters
+    client-side, so fetcher fan-out would multiply Prometheus load by N for
+    no gain — the fetcher manager is told to run one full fetch instead.
+    """
+
+    supports_partition_scoped_fetch = False
 
     def __init__(self, endpoint: str | None = None,
                  broker_id_by_host: dict | None = None,

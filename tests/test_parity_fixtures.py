@@ -158,3 +158,56 @@ def test_broken_broker_self_healing():
     res = _optimize(ct, meta, ["RackAwareGoal", "ReplicaCapacityGoal",
                                "DiskCapacityGoal", "ReplicaDistributionGoal"])
     verify(ct, meta, res, ["BROKEN_BROKERS"])
+
+
+def test_overfull_cluster_raises_with_provision_recommendation():
+    """VERDICT item 7: an over-full cluster raises OptimizationFailureError
+    carrying an UNDER_PROVISIONED recommendation with a broker count
+    (reference OptimizationFailureException + ProvisionRecommendation)."""
+    from cruise_control_tpu.detector.provisioner import ProvisionStatus
+    from cruise_control_tpu.model.builder import ClusterModelBuilder
+    b = ClusterModelBuilder()
+    for i in range(3):
+        b.add_broker(i, rack=f"r{i}", capacity={3: 1000.0})
+    # 9 x 320 MB = 2880 > 3 brokers x 1000 x 0.8 = 2400 allowed (the 100 MB
+    # disk epsilon would swallow a deficit smaller than that per broker)
+    for p in range(9):
+        b.add_replica("T1", p, broker_id=p % 3, is_leader=True,
+                      load=[1.0, 10.0, 20.0, 320.0])
+    ct, meta = b.build()
+    with pytest.raises(OptimizationFailureError) as ei:
+        _optimize(ct, meta, ["DiskCapacityGoal"], raise_on_failure=True)
+    rec = ei.value.recommendation
+    assert rec is not None
+    assert rec.status is ProvisionStatus.UNDER_PROVISIONED
+    # deficit 300 MB / (1000 * 0.8) -> 1 more broker
+    assert rec.num_brokers == 1
+    assert "DISK" in rec.reason
+
+
+def test_goal_violation_detector_reports_under_provisioned():
+    from cruise_control_tpu.backend import SimulatedClusterBackend
+    from cruise_control_tpu.config import cruise_control_config
+    from cruise_control_tpu.detector.detectors import GoalViolationDetector
+    from cruise_control_tpu.detector.provisioner import (
+        NoopProvisioner, ProvisionStatus,
+    )
+    from cruise_control_tpu.monitor import LoadMonitor
+    from cruise_control_tpu.monitor.sampling.samplers import SimulatedMetricSampler
+    be = SimulatedClusterBackend()
+    for i in range(2):
+        be.add_broker(i, f"r{i}", logdirs={"/d": 1000.0})
+    for p in range(8):
+        be.create_partition("T1", p, [p % 2], size_mb=250.0, bytes_in_rate=5.0)
+    lm = LoadMonitor(config=cruise_control_config(
+        {"min.samples.per.metrics.window": 1}), backend=be,
+        sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    for i in range(8):
+        lm.sample_once(now_ms=i * 300_000.0)
+    det = GoalViolationDetector(GoalOptimizer(), lm, ["DiskCapacityGoal"],
+                                provisioner=NoopProvisioner())
+    det.run_once(0.0)
+    assert det.last_provision is not None
+    assert det.last_provision.status is ProvisionStatus.UNDER_PROVISIONED
+    assert det.last_provision.num_brokers >= 1
