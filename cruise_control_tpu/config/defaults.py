@@ -151,6 +151,9 @@ _D.define(name="prometheus.query.resolution.step.ms", type=Type.INT, default=60_
           validator=at_least(1000))
 _D.define(name="prometheus.query.supplier", type=Type.STRING, default="",
           doc="Custom PrometheusQuerySupplier class ('' = default node/JMX exporter map).")
+_D.define(name="metrics.reporter.topic.path", type=Type.STRING, default="",
+          doc="File-backed __CruiseControlMetrics transport consumed by "
+              "CruiseControlMetricsReporterSampler (reporter/ module).")
 _D.define(name="prometheus.broker.id.by.instance", type=Type.STRING, default="",
           doc='JSON map of Prometheus instance label -> broker id, e.g. '
               '{"kafka-3.prod:7071": 3}; empty = host-<id> convention.')
@@ -257,6 +260,14 @@ _D.define(name="anomaly.notifier.class", type=Type.CLASS,
           doc="AnomalyNotifier plugin returning FIX/CHECK/IGNORE.")
 _D.define(name="anomaly.detection.goals", type=Type.LIST, default=DEFAULT_ANOMALY_DETECTION_GOALS,
           doc="Goals the GoalViolationDetector re-checks.")
+_D.define(name="slack.self.healing.notifier.webhook", type=Type.STRING, default="",
+          doc="Slack incoming-webhook URL (SlackSelfHealingNotifier.java).")
+_D.define(name="slack.self.healing.notifier.channel", type=Type.STRING, default="")
+_D.define(name="alerta.self.healing.notifier.api.url", type=Type.STRING, default="",
+          doc="Alerta API base URL (AlertaSelfHealingNotifier.java).")
+_D.define(name="alerta.self.healing.notifier.api.key", type=Type.PASSWORD, default="")
+_D.define(name="alerta.self.healing.notifier.environment", type=Type.STRING,
+          default="Production")
 _D.define(name="self.healing.enabled", type=Type.BOOLEAN, default=False,
           doc="Master switch for self-healing (per-type switches in the notifier).")
 _D.define(name="self.healing.exclude.recently.demoted.brokers", type=Type.BOOLEAN, default=True)

@@ -12,7 +12,8 @@ from cruise_control_tpu.detector.maintenance import (
 from cruise_control_tpu.detector.manager import AnomalyDetectorManager
 from cruise_control_tpu.detector.metric_anomaly import PercentileMetricAnomalyFinder
 from cruise_control_tpu.detector.notifier import (
-    Action, AlertFileNotifier, NoopNotifier, SelfHealingNotifier,
+    Action, AlertaSelfHealingNotifier, AlertFileNotifier, NoopNotifier,
+    SelfHealingNotifier, SlackSelfHealingNotifier,
 )
 from cruise_control_tpu.detector.provisioner import (
     NoopProvisioner, ProvisionRecommendation, ProvisionStatus,
@@ -27,7 +28,8 @@ __all__ = [
     "BrokerFailureDetector", "DiskFailureDetector", "GoalViolationDetector",
     "SlowBrokerFinder", "FileMaintenanceEventReader", "IdempotenceCache",
     "AnomalyDetectorManager", "PercentileMetricAnomalyFinder",
-    "Action", "AlertFileNotifier", "NoopNotifier", "SelfHealingNotifier",
+    "Action", "AlertaSelfHealingNotifier", "AlertFileNotifier", "NoopNotifier",
+    "SelfHealingNotifier", "SlackSelfHealingNotifier",
     "NoopProvisioner", "ProvisionRecommendation", "ProvisionStatus",
     "PartitionSizeAnomalyFinder", "TopicReplicationFactorAnomalyFinder",
 ]

@@ -111,6 +111,86 @@ class SelfHealingNotifier:
         return NotificationResult(Action.FIX)
 
 
+def _post_json(url: str, payload: dict, headers: dict | None = None,
+               timeout_s: float = 10.0) -> None:
+    import urllib.request
+    data = json.dumps(payload).encode("utf-8")
+    req = urllib.request.Request(
+        url, data=data, method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout_s):
+        pass
+
+
+class SlackSelfHealingNotifier(SelfHealingNotifier):
+    """Slack webhook alerting (SlackSelfHealingNotifier.java: posts
+    {text, channel, username, icon_emoji} to slack.self.healing.notifier.webhook)."""
+
+    def __init__(self, webhook: str = "", channel: str = "",
+                 user: str = "Cruise Control", icon: str = ":information_source:"):
+        super().__init__()
+        self.webhook = webhook
+        self.channel = channel
+        self.user = user
+        self.icon = icon
+        self._alert_sink = self._post
+
+    def configure(self, config, **extra):
+        if config is not None:
+            self.webhook = config.get_string(
+                "slack.self.healing.notifier.webhook") or self.webhook
+            self.channel = config.get_string(
+                "slack.self.healing.notifier.channel") or self.channel
+        super().configure(config, alert_sink=self._post, **extra)
+
+    def _post(self, payload: dict) -> None:
+        if not self.webhook:
+            return
+        text = (f"{payload['anomaly'].get('type', 'ANOMALY')}: "
+                f"{payload['anomaly'].get('description', '')} "
+                f"(autoFixTriggered={payload['autoFixTriggered']})")
+        _post_json(self.webhook, {"text": text, "channel": self.channel,
+                                  "username": self.user,
+                                  "icon_emoji": self.icon})
+
+
+class AlertaSelfHealingNotifier(SelfHealingNotifier):
+    """Alerta API alerting (AlertaSelfHealingNotifier.java: POSTs AlertaMessage
+    objects to alerta.self.healing.notifier.api.url with an API key)."""
+
+    def __init__(self, api_url: str = "", api_key: str = "",
+                 environment: str = "Production"):
+        super().__init__()
+        self.api_url = api_url
+        self.api_key = api_key
+        self.environment = environment
+        self._alert_sink = self._post
+
+    def configure(self, config, **extra):
+        if config is not None:
+            self.api_url = config.get_string(
+                "alerta.self.healing.notifier.api.url") or self.api_url
+            self.api_key = config.get_string(
+                "alerta.self.healing.notifier.api.key") or self.api_key
+            self.environment = config.get_string(
+                "alerta.self.healing.notifier.environment") or self.environment
+        super().configure(config, alert_sink=self._post, **extra)
+
+    def _post(self, payload: dict) -> None:
+        if not self.api_url:
+            return
+        anomaly = payload["anomaly"]
+        _post_json(
+            f"{self.api_url.rstrip('/')}/alert",
+            {"environment": self.environment,
+             "event": anomaly.get("type", "ANOMALY"),
+             "resource": "cruise-control",
+             "severity": "critical" if payload["autoFixTriggered"] else "warning",
+             "text": anomaly.get("description", ""),
+             "service": ["cruise-control"]},
+            headers={"Authorization": f"Key {self.api_key}"} if self.api_key else {})
+
+
 class AlertFileNotifier(SelfHealingNotifier):
     """Stands in for Slack/Alerta webhook notifiers (zero-egress environment):
     appends alert JSON lines to a file."""

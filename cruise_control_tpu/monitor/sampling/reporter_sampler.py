@@ -1,0 +1,133 @@
+"""Sampler consuming the metrics-reporter topic.
+
+Reference: monitor/sampling/CruiseControlMetricsReporterSampler.java (the
+DEFAULT sampler: consumes __CruiseControlMetrics from the last committed
+offset) + CruiseControlMetricsProcessor.java (raw -> PartitionMetricSample /
+BrokerMetricSample conversion; per-partition CPU via
+ModelUtils.estimateLeaderCpuUtilPerCore).
+
+Per-partition network attribution: the reference allocates a topic's
+bytes-in/out across its leader partitions; here the allocation weight is the
+partition's share of the topic's total size on that broker (documented
+simplification — same totals, smoother split than the reference's
+equal-share fallback when partition-level rate metrics are absent).
+"""
+from __future__ import annotations
+
+import logging
+import struct
+
+from cruise_control_tpu.monitor.cpu_model import CpuModelParams, estimate_leader_cpu_util
+from cruise_control_tpu.monitor.sampling.samplers import (
+    BrokerSample, PartitionSample, Samples,
+)
+from cruise_control_tpu.reporter.metrics import metric_from_bytes
+from cruise_control_tpu.reporter.topic import FileMetricsTopic
+
+LOG = logging.getLogger(__name__)
+
+
+class CruiseControlMetricsReporterSampler:
+    """MetricSampler plugin over a FileMetricsTopic."""
+
+    supports_partition_scoped_fetch = False   # one consumer sweep per round
+
+    def __init__(self, topic: FileMetricsTopic | None = None,
+                 cpu_params: CpuModelParams | None = None):
+        self._topic = topic
+        self._offset = 0
+        self._cpu_params = cpu_params or CpuModelParams()
+
+    def configure(self, config, metrics_topic=None, **extra):
+        new_topic = None
+        if metrics_topic is not None:
+            new_topic = metrics_topic
+        elif config is not None:
+            path = config.get_string("metrics.reporter.topic.path")
+            if path:
+                new_topic = FileMetricsTopic(path)
+        if new_topic is not None and new_topic is not self._topic:
+            # a byte offset is only meaningful within one log file
+            self._topic = new_topic
+            self._offset = 0
+        if config is not None:
+            self._cpu_params = CpuModelParams.from_config(config)
+
+    def get_samples(self, now_ms: float, partitions=None,
+                    include_broker_samples: bool = True) -> Samples:
+        if self._topic is None:
+            return Samples([], [])
+        del now_ms   # samples are stamped with their SERIALIZED time, not the
+        #              consume time: a backlog spanning several reporting
+        #              intervals must land in the windows it was measured in
+        broker_raw: dict[tuple, dict] = {}   # (broker, t_ms) -> {raw: v}
+        topic_raw: dict[tuple, dict] = {}    # (broker, topic, t_ms) -> {raw: v}
+        # (topic, partition, t_ms) -> (reporting broker, {raw: v}) — keyed
+        # WITHOUT the broker so a leadership change between intervals cannot
+        # double-count the partition; log order makes the last report win
+        part_raw: dict[tuple, tuple] = {}
+        latest = self._offset
+        for next_off, payload in self._topic.consume(self._offset):
+            latest = next_off
+            try:
+                m = metric_from_bytes(payload)
+            except (ValueError, struct.error) as e:
+                # at-least-once contract: skip-and-log a poison record — the
+                # offset still advances, otherwise one bad record wedges
+                # sampling forever
+                LOG.warning("skipping undecodable metrics record at offset "
+                            "%d: %s", next_off, e)
+                continue
+            if m.class_id == 0:
+                broker_raw.setdefault((m.broker_id, m.time_ms),
+                                      {})[m.raw_type] = m.value
+            elif m.class_id == 1:
+                topic_raw.setdefault((m.broker_id, m.topic, m.time_ms),
+                                     {})[m.raw_type] = m.value
+            else:
+                key = (m.topic, m.partition, m.time_ms)
+                b_prev, vals = part_raw.get(key, (m.broker_id, {}))
+                if b_prev != m.broker_id:
+                    vals = {}            # leadership changed: last report wins
+                vals[m.raw_type] = m.value
+                part_raw[key] = (m.broker_id, vals)
+        self._offset = latest
+
+        # topic size totals per (broker, topic, time) for allocation weights
+        topic_size: dict[tuple, float] = {}
+        for (t, p, tms), (b, vals) in part_raw.items():
+            topic_size[(b, t, tms)] = topic_size.get((b, t, tms), 0.0) \
+                + vals.get("PARTITION_SIZE", 0.0)
+
+        psamples = []
+        for (t, p, tms), (b, vals) in part_raw.items():
+            size = vals.get("PARTITION_SIZE", 0.0)
+            total = topic_size.get((b, t, tms), 0.0)
+            share = size / total if total > 0 else 0.0
+            traw = topic_raw.get((b, t, tms), {})
+            p_in = traw.get("TOPIC_BYTES_IN", 0.0) * share
+            p_out = traw.get("TOPIC_BYTES_OUT", 0.0) * share
+            braw = broker_raw.get((b, tms), {})
+            cpu = float(estimate_leader_cpu_util(
+                braw.get("BROKER_CPU_UTIL", 0.0),
+                braw.get("ALL_TOPIC_BYTES_IN", 0.0),
+                braw.get("ALL_TOPIC_BYTES_OUT", 0.0),
+                braw.get("ALL_TOPIC_REPLICATION_BYTES_IN", 0.0),
+                p_in, p_out, self._cpu_params))
+            psamples.append(PartitionSample(
+                topic=t, partition=p, ts_ms=tms,
+                values={"CPU_USAGE": cpu, "DISK_USAGE": size,
+                        "LEADER_BYTES_IN": p_in, "LEADER_BYTES_OUT": p_out}))
+        if partitions is not None:
+            wanted = set(partitions)
+            psamples = [s for s in psamples if (s.topic, s.partition) in wanted]
+
+        bsamples = []
+        if include_broker_samples:
+            for (b, tms), vals in broker_raw.items():
+                bsamples.append(BrokerSample(broker_id=b, ts_ms=tms,
+                                             values=dict(vals)))
+        return Samples(psamples, bsamples)
+
+    def close(self):
+        pass

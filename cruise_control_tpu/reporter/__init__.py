@@ -1,0 +1,22 @@
+"""Metrics-reporter module: broker-side metric emission + serde + transport.
+
+Reference: cruise-control-metrics-reporter/ — the in-broker
+CruiseControlMetricsReporter plugin snapshots broker metrics, serializes them
+(metric/MetricSerde.java) and produces them to the __CruiseControlMetrics
+topic; the monitor's CruiseControlMetricsReporterSampler consumes that topic.
+Here the transport is a file-backed append log (FileMetricsTopic) — the
+zero-dependency stand-in for a Kafka topic, with the same offset-consumption
+contract — and the reporter snapshots a ClusterBackend.
+"""
+from cruise_control_tpu.reporter.metrics import (
+    BrokerMetric, CruiseControlMetric, PartitionMetric, TopicMetric,
+    metric_from_bytes, metric_to_bytes,
+)
+from cruise_control_tpu.reporter.reporter import CruiseControlMetricsReporter
+from cruise_control_tpu.reporter.topic import FileMetricsTopic
+
+__all__ = [
+    "BrokerMetric", "CruiseControlMetric", "PartitionMetric", "TopicMetric",
+    "metric_from_bytes", "metric_to_bytes",
+    "CruiseControlMetricsReporter", "FileMetricsTopic",
+]

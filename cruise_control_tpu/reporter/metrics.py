@@ -1,0 +1,163 @@
+"""CruiseControlMetric model + versioned binary serde.
+
+Reference: metricsreporter/metric/CruiseControlMetric.java (+ BrokerMetric /
+TopicMetric / PartitionMetric subclasses, MetricClassId) and
+MetricSerde.java — one class-id header byte, then a per-class versioned
+buffer. The wire format here mirrors that shape with Python struct packing;
+raw metric types are identified by their index in the shared taxonomy
+(monitor/metricdef.RAW_METRIC_TYPES, RawMetricType.java parity).
+"""
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+from cruise_control_tpu.monitor.metricdef import RAW_METRIC_TYPES, MetricScope
+
+# FROZEN raw-type wire ids (RawMetricType.java explicit serde ids role).
+# FileMetricsTopic logs are durable: these ids must NEVER be renumbered —
+# append new types with fresh ids. test_reporter asserts every taxonomy
+# entry is pinned here.
+RAW_TYPE_IDS = {
+    "ALL_TOPIC_BYTES_IN": 0, "ALL_TOPIC_BYTES_OUT": 1,
+    "ALL_TOPIC_REPLICATION_BYTES_IN": 2, "ALL_TOPIC_REPLICATION_BYTES_OUT": 3,
+    "ALL_TOPIC_FETCH_REQUEST_RATE": 4, "ALL_TOPIC_PRODUCE_REQUEST_RATE": 5,
+    "ALL_TOPIC_MESSAGES_IN_PER_SEC": 6, "BROKER_PRODUCE_REQUEST_RATE": 7,
+    "BROKER_CONSUMER_FETCH_REQUEST_RATE": 8,
+    "BROKER_FOLLOWER_FETCH_REQUEST_RATE": 9,
+    "BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT": 10,
+    "BROKER_REQUEST_QUEUE_SIZE": 11, "BROKER_RESPONSE_QUEUE_SIZE": 12,
+    "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MAX": 13,
+    "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MEAN": 14,
+    "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MAX": 15,
+    "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN": 16,
+    "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MAX": 17,
+    "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN": 18,
+    "BROKER_PRODUCE_TOTAL_TIME_MS_MAX": 19,
+    "BROKER_PRODUCE_TOTAL_TIME_MS_MEAN": 20,
+    "BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_MAX": 21,
+    "BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_MEAN": 22,
+    "BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_MAX": 23,
+    "BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_MEAN": 24,
+    "BROKER_PRODUCE_LOCAL_TIME_MS_MAX": 25,
+    "BROKER_PRODUCE_LOCAL_TIME_MS_MEAN": 26,
+    "BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_MAX": 27,
+    "BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_MEAN": 28,
+    "BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_MAX": 29,
+    "BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_MEAN": 30,
+    "BROKER_LOG_FLUSH_RATE": 31, "BROKER_LOG_FLUSH_TIME_MS_MAX": 32,
+    "BROKER_LOG_FLUSH_TIME_MS_MEAN": 33,
+    "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_50TH": 34,
+    "BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_999TH": 35,
+    "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_50TH": 36,
+    "BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_999TH": 37,
+    "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_50TH": 38,
+    "BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_999TH": 39,
+    "BROKER_PRODUCE_TOTAL_TIME_MS_50TH": 40,
+    "BROKER_PRODUCE_TOTAL_TIME_MS_999TH": 41,
+    "BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_50TH": 42,
+    "BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_999TH": 43,
+    "BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_50TH": 44,
+    "BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_999TH": 45,
+    "BROKER_PRODUCE_LOCAL_TIME_MS_50TH": 46,
+    "BROKER_PRODUCE_LOCAL_TIME_MS_999TH": 47,
+    "BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_50TH": 48,
+    "BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_999TH": 49,
+    "BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_50TH": 50,
+    "BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_999TH": 51,
+    "BROKER_LOG_FLUSH_TIME_MS_50TH": 52, "BROKER_LOG_FLUSH_TIME_MS_999TH": 53,
+    "BROKER_CPU_UTIL": 54,
+    "TOPIC_BYTES_IN": 55, "TOPIC_BYTES_OUT": 56,
+    "TOPIC_REPLICATION_BYTES_IN": 57, "TOPIC_REPLICATION_BYTES_OUT": 58,
+    "TOPIC_FETCH_REQUEST_RATE": 59, "TOPIC_PRODUCE_REQUEST_RATE": 60,
+    "TOPIC_MESSAGES_IN_PER_SEC": 61,
+    "PARTITION_SIZE": 62,
+}
+RAW_TYPE_NAMES = {i: name for name, i in RAW_TYPE_IDS.items()}
+
+# MetricClassId (CruiseControlMetric.MetricClassId)
+BROKER_METRIC = 0
+TOPIC_METRIC = 1
+PARTITION_METRIC = 2
+
+_VERSION = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class CruiseControlMetric:
+    raw_type: str            # RawMetricType name
+    time_ms: float
+    broker_id: int
+    value: float
+
+    @property
+    def class_id(self) -> int:
+        return BROKER_METRIC
+
+    @property
+    def scope(self) -> MetricScope:
+        return RAW_METRIC_TYPES[self.raw_type]
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerMetric(CruiseControlMetric):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class TopicMetric(CruiseControlMetric):
+    topic: str = ""
+
+    @property
+    def class_id(self) -> int:
+        return TOPIC_METRIC
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionMetric(TopicMetric):
+    partition: int = -1
+
+    @property
+    def class_id(self) -> int:
+        return PARTITION_METRIC
+
+
+_HEADER = struct.Struct(">BBHqid")   # class id, version, raw type, time, broker, value
+
+
+def metric_to_bytes(m: CruiseControlMetric) -> bytes:
+    """MetricSerde.toBytes analogue."""
+    head = _HEADER.pack(m.class_id, _VERSION, RAW_TYPE_IDS[m.raw_type],
+                        int(m.time_ms), m.broker_id, m.value)
+    if m.class_id == BROKER_METRIC:
+        return head
+    topic_b = m.topic.encode("utf-8")
+    body = struct.pack(">H", len(topic_b)) + topic_b
+    if m.class_id == PARTITION_METRIC:
+        body += struct.pack(">i", m.partition)
+    return head + body
+
+
+def metric_from_bytes(data: bytes) -> CruiseControlMetric:
+    """MetricSerde.fromBytes analogue; raises on unknown class/version
+    (UnknownVersionException parity)."""
+    class_id, version, type_id, time_ms, broker, value = _HEADER.unpack_from(data, 0)
+    if version != _VERSION:
+        raise ValueError(f"unknown metric serde version {version}")
+    if type_id not in RAW_TYPE_NAMES:
+        raise ValueError(f"unknown raw metric type id {type_id}")
+    raw_type = RAW_TYPE_NAMES[type_id]
+    off = _HEADER.size
+    if class_id == BROKER_METRIC:
+        return BrokerMetric(raw_type, float(time_ms), broker, value)
+    (tlen,) = struct.unpack_from(">H", data, off)
+    off += 2
+    topic = data[off:off + tlen].decode("utf-8")
+    off += tlen
+    if class_id == TOPIC_METRIC:
+        return TopicMetric(raw_type, float(time_ms), broker, value, topic)
+    if class_id == PARTITION_METRIC:
+        (partition,) = struct.unpack_from(">i", data, off)
+        return PartitionMetric(raw_type, float(time_ms), broker, value, topic,
+                               partition)
+    raise ValueError(f"unknown metric class id {class_id}")

@@ -1,0 +1,73 @@
+"""The metrics reporter agent.
+
+Reference: metricsreporter/CruiseControlMetricsReporter.java — runs inside
+every Kafka broker, periodically snapshots the broker's metric registry
+(YammerMetricProcessor role) and produces typed CruiseControlMetrics to the
+metrics topic. Here one reporter process snapshots a ClusterBackend (which
+stands in for the brokers' registries) and appends to a FileMetricsTopic;
+the emitted record stream has the same shape the reference sampler consumes:
+BROKER-scope rates/times per broker, TOPIC-scope rates per (broker, topic)
+leader aggregation, PARTITION_SIZE per (broker, topic, partition).
+"""
+from __future__ import annotations
+
+from cruise_control_tpu.reporter.metrics import (
+    BrokerMetric, PartitionMetric, TopicMetric, metric_to_bytes,
+)
+from cruise_control_tpu.reporter.topic import FileMetricsTopic
+
+
+class CruiseControlMetricsReporter:
+    def __init__(self, backend, topic: FileMetricsTopic):
+        self._backend = backend
+        self._topic = topic
+
+    def configure(self, config, backend=None, **extra):
+        if backend is not None:
+            self._backend = backend
+
+    def report_once(self, now_ms: float) -> int:
+        """One reporting interval across all brokers
+        (CruiseControlMetricsReporter.run snapshot role). Returns #records."""
+        records: list[bytes] = []
+        partitions = self._backend.partitions()
+        broker_metrics = self._backend.broker_metrics()
+
+        for b, metrics in broker_metrics.items():
+            for raw, value in (
+                    ("BROKER_CPU_UTIL", metrics.get("BROKER_CPU_UTIL", 0.0)),
+                    ("ALL_TOPIC_BYTES_IN", metrics.get("ALL_TOPIC_BYTES_IN", 0.0)),
+                    ("ALL_TOPIC_BYTES_OUT", metrics.get("ALL_TOPIC_BYTES_OUT", 0.0)),
+                    ("BROKER_LOG_FLUSH_TIME_MS_MEAN",
+                     metrics.get("BROKER_LOG_FLUSH_TIME_MS_MEAN", 0.0)),
+                    ("BROKER_LOG_FLUSH_TIME_MS_999TH",
+                     metrics.get("BROKER_LOG_FLUSH_TIME_MS_999TH", 0.0))):
+                records.append(metric_to_bytes(
+                    BrokerMetric(raw, now_ms, b, float(value))))
+
+        # TOPIC scope: per-(leader broker, topic) aggregates
+        topic_in: dict[tuple, float] = {}
+        topic_out: dict[tuple, float] = {}
+        for (topic, _p), info in partitions.items():
+            if info.leader < 0:
+                continue
+            key = (info.leader, topic)
+            topic_in[key] = topic_in.get(key, 0.0) + info.bytes_in_rate
+            topic_out[key] = topic_out.get(key, 0.0) + info.bytes_out_rate
+        for (b, topic), v in topic_in.items():
+            records.append(metric_to_bytes(
+                TopicMetric("TOPIC_BYTES_IN", now_ms, b, v, topic)))
+        for (b, topic), v in topic_out.items():
+            records.append(metric_to_bytes(
+                TopicMetric("TOPIC_BYTES_OUT", now_ms, b, v, topic)))
+
+        # PARTITION scope: sizes from the leader
+        for (topic, p), info in partitions.items():
+            if info.leader < 0:
+                continue
+            records.append(metric_to_bytes(PartitionMetric(
+                "PARTITION_SIZE", now_ms, info.leader, float(info.size_mb),
+                topic, p)))
+
+        self._topic.append(records)
+        return len(records)

@@ -1,0 +1,230 @@
+"""Metrics-reporter layer tests: serde, topic transport, reporter -> sampler
+round trip, webhook notifiers.
+
+Reference test roles: metricsreporter/ MetricSerde + integration tests
+(produce real metrics, consume via CruiseControlMetricsReporterSampler) and
+notifier/ Slack/Alerta tests.
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.backend import SimulatedClusterBackend
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.detector.anomalies import AnomalyType, BrokerFailures
+from cruise_control_tpu.detector.notifier import (
+    AlertaSelfHealingNotifier, SlackSelfHealingNotifier,
+)
+from cruise_control_tpu.monitor import LoadMonitor
+from cruise_control_tpu.monitor.sampling.reporter_sampler import (
+    CruiseControlMetricsReporterSampler,
+)
+from cruise_control_tpu.reporter import (
+    BrokerMetric, CruiseControlMetricsReporter, FileMetricsTopic,
+    PartitionMetric, TopicMetric, metric_from_bytes, metric_to_bytes,
+)
+
+
+def test_metric_serde_round_trip():
+    cases = [
+        BrokerMetric("BROKER_CPU_UTIL", 1000.0, 3, 42.5),
+        TopicMetric("TOPIC_BYTES_IN", 2000.0, 1, 1234.5, "payments"),
+        PartitionMetric("PARTITION_SIZE", 3000.0, 2, 9999.0, "payments", 7),
+    ]
+    for m in cases:
+        out = metric_from_bytes(metric_to_bytes(m))
+        assert out == m
+
+
+def test_metric_serde_rejects_unknown_version():
+    raw = bytearray(metric_to_bytes(BrokerMetric("BROKER_CPU_UTIL", 0.0, 0, 1.0)))
+    raw[1] = 99  # version byte
+    with pytest.raises(ValueError, match="version"):
+        metric_from_bytes(bytes(raw))
+
+
+def test_file_metrics_topic_offsets(tmp_path):
+    topic = FileMetricsTopic(str(tmp_path / "metrics.log"))
+    topic.append([b"aaa", b"bb"])
+    got = topic.consume(0)
+    assert [r for _, r in got] == [b"aaa", b"bb"]
+    # consuming from the returned offset yields only new records
+    off = got[-1][0]
+    topic.append([b"c"])
+    got2 = topic.consume(off)
+    assert [r for _, r in got2] == [b"c"]
+    assert topic.consume(topic.end_offset) == []
+
+
+def _backend():
+    be = SimulatedClusterBackend()
+    be.add_broker(0, "r0").add_broker(1, "r1")
+    be.create_partition("t", 0, [0, 1], size_mb=1000.0, bytes_in_rate=100.0,
+                        bytes_out_rate=200.0, cpu_util=5.0)
+    be.create_partition("t", 1, [1, 0], size_mb=3000.0, bytes_in_rate=50.0,
+                        bytes_out_rate=100.0, cpu_util=2.0)
+    return be
+
+
+def test_reporter_to_sampler_round_trip(tmp_path):
+    """Full reporter-path parity check: reporter produces raw metrics to the
+    topic; the sampler consumes + converts raw -> model samples; the monitor
+    builds a cluster model from them (the reference's default metric path)."""
+    be = _backend()
+    topic = FileMetricsTopic(str(tmp_path / "cc-metrics.log"))
+    reporter = CruiseControlMetricsReporter(be, topic)
+    sampler = CruiseControlMetricsReporterSampler(topic)
+    lm = LoadMonitor(backend=be, sampler=sampler)
+    lm.start_up()
+    for i in range(8):
+        n = reporter.report_once(now_ms=i * 300_000.0)
+        assert n > 0
+        lm.sample_once(now_ms=i * 300_000.0)
+    ct, meta = lm.cluster_model()
+    util = np.asarray(ct.broker_utilization())
+    # disk usage flows through PARTITION_SIZE: broker 0 hosts t-0 (leader,
+    # 1000) + t-1 (follower, 3000)
+    assert util[meta.broker_index(0), Resource.DISK] == pytest.approx(4000.0, rel=1e-3)
+    # leader bytes-in allocated from TOPIC_BYTES_IN by size share
+    lead = np.asarray(ct.leader_load)
+    valid = np.asarray(ct.replica_valid) & np.asarray(ct.replica_is_leader)
+    assert lead[valid][:, Resource.NW_IN].sum() == pytest.approx(150.0, rel=1e-3)
+
+
+def test_reporter_sampler_incremental_consumption(tmp_path):
+    be = _backend()
+    topic = FileMetricsTopic(str(tmp_path / "m.log"))
+    reporter = CruiseControlMetricsReporter(be, topic)
+    sampler = CruiseControlMetricsReporterSampler(topic)
+    reporter.report_once(1000.0)
+    s1 = sampler.get_samples(1000.0)
+    assert s1.partition_samples
+    # nothing new -> empty round (offset advanced)
+    s2 = sampler.get_samples(2000.0)
+    assert not s2.partition_samples and not s2.broker_samples
+
+
+class _Webhook(BaseHTTPRequestHandler):
+    received = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        _Webhook.received.append(
+            (self.path, dict(self.headers), json.loads(self.rfile.read(n))))
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+@pytest.fixture()
+def webhook_url():
+    _Webhook.received.clear()
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Webhook)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _anomaly():
+    return BrokerFailures(anomaly_type=AnomalyType.BROKER_FAILURE,
+                          detected_ms=0.0, failed_brokers={2: 0.0},
+                          description="broker 2 died")
+
+
+def test_slack_notifier_posts_webhook(webhook_url):
+    n = SlackSelfHealingNotifier(webhook=webhook_url, channel="#kafka-alerts")
+    n.set_self_healing(AnomalyType.BROKER_FAILURE, True)
+    n.alert_threshold_ms = 0.0
+    n.self_healing_threshold_ms = 0.0
+    result = n.on_anomaly(_anomaly(), now_ms=10_000.0)
+    assert result.action.name == "FIX"
+    assert len(_Webhook.received) == 1
+    _, _, body = _Webhook.received[0]
+    assert body["channel"] == "#kafka-alerts"
+    assert "BROKER_FAILURE" in body["text"] and "broker 2 died" in body["text"]
+
+
+def test_alerta_notifier_posts_alert(webhook_url):
+    n = AlertaSelfHealingNotifier(api_url=webhook_url, api_key="sekrit",
+                                  environment="Staging")
+    n.alert_threshold_ms = 0.0
+    n.self_healing_threshold_ms = 1e12   # alert-only window
+    n.on_anomaly(_anomaly(), now_ms=10_000.0)
+    assert len(_Webhook.received) == 1
+    path, headers, body = _Webhook.received[0]
+    assert path == "/alert"
+    assert headers.get("Authorization") == "Key sekrit"
+    assert body["environment"] == "Staging"
+    assert body["severity"] == "warning"
+    assert body["event"] == "BROKER_FAILURE"
+
+
+def test_webhook_failure_does_not_break_detection():
+    n = SlackSelfHealingNotifier(webhook="http://127.0.0.1:9/unreachable")
+    n.alert_threshold_ms = 0.0
+    n.self_healing_threshold_ms = 0.0
+    result = n.on_anomaly(_anomaly(), now_ms=10_000.0)   # must not raise
+    assert result is not None
+
+
+def test_all_raw_types_have_frozen_wire_ids():
+    """Every taxonomy entry must be pinned in the frozen serde id table
+    (RawMetricType.java explicit ids contract)."""
+    from cruise_control_tpu.monitor.metricdef import RAW_METRIC_TYPES
+    from cruise_control_tpu.reporter.metrics import RAW_TYPE_IDS
+    missing = set(RAW_METRIC_TYPES) - set(RAW_TYPE_IDS)
+    assert not missing, f"raw types without frozen wire ids: {missing}"
+    assert len(set(RAW_TYPE_IDS.values())) == len(RAW_TYPE_IDS)  # unique ids
+
+
+def test_sampler_skips_poison_records(tmp_path):
+    be = _backend()
+    topic = FileMetricsTopic(str(tmp_path / "m.log"))
+    reporter = CruiseControlMetricsReporter(be, topic)
+    reporter.report_once(1000.0)
+    topic.append([b"\x63garbage-record"])       # unknown class id 0x63
+    reporter.report_once(301_000.0)
+    sampler = CruiseControlMetricsReporterSampler(topic)
+    s = sampler.get_samples(400_000.0)
+    # both good intervals consumed despite the poison record between them
+    times = {ps.ts_ms for ps in s.partition_samples}
+    assert times == {1000.0, 301_000.0}
+    # offset advanced past everything: next round is empty, not an error
+    assert not sampler.get_samples(500_000.0).partition_samples
+
+
+def test_sampler_windows_by_serialized_time(tmp_path):
+    """A backlog spanning several intervals must land in the windows it was
+    measured in, not collapse into consume-time."""
+    be = _backend()
+    topic = FileMetricsTopic(str(tmp_path / "m.log"))
+    reporter = CruiseControlMetricsReporter(be, topic)
+    for i in range(5):
+        reporter.report_once(i * 300_000.0)      # 5 intervals, no consumption
+    sampler = CruiseControlMetricsReporterSampler(topic)
+    lm = LoadMonitor(backend=be, sampler=sampler)
+    lm.start_up()
+    lm.sample_once(now_ms=1_500_000.0)           # one consuming sweep
+    assert lm.num_valid_windows >= 4            # history preserved
+
+
+def test_sampler_leadership_change_no_double_count(tmp_path):
+    be = _backend()
+    topic = FileMetricsTopic(str(tmp_path / "m.log"))
+    from cruise_control_tpu.reporter import PartitionMetric, metric_to_bytes
+    # same (topic, partition, time) reported by two brokers (leader moved)
+    topic.append([
+        metric_to_bytes(PartitionMetric("PARTITION_SIZE", 1000.0, 0, 500.0, "t", 0)),
+        metric_to_bytes(PartitionMetric("PARTITION_SIZE", 1000.0, 1, 500.0, "t", 0)),
+    ])
+    sampler = CruiseControlMetricsReporterSampler(topic)
+    s = sampler.get_samples(2000.0)
+    assert len(s.partition_samples) == 1          # last report wins, no dup
+    assert s.partition_samples[0].values["DISK_USAGE"] == 500.0
