@@ -1,0 +1,4 @@
+"""``python -m cruise_control_tpu`` — KafkaCruiseControlMain analogue."""
+from cruise_control_tpu.main import main
+
+raise SystemExit(main())

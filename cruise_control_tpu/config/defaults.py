@@ -238,7 +238,7 @@ _D.define(name="concurrency.adjuster.multiplicative.decrease.leadership", type=T
 _D.define(name="leader.movement.timeout.ms", type=Type.LONG, default=180_000)
 _D.define(name="task.execution.alerting.threshold.ms", type=Type.LONG, default=90_000)
 _D.define(name="executor.backend.class", type=Type.CLASS,
-          default="cruise_control_tpu.executor.backends.SimulatedClusterBackend",
+          default="cruise_control_tpu.backend.simulated.SimulatedClusterBackend",
           doc="ClusterBackend plugin: simulated (tests/dev) or adapter to a real cluster "
               "(the reference actuates via ZK znodes + AdminClient, Executor.java:1272).")
 _D.define(name="remove.recently.removed.brokers.grace.ms", type=Type.LONG, default=0)

@@ -1,0 +1,171 @@
+"""Process bootstrap: properties file -> full Cruise Control service.
+
+Reference: KafkaCruiseControlMain.java:26-41 (takes a cruisecontrol.properties
+path, builds the config, boots KafkaCruiseControlApp) and
+KafkaCruiseControlApp.java:36-121 (constructs the facade, mounts the servlet,
+starts monitor + detection + web server). Run as::
+
+    python -m cruise_control_tpu config/cruisecontrol.properties \
+        [--cluster-spec cluster.json]
+
+The backend comes from ``executor.backend.class`` (simulated by default);
+``--cluster-spec`` seeds it from a JSON file of brokers + partitions so a
+standalone process has something to balance.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import threading
+import time
+
+LOG = logging.getLogger("cruise_control_tpu.main")
+
+
+def load_properties(path: str) -> dict:
+    """Parse a Kafka-style ``key=value`` properties file (comments with #)."""
+    props: dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            key, _, value = line.partition("=")
+            props[key.strip()] = value.strip()
+    return props
+
+
+def seed_backend_from_spec(backend, spec: dict) -> None:
+    """Seed a simulated backend from {"brokers": [...], "partitions": [...]}."""
+    for b in spec.get("brokers", []):
+        backend.add_broker(int(b["id"]), b.get("rack", "r0"),
+                           logdirs=b.get("logdirs"))
+    for p in spec.get("partitions", []):
+        backend.create_partition(
+            p["topic"], int(p["partition"]), [int(x) for x in p["replicas"]],
+            size_mb=float(p.get("sizeMb", 0.0)),
+            bytes_in_rate=float(p.get("bytesInRate", 0.0)),
+            bytes_out_rate=float(p.get("bytesOutRate", 0.0)),
+            cpu_util=float(p.get("cpuUtil", 0.0)))
+
+
+def build_app(config, backend=None):
+    """Construct backend + facade (KafkaCruiseControl wiring order)."""
+    from cruise_control_tpu.app import CruiseControl
+    if backend is None:
+        backend = config.get_configured_instance("executor.backend.class")
+    return CruiseControl(backend, config)
+
+
+def build_server(cc, config):
+    """Mount the REST layer per the webserver.* config surface
+    (KafkaCruiseControlApp.java:45-61 Jetty bootstrap role)."""
+    from cruise_control_tpu.api import CruiseControlServer
+    from cruise_control_tpu.api.security import (
+        BasicSecurityProvider, NoopSecurityProvider,
+    )
+    security = NoopSecurityProvider()
+    if config.get_boolean("webserver.security.enable"):
+        cred_file = config.get_string("webserver.auth.credentials.file")
+        if not cred_file:
+            raise ValueError("webserver.security.enable requires "
+                             "webserver.auth.credentials.file")
+        security = BasicSecurityProvider.from_file(cred_file)
+    return CruiseControlServer(
+        cc,
+        host=config.get_string("webserver.http.address"),
+        port=config.get_int("webserver.http.port"),
+        security_provider=security,
+        two_step_verification=config.get_boolean("two.step.verification.enabled"),
+        max_block_ms=float(config.get_int("webserver.request.maxBlockTimeMs")),
+        max_active_user_tasks=config.get_int("max.active.user.tasks"),
+        completed_user_task_retention_ms=float(
+            config.get_int("completed.user.task.retention.time.ms")))
+
+
+class SamplingLoop:
+    """Periodic sampling driver (LoadMonitorTaskRunner SamplingTask schedule).
+
+    When the backend carries a simulated clock (``advance``), each round also
+    advances it by the interval so detector grace periods / deferred re-checks
+    move with wall time — otherwise a standalone run against the simulated
+    backend would mix a frozen sim clock with wall-clock sample stamps.
+    """
+
+    def __init__(self, load_monitor, interval_ms: float, backend=None):
+        self._lm = load_monitor
+        self._backend = backend
+        self._interval_ms = interval_ms
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="sampling-loop",
+                                        daemon=True)
+
+    def _run(self):
+        while not self._stop.wait(self._interval_ms / 1000.0):
+            try:
+                if self._backend is not None and hasattr(self._backend, "advance"):
+                    self._backend.advance(self._interval_ms)
+                self._lm.sample_once()
+            except Exception:
+                LOG.exception("sampling round failed")
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=30.0)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cruise-control-tpu",
+        description="TPU-native Cruise Control service")
+    parser.add_argument("properties", help="cruisecontrol.properties path")
+    parser.add_argument("--cluster-spec", default=None,
+                        help="JSON cluster spec to seed the simulated backend")
+    parser.add_argument("--no-detection", action="store_true",
+                        help="do not start the anomaly detection loop")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    from cruise_control_tpu.config import cruise_control_config
+    config = cruise_control_config(load_properties(args.properties))
+    cc = build_app(config)
+    if args.cluster_spec:
+        with open(args.cluster_spec) as f:
+            seed_backend_from_spec(cc.backend, json.load(f))
+
+    # startUp order mirrors KafkaCruiseControl.startUp (:201-207): monitor
+    # replay, sampling schedule, anomaly detection, then the web server
+    cc.start_up()
+    sampling = SamplingLoop(cc.load_monitor,
+                            config.get_int("metric.sampling.interval.ms"),
+                            backend=cc.backend)
+    sampling.start()
+    if not args.no_detection:
+        cc.anomaly_detector.start_detection(
+            config.get_int("anomaly.detection.interval.ms"))
+    server = build_server(cc, config)
+    server.start()
+    LOG.info("cruise-control-tpu serving on %s", server.base_url)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        LOG.info("shutting down")
+    finally:
+        server.stop()
+        sampling.stop()
+        cc.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+"""Process bootstrap tests (KafkaCruiseControlMain/App role)."""
+import json
+
+import pytest
+
+from cruise_control_tpu.client import CruiseControlClient
+from cruise_control_tpu.config import cruise_control_config
+from cruise_control_tpu.main import (
+    build_app, build_server, load_properties, seed_backend_from_spec,
+)
+
+
+def test_load_properties(tmp_path):
+    p = tmp_path / "cruisecontrol.properties"
+    p.write_text("""
+# comment
+webserver.http.port=0
+num.metrics.windows = 7
+goals=RackAwareGoal,DiskCapacityGoal
+hard.goals=RackAwareGoal,DiskCapacityGoal
+default.goals=RackAwareGoal,DiskCapacityGoal
+anomaly.detection.goals=RackAwareGoal
+
+self.healing.enabled=true
+""")
+    props = load_properties(str(p))
+    assert props["webserver.http.port"] == "0"
+    assert props["num.metrics.windows"] == "7"
+    assert props["goals"] == "RackAwareGoal,DiskCapacityGoal"
+    cfg = cruise_control_config(props)
+    assert cfg.get_int("num.metrics.windows") == 7
+    assert cfg.get_list("goals") == ["RackAwareGoal", "DiskCapacityGoal"]
+    assert cfg.get_boolean("self.healing.enabled") is True
+
+
+def test_bootstrap_end_to_end(tmp_path):
+    """properties + cluster spec -> booted service answering REST requests."""
+    spec = {
+        "brokers": [{"id": b, "rack": f"r{b % 2}"} for b in range(4)],
+        "partitions": [
+            {"topic": "t", "partition": p, "replicas": [p % 4, (p + 1) % 4],
+             "sizeMb": 100.0 + 10 * p, "bytesInRate": 10.0, "cpuUtil": 1.0}
+            for p in range(8)
+        ],
+    }
+    spec_path = tmp_path / "cluster.json"
+    spec_path.write_text(json.dumps(spec))
+    props = tmp_path / "cc.properties"
+    props.write_text("webserver.http.port=0\n"
+                     "min.samples.per.metrics.window=1\n"
+                     "webserver.request.maxBlockTimeMs=120000\n")
+    config = cruise_control_config(load_properties(str(props)))
+    cc = build_app(config)
+    seed_backend_from_spec(cc.backend, json.loads(spec_path.read_text()))
+    cc.start_up()
+    for i in range(8):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    server = build_server(cc, config)
+    server.start()
+    try:
+        client = CruiseControlClient(f"127.0.0.1:{server.port}", timeout_s=300)
+        state = client.state()
+        assert state["MonitorState"]["state"] == "RUNNING"
+        ks = client.kafka_cluster_state()
+        assert ks["KafkaPartitionState"]["totalPartitions"] == 8
+        assert len(ks["KafkaBrokerState"]) == 4
+    finally:
+        server.stop()
+        cc.shutdown()
+
+
+def test_security_enable_requires_credentials(tmp_path):
+    config = cruise_control_config({"webserver.security.enable": True})
+    cc = build_app(config)
+    with pytest.raises(ValueError, match="credentials"):
+        build_server(cc, config)
