@@ -91,7 +91,8 @@ class OptimizationOptions:
                       "broker_disk_capacity", "broker_disk_alive",
                       "replica_partition", "replica_topic", "replica_valid",
                       "replica_original_broker", "partition_replicas", "partition_topic",
-                      "topic_excluded", "topic_min_leaders", "dst_candidate"],
+                      "topic_excluded", "topic_min_leaders", "dst_candidate",
+                      "num_real_racks"],
          meta_fields=["num_racks", "max_rf"])
 @dataclasses.dataclass(frozen=True)
 class ClusterEnv:
@@ -115,8 +116,9 @@ class ClusterEnv:
     topic_excluded: Array       # bool[T]
     topic_min_leaders: Array    # bool[T] topics subject to MinTopicLeadersPerBrokerGoal
     dst_candidate: Array        # bool[B] allowed destination brokers (alive, not excluded)
-    num_racks: int
-    max_rf: int
+    num_real_racks: Array       # i32 scalar: ACTUAL rack count (rack math input)
+    num_racks: int              # padded rack-axis size (shape bucket; >= real)
+    max_rf: int                 # padded membership-table width (shape bucket)
 
     @property
     def num_brokers(self) -> int:
@@ -160,7 +162,15 @@ def build_partition_replicas(ct: ClusterTensor) -> np.ndarray:
 
 def make_env(ct: ClusterTensor, meta: ClusterMeta,
              topic_min_leaders_mask: np.ndarray | None = None) -> ClusterEnv:
+    from cruise_control_tpu.model.cluster_tensor import bucket_size
     table = build_partition_replicas(ct)
+    # bucket the RF width (padded with -1 members) and the rack-axis size so
+    # clusters differing only in max RF or rack count share compiled engine
+    # programs; the SEMANTIC rack count rides along as traced data
+    F = bucket_size(table.shape[1], 4)
+    if F != table.shape[1]:
+        table = np.pad(table, [(0, 0), (0, F - table.shape[1])],
+                       constant_values=-1)
     T = ct.num_topics
     tml = (np.zeros(T, bool) if topic_min_leaders_mask is None
            else np.asarray(topic_min_leaders_mask, bool))
@@ -189,7 +199,8 @@ def make_env(ct: ClusterTensor, meta: ClusterMeta,
         topic_excluded=ct.topic_excluded,
         topic_min_leaders=jnp.asarray(tml),
         dst_candidate=jnp.asarray(dst_ok),
-        num_racks=meta.num_racks,
+        num_real_racks=jnp.asarray(meta.num_racks, jnp.int32),
+        num_racks=bucket_size(meta.num_racks, 8),
         max_rf=int(table.shape[1]),
     )
 

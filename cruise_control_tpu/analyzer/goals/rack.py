@@ -95,7 +95,7 @@ class RackAwareDistributionGoal(GoalKernel):
 
     def _max_per_rack(self, env: ClusterEnv) -> jnp.ndarray:
         rf = self._partition_rf(env)
-        return jnp.ceil(rf / jnp.maximum(env.num_racks, 1)).astype(jnp.int32)
+        return jnp.ceil(rf / jnp.maximum(env.num_real_racks, 1)).astype(jnp.int32)
 
     def broker_severity(self, env: ClusterEnv, st: EngineState):
         limit = self._max_per_rack(env)                                      # [P]
@@ -120,7 +120,7 @@ class RackAwareDistributionGoal(GoalKernel):
         """i32[K] per-candidate rack limit (avoids the full [P] computation in
         the engine's per-move re-scoring loop)."""
         rf = jnp.sum(env.partition_replicas[p] >= 0, axis=1)                 # [K]
-        return jnp.ceil(rf / jnp.maximum(env.num_racks, 1)).astype(jnp.int32)
+        return jnp.ceil(rf / jnp.maximum(env.num_real_racks, 1)).astype(jnp.int32)
 
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
         p = env.replica_partition[cand]

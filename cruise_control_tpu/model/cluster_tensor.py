@@ -283,7 +283,9 @@ def bucket_size(n: int, minimum: int = 8) -> int:
 
 
 def pad_cluster(ct: ClusterTensor, meta: ClusterMeta,
-                minimum: int = 8) -> tuple[ClusterTensor, ClusterMeta]:
+                min_replicas: int = 1024, min_brokers: int = 16,
+                min_partitions: int = 256,
+                min_topics: int = 16) -> tuple[ClusterTensor, ClusterMeta]:
     """Pad the replica/broker/partition/topic axes up to bucket sizes.
 
     Padding is appended, so existing indices stay valid: padded replicas have
@@ -292,10 +294,15 @@ def pad_cluster(ct: ClusterTensor, meta: ClusterMeta,
     or party to any limit computed over alive brokers), padded partitions have
     no members, padded topics have zero counts. ``meta`` is shared unchanged —
     its name lists keep their original lengths and indices.
+
+    The floors are deliberately generous: every cluster below them shares ONE
+    shape bucket, so the whole small-fixture test population reuses a single
+    set of compiled engine programs (at floor scale the padded compute is
+    noise; at real scale the {1,1.25,1.5,1.75}x2^k ladder caps waste at 25%).
     """
     R, B, P, T = ct.num_replicas, ct.num_brokers, ct.num_partitions, ct.num_topics
-    Rp, Bp, Pp, Tp = (bucket_size(R, minimum), bucket_size(B, minimum),
-                      bucket_size(P, minimum), bucket_size(T, minimum))
+    Rp, Bp, Pp, Tp = (bucket_size(R, min_replicas), bucket_size(B, min_brokers),
+                      bucket_size(P, min_partitions), bucket_size(T, min_topics))
     if (Rp, Bp, Pp, Tp) == (R, B, P, T):
         return ct, meta
 

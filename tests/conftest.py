@@ -3,14 +3,35 @@ paths are exercised without TPU hardware (the driver separately dry-runs
 multichip via __graft_entry__.dryrun_multichip)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the ambient environment may point JAX at real TPU
+# hardware (JAX_PLATFORMS=axon under the driver tunnel); the suite is written
+# for the deterministic 8-device virtual CPU platform. Opt out with
+# CC_TPU_TESTS_ON_HW=1 to run the suite against the ambient platform.
+if not os.environ.get("CC_TPU_TESTS_ON_HW"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 # Keep compile times sane in CI: 64-bit off (f32 everywhere, matching TPU).
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 # Persistent compilation cache: the engine compiles one loop per
-# (goal, prev-goals) combo — cache them across test runs.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+# (goal, prev-goals) combo — cache them across test runs. Deliberately a
+# DIFFERENT directory from bench.py's TPU cache: CPU AOT artifacts are keyed
+# loosely enough that entries compiled on another machine (the TPU tunnel's
+# terminal host) can load here and SIGILL on missing ISA features.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_cpu")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+# A sitecustomize may have imported jax (with a hardware platform plugin)
+# before this conftest runs, making every env var above too late; the config
+# updates below still win as long as no backend has been initialized.
+import jax  # noqa: E402
+
+if not os.environ.get("CC_TPU_TESTS_ON_HW"):
+    jax.config.update("jax_platforms", "cpu")
+if not os.environ.get("CC_TPU_NO_COMPILE_CACHE"):
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
