@@ -145,7 +145,11 @@ def _compiled_ple(ple):
 
 class GoalOptimizer:
     def __init__(self, config=None, constraint: BalancingConstraint | None = None,
-                 engine_params: EngineParams | None = None):
+                 engine_params: EngineParams | None = None, sensors=None):
+        from cruise_control_tpu.common.sensors import MetricRegistry
+        self._sensors = sensors if sensors is not None else MetricRegistry()
+        # GoalOptimizer.java:125 proposal-computation-timer
+        self._proposal_timer = self._sensors.timer("proposal-computation-timer")
         self._config = config
         if constraint is None:
             constraint = (BalancingConstraint.from_config(config) if config is not None
@@ -186,6 +190,14 @@ class GoalOptimizer:
         all goal programs asynchronously — one device round-trip for the whole
         chain instead of one per goal, which dominates wall clock on a
         tunneled/remote TPU."""
+        with self._proposal_timer.time():
+            return self._optimizations(ct, meta, goal_names, options,
+                                       skip_hard_goal_check, raise_on_failure,
+                                       measure_goal_durations)
+
+    def _optimizations(self, ct, meta, goal_names, options,
+                       skip_hard_goal_check, raise_on_failure,
+                       measure_goal_durations) -> OptimizerResult:
         names = goal_names or self._default_goal_names
         # honour hard-goal enforcement (KafkaCruiseControl sanityCheckHardGoalPresence)
         if goal_names and not skip_hard_goal_check:

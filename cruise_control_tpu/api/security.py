@@ -1,12 +1,13 @@
-"""HTTP security: pluggable provider, basic auth, role-based authorization.
+"""HTTP security: pluggable provider, basic/JWT/trusted-proxy auth, roles.
 
 Reference: servlet/security/ — SecurityProvider SPI, BasicSecurityProvider
-(htpasswd-style credential file), DefaultRoleSecurityProvider with roles
-VIEWER/USER/ADMIN, and trusted-proxy support. JWT/SPNEGO providers are
-Jetty-specific and are represented here by the same SPI seam (a provider maps
-request credentials -> (principal, role)); the default deployment is
-unauthenticated, matching the reference's webserver.security.enable=false
-default (WebServerConfig.java).
+(htpasswd-style credential file), jwt/ (JwtAuthenticator + JwtLoginService),
+trusted-proxy (TrustedProxyAuthenticator: an authenticated proxy forwards the
+end user via ``doAs``), DefaultRoleSecurityProvider with roles
+VIEWER/USER/ADMIN. SPNEGO is Kerberos/Jetty-specific and is represented by
+the same SPI seam (a provider maps request credentials ->
+(principal, role)); the default deployment is unauthenticated, matching the
+reference's webserver.security.enable=false default (WebServerConfig.java).
 
 Role semantics (DefaultRoleSecurityProvider):
   VIEWER — monitor-type endpoints (STATE, LOAD, PROPOSALS, ...)
@@ -17,6 +18,10 @@ from __future__ import annotations
 
 import base64
 import binascii
+import hashlib
+import hmac
+import json
+import time
 
 from cruise_control_tpu.api.endpoints import EndPoint, EndpointType
 
@@ -70,6 +75,11 @@ class BasicSecurityProvider(SecurityProvider):
     def __init__(self, credentials: dict[str, tuple[str, str]]):
         self._creds = credentials  # user -> (password, role)
 
+    def user_roles(self) -> dict[str, str]:
+        """user -> role map (trusted-proxy reuses the realm file for doAs
+        principals' roles)."""
+        return {u: role for u, (_pw, role) in self._creds.items()}
+
     @classmethod
     def from_file(cls, path: str) -> "BasicSecurityProvider":
         creds = {}
@@ -96,3 +106,123 @@ class BasicSecurityProvider(SecurityProvider):
         if entry is None or entry[0] != password:
             raise AuthError("bad credentials", 401)
         return (user, entry[1])
+
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+class JwtSecurityProvider(SecurityProvider):
+    """Bearer-token auth: HS256 JWTs verified against a shared secret.
+
+    Reference: servlet/security/jwt/JwtAuthenticator + JwtLoginService —
+    there an RS256 cert from ``jwt.authentication.provider.url``; here an
+    HMAC shared secret (no cryptography dependency), same claims contract:
+    the principal comes from the configured user-claim, expiry is enforced,
+    and the role is looked up in the authorized-users map (or taken from a
+    ``role`` claim when no map is given).
+    """
+
+    def __init__(self, secret: bytes | str, roles: dict[str, str] | None = None,
+                 principal_claim: str = "sub", clock=time.time):
+        self._secret = secret.encode() if isinstance(secret, str) else secret
+        self._roles = {u: r.upper() for u, r in (roles or {}).items()}
+        self._claim = principal_claim
+        self._clock = clock
+
+    def authenticate(self, headers) -> tuple[str, str]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            raise AuthError("bearer token required", 401)
+        token = auth[7:].strip()
+        parts = token.split(".")
+        if len(parts) != 3:
+            raise AuthError("malformed JWT", 401)
+        try:
+            header = json.loads(_b64url_decode(parts[0]))
+            payload = json.loads(_b64url_decode(parts[1]))
+            sig = _b64url_decode(parts[2])
+        except (binascii.Error, ValueError):
+            raise AuthError("malformed JWT", 401) from None
+        if header.get("alg") != "HS256":
+            raise AuthError(f"unsupported JWT alg {header.get('alg')!r}", 401)
+        expect = hmac.new(self._secret,
+                          f"{parts[0]}.{parts[1]}".encode("ascii"),
+                          hashlib.sha256).digest()
+        if not hmac.compare_digest(sig, expect):
+            raise AuthError("bad JWT signature", 401)
+        exp = payload.get("exp")
+        if exp is not None and self._clock() >= float(exp):
+            raise AuthError("JWT expired", 401)
+        principal = payload.get(self._claim)
+        if not principal:
+            raise AuthError(f"JWT missing {self._claim!r} claim", 401)
+        if self._roles:
+            role = self._roles.get(principal)
+            if role is None:
+                raise AuthError(f"user {principal!r} not authorized", 403)
+        else:
+            role = str(payload.get("role", ROLE_VIEWER)).upper()
+        if role not in _ROLE_RANK:
+            raise AuthError(f"unknown role {role!r}", 403)
+        return (principal, role)
+
+    @staticmethod
+    def make_token(secret: bytes | str, principal: str, role: str | None = None,
+                   expires_in_s: float | None = 3600.0,
+                   principal_claim: str = "sub", clock=time.time) -> str:
+        """Mint an HS256 token (test/ops utility — the reference's login
+        service is external; this is its stand-in for round-trip tests)."""
+        secret = secret.encode() if isinstance(secret, str) else secret
+        def enc(obj):
+            return base64.urlsafe_b64encode(
+                json.dumps(obj, separators=(",", ":")).encode()).rstrip(b"=").decode()
+        payload = {principal_claim: principal}
+        if role is not None:
+            payload["role"] = role
+        if expires_in_s is not None:
+            payload["exp"] = clock() + expires_in_s
+        head_body = f"{enc({'alg': 'HS256', 'typ': 'JWT'})}.{enc(payload)}"
+        sig = hmac.new(secret, head_body.encode("ascii"), hashlib.sha256).digest()
+        return f"{head_body}.{base64.urlsafe_b64encode(sig).rstrip(b'=').decode()}"
+
+
+class TrustedProxySecurityProvider(SecurityProvider):
+    """An authenticated proxy service forwards the real user.
+
+    Reference: servlet/security/trustedproxy/ — the proxy authenticates
+    itself (here: via a delegate provider, e.g. Basic or JWT) and names the
+    end user in the ``doAs`` request header/parameter; only principals in the
+    trusted-service list may delegate, optionally restricted to an IP
+    allowlist (trusted.proxy.services / trusted.proxy.spnego.fallback roles).
+    """
+
+    DO_AS_HEADER = "X-Do-As"
+
+    def __init__(self, delegate: SecurityProvider, trusted_services: list[str],
+                 user_roles: dict[str, str] | None = None,
+                 fallback_to_delegate: bool = True):
+        self._delegate = delegate
+        self._trusted = set(trusted_services)
+        self._user_roles = {u: r.upper() for u, r in (user_roles or {}).items()}
+        self._fallback = fallback_to_delegate
+
+    def authenticate(self, headers) -> tuple[str, str]:
+        principal, role = self._delegate.authenticate(headers)
+        do_as = headers.get(self.DO_AS_HEADER)
+        if not do_as:
+            if self._fallback:
+                return (principal, role)
+            raise AuthError("trusted proxy requests must carry "
+                            f"{self.DO_AS_HEADER}", 401)
+        if principal not in self._trusted:
+            raise AuthError(f"{principal!r} is not a trusted proxy", 403)
+        if self._user_roles:
+            # a roles map is authoritative: unknown doAs principals are
+            # rejected, matching direct-auth behavior for unknown users
+            user_role = self._user_roles.get(do_as)
+            if user_role is None:
+                raise AuthError(f"doAs principal {do_as!r} not authorized", 403)
+        else:
+            user_role = ROLE_VIEWER
+        return (do_as, user_role)

@@ -76,6 +76,21 @@ class CruiseControlServer:
     def handle(self, method: str, endpoint: EndPoint, params: dict,
                client: str, task_id_header: str | None):
         """Returns (status_code, body_dict, extra_headers)."""
+        import time as _time
+        t0 = _time.monotonic()
+        status, body, headers = self._handle(method, endpoint, params, client,
+                                             task_id_header)
+        # per-endpoint success timer (KafkaCruiseControlServlet.java:64);
+        # 202 progress polls / purgatory parks are NOT completed executions —
+        # recording them would make the timer describe polling, not latency
+        sensors = getattr(self.app, "sensors", None)
+        if sensors is not None and status == 200:
+            sensors.timer(f"{endpoint.path}-successful-request-execution-timer"
+                          ).record(_time.monotonic() - t0)
+        return status, body, headers
+
+    def _handle(self, method: str, endpoint: EndPoint, params: dict,
+                client: str, task_id_header: str | None):
         headers: dict[str, str] = {}
 
         # two-step verification: POSTs (except /review) must be reviewed
@@ -112,6 +127,14 @@ class CruiseControlServer:
     def _handle_async(self, method, endpoint, params, client, task_id_header,
                       headers):
         # parameter problems must 400 before a task slot is consumed
+        if params.get("excluded_topics"):
+            import re
+            try:
+                re.compile(params["excluded_topics"])
+            except re.error as e:
+                raise ParameterError(
+                    f"invalid excluded_topics regex "
+                    f"{params['excluded_topics']!r}: {e}")
         if endpoint is EndPoint.TOPIC_CONFIGURATION and (
                 not params["topic"] or params["replication_factor"] is None):
             raise ParameterError(
@@ -172,7 +195,8 @@ class CruiseControlServer:
                         goals = kafka_assigner_goal_names(goals or [])
                     res = app.cached_proposals(
                         force_refresh=p["ignore_proposal_cache"],
-                        goal_names=goals)
+                        goal_names=goals,
+                        excluded_topics=p["excluded_topics"])
                     return wrap({"summary": res.to_json()})
                 if endpoint is EndPoint.REBALANCE:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
@@ -181,16 +205,31 @@ class CruiseControlServer:
                         skip_hard_goal_check=p["skip_hard_goal_check"],
                         rebalance_disk=p["rebalance_disk"],
                         kafka_assigner=p["kafka_assigner"],
+                        excluded_topics=p["excluded_topics"],
+                        exclude_recently_removed_brokers=
+                        p["exclude_recently_removed_brokers"],
+                        exclude_recently_demoted_brokers=
+                        p["exclude_recently_demoted_brokers"],
                         reason=p["reason"] or "rebalance request"))
                 if endpoint is EndPoint.ADD_BROKER:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
                     return wrap(app.add_brokers(
                         p["brokerid"] or [], dry_run=p["dryrun"],
+                        excluded_topics=p["excluded_topics"],
+                        exclude_recently_removed_brokers=
+                        p["exclude_recently_removed_brokers"],
+                        exclude_recently_demoted_brokers=
+                        p["exclude_recently_demoted_brokers"],
                         reason=p["reason"] or "add brokers"))
                 if endpoint is EndPoint.REMOVE_BROKER:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
                     return wrap(app.remove_brokers(
                         p["brokerid"] or [], dry_run=p["dryrun"],
+                        excluded_topics=p["excluded_topics"],
+                        exclude_recently_removed_brokers=
+                        p["exclude_recently_removed_brokers"],
+                        exclude_recently_demoted_brokers=
+                        p["exclude_recently_demoted_brokers"],
                         reason=p["reason"] or "remove brokers"))
                 if endpoint is EndPoint.DEMOTE_BROKER:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
@@ -201,6 +240,11 @@ class CruiseControlServer:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
                     return wrap(app.fix_offline_replicas(
                         dry_run=p["dryrun"],
+                        excluded_topics=p["excluded_topics"],
+                        exclude_recently_removed_brokers=
+                        p["exclude_recently_removed_brokers"],
+                        exclude_recently_demoted_brokers=
+                        p["exclude_recently_demoted_brokers"],
                         reason=p["reason"] or "fix offline replicas"))
                 if endpoint is EndPoint.TOPIC_CONFIGURATION:
                     return wrap(app.fix_topic_replication_factor(
@@ -315,6 +359,11 @@ def _make_handler(server: CruiseControlServer):
                 self._send(405, error_json(
                     f"{endpoint.path} only supports {other}"), {})
                 return
+            # the reference's trusted-proxy contract names the end user in the
+            # ?doas= parameter; surface it to providers as the doAs header
+            doas_vals = urllib.parse.parse_qs(parsed.query).get("doas")
+            if doas_vals and not self.headers.get("X-Do-As"):
+                self.headers["X-Do-As"] = doas_vals[0]
             try:
                 principal, role = server.security.authenticate(self.headers)
                 if not server.security.authorize(role, endpoint, method):

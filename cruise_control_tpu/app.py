@@ -61,11 +61,19 @@ class OperationResult:
 
 class CruiseControl:
     def __init__(self, backend, config=None):
+        from cruise_control_tpu.common.sensors import MetricRegistry
         self.config = config or cruise_control_config()
         self.backend = backend
-        self.load_monitor = LoadMonitor(config=self.config, backend=backend)
-        self.goal_optimizer = GoalOptimizer(config=self.config)
-        self.executor = Executor(backend, config=self.config)
+        # one registry for the whole app — the MetricRegistry -> JMX domain
+        # kafka.cruisecontrol role (KafkaCruiseControlApp.java:29,40); exported
+        # via /state?substates=SENSORS
+        self.sensors = MetricRegistry()
+        self.load_monitor = LoadMonitor(config=self.config, backend=backend,
+                                        sensors=self.sensors)
+        self.goal_optimizer = GoalOptimizer(config=self.config,
+                                            sensors=self.sensors)
+        self.executor = Executor(backend, config=self.config,
+                                 sensors=self.sensors)
         notifier = SelfHealingNotifier()
         notifier.configure(self.config)
         clock = SimClock(backend) if hasattr(backend, "advance") else None
@@ -83,7 +91,8 @@ class CruiseControl:
         disk_fd = DiskFailureDetector(self.backend)
         goal_vd = GoalViolationDetector(
             self.goal_optimizer, self.load_monitor,
-            self.config.get_list("anomaly.detection.goals"))
+            self.config.get_list("anomaly.detection.goals"),
+            sensors=self.sensors)
         slow = SlowBrokerFinder()
         slow.configure(self.config)
         topic_rf = TopicReplicationFactorAnomalyFinder()
@@ -127,6 +136,58 @@ class CruiseControl:
     def _model(self, requirements=None):
         return self.load_monitor.cluster_model(requirements)
 
+    def _apply_excluded_topics(self, ct, meta, pattern: str | None):
+        """Mask topics matching ``pattern`` (or the configured default regex,
+        topics.excluded.from.partition.movement) from partition movement —
+        the excludedTopics parameter semantics (GoalBasedOperationRunnable /
+        OptimizationOptions excludedTopics role)."""
+        import re
+        pattern = pattern if pattern is not None else \
+            self.config.get_string("topics.excluded.from.partition.movement")
+        if not pattern:
+            return ct
+        try:
+            rx = re.compile(pattern)
+        except re.error as e:
+            # backstop only: the server pre-validates request patterns (400)
+            # and config load pre-validates the configured pattern
+            raise ValueError(
+                f"invalid excluded_topics regex {pattern!r}: {e}") from None
+        excl = np.asarray(ct.topic_excluded).copy()
+        for i, name in enumerate(meta.topic_names):
+            if rx.fullmatch(name):
+                excl[i] = True
+        import jax.numpy as jnp
+        return dataclasses.replace(ct, topic_excluded=jnp.asarray(excl))
+
+    def _apply_broker_exclusions(self, ct, meta, exclude_recently_removed: bool,
+                                 exclude_recently_demoted: bool):
+        """Blocklist recently removed brokers as move destinations and
+        recently demoted brokers for leadership (the
+        excludeRecentlyRemovedBrokers / excludeRecentlyDemotedBrokers
+        parameter semantics; history kept by the executor,
+        Executor.java:449-506)."""
+        import jax.numpy as jnp
+        known = set(meta.broker_ids)
+        if exclude_recently_removed:
+            # skip history entries for brokers the backend no longer reports
+            removed = self.executor.recently_removed_brokers() & known
+            if removed:
+                excl = np.asarray(ct.broker_excluded_for_replica_move).copy()
+                for b in removed:
+                    excl[meta.broker_index(b)] = True
+                ct = dataclasses.replace(
+                    ct, broker_excluded_for_replica_move=jnp.asarray(excl))
+        if exclude_recently_demoted:
+            demoted = self.executor.recently_demoted_brokers() & known
+            if demoted:
+                excl = np.asarray(ct.broker_excluded_for_leadership).copy()
+                for b in demoted:
+                    excl[meta.broker_index(b)] = True
+                ct = dataclasses.replace(
+                    ct, broker_excluded_for_leadership=jnp.asarray(excl))
+        return ct
+
     def _run_optimization(self, operation: str, reason: str, ct, meta,
                           goal_names=None, options=OptimizationOptions(),
                           dry_run: bool = True, skip_hard_goal_check: bool = False,
@@ -144,13 +205,23 @@ class CruiseControl:
                                   "ms": self._now_ms(),
                                   "numProposals": len(res.proposals),
                                   "executed": op.executed})
+        if op.executed:
+            # dedicated operation log channel (OPERATION_LOGGER, Executor.java:1037)
+            from cruise_control_tpu.common.sensors import OPERATION_LOGGER
+            OPERATION_LOGGER.info(
+                "%s (%s): executed %d proposals (%d replica moves, %d "
+                "leadership moves)", operation, reason, len(res.proposals),
+                res.num_replica_movements, res.num_leadership_movements)
         return op
 
     # ---------------------------------------------------------- operations
     def rebalance(self, goal_names=None, dry_run: bool = False,
                   self_healing: bool = False, triggered_by_goal_violation: bool = False,
                   skip_hard_goal_check: bool = False, rebalance_disk: bool = False,
-                  kafka_assigner: bool = False, reason: str = "rebalance") -> dict:
+                  kafka_assigner: bool = False, excluded_topics: str | None = None,
+                  exclude_recently_removed_brokers: bool = False,
+                  exclude_recently_demoted_brokers: bool = False,
+                  reason: str = "rebalance") -> dict:
         """POST /rebalance (RebalanceRunnable.java:30-115 role).
         ``rebalance_disk=True`` balances load across the logdirs of each
         broker with the intra-broker goal chain instead
@@ -158,6 +229,10 @@ class CruiseControl:
         substitutes the kafka-assigner mode goals
         (analyzer/kafkaassigner/ role)."""
         ct, meta = self._model()
+        ct = self._apply_excluded_topics(ct, meta, excluded_topics)
+        ct = self._apply_broker_exclusions(ct, meta,
+                                           exclude_recently_removed_brokers,
+                                           exclude_recently_demoted_brokers)
         options = OptimizationOptions(
             triggered_by_goal_violation=triggered_by_goal_violation)
         if kafka_assigner:
@@ -184,11 +259,18 @@ class CruiseControl:
 
     def remove_brokers(self, broker_ids: list, dry_run: bool = False,
                        self_healing: bool = False,
+                       excluded_topics: str | None = None,
+                       exclude_recently_removed_brokers: bool = False,
+                       exclude_recently_demoted_brokers: bool = False,
                        reason: str = "remove brokers") -> dict:
         """POST /remove_broker: drain the brokers, then (really) move load off
         (RemoveBrokersRunnable role). Marks brokers as move-excluded
         destinations and relocates everything they host."""
         ct, meta = self._model()
+        ct = self._apply_excluded_topics(ct, meta, excluded_topics)
+        ct = self._apply_broker_exclusions(ct, meta,
+                                           exclude_recently_removed_brokers,
+                                           exclude_recently_demoted_brokers)
         idx = [meta.broker_index(b) for b in broker_ids]
         alive = np.asarray(ct.broker_alive).copy()
         excl = np.asarray(ct.broker_excluded_for_replica_move).copy()
@@ -212,9 +294,16 @@ class CruiseControl:
         return op.to_json()
 
     def add_brokers(self, broker_ids: list, dry_run: bool = False,
+                    excluded_topics: str | None = None,
+                    exclude_recently_removed_brokers: bool = False,
+                    exclude_recently_demoted_brokers: bool = False,
                     reason: str = "add brokers") -> dict:
         """POST /add_broker: rebalance load onto the (new) brokers."""
         ct, meta = self._model()
+        ct = self._apply_excluded_topics(ct, meta, excluded_topics)
+        ct = self._apply_broker_exclusions(ct, meta,
+                                           exclude_recently_removed_brokers,
+                                           exclude_recently_demoted_brokers)
         new = np.asarray(ct.broker_new).copy()
         for b in broker_ids:
             new[meta.broker_index(b)] = True
@@ -243,9 +332,16 @@ class CruiseControl:
         return op.to_json()
 
     def fix_offline_replicas(self, dry_run: bool = False,
+                             excluded_topics: str | None = None,
+                             exclude_recently_removed_brokers: bool = False,
+                             exclude_recently_demoted_brokers: bool = False,
                              reason: str = "fix offline replicas") -> dict:
         """POST /fix_offline_replicas (FixOfflineReplicasRunnable role)."""
         ct, meta = self._model()
+        ct = self._apply_excluded_topics(ct, meta, excluded_topics)
+        ct = self._apply_broker_exclusions(ct, meta,
+                                           exclude_recently_removed_brokers,
+                                           exclude_recently_demoted_brokers)
         op = self._run_optimization(
             "FIX_OFFLINE_REPLICAS", reason, ct, meta, SELF_HEALING_GOALS,
             OptimizationOptions(fix_offline_replicas_only=True),
@@ -375,24 +471,28 @@ class CruiseControl:
 
     # ------------------------------------------------------------ proposals
     def cached_proposals(self, force_refresh: bool = False,
-                         goal_names=None) -> OptimizerResult:
+                         goal_names=None,
+                         excluded_topics: str | None = None) -> OptimizerResult:
         """GET /proposals with generation-checked cache
         (GoalOptimizer precompute/cache role, GoalOptimizer.java:219-339).
         A custom goal list bypasses the cache, like the reference does when
         ProposalsParameters carries non-default goals."""
-        if goal_names:
-            # dry-run-only path: custom goal lists need not include every hard
-            # goal (precompute always runs the full default chain)
+        if goal_names or excluded_topics:
+            # dry-run-only path: custom goal lists / exclusions bypass the
+            # cache (the precompute always runs the full default chain)
             ct, meta = self._model()
+            ct = self._apply_excluded_topics(ct, meta, excluded_topics)
             return self.goal_optimizer.optimizations(
-                ct, meta, goal_names=goal_names, raise_on_failure=False,
-                skip_hard_goal_check=True)
+                ct, meta, goal_names=goal_names or None,
+                raise_on_failure=False, skip_hard_goal_check=True)
         gen = self.load_monitor.model_generation().as_tuple()
         with self._cache_lock:
             if (not force_refresh and self._proposal_cache is not None
                     and self._proposal_cache_generation == gen):
                 return self._proposal_cache
         ct, meta = self._model()
+        # the configured exclusion regex applies to precomputed proposals too
+        ct = self._apply_excluded_topics(ct, meta, None)
         # the precompute path records violations instead of failing the cache
         # refresh (GoalOptimizer.java precompute thread logs + retries)
         res = self.goal_optimizer.optimizations(ct, meta, raise_on_failure=False)
@@ -419,6 +519,8 @@ class CruiseControl:
             }
         if "ANOMALY_DETECTOR" in substates:
             out["AnomalyDetectorState"] = self.anomaly_detector.state_json()
+        if "SENSORS" in substates:
+            out["Sensors"] = self.sensors.to_json()
         return out
 
     def kafka_cluster_state(self) -> dict:

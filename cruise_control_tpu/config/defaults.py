@@ -12,7 +12,7 @@ balance/capacity thresholds).
 from __future__ import annotations
 
 from cruise_control_tpu.config.configdef import (
-    ConfigDef, ConfigKey, Importance, Type, at_least, between,
+    ConfigDef, ConfigKey, Importance, Type, at_least, between, in_set,
 )
 
 # --------------------------------------------------------------------------
@@ -93,6 +93,10 @@ _D.define(name="topic.replica.count.balance.max.gap", type=Type.INT, default=40,
 _D.define(name="goal.violation.distribution.threshold.multiplier", type=Type.DOUBLE, default=1.0,
           validator=at_least(1.0),
           doc="Extra leniency on distribution goals when triggered by the goal-violation detector.")
+_D.define(name="topics.excluded.from.partition.movement", type=Type.STRING, default="",
+          doc="Regex of topics no proposal may move/touch "
+              "(AnalyzerConfig topics.excluded.from.partition.movement); "
+              "per-request excluded_topics overrides it.")
 _D.define(name="goals", type=Type.LIST, default=DEFAULT_GOALS, importance=Importance.HIGH,
           doc="Inter-broker goals in descending priority (AnalyzerConfig DEFAULT_GOALS order).")
 _D.define(name="hard.goals", type=Type.LIST, default=DEFAULT_HARD_GOALS, importance=Importance.HIGH,
@@ -329,6 +333,22 @@ _D.define(name="two.step.purgatory.max.requests", type=Type.INT, default=25)
 _D.define(name="webserver.security.enable", type=Type.BOOLEAN, default=False)
 _D.define(name="webserver.auth.credentials.file", type=Type.STRING, default="")
 _D.define(name="webserver.ssl.enable", type=Type.BOOLEAN, default=False)
+_D.define(name="webserver.security.provider", type=Type.STRING, default="BASIC",
+          validator=in_set("BASIC", "JWT", "TRUSTED_PROXY"),
+          doc="Auth scheme when webserver.security.enable "
+              "(servlet/security/: Basic, jwt/, trustedproxy/).")
+_D.define(name="jwt.secret.file", type=Type.STRING, default="",
+          doc="Shared-secret file for HS256 JWT verification "
+              "(jwt.authentication.provider.url RS256 role).")
+_D.define(name="jwt.principal.claim", type=Type.STRING, default="sub",
+          doc="JWT claim carrying the principal (JwtAuthenticator "
+              "JWT_TOKEN_PRINCIPAL role).")
+_D.define(name="trusted.proxy.services", type=Type.LIST, default="",
+          doc="Principals allowed to delegate via the doAs header "
+              "(trusted.proxy.services).")
+_D.define(name="trusted.proxy.fallback.enabled", type=Type.BOOLEAN, default=True,
+          doc="Whether a trusted-proxy request without doAs falls back to the "
+              "proxy's own identity (trusted.proxy.spnego.fallback.enabled role).")
 
 # --------------------------------------------------------------------------
 # TPU placement / parallelism (no reference analogue — TPU-native surface)
@@ -372,3 +392,12 @@ def _sanity_check(cfg) -> None:
     if cfg.get_int("max.num.cluster.movements") < cfg.get_int("num.concurrent.leader.movements"):
         # mirrors sanityCheckConcurrency: cluster cap must cover leadership concurrency
         raise ConfigException("max.num.cluster.movements < num.concurrent.leader.movements")
+    pattern = cfg.get_string("topics.excluded.from.partition.movement")
+    if pattern:
+        import re
+        try:
+            re.compile(pattern)
+        except re.error as e:
+            raise ConfigException(
+                f"topics.excluded.from.partition.movement is not a valid "
+                f"regex: {e}") from None

@@ -28,15 +28,31 @@ from cruise_control_tpu.detector.provisioner import (
 
 class GoalViolationDetector:
     def __init__(self, goal_optimizer, load_monitor, detection_goals: list,
-                 provisioner=None):
+                 provisioner=None, sensors=None):
         self._optimizer = goal_optimizer
         self._monitor = load_monitor
         self._goals = list(detection_goals)
         self._provisioner = provisioner
         self.last_balancedness: float = 100.0
         self.last_provision: ProvisionRecommendation | None = None
+        if sensors is not None:
+            # Sensors.md catalog: balancedness-score + under/over-provisioned
+            # gauges, goal-violation-detection-timer (GoalViolationDetector.java:93)
+            sensors.gauge("balancedness-score", lambda: self.last_balancedness)
+            sensors.gauge(
+                "provision-status",
+                lambda: (self.last_provision.status.value
+                         if self.last_provision else "RIGHT_SIZED"))
+            self._detection_timer = sensors.timer("goal-violation-detection-timer")
+        else:
+            from cruise_control_tpu.common.sensors import Timer
+            self._detection_timer = Timer()
 
     def run_once(self, now_ms: float) -> list:
+        with self._detection_timer.time():
+            return self._run_once(now_ms)
+
+    def _run_once(self, now_ms: float) -> list:
         from cruise_control_tpu.analyzer.env import OptimizationOptions
         from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
         try:

@@ -180,7 +180,16 @@ class ConcurrencyAdjuster:
 
 
 class Executor:
-    def __init__(self, backend, config=None, clock=None, strategy_names=None):
+    def __init__(self, backend, config=None, clock=None, strategy_names=None,
+                 sensors=None):
+        from cruise_control_tpu.common.sensors import MetricRegistry
+        self._sensors = sensors if sensors is not None else MetricRegistry()
+        # Executor sensor catalog (Sensors.md): ongoing-execution gauge +
+        # started/stopped execution meters
+        self._sensors.gauge("ongoing-execution",
+                            lambda: int(self.has_ongoing_execution()))
+        self._execution_meter = self._sensors.meter("execution-started")
+        self._execution_stopped_meter = self._sensors.meter("execution-stopped")
         self._backend = backend
         self._cfg = (ExecutorConfigView.from_config(config) if config is not None
                      else ExecutorConfigView())
@@ -227,8 +236,13 @@ class Executor:
         """Graceful stop: no new tasks; force: cancel in-flight reassignments
         (znode deletion, ExecutionUtils.java:305-307)."""
         with self._lock:
+            # count once per stopped execution, not per stop call
+            newly_stopped = (self._state != ExecutorState.NO_TASK_IN_PROGRESS
+                             and not self._stop_requested)
             self._stop_requested = True
             self._force_stop = force
+        if newly_stopped:
+            self._execution_stopped_meter.mark()
 
     def recently_removed_brokers(self) -> set:
         return set(self._recently_removed_brokers)
@@ -294,6 +308,7 @@ class Executor:
             self._state = ExecutorState.STARTING_EXECUTION
             self._stop_requested = False
             self._force_stop = False
+        self._execution_meter.mark()
         planner = ExecutionTaskPlanner(self._strategy)
         if context is None:
             sizes = {tp: info.size_mb for tp, info in self._backend.partitions().items()}

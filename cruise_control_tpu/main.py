@@ -64,15 +64,34 @@ def build_server(cc, config):
     (KafkaCruiseControlApp.java:45-61 Jetty bootstrap role)."""
     from cruise_control_tpu.api import CruiseControlServer
     from cruise_control_tpu.api.security import (
-        BasicSecurityProvider, NoopSecurityProvider,
+        BasicSecurityProvider, JwtSecurityProvider, NoopSecurityProvider,
+        TrustedProxySecurityProvider,
     )
     security = NoopSecurityProvider()
     if config.get_boolean("webserver.security.enable"):
-        cred_file = config.get_string("webserver.auth.credentials.file")
-        if not cred_file:
-            raise ValueError("webserver.security.enable requires "
-                             "webserver.auth.credentials.file")
-        security = BasicSecurityProvider.from_file(cred_file)
+        scheme = config.get_string("webserver.security.provider").upper()
+        if scheme == "JWT":
+            secret_file = config.get_string("jwt.secret.file")
+            if not secret_file:
+                raise ValueError("JWT security requires jwt.secret.file")
+            with open(secret_file, "rb") as f:
+                security = JwtSecurityProvider(
+                    f.read().strip(),
+                    principal_claim=config.get_string("jwt.principal.claim"))
+        else:
+            cred_file = config.get_string("webserver.auth.credentials.file")
+            if not cred_file:
+                raise ValueError("webserver.security.enable requires "
+                                 "webserver.auth.credentials.file")
+            security = BasicSecurityProvider.from_file(cred_file)
+            if scheme == "TRUSTED_PROXY":
+                # the realm file doubles as the doAs-principal role map
+                security = TrustedProxySecurityProvider(
+                    security,
+                    trusted_services=config.get_list("trusted.proxy.services"),
+                    user_roles=security.user_roles(),
+                    fallback_to_delegate=config.get_boolean(
+                        "trusted.proxy.fallback.enabled"))
     return CruiseControlServer(
         cc,
         host=config.get_string("webserver.http.address"),

@@ -82,6 +82,12 @@ class MetricSampleAggregator:
         self._oldest_window: int | None = None   # absolute index of ring slot 0
         self._current_window: int | None = None  # absolute index of the active window
         self._first_window: int | None = None    # first window ever observed
+        # aggregate() memo: (num_windows arg) -> result, valid until the next
+        # accepted sample (generation-numbered cache invalidation role,
+        # common/LongGenerationed.java). Sensors/gauges snapshot aggregate()
+        # repeatedly; without this each read is a full O(E x W x M) pass.
+        self._dirty = True
+        self._agg_cache: dict[int | None, AggregationResult] = {}
 
     # -- geometry --
     def window_index(self, ts_ms: float) -> int:
@@ -110,6 +116,7 @@ class MetricSampleAggregator:
             self._current_window = None
             self._first_window = None
             self._generation += 1
+            self._dirty = True
 
     @property
     def generation(self) -> int:
@@ -193,76 +200,90 @@ class MetricSampleAggregator:
             self._max[row, slot, mask] = np.maximum(self._max[row, slot, mask], vec[mask])
             self._latest[row, slot, mask] = vec[mask]
             self._counts[row, slot] += 1
+            self._dirty = True
             return True
 
     # -- aggregation --
     def aggregate(self, num_windows: int | None = None) -> AggregationResult:
-        """Aggregate the most recent ``num_windows`` completed windows."""
+        """Aggregate the most recent ``num_windows`` completed windows.
+        Results are memoized until the next accepted sample."""
         with self._lock:
-            W = min(num_windows or self._num_windows, self._num_windows)
-            E = len(self._entities)
-            M = self._metric_def.num_metrics
-            if E == 0 or self._current_window is None:
-                return AggregationResult([], [], np.zeros((0, W, M)),
-                                         np.zeros((0, W), np.uint8), np.zeros(0, bool),
-                                         np.zeros(W), 0.0)
-            # only windows that have actually existed (>= first observed window)
-            n_exist = self._current_window - max(self._first_window, self._oldest_window)
-            W = max(min(W, n_exist), 0)
-            lo_slot = self._num_windows - W
-            counts = self._counts[:, lo_slot:self._num_windows]          # [E, W]
-            sums = self._sum[:, lo_slot:self._num_windows]               # [E, W, M]
-            maxs = self._max[:, lo_slot:self._num_windows]
-            lasts = self._latest[:, lo_slot:self._num_windows]
+            if self._dirty:
+                self._agg_cache.clear()
+                self._dirty = False
+            cached = self._agg_cache.get(num_windows)
+            if cached is not None:
+                return cached
+            result = self._aggregate_locked(num_windows)
+            self._agg_cache[num_windows] = result
+            return result
 
-            own = np.where(self._is_avg[None, None, :],
-                           sums / np.maximum(counts[:, :, None], 1),
-                           np.where(self._agg_funcs[None, None, :]
-                                    == AggregationFunction.MAX.value,
-                                    np.where(np.isfinite(maxs), maxs, 0.0), lasts))
+    def _aggregate_locked(self, num_windows: int | None = None) -> AggregationResult:
+        """Full aggregation pass; caller holds the lock."""
+        W = min(num_windows or self._num_windows, self._num_windows)
+        E = len(self._entities)
+        M = self._metric_def.num_metrics
+        if E == 0 or self._current_window is None:
+            return AggregationResult([], [], np.zeros((0, W, M)),
+                                     np.zeros((0, W), np.uint8), np.zeros(0, bool),
+                                     np.zeros(W), 0.0)
+        # only windows that have actually existed (>= first observed window)
+        n_exist = self._current_window - max(self._first_window, self._oldest_window)
+        W = max(min(W, n_exist), 0)
+        lo_slot = self._num_windows - W
+        counts = self._counts[:, lo_slot:self._num_windows]          # [E, W]
+        sums = self._sum[:, lo_slot:self._num_windows]               # [E, W, M]
+        maxs = self._max[:, lo_slot:self._num_windows]
+        lasts = self._latest[:, lo_slot:self._num_windows]
 
-            c = counts
-            c_prev = np.pad(c, ((0, 0), (1, 0)))[:, :-1]                 # count of left neighbor
-            c_next = np.pad(c, ((0, 0), (0, 1)))[:, 1:]
-            s_prev = np.pad(sums, ((0, 0), (1, 0), (0, 0)))[:, :-1]
-            s_next = np.pad(sums, ((0, 0), (0, 1), (0, 0)))[:, 1:]
-            interior = np.zeros((E, W), bool)
-            if W > 2:
-                interior[:, 1:-1] = True
+        own = np.where(self._is_avg[None, None, :],
+                       sums / np.maximum(counts[:, :, None], 1),
+                       np.where(self._agg_funcs[None, None, :]
+                                == AggregationFunction.MAX.value,
+                                np.where(np.isfinite(maxs), maxs, 0.0), lasts))
 
-            sufficient = c >= self._half_min
-            adjacent_ok = (interior & (c_prev >= self._min_samples)
-                           & (c_next >= self._min_samples))
-            own_some = c > 0
+        c = counts
+        c_prev = np.pad(c, ((0, 0), (1, 0)))[:, :-1]                 # count of left neighbor
+        c_next = np.pad(c, ((0, 0), (0, 1)))[:, 1:]
+        s_prev = np.pad(sums, ((0, 0), (1, 0), (0, 0)))[:, :-1]
+        s_next = np.pad(sums, ((0, 0), (0, 1), (0, 0)))[:, 1:]
+        interior = np.zeros((E, W), bool)
+        if W > 2:
+            interior[:, 1:-1] = True
 
-            # adjacent-pooled values
-            pooled_cnt = np.maximum(c_prev + c + c_next, 1)[:, :, None]
-            adj_avg = (s_prev + np.where(own_some[:, :, None], sums, 0.0) + s_next) / pooled_cnt
-            nonavg_total = (np.pad(own, ((0, 0), (1, 0), (0, 0)))[:, :-1]
-                            + np.where(own_some[:, :, None], own, 0.0)
-                            + np.pad(own, ((0, 0), (0, 1), (0, 0)))[:, 1:])
-            adj_nonavg = nonavg_total / np.where(own_some, 3.0, 2.0)[:, :, None]
-            adj = np.where(self._is_avg[None, None, :], adj_avg, adj_nonavg)
+        sufficient = c >= self._half_min
+        adjacent_ok = (interior & (c_prev >= self._min_samples)
+                       & (c_next >= self._min_samples))
+        own_some = c > 0
 
-            values = np.where(sufficient[:, :, None], own,
-                              np.where(adjacent_ok[:, :, None], adj,
-                                       np.where(own_some[:, :, None], own, 0.0)))
-            extra = np.full((E, W), Extrapolation.NO_VALID_EXTRAPOLATION, np.uint8)
-            extra[own_some] = Extrapolation.FORCED_INSUFFICIENT
-            extra[adjacent_ok & ~sufficient] = Extrapolation.AVG_ADJACENT
-            extra[sufficient & (c < self._min_samples)] = Extrapolation.AVG_AVAILABLE
-            extra[c >= self._min_samples] = Extrapolation.NONE
+        # adjacent-pooled values
+        pooled_cnt = np.maximum(c_prev + c + c_next, 1)[:, :, None]
+        adj_avg = (s_prev + np.where(own_some[:, :, None], sums, 0.0) + s_next) / pooled_cnt
+        nonavg_total = (np.pad(own, ((0, 0), (1, 0), (0, 0)))[:, :-1]
+                        + np.where(own_some[:, :, None], own, 0.0)
+                        + np.pad(own, ((0, 0), (0, 1), (0, 0)))[:, 1:])
+        adj_nonavg = nonavg_total / np.where(own_some, 3.0, 2.0)[:, :, None]
+        adj = np.where(self._is_avg[None, None, :], adj_avg, adj_nonavg)
 
-            invalid_any = (extra == Extrapolation.NO_VALID_EXTRAPOLATION).any(axis=1)
-            n_extrapolated = (extra != Extrapolation.NONE).sum(axis=1)
-            entity_valid = ~invalid_any & (n_extrapolated <= self._max_extrapolations)
+        values = np.where(sufficient[:, :, None], own,
+                          np.where(adjacent_ok[:, :, None], adj,
+                                   np.where(own_some[:, :, None], own, 0.0)))
+        extra = np.full((E, W), Extrapolation.NO_VALID_EXTRAPOLATION, np.uint8)
+        extra[own_some] = Extrapolation.FORCED_INSUFFICIENT
+        extra[adjacent_ok & ~sufficient] = Extrapolation.AVG_ADJACENT
+        extra[sufficient & (c < self._min_samples)] = Extrapolation.AVG_AVAILABLE
+        extra[c >= self._min_samples] = Extrapolation.NONE
 
-            window_ok = extra != Extrapolation.NO_VALID_EXTRAPOLATION
-            completeness_per_window = window_ok.mean(axis=0)
-            completeness = float(entity_valid.mean())
+        invalid_any = (extra == Extrapolation.NO_VALID_EXTRAPOLATION).any(axis=1)
+        n_extrapolated = (extra != Extrapolation.NONE).sum(axis=1)
+        entity_valid = ~invalid_any & (n_extrapolated <= self._max_extrapolations)
 
-            start = (self._oldest_window + lo_slot)
-            window_starts = [(start + i) * self._window_ms for i in range(W)]
-            entities = [e for e, _ in sorted(self._entities.items(), key=lambda kv: kv[1])]
-            return AggregationResult(entities, window_starts, values, extra,
-                                     entity_valid, completeness_per_window, completeness)
+        window_ok = extra != Extrapolation.NO_VALID_EXTRAPOLATION
+        completeness_per_window = window_ok.mean(axis=0)
+        completeness = float(entity_valid.mean())
+
+        start = (self._oldest_window + lo_slot)
+        window_starts = [(start + i) * self._window_ms for i in range(W)]
+        entities = [e for e, _ in sorted(self._entities.items(), key=lambda kv: kv[1])]
+        return AggregationResult(entities, window_starts, values, extra,
+                                 entity_valid, completeness_per_window, completeness)

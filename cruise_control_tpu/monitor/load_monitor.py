@@ -79,7 +79,20 @@ class LoadMonitorState:
 
 class LoadMonitor:
     def __init__(self, config=None, backend=None, sampler=None, sample_store=None,
-                 capacity_resolver=None):
+                 capacity_resolver=None, sensors=None):
+        from cruise_control_tpu.common.sensors import MetricRegistry
+        self._sensors = sensors if sensors is not None else MetricRegistry()
+        # sensor catalog (LoadMonitor.java:180-195 gauges + :173 timer)
+        self._model_timer = self._sensors.timer("cluster-model-creation-timer")
+        self._sensors.gauge(
+            "valid-windows",
+            lambda: len(self._partition_agg.aggregate().window_starts_ms))
+        self._sensors.gauge(
+            "monitored-partitions-percentage",
+            lambda: float(self._partition_agg.aggregate().entity_valid.mean())
+            if self._partition_agg.aggregate().entity_valid.size else 0.0)
+        self._sensors.gauge("total-monitored-windows",
+                            lambda: self._partition_agg.num_windows)
         self._config = config
         self._backend = backend
         if sampler is None and config is not None:
@@ -297,7 +310,7 @@ class LoadMonitor:
         if self._backend is None:
             raise RuntimeError("LoadMonitor has no cluster backend")
         req = requirements or ModelCompletenessRequirements()
-        with self._model_semaphore:
+        with self._model_timer.time(), self._model_semaphore:
             agg = self._partition_agg.aggregate()
             if len(agg.window_starts_ms) < req.min_required_num_windows:
                 raise NotEnoughValidWindowsError(

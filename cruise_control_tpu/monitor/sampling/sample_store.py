@@ -127,3 +127,104 @@ class FileSampleStore:
             if self._bf:
                 self._bf.close()
                 self._bf = None
+
+
+class TopicSampleStore:
+    """Sample store over the metrics-topic transport — the KafkaSampleStore
+    shape: one topic per sample kind (__KafkaCruiseControlPartitionMetricSamples
+    / __KafkaCruiseControlModelTrainingSamples), produced on store, consumed
+    from offset 0 on startup replay. Uses the same length-prefixed log-file
+    topic as the reporter (reporter/topic.FileMetricsTopic), so durability and
+    replay semantics match the reporter pipeline's."""
+
+    PARTITION_TOPIC = "__KafkaCruiseControlPartitionMetricSamples"
+    BROKER_TOPIC = "__KafkaCruiseControlModelTrainingSamples"
+
+    def __init__(self, path: str | None = None):
+        self._path = path
+        self._ptopic = None
+        self._btopic = None
+
+    def configure(self, config, **extra):
+        path = extra.get("path") or (config.get_string("sample.store.path")
+                                     if config is not None else "")
+        if path:
+            self._path = path
+        if self._path:
+            from cruise_control_tpu.reporter.topic import FileMetricsTopic
+            os.makedirs(self._path, exist_ok=True)
+            self._ptopic = FileMetricsTopic(
+                os.path.join(self._path, self.PARTITION_TOPIC))
+            self._btopic = FileMetricsTopic(
+                os.path.join(self._path, self.BROKER_TOPIC))
+
+    def store_samples(self, samples: Samples) -> None:
+        if self._ptopic is None:
+            return
+        if samples.partition_samples:
+            self._ptopic.append([
+                json.dumps({"t": s.topic, "p": s.partition, "ts": s.ts_ms,
+                            "v": s.values}).encode("utf-8")
+                for s in samples.partition_samples])
+        if samples.broker_samples:
+            self._btopic.append([
+                json.dumps({"b": s.broker_id, "ts": s.ts_ms,
+                            "v": s.values}).encode("utf-8")
+                for s in samples.broker_samples])
+
+    def load_samples(self, loader) -> int:
+        if self._ptopic is None:
+            return 0
+        psamples = []
+        for _off, rec in self._ptopic.consume(0):
+            try:
+                d = json.loads(rec)
+            except json.JSONDecodeError:
+                continue
+            psamples.append(PartitionSample(topic=d["t"], partition=d["p"],
+                                            ts_ms=d["ts"], values=d["v"]))
+        bsamples = []
+        for _off, rec in self._btopic.consume(0):
+            try:
+                d = json.loads(rec)
+            except json.JSONDecodeError:
+                continue
+            bsamples.append(BrokerSample(broker_id=d["b"], ts_ms=d["ts"],
+                                         values=d["v"]))
+        if psamples or bsamples:
+            loader(Samples(psamples, bsamples))
+        return len(psamples) + len(bsamples)
+
+    def close(self):
+        pass
+
+
+class ReadOnlyTopicSampleStore(TopicSampleStore):
+    """Replays history but never produces — for standby/analysis instances
+    pointed at another instance's topics (ReadOnlyKafkaSampleStore role)."""
+
+    def store_samples(self, samples: Samples) -> None:
+        pass
+
+
+class OnExecutionSampleStore(TopicSampleStore):
+    """Records partition samples only while an execution is in progress, to a
+    dedicated topic (KafkaPartitionMetricSampleOnExecutionStore role) — a
+    post-mortem trail of load during movement."""
+
+    PARTITION_TOPIC = "__KafkaCruiseControlPartitionMetricSamplesOnExecution"
+
+    def __init__(self, path: str | None = None, executor=None):
+        super().__init__(path)
+        self._executor = executor
+
+    def configure(self, config, **extra):
+        if "executor" in extra:
+            self._executor = extra["executor"]
+        super().configure(config, **extra)
+
+    def store_samples(self, samples: Samples) -> None:
+        if self._executor is not None and not self._executor.has_ongoing_execution():
+            return
+        super().store_samples(
+            Samples(samples.partition_samples, []))

@@ -380,3 +380,69 @@ def test_parse_params_defaults_and_types():
         parse_params(EndPoint.STATE, {"nope": ["1"]})
     with pytest.raises(ParameterError):
         parse_params(EndPoint.ADD_BROKER, {"brokerid": ["x"]})
+
+
+def test_excluded_topics_regex():
+    """excluded_topics masks matching topics from movement end-to-end
+    (GoalBasedOptimizationParameters excludedTopics ->
+    OptimizationOptions role): on a skewed cluster, excluding every topic
+    yields zero proposals while a non-matching regex still rebalances."""
+    be = SimulatedClusterBackend()
+    for b in range(3):
+        be.add_broker(b, "r0")
+    for p in range(9):     # all replicas crowd broker 0 -> disk imbalance
+        be.create_partition("skewed", p, [0], size_mb=4000.0,
+                            bytes_in_rate=50.0, bytes_out_rate=100.0,
+                            cpu_util=2.0)
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+    cc.start_up()
+    for i in range(12):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    srv = CruiseControlServer(cc, port=0, max_block_ms=120_000.0)
+    srv.start()
+    try:
+        url = (f"{srv.base_url}/rebalance?dryrun=true&excluded_topics=skew.*"
+               f"&goals=DiskUsageDistributionGoal&skip_hard_goal_check=true")
+        status, body, _ = _poll_until_done(url, *_request("POST", url))
+        assert status == 200
+        assert body["result"]["proposals"] == []
+        url2 = (f"{srv.base_url}/rebalance?dryrun=true&excluded_topics=nomatch.*"
+                f"&goals=DiskUsageDistributionGoal&skip_hard_goal_check=true")
+        status2, body2, _ = _poll_until_done(url2, *_request("POST", url2))
+        assert status2 == 200
+        assert len(body2["result"]["proposals"]) > 0
+    finally:
+        srv.stop()
+
+
+def test_exclude_recently_removed_brokers_facade():
+    """Recently removed brokers are blocked as move destinations when the
+    exclude flag is set (excludeRecentlyRemovedBrokers semantics; history
+    from Executor.java:449-506)."""
+    be = SimulatedClusterBackend()
+    for b in range(3):
+        be.add_broker(b, "r0")
+    for p in range(9):
+        be.create_partition("skewed", p, [0], size_mb=4000.0,
+                            bytes_in_rate=50.0, bytes_out_rate=100.0,
+                            cpu_util=2.0)
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+    cc.start_up()
+    for i in range(12):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    cc.executor.note_removed_brokers([2])
+    out = cc.rebalance(goal_names=["DiskUsageDistributionGoal"], dry_run=True,
+                       skip_hard_goal_check=True,
+                       exclude_recently_removed_brokers=True)
+    dests = {b for prop in out["result"]["proposals"]
+             for b in set(prop["newReplicas"]) - set(prop["oldReplicas"])}
+    assert 2 not in dests
+    assert dests   # broker 1 still receives load
+    # without the flag the blocklist is ignored
+    out2 = cc.rebalance(goal_names=["DiskUsageDistributionGoal"], dry_run=True,
+                        skip_hard_goal_check=True)
+    dests2 = {b for prop in out2["result"]["proposals"]
+              for b in set(prop["newReplicas"]) - set(prop["oldReplicas"])}
+    assert 2 in dests2

@@ -223,3 +223,64 @@ def test_linear_regression_cpu_model_used_when_enabled():
     np.testing.assert_allclose(cpu_lr, 0.01 * lin + 0.02 * lout, rtol=1e-5)
     cpu_static = np.asarray(ct_static.leader_load)[lead][:, Resource.CPU]
     assert not np.allclose(cpu_lr, cpu_static)
+
+
+def test_topic_sample_store_replay_and_variants(tmp_path):
+    """KafkaSampleStore-shape store: two topic logs, replay on startup;
+    read-only variant never produces; on-execution variant gates on the
+    executor's in-progress state."""
+    from cruise_control_tpu.monitor.sampling.sample_store import (
+        OnExecutionSampleStore, ReadOnlyTopicSampleStore, TopicSampleStore,
+    )
+    be = _backend()
+    store = TopicSampleStore(str(tmp_path))
+    store.configure(None)
+    lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be),
+                     sample_store=store)
+    lm.start_up()
+    for i in range(20):
+        lm.sample_once(now_ms=i * 60_000.0)
+    ct1, _ = lm.cluster_model()
+    lm.shutdown()
+    # both topic logs exist on disk under the reference topic names
+    import os
+    assert os.path.exists(
+        str(tmp_path / TopicSampleStore.PARTITION_TOPIC))
+    assert os.path.exists(
+        str(tmp_path / TopicSampleStore.BROKER_TOPIC))
+
+    store2 = TopicSampleStore(str(tmp_path))
+    store2.configure(None)
+    lm2 = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be),
+                      sample_store=store2)
+    assert lm2.start_up() > 0
+    ct2, _ = lm2.cluster_model()
+    np.testing.assert_allclose(np.asarray(ct1.broker_utilization()),
+                               np.asarray(ct2.broker_utilization()), rtol=1e-5)
+
+    # read-only: replays but store_samples is a no-op
+    ro = ReadOnlyTopicSampleStore(str(tmp_path))
+    ro.configure(None)
+    end_before = ro._ptopic.end_offset
+    replayed = []
+    assert ro.load_samples(replayed.append) > 0
+    ro.store_samples(replayed[0])
+    assert ro._ptopic.end_offset == end_before
+
+    # on-execution: drops samples while no execution is ongoing
+    class FakeExecutor:
+        ongoing = False
+
+        def has_ongoing_execution(self):
+            return self.ongoing
+
+    ex = FakeExecutor()
+    oe = OnExecutionSampleStore(str(tmp_path / "exec"), executor=ex)
+    oe.configure(None)
+    oe.store_samples(replayed[0])
+    assert oe.load_samples(lambda s: None) == 0
+    ex.ongoing = True
+    oe.store_samples(replayed[0])
+    got = []
+    assert oe.load_samples(got.append) > 0
+    assert got[0].broker_samples == []   # partition samples only

@@ -1,0 +1,158 @@
+"""Sensor registry + JWT / trusted-proxy security provider tests.
+
+Reference catalog: docs/wiki Sensors.md (proposal-computation-timer,
+cluster-model-creation-timer, valid-windows, balancedness-score, ...) and
+servlet/security/jwt + trustedproxy.
+"""
+import time
+
+import pytest
+
+from cruise_control_tpu.api.security import (
+    AuthError, BasicSecurityProvider, JwtSecurityProvider,
+    TrustedProxySecurityProvider,
+)
+from cruise_control_tpu.common.sensors import MetricRegistry, Meter, Timer
+
+
+# ------------------------------------------------------------------ sensors
+
+def test_timer_records_and_snapshots():
+    t = Timer()
+    for v in (0.1, 0.2, 0.3):
+        t.record(v)
+    with t.time():
+        pass
+    snap = t.to_json()
+    assert snap["count"] == 4
+    assert snap["maxSec"] == pytest.approx(0.3)
+    assert 0.0 < snap["meanSec"] < 0.2
+    assert snap["p95Sec"] == pytest.approx(0.3)
+
+
+def test_meter_rates():
+    now = [0.0]
+    m = Meter(clock=lambda: now[0])
+    m.mark(10)
+    now[0] = 5.0
+    snap = m.to_json()
+    assert snap["count"] == 10
+    assert snap["meanRatePerSec"] == pytest.approx(2.0)
+
+
+def test_registry_gauges_and_errors():
+    reg = MetricRegistry()
+    reg.gauge("ok", lambda: 42)
+    reg.gauge("boom", lambda: 1 / 0)
+    reg.timer("t").record(0.5)
+    reg.meter("m").mark()
+    out = reg.to_json()
+    assert out["ok"] == {"type": "gauge", "value": 42}
+    assert "ZeroDivisionError" in out["boom"]["error"]
+    assert out["t"]["count"] == 1
+    assert out["m"]["count"] == 1
+    assert reg.names() == ["boom", "m", "ok", "t"]
+    # idempotent accessors return the same sensor
+    assert reg.timer("t").to_json()["count"] == 1
+
+
+def test_app_sensor_catalog(sim_app):
+    """The facade wires the reference's sensor catalog end to end."""
+    app, backend = sim_app
+    app.rebalance(dry_run=True)
+    sensors = app.state_json(substates=["SENSORS"])["Sensors"]
+    assert sensors["proposal-computation-timer"]["count"] >= 1
+    assert sensors["cluster-model-creation-timer"]["count"] >= 1
+    assert sensors["valid-windows"]["value"] >= 1
+    assert 0.0 <= sensors["monitored-partitions-percentage"]["value"] <= 1.0
+    assert sensors["ongoing-execution"]["value"] == 0
+
+
+@pytest.fixture
+def sim_app():
+    from cruise_control_tpu.app import CruiseControl
+    from cruise_control_tpu.backend import SimulatedClusterBackend
+
+    backend = SimulatedClusterBackend()
+    for b in range(4):
+        backend.add_broker(b, f"r{b % 2}")
+    for p in range(8):
+        backend.create_partition("t", p, [p % 4, (p + 1) % 4], size_mb=100.0,
+                                 bytes_in_rate=10.0, bytes_out_rate=20.0,
+                                 cpu_util=1.0)
+    app = CruiseControl(backend)
+    app.start_up()
+    for i in range(20):
+        app.load_monitor.sample_once(now_ms=i * 60_000.0)
+    yield app, backend
+    app.shutdown()
+
+
+# ----------------------------------------------------------------- security
+
+SECRET = "sekrit"
+
+
+def test_jwt_roundtrip():
+    token = JwtSecurityProvider.make_token(SECRET, "alice", role="ADMIN")
+    p = JwtSecurityProvider(SECRET)
+    principal, role = p.authenticate({"Authorization": f"Bearer {token}"})
+    assert (principal, role) == ("alice", "ADMIN")
+
+
+def test_jwt_expiry_and_signature():
+    p = JwtSecurityProvider(SECRET)
+    expired = JwtSecurityProvider.make_token(SECRET, "bob", role="VIEWER",
+                                             expires_in_s=-10)
+    with pytest.raises(AuthError, match="expired"):
+        p.authenticate({"Authorization": f"Bearer {expired}"})
+    forged = JwtSecurityProvider.make_token("wrong-secret", "eve", role="ADMIN")
+    with pytest.raises(AuthError, match="signature"):
+        p.authenticate({"Authorization": f"Bearer {forged}"})
+    with pytest.raises(AuthError, match="bearer token required"):
+        p.authenticate({})
+    with pytest.raises(AuthError, match="malformed"):
+        p.authenticate({"Authorization": "Bearer not.a"})
+
+
+def test_jwt_authorized_users_map():
+    """With a roles map, the map is authoritative and unknown users 403."""
+    p = JwtSecurityProvider(SECRET, roles={"alice": "USER"})
+    token = JwtSecurityProvider.make_token(SECRET, "alice", role="ADMIN")
+    assert p.authenticate({"Authorization": f"Bearer {token}"}) == ("alice", "USER")
+    stranger = JwtSecurityProvider.make_token(SECRET, "mallory")
+    with pytest.raises(AuthError, match="not authorized"):
+        p.authenticate({"Authorization": f"Bearer {stranger}"})
+
+
+def test_trusted_proxy():
+    inner = BasicSecurityProvider({"proxysvc": ("pw", "ADMIN"),
+                                   "rando": ("pw2", "VIEWER")})
+    p = TrustedProxySecurityProvider(inner, ["proxysvc"],
+                                     user_roles={"carol": "ADMIN"})
+    import base64
+
+    def basic(u, pw):
+        return {"Authorization":
+                "Basic " + base64.b64encode(f"{u}:{pw}".encode()).decode()}
+
+    # delegated identity: proxy authenticates, doAs names the end user
+    hdrs = {**basic("proxysvc", "pw"), "X-Do-As": "carol"}
+    assert p.authenticate(hdrs) == ("carol", "ADMIN")
+    # a roles map is authoritative: unknown doAs principals are rejected
+    hdrs = {**basic("proxysvc", "pw"), "X-Do-As": "dave"}
+    with pytest.raises(AuthError, match="not authorized"):
+        p.authenticate(hdrs)
+    # with no roles map, delegated users default to VIEWER
+    open_p = TrustedProxySecurityProvider(inner, ["proxysvc"])
+    assert open_p.authenticate(hdrs) == ("dave", "VIEWER")
+    # non-trusted principals may not delegate
+    hdrs = {**basic("rando", "pw2"), "X-Do-As": "carol"}
+    with pytest.raises(AuthError, match="not a trusted proxy"):
+        p.authenticate(hdrs)
+    # no doAs falls back to the proxy's own identity
+    assert p.authenticate(basic("proxysvc", "pw")) == ("proxysvc", "ADMIN")
+    strict = TrustedProxySecurityProvider(inner, ["proxysvc"],
+                                          fallback_to_delegate=False)
+    with pytest.raises(AuthError, match="must carry"):
+        strict.authenticate(basic("proxysvc", "pw"))
