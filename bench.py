@@ -114,7 +114,7 @@ def main() -> None:
         log("rung 2: 100 brokers / 10k replicas")
         ct, meta = generate(RandomClusterSpec(
             num_brokers=100, num_racks=10, num_topics=40, num_partitions=5000,
-            max_replication=3, skew=1.0, seed=3140))
+            max_replication=3, skew=1.0, seed=3140, target_cpu_util=0.45))
         log(f"  generated {meta.num_valid_replicas} replicas")
         rungs.append(run_rung("100b-10k", ct, meta))
 
@@ -122,7 +122,7 @@ def main() -> None:
         log("rung 3: 1,000 brokers / 100k replicas (skewed)")
         ct, meta = generate_scale(RandomClusterSpec(
             num_brokers=1000, num_racks=20, num_topics=200, num_partitions=50000,
-            max_replication=3, skew=1.5, seed=3141))
+            max_replication=3, skew=1.5, seed=3141, target_cpu_util=0.45))
         log(f"  generated {meta.num_valid_replicas} replicas")
         rungs.append(run_rung("1000b-100k", ct, meta))
 
@@ -131,7 +131,8 @@ def main() -> None:
         log("rung 4: 7,000 brokers / 1M replicas (north star)")
         ct, meta = generate_scale(RandomClusterSpec(
             num_brokers=7000, num_racks=40, num_topics=2000,
-            num_partitions=500000, max_replication=3, skew=1.0, seed=3142))
+            num_partitions=500000, max_replication=3, skew=1.0, seed=3142,
+            target_cpu_util=0.45))
         log(f"  generated {meta.num_valid_replicas} replicas")
         headline = run_rung("7000b-1M", ct, meta)
         rungs.append(headline)
@@ -144,7 +145,7 @@ def main() -> None:
             num_brokers=7000, num_racks=40, num_topics=2000,
             num_partitions=500000, max_replication=3, skew=1.0, seed=3143,
             logdirs_per_broker=4, num_dead_brokers=20,
-            num_brokers_with_dead_disk=50))
+            num_brokers_with_dead_disk=50, target_cpu_util=0.45))
         log(f"  generated {meta.num_valid_replicas} replicas "
             f"({int(np.asarray(ct.replica_offline).sum())} offline)")
         rungs.append(run_rung("7000b-JBOD-selfheal", ct, meta, goal_names=[

@@ -39,9 +39,10 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer.env import ClusterEnv
 from cruise_control_tpu.analyzer.goals.base import (
-    GoalKernel, legit_disk_move_mask, legit_leadership_mask, legit_move_mask,
-    legit_swap_mask,
+    WAVE_DIMS, GoalKernel, legit_disk_move_mask, legit_leadership_mask,
+    legit_move_mask, legit_swap_mask,
 )
+from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.analyzer.state import (
     EngineState, apply_disk_move, apply_leadership, apply_move,
     apply_moves_batched, apply_swap,
@@ -74,6 +75,37 @@ class EngineParams:
     min_gain: float = 1e-9            # scores below this count as no progress
 
 
+def _wave_budget_capable(g: GoalKernel) -> bool:
+    """Can multi-move waves preserve this goal's acceptance semantics?
+    Yes when it provides cumulative budgets, never vetoes moves, or is
+    covered by the wave's partition/topic first-use rules (wave_safe)."""
+    return (type(g).wave_budgets is not GoalKernel.wave_budgets
+            or type(g).accept_move is GoalKernel.accept_move
+            or g.wave_safe)
+
+
+def _group_cumsum(groups: Array, d: Array):
+    """Per-group inclusive prefix sums of ``d[K, DIMS]`` (and i32[K] in-group
+    ranks), where rows sharing ``groups[K]`` form a group and rows keep their
+    current (score-desc) order within it."""
+    K = groups.shape[0]
+    idx = jnp.arange(K)
+    order = jnp.argsort(groups, stable=True)    # stable: keeps score order
+    ds = d[order]
+    gs = groups[order]
+    cums = jnp.cumsum(ds, axis=0)
+    is_start = jnp.concatenate([jnp.ones(1, bool), gs[1:] != gs[:-1]])
+    start_idx = jax.lax.associative_scan(jnp.maximum,
+                                         jnp.where(is_start, idx, 0))
+    base = jnp.where(start_idx[:, None] > 0,
+                     cums[jnp.maximum(start_idx - 1, 0)], 0.0)
+    cum_in_group = cums - base
+    rank_sorted = (idx - start_idx).astype(jnp.int32)
+    cum = jnp.zeros_like(d).at[order].set(cum_in_group)
+    rank = jnp.zeros(K, jnp.int32).at[order].set(rank_sorted)
+    return cum, rank
+
+
 def _rescore_move_row(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                       prev_goals: tuple, r: Array) -> Array:
     """f32[B]: the candidate replica's move score against the CURRENT state —
@@ -98,14 +130,22 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
        destinations by position (row j takes its (j mod T)-th best) — goals
        whose destination ranking is row-independent (capacity headroom, rack
        utilization) would otherwise point every row at the SAME best broker
-       and starve the wave. A candidate WINS iff, in score order, it is the
-       FIRST use of its source broker, the first use of its assigned
-       destination (in either role) and the first touch of its partition.
-       Winners are mutually independent — every broker participates at most
-       once, in one role — so each is exactly as valid as it scored; they
-       all apply in ONE batched scatter update (`apply_moves_batched`).
-       First-use is a scatter-min, not a scan, so the whole wave costs a
-       handful of vector ops.
+       and starve the wave. Admission, in score order:
+       - partition first-touch (rack/sibling constraints stay single-move
+         exact) and, on the budgeted path, (topic, broker) pair first-use
+         (topic-count constraints likewise);
+       - BUDGETED admission (when every chain goal supports it): a broker
+         may source/absorb MANY wave moves while the per-broker cumulative
+         delta stays inside the combined slack of every goal's band
+         (GoalKernel.wave_budgets) — interval constraints on monotone sums
+         hold for every prefix and any interleaving, so each admitted move
+         is valid in application order. This is what collapses pass counts
+         when one broker must shed dozens of replicas;
+       - otherwise the conservative rule: every broker participates at most
+         once, in one role.
+       Winners all apply in ONE batched scatter update
+       (`apply_moves_batched`); first-use/budget checks are scatter-mins and
+       segment cumsums, not scans.
     3. LEFTOVERS (sequential, dynamically bounded): positively-scored
        non-winners are re-validated one at a time against the running state
        (`_rescore_move_row`) — the path that matters when severity is
@@ -154,11 +194,74 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     INF = jnp.int32(K + 1)
     guarded = jnp.where(wave_ok, posn, INF)
     B = env.num_brokers
-    first_broker = (jnp.full(B, INF, jnp.int32)
-                    .at[src_s].min(guarded).at[dst_s].min(guarded))
     first_part = jnp.full(env.num_partitions, INF, jnp.int32).at[p_s].min(guarded)
-    win = (wave_ok & (first_broker[src_s] == posn)
-           & (first_broker[dst_s] == posn) & (first_part[p_s] == posn))
+    part_ok = first_part[p_s] == posn
+
+    if all(_wave_budget_capable(g) for g in (goal, *prev_goals)):
+        # ---- budgeted admission: MANY moves per broker per wave ----
+        # Every broker-level acceptance in the chain is an interval constraint
+        # on monotone cumulative deltas, so rows are admitted (in score order)
+        # while their per-src/per-dst cumulative delta stays within the
+        # combined remaining slack; topic-count acceptance is preserved by
+        # using each (topic, broker) pair at most once.
+        t_s = env.replica_topic[r_sorted]
+        nT = env.topic_excluded.shape[0]
+        ts_key = t_s * B + src_s
+        td_key = t_s * B + dst_s
+        first_ts = jnp.full(nT * B, INF, jnp.int32).at[ts_key].min(guarded)
+        first_td = jnp.full(nT * B, INF, jnp.int32).at[td_key].min(guarded)
+        topic_ok = (first_ts[ts_key] == posn) & (first_td[td_key] == posn)
+
+        lead_s = st.replica_is_leader[r_sorted]
+        eff = jnp.where(lead_s[:, None], env.leader_load[r_sorted],
+                        env.follower_load[r_sorted])
+        one = jnp.ones((K, 1), eff.dtype)
+        d = jnp.concatenate([
+            eff, one, lead_s[:, None].astype(eff.dtype),
+            env.leader_load[r_sorted, Resource.NW_OUT][:, None],
+        ], axis=1)                                              # [K, WAVE_DIMS]
+        d = jnp.where(wave_ok[:, None], d, 0.0)
+        src_slack = jnp.full((B, WAVE_DIMS), jnp.inf, eff.dtype)
+        dst_slack = jnp.full((B, WAVE_DIMS), jnp.inf, eff.dtype)
+        for g in (goal, *prev_goals):
+            bud = g.wave_budgets(env, st)
+            if bud is not None:
+                src_slack = jnp.minimum(src_slack, bud[0])
+                dst_slack = jnp.minimum(dst_slack, bud[1])
+        # rows that fail elsewhere still occupy cumulative room (conservative);
+        # rows not in the wave group as singletons so ranks stay meaningful
+        sgroups = jnp.where(wave_ok, src_s, B + posn)
+        dgroups = jnp.where(wave_ok, dst_s, B + posn)
+        cum_src, rank_src = _group_cumsum(sgroups, d)
+        cum_dst, rank_dst = _group_cumsum(dgroups, d)
+        # rank-0 rows were validated against the true state by the masks
+        # themselves — always admissible, exactly like the one-per-broker wave
+        src_fit = (rank_src == 0) | jnp.all(cum_src <= src_slack[src_s] + 1e-4,
+                                            axis=1)
+        dst_fit = (rank_dst == 0) | jnp.all(cum_dst <= dst_slack[dst_s] + 1e-4,
+                                            axis=1)
+        win = wave_ok & part_ok & topic_ok & src_fit & dst_fit
+        # per-row scores were computed pre-wave: cap the wave at the ACTIVE
+        # goal's remaining useful work (src excess / dst deficit) so band-legal
+        # but zero-gain churn is rejected (offline healing always gains)
+        gb = goal.wave_gain_budgets(env, st)
+        if gb is not None:
+            src_gain, dst_gain, dim = gb
+            excl_src = cum_src[:, dim] - d[:, dim]
+            excl_dst = cum_dst[:, dim] - d[:, dim]
+            # a clause only admits when its budget is strictly positive — an
+            # exactly-zero budget plus the fp epsilon would otherwise admit
+            # every first-use row (zero-gain churn)
+            gain_ok = (((src_gain[src_s] > 0) & (excl_src < src_gain[src_s]))
+                       | ((dst_gain[dst_s] > 0) & (excl_dst < dst_gain[dst_s]))
+                       | st.replica_offline[r_sorted])
+            win = win & gain_ok
+    else:
+        # legacy conservative wave: each broker participates at most once
+        first_broker = (jnp.full(B, INF, jnp.int32)
+                        .at[src_s].min(guarded).at[dst_s].min(guarded))
+        win = (wave_ok & (first_broker[src_s] == posn)
+               & (first_broker[dst_s] == posn) & part_ok)
     st = apply_moves_batched(env, st, r_sorted, dst_s, win)
     n_applied = jnp.sum(win).astype(jnp.int32)
 

@@ -44,6 +44,18 @@ from cruise_control_tpu.analyzer.state import EngineState
 Array = jax.Array
 NEG_INF = -jnp.inf
 
+# Budgeted-wave delta dimensions (engine-wide convention, see
+# engine._move_branch_batched): what one applied replica move adds to its
+# destination broker / removes from its source broker.
+#   0..3  effective load (CPU, NW_IN, NW_OUT, DISK — current-role load)
+#   4     replica count (always 1)
+#   5     leader count (1 iff the moved replica is a leader)
+#   6     potential NW_OUT (leader-mode NW_OUT, every replica)
+WAVE_DIMS = 7
+WAVE_COUNT = 4
+WAVE_LEADER_COUNT = 5
+WAVE_POT_NW_OUT = 6
+
 
 @dataclasses.dataclass(frozen=True)
 class GoalKernel:
@@ -58,6 +70,12 @@ class GoalKernel:
     uses_leadership_moves: bool = dataclasses.field(default=False, init=False)
     uses_swaps: bool = dataclasses.field(default=False, init=False)
     uses_disk_moves: bool = dataclasses.field(default=False, init=False)
+    # True when this goal's accept_move cannot be broken by a multi-move wave
+    # given the engine's per-partition first-touch and per-(topic, broker)
+    # first-use rules (e.g. rack/topic count goals). Goals with broker-level
+    # band acceptance provide wave_budgets instead; a goal with neither forces
+    # the engine back to the one-move-per-broker wave.
+    wave_safe: bool = dataclasses.field(default=False, init=False)
 
     # --- kernel methods (override) ---
     def broker_severity(self, env: ClusterEnv, st: EngineState) -> Array:
@@ -77,6 +95,30 @@ class GoalKernel:
     def accept_move(self, env: ClusterEnv, st: EngineState, cand: Array) -> Array:
         """bool[K, B] veto as a previously-optimized goal. Default: accept."""
         return jnp.ones((cand.shape[0], env.num_brokers), bool)
+
+    def wave_budgets(self, env: ClusterEnv, st: EngineState):
+        """Optional ``(src_slack[B, WAVE_DIMS], dst_slack[B, WAVE_DIMS])``.
+
+        A goal whose accept_move/move feasibility is an interval constraint on
+        per-broker monotone quantities exposes it here as remaining slack in
+        delta units (+inf where unconstrained): the engine admits multiple
+        same-broker moves per wave while every cumulative delta stays within
+        the combined slack — the admitted set then satisfies this goal's
+        acceptance in ANY application order (prefix sums of nonnegative deltas
+        are monotone). Return None when not applicable (see ``wave_safe``)."""
+        return None
+
+    def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
+        """Optional ``(src_gain[B], dst_gain[B], dim)`` for the ACTIVE goal:
+        the remaining genuinely-useful shed (src excess above its target) and
+        fill (dst deficit below its target) in units of the wave delta column
+        ``dim``. The engine rejects wave rows whose cumulative delta exceeds
+        BOTH budgets — per-row scores are computed against the pre-wave state,
+        so without this cap a wave admits band-legal but zero-gain churn
+        (shedding past the upper bound all the way to lower). None = every
+        scored row is genuinely gainful (e.g. rack fixes, partition-exact
+        goals)."""
+        return None
 
     def leader_key(self, env: ClusterEnv, st: EngineState, severity: Array) -> Array:
         return jnp.full(env.num_replicas, NEG_INF)

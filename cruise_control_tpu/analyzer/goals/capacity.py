@@ -15,7 +15,7 @@ import dataclasses
 import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer.env import ClusterEnv
-from cruise_control_tpu.analyzer.goals.base import NEG_INF, GoalKernel, candidate_load
+from cruise_control_tpu.analyzer.goals.base import NEG_INF, WAVE_COUNT, WAVE_DIMS, GoalKernel, candidate_load
 from cruise_control_tpu.analyzer.state import EngineState
 
 from cruise_control_tpu.common.resources import EPSILON_ABS, RESOURCES
@@ -71,6 +71,22 @@ class CapacityGoal(GoalKernel):
         l = candidate_load(env, st, cand)[:, self.resource]
         limit = self._limit(env) + RESOURCE_EPS[self.resource]
         return (st.util[None, :, self.resource] + l[:, None]) <= limit[None, :]
+
+    def wave_budgets(self, env: ClusterEnv, st: EngineState):
+        """Destination headroom to the capacity limit; sources unconstrained
+        (cumulative form of accept_move)."""
+        util = st.util[:, self.resource]
+        limit = self._limit(env) + RESOURCE_EPS[self.resource]
+        B = env.num_brokers
+        src = jnp.full((B, WAVE_DIMS), jnp.inf, util.dtype)
+        dst = jnp.full((B, WAVE_DIMS), jnp.inf, util.dtype)
+        dst = dst.at[:, self.resource].set(limit - util)
+        return src, dst
+
+    def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
+        util = st.util[:, self.resource]
+        excess = jnp.maximum(util - self._limit(env), 0.0)
+        return excess, jnp.zeros_like(excess), self.resource
 
     # -- leadership (CPU / NW_OUT shift with leadership) --
     def leader_key(self, env: ClusterEnv, st: EngineState, severity):
@@ -190,6 +206,20 @@ class ReplicaCapacityGoal(GoalKernel):
     def accept_move(self, env: ClusterEnv, st: EngineState, cand):
         ok = (st.replica_count[None, :] + 1) <= self._max()
         return jnp.broadcast_to(ok, (cand.shape[0], env.num_brokers))
+
+    def wave_budgets(self, env: ClusterEnv, st: EngineState):
+        """Destination replica-count headroom to the per-broker cap."""
+        c = st.replica_count.astype(jnp.float32)
+        B = env.num_brokers
+        src = jnp.full((B, WAVE_DIMS), jnp.inf, c.dtype)
+        dst = jnp.full((B, WAVE_DIMS), jnp.inf, c.dtype)
+        dst = dst.at[:, WAVE_COUNT].set(float(self._max()) - c)
+        return src, dst
+
+    def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
+        c = st.replica_count.astype(jnp.float32)
+        excess = jnp.maximum(c - float(self._max()), 0.0)
+        return excess, jnp.zeros_like(excess), WAVE_COUNT
 
     def accept_swap(self, env: ClusterEnv, st: EngineState, cand_out, cand_in):
         """Swaps are count-neutral -> always accepted

@@ -28,7 +28,8 @@ from cruise_control_tpu.analyzer.env import (
     BALANCE_MARGIN, ClusterEnv, resource_balance_limits,
 )
 from cruise_control_tpu.analyzer.goals.base import (
-    NEG_INF, GoalKernel, candidate_load, rank_within_broker,
+    NEG_INF, WAVE_COUNT, WAVE_DIMS, WAVE_LEADER_COUNT, GoalKernel,
+    candidate_load, rank_within_broker,
 )
 from cruise_control_tpu.analyzer.goals.capacity import RESOURCE_EPS
 from cruise_control_tpu.analyzer.state import EngineState
@@ -126,6 +127,26 @@ class ResourceDistributionGoal(GoalKernel):
         score = jnp.where(offline[:, None], heal_score,
                           jnp.where(feasible & (gain > 0), gain, NEG_INF))
         return score
+
+    def wave_budgets(self, env: ClusterEnv, st: EngineState):
+        """Band slack on this resource: a wave may shed util down to lower and
+        fill up to upper (the cumulative form of accept_move's band checks;
+        conservative vs the single-move excess exception)."""
+        lower, upper = self._limits(env, st)
+        util = st.util[:, self.resource]
+        eps = RESOURCE_EPS[self.resource]
+        B = env.num_brokers
+        src = jnp.full((B, WAVE_DIMS), jnp.inf, util.dtype)
+        dst = jnp.full((B, WAVE_DIMS), jnp.inf, util.dtype)
+        src = src.at[:, self.resource].set(util - lower + eps)
+        dst = dst.at[:, self.resource].set(upper - util + eps)
+        return src, dst
+
+    def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
+        lower, upper = self._limits(env, st)
+        util = st.util[:, self.resource]
+        return (jnp.maximum(util - upper, 0.0), jnp.maximum(lower - util, 0.0),
+                self.resource)
 
     def accept_move(self, env: ClusterEnv, st: EngineState, cand):
         """Veto (as an already-optimized goal): moving cand -> dst must not push
@@ -341,6 +362,24 @@ class ReplicaDistributionGoal(GoalKernel):
         src_ok = ((c[src] - 1 >= lower[src]) | (c[src] > upper[src]))[:, None]
         return dst_ok & src_ok
 
+    def wave_budgets(self, env: ClusterEnv, st: EngineState):
+        """Replica-count band slack (cumulative form of accept_move: shedding
+        stepwise from excess may continue down to lower)."""
+        lower, upper = self._limits(env, st)
+        c = st.replica_count.astype(jnp.float32)
+        B = env.num_brokers
+        src = jnp.full((B, WAVE_DIMS), jnp.inf, c.dtype)
+        dst = jnp.full((B, WAVE_DIMS), jnp.inf, c.dtype)
+        src = src.at[:, WAVE_COUNT].set(c - lower)
+        dst = dst.at[:, WAVE_COUNT].set(upper - c)
+        return src, dst
+
+    def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
+        lower, upper = self._limits(env, st)
+        c = st.replica_count.astype(jnp.float32)
+        return (jnp.maximum(c - upper, 0.0), jnp.maximum(lower - c, 0.0),
+                WAVE_COUNT)
+
     def accept_swap(self, env: ClusterEnv, st: EngineState, cand_out, cand_in):
         """Swaps are count-neutral -> always accepted
         (ReplicaDistributionGoal.java:122 INTER_BROKER_REPLICA_SWAP: ACCEPT)."""
@@ -401,6 +440,24 @@ class LeaderReplicaDistributionGoal(GoalKernel):
         src_ok = ((c[src] - 1 >= lower[src]) | (c[src] > upper[src]))[:, None]
         moving_leader = is_leader[:, None]
         return jnp.where(moving_leader, dst_ok & src_ok, True)
+
+    def wave_budgets(self, env: ClusterEnv, st: EngineState):
+        """Leader-count band slack; follower moves carry a zero leader-count
+        delta, so the conditionality of accept_move is preserved exactly."""
+        lower, upper = self._limits(env, st)
+        c = st.leader_count.astype(jnp.float32)
+        B = env.num_brokers
+        src = jnp.full((B, WAVE_DIMS), jnp.inf, c.dtype)
+        dst = jnp.full((B, WAVE_DIMS), jnp.inf, c.dtype)
+        src = src.at[:, WAVE_LEADER_COUNT].set(c - lower)
+        dst = dst.at[:, WAVE_LEADER_COUNT].set(upper - c)
+        return src, dst
+
+    def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
+        lower, upper = self._limits(env, st)
+        c = st.leader_count.astype(jnp.float32)
+        return (jnp.maximum(c - upper, 0.0), jnp.maximum(lower - c, 0.0),
+                WAVE_LEADER_COUNT)
 
     def leader_key(self, env: ClusterEnv, st: EngineState, severity):
         lower, upper = self._limits(env, st)

@@ -13,7 +13,7 @@ import dataclasses
 import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer.env import ClusterEnv, resource_balance_limits
-from cruise_control_tpu.analyzer.goals.base import NEG_INF, GoalKernel
+from cruise_control_tpu.analyzer.goals.base import NEG_INF, WAVE_DIMS, WAVE_POT_NW_OUT, GoalKernel
 from cruise_control_tpu.analyzer.goals.capacity import RESOURCE_EPS
 from cruise_control_tpu.analyzer.state import EngineState
 from cruise_control_tpu.common.resources import Resource
@@ -58,6 +58,19 @@ class PotentialNwOutGoal(GoalKernel):
         pot = env.leader_load[cand, NW_OUT]
         limit = self._limit(env) + RESOURCE_EPS[NW_OUT]
         return st.potential_nw_out[None, :] + pot[:, None] <= limit[None, :]
+
+    def wave_budgets(self, env: ClusterEnv, st: EngineState):
+        """Destination headroom to the potential-NW_OUT limit."""
+        limit = self._limit(env) + RESOURCE_EPS[NW_OUT]
+        B = env.num_brokers
+        src = jnp.full((B, WAVE_DIMS), jnp.inf, st.potential_nw_out.dtype)
+        dst = jnp.full((B, WAVE_DIMS), jnp.inf, st.potential_nw_out.dtype)
+        dst = dst.at[:, WAVE_POT_NW_OUT].set(limit - st.potential_nw_out)
+        return src, dst
+
+    def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
+        excess = jnp.maximum(st.potential_nw_out - self._limit(env), 0.0)
+        return excess, jnp.zeros_like(excess), WAVE_POT_NW_OUT
 
 
 @dataclasses.dataclass(frozen=True)

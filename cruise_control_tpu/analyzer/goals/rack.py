@@ -30,6 +30,9 @@ class RackAwareGoal(GoalKernel):
     def __post_init__(self):
         object.__setattr__(self, "name", "RackAwareGoal")
         object.__setattr__(self, "is_hard", True)
+        # acceptance depends only on per-(partition, rack) counts: the wave's
+        # partition-first-touch rule keeps it single-move-exact
+        object.__setattr__(self, "wave_safe", True)
 
     def broker_severity(self, env: ClusterEnv, st: EngineState):
         """Severity = count of rack-violating (or offline) replicas per broker."""
@@ -89,6 +92,7 @@ class RackAwareDistributionGoal(GoalKernel):
     def __post_init__(self):
         object.__setattr__(self, "name", "RackAwareDistributionGoal")
         object.__setattr__(self, "is_hard", True)
+        object.__setattr__(self, "wave_safe", True)   # per-(partition, rack)
 
     def _partition_rf(self, env: ClusterEnv) -> jnp.ndarray:
         return jnp.sum(env.partition_replicas >= 0, axis=1)                  # i32[P]

@@ -24,6 +24,9 @@ from cruise_control_tpu.analyzer.state import EngineState
 class TopicReplicaDistributionGoal(GoalKernel):
     def __post_init__(self):
         object.__setattr__(self, "name", "TopicReplicaDistributionGoal")
+        # acceptance bands per-(topic, broker) count: the wave's
+        # (topic, src)/(topic, dst) first-use rule keeps it single-move-exact
+        object.__setattr__(self, "wave_safe", True)
 
     def _limits(self, env: ClusterEnv, st: EngineState):
         """(lower[T], upper[T]) per-topic per-broker count limits."""
@@ -132,6 +135,7 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
         object.__setattr__(self, "name", "MinTopicLeadersPerBrokerGoal")
         object.__setattr__(self, "is_hard", True)
         object.__setattr__(self, "uses_leadership_moves", True)
+        object.__setattr__(self, "wave_safe", True)   # per-(topic, src) count
 
     def _min(self) -> int:
         return self.constraint.min_topic_leaders_per_broker

@@ -196,12 +196,16 @@ def apply_leadership(env: ClusterEnv, st: EngineState, src_replica: Array,
 
 def apply_moves_batched(env: ClusterEnv, st: EngineState, replicas: Array,
                         dsts: Array, mask: Array) -> EngineState:
-    """Apply a WAVE of mutually-independent moves in one set of scatter
-    updates: ``replicas[W]`` (unique indices) relocate to ``dsts[W]`` where
-    ``mask[W]``; masked-off rows are no-ops. The caller guarantees wave
-    members touch disjoint brokers (each broker at most once, in one role)
-    and disjoint partitions, so every move is exactly as valid as it scored
-    against the pre-wave state. Scatter-adds are duplicate-safe regardless.
+    """Apply a WAVE of moves in one set of scatter updates: ``replicas[W]``
+    (unique indices) relocate to ``dsts[W]`` where ``mask[W]``; masked-off
+    rows are no-ops. The caller guarantees wave members touch disjoint
+    partitions and keep every broker's cumulative delta within the engine's
+    admission budgets (see engine._move_branch_batched), so the final state
+    satisfies every validated constraint; scatter-adds are duplicate-safe, so
+    brokers MAY appear in many rows and in both roles. One caveat: same-dst
+    rows all pick the pre-wave most-free logdir — broker-level tallies stay
+    exact, per-disk placement is advisory (the executor re-picks logdirs; the
+    intra-broker goals run their own single-broker branch).
 
     This is the engine's bulk path: one wave lands ~K moves for ~15 vector
     ops instead of K sequential re-score iterations."""

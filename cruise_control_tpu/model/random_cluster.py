@@ -41,6 +41,13 @@ class RandomClusterSpec:
     leader_to_follower_ratio: float = 2.0   # unused when builder splits loads
     skew: float = 0.0                   # extra placement skew toward low-id brokers
     seed: int = 3140                    # TestConstants.SEED_BASE
+    target_cpu_util: float | None = None
+    """When set, rescale per-replica CPU loads so cluster-mean CPU utilization
+    equals this fraction. The raw mean_cpu knob scales with P/B, so large
+    rungs silently drift infeasible (mean util above the 0.7 capacity
+    threshold means NO assignment can satisfy CpuCapacityGoal — the engine
+    then burns its whole iteration budget proving it). Benchmarks pin this
+    to a feasible-but-skewed operating point instead."""
 
 
 def _sample(rng: np.random.Generator, dist: str, mean: float, n: int) -> np.ndarray:
@@ -51,6 +58,30 @@ def _sample(rng: np.random.Generator, dist: str, mean: float, n: int) -> np.ndar
     if dist == "uniform":
         return rng.uniform(0.5 * mean, 1.5 * mean, n)
     raise ValueError(f"unknown distribution {dist!r}")
+
+
+def _calibrate_cpu(ct, target_util: float):
+    """Rescale CPU loads so mean CPU utilization over alive brokers hits
+    ``target_util`` (shape and skew preserved; only the scale changes)."""
+    import jax.numpy as jnp
+
+    lead = np.asarray(ct.leader_load)
+    fol = np.asarray(ct.follower_load)
+    is_lead = np.asarray(ct.replica_is_leader)
+    valid = np.asarray(ct.replica_valid)
+    eff = np.where(is_lead, lead[:, Resource.CPU], fol[:, Resource.CPU])
+    total = float(eff[valid].sum())
+    cap = np.asarray(ct.broker_capacity)[np.asarray(ct.broker_alive),
+                                         Resource.CPU].sum()
+    if total <= 0.0 or cap <= 0.0:
+        return ct
+    scale = target_util * float(cap) / total
+    lead = lead.copy()
+    fol = fol.copy()
+    lead[:, Resource.CPU] *= scale
+    fol[:, Resource.CPU] *= scale
+    return dataclasses.replace(ct, leader_load=jnp.asarray(lead),
+                       follower_load=jnp.asarray(fol))
 
 
 def generate(spec: RandomClusterSpec):
@@ -105,7 +136,10 @@ def generate(spec: RandomClusterSpec):
                 logdir = logdirs[int(rng.integers(spec.logdirs_per_broker))]
                 b.add_replica(f"topic{t}", p, int(broker), is_leader=(i == 0),
                               load=load, logdir=logdir)
-    return b.build()
+    ct, meta = b.build()
+    if spec.target_cpu_util is not None:
+        ct = _calibrate_cpu(ct, spec.target_cpu_util)
+    return ct, meta
 
 
 def generate_scale(spec: RandomClusterSpec):
@@ -247,4 +281,6 @@ def generate_scale(spec: RandomClusterSpec):
         num_racks=spec.num_racks,
         num_valid_replicas=R,
     )
+    if spec.target_cpu_util is not None:
+        ct = _calibrate_cpu(ct, spec.target_cpu_util)
     return ct, meta
