@@ -44,8 +44,8 @@ from cruise_control_tpu.analyzer.goals.base import (
 )
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.analyzer.state import (
-    EngineState, apply_disk_move, apply_leadership, apply_move,
-    apply_moves_batched, apply_swap,
+    EngineState, apply_disk_move, apply_leadership, apply_leaderships_batched,
+    apply_move, apply_moves_batched, apply_swap,
 )
 
 Array = jax.Array
@@ -75,13 +75,84 @@ class EngineParams:
     min_gain: float = 1e-9            # scores below this count as no progress
 
 
-def _wave_budget_capable(g: GoalKernel) -> bool:
-    """Can multi-move waves preserve this goal's acceptance semantics?
-    Yes when it provides cumulative budgets, never vetoes moves, or is
-    covered by the wave's partition/topic first-use rules (wave_safe)."""
-    return (type(g).wave_budgets is not GoalKernel.wave_budgets
-            or type(g).accept_move is GoalKernel.accept_move
-            or g.wave_safe)
+def _wave_budget_capable(g: GoalKernel, leadership: bool = False) -> bool:
+    """Can multi-action waves preserve this goal's acceptance semantics?
+    Yes when it provides cumulative budgets, is covered by the wave's
+    partition/topic first-use rules (wave_safe), or never vetoes the action
+    kind in question (the veto method checked is per action kind — a custom
+    accept_leadership forces the sequential path even if accept_move is the
+    default, and vice versa)."""
+    if (type(g).wave_budgets is not GoalKernel.wave_budgets) or g.wave_safe:
+        return True
+    if leadership:
+        return type(g).accept_leadership is GoalKernel.accept_leadership
+    return type(g).accept_move is GoalKernel.accept_move
+
+
+def _wave_admission(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                    prev_goals: tuple, d_src: Array, d_dst: Array,
+                    src_b: Array, dst_b: Array, wave_ok: Array, topics: Array,
+                    posn: Array, gain_escape: Array | None = None) -> Array:
+    """bool[K] budgeted wave admission, shared by the move and leadership
+    branches. In score order, a row is admitted iff:
+    - its (topic, src) and (topic, dst) pairs are first-use in this wave
+      (keeps per-(topic, broker) count acceptance single-action exact),
+    - its per-src / per-dst cumulative delta stays within the combined band
+      slack of every chain goal (rank-0 rows always pass — they were
+      validated against the true state by the masks themselves), and
+    - the ACTIVE goal still has useful work left at its endpoints
+      (wave_gain_budgets; ``gain_escape`` rows — e.g. offline healing —
+      bypass the gain cap).
+    ``d_src``/``d_dst`` are the [K, WAVE_DIMS] deltas each row removes from
+    its source / adds to its destination (they differ for leadership
+    transfers, where the destination gains the DST replica's loads)."""
+    B = env.num_brokers
+    K = posn.shape[0]
+    INF = jnp.int32(K + 1)
+    guarded = jnp.where(wave_ok, posn, INF)
+    nT = env.topic_excluded.shape[0]
+    ts_key = topics * B + src_b
+    td_key = topics * B + dst_b
+    first_ts = jnp.full(nT * B, INF, jnp.int32).at[ts_key].min(guarded)
+    first_td = jnp.full(nT * B, INF, jnp.int32).at[td_key].min(guarded)
+    topic_ok = (first_ts[ts_key] == posn) & (first_td[td_key] == posn)
+
+    d_src = jnp.where(wave_ok[:, None], d_src, 0.0)
+    d_dst = jnp.where(wave_ok[:, None], d_dst, 0.0)
+    src_slack = jnp.full((B, WAVE_DIMS), jnp.inf, d_src.dtype)
+    dst_slack = jnp.full((B, WAVE_DIMS), jnp.inf, d_src.dtype)
+    for g in (goal, *prev_goals):
+        bud = g.wave_budgets(env, st)
+        if bud is not None:
+            src_slack = jnp.minimum(src_slack, bud[0])
+            dst_slack = jnp.minimum(dst_slack, bud[1])
+    # rows that fail elsewhere still occupy cumulative room (conservative);
+    # rows not in the wave group as singletons so ranks stay meaningful
+    sgroups = jnp.where(wave_ok, src_b, B + posn)
+    dgroups = jnp.where(wave_ok, dst_b, B + posn)
+    cum_src, rank_src = _group_cumsum(sgroups, d_src)
+    cum_dst, rank_dst = _group_cumsum(dgroups, d_dst)
+    src_fit = (rank_src == 0) | jnp.all(cum_src <= src_slack[src_b] + 1e-4,
+                                        axis=1)
+    dst_fit = (rank_dst == 0) | jnp.all(cum_dst <= dst_slack[dst_b] + 1e-4,
+                                        axis=1)
+    win = wave_ok & topic_ok & src_fit & dst_fit
+    # per-row scores were computed pre-wave: cap the wave at the ACTIVE
+    # goal's remaining useful work (src excess / dst deficit) so band-legal
+    # but zero-gain churn is rejected. A clause only admits when its budget
+    # is strictly positive — an exactly-zero budget plus an fp epsilon would
+    # otherwise admit every first-use row.
+    gb = goal.wave_gain_budgets(env, st)
+    if gb is not None:
+        src_gain, dst_gain, dim = gb
+        excl_src = cum_src[:, dim] - d_src[:, dim]
+        excl_dst = cum_dst[:, dim] - d_dst[:, dim]
+        gain_ok = (((src_gain[src_b] > 0) & (excl_src < src_gain[src_b]))
+                   | ((dst_gain[dst_b] > 0) & (excl_dst < dst_gain[dst_b])))
+        if gain_escape is not None:
+            gain_ok = gain_ok | gain_escape
+        win = win & gain_ok
+    return win
 
 
 def _group_cumsum(groups: Array, d: Array):
@@ -199,19 +270,6 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
     if all(_wave_budget_capable(g) for g in (goal, *prev_goals)):
         # ---- budgeted admission: MANY moves per broker per wave ----
-        # Every broker-level acceptance in the chain is an interval constraint
-        # on monotone cumulative deltas, so rows are admitted (in score order)
-        # while their per-src/per-dst cumulative delta stays within the
-        # combined remaining slack; topic-count acceptance is preserved by
-        # using each (topic, broker) pair at most once.
-        t_s = env.replica_topic[r_sorted]
-        nT = env.topic_excluded.shape[0]
-        ts_key = t_s * B + src_s
-        td_key = t_s * B + dst_s
-        first_ts = jnp.full(nT * B, INF, jnp.int32).at[ts_key].min(guarded)
-        first_td = jnp.full(nT * B, INF, jnp.int32).at[td_key].min(guarded)
-        topic_ok = (first_ts[ts_key] == posn) & (first_td[td_key] == posn)
-
         lead_s = st.replica_is_leader[r_sorted]
         eff = jnp.where(lead_s[:, None], env.leader_load[r_sorted],
                         env.follower_load[r_sorted])
@@ -219,43 +277,12 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         d = jnp.concatenate([
             eff, one, lead_s[:, None].astype(eff.dtype),
             env.leader_load[r_sorted, Resource.NW_OUT][:, None],
+            jnp.zeros((K, 1), eff.dtype),   # leader NW_IN: moves unconstrained
         ], axis=1)                                              # [K, WAVE_DIMS]
-        d = jnp.where(wave_ok[:, None], d, 0.0)
-        src_slack = jnp.full((B, WAVE_DIMS), jnp.inf, eff.dtype)
-        dst_slack = jnp.full((B, WAVE_DIMS), jnp.inf, eff.dtype)
-        for g in (goal, *prev_goals):
-            bud = g.wave_budgets(env, st)
-            if bud is not None:
-                src_slack = jnp.minimum(src_slack, bud[0])
-                dst_slack = jnp.minimum(dst_slack, bud[1])
-        # rows that fail elsewhere still occupy cumulative room (conservative);
-        # rows not in the wave group as singletons so ranks stay meaningful
-        sgroups = jnp.where(wave_ok, src_s, B + posn)
-        dgroups = jnp.where(wave_ok, dst_s, B + posn)
-        cum_src, rank_src = _group_cumsum(sgroups, d)
-        cum_dst, rank_dst = _group_cumsum(dgroups, d)
-        # rank-0 rows were validated against the true state by the masks
-        # themselves — always admissible, exactly like the one-per-broker wave
-        src_fit = (rank_src == 0) | jnp.all(cum_src <= src_slack[src_s] + 1e-4,
-                                            axis=1)
-        dst_fit = (rank_dst == 0) | jnp.all(cum_dst <= dst_slack[dst_s] + 1e-4,
-                                            axis=1)
-        win = wave_ok & part_ok & topic_ok & src_fit & dst_fit
-        # per-row scores were computed pre-wave: cap the wave at the ACTIVE
-        # goal's remaining useful work (src excess / dst deficit) so band-legal
-        # but zero-gain churn is rejected (offline healing always gains)
-        gb = goal.wave_gain_budgets(env, st)
-        if gb is not None:
-            src_gain, dst_gain, dim = gb
-            excl_src = cum_src[:, dim] - d[:, dim]
-            excl_dst = cum_dst[:, dim] - d[:, dim]
-            # a clause only admits when its budget is strictly positive — an
-            # exactly-zero budget plus the fp epsilon would otherwise admit
-            # every first-use row (zero-gain churn)
-            gain_ok = (((src_gain[src_s] > 0) & (excl_src < src_gain[src_s]))
-                       | ((dst_gain[dst_s] > 0) & (excl_dst < dst_gain[dst_s]))
-                       | st.replica_offline[r_sorted])
-            win = win & gain_ok
+        win = part_ok & _wave_admission(
+            env, st, goal, prev_goals, d, d, src_s, dst_s, wave_ok,
+            env.replica_topic[r_sorted], posn,
+            gain_escape=st.replica_offline[r_sorted])
     else:
         # legacy conservative wave: each broker participates at most once
         first_broker = (jnp.full(B, INF, jnp.int32)
@@ -297,9 +324,13 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                                prev_goals: tuple, params: EngineParams,
                                severity: Array):
-    """Leadership analogue of _move_branch_batched: order candidates by a
-    [KL, F] scoring pass, then apply up to KL transfers, re-scoring each
-    [1, F] row against the running state."""
+    """Leadership analogue of _move_branch_batched: one [KL, F] scoring pass,
+    then budgeted wave admission (each candidate is a distinct partition's
+    leader, so rows never conflict on partition state; per-broker cumulative
+    deltas — util shift, leader count, leader bytes-in — stay within the
+    combined band slack), one batched apply, sequential re-scored leftovers
+    when the wave was thin. Falls back to fully sequential application for
+    chains with non-budget-capable goals."""
     lkey = goal.leader_key(env, st, severity)
     lkv, lcand = _top_candidates(lkey, min(params.num_leader_candidates,
                                            env.num_replicas),
@@ -311,11 +342,12 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
     lscore = jnp.where(lmask & (lkv > NEG_INF)[:, None], lscore, NEG_INF)
     best_val = jnp.max(lscore, axis=1)
     order = jnp.argsort(-best_val)
+    KL = lscore.shape[0]
 
-    def body(i, carry):
-        st, n_applied = carry
-        k = order[i]
-        r = lcand[k]
+    def seq_body(i, carry):
+        """Re-score one candidate row against the live state and apply."""
+        st, n_applied, idx = carry
+        r = idx[i]
         c1 = r[None]
         m1 = legit_leadership_mask(env, st, c1)
         for g in prev_goals:
@@ -323,14 +355,58 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
         s1 = jnp.where(m1, goal.leadership_score(env, st, c1), NEG_INF)[0]
         f = jnp.argmax(s1)
         dst = env.partition_replicas[env.replica_partition[r], f]
-        ok = (best_val[k] > params.min_gain) & (s1[f] > params.min_gain)
+        ok = env.replica_valid[r] & (s1[f] > params.min_gain)
         st = apply_leadership(env, st, r, jnp.clip(dst, 0), enabled=ok)
-        return st, n_applied + ok.astype(jnp.int32)
+        return st, n_applied + ok.astype(jnp.int32), idx
 
-    KL = lscore.shape[0]
-    n_pos = jnp.sum(best_val > params.min_gain).astype(jnp.int32)
-    st, n_applied = jax.lax.fori_loop(0, jnp.minimum(n_pos, KL), body,
-                                      (st, jnp.int32(0)))
+    if not all(_wave_budget_capable(g, leadership=True)
+               for g in (goal, *prev_goals)):
+        n_pos = jnp.sum(best_val > params.min_gain).astype(jnp.int32)
+        st, n_applied, _ = jax.lax.fori_loop(
+            0, jnp.minimum(n_pos, KL), seq_body,
+            (st, jnp.int32(0), lcand[order]))
+        return st, n_applied
+
+    # ---- budgeted wave ----
+    posn = jnp.arange(KL, dtype=jnp.int32)
+    r_sorted = lcand[order]
+    f_best = jnp.argmax(lscore, axis=1)[order]
+    members = env.partition_replicas[env.replica_partition[r_sorted]]
+    dst_rep = jnp.clip(members[posn, f_best], 0)
+    val_s = best_val[order]
+    wave_ok = val_s > params.min_gain
+    src_b = st.replica_broker[r_sorted]
+    dst_b = st.replica_broker[dst_rep]
+
+    def leadership_deltas(rep):
+        """[KL, WAVE_DIMS] per-broker deltas of gaining/losing leadership of
+        ``rep`` — replicas of one partition may carry different load rows, so
+        src and dst deltas are built from their OWN replica's loads."""
+        delta = env.leader_load[rep] - env.follower_load[rep]
+        zero = jnp.zeros((KL, 1), delta.dtype)
+        one = jnp.ones((KL, 1), delta.dtype)
+        return jnp.concatenate([
+            delta, zero, one, zero,
+            env.leader_load[rep, Resource.NW_IN][:, None],
+        ], axis=1)
+
+    win = _wave_admission(env, st, goal, prev_goals,
+                          leadership_deltas(r_sorted), leadership_deltas(dst_rep),
+                          src_b, dst_b, wave_ok,
+                          env.replica_topic[r_sorted], posn)
+    st = apply_leaderships_batched(env, st, r_sorted, dst_rep, win)
+    n_applied = jnp.sum(win).astype(jnp.int32)
+
+    # sequential leftovers when the wave was thin (same rationale as the
+    # move branch); compacted so the loop runs only as long as needed
+    n_pos = jnp.sum(wave_ok).astype(jnp.int32)
+    leftover = wave_ok & ~win
+    n_lo = jnp.sum(leftover).astype(jnp.int32)
+    lo_order = jnp.argsort(~leftover)
+    wave_thin = n_applied * 8 < n_pos
+    trip = jnp.where(wave_thin, jnp.minimum(n_lo, KL), 0)
+    st, n_applied, _ = jax.lax.fori_loop(0, trip, seq_body,
+                                         (st, n_applied, r_sorted[lo_order]))
     return st, n_applied
 
 

@@ -45,16 +45,23 @@ Array = jax.Array
 NEG_INF = -jnp.inf
 
 # Budgeted-wave delta dimensions (engine-wide convention, see
-# engine._move_branch_batched): what one applied replica move adds to its
-# destination broker / removes from its source broker.
-#   0..3  effective load (CPU, NW_IN, NW_OUT, DISK — current-role load)
-#   4     replica count (always 1)
-#   5     leader count (1 iff the moved replica is a leader)
-#   6     potential NW_OUT (leader-mode NW_OUT, every replica)
-WAVE_DIMS = 7
+# engine._move_branch_batched / _leadership_branch_batched): what one applied
+# action adds to its destination broker / removes from its source broker.
+#   0..3  utilization delta (CPU, NW_IN, NW_OUT, DISK) — a replica move
+#         carries the replica's current-role load; a leadership transfer
+#         carries (leader_load - follower_load)
+#   4     replica count (1 for moves, 0 for leadership)
+#   5     leader count (1 iff the action moves leadership)
+#   6     potential NW_OUT (leader-mode NW_OUT; 0 for leadership transfers)
+#   7     leader NW_IN (what leader_util[:, NW_IN] shifts by). Deliberately 0
+#         in MOVE waves: no goal vetoes replica moves on leader bytes-in
+#         (LeaderBytesInDistributionGoal has no accept_move, matching the
+#         reference), so budgets on this dim only bind leadership waves.
+WAVE_DIMS = 8
 WAVE_COUNT = 4
 WAVE_LEADER_COUNT = 5
 WAVE_POT_NW_OUT = 6
+WAVE_LEADER_NW_IN = 7
 
 
 @dataclasses.dataclass(frozen=True)

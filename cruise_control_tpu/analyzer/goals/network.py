@@ -13,7 +13,9 @@ import dataclasses
 import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer.env import ClusterEnv, resource_balance_limits
-from cruise_control_tpu.analyzer.goals.base import NEG_INF, WAVE_DIMS, WAVE_POT_NW_OUT, GoalKernel
+from cruise_control_tpu.analyzer.goals.base import (
+    NEG_INF, WAVE_DIMS, WAVE_LEADER_NW_IN, WAVE_POT_NW_OUT, GoalKernel,
+)
 from cruise_control_tpu.analyzer.goals.capacity import RESOURCE_EPS
 from cruise_control_tpu.analyzer.state import EngineState
 from cruise_control_tpu.common.resources import Resource
@@ -127,3 +129,20 @@ class LeaderBytesInDistributionGoal(GoalKernel):
         lin = env.leader_load[cand, NW_IN][:, None]
         eps = RESOURCE_EPS[NW_IN]
         return st.leader_util[dst_broker, NW_IN] + lin <= upper[dst_broker] + eps
+
+    def wave_budgets(self, env: ClusterEnv, st: EngineState):
+        """Destination leader-bytes-in headroom; binds leadership waves only
+        (move-wave deltas carry 0 on the leader-NW_IN dim, mirroring the
+        absence of an accept_move veto)."""
+        upper = self._limits(env, st) + RESOURCE_EPS[NW_IN]
+        lu = st.leader_util[:, NW_IN]
+        B = env.num_brokers
+        src = jnp.full((B, WAVE_DIMS), jnp.inf, lu.dtype)
+        dst = jnp.full((B, WAVE_DIMS), jnp.inf, lu.dtype)
+        dst = dst.at[:, WAVE_LEADER_NW_IN].set(upper - lu)
+        return src, dst
+
+    def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
+        upper = self._limits(env, st)
+        excess = jnp.maximum(st.leader_util[:, NW_IN] - upper, 0.0)
+        return excess, jnp.zeros_like(excess), WAVE_LEADER_NW_IN

@@ -194,6 +194,41 @@ def apply_leadership(env: ClusterEnv, st: EngineState, src_replica: Array,
                                .at[dst_replica].set(st.leadership_moved[dst_replica] | en))
 
 
+def apply_leaderships_batched(env: ClusterEnv, st: EngineState,
+                              src_replicas: Array, dst_replicas: Array,
+                              mask: Array) -> EngineState:
+    """Apply a WAVE of leadership transfers in one set of scatter updates:
+    leadership moves from ``src_replicas[W]`` to ``dst_replicas[W]`` (same
+    partition, distinct partitions across rows) where ``mask[W]``. Brokers may
+    appear in many rows — the engine's admission budgets keep cumulative
+    deltas within every validated band (see apply_moves_batched)."""
+    en = mask
+    enf = en.astype(st.util.dtype)[:, None]
+    bs = st.replica_broker[src_replicas]
+    bd = st.replica_broker[dst_replicas]
+    delta_s = (env.leader_load[src_replicas] - env.follower_load[src_replicas]) * enf
+    delta_d = (env.leader_load[dst_replicas] - env.follower_load[dst_replicas]) * enf
+    util = st.util.at[bs].add(-delta_s).at[bd].add(delta_d)
+    leader_util = (st.leader_util.at[bs].add(-env.leader_load[src_replicas] * enf)
+                                  .at[bd].add(env.leader_load[dst_replicas] * enf))
+    one = en.astype(jnp.int32)
+    lc = st.leader_count.at[bs].add(-one).at[bd].add(one)
+    t = env.replica_topic[src_replicas]
+    tlc = st.topic_leader_count.at[t, bs].add(-one).at[t, bd].add(one)
+    # duplicate-safe leadership flip: gather/.set would let a MASKED row whose
+    # dst index collides with an enabled row's src/dst write back a stale
+    # pre-wave value (top-k pads rows with arbitrary replicas). OR/AND-style
+    # scatters (.max/.min on bool) are order-independent.
+    R = st.replica_is_leader.shape[0]
+    cleared = jnp.zeros(R, bool).at[src_replicas].max(en)
+    granted = jnp.zeros(R, bool).at[dst_replicas].max(en)
+    lead = (st.replica_is_leader & ~cleared) | granted
+    lmoved = st.leadership_moved | cleared | granted
+    return dataclasses.replace(st, replica_is_leader=lead, util=util,
+                               leader_util=leader_util, leader_count=lc,
+                               topic_leader_count=tlc, leadership_moved=lmoved)
+
+
 def apply_moves_batched(env: ClusterEnv, st: EngineState, replicas: Array,
                         dsts: Array, mask: Array) -> EngineState:
     """Apply a WAVE of moves in one set of scatter updates: ``replicas[W]``
