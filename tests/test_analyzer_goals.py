@@ -144,3 +144,67 @@ def test_preferred_leader_election():
     })))
     assert bool(st3.replica_is_leader[0])
     assert not bool(st3.replica_is_leader[1])
+
+
+def test_group_cumsum_and_wave_admission_math():
+    """Unit checks of the budgeted-wave machinery (engine._group_cumsum):
+    per-group inclusive prefix sums in the given row order + in-group ranks,
+    against a straightforward numpy oracle."""
+    import numpy as np
+    from cruise_control_tpu.analyzer.engine import _group_cumsum
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    K, DIMS = 64, 3
+    groups = rng.integers(0, 9, K).astype(np.int32)
+    d = rng.uniform(0.0, 2.0, (K, DIMS)).astype(np.float32)
+    cum, rank = _group_cumsum(jnp.asarray(groups), jnp.asarray(d))
+    cum = np.asarray(cum)
+    rank = np.asarray(rank)
+    seen: dict = {}
+    run: dict = {}
+    for i in range(K):
+        g = int(groups[i])
+        run[g] = run.get(g, np.zeros(DIMS)) + d[i]
+        # f32 global-cumsum-minus-base incurs ~1e-6 cancellation error
+        np.testing.assert_allclose(cum[i], run[g], rtol=1e-4, atol=1e-5)
+        assert rank[i] == seen.get(g, 0)
+        seen[g] = seen.get(g, 0) + 1
+
+
+def test_budgeted_wave_respects_capacity_band():
+    """A wave may drain an overloaded broker with MANY moves at once, but the
+    per-destination cumulative budget must keep every destination under the
+    capacity limit — the multi-move analogue of accept_move's band check."""
+    from cruise_control_tpu.analyzer import (
+        EngineParams, init_state, make_env, optimize_goal,
+    )
+    from cruise_control_tpu.analyzer.goals import make_goal
+    from cruise_control_tpu.model.builder import ClusterModelBuilder
+
+    b = ClusterModelBuilder()
+    for i in range(6):
+        b.add_broker(i, rack="r0")
+    # broker 0 hosts 30 partitions of 600 MB; capacity threshold 0.8 of
+    # 500k MB -> plenty of room, but disk-distribution bands are tight
+    for p in range(30):
+        b.add_replica("hot", p, 0, is_leader=True,
+                      load=[1.0, 10.0, 10.0, 600.0])
+    for p in range(3):
+        b.add_replica("cold", p, 1 + (p % 5), is_leader=True,
+                      load=[1.0, 10.0, 10.0, 100.0])
+    ct, meta = b.build()
+    env = make_env(ct, meta)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    goal = make_goal("DiskUsageDistributionGoal")
+    st, info = optimize_goal(env, st, goal, (), EngineParams(max_iters=64))
+    util = np.asarray(st.util)[:, 3]
+    alive_utils = util[:6]
+    # cluster balances: no broker outside the band afterwards
+    assert not bool(info["violated_after"])
+    # and the work took FEW passes (the wave drains broker 0 in bulk) —
+    # one-per-broker waves would need ~25 passes for 25+ moves off broker 0
+    assert int(info["passes"]) <= 10, int(info["passes"])
+    assert abs(alive_utils.sum() - (30 * 600.0 + 3 * 100.0)) < 1.0
