@@ -222,7 +222,11 @@ class GoalOptimizer:
             num_candidates=min(2048, max(self._params.num_candidates,
                                          ct.num_brokers // 4)),
             num_leader_candidates=min(1024, max(self._params.num_leader_candidates,
-                                                ct.num_brokers // 8)))
+                                                ct.num_brokers // 8)),
+            # swaps are the stall-breaking last resort: the [K1, K2] pair
+            # scoring is quadratic, so grow the pool sub-linearly
+            num_swap_candidates=min(256, max(self._params.num_swap_candidates,
+                                             ct.num_brokers // 32)))
 
         env = make_env(ct, meta)
         st = init_state(env, ct.replica_broker, ct.replica_is_leader,
