@@ -14,10 +14,14 @@ optimized goals -> mutate) with a vectorized loop:
         4. (leadership variant when the goal moves leadership)
         5. best      = argmax(score); apply if score > 0      scatter update
 
-One iteration = one applied action (replica move or leadership transfer), but
-every candidate x destination pair in the cluster was scored to choose it —
-the per-iteration work is a handful of fused [K, B] kernels regardless of
-cluster size, which is what makes 7k-broker clusters tractable on TPU.
+One iteration = one WAVE of admitted actions: every candidate x destination
+pair is scored once, then budgeted admission (see _wave_admission) applies up
+to K mutually-valid moves — or leadership transfers — in a single batched
+scatter update. Per-broker cumulative budgets let one overloaded broker shed
+dozens of replicas per wave, so pass counts stay near the information-theoretic
+minimum instead of scaling with per-broker excess; the per-pass work is a
+handful of fused [K, B] kernels regardless of cluster size, which is what
+makes 7k-broker clusters tractable on TPU.
 
 Scores are construct-positive gains: each goal defines score as the strict
 decrease of its violation measure, so total violation is monotonically
