@@ -49,7 +49,7 @@ from cruise_control_tpu.analyzer.goals.base import (
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.analyzer.state import (
     EngineState, apply_disk_move, apply_leadership, apply_leaderships_batched,
-    apply_move, apply_moves_batched, apply_swap,
+    apply_move, apply_moves_batched, apply_swap, apply_swaps_batched,
 )
 
 Array = jax.Array
@@ -77,16 +77,25 @@ class EngineParams:
     num_swap_candidates: int = 32     # K1/K2: swap-out / swap-in candidates
     num_dst_choices: int = 16         # T: per-row destination spread (wave width)
     min_gain: float = 1e-9            # scores below this count as no progress
+    # sequential fallback loops are OFF by default: waves + the next pass's
+    # full re-score converge faster than one-at-a-time re-validation (rung-3
+    # A/B: leftovers-off was 36% faster AND satisfied one more goal), and a
+    # zero cap removes the loop from the compiled program entirely
+    max_leftover: int = 0             # cap on sequential leftover re-scores
+    max_seq_swaps: int = 0            # cap on sequential swap applications
 
 
 def _wave_budget_capable(g: GoalKernel, leadership: bool = False) -> bool:
     """Can multi-action waves preserve this goal's acceptance semantics?
-    Yes when it provides cumulative budgets, is covered by the wave's
-    partition/topic first-use rules (wave_safe), or never vetoes the action
-    kind in question (the veto method checked is per action kind — a custom
-    accept_leadership forces the sequential path even if accept_move is the
-    default, and vice versa)."""
+    Yes when it provides cumulative budgets (per-broker or per-(topic,
+    broker)), is covered by the wave's partition first-touch rule
+    (wave_safe), or never vetoes the action kind in question (the veto
+    method checked is per action kind — a custom accept_leadership forces
+    the sequential path even if accept_move is the default, and vice
+    versa)."""
     if (type(g).wave_budgets is not GoalKernel.wave_budgets) or g.wave_safe:
+        return True
+    if type(g).wave_topic_budgets is not GoalKernel.wave_topic_budgets:
         return True
     if leadership:
         return type(g).accept_leadership is GoalKernel.accept_leadership
@@ -96,30 +105,50 @@ def _wave_budget_capable(g: GoalKernel, leadership: bool = False) -> bool:
 def _wave_admission(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                     prev_goals: tuple, d_src: Array, d_dst: Array,
                     src_b: Array, dst_b: Array, wave_ok: Array, topics: Array,
-                    posn: Array, gain_escape: Array | None = None) -> Array:
+                    posn: Array, d_count: Array, d_leader: Array,
+                    gain_escape: Array | None = None) -> Array:
     """bool[K] budgeted wave admission, shared by the move and leadership
     branches. In score order, a row is admitted iff:
-    - its (topic, src) and (topic, dst) pairs are first-use in this wave
-      (keeps per-(topic, broker) count acceptance single-action exact),
+    - its per-(topic, src) / per-(topic, dst) cumulative count delta stays
+      within every chain goal's per-topic slack (wave_topic_budgets; rank-0
+      rows at a pair always pass — their single action was validated against
+      the true state by the acceptance masks themselves),
     - its per-src / per-dst cumulative delta stays within the combined band
-      slack of every chain goal (rank-0 rows always pass — they were
-      validated against the true state by the masks themselves), and
+      slack of every chain goal (same rank-0 rule), and
     - the ACTIVE goal still has useful work left at its endpoints
       (wave_gain_budgets; ``gain_escape`` rows — e.g. offline healing —
       bypass the gain cap).
     ``d_src``/``d_dst`` are the [K, WAVE_DIMS] deltas each row removes from
     its source / adds to its destination (they differ for leadership
-    transfers, where the destination gains the DST replica's loads)."""
+    transfers, where the destination gains the DST replica's loads);
+    ``d_count``/``d_leader`` [K] feed the per-topic budgets."""
     B = env.num_brokers
     K = posn.shape[0]
-    INF = jnp.int32(K + 1)
-    guarded = jnp.where(wave_ok, posn, INF)
     nT = env.topic_excluded.shape[0]
-    ts_key = topics * B + src_b
-    td_key = topics * B + dst_b
-    first_ts = jnp.full(nT * B, INF, jnp.int32).at[ts_key].min(guarded)
-    first_td = jnp.full(nT * B, INF, jnp.int32).at[td_key].min(guarded)
-    topic_ok = (first_ts[ts_key] == posn) & (first_td[td_key] == posn)
+    # per-(topic, broker) cumulative budgets — replaces the former blanket
+    # (topic, broker) first-use rule, which capped waves at ONE move per
+    # topic per broker and collapsed wave yield wherever one topic dominates
+    # a broker's replicas
+    topic_ok = jnp.ones(K, bool)
+    ts_groups = jnp.where(wave_ok, topics * B + src_b, nT * B + posn)
+    td_groups = jnp.where(wave_ok, topics * B + dst_b, nT * B + posn)
+    for g in (goal, *prev_goals):
+        tb = g.wave_topic_budgets(env, st, topics, src_b, dst_b,
+                                  d_count, d_leader)
+        if tb is None:
+            continue
+        delta, s_slack, t_slack = tb
+        delta = jnp.where(wave_ok, delta, 0.0)
+        cum_s, rank_s = _group_cumsum(ts_groups, delta[:, None])
+        cum_d, rank_d = _group_cumsum(td_groups, delta[:, None])
+        # zero-delta rows consume no budget and can never violate the
+        # constraint — admit them unconditionally (a negative-slack pair
+        # would otherwise veto e.g. every follower move / leadership
+        # transfer at exactly the deficient pairs being healed)
+        free = delta == 0
+        topic_ok = (topic_ok
+                    & (free | (rank_s == 0) | (cum_s[:, 0] <= s_slack + 1e-4))
+                    & (free | (rank_d == 0) | (cum_d[:, 0] <= t_slack + 1e-4)))
 
     d_src = jnp.where(wave_ok[:, None], d_src, 0.0)
     d_dst = jnp.where(wave_ok[:, None], d_dst, 0.0)
@@ -286,6 +315,8 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         win = part_ok & _wave_admission(
             env, st, goal, prev_goals, d, d, src_s, dst_s, wave_ok,
             env.replica_topic[r_sorted], posn,
+            d_count=jnp.ones(K, eff.dtype),
+            d_leader=lead_s.astype(eff.dtype),
             gain_escape=st.replica_offline[r_sorted])
     else:
         # legacy conservative wave: each broker participates at most once
@@ -296,32 +327,33 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     st = apply_moves_batched(env, st, r_sorted, dst_s, win)
     n_applied = jnp.sum(win).astype(jnp.int32)
 
-    # ---- stage 3: sequential leftovers, re-scored against the live state.
-    # Only worth running when the wave was THIN (severity concentrated on few
-    # brokers, where waves land ~1 move): a fat wave means the next pass will
-    # re-score everything anyway, so leftovers just wait for it. Leftover
-    # positions are compacted to the front so the loop runs exactly as many
-    # steps as there are leftovers.
-    pos_ok = best_val[order] > params.min_gain
-    leftover = pos_ok & ~win
-    n_lo = jnp.sum(leftover).astype(jnp.int32)
-    lo_order = jnp.argsort(~leftover)            # leftover positions first
+    # ---- stage 3 (opt-in): sequential leftovers, re-scored against the live
+    # state. Only when the wave was THIN (severity concentrated on few
+    # brokers): a fat wave means the next pass re-scores everything anyway.
+    # OFF by default (max_leftover=0): measured slower AND lower-quality than
+    # letting the next pass retry, and omitting the loop shrinks the program.
+    cap = min(K, params.max_leftover)
+    if cap > 0:
+        pos_ok = best_val[order] > params.min_gain
+        leftover = pos_ok & ~win
+        n_lo = jnp.sum(leftover).astype(jnp.int32)
+        lo_order = jnp.argsort(~leftover)        # leftover positions first
 
-    def body(i, carry):
-        st, n = carry
-        r = r_sorted[lo_order[i]]
-        row = _rescore_move_row(env, st, goal, prev_goals, r)
-        d = jnp.argmax(row).astype(jnp.int32)
-        ok = row[d] > params.min_gain
-        st = apply_move(env, st, r, d, enabled=ok)
-        return st, n + ok.astype(jnp.int32)
+        def body(i, carry):
+            st, n = carry
+            r = r_sorted[lo_order[i]]
+            row = _rescore_move_row(env, st, goal, prev_goals, r)
+            d = jnp.argmax(row).astype(jnp.int32)
+            ok = row[d] > params.min_gain
+            st = apply_move(env, st, r, d, enabled=ok)
+            return st, n + ok.astype(jnp.int32)
 
-    # gate via a zero trip count, NOT lax.cond: a cond carrying the full
-    # EngineState defeats XLA's buffer aliasing and copies ~hundreds of MB
-    # per pass at 1M-replica scale; a while-loop with 0 iterations aliases
-    wave_thin = n_applied * 8 < n_pos
-    trip = jnp.where(wave_thin, jnp.minimum(n_lo, K), 0)
-    st, n_applied = jax.lax.fori_loop(0, trip, body, (st, n_applied))
+        # gate via a zero trip count, NOT lax.cond: a cond carrying the full
+        # EngineState defeats XLA's buffer aliasing and copies ~hundreds of
+        # MB per pass at 1M-replica scale; a 0-trip while-loop aliases
+        wave_thin = n_applied * 8 < n_pos
+        trip = jnp.where(wave_thin, jnp.minimum(n_lo, cap), 0)
+        st, n_applied = jax.lax.fori_loop(0, trip, body, (st, n_applied))
     return st, n_applied
 
 
@@ -397,20 +429,24 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
     win = _wave_admission(env, st, goal, prev_goals,
                           leadership_deltas(r_sorted), leadership_deltas(dst_rep),
                           src_b, dst_b, wave_ok,
-                          env.replica_topic[r_sorted], posn)
+                          env.replica_topic[r_sorted], posn,
+                          d_count=jnp.zeros(KL, st.util.dtype),
+                          d_leader=jnp.ones(KL, st.util.dtype))
     st = apply_leaderships_batched(env, st, r_sorted, dst_rep, win)
     n_applied = jnp.sum(win).astype(jnp.int32)
 
     # sequential leftovers when the wave was thin (same rationale as the
-    # move branch); compacted so the loop runs only as long as needed
-    n_pos = jnp.sum(wave_ok).astype(jnp.int32)
-    leftover = wave_ok & ~win
-    n_lo = jnp.sum(leftover).astype(jnp.int32)
-    lo_order = jnp.argsort(~leftover)
-    wave_thin = n_applied * 8 < n_pos
-    trip = jnp.where(wave_thin, jnp.minimum(n_lo, KL), 0)
-    st, n_applied, _ = jax.lax.fori_loop(0, trip, seq_body,
-                                         (st, n_applied, r_sorted[lo_order]))
+    # move branch); OFF by default, see EngineParams.max_leftover
+    cap = min(KL, params.max_leftover)
+    if cap > 0:
+        n_pos = jnp.sum(wave_ok).astype(jnp.int32)
+        leftover = wave_ok & ~win
+        n_lo = jnp.sum(leftover).astype(jnp.int32)
+        lo_order = jnp.argsort(~leftover)
+        wave_thin = n_applied * 8 < n_pos
+        trip = jnp.where(wave_thin, jnp.minimum(n_lo, cap), 0)
+        st, n_applied, _ = jax.lax.fori_loop(
+            0, trip, seq_body, (st, n_applied, r_sorted[lo_order]))
     return st, n_applied
 
 
@@ -427,9 +463,18 @@ def _rescore_swap_pair(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
 def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                          prev_goals: tuple, params: EngineParams, severity: Array):
-    """Swap analogue of _move_branch_batched: one [K1, K2] scoring pass
-    orders candidate pairs, then up to K1 swaps apply per pass, each
-    re-validated as a pair against the running state."""
+    """Swap analogue of _move_branch_batched: one [K1, K2] scoring pass, then
+    a WAVE of independent swaps applies in one batched update. Admission, in
+    score order, pairs each out-candidate with its best counterparty and
+    admits rows whose brokers (either role) and partitions (either side) are
+    first-use in the wave — each admitted swap was validated against the
+    pre-wave state and touches state no other admitted swap reads, so the
+    batch equals some sequential application order. Non-winning positive rows
+    are re-paired by the next pass (or, when ``max_seq_swaps`` > 0 and the
+    wave was thin, re-validated sequentially). This replaces the former
+    one-at-a-time re-scored swap crawl — the rung-4 profile put two thirds of
+    the whole 18-goal chain's wall clock inside that crawl for the two
+    leadership-less distribution goals (NW-in, disk)."""
     k = min(params.num_swap_candidates, env.num_replicas)
     okey = goal.swap_out_key(env, st, severity)
     ikey = goal.swap_in_key(env, st, severity)
@@ -441,22 +486,61 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     score = goal.swap_score(env, st, cand_out, cand_in)
     score = jnp.where(mask & (okv > NEG_INF)[:, None] & (ikv > NEG_INF)[None, :],
                       score, NEG_INF)
-    # order the top-k1 pairs by scored value (flattened)
-    S = score.shape[0]
-    best_flat, flat_idx = jax.lax.top_k(score.reshape(-1), S)
+    K1, K2 = score.shape
 
-    def body(i, carry):
-        st, n_applied = carry
-        oi, ij = jnp.unravel_index(flat_idx[i], score.shape)
-        r_out, r_in = cand_out[oi], cand_in[ij]
-        v = _rescore_swap_pair(env, st, goal, prev_goals, r_out, r_in)
-        ok = (best_flat[i] > params.min_gain) & (v > params.min_gain)
-        st = apply_swap(env, st, r_out, r_in, enabled=ok)
-        return st, n_applied + ok.astype(jnp.int32)
+    best_j = jnp.argmax(score, axis=1).astype(jnp.int32)          # [K1]
+    best_val = score[jnp.arange(K1), best_j]
+    order = jnp.argsort(-best_val)
+    posn = jnp.arange(K1, dtype=jnp.int32)
+    r_out = cand_out[order]
+    j_s = best_j[order]
+    r_in = cand_in[j_s]
+    val_s = best_val[order]
+    wave_ok = val_s > params.min_gain
+    INF = jnp.int32(K1 + 1)
+    guarded = jnp.where(wave_ok, posn, INF)
+    B = env.num_brokers
+    b_out = st.replica_broker[r_out]
+    b_in = st.replica_broker[r_in]
+    # each broker at most once across BOTH roles: every admitted swap's
+    # acceptance (validated pre-wave) stays exact, and (topic, broker)
+    # count-goal vetoes hold trivially
+    first_b = (jnp.full(B, INF, jnp.int32)
+               .at[b_out].min(guarded).at[b_in].min(guarded))
+    ok_b = (first_b[b_out] == posn) & (first_b[b_in] == posn)
+    # each in-candidate claimed by one row
+    first_in = jnp.full(K2, INF, jnp.int32).at[j_s].min(guarded)
+    ok_in = first_in[j_s] == posn
+    # partition first-touch on both sides (rack/sibling exactness)
+    p_out = env.replica_partition[r_out]
+    p_in = env.replica_partition[r_in]
+    first_p = (jnp.full(env.num_partitions, INF, jnp.int32)
+               .at[p_out].min(guarded).at[p_in].min(guarded))
+    ok_p = (first_p[p_out] == posn) & (first_p[p_in] == posn)
+    win = wave_ok & ok_b & ok_in & ok_p
+    st = apply_swaps_batched(env, st, r_out, r_in, win)
+    n_applied = jnp.sum(win).astype(jnp.int32)
 
-    n_pos = jnp.sum(best_flat > params.min_gain).astype(jnp.int32)
-    st, n_applied = jax.lax.fori_loop(0, jnp.minimum(n_pos, S), body,
-                                      (st, jnp.int32(0)))
+    if min(K1, params.max_seq_swaps) > 0:
+        # sequential leftovers (exact pair re-score) when the wave was thin
+        n_pos = jnp.sum(wave_ok).astype(jnp.int32)
+        leftover = wave_ok & ~win
+        n_lo = jnp.sum(leftover).astype(jnp.int32)
+        lo_order = jnp.argsort(~leftover)
+
+        def body(i, carry):
+            st, n = carry
+            idx = lo_order[i]
+            ro, ri = r_out[idx], r_in[idx]
+            v = _rescore_swap_pair(env, st, goal, prev_goals, ro, ri)
+            ok = v > params.min_gain
+            st = apply_swap(env, st, ro, ri, enabled=ok)
+            return st, n + ok.astype(jnp.int32)
+
+        wave_thin = n_applied * 8 < n_pos
+        cap = min(K1, params.max_seq_swaps)
+        trip = jnp.where(wave_thin, jnp.minimum(n_lo, cap), 0)
+        st, n_applied = jax.lax.fori_loop(0, trip, body, (st, n_applied))
     return st, n_applied
 
 

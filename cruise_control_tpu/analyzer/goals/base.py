@@ -115,6 +115,23 @@ class GoalKernel:
         are monotone). Return None when not applicable (see ``wave_safe``)."""
         return None
 
+    def wave_topic_budgets(self, env: ClusterEnv, st: EngineState,
+                           topics: Array, src_b: Array, dst_b: Array,
+                           d_count: Array, d_leader: Array):
+        """Optional ``(delta[K], src_slack[K], dst_slack[K])``: this goal's
+        per-(topic, broker) count constraint in wave form. ``delta`` is what
+        each row subtracts from its (topic, src) pair and adds to its
+        (topic, dst) pair in this goal's counting unit; the slacks are the
+        remaining room at the row's own pairs measured from the pre-wave
+        state (+inf where unconstrained). The engine admits rows while the
+        cumulative per-pair delta stays within slack (rank-0 rows exempt —
+        their single action was validated exactly by the acceptance masks).
+        ``d_count``/``d_leader`` [K] are the wave's replica-count and
+        leader-count deltas per row (moves: 1 / is_leader; leadership
+        transfers: 0 / 1). Return None when the goal has no per-topic
+        constraint."""
+        return None
+
     def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
         """Optional ``(src_gain[B], dst_gain[B], dim)`` for the ACTIVE goal:
         the remaining genuinely-useful shed (src excess above its target) and

@@ -24,9 +24,9 @@ from cruise_control_tpu.analyzer.state import EngineState
 class TopicReplicaDistributionGoal(GoalKernel):
     def __post_init__(self):
         object.__setattr__(self, "name", "TopicReplicaDistributionGoal")
-        # acceptance bands per-(topic, broker) count: the wave's
-        # (topic, src)/(topic, dst) first-use rule keeps it single-move-exact
-        object.__setattr__(self, "wave_safe", True)
+        # swaps are the count-neutral escape when replica-count bands veto
+        # plain moves (TopicReplicaDistributionGoal.java swap rebalancing)
+        object.__setattr__(self, "uses_swaps", True)
 
     def _limits(self, env: ClusterEnv, st: EngineState):
         """(lower[T], upper[T]) per-topic per-broker count limits."""
@@ -125,6 +125,75 @@ class TopicReplicaDistributionGoal(GoalKernel):
         src_ok = ((src_c - 1.0 >= lower) | (src_c > upper))[:, None]
         return dst_ok & src_ok
 
+    # -- swaps: exchange replicas of two topics so both counts improve while
+    # every broker's total replica count is untouched (the count-neutral
+    # escape when ReplicaDistributionGoal's band vetoes plain moves) --
+    def swap_out_key(self, env: ClusterEnv, st: EngineState, severity):
+        t = env.replica_topic
+        b = st.replica_broker
+        lower, upper = self._limits(env, st)
+        over = st.topic_broker_count[t, b].astype(jnp.float32) > upper[t]
+        load = jnp.sum(st.effective_load(env), axis=1)
+        ok = env.replica_valid & over & ~st.replica_offline
+        return jnp.where(ok, -load, NEG_INF)
+
+    def swap_in_key(self, env: ClusterEnv, st: EngineState, severity):
+        t = env.replica_topic
+        b = st.replica_broker
+        lower, _upper = self._limits(env, st)
+        can_leave = (st.topic_broker_count[t, b].astype(jnp.float32) - 1.0
+                     >= lower[t])
+        load = jnp.sum(st.effective_load(env), axis=1)
+        ok = env.replica_valid & can_leave & ~st.replica_offline
+        return jnp.where(ok, -load, NEG_INF)
+
+    def swap_score(self, env: ClusterEnv, st: EngineState, cand_out, cand_in):
+        to = env.replica_topic[cand_out]                      # [K1]
+        ti = env.replica_topic[cand_in]                       # [K2]
+        bo = st.replica_broker[cand_out]
+        bi = st.replica_broker[cand_in]
+        lower, upper = self._limits(env, st)
+        c = st.topic_broker_count.astype(jnp.float32)
+
+        def viol(cc, lo, up):
+            return jnp.maximum(cc - up, 0.0) + jnp.maximum(lo - cc, 0.0)
+
+        # out-replica's topic: (to, bo) loses one, (to, bi) gains one
+        lo_o, up_o = lower[to][:, None], upper[to][:, None]
+        c_oo = c[to, bo][:, None]                             # [K1, 1]
+        c_oi = c[to[:, None], bi[None, :]]                    # [K1, K2]
+        g_out = (viol(c_oo, lo_o, up_o) - viol(c_oo - 1.0, lo_o, up_o)
+                 + viol(c_oi, lo_o, up_o) - viol(c_oi + 1.0, lo_o, up_o))
+        new_viol_out = ((viol(c_oo - 1.0, lo_o, up_o) > viol(c_oo, lo_o, up_o))
+                        | (viol(c_oi + 1.0, lo_o, up_o) > viol(c_oi, lo_o, up_o)))
+        # in-replica's topic: (ti, bi) loses one, (ti, bo) gains one
+        lo_i, up_i = lower[ti][None, :], upper[ti][None, :]
+        c_ii = c[ti, bi][None, :]                             # [1, K2]
+        c_io = c[ti[None, :], bo[:, None]]                    # [K1, K2]
+        g_in = (viol(c_ii, lo_i, up_i) - viol(c_ii - 1.0, lo_i, up_i)
+                + viol(c_io, lo_i, up_i) - viol(c_io + 1.0, lo_i, up_i))
+        new_viol_in = ((viol(c_ii - 1.0, lo_i, up_i) > viol(c_ii, lo_i, up_i))
+                       | (viol(c_io + 1.0, lo_i, up_i) > viol(c_io, lo_i, up_i)))
+        same_topic = to[:, None] == ti[None, :]
+        gain = g_out + g_in
+        feasible = ~new_viol_out & ~new_viol_in & ~same_topic
+        # discount vs moves so a tie prefers the cheaper action
+        return jnp.where(feasible & (gain > 0), gain * 0.95, NEG_INF)
+
+    def wave_topic_budgets(self, env: ClusterEnv, st: EngineState, topics,
+                           src_b, dst_b, d_count, d_leader):
+        """Cumulative form of accept_move's per-(topic, broker) band: a wave
+        may shed a pair down to the topic's lower limit and fill one up to
+        its upper limit (topic totals are move-invariant, so the pre-wave
+        limits hold throughout the wave)."""
+        n_alive = jnp.maximum(jnp.sum(env.broker_alive), 1).astype(jnp.float32)
+        topic_total = jnp.sum(st.topic_broker_count, axis=1)        # [T]
+        avg = topic_total[topics].astype(jnp.float32) / n_alive     # [K]
+        lower, upper = self._limits_from_avg(avg)
+        c_src = st.topic_broker_count[topics, src_b].astype(jnp.float32)
+        c_dst = st.topic_broker_count[topics, dst_b].astype(jnp.float32)
+        return d_count, c_src - lower, upper - c_dst
+
 
 @dataclasses.dataclass(frozen=True)
 class MinTopicLeadersPerBrokerGoal(GoalKernel):
@@ -135,7 +204,6 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
         object.__setattr__(self, "name", "MinTopicLeadersPerBrokerGoal")
         object.__setattr__(self, "is_hard", True)
         object.__setattr__(self, "uses_leadership_moves", True)
-        object.__setattr__(self, "wave_safe", True)   # per-(topic, src) count
 
     def _min(self) -> int:
         return self.constraint.min_topic_leaders_per_broker
@@ -223,3 +291,14 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
         guarded = env.topic_min_leaders[t] & self._eligible(env)[src]
         src_ok = (c_ts - 1.0 >= float(self._min())) | ~guarded
         return jnp.broadcast_to(src_ok[:, None], (cand.shape[0], env.max_rf))
+
+    def wave_topic_budgets(self, env: ClusterEnv, st: EngineState, topics,
+                           src_b, dst_b, d_count, d_leader):
+        """Cumulative form of the leader-minimum veto: a wave may drain
+        leaders of a guarded (topic, src) pair down to the minimum; gaining
+        leaders never violates a minimum (dst unconstrained)."""
+        c_ts = st.topic_leader_count[topics, src_b].astype(jnp.float32)
+        guarded = env.topic_min_leaders[topics] & self._eligible(env)[src_b]
+        src_slack = jnp.where(guarded, c_ts - float(self._min()), jnp.inf)
+        dst_slack = jnp.full_like(src_slack, jnp.inf)
+        return d_leader, src_slack, dst_slack

@@ -52,10 +52,11 @@ class GoalResult:
     name: str
     violated_before: bool
     violated_after: bool
-    iterations: int
+    iterations: int               # actions applied
     duration_s: float
     stat_after: float
     hit_max_iters: bool = False   # iteration budget exhausted while progressing
+    passes: int = 0               # engine while_loop trips (scoring passes)
 
 
 @dataclasses.dataclass
@@ -274,6 +275,7 @@ class GoalOptimizer:
                 duration_s=dur,
                 stat_after=float(info["stat"]),
                 hit_max_iters=bool(info.get("hit_max_iters", False)),
+                passes=int(info.get("passes", 0)),
             )
             for g, info, dur in zip(goals, infos, durations)
         ]
@@ -293,8 +295,8 @@ class GoalOptimizer:
         proposals = diff_proposals(env, meta, initial_broker, initial_leader,
                                    initial_disk, st,
                                    final=(final_broker, final_leader, final_disk))
-        n_moves = sum(len(p.replicas_to_add) for p in proposals)
-        n_lead = sum(1 for p in proposals if p.has_leader_action)
+        n_moves = proposals.num_replica_additions
+        n_lead = proposals.num_leadership_changes
         data_mb = float(disk_load[moved_mask].sum())
 
         viol_after = {g.name: g.violated_after for g in goal_results}

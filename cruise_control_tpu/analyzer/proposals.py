@@ -4,10 +4,18 @@ Reference: analyzer/AnalyzerUtils.getDiff (initial replica/leader distribution
 vs the optimized ClusterModel -> Set<ExecutionProposal>) and
 executor/ExecutionProposal.java (tp, old/new leader, old/new replica
 (broker, logdir) lists).
+
+The diff itself is pure numpy over the dense assignment arrays; the per-
+partition ``ExecutionProposal`` objects are materialized LAZILY by
+``ProposalSet`` — at 7k-broker scale an optimization can change >100k
+partitions, and building 100k Python dataclasses eagerly costs seconds of
+host time inside the proposal-computation window (the aggregate counts the
+optimizer needs are computed vectorized instead).
 """
 from __future__ import annotations
 
 import dataclasses
+from collections.abc import Sequence
 
 import jax
 import numpy as np
@@ -61,14 +69,70 @@ class ExecutionProposal:
         }
 
 
+class ProposalSet(Sequence):
+    """Lazy sequence of ExecutionProposals over vectorized diff arrays.
+
+    Aggregates the optimizer needs (replica-addition count, leadership-change
+    count) are precomputed with numpy — iterating materializes objects one at
+    a time, so callers that only need ``len`` or the counts never pay for
+    object construction. Indexing/iteration yields real ``ExecutionProposal``
+    instances, keeping the executor/tests/JSON paths unchanged.
+    """
+
+    def __init__(self, meta: ClusterMeta, part_idx: np.ndarray,
+                 members: np.ndarray, valid_m: np.ndarray,
+                 old_broker_ext: np.ndarray, new_broker_ext: np.ndarray,
+                 old_disk: np.ndarray, new_disk: np.ndarray,
+                 old_leader_ext: np.ndarray, new_leader_ext: np.ndarray,
+                 num_additions: int):
+        self._meta = meta
+        self._part_idx = part_idx            # i64[Pc] internal partition index
+        self._members = members              # i32[Pc, F] replica ids (-1 pad)
+        self._valid = valid_m                # bool[Pc, F]
+        self._old_b = old_broker_ext         # i64[Pc, F] external broker ids
+        self._new_b = new_broker_ext
+        self._old_d = old_disk               # i32[Pc, F]
+        self._new_d = new_disk
+        self._old_leader = old_leader_ext    # i64[Pc]
+        self._new_leader = new_leader_ext
+        self.num_replica_additions = int(num_additions)
+        self.num_leadership_changes = int((old_leader_ext != new_leader_ext).sum())
+
+    def __len__(self) -> int:
+        return len(self._part_idx)
+
+    def _make(self, i: int) -> ExecutionProposal:
+        v = self._valid[i]
+        topic, partition = self._meta.partition_ids[int(self._part_idx[i])]
+        old_replicas = tuple(zip(self._old_b[i][v].tolist(),
+                                 self._old_d[i][v].tolist()))
+        new_replicas = tuple(zip(self._new_b[i][v].tolist(),
+                                 self._new_d[i][v].tolist()))
+        return ExecutionProposal(
+            topic=topic, partition=int(partition),
+            old_leader=int(self._old_leader[i]),
+            new_leader=int(self._new_leader[i]),
+            old_replicas=old_replicas, new_replicas=new_replicas)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self._make(j) for j in range(*i.indices(len(self)))]
+        if i < 0:
+            i += len(self)
+        if not 0 <= i < len(self):
+            raise IndexError(i)
+        return self._make(i)
+
+
 def diff_proposals(env: ClusterEnv, meta: ClusterMeta,
                    initial_broker: np.ndarray, initial_leader: np.ndarray,
                    initial_disk: np.ndarray, st: EngineState,
-                   final: tuple | None = None) -> list[ExecutionProposal]:
+                   final: tuple | None = None) -> ProposalSet:
     """Compare assignments and emit one proposal per changed partition.
 
     ``final`` lets the caller pass already-fetched (broker, leader, disk) host
-    arrays to avoid extra device round-trips.
+    arrays to avoid extra device round-trips. Entirely vectorized: no Python
+    loop over partitions (AnalyzerUtils.getDiff role at 1M-replica scale).
     """
     if final is not None:
         final_broker, final_leader, final_disk = (np.asarray(a) for a in final)
@@ -80,27 +144,40 @@ def diff_proposals(env: ClusterEnv, meta: ClusterMeta,
     initial_disk = np.asarray(initial_disk)
     members_table, valid, part_of = jax.device_get(
         (env.partition_replicas, env.replica_valid, env.replica_partition))
+    members_table = np.asarray(members_table)
+    valid = np.asarray(valid)
+    part_of = np.asarray(part_of)
     broker_ids = np.asarray(meta.broker_ids)
 
     changed_r = (final_broker != initial_broker) | (final_leader != initial_leader) \
         | (final_disk != initial_disk)
     changed_parts = np.unique(part_of[changed_r & valid])
 
-    proposals: list[ExecutionProposal] = []
-    for p in changed_parts.tolist():
-        members = members_table[p]
-        members = members[members >= 0]
-        topic, partition = meta.partition_ids[p]
-        old_replicas = tuple((int(broker_ids[initial_broker[m]]), int(initial_disk[m]))
-                             for m in members)
-        new_replicas = tuple((int(broker_ids[final_broker[m]]), int(final_disk[m]))
-                             for m in members)
-        old_lead = [m for m in members if initial_leader[m]]
-        new_lead = [m for m in members if final_leader[m]]
-        old_leader = int(broker_ids[initial_broker[old_lead[0]]]) if old_lead else -1
-        new_leader = int(broker_ids[final_broker[new_lead[0]]]) if new_lead else -1
-        proposals.append(ExecutionProposal(
-            topic=topic, partition=int(partition),
-            old_leader=old_leader, new_leader=new_leader,
-            old_replicas=old_replicas, new_replicas=new_replicas))
-    return proposals
+    members = members_table[changed_parts]              # [Pc, F], -1 padded
+    valid_m = members >= 0
+    m = np.where(valid_m, members, 0)
+    ib, fb = initial_broker[m], final_broker[m]         # internal ids [Pc, F]
+    old_b_ext = np.where(valid_m, broker_ids[ib], -1)
+    new_b_ext = np.where(valid_m, broker_ids[fb], -1)
+    old_d = np.where(valid_m, initial_disk[m], 0).astype(np.int32)
+    new_d = np.where(valid_m, final_disk[m], 0).astype(np.int32)
+
+    # leadership: the member flagged leader, -1 if none (matches the old
+    # behavior of taking the first flagged member)
+    def leader_ext(leader_flags, brokers_ext):
+        flags = np.where(valid_m, leader_flags[m], False)
+        has = flags.any(axis=1)
+        first = np.argmax(flags, axis=1)
+        return np.where(has, brokers_ext[np.arange(len(first)), first], -1)
+
+    old_leader = leader_ext(initial_leader, old_b_ext)
+    new_leader = leader_ext(final_leader, new_b_ext)
+
+    # replica additions: members whose new broker hosts no OLD copy of the
+    # partition (replicas_to_add semantics), vectorized [Pc, F, F]
+    in_old = (new_b_ext[:, :, None] == old_b_ext[:, None, :]).any(axis=2)
+    num_additions = int((valid_m & ~in_old).sum())
+
+    return ProposalSet(meta, changed_parts, members, valid_m,
+                       old_b_ext, new_b_ext, old_d, new_d,
+                       old_leader, new_leader, num_additions)

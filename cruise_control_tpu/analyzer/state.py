@@ -243,7 +243,14 @@ def apply_moves_batched(env: ClusterEnv, st: EngineState, replicas: Array,
     intra-broker goals run their own single-broker branch).
 
     This is the engine's bulk path: one wave lands ~K moves for ~15 vector
-    ops instead of K sequential re-score iterations."""
+    ops instead of K sequential re-score iterations.
+
+    Duplicate-safe for MASKED rows: all .add scatters carry zero deltas for
+    them, and the .set scatters route masked rows to an out-of-bounds index
+    (XLA drops OOB scatter updates) — top-k padding may alias a masked row
+    onto an enabled row's replica (e.g. the swap wave's counterparty list),
+    and a masked stale-value write racing an enabled write would otherwise
+    corrupt the assignment. ENABLED rows must still be unique."""
     is_leader = st.replica_is_leader[replicas]
     src = st.replica_broker[replicas]
     load = jnp.where(is_leader[:, None], env.leader_load[replicas],
@@ -273,19 +280,18 @@ def apply_moves_batched(env: ClusterEnv, st: EngineState, replicas: Array,
     dl = load[:, Resource.DISK]
     du = (st.disk_util.at[src, st.replica_disk[replicas]].add(-dl)
                       .at[dsts, dst_disk].add(dl))
-    new_broker = jnp.where(mask, jnp.asarray(dsts, jnp.int32),
-                           st.replica_broker[replicas])
-    new_disk = jnp.where(mask, dst_disk, st.replica_disk[replicas])
+    R = st.replica_broker.shape[0]
+    widx = jnp.where(mask, replicas, R)      # masked rows -> dropped OOB write
     return dataclasses.replace(
         st,
-        replica_broker=st.replica_broker.at[replicas].set(new_broker),
-        replica_disk=st.replica_disk.at[replicas].set(new_disk),
-        replica_offline=st.replica_offline.at[replicas].set(
-            st.replica_offline[replicas] & ~mask),
+        replica_broker=st.replica_broker.at[widx].set(
+            jnp.asarray(dsts, jnp.int32)),
+        replica_disk=st.replica_disk.at[widx].set(dst_disk),
+        replica_offline=st.replica_offline.at[widx].set(False),
         util=util, leader_util=leader_util, potential_nw_out=pot,
         replica_count=rc, leader_count=lc, part_rack_count=prc,
         topic_broker_count=tbc, topic_leader_count=tlc, disk_util=du,
-        moved=st.moved.at[replicas].set(st.moved[replicas] | mask),
+        moved=st.moved.at[widx].set(True),
     )
 
 
@@ -324,6 +330,19 @@ def apply_swap(env: ClusterEnv, st: EngineState, replica_a: Array,
     b_b = st.replica_broker[replica_b]
     st = apply_move(env, st, replica_a, b_b, enabled)
     return apply_move(env, st, replica_b, b_a, enabled)
+
+
+def apply_swaps_batched(env: ClusterEnv, st: EngineState, r_out: Array,
+                        r_in: Array, mask: Array) -> EngineState:
+    """Apply a WAVE of swaps (``r_out[W]`` <-> ``r_in[W]`` where ``mask[W]``)
+    as two batched move waves. The engine's swap admission guarantees wave
+    rows touch disjoint brokers AND disjoint partitions, so the two replica
+    sets are disjoint and each leg's source brokers are unchanged by the
+    other leg (rebalanceBySwappingLoadOut batched equivalent)."""
+    b_out = st.replica_broker[r_out]
+    b_in = st.replica_broker[r_in]
+    st = apply_moves_batched(env, st, r_out, b_in, mask)
+    return apply_moves_batched(env, st, r_in, b_out, mask)
 
 
 def no_op_move(st: EngineState) -> EngineState:

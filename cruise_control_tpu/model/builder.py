@@ -131,28 +131,11 @@ class ClusterModelBuilder:
         return self
 
     # ---- assembly ----
-    def build(self, pad_replicas_to: int | None = None) -> tuple[ClusterTensor, ClusterMeta]:
-        if not self._brokers:
-            raise ValueError("no brokers")
-        broker_ids = sorted(self._brokers)
-        bidx = {b: i for i, b in enumerate(broker_ids)}
-        racks = sorted({s.rack for s in self._brokers.values()})
-        ridx = {r: i for i, r in enumerate(racks)}
-        topics = sorted({r.topic for r in self._replicas} | self._excluded_topics)
-        tidx = {t: i for i, t in enumerate(topics)}
-        partitions = sorted({(r.topic, r.partition) for r in self._replicas})
-        pidx = {tp: i for i, tp in enumerate(partitions)}
-
+    def _broker_arrays(self, broker_ids: list, ridx: dict):
+        """Dense broker topology arrays shared by both assembly paths."""
         B = len(broker_ids)
-        R_valid = len(self._replicas)
-        R = pad_replicas_to or max(R_valid, 1)
-        if R < R_valid:
-            raise ValueError(f"pad_replicas_to={R} < {R_valid} replicas")
-        T = max(len(topics), 1)
-        P = max(len(partitions), 1)
         D = max(len(s.logdirs) for s in self._brokers.values())
         M = NUM_RESOURCES
-
         specs = self._brokers
         broker_capacity = np.zeros((B, M), np.float32)
         broker_rack = np.zeros(B, np.int32)
@@ -164,8 +147,7 @@ class ClusterModelBuilder:
         broker_disk_capacity = np.zeros((B, D), np.float32)
         broker_disk_alive = np.zeros((B, D), bool)
         logdirs_per_broker: list[list[str]] = []
-        for b_id in broker_ids:
-            i = bidx[b_id]
+        for i, b_id in enumerate(broker_ids):
             s = specs[b_id]
             for res in Resource:
                 broker_capacity[i, res] = s.capacity[res]
@@ -179,6 +161,131 @@ class ClusterModelBuilder:
                 broker_disk_capacity[i, d] = s.disk_capacity[d]
                 broker_disk_alive[i, d] = s.alive and (ld not in s.dead_disks)
             logdirs_per_broker.append(list(s.logdirs))
+        return (broker_capacity, broker_rack, broker_alive, broker_new,
+                broker_demoted, broker_excl_move, broker_excl_lead,
+                broker_disk_capacity, broker_disk_alive, logdirs_per_broker)
+
+    def build_from_arrays(self, topics: list, partitions: list,
+                          replica_partition: np.ndarray,
+                          replica_broker: np.ndarray,
+                          replica_disk: np.ndarray,
+                          replica_is_leader: np.ndarray,
+                          replica_offline: np.ndarray,
+                          leader_load: np.ndarray, follower_load: np.ndarray,
+                          pad_replicas_to: int | None = None
+                          ) -> tuple[ClusterTensor, ClusterMeta]:
+        """Vectorized assembly: topology from prior ``add_broker`` calls,
+        replica population directly from dense arrays — the monitor's fast
+        path (no per-replica Python objects at 500k-partition scale;
+        LoadMonitor.java:575-580 role).
+
+        ``replica_partition`` indexes into ``partitions`` (list of
+        (topic, partition) IN the order the arrays were built against);
+        ``replica_broker`` is an INDEX into sorted broker ids;
+        ``replica_disk`` an index into that broker's logdir list.
+        """
+        if not self._brokers:
+            raise ValueError("no brokers")
+        broker_ids = sorted(self._brokers)
+        racks = sorted({s.rack for s in self._brokers.values()})
+        ridx = {r: i for i, r in enumerate(racks)}
+        topics = sorted(set(topics) | self._excluded_topics)
+        tidx = {t: i for i, t in enumerate(topics)}
+
+        (broker_capacity, broker_rack, broker_alive, broker_new,
+         broker_demoted, broker_excl_move, broker_excl_lead,
+         broker_disk_capacity, broker_disk_alive,
+         logdirs_per_broker) = self._broker_arrays(broker_ids, ridx)
+
+        R_valid = int(replica_partition.shape[0])
+        R = pad_replicas_to or max(R_valid, 1)
+        if R < R_valid:
+            raise ValueError(f"pad_replicas_to={R} < {R_valid} replicas")
+        P = max(len(partitions), 1)
+        T = max(len(topics), 1)
+
+        # two-leaders sanity (ClusterModel leader bookkeeping invariant)
+        leaders_per_part = np.bincount(
+            replica_partition[replica_is_leader.astype(bool)], minlength=P)
+        if (leaders_per_part > 1).any():
+            bad = int(np.argmax(leaders_per_part > 1))
+            raise ValueError(f"two leaders for {partitions[bad]}")
+
+        if partitions:
+            partition_topic = np.fromiter(
+                (tidx[t] for t, _ in partitions), dtype=np.int32,
+                count=len(partitions))
+        else:
+            partition_topic = np.zeros(P, np.int32)
+        topic_excluded = np.zeros(T, bool)
+        for t in self._excluded_topics:
+            topic_excluded[tidx[t]] = True
+
+        def pad(a, dtype, fill=0):
+            out = np.full((R,) + a.shape[1:], fill, dtype)
+            out[:R_valid] = a
+            return out
+
+        replica_valid = np.zeros(R, bool)
+        replica_valid[:R_valid] = True
+        rb = pad(replica_broker.astype(np.int32), np.int32)
+        ct = ClusterTensor(
+            replica_broker=jnp.asarray(rb),
+            replica_disk=jnp.asarray(pad(replica_disk.astype(np.int32), np.int32)),
+            replica_partition=jnp.asarray(
+                pad(replica_partition.astype(np.int32), np.int32)),
+            replica_topic=jnp.asarray(
+                pad(partition_topic[replica_partition].astype(np.int32), np.int32)),
+            replica_is_leader=jnp.asarray(pad(replica_is_leader.astype(bool), bool)),
+            replica_valid=jnp.asarray(replica_valid),
+            replica_offline=jnp.asarray(pad(replica_offline.astype(bool), bool)),
+            replica_original_broker=jnp.asarray(rb.copy()),
+            leader_load=jnp.asarray(pad(leader_load.astype(np.float32), np.float32)),
+            follower_load=jnp.asarray(
+                pad(follower_load.astype(np.float32), np.float32)),
+            broker_capacity=jnp.asarray(broker_capacity),
+            broker_rack=jnp.asarray(broker_rack),
+            broker_alive=jnp.asarray(broker_alive),
+            broker_new=jnp.asarray(broker_new),
+            broker_demoted=jnp.asarray(broker_demoted),
+            broker_excluded_for_replica_move=jnp.asarray(broker_excl_move),
+            broker_excluded_for_leadership=jnp.asarray(broker_excl_lead),
+            broker_disk_capacity=jnp.asarray(broker_disk_capacity),
+            broker_disk_alive=jnp.asarray(broker_disk_alive),
+            topic_excluded=jnp.asarray(topic_excluded),
+            partition_topic=jnp.asarray(partition_topic),
+        )
+        meta = ClusterMeta(topic_names=topics, partition_ids=list(partitions),
+                           broker_ids=broker_ids, rack_ids=racks,
+                           logdirs=logdirs_per_broker, num_racks=len(racks),
+                           num_valid_replicas=R_valid)
+        return ct, meta
+
+    def build(self, pad_replicas_to: int | None = None) -> tuple[ClusterTensor, ClusterMeta]:
+        if not self._brokers:
+            raise ValueError("no brokers")
+        broker_ids = sorted(self._brokers)
+        bidx = {b: i for i, b in enumerate(broker_ids)}
+        racks = sorted({s.rack for s in self._brokers.values()})
+        ridx = {r: i for i, r in enumerate(racks)}
+        topics = sorted({r.topic for r in self._replicas} | self._excluded_topics)
+        tidx = {t: i for i, t in enumerate(topics)}
+        partitions = sorted({(r.topic, r.partition) for r in self._replicas})
+        pidx = {tp: i for i, tp in enumerate(partitions)}
+
+        R_valid = len(self._replicas)
+        R = pad_replicas_to or max(R_valid, 1)
+        if R < R_valid:
+            raise ValueError(f"pad_replicas_to={R} < {R_valid} replicas")
+        T = max(len(topics), 1)
+        P = max(len(partitions), 1)
+        M = NUM_RESOURCES
+
+        specs = self._brokers
+        (broker_capacity, broker_rack, broker_alive, broker_new,
+         broker_demoted, broker_excl_move, broker_excl_lead,
+         broker_disk_capacity, broker_disk_alive,
+         logdirs_per_broker) = self._broker_arrays(broker_ids, ridx)
 
         replica_broker = np.zeros(R, np.int32)
         replica_disk = np.zeros(R, np.int32)

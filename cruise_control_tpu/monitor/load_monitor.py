@@ -328,6 +328,8 @@ class LoadMonitor:
             logdir_state = self._backend.describe_logdirs()
 
             builder = ClusterModelBuilder()
+            lds_by_broker: dict = {}     # broker id -> ordered logdir names
+            dead_by_broker: dict = {}    # broker id -> set of dead names
             for b, node in brokers.items():
                 cap_info = self._capacity.capacity_for(b)
                 if cap_info.estimated and not allow_capacity_estimation:
@@ -352,6 +354,8 @@ class LoadMonitor:
                     disk_caps = [per] * len(logdirs)
                 dead = set(node.dead_logdirs)
                 dead |= {ld for ld, ok in logdir_state.get(b, {}).items() if not ok}
+                lds_by_broker[b] = logdirs
+                dead_by_broker[b] = dead
                 builder.add_broker(
                     b, rack=node.rack, alive=node.alive,
                     capacity={Resource.CPU: cap_info.capacity[Resource.CPU],
@@ -360,8 +364,12 @@ class LoadMonitor:
                               Resource.NW_OUT: cap_info.capacity[Resource.NW_OUT]},
                     logdirs=logdirs, disk_capacity=disk_caps, dead_disks=dead)
 
-            # window-reduce per partition: AVG for CPU/NW, LATEST for DISK
-            # experimental LR CPU model (use.linear.regression.model +
+            # window-reduce AVG for CPU/NW, LATEST for DISK — vectorized over
+            # every entity at once: the former per-partition Python loop was
+            # minutes of host time at 500k partitions, this is one masked
+            # mean over [E, W, M] (LoadMonitor.java:539-591 +
+            # cluster-model-creation-timer LoadMonitor.java:173 role).
+            # Experimental LR CPU model (use.linear.regression.model +
             # LinearRegressionModelParameters role): when trained + enabled,
             # leader CPU comes from the fitted cpu ~ a*bytes_in + b*bytes_out
             use_lr = (self._config is not None
@@ -372,52 +380,114 @@ class LoadMonitor:
             id_din = mdef.info("DISK_USAGE").metric_id
             id_lin = mdef.info("LEADER_BYTES_IN").metric_id
             id_lout = mdef.info("LEADER_BYTES_OUT").metric_id
-            row_of = {e: i for i, e in enumerate(agg.entities)}
             from cruise_control_tpu.monitor.aggregator.sample_aggregator import (
                 Extrapolation,
             )
-            for tp, info in partitions.items():
-                row = row_of.get(tp)
-                if row is None:
-                    cpu = disk = lin = lout = 0.0
-                else:
-                    vals = agg.values[row]            # [W, M]
-                    # zero-filled NO_VALID_EXTRAPOLATION windows would dilute
-                    # the mean (and LATEST could read a hole): reduce over
-                    # valid windows only (RawMetricValues.isValid :166 role)
-                    wmask = (agg.extrapolations[row]
-                             != Extrapolation.NO_VALID_EXTRAPOLATION)
-                    if not wmask.any():
-                        cpu = disk = lin = lout = 0.0
-                    else:
-                        v = vals[wmask]
-                        cpu = float(v[:, id_cpu].mean())
-                        lin = float(v[:, id_lin].mean())
-                        lout = float(v[:, id_lout].mean())
-                        disk = float(v[-1, id_din])   # LATEST valid window
-                        if use_lr:
-                            cpu = max(0.0, float(
-                                self.lr_cpu_model.predict(lin, lout)))
-                leader_load = np.zeros(4)
-                leader_load[Resource.CPU] = cpu
-                leader_load[Resource.NW_IN] = lin
-                leader_load[Resource.NW_OUT] = lout
-                leader_load[Resource.DISK] = disk
-                follower_cpu = float(estimate_follower_cpu_util(
-                    cpu, lin, lout, self._cpu_params))
-                follower_load = leader_load.copy()
-                follower_load[Resource.CPU] = follower_cpu
-                follower_load[Resource.NW_OUT] = 0.0
-                for b in info.replicas:
-                    node = brokers[b]
-                    logdir = info.logdir_by_broker.get(b)
-                    offline = (not node.alive) or (logdir in node.dead_logdirs)
-                    builder.add_replica(
-                        info.topic, info.partition, b,
-                        is_leader=(b == info.leader),
-                        leader_load=leader_load, follower_load=follower_load,
-                        logdir=logdir, offline=offline)
-            return builder.build()
+            # zero-filled NO_VALID_EXTRAPOLATION windows would dilute the
+            # mean (and LATEST could read a hole): reduce over valid windows
+            # only (RawMetricValues.isValid :166 role)
+            E = len(agg.entities)
+            W = agg.values.shape[1] if E else 0
+            wmask = agg.extrapolations != Extrapolation.NO_VALID_EXTRAPOLATION
+            any_valid = wmask.any(axis=1) if E else np.zeros(0, bool)
+            nvalid = np.maximum(wmask.sum(axis=1), 1) if E else np.zeros(0)
+            if E:
+                mean = ((agg.values * wmask[:, :, None]).sum(axis=1)
+                        / nvalid[:, None])
+                last = W - 1 - np.argmax(wmask[:, ::-1], axis=1)
+                disk_e = agg.values[np.arange(E), last, id_din]
+                cpu_e = np.where(any_valid, mean[:, id_cpu], 0.0)
+                lin_e = np.where(any_valid, mean[:, id_lin], 0.0)
+                lout_e = np.where(any_valid, mean[:, id_lout], 0.0)
+                disk_e = np.where(any_valid, disk_e, 0.0)
+                if use_lr:
+                    cpu_e = np.where(
+                        any_valid,
+                        np.maximum(0.0, self.lr_cpu_model.predict(lin_e, lout_e)),
+                        0.0)
+            else:
+                cpu_e = lin_e = lout_e = disk_e = np.zeros(0)
+
+            # map entity rows -> the (sorted) partition list, then flatten the
+            # per-partition replica lists into dense arrays
+            tps = sorted(partitions)
+            infos = [partitions[tp] for tp in tps]
+            P = len(tps)
+            row_of = {e: i for i, e in enumerate(agg.entities)}
+            rows = np.fromiter((row_of.get(tp, -1) for tp in tps),
+                               dtype=np.int64, count=P)
+            has = rows >= 0
+            rr = np.clip(rows, 0, None)
+
+            def per_part(x):
+                return np.where(has, x[rr], 0.0) if E else np.zeros(P)
+
+            cpu_p, lin_p, lout_p, disk_p = (per_part(x) for x in
+                                            (cpu_e, lin_e, lout_e, disk_e))
+            fcpu_p = estimate_follower_cpu_util(cpu_p, lin_p, lout_p,
+                                                self._cpu_params)
+
+            broker_ids = sorted(brokers)
+            sorted_bids = np.asarray(broker_ids, dtype=np.int64)
+            alive_b = np.asarray([brokers[b].alive for b in broker_ids])
+            # (broker id, logdir name) -> logdir index; dead flagged per
+            # index — reusing the names/dead sets the add_broker loop derived
+            # so replica offline marking can't diverge from broker_disk_alive
+            dixmap: dict = {}
+            Dmax = max((len(lds_by_broker[b]) for b in broker_ids), default=1)
+            dead_arr = np.zeros((len(broker_ids), Dmax), bool)
+            for bi, b in enumerate(broker_ids):
+                lds = lds_by_broker[b]
+                dead = dead_by_broker[b]
+                for d, ld in enumerate(lds):
+                    dixmap[(b, ld)] = d
+                    dead_arr[bi, d] = ld in dead
+
+            nrep = np.fromiter((len(i.replicas) for i in infos),
+                               dtype=np.int64, count=P)
+            rep_part = np.repeat(np.arange(P, dtype=np.int64), nrep)
+            rep_bid = np.fromiter((b for i in infos for b in i.replicas),
+                                  dtype=np.int64, count=int(nrep.sum()))
+            rep_leader = np.fromiter(
+                (b == i.leader for i in infos for b in i.replicas),
+                dtype=bool, count=int(nrep.sum()))
+            # logdir index per replica; unknown/unassigned dirs default to
+            # index 0 INCLUDING its deadness (a replica whose logdir we can't
+            # resolve on a broker whose first dir is dead must stay
+            # self-healing-eligible)
+            rep_disk = np.fromiter(
+                (dixmap.get((b, i.logdir_by_broker.get(b)), 0)
+                 for i in infos for b in i.replicas),
+                dtype=np.int64, count=int(nrep.sum()))
+            rep_bidx = np.searchsorted(sorted_bids, rep_bid)
+            # a replica on a broker id absent from brokers() is metadata
+            # corruption — fail loudly (the pre-vectorized path's KeyError)
+            rep_bidx = np.clip(rep_bidx, 0, len(broker_ids) - 1)
+            bad = sorted_bids[rep_bidx] != rep_bid
+            if bad.any():
+                raise KeyError(
+                    f"replica assigned to unknown broker id(s) "
+                    f"{sorted(set(rep_bid[bad].tolist()))[:5]}")
+            rep_offline = (~alive_b[rep_bidx]) | dead_arr[rep_bidx, rep_disk]
+
+            Rv = rep_part.shape[0]
+            M = len(Resource)
+            leader_load = np.zeros((Rv, M), np.float32)
+            leader_load[:, Resource.CPU] = cpu_p[rep_part]
+            leader_load[:, Resource.NW_IN] = lin_p[rep_part]
+            leader_load[:, Resource.NW_OUT] = lout_p[rep_part]
+            leader_load[:, Resource.DISK] = disk_p[rep_part]
+            follower_load = leader_load.copy()
+            follower_load[:, Resource.CPU] = fcpu_p[rep_part]
+            follower_load[:, Resource.NW_OUT] = 0.0
+
+            topics = sorted({t for t, _ in tps})
+            return builder.build_from_arrays(
+                topics=topics, partitions=tps,
+                replica_partition=rep_part, replica_broker=rep_bidx,
+                replica_disk=rep_disk, replica_is_leader=rep_leader,
+                replica_offline=rep_offline,
+                leader_load=leader_load, follower_load=follower_load)
 
     # ---------------------------------------------------------------- state
     def state_json(self) -> dict:

@@ -56,16 +56,28 @@ def test_random_self_healing_dead_brokers():
 
 
 def test_goal_stats_monotone():
-    """Per-goal severity totals never regress across the goal sequence
-    (AbstractGoal.java:110-119 monotonicity assertion analogue: later goals may
-    not re-violate an earlier-optimized hard goal)."""
+    """Hard goals never regress across the goal sequence
+    (AbstractGoal.java:110-119 monotonicity assertion + acceptance contract:
+    every later goal's actions are vetoed by already-optimized goals, and
+    hard goals stay enforced for the REST of the chain).
+
+    Soft goals carry no such cross-chain guarantee in the reference either:
+    a later soft goal optimizes subject to earlier goals' acceptance, and an
+    EARLIER goal may legally disturb a not-yet-optimized soft goal's stat
+    beyond later repair (e.g. a resource-distribution goal stacking one
+    topic's replicas before TopicReplicaDistributionGoal runs, with the
+    replica-count band then vetoing the un-stacking moves). Those end-states
+    surface as violated soft goals — the goal-violation detector's job — so
+    here we only require that the chain's OWN hard-goal contract holds."""
+    from cruise_control_tpu.analyzer.goals import make_goal
+
     ct, meta = generate(RandomClusterSpec(num_brokers=10, num_racks=3, num_topics=6,
                                           num_partitions=80, skew=1.5, seed=7))
     opt = GoalOptimizer()
     res = opt.optimizations(ct, meta, goal_names=GOALS_CORE)
     for g in res.goal_results:
-        if g.violated_after and not g.violated_before:
-            pytest.fail(f"goal {g.name} was satisfied before but violated after")
+        if make_goal(g.name).is_hard and g.violated_after and not g.violated_before:
+            pytest.fail(f"hard goal {g.name} was satisfied before but violated after")
 
 
 def test_proposals_reproduce_final_state():
