@@ -55,6 +55,12 @@ from cruise_control_tpu.analyzer.state import (
 Array = jax.Array
 NEG_INF = -jnp.inf
 
+# debug bisect knob (CC_DEBUG_DISABLE=swap|swap_apply|swap_admit): carve
+# pieces out of the compiled program to localize device faults; unset in
+# normal operation
+import os as _os  # noqa: E402
+_DEBUG_DISABLE = set((_os.environ.get("CC_DEBUG_DISABLE") or "").split(","))
+
 
 def _top_candidates(key: Array, k: int, exact: bool = False):
     """Candidate selection. Soft goals use approximate top-k
@@ -475,6 +481,8 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     one-at-a-time re-scored swap crawl — the rung-4 profile put two thirds of
     the whole 18-goal chain's wall clock inside that crawl for the two
     leadership-less distribution goals (NW-in, disk)."""
+    if "swap" in _DEBUG_DISABLE:
+        return st, jnp.int32(0)
     k = min(params.num_swap_candidates, env.num_replicas)
     okey = goal.swap_out_key(env, st, severity)
     ikey = goal.swap_in_key(env, st, severity)
@@ -518,7 +526,10 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                .at[p_out].min(guarded).at[p_in].min(guarded))
     ok_p = (first_p[p_out] == posn) & (first_p[p_in] == posn)
     win = wave_ok & ok_b & ok_in & ok_p
-    st = apply_swaps_batched(env, st, r_out, r_in, win)
+    if "swap_admit" in _DEBUG_DISABLE:
+        win = win & False
+    if "swap_apply" not in _DEBUG_DISABLE:
+        st = apply_swaps_batched(env, st, r_out, r_in, win)
     n_applied = jnp.sum(win).astype(jnp.int32)
 
     if min(K1, params.max_seq_swaps) > 0:

@@ -50,7 +50,9 @@ class BalancingConstraint:
     topic_replica_balance_min_gap: int = 2
     topic_replica_balance_max_gap: int = 40
     goal_violation_distribution_threshold_multiplier: float = 1.0
-    min_topic_leaders_per_broker: int = 0
+    # reference default 1 (AnalyzerConfig.DEFAULT_MIN_TOPIC_LEADERS_PER_BROKER);
+    # inert until topics match the min-leaders pattern
+    min_topic_leaders_per_broker: int = 1
 
     @classmethod
     def from_config(cls, cfg) -> "BalancingConstraint":

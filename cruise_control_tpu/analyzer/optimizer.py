@@ -83,28 +83,24 @@ class OptimizerResult:
         return [g.name for g in self.goal_results if g.violated_after]
 
     def to_json(self) -> dict:
-        return {
-            "summary": {
-                "numReplicaMovements": self.num_replica_movements,
-                "numLeaderMovements": self.num_leadership_movements,
-                "dataToMoveMB": self.data_to_move_mb,
-                "balancednessBefore": self.balancedness_before,
-                "balancednessAfter": self.balancedness_after,
-                "violatedGoalsBefore": self.violated_goals_before,
-                "violatedGoalsAfter": self.violated_goals_after,
-            },
-            "goalSummary": [
-                {"goal": g.name, "status": "VIOLATED" if g.violated_after else "NO-ACTION"
-                 if not g.iterations else "FIXED", "iterations": g.iterations,
-                 "budgetExhausted": g.hit_max_iters,
-                 # async-pipelined runs record dispatch time, not device time:
-                 # only emit the field when it was honestly measured
-                 **({"durationSec": round(g.duration_s, 4)}
-                    if self.durations_measured else {})}
-                for g in self.goal_results
-            ],
-            "proposals": [p.to_json() for p in self.proposals],
-        }
+        """Reference OptimizationResult schema
+        (servlet/response/OptimizationResult.java:138-150): summary with the
+        OptimizerResult.java:303-316 field set, goalSummary entries of
+        {goal, status, clusterModelStats[, optimizationTimeMs]}, proposals,
+        loadAfterOptimization (BrokerStats) — plus our violatedGoals lists
+        kept as extension fields."""
+        from cruise_control_tpu.api.responses import optimization_result_json
+        out = optimization_result_json(
+            self,
+            num_windows=getattr(self, "num_windows", 1),
+            monitored_partitions_pct=getattr(self, "monitored_partitions_pct",
+                                             1.0))
+        out["summary"]["violatedGoalsBefore"] = self.violated_goals_before
+        out["summary"]["violatedGoalsAfter"] = self.violated_goals_after
+        for g, entry in zip(self.goal_results, out["goalSummary"]):
+            entry["iterations"] = g.iterations
+            entry["budgetExhausted"] = g.hit_max_iters
+        return out
 
 
 def _balancedness(goals, results_violated: dict) -> float:
@@ -185,20 +181,41 @@ class GoalOptimizer:
                       options: OptimizationOptions = OptimizationOptions(),
                       skip_hard_goal_check: bool = False,
                       raise_on_failure: bool = True,
-                      measure_goal_durations: bool = False) -> OptimizerResult:
+                      measure_goal_durations: bool = False,
+                      min_leader_topic_pattern: str | None = None) -> OptimizerResult:
         """``measure_goal_durations=True`` blocks after every goal to time it
         honestly (proposal-computation-timer per goal); the default pipelines
         all goal programs asynchronously — one device round-trip for the whole
         chain instead of one per goal, which dominates wall clock on a
-        tunneled/remote TPU."""
+        tunneled/remote TPU.
+
+        ``min_leader_topic_pattern`` (regex) marks the topics subject to
+        MinTopicLeadersPerBrokerGoal; defaults to the
+        ``topics.with.min.leaders.per.broker`` config key
+        (AnalyzerConfig.TOPICS_WITH_MIN_LEADERS_PER_BROKER_CONFIG role)."""
         with self._proposal_timer.time():
             return self._optimizations(ct, meta, goal_names, options,
                                        skip_hard_goal_check, raise_on_failure,
-                                       measure_goal_durations)
+                                       measure_goal_durations,
+                                       min_leader_topic_pattern)
+
+    def _min_leader_mask(self, meta, pattern: str | None):
+        """bool[T] mask of topics matching the min-leaders regex."""
+        import re
+
+        if pattern is None and self._config is not None:
+            pattern = self._config.get_string(
+                "topics.with.min.leaders.per.broker")
+        if not pattern:
+            return None
+        rx = re.compile(pattern)
+        return np.asarray([bool(rx.fullmatch(t)) for t in meta.topic_names],
+                          bool)
 
     def _optimizations(self, ct, meta, goal_names, options,
                        skip_hard_goal_check, raise_on_failure,
-                       measure_goal_durations) -> OptimizerResult:
+                       measure_goal_durations,
+                       min_leader_topic_pattern=None) -> OptimizerResult:
         names = goal_names or self._default_goal_names
         # honour hard-goal enforcement (KafkaCruiseControl sanityCheckHardGoalPresence)
         if goal_names and not skip_hard_goal_check:
@@ -229,7 +246,10 @@ class GoalOptimizer:
             num_swap_candidates=min(256, max(self._params.num_swap_candidates,
                                              ct.num_brokers // 32)))
 
-        env = make_env(ct, meta)
+        tml = self._min_leader_mask(meta, min_leader_topic_pattern)
+        if tml is not None and tml.shape[0] < ct.num_topics:
+            tml = np.pad(tml, (0, ct.num_topics - tml.shape[0]))
+        env = make_env(ct, meta, topic_min_leaders_mask=tml)
         st = init_state(env, ct.replica_broker, ct.replica_is_leader,
                         ct.replica_offline, ct.replica_disk)
         # ONE device->host batch for everything needed up front: each
@@ -311,6 +331,7 @@ class GoalOptimizer:
         )
         result.final_state = st          # for executor / tests
         result.env = env
+        result.meta = meta               # for loadAfterOptimization rendering
 
         if raise_on_failure:
             failed = [r.name + (" (iteration budget exhausted)" if r.hit_max_iters else "")
@@ -331,12 +352,30 @@ class GoalOptimizer:
 
 
 def cluster_stats_state(env: ClusterEnv, st: EngineState) -> dict:
-    """Stats over the engine state (same fields as model.cluster_stats)."""
-    alive, util, counts, pot, offline, valid = jax.device_get(
-        (env.broker_alive, st.util, st.replica_count, st.potential_nw_out,
-         st.replica_offline, env.replica_valid))
+    """Stats over the engine state (ClusterModelStats.java:30-44 field set:
+    AVG/MAX/MIN/STD over alive brokers for resource utilization, potential
+    NW-out, replica / leader-replica / topic-replica counts, plus the
+    metadata counts used by ClusterModelStatsMetaData)."""
+    (alive, util, counts, lcounts, pot, offline, valid, tbc) = jax.device_get(
+        (env.broker_alive, st.util, st.replica_count, st.leader_count,
+         st.potential_nw_out, st.replica_offline, env.replica_valid,
+         st.topic_broker_count))
     util = util[alive]
     counts = counts[alive]
+    lcounts = lcounts[alive]
+    pot = pot[alive]
+    # topic-replica stats: per-(topic, alive broker) replica counts of topics
+    # that actually exist (ClusterModelStats topicReplicaStats role)
+    tbc = tbc[:, alive]
+    real_topics = tbc.sum(axis=1) > 0
+    trc = tbc[real_topics].astype(float)
+
+    def four(a, empty=0.0):
+        if a.size == 0:
+            return dict(avg=empty, max=empty, min=empty, std=empty)
+        return dict(avg=float(a.mean()), max=float(a.max()),
+                    min=float(a.min()), std=float(a.std()))
+
     return {
         "avg": util.mean(axis=0).tolist() if util.size else [],
         "max": util.max(axis=0).tolist() if util.size else [],
@@ -344,7 +383,14 @@ def cluster_stats_state(env: ClusterEnv, st: EngineState) -> dict:
         "std": util.std(axis=0).tolist() if util.size else [],
         "replica_count_avg": float(counts.mean()) if counts.size else 0.0,
         "replica_count_max": int(counts.max()) if counts.size else 0,
+        "replica_count_min": int(counts.min()) if counts.size else 0,
         "replica_count_std": float(counts.std()) if counts.size else 0.0,
-        "potential_nw_out_max": float(pot[alive].max()) if alive.any() else 0.0,
+        "leader_count": four(lcounts.astype(float)),
+        "topic_replica_count": four(trc),
+        "potential_nw_out": four(pot),
+        "potential_nw_out_max": float(pot.max()) if pot.size else 0.0,
         "num_offline_replicas": int((offline & valid).sum()),
+        "num_brokers": int(alive.sum()),
+        "num_replicas": int(valid.sum()),
+        "num_topics": int(real_topics.sum()),
     }

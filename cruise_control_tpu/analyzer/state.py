@@ -285,13 +285,13 @@ def apply_moves_batched(env: ClusterEnv, st: EngineState, replicas: Array,
     return dataclasses.replace(
         st,
         replica_broker=st.replica_broker.at[widx].set(
-            jnp.asarray(dsts, jnp.int32)),
-        replica_disk=st.replica_disk.at[widx].set(dst_disk),
-        replica_offline=st.replica_offline.at[widx].set(False),
+            jnp.asarray(dsts, jnp.int32), mode="drop"),
+        replica_disk=st.replica_disk.at[widx].set(dst_disk, mode="drop"),
+        replica_offline=st.replica_offline.at[widx].set(False, mode="drop"),
         util=util, leader_util=leader_util, potential_nw_out=pot,
         replica_count=rc, leader_count=lc, part_rack_count=prc,
         topic_broker_count=tbc, topic_leader_count=tlc, disk_util=du,
-        moved=st.moved.at[widx].set(True),
+        moved=st.moved.at[widx].set(True, mode="drop"),
     )
 
 

@@ -182,7 +182,7 @@ ENDPOINT_PARAMS: dict[EndPoint, dict[str, ParamSpec]] = {
     EndPoint.STOP_PROPOSAL_EXECUTION: {"force_stop": _B},
     EndPoint.PAUSE_SAMPLING: {},
     EndPoint.RESUME_SAMPLING: {},
-    EndPoint.KAFKA_CLUSTER_STATE: {"topic": _S},
+    EndPoint.KAFKA_CLUSTER_STATE: {"topic": _S, "verbose": _B},
     EndPoint.DEMOTE_BROKER: {**_EXECUTION, "brokerid": _IL,
                              "exclude_follower_demotion": _B,
                              "exclude_recently_demoted_brokers": _B},

@@ -226,3 +226,71 @@ class TrustedProxySecurityProvider(SecurityProvider):
         else:
             user_role = ROLE_VIEWER
         return (do_as, user_role)
+
+
+class SpnegoSecurityProvider(SecurityProvider):
+    """SPNEGO/Negotiate-shaped provider (servlet/security/spnego/ role:
+    SpnegoSecurityProvider + Jetty's ConfigurableSpnegoAuthenticator).
+
+    Implements the HTTP Negotiate handshake contract:
+    - no ``Authorization: Negotiate <token>`` -> 401 with a
+      ``WWW-Authenticate: Negotiate`` challenge,
+    - a presented token is validated by a pluggable ``token_validator``
+      (the GSS-API seam; Kerberos itself is not available in this
+      environment, so deployments plug their GSS binding here, and tests
+      use :func:`hmac_token_validator`),
+    - the authenticated principal's service/realm suffixes are stripped
+      (``user/host@REALM`` -> ``user``) before role lookup, mirroring
+      SpnegoUserStoreAuthorizationService's principal-name normalization.
+    """
+
+    def __init__(self, token_validator, roles: dict[str, str] | None = None,
+                 default_role: str | None = None):
+        self._validate = token_validator
+        self._roles = roles or {}
+        self._default_role = default_role
+
+    @property
+    def challenge(self) -> str:
+        return "Negotiate"
+
+    def authenticate(self, headers) -> tuple[str, str]:
+        auth = headers.get("Authorization", "")
+        if not auth.startswith("Negotiate "):
+            raise AuthError("Negotiate authentication required", 401)
+        token = auth[len("Negotiate "):].strip()
+        principal = self._validate(token)
+        if principal is None:
+            raise AuthError("invalid Negotiate token", 403)
+        # user/service-instance@REALM -> user
+        short = principal.split("@")[0].split("/")[0]
+        role = self._roles.get(short, self._default_role)
+        if role is None:
+            raise AuthError(f"principal {short!r} has no role", 403)
+        return short, role
+
+
+def hmac_token_validator(secret: bytes | str):
+    """Test/deployment-stub GSS seam for :class:`SpnegoSecurityProvider`:
+    accepts base64("principal:" + hex(hmac_sha256(secret, principal)))."""
+    key = secret.encode() if isinstance(secret, str) else secret
+
+    def validate(token: str):
+        try:
+            raw = base64.b64decode(token.encode(), validate=True).decode()
+            principal, _, mac = raw.rpartition(":")
+        except (binascii.Error, UnicodeDecodeError, ValueError):
+            return None
+        if not principal:
+            return None
+        want = hmac.new(key, principal.encode(), hashlib.sha256).hexdigest()
+        return principal if hmac.compare_digest(mac, want) else None
+
+    return validate
+
+
+def make_spnego_token(secret: bytes | str, principal: str) -> str:
+    """Mint a token the hmac_token_validator accepts (client/test side)."""
+    key = secret.encode() if isinstance(secret, str) else secret
+    mac = hmac.new(key, principal.encode(), hashlib.sha256).hexdigest()
+    return base64.b64encode(f"{principal}:{mac}".encode()).decode()

@@ -12,11 +12,16 @@ prefix is optional here).
 """
 from __future__ import annotations
 
+import http.cookies
 import json
 import threading
 import traceback
 import urllib.parse
+import uuid as uuid_mod
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+# the reference's servlet-container session cookie (JSESSIONID role)
+SESSION_COOKIE = "CCSESSIONID"
 
 from cruise_control_tpu.api.endpoints import (
     ASYNC_ENDPOINTS, GET_ENDPOINTS, POST_ENDPOINTS, EndPoint, ParameterError,
@@ -39,7 +44,10 @@ class CruiseControlServer:
     def __init__(self, app, host: str = "127.0.0.1", port: int = 0,
                  security_provider=None, two_step_verification: bool = False,
                  max_block_ms: float = 10_000.0, max_active_user_tasks: int = 25,
-                 completed_user_task_retention_ms: float = 24 * 3600 * 1000.0):
+                 completed_user_task_retention_ms: float = 24 * 3600 * 1000.0,
+                 ssl_context=None):
+        """``ssl_context``: an ``ssl.SSLContext`` to serve HTTPS
+        (KafkaCruiseControlApp.java:100-121 webserver.ssl.* role)."""
         self.app = app
         self.security = security_provider or NoopSecurityProvider()
         self.two_step = two_step_verification
@@ -48,8 +56,12 @@ class CruiseControlServer:
             max_active_tasks=max_active_user_tasks,
             completed_task_retention_ms=completed_user_task_retention_ms)
         self.max_block_ms = max_block_ms
+        self._ssl = ssl_context
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((host, port), handler)
+        if ssl_context is not None:
+            self._httpd.socket = ssl_context.wrap_socket(
+                self._httpd.socket, server_side=True)
         self._thread: threading.Thread | None = None
 
     # ------------------------------------------------------------ lifecycle
@@ -60,7 +72,8 @@ class CruiseControlServer:
     @property
     def base_url(self) -> str:
         host = self._httpd.server_address[0]
-        return f"http://{host}:{self.port}{URL_PREFIX}"
+        scheme = "https" if self._ssl is not None else "http"
+        return f"{scheme}://{host}:{self.port}{URL_PREFIX}"
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -180,8 +193,11 @@ class CruiseControlServer:
                         capacity_only=p["capacity_only"])
                 if endpoint is EndPoint.PARTITION_LOAD:
                     progress.add_step(GENERATING_CLUSTER_MODEL)
-                    return wrap({"records": app.partition_load(
-                        sort_by=p["resource"], limit=p["entries"])})
+                    from cruise_control_tpu.api.responses import (
+                        partition_load_records_json,
+                    )
+                    return partition_load_records_json(app.partition_load(
+                        sort_by=p["resource"], limit=p["entries"]))
                 if endpoint is EndPoint.PROPOSALS:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
                     goals = p["goals"] or None
@@ -262,7 +278,7 @@ class CruiseControlServer:
         if endpoint is EndPoint.STATE:
             return wrap(app.state_json(substates=p["substates"] or None))
         if endpoint is EndPoint.KAFKA_CLUSTER_STATE:
-            return wrap(app.kafka_cluster_state())
+            return wrap(app.kafka_cluster_state(verbose=bool(p["verbose"])))
         if endpoint is EndPoint.PAUSE_SAMPLING:
             return wrap(app.pause_sampling(p["reason"] or "operator request"))
         if endpoint is EndPoint.RESUME_SAMPLING:
@@ -370,10 +386,24 @@ def _make_handler(server: CruiseControlServer):
                     raise AuthError(f"role {role} may not access "
                                     f"{method} /{endpoint.path}", 403)
             except AuthError as e:
-                hdrs = {"WWW-Authenticate": 'Basic realm="cruise-control"'} \
-                    if e.status == 401 else {}
+                challenge = getattr(server.security, "challenge", "Basic")
+                hdrs = ({"WWW-Authenticate":
+                         f'{challenge} realm="cruise-control"'
+                         if challenge == "Basic" else challenge}
+                        if e.status == 401 else {})
                 self._send(e.status, error_json(str(e)), hdrs)
                 return
+            # per-session identity for user-task affinity (the reference's
+            # HttpSession cookie, UserTaskManager.java): requests without a
+            # session cookie get a fresh session — NAT'd clients no longer
+            # collide on client-ip; cookie-less clients resume via the
+            # explicit User-Task-ID header only
+            cookies = http.cookies.SimpleCookie(self.headers.get("Cookie", ""))
+            session_id = (cookies[SESSION_COOKIE].value
+                          if SESSION_COOKIE in cookies else None)
+            new_session = session_id is None
+            if new_session:
+                session_id = uuid_mod.uuid4().hex
             query = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
             if method == "POST":
                 # form-encoded POST bodies fold into the query params
@@ -403,11 +433,15 @@ def _make_handler(server: CruiseControlServer):
             except ParameterError as e:
                 self._send(400, error_json(str(e)), {})
                 return
-            client = f"{principal}@{self.client_address[0]}"
+            client = f"{principal}@{session_id}"
             try:
                 status, body, headers = server.handle(
                     method, endpoint, params, client,
                     self.headers.get(USER_TASK_HEADER_NAME))
+                if new_session:
+                    headers = dict(headers or {})
+                    headers["Set-Cookie"] = (
+                        f"{SESSION_COOKIE}={session_id}; Path=/; HttpOnly")
             except (ParameterError, KeyError, ValueError) as e:
                 self._send(400, error_json(str(e)), {})
                 return

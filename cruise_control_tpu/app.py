@@ -523,48 +523,49 @@ class CruiseControl:
             out["Sensors"] = self.sensors.to_json()
         return out
 
-    def kafka_cluster_state(self) -> dict:
-        """GET /kafka_cluster_state."""
-        brokers = self.backend.brokers()
-        partitions = self.backend.partitions()
-        per_broker: dict[int, dict] = {
-            b: {"replicaCount": 0, "leaderCount": 0, "rack": n.rack,
-                "alive": n.alive} for b, n in brokers.items()}
-        for info in partitions.values():
-            for b in info.replicas:
-                if b in per_broker:
-                    per_broker[b]["replicaCount"] += 1
-            if info.leader in per_broker:
-                per_broker[info.leader]["leaderCount"] += 1
-        return {
-            "KafkaBrokerState": per_broker,
-            "KafkaPartitionState": {
-                "offline": [f"{t}-{p}" for (t, p), i in partitions.items()
-                            if i.leader < 0],
-                "underReplicated": [],
-                "totalPartitions": len(partitions),
-            },
-        }
+    def kafka_cluster_state(self, verbose: bool = False) -> dict:
+        """GET /kafka_cluster_state
+        (servlet/response/KafkaClusterState.java schema)."""
+        from cruise_control_tpu.api.responses import kafka_cluster_state_json
+        return kafka_cluster_state_json(self.backend.brokers(),
+                                        self.backend.partitions(),
+                                        verbose=verbose)
 
     def partition_load(self, sort_by: str = "DISK", limit: int = 50) -> list:
-        """GET /partition_load: per-partition utilization, sorted."""
+        """GET /partition_load: per-partition utilization rows in the
+        reference record schema (PartitionLoadState.java: topic, partition,
+        leader, followers, the four Resource JSON names, msg_in)."""
         from cruise_control_tpu.common.resources import Resource
         ct, meta = self._model()
         loads = np.asarray(ct.leader_load)
         lead = np.asarray(ct.replica_is_leader)
         valid = np.asarray(ct.replica_valid)
+        part_of = np.asarray(ct.replica_partition)
+        broker_of = np.asarray(ct.replica_broker)
         res = Resource[sort_by.upper()] if sort_by.upper() in Resource.__members__ \
             else Resource.DISK
+        # sort + truncate FIRST; followers are gathered only for the emitted
+        # rows (at 1M replicas materializing every partition's follower list
+        # would cost seconds of host time for discarded data)
+        leaders = np.flatnonzero(valid & lead)
+        order = np.argsort(-loads[leaders, res])[:limit]
+        emit = leaders[order]
+        emit_parts = np.unique(part_of[emit])
+        followers_by_part: dict[int, list] = {int(p): [] for p in emit_parts}
+        fmask = valid & ~lead & np.isin(part_of, emit_parts)
+        for j in np.flatnonzero(fmask):
+            followers_by_part[int(part_of[j])].append(
+                int(meta.broker_ids[int(broker_of[j])]))
         rows = []
-        for j in np.flatnonzero(valid & lead):
-            t, p = meta.partition_ids[int(ct.replica_partition[j])]
+        for j in emit:
+            pi = int(part_of[j])
+            t, p = meta.partition_ids[pi]
             rows.append({"topic": t, "partition": p,
                          "cpu": float(loads[j, Resource.CPU]),
                          "networkInbound": float(loads[j, Resource.NW_IN]),
                          "networkOutbound": float(loads[j, Resource.NW_OUT]),
                          "disk": float(loads[j, Resource.DISK]),
-                         "leader": int(meta.broker_ids[int(ct.replica_broker[j])])})
-        key = {"CPU": "cpu", "NW_IN": "networkInbound", "NW_OUT": "networkOutbound",
-               "DISK": "disk"}[res.name]
-        rows.sort(key=lambda r: -r[key])
-        return rows[:limit]
+                         "msg_in": 0.0,
+                         "leader": int(meta.broker_ids[int(broker_of[j])]),
+                         "followers": followers_by_part.get(pi, [])})
+        return rows

@@ -51,6 +51,9 @@ class CruiseControlClient:
             self.base_url += URL_PREFIX
         self.timeout_s = timeout_s
         self.poll_interval_s = poll_interval_s
+        # session cookie jar (the reference client rides requests.Session;
+        # the server's CCSESSIONID scopes user-task affinity per session)
+        self._session_cookie: str | None = None
         self._auth_header = None
         if auth is not None:
             import base64
@@ -82,9 +85,14 @@ class CruiseControlClient:
             headers[USER_TASK_HEADER_NAME] = task_id
         if self._auth_header:
             headers["Authorization"] = self._auth_header
+        if self._session_cookie:
+            headers["Cookie"] = self._session_cookie
         req = urllib.request.Request(url, method=method, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                set_cookie = resp.headers.get("Set-Cookie")
+                if set_cookie:
+                    self._session_cookie = set_cookie.split(";", 1)[0]
                 return resp.status, json.loads(resp.read().decode()), \
                     resp.headers.get(USER_TASK_HEADER_NAME)
         except urllib.error.HTTPError as e:

@@ -332,11 +332,24 @@ _D.define(name="two.step.purgatory.retention.time.ms", type=Type.LONG, default=1
 _D.define(name="two.step.purgatory.max.requests", type=Type.INT, default=25)
 _D.define(name="webserver.security.enable", type=Type.BOOLEAN, default=False)
 _D.define(name="webserver.auth.credentials.file", type=Type.STRING, default="")
-_D.define(name="webserver.ssl.enable", type=Type.BOOLEAN, default=False)
+_D.define(name="webserver.ssl.enable", type=Type.BOOLEAN, default=False,
+          doc="Serve HTTPS (KafkaCruiseControlApp.java:100-121 ssl block).")
+_D.define(name="webserver.ssl.cert.location", type=Type.STRING, default="",
+          doc="PEM certificate chain file (webserver.ssl.keystore.location "
+              "role for the stdlib ssl stack).")
+_D.define(name="webserver.ssl.key.location", type=Type.STRING, default="",
+          doc="PEM private-key file; may equal the cert file.")
+_D.define(name="webserver.ssl.key.password", type=Type.PASSWORD, default="",
+          doc="Private-key passphrase (webserver.ssl.key.password).")
 _D.define(name="webserver.security.provider", type=Type.STRING, default="BASIC",
-          validator=in_set("BASIC", "JWT", "TRUSTED_PROXY"),
+          validator=in_set("BASIC", "JWT", "TRUSTED_PROXY", "SPNEGO"),
           doc="Auth scheme when webserver.security.enable "
-              "(servlet/security/: Basic, jwt/, trustedproxy/).")
+              "(servlet/security/: Basic, jwt/, trustedproxy/, spnego/).")
+_D.define(name="spnego.principal.secret.file", type=Type.STRING, default="",
+          doc="Shared secret for the SPNEGO token-validator stub (the "
+              "GSS/keytab seam; spnego.keytab.file role).")
+_D.define(name="spnego.principal.roles.file", type=Type.STRING, default="",
+          doc="htpasswd-style file mapping SPNEGO principals to roles.")
 _D.define(name="jwt.secret.file", type=Type.STRING, default="",
           doc="Shared-secret file for HS256 JWT verification "
               "(jwt.authentication.provider.url RS256 role).")

@@ -70,7 +70,22 @@ def build_server(cc, config):
     security = NoopSecurityProvider()
     if config.get_boolean("webserver.security.enable"):
         scheme = config.get_string("webserver.security.provider").upper()
-        if scheme == "JWT":
+        if scheme == "SPNEGO":
+            from cruise_control_tpu.api.security import (
+                SpnegoSecurityProvider, hmac_token_validator,
+            )
+            secret_file = config.get_string("spnego.principal.secret.file")
+            if not secret_file:
+                raise ValueError("SPNEGO security requires "
+                                 "spnego.principal.secret.file")
+            with open(secret_file, "rb") as f:
+                validator = hmac_token_validator(f.read().strip())
+            roles = {}
+            roles_file = config.get_string("spnego.principal.roles.file")
+            if roles_file:
+                roles = BasicSecurityProvider.from_file(roles_file).user_roles()
+            security = SpnegoSecurityProvider(validator, roles=roles)
+        elif scheme == "JWT":
             secret_file = config.get_string("jwt.secret.file")
             if not secret_file:
                 raise ValueError("JWT security requires jwt.secret.file")
@@ -92,10 +107,23 @@ def build_server(cc, config):
                     user_roles=security.user_roles(),
                     fallback_to_delegate=config.get_boolean(
                         "trusted.proxy.fallback.enabled"))
+    ssl_ctx = None
+    if config.get_boolean("webserver.ssl.enable"):
+        import ssl
+
+        cert = config.get_string("webserver.ssl.cert.location")
+        if not cert:
+            raise ValueError("webserver.ssl.enable requires "
+                             "webserver.ssl.cert.location")
+        key = config.get_string("webserver.ssl.key.location") or None
+        password = config.get_string("webserver.ssl.key.password") or None
+        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ssl_ctx.load_cert_chain(cert, keyfile=key, password=password)
     return CruiseControlServer(
         cc,
         host=config.get_string("webserver.http.address"),
         port=config.get_int("webserver.http.port"),
+        ssl_context=ssl_ctx,
         security_provider=security,
         two_step_verification=config.get_boolean("two.step.verification.enabled"),
         max_block_ms=float(config.get_int("webserver.request.maxBlockTimeMs")),

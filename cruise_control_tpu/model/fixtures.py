@@ -242,3 +242,122 @@ def jbod_cluster():
     b.add_replica("T2", 0, broker_id=1, is_leader=True,
                   load=[1.0, 50.0, 100.0, 5_000.0], logdir="/mnt/i01")
     return b.build()
+
+
+# ---------------------------------------------------------------------------
+# Exact-Java parity fixtures (loads transcribed verbatim from
+# DeterministicCluster.java; used by tests/test_java_parity_matrix.py to
+# replay DeterministicClusterTest.java's parameter matrix)
+# ---------------------------------------------------------------------------
+TOPIC_MIN_LEADER = "must_have_leader_replica_on_broker_topic"
+
+
+def _add_rf2(b, topic, part, leader_broker, follower_broker, leader_row,
+             follower_row):
+    """One RF=2 partition with explicit leader-role / follower-role load rows
+    (each replica carries both: what it bears now and what it would bear
+    after a leadership transfer — ClusterModel.setReplicaLoad +
+    ModelUtils attribution collapsed into two rows)."""
+    b.add_replica(topic, part, leader_broker, is_leader=True,
+                  leader_load=np.asarray(leader_row, float),
+                  follower_load=np.asarray(follower_row, float))
+    b.add_replica(topic, part, follower_broker, is_leader=False,
+                  leader_load=np.asarray(leader_row, float),
+                  follower_load=np.asarray(follower_row, float))
+
+
+def small_cluster_java(capacity: dict | None = None):
+    """DeterministicCluster.smallClusterModel (:712-768) verbatim: 3 brokers
+    / 2 racks (RACK_BY_BROKER), T1 x2 + T2 x3 partitions, RF=2, loads
+    (cpu, nw_in, nw_out, disk) exactly as setReplicaLoad lines."""
+    b = _homogeneous(RACK_BY_BROKER, capacity=capacity)
+    _add_rf2(b, "T1", 0, 0, 2, [20.0, 100.0, 130.0, 75.0], [5.0, 100.0, 0.0, 75.0])
+    _add_rf2(b, "T1", 1, 1, 0, [15.0, 90.0, 110.0, 55.0], [4.5, 90.0, 0.0, 55.0])
+    _add_rf2(b, "T2", 0, 1, 2, [5.0, 5.0, 6.0, 5.0], [4.0, 5.0, 0.0, 5.0])
+    _add_rf2(b, "T2", 1, 0, 2, [25.0, 25.0, 45.0, 55.0], [10.5, 25.0, 0.0, 55.0])
+    _add_rf2(b, "T2", 2, 0, 1, [20.0, 45.0, 120.0, 95.0], [8.0, 45.0, 0.0, 95.0])
+    return b.build()
+
+
+def medium_cluster_java(capacity: dict | None = None):
+    """DeterministicCluster.mediumClusterModel (:833-893) verbatim: topics
+    A(x3)/B/C/D, RF=2 each, 3 brokers / 2 racks."""
+    b = _homogeneous(RACK_BY_BROKER, capacity=capacity)
+    _add_rf2(b, "A", 0, 1, 0, [5.0, 4.0, 10.0, 10.0], [5.0, 5.0, 0.0, 4.0])
+    _add_rf2(b, "A", 1, 0, 2, [5.0, 3.0, 10.0, 8.0], [3.0, 4.0, 0.0, 6.0])
+    _add_rf2(b, "A", 2, 0, 2, [5.0, 2.0, 10.0, 6.0], [4.0, 5.0, 0.0, 3.0])
+    _add_rf2(b, "B", 0, 1, 2, [5.0, 4.0, 10.0, 7.0], [2.0, 2.0, 0.0, 5.0])
+    _add_rf2(b, "C", 0, 2, 1, [1.0, 8.0, 10.0, 4.0], [5.0, 6.0, 0.0, 4.0])
+    _add_rf2(b, "D", 0, 1, 2, [5.0, 5.0, 10.0, 6.0], [2.0, 8.0, 0.0, 7.0])
+    return b.build()
+
+
+_HALF_LOAD = [TYPICAL_CPU_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2,
+              MEDIUM_BROKER_CAPACITY / 2, LARGE_BROKER_CAPACITY / 2]
+_HALF_FOLLOWER = [TYPICAL_CPU_CAPACITY / 4, LARGE_BROKER_CAPACITY / 2, 0.0,
+                  LARGE_BROKER_CAPACITY / 2]
+
+
+def _min_leader_cluster(assignment, rack_by_broker=None, load_scale=0.01):
+    """Builder for the minLeaderReplicaPerBroker* fixtures: ``assignment``
+    maps partition -> (leader_broker, [follower_brokers...]); loads are a
+    small uniform row (the goal only counts leaders)."""
+    b = _homogeneous(rack_by_broker or RACK_BY_BROKER2)
+    row = [x * load_scale for x in _HALF_LOAD]
+    frow = [x * load_scale for x in _HALF_FOLLOWER]
+    for (topic, part), (leader, followers) in assignment.items():
+        b.add_replica(topic, part, leader, is_leader=True,
+                      leader_load=np.asarray(row, float),
+                      follower_load=np.asarray(frow, float))
+        for f in followers:
+            b.add_replica(topic, part, f, is_leader=False,
+                          leader_load=np.asarray(row, float),
+                          follower_load=np.asarray(frow, float))
+    return b.build()
+
+
+def min_leader_satisfiable():
+    """minLeaderReplicaPerBrokerSatisfiable (:349): B0 {P0L, P1L},
+    B1 {P2L, P0F}, B2 {P2F, P1F} — B2 needs a leadership transfer."""
+    T = TOPIC_MIN_LEADER
+    return _min_leader_cluster({(T, 0): (0, [1]), (T, 1): (0, [2]),
+                                (T, 2): (1, [2])})
+
+
+def min_leader_satisfiable2():
+    """minLeaderReplicaPerBrokerSatisfiable2 (:400): all three leaders on
+    B0; followers P1F->B1, P0F/P2F->B2."""
+    T = TOPIC_MIN_LEADER
+    return _min_leader_cluster({(T, 0): (0, [2]), (T, 1): (0, [1]),
+                                (T, 2): (0, [2])})
+
+
+def min_leader_satisfiable3():
+    """minLeaderReplicaPerBrokerSatisfiable3 (:522): 4 brokers
+    (RACK_BY_BROKER3), 16 partitions, leader+follower pairs co-located
+    (B1: P0-3, B2: P4-9, B3: P10-15), min 4 leaders per broker -> B0 needs
+    4 leader replicas moved in."""
+    T = TOPIC_MIN_LEADER
+    assignment = {}
+    for i in range(16):
+        broker = 1 if i < 4 else (2 if i < 10 else 3)
+        assignment[(T, i)] = (broker, [broker])
+    return _min_leader_cluster(assignment, rack_by_broker=RACK_BY_BROKER3)
+
+
+def min_leader_satisfiable4():
+    """minLeaderReplicaPerBrokerSatisfiable4 (:453): topics topic0/topic1
+    (x3 partitions each), all leaders on B0, all followers on B1, B2 empty;
+    min 1 leader of EACH topic per broker."""
+    assignment = {}
+    for t in ("topic0", "topic1"):
+        for i in range(3):
+            assignment[(t, i)] = (0, [1])
+    return _min_leader_cluster(assignment)
+
+
+def min_leader_unsatisfiable():
+    """leaderReplicaPerBrokerUnsatisfiable (:589): 2 partitions / 3 brokers
+    each requiring a leader -> impossible."""
+    T = TOPIC_MIN_LEADER
+    return _min_leader_cluster({(T, 0): (0, [2]), (T, 1): (0, [1])})

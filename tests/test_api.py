@@ -72,8 +72,10 @@ def test_state_endpoint(server):
 def test_kafka_cluster_state(server):
     status, body, _ = _request("GET", f"{server.base_url}/kafka_cluster_state")
     assert status == 200
-    assert body["KafkaPartitionState"]["totalPartitions"] == 12
-    assert len(body["KafkaBrokerState"]) == 4
+    assert body["KafkaBrokerState"]["Summary"]["Topics"] >= 1
+    assert len(body["KafkaBrokerState"]["ReplicaCountByBrokerId"]) == 4
+    for bucket in ("offline", "with-offline-replicas", "urp", "under-min-isr"):
+        assert bucket in body["KafkaPartitionState"]
 
 
 def test_load_endpoint(server):
@@ -127,10 +129,18 @@ def test_rebalance_dryrun_and_task_id(server):
     assert body["operation"] == "REBALANCE" and body["executed"] is False
     tid = headers.get(USER_TASK_HEADER_NAME)
     assert tid
-    # same client + same params within session expiry -> same task resumed
-    status2, body2, headers2 = _request("POST", url)
+    # session affinity rides the CCSESSIONID cookie (the reference's
+    # HttpSession): same session + same params -> same task resumed
+    cookie = headers.get("Set-Cookie", "").split(";", 1)[0]
+    assert cookie.startswith("CCSESSIONID=")
+    status2, body2, headers2 = _request("POST", url,
+                                        headers={"Cookie": cookie})
     assert headers2.get(USER_TASK_HEADER_NAME) == tid
-    # explicit User-Task-ID fetch also resumes it
+    # a DIFFERENT session (e.g. second operator behind the same NAT) must
+    # NOT be handed the first session's task
+    status4, _, headers4 = _request("POST", url)
+    assert headers4.get(USER_TASK_HEADER_NAME) != tid
+    # explicit User-Task-ID fetch resumes regardless of session
     status3, _, headers3 = _request(
         "POST", url, headers={USER_TASK_HEADER_NAME: tid})
     assert status3 == 200 and headers3.get(USER_TASK_HEADER_NAME) == tid
@@ -313,7 +323,7 @@ def test_load_capacity_only_carries_capacity(server):
     status, body, _ = _request("GET", f"{server.base_url}/load?capacity_only=true")
     assert status == 200
     row = body["brokers"][0]
-    assert row["DiskCapacityMB"] > 0 and row["NwInCapacity"] > 0
+    assert row["DiskCapacityMB"] > 0 and row["NetworkInCapacity"] > 0
     assert row["DiskMB"] == 0.0  # utilization suppressed
 
 
@@ -448,3 +458,78 @@ def test_exclude_recently_removed_brokers_facade():
     dests2 = {b for prop in out2["result"]["proposals"]
               for b in set(prop["newReplicas"]) - set(prop["oldReplicas"])}
     assert 2 in dests2
+
+
+def test_spnego_negotiate_handshake():
+    """servlet/security/spnego/ role: 401 + WWW-Authenticate: Negotiate
+    challenge, token validation via the GSS seam, principal normalization."""
+    from cruise_control_tpu.api.security import (
+        SpnegoSecurityProvider, hmac_token_validator, make_spnego_token,
+    )
+    be = _backend()
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+    cc.start_up()
+    for i in range(12):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    provider = SpnegoSecurityProvider(hmac_token_validator("kdc-secret"),
+                                      roles={"alice": "ADMIN"})
+    srv = CruiseControlServer(cc, port=0, security_provider=provider,
+                              max_block_ms=120_000.0)
+    srv.start()
+    try:
+        status, _, headers = _request("GET", f"{srv.base_url}/state")
+        assert status == 401
+        assert headers.get("WWW-Authenticate") == "Negotiate"
+        # garbage token -> rejected
+        status, _, _ = _request("GET", f"{srv.base_url}/state", headers={
+            "Authorization": "Negotiate bm9wZQ=="})
+        assert status == 403
+        # valid token, service/realm suffixes stripped for role lookup
+        tok = make_spnego_token("kdc-secret", "alice/admin-host@EXAMPLE.COM")
+        status, body, _ = _request("GET", f"{srv.base_url}/state", headers={
+            "Authorization": f"Negotiate {tok}"})
+        assert status == 200 and "MonitorState" in body
+        # unknown principal -> no role -> 403
+        tok2 = make_spnego_token("kdc-secret", "mallory@EXAMPLE.COM")
+        status, _, _ = _request("GET", f"{srv.base_url}/state", headers={
+            "Authorization": f"Negotiate {tok2}"})
+        assert status == 403
+    finally:
+        srv.stop()
+
+
+def test_tls_server(tmp_path):
+    """webserver.ssl.* (KafkaCruiseControlApp.java:100-121): HTTPS serving
+    with a self-signed certificate."""
+    import ssl
+    import subprocess
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout",
+         str(key), "-out", str(cert), "-days", "1", "-nodes", "-subj",
+         "/CN=127.0.0.1"], check=True, capture_output=True)
+    be = _backend()
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+    cc.start_up()
+    for i in range(12):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(str(cert), keyfile=str(key))
+    srv = CruiseControlServer(cc, port=0, max_block_ms=120_000.0,
+                              ssl_context=ctx)
+    srv.start()
+    try:
+        assert srv.base_url.startswith("https://")
+        client_ctx = ssl.create_default_context(cafile=str(cert))
+        client_ctx.check_hostname = False
+        req = urllib.request.Request(f"{srv.base_url}/state")
+        with urllib.request.urlopen(req, timeout=120,
+                                    context=client_ctx) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["version"] == 1 and "MonitorState" in body
+    finally:
+        srv.stop()

@@ -62,8 +62,8 @@ def test_bootstrap_end_to_end(tmp_path):
         state = client.state()
         assert state["MonitorState"]["state"] == "RUNNING"
         ks = client.kafka_cluster_state()
-        assert ks["KafkaPartitionState"]["totalPartitions"] == 8
-        assert len(ks["KafkaBrokerState"]) == 4
+        assert ks["KafkaBrokerState"]["Summary"]["Replicas"] == 16
+        assert len(ks["KafkaBrokerState"]["ReplicaCountByBrokerId"]) == 4
     finally:
         server.stop()
         cc.shutdown()

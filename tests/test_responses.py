@@ -1,0 +1,133 @@
+"""Response-schema parity tests.
+
+Golden field sets transcribed from the reference's servlet/response classes
+(each test cites its source file): every endpoint body must carry the same
+top-level keys the Java renderers emit, so the reference's own Python client
+(cruise-control-client) would parse our responses.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.api import responses
+from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+from cruise_control_tpu.model.fixtures import small_cluster_java
+
+
+@pytest.fixture(scope="module")
+def opt_result():
+    ct, meta = small_cluster_java()
+    res = GoalOptimizer().optimizations(
+        ct, meta, goal_names=["ReplicaDistributionGoal",
+                              "DiskUsageDistributionGoal"],
+        skip_hard_goal_check=True, raise_on_failure=False)
+    return ct, meta, res
+
+
+def test_optimization_result_schema(opt_result):
+    """servlet/response/OptimizationResult.java:138-150 +
+    OptimizerResult.java:303-316 summary field set."""
+    _ct, _meta, res = opt_result
+    out = res.to_json()
+    assert out["version"] == 1
+    summary = out["summary"]
+    for field in ("numReplicaMovements", "dataToMoveMB",
+                  "numIntraBrokerReplicaMovements", "intraBrokerDataToMoveMB",
+                  "numLeaderMovements", "recentWindows",
+                  "monitoredPartitionsPercentage", "excludedTopics",
+                  "excludedBrokersForLeadership",
+                  "excludedBrokersForReplicaMove",
+                  "onDemandBalancednessScoreBefore",
+                  "onDemandBalancednessScoreAfter", "provisionStatus",
+                  "provisionRecommendation"):
+        assert field in summary, field
+    for entry in out["goalSummary"]:
+        assert set(entry) >= {"goal", "status", "clusterModelStats"}
+        assert entry["status"] in ("VIOLATED", "FIXED", "NO-ACTION")
+        stats = entry["clusterModelStats"]
+        assert set(stats["metadata"]) == {"brokers", "replicas", "topics"}
+        for stat in ("AVG", "MAX", "MIN", "STD"):
+            holder = stats["statistics"][stat]
+            assert set(holder) == {"cpu", "networkInbound", "networkOutbound",
+                                   "disk", "potentialNwOut", "replicas",
+                                   "leaderReplicas", "topicReplicas"}
+    for p in out["proposals"]:
+        assert set(p) >= {"topicPartition", "oldLeader", "newLeader",
+                          "oldReplicas", "newReplicas"}
+    assert "loadAfterOptimization" in out
+    assert {"brokers", "hosts"} <= set(out["loadAfterOptimization"])
+
+
+def test_broker_stats_schema(opt_result):
+    """response/stats/{BrokerStats,SingleBrokerStats,BasicStats}.java rows."""
+    _ct, meta, res = opt_result
+    out = responses.broker_stats_from_state(res.env, res.final_state, meta)
+    row = out["brokers"][0]
+    for field in ("Broker", "Host", "Rack", "BrokerState", "DiskMB",
+                  "DiskPct", "CpuPct", "LeaderNwInRate", "FollowerNwInRate",
+                  "NwOutRate", "PnwOutRate", "Leaders", "Replicas",
+                  "DiskCapacityMB", "NetworkInCapacity", "NetworkOutCapacity",
+                  "NumCore"):
+        assert field in row, field
+    # accounting sanity: totals preserved across rows
+    assert sum(r["Replicas"] for r in out["brokers"]) == 10
+    assert sum(r["Leaders"] for r in out["brokers"]) == 5
+
+
+def test_kafka_cluster_state_schema():
+    """servlet/response/{KafkaClusterState,ClusterBrokerState,
+    ClusterPartitionState,PartitionState}.java field sets."""
+    backend = SimulatedClusterBackend()
+    for b in range(4):
+        backend.add_broker(b, f"r{b % 2}")
+    for p in range(8):
+        backend.create_partition("t", p, [(p + i) % 4 for i in range(2)],
+                                 size_mb=100.0, bytes_in_rate=50.0,
+                                 bytes_out_rate=100.0, cpu_util=2.0)
+    backend.kill_broker(3)
+    out = responses.kafka_cluster_state_json(backend.brokers(),
+                                             backend.partitions(),
+                                             verbose=True)
+    bs = out["KafkaBrokerState"]
+    for field in ("LeaderCountByBrokerId", "ReplicaCountByBrokerId",
+                  "OutOfSyncCountByBrokerId", "OfflineReplicaCountByBrokerId",
+                  "OnlineLogDirsByBrokerId", "OfflineLogDirsByBrokerId",
+                  "IsController", "Summary"):
+        assert field in bs, field
+    assert set(bs["Summary"]) >= {"Brokers", "Topics", "Replicas", "Leaders"}
+    ps = out["KafkaPartitionState"]
+    for bucket in ("offline", "with-offline-replicas", "urp",
+                   "under-min-isr", "other"):
+        assert bucket in ps, bucket
+    # the dead broker must surface its partitions outside "other"
+    abnormal = (ps["offline"] + ps["with-offline-replicas"] + ps["urp"])
+    assert abnormal, "dead broker produced no abnormal partitions"
+    row = abnormal[0]
+    assert set(row) == {"topic", "partition", "leader", "replicas",
+                        "in-sync", "out-of-sync", "offline"}
+
+
+def test_partition_load_schema():
+    rows = [{"topic": "t", "partition": 0, "leader": 1, "followers": [2],
+             "cpu": 1.0, "networkInbound": 2.0, "networkOutbound": 3.0,
+             "disk": 4.0}]
+    out = responses.partition_load_records_json(rows)
+    rec = out["records"][0]
+    assert set(rec) == {"topic", "partition", "leader", "followers", "cpu",
+                        "networkInbound", "networkOutbound", "disk", "msg_in"}
+
+
+def test_reference_client_double_parses_endpoints(opt_result):
+    """A minimal double of the reference cruise-control-client's response
+    handling (cruisecontrolclient/client/Responder.py role: json -> dict,
+    then field access per endpoint) must read our bodies."""
+    _ct, meta, res = opt_result
+    body = res.to_json()
+    # what cccli prints for rebalance/proposals
+    assert isinstance(body["summary"]["numReplicaMovements"], int)
+    assert isinstance(body["goalSummary"], list)
+    load = responses.broker_stats_from_state(res.env, res.final_state, meta)
+    hosts = {r["Host"] for r in load["hosts"]}
+    assert len(hosts) == len(meta.broker_ids)

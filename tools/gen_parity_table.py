@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Generate PARITY.md: the DeterministicClusterTest matrix, Java outcome
+(transcribed from the reference test's assertions) vs this implementation's
+outcome (measured by running the same combination).
+
+Usage: PYTHONPATH=. JAX_PLATFORMS=cpu python tools/gen_parity_table.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cruise_control_tpu.analyzer.optimizer import OptimizationFailureError  # noqa: E402
+from cruise_control_tpu.detector.provisioner import ProvisionStatus  # noqa: E402
+from tests.test_java_parity_matrix import MATRIX, run_row  # noqa: E402
+
+HEADER = """# PARITY — violation-outcome parity vs the Java optimizer
+
+The JVM toolchain cannot run in this environment, so the Java side of this
+table is TRANSCRIBED from the reference's own test assertions
+(`DeterministicClusterTest.java:97-247`): every parameterized combination
+must optimize successfully (hard goals satisfied, OptimizationVerifier
+REGRESSION check passing) except (a) combinations whose failure is an
+"Insufficient capacity" / UNDER_PROVISIONED one — explicitly tolerated by
+the Java test's catch block (`:263-274`) — and (b) the two rows
+parameterized with `expectedException=OptimizationFailureException`.
+
+The TPU column is measured by `tests/test_java_parity_matrix.py` (same
+fixtures — loads transcribed verbatim from `DeterministicCluster.java` —
+same constraints from `TestConstants.java`, same goal chains).
+
+| row | fixture | goals | constraint | Java outcome | TPU outcome | match |
+|---|---|---|---|---|---|---|
+"""
+
+
+def describe_outcome(expected: str) -> str:
+    return {"ok": "optimizes, hard goals satisfied",
+            "ok_or_underprovisioned": "optimizes OR insufficient-capacity",
+            "raise": "OptimizationFailureException"}[expected]
+
+
+def main() -> None:
+    rows = []
+    all_match = True
+    for row_id, factory, chain, constraint, pattern, expected in MATRIX:
+        t0 = time.monotonic()
+        try:
+            _ct, _meta, res = run_row(factory, chain, constraint, pattern)
+            hard = [g.name for g in res.goal_results
+                    if g.violated_after and g.name in (
+                        "RackAwareGoal", "MinTopicLeadersPerBrokerGoal",
+                        "ReplicaCapacityGoal", "DiskCapacityGoal",
+                        "NetworkInboundCapacityGoal",
+                        "NetworkOutboundCapacityGoal", "CpuCapacityGoal",
+                        "KafkaAssignerEvenRackAwareGoal")]
+            got = ("hard goals violated: " + ",".join(hard)) if hard else \
+                f"optimized ({len(res.violated_goals_after)} soft violated)"
+            ok = not hard and expected in ("ok", "ok_or_underprovisioned")
+        except OptimizationFailureError as e:
+            under = (e.recommendation is not None and
+                     e.recommendation.status == ProvisionStatus.UNDER_PROVISIONED)
+            got = ("raises (UNDER_PROVISIONED)" if under else "raises")
+            ok = (expected == "raise"
+                  or (expected == "ok_or_underprovisioned" and under))
+        all_match &= ok
+        chain_desc = (f"{len(chain)}-goal default chain" if len(chain) > 3
+                      else "+".join(chain))
+        cdesc = (f"bal={constraint.resource_balance_percentage[0]} "
+                 f"cap={constraint.capacity_threshold[0]}")
+        rows.append(f"| {row_id} | {factory.__name__ if hasattr(factory, '__name__') else row_id} "
+                    f"| {chain_desc} | {cdesc} | {describe_outcome(expected)} "
+                    f"| {got} | {'yes' if ok else 'NO'} |")
+        print(f"{row_id:32s} {got:50s} {'OK' if ok else 'MISMATCH'} "
+              f"({time.monotonic() - t0:.1f}s)", file=sys.stderr, flush=True)
+
+    with open("PARITY.md", "w") as f:
+        f.write(HEADER)
+        f.write("\n".join(rows) + "\n")
+        f.write(f"\n**{len(rows)} rows, "
+                f"{'all matching' if all_match else 'MISMATCHES PRESENT'}.**\n\n"
+                "Regenerate with `python tools/gen_parity_table.py` "
+                "(tests/test_java_parity_matrix.py asserts the same "
+                "contract in CI).\n")
+    print(f"PARITY.md written ({len(rows)} rows, match={all_match})",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
