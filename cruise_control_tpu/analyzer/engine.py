@@ -483,7 +483,12 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     leadership-less distribution goals (NW-in, disk)."""
     if "swap" in _DEBUG_DISABLE:
         return st, jnp.int32(0)
-    k = min(params.num_swap_candidates, env.num_replicas)
+    # hard clamp 128: swap-candidate pools >= 220 reproducibly kernel-fault
+    # the TPU runtime at 7k-broker/1M-replica shapes (bisected 2026-07-31:
+    # 32/64/128 fine, 220 and 256 crash inside the applied swap wave, so
+    # alignment is not the trigger) — enforced HERE so every caller is safe,
+    # not just GoalOptimizer
+    k = min(params.num_swap_candidates, env.num_replicas, 128)
     okey = goal.swap_out_key(env, st, severity)
     ikey = goal.swap_in_key(env, st, severity)
     okv, cand_out = _top_candidates(okey, k, exact=goal.is_hard)
@@ -628,6 +633,8 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
 
     @partial(jax.jit, donate_argnums=(1,) if donate_state else ())
     def run(env: ClusterEnv, st: EngineState):
+        stat_before = goal.stat(env, st)
+
         def step(carry):
             st, it, n_applied, _progress = carry
             severity = goal.broker_severity(env, st)
@@ -691,6 +698,7 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
         return st, {"iterations": n_applied, "passes": iters,
                     "violated_after": violated,
                     "hit_max_iters": hit_max_iters,
+                    "stat_before": stat_before,
                     "stat": goal.stat(env, st)}
 
     return run

@@ -90,8 +90,10 @@ class GoalKernel:
 
     def replica_key(self, env: ClusterEnv, st: EngineState, severity: Array) -> Array:
         """f32[R] candidate ranking; default: effective load magnitude of
-        replicas on positive-severity brokers (offline replicas get priority)."""
-        on_bad = severity[st.replica_broker] > 0
+        replicas on positive-severity brokers (offline replicas get
+        priority). Severity reaches replica granularity via one packed
+        gather (see broker_lookup)."""
+        on_bad = broker_lookup(st.replica_broker, severity)[:, 0] > 0
         load = jnp.sum(st.effective_load(env), axis=1)
         key = jnp.where(on_bad & env.replica_valid, load, NEG_INF)
         return jnp.where(st.replica_offline & env.replica_valid, key + 1e12, key)
@@ -201,29 +203,20 @@ class GoalKernel:
         return jnp.sum(jnp.maximum(self.broker_severity(env, st), 0.0))
 
 
-def rank_within_broker(broker: Array, value: Array) -> Array:
-    """i32[R]: dense rank (0 = first) of each replica among the replicas of
-    its own broker, ordered by descending ``value``.
+def broker_lookup(rb: Array, *cols: Array) -> Array:
+    """f32[R, len(cols)]: per-broker columns gathered at replica positions in
+    ONE packed gather.
 
-    Used to SPREAD top-k candidate selection across source brokers: keys of
-    the form ``-rank + tiebreak`` put every broker's best replica ahead of any
-    broker's second-best, so one pathological broker cannot monopolize the
-    candidate set (the tensor analogue of the reference's per-broker
-    rebalancing loop visiting each broker, AbstractGoal.java:98-103).
-
-    Two stable argsorts (sort by value, then stably by broker) produce a
-    (broker, value-desc) grouping without composite integer keys — avoids
-    int32 overflow at B*R scale with x64 disabled.
-    """
-    idx = jnp.arange(broker.shape[0])
-    order1 = jnp.argsort(-value)                    # value desc (stable)
-    order = order1[jnp.argsort(broker[order1])]     # broker asc, value desc
-    sb = broker[order]
-    is_start = jnp.concatenate([jnp.ones(1, bool), sb[1:] != sb[:-1]])
-    group_start = jax.lax.associative_scan(jnp.maximum,
-                                           jnp.where(is_start, idx, 0))
-    rank_sorted = idx - group_start
-    return jnp.zeros_like(idx).at[order].set(rank_sorted).astype(jnp.int32)
+    TPU random-access gathers pay per index, not per byte: profiling the
+    rung-4 engine showed a single-column [R]<-[B] gather at ~7 ms while a
+    packed [R,4]<-[B,4] row gather is ~2 ms — the seven broker-value gathers
+    inside one scoring pass were ~75% of the whole pass. Every kernel that
+    needs several broker-level values at replica granularity must fetch them
+    through one packed table, padded to >= 4 columns for the fast path."""
+    k = len(cols)
+    cols = list(cols) + [cols[0]] * max(0, 4 - k)
+    table = jnp.stack([c.astype(jnp.float32) for c in cols], axis=1)
+    return table[rb][:, :k]
 
 
 def candidate_load(env: ClusterEnv, st: EngineState, cand: Array) -> Array:

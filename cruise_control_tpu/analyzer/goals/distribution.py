@@ -29,7 +29,7 @@ from cruise_control_tpu.analyzer.env import (
 )
 from cruise_control_tpu.analyzer.goals.base import (
     NEG_INF, WAVE_COUNT, WAVE_DIMS, WAVE_LEADER_COUNT, GoalKernel,
-    candidate_load, rank_within_broker,
+    broker_lookup, candidate_load,
 )
 from cruise_control_tpu.analyzer.goals.capacity import RESOURCE_EPS
 from cruise_control_tpu.analyzer.state import EngineState
@@ -86,11 +86,15 @@ class ResourceDistributionGoal(GoalKernel):
         lower, upper = self._limits(env, st)
         util = st.util[:, self.resource]
         eps = RESOURCE_EPS[self.resource]
-        excess_src = (util - upper)[st.replica_broker] > eps
+        # ONE packed gather for every broker-level value this key needs
+        # (broker_lookup: single-column gathers at R scale are the engine's
+        # dominant cost)
+        per = broker_lookup(st.replica_broker, util - upper, util, lower, upper)
+        excess_src = per[:, 0] > eps
         any_deficit = jnp.any((lower - util) > eps)
         load = st.effective_load(env)[:, self.resource]
         # donors for move-in: any broker that can shed without going deficient
-        donor = (util[st.replica_broker] - load) >= lower[st.replica_broker]
+        donor = (per[:, 1] - load) >= per[:, 2]
         # only replicas that can actually LAND somewhere: a replica larger
         # than every destination's remaining band headroom scores -inf for all
         # dsts, and a top-k full of such replicas stalls the goal — filter
@@ -100,14 +104,13 @@ class ResourceDistributionGoal(GoalKernel):
         movable = (env.replica_valid & (load > 0) & fits
                    & (excess_src | (any_deficit & donor)))
         offline = st.replica_offline & env.replica_valid
-        # spread candidates across source brokers (largest replica of every
-        # violating broker before any broker's second-largest); rank over the
-        # ELIGIBLE set only, so padded/ineligible replicas can't displace a
-        # broker's real candidates
-        rank_val = jnp.where(movable | offline, load, NEG_INF)
-        rank = rank_within_broker(st.replica_broker, rank_val).astype(jnp.float32)
-        tiebreak = load / (jnp.max(load) + 1e-9)      # in (0, 1]
-        key = jnp.where(movable | offline, tiebreak - rank, NEG_INF)
+        # spread candidates across source brokers WITHOUT per-replica rank
+        # machinery (rank_within_broker cost 3 R-sized gathers/scatters per
+        # pass): each replica keys by its fraction of its own broker's
+        # utilization, so every broker's dominant replicas surface near the
+        # top regardless of the broker's absolute load
+        frac = load / jnp.maximum(per[:, 1], 1e-9)
+        key = jnp.where(movable | offline, frac, NEG_INF)
         return jnp.where(offline, key + 1e12, key)
 
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
@@ -167,7 +170,8 @@ class ResourceDistributionGoal(GoalKernel):
     def leader_key(self, env: ClusterEnv, st: EngineState, severity):
         lower, upper = self._limits(env, st)
         util = st.util[:, self.resource]
-        on_excess = (util - upper)[st.replica_broker] > RESOURCE_EPS[self.resource]
+        on_excess = (broker_lookup(st.replica_broker, util - upper)[:, 0]
+                     > RESOURCE_EPS[self.resource])
         delta = env.leader_load[:, self.resource] - env.follower_load[:, self.resource]
         ok = env.replica_valid & st.replica_is_leader & on_excess & (delta > 0) \
             & ~st.replica_offline
@@ -205,7 +209,7 @@ class ResourceDistributionGoal(GoalKernel):
     # -- swaps (rebalanceBySwappingLoadOut/In, ResourceDistributionGoal.java:598,:697) --
     def swap_out_key(self, env: ClusterEnv, st: EngineState, severity):
         """Replicas on out-of-band brokers, largest resource load first."""
-        on_bad = severity[st.replica_broker] > 0
+        on_bad = broker_lookup(st.replica_broker, severity)[:, 0] > 0
         load = st.effective_load(env)[:, self.resource]
         ok = env.replica_valid & on_bad & ~st.replica_offline
         return jnp.where(ok, load, NEG_INF)
@@ -215,7 +219,8 @@ class ResourceDistributionGoal(GoalKernel):
         brokers are prime counterparties: they trade a small replica for a big
         one); smallest loads first so a swap can shed a small net amount."""
         _lower, upper = self._limits(env, st)
-        not_excess = (st.util[:, self.resource] <= upper)[st.replica_broker]
+        not_excess = broker_lookup(
+            st.replica_broker, st.util[:, self.resource] - upper)[:, 0] <= 0
         load = st.effective_load(env)[:, self.resource]
         ok = env.replica_valid & not_excess & ~st.replica_offline
         return jnp.where(ok, -load, NEG_INF)
@@ -328,18 +333,19 @@ class ReplicaDistributionGoal(GoalKernel):
     def replica_key(self, env: ClusterEnv, st: EngineState, severity):
         lower, upper = self._limits(env, st)
         c = st.replica_count.astype(jnp.float32)
-        over = (c - upper)[st.replica_broker] > 0
+        per = broker_lookup(st.replica_broker, c - upper, c - 1.0 - lower,
+                            jnp.sum(st.util, axis=1))
+        over = per[:, 0] > 0
         any_deficit = jnp.any(lower - c > 0)
-        donor = (c - 1)[st.replica_broker] >= lower[st.replica_broker]
+        donor = per[:, 1] >= 0
         load = jnp.sum(st.effective_load(env), axis=1)
         movable = env.replica_valid & (over | (any_deficit & donor))
         offline = st.replica_offline & env.replica_valid
-        # spread across source brokers; prefer light replicas within a broker
-        # (less data moved per count unit); rank over the eligible set only
-        rank_val = jnp.where(movable | offline, -load, NEG_INF)
-        rank = rank_within_broker(st.replica_broker, rank_val).astype(jnp.float32)
-        tiebreak = 1.0 - load / (jnp.max(load) + 1e-9)
-        key = jnp.where(movable | offline, tiebreak - rank, NEG_INF)
+        # prefer light replicas (less data moved per count unit), normalized
+        # per broker so every broker's lightest surfaces near the top (the
+        # gather-free replacement of the per-broker rank spread)
+        tiebreak = 1.0 - load / jnp.maximum(per[:, 2], 1e-9)
+        key = jnp.where(movable | offline, tiebreak, NEG_INF)
         return jnp.where(offline, key + 1e12, key)
 
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
@@ -416,7 +422,7 @@ class LeaderReplicaDistributionGoal(GoalKernel):
     def replica_key(self, env: ClusterEnv, st: EngineState, severity):
         lower, upper = self._limits(env, st)
         c = st.leader_count.astype(jnp.float32)
-        over = (c - upper)[st.replica_broker] > 0
+        over = broker_lookup(st.replica_broker, c - upper)[:, 0] > 0
         load = jnp.sum(st.effective_load(env), axis=1)
         movable = env.replica_valid & st.replica_is_leader & over & ~st.replica_offline
         return jnp.where(movable, -load, NEG_INF)
@@ -462,15 +468,14 @@ class LeaderReplicaDistributionGoal(GoalKernel):
     def leader_key(self, env: ClusterEnv, st: EngineState, severity):
         lower, upper = self._limits(env, st)
         c = st.leader_count.astype(jnp.float32)
-        over = (c - upper)[st.replica_broker] > 0
+        per = broker_lookup(st.replica_broker, c - upper,
+                            st.leader_util[:, 2])
+        over = per[:, 0] > 0
         nw = env.leader_load[:, 2] - env.follower_load[:, 2]
         ok = env.replica_valid & st.replica_is_leader & over & ~st.replica_offline
-        # spread across source brokers; light partitions first within a broker
-        # (rank over the eligible set only)
-        rank_val = jnp.where(ok, -nw, NEG_INF)
-        rank = rank_within_broker(st.replica_broker, rank_val).astype(jnp.float32)
-        tiebreak = 1.0 - nw / (jnp.max(jnp.abs(nw)) + 1e-9)
-        return jnp.where(ok, tiebreak - rank, NEG_INF)
+        # light partitions first, normalized per broker (gather-free spread)
+        tiebreak = 1.0 - nw / jnp.maximum(per[:, 1], 1e-9)
+        return jnp.where(ok, tiebreak, NEG_INF)
 
     def leadership_score(self, env: ClusterEnv, st: EngineState, cand):
         members = env.partition_replicas[env.replica_partition[cand]]

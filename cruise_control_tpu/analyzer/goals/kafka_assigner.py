@@ -9,22 +9,28 @@ pairs instead of moving them). The ``kafka_assigner`` request parameter
 substitutes these for their standard counterparts
 (GoalBasedOperationRunnable kafka-assigner mode).
 
-The contract kept here is the outcome, not the scan order: even rack spread
-== at most ceil(RF / num_racks) replicas per rack (the fixed point of the
-reference's round-robin), and swap-only disk balancing == replica-count-
-preserving actions.
+The contract kept here is the outcome, not the scan order: STRICT rack
+awareness (each replica of a partition on a distinct rack; RF above the
+alive-rack count raises, KafkaAssignerEvenRackAwareGoal.java:302-356), and
+swap-only disk balancing == replica-count-preserving actions.
 """
 from __future__ import annotations
 
 import dataclasses
 
 from cruise_control_tpu.analyzer.goals.distribution import DiskUsageDistributionGoal
-from cruise_control_tpu.analyzer.goals.rack import RackAwareDistributionGoal
+from cruise_control_tpu.analyzer.goals.rack import RackAwareGoal
 
 
 @dataclasses.dataclass(frozen=True)
-class KafkaAssignerEvenRackAwareGoal(RackAwareDistributionGoal):
-    """Even rack spread (the round-robin fixed point), hard."""
+class KafkaAssignerEvenRackAwareGoal(RackAwareGoal):
+    """STRICT rack awareness (each replica of a partition on a distinct
+    rack), hard — the reference's even-rack goal enforces
+    ensureRackAwareSatisfiable/ensureRackAware
+    (KafkaAssignerEvenRackAwareGoal.java:302-356: throws when max RF exceeds
+    the alive-rack count, and requires distinct racks per partition), i.e.
+    RackAwareGoal's contract; the position-by-position round-robin is its
+    packing order, not a weaker ceil-based spread."""
 
     def __post_init__(self):
         super().__post_init__()

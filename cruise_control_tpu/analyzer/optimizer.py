@@ -18,6 +18,7 @@ import time
 from functools import lru_cache
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from cruise_control_tpu.analyzer.engine import EngineParams, optimize_goal
@@ -57,6 +58,8 @@ class GoalResult:
     stat_after: float
     hit_max_iters: bool = False   # iteration budget exhausted while progressing
     passes: int = 0               # engine while_loop trips (scoring passes)
+    stat_before: float = 0.0      # goal's own stat entering ITS run (rolling
+    #                               monotonicity oracle, AbstractGoal:110-119)
 
 
 @dataclasses.dataclass
@@ -242,8 +245,12 @@ class GoalOptimizer:
             num_leader_candidates=min(1024, max(self._params.num_leader_candidates,
                                                 ct.num_brokers // 8)),
             # swaps are the stall-breaking last resort: the [K1, K2] pair
-            # scoring is quadratic, so grow the pool sub-linearly
-            num_swap_candidates=min(256, max(self._params.num_swap_candidates,
+            # scoring is quadratic, so grow the pool sub-linearly. Hard cap
+            # 128: swap-candidate pools >=220 reproducibly kernel-fault the
+            # TPU runtime at 7k-broker/1M-replica shapes (bisected 2026-07-31:
+            # 32/64/128 fine, 220/256 crash inside the applied swap wave);
+            # alignment is not the trigger (256 crashes too)
+            num_swap_candidates=min(128, max(self._params.num_swap_candidates,
                                              ct.num_brokers // 32)))
 
         tml = self._min_leader_mask(meta, min_leader_topic_pattern)
@@ -296,6 +303,7 @@ class GoalOptimizer:
                 stat_after=float(info["stat"]),
                 hit_max_iters=bool(info.get("hit_max_iters", False)),
                 passes=int(info.get("passes", 0)),
+                stat_before=float(info.get("stat_before", 0.0)),
             )
             for g, info, dur in zip(goals, infos, durations)
         ]
@@ -351,46 +359,71 @@ class GoalOptimizer:
         return result
 
 
+@jax.jit
+def _stats_device(env: ClusterEnv, st: EngineState):
+    """All ClusterModelStats reductions ON DEVICE — fetching the raw [T, B]
+    topic table to the host costs seconds over a tunneled device; this
+    returns a few dozen scalars instead."""
+    alive = env.broker_alive
+    af = alive.astype(jnp.float32)
+    n = jnp.maximum(af.sum(), 1.0)
+
+    def four_masked(a, mask, nm):
+        a = a.astype(jnp.float32)
+        any_m = jnp.any(mask)
+        s = jnp.where(mask, a, 0.0).sum() / nm
+        # all-False mask (no alive brokers / no real topics) -> 0.0, not inf
+        mx = jnp.where(any_m, jnp.where(mask, a, -jnp.inf).max(), 0.0)
+        mn = jnp.where(any_m, jnp.where(mask, a, jnp.inf).min(), 0.0)
+        var = jnp.where(mask, (a - s) ** 2, 0.0).sum() / nm
+        return dict(avg=s, max=mx, min=mn, std=jnp.sqrt(var))
+
+    per_res = [four_masked(st.util[:, r], alive, n) for r in range(4)]
+    util = {k: [per_res[r][k] for r in range(4)]
+            for k in ("avg", "max", "min", "std")}
+    rc = four_masked(st.replica_count, alive, n)
+    lc = four_masked(st.leader_count, alive, n)
+    pot = four_masked(st.potential_nw_out, alive, n)
+    tbc = jnp.where(alive[None, :], st.topic_broker_count, 0)
+    real = tbc.sum(axis=1) > 0
+    nt = jnp.maximum(real.sum().astype(jnp.float32), 1.0)
+    tmask = real[:, None] & alive[None, :]
+    ntb = nt * n
+    trc = four_masked(tbc.reshape(-1), tmask.reshape(-1), ntb)
+    return {
+        "util": util, "rc": rc, "lc": lc, "pot": pot, "trc": trc,
+        "num_offline": (st.replica_offline & env.replica_valid).sum(),
+        "num_brokers": alive.sum(),
+        "num_replicas": env.replica_valid.sum(),
+        "num_topics": real.sum(),
+    }
+
+
 def cluster_stats_state(env: ClusterEnv, st: EngineState) -> dict:
     """Stats over the engine state (ClusterModelStats.java:30-44 field set:
     AVG/MAX/MIN/STD over alive brokers for resource utilization, potential
     NW-out, replica / leader-replica / topic-replica counts, plus the
     metadata counts used by ClusterModelStatsMetaData)."""
-    (alive, util, counts, lcounts, pot, offline, valid, tbc) = jax.device_get(
-        (env.broker_alive, st.util, st.replica_count, st.leader_count,
-         st.potential_nw_out, st.replica_offline, env.replica_valid,
-         st.topic_broker_count))
-    util = util[alive]
-    counts = counts[alive]
-    lcounts = lcounts[alive]
-    pot = pot[alive]
-    # topic-replica stats: per-(topic, alive broker) replica counts of topics
-    # that actually exist (ClusterModelStats topicReplicaStats role)
-    tbc = tbc[:, alive]
-    real_topics = tbc.sum(axis=1) > 0
-    trc = tbc[real_topics].astype(float)
+    d = jax.device_get(_stats_device(env, st))
 
-    def four(a, empty=0.0):
-        if a.size == 0:
-            return dict(avg=empty, max=empty, min=empty, std=empty)
-        return dict(avg=float(a.mean()), max=float(a.max()),
-                    min=float(a.min()), std=float(a.std()))
+    def four(x):
+        return {k: float(v) for k, v in x.items()}
 
     return {
-        "avg": util.mean(axis=0).tolist() if util.size else [],
-        "max": util.max(axis=0).tolist() if util.size else [],
-        "min": util.min(axis=0).tolist() if util.size else [],
-        "std": util.std(axis=0).tolist() if util.size else [],
-        "replica_count_avg": float(counts.mean()) if counts.size else 0.0,
-        "replica_count_max": int(counts.max()) if counts.size else 0,
-        "replica_count_min": int(counts.min()) if counts.size else 0,
-        "replica_count_std": float(counts.std()) if counts.size else 0.0,
-        "leader_count": four(lcounts.astype(float)),
-        "topic_replica_count": four(trc),
-        "potential_nw_out": four(pot),
-        "potential_nw_out_max": float(pot.max()) if pot.size else 0.0,
-        "num_offline_replicas": int((offline & valid).sum()),
-        "num_brokers": int(alive.sum()),
-        "num_replicas": int(valid.sum()),
-        "num_topics": int(real_topics.sum()),
+        "avg": [float(x) for x in d["util"]["avg"]],
+        "max": [float(x) for x in d["util"]["max"]],
+        "min": [float(x) for x in d["util"]["min"]],
+        "std": [float(x) for x in d["util"]["std"]],
+        "replica_count_avg": float(d["rc"]["avg"]),
+        "replica_count_max": int(d["rc"]["max"]),
+        "replica_count_min": int(d["rc"]["min"]),
+        "replica_count_std": float(d["rc"]["std"]),
+        "leader_count": four(d["lc"]),
+        "topic_replica_count": four(d["trc"]),
+        "potential_nw_out": four(d["pot"]),
+        "potential_nw_out_max": float(d["pot"]["max"]),
+        "num_offline_replicas": int(d["num_offline"]),
+        "num_brokers": int(d["num_brokers"]),
+        "num_replicas": int(d["num_replicas"]),
+        "num_topics": int(d["num_topics"]),
     }

@@ -153,11 +153,14 @@ def run_row(fixture_factory, chain, constraint, pattern):
         min_leader_topic_pattern=pattern)
 
 
-@pytest.mark.parametrize(
-    "row_id,fixture_factory,chain,constraint,pattern,expected",
-    MATRIX, ids=[m[0] for m in MATRIX])
-def test_java_matrix(row_id, fixture_factory, chain, constraint, pattern,
-                     expected):
+# first half here; tests/test_java_parity_matrix2.py runs the rest — the
+# split halves the per-xdist-worker XLA:CPU compile count (a single worker
+# compiling the whole matrix trips the 1-core host's compiler crash)
+MATRIX_A = MATRIX[:len(MATRIX) // 2]
+MATRIX_B = MATRIX[len(MATRIX) // 2:]
+
+
+def _run_matrix_row(fixture_factory, chain, constraint, pattern, expected):
     if expected == "raise":
         with pytest.raises(OptimizationFailureError):
             run_row(fixture_factory, chain, constraint, pattern)
@@ -182,3 +185,11 @@ def test_java_matrix(row_id, fixture_factory, chain, constraint, pattern,
                          "KafkaAssignerEvenRackAwareGoal")]
     assert not hard_violated, f"hard goals violated: {hard_violated}"
     verify(ct, meta, res, verifications=("REGRESSION",))
+
+
+@pytest.mark.parametrize(
+    "row_id,fixture_factory,chain,constraint,pattern,expected",
+    MATRIX_A, ids=[m[0] for m in MATRIX_A])
+def test_java_matrix(row_id, fixture_factory, chain, constraint, pattern,
+                     expected):
+    _run_matrix_row(fixture_factory, chain, constraint, pattern, expected)
