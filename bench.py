@@ -71,6 +71,7 @@ RUNG_COST_EST = {
     "3": (560, 90),
     "4": (1600, 450),
     "5": (1700, 500),
+    "e2e": (400, 120),
 }
 
 
@@ -206,7 +207,7 @@ def main() -> None:
     skip_cold = "--skip-cold" in flags
     repeats = 1 if skip_cold else 2
     # headline first: a harness timeout can then never cost the headline
-    order = args if args else ["4", "5", "2", "3", "1"]
+    order = args if args else ["4", "5", "2", "3", "1", "e2e"]
 
     for rung_id in order:
         if rung_id not in RUNG_COST_EST:
@@ -273,11 +274,80 @@ def main() -> None:
                 "IntraBrokerDiskUsageDistributionGoal"],
                 repeats=repeats, profile=profile)
 
+        elif rung_id == "e2e":
+            # samples -> windows -> ClusterTensor -> proposals END TO END at
+            # rung-3 scale (LoadMonitor.java:539-591 +
+            # cluster-model-creation-timer role): measures the monitor path
+            # the synthetic rungs skip
+            rung = run_e2e_rung()
+
         SUMMARY.rungs.append(rung)
         SUMMARY.emit()
 
     log(f"total bench time {time.monotonic() - T_START:.1f}s")
     SUMMARY.emit(final=True)
+
+
+def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000) -> dict:
+    import numpy as np  # noqa: F811
+
+    from cruise_control_tpu.app import CruiseControl
+    from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+    from cruise_control_tpu.config import cruise_control_config
+
+    log(f"rung e2e: backend->samples->tensor->proposals "
+        f"({num_brokers} brokers / {num_partitions} partitions RF2)")
+    rng = np.random.default_rng(7)
+    t0 = time.monotonic()
+    be = SimulatedClusterBackend()
+    for b in range(num_brokers):
+        be.add_broker(b, f"r{b % 20}")
+    leaders = rng.integers(0, num_brokers // 4, num_partitions)  # skewed
+    follows = (leaders + 1 + rng.integers(0, num_brokers - 2,
+                                          num_partitions)) % num_brokers
+    sizes = rng.exponential(200.0, num_partitions)
+    for p in range(num_partitions):
+        be.create_partition("t%d" % (p % 200), p,
+                            [int(leaders[p]), int(follows[p])],
+                            size_mb=float(sizes[p]),
+                            bytes_in_rate=float(sizes[p] / 10),
+                            bytes_out_rate=float(sizes[p] / 5),
+                            cpu_util=float(sizes[p] / 300))
+    seed_s = time.monotonic() - t0
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+    cc.start_up()
+    t0 = time.monotonic()
+    rounds = 5
+    for i in range(rounds):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    sample_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    ct, meta = cc.load_monitor.cluster_model()
+    model_s = time.monotonic() - t0
+    # cold + warm optimize runs, like every other rung (wall_s = warm)
+    walls = []
+    res = None
+    for _ in range(2):
+        t0 = time.monotonic()
+        res = cc.goal_optimizer.optimizations(ct, meta, raise_on_failure=False,
+                                              skip_hard_goal_check=True)
+        walls.append(time.monotonic() - t0)
+    rung = {
+        "config": f"e2e-{num_brokers}b-{num_partitions}p",
+        "seed_backend_s": round(seed_s, 2),
+        "sampling_s_per_round": round(sample_s / rounds, 2),
+        "cluster_model_s": round(model_s, 2),
+        "optimize_s": round(walls[-1], 2),
+        "wall_s": round(model_s + walls[-1], 3),
+        "wall_s_cold": round(model_s + walls[0], 3),
+        "warm_measured": True,
+        "violations_after": len(res.violated_goals_after),
+        "num_replica_movements": res.num_replica_movements,
+    }
+    log(f"  [e2e] seed={seed_s:.1f}s sample={sample_s / rounds:.2f}s/round "
+        f"model={model_s:.2f}s optimize cold={walls[0]:.2f}s warm={walls[-1]:.2f}s")
+    return rung
 
 
 if __name__ == "__main__":

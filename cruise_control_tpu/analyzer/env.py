@@ -94,6 +94,7 @@ class OptimizationOptions:
                       "replica_partition", "replica_topic", "replica_valid",
                       "replica_original_broker", "partition_replicas", "partition_topic",
                       "topic_excluded", "topic_min_leaders", "dst_candidate",
+                      "replica_topic_excluded",
                       "num_real_racks"],
          meta_fields=["num_racks", "max_rf"])
 @dataclasses.dataclass(frozen=True)
@@ -111,6 +112,9 @@ class ClusterEnv:
     broker_disk_alive: Array     # bool[B, D]
     replica_partition: Array    # i32[R]
     replica_topic: Array        # i32[R]
+    replica_topic_excluded: Array  # bool[R] — topic_excluded hoisted to replica
+    #                               granularity ONCE (an [R]<-[T] gather costs
+    #                               ~8 ms per engine pass on TPU; static here)
     replica_valid: Array        # bool[R]
     replica_original_broker: Array  # i32[R]
     partition_replicas: Array   # i32[P, F] replica indices, -1 padded
@@ -194,6 +198,7 @@ def make_env(ct: ClusterTensor, meta: ClusterMeta,
         broker_disk_alive=ct.broker_disk_alive,
         replica_partition=ct.replica_partition,
         replica_topic=ct.replica_topic,
+        replica_topic_excluded=ct.topic_excluded[ct.replica_topic],
         replica_valid=ct.replica_valid,
         replica_original_broker=ct.replica_original_broker,
         partition_replicas=jnp.asarray(table),

@@ -219,6 +219,19 @@ def broker_lookup(rb: Array, *cols: Array) -> Array:
     return table[rb][:, :k]
 
 
+def spread_jitter(num_replicas: int) -> Array:
+    """f32[R] deterministic per-replica multiplier in [0.5, 1.0) used to mix
+    candidate keys ACROSS brokers. Count-goal keys of the form
+    ``1 - load/broker_total`` are ~1.0 for EVERY light replica of a broker
+    with many of them, so one such broker would monopolize the top-k pool
+    and starve other violating brokers (pass-count explosion). Scaling each
+    key by a hash-derived factor gives every broker top-k representation
+    roughly proportional to its candidate count while still preferring
+    lighter replicas. Pure elementwise — no gathers."""
+    h = (jnp.arange(num_replicas, dtype=jnp.uint32) * jnp.uint32(2654435761))
+    return 0.5 + (h >> 9).astype(jnp.float32) / jnp.float32(1 << 24)
+
+
 def candidate_load(env: ClusterEnv, st: EngineState, cand: Array) -> Array:
     """f32[K, M] current effective load rows of the candidate replicas."""
     lead = st.replica_is_leader[cand][:, None]
@@ -295,7 +308,7 @@ def legit_swap_mask(env: ClusterEnv, st: EngineState, cand_out: Array,
     out_ok = ~sib_on(cand_out, b_in)                        # [K1, K2] out's partition not on in's broker
     in_ok = ~sib_on(cand_in, b_out).T                       # [K1, K2]
     ok_r = (env.replica_valid & ~st.replica_offline
-            & ~env.topic_excluded[env.replica_topic])
+            & ~env.replica_topic_excluded)
     dst_ok = env.dst_candidate[b_in][None, :] & env.dst_candidate[b_out][:, None]
     # new-broker mode: each directed leg must target a new broker unless the
     # moving replica's original broker is new (same rule as legit_move_mask)

