@@ -513,9 +513,13 @@ class CruiseControl:
         if "ANALYZER" in substates:
             with self._cache_lock:
                 ready = self._proposal_cache is not None
+            from cruise_control_tpu.analyzer.goals import GOAL_CLASSES
             out["AnalyzerState"] = {
                 "isProposalReady": ready,
                 "goals": self.goal_optimizer.default_goal_names,
+                # every goal the analyzer can run on request (reference
+                # AnalyzerState.java goalReadiness catalog role)
+                "supportedGoals": sorted(GOAL_CLASSES),
             }
         if "ANOMALY_DETECTOR" in substates:
             out["AnomalyDetectorState"] = self.anomaly_detector.state_json()

@@ -19,8 +19,14 @@ from cruise_control_tpu.config.configdef import (
 # Goal catalog names (priority order = reference AnalyzerConfig DEFAULT_GOALS).
 # --------------------------------------------------------------------------
 DEFAULT_GOALS = [
+    # the chain RUN by default (reference AnalyzerConfig
+    # DEFAULT_DEFAULT_GOALS, :295-310): TopicReplicaDistribution runs BEFORE
+    # the leader goals, and PreferredLeaderElectionGoal is deliberately NOT
+    # here — it transfers leadership unconditionally (no acceptance checks,
+    # PreferredLeaderElectionGoal.java:139), so running it after the leader
+    # goals would re-violate them; it stays available on request via the
+    # supported-goals list / explicit goal parameters.
     "RackAwareGoal",
-    "RackAwareDistributionGoal",
     "MinTopicLeadersPerBrokerGoal",
     "ReplicaCapacityGoal",
     "DiskCapacityGoal",
@@ -33,11 +39,12 @@ DEFAULT_GOALS = [
     "NetworkInboundUsageDistributionGoal",
     "NetworkOutboundUsageDistributionGoal",
     "CpuUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
     "LeaderReplicaDistributionGoal",
     "LeaderBytesInDistributionGoal",
-    "TopicReplicaDistributionGoal",
-    "PreferredLeaderElectionGoal",
 ]
+# the full supported-goal catalog is the goal registry itself
+# (analyzer/goals/__init__.py GOAL_CLASSES) — surfaced via /state AnalyzerState
 
 DEFAULT_HARD_GOALS = [
     "RackAwareGoal",

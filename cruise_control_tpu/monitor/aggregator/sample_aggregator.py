@@ -131,12 +131,20 @@ class MetricSampleAggregator:
         if row is None:
             row = len(self._entities)
             self._entities[entity] = row
-            W1 = self._num_windows + 1
-            M = self._metric_def.num_metrics
-            self._sum = np.concatenate([self._sum, np.zeros((1, W1, M))])
-            self._max = np.concatenate([self._max, np.full((1, W1, M), -np.inf)])
-            self._latest = np.concatenate([self._latest, np.zeros((1, W1, M))])
-            self._counts = np.concatenate([self._counts, np.zeros((1, W1), np.int32)])
+            if row >= self._sum.shape[0]:
+                # amortized doubling: a concatenate PER new entity is O(E)
+                # copy each -> O(E^2) on the first sampling round (minutes at
+                # 500k partitions); geometric growth keeps ingestion linear
+                grow = max(64, self._sum.shape[0])
+                W1 = self._num_windows + 1
+                M = self._metric_def.num_metrics
+                self._sum = np.concatenate([self._sum, np.zeros((grow, W1, M))])
+                self._max = np.concatenate(
+                    [self._max, np.full((grow, W1, M), -np.inf)])
+                self._latest = np.concatenate(
+                    [self._latest, np.zeros((grow, W1, M))])
+                self._counts = np.concatenate(
+                    [self._counts, np.zeros((grow, W1), np.int32)])
         return row
 
     def _slot_of(self, window: int) -> int | None:
@@ -231,10 +239,11 @@ class MetricSampleAggregator:
         n_exist = self._current_window - max(self._first_window, self._oldest_window)
         W = max(min(W, n_exist), 0)
         lo_slot = self._num_windows - W
-        counts = self._counts[:, lo_slot:self._num_windows]          # [E, W]
-        sums = self._sum[:, lo_slot:self._num_windows]               # [E, W, M]
-        maxs = self._max[:, lo_slot:self._num_windows]
-        lasts = self._latest[:, lo_slot:self._num_windows]
+        # slice off spare capacity rows (see _entity_row's doubling growth)
+        counts = self._counts[:E, lo_slot:self._num_windows]         # [E, W]
+        sums = self._sum[:E, lo_slot:self._num_windows]              # [E, W, M]
+        maxs = self._max[:E, lo_slot:self._num_windows]
+        lasts = self._latest[:E, lo_slot:self._num_windows]
 
         own = np.where(self._is_avg[None, None, :],
                        sums / np.maximum(counts[:, :, None], 1),
