@@ -11,9 +11,17 @@ per-iteration kernel in the engine is either
 so sharding the broker axis across a 1-D ``Mesh(("brokers",))`` splits the
 scoring work and state while XLA inserts the collectives (argmax over the
 sharded axis becomes a cross-device reduce; scatter updates stay local to the
-owning shard). Replica-axis arrays are replicated in v1 — at the 7k-broker /
-1M-replica north star the [K, B] scoring and [B]-state dominate; replica
-sharding (segment-sum via reduce_scatter) is the next step up.
+owning shard).
+
+REPLICA-axis leaves (the [R]-shaped load rows, assignment, candidate keys)
+shard along the SAME 1-D device axis: per-replica key computation and the
+packed broker-table gathers run on local shards (the broker tables are
+small and replicated), segment-sums into broker bins become per-shard
+partials + cross-device reduce (psum / reduce_scatter, inserted by GSPMD),
+and top-k over the sharded replica axis lowers to per-shard top-k + a
+cross-device merge. At the 7k-broker / 1M-replica north star this splits
+the ~44 MB of per-replica state and the dominant O(R) key work n ways
+instead of replicating it.
 
 This module only *places* data: the engine code is unchanged — jit propagates
 input shardings through the whole while_loop (GSPMD), which is exactly the
@@ -43,6 +51,16 @@ _STATE_BROKER_AXES = {
     "util": 0, "leader_util": 0, "potential_nw_out": 0, "replica_count": 0,
     "leader_count": 0, "topic_broker_count": 1, "topic_leader_count": 1,
     "disk_util": 0,
+}
+# replica-dim leaves sharded along the same device axis
+_ENV_REPLICA_AXES = {
+    "leader_load": 0, "follower_load": 0, "replica_partition": 0,
+    "replica_topic": 0, "replica_topic_excluded": 0, "replica_valid": 0,
+    "replica_original_broker": 0,
+}
+_STATE_REPLICA_AXES = {
+    "replica_broker": 0, "replica_is_leader": 0, "replica_offline": 0,
+    "replica_disk": 0, "moved": 0, "leadership_moved": 0,
 }
 
 
@@ -81,17 +99,57 @@ def pad_brokers(ct_arrays_factory, num_brokers: int, multiple: int) -> int:
     return num_brokers if rem == 0 else num_brokers + (multiple - rem)
 
 
-def shard_cluster(env: ClusterEnv, st: EngineState, mesh: Mesh):
-    """Place (env, state) on the mesh: broker-dim leaves sharded, rest
-    replicated. The broker count must divide evenly by the mesh size."""
+def _axes_maps(shard_replicas: bool) -> tuple[dict, dict]:
+    """(env_axes, state_axes) for a placement — single source of truth for
+    shard_cluster and per_device_bytes."""
+    env_axes = dict(_ENV_BROKER_AXES)
+    st_axes = dict(_STATE_BROKER_AXES)
+    if shard_replicas:
+        env_axes.update(_ENV_REPLICA_AXES)
+        st_axes.update(_STATE_REPLICA_AXES)
+    return env_axes, st_axes
+
+
+def shard_cluster(env: ClusterEnv, st: EngineState, mesh: Mesh,
+                  shard_replicas: bool = True):
+    """Place (env, state) on the mesh: broker-dim leaves sharded along the
+    device axis, replica-dim leaves likewise (``shard_replicas=False`` keeps
+    the v1 replicated-replica placement), everything else replicated. Broker
+    and replica counts must divide evenly by the mesh size (the shape
+    buckets of pad_cluster make the replica axis a multiple of 8)."""
     B = env.num_brokers
     n = mesh.devices.size
     if B % n != 0:
         raise ValueError(f"num_brokers={B} must be a multiple of mesh size {n}; "
                          f"pad the cluster with dead brokers (pad_brokers)")
-    env_s = _place(env, _ENV_BROKER_AXES, mesh)
-    st_s = _place(st, _STATE_BROKER_AXES, mesh)
+    if shard_replicas and env.num_replicas % n != 0:
+        raise ValueError(f"num_replicas={env.num_replicas} must be a "
+                         f"multiple of mesh size {n} (use pad_cluster)")
+    env_axes, st_axes = _axes_maps(shard_replicas)
+    env_s = _place(env, env_axes, mesh)
+    st_s = _place(st, st_axes, mesh)
     return env_s, st_s
+
+
+def per_device_bytes(env: ClusterEnv, st: EngineState, mesh: Mesh,
+                     shard_replicas: bool = True) -> dict:
+    """Analytic per-device memory footprint of the placed (env, state):
+    sharded leaves contribute nbytes / mesh-size, replicated leaves their
+    full size. Returns {"sharded": ..., "replicated": ..., "total": ...}."""
+    n = mesh.devices.size
+    env_axes, st_axes = _axes_maps(shard_replicas)
+    sharded = replicated = 0
+    for obj, axes in ((env, env_axes), (st, st_axes)):
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if not hasattr(v, "nbytes"):
+                continue
+            if f.name in axes:
+                sharded += v.nbytes // n
+            else:
+                replicated += v.nbytes
+    return {"sharded": sharded, "replicated": replicated,
+            "total": sharded + replicated}
 
 
 def replicate(tree, mesh: Mesh):

@@ -67,17 +67,48 @@ def mesh():
 
 def test_mesh_and_placement(mesh):
     env, st = _setup()
-    env_s, st_s = shard_cluster(env, st, mesh)
+    env_s, st_s = shard_cluster(env, st, mesh, shard_replicas=False)
     # broker-axis leaves really are sharded across the mesh ...
     spec = env_s.broker_capacity.sharding.spec
     assert spec[0] == BROKER_AXIS
     assert st_s.util.sharding.spec[0] == BROKER_AXIS
     # topic_broker_count shards its axis-1 (broker) dim
     assert st_s.topic_broker_count.sharding.spec[1] == BROKER_AXIS
-    # ... replica-axis leaves are replicated
+    # ... replica-axis leaves are replicated in the v1 placement
     assert st_s.replica_broker.sharding.is_fully_replicated
     # values unchanged by placement
     np.testing.assert_array_equal(np.asarray(st_s.util), np.asarray(st.util))
+
+
+def test_replica_axis_sharding_placement_and_equality(mesh):
+    """Default placement shards the replica axis too; the engine result is
+    bit-identical to the unsharded run (the dryrun_multichip contract)."""
+    from cruise_control_tpu.analyzer.engine import EngineParams, optimize_goal
+    from cruise_control_tpu.analyzer.goals import make_goals
+
+    ct, meta = _skewed_cluster(num_brokers=16)
+    env = make_env(ct, meta)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    assert env.num_replicas % 8 == 0, "fixture must pad replicas to the mesh"
+    env_s, st_s = shard_cluster(env, st, mesh)
+    assert env_s.leader_load.sharding.spec[0] == BROKER_AXIS
+    assert st_s.replica_broker.sharding.spec[0] == BROKER_AXIS
+    params = EngineParams(max_iters=32)
+    goals = make_goals(["DiskCapacityGoal", "ReplicaDistributionGoal",
+                        "DiskUsageDistributionGoal"])
+    prev = []
+    for g in goals:
+        st_s, _ = optimize_goal(env_s, st_s, g, tuple(prev), params)
+        prev.append(g)
+    prev = []
+    for g in goals:
+        st, _ = optimize_goal(env, st, g, tuple(prev), params)
+        prev.append(g)
+    np.testing.assert_array_equal(np.asarray(st_s.replica_broker),
+                                  np.asarray(st.replica_broker))
+    np.testing.assert_allclose(np.asarray(st_s.util), np.asarray(st.util),
+                               atol=1e-3)
 
 
 def test_shard_cluster_rejects_indivisible(mesh):
