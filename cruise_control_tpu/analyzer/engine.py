@@ -62,6 +62,25 @@ import os as _os  # noqa: E402
 _DEBUG_DISABLE = set((_os.environ.get("CC_DEBUG_DISABLE") or "").split(","))
 
 
+def _stall_explore(key: Array, stall: Array, salt: int = 0) -> Array:
+    """Re-key candidates for a STALLED pass: the ranked order just yielded
+    zero actions, so rank the eligible set by a (replica, stall)-salted hash
+    instead — each retry pass surfaces a fresh pseudo-random top-K subset.
+    Ineligible rows stay -inf; offline-healing candidates (key >= 1e12) keep
+    priority via a +2.0 bump — adding the full 1e12 would absorb the [0,1)
+    hash below the f32 ulp (65536 at 1e12) and freeze their retry order.
+    ``salt`` decorrelates pools salted in the same pass (swap out vs in)."""
+    R = key.shape[0]
+    h = (jnp.arange(R, dtype=jnp.uint32) * jnp.uint32(2246822519)
+         + (stall.astype(jnp.uint32) + jnp.uint32(salt))
+         * jnp.uint32(3266489917))
+    h = (h ^ (h >> 15)) * jnp.uint32(2654435761)
+    r01 = (h >> 8).astype(jnp.float32) / jnp.float32(1 << 24)
+    salted = jnp.where(key > NEG_INF,
+                       r01 + jnp.where(key >= 1e12, 2.0, 0.0), NEG_INF)
+    return jnp.where(stall > 0, salted, key)
+
+
 def _top_candidates(key: Array, k: int, exact: bool = False):
     """Candidate selection. Soft goals use approximate top-k
     (jax.lax.approx_max_k, recall 0.95) — the TPU-native partial reduction is
@@ -89,6 +108,14 @@ class EngineParams:
     # zero cap removes the loop from the compiled program entirely
     max_leftover: int = 0             # cap on sequential leftover re-scores
     max_seq_swaps: int = 0            # cap on sequential swap applications
+    # a zero-action pass does NOT terminate the goal immediately: the ranked
+    # top-K window may simply contain no applicable candidate while
+    # thousands exist outside it (measured: 20k+ applicable accepted moves
+    # remaining after a single-stall exit at rung 2). Stalled passes re-key
+    # candidates with a pass-salted pseudo-random ranking over the eligible
+    # set, exploring fresh subsets; the goal exits after this many
+    # consecutive fruitless passes.
+    stall_retries: int = 8
 
 
 def _wave_budget_capable(g: GoalKernel, leadership: bool = False) -> bool:
@@ -229,7 +256,8 @@ def _rescore_move_row(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
 
 def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-                         prev_goals: tuple, params: EngineParams, severity: Array):
+                         prev_goals: tuple, params: EngineParams,
+                         severity: Array, stall: Array):
     """Score once, wave-apply the independent winners, re-score leftovers.
 
     A pass is three stages:
@@ -264,7 +292,7 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     Compared to one-move-per-pass, a pass lands up to K moves for little
     more than one scoring sweep (reference hot loop it replaces:
     ResourceDistributionGoal.java:384-862)."""
-    key = goal.replica_key(env, st, severity)
+    key = _stall_explore(goal.replica_key(env, st, severity), stall)
     kv, cand = _top_candidates(key, min(params.num_candidates, env.num_replicas),
                                exact=goal.is_hard)
     mask = legit_move_mask(env, st, cand, goal.options)
@@ -365,7 +393,7 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
 def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                                prev_goals: tuple, params: EngineParams,
-                               severity: Array):
+                               severity: Array, stall: Array):
     """Leadership analogue of _move_branch_batched: one [KL, F] scoring pass,
     then budgeted wave admission (each candidate is a distinct partition's
     leader, so rows never conflict on partition state; per-broker cumulative
@@ -373,7 +401,7 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
     combined band slack), one batched apply, sequential re-scored leftovers
     when the wave was thin. Falls back to fully sequential application for
     chains with non-budget-capable goals."""
-    lkey = goal.leader_key(env, st, severity)
+    lkey = _stall_explore(goal.leader_key(env, st, severity), stall)
     lkv, lcand = _top_candidates(lkey, min(params.num_leader_candidates,
                                            env.num_replicas),
                                  exact=goal.is_hard)
@@ -468,7 +496,8 @@ def _rescore_swap_pair(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
 
 def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-                         prev_goals: tuple, params: EngineParams, severity: Array):
+                         prev_goals: tuple, params: EngineParams,
+                         severity: Array, stall: Array):
     """Swap analogue of _move_branch_batched: one [K1, K2] scoring pass, then
     a WAVE of independent swaps applies in one batched update. Admission, in
     score order, pairs each out-candidate with its best counterparty and
@@ -491,6 +520,8 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     k = min(params.num_swap_candidates, env.num_replicas, 128)
     okey = goal.swap_out_key(env, st, severity)
     ikey = goal.swap_in_key(env, st, severity)
+    okey = _stall_explore(okey, stall)
+    ikey = _stall_explore(ikey, stall, salt=101)   # decorrelate from okey
     okv, cand_out = _top_candidates(okey, k, exact=goal.is_hard)
     ikv, cand_in = _top_candidates(ikey, k, exact=goal.is_hard)
     mask = legit_swap_mask(env, st, cand_out, cand_in)
@@ -573,11 +604,11 @@ def _rescore_disk_move_row(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
 def _disk_move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                               prev_goals: tuple, params: EngineParams,
-                              severity: Array):
+                              severity: Array, stall: Array):
     """Intra-broker analogue of _move_branch_batched: destinations are the D
     logdirs of each candidate's own broker (IntraBrokerDiskUsageDistribution
     Goal.java:518 hot loop role). [K, D] scoring, per-move [1, D] re-score."""
-    key = goal.replica_key(env, st, severity)
+    key = _stall_explore(goal.replica_key(env, st, severity), stall)
     kv, cand = _top_candidates(key, min(params.num_candidates, env.num_replicas),
                                exact=goal.is_hard)
     mask = legit_disk_move_mask(env, st, cand)
@@ -636,7 +667,7 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
         stat_before = goal.stat(env, st)
 
         def step(carry):
-            st, it, n_applied, _progress = carry
+            st, it, n_applied, stall = carry
             severity = goal.broker_severity(env, st)
 
             # 0. intra-broker disk moves (IntraBroker*Goal actions never leave
@@ -645,14 +676,14 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
             if goal.uses_disk_moves:
                 st, n_disk = _disk_move_branch_batched(env, st, goal,
                                                        prev_goals, params,
-                                                       severity)
+                                                       severity, stall)
 
             # 1. replica moves (cheapest per unit of work on TPU: one scoring
             #    pass lands up to K moves)
             n_moves = jnp.int32(0)
             if goal.uses_replica_moves:
                 st, n_moves = _move_branch_batched(env, st, goal, prev_goals,
-                                                   params, severity)
+                                                   params, severity, stall)
 
             # 2. leadership transfers — only when no move landed; gated by a
             #    zero/one trip count, NOT lax.cond (a cond carrying the full
@@ -663,7 +694,7 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
                     s, _n = carry
                     return _leadership_branch_batched(
                         env, s, goal, prev_goals, params,
-                        goal.broker_severity(env, s))
+                        goal.broker_severity(env, s), stall)
                 st, n_leads = jax.lax.fori_loop(
                     0, jnp.where(n_moves == 0, 1, 0), lead_body,
                     (st, jnp.int32(0)))
@@ -676,25 +707,27 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
                     s, _n = carry
                     return _swap_branch_batched(env, s, goal, prev_goals,
                                                 params,
-                                                goal.broker_severity(env, s))
+                                                goal.broker_severity(env, s),
+                                                stall)
                 st, n_swaps = jax.lax.fori_loop(
                     0, jnp.where((n_moves + n_leads) == 0, 1, 0), swap_body,
                     (st, jnp.int32(0)))
 
             applied = n_disk + n_moves + n_leads + n_swaps
-            progress = applied > 0
-            return st, it + 1, n_applied + applied, progress
+            # fruitless pass -> escalate exploration; any action resets it
+            stall = jnp.where(applied > 0, jnp.int32(0), stall + 1)
+            return st, it + 1, n_applied + applied, stall
 
         def cond_fn(carry):
-            _st, it, _n, progress = carry
-            return progress & (it < params.max_iters)
+            _st, it, _n, stall = carry
+            return (stall <= params.stall_retries) & (it < params.max_iters)
 
-        st, iters, n_applied, progress = jax.lax.while_loop(
-            cond_fn, step, (st, jnp.int32(0), jnp.int32(0), jnp.bool_(True)))
+        st, iters, n_applied, stall = jax.lax.while_loop(
+            cond_fn, step, (st, jnp.int32(0), jnp.int32(0), jnp.int32(0)))
         violated = goal.violated(env, st)
-        # progress still true at the iteration cap = budget exhausted, NOT
-        # converged — downstream must not treat the state as final
-        hit_max_iters = progress & (iters >= params.max_iters)
+        # stopped by the iteration cap while still applying actions = budget
+        # exhausted, NOT converged — downstream must not treat it as final
+        hit_max_iters = (stall <= params.stall_retries) & (iters >= params.max_iters)
         return st, {"iterations": n_applied, "passes": iters,
                     "violated_after": violated,
                     "hit_max_iters": hit_max_iters,

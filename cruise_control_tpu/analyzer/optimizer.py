@@ -240,8 +240,12 @@ class GoalOptimizer:
         # larger K costs [K, B] scoring, not a bigger sort
         params = dataclasses.replace(
             self._params,
+            # K scales with brokers AND replicas: at small B with many
+            # replicas, a B-derived K leaves most of the eligible set
+            # unexplored (search holes the plateau-fixpoint test measures)
             num_candidates=min(2048, max(self._params.num_candidates,
-                                         ct.num_brokers // 4)),
+                                         ct.num_brokers // 4,
+                                         ct.num_replicas // 64)),
             num_leader_candidates=min(1024, max(self._params.num_leader_candidates,
                                                 ct.num_brokers // 8)),
             # swaps are the stall-breaking last resort: the [K1, K2] pair

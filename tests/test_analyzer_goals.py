@@ -199,7 +199,10 @@ def test_budgeted_wave_respects_capacity_band():
     st = init_state(env, ct.replica_broker, ct.replica_is_leader,
                     ct.replica_offline, ct.replica_disk)
     goal = make_goal("DiskUsageDistributionGoal")
-    st, info = optimize_goal(env, st, goal, (), EngineParams(max_iters=64))
+    # stall_retries=0: this test bounds the number of PRODUCTIVE passes a
+    # budgeted wave needs; exploration retries would pad the count
+    st, info = optimize_goal(env, st, goal, (),
+                             EngineParams(max_iters=64, stall_retries=0))
     util = np.asarray(st.util)[:, 3]
     alive_utils = util[:6]
     # cluster balances: no broker outside the band afterwards
