@@ -243,7 +243,11 @@ class GoalOptimizer:
             # K scales with brokers AND replicas: at small B with many
             # replicas, a B-derived K leaves most of the eligible set
             # unexplored (search holes the plateau-fixpoint test measures)
-            num_candidates=min(2048, max(self._params.num_candidates,
+            # cap 1760: K=2048 move-branch programs reproducibly
+            # kernel-fault the TPU runtime at 1M-replica shapes (same
+            # failure mode as the swap-pool >=220 fault; 1760 is the
+            # largest bisect-proven-safe pool)
+            num_candidates=min(1760, max(self._params.num_candidates,
                                          ct.num_brokers // 4,
                                          ct.num_replicas // 64)),
             num_leader_candidates=min(1024, max(self._params.num_leader_candidates,
