@@ -738,9 +738,12 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
             cond_fn, step, (st, jnp.int32(0), jnp.int32(0), jnp.int32(0),
                             jnp.int32(0)))
         violated = goal.violated(env, st)
-        # stopped by the iteration cap while still applying actions = budget
-        # exhausted, NOT converged — downstream must not treat it as final
-        hit_max_iters = (stall <= params.stall_retries) & (iters >= params.max_iters)
+        # stopped by the iteration cap OR the dribble tail budget while still
+        # applying actions = budget exhausted, NOT converged — downstream
+        # must not report it as a proven fixpoint
+        hit_max_iters = ((stall <= params.stall_retries)
+                         & ((iters >= params.max_iters)
+                            | (dribble > params.tail_pass_budget)))
         return st, {"iterations": n_applied, "passes": iters,
                     "violated_after": violated,
                     "hit_max_iters": hit_max_iters,

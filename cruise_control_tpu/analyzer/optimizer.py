@@ -106,18 +106,20 @@ class OptimizerResult:
         return out
 
 
-def _balancedness(goals, results_violated: dict) -> float:
+def _balancedness(goals, results_violated: dict,
+                  priority_weight: float = BALANCEDNESS_PRIORITY_WEIGHT,
+                  strictness_weight: float = BALANCEDNESS_STRICTNESS_WEIGHT) -> float:
     """Weighted fraction of satisfied goals (GoalViolationDetector.java:104
     balancedness score role): hard goals weigh strictness x priority more."""
     total = 0.0
     got = 0.0
     weight = 1.0
     for g in reversed(goals):  # lowest priority gets weight 1, each step x1.1
-        w = weight * (BALANCEDNESS_STRICTNESS_WEIGHT if g.is_hard else 1.0)
+        w = weight * (strictness_weight if g.is_hard else 1.0)
         total += w
         if not results_violated.get(g.name, False):
             got += w
-        weight *= BALANCEDNESS_PRIORITY_WEIGHT
+        weight *= priority_weight
     return 100.0 * got / total if total else 100.0
 
 
@@ -159,8 +161,21 @@ class GoalOptimizer:
             engine_params = EngineParams(
                 max_iters=config.get_int("analyzer.max.iterations"),
                 num_candidates=config.get_int("analyzer.candidate.replicas.per.broker"),
+                num_leader_candidates=config.get_int(
+                    "analyzer.leader.candidates.per.iteration"),
+                num_swap_candidates=config.get_int(
+                    "analyzer.swap.candidates.per.iteration"),
+                num_dst_choices=config.get_int("analyzer.destination.spread"),
+                stall_retries=config.get_int("analyzer.stall.retries"),
+                tail_pass_budget=config.get_int("analyzer.tail.pass.budget"),
             )
         self._params = engine_params or EngineParams()
+        self._balancedness_priority_weight = (
+            config.get_double("goal.balancedness.priority.weight")
+            if config is not None else BALANCEDNESS_PRIORITY_WEIGHT)
+        self._balancedness_strictness_weight = (
+            config.get_double("goal.balancedness.strictness.weight")
+            if config is not None else BALANCEDNESS_STRICTNESS_WEIGHT)
         if config is not None:
             self._default_goal_names = list(config.get_list("goals"))
             self._hard_goal_names = set(config.get_list("hard.goals"))
@@ -336,8 +351,12 @@ class GoalOptimizer:
         result = OptimizerResult(
             goal_results=goal_results, proposals=proposals,
             stats_before=stats_before, stats_after=stats_after,
-            balancedness_before=_balancedness(goals, violated_before),
-            balancedness_after=_balancedness(goals, viol_after),
+            balancedness_before=_balancedness(
+                goals, violated_before, self._balancedness_priority_weight,
+                self._balancedness_strictness_weight),
+            balancedness_after=_balancedness(
+                goals, viol_after, self._balancedness_priority_weight,
+                self._balancedness_strictness_weight),
             num_replica_movements=n_moves, num_leadership_movements=n_lead,
             data_to_move_mb=data_mb,
             durations_measured=measure_goal_durations,

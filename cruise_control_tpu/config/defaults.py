@@ -130,6 +130,30 @@ _D.define(name="analyzer.candidate.replicas.per.broker", type=Type.INT, default=
 _D.define(name="analyzer.batched.moves", type=Type.BOOLEAN, default=True,
           doc="TPU-specific: apply one non-conflicting move per violating broker per iteration "
               "instead of a single global move (faster, same violation contract).")
+_D.define(name="analyzer.leader.candidates.per.iteration", type=Type.INT, default=32,
+          validator=at_least(1),
+          doc="TPU-specific: leadership-transfer candidate pool per engine pass.")
+_D.define(name="analyzer.swap.candidates.per.iteration", type=Type.INT, default=32,
+          validator=at_least(1),
+          doc="TPU-specific: swap-out/in candidate pools per engine pass "
+              "(hard-clamped at the TPU-safe bound in the engine).")
+_D.define(name="analyzer.destination.spread", type=Type.INT, default=16, validator=at_least(1),
+          doc="TPU-specific: destination affinity classes per wave (row fan-out width).")
+_D.define(name="analyzer.stall.retries", type=Type.INT, default=8, validator=at_least(0),
+          doc="TPU-specific: consecutive fruitless passes explored with salted "
+              "candidate ranking before a goal exits.")
+_D.define(name="analyzer.tail.pass.budget", type=Type.INT, default=64, validator=at_least(0),
+          doc="TPU-specific: cumulative low-yield passes allowed per goal — the "
+              "bounded convergence tail (reference analogue: the 1 s-per-broker "
+              "swap cap, ResourceDistributionGoal.java:58).")
+_D.define(name="goal.balancedness.priority.weight", type=Type.DOUBLE, default=1.1,
+          validator=at_least(1.0),
+          doc="Balancedness score: weight step per goal priority rank "
+              "(AnalyzerConfig goal.balancedness.priority.weight).")
+_D.define(name="goal.balancedness.strictness.weight", type=Type.DOUBLE, default=1.5,
+          validator=at_least(1.0),
+          doc="Balancedness score: extra weight of hard goals "
+              "(AnalyzerConfig goal.balancedness.strictness.weight).")
 
 # --------------------------------------------------------------------------
 # Monitor (reference: config/constants/MonitorConfig.java)

@@ -24,8 +24,26 @@ import time
 LOG = logging.getLogger("cruise_control_tpu.main")
 
 
+def resolve_env_refs(value: str) -> str:
+    """Env-var indirection in property values (config/EnvConfigProvider.java
+    role): ``${env:VAR}`` -> os.environ["VAR"]; unset vars are a loud
+    ConfigException-shaped error rather than a silent empty string."""
+    import os
+    import re
+
+    def sub(m):
+        var = m.group(1)
+        if var not in os.environ:
+            raise ValueError(
+                f"property references ${{env:{var}}} but {var} is not set")
+        return os.environ[var]
+
+    return re.sub(r"\$\{env:([A-Za-z_][A-Za-z0-9_]*)\}", sub, value)
+
+
 def load_properties(path: str) -> dict:
-    """Parse a Kafka-style ``key=value`` properties file (comments with #)."""
+    """Parse a Kafka-style ``key=value`` properties file (comments with #),
+    resolving ``${env:VAR}`` references in values."""
     props: dict[str, str] = {}
     with open(path) as f:
         for line in f:
@@ -33,7 +51,7 @@ def load_properties(path: str) -> dict:
             if not line or line.startswith("#") or "=" not in line:
                 continue
             key, _, value = line.partition("=")
-            props[key.strip()] = value.strip()
+            props[key.strip()] = resolve_env_refs(value.strip())
     return props
 
 

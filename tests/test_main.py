@@ -74,3 +74,17 @@ def test_security_enable_requires_credentials(tmp_path):
     cc = build_app(config)
     with pytest.raises(ValueError, match="credentials"):
         build_server(cc, config)
+
+
+def test_env_config_provider(tmp_path, monkeypatch):
+    """${env:VAR} indirection in property values (EnvConfigProvider.java
+    role); unset variables fail loudly."""
+    from cruise_control_tpu.main import load_properties
+
+    monkeypatch.setenv("CC_TEST_PORT", "1234")
+    p = tmp_path / "cc.properties"
+    p.write_text("webserver.http.port=${env:CC_TEST_PORT}\n")
+    assert load_properties(str(p))["webserver.http.port"] == "1234"
+    p.write_text("jwt.secret.file=${env:CC_TEST_UNSET_VAR}\n")
+    with pytest.raises(ValueError, match="CC_TEST_UNSET_VAR"):
+        load_properties(str(p))

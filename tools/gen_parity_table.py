@@ -53,29 +53,54 @@ def describe_outcome(expected: str) -> str:
             "raise": "OptimizationFailureException"}[expected]
 
 
+def run_one(row_index: int) -> None:
+    """Run ONE matrix row and print a JSON verdict line (subprocess mode —
+    a single long-lived process accumulating every row's XLA:CPU programs
+    eventually crashes LLVM on this host)."""
+    import json
+    row_id, factory, chain, constraint, pattern, expected = MATRIX[row_index]
+    try:
+        _ct, _meta, res = run_row(factory, chain, constraint, pattern)
+        hard = [g.name for g in res.goal_results
+                if g.violated_after and g.name in (
+                    "RackAwareGoal", "MinTopicLeadersPerBrokerGoal",
+                    "ReplicaCapacityGoal", "DiskCapacityGoal",
+                    "NetworkInboundCapacityGoal",
+                    "NetworkOutboundCapacityGoal", "CpuCapacityGoal",
+                    "KafkaAssignerEvenRackAwareGoal")]
+        got = ("hard goals violated: " + ",".join(hard)) if hard else             f"optimized ({len(res.violated_goals_after)} soft violated)"
+        ok = not hard and expected in ("ok", "ok_or_underprovisioned")
+    except OptimizationFailureError as e:
+        under = (e.recommendation is not None and
+                 e.recommendation.status == ProvisionStatus.UNDER_PROVISIONED)
+        got = ("raises (UNDER_PROVISIONED)" if under else "raises")
+        ok = (expected == "raise"
+              or (expected == "ok_or_underprovisioned" and under))
+    print(json.dumps({"row": row_id, "got": got, "ok": ok}), flush=True)
+
+
 def main() -> None:
+    import json
+    import subprocess
+
     rows = []
     all_match = True
-    for row_id, factory, chain, constraint, pattern, expected in MATRIX:
+    for i, (row_id, factory, chain, constraint, pattern, expected) in enumerate(MATRIX):
         t0 = time.monotonic()
         try:
-            _ct, _meta, res = run_row(factory, chain, constraint, pattern)
-            hard = [g.name for g in res.goal_results
-                    if g.violated_after and g.name in (
-                        "RackAwareGoal", "MinTopicLeadersPerBrokerGoal",
-                        "ReplicaCapacityGoal", "DiskCapacityGoal",
-                        "NetworkInboundCapacityGoal",
-                        "NetworkOutboundCapacityGoal", "CpuCapacityGoal",
-                        "KafkaAssignerEvenRackAwareGoal")]
-            got = ("hard goals violated: " + ",".join(hard)) if hard else \
-                f"optimized ({len(res.violated_goals_after)} soft violated)"
-            ok = not hard and expected in ("ok", "ok_or_underprovisioned")
-        except OptimizationFailureError as e:
-            under = (e.recommendation is not None and
-                     e.recommendation.status == ProvisionStatus.UNDER_PROVISIONED)
-            got = ("raises (UNDER_PROVISIONED)" if under else "raises")
-            ok = (expected == "raise"
-                  or (expected == "ok_or_underprovisioned" and under))
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--row", str(i)],
+                capture_output=True, text=True, timeout=1800)
+            verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+        except subprocess.TimeoutExpired:
+            verdict = {"row": row_id, "got": "subprocess timed out (1800s)",
+                       "ok": False}
+        except (IndexError, json.JSONDecodeError):
+            verdict = {"row": row_id,
+                       "got": f"subprocess failed rc={proc.returncode}",
+                       "ok": False}
+            print(proc.stderr[-2000:], file=sys.stderr, flush=True)
+        got, ok = verdict["got"], verdict["ok"]
         all_match &= ok
         chain_desc = (f"{len(chain)}-goal default chain" if len(chain) > 3
                       else "+".join(chain))
@@ -87,6 +112,10 @@ def main() -> None:
         print(f"{row_id:32s} {got:50s} {'OK' if ok else 'MISMATCH'} "
               f"({time.monotonic() - t0:.1f}s)", file=sys.stderr, flush=True)
 
+    _write(rows, all_match)
+
+
+def _write(rows, all_match) -> None:
     with open("PARITY.md", "w") as f:
         f.write(HEADER)
         f.write("\n".join(rows) + "\n")
@@ -100,4 +129,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--row":
+        run_one(int(sys.argv[2]))
+    else:
+        main()
