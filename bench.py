@@ -250,7 +250,10 @@ def main() -> None:
                 num_partitions=500000, max_replication=3, skew=1.0, seed=3142,
                 target_cpu_util=0.45))
             log(f"  generated {meta.num_valid_replicas} replicas")
-            rung = run_rung("7000b-1M", ct, meta, repeats=repeats,
+            # min-of-2 warm repeats: tunnel latency variance at ~1300
+            # dispatches per run is several seconds run to run
+            rung = run_rung("7000b-1M", ct, meta,
+                            repeats=max(repeats, 3) if not skip_cold else 2,
                             profile=profile)
             SUMMARY.headline = rung
 
