@@ -24,7 +24,7 @@ from cruise_control_tpu.detector.detectors import (
     SlowBrokerFinder,
 )
 from cruise_control_tpu.detector.maintenance import (
-    FileMaintenanceEventReader, IdempotenceCache,
+    IdempotenceCache, TopicMaintenanceEventReader,
 )
 from cruise_control_tpu.detector.manager import AnomalyDetectorManager
 from cruise_control_tpu.detector.notifier import SelfHealingNotifier
@@ -97,8 +97,17 @@ class CruiseControl:
         slow.configure(self.config)
         topic_rf = TopicReplicationFactorAnomalyFinder()
         topic_rf.configure(self.config)
-        maint_reader = FileMaintenanceEventReader()
-        maint_reader.configure(self.config)
+        # the pluggable reader SPI (maintenance.event.reader.class) plus the
+        # topic transport when its path is configured
+        maint_readers = [self.config.get_configured_instance(
+            "maintenance.event.reader.class")]
+        maint_readers[0].configure(self.config)
+        if (self.config.get_string("maintenance.event.topic.path")
+                and not isinstance(maint_readers[0],
+                                   TopicMaintenanceEventReader)):
+            topic_reader = TopicMaintenanceEventReader()
+            topic_reader.configure(self.config)
+            maint_readers.append(topic_reader)
         idem = IdempotenceCache(
             float(self.config.get_int("maintenance.event.idempotence.retention.ms")))
         self.goal_violation_detector = goal_vd
@@ -117,7 +126,8 @@ class CruiseControl:
             lambda now: topic_rf.anomalies(self.backend, now))
         self.anomaly_detector.register_detector(
             "MaintenanceEventDetector",
-            lambda now: [e for e in maint_reader.read_events(now)
+            lambda now: [e for r in maint_readers
+                         for e in r.read_events(now)
                          if not idem.seen_before(
                              f"{e.plan_type}:{e.brokers}:{e.topics}", now)])
 

@@ -342,6 +342,10 @@ _D.define(name="self.healing.target.topic.replication.factor", type=Type.INT, de
 _D.define(name="maintenance.event.reader.class", type=Type.CLASS,
           default="cruise_control_tpu.detector.maintenance.FileMaintenanceEventReader",
           doc="MaintenanceEventReader plugin (reference reads a Kafka topic).")
+_D.define(name="maintenance.event.topic.path", type=Type.STRING, default="",
+          doc="Topic-log file carrying operator maintenance plans "
+              "(MaintenanceEventTopicReader.java maintenance.event.topic role); "
+              "when set, the topic reader is wired alongside the file-spool one.")
 _D.define(name="maintenance.event.path", type=Type.STRING, default="",
           doc="Spool directory for FileMaintenanceEventReader.")
 _D.define(name="maintenance.event.idempotence.retention.ms", type=Type.LONG, default=180_000)

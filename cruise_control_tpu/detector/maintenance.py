@@ -32,6 +32,15 @@ class IdempotenceCache:
         return False
 
 
+def _event_from_dict(d: dict, now_ms: float):
+    """One parsed plan dict -> MaintenanceEvent (shared by every reader)."""
+    return MaintenanceEvent(
+        anomaly_type=AnomalyType.MAINTENANCE_EVENT,
+        detected_ms=now_ms, plan_type=d.get("type", ""),
+        brokers=d.get("brokers", []), topics=d.get("topics", {}),
+        description=f"maintenance plan {d.get('type')}")
+
+
 class FileMaintenanceEventReader:
     def __init__(self, path: str = ""):
         self._path = path
@@ -57,10 +66,58 @@ class FileMaintenanceEventReader:
                     d = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                events.append(MaintenanceEvent(
-                    anomaly_type=AnomalyType.MAINTENANCE_EVENT,
-                    detected_ms=now_ms, plan_type=d.get("type", ""),
-                    brokers=d.get("brokers", []), topics=d.get("topics", {}),
-                    description=f"maintenance plan {d.get('type')}"))
+                events.append(_event_from_dict(d, now_ms))
             self._offset = f.tell()
         return events
+
+
+class TopicMaintenanceEventReader:
+    """Maintenance plans consumed from a TOPIC transport
+    (detector/MaintenanceEventTopicReader.java role: the reference reads the
+    __MaintenanceEvent Kafka topic from a stored offset forward; here the
+    same length-prefixed topic-log transport the metrics reporter uses,
+    reporter/topic.FileMetricsTopic, carries JSON-encoded plans and the
+    reader tracks its consumer offset). Producers submit with
+    :func:`submit_maintenance_plan`."""
+
+    def __init__(self, path: str = ""):
+        self._path = path
+        self._topic = None
+        self._offset = 0
+
+    def configure(self, config, **extra):
+        path = extra.get("path") or (
+            config.get_string("maintenance.event.topic.path")
+            if config is not None else "")
+        if path:
+            self._path = path
+
+    def _ensure(self):
+        if self._topic is None and self._path:
+            from cruise_control_tpu.reporter.topic import FileMetricsTopic
+            self._topic = FileMetricsTopic(self._path)
+        return self._topic
+
+    def read_events(self, now_ms: float) -> list:
+        topic = self._ensure()
+        if topic is None:
+            return []
+        events = []
+        for next_offset, payload in topic.consume(self._offset):
+            self._offset = next_offset
+            try:
+                d = json.loads(payload.decode())
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            events.append(_event_from_dict(d, now_ms))
+        return events
+
+
+def submit_maintenance_plan(path: str, plan_type: str, brokers=(),
+                            topics=None) -> None:
+    """Operator-side producer (MaintenanceEventTopicReader's write
+    counterpart): append one plan to the maintenance topic log."""
+    from cruise_control_tpu.reporter.topic import FileMetricsTopic
+    FileMetricsTopic(path).append([json.dumps(
+        {"type": plan_type, "brokers": list(brokers),
+         "topics": dict(topics or {})}).encode()])

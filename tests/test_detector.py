@@ -159,3 +159,35 @@ def test_maintenance_event_flow(tmp_path):
     handled = cc.anomaly_detector.handle_anomalies(now_ms=1e9)
     assert any(h["anomaly"]["type"] == "MAINTENANCE_EVENT" and h["action"] == "FIX"
                for h in handled)
+
+
+def test_topic_maintenance_event_reader(tmp_path):
+    """MaintenanceEventTopicReader.java role: plans ride the topic-log
+    transport; the reader consumes from its stored offset forward and the
+    idempotence cache drops re-submissions."""
+    from cruise_control_tpu.detector.maintenance import (
+        IdempotenceCache, TopicMaintenanceEventReader, submit_maintenance_plan,
+    )
+
+    path = str(tmp_path / "maintenance_topic.log")
+    reader = TopicMaintenanceEventReader()
+    reader.configure(None, path=path)
+    assert reader.read_events(0.0) == []
+    submit_maintenance_plan(path, "REMOVE_BROKER", brokers=[3])
+    submit_maintenance_plan(path, "TOPIC_REPLICATION_FACTOR",
+                            topics={"t": 3})
+    events = reader.read_events(1.0)
+    assert [e.plan_type for e in events] == ["REMOVE_BROKER",
+                                             "TOPIC_REPLICATION_FACTOR"]
+    assert events[0].brokers == [3]
+    # offset advanced: nothing re-read
+    assert reader.read_events(2.0) == []
+    # new submission picked up from the stored offset
+    submit_maintenance_plan(path, "REBALANCE")
+    again = reader.read_events(3.0)
+    assert [e.plan_type for e in again] == ["REBALANCE"]
+    # idempotence: duplicate plan within retention dropped
+    idem = IdempotenceCache(retention_ms=10_000.0)
+    key = f"{events[0].plan_type}:{events[0].brokers}:{events[0].topics}"
+    assert not idem.seen_before(key, 0.0)
+    assert idem.seen_before(key, 1.0)
