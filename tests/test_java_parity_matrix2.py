@@ -3,12 +3,12 @@ tests/test_java_parity_matrix.py (split across two files so pytest-xdist's
 loadfile scheduler spreads the XLA:CPU compile load over both workers)."""
 import pytest
 
-from tests.test_java_parity_matrix import MATRIX_B, _run_matrix_row
+from tests.test_java_parity_matrix import MATRIX, MATRIX_A, MATRIX_B, _run_matrix_row
 
 
 @pytest.mark.parametrize(
-    "row_id,fixture_factory,chain,constraint,pattern,expected",
-    MATRIX_B, ids=[m[0] for m in MATRIX_B])
-def test_java_matrix_b(row_id, fixture_factory, chain, constraint, pattern,
-                       expected):
-    _run_matrix_row(fixture_factory, chain, constraint, pattern, expected)
+    "row_index", range(len(MATRIX_A), len(MATRIX)),
+    ids=[m[0] for m in MATRIX_B])
+def test_java_matrix_b(row_index):
+    row = MATRIX[row_index]
+    _run_matrix_row(*row[1:], row_index=row_index)
