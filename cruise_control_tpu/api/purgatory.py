@@ -56,8 +56,15 @@ class RequestInfo:
 
 class Purgatory:
     def __init__(self, retention_ms: float = 7 * 24 * 3600 * 1000.0,
+                 max_requests: int = 25, max_cached_completed: int = 100,
                  time_fn=None):
+        """``max_requests`` caps requests awaiting review
+        (two.step.purgatory.max.requests); ``max_cached_completed`` caps
+        finished (submitted/discarded) requests kept for the review board
+        (two.step.purgatory.max.cached.completed.requests)."""
         self._retention_ms = retention_ms
+        self._max_requests = max_requests
+        self._max_completed = max_cached_completed
         self._time = time_fn or (lambda: time.time() * 1000.0)
         self._lock = threading.Lock()
         self._requests: dict[int, RequestInfo] = {}
@@ -66,6 +73,14 @@ class Purgatory:
     def add(self, endpoint: EndPoint, params: dict, submitter: str) -> RequestInfo:
         with self._lock:
             self._remove_old()
+            pending = sum(1 for i in self._requests.values()
+                          if i.status in (ReviewStatus.PENDING_REVIEW,
+                                          ReviewStatus.APPROVED))
+            if pending >= self._max_requests:
+                raise ValueError(
+                    f"two-step purgatory is full ({pending} requests awaiting "
+                    f"review >= two.step.purgatory.max.requests="
+                    f"{self._max_requests})")
             rid = self._next_id
             self._next_id += 1
             info = RequestInfo(rid, endpoint, params, submitter, self._time())
@@ -76,6 +91,12 @@ class Purgatory:
         now = self._time()
         for rid, info in list(self._requests.items()):
             if now - info.submission_ms > self._retention_ms:
+                del self._requests[rid]
+        done = [(rid, i) for rid, i in self._requests.items()
+                if i.status in (ReviewStatus.SUBMITTED, ReviewStatus.DISCARDED)]
+        if len(done) > self._max_completed:
+            done.sort(key=lambda e: e[1].submission_ms)
+            for rid, _ in done[:len(done) - self._max_completed]:
                 del self._requests[rid]
 
     def _transition(self, rid: int, to: ReviewStatus, reason: str) -> RequestInfo:

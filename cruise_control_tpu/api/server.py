@@ -38,6 +38,38 @@ from cruise_control_tpu.api.user_tasks import USER_TASK_HEADER_NAME, UserTaskMan
 URL_PREFIX = "/kafkacruisecontrol"
 
 
+class AccessLog:
+    """NCSA combined-ish access log (WebServerConfig webserver.accesslog.*:
+    Jetty's RequestLogWriter role). Startup deletes rotated logs older than
+    the retention window."""
+
+    def __init__(self, path: str, retention_days: int = 14):
+        import glob
+        import os
+        import time as _t
+        self._path = path
+        self._lock = threading.Lock()
+        cutoff = _t.time() - retention_days * 86_400
+        for old in glob.glob(path + ".*"):
+            try:
+                if os.path.getmtime(old) < cutoff:
+                    os.unlink(old)
+            except OSError:
+                pass
+        self._f = open(path, "a", buffering=1)
+
+    def log(self, client_ip: str, method: str, path: str, status: int,
+            length: int) -> None:
+        import time as _t
+        ts = _t.strftime("%d/%b/%Y:%H:%M:%S %z")
+        with self._lock:
+            self._f.write(f'{client_ip} - - [{ts}] "{method} {path} '
+                          f'HTTP/1.1" {status} {length}\n')
+
+    def close(self) -> None:
+        self._f.close()
+
+
 class CruiseControlServer:
     """Serves the 20 endpoints over HTTP against a CruiseControl facade."""
 
@@ -45,17 +77,89 @@ class CruiseControlServer:
                  security_provider=None, two_step_verification: bool = False,
                  max_block_ms: float = 10_000.0, max_active_user_tasks: int = 25,
                  completed_user_task_retention_ms: float = 24 * 3600 * 1000.0,
-                 ssl_context=None):
+                 ssl_context=None, config=None):
         """``ssl_context``: an ``ssl.SSLContext`` to serve HTTPS
-        (KafkaCruiseControlApp.java:100-121 webserver.ssl.* role)."""
+        (KafkaCruiseControlApp.java:100-121 webserver.ssl.* role).
+        ``config``: the framework Config — consumed for the webserver.* key
+        families (CORS, access log, UI serving, reason requirement, session
+        path, per-endpoint parameters/request class overrides, purgatory and
+        user-task cache caps)."""
         self.app = app
         self.security = security_provider or NoopSecurityProvider()
         self.two_step = two_step_verification
-        self.purgatory = Purgatory() if two_step_verification else None
+        cfg = config if config is not None else getattr(app, "config", None)
+        if self.two_step and cfg is not None:
+            self.purgatory = Purgatory(
+                retention_ms=float(cfg.get_int(
+                    "two.step.purgatory.retention.time.ms")),
+                max_requests=cfg.get_int("two.step.purgatory.max.requests"),
+                max_cached_completed=cfg.get_int(
+                    "two.step.purgatory.max.cached.completed.requests"))
+        else:
+            self.purgatory = Purgatory() if two_step_verification else None
+        by_type = {}
+        if cfg is not None:
+            from cruise_control_tpu.api.endpoints import EndpointType
+            for etype, key in (
+                    (EndpointType.KAFKA_ADMIN,
+                     "max.cached.completed.kafka.admin.user.tasks"),
+                    (EndpointType.KAFKA_MONITOR,
+                     "max.cached.completed.kafka.monitor.user.tasks"),
+                    (EndpointType.CRUISE_CONTROL_ADMIN,
+                     "max.cached.completed.cruise.control.admin.user.tasks"),
+                    (EndpointType.CRUISE_CONTROL_MONITOR,
+                     "max.cached.completed.cruise.control.monitor.user.tasks")):
+                by_type[etype] = cfg.get(key)
         self.user_tasks = UserTaskManager(
             max_active_tasks=max_active_user_tasks,
-            completed_task_retention_ms=completed_user_task_retention_ms)
+            completed_task_retention_ms=completed_user_task_retention_ms,
+            session_expiry_ms=(float(cfg.get_int(
+                "webserver.session.maxExpiryTime")) if cfg is not None
+                else 60_000.0),
+            max_cached_completed=(cfg.get_int(
+                "max.cached.completed.user.tasks") if cfg is not None else 100),
+            max_cached_completed_by_type=by_type)
         self.max_block_ms = max_block_ms
+        # webserver.http.cors.*: headers attached to every response (+ the
+        # OPTIONS preflight) when enabled
+        self._cors: dict[str, str] | None = None
+        if cfg is not None and cfg.get_boolean("webserver.http.cors.enabled"):
+            self._cors = {
+                "Access-Control-Allow-Origin":
+                    cfg.get_string("webserver.http.cors.origin"),
+                "Access-Control-Allow-Methods":
+                    cfg.get_string("webserver.http.cors.allowmethods"),
+                "Access-Control-Expose-Headers":
+                    cfg.get_string("webserver.http.cors.exposeheaders"),
+            }
+        self._reason_required = bool(
+            cfg is not None and cfg.get_boolean("request.reason.required"))
+        self._session_path = (cfg.get_string("webserver.session.path")
+                              if cfg is not None else "/")
+        # webserver.ui.diskpath/urlprefix: static cruise-control-ui serving
+        self._ui_dir = (cfg.get_string("webserver.ui.diskpath")
+                        if cfg is not None else "")
+        self._ui_prefix = ((cfg.get_string("webserver.ui.urlprefix")
+                            if cfg is not None else "/*").rstrip("*") or "/")
+        self._access_log = None
+        if cfg is not None and cfg.get_boolean("webserver.accesslog.enabled"):
+            self._access_log = AccessLog(
+                cfg.get_string("webserver.accesslog.path"),
+                retention_days=cfg.get_int("webserver.accesslog.retention.days"))
+        # per-endpoint parameter-parser / request-handler overrides
+        # (CruiseControlParametersConfig / CruiseControlRequestConfig)
+        self._param_overrides: dict[EndPoint, object] = {}
+        self._request_overrides: dict[EndPoint, object] = {}
+        if cfg is not None:
+            from cruise_control_tpu.config.defaults import endpoint_config_stem
+            for ep in EndPoint:
+                stem = endpoint_config_stem(ep.path)
+                pc = cfg.get_class(f"{stem}.parameters.class")
+                if pc is not None:
+                    self._param_overrides[ep] = cfg.configure_instance(pc)
+                rc = cfg.get_class(f"{stem}.request.class")
+                if rc is not None:
+                    self._request_overrides[ep] = cfg.configure_instance(rc)
         self._ssl = ssl_context
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((host, port), handler)
@@ -84,6 +188,8 @@ class CruiseControlServer:
         self._httpd.shutdown()
         self._httpd.server_close()
         self.user_tasks.close()
+        if self._access_log is not None:
+            self._access_log.close()
 
     # ----------------------------------------------------------- dispatch
     def handle(self, method: str, endpoint: EndPoint, params: dict,
@@ -105,6 +211,13 @@ class CruiseControlServer:
     def _handle(self, method: str, endpoint: EndPoint, params: dict,
                 client: str, task_id_header: str | None):
         headers: dict[str, str] = {}
+
+        # <endpoint>.request.class override: the configured handler replaces
+        # the built-in request processing wholesale
+        override = self._request_overrides.get(endpoint)
+        if override is not None:
+            return override.handle(self, method, endpoint, params, client,
+                                   task_id_header)
 
         # two-step verification: POSTs (except /review) must be reviewed
         # first. A request resuming an async task via User-Task-ID already
@@ -351,13 +464,50 @@ def _make_handler(server: CruiseControlServer):
 
         def _send(self, status: int, body: dict, headers: dict[str, str]):
             payload = json.dumps(body, indent=2).encode("utf-8")
+            self._send_raw(status, payload, "application/json", headers)
+
+        def _send_raw(self, status: int, payload: bytes, ctype: str,
+                      headers: dict[str, str]):
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
+            if server._cors is not None:
+                for k, v in server._cors.items():
+                    self.send_header(k, v)
             for k, v in headers.items():
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(payload)
+            if server._access_log is not None:
+                server._access_log.log(self.client_address[0],
+                                       self.command, self.path, status,
+                                       len(payload))
+
+        def _serve_ui(self, path: str) -> bool:
+            """Static cruise-control-ui files from webserver.ui.diskpath."""
+            import mimetypes
+            import os
+            if not server._ui_dir or not path.startswith(server._ui_prefix):
+                return False
+            rel = path[len(server._ui_prefix):].lstrip("/") or "index.html"
+            full = os.path.realpath(os.path.join(server._ui_dir, rel))
+            root = os.path.realpath(server._ui_dir)
+            if not full.startswith(root + os.sep) and full != root:
+                return False   # traversal attempts fall through to the API 404
+            if not os.path.isfile(full):
+                return False
+            with open(full, "rb") as f:
+                data = f.read()
+            ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+            self._send_raw(200, data, ctype, {})
+            return True
+
+        def do_OPTIONS(self):
+            # CORS preflight (webserver.http.cors.enabled)
+            if server._cors is None:
+                self._send(405, error_json("OPTIONS unsupported"), {})
+                return
+            self._send_raw(204, b"", "text/plain", {})
 
         def _dispatch(self, method: str):
             parsed = urllib.parse.urlparse(self.path)
@@ -367,6 +517,8 @@ def _make_handler(server: CruiseControlServer):
             name = path.strip("/").split("/")[0]
             endpoint = EndPoint.from_path(name)
             if endpoint is None:
+                if method == "GET" and self._serve_ui(parsed.path):
+                    return
                 self._send(404, error_json(f"unknown endpoint {name!r}"), {})
                 return
             allowed = GET_ENDPOINTS if method == "GET" else POST_ENDPOINTS
@@ -381,7 +533,8 @@ def _make_handler(server: CruiseControlServer):
             if doas_vals and not self.headers.get("X-Do-As"):
                 self.headers["X-Do-As"] = doas_vals[0]
             try:
-                principal, role = server.security.authenticate(self.headers)
+                principal, role = server.security.authenticate(
+                    self.headers, client_ip=self.client_address[0])
                 if not server.security.authorize(role, endpoint, method):
                     raise AuthError(f"role {role} may not access "
                                     f"{method} /{endpoint.path}", 403)
@@ -391,6 +544,18 @@ def _make_handler(server: CruiseControlServer):
                          f'{challenge} realm="cruise-control"'
                          if challenge == "Basic" else challenge}
                         if e.status == 401 else {})
+                # jwt.authentication.provider.url: browsers are bounced to
+                # the login service; the original URL rides along as
+                # ?origin=<url> so the login service can send the user back
+                # (the reference JwtAuthenticator's {redirect}?origin= shape)
+                hdrs.update(getattr(e, "extra_headers", None) or {})
+                loc = hdrs.get("Location")
+                if loc and "origin=" not in loc:
+                    origin = urllib.parse.quote(
+                        f"{'https' if server._ssl else 'http'}://"
+                        f"{self.headers.get('Host', '')}{self.path}", safe="")
+                    hdrs["Location"] = (
+                        f"{loc}{'&' if '?' in loc else '?'}origin={origin}")
                 self._send(e.status, error_json(str(e)), hdrs)
                 return
             # per-session identity for user-task affinity (the reference's
@@ -428,8 +593,21 @@ def _make_handler(server: CruiseControlServer):
                 except (ValueError, UnicodeDecodeError) as e:
                     self._send(400, error_json(f"malformed request body: {e}"), {})
                     return
+            if (server._reason_required and method == "POST"
+                    and not query.get("reason", [""])[0]):
+                # WebServerConfig request.reason.required
+                self._send(400, error_json(
+                    "a reason parameter is required on POST requests "
+                    "(request.reason.required=true)"), {})
+                return
             try:
-                params = parse_params(endpoint, query)
+                override = server._param_overrides.get(endpoint)
+                if override is not None:
+                    # <endpoint>.parameters.class: configured parser
+                    parse = getattr(override, "parse", override)
+                    params = parse(endpoint, query)
+                else:
+                    params = parse_params(endpoint, query)
             except ParameterError as e:
                 self._send(400, error_json(str(e)), {})
                 return
@@ -441,7 +619,8 @@ def _make_handler(server: CruiseControlServer):
                 if new_session:
                     headers = dict(headers or {})
                     headers["Set-Cookie"] = (
-                        f"{SESSION_COOKIE}={session_id}; Path=/; HttpOnly")
+                        f"{SESSION_COOKIE}={session_id}; "
+                        f"Path={server._session_path}; HttpOnly")
             except (ParameterError, KeyError, ValueError) as e:
                 self._send(400, error_json(str(e)), {})
                 return

@@ -79,10 +79,18 @@ class UserTaskManager:
                  completed_task_retention_ms: float = 24 * 3600 * 1000.0,
                  session_expiry_ms: float = 60 * 1000.0,
                  max_workers: int = 8,
-                 time_fn: Callable[[], float] | None = None):
+                 time_fn: Callable[[], float] | None = None,
+                 max_cached_completed: int = 100,
+                 max_cached_completed_by_type: dict | None = None):
         self._max_active = max_active_tasks
         self._retention_ms = completed_task_retention_ms
         self._session_expiry_ms = session_expiry_ms
+        # completed-task cache caps: global (UserTaskManagerConfig
+        # max.cached.completed.user.tasks) + per endpoint type
+        # (max.cached.completed.{kafka.admin,kafka.monitor,...}.user.tasks;
+        # None entries fall back to the global cap)
+        self._max_completed = max_cached_completed
+        self._max_completed_by_type = dict(max_cached_completed_by_type or {})
         self._time = time_fn or (lambda: time.time() * 1000.0)
         self._lock = threading.Lock()
         self._executor = ThreadPoolExecutor(max_workers=max_workers,
@@ -121,6 +129,23 @@ class UserTaskManager:
             # retention runs from completion, not start: a long-running task
             # must still be retrievable for the full window after it finishes
             if now - (task.completed_ms or task.start_ms) > self._retention_ms:
+                del self._completed[tid]
+        # enforce the per-endpoint-type completed caps, oldest evicted first
+        by_type: dict = {}
+        for tid, task in self._completed.items():
+            by_type.setdefault(task.endpoint.endpoint_type, []).append((tid, task))
+        for etype, entries in by_type.items():
+            cap = self._max_completed_by_type.get(etype)
+            cap = self._max_completed if cap is None else cap
+            if len(entries) > cap:
+                entries.sort(key=lambda e: e[1].completed_ms or e[1].start_ms)
+                for tid, _ in entries[:len(entries) - cap]:
+                    del self._completed[tid]
+        # ... and the GLOBAL completed cap across all types
+        if len(self._completed) > self._max_completed:
+            ordered = sorted(self._completed.items(),
+                             key=lambda e: e[1].completed_ms or e[1].start_ms)
+            for tid, _ in ordered[:len(ordered) - self._max_completed]:
                 del self._completed[tid]
 
     def get_or_create_task(self, client: str, endpoint: EndPoint, method: str,

@@ -74,11 +74,24 @@ class CruiseControl:
                                             sensors=self.sensors)
         self.executor = Executor(backend, config=self.config,
                                  sensors=self.sensors)
+        oes = self.load_monitor.on_execution_store
+        if oes is not None:
+            # the on-execution store gates on the live executor
+            oes.configure(self.config, executor=self.executor)
         notifier = SelfHealingNotifier()
-        notifier.configure(self.config)
+        notifier.configure(self.config,
+                           num_brokers_supplier=lambda: len(backend.brokers()))
         clock = SimClock(backend) if hasattr(backend, "advance") else None
         self.anomaly_detector = AnomalyDetectorManager(
-            notifier=notifier, cruise_control=self, clock=clock)
+            notifier=notifier, cruise_control=self, clock=clock,
+            num_cached_recent_states=self.config.get_int(
+                "num.cached.recent.anomaly.states"),
+            maintenance_stops_ongoing_execution=self.config.get_boolean(
+                "maintenance.event.stop.ongoing.execution"))
+        # optimization.options.generator.class: seam for deployment-specific
+        # per-run option derivation
+        self._options_generator = self.config.get_configured_instance(
+            "optimization.options.generator.class")
         self._wire_detectors()
         self._proposal_cache: OptimizerResult | None = None
         self._proposal_cache_generation = None
@@ -87,14 +100,37 @@ class CruiseControl:
 
     # ------------------------------------------------------------- wiring
     def _wire_detectors(self):
-        broker_fd = BrokerFailureDetector(self.backend)
-        disk_fd = DiskFailureDetector(self.backend)
+        broker_fd = BrokerFailureDetector(
+            self.backend,
+            persist_path=self.config.get_string("failed.brokers.storage.path"),
+            anomaly_cls=self.config.get_class("broker.failures.class"))
+        disk_fd = DiskFailureDetector(
+            self.backend,
+            anomaly_cls=self.config.get_class("disk.failures.class"))
         goal_vd = GoalViolationDetector(
             self.goal_optimizer, self.load_monitor,
             self.config.get_list("anomaly.detection.goals"),
-            sensors=self.sensors)
+            sensors=self.sensors,
+            anomaly_cls=self.config.get_class("goal.violations.class"),
+            allow_capacity_estimation=self.config.get_boolean(
+                "anomaly.detection.allow.capacity.estimation"))
         slow = SlowBrokerFinder()
         slow.configure(self.config)
+        # metric.anomaly.finder.class (MetricAnomalyFinder SPI): percentile
+        # spike detection over a rolling broker-metric history
+        metric_finder = self.config.get_configured_instance(
+            "metric.anomaly.finder.class")
+        metric_history: dict[int, dict[str, list]] = {}
+
+        def run_metric_finder(now_ms: float) -> list:
+            current = self.backend.broker_metrics()
+            found = metric_finder.anomalies(metric_history, current, now_ms)
+            for b, metrics in current.items():
+                hist = metric_history.setdefault(b, {})
+                for name, v in metrics.items():
+                    hist.setdefault(name, []).append(float(v))
+                    del hist[name][:-64]   # bounded history window
+            return found
         topic_rf = TopicReplicationFactorAnomalyFinder()
         topic_rf.configure(self.config)
         # the pluggable reader SPI (maintenance.event.reader.class) plus the
@@ -109,7 +145,11 @@ class CruiseControl:
             topic_reader.configure(self.config)
             maint_readers.append(topic_reader)
         idem = IdempotenceCache(
-            float(self.config.get_int("maintenance.event.idempotence.retention.ms")))
+            float(self.config.get_int("maintenance.event.idempotence.retention.ms")),
+            max_size=self.config.get_int(
+                "maintenance.event.max.idempotence.cache.size"),
+            enabled=self.config.get_boolean(
+                "maintenance.event.enable.idempotence"))
         self.goal_violation_detector = goal_vd
 
         self.anomaly_detector.register_detector("BrokerFailureDetector",
@@ -121,6 +161,8 @@ class CruiseControl:
         self.anomaly_detector.register_detector(
             "SlowBrokerFinder",
             lambda now: slow.run_once(self.backend.broker_metrics(), now))
+        self.anomaly_detector.register_detector(
+            "MetricAnomalyDetector", run_metric_finder)
         self.anomaly_detector.register_detector(
             "TopicAnomalyDetector",
             lambda now: topic_rf.anomalies(self.backend, now))
@@ -140,8 +182,11 @@ class CruiseControl:
 
     # ------------------------------------------------------------ helpers
     def _now_ms(self) -> float:
-        return (self.backend.now_ms if hasattr(self.backend, "now_ms")
-                else time.time() * 1000.0)
+        now = getattr(self.backend, "now_ms", None)
+        if now is None:
+            return time.time() * 1000.0
+        # simulated backend exposes a property; the RPC client a method
+        return float(now() if callable(now) else now)
 
     def _model(self, requirements=None):
         return self.load_monitor.cluster_model(requirements)
@@ -198,18 +243,31 @@ class CruiseControl:
                     ct, broker_excluded_for_leadership=jnp.asarray(excl))
         return ct
 
+    def _self_healing_goals(self) -> list:
+        """Goals self-healing fixes optimize: AnomalyDetectorConfig
+        ``self.healing.goals`` when set, else the built-in evacuation chain."""
+        return self.config.get_list("self.healing.goals") or SELF_HEALING_GOALS
+
     def _run_optimization(self, operation: str, reason: str, ct, meta,
                           goal_names=None, options=OptimizationOptions(),
                           dry_run: bool = True, skip_hard_goal_check: bool = False,
                           execute_kw: dict | None = None) -> OperationResult:
         goals = goal_names or effective_default_goals(self.config)
+        # optimization.options.generator.class seam: deployments may rewrite
+        # the options of any internally-triggered optimization
+        options = self._options_generator.optimization_options(options, operation)
         res = self.goal_optimizer.optimizations(
             ct, meta, goal_names=goals, options=options,
             skip_hard_goal_check=skip_hard_goal_check)
         op = OperationResult(operation=operation, reason=reason,
                              optimizer_result=res)
         if not dry_run and res.proposals:
-            self.executor.execute_proposals(res.proposals, **(execute_kw or {}))
+            kw = dict(execute_kw or {})
+            sizes = {tp: info.size_mb
+                     for tp, info in self.backend.partitions().items()}
+            kw.setdefault("context", {"partition_size_mb": sizes,
+                                      "operation": f"{operation}: {reason}"})
+            self.executor.execute_proposals(res.proposals, **kw)
             op.executed = True
         self._ops_history.append({"operation": operation, "reason": reason,
                                   "ms": self._now_ms(),
@@ -260,7 +318,7 @@ class CruiseControl:
             else:
                 goal_names = intra
             skip_hard_goal_check = True
-        goals = goal_names or (SELF_HEALING_GOALS if self_healing else None)
+        goals = goal_names or (self._self_healing_goals() if self_healing else None)
         op = self._run_optimization("REBALANCE", reason, ct, meta, goals, options,
                                     dry_run=dry_run,
                                     skip_hard_goal_check=skip_hard_goal_check
@@ -297,7 +355,8 @@ class CruiseControl:
             broker_excluded_for_replica_move=jnp.asarray(excl),
             replica_offline=jnp.asarray(offline))
         op = self._run_optimization("REMOVE_BROKER", reason, ct, meta,
-                                    SELF_HEALING_GOALS, OptimizationOptions(),
+                                    self._self_healing_goals(),
+                                    OptimizationOptions(),
                                     dry_run=dry_run, skip_hard_goal_check=True)
         if op.executed:
             self.executor.note_removed_brokers(broker_ids)
@@ -353,7 +412,7 @@ class CruiseControl:
                                            exclude_recently_removed_brokers,
                                            exclude_recently_demoted_brokers)
         op = self._run_optimization(
-            "FIX_OFFLINE_REPLICAS", reason, ct, meta, SELF_HEALING_GOALS,
+            "FIX_OFFLINE_REPLICAS", reason, ct, meta, self._self_healing_goals(),
             OptimizationOptions(fix_offline_replicas_only=True),
             dry_run=dry_run, skip_hard_goal_check=True)
         return op.to_json()
@@ -500,7 +559,11 @@ class CruiseControl:
             if (not force_refresh and self._proposal_cache is not None
                     and self._proposal_cache_generation == gen):
                 return self._proposal_cache
-        ct, meta = self._model()
+        # allow.capacity.estimation.on.proposal.precompute: whether the
+        # precompute path tolerates estimated broker capacities
+        ct, meta = self.load_monitor.cluster_model(
+            allow_capacity_estimation=self.config.get_boolean(
+                "allow.capacity.estimation.on.proposal.precompute"))
         # the configured exclusion regex applies to precomputed proposals too
         ct = self._apply_excluded_topics(ct, meta, None)
         # the precompute path records violations instead of failing the cache

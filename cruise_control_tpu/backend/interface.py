@@ -38,6 +38,8 @@ class PartitionInfo:
     bytes_in_rate: float = 0.0          # KB/s produced to the leader
     bytes_out_rate: float = 0.0         # KB/s consumed from the leader
     cpu_util: float = 0.0               # leader CPU percent
+    isr: list | None = None             # in-sync replica ids; None = derive
+    #                                     from replicas on alive brokers
 
 
 class ClusterBackend(Protocol):

@@ -44,7 +44,9 @@ class RpcClusterBackend:
     One request/response in flight at a time (the executor/monitor layers
     already serialize actuation); `close()` terminates the sidecar."""
 
-    def __init__(self, argv: list[str] | None = None, proc=None):
+    def __init__(self, argv: list[str] | None = None, proc=None,
+                 admin_timeout_s: float = 180.0,
+                 logdir_timeout_s: float = 10.0):
         if proc is None:
             argv = argv or [sys.executable, "-m",
                             "cruise_control_tpu.backend.rpc"]
@@ -54,9 +56,17 @@ class RpcClusterBackend:
         self._proc = proc
         self._lock = threading.Lock()
         self._next_id = 0
+        # ExecutorConfig admin.client.request.timeout.ms /
+        # logdir.response.timeout.ms: how long one wire request may take
+        self._admin_timeout_s = admin_timeout_s
+        self._logdir_timeout_s = logdir_timeout_s
 
     def configure(self, config, **extra):
-        pass
+        if config is not None:
+            self._admin_timeout_s = (
+                config.get_int("admin.client.request.timeout.ms") / 1000.0)
+            self._logdir_timeout_s = (
+                config.get_int("logdir.response.timeout.ms") / 1000.0)
 
     def close(self) -> None:
         try:
@@ -66,12 +76,30 @@ class RpcClusterBackend:
             self._proc.kill()
 
     def _call(self, method: str, **params):
+        import select
         with self._lock:
+            if self._proc.poll() is not None:
+                raise RpcError(f"sidecar is down (exit "
+                               f"{self._proc.returncode}); recreate the "
+                               f"backend client")
             self._next_id += 1
             req = {"jsonrpc": "2.0", "id": self._next_id, "method": method,
                    "params": params}
             self._proc.stdin.write(json.dumps(req) + "\n")
             self._proc.stdin.flush()
+            timeout_s = (self._logdir_timeout_s if method == "describe_logdirs"
+                         else self._admin_timeout_s)
+            ready, _, _ = select.select([self._proc.stdout], [], [], timeout_s)
+            if not ready:
+                # fail-stop: the late reply is still in the pipe — leaving it
+                # there would desynchronize every subsequent request/response
+                # pair (the next _call would read THIS call's answer), so the
+                # sidecar is killed and the client reports itself down
+                self._proc.kill()
+                raise RpcError(
+                    f"{method}: no response within {timeout_s:.0f}s "
+                    f"(admin.client.request.timeout.ms / "
+                    f"logdir.response.timeout.ms); sidecar terminated")
             line = self._proc.stdout.readline()
             if not line:
                 raise RpcError(f"sidecar died during {method}")
@@ -154,6 +182,11 @@ class RpcClusterBackend:
     def replication_throttle(self):
         return self._call("replication_throttle")
 
+    def topic_configs(self) -> dict:
+        """Per-topic config maps (describeConfigs role; feeds the
+        TopicConfigProvider / min-ISR safety check)."""
+        return self._call("topic_configs")
+
     # -- simulated-cluster controls, forwarded so fault-injection tests can
     # drive a remote simulated sidecar exactly like the in-process one --
     def add_broker(self, broker_id, rack, **kw):
@@ -182,6 +215,25 @@ class RpcClusterBackend:
 
 
 # ------------------------------------------------------------------ server
+class DefaultBackendClientProvider:
+    """Backend wire-client factory (MonitorConfig
+    ``network.client.provider.class`` role: how the framework constructs its
+    connection to the managed cluster). Custom providers return their own
+    ClusterBackend-compatible client (e.g. pointing the sidecar argv at a
+    remote shim, injecting TLS, ...)."""
+
+    def __init__(self):
+        self._config = None
+
+    def configure(self, config) -> None:
+        self._config = config
+
+    def create(self, argv: list[str] | None = None):
+        client = RpcClusterBackend(argv=argv)
+        client.configure(self._config)
+        return client
+
+
 def _encode(obj):
     if isinstance(obj, BrokerNode):
         d = asdict(obj)
@@ -260,6 +312,9 @@ def _dispatch(backend, method: str, p: dict):
         return None
     if method == "replication_throttle":
         return backend.replication_throttle()
+    if method == "topic_configs":
+        getter = getattr(backend, "topic_configs", None)
+        return getter() if getter is not None else {}
     # simulated-cluster controls (fault injection / setup over the wire)
     if method in ("add_broker", "create_partition", "kill_broker",
                   "restart_broker", "fail_disk", "advance", "now_ms"):

@@ -47,9 +47,20 @@ class SimulatedClusterBackend:
         self._noise = metric_noise
         self._rng = np.random.default_rng(seed)
         self._metric_overrides: dict[int, dict[str, float]] = {}
+        self._topic_configs: dict[str, dict] = {}
 
     def configure(self, config, **extra):
         pass
+
+    # -- per-topic config (TopicConfigProvider source; the real cluster's
+    #    describeConfigs analogue) --
+    def set_topic_config(self, topic: str, key: str, value) -> None:
+        with self._lock:
+            self._topic_configs.setdefault(topic, {})[key] = value
+
+    def topic_configs(self) -> dict:
+        with self._lock:
+            return {t: dict(c) for t, c in self._topic_configs.items()}
 
     # ------------------------------------------------------------------ setup
     def add_broker(self, broker_id: int, rack: str, logdirs: dict | None = None,

@@ -80,6 +80,13 @@ class ConfigKey:
     validator: Callable[[Any], bool] | None = None
     validator_doc: str = ""
     required: bool = False
+    # Reference-compatible spelling of another key: setting this key sets the
+    # canonical one (conflict if both are set to different values), and reads
+    # of either name resolve to the canonical value. This is how the
+    # reference's exact key names stay accepted where this framework's
+    # canonical name differs (e.g. ``webserver.session.maxExpiryTimeMs`` ->
+    # ``webserver.session.maxExpiryTime``).
+    alias_of: str | None = None
 
     def validate(self, value: Any) -> Any:
         value = _coerce(self.name, self.type, value)
@@ -131,13 +138,32 @@ class ConfigDef:
     def keys(self) -> Mapping[str, ConfigKey]:
         return dict(self._keys)
 
+    def resolve_name(self, name: str) -> str:
+        """Canonical key name (follows alias_of; identity for canonical keys)."""
+        key = self._keys.get(name)
+        while key is not None and key.alias_of is not None:
+            name = key.alias_of
+            key = self._keys.get(name)
+        return name
+
     def parse(self, props: Mapping[str, Any], ignore_unknown: bool = False) -> dict[str, Any]:
         unknown = set(props) - set(self._keys)
         if unknown and not ignore_unknown:
             raise ConfigException(f"Unknown config key(s): {sorted(unknown)}")
+        # fold alias spellings onto their canonical keys first
+        folded: dict[str, Any] = {}
+        for name, value in props.items():
+            canon = self.resolve_name(name)
+            if canon in folded and folded[canon] != value:
+                raise ConfigException(
+                    f"Config {name!r} conflicts with its alias target "
+                    f"{canon!r}: {value!r} vs {folded[canon]!r}")
+            folded[canon] = value
         out: dict[str, Any] = {}
         for name, key in self._keys.items():
-            raw = props.get(name, key.default)
+            if key.alias_of is not None:
+                continue   # reads resolve through resolve_name
+            raw = folded.get(name, key.default)
             out[name] = key.validate(raw)
         return out
 
@@ -175,16 +201,17 @@ class Config:
         self._values = config_def.parse(self._props, ignore_unknown=ignore_unknown)
 
     def __contains__(self, name: str) -> bool:
-        return name in self._values
+        return self._def.resolve_name(name) in self._values
 
     def get(self, name: str, default: Any = None) -> Any:
+        name = self._def.resolve_name(name)
         if name not in self._values:
             return default
         return self._values[name]
 
     def __getitem__(self, name: str) -> Any:
         try:
-            return self._values[name]
+            return self._values[self._def.resolve_name(name)]
         except KeyError:
             raise ConfigException(f"Unknown config {name!r}") from None
 

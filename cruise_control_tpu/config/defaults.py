@@ -146,6 +146,12 @@ _D.define(name="analyzer.tail.pass.budget", type=Type.INT, default=64, validator
           doc="TPU-specific: cumulative low-yield passes allowed per goal — the "
               "bounded convergence tail (reference analogue: the 1 s-per-broker "
               "swap cap, ResourceDistributionGoal.java:58).")
+_D.define(name="analyzer.fused.chain.min.replicas", type=Type.INT, default=65_536,
+          doc="TPU-specific: at/above this cluster size the whole goal chain "
+              "compiles into ONE device program (one dispatch instead of one "
+              "per goal — each execution costs ~1 s fixed overhead on a "
+              "tunneled TPU); below it per-goal programs keep compiles small. "
+              "-1 disables fusion.")
 _D.define(name="goal.balancedness.priority.weight", type=Type.DOUBLE, default=1.1,
           validator=at_least(1.0),
           doc="Balancedness score: weight step per goal priority rank "
@@ -154,6 +160,15 @@ _D.define(name="goal.balancedness.strictness.weight", type=Type.DOUBLE, default=
           validator=at_least(1.0),
           doc="Balancedness score: extra weight of hard goals "
               "(AnalyzerConfig goal.balancedness.strictness.weight).")
+_D.define(name="allow.capacity.estimation.on.proposal.precompute", type=Type.BOOLEAN,
+          default=True,
+          doc="Whether proposal precompute may run on estimated broker "
+              "capacities (AnalyzerConfig.java); the explicit /proposals "
+              "allow_capacity_estimation parameter governs user requests.")
+_D.define(name="optimization.options.generator.class", type=Type.CLASS,
+          default="cruise_control_tpu.analyzer.options.DefaultOptimizationOptionsGenerator",
+          doc="Pluggable OptimizationOptions generator "
+              "(AnalyzerConfig optimization.options.generator.class).")
 
 # --------------------------------------------------------------------------
 # Monitor (reference: config/constants/MonitorConfig.java)
@@ -221,6 +236,51 @@ _D.define(name="follower.network.inbound.weight.for.cpu.util", type=Type.DOUBLE,
 _D.define(name="leader.network.outbound.weight.for.cpu.util", type=Type.DOUBLE, default=0.1)
 _D.define(name="use.linear.regression.model", type=Type.BOOLEAN, default=False,
           doc="Experimental linear-regression CPU model (LinearRegressionModelParameters.java).")
+_D.define(name="linear.regression.model.cpu.util.bucket.size", type=Type.INT, default=5,
+          validator=between(1, 100),
+          doc="CPU-utilization bucket width (percent) for linreg training "
+              "coverage tracking (MonitorConfig.java).")
+# reference spellings of the window keys (MonitorConfig names the partition
+# aggregator's keys `*.partition.metrics.*`; the canonical names here predate
+# the broker aggregator split)
+_D.define(name="num.partition.metrics.windows", type=Type.INT, alias_of="num.metrics.windows",
+          doc="Reference spelling of num.metrics.windows (MonitorConfig.java).")
+_D.define(name="partition.metrics.window.ms", type=Type.LONG, alias_of="metrics.window.ms",
+          doc="Reference spelling of metrics.window.ms.")
+_D.define(name="min.samples.per.partition.metrics.window", type=Type.INT,
+          alias_of="min.samples.per.metrics.window",
+          doc="Reference spelling of min.samples.per.metrics.window.")
+_D.define(name="skip.loading.samples", type=Type.BOOLEAN, default=False,
+          doc="Skip sample-store replay at startup (MonitorConfig "
+              "skip.loading.samples; LOADING state is skipped entirely).")
+_D.define(name="sampling.allow.cpu.capacity.estimation", type=Type.BOOLEAN, default=True,
+          doc="Allow samplers to estimate CPU capacity (cores) when the "
+              "capacity resolver does not provide it (MonitorConfig).")
+_D.define(name="metric.sampler.partition.assignor.class", type=Type.CLASS,
+          default="cruise_control_tpu.monitor.fetcher.DefaultPartitionAssignor",
+          doc="Partition -> fetcher assignment plugin "
+              "(MetricSamplerPartitionAssignor SPI).")
+_D.define(name="metadata.max.age.ms", type=Type.LONG, default=300_000, validator=at_least(1),
+          doc="Backend cluster-metadata refresh budget; reads newer than this "
+              "reuse the cached topology (MonitorConfig metadata.max.age.ms role).")
+_D.define(name="metadata.factor.exponent", type=Type.DOUBLE, default=1.0,
+          validator=at_least(0.0),
+          doc="Exponent of the metadata factor ((#replicas * #brokers^exp) "
+              "used by cluster-size sensors/provision math (MonitorConfig).")
+_D.define(name="network.client.provider.class", type=Type.CLASS,
+          default="cruise_control_tpu.backend.rpc.DefaultBackendClientProvider",
+          doc="Factory for the backend wire client (MonitorConfig "
+              "network.client.provider.class role: how the framework reaches "
+              "the cluster it manages).")
+_D.define(name="topic.config.provider.class", type=Type.CLASS,
+          default="cruise_control_tpu.backend.topic_config.BackendTopicConfigProvider",
+          doc="TopicConfigProvider SPI: per-topic configs (min.insync.replicas "
+              "feeds the concurrency adjuster's min-ISR check).")
+_D.define(name="sample.partition.metric.store.on.execution.class", type=Type.CLASS,
+          default=None,
+          doc="Extra SampleStore that records partition metrics DURING "
+              "execution (KafkaCruiseControlConfig "
+              "sample.partition.metric.store.on.execution.class); None disables.")
 
 # --------------------------------------------------------------------------
 # Executor (reference: config/constants/ExecutorConfig.java)
@@ -279,6 +339,58 @@ _D.define(name="executor.backend.class", type=Type.CLASS,
 _D.define(name="remove.recently.removed.brokers.grace.ms", type=Type.LONG, default=0)
 _D.define(name="demotion.history.retention.time.ms", type=Type.LONG, default=86_400_000)
 _D.define(name="removal.history.retention.time.ms", type=Type.LONG, default=86_400_000)
+_D.define(name="min.execution.progress.check.interval.ms", type=Type.LONG, default=5_000,
+          validator=at_least(1),
+          doc="Floor for the (admin-adjustable) execution progress-check "
+              "interval (ExecutorConfig.java).")
+_D.define(name="slow.task.alerting.backoff.ms", type=Type.LONG, default=60_000,
+          validator=at_least(0),
+          doc="Backoff between repeated slow-task alerts for the same task "
+              "(ExecutorConfig.java).")
+_D.define(name="admin.client.request.timeout.ms", type=Type.LONG, default=180_000,
+          validator=at_least(1),
+          doc="Timeout for backend admin requests (list/alter/describe; "
+              "ExecutorConfig admin.client.request.timeout.ms).")
+_D.define(name="logdir.response.timeout.ms", type=Type.LONG, default=10_000,
+          validator=at_least(1),
+          doc="Timeout for backend logdir describe requests "
+              "(ExecutorConfig logdir.response.timeout.ms).")
+_D.define(name="executor.notifier.class", type=Type.CLASS,
+          default="cruise_control_tpu.executor.notifier.LoggingExecutorNotifier",
+          doc="ExecutorNotifier SPI: notified when a proposal execution "
+              "finishes (success/failure/stopped; ExecutorConfig).")
+_D.define(name="failed.brokers.storage.path", type=Type.STRING, default="",
+          doc="File persisting failed-broker first-seen times across restarts "
+              "(the reference stores these under failed.brokers.zk.path; "
+              "'' keeps them in-memory only).")
+_D.define(name="failed.brokers.zk.path", type=Type.STRING,
+          alias_of="failed.brokers.storage.path",
+          doc="Reference spelling: accepted and used as the persistence path.")
+_D.define(name="zookeeper.security.enabled", type=Type.BOOLEAN, default=False,
+          doc="Accepted for config-file compatibility. This framework has no "
+              "ZooKeeper path (the backend seam actuates instead); setting "
+              "true is rejected at load.")
+_D.define(name="concurrency.adjuster.inter.broker.replica.enabled", type=Type.BOOLEAN,
+          default=True,
+          doc="Whether AIMD adjustment covers inter-broker replica moves "
+              "(ExecutorConfig).")
+_D.define(name="concurrency.adjuster.leadership.enabled", type=Type.BOOLEAN,
+          default=True,
+          doc="Whether AIMD adjustment covers leadership movements.")
+_D.define(name="concurrency.adjuster.min.isr.check.enabled", type=Type.BOOLEAN,
+          default=False,
+          doc="Pause concurrency increases (and decrease) while any sampled "
+              "partition is at/below its topic's min.insync.replicas "
+              "(ExecutorConfig concurrency.adjuster.min.isr.check.enabled).")
+_D.define(name="concurrency.adjuster.min.isr.cache.size", type=Type.INT, default=5000,
+          validator=at_least(1),
+          doc="Max (topic -> min.insync.replicas) entries cached.")
+_D.define(name="concurrency.adjuster.min.isr.retention.ms", type=Type.LONG,
+          default=720_000, validator=at_least(1),
+          doc="Cached min-ISR entry freshness budget.")
+_D.define(name="concurrency.adjuster.num.min.isr.check", type=Type.INT, default=100,
+          validator=at_least(1),
+          doc="Partitions sampled per min-ISR check round.")
 
 # --------------------------------------------------------------------------
 # Anomaly detector (reference: config/constants/AnomalyDetectorConfig.java)
@@ -349,6 +461,56 @@ _D.define(name="maintenance.event.topic.path", type=Type.STRING, default="",
 _D.define(name="maintenance.event.path", type=Type.STRING, default="",
           doc="Spool directory for FileMaintenanceEventReader.")
 _D.define(name="maintenance.event.idempotence.retention.ms", type=Type.LONG, default=180_000)
+_D.define(name="maintenance.event.enable.idempotence", type=Type.BOOLEAN, default=True,
+          doc="Drop duplicate maintenance events seen within the idempotence "
+              "retention window (AnomalyDetectorConfig).")
+_D.define(name="maintenance.event.max.idempotence.cache.size", type=Type.INT, default=25,
+          validator=at_least(1),
+          doc="Max remembered recent maintenance events for dedup.")
+_D.define(name="maintenance.event.stop.ongoing.execution", type=Type.BOOLEAN, default=False,
+          doc="Whether a maintenance event stops an ongoing proposal "
+              "execution before being handled.")
+_D.define(name="anomaly.detection.allow.capacity.estimation", type=Type.BOOLEAN, default=True,
+          doc="Whether detector-triggered optimizations may run on estimated "
+              "broker capacities (AnomalyDetectorConfig).")
+_D.define(name="num.cached.recent.anomaly.states", type=Type.INT, default=10,
+          validator=between(1, 100),
+          doc="Recent anomalies of each type retained for /state "
+              "(AnomalyDetectorConfig num.cached.recent.anomaly.states).")
+_D.define(name="self.healing.goals", type=Type.LIST, default=[],
+          doc="Goal names self-healing fixes optimize ([] = the default "
+              "goals; AnomalyDetectorConfig self.healing.goals).")
+_D.define(name="fixable.failed.broker.count.threshold", type=Type.INT, default=10,
+          validator=at_least(0),
+          doc="More simultaneously failed brokers than this is treated as "
+              "unfixable (likely a network partition, not broker death).")
+_D.define(name="fixable.failed.broker.percentage.threshold", type=Type.DOUBLE, default=0.4,
+          validator=between(0.0, 1.0),
+          doc="Failed-broker fraction above which self-healing refuses to fix.")
+# pluggable anomaly classes: the detector manager instantiates these when
+# materializing anomalies (AnomalyDetectorConfig {broker.failures, goal.
+# violations, disk.failures, metric.anomaly, topic.anomaly, maintenance.
+# event}.class; custom classes must subclass the built-in they replace)
+_D.define(name="broker.failures.class", type=Type.CLASS,
+          default="cruise_control_tpu.detector.anomalies.BrokerFailures")
+_D.define(name="goal.violations.class", type=Type.CLASS,
+          default="cruise_control_tpu.detector.anomalies.GoalViolations")
+_D.define(name="disk.failures.class", type=Type.CLASS,
+          default="cruise_control_tpu.detector.anomalies.DiskFailures")
+_D.define(name="metric.anomaly.class", type=Type.CLASS,
+          default="cruise_control_tpu.detector.anomalies.MetricAnomaly")
+_D.define(name="maintenance.event.class", type=Type.CLASS,
+          default="cruise_control_tpu.detector.anomalies.MaintenanceEvent")
+# provisioner right-sizing floors (AnomalyDetectorConfig overprovisioned.*)
+_D.define(name="overprovisioned.min.brokers", type=Type.INT, default=3, validator=at_least(1),
+          doc="Never recommend shrinking below this broker count.")
+_D.define(name="overprovisioned.min.extra.racks", type=Type.INT, default=1,
+          validator=at_least(0),
+          doc="Extra racks beyond max replication factor required before an "
+              "over-provisioned verdict.")
+_D.define(name="overprovisioned.max.replicas.per.broker", type=Type.LONG, default=1500,
+          validator=at_least(1),
+          doc="Replica density above which the cluster is NOT over-provisioned.")
 
 # --------------------------------------------------------------------------
 # Web server + user tasks (reference: WebServerConfig.java, UserTaskManagerConfig.java)
@@ -397,6 +559,124 @@ _D.define(name="trusted.proxy.services", type=Type.LIST, default="",
 _D.define(name="trusted.proxy.fallback.enabled", type=Type.BOOLEAN, default=True,
           doc="Whether a trusted-proxy request without doAs falls back to the "
               "proxy's own identity (trusted.proxy.spnego.fallback.enabled role).")
+_D.define(name="trusted.proxy.services.ip.regex", type=Type.STRING, default="",
+          doc="Regex of client IPs allowed to act as trusted proxies "
+              "('' = any; WebServerConfig trusted.proxy.services.ip.regex).")
+_D.define(name="webserver.session.maxExpiryTimeMs", type=Type.LONG,
+          alias_of="webserver.session.maxExpiryTime",
+          doc="Reference spelling of webserver.session.maxExpiryTime.")
+_D.define(name="webserver.session.path", type=Type.STRING, default="/",
+          doc="Path attribute of the session cookie (WebServerConfig "
+              "webserver.session.path).")
+_D.define(name="webserver.accesslog.enabled", type=Type.BOOLEAN, default=False,
+          doc="NCSA-style access log (WebServerConfig webserver.accesslog.*).")
+_D.define(name="webserver.accesslog.path", type=Type.STRING, default="access.log",
+          doc="Access-log file path.")
+_D.define(name="webserver.accesslog.retention.days", type=Type.INT, default=14,
+          validator=at_least(0),
+          doc="Rotated access logs older than this are deleted at startup.")
+_D.define(name="webserver.http.cors.enabled", type=Type.BOOLEAN, default=False,
+          doc="CORS headers + OPTIONS preflight (WebServerConfig cors block).")
+_D.define(name="webserver.http.cors.origin", type=Type.STRING, default="*",
+          doc="Access-Control-Allow-Origin value.")
+_D.define(name="webserver.http.cors.allowmethods", type=Type.STRING,
+          default="OPTIONS, GET, POST",
+          doc="Access-Control-Allow-Methods value.")
+_D.define(name="webserver.http.cors.exposeheaders", type=Type.STRING,
+          default="User-Task-ID",
+          doc="Access-Control-Expose-Headers value.")
+_D.define(name="webserver.ui.diskpath", type=Type.STRING, default="",
+          doc="Directory of cruise-control-ui static files to serve "
+              "('' disables the UI; WebServerConfig webserver.ui.diskpath).")
+_D.define(name="webserver.ui.urlprefix", type=Type.STRING, default="/*",
+          doc="URL prefix the UI is served under.")
+_D.define(name="request.reason.required", type=Type.BOOLEAN, default=False,
+          doc="Require a ?reason= on POST requests (WebServerConfig).")
+_D.define(name="two.step.purgatory.max.cached.completed.requests", type=Type.INT,
+          default=100, validator=at_least(0),
+          doc="Completed (submitted/discarded) purgatory requests retained "
+              "for the review board.")
+_D.define(name="max.cached.completed.kafka.admin.user.tasks", type=Type.INT, default=None,
+          doc="Per-type completed-task cache cap for KAFKA_ADMIN endpoints "
+              "(None = max.cached.completed.user.tasks; UserTaskManagerConfig).")
+_D.define(name="max.cached.completed.kafka.monitor.user.tasks", type=Type.INT, default=None,
+          doc="Per-type completed-task cache cap for KAFKA_MONITOR endpoints.")
+_D.define(name="max.cached.completed.cruise.control.admin.user.tasks", type=Type.INT,
+          default=None,
+          doc="Per-type completed-task cache cap for CRUISE_CONTROL_ADMIN endpoints.")
+_D.define(name="max.cached.completed.cruise.control.monitor.user.tasks", type=Type.INT,
+          default=None,
+          doc="Per-type completed-task cache cap for CRUISE_CONTROL_MONITOR endpoints.")
+# --- SSL: reference keystore spellings onto the PEM-based stdlib stack ---
+_D.define(name="webserver.ssl.keystore.location", type=Type.STRING,
+          alias_of="webserver.ssl.cert.location",
+          doc="Reference spelling: the certificate (PEM) file.")
+_D.define(name="webserver.ssl.keystore.password", type=Type.PASSWORD,
+          alias_of="webserver.ssl.key.password",
+          doc="Reference spelling: the private-key passphrase.")
+_D.define(name="webserver.ssl.keystore.type", type=Type.STRING, default="PEM",
+          doc="Only PEM is supported by the stdlib ssl stack; JKS/PKCS12 "
+              "files must be converted (rejected at load otherwise).")
+_D.define(name="webserver.ssl.protocol", type=Type.STRING, default="TLS",
+          validator=in_set("TLS", "TLSv1.2", "TLSv1.3"),
+          doc="Minimum TLS protocol version for the HTTPS listener.")
+_D.define(name="webserver.ssl.include.ciphers", type=Type.LIST, default=None,
+          doc="Explicit OpenSSL cipher list for TLSv1.2 ('None' = defaults).")
+_D.define(name="webserver.ssl.exclude.ciphers", type=Type.LIST, default=None,
+          doc="Ciphers removed from the TLSv1.2 cipher list.")
+_D.define(name="webserver.ssl.include.protocols", type=Type.LIST, default=None,
+          doc="Allowed TLS protocol versions (subset of TLSv1.2/TLSv1.3).")
+_D.define(name="webserver.ssl.exclude.protocols", type=Type.LIST, default=None,
+          doc="TLS protocol versions to disable.")
+# --- JWT/SPNEGO reference keys ---
+_D.define(name="jwt.cookie.name", type=Type.STRING, default="",
+          doc="Cookie carrying the JWT ('' = Authorization header only; "
+              "WebServerConfig jwt.cookie.name).")
+_D.define(name="jwt.expected.audiences", type=Type.LIST, default=None,
+          doc="Accepted 'aud' claim values (None = audience not checked).")
+_D.define(name="jwt.authentication.provider.url", type=Type.STRING, default="",
+          doc="Login-service URL unauthenticated browsers are redirected to "
+              "({redirect}?origin=<url> contract of the reference's "
+              "JwtAuthenticator); '' returns a plain 401.")
+_D.define(name="jwt.auth.certificate.location", type=Type.STRING, default="",
+          doc="RS256 public certificate (PEM). The stdlib stack verifies "
+              "HS256 via jwt.secret.file; setting this selects RS256 "
+              "verification of the token signature instead.")
+_D.define(name="spnego.principal", type=Type.STRING, default="",
+          doc="Service principal expected in Negotiate tokens "
+              "(WebServerConfig spnego.principal; '' accepts any).")
+_D.define(name="spnego.keytab.file", type=Type.STRING,
+          alias_of="spnego.principal.secret.file",
+          doc="Reference spelling: the credential file backing the SPNEGO "
+              "token-validator seam.")
+
+# --------------------------------------------------------------------------
+# Pluggable per-endpoint request/parameter classes
+# (reference: CruiseControlParametersConfig.java + CruiseControlRequestConfig
+# .java — one `<endpoint>.parameters.class` + `<endpoint>.request.class` pair
+# per endpoint). None = the built-in parser/handler. A parameters class is a
+# callable ``(endpoint, query) -> params dict``; a request class exposes
+# ``handle(server, method, endpoint, params, client, task_id_header) ->
+# (status, body, headers)``. Consumed by api/server.py dispatch.
+# --------------------------------------------------------------------------
+from cruise_control_tpu.api.endpoints import EndPoint as _EndPoint  # noqa: E402
+
+
+def endpoint_config_stem(path: str) -> str:
+    """Endpoint URL path -> reference config-key stem
+    (CruiseControlParametersConfig.java naming; one irregular case)."""
+    return {"stop_proposal_execution": "stop.proposal"}.get(
+        path, path.replace("_", "."))
+
+
+for _ep in _EndPoint:
+    _stem = endpoint_config_stem(_ep.path)
+    _D.define(name=f"{_stem}.parameters.class", type=Type.CLASS, default=None,
+              doc=f"Parameter-parser override for /{_ep.path} "
+                  f"(CruiseControlParametersConfig).")
+    _D.define(name=f"{_stem}.request.class", type=Type.CLASS, default=None,
+              doc=f"Request-handler override for /{_ep.path} "
+                  f"(CruiseControlRequestConfig).")
 
 # --------------------------------------------------------------------------
 # TPU placement / parallelism (no reference analogue — TPU-native surface)
@@ -440,12 +720,34 @@ def _sanity_check(cfg) -> None:
     if cfg.get_int("max.num.cluster.movements") < cfg.get_int("num.concurrent.leader.movements"):
         # mirrors sanityCheckConcurrency: cluster cap must cover leadership concurrency
         raise ConfigException("max.num.cluster.movements < num.concurrent.leader.movements")
-    pattern = cfg.get_string("topics.excluded.from.partition.movement")
-    if pattern:
-        import re
-        try:
-            re.compile(pattern)
-        except re.error as e:
+    import re
+    for rx_key in ("topics.excluded.from.partition.movement",
+                   "trusted.proxy.services.ip.regex"):
+        pattern = cfg.get_string(rx_key)
+        if pattern:
+            try:
+                re.compile(pattern)
+            except re.error as e:
+                raise ConfigException(
+                    f"{rx_key} is not a valid regex: {e}") from None
+    # keys accepted for reference config-file compatibility whose JVM-specific
+    # values this framework cannot honor are rejected loudly, not ignored
+    if cfg.get_boolean("zookeeper.security.enabled"):
+        raise ConfigException(
+            "zookeeper.security.enabled=true: this framework has no ZooKeeper "
+            "path — actuation goes through the backend seam "
+            "(executor.backend.class); secure that transport instead")
+    if cfg.get_string("webserver.ssl.keystore.type").upper() != "PEM":
+        raise ConfigException(
+            "webserver.ssl.keystore.type: only PEM is supported by the "
+            "stdlib ssl stack — convert JKS/PKCS12 keystores "
+            "(openssl pkcs12 -in ks.p12 -out ks.pem)")
+    allowed_tls = {"TLSv1.2", "TLSv1.3"}
+    for proto_key in ("webserver.ssl.include.protocols",
+                      "webserver.ssl.exclude.protocols"):
+        vals = cfg.get(proto_key)
+        bad = [v for v in (vals or []) if v not in allowed_tls]
+        if bad:
             raise ConfigException(
-                f"topics.excluded.from.partition.movement is not a valid "
-                f"regex: {e}") from None
+                f"{proto_key}: unsupported protocol(s) {bad} "
+                f"(allowed: {sorted(allowed_tls)})")

@@ -28,11 +28,15 @@ from cruise_control_tpu.detector.provisioner import (
 
 class GoalViolationDetector:
     def __init__(self, goal_optimizer, load_monitor, detection_goals: list,
-                 provisioner=None, sensors=None):
+                 provisioner=None, sensors=None, anomaly_cls=GoalViolations,
+                 allow_capacity_estimation: bool = True):
         self._optimizer = goal_optimizer
         self._monitor = load_monitor
         self._goals = list(detection_goals)
         self._provisioner = provisioner
+        # goal.violations.class: pluggable anomaly materialization
+        self._anomaly_cls = anomaly_cls
+        self._allow_capacity_estimation = allow_capacity_estimation
         self.last_balancedness: float = 100.0
         self.last_provision: ProvisionRecommendation | None = None
         if sensors is not None:
@@ -56,7 +60,8 @@ class GoalViolationDetector:
         from cruise_control_tpu.analyzer.env import OptimizationOptions
         from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
         try:
-            ct, meta = self._monitor.cluster_model()
+            ct, meta = self._monitor.cluster_model(
+                allow_capacity_estimation=self._allow_capacity_estimation)
         except NotEnoughValidWindowsError:
             return []   # not enough data yet — detector skips this round
         # raise_on_failure=False: the detector *assesses* violations — an
@@ -80,7 +85,7 @@ class GoalViolationDetector:
                 self._provisioner.rightsize([rec])
         if not fixable and not unfixable:
             return []
-        return [GoalViolations(
+        return [self._anomaly_cls(
             anomaly_type=AnomalyType.GOAL_VIOLATION, detected_ms=now_ms,
             violated_goals_fixable=fixable, violated_goals_unfixable=unfixable,
             fixable=bool(fixable),
@@ -92,9 +97,11 @@ class BrokerFailureDetector:
     not reset the self-healing grace clock (BrokerFailureDetector.java:119-123
     persists to a znode; here a JSON file)."""
 
-    def __init__(self, backend, persist_path: str = ""):
+    def __init__(self, backend, persist_path: str = "",
+                 anomaly_cls=BrokerFailures):
         self._backend = backend
         self._persist_path = persist_path
+        self._anomaly_cls = anomaly_cls   # broker.failures.class
         self._failure_ms: dict[int, float] = {}
         self._load()
 
@@ -128,15 +135,16 @@ class BrokerFailureDetector:
             self._save()
         if not self._failure_ms:
             return []
-        return [BrokerFailures(
+        return [self._anomaly_cls(
             anomaly_type=AnomalyType.BROKER_FAILURE, detected_ms=now_ms,
             failed_brokers=dict(self._failure_ms),
             description=f"failed brokers: {sorted(self._failure_ms)}")]
 
 
 class DiskFailureDetector:
-    def __init__(self, backend):
+    def __init__(self, backend, anomaly_cls=DiskFailures):
         self._backend = backend
+        self._anomaly_cls = anomaly_cls   # disk.failures.class
 
     def run_once(self, now_ms: float) -> list:
         logdirs = self._backend.describe_logdirs()
@@ -150,7 +158,7 @@ class DiskFailureDetector:
                 failed[b] = bad
         if not failed:
             return []
-        return [DiskFailures(
+        return [self._anomaly_cls(
             anomaly_type=AnomalyType.DISK_FAILURE, detected_ms=now_ms,
             failed_disks=failed,
             description=f"failed disks: {failed}")]

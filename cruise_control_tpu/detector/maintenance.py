@@ -16,25 +16,37 @@ from cruise_control_tpu.detector.anomalies import AnomalyType, MaintenanceEvent
 
 
 class IdempotenceCache:
-    """Drops plans already seen within the retention window
-    (detector/IdempotenceCache.java)."""
+    """Drops plans already seen within the retention window, remembering at
+    most ``max_size`` recent plans (detector/IdempotenceCache.java;
+    AnomalyDetectorConfig maintenance.event.{enable.idempotence,
+    max.idempotence.cache.size, idempotence.retention.ms}). ``enabled=False``
+    turns the cache into a pass-through."""
 
-    def __init__(self, retention_ms: float = 180_000.0):
+    def __init__(self, retention_ms: float = 180_000.0, max_size: int = 25,
+                 enabled: bool = True):
         self._retention = retention_ms
+        self._max = max_size
+        self._enabled = enabled
         self._seen: dict[str, float] = {}
 
     def seen_before(self, key: str, now_ms: float) -> bool:
+        if not self._enabled:
+            return False
         self._seen = {k: t for k, t in self._seen.items()
                       if now_ms - t < self._retention}
         if key in self._seen:
             return True
+        if len(self._seen) >= self._max:
+            oldest = min(self._seen, key=self._seen.get)
+            del self._seen[oldest]
         self._seen[key] = now_ms
         return False
 
 
-def _event_from_dict(d: dict, now_ms: float):
-    """One parsed plan dict -> MaintenanceEvent (shared by every reader)."""
-    return MaintenanceEvent(
+def _event_from_dict(d: dict, now_ms: float, event_cls=MaintenanceEvent):
+    """One parsed plan dict -> MaintenanceEvent (shared by every reader);
+    ``event_cls`` is the pluggable maintenance.event.class."""
+    return event_cls(
         anomaly_type=AnomalyType.MAINTENANCE_EVENT,
         detected_ms=now_ms, plan_type=d.get("type", ""),
         brokers=d.get("brokers", []), topics=d.get("topics", {}),
@@ -45,12 +57,16 @@ class FileMaintenanceEventReader:
     def __init__(self, path: str = ""):
         self._path = path
         self._offset = 0
+        self._event_cls = MaintenanceEvent
 
     def configure(self, config, **extra):
         path = extra.get("path") or (config.get_string("maintenance.event.path")
                                      if config is not None else "")
         if path:
             self._path = path
+        if config is not None:
+            self._event_cls = (config.get_class("maintenance.event.class")
+                               or MaintenanceEvent)
 
     def read_events(self, now_ms: float) -> list:
         if not self._path:
@@ -66,7 +82,7 @@ class FileMaintenanceEventReader:
                     d = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                events.append(_event_from_dict(d, now_ms))
+                events.append(_event_from_dict(d, now_ms, self._event_cls))
             self._offset = f.tell()
         return events
 
@@ -84,6 +100,7 @@ class TopicMaintenanceEventReader:
         self._path = path
         self._topic = None
         self._offset = 0
+        self._event_cls = MaintenanceEvent
 
     def configure(self, config, **extra):
         path = extra.get("path") or (
@@ -91,6 +108,9 @@ class TopicMaintenanceEventReader:
             if config is not None else "")
         if path:
             self._path = path
+        if config is not None:
+            self._event_cls = (config.get_class("maintenance.event.class")
+                               or MaintenanceEvent)
 
     def _ensure(self):
         if self._topic is None and self._path:
@@ -109,7 +129,7 @@ class TopicMaintenanceEventReader:
                 d = json.loads(payload.decode())
             except (json.JSONDecodeError, UnicodeDecodeError):
                 continue
-            events.append(_event_from_dict(d, now_ms))
+            events.append(_event_from_dict(d, now_ms, self._event_cls))
         return events
 
 

@@ -24,7 +24,9 @@ LOG = logging.getLogger("cruise_control_tpu.detector")
 
 
 class AnomalyDetectorManager:
-    def __init__(self, notifier=None, cruise_control=None, clock=None):
+    def __init__(self, notifier=None, cruise_control=None, clock=None,
+                 num_cached_recent_states: int = 10,
+                 maintenance_stops_ongoing_execution: bool = False):
         self._notifier = notifier or NoopNotifier()
         self._cc = cruise_control
         self._clock = clock
@@ -33,6 +35,13 @@ class AnomalyDetectorManager:
         self._lock = threading.Lock()
         self._detectors: list = []       # (name, callable(now_ms) -> [Anomaly])
         self._history: list[dict] = []
+        # per-type recent-anomaly ring (AnomalyDetectorConfig
+        # num.cached.recent.anomaly.states; served at /state)
+        from collections import deque
+        self._recent = {t: deque(maxlen=num_cached_recent_states)
+                        for t in AnomalyType}
+        # AnomalyDetectorConfig maintenance.event.stop.ongoing.execution
+        self._maintenance_stops_ongoing = maintenance_stops_ongoing_execution
         self._self_healing_actions = 0
         self._thread: threading.Thread | None = None
         self._stop_event = threading.Event()
@@ -95,6 +104,12 @@ class AnomalyDetectorManager:
             entry = {"anomaly": anomaly.to_json(), "action": verdict.action.value}
             if verdict.action is Action.FIX and self._cc is not None:
                 try:
+                    if (anomaly.anomaly_type is AnomalyType.MAINTENANCE_EVENT
+                            and self._maintenance_stops_ongoing
+                            and self._cc.executor.has_ongoing_execution()):
+                        # maintenance.event.stop.ongoing.execution: the plan
+                        # preempts whatever proposal execution is running
+                        self._cc.stop_proposal_execution(force=False)
                     result = anomaly.fix(self._cc)
                     entry["fixResult"] = result
                     self._self_healing_actions += 1
@@ -106,6 +121,8 @@ class AnomalyDetectorManager:
                     self._deferred.append((now_ms + verdict.delay_ms, anomaly))
             handled.append(entry)
             self._history.append(entry)
+            with self._lock:
+                self._recent[anomaly.anomaly_type].append(entry)
         return handled
 
     # --------------------------------------------------- background thread
@@ -141,9 +158,13 @@ class AnomalyDetectorManager:
     def state_json(self) -> dict:
         with self._lock:
             recent = self._history[-10:]
+            by_type = {t.name: list(d) for t, d in self._recent.items() if d}
         return {
             "selfHealingEnabled": self._notifier.self_healing_enabled(),
             "recentAnomalies": recent,
+            # AnomalyDetectorState recent<Type>s role, capped per type by
+            # num.cached.recent.anomaly.states
+            "recentAnomaliesByType": by_type,
             "numSelfHealingActions": self._self_healing_actions,
             "numQueuedAnomalies": self.num_queued(),
             "registeredDetectors": [n for n, _ in self._detectors],

@@ -20,11 +20,13 @@ class PercentileMetricAnomalyFinder:
                           "BROKER_PRODUCE_LOCAL_TIME_MS_999TH")
 
     def __init__(self, upper_percentile: float = 95.0, lower_percentile: float = 2.0,
-                 upper_margin: float = 0.5, lower_margin: float = 0.2):
+                 upper_margin: float = 0.5, lower_margin: float = 0.2,
+                 anomaly_cls=MetricAnomaly):
         self.upper_percentile = upper_percentile
         self.lower_percentile = lower_percentile
         self.upper_margin = upper_margin
         self.lower_margin = lower_margin
+        self._anomaly_cls = anomaly_cls   # metric.anomaly.class
 
     def configure(self, config, **extra):
         if config is not None:
@@ -32,6 +34,9 @@ class PercentileMetricAnomalyFinder:
                 "metric.anomaly.percentile.upper.threshold")
             self.lower_percentile = config.get_double(
                 "metric.anomaly.percentile.lower.threshold")
+            cls = config.get_class("metric.anomaly.class")
+            if cls is not None:
+                self._anomaly_cls = cls
 
     def anomalies(self, history: dict, current: dict, now_ms: float) -> list:
         """history: broker -> {metric: np.ndarray of past window values};
@@ -49,7 +54,7 @@ class PercentileMetricAnomalyFinder:
                 upper = np.percentile(h, self.upper_percentile) * (1 + self.upper_margin)
                 lower = np.percentile(h, self.lower_percentile) * self.lower_margin
                 if cur > upper or (lower > 0 and cur < lower):
-                    out.append(MetricAnomaly(
+                    out.append(self._anomaly_cls(
                         anomaly_type=AnomalyType.METRIC_ANOMALY, detected_ms=now_ms,
                         broker_ids=[broker], metric_name=name,
                         description=f"broker {broker} {name}={cur:.2f} outside "

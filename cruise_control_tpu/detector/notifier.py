@@ -50,6 +50,12 @@ class SelfHealingNotifier:
         self._enabled: dict[AnomalyType, bool] = {t: False for t in AnomalyType}
         self.alert_threshold_ms = 900_000.0
         self.self_healing_threshold_ms = 1_800_000.0
+        # fixability gate (AnomalyDetectorConfig fixable.failed.broker.
+        # {count,percentage}.threshold): mass failures look like a network
+        # partition — self-healing must not try to evacuate half the cluster
+        self.fixable_broker_count_threshold = 10
+        self.fixable_broker_pct_threshold = 0.4
+        self._num_brokers = lambda: 0   # live cluster size supplier
         self._alert_sink = None     # callable(dict) for Slack/Alerta-style fanout
         self._alerted: set[int] = set()
 
@@ -70,8 +76,14 @@ class SelfHealingNotifier:
             self.alert_threshold_ms = float(config.get_int("broker.failure.alert.threshold.ms"))
             self.self_healing_threshold_ms = float(
                 config.get_int("broker.failure.self.healing.threshold.ms"))
+            self.fixable_broker_count_threshold = config.get_int(
+                "fixable.failed.broker.count.threshold")
+            self.fixable_broker_pct_threshold = config.get_double(
+                "fixable.failed.broker.percentage.threshold")
         if alert_sink is not None:
             self._alert_sink = alert_sink
+        if extra.get("num_brokers_supplier") is not None:
+            self._num_brokers = extra["num_brokers_supplier"]
 
     def set_self_healing(self, anomaly_type: AnomalyType, enabled: bool) -> None:
         self._enabled[anomaly_type] = enabled
@@ -94,6 +106,17 @@ class SelfHealingNotifier:
     def on_anomaly(self, anomaly: Anomaly, now_ms: float) -> NotificationResult:
         enabled = self._enabled.get(anomaly.anomaly_type, False)
         if isinstance(anomaly, BrokerFailures):
+            # mass failures are unfixable by evacuation (fixable.failed.
+            # broker.*.threshold): alert only, never FIX. The percentage
+            # check needs the live cluster size; when no supplier was wired
+            # (size 0 = unknown) only the absolute count gate applies.
+            n_failed = len(anomaly.failed_brokers)
+            n_total = self._num_brokers()
+            if (n_failed > self.fixable_broker_count_threshold
+                    or (n_total > 0 and n_failed / n_total
+                        > self.fixable_broker_pct_threshold)):
+                self._alert(anomaly, auto_fix=False)
+                return NotificationResult(Action.IGNORE)
             # grace ladder: wait, then alert, then fix
             first_failure = min(anomaly.failed_brokers.values(), default=now_ms)
             alert_at = first_failure + self.alert_threshold_ms

@@ -17,9 +17,12 @@ tests and a real deployment.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time as _time
 from collections import deque
+
+LOG = logging.getLogger(__name__)
 
 from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
 from cruise_control_tpu.executor.strategy import build_strategy
@@ -83,11 +86,41 @@ class ExecutorConfigView:
     adjuster_add_leadership: int = 100
     adjuster_div_replica: int = 2
     adjuster_div_leadership: int = 2
+    # per-movement-type AIMD gates + the min-ISR safety check
+    # (ExecutorConfig concurrency.adjuster.{inter.broker.replica,leadership}.
+    # enabled and concurrency.adjuster.min.isr.*)
+    adjuster_replica_enabled: bool = True
+    adjuster_leadership_enabled: bool = True
+    min_isr_check_enabled: bool = False
+    min_isr_cache_size: int = 5000
+    min_isr_retention_ms: float = 720_000.0
+    min_isr_num_check: int = 100
+    min_progress_check_interval_ms: float = 5_000.0
+    slow_task_threshold_ms: float = 90_000.0
+    slow_task_backoff_ms: float = 60_000.0
 
     @classmethod
     def from_config(cls, cfg) -> "ExecutorConfigView":
         throttle = cfg.get_int("default.replication.throttle")
         return cls(
+            adjuster_replica_enabled=cfg.get_boolean(
+                "concurrency.adjuster.inter.broker.replica.enabled"),
+            adjuster_leadership_enabled=cfg.get_boolean(
+                "concurrency.adjuster.leadership.enabled"),
+            min_isr_check_enabled=cfg.get_boolean(
+                "concurrency.adjuster.min.isr.check.enabled"),
+            min_isr_cache_size=cfg.get_int(
+                "concurrency.adjuster.min.isr.cache.size"),
+            min_isr_retention_ms=float(cfg.get_int(
+                "concurrency.adjuster.min.isr.retention.ms")),
+            min_isr_num_check=cfg.get_int(
+                "concurrency.adjuster.num.min.isr.check"),
+            min_progress_check_interval_ms=float(cfg.get_int(
+                "min.execution.progress.check.interval.ms")),
+            slow_task_threshold_ms=float(cfg.get_int(
+                "task.execution.alerting.threshold.ms")),
+            slow_task_backoff_ms=float(cfg.get_int(
+                "slow.task.alerting.backoff.ms")),
             per_broker_cap=cfg.get_int("num.concurrent.partition.movements.per.broker"),
             cluster_cap=cfg.get_int("max.num.cluster.partition.movements"),
             intra_broker_cap=cfg.get_int("num.concurrent.intra.broker.partition.movements"),
@@ -126,6 +159,32 @@ class ExecutorConfigView:
         )
 
 
+class MinIsrCache:
+    """Bounded (topic -> min.insync.replicas) cache with entry freshness
+    (Executor.java MinIsrCache role; ExecutorConfig concurrency.adjuster.
+    min.isr.{cache.size, retention.ms}). Stale/evicted entries are re-fetched
+    from the TopicConfigProvider on demand."""
+
+    def __init__(self, provider, max_size: int = 5000,
+                 retention_ms: float = 720_000.0):
+        self._provider = provider
+        self._max = max_size
+        self._retention_ms = retention_ms
+        self._entries: dict[str, tuple[int, float]] = {}  # topic -> (minIsr, ts)
+
+    def min_isr(self, topic: str, now_ms: float) -> int:
+        hit = self._entries.get(topic)
+        if hit is not None and now_ms - hit[1] < self._retention_ms:
+            return hit[0]
+        value = self._provider.min_insync_replicas(topic)
+        if len(self._entries) >= self._max:
+            # evict the stalest entry
+            oldest = min(self._entries, key=lambda t: self._entries[t][1])
+            del self._entries[oldest]
+        self._entries[topic] = (value, now_ms)
+        return value
+
+
 class ConcurrencyAdjuster:
     """AIMD movement-concurrency control from live broker metrics.
 
@@ -134,14 +193,49 @@ class ConcurrencyAdjuster:
     configured limit for one of the watched 999th-percentile latency / queue
     metrics, the concurrency is divided (multiplicative decrease, clamped to
     the configured min); if all brokers are healthy it is increased additively
-    (clamped to the max). The reference's (At/Under)MinISR-based cancel check
-    needs topic minIsr configs, which the backend SPI does not expose yet —
-    metrics-based adjustment is the part carried here.
+    (clamped to the max). When the min-ISR check is enabled
+    (concurrency.adjuster.min.isr.check.enabled), partitions at/under their
+    topic's min.insync.replicas count as over-limit too — movement concurrency
+    backs off while the cluster is fragile.
     """
 
-    def __init__(self, cfg: ExecutorConfigView):
+    def __init__(self, cfg: ExecutorConfigView, min_isr_cache=None,
+                 backend=None):
         self._cfg = cfg
+        self._min_isr = min_isr_cache
+        self._backend = backend
+        self._min_isr_cursor = 0   # rotating sample window over partitions
         self.history: deque = deque(maxlen=100)
+
+    def _min_isr_violations(self) -> list:
+        """A rotating window of num.min.isr.check partitions whose in-sync
+        replica count is at/below the topic's min.insync.replicas — the
+        cursor advances every tick so the whole cluster is covered over
+        successive checks, not just a fixed prefix. The effective ISR is the
+        backend's reported one, falling back to replicas on alive brokers."""
+        if (not self._cfg.min_isr_check_enabled or self._min_isr is None
+                or self._backend is None):
+            return []
+        brokers = self._backend.brokers()
+        clock = getattr(self._backend, "now_ms", 0.0)
+        now_ms = float(clock() if callable(clock) else clock)
+        items = list(self._backend.partitions().items())
+        n = self._cfg.min_isr_num_check
+        start = self._min_isr_cursor % max(len(items), 1)
+        self._min_isr_cursor = start + n
+        window = items[start:start + n]
+        if len(window) < n:   # wrap
+            window += items[:n - len(window)]
+        bad = []
+        for (topic, part), info in window:
+            isr = getattr(info, "isr", None)
+            if isr is None:
+                isr = [r for r in info.replicas
+                       if brokers.get(r) is not None and brokers[r].alive]
+            need = self._min_isr.min_isr(topic, now_ms)
+            if len(isr) <= need:
+                bad.append((topic, part, len(isr), need))
+        return bad
 
     def _over_limit(self, broker_metrics: dict) -> list:
         over = []
@@ -150,6 +244,8 @@ class ConcurrencyAdjuster:
                 v = metrics.get(name)
                 if v is not None and v > limit:
                     over.append((b, name, v, limit))
+        over.extend(("minIsr", f"{t}-{p}", in_sync, need)
+                    for t, p, in_sync, need in self._min_isr_violations())
         return over
 
     def recommend_replica_concurrency(self, current: int, broker_metrics: dict) -> int:
@@ -207,7 +303,22 @@ class Executor:
         self._recently_demoted_brokers: dict[int, float] = {}
         self._execution_thread: threading.Thread | None = None
         self._reservation = None
-        self._adjuster = ConcurrencyAdjuster(self._cfg)
+        min_isr_cache = None
+        self._notifier = None
+        if config is not None:
+            provider = config.get_configured_instance("topic.config.provider.class")
+            if provider is not None:
+                attach = getattr(provider, "attach", None)
+                if callable(attach):
+                    attach(backend)
+                min_isr_cache = MinIsrCache(
+                    provider, max_size=self._cfg.min_isr_cache_size,
+                    retention_ms=self._cfg.min_isr_retention_ms)
+            # ExecutorNotifier SPI (executor.notifier.class)
+            self._notifier = config.get_configured_instance(
+                "executor.notifier.class")
+        self._adjuster = ConcurrencyAdjuster(self._cfg, min_isr_cache, backend)
+        self._slow_task_alerts: dict[int, float] = {}  # task_id -> last alert ms
 
     # ---------------------------------------------------------- reservation
     def reserve(self, owner: str) -> None:
@@ -284,7 +395,10 @@ class Executor:
         if leadership is not None:
             self._cfg.leadership_cap = int(leadership)
         if progress_check_interval_ms is not None:
-            self._cfg.progress_check_interval_ms = float(progress_check_interval_ms)
+            # floor per ExecutorConfig min.execution.progress.check.interval.ms
+            self._cfg.progress_check_interval_ms = max(
+                float(progress_check_interval_ms),
+                self._cfg.min_progress_check_interval_ms)
         return {"perBroker": self._cfg.per_broker_cap,
                 "intraBroker": self._cfg.intra_broker_cap,
                 "leadership": self._cfg.leadership_cap,
@@ -299,6 +413,23 @@ class Executor:
             self._recently_demoted_brokers[b] = self._clock.now_ms()
 
     # ------------------------------------------------------------ execution
+    def _alert_slow_tasks(self, in_flight: dict) -> None:
+        """Alert on tasks in flight longer than the alerting threshold
+        (ExecutorConfig task.execution.alerting.threshold.ms), re-alerting the
+        same task only after slow.task.alerting.backoff.ms."""
+        now = self._clock.now_ms()
+        for t in in_flight.values():
+            if t.start_ms < 0 or now - t.start_ms < self._cfg.slow_task_threshold_ms:
+                continue
+            last = self._slow_task_alerts.get(t.task_id, -1e18)
+            if now - last < self._cfg.slow_task_backoff_ms:
+                continue
+            self._slow_task_alerts[t.task_id] = now
+            self._sensors.meter("slow-task-alerts").mark()
+            LOG.warning("slow task %s: %s in flight for %.0f s (threshold %.0f s)",
+                        t.task_id, t.tp, (now - t.start_ms) / 1000.0,
+                        self._cfg.slow_task_threshold_ms / 1000.0)
+
     def execute_proposals(self, proposals: list, blocking: bool = True,
                           context: dict | None = None) -> None:
         """Run the 3-phase execution (Executor.executeProposals :567)."""
@@ -313,6 +444,8 @@ class Executor:
         if context is None:
             sizes = {tp: info.size_mb for tp, info in self._backend.partitions().items()}
             context = {"partition_size_mb": sizes}
+        self._operation = context.get("operation", "proposal execution")
+        self._slow_task_alerts.clear()
         planner.add_proposals(proposals, context)
         self._current_planner = planner
         if blocking:
@@ -353,6 +486,25 @@ class Executor:
             })
             with self._lock:
                 self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            if self._notifier is not None:
+                # ExecutorNotifier SPI (executor.notifier.class): one
+                # notification per finished execution
+                from cruise_control_tpu.executor.notifier import (
+                    ExecutorNotification,
+                )
+                n_lead = sum(1 for t in planner.all_tasks
+                             if t.task_type is TaskType.LEADER_ACTION
+                             and t.state is TaskState.COMPLETED)
+                try:
+                    self._notifier.on_execution_finished(ExecutorNotification(
+                        operation=self._operation,
+                        success=not self._stop_requested
+                        and done == len(planner.all_tasks),
+                        stopped_by_user=self._stop_requested,
+                        num_replica_movements=done - n_lead,
+                        num_leadership_movements=n_lead))
+                except Exception:
+                    LOG.exception("executor notifier failed")
 
     def _inter_broker_phase(self, planner: ExecutionTaskPlanner) -> None:
         self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT
@@ -378,10 +530,13 @@ class Executor:
                 for b in t.brokers_involved:
                     in_flight_by_broker[b] = max(0, in_flight_by_broker.get(b, 1) - 1)
             # dynamic concurrency: AIMD on live broker metrics each progress
-            # tick (ConcurrencyAdjuster role, Executor.java:335-448)
-            if self._cfg.adjuster_enabled:
+            # tick (ConcurrencyAdjuster role, Executor.java:335-448); gated
+            # per movement type (concurrency.adjuster.inter.broker.replica.
+            # enabled)
+            if self._cfg.adjuster_enabled and self._cfg.adjuster_replica_enabled:
                 self._cfg.per_broker_cap = self._adjuster.recommend_replica_concurrency(
                     self._cfg.per_broker_cap, self._backend.broker_metrics())
+            self._alert_slow_tasks(in_flight)
             if not self._stop_requested:
                 batch = planner.next_inter_broker_tasks(
                     in_flight_by_broker, self._cfg.per_broker_cap,
@@ -436,7 +591,7 @@ class Executor:
         while True:
             if self._stop_requested:
                 return
-            if self._cfg.adjuster_enabled:
+            if self._cfg.adjuster_enabled and self._cfg.adjuster_leadership_enabled:
                 self._cfg.leadership_cap = \
                     self._adjuster.recommend_leadership_concurrency(
                         self._cfg.leadership_cap, self._backend.broker_metrics())

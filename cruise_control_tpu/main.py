@@ -73,7 +73,17 @@ def build_app(config, backend=None):
     """Construct backend + facade (KafkaCruiseControl wiring order)."""
     from cruise_control_tpu.app import CruiseControl
     if backend is None:
-        backend = config.get_configured_instance("executor.backend.class")
+        from cruise_control_tpu.backend.rpc import RpcClusterBackend
+        cls = config.get_class("executor.backend.class")
+        if cls is not None and issubclass(cls, RpcClusterBackend):
+            # wire clients are built by the configured provider seam
+            # (network.client.provider.class), so deployments can swap the
+            # transport without replacing the backend class
+            provider = config.get_configured_instance(
+                "network.client.provider.class")
+            backend = provider.create()
+        else:
+            backend = config.get_configured_instance("executor.backend.class")
     return CruiseControl(backend, config)
 
 
@@ -95,22 +105,38 @@ def build_server(cc, config):
             secret_file = config.get_string("spnego.principal.secret.file")
             if not secret_file:
                 raise ValueError("SPNEGO security requires "
-                                 "spnego.principal.secret.file")
+                                 "spnego.principal.secret.file "
+                                 "(spnego.keytab.file)")
             with open(secret_file, "rb") as f:
                 validator = hmac_token_validator(f.read().strip())
             roles = {}
             roles_file = config.get_string("spnego.principal.roles.file")
             if roles_file:
                 roles = BasicSecurityProvider.from_file(roles_file).user_roles()
-            security = SpnegoSecurityProvider(validator, roles=roles)
+            security = SpnegoSecurityProvider(
+                validator, roles=roles,
+                service_principal=config.get_string("spnego.principal"))
         elif scheme == "JWT":
             secret_file = config.get_string("jwt.secret.file")
-            if not secret_file:
-                raise ValueError("JWT security requires jwt.secret.file")
-            with open(secret_file, "rb") as f:
-                security = JwtSecurityProvider(
-                    f.read().strip(),
-                    principal_claim=config.get_string("jwt.principal.claim"))
+            cert_file = config.get_string("jwt.auth.certificate.location")
+            secret = None
+            if secret_file:
+                with open(secret_file, "rb") as f:
+                    secret = f.read().strip()
+            rs256_key = None
+            if cert_file:
+                from cruise_control_tpu.api.security import (
+                    rsa_public_key_from_pem,
+                )
+                with open(cert_file) as f:
+                    rs256_key = rsa_public_key_from_pem(f.read())
+            security = JwtSecurityProvider(
+                secret, rs256_key=rs256_key,
+                principal_claim=config.get_string("jwt.principal.claim"),
+                cookie_name=config.get_string("jwt.cookie.name"),
+                expected_audiences=config.get("jwt.expected.audiences"),
+                provider_url=config.get_string(
+                    "jwt.authentication.provider.url"))
         else:
             cred_file = config.get_string("webserver.auth.credentials.file")
             if not cred_file:
@@ -124,19 +150,10 @@ def build_server(cc, config):
                     trusted_services=config.get_list("trusted.proxy.services"),
                     user_roles=security.user_roles(),
                     fallback_to_delegate=config.get_boolean(
-                        "trusted.proxy.fallback.enabled"))
-    ssl_ctx = None
-    if config.get_boolean("webserver.ssl.enable"):
-        import ssl
-
-        cert = config.get_string("webserver.ssl.cert.location")
-        if not cert:
-            raise ValueError("webserver.ssl.enable requires "
-                             "webserver.ssl.cert.location")
-        key = config.get_string("webserver.ssl.key.location") or None
-        password = config.get_string("webserver.ssl.key.password") or None
-        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-        ssl_ctx.load_cert_chain(cert, keyfile=key, password=password)
+                        "trusted.proxy.fallback.enabled"),
+                    ip_regex=config.get_string(
+                        "trusted.proxy.services.ip.regex"))
+    ssl_ctx = build_ssl_context(config)
     return CruiseControlServer(
         cc,
         host=config.get_string("webserver.http.address"),
@@ -147,7 +164,54 @@ def build_server(cc, config):
         max_block_ms=float(config.get_int("webserver.request.maxBlockTimeMs")),
         max_active_user_tasks=config.get_int("max.active.user.tasks"),
         completed_user_task_retention_ms=float(
-            config.get_int("completed.user.task.retention.time.ms")))
+            config.get_int("completed.user.task.retention.time.ms")),
+        config=config)
+
+
+def build_ssl_context(config):
+    """webserver.ssl.* -> ssl.SSLContext (PEM stack; keystore spellings are
+    aliases). Protocol floors/allowlists and cipher include/exclude lists
+    mirror Jetty's SslContextFactory knobs on the stdlib API."""
+    if not config.get_boolean("webserver.ssl.enable"):
+        return None
+    import ssl
+
+    cert = config.get_string("webserver.ssl.cert.location")
+    if not cert:
+        raise ValueError("webserver.ssl.enable requires "
+                         "webserver.ssl.cert.location "
+                         "(webserver.ssl.keystore.location)")
+    key = config.get_string("webserver.ssl.key.location") or None
+    password = config.get_string("webserver.ssl.key.password") or None
+    ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ssl_ctx.load_cert_chain(cert, keyfile=key, password=password)
+    proto = config.get_string("webserver.ssl.protocol")
+    include = set(config.get("webserver.ssl.include.protocols") or [])
+    exclude = set(config.get("webserver.ssl.exclude.protocols") or [])
+    allowed = include or {"TLSv1.2", "TLSv1.3"}
+    allowed -= exclude
+    if proto == "TLSv1.3":
+        allowed &= {"TLSv1.3"}
+    elif proto == "TLSv1.2":
+        allowed &= {"TLSv1.2", "TLSv1.3"}
+    if not allowed:
+        raise ValueError("webserver.ssl.{include,exclude}.protocols leave no "
+                         "enabled TLS version")
+    ssl_ctx.minimum_version = (ssl.TLSVersion.TLSv1_3
+                               if "TLSv1.2" not in allowed
+                               else ssl.TLSVersion.TLSv1_2)
+    ssl_ctx.maximum_version = (ssl.TLSVersion.TLSv1_2
+                               if "TLSv1.3" not in allowed
+                               else ssl.TLSVersion.TLSv1_3)
+    ciphers = config.get("webserver.ssl.include.ciphers")
+    exclude_ciphers = set(config.get("webserver.ssl.exclude.ciphers") or [])
+    if ciphers:
+        ssl_ctx.set_ciphers(":".join(c for c in ciphers
+                                     if c not in exclude_ciphers))
+    elif exclude_ciphers:
+        ssl_ctx.set_ciphers("DEFAULT:" + ":".join(
+            f"!{c}" for c in exclude_ciphers))
+    return ssl_ctx
 
 
 class SamplingLoop:

@@ -62,10 +62,15 @@ class DefaultCapacityResolver:
 class FileCapacityResolver:
     """BrokerCapacityConfigFileResolver analogue."""
 
-    def __init__(self, path: str | None = None):
+    def __init__(self, path: str | None = None,
+                 allow_cpu_estimation: bool = True):
         self._by_broker: dict[int, BrokerCapacityInfo] = {}
         self._default: BrokerCapacityInfo | None = None
         self._fallback = DefaultCapacityResolver()
+        # MonitorConfig sampling.allow.cpu.capacity.estimation: whether a
+        # broker entry without an explicit CPU capacity may fall back to the
+        # estimated default (False = loud failure at resolution time)
+        self._allow_cpu_estimation = allow_cpu_estimation
         if path:
             self._load(path)
 
@@ -73,6 +78,9 @@ class FileCapacityResolver:
         self._fallback.configure(config)
         path = extra.get("path") or (config.get_string("capacity.config.file")
                                      if config is not None else "")
+        if config is not None:
+            self._allow_cpu_estimation = config.get_boolean(
+                "sampling.allow.cpu.capacity.estimation")
         if path:
             self._load(path)
 
@@ -89,6 +97,11 @@ class FileCapacityResolver:
             else:
                 by_logdir = None
                 disk_total = float(disk_raw)
+            cpu_estimated = "CPU" not in cap_raw
+            if cpu_estimated and not self._allow_cpu_estimation:
+                raise ValueError(
+                    f"broker {broker_id} capacity entry has no CPU and "
+                    f"sampling.allow.cpu.capacity.estimation=false")
             info = BrokerCapacityInfo(
                 capacity={
                     Resource.CPU: float(cap_raw.get("CPU", 100)),
@@ -96,7 +109,9 @@ class FileCapacityResolver:
                     Resource.NW_OUT: float(cap_raw.get("NW_OUT", 0)),
                     Resource.DISK: disk_total,
                 },
-                disk_capacity_by_logdir=by_logdir)
+                disk_capacity_by_logdir=by_logdir,
+                estimated=cpu_estimated,
+                estimation_info="CPU capacity estimated" if cpu_estimated else "")
             if broker_id == -1:
                 self._default = info
             else:

@@ -64,15 +64,34 @@ def estimate_follower_cpu_util(leader_cpu_util, leader_bytes_in, leader_bytes_ou
 
 class LinearRegressionCpuModel:
     """Experimental CPU model (LinearRegressionModelParameters role): fits
-    cpu ~ a*bytes_in + b*bytes_out from training samples."""
+    cpu ~ a*bytes_in + b*bytes_out from training samples.
 
-    def __init__(self):
+    ``bucket_size_pct`` (MonitorConfig linear.regression.model.cpu.util.
+    bucket.size): training coverage is tracked per CPU-utilization bucket —
+    the model reports itself trainable only once samples span enough distinct
+    buckets to pin the regression down (the reference's
+    LinearRegressionModelParameters.modelCoefficientTrainingCompleteness)."""
+
+    MIN_BUCKETS = 2   # below this the fit rests on one utilization regime
+
+    def __init__(self, bucket_size_pct: int = 5):
         self._coef = None
+        self._bucket_pct = max(1, bucket_size_pct)
+        self._buckets_seen: set[int] = set()
 
     def train(self, bytes_in: np.ndarray, bytes_out: np.ndarray, cpu: np.ndarray) -> None:
         X = np.stack([np.asarray(bytes_in), np.asarray(bytes_out)], axis=1)
         y = np.asarray(cpu)
+        self._buckets_seen.update(int(v // self._bucket_pct) for v in y)
         self._coef, *_ = np.linalg.lstsq(X, y, rcond=None)
+
+    def training_completeness(self) -> dict:
+        """Coverage report (LinearRegressionModelParameters
+        .modelCoefficientTrainingCompleteness role): distinct
+        CPU-utilization buckets the training data spanned."""
+        return {"bucketSizePct": self._bucket_pct,
+                "bucketsSeen": sorted(self._buckets_seen),
+                "sufficient": len(self._buckets_seen) >= self.MIN_BUCKETS}
 
     @property
     def trained(self) -> bool:

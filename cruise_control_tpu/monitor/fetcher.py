@@ -26,12 +26,26 @@ def assign_partitions(partitions: list, num_fetchers: int) -> list[list]:
     return groups
 
 
+class DefaultPartitionAssignor:
+    """MetricSamplerPartitionAssignor SPI (MonitorConfig
+    ``metric.sampler.partition.assignor.class``): splits the partition
+    universe into per-fetcher groups. Custom assignors subclass and override
+    :meth:`assign` (e.g. locality-aware grouping)."""
+
+    def configure(self, config) -> None:
+        pass
+
+    def assign(self, partitions: list, num_fetchers: int) -> list[list]:
+        return assign_partitions(partitions, num_fetchers)
+
+
 class MetricFetcherManager:
     """Runs one sampling round across N concurrent fetchers and merges the
     results (MetricFetcherManager.fetchMetricSamples :148 role)."""
 
-    def __init__(self, sampler, num_fetchers: int = 1):
+    def __init__(self, sampler, num_fetchers: int = 1, assignor=None):
         self._sampler = sampler
+        self._assignor = assignor or DefaultPartitionAssignor()
         self._num_fetchers = max(1, num_fetchers)
         self._pool = (ThreadPoolExecutor(max_workers=self._num_fetchers,
                                          thread_name_prefix="metric-fetcher")
@@ -44,7 +58,8 @@ class MetricFetcherManager:
         if self._pool is None or not getattr(
                 self._sampler, "supports_partition_scoped_fetch", True):
             return self._sampler.get_samples(now_ms)
-        groups = [g for g in assign_partitions(partitions, self._num_fetchers) if g]
+        groups = [g for g in self._assignor.assign(partitions,
+                                                   self._num_fetchers) if g]
         if not groups:
             return self._sampler.get_samples(now_ms, partitions=[])
         # broker metrics are fetched by the FIRST fetcher only — the others

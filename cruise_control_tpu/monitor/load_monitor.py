@@ -93,6 +93,11 @@ class LoadMonitor:
             if self._partition_agg.aggregate().entity_valid.size else 0.0)
         self._sensors.gauge("total-monitored-windows",
                             lambda: self._partition_agg.num_windows)
+        # metadata-factor gauge (LoadMonitor.java:190-192,:735): replicas x
+        # brokers-with-replicas^exponent — quantifies metadata scale impact
+        self._metadata_factor_exponent = (
+            config.get_double("metadata.factor.exponent") if config else 1.0)
+        self._sensors.gauge("metadata-factor", self._metadata_factor)
         self._config = config
         self._backend = backend
         if sampler is None and config is not None:
@@ -124,17 +129,56 @@ class LoadMonitor:
         self._pause_reason = None
         self._lock = threading.Lock()
         self._model_semaphore = threading.Semaphore(2)  # LoadMonitor.java:92 cluster-model gate
-        self.lr_cpu_model = LinearRegressionCpuModel()
+        self.lr_cpu_model = LinearRegressionCpuModel(
+            bucket_size_pct=config.get_int(
+                "linear.regression.model.cpu.util.bucket.size")
+            if config else 5)
         self._bootstrap_progress = 0.0
         num_fetchers = config.get_int("num.metric.fetchers") if config else 1
-        self._fetchers = MetricFetcherManager(self._sampler, num_fetchers) \
+        assignor = (config.get_configured_instance(
+            "metric.sampler.partition.assignor.class") if config else None)
+        self._fetchers = MetricFetcherManager(self._sampler, num_fetchers,
+                                              assignor=assignor) \
             if self._sampler is not None else None
+        # MonitorConfig skip.loading.samples: bypass sample-store replay
+        self._skip_loading = (config.get_boolean("skip.loading.samples")
+                              if config else False)
+        # metadata.max.age.ms: the sampling path reuses its partition-universe
+        # snapshot until it ages out (MetadataClient refresh budget role)
+        self._metadata_max_age_ms = (config.get_int("metadata.max.age.ms")
+                                     if config else 300_000)
+        self._partition_list_cache: list | None = None
+        self._partition_list_ts = -1e18
+        # an extra store recording samples DURING execution
+        # (sample.partition.metric.store.on.execution.class); consulted by
+        # samplers via on_execution_store
+        self.on_execution_store = (config.get_configured_instance(
+            "sample.partition.metric.store.on.execution.class")
+            if config else None)
+
+    def _metadata_factor(self) -> float:
+        if self._backend is None:
+            return 0.0
+        # computed lazily under the same metadata.max.age.ms budget as the
+        # sampling path — a sensor scrape must not trigger a fresh
+        # full-partition dump over the backend wire each poll
+        now = time.time() * 1000.0
+        cached = getattr(self, "_metadata_factor_cache", None)
+        if cached is not None and now - cached[0] < self._metadata_max_age_ms:
+            return cached[1]
+        parts = self._backend.partitions()
+        num_replicas = sum(len(p.replicas) for p in parts.values())
+        brokers_with = {b for p in parts.values() for b in p.replicas}
+        value = num_replicas * (len(brokers_with)
+                                ** self._metadata_factor_exponent)
+        self._metadata_factor_cache = (now, value)
+        return value
 
     # ------------------------------------------------------------ lifecycle
     def start_up(self) -> int:
         """Replay persisted samples (SampleLoadingTask role), go RUNNING."""
         n = 0
-        if self._store is not None:
+        if self._store is not None and not self._skip_loading:
             self._state = LoadMonitorState.LOADING
             n = self._store.load_samples(self._ingest)
         self._state = LoadMonitorState.RUNNING
@@ -217,7 +261,10 @@ class LoadMonitor:
                 if self._state == LoadMonitorState.TRAINING:
                     self._state = prev if prev != LoadMonitorState.NOT_STARTED \
                         else LoadMonitorState.RUNNING
-        return {"numTrainingSamples": len(cpu), "trained": self.lr_cpu_model.trained}
+        return {"numTrainingSamples": len(cpu),
+                "trained": self.lr_cpu_model.trained,
+                "trainingCompleteness":
+                    self.lr_cpu_model.training_completeness()}
 
     def shutdown(self):
         if self._store is not None:
@@ -257,13 +304,21 @@ class LoadMonitor:
         # the fetcher pool splits the partition universe across concurrent
         # fetchers (MetricFetcherManager + partition assignor role)
         if self._fetchers is not None and self._backend is not None:
-            samples = self._fetchers.fetch_once(
-                now, list(self._backend.partitions()))
+            if (self._partition_list_cache is None
+                    or now - self._partition_list_ts >= self._metadata_max_age_ms):
+                self._partition_list_cache = list(self._backend.partitions())
+                self._partition_list_ts = now
+            samples = self._fetchers.fetch_once(now, self._partition_list_cache)
         else:
             samples = self._sampler.get_samples(now)
         n = self._ingest(samples)
         if self._store is not None:
             self._store.store_samples(samples)
+        if self.on_execution_store is not None:
+            # sample.partition.metric.store.on.execution.class: a second
+            # store that keeps only mid-execution samples (its own class
+            # gates on executor.has_ongoing_execution)
+            self.on_execution_store.store_samples(samples)
         return n
 
     def _ingest(self, samples: Samples) -> int:
