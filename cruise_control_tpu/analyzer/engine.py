@@ -124,6 +124,14 @@ class EngineParams:
     # to the max_iters cap for a fraction-of-a-percent stat gain.
     tail_pass_budget: int = 64    # 64 vs 192 measured identical violation
     #                               counts at rung 4 for 14s less wall
+    # once the goal's own violation measure reads SATISFIED on a dribbling
+    # pass, the remaining stall/dribble exploration buys nothing the
+    # violation count can see — clamp both budgets. Full budgets stay in
+    # force while the goal is violated (that exploration is what buys the
+    # improved violation counts); most chain goals end satisfied, so their
+    # tails dominate the exploration cost at the 7k/1M rung.
+    sat_stall_retries: int = 2
+    sat_tail_passes: int = 8
 
 
 def _wave_budget_capable(g: GoalKernel, leadership: bool = False) -> bool:
@@ -672,82 +680,124 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
 
     @partial(jax.jit, donate_argnums=(1,) if donate_state else ())
     def run(env: ClusterEnv, st: EngineState):
-        stat_before = goal.stat(env, st)
-
-        def step(carry):
-            st, it, n_applied, stall, dribble = carry
-            severity = goal.broker_severity(env, st)
-
-            # 0. intra-broker disk moves (IntraBroker*Goal actions never leave
-            #    the broker; only these goals set the flag)
-            n_disk = jnp.int32(0)
-            if goal.uses_disk_moves:
-                st, n_disk = _disk_move_branch_batched(env, st, goal,
-                                                       prev_goals, params,
-                                                       severity, stall)
-
-            # 1. replica moves (cheapest per unit of work on TPU: one scoring
-            #    pass lands up to K moves)
-            n_moves = jnp.int32(0)
-            if goal.uses_replica_moves:
-                st, n_moves = _move_branch_batched(env, st, goal, prev_goals,
-                                                   params, severity, stall)
-
-            # 2. leadership transfers — only when no move landed; gated by a
-            #    zero/one trip count, NOT lax.cond (a cond carrying the full
-            #    EngineState defeats XLA aliasing and copies it wholesale)
-            n_leads = jnp.int32(0)
-            if goal.uses_leadership_moves:
-                def lead_body(_i, carry):
-                    s, _n = carry
-                    return _leadership_branch_batched(
-                        env, s, goal, prev_goals, params,
-                        goal.broker_severity(env, s), stall)
-                st, n_leads = jax.lax.fori_loop(
-                    0, jnp.where(n_moves == 0, 1, 0), lead_body,
-                    (st, jnp.int32(0)))
-
-            # 3. swaps — last resort when neither moves nor transfers progress
-            #    (rebalanceBySwappingLoadOut/In role), batched like moves
-            n_swaps = jnp.int32(0)
-            if goal.uses_swaps:
-                def swap_body(_i, carry):
-                    s, _n = carry
-                    return _swap_branch_batched(env, s, goal, prev_goals,
-                                                params,
-                                                goal.broker_severity(env, s),
-                                                stall)
-                st, n_swaps = jax.lax.fori_loop(
-                    0, jnp.where((n_moves + n_leads) == 0, 1, 0), swap_body,
-                    (st, jnp.int32(0)))
-
-            applied = n_disk + n_moves + n_leads + n_swaps
-            # fruitless pass -> escalate exploration; any action resets it
-            stall = jnp.where(applied > 0, jnp.int32(0), stall + 1)
-            dribble = dribble + jnp.where(
-                applied < max(1, params.num_candidates // 128), 1, 0)
-            return st, it + 1, n_applied + applied, stall, dribble
-
-        def cond_fn(carry):
-            _st, it, _n, stall, dribble = carry
-            return ((stall <= params.stall_retries)
-                    & (dribble <= params.tail_pass_budget)
-                    & (it < params.max_iters))
-
-        st, iters, n_applied, stall, dribble = jax.lax.while_loop(
-            cond_fn, step, (st, jnp.int32(0), jnp.int32(0), jnp.int32(0),
-                            jnp.int32(0)))
-        violated = goal.violated(env, st)
-        # stopped by the iteration cap OR the dribble tail budget while still
-        # applying actions = budget exhausted, NOT converged — downstream
-        # must not report it as a proven fixpoint
-        hit_max_iters = ((stall <= params.stall_retries)
-                         & ((iters >= params.max_iters)
-                            | (dribble > params.tail_pass_budget)))
-        return st, {"iterations": n_applied, "passes": iters,
-                    "violated_after": violated,
-                    "hit_max_iters": hit_max_iters,
-                    "stat_before": stat_before,
-                    "stat": goal.stat(env, st)}
+        return _goal_loop(env, st, goal, prev_goals, params)
 
     return run
+
+
+def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+               prev_goals: tuple, params: EngineParams):
+    """One goal's full optimization loop (traced; shared by the per-goal
+    program and the fused whole-chain program)."""
+    stat_before = goal.stat(env, st)
+
+    def step(carry):
+        st, it, n_applied, stall, dribble, _sat = carry
+        severity = goal.broker_severity(env, st)
+
+        # 0. intra-broker disk moves (IntraBroker*Goal actions never leave
+        #    the broker; only these goals set the flag)
+        n_disk = jnp.int32(0)
+        if goal.uses_disk_moves:
+            st, n_disk = _disk_move_branch_batched(env, st, goal,
+                                                   prev_goals, params,
+                                                   severity, stall)
+
+        lead_first = goal.uses_leadership_moves and goal.leadership_primary
+
+        # 1a. leadership-primary goals run the cheap [KL, F] leadership
+        #     branch FIRST, every pass (LeaderReplicaDistributionGoal
+        #     prefers transfers; paying a [K, B] move pass to discover
+        #     "no moves" doubles pass counts for leadership-heavy work)
+        n_leads = jnp.int32(0)
+        if lead_first:
+            st, n_leads = _leadership_branch_batched(
+                env, st, goal, prev_goals, params, severity, stall)
+
+        # 1b. replica moves (cheapest per unit of work on TPU: one scoring
+        #     pass lands up to K moves); for leadership-primary goals they
+        #     are the FALLBACK, gated behind a fruitless leadership pass
+        #     (zero/one-trip fori_loop, not lax.cond — a cond carrying the
+        #     full EngineState defeats XLA aliasing and copies it)
+        n_moves = jnp.int32(0)
+        if goal.uses_replica_moves:
+            if lead_first:
+                def move_body(_i, carry):
+                    s, _n = carry
+                    return _move_branch_batched(
+                        env, s, goal, prev_goals, params,
+                        goal.broker_severity(env, s), stall)
+                st, n_moves = jax.lax.fori_loop(
+                    0, jnp.where(n_leads == 0, 1, 0), move_body,
+                    (st, jnp.int32(0)))
+            else:
+                st, n_moves = _move_branch_batched(env, st, goal,
+                                                   prev_goals, params,
+                                                   severity, stall)
+
+        # 2. leadership transfers — only when no move landed; same
+        #    zero/one trip-count gating
+        if goal.uses_leadership_moves and not lead_first:
+            def lead_body(_i, carry):
+                s, _n = carry
+                return _leadership_branch_batched(
+                    env, s, goal, prev_goals, params,
+                    goal.broker_severity(env, s), stall)
+            st, n_leads = jax.lax.fori_loop(
+                0, jnp.where(n_moves == 0, 1, 0), lead_body,
+                (st, jnp.int32(0)))
+
+        # 3. swaps — last resort when neither moves nor transfers progress
+        #    (rebalanceBySwappingLoadOut/In role), batched like moves
+        n_swaps = jnp.int32(0)
+        if goal.uses_swaps:
+            def swap_body(_i, carry):
+                s, _n = carry
+                return _swap_branch_batched(env, s, goal, prev_goals,
+                                            params,
+                                            goal.broker_severity(env, s),
+                                            stall)
+            st, n_swaps = jax.lax.fori_loop(
+                0, jnp.where((n_moves + n_leads) == 0, 1, 0), swap_body,
+                (st, jnp.int32(0)))
+
+        applied = n_disk + n_moves + n_leads + n_swaps
+        # fruitless pass -> escalate exploration; any action resets it
+        stall = jnp.where(applied > 0, jnp.int32(0), stall + 1)
+        is_dribble = applied < max(1, params.num_candidates // 128)
+        dribble = dribble + jnp.where(is_dribble, 1, 0)
+        # on a dribbling pass, check whether the goal already reads
+        # satisfied — the tail budgets clamp then (see EngineParams.
+        # sat_tail_passes). Productive passes skip the check (sat=False):
+        # the budgets only bind in the dribble/stall regime anyway.
+        sat = is_dribble & ~goal.violated(env, st)
+        return st, it + 1, n_applied + applied, stall, dribble, sat
+
+    def cond_fn(carry):
+        _st, it, _n, stall, dribble, sat = carry
+        stall_cap = jnp.where(
+            sat, min(params.stall_retries, params.sat_stall_retries),
+            params.stall_retries)
+        tail_cap = jnp.where(
+            sat, min(params.tail_pass_budget, params.sat_tail_passes),
+            params.tail_pass_budget)
+        return ((stall <= stall_cap)
+                & (dribble <= tail_cap)
+                & (it < params.max_iters))
+
+    st, iters, n_applied, stall, dribble, _sat = jax.lax.while_loop(
+        cond_fn, step, (st, jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                        jnp.int32(0), jnp.bool_(False)))
+    violated = goal.violated(env, st)
+    # stopped by the iteration cap OR the dribble tail budget while still
+    # applying actions = budget exhausted, NOT converged — downstream
+    # must not report it as a proven fixpoint
+    hit_max_iters = ((stall <= params.stall_retries)
+                     & ((iters >= params.max_iters)
+                        | (dribble > params.tail_pass_budget)))
+    return st, {"iterations": n_applied, "passes": iters,
+                "violated_after": violated,
+                "hit_max_iters": hit_max_iters,
+                "stat_before": stat_before,
+                "stat": goal.stat(env, st)}
+

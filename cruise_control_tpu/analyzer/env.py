@@ -166,17 +166,28 @@ def build_partition_replicas(ct: ClusterTensor) -> np.ndarray:
     return table
 
 
-def make_env(ct: ClusterTensor, meta: ClusterMeta,
-             topic_min_leaders_mask: np.ndarray | None = None) -> ClusterEnv:
+def padded_partition_table(ct: ClusterTensor) -> np.ndarray:
+    """Host [P, F] membership table with the RF width bucketed (padded with -1
+    members) so clusters differing only in max RF share compiled engine
+    programs. Kept on the host so proposal diffing can reuse it without a
+    device round-trip (~8 MB at the 1M-replica rung over a tunneled TPU)."""
     from cruise_control_tpu.model.cluster_tensor import bucket_size
     table = build_partition_replicas(ct)
-    # bucket the RF width (padded with -1 members) and the rack-axis size so
-    # clusters differing only in max RF or rack count share compiled engine
-    # programs; the SEMANTIC rack count rides along as traced data
     F = bucket_size(table.shape[1], 4)
     if F != table.shape[1]:
         table = np.pad(table, [(0, 0), (0, F - table.shape[1])],
                        constant_values=-1)
+    return table
+
+
+def make_env(ct: ClusterTensor, meta: ClusterMeta,
+             topic_min_leaders_mask: np.ndarray | None = None,
+             partition_table: np.ndarray | None = None) -> ClusterEnv:
+    from cruise_control_tpu.model.cluster_tensor import bucket_size
+    table = (padded_partition_table(ct) if partition_table is None
+             else partition_table)
+    # the rack-axis size is bucketed like the RF width; the SEMANTIC rack
+    # count rides along as traced data
     T = ct.num_topics
     tml = (np.zeros(T, bool) if topic_min_leaders_mask is None
            else np.asarray(topic_min_leaders_mask, bool))

@@ -77,6 +77,12 @@ class GoalKernel:
     uses_leadership_moves: bool = dataclasses.field(default=False, init=False)
     uses_swaps: bool = dataclasses.field(default=False, init=False)
     uses_disk_moves: bool = dataclasses.field(default=False, init=False)
+    # True when leadership transfers are this goal's PREFERRED action (e.g.
+    # LeaderReplicaDistributionGoal.java:369 tries transfers before moving
+    # leader replicas): the engine then runs the cheap [KL, F] leadership
+    # branch every pass and gates replica moves behind it, instead of paying
+    # a full [K, B] move-scoring pass just to discover "no moves" first.
+    leadership_primary: bool = dataclasses.field(default=False, init=False)
     # True when this goal's accept_move cannot be broken by a multi-move wave
     # given the engine's per-partition first-touch and per-(topic, broker)
     # first-use rules (e.g. rack/topic count goals). Goals with broker-level

@@ -401,6 +401,7 @@ class LeaderReplicaDistributionGoal(GoalKernel):
     def __post_init__(self):
         object.__setattr__(self, "name", "LeaderReplicaDistributionGoal")
         object.__setattr__(self, "uses_leadership_moves", True)
+        object.__setattr__(self, "leadership_primary", True)
 
     def _limits(self, env: ClusterEnv, st: EngineState):
         n_alive = jnp.sum(env.broker_alive)

@@ -204,6 +204,7 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
         object.__setattr__(self, "name", "MinTopicLeadersPerBrokerGoal")
         object.__setattr__(self, "is_hard", True)
         object.__setattr__(self, "uses_leadership_moves", True)
+        object.__setattr__(self, "leadership_primary", True)
 
     def _min(self) -> int:
         return self.constraint.min_topic_leaders_per_broker

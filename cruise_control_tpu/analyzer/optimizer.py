@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from functools import lru_cache
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +24,7 @@ import numpy as np
 from cruise_control_tpu.analyzer.engine import EngineParams, optimize_goal
 from cruise_control_tpu.analyzer.env import (
     BalancingConstraint, ClusterEnv, OptimizationOptions, make_env,
+    padded_partition_table,
 )
 from cruise_control_tpu.analyzer.goals import make_goals
 from cruise_control_tpu.analyzer.goals.leader_election import PreferredLeaderElectionGoal
@@ -170,6 +171,14 @@ class GoalOptimizer:
                 tail_pass_budget=config.get_int("analyzer.tail.pass.budget"),
             )
         self._params = engine_params or EngineParams()
+        # analyzer.fused.chain.min.replicas: at/above this cluster size the
+        # whole goal chain runs as ONE compiled program (one dispatch instead
+        # of ~16 — each program execution costs ~a second of fixed overhead
+        # on a tunneled TPU); below it, per-goal programs keep compile times
+        # small for the long tail of distinct test chains. -1 disables.
+        self._fused_min_replicas = (
+            config.get_int("analyzer.fused.chain.min.replicas")
+            if config is not None else 65_536)
         self._balancedness_priority_weight = (
             config.get_double("goal.balancedness.priority.weight")
             if config is not None else BALANCEDNESS_PRIORITY_WEIGHT)
@@ -271,48 +280,87 @@ class GoalOptimizer:
             # scoring is quadratic, so grow the pool sub-linearly (the
             # TPU-fault hard clamp lives in engine._swap_branch_batched)
             num_swap_candidates=max(self._params.num_swap_candidates,
-                                    ct.num_brokers // 32))
+                                    ct.num_brokers // 32),
+            # destination-affinity classes scale with broker count: at 7k
+            # brokers T=16 collapses the wave's destination variety (rung-4
+            # A/B: T=64 was 21% faster AND left one fewer goal violated)
+            num_dst_choices=min(128, max(self._params.num_dst_choices,
+                                         ct.num_brokers // 100)))
 
         tml = self._min_leader_mask(meta, min_leader_topic_pattern)
         if tml is not None and tml.shape[0] < ct.num_topics:
             tml = np.pad(tml, (0, ct.num_topics - tml.shape[0]))
-        env = make_env(ct, meta, topic_min_leaders_mask=tml)
+        # the membership table is built ON HOST once and shared with proposal
+        # diffing below — fetching it back from the device costs ~8 MB per
+        # optimization over a tunneled TPU
+        part_table = padded_partition_table(ct)
+        env = make_env(ct, meta, topic_min_leaders_mask=tml,
+                       partition_table=part_table)
         st = init_state(env, ct.replica_broker, ct.replica_is_leader,
                         ct.replica_offline, ct.replica_disk)
-        # ONE device->host batch for everything needed up front: each
-        # individual sync (bool()/np.asarray) is a full round-trip, which
-        # dominates wall clock on a tunneled/remote device
-        initial_broker, initial_leader, initial_disk = (
-            jax.device_get((st.replica_broker, st.replica_is_leader,
-                            st.replica_disk)))
-        stats_before = cluster_stats_state(env, st)
-        viol0 = jax.device_get(_compiled_violations(tuple(goals))(env, st))
-        violated_before = {g.name: bool(v) for g, v in zip(goals, viol0)}
+        # the initial assignment is exactly what init_state was given — take
+        # the host copies instead of a ~6 MB device round-trip (pad_cluster
+        # returns numpy; np.asarray is free there)
+        initial_broker = np.asarray(ct.replica_broker, np.int32)
+        initial_leader = np.asarray(ct.replica_is_leader, bool)
+        initial_disk = np.asarray(ct.replica_disk, np.int32)
 
-        infos = []
-        durations = []
-        prev: list = []
-        for g in goals:
-            t0 = time.monotonic()
-            # NOTE: donate_state measured SLOWER here — buffer ownership
-            # transfer serializes the async dispatch pipeline on the tunneled
-            # TPU; the non-donating chain keeps all 18 goal programs in flight
-            st, info = optimize_goal(env, st, g, tuple(prev), params)
-            if measure_goal_durations:
-                jax.block_until_ready(st.util)   # block per goal: honest timing
-            durations.append(time.monotonic() - t0)
-            infos.append(info)               # stays on device until one batch get
-            prev.append(g)
+        use_fused = (not measure_goal_durations
+                     and self._fused_min_replicas >= 0
+                     and ct.num_replicas >= self._fused_min_replicas)
+        if use_fused:
+            # the WHOLE optimization — initial stats + violations, every
+            # goal's loop, optional preferred-leader pass, final stats and
+            # the packed final-assignment fetch — is ONE compiled program
+            # and ONE batched device->host transfer: on a tunneled TPU each
+            # separate program execution costs ~a second of fixed overhead
+            ple = (PreferredLeaderElectionGoal(constraint=self._constraint,
+                                               options=options)
+                   if run_preferred else None)
+            st, out_dev = _compiled_full_chain(
+                tuple(type(g) for g in goals), tuple(goals), params, ple)(env, st)
+            out = jax.device_get(out_dev)
+            ple_dur = 0.0   # one fused program: no per-pass timing
+            viol0, infos, sb, sa = (out["viol_before"], out["infos"],
+                                    out["stats_before"], out["stats_after"])
+            packed = out["packed"]
+            if run_preferred:
+                was, still = out["ple_was"], out["ple_still"]
+            stats_before = _stats_to_json(sb)
+            stats_after = _stats_to_json(sa)
+            violated_before = {g.name: bool(v) for g, v in zip(goals, viol0)}
+            durations = [0.0] * len(goals)   # one program: not per-goal timed
+        else:
+            stats_before = cluster_stats_state(env, st)
+            viol0 = jax.device_get(_compiled_violations(tuple(goals))(env, st))
+            violated_before = {g.name: bool(v) for g, v in zip(goals, viol0)}
 
-        if run_preferred:
-            ple = PreferredLeaderElectionGoal(constraint=self._constraint, options=options)
-            t0 = time.monotonic()
-            was, st, still = _compiled_ple(ple)(env, st)
-            if measure_goal_durations:
-                jax.block_until_ready(st.replica_is_leader)
-            ple_dur = time.monotonic() - t0
+            infos = []
+            durations = []
+            prev: list = []
+            for g in goals:
+                t0 = time.monotonic()
+                # NOTE: donate_state measured SLOWER here — buffer ownership
+                # transfer serializes the async dispatch pipeline on the
+                # tunneled TPU; the non-donating chain keeps all goal
+                # programs in flight
+                st, info = optimize_goal(env, st, g, tuple(prev), params)
+                if measure_goal_durations:
+                    jax.block_until_ready(st.util)   # block per goal: honest
+                durations.append(time.monotonic() - t0)
+                infos.append(info)       # stays on device until one batch get
+                prev.append(g)
 
-        infos = jax.device_get(infos)
+            if run_preferred:
+                ple = PreferredLeaderElectionGoal(constraint=self._constraint,
+                                                  options=options)
+                t0 = time.monotonic()
+                was, st, still = _compiled_ple(ple)(env, st)
+                if measure_goal_durations:
+                    jax.block_until_ready(st.replica_is_leader)
+                ple_dur = time.monotonic() - t0
+
+            infos = jax.device_get(infos)
         goal_results = [
             GoalResult(
                 name=g.name,
@@ -334,18 +382,23 @@ class GoalOptimizer:
                 violated_after=bool(still), iterations=1 if bool(was) else 0,
                 duration_s=ple_dur, stat_after=0.0))
 
-        stats_after = cluster_stats_state(env, st)
-        from cruise_control_tpu.common.resources import Resource
-        final_broker, final_leader, final_disk, moved_mask, disk_load = (
-            jax.device_get((st.replica_broker, st.replica_is_leader,
-                            st.replica_disk, st.moved,
-                            env.leader_load[:, Resource.DISK])))
-        proposals = diff_proposals(env, meta, initial_broker, initial_leader,
-                                   initial_disk, st,
-                                   final=(final_broker, final_leader, final_disk))
+        if use_fused:
+            pb, plead, pdisk, data_mb = packed
+        else:
+            stats_after = cluster_stats_state(env, st)
+            pb, plead, pdisk, data_mb = jax.device_get(_pack_final(env, st))
+        R = env.num_replicas
+        final_broker = np.asarray(pb, np.int32)
+        final_leader = np.unpackbits(plead)[:R].astype(bool)
+        final_disk = np.asarray(pdisk, np.int32)
+        proposals = diff_proposals(
+            env, meta, initial_broker, initial_leader, initial_disk, st,
+            final=(final_broker, final_leader, final_disk),
+            host_statics=(part_table, np.asarray(ct.replica_valid, bool),
+                          np.asarray(ct.replica_partition, np.int32)))
         n_moves = proposals.num_replica_additions
         n_lead = proposals.num_leadership_changes
-        data_mb = float(disk_load[moved_mask].sum())
+        data_mb = float(data_mb)
 
         viol_after = {g.name: g.violated_after for g in goal_results}
         result = OptimizerResult(
@@ -381,6 +434,55 @@ class GoalOptimizer:
                     f"[{rec.status.value}: {rec.reason}]",
                     recommendation=rec, result=result)
         return result
+
+
+@lru_cache(maxsize=64)
+def _compiled_full_chain(goal_classes: tuple, goals: tuple,
+                         params: EngineParams, ple):
+    """ONE jitted program for the whole optimization: initial stats +
+    violations, the sequential goal-chain loops, the optional
+    PreferredLeaderElection pass, final stats, and the packed final-
+    assignment transfer (see GoalOptimizer fused path)."""
+    from cruise_control_tpu.analyzer.engine import _goal_loop
+    del goal_classes  # cache key only
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def run(env: ClusterEnv, st: EngineState):
+        out = {"stats_before": _stats_device(env, st),
+               "viol_before": [g.violated(env, st) for g in goals]}
+        infos = []
+        prev: tuple = ()
+        for g in goals:
+            st2, info = _goal_loop(env, st, g, prev, params)
+            st = st2
+            infos.append(info)
+            prev = prev + (g,)
+        out["infos"] = infos
+        if ple is not None:
+            out["ple_was"] = ple.violated(env, st)
+            st = ple.apply(env, st)
+            out["ple_still"] = ple.violated(env, st)
+        out["stats_after"] = _stats_device(env, st)
+        out["packed"] = _pack_final(env, st)
+        return st, out
+
+    return run
+
+
+@jax.jit
+def _pack_final(env: ClusterEnv, st: EngineState):
+    """Final-assignment fetch, packed for the tunnel: int16 broker ids,
+    bit-packed leadership, int8 logdir ids, and the data-to-move reduction
+    done on device — ~3 MB instead of ~14 MB at the 1M-replica rung over a
+    ~4 MB/s tunneled link."""
+    from cruise_control_tpu.common.resources import Resource
+    b = (st.replica_broker.astype(jnp.int16)
+         if env.num_brokers <= 32767 else st.replica_broker)
+    disk = (st.replica_disk.astype(jnp.int8)
+            if env.broker_disk_capacity.shape[1] <= 127 else st.replica_disk)
+    lead = jnp.packbits(st.replica_is_leader)
+    data_mb = jnp.where(st.moved, env.leader_load[:, Resource.DISK], 0.0).sum()
+    return b, lead, disk, data_mb
 
 
 @jax.jit
@@ -428,8 +530,11 @@ def cluster_stats_state(env: ClusterEnv, st: EngineState) -> dict:
     AVG/MAX/MIN/STD over alive brokers for resource utilization, potential
     NW-out, replica / leader-replica / topic-replica counts, plus the
     metadata counts used by ClusterModelStatsMetaData)."""
-    d = jax.device_get(_stats_device(env, st))
+    return _stats_to_json(jax.device_get(_stats_device(env, st)))
 
+
+def _stats_to_json(d) -> dict:
+    """Host rendering of one fetched _stats_device result."""
     def four(x):
         return {k: float(v) for k, v in x.items()}
 

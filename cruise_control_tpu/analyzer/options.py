@@ -1,0 +1,27 @@
+"""Pluggable OptimizationOptions generation.
+
+Reference: analyzer/OptimizationOptionsGenerator.java (AnalyzerConfig
+``optimization.options.generator.class``) — a seam letting deployments derive
+per-run OptimizationOptions (e.g. force fast mode during business hours)
+instead of the defaults. The app asks the configured generator for the
+options of every internally-triggered optimization.
+"""
+from __future__ import annotations
+
+from cruise_control_tpu.analyzer.env import OptimizationOptions
+
+
+class DefaultOptimizationOptionsGenerator:
+    """Passes through the options the caller built (reference
+    DefaultOptimizationOptionsGenerator behavior)."""
+
+    def configure(self, config) -> None:  # CruiseControlConfigurable seam
+        self._config = config
+
+    def optimization_options(self, base: OptimizationOptions,
+                             operation: str = "") -> OptimizationOptions:
+        """Return the options an optimization should run with. ``base`` is
+        what the operation itself requested; ``operation`` names the caller
+        (rebalance / remove_brokers / self-healing / ...)."""
+        del operation
+        return base

@@ -127,12 +127,17 @@ class ProposalSet(Sequence):
 def diff_proposals(env: ClusterEnv, meta: ClusterMeta,
                    initial_broker: np.ndarray, initial_leader: np.ndarray,
                    initial_disk: np.ndarray, st: EngineState,
-                   final: tuple | None = None) -> ProposalSet:
+                   final: tuple | None = None,
+                   host_statics: tuple | None = None) -> ProposalSet:
     """Compare assignments and emit one proposal per changed partition.
 
     ``final`` lets the caller pass already-fetched (broker, leader, disk) host
-    arrays to avoid extra device round-trips. Entirely vectorized: no Python
-    loop over partitions (AnalyzerUtils.getDiff role at 1M-replica scale).
+    arrays to avoid extra device round-trips, and ``host_statics``
+    ``(members_table, replica_valid, replica_partition)`` does the same for
+    the static membership arrays (they originate on the host — fetching them
+    back is ~13 MB per optimization over a tunneled TPU). Entirely
+    vectorized: no Python loop over partitions (AnalyzerUtils.getDiff role at
+    1M-replica scale).
     """
     if final is not None:
         final_broker, final_leader, final_disk = (np.asarray(a) for a in final)
@@ -142,11 +147,12 @@ def diff_proposals(env: ClusterEnv, meta: ClusterMeta,
     initial_broker = np.asarray(initial_broker)
     initial_leader = np.asarray(initial_leader)
     initial_disk = np.asarray(initial_disk)
-    members_table, valid, part_of = jax.device_get(
-        (env.partition_replicas, env.replica_valid, env.replica_partition))
-    members_table = np.asarray(members_table)
-    valid = np.asarray(valid)
-    part_of = np.asarray(part_of)
+    if host_statics is not None:
+        members_table, valid, part_of = (np.asarray(a) for a in host_statics)
+    else:
+        members_table, valid, part_of = (np.asarray(a) for a in jax.device_get(
+            (env.partition_replicas, env.replica_valid,
+             env.replica_partition)))
     broker_ids = np.asarray(meta.broker_ids)
 
     changed_r = (final_broker != initial_broker) | (final_leader != initial_leader) \

@@ -130,3 +130,31 @@ def test_optimizer_result_json():
     assert "summary" in j and "goalSummary" in j and "proposals" in j
     assert j["summary"]["numReplicaMovements"] >= 1
     assert not j["summary"]["violatedGoalsAfter"]
+
+
+def test_fused_chain_matches_per_goal_programs():
+    """The whole-chain fused program (one dispatch) must produce exactly the
+    per-goal-program result: same final assignment, violations, stats."""
+    import numpy as np
+    from cruise_control_tpu.model.fixtures import small_cluster
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    ct, meta = small_cluster()
+    fused = GoalOptimizer()
+    fused._fused_min_replicas = 0
+    per_goal = GoalOptimizer()
+    per_goal._fused_min_replicas = -1
+    kw = dict(goal_names=["RackAwareGoal", "ReplicaDistributionGoal",
+                          "LeaderReplicaDistributionGoal"],
+              raise_on_failure=False, skip_hard_goal_check=True)
+    rf = fused.optimizations(ct, meta, **kw)
+    rp = per_goal.optimizations(ct, meta, **kw)
+    assert rf.violated_goals_before == rp.violated_goals_before
+    assert rf.violated_goals_after == rp.violated_goals_after
+    assert rf.num_replica_movements == rp.num_replica_movements
+    assert rf.num_leadership_movements == rp.num_leadership_movements
+    assert np.array_equal(np.asarray(rf.final_state.replica_broker),
+                          np.asarray(rp.final_state.replica_broker))
+    assert np.array_equal(np.asarray(rf.final_state.replica_is_leader),
+                          np.asarray(rp.final_state.replica_is_leader))
+    assert rf.stats_after == rp.stats_after
+    assert abs(rf.balancedness_after - rp.balancedness_after) < 1e-12
