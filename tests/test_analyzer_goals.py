@@ -211,3 +211,38 @@ def test_budgeted_wave_respects_capacity_band():
     # one-per-broker waves would need ~25 passes for 25+ moves off broker 0
     assert int(info["passes"]) <= 10, int(info["passes"])
     assert abs(alive_utils.sum() - (30 * 600.0 + 3 * 100.0)) < 1.0
+
+
+def test_satisfied_goal_exits_with_clamped_tail():
+    """A goal that starts satisfied must exit after the clamped
+    sat_stall_retries tail (EngineParams.sat_*), not burn the full violated-
+    goal exploration budget — the clamp is what keeps the 7k/1M chain's
+    satisfied goals nearly free."""
+    from cruise_control_tpu.analyzer.engine import EngineParams
+    env, st = _setup(small_cluster)
+    g = make_goal("RackAwareGoal")
+    # first run fixes any violation; the second run starts satisfied
+    st, info = optimize_goal(env, st, g, ())
+    assert not bool(info["violated_after"])
+    params = EngineParams()
+    st, info2 = optimize_goal(env, st, g, (), params)
+    assert not bool(info2["violated_after"])
+    assert int(info2["iterations"]) == 0
+    # pass count: 1 discovery pass + sat_stall_retries + exit margin,
+    # far below the violated-goal budget (stall_retries + tail_pass_budget)
+    assert int(info2["passes"]) <= params.sat_stall_retries + 3
+    assert not bool(info2["hit_max_iters"])
+
+
+def test_leadership_primary_prefers_transfers_over_moves():
+    """LeaderReplicaDistributionGoal is leadership-primary: on a cluster
+    where transfers alone can balance leader counts, it must fix the skew
+    without relocating any replica (the reference's transfer-first ordering,
+    LeaderReplicaDistributionGoal.java:369)."""
+    env, st = _setup(leaders_skewed)
+    before_brokers = np.asarray(st.replica_broker).copy()
+    g, st, info = _run(env, st, "LeaderReplicaDistributionGoal")
+    assert g.leadership_primary
+    assert not bool(info["violated_after"])
+    assert np.array_equal(np.asarray(st.replica_broker), before_brokers), \
+        "leadership-primary goal moved replicas although transfers sufficed"
