@@ -171,6 +171,13 @@ class UserTaskManager:
                     raise KeyError(
                         f"User-Task-ID {task_id!r} was created by a different "
                         f"request ({task.endpoint.path})")
+                # bind the CALLER's session too: a poll from a fresh session
+                # that resumes by header must leave that session able to
+                # find the task by cookie alone afterwards (the reference
+                # re-associates the HttpSession on every request)
+                self._session_to_task[self._session_key(client, endpoint,
+                                                        params)] = (
+                    task.task_id, self._time())
                 return task
             skey = self._session_key(client, endpoint, params)
             bound = self._session_to_task.get(skey)

@@ -315,9 +315,13 @@ def _dispatch(backend, method: str, p: dict):
     if method == "topic_configs":
         getter = getattr(backend, "topic_configs", None)
         return getter() if getter is not None else {}
+    if method == "now_ms":
+        # property on the simulated backend, method on wire clients
+        clock = backend.now_ms
+        return float(clock() if callable(clock) else clock)
     # simulated-cluster controls (fault injection / setup over the wire)
     if method in ("add_broker", "create_partition", "kill_broker",
-                  "restart_broker", "fail_disk", "advance", "now_ms"):
+                  "restart_broker", "fail_disk", "advance"):
         r = getattr(backend, method)(**p)
         return r if isinstance(r, (int, float, str, type(None))) else None
     raise ValueError(f"unknown method {method!r}")
