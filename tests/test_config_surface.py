@@ -432,3 +432,48 @@ def test_access_log_written(surface_server):
     _get(f"{srv.base_url}/state")
     content = access_log.read_text()
     assert "/kafkacruisecontrol/state" in content and '" 200 ' in content
+
+
+def test_rs256_jwt_verification_from_pem(tmp_path):
+    """jwt.auth.certificate.location path: RS256 tokens verified against a
+    PEM public key / X.509 cert via the stdlib DER walk (the reference's
+    JwtLoginService verifies RS256 against the IdP certificate)."""
+    import shutil
+    import subprocess
+    if shutil.which("openssl") is None:
+        pytest.skip("openssl not available")
+    key = tmp_path / "k.pem"
+    pub = tmp_path / "p.pem"
+    cert = tmp_path / "c.pem"
+    subprocess.run(["openssl", "genrsa", "-out", str(key), "2048"],
+                   check=True, capture_output=True)
+    subprocess.run(["openssl", "rsa", "-in", str(key), "-pubout",
+                    "-out", str(pub)], check=True, capture_output=True)
+    subprocess.run(["openssl", "req", "-new", "-x509", "-key", str(key),
+                    "-out", str(cert), "-days", "1", "-subj", "/CN=t"],
+                   check=True, capture_output=True)
+    from cruise_control_tpu.api.security import (
+        AuthError, JwtSecurityProvider, rsa_public_key_from_pem,
+    )
+
+    def b64u(b):
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    head = b64u(json.dumps({"alg": "RS256"}).encode())
+    body = b64u(json.dumps({"sub": "alice", "role": "ADMIN"}).encode())
+    si = tmp_path / "si.bin"
+    si.write_bytes(f"{head}.{body}".encode())
+    sig_f = tmp_path / "sig.bin"
+    subprocess.run(["openssl", "dgst", "-sha256", "-sign", str(key),
+                    "-out", str(sig_f), str(si)], check=True,
+                   capture_output=True)
+    tok = f"{head}.{body}.{b64u(sig_f.read_bytes())}"
+    n_e = rsa_public_key_from_pem(pub.read_text())
+    p = JwtSecurityProvider(rs256_key=n_e)
+    assert p.authenticate({"Authorization": f"Bearer {tok}"}) == ("alice", "ADMIN")
+    # the same key is recoverable from the X.509 certificate
+    assert rsa_public_key_from_pem(cert.read_text()) == n_e
+    # tampered payload is rejected
+    bad = f"{head}.{b64u(json.dumps({'sub': 'mallory', 'role': 'ADMIN'}).encode())}.{b64u(sig_f.read_bytes())}"
+    with pytest.raises(AuthError):
+        p.authenticate({"Authorization": f"Bearer {bad}"})
