@@ -124,6 +124,12 @@ def _balancedness(goals, results_violated: dict,
     return 100.0 * got / total if total else 100.0
 
 
+def _budget_scale(ct) -> int:
+    """How many times cheaper an engine pass is than at the 512k-replica
+    reference point (pass cost ~linear in R); floors at 1."""
+    return max(1, (512 * 1024) // max(ct.num_replicas, 1024))
+
+
 @lru_cache(maxsize=256)
 def _compiled_violations(goals_tuple: tuple):
     """One jitted program evaluating every goal's violated() — replaces G
@@ -285,7 +291,17 @@ class GoalOptimizer:
             # brokers T=16 collapses the wave's destination variety (rung-4
             # A/B: T=64 was 21% faster AND left one fewer goal violated)
             num_dst_choices=min(128, max(self._params.num_dst_choices,
-                                         ct.num_brokers // 100)))
+                                         ct.num_brokers // 100)),
+            # exploration budgets scale with how CHEAP a pass is: per-pass
+            # cost is ~linear in R, so smaller clusters afford far deeper
+            # stall/dribble tails. Measured at 100k replicas: 1024/32
+            # converts four more soft goals (10 -> 3 violated) for ~6 s;
+            # at 1M replicas tripling the tail bought nothing (PERF.md), so
+            # the headline rung keeps the lean 64/8.
+            tail_pass_budget=min(
+                1024, self._params.tail_pass_budget * _budget_scale(ct) ** 2),
+            stall_retries=min(
+                32, self._params.stall_retries * _budget_scale(ct)))
 
         tml = self._min_leader_mask(meta, min_leader_topic_pattern)
         if tml is not None and tml.shape[0] < ct.num_topics:

@@ -477,3 +477,110 @@ def test_rs256_jwt_verification_from_pem(tmp_path):
     bad = f"{head}.{b64u(json.dumps({'sub': 'mallory', 'role': 'ADMIN'}).encode())}.{b64u(sig_f.read_bytes())}"
     with pytest.raises(AuthError):
         p.authenticate({"Authorization": f"Bearer {bad}"})
+
+
+def test_maintenance_event_stops_ongoing_execution():
+    """maintenance.event.stop.ongoing.execution: a FIXed maintenance plan
+    preempts a running proposal execution before being handled."""
+    from cruise_control_tpu.detector.anomalies import AnomalyType, MaintenanceEvent
+    from cruise_control_tpu.detector.manager import AnomalyDetectorManager
+    from cruise_control_tpu.detector.notifier import Action, NotificationResult
+
+    calls = []
+
+    class CC:
+        class executor:
+            @staticmethod
+            def has_ongoing_execution():
+                return True
+
+        @staticmethod
+        def stop_proposal_execution(force=False):
+            calls.append(("stop", force))
+            return {}
+
+    class FixAll:
+        def on_anomaly(self, anomaly, now_ms):
+            return NotificationResult(Action.FIX)
+
+        def self_healing_enabled(self):
+            return {}
+
+    cc = CC()
+    m = AnomalyDetectorManager(notifier=FixAll(), cruise_control=cc,
+                               maintenance_stops_ongoing_execution=True)
+    ev = MaintenanceEvent(anomaly_type=AnomalyType.MAINTENANCE_EVENT,
+                          detected_ms=0.0, plan_type="REBALANCE")
+    ev.fix = lambda cc: calls.append(("fix",)) or {}
+    m.add_anomaly(ev)
+    m.handle_anomalies(1.0)
+    assert calls == [("stop", False), ("fix",)]
+    # with the flag off, no stop happens
+    calls.clear()
+    m2 = AnomalyDetectorManager(notifier=FixAll(), cruise_control=cc,
+                                maintenance_stops_ongoing_execution=False)
+    ev2 = MaintenanceEvent(anomaly_type=AnomalyType.MAINTENANCE_EVENT,
+                           detected_ms=0.0, plan_type="REBALANCE")
+    ev2.fix = lambda cc: calls.append(("fix",)) or {}
+    m2.add_anomaly(ev2)
+    m2.handle_anomalies(1.0)
+    assert calls == [("fix",)]
+
+
+def test_skip_loading_samples_bypasses_store_replay():
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+
+    class Store:
+        def __init__(self):
+            self.loaded = 0
+
+        def configure(self, config, **extra):
+            pass
+
+        def store_samples(self, samples):
+            pass
+
+        def load_samples(self, loader):
+            self.loaded += 1
+            return 0
+
+        def close(self):
+            pass
+
+    st1 = Store()
+    lm = LoadMonitor(config=cruise_control_config(), sample_store=st1)
+    lm.start_up()
+    assert st1.loaded == 1
+    st2 = Store()
+    lm2 = LoadMonitor(config=cruise_control_config(
+        {"skip.loading.samples": True}), sample_store=st2)
+    lm2.start_up()
+    assert st2.loaded == 0
+
+
+def test_custom_partition_assignor_class_used():
+    from cruise_control_tpu.monitor.fetcher import MetricFetcherManager
+
+    class RecordingAssignor:
+        def __init__(self):
+            self.calls = 0
+
+        def configure(self, config):
+            pass
+
+        def assign(self, partitions, num_fetchers):
+            self.calls += 1
+            return [list(partitions)]
+
+    class Sampler:
+        supports_partition_scoped_fetch = True
+
+        def get_samples(self, now_ms, partitions=None,
+                        include_broker_samples=True):
+            from cruise_control_tpu.monitor.sampling.samplers import Samples
+            return Samples([], [])
+
+    a = RecordingAssignor()
+    mgr = MetricFetcherManager(Sampler(), num_fetchers=2, assignor=a)
+    mgr.fetch_once(0.0, [("t", 0), ("t", 1)])
+    assert a.calls == 1
