@@ -19,7 +19,14 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # DIFFERENT directory from bench.py's TPU cache: CPU AOT artifacts are keyed
 # loosely enough that entries compiled on another machine (the TPU tunnel's
 # terminal host) can load here and SIGILL on missing ISA features.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_cpu")
+# Per-xdist-worker directories: three workers sharing one cache dir were
+# observed to SEGFAULT inside compilation_cache.get_executable_and_time
+# (torn read of a concurrently-written entry), which also wedges xdist's
+# crash recovery. Worker names (gw0..gwN) are stable across runs, so each
+# worker still reuses its own cache between runs.
+_worker = os.environ.get("PYTEST_XDIST_WORKER", "gw0")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      f"/tmp/jax_cache_cc_cpu_{_worker}")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
