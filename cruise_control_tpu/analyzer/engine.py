@@ -132,6 +132,14 @@ class EngineParams:
     # tails dominate the exploration cost at the 7k/1M rung.
     sat_stall_retries: int = 2
     sat_tail_passes: int = 8
+    # stat-slope plateau exit: while dribbling, the goal's own stat (sum of
+    # positive severities) is sampled every stat_window dribble passes; if a
+    # whole window improves it by less than stat_slope_min (relative), the
+    # tail is provably flat and the goal exits early — deep tail budgets
+    # then cost nothing on clusters whose survivors cannot converge, while
+    # genuinely-progressing tails keep their full budget.
+    stat_window: int = 24
+    stat_slope_min: float = 1e-3
 
 
 def _wave_budget_capable(g: GoalKernel, leadership: bool = False) -> bool:
@@ -692,7 +700,8 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     stat_before = goal.stat(env, st)
 
     def step(carry):
-        st, it, n_applied, stall, dribble, _sat = carry
+        st, it, n_applied, stall, dribble, _sat, win_stat, win_dribble, \
+            plateau = carry
         severity = goal.broker_severity(env, st)
 
         # 0. intra-broker disk moves (IntraBroker*Goal actions never leave
@@ -771,10 +780,21 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         # sat_tail_passes). Productive passes skip the check (sat=False):
         # the budgets only bind in the dribble/stall regime anyway.
         sat = is_dribble & ~goal.violated(env, st)
-        return st, it + 1, n_applied + applied, stall, dribble, sat
+        # stat-slope plateau detection: sample the goal's own stat at
+        # dribble-window boundaries; a window of stat_window dribble passes
+        # that improved it by < stat_slope_min (relative) is a flat tail
+        stat_now = goal.stat(env, st)
+        roll = dribble - win_dribble >= params.stat_window
+        plateau = plateau | (roll & (
+            (win_stat - stat_now)
+            < params.stat_slope_min * jnp.maximum(win_stat, 1e-6)))
+        win_stat = jnp.where(roll, stat_now, win_stat)
+        win_dribble = jnp.where(roll, dribble, win_dribble)
+        return (st, it + 1, n_applied + applied, stall, dribble, sat,
+                win_stat, win_dribble, plateau)
 
     def cond_fn(carry):
-        _st, it, _n, stall, dribble, sat = carry
+        _st, it, _n, stall, dribble, sat, _ws, _wd, plateau = carry
         stall_cap = jnp.where(
             sat, min(params.stall_retries, params.sat_stall_retries),
             params.stall_retries)
@@ -783,10 +803,13 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
             params.tail_pass_budget)
         return ((stall <= stall_cap)
                 & (dribble <= tail_cap)
-                & (it < params.max_iters))
+                & (it < params.max_iters)
+                & ~plateau)
 
-    st, iters, n_applied, stall, dribble, _sat = jax.lax.while_loop(
+    (st, iters, n_applied, stall, dribble, _sat, _ws, _wd,
+     _plateau) = jax.lax.while_loop(
         cond_fn, step, (st, jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                        jnp.int32(0), jnp.bool_(False), jnp.float32(jnp.inf),
                         jnp.int32(0), jnp.bool_(False)))
     violated = goal.violated(env, st)
     # stopped by the iteration cap OR the dribble tail budget while still
