@@ -4,8 +4,9 @@ Reference: analyzer/GoalOptimizer.java:417 ``optimizations(...)`` — the
 sequential per-goal loop (:440-467): for each goal in priority order run
 ``goal.optimize(clusterModel, optimizedGoals, options)``, collect per-goal
 stats/durations, then diff initial vs final distribution into proposals
-(:476-481). The proposal cache + precompute thread live in
-``analyzer.cache.ProposalCache`` (GoalOptimizer.java:139-339 role).
+(:476-481). The proposal cache + precompute thread
+(GoalOptimizer.java:139-339 role) live host-side on the facade:
+``app.CruiseControl.cached_proposals`` / ``start_proposal_precompute``.
 
 Here each goal runs as one jitted engine loop (engine.optimize_goal) with the
 previously-optimized goals' acceptance masks fused into candidate scoring —
@@ -185,6 +186,14 @@ class GoalOptimizer:
         self._fused_min_replicas = (
             config.get_int("analyzer.fused.chain.min.replicas")
             if config is not None else 65_536)
+        # tpu.mesh.axis.brokers: >1 shards the chain over a device mesh
+        self._mesh_axis_brokers = (config.get_int("tpu.mesh.axis.brokers")
+                                   if config is not None else 1)
+        # tpu.donate.state: donate per-goal state buffers (saves HBM at the
+        # cost of serializing the async dispatch pipeline — see the NOTE in
+        # optimizations(); default off)
+        self._donate_state = (config.get_boolean("tpu.donate.state")
+                              if config is not None else False)
         self._balancedness_priority_weight = (
             config.get_double("goal.balancedness.priority.weight")
             if config is not None else BALANCEDNESS_PRIORITY_WEIGHT)
@@ -314,6 +323,13 @@ class GoalOptimizer:
                        partition_table=part_table)
         st = init_state(env, ct.replica_broker, ct.replica_is_leader,
                         ct.replica_offline, ct.replica_disk)
+        if self._mesh_axis_brokers > 1:
+            # tpu.mesh.axis.brokers: place env+state on an n-device mesh so
+            # the same chain runs GSPMD-sharded (parallel/sharding.py; the
+            # multichip dryrun drives this path with virtual devices)
+            from cruise_control_tpu.parallel import make_mesh, shard_cluster
+            mesh = make_mesh(self._mesh_axis_brokers)
+            env, st = shard_cluster(env, st, mesh)
         # the initial assignment is exactly what init_state was given — take
         # the host copies instead of a ~6 MB device round-trip (pad_cluster
         # returns numpy; np.asarray is free there)
@@ -359,8 +375,10 @@ class GoalOptimizer:
                 # NOTE: donate_state measured SLOWER here — buffer ownership
                 # transfer serializes the async dispatch pipeline on the
                 # tunneled TPU; the non-donating chain keeps all goal
-                # programs in flight
-                st, info = optimize_goal(env, st, g, tuple(prev), params)
+                # programs in flight. tpu.donate.state opts in for
+                # HBM-constrained deployments.
+                st, info = optimize_goal(env, st, g, tuple(prev), params,
+                                         donate_state=self._donate_state)
                 if measure_goal_durations:
                     jax.block_until_ready(st.util)   # block per goal: honest
                 durations.append(time.monotonic() - t0)
@@ -442,9 +460,12 @@ class GoalOptimizer:
                 # attach how many brokers are missing (reference:
                 # OptimizationFailureException carries ProvisionRecommendation)
                 from cruise_control_tpu.detector.provisioner import (
-                    recommendation_from_result,
+                    ProvisionFloors, recommendation_from_result,
                 )
-                rec = recommendation_from_result(result, self._constraint)
+                floors = (ProvisionFloors.from_config(self._config)
+                          if self._config is not None else None)
+                rec = recommendation_from_result(result, self._constraint,
+                                                 floors=floors)
                 raise OptimizationFailureError(
                     f"hard goal(s) not satisfiable: {failed} "
                     f"[{rec.status.value}: {rec.reason}]",

@@ -404,8 +404,15 @@ class SpnegoSecurityProvider(SecurityProvider):
         principal = self._validate(token)
         if principal is None:
             raise AuthError("invalid Negotiate token", 403)
-        if self._service_principal and "\x00" in principal:
-            # tokens bound to a service carry "principal\x00service"
+        if self._service_principal:
+            # tokens bound to a service carry "principal\x00service"; when a
+            # service principal is pinned, a token WITHOUT any binding is
+            # rejected too — otherwise the pinning would be opt-in for the
+            # token minter rather than enforced by the server
+            if "\x00" not in principal:
+                raise AuthError(
+                    "token carries no service binding but this server pins "
+                    f"{self._service_principal!r} (spnego.principal)", 403)
             principal, _, svc = principal.partition("\x00")
             if svc != self._service_principal:
                 raise AuthError(

@@ -40,29 +40,55 @@ URL_PREFIX = "/kafkacruisecontrol"
 
 class AccessLog:
     """NCSA combined-ish access log (WebServerConfig webserver.accesslog.*:
-    Jetty's RequestLogWriter role). Startup deletes rotated logs older than
-    the retention window."""
+    Jetty's RequestLogWriter role). Rotates daily — the current file is
+    ``path``, finished days move to ``path.YYYY-MM-DD`` — and deletes rotated
+    files older than the retention window (checked at startup and on each
+    rotation, like Jetty's retainDays sweep)."""
 
     def __init__(self, path: str, retention_days: int = 14):
+        import time as _t
+        self._path = path
+        self._retention_days = retention_days
+        self._lock = threading.Lock()
+        self._sweep()
+        self._f = open(path, "a", buffering=1)
+        self._day = _t.strftime("%Y-%m-%d")
+
+    def _sweep(self) -> None:
         import glob
         import os
         import time as _t
-        self._path = path
-        self._lock = threading.Lock()
-        cutoff = _t.time() - retention_days * 86_400
-        for old in glob.glob(path + ".*"):
+        cutoff = _t.time() - self._retention_days * 86_400
+        for old in glob.glob(self._path + ".*"):
             try:
                 if os.path.getmtime(old) < cutoff:
                     os.unlink(old)
             except OSError:
                 pass
-        self._f = open(path, "a", buffering=1)
+
+    def _maybe_rotate(self) -> None:
+        """Caller holds the lock. On day change, the open file is renamed to
+        path.<previous-day> and a fresh one started."""
+        import os
+        import time as _t
+        day = _t.strftime("%Y-%m-%d")
+        if day == self._day:
+            return
+        try:
+            self._f.close()
+            os.replace(self._path, f"{self._path}.{self._day}")
+        except OSError:
+            pass
+        self._f = open(self._path, "a", buffering=1)
+        self._day = day
+        self._sweep()
 
     def log(self, client_ip: str, method: str, path: str, status: int,
             length: int) -> None:
         import time as _t
         ts = _t.strftime("%d/%b/%Y:%H:%M:%S %z")
         with self._lock:
+            self._maybe_rotate()
             self._f.write(f'{client_ip} - - [{ts}] "{method} {path} '
                           f'HTTP/1.1" {status} {length}\n')
 
@@ -131,6 +157,10 @@ class CruiseControlServer:
                     cfg.get_string("webserver.http.cors.allowmethods"),
                 "Access-Control-Expose-Headers":
                     cfg.get_string("webserver.http.cors.exposeheaders"),
+                # on EVERY response, not just the preflight: a credentialed
+                # fetch (session cookie / Authorization) is discarded by the
+                # browser unless the actual response grants credentials too
+                "Access-Control-Allow-Credentials": "true",
             }
         self._reason_required = bool(
             cfg is not None and cfg.get_boolean("request.reason.required"))
@@ -141,6 +171,12 @@ class CruiseControlServer:
                         if cfg is not None else "")
         self._ui_prefix = ((cfg.get_string("webserver.ui.urlprefix")
                             if cfg is not None else "/*").rstrip("*") or "/")
+        # webserver.api.urlprefix (WebServerConfig.java:73-75): the API mount
+        # point; "/kafkacruisecontrol/*" by default. The trailing * matches
+        # the reference's servlet-spec wildcard
+        self._api_prefix = ((cfg.get_string("webserver.api.urlprefix")
+                             if cfg is not None else URL_PREFIX + "/*")
+                            .rstrip("*").rstrip("/") or URL_PREFIX)
         self._access_log = None
         if cfg is not None and cfg.get_boolean("webserver.accesslog.enabled"):
             self._access_log = AccessLog(
@@ -265,6 +301,12 @@ class CruiseControlServer:
                 not params["topic"] or params["replication_factor"] is None):
             raise ParameterError(
                 "topic_configuration requires topic and replication_factor")
+        if params.get("replica_movement_strategies"):
+            try:
+                self.app.executor.validate_strategies(
+                    params["replica_movement_strategies"])
+            except ValueError as e:
+                raise ParameterError(str(e)) from None
         if (endpoint in (EndPoint.REBALANCE, EndPoint.PROPOSALS)
                 and params.get("rebalance_disk") and params.get("goals")):
             intra = self.app.config.get_list("intra.broker.goals")
@@ -310,7 +352,8 @@ class CruiseControlServer:
                         partition_load_records_json,
                     )
                     return partition_load_records_json(app.partition_load(
-                        sort_by=p["resource"], limit=p["entries"]))
+                        sort_by=p["resource"], limit=p["entries"],
+                        min_valid_partition_ratio=p["min_valid_partition_ratio"]))
                 if endpoint is EndPoint.PROPOSALS:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
                     goals = p["goals"] or None
@@ -339,6 +382,8 @@ class CruiseControlServer:
                         p["exclude_recently_removed_brokers"],
                         exclude_recently_demoted_brokers=
                         p["exclude_recently_demoted_brokers"],
+                        replica_movement_strategies=
+                        p["replica_movement_strategies"] or None,
                         reason=p["reason"] or "rebalance request"))
                 if endpoint is EndPoint.ADD_BROKER:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
@@ -503,16 +548,27 @@ def _make_handler(server: CruiseControlServer):
             return True
 
         def do_OPTIONS(self):
-            # CORS preflight (webserver.http.cors.enabled)
+            # CORS preflight (webserver.http.cors.enabled). The reference's
+            # handleOptions (KafkaCruiseControlServletUtils.java:258-268) also
+            # grants the request headers (reusing the exposeheaders value) and
+            # credentials — without them a browser sending Authorization or
+            # User-Task-ID fails preflight even with CORS enabled.
             if server._cors is None:
                 self._send(405, error_json("OPTIONS unsupported"), {})
                 return
-            self._send_raw(204, b"", "text/plain", {})
+            headers = dict(server._cors)
+            headers["Access-Control-Allow-Headers"] = server._cors.get(
+                "Access-Control-Expose-Headers", "")
+            self._send_raw(204, b"", "text/plain", headers)
 
         def _dispatch(self, method: str):
             parsed = urllib.parse.urlparse(self.path)
             path = parsed.path
-            if path.startswith(URL_PREFIX):
+            prefix = getattr(server, "_api_prefix", URL_PREFIX)
+            if path.startswith(prefix):
+                path = path[len(prefix):]
+            elif path.startswith(URL_PREFIX):
+                # the canonical prefix keeps working under a custom mount
                 path = path[len(URL_PREFIX):]
             name = path.strip("/").split("/")[0]
             endpoint = EndPoint.from_path(name)

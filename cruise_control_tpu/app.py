@@ -78,7 +78,10 @@ class CruiseControl:
         if oes is not None:
             # the on-execution store gates on the live executor
             oes.configure(self.config, executor=self.executor)
-        notifier = SelfHealingNotifier()
+        # anomaly.notifier.class: pluggable AnomalyNotifier
+        # (AnomalyDetectorConfig.java anomaly.notifier.class ->
+        # getConfiguredInstance); default SelfHealingNotifier
+        notifier = self.config.get_class("anomaly.notifier.class")()
         notifier.configure(self.config,
                            num_brokers_supplier=lambda: len(backend.brokers()))
         clock = SimClock(backend) if hasattr(backend, "advance") else None
@@ -95,11 +98,17 @@ class CruiseControl:
         self._wire_detectors()
         self._proposal_cache: OptimizerResult | None = None
         self._proposal_cache_generation = None
+        self._proposal_cache_ms: float = -1.0   # computation time (backend clock)
         self._cache_lock = threading.Lock()
+        # one party refreshes at a time; readers fall back to waiting on it
+        self._refresh_lock = threading.Lock()
+        self._precompute_threads: list[threading.Thread] = []
+        self._precompute_stop = threading.Event()
         self._ops_history: list[dict] = []
 
     # ------------------------------------------------------------- wiring
     def _wire_detectors(self):
+        from cruise_control_tpu.detector.provisioner import ProvisionFloors
         broker_fd = BrokerFailureDetector(
             self.backend,
             persist_path=self.config.get_string("failed.brokers.storage.path"),
@@ -107,9 +116,13 @@ class CruiseControl:
         disk_fd = DiskFailureDetector(
             self.backend,
             anomaly_cls=self.config.get_class("disk.failures.class"))
+        # provisioner.class: right-sizing SPI invoked on UNDER/OVER_PROVISIONED
+        provisioner = self.config.get_configured_instance("provisioner.class")
         goal_vd = GoalViolationDetector(
             self.goal_optimizer, self.load_monitor,
             self.config.get_list("anomaly.detection.goals"),
+            provisioner=provisioner,
+            provision_floors=ProvisionFloors.from_config(self.config),
             sensors=self.sensors,
             anomaly_cls=self.config.get_class("goal.violations.class"),
             allow_capacity_estimation=self.config.get_boolean(
@@ -131,8 +144,10 @@ class CruiseControl:
                     hist.setdefault(name, []).append(float(v))
                     del hist[name][:-64]   # bounded history window
             return found
-        topic_rf = TopicReplicationFactorAnomalyFinder()
-        topic_rf.configure(self.config)
+        # topic.anomaly.finder.class: LIST of TopicAnomalyFinder plugins
+        # (reference TopicAnomalyDetector runs every configured finder)
+        topic_finders = self.config.get_configured_instances(
+            "topic.anomaly.finder.class")
         # the pluggable reader SPI (maintenance.event.reader.class) plus the
         # topic transport when its path is configured
         maint_readers = [self.config.get_configured_instance(
@@ -152,31 +167,100 @@ class CruiseControl:
                 "maintenance.event.enable.idempotence"))
         self.goal_violation_detector = goal_vd
 
-        self.anomaly_detector.register_detector("BrokerFailureDetector",
-                                                broker_fd.run_once)
-        self.anomaly_detector.register_detector("DiskFailureDetector",
-                                                disk_fd.run_once)
-        self.anomaly_detector.register_detector("GoalViolationDetector",
-                                                goal_vd.run_once)
-        self.anomaly_detector.register_detector(
-            "SlowBrokerFinder",
-            lambda now: slow.run_once(self.backend.broker_metrics(), now))
-        self.anomaly_detector.register_detector(
-            "MetricAnomalyDetector", run_metric_finder)
-        self.anomaly_detector.register_detector(
-            "TopicAnomalyDetector",
-            lambda now: topic_rf.anomalies(self.backend, now))
-        self.anomaly_detector.register_detector(
-            "MaintenanceEventDetector",
-            lambda now: [e for r in maint_readers
-                         for e in r.read_events(now)
-                         if not idem.seen_before(
-                             f"{e.plan_type}:{e.brokers}:{e.topics}", now)])
+        # per-detector cadence (AnomalyDetectorConfig.java:154-205): each
+        # *.detection.interval.ms falls back to anomaly.detection.interval.ms
+        # when -1; broker failure uses its own re-detection backoff
+        base_ms = float(self.config.get_int("anomaly.detection.interval.ms"))
 
-    def start_up(self) -> None:
+        def interval(key: str) -> float:
+            v = float(self.config.get_int(key))
+            return base_ms if v < 0 else v
+
+        register = self.anomaly_detector.register_detector
+        register("BrokerFailureDetector", broker_fd.run_once,
+                 interval_ms=float(self.config.get_int(
+                     "broker.failure.detection.backoff.ms")))
+        register("DiskFailureDetector", disk_fd.run_once,
+                 interval_ms=interval("disk.failure.detection.interval.ms"))
+        register("GoalViolationDetector", goal_vd.run_once,
+                 interval_ms=interval("goal.violation.detection.interval.ms"))
+        register("SlowBrokerFinder",
+                 lambda now: slow.run_once(self.backend.broker_metrics(), now),
+                 interval_ms=interval("metric.anomaly.detection.interval.ms"))
+        register("MetricAnomalyDetector", run_metric_finder,
+                 interval_ms=interval("metric.anomaly.detection.interval.ms"))
+        register("TopicAnomalyDetector",
+                 lambda now: [a for f in topic_finders
+                              for a in f.anomalies(self.backend, now)],
+                 interval_ms=interval("topic.anomaly.detection.interval.ms"))
+        # maintenance events poll on the base interval (the reference runs a
+        # dedicated long-poll consumer thread; the spool-file reader here is
+        # cheap enough to poll)
+        register("MaintenanceEventDetector",
+                 lambda now: [e for r in maint_readers
+                              for e in r.read_events(now)
+                              if not idem.seen_before(
+                                  f"{e.plan_type}:{e.brokers}:{e.topics}", now)],
+                 interval_ms=base_ms)
+
+    def start_up(self, proposal_precompute: bool = False) -> None:
+        """Monitor replay + (optionally) the background proposal-precompute
+        loop (KafkaCruiseControl.java:201-207 starts both; the REST main
+        passes ``proposal_precompute=True``, unit tests mostly don't want a
+        thread optimizing underneath them)."""
         self.load_monitor.start_up()
+        if proposal_precompute:
+            self.start_proposal_precompute()
+
+    def start_proposal_precompute(self) -> None:
+        """num.proposal.precompute.threads background workers keep the
+        proposal cache fresh against model-generation bumps AND
+        proposal.expiration.ms staleness (GoalOptimizer.java:139-190
+        ProposalCandidateComputer + :219-226 staleness check)."""
+        if self._precompute_threads:
+            return
+        self._precompute_stop.clear()
+        expiration_ms = self.config.get_int("proposal.expiration.ms")
+        for i in range(self.config.get_int("num.proposal.precompute.threads")):
+            t = threading.Thread(target=self._precompute_loop,
+                                 args=(expiration_ms,), daemon=True,
+                                 name=f"proposal-precompute-{i}")
+            t.start()
+            self._precompute_threads.append(t)
+
+    def _precompute_loop(self, expiration_ms: float) -> None:
+        from cruise_control_tpu.monitor.load_monitor import (
+            NotEnoughValidWindowsError,
+        )
+        while not self._precompute_stop.is_set():
+            try:
+                if self._proposal_cache_stale(expiration_ms):
+                    self.cached_proposals()
+            except NotEnoughValidWindowsError:
+                pass      # monitor not ready yet — retry next tick
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception("proposal precompute failed")
+            # poll fast enough to notice generation bumps promptly but far
+            # below the expiration budget; the refresh itself is the cost
+            wait_s = min(max(expiration_ms / 4000.0, 0.05), 30.0)
+            self._precompute_stop.wait(wait_s)
+
+    def _proposal_cache_stale(self, expiration_ms: float) -> bool:
+        gen = self.load_monitor.model_generation().as_tuple()
+        with self._cache_lock:
+            if self._proposal_cache is None:
+                return True
+            if self._proposal_cache_generation != gen:
+                return True
+            return (expiration_ms >= 0
+                    and self._now_ms() - self._proposal_cache_ms > expiration_ms)
 
     def shutdown(self) -> None:
+        self._precompute_stop.set()
+        for t in self._precompute_threads:
+            t.join(5.0)
+        self._precompute_threads.clear()
         self.anomaly_detector.shutdown()
         self.load_monitor.shutdown()
 
@@ -248,6 +332,19 @@ class CruiseControl:
         ``self.healing.goals`` when set, else the built-in evacuation chain."""
         return self.config.get_list("self.healing.goals") or SELF_HEALING_GOALS
 
+    def _self_healing_exclusions(self, excl_removed: bool, excl_demoted: bool,
+                                 self_healing: bool) -> tuple:
+        """Self-healing operations exclude recently removed/demoted brokers
+        by default (AnomalyDetectorConfig
+        self.healing.exclude.recently.{removed,demoted}.brokers); explicit
+        request flags still win when already set."""
+        if self_healing:
+            excl_removed = excl_removed or self.config.get_boolean(
+                "self.healing.exclude.recently.removed.brokers")
+            excl_demoted = excl_demoted or self.config.get_boolean(
+                "self.healing.exclude.recently.demoted.brokers")
+        return excl_removed, excl_demoted
+
     def _run_optimization(self, operation: str, reason: str, ct, meta,
                           goal_names=None, options=OptimizationOptions(),
                           dry_run: bool = True, skip_hard_goal_check: bool = False,
@@ -289,6 +386,7 @@ class CruiseControl:
                   kafka_assigner: bool = False, excluded_topics: str | None = None,
                   exclude_recently_removed_brokers: bool = False,
                   exclude_recently_demoted_brokers: bool = False,
+                  replica_movement_strategies: list | None = None,
                   reason: str = "rebalance") -> dict:
         """POST /rebalance (RebalanceRunnable.java:30-115 role).
         ``rebalance_disk=True`` balances load across the logdirs of each
@@ -296,11 +394,16 @@ class CruiseControl:
         (RebalanceParameters.java rebalance_disk); ``kafka_assigner=True``
         substitutes the kafka-assigner mode goals
         (analyzer/kafkaassigner/ role)."""
+        if replica_movement_strategies:
+            # fail before optimizing — a typo'd strategy must 400, not burn
+            # an optimization then 500 at execute time
+            self.executor.validate_strategies(replica_movement_strategies)
         ct, meta = self._model()
         ct = self._apply_excluded_topics(ct, meta, excluded_topics)
-        ct = self._apply_broker_exclusions(ct, meta,
-                                           exclude_recently_removed_brokers,
-                                           exclude_recently_demoted_brokers)
+        excl_rm, excl_dm = self._self_healing_exclusions(
+            exclude_recently_removed_brokers, exclude_recently_demoted_brokers,
+            self_healing)
+        ct = self._apply_broker_exclusions(ct, meta, excl_rm, excl_dm)
         options = OptimizationOptions(
             triggered_by_goal_violation=triggered_by_goal_violation)
         if kafka_assigner:
@@ -319,10 +422,13 @@ class CruiseControl:
                 goal_names = intra
             skip_hard_goal_check = True
         goals = goal_names or (self._self_healing_goals() if self_healing else None)
+        execute_kw = ({"strategy_names": replica_movement_strategies}
+                      if replica_movement_strategies else None)
         op = self._run_optimization("REBALANCE", reason, ct, meta, goals, options,
                                     dry_run=dry_run,
                                     skip_hard_goal_check=skip_hard_goal_check
-                                    or self_healing)
+                                    or self_healing,
+                                    execute_kw=execute_kw)
         return op.to_json()
 
     def remove_brokers(self, broker_ids: list, dry_run: bool = False,
@@ -336,9 +442,10 @@ class CruiseControl:
         destinations and relocates everything they host."""
         ct, meta = self._model()
         ct = self._apply_excluded_topics(ct, meta, excluded_topics)
-        ct = self._apply_broker_exclusions(ct, meta,
-                                           exclude_recently_removed_brokers,
-                                           exclude_recently_demoted_brokers)
+        excl_rm, excl_dm = self._self_healing_exclusions(
+            exclude_recently_removed_brokers, exclude_recently_demoted_brokers,
+            self_healing)
+        ct = self._apply_broker_exclusions(ct, meta, excl_rm, excl_dm)
         idx = [meta.broker_index(b) for b in broker_ids]
         alive = np.asarray(ct.broker_alive).copy()
         excl = np.asarray(ct.broker_excluded_for_replica_move).copy()
@@ -401,6 +508,7 @@ class CruiseControl:
         return op.to_json()
 
     def fix_offline_replicas(self, dry_run: bool = False,
+                             self_healing: bool = False,
                              excluded_topics: str | None = None,
                              exclude_recently_removed_brokers: bool = False,
                              exclude_recently_demoted_brokers: bool = False,
@@ -408,9 +516,10 @@ class CruiseControl:
         """POST /fix_offline_replicas (FixOfflineReplicasRunnable role)."""
         ct, meta = self._model()
         ct = self._apply_excluded_topics(ct, meta, excluded_topics)
-        ct = self._apply_broker_exclusions(ct, meta,
-                                           exclude_recently_removed_brokers,
-                                           exclude_recently_demoted_brokers)
+        excl_rm, excl_dm = self._self_healing_exclusions(
+            exclude_recently_removed_brokers, exclude_recently_demoted_brokers,
+            self_healing)
+        ct = self._apply_broker_exclusions(ct, meta, excl_rm, excl_dm)
         op = self._run_optimization(
             "FIX_OFFLINE_REPLICAS", reason, ct, meta, self._self_healing_goals(),
             OptimizationOptions(fix_offline_replicas_only=True),
@@ -554,25 +663,49 @@ class CruiseControl:
             return self.goal_optimizer.optimizations(
                 ct, meta, goal_names=goal_names or None,
                 raise_on_failure=False, skip_hard_goal_check=True)
-        gen = self.load_monitor.model_generation().as_tuple()
-        with self._cache_lock:
-            if (not force_refresh and self._proposal_cache is not None
-                    and self._proposal_cache_generation == gen):
-                return self._proposal_cache
-        # allow.capacity.estimation.on.proposal.precompute: whether the
-        # precompute path tolerates estimated broker capacities
-        ct, meta = self.load_monitor.cluster_model(
-            allow_capacity_estimation=self.config.get_boolean(
-                "allow.capacity.estimation.on.proposal.precompute"))
-        # the configured exclusion regex applies to precomputed proposals too
-        ct = self._apply_excluded_topics(ct, meta, None)
-        # the precompute path records violations instead of failing the cache
-        # refresh (GoalOptimizer.java precompute thread logs + retries)
-        res = self.goal_optimizer.optimizations(ct, meta, raise_on_failure=False)
-        with self._cache_lock:
-            self._proposal_cache = res
-            self._proposal_cache_generation = gen
-        return res
+        expiration_ms = self.config.get_int("proposal.expiration.ms")
+
+        def fresh() -> OptimizerResult | None:
+            gen = self.load_monitor.model_generation().as_tuple()
+            with self._cache_lock:
+                if (not force_refresh and self._proposal_cache is not None
+                        and self._proposal_cache_generation == gen
+                        and (expiration_ms == 0
+                             or self._now_ms() - self._proposal_cache_ms
+                             <= expiration_ms)):
+                    return self._proposal_cache
+            return None
+
+        hit = fresh()
+        if hit is not None:
+            return hit
+        with self._refresh_lock:
+            # the precompute thread may have refreshed while we waited
+            hit = fresh()
+            if hit is not None:
+                return hit
+            computed_ms = self._now_ms()
+            # generation is read BEFORE the (multi-second at scale) model
+            # build: a concurrent sampling tick bumping it mid-build must
+            # only cause an extra refresh, never stamp the cache newer than
+            # the data it was computed from
+            gen = self.load_monitor.model_generation().as_tuple()
+            # allow.capacity.estimation.on.proposal.precompute: whether the
+            # precompute path tolerates estimated broker capacities
+            ct, meta = self.load_monitor.cluster_model(
+                allow_capacity_estimation=self.config.get_boolean(
+                    "allow.capacity.estimation.on.proposal.precompute"))
+            # the configured exclusion regex applies to precomputed proposals
+            ct = self._apply_excluded_topics(ct, meta, None)
+            # the precompute path records violations instead of failing the
+            # cache refresh (GoalOptimizer.java precompute thread logs+retries)
+            res = self.goal_optimizer.optimizations(ct, meta,
+                                                    raise_on_failure=False)
+            with self._cache_lock:
+                self._proposal_cache = res
+                self._proposal_cache_generation = gen
+                self._proposal_cache_ms = computed_ms
+            return res
 
     # ---------------------------------------------------------------- state
     def state_json(self, substates=None) -> dict:
@@ -608,12 +741,20 @@ class CruiseControl:
                                         self.backend.partitions(),
                                         verbose=verbose)
 
-    def partition_load(self, sort_by: str = "DISK", limit: int = 50) -> list:
+    def partition_load(self, sort_by: str = "DISK", limit: int = 50,
+                       min_valid_partition_ratio: float | None = None) -> list:
         """GET /partition_load: per-partition utilization rows in the
         reference record schema (PartitionLoadState.java: topic, partition,
-        leader, followers, the four Resource JSON names, msg_in)."""
+        leader, followers, the four Resource JSON names, msg_in). The model
+        build requires ``min_valid_partition_ratio`` valid partitions,
+        defaulting to MonitorConfig min.valid.partition.ratio
+        (PartitionLoadRunnable.java)."""
         from cruise_control_tpu.common.resources import Resource
-        ct, meta = self._model()
+        ratio = (min_valid_partition_ratio if min_valid_partition_ratio
+                 is not None
+                 else self.config.get_double("min.valid.partition.ratio"))
+        ct, meta = self._model(ModelCompletenessRequirements(
+            min_monitored_partitions_percentage=ratio))
         loads = np.asarray(ct.leader_load)
         lead = np.asarray(ct.replica_is_leader)
         valid = np.asarray(ct.replica_valid)

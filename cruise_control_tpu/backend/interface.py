@@ -63,3 +63,8 @@ class ClusterBackend(Protocol):
     def describe_logdirs(self) -> dict: ...              # broker -> {logdir: alive}
     def set_replication_throttle(self, rate_bytes_per_sec: int | None) -> None: ...
     def replication_throttle(self) -> int | None: ...
+    # per-topic config writes (alterConfigs role): the throttle helper sets
+    # leader/follower.replication.throttled.replicas lists per topic and
+    # deletes them (value None) after execution
+    def set_topic_config(self, topic: str, key: str, value) -> None: ...
+    def topic_configs(self) -> dict: ...

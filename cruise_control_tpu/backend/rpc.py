@@ -187,6 +187,10 @@ class RpcClusterBackend:
         TopicConfigProvider / min-ISR safety check)."""
         return self._call("topic_configs")
 
+    def set_topic_config(self, topic: str, key: str, value) -> None:
+        """alterConfigs role (throttled-replica lists; value None deletes)."""
+        self._call("set_topic_config", topic=topic, key=key, value=value)
+
     # -- simulated-cluster controls, forwarded so fault-injection tests can
     # drive a remote simulated sidecar exactly like the in-process one --
     def add_broker(self, broker_id, rack, **kw):
@@ -315,6 +319,11 @@ def _dispatch(backend, method: str, p: dict):
     if method == "topic_configs":
         getter = getattr(backend, "topic_configs", None)
         return getter() if getter is not None else {}
+    if method == "set_topic_config":
+        setter = getattr(backend, "set_topic_config", None)
+        if setter is not None:
+            setter(p["topic"], p["key"], p.get("value"))
+        return None
     if method == "now_ms":
         # property on the simulated backend, method on wire clients
         clock = backend.now_ms

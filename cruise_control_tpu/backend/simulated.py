@@ -55,8 +55,17 @@ class SimulatedClusterBackend:
     # -- per-topic config (TopicConfigProvider source; the real cluster's
     #    describeConfigs analogue) --
     def set_topic_config(self, topic: str, key: str, value) -> None:
+        """``value=None`` deletes the entry (the alterConfigs DELETE op the
+        throttle-helper cleanup uses, ReplicationThrottleHelper.java:200)."""
         with self._lock:
-            self._topic_configs.setdefault(topic, {})[key] = value
+            if value is None:
+                cfgs = self._topic_configs.get(topic)
+                if cfgs is not None:
+                    cfgs.pop(key, None)
+                    if not cfgs:
+                        del self._topic_configs[topic]
+            else:
+                self._topic_configs.setdefault(topic, {})[key] = value
 
     def topic_configs(self) -> dict:
         with self._lock:

@@ -20,6 +20,13 @@ class ConfigException(Exception):
     """Raised on invalid config keys/values (reference ConfigException.java)."""
 
 
+# When not None, every key read through Config.get/__getitem__ records its
+# RESOLVED canonical name here. tests/test_config_surface.py uses this to
+# prove every canonical key is actually consumed somewhere (the anti-
+# "defined-but-dead key" guard); no production path enables it.
+READ_TRACKER: set | None = None
+
+
 class Type(enum.Enum):
     BOOLEAN = "boolean"
     INT = "int"
@@ -205,13 +212,18 @@ class Config:
 
     def get(self, name: str, default: Any = None) -> Any:
         name = self._def.resolve_name(name)
+        if READ_TRACKER is not None:
+            READ_TRACKER.add(name)
         if name not in self._values:
             return default
         return self._values[name]
 
     def __getitem__(self, name: str) -> Any:
+        name = self._def.resolve_name(name)
+        if READ_TRACKER is not None:
+            READ_TRACKER.add(name)
         try:
-            return self._values[self._def.resolve_name(name)]
+            return self._values[name]
         except KeyError:
             raise ConfigException(f"Unknown config {name!r}") from None
 

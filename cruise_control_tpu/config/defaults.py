@@ -117,19 +117,17 @@ _D.define(name="min.topic.leaders.per.broker", type=Type.INT, default=1, validat
 _D.define(name="topics.with.min.leaders.per.broker", type=Type.STRING, default="",
           doc="Regex of topics that must keep a minimum leader count on each broker.")
 _D.define(name="proposal.expiration.ms", type=Type.LONG, default=900_000, validator=at_least(0),
-          doc="Precomputed proposal freshness budget (AnalyzerConfig.java:208-209).")
-_D.define(name="max.proposal.candidates", type=Type.INT, default=10, validator=at_least(1),
-          doc="Precompute candidates retained.")
+          doc="Precomputed proposal freshness budget (AnalyzerConfig.java:208-209); "
+              "0 = refresh continuously.")
 _D.define(name="num.proposal.precompute.threads", type=Type.INT, default=1, validator=at_least(1),
-          doc="Proposal precompute workers (host-side).")
+          doc="Proposal precompute workers (host-side; AnalyzerConfig.java:225-230). "
+              "One device program runs at a time on the TPU — extra threads only "
+              "pipeline model builds against device execution.")
 _D.define(name="analyzer.max.iterations", type=Type.INT, default=4096, validator=at_least(1),
           doc="TPU-specific: hard cap on greedy-engine iterations per goal per round.")
 _D.define(name="analyzer.candidate.replicas.per.broker", type=Type.INT, default=64, validator=at_least(1),
           doc="TPU-specific: top-K replicas per source broker considered per engine iteration "
               "(replaces the reference's sorted-replica scan, SortedReplicas.java).")
-_D.define(name="analyzer.batched.moves", type=Type.BOOLEAN, default=True,
-          doc="TPU-specific: apply one non-conflicting move per violating broker per iteration "
-              "instead of a single global move (faster, same violation contract).")
 _D.define(name="analyzer.leader.candidates.per.iteration", type=Type.INT, default=32,
           validator=at_least(1),
           doc="TPU-specific: leadership-transfer candidate pool per engine pass.")
@@ -186,7 +184,6 @@ _D.define(name="min.samples.per.broker.metrics.window", type=Type.INT, default=1
 _D.define(name="max.allowed.extrapolations.per.partition", type=Type.INT, default=5, validator=at_least(0),
           doc="Per-entity extrapolation budget before samples are invalid.")
 _D.define(name="max.allowed.extrapolations.per.broker", type=Type.INT, default=5, validator=at_least(0))
-_D.define(name="partition.metrics.window.holding.capacity", type=Type.INT, default=5, validator=at_least(1))
 _D.define(name="metric.sampling.interval.ms", type=Type.LONG, default=120_000, validator=at_least(1),
           doc="Sampler period.")
 _D.define(name="metric.sampler.class", type=Type.CLASS,
@@ -225,11 +222,15 @@ _D.define(name="default.broker.capacity.nw.in", type=Type.DOUBLE, default=50_000
           doc="Fallback network-in capacity (KB/s).")
 _D.define(name="default.broker.capacity.nw.out", type=Type.DOUBLE, default=50_000.0,
           doc="Fallback network-out capacity (KB/s).")
-_D.define(name="monitor.state.update.interval.ms", type=Type.LONG, default=30_000)
-_D.define(name="min.valid.partition.ratio", type=Type.DOUBLE, default=0.95, validator=between(0.0, 1.0),
-          doc="Default completeness: min fraction of monitored partitions with valid samples.")
-_D.define(name="min.monitored.partition.percentage", type=Type.DOUBLE, default=0.995,
-          validator=between(0.0, 1.0))
+_D.define(name="monitor.state.update.interval.ms", type=Type.LONG, default=30_000,
+          doc="Monitor state/sensor refresh cadence (MonitorConfig.java:346-347): "
+              "state_json recomputation is cached for this long.")
+_D.define(name="min.valid.partition.ratio", type=Type.DOUBLE, default=0.995,
+          validator=between(0.0, 1.0),
+          doc="Default min fraction of partitions with valid samples a "
+              "/partition_load model build requires when the request passes no "
+              "min_valid_partition_ratio (MonitorConfig.java:230-233, "
+              "PartitionLoadRunnable.java).")
 _D.define(name="leader.network.inbound.weight.for.cpu.util", type=Type.DOUBLE, default=0.6,
           doc="Static CPU attribution weights (ModelUtils.java:61-141).")
 _D.define(name="follower.network.inbound.weight.for.cpu.util", type=Type.DOUBLE, default=0.3)
@@ -336,9 +337,12 @@ _D.define(name="executor.backend.class", type=Type.CLASS,
           default="cruise_control_tpu.backend.simulated.SimulatedClusterBackend",
           doc="ClusterBackend plugin: simulated (tests/dev) or adapter to a real cluster "
               "(the reference actuates via ZK znodes + AdminClient, Executor.java:1272).")
-_D.define(name="remove.recently.removed.brokers.grace.ms", type=Type.LONG, default=0)
-_D.define(name="demotion.history.retention.time.ms", type=Type.LONG, default=86_400_000)
-_D.define(name="removal.history.retention.time.ms", type=Type.LONG, default=86_400_000)
+_D.define(name="demotion.history.retention.time.ms", type=Type.LONG, default=1_209_600_000,
+          doc="How long a demoted broker stays in the recently-demoted "
+              "blocklist (ExecutorConfig.java:197-199; default 336 h).")
+_D.define(name="removal.history.retention.time.ms", type=Type.LONG, default=1_209_600_000,
+          doc="How long a removed broker stays in the recently-removed "
+              "blocklist (ExecutorConfig.java:205; default 336 h).")
 _D.define(name="min.execution.progress.check.interval.ms", type=Type.LONG, default=5_000,
           validator=at_least(1),
           doc="Floor for the (admin-adjustable) execution progress-check "
@@ -443,7 +447,12 @@ _D.define(name="slow.broker.bytes.rate.detection.threshold", type=Type.DOUBLE, d
 _D.define(name="slow.broker.log.flush.time.threshold.ms", type=Type.DOUBLE, default=1000.0)
 _D.define(name="slow.broker.demotion.score", type=Type.INT, default=5)
 _D.define(name="slow.broker.decommission.score", type=Type.INT, default=50)
-_D.define(name="slow.broker.self.healing.unfixable.action", type=Type.STRING, default="DEMOTE")
+_D.define(name="slow.broker.self.healing.unfixable.ratio", type=Type.DOUBLE, default=0.1,
+          validator=between(0.0, 1.0),
+          doc="Max fraction of cluster brokers that may be slow before the "
+              "anomaly is reported unfixable (alert-only) — mass slowness "
+              "looks like an external cause, not per-broker degradation "
+              "(SlowBrokerFinder.java:105-132).")
 _D.define(name="provisioner.class", type=Type.CLASS,
           default="cruise_control_tpu.detector.provisioner.NoopProvisioner",
           doc="Provisioner SPI for cluster right-sizing.")
@@ -683,8 +692,11 @@ for _ep in _EndPoint:
 # --------------------------------------------------------------------------
 _D.define(name="tpu.mesh.axis.brokers", type=Type.INT, default=1, validator=at_least(1),
           doc="Device-mesh size along the candidate-destination (broker) axis for sharded scoring.")
-_D.define(name="tpu.donate.state", type=Type.BOOLEAN, default=True,
-          doc="Donate engine state buffers between iterations to avoid HBM copies.")
+_D.define(name="tpu.donate.state", type=Type.BOOLEAN, default=False,
+          doc="Donate engine state buffers between per-goal programs to halve "
+              "peak HBM. Off by default: ownership transfer serializes the "
+              "async dispatch pipeline on a tunneled TPU (measured slower); "
+              "enable only when HBM-bound.")
 
 CRUISE_CONTROL_CONFIG_DEF = _D
 

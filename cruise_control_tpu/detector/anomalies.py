@@ -70,6 +70,7 @@ class DiskFailures(Anomaly):
     def fix(self, cruise_control):
         """FixOfflineReplicasRunnable role."""
         return cruise_control.fix_offline_replicas(
+            self_healing=True,
             reason=f"self-healing disk failure: {self.failed_disks}")
 
 

@@ -28,12 +28,14 @@ from cruise_control_tpu.detector.provisioner import (
 
 class GoalViolationDetector:
     def __init__(self, goal_optimizer, load_monitor, detection_goals: list,
-                 provisioner=None, sensors=None, anomaly_cls=GoalViolations,
+                 provisioner=None, provision_floors=None, sensors=None,
+                 anomaly_cls=GoalViolations,
                  allow_capacity_estimation: bool = True):
         self._optimizer = goal_optimizer
         self._monitor = load_monitor
         self._goals = list(detection_goals)
         self._provisioner = provisioner
+        self._provision_floors = provision_floors  # overprovisioned.* floors
         # goal.violations.class: pluggable anomaly materialization
         self._anomaly_cls = anomaly_cls
         self._allow_capacity_estimation = allow_capacity_estimation
@@ -79,7 +81,8 @@ class GoalViolationDetector:
             from cruise_control_tpu.detector.provisioner import (
                 recommendation_from_result,
             )
-            rec = recommendation_from_result(res, self._optimizer.constraint)
+            rec = recommendation_from_result(res, self._optimizer.constraint,
+                                             floors=self._provision_floors)
             self.last_provision = rec
             if rec.status is not ProvisionStatus.RIGHT_SIZED:
                 self._provisioner.rightsize([rec])
@@ -172,11 +175,17 @@ class SlowBrokerFinder:
 
     def __init__(self, flush_time_threshold_ms: float = 1000.0,
                  bytes_rate_threshold: float = 1024.0,
-                 demotion_score: int = 5, decommission_score: int = 50):
+                 demotion_score: int = 5, decommission_score: int = 50,
+                 unfixable_ratio: float = 0.1):
         self.flush_time_threshold_ms = flush_time_threshold_ms
         self.bytes_rate_threshold = bytes_rate_threshold
         self.demotion_score = demotion_score
         self.decommission_score = decommission_score
+        # slow.broker.self.healing.unfixable.ratio
+        # (SlowBrokerFinder.java:105-132): when more than this fraction of
+        # the cluster looks slow, the cause is almost surely external —
+        # report the anomaly unfixable (alert-only), never demote/remove
+        self.unfixable_ratio = unfixable_ratio
         self._scores: dict[int, int] = {}
 
     def configure(self, config, **extra):
@@ -187,6 +196,8 @@ class SlowBrokerFinder:
                 "slow.broker.bytes.rate.detection.threshold")
             self.demotion_score = config.get_int("slow.broker.demotion.score")
             self.decommission_score = config.get_int("slow.broker.decommission.score")
+            self.unfixable_ratio = config.get_double(
+                "slow.broker.self.healing.unfixable.ratio")
 
     def run_once(self, broker_metrics: dict, now_ms: float) -> list:
         """broker_metrics: broker -> {metric: value} (latest)."""
@@ -210,15 +221,19 @@ class SlowBrokerFinder:
                      if s >= self.decommission_score}
         to_demote = {b: s for b, s in self._scores.items()
                      if self.demotion_score <= s < self.decommission_score}
+        fixable = (len(to_remove) + len(to_demote)
+                   <= self.unfixable_ratio * max(len(flush), 1))
         out = []
         if to_remove:
             out.append(SlowBrokers(anomaly_type=AnomalyType.METRIC_ANOMALY,
                                    detected_ms=now_ms, slow_brokers=to_remove,
-                                   remove=True,
-                                   description=f"slow brokers to remove: {sorted(to_remove)}"))
+                                   remove=True, fixable=fixable,
+                                   description=f"slow brokers to remove: {sorted(to_remove)}"
+                                   + ("" if fixable else " (unfixable: ratio exceeded)")))
         if to_demote:
             out.append(SlowBrokers(anomaly_type=AnomalyType.METRIC_ANOMALY,
                                    detected_ms=now_ms, slow_brokers=to_demote,
-                                   remove=False,
-                                   description=f"slow brokers to demote: {sorted(to_demote)}"))
+                                   remove=False, fixable=fixable,
+                                   description=f"slow brokers to demote: {sorted(to_demote)}"
+                                   + ("" if fixable else " (unfixable: ratio exceeded)")))
         return out

@@ -9,7 +9,11 @@ same code path as the REST handlers.
 
 Here detection rounds are explicit (``run_detection_round``) and can also be
 driven by a host thread (``start`` / ``stop``); time is injected for the
-simulated backend.
+simulated backend. Each detector carries its own detection interval
+(AnomalyDetectorConfig.java:154-205 per-type ``*.detection.interval.ms``
+falling back to ``anomaly.detection.interval.ms``), with a deterministic
+initial phase jitter standing in for the reference's random init delay
+(AnomalyDetectorManager.java:218-226).
 """
 from __future__ import annotations
 
@@ -33,7 +37,8 @@ class AnomalyDetectorManager:
         self._queue: list[tuple, Anomaly] = []
         self._deferred: list = []        # (due_ms, anomaly) for CHECK verdicts
         self._lock = threading.Lock()
-        self._detectors: list = []       # (name, callable(now_ms) -> [Anomaly])
+        # name -> [run_once, interval_ms or None, next_due_ms or None]
+        self._detectors: dict[str, list] = {}
         self._history: list[dict] = []
         # per-type recent-anomaly ring (AnomalyDetectorConfig
         # num.cached.recent.anomaly.states; served at /state)
@@ -48,8 +53,12 @@ class AnomalyDetectorManager:
         self.detection_interval_ms = 300_000.0
 
     # ------------------------------------------------------------- wiring
-    def register_detector(self, name: str, run_once) -> None:
-        self._detectors.append((name, run_once))
+    def register_detector(self, name: str, run_once,
+                          interval_ms: float | None = None) -> None:
+        """``interval_ms`` None = run every round (legacy/explicit callers);
+        a value gives the detector its own cadence, honored by the scheduled
+        path (the background thread / ``run_due``)."""
+        self._detectors[name] = [run_once, interval_ms, None]
 
     @property
     def notifier(self):
@@ -72,9 +81,35 @@ class AnomalyDetectorManager:
 
     # ------------------------------------------------------------ rounds
     def run_detection_round(self, now_ms: float) -> int:
-        """Run every registered detector once; queue found anomalies."""
+        """Run every registered detector once (ignoring per-detector
+        schedules); queue found anomalies. Explicit-driver entry point."""
+        return self._run(now_ms, self._detectors.keys())
+
+    def run_due(self, now_ms: float) -> int:
+        """Run only detectors whose interval has elapsed, then reschedule
+        them — the scheduleAtFixedRate role. First run lands at
+        interval/2 + deterministic jitter like the reference's init delay."""
+        due = []
+        for name, slot in self._detectors.items():
+            _, interval, next_due = slot
+            if interval is None:
+                due.append(name)
+                continue
+            if next_due is None:
+                # deterministic phase jitter: spread detectors so they don't
+                # all fire on the same tick (reference uses RANDOM.nextInt)
+                jitter = (hash(name) % 10_000) / 10_000.0 * interval * 0.1
+                slot[2] = now_ms + interval / 2 + jitter
+                continue
+            if now_ms >= next_due:
+                due.append(name)
+                slot[2] = now_ms + interval
+        return self._run(now_ms, due)
+
+    def _run(self, now_ms: float, names) -> int:
         n = 0
-        for name, run_once in self._detectors:
+        for name in names:
+            run_once = self._detectors[name][0]
             try:
                 found = run_once(now_ms)
             except Exception:
@@ -84,6 +119,12 @@ class AnomalyDetectorManager:
                 self.add_anomaly(a)
                 n += 1
         return n
+
+    def next_due_ms(self) -> float | None:
+        """Earliest scheduled detector wake-up (None = nothing scheduled)."""
+        dues = [slot[2] for slot in self._detectors.values()
+                if slot[1] is not None and slot[2] is not None]
+        return min(dues) if dues else None
 
     def handle_anomalies(self, now_ms: float) -> list:
         """Drain the queue through the notifier; FIX routes to self-healing
@@ -140,9 +181,15 @@ class AnomalyDetectorManager:
             while not self._stop_event.is_set():
                 now = (self._clock.now_ms() if self._clock is not None
                        else time.time() * 1000.0)
-                self.run_detection_round(now)
+                self.run_due(now)
                 self.handle_anomalies(now)
-                self._stop_event.wait(self.detection_interval_ms / 1000.0)
+                # wake at the earliest per-detector due time, bounded by the
+                # global interval (deferred CHECK anomalies also need draining)
+                wait_ms = self.detection_interval_ms
+                nxt = self.next_due_ms()
+                if nxt is not None:
+                    wait_ms = min(wait_ms, max(nxt - now, 100.0))
+                self._stop_event.wait(wait_ms / 1000.0)
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="anomaly-detector")
@@ -167,5 +214,7 @@ class AnomalyDetectorManager:
             "recentAnomaliesByType": by_type,
             "numSelfHealingActions": self._self_healing_actions,
             "numQueuedAnomalies": self.num_queued(),
-            "registeredDetectors": [n for n, _ in self._detectors],
+            "registeredDetectors": list(self._detectors),
+            "detectionIntervalsMs": {n: s[1] for n, s in self._detectors.items()
+                                     if s[1] is not None},
         }

@@ -38,7 +38,29 @@ class NoopProvisioner:
         return False
 
 
-def recommendation_from_result(res, constraint) -> ProvisionRecommendation:
+@dataclasses.dataclass
+class ProvisionFloors:
+    """Right-sizing floors an OVER_PROVISIONED recommendation must respect
+    (AnomalyDetectorConfig overprovisioned.*): never recommend shrinking
+    below ``min_brokers``, below ``min_extra_racks`` spare racks beyond the
+    max partition RF, or past the point where the average replica count per
+    remaining broker exceeds ``max_replicas_per_broker``."""
+    min_brokers: int = 3
+    min_extra_racks: int = 1
+    max_replicas_per_broker: int = 1500
+
+    @classmethod
+    def from_config(cls, cfg) -> "ProvisionFloors":
+        return cls(
+            min_brokers=cfg.get_int("overprovisioned.min.brokers"),
+            min_extra_racks=cfg.get_int("overprovisioned.min.extra.racks"),
+            max_replicas_per_broker=int(cfg.get_int(
+                "overprovisioned.max.replicas.per.broker")))
+
+
+def recommendation_from_result(res, constraint,
+                               floors: ProvisionFloors | None = None,
+                               ) -> ProvisionRecommendation:
     """Capacity-math provision recommendation from an OptimizerResult
     (GoalViolationDetector.java:228 -> Provisioner.rightsize path, and the
     ProvisionRecommendation attached to OptimizationFailureException by the
@@ -82,15 +104,36 @@ def recommendation_from_result(res, constraint) -> ProvisionRecommendation:
     if active.any() and n > 1:
         avg_util_frac = total_load / np.maximum(cap.sum(axis=0), 1e-9)
         if (avg_util_frac[active] < low[active]).all():
+            floors = floors or ProvisionFloors()
             # brokers removable while every resource stays under its allowed
             # aggregate capacity (reference low-utilization OVER_PROVISIONED)
+            # AND the overprovisioned.* floors hold
+            n_replicas = int(np.asarray(env.replica_valid).sum())
+            keep_floor = max(
+                1, floors.min_brokers,
+                math.ceil(n_replicas / max(floors.max_replicas_per_broker, 1)))
             keep = n
-            while keep > 1 and (total_load
-                                <= avg_cap * thresh * (keep - 1) - 1e-9).all():
+            while keep > keep_floor and (
+                    total_load <= avg_cap * thresh * (keep - 1) - 1e-9).all():
                 keep -= 1
+            # min.extra.racks: keep enough brokers that the cluster retains
+            # (racks hosting the max partition RF) + extra racks' worth of
+            # spread — shrinking below max-RF racks would make rack-aware
+            # placement permanently infeasible. With one broker per rack in
+            # the worst case this is a broker floor.
+            racks_alive = np.asarray(env.broker_rack)[alive]
+            num_racks = len(np.unique(racks_alive))
+            if num_racks > 0:
+                valid = np.asarray(env.replica_valid)
+                parts = np.asarray(env.replica_partition)[valid]
+                max_rf = int(np.bincount(parts).max()) if parts.size else 1
+                per_rack = n / num_racks
+                min_racks = min(num_racks, max_rf + floors.min_extra_racks)
+                keep = max(keep, math.ceil(min_racks * per_rack))
             if keep < n:
                 return ProvisionRecommendation(
                     ProvisionStatus.OVER_PROVISIONED, num_brokers=n - keep,
                     reason=f"{n - keep} broker(s) removable under the "
-                           f"low-utilization thresholds")
+                           f"low-utilization thresholds (floors: "
+                           f">={keep_floor} brokers)")
     return ProvisionRecommendation(ProvisionStatus.RIGHT_SIZED)

@@ -47,7 +47,9 @@ class SimClock:
         self._backend = backend
 
     def now_ms(self) -> float:
-        return self._backend.now_ms
+        # simulated backend exposes a property; the RPC client a method
+        now = self._backend.now_ms
+        return float(now() if callable(now) else now)
 
     def sleep_ms(self, ms: float) -> None:
         self._backend.advance(ms)
@@ -98,6 +100,18 @@ class ExecutorConfigView:
     min_progress_check_interval_ms: float = 5_000.0
     slow_task_threshold_ms: float = 90_000.0
     slow_task_backoff_ms: float = 60_000.0
+    # max.num.cluster.movements: bound on TOTAL ongoing movements of any
+    # kind (ExecutorConfig.java:76-79); caps both the inter-broker in-flight
+    # set and a leadership batch
+    total_movement_cap: int = 1250
+    # leader.movement.timeout.ms (ExecutorConfig.java:139-141)
+    leader_movement_timeout_ms: float = 180_000.0
+    # concurrency.adjuster.interval.ms (ExecutorConfig.java:213): the AIMD
+    # adjuster runs on its own cadence, not every progress tick
+    adjuster_interval_ms: float = 360_000.0
+    # {demotion,removal}.history.retention.time.ms
+    demotion_history_retention_ms: float = 1_209_600_000.0
+    removal_history_retention_ms: float = 1_209_600_000.0
 
     @classmethod
     def from_config(cls, cfg) -> "ExecutorConfigView":
@@ -156,6 +170,15 @@ class ExecutorConfigView:
                 "concurrency.adjuster.multiplicative.decrease.inter.broker.replica"),
             adjuster_div_leadership=cfg.get_int(
                 "concurrency.adjuster.multiplicative.decrease.leadership"),
+            total_movement_cap=cfg.get_int("max.num.cluster.movements"),
+            leader_movement_timeout_ms=float(cfg.get_int(
+                "leader.movement.timeout.ms")),
+            adjuster_interval_ms=float(cfg.get_int(
+                "concurrency.adjuster.interval.ms")),
+            demotion_history_retention_ms=float(cfg.get_int(
+                "demotion.history.retention.time.ms")),
+            removal_history_retention_ms=float(cfg.get_int(
+                "removal.history.retention.time.ms")),
         )
 
 
@@ -200,10 +223,14 @@ class ConcurrencyAdjuster:
     """
 
     def __init__(self, cfg: ExecutorConfigView, min_isr_cache=None,
-                 backend=None):
+                 backend=None, clock=None):
         self._cfg = cfg
         self._min_isr = min_isr_cache
         self._backend = backend
+        # the executor's clock (SimClock/WallClock): MinIsrCache freshness
+        # must advance with the execution, not with a backend attribute that
+        # may not exist (in which case entries would never expire)
+        self._clock = clock or WallClock()
         self._min_isr_cursor = 0   # rotating sample window over partitions
         self.history: deque = deque(maxlen=100)
 
@@ -217,8 +244,7 @@ class ConcurrencyAdjuster:
                 or self._backend is None):
             return []
         brokers = self._backend.brokers()
-        clock = getattr(self._backend, "now_ms", 0.0)
-        now_ms = float(clock() if callable(clock) else clock)
+        now_ms = self._clock.now_ms()
         items = list(self._backend.partitions().items())
         n = self._cfg.min_isr_num_check
         start = self._min_isr_cursor % max(len(items), 1)
@@ -291,8 +317,21 @@ class Executor:
                      else ExecutorConfigView())
         self._clock = clock or (SimClock(backend) if hasattr(backend, "advance")
                                 else WallClock())
-        self._strategy = build_strategy(strategy_names
-                                        or ["BaseReplicaMovementStrategy"])
+        # strategy catalog + default chain from config
+        # (ExecutorConfig replica.movement.strategies = available plugin
+        # classes; default.replica.movement.strategies = the chain used when
+        # a request names none; ExecutionTaskPlanner.java:65-78)
+        self._strategy_registry = None
+        if config is not None:
+            from cruise_control_tpu.executor.strategy import strategy_registry
+            self._strategy_registry = strategy_registry(
+                config.get_list("replica.movement.strategies"))
+            if strategy_names is None:
+                strategy_names = config.get_list(
+                    "default.replica.movement.strategies")
+        self._strategy = build_strategy(
+            strategy_names or ["BaseReplicaMovementStrategy"],
+            registry=self._strategy_registry)
         self._state = ExecutorState.NO_TASK_IN_PROGRESS
         self._stop_requested = False
         self._force_stop = False
@@ -317,7 +356,9 @@ class Executor:
             # ExecutorNotifier SPI (executor.notifier.class)
             self._notifier = config.get_configured_instance(
                 "executor.notifier.class")
-        self._adjuster = ConcurrencyAdjuster(self._cfg, min_isr_cache, backend)
+        self._adjuster = ConcurrencyAdjuster(self._cfg, min_isr_cache, backend,
+                                             clock=self._clock)
+        self._last_adjust_ms = -1e18  # concurrency.adjuster.interval.ms gate
         self._slow_task_alerts: dict[int, float] = {}  # task_id -> last alert ms
 
     # ---------------------------------------------------------- reservation
@@ -355,10 +396,24 @@ class Executor:
         if newly_stopped:
             self._execution_stopped_meter.mark()
 
+    def _expire_history(self) -> None:
+        """Drop blocklist entries past their retention
+        ({removal,demotion}.history.retention.time.ms, Executor.java:449-506)."""
+        now = self._clock.now_ms()
+        for hist, retention in (
+                (self._recently_removed_brokers,
+                 self._cfg.removal_history_retention_ms),
+                (self._recently_demoted_brokers,
+                 self._cfg.demotion_history_retention_ms)):
+            for b in [b for b, ts in hist.items() if now - ts > retention]:
+                del hist[b]
+
     def recently_removed_brokers(self) -> set:
+        self._expire_history()
         return set(self._recently_removed_brokers)
 
     def recently_demoted_brokers(self) -> set:
+        self._expire_history()
         return set(self._recently_demoted_brokers)
 
     def drop_recently_removed_brokers(self, brokers) -> list:
@@ -404,6 +459,11 @@ class Executor:
                 "leadership": self._cfg.leadership_cap,
                 "progressCheckIntervalMs": self._cfg.progress_check_interval_ms}
 
+    def validate_strategies(self, strategy_names: list) -> None:
+        """Raise ValueError early (before any optimization work) when a
+        requested movement-strategy name is not in the configured catalog."""
+        build_strategy(strategy_names, registry=self._strategy_registry)
+
     def note_removed_brokers(self, brokers) -> None:
         for b in brokers:
             self._recently_removed_brokers[b] = self._clock.now_ms()
@@ -431,8 +491,14 @@ class Executor:
                         self._cfg.slow_task_threshold_ms / 1000.0)
 
     def execute_proposals(self, proposals: list, blocking: bool = True,
-                          context: dict | None = None) -> None:
-        """Run the 3-phase execution (Executor.executeProposals :567)."""
+                          context: dict | None = None,
+                          strategy_names: list | None = None) -> None:
+        """Run the 3-phase execution (Executor.executeProposals :567).
+        ``strategy_names`` overrides the configured default movement-strategy
+        chain for this execution (the REST replica_movement_strategies
+        parameter role)."""
+        strategy = (build_strategy(strategy_names, registry=self._strategy_registry)
+                    if strategy_names else self._strategy)
         with self._lock:
             if self._state != ExecutorState.NO_TASK_IN_PROGRESS:
                 raise RuntimeError("an execution is already in progress")
@@ -440,7 +506,11 @@ class Executor:
             self._stop_requested = False
             self._force_stop = False
         self._execution_meter.mark()
-        planner = ExecutionTaskPlanner(self._strategy)
+        # a fresh execution consults the current broker metrics immediately
+        # (the reference's adjuster thread runs continuously; ours only runs
+        # during executions, so re-arm the cadence gate at start)
+        self._last_adjust_ms = -1e18
+        planner = ExecutionTaskPlanner(strategy)
         if context is None:
             sizes = {tp: info.size_mb for tp, info in self._backend.partitions().items()}
             context = {"partition_size_mb": sizes}
@@ -460,22 +530,62 @@ class Executor:
         if t is not None:
             t.join(timeout_s)
 
+    # ----------------------------------------------------------- throttling
+    def _set_throttles(self, planner: ExecutionTaskPlanner) -> tuple:
+        """ReplicationThrottleHelper.java:28-46,159: set the global
+        leader/follower replication rate AND per-topic throttled-replica
+        lists ("partition:broker" entries — sources on the leader list,
+        move destinations on the follower list) covering every inter-broker
+        move of this execution."""
+        if not self._cfg.throttle_bytes_per_sec:
+            return False, []
+        self._backend.set_replication_throttle(self._cfg.throttle_bytes_per_sec)
+        set_topic_config = getattr(self._backend, "set_topic_config", None)
+        if set_topic_config is None:   # backend without topic-config support
+            return True, []
+        leader: dict[str, set] = {}
+        follower: dict[str, set] = {}
+        for t in planner.all_tasks:
+            if t.task_type is not TaskType.INTER_BROKER_REPLICA_ACTION:
+                continue
+            p = t.proposal
+            for b, _ in p.old_replicas:
+                leader.setdefault(p.topic, set()).add(f"{p.partition}:{b}")
+            for b in p.replicas_to_add:
+                follower.setdefault(p.topic, set()).add(f"{p.partition}:{b}")
+        topics = sorted(set(leader) | set(follower))
+        for topic in topics:
+            set_topic_config(topic, "leader.replication.throttled.replicas",
+                             ",".join(sorted(leader.get(topic, ()))))
+            set_topic_config(topic, "follower.replication.throttled.replicas",
+                             ",".join(sorted(follower.get(topic, ()))))
+        return True, topics
+
+    def _clear_throttles(self, throttled: bool, topics: list) -> None:
+        """ReplicationThrottleHelper cleanup (:200): remove the rate and every
+        per-topic list, including on stop/force-stop paths."""
+        if not throttled:
+            return
+        self._backend.set_replication_throttle(None)
+        set_topic_config = getattr(self._backend, "set_topic_config", None)
+        if set_topic_config is None:
+            return
+        for topic in topics:
+            set_topic_config(topic, "leader.replication.throttled.replicas", None)
+            set_topic_config(topic, "follower.replication.throttled.replicas", None)
+
     # ------------------------------------------------------------ internals
     def _run_execution(self, planner: ExecutionTaskPlanner) -> None:
-        throttled = False
+        throttled, throttled_topics = False, []
         try:
-            if self._cfg.throttle_bytes_per_sec:
-                self._backend.set_replication_throttle(self._cfg.throttle_bytes_per_sec)
-                throttled = True
+            throttled, throttled_topics = self._set_throttles(planner)
             self._inter_broker_phase(planner)
             if not self._stop_requested:
                 self._intra_broker_phase(planner)
             if not self._stop_requested:
                 self._leadership_phase(planner)
         finally:
-            if throttled:
-                # ReplicationThrottleHelper cleanup (:200)
-                self._backend.set_replication_throttle(None)
+            self._clear_throttles(throttled, throttled_topics)
             done = sum(1 for t in planner.all_tasks
                        if t.state is TaskState.COMPLETED)
             self._history.append({
@@ -529,18 +639,20 @@ class Executor:
                 t.transition(TaskState.COMPLETED, self._clock.now_ms())
                 for b in t.brokers_involved:
                     in_flight_by_broker[b] = max(0, in_flight_by_broker.get(b, 1) - 1)
-            # dynamic concurrency: AIMD on live broker metrics each progress
-            # tick (ConcurrencyAdjuster role, Executor.java:335-448); gated
-            # per movement type (concurrency.adjuster.inter.broker.replica.
-            # enabled)
-            if self._cfg.adjuster_enabled and self._cfg.adjuster_replica_enabled:
+            # dynamic concurrency: AIMD on live broker metrics on its own
+            # cadence (ConcurrencyAdjuster role, Executor.java:335-448;
+            # concurrency.adjuster.interval.ms :213-225); gated per movement
+            # type (concurrency.adjuster.inter.broker.replica.enabled)
+            if (self._cfg.adjuster_enabled and self._cfg.adjuster_replica_enabled
+                    and self._adjuster_due()):
                 self._cfg.per_broker_cap = self._adjuster.recommend_replica_concurrency(
                     self._cfg.per_broker_cap, self._backend.broker_metrics())
             self._alert_slow_tasks(in_flight)
             if not self._stop_requested:
                 batch = planner.next_inter_broker_tasks(
                     in_flight_by_broker, self._cfg.per_broker_cap,
-                    self._cfg.cluster_cap, len(in_flight))
+                    min(self._cfg.cluster_cap, self._cfg.total_movement_cap),
+                    len(in_flight))
                 assignments = {}
                 for t in batch:
                     target = [b for b, _ in t.proposal.new_replicas]
@@ -586,16 +698,26 @@ class Executor:
             out[(topic, part, b)] = logdirs[idx] if idx < len(logdirs) else logdirs[0]
         return out
 
+    def _adjuster_due(self) -> bool:
+        now = self._clock.now_ms()
+        if now - self._last_adjust_ms >= self._cfg.adjuster_interval_ms:
+            self._last_adjust_ms = now
+            return True
+        return False
+
     def _leadership_phase(self, planner: ExecutionTaskPlanner) -> None:
         self._state = ExecutorState.LEADER_MOVEMENT
         while True:
             if self._stop_requested:
                 return
-            if self._cfg.adjuster_enabled and self._cfg.adjuster_leadership_enabled:
+            if (self._cfg.adjuster_enabled
+                    and self._cfg.adjuster_leadership_enabled
+                    and self._adjuster_due()):
                 self._cfg.leadership_cap = \
                     self._adjuster.recommend_leadership_concurrency(
                         self._cfg.leadership_cap, self._backend.broker_metrics())
-            batch = planner.next_leadership_tasks(self._cfg.leadership_cap)
+            batch = planner.next_leadership_tasks(
+                min(self._cfg.leadership_cap, self._cfg.total_movement_cap))
             if not batch:
                 return
             elections = {}
@@ -605,11 +727,37 @@ class Executor:
                 info = partitions.get(t.tp)
                 if info is not None and t.proposal.new_leader in info.replicas:
                     elections[t.tp] = t.proposal.new_leader
-                    t.transition(TaskState.COMPLETED, self._clock.now_ms())
                 else:
                     t.transition(TaskState.DEAD, self._clock.now_ms())
             if elections:
                 self._backend.elect_leaders(elections)
+                self._await_leadership(elections, planner, batch)
+
+    def _await_leadership(self, elections: dict, planner, batch: list) -> None:
+        """Wait for submitted elections to take effect, up to
+        leader.movement.timeout.ms per batch (ExecutorConfig.java:139-141);
+        a task whose election hasn't landed by then is marked DEAD, like the
+        reference abandoning a leadership task that exceeds the timeout."""
+        pending = {t.tp: t for t in batch if t.tp in elections}
+        deadline = self._clock.now_ms() + self._cfg.leader_movement_timeout_ms
+        while pending:
+            partitions = self._backend.partitions()
+            landed = [tp for tp, t in pending.items()
+                      if getattr(partitions.get(tp), "leader", None)
+                      == t.proposal.new_leader]
+            for tp in landed:
+                pending.pop(tp).transition(TaskState.COMPLETED,
+                                           self._clock.now_ms())
+            if not pending:
+                return
+            if self._clock.now_ms() >= deadline or self._stop_requested:
+                for t in pending.values():
+                    t.transition(TaskState.DEAD, self._clock.now_ms())
+                    LOG.warning("leadership movement timed out for %s", t.tp)
+                return
+            self._clock.sleep_ms(min(
+                self._cfg.progress_check_interval_ms,
+                max(deadline - self._clock.now_ms(), 1.0)))
 
     # ---------------------------------------------------------------- state
     def state_json(self) -> dict:

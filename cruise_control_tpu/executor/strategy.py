@@ -89,10 +89,34 @@ STRATEGY_CLASSES = {c.name: c for c in (
     PrioritizeMinIsrWithOfflineReplicasStrategy)}
 
 
-def build_strategy(names: Iterable[str]) -> ReplicaMovementStrategy:
+def strategy_registry(specs: Iterable[str]) -> dict:
+    """Resolve ExecutorConfig ``replica.movement.strategies`` — the catalog
+    of available strategy classes (built-ins by bare name, plugins by dotted
+    path) — into a name -> class map including every built-in."""
+    from cruise_control_tpu.config.configdef import resolve_class
+    registry = dict(STRATEGY_CLASSES)
+    for spec in specs or ():
+        if isinstance(spec, str) and spec in registry:
+            continue
+        cls = resolve_class(spec)
+        registry[getattr(cls, "name", cls.__name__)] = cls
+    return registry
+
+
+def build_strategy(names: Iterable[str],
+                   registry: dict | None = None) -> ReplicaMovementStrategy:
     """Compose a chain, always terminated by the base strategy for determinism
-    (BaseReplicaMovementStrategy is the reference's implicit tie-breaker)."""
-    chain = [STRATEGY_CLASSES[n]() for n in names if n in STRATEGY_CLASSES]
+    (BaseReplicaMovementStrategy is the reference's implicit tie-breaker).
+    Unknown names raise — a typo'd strategy silently ignored would reorder an
+    entire execution."""
+    registry = registry or STRATEGY_CLASSES
+    chain = []
+    for n in names:
+        short = n.rsplit(".", 1)[-1] if isinstance(n, str) else n
+        if short not in registry:
+            raise ValueError(f"unknown replica movement strategy {n!r}; "
+                             f"available: {sorted(registry)}")
+        chain.append(registry[short]())
     if not any(isinstance(s, BaseReplicaMovementStrategy) for s in chain):
         chain.append(BaseReplicaMovementStrategy())
     return ChainedStrategy(chain)

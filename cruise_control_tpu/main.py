@@ -183,11 +183,15 @@ def build_ssl_context(config):
                          "(webserver.ssl.keystore.location)")
     key = config.get_string("webserver.ssl.key.location") or None
     password = config.get_string("webserver.ssl.key.password") or None
-    ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-    ssl_ctx.load_cert_chain(cert, keyfile=key, password=password)
+    # read the full webserver.ssl.* family BEFORE touching the filesystem:
+    # a bad protocol/cipher config should fail fast, not after cert IO
     proto = config.get_string("webserver.ssl.protocol")
     include = set(config.get("webserver.ssl.include.protocols") or [])
     exclude = set(config.get("webserver.ssl.exclude.protocols") or [])
+    ciphers = config.get("webserver.ssl.include.ciphers")
+    exclude_ciphers = set(config.get("webserver.ssl.exclude.ciphers") or [])
+    ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ssl_ctx.load_cert_chain(cert, keyfile=key, password=password)
     allowed = include or {"TLSv1.2", "TLSv1.3"}
     allowed -= exclude
     if proto == "TLSv1.3":
@@ -203,8 +207,6 @@ def build_ssl_context(config):
     ssl_ctx.maximum_version = (ssl.TLSVersion.TLSv1_2
                                if "TLSv1.3" not in allowed
                                else ssl.TLSVersion.TLSv1_3)
-    ciphers = config.get("webserver.ssl.include.ciphers")
-    exclude_ciphers = set(config.get("webserver.ssl.exclude.ciphers") or [])
     if ciphers:
         ssl_ctx.set_ciphers(":".join(c for c in ciphers
                                      if c not in exclude_ciphers))
@@ -249,6 +251,13 @@ class SamplingLoop:
             self._thread.join(timeout=30.0)
 
 
+def build_sampling_loop(cc, config) -> SamplingLoop:
+    """The sampling schedule main() starts (metric.sampling.interval.ms)."""
+    return SamplingLoop(cc.load_monitor,
+                        config.get_int("metric.sampling.interval.ms"),
+                        backend=cc.backend)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="cruise-control-tpu",
@@ -272,11 +281,10 @@ def main(argv=None) -> int:
             seed_backend_from_spec(cc.backend, json.load(f))
 
     # startUp order mirrors KafkaCruiseControl.startUp (:201-207): monitor
-    # replay, sampling schedule, anomaly detection, then the web server
-    cc.start_up()
-    sampling = SamplingLoop(cc.load_monitor,
-                            config.get_int("metric.sampling.interval.ms"),
-                            backend=cc.backend)
+    # replay, sampling schedule, proposal precompute, anomaly detection,
+    # then the web server (KafkaCruiseControl.java:201-207 start order)
+    cc.start_up(proposal_precompute=True)
+    sampling = build_sampling_loop(cc, config)
     sampling.start()
     if not args.no_detection:
         cc.anomaly_detector.start_detection(

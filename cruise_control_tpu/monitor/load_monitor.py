@@ -127,6 +127,10 @@ class LoadMonitor:
                             else CpuModelParams())
         self._state = LoadMonitorState.NOT_STARTED
         self._pause_reason = None
+        self._state_update_interval_ms = (
+            config.get_int("monitor.state.update.interval.ms")
+            if config else 30_000)
+        self._state_json_cache = None   # (payload, generation-key, monotonic-ts)
         self._lock = threading.Lock()
         self._model_semaphore = threading.Semaphore(2)  # LoadMonitor.java:92 cluster-model gate
         self.lr_cpu_model = LinearRegressionCpuModel(
@@ -546,6 +550,23 @@ class LoadMonitor:
 
     # ---------------------------------------------------------------- state
     def state_json(self) -> dict:
+        """Monitor state, recomputed at most every
+        monitor.state.update.interval.ms (MonitorConfig.java:346-347 — the
+        reference refreshes its state sensors on that schedule; aggregation
+        over every entity is not free at 1M replicas) and invalidated by any
+        load-generation bump."""
+        import time as _time
+        now = _time.monotonic()
+        cached = self._state_json_cache
+        gen = (self._partition_agg.generation, self._state, self._pause_reason)
+        if (cached is not None and cached[1] == gen
+                and now - cached[2] < self._state_update_interval_ms / 1000.0):
+            return dict(cached[0])
+        out = self._state_json()
+        self._state_json_cache = (out, gen, now)
+        return dict(out)
+
+    def _state_json(self) -> dict:
         agg = self._partition_agg.aggregate()
         out = {
             "state": self._state,

@@ -584,3 +584,133 @@ def test_custom_partition_assignor_class_used():
     mgr = MetricFetcherManager(Sampler(), num_fetchers=2, assignor=a)
     mgr.fetch_once(0.0, [("t", 0), ("t", 1)])
     assert a.calls == 1
+
+
+# ------------------------------------------------------- full consumption
+def test_every_canonical_key_is_consumed(tmp_path):
+    """Anti-dead-key guard (the reference consumes every key it defines via
+    getConfiguredInstance/getLong/...): instrument Config reads, drive the
+    whole stack — app wiring, detectors + a self-healing fix, proposals
+    cache/precompute, server + every security provider, SSL context, the
+    pluggable samplers/notifiers, the RPC backend seam — and assert every
+    canonical key was READ somewhere. A key that only exists in defaults.py
+    fails this test."""
+    from cruise_control_tpu.config import configdef
+    from cruise_control_tpu.main import (
+        build_app, build_sampling_loop, build_server, build_ssl_context,
+    )
+
+    tracker = set()
+    configdef.READ_TRACKER = tracker
+    tmp = str(tmp_path)
+    try:
+        cfg = cruise_control_config({
+            "webserver.http.port": 0,
+            "webserver.accesslog.enabled": True,
+            "webserver.accesslog.path": f"{tmp}/access.log",
+            "webserver.http.cors.enabled": True,
+            "webserver.ui.diskpath": tmp,
+            "self.healing.enabled": True,
+            "sample.store.path": tmp,
+            "maintenance.event.topic.path": f"{tmp}/maint.jsonl",
+            "two.step.verification.enabled": True,
+            "broker.failure.alert.threshold.ms": 0,
+            "broker.failure.self.healing.threshold.ms": 0,
+            "num.metrics.windows": 2,
+            "min.samples.per.metrics.window": 1,
+            # short goal chains: this test proves KEY READS, not
+            # optimization quality — the full 16-goal chain would compile
+            # for minutes on the CPU test platform
+            "goals": ["RackAwareGoal", "ReplicaDistributionGoal"],
+            "hard.goals": ["RackAwareGoal"],
+            "default.goals": ["ReplicaDistributionGoal"],
+            "anomaly.detection.goals": ["ReplicaDistributionGoal"],
+            "self.healing.goals": ["ReplicaDistributionGoal"],
+            "intra.broker.goals": ["IntraBrokerDiskCapacityGoal"],
+            "topic.anomaly.finder.class": [
+                "cruise_control_tpu.detector.topic_anomaly."
+                "TopicReplicationFactorAnomalyFinder",
+                "cruise_control_tpu.detector.topic_anomaly."
+                "PartitionSizeAnomalyFinder"],
+        })
+        cc = build_app(cfg)
+        be = cc.backend
+        for b in range(4):
+            be.add_broker(b, f"r{b % 2}")
+        for p in range(8):
+            be.create_partition("t", p, [p % 4, (p + 1) % 4], size_mb=10.0)
+        cc.start_up()
+        build_sampling_loop(cc, cfg)
+        cc.load_monitor.sample_once(now_ms=0.0)
+        cc.load_monitor.sample_once(now_ms=300000.0)
+        # self-healing fix path reads the healing-goal + exclusion keys
+        be.kill_broker(3)
+        cc.anomaly_detector.run_detection_round(be.now_ms + 1.0)
+        cc.anomaly_detector.handle_anomalies(be.now_ms + 2.0)
+        cc.cached_proposals()
+        cc.start_proposal_precompute()
+        cc.partition_load(limit=3)
+        try:
+            cc.rebalance(rebalance_disk=True, dry_run=True)
+        except Exception:
+            pass
+        _srv = build_server(cc, cfg); _srv.start(); _srv.stop()
+        cc.shutdown()
+
+        # each security provider reads its own key family
+        cred = tmp_path / "cred"
+        cred.write_text("u: p, ADMIN\n")
+        for sec in (
+            {"webserver.security.provider": "BASIC"},
+            {"webserver.security.provider": "JWT",
+             "jwt.secret.file": str(cred)},
+            {"webserver.security.provider": "SPNEGO",
+             "spnego.principal.secret.file": str(cred)},
+            {"webserver.security.provider": "TRUSTED_PROXY",
+             "trusted.proxy.services": "nuage",
+             "spnego.principal.secret.file": str(cred)},
+        ):
+            c2 = cruise_control_config({
+                "webserver.http.port": 0,
+                "webserver.security.enable": True,
+                "webserver.auth.credentials.file": str(cred), **sec})
+            _s2 = build_server(cc, c2); _s2.start(); _s2.stop()
+        # SSL family: reads all webserver.ssl.* before the (failing) cert IO
+        with pytest.raises(Exception):
+            build_ssl_context(cruise_control_config({
+                "webserver.ssl.enable": True,
+                "webserver.ssl.cert.location": str(cred),
+                "webserver.ssl.key.location": str(cred),
+                "webserver.ssl.key.password": "x"}))
+        # pluggable samplers
+        cruise_control_config({
+            "metric.sampler.class": "cruise_control_tpu.monitor.sampling."
+                                    "prometheus.PrometheusMetricSampler",
+            "prometheus.server.endpoint": "localhost:9090",
+        }).get_configured_instance("metric.sampler.class")
+        cruise_control_config({
+            "metric.sampler.class":
+                "cruise_control_tpu.monitor.sampling.reporter_sampler."
+                "CruiseControlMetricsReporterSampler",
+            "metrics.reporter.topic.path": f"{tmp}/metrics.jsonl",
+        }).get_configured_instance("metric.sampler.class")
+        # webhook notifier families
+        for cls in ("SlackSelfHealingNotifier", "AlertaSelfHealingNotifier"):
+            cruise_control_config({
+                "anomaly.notifier.class":
+                    f"cruise_control_tpu.detector.notifier.{cls}",
+            }).get_configured_instance("anomaly.notifier.class")
+        # RPC client timeout keys (configure() without spawning a sidecar)
+        from cruise_control_tpu.backend.rpc import RpcClusterBackend
+        rb = RpcClusterBackend.__new__(RpcClusterBackend)
+        rb.configure(cruise_control_config())
+        # wire-provider seam (build_app's RPC branch)
+        cruise_control_config().get_configured_instance(
+            "network.client.provider.class")
+    finally:
+        configdef.READ_TRACKER = None
+
+    keys = CRUISE_CONTROL_CONFIG_DEF.keys()
+    canonical = {n for n, k in keys.items() if k.alias_of is None}
+    unread = sorted(canonical - tracker)
+    assert not unread, f"{len(unread)} canonical keys defined but never read: {unread}"

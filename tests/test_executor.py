@@ -206,3 +206,97 @@ def test_concurrency_adjuster_in_execution():
     before = ex._cfg.per_broker_cap
     ex.execute_proposals([_move("t", 2, [2, 0], [1, 0], old_leader=2, new_leader=1)])
     assert ex._cfg.per_broker_cap > before
+
+
+def test_per_topic_throttled_replica_lists_set_and_cleaned():
+    """ReplicationThrottleHelper.java:28-46,159,200 parity: during an
+    execution the moved topics carry leader/follower throttled-replica lists
+    ("partition:broker"); after the execution (and on stop) they are gone."""
+    be = _backend()
+    seen = {}
+
+    # observe configs mid-execution: hook the reassignment call, which the
+    # executor makes after setting throttles
+    orig = be.alter_partition_reassignments
+
+    def spy(assignments):
+        seen.update(be.topic_configs())
+        orig(assignments)
+
+    be.alter_partition_reassignments = spy
+    ex = Executor(be)
+    ex._cfg.throttle_bytes_per_sec = 50_000_000
+    ex.execute_proposals([_move("t", 2, [2, 0], [3, 0])])
+    # mid-execution: source brokers on the leader list, destination on the
+    # follower list
+    assert seen["t"]["leader.replication.throttled.replicas"] == "2:0,2:2"
+    assert seen["t"]["follower.replication.throttled.replicas"] == "2:3"
+    # cleaned up afterwards (rate AND per-topic lists)
+    assert be.replication_throttle() is None
+    assert "t" not in be.topic_configs()
+
+
+def test_per_topic_throttle_cleanup_after_force_stop():
+    be = _backend()
+    ex = Executor(be)
+    ex._cfg.throttle_bytes_per_sec = 1  # so slow the move can't finish
+    ex.execute_proposals([_move("t", 1, [1, 2], [3, 2])], blocking=False)
+    import time
+    for _ in range(100):
+        if be.topic_configs().get("t"):
+            break
+        time.sleep(0.05)
+    ex.stop_execution(force=True)
+    ex.wait_for_completion()
+    assert be.replication_throttle() is None
+    assert "t" not in be.topic_configs()
+
+
+def test_strategy_chain_from_config():
+    """default.replica.movement.strategies drives execution order;
+    replica.movement.strategies registers the available catalog
+    (ExecutionTaskPlanner.java:65-78)."""
+    from cruise_control_tpu.config import cruise_control_config
+    cfg = cruise_control_config({
+        "default.replica.movement.strategies":
+            ["PrioritizeSmallReplicaMovementStrategy"]})
+    be = _backend()
+    ex = Executor(be, config=cfg)
+    assert "PrioritizeSmallReplicaMovementStrategy" in ex._strategy.name
+
+    # request-level override validates against the catalog
+    with pytest.raises(ValueError):
+        ex.validate_strategies(["NoSuchStrategy"])
+    ex.validate_strategies(["PrioritizeLargeReplicaMovementStrategy"])
+
+
+def test_removal_history_retention_expires():
+    from cruise_control_tpu.config import cruise_control_config
+    cfg = cruise_control_config({"removal.history.retention.time.ms": 1000,
+                                 "demotion.history.retention.time.ms": 2000})
+    be = _backend()
+    ex = Executor(be, config=cfg)
+    ex.note_removed_brokers([1])
+    ex.note_demoted_brokers([2])
+    assert ex.recently_removed_brokers() == {1}
+    assert ex.recently_demoted_brokers() == {2}
+    be.advance(1500.0)
+    assert ex.recently_removed_brokers() == set()   # past removal retention
+    assert ex.recently_demoted_brokers() == {2}     # demotion retains longer
+    be.advance(1000.0)
+    assert ex.recently_demoted_brokers() == set()
+
+
+def test_leadership_timeout_marks_dead():
+    """leader.movement.timeout.ms: an election the cluster never applies is
+    abandoned as DEAD instead of hanging the leadership phase."""
+    from cruise_control_tpu.config import cruise_control_config
+    cfg = cruise_control_config({"leader.movement.timeout.ms": 5000})
+    be = _backend()
+    be.elect_leaders = lambda elections: None   # cluster ignores elections
+    ex = Executor(be, config=cfg)
+    ex.execute_proposals([_move("t", 2, [2, 0], [2, 0], old_leader=2,
+                                new_leader=0)])
+    lead = [t for t in ex._current_planner.all_tasks
+            if t.task_type is TaskType.LEADER_ACTION]
+    assert [t.state for t in lead] == [TaskState.DEAD]
