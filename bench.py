@@ -166,7 +166,19 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
         "violations_before": len(res.violated_goals_before),
         "violations_after": len(res.violated_goals_after),
         "violated_goals_after": res.violated_goals_after,
+        # budget exits that the finisher could NOT certify as fixpoints
         "budget_exhausted": [g.name for g in res.goal_results if g.hit_max_iters],
+        # violated survivors WITH a machine-checked single-action fixpoint
+        # certificate (zero accepted positive-gain moves/transfers + empty
+        # bounded swap window at the final state; engine._finisher)
+        "fixpoint_proven": [g.name for g in res.goal_results
+                            if g.violated_after and g.fixpoint_proven],
+        "actions_remaining": {
+            g.name: {"moves": g.moves_remaining, "leads": g.leads_remaining,
+                     "swap_window": g.swap_window_remaining}
+            for g in res.goal_results
+            if g.violated_after and not g.fixpoint_proven
+            and g.moves_remaining >= 0},
         "num_replica_movements": res.num_replica_movements,
         "num_leadership_movements": res.num_leadership_movements,
     }

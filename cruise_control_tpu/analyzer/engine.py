@@ -49,7 +49,7 @@ from cruise_control_tpu.analyzer.goals.base import (
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.analyzer.state import (
     EngineState, apply_disk_move, apply_leadership, apply_leaderships_batched,
-    apply_move, apply_moves_batched, apply_swap, apply_swaps_batched,
+    apply_moves_batched, apply_swaps_batched,
 )
 
 Array = jax.Array
@@ -102,12 +102,6 @@ class EngineParams:
     num_swap_candidates: int = 32     # K1/K2: swap-out / swap-in candidates
     num_dst_choices: int = 16         # T: per-row destination spread (wave width)
     min_gain: float = 1e-9            # scores below this count as no progress
-    # sequential fallback loops are OFF by default: waves + the next pass's
-    # full re-score converge faster than one-at-a-time re-validation (rung-3
-    # A/B: leftovers-off was 36% faster AND satisfied one more goal), and a
-    # zero cap removes the loop from the compiled program entirely
-    max_leftover: int = 0             # cap on sequential leftover re-scores
-    max_seq_swaps: int = 0            # cap on sequential swap applications
     # a zero-action pass does NOT terminate the goal immediately: the ranked
     # top-K window may simply contain no applicable candidate while
     # thousands exist outside it (measured: 20k+ applicable accepted moves
@@ -124,6 +118,13 @@ class EngineParams:
     # to the max_iters cap for a fraction-of-a-percent stat gain.
     tail_pass_budget: int = 64    # 64 vs 192 measured identical violation
     #                               counts at rung 4 for 14s less wall
+    # once the loop enters the tail regime (any dribble/stall recorded),
+    # EVERY subsequent pass counts against this total — salted exploration
+    # keeps landing actions (so stall/dribble counters reset) and would
+    # otherwise run to max_iters; this bounds the whole tail at a wall cost
+    # of ~tail_total_budget x 12 ms, with the finisher certifying whatever
+    # remains
+    tail_total_budget: int = 192
     # once the goal's own violation measure reads SATISFIED on a dribbling
     # pass, the remaining stall/dribble exploration buys nothing the
     # violation count can see — clamp both budgets. Full budgets stay in
@@ -140,6 +141,29 @@ class EngineParams:
     # genuinely-progressing tails keep their full budget.
     stat_window: int = 24
     stat_slope_min: float = 1e-3
+    # FINISHER: after the budgeted loop exits, up to finisher_rounds
+    # exhaustive rounds run — an EXHAUSTIVE scan of every (replica ->
+    # best destination) move and every (leader -> follower) transfer
+    # (chunked [scan_chunk, B] sweeps, not top-K windows), followed by a
+    # wave of the finisher_candidates highest TRUE-gain actions. The loop
+    # ends when the scan proves ZERO accepted positive-gain moves and
+    # transfers remain — a machine-checked single-action fixpoint
+    # certificate (the reference's convergence contract,
+    # AbstractGoal.java:110-119, modulo its own time-bounded swap search) —
+    # or at the round cap. This replaces deep dribble tails: the budgeted
+    # loop's top-K windows can miss the last scattered positive actions for
+    # dozens of passes; the scan lands exactly them.
+    finisher_rounds: int = 12
+    finisher_candidates: int = 1760   # wave width; the bisect-proven TPU cap
+    finisher_waves: int = 6           # rank-banded waves per exhaustive scan:
+    #                                   wave w takes true-gain ranks
+    #                                   [w*K, (w+1)*K) — selection goes stale
+    #                                   within a round but every wave
+    #                                   re-scores its candidates against the
+    #                                   live state, so applications stay
+    #                                   exact; this amortizes the ~0.65 s
+    #                                   scan over up to W waves of work
+    scan_chunk: int = 1024            # rows per exhaustive-scan sweep
 
 
 def _wave_budget_capable(g: GoalKernel, leadership: bool = False) -> bool:
@@ -267,21 +291,10 @@ def _group_cumsum(groups: Array, d: Array):
     return cum, rank
 
 
-def _rescore_move_row(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-                      prev_goals: tuple, r: Array) -> Array:
-    """f32[B]: the candidate replica's move score against the CURRENT state —
-    full legitimacy + self-satisfaction + prev-goal acceptance, one row."""
-    c1 = r[None]
-    m1 = legit_move_mask(env, st, c1, goal.options)
-    for g in prev_goals:
-        m1 = m1 & g.accept_move(env, st, c1)
-    s1 = goal.move_score(env, st, c1)
-    return jnp.where(m1, s1, NEG_INF)[0]
-
-
 def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                          prev_goals: tuple, params: EngineParams,
-                         severity: Array, stall: Array):
+                         severity: Array, stall: Array,
+                         cand: Array | None = None, kv: Array | None = None):
     """Score once, wave-apply the independent winners, re-score leftovers.
 
     A pass is three stages:
@@ -307,18 +320,23 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
          once, in one role.
        Winners all apply in ONE batched scatter update
        (`apply_moves_batched`); first-use/budget checks are scatter-mins and
-       segment cumsums, not scans.
-    3. LEFTOVERS (sequential, dynamically bounded): positively-scored
-       non-winners are re-validated one at a time against the running state
-       (`_rescore_move_row`) — the path that matters when severity is
-       concentrated on few brokers and waves are thin.
+       segment cumsums, not scans. Positive non-winners are simply retried
+       by the next pass's full re-score (sequential leftover re-validation
+       was measured slower AND lower-quality; the finisher catches tails).
 
     Compared to one-move-per-pass, a pass lands up to K moves for little
     more than one scoring sweep (reference hot loop it replaces:
-    ResourceDistributionGoal.java:384-862)."""
-    key = _stall_explore(goal.replica_key(env, st, severity), stall)
-    kv, cand = _top_candidates(key, min(params.num_candidates, env.num_replicas),
-                               exact=goal.is_hard)
+    ResourceDistributionGoal.java:384-862).
+
+    ``cand``/``kv`` override the heuristic-key candidate selection — the
+    finisher passes the top TRUE-gain replicas from an exhaustive scan and
+    reuses this whole wave stage (re-score, destination spread, budgeted
+    admission) unchanged."""
+    if cand is None:
+        key = _stall_explore(goal.replica_key(env, st, severity), stall)
+        kv, cand = _top_candidates(key,
+                                   min(params.num_candidates, env.num_replicas),
+                                   exact=goal.is_hard)
     mask = legit_move_mask(env, st, cand, goal.options)
     for g in prev_goals:
         mask = mask & g.accept_move(env, st, cand)
@@ -327,7 +345,6 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     best_val = jnp.max(score, axis=1)                               # [K]
     order = jnp.argsort(-best_val)                                  # best first
     K = score.shape[0]
-    n_pos = jnp.sum(best_val > params.min_gain).astype(jnp.int32)
 
     # ---- stage 2: independent-wave selection in score order ----
     r_sorted = cand[order]                                          # [K]
@@ -384,51 +401,32 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                & (first_broker[dst_s] == posn) & part_ok)
     st = apply_moves_batched(env, st, r_sorted, dst_s, win)
     n_applied = jnp.sum(win).astype(jnp.int32)
-
-    # ---- stage 3 (opt-in): sequential leftovers, re-scored against the live
-    # state. Only when the wave was THIN (severity concentrated on few
-    # brokers): a fat wave means the next pass re-scores everything anyway.
-    # OFF by default (max_leftover=0): measured slower AND lower-quality than
-    # letting the next pass retry, and omitting the loop shrinks the program.
-    cap = min(K, params.max_leftover)
-    if cap > 0:
-        pos_ok = best_val[order] > params.min_gain
-        leftover = pos_ok & ~win
-        n_lo = jnp.sum(leftover).astype(jnp.int32)
-        lo_order = jnp.argsort(~leftover)        # leftover positions first
-
-        def body(i, carry):
-            st, n = carry
-            r = r_sorted[lo_order[i]]
-            row = _rescore_move_row(env, st, goal, prev_goals, r)
-            d = jnp.argmax(row).astype(jnp.int32)
-            ok = row[d] > params.min_gain
-            st = apply_move(env, st, r, d, enabled=ok)
-            return st, n + ok.astype(jnp.int32)
-
-        # gate via a zero trip count, NOT lax.cond: a cond carrying the full
-        # EngineState defeats XLA's buffer aliasing and copies ~hundreds of
-        # MB per pass at 1M-replica scale; a 0-trip while-loop aliases
-        wave_thin = n_applied * 8 < n_pos
-        trip = jnp.where(wave_thin, jnp.minimum(n_lo, cap), 0)
-        st, n_applied = jax.lax.fori_loop(0, trip, body, (st, n_applied))
+    # non-winning positive rows are retried by the next pass's full
+    # re-score (sequential leftover re-validation was measured slower AND
+    # lower-quality at rung 3, and the finisher phase now catches the tail)
     return st, n_applied
 
 
 def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                                prev_goals: tuple, params: EngineParams,
-                               severity: Array, stall: Array):
+                               severity: Array, stall: Array,
+                               cand: Array | None = None,
+                               kv: Array | None = None):
     """Leadership analogue of _move_branch_batched: one [KL, F] scoring pass,
     then budgeted wave admission (each candidate is a distinct partition's
     leader, so rows never conflict on partition state; per-broker cumulative
     deltas — util shift, leader count, leader bytes-in — stay within the
     combined band slack), one batched apply, sequential re-scored leftovers
     when the wave was thin. Falls back to fully sequential application for
-    chains with non-budget-capable goals."""
-    lkey = _stall_explore(goal.leader_key(env, st, severity), stall)
-    lkv, lcand = _top_candidates(lkey, min(params.num_leader_candidates,
-                                           env.num_replicas),
-                                 exact=goal.is_hard)
+    chains with non-budget-capable goals. ``cand``/``kv`` override candidate
+    selection (see _move_branch_batched)."""
+    if cand is None:
+        lkey = _stall_explore(goal.leader_key(env, st, severity), stall)
+        lkv, lcand = _top_candidates(lkey, min(params.num_leader_candidates,
+                                               env.num_replicas),
+                                     exact=goal.is_hard)
+    else:
+        lkv, lcand = kv, cand
     lmask = legit_leadership_mask(env, st, lcand)
     for g in prev_goals:
         lmask = lmask & g.accept_leadership(env, st, lcand)
@@ -492,31 +490,7 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
                           d_leader=jnp.ones(KL, st.util.dtype))
     st = apply_leaderships_batched(env, st, r_sorted, dst_rep, win)
     n_applied = jnp.sum(win).astype(jnp.int32)
-
-    # sequential leftovers when the wave was thin (same rationale as the
-    # move branch); OFF by default, see EngineParams.max_leftover
-    cap = min(KL, params.max_leftover)
-    if cap > 0:
-        n_pos = jnp.sum(wave_ok).astype(jnp.int32)
-        leftover = wave_ok & ~win
-        n_lo = jnp.sum(leftover).astype(jnp.int32)
-        lo_order = jnp.argsort(~leftover)
-        wave_thin = n_applied * 8 < n_pos
-        trip = jnp.where(wave_thin, jnp.minimum(n_lo, cap), 0)
-        st, n_applied, _ = jax.lax.fori_loop(
-            0, trip, seq_body, (st, n_applied, r_sorted[lo_order]))
     return st, n_applied
-
-
-def _rescore_swap_pair(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-                       prev_goals: tuple, r_out: Array, r_in: Array) -> Array:
-    """f32 scalar: the swap's score against the CURRENT state."""
-    co, ci = r_out[None], r_in[None]
-    m = legit_swap_mask(env, st, co, ci)
-    for g in prev_goals:
-        m = m & g.accept_swap(env, st, co, ci)
-    s = goal.swap_score(env, st, co, ci)
-    return jnp.where(m, s, NEG_INF)[0, 0]
 
 
 def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
@@ -591,27 +565,6 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     if "swap_apply" not in _DEBUG_DISABLE:
         st = apply_swaps_batched(env, st, r_out, r_in, win)
     n_applied = jnp.sum(win).astype(jnp.int32)
-
-    if min(K1, params.max_seq_swaps) > 0:
-        # sequential leftovers (exact pair re-score) when the wave was thin
-        n_pos = jnp.sum(wave_ok).astype(jnp.int32)
-        leftover = wave_ok & ~win
-        n_lo = jnp.sum(leftover).astype(jnp.int32)
-        lo_order = jnp.argsort(~leftover)
-
-        def body(i, carry):
-            st, n = carry
-            idx = lo_order[i]
-            ro, ri = r_out[idx], r_in[idx]
-            v = _rescore_swap_pair(env, st, goal, prev_goals, ro, ri)
-            ok = v > params.min_gain
-            st = apply_swap(env, st, ro, ri, enabled=ok)
-            return st, n + ok.astype(jnp.int32)
-
-        wave_thin = n_applied * 8 < n_pos
-        cap = min(K1, params.max_seq_swaps)
-        trip = jnp.where(wave_thin, jnp.minimum(n_lo, cap), 0)
-        st, n_applied = jax.lax.fori_loop(0, trip, body, (st, n_applied))
     return st, n_applied
 
 
@@ -660,6 +613,223 @@ def _disk_move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel
     return st, n_applied
 
 
+def _exhaustive_move_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                          prev_goals: tuple, chunk: int):
+    """(gain f32[Rp], dst i32[Rp]) — every replica's best single-move gain
+    over ALL destinations under full legitimacy + chain acceptance (NEG_INF
+    where none exists). Unlike the budgeted passes' top-K windows this scan
+    is EXHAUSTIVE: zero positives here is a machine-checked certificate that
+    no accepted positive-gain inter-broker move exists at this state.
+    Chunked [chunk, B] sweeps (one fori_loop, ~0.6 s at 1M x 7k)."""
+    R = env.num_replicas
+    chunk = min(chunk, R)
+    n_chunks = -(-R // chunk)
+    # the goal's move_score contract only covers its OWN candidate-eligible
+    # replicas (replica_key > -inf) — e.g. the leader-count goal scores
+    # assuming the candidate IS a leader; scoring outside the eligible set
+    # would produce (and the finisher would APPLY) bogus actions
+    eligible = goal.replica_key(env, st, goal.broker_severity(env, st)) > NEG_INF
+
+    def body(i, carry):
+        gain, dst = carry
+        base = i * chunk
+        idx = base + jnp.arange(chunk, dtype=jnp.int32)
+        cand = jnp.minimum(idx, R - 1)
+        mask = legit_move_mask(env, st, cand, goal.options)
+        mask = mask & eligible[cand][:, None]
+        for g in prev_goals:
+            mask = mask & g.accept_move(env, st, cand)
+        score = jnp.where(mask, goal.move_score(env, st, cand), NEG_INF)
+        d = jnp.argmax(score, axis=1).astype(jnp.int32)
+        v = score[jnp.arange(chunk), d]
+        v = jnp.where(idx < R, v, NEG_INF)   # clamp-duplicated tail rows
+        gain = jax.lax.dynamic_update_slice(gain, v, (base,))
+        dst = jax.lax.dynamic_update_slice(dst, d, (base,))
+        return gain, dst
+
+    gain0 = jnp.full(n_chunks * chunk, NEG_INF, st.util.dtype)
+    dst0 = jnp.zeros(n_chunks * chunk, jnp.int32)
+    return jax.lax.fori_loop(0, n_chunks, body, (gain0, dst0))
+
+
+def _exhaustive_lead_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                          prev_goals: tuple, chunk: int):
+    """(gain f32[Rp], dst_rep i32[Rp]) — every leader's best leadership-
+    transfer gain over ALL its followers (exhaustive analogue of the
+    [KL, F] leadership branch)."""
+    R = env.num_replicas
+    chunk = min(chunk, R)
+    n_chunks = -(-R // chunk)
+    # same eligibility contract as the move scan, via the goal's leader key
+    eligible = goal.leader_key(env, st, goal.broker_severity(env, st)) > NEG_INF
+
+    def body(i, carry):
+        gain, dst = carry
+        base = i * chunk
+        idx = base + jnp.arange(chunk, dtype=jnp.int32)
+        cand = jnp.minimum(idx, R - 1)
+        mask = legit_leadership_mask(env, st, cand)
+        mask = mask & eligible[cand][:, None]
+        for g in prev_goals:
+            mask = mask & g.accept_leadership(env, st, cand)
+        score = jnp.where(mask, goal.leadership_score(env, st, cand), NEG_INF)
+        f = jnp.argmax(score, axis=1).astype(jnp.int32)
+        v = score[jnp.arange(chunk), f]
+        v = jnp.where(idx < R, v, NEG_INF)
+        members = env.partition_replicas[env.replica_partition[cand]]
+        d = jnp.clip(members[jnp.arange(chunk), f], 0)
+        gain = jax.lax.dynamic_update_slice(gain, v, (base,))
+        dst = jax.lax.dynamic_update_slice(dst, d, (base,))
+        return gain, dst
+
+    gain0 = jnp.full(n_chunks * chunk, NEG_INF, st.util.dtype)
+    dst0 = jnp.zeros(n_chunks * chunk, jnp.int32)
+    return jax.lax.fori_loop(0, n_chunks, body, (gain0, dst0))
+
+
+def _swap_window_positives(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                           prev_goals: tuple, params: EngineParams):
+    """i32: accepted positive-gain swaps in the goal's own bounded top-K
+    swap window at this state — the fixpoint certificate's swap clause.
+    Deliberately window-bounded, not exhaustive (R^2 pairs): the reference's
+    own convergence contract bounds its swap search by wall-clock
+    (ResourceDistributionGoal.java:58), so 'the bounded search finds
+    nothing' is the matching claim."""
+    severity = goal.broker_severity(env, st)
+    k = min(params.num_swap_candidates, env.num_replicas, 128)
+    okv, cand_out = _top_candidates(goal.swap_out_key(env, st, severity), k,
+                                    exact=goal.is_hard)
+    ikv, cand_in = _top_candidates(goal.swap_in_key(env, st, severity), k,
+                                   exact=goal.is_hard)
+    mask = legit_swap_mask(env, st, cand_out, cand_in)
+    for g in prev_goals:
+        mask = mask & g.accept_swap(env, st, cand_out, cand_in)
+    score = goal.swap_score(env, st, cand_out, cand_in)
+    score = jnp.where(mask & (okv > NEG_INF)[:, None] & (ikv > NEG_INF)[None, :],
+                      score, NEG_INF)
+    return jnp.sum(score > params.min_gain).astype(jnp.int32)
+
+
+def _finisher_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                   prev_goals: tuple, params: EngineParams,
+                   gain: Array, leadership: bool):
+    """Apply up to finisher_waves rank-banded waves of the highest TRUE-gain
+    candidates from one exhaustive scan, each by delegating to the regular
+    move/leadership branch with the candidate selection overridden: the
+    branch re-scores its candidates [K, B] at the LIVE state and keeps its
+    destination-spread + budgeted admission — a scan's
+    single-best-destination choices would otherwise all collide on the same
+    deficit brokers and starve the wave (measured: 19/1024 admitted).
+    Banding amortizes the ~0.65 s scan over several ~15 ms waves; selection
+    within later bands is stale but every application is re-scored exact.
+    Waves stop once one admits nothing."""
+    K = min(params.finisher_candidates, env.num_replicas)
+    W = max(1, min(params.finisher_waves,
+                   env.num_replicas // max(K, 1)))
+    kv_all, cand_all = jax.lax.top_k(gain[:env.num_replicas], K * W)  # exact
+    severity = goal.broker_severity(env, st)
+    zero_stall = jnp.int32(0)
+    total = jnp.int32(0)
+    go = jnp.bool_(True)
+    for w in range(W):
+        cand = jax.lax.dynamic_slice(cand_all, (w * K,), (K,))
+        kv = jax.lax.dynamic_slice(kv_all, (w * K,), (K,))
+        kv = jnp.where((kv > params.min_gain) & go, kv, NEG_INF)
+
+        def wave_body(_i, carry, cand=cand, kv=kv):
+            s, _n = carry
+            if leadership:
+                return _leadership_branch_batched(
+                    env, s, goal, prev_goals, params, severity, zero_stall,
+                    cand=cand, kv=kv)
+            return _move_branch_batched(env, s, goal, prev_goals, params,
+                                        severity, zero_stall,
+                                        cand=cand, kv=kv)
+
+        # 0/1-trip fori_loop keeps state aliasing (a cond would copy it)
+        st, n = jax.lax.fori_loop(0, jnp.where(go, 1, 0), wave_body,
+                                  (st, jnp.int32(0)))
+        total += n
+        go = go & (n > 0)
+    return st, total
+
+
+def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+              prev_goals: tuple, params: EngineParams, run: Array):
+    """Post-budget exhaustive convergence. While ``run`` (the goal was still
+    violated when its budgeted loop exited) and any scan finds accepted
+    positive-gain actions: wave-apply the top true-gain moves, then
+    transfers. Exits when a round's scans BOTH return zero (nothing was
+    applied that round either, so the certificate holds at the exit state)
+    or at finisher_rounds. Returns
+    (st, proven, moves_left, leads_left, swaps_window_left, rounds,
+    n_applied)."""
+    use_moves = goal.uses_replica_moves
+    use_leads = goal.uses_leadership_moves
+    zero = jnp.int32(0)
+    if params.finisher_rounds <= 0 or not (use_moves or use_leads):
+        return (st, jnp.bool_(False), jnp.int32(-1), jnp.int32(-1),
+                jnp.int32(-1), zero, zero)
+
+    def round_body(carry):
+        st, rounds, prev_m, prev_l, total, _done = carry
+        mleft = zero
+        lleft = zero
+        applied = zero
+        if use_moves:
+            gain, _ = _exhaustive_move_scan(env, st, goal, prev_goals,
+                                            params.scan_chunk)
+            mleft = jnp.sum(gain > params.min_gain).astype(jnp.int32)
+            st, n = _finisher_wave(env, st, goal, prev_goals, params,
+                                   gain, leadership=False)
+            applied += n
+        if use_leads:
+            gain, _ = _exhaustive_lead_scan(env, st, goal, prev_goals,
+                                            params.scan_chunk)
+            lleft = jnp.sum(gain > params.min_gain).astype(jnp.int32)
+            st, n = _finisher_wave(env, st, goal, prev_goals, params,
+                                   gain, leadership=True)
+            applied += n
+        # exits:
+        # - both scans zero => nothing applied this round => the scanned
+        #   state IS the exit state and the certificate holds;
+        # - zero applies with positive scans (admission blocks everything
+        #   the scan found; a repeat round recomputes the identical wave) —
+        #   counts stay positive => NOT proven;
+        # - the goal became SATISFIED (fixed outright — better than proof);
+        # - stagnation: remaining counts shrank < 1/8 since last round —
+        #   convergence at that decay would take more rounds than the cap
+        #   allows, so stop burning ~0.7 s scans on it.
+        done = ((mleft == 0) & (lleft == 0)) | (applied == 0)
+        done = done | ~goal.violated(env, st)
+        done = done | (mleft + lleft > (prev_m + prev_l) * 7 // 8)
+        return st, rounds + 1, mleft, lleft, total + applied, done
+
+    def cond(carry):
+        _st, rounds, _m, _l, _t, done = carry
+        return run & ~done & (rounds < params.finisher_rounds)
+
+    # far above any real count (counts are <= R) so the first round can
+    # never trip the stagnation exit, yet small enough that *7 stays well
+    # inside int32
+    big = jnp.int32(2**27)
+    st, rounds, mleft, lleft, n_applied, done = jax.lax.while_loop(
+        cond, round_body, (st, zero, big, big, zero, jnp.bool_(False)))
+    mleft = jnp.where(run, mleft, -1)   # -1 = finisher did not run
+    lleft = jnp.where(run, lleft, -1)
+    moves_proven = (mleft == 0) | jnp.bool_(not use_moves)
+    leads_proven = (lleft == 0) | jnp.bool_(not use_leads)
+    if goal.uses_swaps:
+        swleft = jnp.where(run, _swap_window_positives(
+            env, st, goal, prev_goals, params), -1)
+        swaps_proven = swleft == 0
+    else:
+        swleft = jnp.int32(-1)
+        swaps_proven = jnp.bool_(True)
+    proven = run & moves_proven & leads_proven & swaps_proven
+    return st, proven, mleft, lleft, swleft, rounds, n_applied
+
+
 def optimize_goal(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                   prev_goals: tuple = (), params: EngineParams = EngineParams(),
                   donate_state: bool = False):
@@ -694,15 +864,36 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
 
 
 def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-               prev_goals: tuple, params: EngineParams):
+               prev_goals: tuple, params: EngineParams,
+               finisher: bool = True):
     """One goal's full optimization loop (traced; shared by the per-goal
-    program and the fused whole-chain program)."""
+    program and the fused prefix-chain program). ``finisher=False`` compiles
+    the loop WITHOUT the exhaustive finisher phase — the fused prefix
+    program uses it (optimizer._compiled_prefix_chain): its goals converge
+    inside their budgets, and many inlined finisher subprograms bloat one
+    program's compile and execution enough to trip the axon runtime's
+    watchdog at the 1M rung. Deep-tail goals run as their own per-goal
+    programs with the finisher inline at their chain position."""
     stat_before = goal.stat(env, st)
 
     def step(carry):
         st, it, n_applied, stall, dribble, _sat, win_stat, win_dribble, \
-            plateau = carry
+            plateau, tailp = carry
         severity = goal.broker_severity(env, st)
+        # every pass inside the tail regime (any stall/dribble recorded)
+        # counts toward tail_total_budget — salted passes reset the
+        # stall/dribble counters by landing actions, so without this the
+        # tail would run to max_iters
+        tailp = tailp + ((stall + dribble) > 0).astype(jnp.int32)
+        # exploration salt: full stalls AND accumulated dribble both re-key
+        # candidate selection. Dribbling passes with a fixed key re-rank the
+        # same starved top-K subset forever while positive actions exist
+        # outside it (measured at rung 4: DiskUsageDistributionGoal exited
+        # its tail budget with 146k accepted positive-gain moves remaining);
+        # salting by the dribble count makes every tail pass explore a fresh
+        # pseudo-random eligible subset, like stall retries always did.
+        explore = (stall if "dribble_salt" in _DEBUG_DISABLE
+                   else stall + dribble)
 
         # 0. intra-broker disk moves (IntraBroker*Goal actions never leave
         #    the broker; only these goals set the flag)
@@ -710,7 +901,7 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         if goal.uses_disk_moves:
             st, n_disk = _disk_move_branch_batched(env, st, goal,
                                                    prev_goals, params,
-                                                   severity, stall)
+                                                   severity, explore)
 
         lead_first = goal.uses_leadership_moves and goal.leadership_primary
 
@@ -721,7 +912,7 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         n_leads = jnp.int32(0)
         if lead_first:
             st, n_leads = _leadership_branch_batched(
-                env, st, goal, prev_goals, params, severity, stall)
+                env, st, goal, prev_goals, params, severity, explore)
 
         # 1b. replica moves (cheapest per unit of work on TPU: one scoring
         #     pass lands up to K moves); for leadership-primary goals they
@@ -735,14 +926,14 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                     s, _n = carry
                     return _move_branch_batched(
                         env, s, goal, prev_goals, params,
-                        goal.broker_severity(env, s), stall)
+                        goal.broker_severity(env, s), explore)
                 st, n_moves = jax.lax.fori_loop(
                     0, jnp.where(n_leads == 0, 1, 0), move_body,
                     (st, jnp.int32(0)))
             else:
                 st, n_moves = _move_branch_batched(env, st, goal,
                                                    prev_goals, params,
-                                                   severity, stall)
+                                                   severity, explore)
 
         # 2. leadership transfers — only when no move landed; same
         #    zero/one trip-count gating
@@ -751,7 +942,7 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                 s, _n = carry
                 return _leadership_branch_batched(
                     env, s, goal, prev_goals, params,
-                    goal.broker_severity(env, s), stall)
+                    goal.broker_severity(env, s), explore)
             st, n_leads = jax.lax.fori_loop(
                 0, jnp.where(n_moves == 0, 1, 0), lead_body,
                 (st, jnp.int32(0)))
@@ -765,7 +956,7 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                 return _swap_branch_batched(env, s, goal, prev_goals,
                                             params,
                                             goal.broker_severity(env, s),
-                                            stall)
+                                            explore)
             st, n_swaps = jax.lax.fori_loop(
                 0, jnp.where((n_moves + n_leads) == 0, 1, 0), swap_body,
                 (st, jnp.int32(0)))
@@ -791,10 +982,10 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         win_stat = jnp.where(roll, stat_now, win_stat)
         win_dribble = jnp.where(roll, dribble, win_dribble)
         return (st, it + 1, n_applied + applied, stall, dribble, sat,
-                win_stat, win_dribble, plateau)
+                win_stat, win_dribble, plateau, tailp)
 
     def cond_fn(carry):
-        _st, it, _n, stall, dribble, sat, _ws, _wd, plateau = carry
+        _st, it, _n, stall, dribble, sat, _ws, _wd, plateau, tailp = carry
         stall_cap = jnp.where(
             sat, min(params.stall_retries, params.sat_stall_retries),
             params.stall_retries)
@@ -803,24 +994,47 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
             params.tail_pass_budget)
         return ((stall <= stall_cap)
                 & (dribble <= tail_cap)
+                & (tailp <= params.tail_total_budget)
                 & (it < params.max_iters)
                 & ~plateau)
 
     (st, iters, n_applied, stall, dribble, _sat, _ws, _wd,
-     _plateau) = jax.lax.while_loop(
+     plateau, tailp) = jax.lax.while_loop(
         cond_fn, step, (st, jnp.int32(0), jnp.int32(0), jnp.int32(0),
                         jnp.int32(0), jnp.bool_(False), jnp.float32(jnp.inf),
-                        jnp.int32(0), jnp.bool_(False)))
+                        jnp.int32(0), jnp.bool_(False), jnp.int32(0)))
+    # FINISHER: a goal still violated at budget exit gets exhaustive-scan
+    # rounds that either converge it to a machine-checked single-action
+    # fixpoint (proven) or land the true best remaining actions trying
+    viol_pre = goal.violated(env, st)
+    if finisher:
+        (st, fin_proven, moves_left, leads_left, swaps_left, fin_rounds,
+         fin_applied) = _finisher(env, st, goal, prev_goals, params, viol_pre)
+    else:
+        fin_proven = jnp.bool_(False)
+        moves_left = leads_left = swaps_left = jnp.int32(-1)
+        fin_rounds = fin_applied = jnp.int32(0)
     violated = goal.violated(env, st)
-    # stopped by the iteration cap OR the dribble tail budget while still
-    # applying actions = budget exhausted, NOT converged — downstream
-    # must not report it as a proven fixpoint
-    hit_max_iters = ((stall <= params.stall_retries)
-                     & ((iters >= params.max_iters)
-                        | (dribble > params.tail_pass_budget)))
-    return st, {"iterations": n_applied, "passes": iters,
+    # stopped by the iteration cap, the dribble tail budget, OR a stat-slope
+    # plateau while still violated and applying actions = budget exhausted,
+    # NOT converged — UNLESS the finisher then proved the exit state is an
+    # action fixpoint. Downstream must not report exhausted-and-unproven
+    # exits as converged.
+    budget_exit = ((iters >= params.max_iters)
+                   | (dribble > params.tail_pass_budget)
+                   | (tailp > params.tail_total_budget)
+                   | plateau)
+    hit_max_iters = ((stall <= params.stall_retries) & budget_exit
+                     & violated & ~fin_proven)
+    return st, {"iterations": n_applied + fin_applied, "passes": iters,
                 "violated_after": violated,
                 "hit_max_iters": hit_max_iters,
+                "plateau_exit": plateau,
+                "fixpoint_proven": fin_proven,
+                "finisher_rounds": fin_rounds,
+                "moves_remaining": moves_left,
+                "leads_remaining": leads_left,
+                "swap_window_remaining": swaps_left,
                 "stat_before": stat_before,
                 "stat": goal.stat(env, st)}
 

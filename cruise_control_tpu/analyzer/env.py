@@ -193,8 +193,9 @@ def make_env(ct: ClusterTensor, meta: ClusterMeta,
            else np.asarray(topic_min_leaders_mask, bool))
     dst_ok = np.asarray(ct.broker_alive) & ~np.asarray(ct.broker_excluded_for_replica_move)
     # new-broker mode is enforced per-replica in legit_move_mask/legit_swap_
-    # mask (a replica whose ORIGINAL broker is new may still move anywhere —
-    # GoalUtils eligibleBrokers semantics), not via this broker-global mask
+    # mask (destinations limited to new brokers or the replica's own
+    # original broker — GoalUtils.eligibleBrokers:163), not via this
+    # broker-global mask
     return ClusterEnv(
         leader_load=ct.leader_load,
         follower_load=ct.follower_load,

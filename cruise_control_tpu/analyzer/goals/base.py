@@ -89,6 +89,15 @@ class GoalKernel:
     # band acceptance provide wave_budgets instead; a goal with neither forces
     # the engine back to the one-move-per-broker wave.
     wave_safe: bool = dataclasses.field(default=False, init=False)
+    # True for goals whose greedy tail is unbounded on skewed instances
+    # (the soft distribution goals: near their plateau every pass lands a
+    # dribble of actions and salted exploration can run for hundreds of
+    # passes). The optimizer runs the chain's fused program only up to the
+    # first deep-tail goal; each deep-tail goal then runs as its OWN
+    # bounded program (salted tail + exhaustive finisher) — one long fused
+    # program containing those tails reproducibly gets the axon TPU
+    # worker killed mid-execution.
+    deep_tail: bool = dataclasses.field(default=False, init=False)
 
     # --- kernel methods (override) ---
     def broker_severity(self, env: ClusterEnv, st: EngineState) -> Array:
@@ -262,13 +271,13 @@ def legit_move_mask(env: ClusterEnv, st: EngineState, cand: Array,
     B = env.num_brokers
     dst_ok = jnp.broadcast_to(env.dst_candidate[None, :], (K, B))
     # new-broker mode (OptimizationVerifier NEW_BROKERS contract, reference
-    # GoalUtils.eligibleBrokers): when the cluster has new brokers, a replica
-    # may only move ONTO a new broker — unless its ORIGINAL broker is new, in
-    # which case it may move anywhere (e.g. shedding load off an over-full
-    # re-added broker stays legal)
+    # GoalUtils.eligibleBrokers:163 `b.isNew() || b == replica.
+    # originalBroker()`): when the cluster has new brokers, a replica may
+    # only move ONTO a new broker or BACK to its own original broker
     new_any = jnp.any(env.broker_new)
-    orig_new = env.broker_new[env.replica_original_broker[cand]]      # [K]
-    new_ok = (~new_any) | env.broker_new[None, :] | orig_new[:, None]
+    orig_b = env.replica_original_broker[cand]                        # [K]
+    back_home = jnp.arange(B)[None, :] == orig_b[:, None]             # [K, B]
+    new_ok = (~new_any) | env.broker_new[None, :] | back_home
     dst_ok = dst_ok & new_ok
     cur = st.replica_broker[cand]
     not_self = jnp.arange(B)[None, :] != cur[:, None]
@@ -316,14 +325,16 @@ def legit_swap_mask(env: ClusterEnv, st: EngineState, cand_out: Array,
     ok_r = (env.replica_valid & ~st.replica_offline
             & ~env.replica_topic_excluded)
     dst_ok = env.dst_candidate[b_in][None, :] & env.dst_candidate[b_out][:, None]
-    # new-broker mode: each directed leg must target a new broker unless the
-    # moving replica's original broker is new (same rule as legit_move_mask)
+    # new-broker mode: each directed leg must target a new broker or the
+    # moving replica's own original broker (same rule as legit_move_mask)
     new_any = jnp.any(env.broker_new)
-    orig_new_out = env.broker_new[env.replica_original_broker[cand_out]]  # [K1]
-    orig_new_in = env.broker_new[env.replica_original_broker[cand_in]]   # [K2]
+    orig_out = env.replica_original_broker[cand_out]                  # [K1]
+    orig_in = env.replica_original_broker[cand_in]                    # [K2]
+    out_home = b_in[None, :] == orig_out[:, None]                     # [K1, K2]
+    in_home = b_out[:, None] == orig_in[None, :]                      # [K1, K2]
     new_ok = ((~new_any)
-              | ((env.broker_new[b_in][None, :] | orig_new_out[:, None])
-                 & (env.broker_new[b_out][:, None] | orig_new_in[None, :])))
+              | ((env.broker_new[b_in][None, :] | out_home)
+                 & (env.broker_new[b_out][:, None] | in_home)))
     return (diff_broker & out_ok & in_ok & dst_ok & new_ok
             & ok_r[cand_out][:, None] & ok_r[cand_in][None, :])
 

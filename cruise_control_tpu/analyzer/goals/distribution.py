@@ -59,6 +59,7 @@ class ResourceDistributionGoal(GoalKernel):
 
     def __post_init__(self):
         object.__setattr__(self, "uses_leadership_moves", self.resource in (0, 2))
+        object.__setattr__(self, "deep_tail", True)
         object.__setattr__(self, "uses_swaps", True)
 
     # -- limits --
@@ -402,6 +403,7 @@ class LeaderReplicaDistributionGoal(GoalKernel):
         object.__setattr__(self, "name", "LeaderReplicaDistributionGoal")
         object.__setattr__(self, "uses_leadership_moves", True)
         object.__setattr__(self, "leadership_primary", True)
+        object.__setattr__(self, "deep_tail", True)
 
     def _limits(self, env: ClusterEnv, st: EngineState):
         n_alive = jnp.sum(env.broker_alive)

@@ -84,6 +84,7 @@ class LeaderBytesInDistributionGoal(GoalKernel):
         object.__setattr__(self, "name", "LeaderBytesInDistributionGoal")
         object.__setattr__(self, "uses_replica_moves", False)
         object.__setattr__(self, "uses_leadership_moves", True)
+        object.__setattr__(self, "deep_tail", True)
 
     def _limits(self, env: ClusterEnv, st: EngineState):
         alive = env.broker_alive

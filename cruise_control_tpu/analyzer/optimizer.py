@@ -58,10 +58,20 @@ class GoalResult:
     iterations: int               # actions applied
     duration_s: float
     stat_after: float
-    hit_max_iters: bool = False   # iteration budget exhausted while progressing
+    hit_max_iters: bool = False   # budget exhausted, still violated, UNPROVEN
     passes: int = 0               # engine while_loop trips (scoring passes)
     stat_before: float = 0.0      # goal's own stat entering ITS run (rolling
     #                               monotonicity oracle, AbstractGoal:110-119)
+    # finisher certificate (engine._finisher): for a goal still violated at
+    # budget exit, whether the exhaustive post-loop scans proved a
+    # single-action fixpoint (zero accepted positive-gain moves + transfers
+    # + an empty bounded swap window), and the remaining counts when not
+    fixpoint_proven: bool = False
+    moves_remaining: int = -1     # -1 = finisher did not run (not violated)
+    leads_remaining: int = -1
+    swap_window_remaining: int = -1
+    finisher_rounds: int = 0
+    plateau_exit: bool = False    # stat-slope plateau cut the tail
 
 
 @dataclasses.dataclass
@@ -105,6 +115,13 @@ class OptimizerResult:
         for g, entry in zip(self.goal_results, out["goalSummary"]):
             entry["iterations"] = g.iterations
             entry["budgetExhausted"] = g.hit_max_iters
+            if g.violated_after:
+                entry["fixpointProven"] = g.fixpoint_proven
+                if g.moves_remaining >= 0:
+                    entry["actionsRemaining"] = {
+                        "moves": g.moves_remaining,
+                        "leaderships": g.leads_remaining,
+                        "swapWindow": g.swap_window_remaining}
         return out
 
 
@@ -341,27 +358,48 @@ class GoalOptimizer:
                      and self._fused_min_replicas >= 0
                      and ct.num_replicas >= self._fused_min_replicas)
         if use_fused:
-            # the WHOLE optimization — initial stats + violations, every
-            # goal's loop, optional preferred-leader pass, final stats and
-            # the packed final-assignment fetch — is ONE compiled program
-            # and ONE batched device->host transfer: on a tunneled TPU each
-            # separate program execution costs ~a second of fixed overhead
+            # SEGMENTED chain: initial stats + violations + every goal up to
+            # the first deep-tail goal run as ONE fused program (on a
+            # tunneled TPU each separate program execution costs ~a second
+            # of fixed overhead); each deep-tail goal (soft distribution /
+            # leader goals whose salted tails + exhaustive finishers run
+            # long) is its OWN bounded program — one monolithic program
+            # containing those tails gets the axon TPU worker killed
+            # mid-execution — and a final program runs the optional
+            # preferred-leader pass, final stats and the packed
+            # final-assignment fetch as one batched device->host transfer.
             ple = (PreferredLeaderElectionGoal(constraint=self._constraint,
                                                options=options)
                    if run_preferred else None)
-            st, out_dev = _compiled_full_chain(
-                tuple(type(g) for g in goals), tuple(goals), params, ple)(env, st)
+            split = next((i for i, g in enumerate(goals)
+                          if getattr(g, "deep_tail", False)), len(goals))
+            gclasses = tuple(type(g) for g in goals)
+            st, out_dev = _compiled_prefix_chain(
+                gclasses, tuple(goals), split, params)(env, st)
+            tail_infos_dev = []
+            prev = tuple(goals[:split])
+            for g in goals[split:]:
+                # finisher inline at the goal's chain position (running it
+                # deferred measured 6x-inflated remaining-action counts);
+                # non-donating: programs pipeline async
+                st, info = optimize_goal(env, st, g, prev, params,
+                                         donate_state=self._donate_state)
+                tail_infos_dev.append(info)
+                prev = prev + (g,)
+            st, fin_dev = _compiled_chain_final(gclasses, tuple(goals),
+                                                ple)(env, st)
             out = jax.device_get(out_dev)
-            ple_dur = 0.0   # one fused program: no per-pass timing
-            viol0, infos, sb, sa = (out["viol_before"], out["infos"],
-                                    out["stats_before"], out["stats_after"])
-            packed = out["packed"]
+            fin = jax.device_get(fin_dev)
+            infos = out["infos"] + jax.device_get(tail_infos_dev)
+            ple_dur = 0.0   # fused segments: no per-pass timing
+            viol0, sb = out["viol_before"], out["stats_before"]
+            sa, packed = fin["stats_after"], fin["packed"]
             if run_preferred:
-                was, still = out["ple_was"], out["ple_still"]
+                was, still = fin["ple_was"], fin["ple_still"]
             stats_before = _stats_to_json(sb)
             stats_after = _stats_to_json(sa)
             violated_before = {g.name: bool(v) for g, v in zip(goals, viol0)}
-            durations = [0.0] * len(goals)   # one program: not per-goal timed
+            durations = [0.0] * len(goals)   # fused segments: not per-goal timed
         else:
             stats_before = cluster_stats_state(env, st)
             viol0 = jax.device_get(_compiled_violations(tuple(goals))(env, st))
@@ -406,6 +444,13 @@ class GoalOptimizer:
                 hit_max_iters=bool(info.get("hit_max_iters", False)),
                 passes=int(info.get("passes", 0)),
                 stat_before=float(info.get("stat_before", 0.0)),
+                fixpoint_proven=bool(info.get("fixpoint_proven", False)),
+                moves_remaining=int(info.get("moves_remaining", -1)),
+                leads_remaining=int(info.get("leads_remaining", -1)),
+                swap_window_remaining=int(
+                    info.get("swap_window_remaining", -1)),
+                finisher_rounds=int(info.get("finisher_rounds", 0)),
+                plateau_exit=bool(info.get("plateau_exit", False)),
             )
             for g, info, dur in zip(goals, infos, durations)
         ]
@@ -474,12 +519,11 @@ class GoalOptimizer:
 
 
 @lru_cache(maxsize=64)
-def _compiled_full_chain(goal_classes: tuple, goals: tuple,
-                         params: EngineParams, ple):
-    """ONE jitted program for the whole optimization: initial stats +
-    violations, the sequential goal-chain loops, the optional
-    PreferredLeaderElection pass, final stats, and the packed final-
-    assignment transfer (see GoalOptimizer fused path)."""
+def _compiled_prefix_chain(goal_classes: tuple, goals: tuple, split: int,
+                           params: EngineParams):
+    """ONE jitted program for the chain's head: initial stats + EVERY
+    goal's violated-before flag, then the loops of goals[:split] (the
+    goals without deep tails — they converge in bounded passes)."""
     from cruise_control_tpu.analyzer.engine import _goal_loop
     del goal_classes  # cache key only
 
@@ -489,12 +533,31 @@ def _compiled_full_chain(goal_classes: tuple, goals: tuple,
                "viol_before": [g.violated(env, st) for g in goals]}
         infos = []
         prev: tuple = ()
-        for g in goals:
-            st2, info = _goal_loop(env, st, g, prev, params)
+        for g in goals[:split]:
+            # finisher=False: prefix goals converge inside their budgets;
+            # inlining a scan/finisher subprogram per goal here bloats the
+            # fused program's compile by minutes and risks the runtime's
+            # execution watchdog. A prefix goal that does exit violated
+            # reports honest hit_max_iters with no certificate.
+            st2, info = _goal_loop(env, st, g, prev, params, finisher=False)
             st = st2
             infos.append(info)
             prev = prev + (g,)
         out["infos"] = infos
+        return st, out
+
+    return run
+
+
+@lru_cache(maxsize=64)
+def _compiled_chain_final(goal_classes: tuple, goals: tuple, ple):
+    """The chain's closing program: optional PreferredLeaderElection pass,
+    final stats, packed final-assignment fetch — one batched transfer."""
+    del goal_classes
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def run(env: ClusterEnv, st: EngineState):
+        out = {}
         if ple is not None:
             out["ple_was"] = ple.violated(env, st)
             st = ple.apply(env, st)

@@ -157,11 +157,14 @@ class CruiseControlServer:
                     cfg.get_string("webserver.http.cors.allowmethods"),
                 "Access-Control-Expose-Headers":
                     cfg.get_string("webserver.http.cors.exposeheaders"),
-                # on EVERY response, not just the preflight: a credentialed
-                # fetch (session cookie / Authorization) is discarded by the
-                # browser unless the actual response grants credentials too
-                "Access-Control-Allow-Credentials": "true",
             }
+            # on EVERY response, not just the preflight: a credentialed
+            # fetch (session cookie / Authorization) is discarded by the
+            # browser unless the actual response grants credentials too.
+            # The Fetch spec forbids credentials with a wildcard origin, so
+            # the grant only applies when a concrete origin is configured.
+            if cfg.get_string("webserver.http.cors.origin") != "*":
+                self._cors["Access-Control-Allow-Credentials"] = "true"
         self._reason_required = bool(
             cfg is not None and cfg.get_boolean("request.reason.required"))
         self._session_path = (cfg.get_string("webserver.session.path")

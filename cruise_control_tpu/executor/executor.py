@@ -405,8 +405,10 @@ class Executor:
                  self._cfg.removal_history_retention_ms),
                 (self._recently_demoted_brokers,
                  self._cfg.demotion_history_retention_ms)):
-            for b in [b for b, ts in hist.items() if now - ts > retention]:
-                del hist[b]
+            # pop(..., None): concurrent REST threads may race this sweep
+            for b in [b for b, ts in list(hist.items())
+                      if now - ts > retention]:
+                hist.pop(b, None)
 
     def recently_removed_brokers(self) -> set:
         self._expire_history()

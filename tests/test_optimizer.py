@@ -35,24 +35,48 @@ def verify(res, env_alive=True):
 
 @pytest.mark.parametrize("seed", [3140, 5234, 72033])
 def test_random_cluster_hard_goals(seed):
+    from optimization_verifier import verify as full_verify
     ct, meta = generate(RandomClusterSpec(num_brokers=12, num_racks=4, num_topics=8,
                                           num_partitions=120, skew=2.0, seed=seed))
     opt = GoalOptimizer()
     res = opt.optimizations(ct, meta, goal_names=GOALS_CORE)
     verify(res)
+    # the reference runs these on every random test (RandomClusterTest.java:61)
+    full_verify(ct, meta, res, ["REGRESSION", "BROKEN_BROKERS"])
 
 
 def test_random_self_healing_dead_brokers():
     """RandomSelfHealingTest role: kill brokers, all replicas must relocate."""
+    from optimization_verifier import verify as full_verify
     ct, meta = generate(RandomClusterSpec(num_brokers=12, num_racks=4, num_topics=8,
                                           num_partitions=100, num_dead_brokers=2,
                                           seed=99))
     opt = GoalOptimizer()
     res = opt.optimizations(ct, meta, goal_names=GOALS_CORE)
     verify(res)
+    full_verify(ct, meta, res, ["REGRESSION", "BROKEN_BROKERS"])
     dead = ~np.asarray(res.env.broker_alive)
     broker_of = np.asarray(res.final_state.replica_broker)[np.asarray(res.env.replica_valid)]
     assert not dead[broker_of].any()
+
+
+def test_random_new_brokers_only_targets():
+    """OptimizationVerifier NEW_BROKERS on a random add-broker run: replica
+    additions may only land on the brokers flagged new."""
+    import dataclasses as dc
+
+    from optimization_verifier import verify as full_verify
+    ct, meta = generate(RandomClusterSpec(num_brokers=12, num_racks=4,
+                                          num_topics=8, num_partitions=120,
+                                          skew=1.5, seed=424))
+    new = np.zeros(ct.broker_capacity.shape[0], bool)
+    new[[3, 7]] = True
+    import jax.numpy as jnp
+    ct = dc.replace(ct, broker_new=jnp.asarray(new))
+    opt = GoalOptimizer()
+    res = opt.optimizations(ct, meta, goal_names=GOALS_CORE,
+                            raise_on_failure=False, skip_hard_goal_check=True)
+    full_verify(ct, meta, res, ["NEW_BROKERS", "REGRESSION"])
 
 
 def test_goal_stats_monotone():

@@ -122,3 +122,15 @@ def test_rung2_violated_goals_are_greedy_fixpoints():
     assert not holes, (
         f"engine stopped with applicable actions remaining (search holes): "
         f"{holes} — violated goals: {res.violated_goals_after}")
+
+    # the engine's OWN in-program certificate (engine._finisher exhaustive
+    # scans) must agree with this host-side oracle: every violated survivor
+    # is flagged fixpoint-proven and none reads as budget-exhausted
+    by_name = {g.name: g for g in res.goal_results}
+    for name in res.violated_goals_after:
+        gr = by_name[name]
+        assert gr.fixpoint_proven, (
+            f"{name}: host oracle proves the fixpoint but the engine's "
+            f"certificate disagrees (moves={gr.moves_remaining}, "
+            f"leads={gr.leads_remaining}, swaps={gr.swap_window_remaining})")
+        assert not gr.hit_max_iters, name

@@ -1,0 +1,24 @@
+import os, time, sys
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cc_tpu")
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate_scale
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+
+ct, meta = generate_scale(RandomClusterSpec(
+    num_brokers=7000, num_racks=40, num_topics=2000,
+    num_partitions=500000, max_replication=3, skew=1.0, seed=3142,
+    target_cpu_util=0.45))
+opt = GoalOptimizer()
+opt._fused_min_replicas = -1 if "--fused" not in sys.argv else 0
+t0 = time.monotonic()
+res = opt.optimizations(ct, meta, raise_on_failure=False,
+                        skip_hard_goal_check=True,
+                        measure_goal_durations=True)
+print("wall", round(time.monotonic() - t0, 1))
+for g in res.goal_results:
+    print(f"{g.name:45s} viol={int(g.violated_after)} hit={int(g.hit_max_iters)} "
+          f"proven={int(g.fixpoint_proven)} fin={g.finisher_rounds} "
+          f"mleft={g.moves_remaining} lleft={g.leads_remaining} "
+          f"sw={g.swap_window_remaining} dur={g.duration_s:.2f}s")
+print("violated_after:", res.violated_goals_after)

@@ -1,0 +1,25 @@
+import os, time, sys
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cc_tpu")
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate_scale
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+
+ct, meta = generate_scale(RandomClusterSpec(
+    num_brokers=7000, num_racks=40, num_topics=2000,
+    num_partitions=500000, max_replication=3, skew=1.0, seed=3142,
+    target_cpu_util=0.45))
+opt = GoalOptimizer()
+opt._fused_min_replicas = -1   # per-goal programs (async pipelined)
+walls = []
+for i in range(3):
+    t0 = time.monotonic()
+    res = opt.optimizations(ct, meta, raise_on_failure=False,
+                            skip_hard_goal_check=True)
+    walls.append(round(time.monotonic() - t0, 2))
+    print(f"run {i}: {walls[-1]}s", flush=True)
+print("walls", walls)
+print("violated:", res.violated_goals_after)
+print("exhausted:", [g.name for g in res.goal_results if g.hit_max_iters])
+print("proven:", [g.name for g in res.goal_results
+                  if g.violated_after and g.fixpoint_proven])

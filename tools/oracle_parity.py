@@ -1,0 +1,102 @@
+"""Differential violation-parity harness (VERDICT r3 next-step #6).
+
+For each seed: generate the RandomCluster 100b/10k instance, run the TPU
+engine's default chain AND the independent numpy sequential-greedy oracle
+(tools/greedy_oracle.py), then evaluate BOTH final assignments with the
+ORACLE's own violation predicates (an independent implementation of the
+reference's GoalUtils band math). Emits a per-seed table; exits nonzero if
+the engine ends with more violations than the Java-style greedy on any seed.
+
+Usage: python tools/oracle_parity.py [num_seeds] [--write-parity]
+"""
+import os, sys, json, time
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+from greedy_oracle import Oracle
+
+ORACLE_GOALS = [
+    "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal", "ReplicaDistributionGoal",
+    "DiskUsageDistributionGoal", "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal", "CpuUsageDistributionGoal",
+    "LeaderReplicaDistributionGoal", "LeaderBytesInDistributionGoal",
+]
+
+
+def run_seed(seed: int):
+    import jax
+    from cruise_control_tpu.model.random_cluster import (RandomClusterSpec,
+                                                         generate)
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+
+    ct, meta = generate(RandomClusterSpec(
+        num_brokers=100, num_racks=10, num_topics=40, num_partitions=5000,
+        max_replication=3, skew=1.0, seed=seed, target_cpu_util=0.45))
+    opt = GoalOptimizer()
+    t0 = time.monotonic()
+    res = opt.optimizations(ct, meta, raise_on_failure=False,
+                            skip_hard_goal_check=True)
+    engine_s = time.monotonic() - t0
+    eng_broker = np.asarray(res.final_state.replica_broker)
+    eng_leader = np.asarray(res.final_state.replica_is_leader)
+
+    t0 = time.monotonic()
+    oracle = Oracle(ct, meta, opt.constraint)
+    before = oracle.violations()
+    oracle.optimize(ORACLE_GOALS)
+    oracle_s = time.monotonic() - t0
+    ov = oracle.violations()
+
+    eng_eval = Oracle(ct, meta, opt.constraint)
+    eng_eval.with_assignment(eng_broker, eng_leader)
+    ev = eng_eval.violations()
+
+    row = {"seed": seed,
+           "violations_initial": sum(before.values()),
+           "engine_violations": sum(ev[g] for g in ORACLE_GOALS),
+           "oracle_violations": sum(ov[g] for g in ORACLE_GOALS),
+           "engine_violated": sorted(g for g in ORACLE_GOALS if ev[g]),
+           "oracle_violated": sorted(g for g in ORACLE_GOALS if ov[g]),
+           "engine_s": round(engine_s, 2), "oracle_s": round(oracle_s, 2)}
+    return row
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 10
+    rows = []
+    worse = 0
+    for seed in range(3200, 3200 + n):
+        row = run_seed(seed)
+        rows.append(row)
+        flag = "" if row["engine_violations"] <= row["oracle_violations"] else "  <-- ENGINE WORSE"
+        print(f"seed {row['seed']}: initial={row['violations_initial']} "
+              f"engine={row['engine_violations']} oracle={row['oracle_violations']}"
+              f" (engine {row['engine_s']}s, oracle {row['oracle_s']}s){flag}",
+              flush=True)
+        if row["engine_violations"] > row["oracle_violations"]:
+            worse += 1
+    print(json.dumps(rows))
+    if "--write-parity" in sys.argv:
+        lines = ["", "## Random-scale differential violation parity "
+                     "(engine vs numpy sequential-greedy oracle, 100b/10k)", "",
+                 "Independent predicates (tools/greedy_oracle.py GoalUtils band math) "
+                 "evaluate BOTH final assignments; 13 shared goals.", "",
+                 "| seed | initial | engine | oracle | engine left | oracle left |",
+                 "|---|---|---|---|---|---|"]
+        for r in rows:
+            lines.append(
+                f"| {r['seed']} | {r['violations_initial']} | "
+                f"{r['engine_violations']} | {r['oracle_violations']} | "
+                f"{', '.join(r['engine_violated']) or '-'} | "
+                f"{', '.join(r['oracle_violated']) or '-'} |")
+        with open("PARITY.md", "a") as f:
+            f.write("\n".join(lines) + "\n")
+        print("appended to PARITY.md")
+    sys.exit(1 if worse else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+import os, time
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+import jax, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cc_tpu")
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate_scale
+from cruise_control_tpu.analyzer.env import make_env, padded_partition_table
+from cruise_control_tpu.analyzer.state import init_state
+from cruise_control_tpu.analyzer.goals import make_goals
+from cruise_control_tpu.analyzer.goals.base import legit_move_mask
+from cruise_control_tpu.analyzer.env import BalancingConstraint, OptimizationOptions
+
+print("generating...", flush=True)
+ct, meta = generate_scale(RandomClusterSpec(
+    num_brokers=7000, num_racks=40, num_topics=2000,
+    num_partitions=500000, max_replication=3, skew=1.0, seed=3142,
+    target_cpu_util=0.45))
+env = make_env(ct, meta, partition_table=padded_partition_table(ct))
+st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                ct.replica_offline, ct.replica_disk)
+goals = make_goals(["DiskUsageDistributionGoal"], BalancingConstraint(), OptimizationOptions())
+goal = goals[0]
+NEG_INF = -jnp.inf
+R = env.num_replicas
+print("R =", R, "B =", env.num_brokers, flush=True)
+
+def scan(env, st, chunk):
+    n_chunks = -(-R // chunk)
+    def body(i, carry):
+        gain, dst = carry
+        base = i * chunk
+        idx = base + jnp.arange(chunk, dtype=jnp.int32)
+        cand = jnp.minimum(idx, R - 1)
+        mask = legit_move_mask(env, st, cand, goal.options)
+        score = jnp.where(mask, goal.move_score(env, st, cand), NEG_INF)
+        d = jnp.argmax(score, axis=1).astype(jnp.int32)
+        v = score[jnp.arange(chunk), d]
+        v = jnp.where(idx < R, v, NEG_INF)
+        gain = jax.lax.dynamic_update_slice(gain, v, (base,))
+        dst = jax.lax.dynamic_update_slice(dst, d, (base,))
+        return gain, dst
+    gain0 = jnp.full(n_chunks * chunk, NEG_INF, jnp.float32)
+    dst0 = jnp.zeros(n_chunks * chunk, jnp.int32)
+    return jax.lax.fori_loop(0, n_chunks, body, (gain0, dst0))
+
+for chunk in (1024, 1760):
+    f = jax.jit(lambda e, s, c=chunk: scan(e, s, c))
+    t0 = time.monotonic()
+    g, d = f(env, st)
+    jax.block_until_ready(g)
+    cold = time.monotonic() - t0
+    t0 = time.monotonic()
+    for _ in range(3):
+        g, d = f(env, st)
+    jax.block_until_ready(g)
+    warm = (time.monotonic() - t0) / 3
+    npos = int((g > 1e-9).sum())
+    print(f"chunk={chunk}: cold={cold:.2f}s warm={warm*1000:.0f}ms positives={npos}", flush=True)
