@@ -164,6 +164,12 @@ class EngineParams:
     #                                   exact; this amortizes the ~0.65 s
     #                                   scan over up to W waves of work
     scan_chunk: int = 1024            # rows per exhaustive-scan sweep
+    # once a finisher round's move+transfer scans read zero, up to this many
+    # salted swap passes (~12 ms each) drain the goal's swap frontier —
+    # swaps are the only action kind whose certificate clause is
+    # window-bounded, and the windows were measured holding 10k+ positive
+    # pairs after the move/lead fixpoint at the 1M rung
+    finisher_swap_passes: int = 64
 
 
 def _wave_budget_capable(g: GoalKernel, leadership: bool = False) -> bool:
@@ -772,7 +778,7 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                 jnp.int32(-1), zero, zero)
 
     def round_body(carry):
-        st, rounds, prev_m, prev_l, total, _done = carry
+        st, rounds, prev_m, prev_l, total, _done, _clean = carry
         mleft = zero
         lleft = zero
         applied = zero
@@ -790,31 +796,60 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
             st, n = _finisher_wave(env, st, goal, prev_goals, params,
                                    gain, leadership=True)
             applied += n
+        if goal.uses_swaps and params.finisher_swap_passes > 0:
+            # swap tail: once moves+transfers are drained this round, salted
+            # swap passes (each pass a fresh pseudo-random window) drain the
+            # swap frontier; swaps change utilization, so the NEXT round's
+            # scans re-check moves/transfers before anything is certified
+            drained = (mleft == 0) & (lleft == 0)
+
+            def swap_step(carry):
+                s, tot, it, _last = carry
+                s2, k = _swap_branch_batched(
+                    env, s, goal, prev_goals, params,
+                    goal.broker_severity(env, s), it)
+                return s2, tot + k, it + 1, k
+
+            def swap_cond(carry):
+                _s, _t, it, last = carry
+                return (drained & (last > 0)
+                        & (it < params.finisher_swap_passes))
+
+            st, n_sw, _, _ = jax.lax.while_loop(
+                swap_cond, swap_step,
+                (st, zero, zero, jnp.int32(1)))
+            applied += n_sw
         # exits:
-        # - both scans zero => nothing applied this round => the scanned
-        #   state IS the exit state and the certificate holds;
-        # - zero applies with positive scans (admission blocks everything
-        #   the scan found; a repeat round recomputes the identical wave) —
-        #   counts stay positive => NOT proven;
+        # - nothing applied this whole round (scans zero, or admission
+        #   blocked everything they found — then counts stay positive and
+        #   the goal is NOT proven): the scanned state IS the exit state,
+        #   so the post-loop certificate is evaluated against it unchanged;
         # - the goal became SATISFIED (fixed outright — better than proof);
         # - stagnation: remaining counts shrank < 1/8 since last round —
         #   convergence at that decay would take more rounds than the cap
         #   allows, so stop burning ~0.7 s scans on it.
-        done = ((mleft == 0) & (lleft == 0)) | (applied == 0)
+        done = applied == 0
         done = done | ~goal.violated(env, st)
-        done = done | (mleft + lleft > (prev_m + prev_l) * 7 // 8)
-        return st, rounds + 1, mleft, lleft, total + applied, done
+        done = done | ((mleft + lleft > 0)
+                       & (mleft + lleft > (prev_m + prev_l) * 7 // 8))
+        # the certificate may only be claimed when the FINAL round applied
+        # nothing — an exit right after applied actions (rounds cap /
+        # stagnation / swap-tail applies) leaves the scans' counts stale
+        # against the mutated state
+        return (st, rounds + 1, mleft, lleft, total + applied, done,
+                applied == 0)
 
     def cond(carry):
-        _st, rounds, _m, _l, _t, done = carry
+        _st, rounds, _m, _l, _t, done, _clean = carry
         return run & ~done & (rounds < params.finisher_rounds)
 
     # far above any real count (counts are <= R) so the first round can
     # never trip the stagnation exit, yet small enough that *7 stays well
     # inside int32
     big = jnp.int32(2**27)
-    st, rounds, mleft, lleft, n_applied, done = jax.lax.while_loop(
-        cond, round_body, (st, zero, big, big, zero, jnp.bool_(False)))
+    st, rounds, mleft, lleft, n_applied, done, clean = jax.lax.while_loop(
+        cond, round_body, (st, zero, big, big, zero, jnp.bool_(False),
+                           jnp.bool_(False)))
     mleft = jnp.where(run, mleft, -1)   # -1 = finisher did not run
     lleft = jnp.where(run, lleft, -1)
     moves_proven = (mleft == 0) | jnp.bool_(not use_moves)
@@ -826,7 +861,7 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     else:
         swleft = jnp.int32(-1)
         swaps_proven = jnp.bool_(True)
-    proven = run & moves_proven & leads_proven & swaps_proven
+    proven = run & clean & moves_proven & leads_proven & swaps_proven
     return st, proven, mleft, lleft, swleft, rounds, n_applied
 
 

@@ -206,6 +206,13 @@ class GoalOptimizer:
         # tpu.mesh.axis.brokers: >1 shards the chain over a device mesh
         self._mesh_axis_brokers = (config.get_int("tpu.mesh.axis.brokers")
                                    if config is not None else 1)
+        # analyzer.finisher.min.replicas: below this, goal programs compile
+        # without the finisher subprogram (certificates at small scale are
+        # covered by the host-side plateau-fixpoint proof; the subprogram
+        # multiplies small-fixture compile times)
+        self._finisher_min_replicas = (
+            config.get_int("analyzer.finisher.min.replicas")
+            if config is not None else 8192)
         # tpu.donate.state: donate per-goal state buffers (saves HBM at the
         # cost of serializing the async dispatch pipeline — see the NOTE in
         # optimizations(); default off)
@@ -327,7 +334,15 @@ class GoalOptimizer:
             tail_pass_budget=min(
                 1024, self._params.tail_pass_budget * _budget_scale(ct) ** 2),
             stall_retries=min(
-                32, self._params.stall_retries * _budget_scale(ct)))
+                32, self._params.stall_retries * _budget_scale(ct)),
+            # small clusters skip the finisher subprogram entirely
+            # (analyzer.finisher.min.replicas): the plateau-fixpoint proof
+            # covers certificates there, and the subprogram multiplies the
+            # small-fixture compile population's cost
+            finisher_rounds=(0 if (self._finisher_min_replicas >= 0
+                                   and ct.num_replicas
+                                   < self._finisher_min_replicas)
+                             else self._params.finisher_rounds))
 
         tml = self._min_leader_mask(meta, min_leader_topic_pattern)
         if tml is not None and tml.shape[0] < ct.num_topics:
@@ -374,8 +389,23 @@ class GoalOptimizer:
             split = next((i for i, g in enumerate(goals)
                           if getattr(g, "deep_tail", False)), len(goals))
             gclasses = tuple(type(g) for g in goals)
+            # CC_PROFILE_SEGMENTS=1: block + log per segment (debug only —
+            # blocking defeats the async dispatch pipeline)
+            import os as _os
+            _prof = bool(_os.environ.get("CC_PROFILE_SEGMENTS"))
+
+            def _tick(label):
+                if _prof:
+                    jax.block_until_ready(st.util)
+                    now = time.monotonic()
+                    print(f"[segment] {label}: {now - _tick.t0:.2f}s",
+                          flush=True)
+                    _tick.t0 = now
+            _tick.t0 = time.monotonic()
+
             st, out_dev = _compiled_prefix_chain(
                 gclasses, tuple(goals), split, params)(env, st)
+            _tick(f"prefix({split})")
             tail_infos_dev = []
             prev = tuple(goals[:split])
             for g in goals[split:]:
@@ -386,6 +416,7 @@ class GoalOptimizer:
                                          donate_state=self._donate_state)
                 tail_infos_dev.append(info)
                 prev = prev + (g,)
+                _tick(g.name)
             st, fin_dev = _compiled_chain_final(gclasses, tuple(goals),
                                                 ple)(env, st)
             out = jax.device_get(out_dev)

@@ -125,6 +125,12 @@ _D.define(name="num.proposal.precompute.threads", type=Type.INT, default=1, vali
               "pipeline model builds against device execution.")
 _D.define(name="analyzer.max.iterations", type=Type.INT, default=4096, validator=at_least(1),
           doc="TPU-specific: hard cap on greedy-engine iterations per goal per round.")
+_D.define(name="analyzer.finisher.min.replicas", type=Type.INT, default=8192,
+          doc="TPU-specific: clusters below this replica count compile their "
+              "goal programs WITHOUT the exhaustive finisher phase (the "
+              "finisher subprogram multiplies small-cluster compile times "
+              "for certificates the plateau-fixpoint proof already covers "
+              "at that scale). -1 always compiles it.")
 _D.define(name="analyzer.candidate.replicas.per.broker", type=Type.INT, default=64, validator=at_least(1),
           doc="TPU-specific: top-K replicas per source broker considered per engine iteration "
               "(replaces the reference's sorted-replica scan, SortedReplicas.java).")

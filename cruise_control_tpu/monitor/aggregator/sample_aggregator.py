@@ -211,6 +211,41 @@ class MetricSampleAggregator:
             self._dirty = True
             return True
 
+    def add_samples(self, entities: list, ts_ms: float, values,
+                    metric_names: list) -> int:
+        """Bulk ingestion: N samples sharing ONE timestamp and ONE metric-name
+        set, ``values`` [N, len(metric_names)]. One vectorized scatter into
+        the ring instead of N python calls — the per-sample path costs ~20 us
+        each, which is minutes per sampling round at 1M partitions."""
+        import numpy as _np
+        n = len(entities)
+        if n == 0:
+            return 0
+        window = self.window_index(ts_ms)
+        with self._lock:
+            if self._current_window is not None and window < self._oldest_window:
+                return 0
+            self._roll_to(max(window, self._current_window or window))
+            rows = _np.fromiter((self._entity_row(e) for e in entities),
+                                dtype=_np.int64, count=n)
+            slot = (window - self._oldest_window
+                    if window < self._current_window else self._num_windows)
+            if slot < 0:
+                return 0
+            cols = _np.asarray([self._metric_def.info(m).metric_id
+                                for m in metric_names], dtype=_np.int64)
+            values = _np.asarray(values, dtype=float)
+            # np.*.at: duplicate entities within one batch accumulate
+            # exactly like repeated add_sample calls would
+            _np.add.at(self._sum[:, slot, :],
+                       (rows[:, None], cols[None, :]), values)
+            _np.maximum.at(self._max[:, slot, :],
+                           (rows[:, None], cols[None, :]), values)
+            self._latest[rows[:, None], slot, cols[None, :]] = values
+            _np.add.at(self._counts[:, slot], rows, 1)
+            self._dirty = True
+            return n
+
     # -- aggregation --
     def aggregate(self, num_windows: int | None = None) -> AggregationResult:
         """Aggregate the most recent ``num_windows`` completed windows.

@@ -327,11 +327,33 @@ class LoadMonitor:
 
     def _ingest(self, samples: Samples) -> int:
         n = 0
-        for s in samples.partition_samples:
-            if self._partition_agg.add_sample((s.topic, s.partition), s.ts_ms, s.values):
-                n += 1
-        for s in samples.broker_samples:
-            if self._broker_agg.add_sample(s.broker_id, s.ts_ms, s.values):
+        n += self._ingest_bulk(self._partition_agg, samples.partition_samples,
+                               lambda s: (s.topic, s.partition))
+        n += self._ingest_bulk(self._broker_agg, samples.broker_samples,
+                               lambda s: s.broker_id)
+        return n
+
+    @staticmethod
+    def _ingest_bulk(agg, sample_list, entity_of) -> int:
+        """Group samples into (ts, metric-name-set) batches and bulk-add
+        them; mixed batches fall back to the per-sample path. A normal
+        sampling round is ONE batch (the sampler stamps every sample with
+        the same collection time), so ingestion is a single vectorized
+        scatter instead of N python calls."""
+        if not sample_list:
+            return 0
+        n = 0
+        names0 = tuple(sample_list[0].values)
+        ts0 = sample_list[0].ts_ms
+        uniform = all(s.ts_ms == ts0 and tuple(s.values) == names0
+                      for s in sample_list)
+        if uniform:
+            values = np.array([[s.values[m] for m in names0]
+                               for s in sample_list], dtype=float)
+            return agg.add_samples([entity_of(s) for s in sample_list],
+                                   ts0, values, list(names0))
+        for s in sample_list:
+            if agg.add_sample(entity_of(s), s.ts_ms, s.values):
                 n += 1
         return n
 

@@ -8,6 +8,10 @@ executed through the intra-broker phase.
 import numpy as np
 import pytest
 
+# engine-path compile-heavy; the fast tier (-m 'not slow') covers the engine via
+# test_model/test_analyzer_goals/test_optimizer
+pytestmark = pytest.mark.slow
+
 from cruise_control_tpu.analyzer import init_state, make_env
 from cruise_control_tpu.analyzer.engine import EngineParams, optimize_goal
 from cruise_control_tpu.analyzer.goals import make_goal

@@ -28,6 +28,10 @@ import dataclasses
 
 import pytest
 
+# engine-path compile-heavy; the fast tier (-m 'not slow') covers the engine via
+# test_model/test_analyzer_goals/test_optimizer
+pytestmark = pytest.mark.slow
+
 from cruise_control_tpu.analyzer.env import BalancingConstraint
 from cruise_control_tpu.analyzer.optimizer import (
     GoalOptimizer, OptimizationFailureError,

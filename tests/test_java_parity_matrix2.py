@@ -3,6 +3,10 @@ tests/test_java_parity_matrix.py (split across two files so pytest-xdist's
 loadfile scheduler spreads the XLA:CPU compile load over both workers)."""
 import pytest
 
+# engine-path compile-heavy; the fast tier (-m 'not slow') covers the engine via
+# test_model/test_analyzer_goals/test_optimizer
+pytestmark = pytest.mark.slow
+
 from tests.test_java_parity_matrix import MATRIX, MATRIX_A, MATRIX_B, _run_matrix_row
 
 

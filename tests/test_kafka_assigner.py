@@ -3,6 +3,11 @@
 Reference test role: analyzer/kafkaassigner/KafkaAssigner*GoalTest — swap-only
 disk balancing preserves replica counts; even rack-aware spread.
 """
+import pytest
+
+# engine-path compile-heavy; the fast tier (-m 'not slow') covers the engine via
+# test_model/test_analyzer_goals/test_optimizer
+pytestmark = pytest.mark.slow
 import numpy as np
 
 from cruise_control_tpu.analyzer import init_state, make_env

@@ -33,6 +33,9 @@ import numpy as np
 
 BALANCE_MARGIN = 0.9   # GoalUtils.java BALANCE_MARGIN
 CPU, NW_IN, NW_OUT, DISK = 0, 1, 2, 3
+# absolute comparison tolerances per resource (Resource.java enum
+# constants: CPU 0.001 %, NW 10 KB/s, DISK 100 MB)
+EPS = np.array([0.001, 10.0, 10.0, 100.0])
 
 
 @dataclasses.dataclass
@@ -79,6 +82,19 @@ class Oracle:
         lnw = np.zeros(self.B, np.float64)
         np.add.at(lnw, broker[leader], self.lead_load[leader, NW_IN])
         return OracleState(broker.copy(), leader.copy(), util, rc, lc, lnw)
+
+    def with_assignment(self, broker_full, leader_full) -> "Oracle":
+        """Re-point the state at an externally-produced assignment (padded
+        [Rp] arrays in ct order) so violations() evaluates THAT state with
+        these independent predicates — how the parity harness scores the
+        engine's final state."""
+        n = self.valid.shape[0]
+        # engine arrays may carry extra appended padding (pad_cluster
+        # buckets); the first n rows correspond to the oracle's ct rows
+        b = np.asarray(broker_full)[:n][self.valid].astype(np.int64)
+        ld = np.asarray(leader_full)[:n][self.valid].copy()
+        self.st = self._init_state(b, ld)
+        return self
 
     # ------------------------------------------------------------- loads
     def row_load(self, i):
@@ -151,7 +167,7 @@ class Oracle:
                         (NW_OUT, "NetworkOutboundCapacityGoal"),
                         (CPU, "CpuCapacityGoal")):
             lim = self.cap[a, r] * self.c.capacity_threshold[r]
-            out[name] = bool((st.util[a, r] > lim + 1e-6).any())
+            out[name] = bool((st.util[a, r] > lim + EPS[r]).any())
         lo, hi = self.count_bounds(st.replica_count,
                                    self.c.replica_balance_percentage)
         out["ReplicaDistributionGoal"] = bool(
@@ -162,14 +178,15 @@ class Oracle:
                         (CPU, "CpuUsageDistributionGoal")):
             lo_u, hi_u = self.resource_bounds(r)
             out[name] = bool(
-                ((st.util[a, r] < lo_u - 1e-6) | (st.util[a, r] > hi_u + 1e-6)).any())
+                ((st.util[a, r] < lo_u - EPS[r])
+                 | (st.util[a, r] > hi_u + EPS[r])).any())
         lo, hi = self.count_bounds(st.leader_count,
                                    self.c.leader_replica_balance_percentage)
         out["LeaderReplicaDistributionGoal"] = bool(
             ((st.leader_count[a] < lo) | (st.leader_count[a] > hi)).any())
         lim = self.leader_nw_in_limit()
         out["LeaderBytesInDistributionGoal"] = bool(
-            (st.leader_nw_in[a] > lim + 1e-6).any())
+            (st.leader_nw_in[a] > lim + EPS[NW_IN]).any())
         return out
 
     # --------------------------------------------------------- legitimacy
@@ -241,7 +258,7 @@ class Oracle:
             else:
                 lo_u, hi_u = self.resource_bounds(r)
                 over = np.flatnonzero(self.alive
-                                      & (st.util[:, r] > hi_u + 1e-6))
+                                      & (st.util[:, r] > hi_u + EPS[r]))
                 key = st.util[:, r]
             if over.size == 0:
                 return
@@ -258,7 +275,7 @@ class Oracle:
                             break
                         key = st.replica_count
                     else:
-                        if st.util[b, r] <= hi_u + 1e-6:
+                        if st.util[b, r] <= hi_u + EPS[r]:
                             break
                         key = st.util[:, r]
                     dsts = np.flatnonzero(self.alive & ~self.excl_move)
@@ -281,7 +298,7 @@ class Oracle:
                 key = counts
             else:
                 under = np.flatnonzero(self.alive
-                                       & (st.util[:, r] < lo_u - 1e-6))
+                                       & (st.util[:, r] < lo_u - EPS[r]))
                 key = st.util[:, r]
             for b in under:
                 srcs = np.flatnonzero(self.alive)
@@ -334,7 +351,7 @@ class Oracle:
             moved = False
             if bytes_in:
                 lim = self.leader_nw_in_limit()
-                over = np.flatnonzero(self.alive & (st.leader_nw_in > lim + 1e-6))
+                over = np.flatnonzero(self.alive & (st.leader_nw_in > lim + EPS[NW_IN]))
                 key = st.leader_nw_in
             else:
                 lo, hi = self.count_bounds(
@@ -350,7 +367,7 @@ class Oracle:
                 for i in rows:
                     # drain until back under the limit
                     if bytes_in:
-                        if st.leader_nw_in[b] <= lim + 1e-6:
+                        if st.leader_nw_in[b] <= lim + EPS[NW_IN]:
                             break
                         key = st.leader_nw_in
                     else:
@@ -434,7 +451,7 @@ class Oracle:
         st = self.st
         for _ in range(passes):
             lim = self.cap[:, r] * self.c.capacity_threshold[r]
-            over = np.flatnonzero(self.alive & (st.util[:, r] > lim + 1e-6))
+            over = np.flatnonzero(self.alive & (st.util[:, r] > lim + EPS[r]))
             if over.size == 0:
                 return
             moved = False
@@ -444,7 +461,7 @@ class Oracle:
                                  self.foll_load[rows, r])
                 rows = rows[np.argsort(-loads)]
                 for i in rows:
-                    if st.util[b, r] <= lim[b] + 1e-6:
+                    if st.util[b, r] <= lim[b] + EPS[r]:
                         break
                     head = lim - st.util[:, r]
                     dsts = np.flatnonzero(self.alive & ~self.excl_move)

@@ -69,7 +69,19 @@ def main():
     rows = []
     worse = 0
     for seed in range(3200, 3200 + n):
-        row = run_seed(seed)
+        # the axon remote-compile service intermittently drops connections
+        # mid-compile; a retry resumes from the persistent compile cache
+        for attempt in range(3):
+            try:
+                row = run_seed(seed)
+                break
+            except Exception as e:
+                print(f"seed {seed} attempt {attempt}: {type(e).__name__}: "
+                      f"{str(e)[:120]}", flush=True)
+                time.sleep(5)
+        else:
+            print(f"seed {seed}: giving up after 3 attempts", flush=True)
+            continue
         rows.append(row)
         flag = "" if row["engine_violations"] <= row["oracle_violations"] else "  <-- ENGINE WORSE"
         print(f"seed {row['seed']}: initial={row['violations_initial']} "

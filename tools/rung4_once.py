@@ -1,0 +1,22 @@
+import os, time, sys
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cc_tpu")
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate_scale
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.analyzer.engine import EngineParams
+import dataclasses, json
+ov = json.loads(os.environ.get("CC_ENGINE_OVERRIDES", "{}"))
+ct, meta = generate_scale(RandomClusterSpec(
+    num_brokers=7000, num_racks=40, num_topics=2000,
+    num_partitions=500000, max_replication=3, skew=1.0, seed=3142,
+    target_cpu_util=0.45))
+opt = GoalOptimizer(engine_params=dataclasses.replace(EngineParams(), **ov))
+for i in range(int(sys.argv[1]) if len(sys.argv) > 1 else 2):
+    t0 = time.monotonic()
+    res = opt.optimizations(ct, meta, raise_on_failure=False,
+                            skip_hard_goal_check=True)
+    print(f"run {i}: {time.monotonic()-t0:.2f}s viol={len(res.violated_goals_after)} "
+          f"exhausted={[g.name for g in res.goal_results if g.hit_max_iters]} "
+          f"proven={[g.name for g in res.goal_results if g.violated_after and g.fixpoint_proven]}",
+          flush=True)

@@ -1,0 +1,53 @@
+"""Per-segment wall profile of the segmented chain at rung 4 (blocking)."""
+import os, time
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cc_tpu")
+import numpy as np
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate_scale
+from cruise_control_tpu.model.cluster_tensor import pad_cluster
+from cruise_control_tpu.analyzer.optimizer import (
+    GoalOptimizer, _compiled_prefix_chain, _compiled_chain_final)
+from cruise_control_tpu.analyzer.engine import optimize_goal
+from cruise_control_tpu.analyzer.env import make_env, padded_partition_table
+from cruise_control_tpu.analyzer.state import init_state
+
+ct, meta = generate_scale(RandomClusterSpec(
+    num_brokers=7000, num_racks=40, num_topics=2000,
+    num_partitions=500000, max_replication=3, skew=1.0, seed=3142,
+    target_cpu_util=0.45))
+opt = GoalOptimizer()
+ct, meta = pad_cluster(ct, meta)
+goals = opt._make_goal_objs(None) if hasattr(opt, '_make_goal_objs') else None
+from cruise_control_tpu.analyzer.goals import make_goals
+goals = make_goals(opt.default_goal_names, opt.constraint)
+params = opt._params
+import dataclasses
+params = dataclasses.replace(params)  # defaults as bench uses
+for rep in range(2):
+    env = make_env(ct, meta, partition_table=padded_partition_table(ct))
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    split = next((i for i, g in enumerate(goals)
+                  if getattr(g, "deep_tail", False)), len(goals))
+    t0 = time.monotonic()
+    st, out = _compiled_prefix_chain(tuple(type(g) for g in goals),
+                                     tuple(goals), split, params)(env, st)
+    jax.block_until_ready(st.util)
+    print(f"rep{rep} prefix({split} goals): {time.monotonic()-t0:.2f}s", flush=True)
+    prev = tuple(goals[:split])
+    for g in goals[split:]:
+        t0 = time.monotonic()
+        st, info = optimize_goal(env, st, g, prev, params)
+        jax.block_until_ready(st.util)
+        info = jax.device_get(info)
+        print(f"rep{rep} {g.name}: {time.monotonic()-t0:.2f}s passes={info['passes']} "
+              f"fin={info['finisher_rounds']} proven={info['fixpoint_proven']} "
+              f"m={info['moves_remaining']} l={info['leads_remaining']} "
+              f"sw={info['swap_window_remaining']}", flush=True)
+        prev = prev + (g,)
+    t0 = time.monotonic()
+    st, fin = _compiled_chain_final(tuple(type(g) for g in goals),
+                                    tuple(goals), None)(env, st)
+    out = jax.device_get(fin)
+    print(f"rep{rep} final: {time.monotonic()-t0:.2f}s", flush=True)
