@@ -619,77 +619,98 @@ def _disk_move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel
     return st, n_applied
 
 
+def _compact_eligible(eligible: Array, pad_len: int):
+    """(order i32[pad_len], n i32) — indices of True entries compacted to the
+    front (index order); tail padded with ``len(eligible)`` as a sentinel.
+    Cumsum + one scatter, no sort: the exhaustive scans sweep only the
+    eligible prefix, so their cost tracks the REMAINING work, not R."""
+    n = eligible.shape[0]
+    pos = jnp.cumsum(eligible.astype(jnp.int32)) - 1
+    order = jnp.full(pad_len, n, jnp.int32)
+    order = order.at[jnp.where(eligible, pos, pad_len)].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    return order, pos[-1] + 1
+
+
 def _exhaustive_move_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                           prev_goals: tuple, chunk: int):
-    """(gain f32[Rp], dst i32[Rp]) — every replica's best single-move gain
+    """(gain f32[R], dst i32[R]) — every replica's best single-move gain
     over ALL destinations under full legitimacy + chain acceptance (NEG_INF
     where none exists). Unlike the budgeted passes' top-K windows this scan
     is EXHAUSTIVE: zero positives here is a machine-checked certificate that
     no accepted positive-gain inter-broker move exists at this state.
-    Chunked [chunk, B] sweeps (one fori_loop, ~0.6 s at 1M x 7k)."""
+
+    The goal's move_score contract only covers its OWN candidate-eligible
+    replicas (replica_key > -inf) — e.g. the leader-count goal scores
+    assuming the candidate IS a leader; scoring outside the eligible set
+    would produce (and the finisher would APPLY) bogus actions. That same
+    contract makes the sweep compactable: eligible indices are packed to the
+    front and only ceil(n_eligible/chunk) [chunk, B] sweeps run (dynamic
+    trip count), so late finisher rounds — where the eligible set has
+    collapsed to the unconverged tail — pay milliseconds, not the full-R
+    ~0.6 s at 1M x 7k."""
     R = env.num_replicas
     chunk = min(chunk, R)
-    n_chunks = -(-R // chunk)
-    # the goal's move_score contract only covers its OWN candidate-eligible
-    # replicas (replica_key > -inf) — e.g. the leader-count goal scores
-    # assuming the candidate IS a leader; scoring outside the eligible set
-    # would produce (and the finisher would APPLY) bogus actions
     eligible = goal.replica_key(env, st, goal.broker_severity(env, st)) > NEG_INF
+    order, n_eligible = _compact_eligible(eligible, -(-R // chunk) * chunk)
 
     def body(i, carry):
         gain, dst = carry
         base = i * chunk
-        idx = base + jnp.arange(chunk, dtype=jnp.int32)
+        idx = jax.lax.dynamic_slice(order, (base,), (chunk,))
         cand = jnp.minimum(idx, R - 1)
         mask = legit_move_mask(env, st, cand, goal.options)
-        mask = mask & eligible[cand][:, None]
+        mask = mask & (idx < R)[:, None]     # sentinel / padded rows
         for g in prev_goals:
             mask = mask & g.accept_move(env, st, cand)
         score = jnp.where(mask, goal.move_score(env, st, cand), NEG_INF)
         d = jnp.argmax(score, axis=1).astype(jnp.int32)
         v = score[jnp.arange(chunk), d]
-        v = jnp.where(idx < R, v, NEG_INF)   # clamp-duplicated tail rows
-        gain = jax.lax.dynamic_update_slice(gain, v, (base,))
-        dst = jax.lax.dynamic_update_slice(dst, d, (base,))
+        # rows are scattered replica ids now — write back by id (sentinel
+        # rows index R -> dropped)
+        gain = gain.at[idx].set(v, mode="drop")
+        dst = dst.at[idx].set(d, mode="drop")
         return gain, dst
 
-    gain0 = jnp.full(n_chunks * chunk, NEG_INF, st.util.dtype)
-    dst0 = jnp.zeros(n_chunks * chunk, jnp.int32)
+    gain0 = jnp.full(R, NEG_INF, st.util.dtype)
+    dst0 = jnp.zeros(R, jnp.int32)
+    n_chunks = jnp.maximum(-(-n_eligible // chunk), 0)
     return jax.lax.fori_loop(0, n_chunks, body, (gain0, dst0))
 
 
 def _exhaustive_lead_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                           prev_goals: tuple, chunk: int):
-    """(gain f32[Rp], dst_rep i32[Rp]) — every leader's best leadership-
+    """(gain f32[R], dst_rep i32[R]) — every leader's best leadership-
     transfer gain over ALL its followers (exhaustive analogue of the
-    [KL, F] leadership branch)."""
+    [KL, F] leadership branch). Compacted over the goal's leader-key
+    eligible set exactly like `_exhaustive_move_scan`."""
     R = env.num_replicas
     chunk = min(chunk, R)
-    n_chunks = -(-R // chunk)
     # same eligibility contract as the move scan, via the goal's leader key
     eligible = goal.leader_key(env, st, goal.broker_severity(env, st)) > NEG_INF
+    order, n_eligible = _compact_eligible(eligible, -(-R // chunk) * chunk)
 
     def body(i, carry):
         gain, dst = carry
         base = i * chunk
-        idx = base + jnp.arange(chunk, dtype=jnp.int32)
+        idx = jax.lax.dynamic_slice(order, (base,), (chunk,))
         cand = jnp.minimum(idx, R - 1)
         mask = legit_leadership_mask(env, st, cand)
-        mask = mask & eligible[cand][:, None]
+        mask = mask & (idx < R)[:, None]
         for g in prev_goals:
             mask = mask & g.accept_leadership(env, st, cand)
         score = jnp.where(mask, goal.leadership_score(env, st, cand), NEG_INF)
         f = jnp.argmax(score, axis=1).astype(jnp.int32)
         v = score[jnp.arange(chunk), f]
-        v = jnp.where(idx < R, v, NEG_INF)
         members = env.partition_replicas[env.replica_partition[cand]]
         d = jnp.clip(members[jnp.arange(chunk), f], 0)
-        gain = jax.lax.dynamic_update_slice(gain, v, (base,))
-        dst = jax.lax.dynamic_update_slice(dst, d, (base,))
+        gain = gain.at[idx].set(v, mode="drop")
+        dst = dst.at[idx].set(d, mode="drop")
         return gain, dst
 
-    gain0 = jnp.full(n_chunks * chunk, NEG_INF, st.util.dtype)
-    dst0 = jnp.zeros(n_chunks * chunk, jnp.int32)
+    gain0 = jnp.full(R, NEG_INF, st.util.dtype)
+    dst0 = jnp.zeros(R, jnp.int32)
+    n_chunks = jnp.maximum(-(-n_eligible // chunk), 0)
     return jax.lax.fori_loop(0, n_chunks, body, (gain0, dst0))
 
 

@@ -196,7 +196,13 @@ def make_env(ct: ClusterTensor, meta: ClusterMeta,
     # mask (destinations limited to new brokers or the replica's own
     # original broker — GoalUtils.eligibleBrokers:163), not via this
     # broker-global mask
-    return ClusterEnv(
+    # device_put the WHOLE env once: most ClusterTensor leaves arrive as host
+    # numpy, and a jitted program re-uploads every numpy argument on EVERY
+    # execution — over a tunneled TPU that re-upload (~45 MB at the 1M rung)
+    # was measured at 60-600 ms per program launch, dominating the segmented
+    # chain and the small-cluster per-pass cost. Committed device buffers
+    # make each subsequent launch pass handles only.
+    return jax.device_put(ClusterEnv(
         leader_load=ct.leader_load,
         follower_load=ct.follower_load,
         broker_capacity=ct.broker_capacity,
@@ -221,7 +227,7 @@ def make_env(ct: ClusterTensor, meta: ClusterMeta,
         num_real_racks=jnp.asarray(meta.num_racks, jnp.int32),
         num_racks=bucket_size(meta.num_racks, 8),
         max_rf=int(table.shape[1]),
-    )
+    ))
 
 
 # ---------------------------------------------------------------------------

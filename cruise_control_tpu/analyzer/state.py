@@ -69,6 +69,9 @@ def init_state(env: ClusterEnv, replica_broker: Array, replica_is_leader: Array,
         moved=jnp.zeros(env.num_replicas, bool),
         leadership_moved=jnp.zeros(env.num_replicas, bool),
     )
+    # refresh is jitted, so every leaf of its output — including the numpy
+    # assignment arrays passed through — comes back as a committed device
+    # array (the env-side analogue needs an explicit device_put; see make_env)
     return refresh(env, st)
 
 

@@ -182,3 +182,66 @@ def test_fused_chain_matches_per_goal_programs():
                           np.asarray(rp.final_state.replica_is_leader))
     assert rf.stats_after == rp.stats_after
     assert abs(rf.balancedness_after - rp.balancedness_after) < 1e-12
+
+
+def test_compacted_exhaustive_scans_match_full_sweep():
+    """engine._exhaustive_{move,lead}_scan compact their sweeps to the
+    goal's eligible set (dynamic trip count); the result must be IDENTICAL
+    to a plain full-R sweep — the certificate's soundness rests on it."""
+    import jax
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer import engine as E
+    from cruise_control_tpu.analyzer.goals import make_goals
+    from cruise_control_tpu.analyzer.goals.base import (
+        NEG_INF, legit_leadership_mask, legit_move_mask,
+    )
+
+    ct, meta = generate(RandomClusterSpec(
+        num_brokers=12, num_racks=3, num_topics=8, num_partitions=200,
+        skew=1.0, seed=11))
+    from cruise_control_tpu.analyzer import init_state, make_env
+
+    opt = GoalOptimizer()
+    env = make_env(ct, meta)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    goals = make_goals(["RackAwareGoal", "DiskCapacityGoal",
+                        "CpuUsageDistributionGoal",
+                        "LeaderReplicaDistributionGoal"], opt.constraint)
+    R = env.num_replicas
+    for i, g in ((2, goals[2]), (3, goals[3])):
+        prev = tuple(goals[:i])
+        gain, dst = E._exhaustive_move_scan(env, st, g, prev, chunk=64)
+        # full-R reference sweep, no compaction
+        cand = jnp.arange(R, dtype=jnp.int32)
+        sev = g.broker_severity(env, st)
+        eligible = g.replica_key(env, st, sev) > NEG_INF
+        mask = legit_move_mask(env, st, cand, g.options) & eligible[:, None]
+        for p in prev:
+            mask = mask & p.accept_move(env, st, cand)
+        score = jnp.where(mask, g.move_score(env, st, cand), NEG_INF)
+        ref = jnp.max(score, axis=1)
+        np.testing.assert_array_equal(np.asarray(gain), np.asarray(ref))
+        # the id-indexed dst scatter must agree wherever a move exists
+        # (identical rows -> identical argmax tie-breaks)
+        pos = np.asarray(ref) > NEG_INF
+        np.testing.assert_array_equal(np.asarray(dst)[pos],
+                                      np.asarray(jnp.argmax(score, axis=1))[pos])
+
+        if g.uses_leadership_moves:
+            lgain, ldst = E._exhaustive_lead_scan(env, st, g, prev, chunk=64)
+            eligible = g.leader_key(env, st, sev) > NEG_INF
+            mask = legit_leadership_mask(env, st, cand) & eligible[:, None]
+            for p in prev:
+                mask = mask & p.accept_leadership(env, st, cand)
+            score = jnp.where(mask, g.leadership_score(env, st, cand), NEG_INF)
+            ref = jnp.max(score, axis=1)
+            np.testing.assert_array_equal(np.asarray(lgain), np.asarray(ref))
+            # dst is the chosen follower's replica id via the membership table
+            f = jnp.argmax(score, axis=1)
+            members = env.partition_replicas[env.replica_partition[cand]]
+            ref_dst = jnp.clip(members[cand, f], 0)
+            pos = np.asarray(ref) > NEG_INF
+            np.testing.assert_array_equal(np.asarray(ldst)[pos],
+                                          np.asarray(ref_dst)[pos])

@@ -1,0 +1,59 @@
+import sys, os
+sys.path.insert(0, "/root/repo")
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', '/tmp/jax_cache_cc_tpu')
+import jax, jax.numpy as jnp
+jax.config.update('jax_compilation_cache_dir', '/tmp/jax_cache_cc_tpu')
+import time
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate_scale
+from cruise_control_tpu.model.cluster_tensor import pad_cluster
+from cruise_control_tpu.analyzer.env import make_env, padded_partition_table, BalancingConstraint, OptimizationOptions, resource_balance_limits
+from cruise_control_tpu.analyzer.state import init_state
+from cruise_control_tpu.analyzer.goals import make_goals
+from cruise_control_tpu.analyzer.goals.base import broker_lookup, NEG_INF
+from cruise_control_tpu.analyzer.goals.capacity import RESOURCE_EPS
+
+shape = sys.argv[1] if len(sys.argv) > 1 else "r3"
+spec = (RandomClusterSpec(num_brokers=1000, num_racks=20, num_topics=400,
+                          num_partitions=50000, max_replication=3, skew=1.0,
+                          seed=3141, target_cpu_util=0.45) if shape == "r3" else
+        RandomClusterSpec(num_brokers=7000, num_racks=40, num_topics=2000,
+                          num_partitions=500000, max_replication=3, skew=1.0,
+                          seed=3142, target_cpu_util=0.45))
+ct, meta = generate_scale(spec)
+ct, meta = pad_cluster(ct, meta)
+env = make_env(ct, meta, partition_table=padded_partition_table(ct))
+st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                ct.replica_offline, ct.replica_disk)
+goal = make_goals(["DiskUsageDistributionGoal"], BalancingConstraint(), OptimizationOptions())[0]
+res = goal.resource
+print("R", ct.num_replicas, "B", ct.num_brokers, flush=True)
+
+def f_limits(env, st):
+    return goal._limits(env, st)
+
+def f_lookup(env, st):
+    lower, upper = goal._limits(env, st)
+    util = st.util[:, res]
+    return broker_lookup(st.replica_broker, util - upper, util, lower, upper)
+
+def f_eff(env, st):
+    return st.effective_load(env)[:, res]
+
+def f_headroom(env, st):
+    lower, upper = goal._limits(env, st)
+    util = st.util[:, res]
+    headroom = jnp.where(env.dst_candidate, upper - util, NEG_INF)
+    return jnp.max(headroom)
+
+def f_key(env, st):
+    return goal.replica_key(env, st, goal.broker_severity(env, st))
+
+for name, fn in (("limits", f_limits), ("lookup", f_lookup), ("eff_load", f_eff),
+                 ("headroom", f_headroom), ("key_full", f_key)):
+    f = jax.jit(fn)
+    r = f(env, st); jax.block_until_ready(jax.tree_util.tree_leaves(r)[0])
+    t0 = time.monotonic()
+    for _ in range(30):
+        r = f(env, st)
+    jax.block_until_ready(jax.tree_util.tree_leaves(r)[0])
+    print(f"{name}: {(time.monotonic()-t0)/30*1e3:.2f}ms", flush=True)

@@ -1,0 +1,39 @@
+import sys, os
+sys.path.insert(0, "/root/repo")
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', '/tmp/jax_cache_cc_tpu')
+import jax, jax.numpy as jnp
+jax.config.update('jax_compilation_cache_dir', '/tmp/jax_cache_cc_tpu')
+import time
+
+for R in (98304, 1048576):
+    key = jax.random.PRNGKey(0)
+    ll = jax.random.uniform(key, (R, 4))
+    fl = jax.random.uniform(key, (R, 4))
+    ll_t = jnp.asarray(ll.T)   # [4, R]
+    fl_t = jnp.asarray(fl.T)
+    lead = jax.random.uniform(key, (R,)) > 0.5
+    valid = jnp.ones(R, bool)
+
+    def f_orig(ll, fl, lead, valid):
+        load = jnp.where(lead[:, None], ll, fl)
+        return jnp.where(valid[:, None], load, 0.0)[:, 3]
+
+    def f_trans(ll_t, fl_t, lead, valid):
+        load = jnp.where(lead, ll_t[3], fl_t[3])
+        return jnp.where(valid, load, 0.0)
+
+    def f_col(ll, fl, lead, valid):
+        # column slices of [R,4] then 1-D where
+        load = jnp.where(lead, ll[:, 3], fl[:, 3])
+        return jnp.where(valid, load, 0.0)
+
+    for name, f, args in (("orig_RM", f_orig, (ll, fl, lead, valid)),
+                          ("trans_MR", f_trans, (ll_t, fl_t, lead, valid)),
+                          ("colslice", f_col, (ll, fl, lead, valid))):
+        g = jax.jit(f)
+        r = g(*args); jax.block_until_ready(r)
+        t0 = time.monotonic()
+        for _ in range(30):
+            r = g(*args)
+        jax.block_until_ready(r)
+        print(f"R={R} {name}: {(time.monotonic()-t0)/30*1e3:.2f}ms", flush=True)

@@ -1,0 +1,45 @@
+import sys, os
+sys.path.insert(0, "/root/repo")
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', '/tmp/jax_cache_cc_tpu')
+import jax, jax.numpy as jnp
+jax.config.update('jax_compilation_cache_dir', '/tmp/jax_cache_cc_tpu')
+import time, numpy as np
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate_scale
+from cruise_control_tpu.model.cluster_tensor import pad_cluster
+from cruise_control_tpu.analyzer.env import make_env, padded_partition_table
+from cruise_control_tpu.analyzer.state import init_state
+
+ct, meta = generate_scale(RandomClusterSpec(
+    num_brokers=1000, num_racks=20, num_topics=400, num_partitions=50000,
+    max_replication=3, skew=1.0, seed=3141, target_cpu_util=0.45))
+ct, meta = pad_cluster(ct, meta)
+env = make_env(ct, meta, partition_table=padded_partition_table(ct))
+st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                ct.replica_offline, ct.replica_disk)
+R = env.num_replicas
+
+def bench(name, f, *args):
+    g = jax.jit(f)
+    r = g(*args); jax.block_until_ready(r)
+    t0 = time.monotonic()
+    for _ in range(30):
+        r = g(*args)
+    jax.block_until_ready(r)
+    print(f"{name}: {(time.monotonic()-t0)/30*1e3:.2f}ms", flush=True)
+
+ll, fl = env.leader_load, env.follower_load
+lead, valid = st.replica_is_leader, env.replica_valid
+print("dtypes", ll.dtype, lead.dtype, valid.dtype, "shapes", ll.shape, flush=True)
+print("formats", ll.format.layout if hasattr(ll, 'format') else '?', flush=True)
+
+def f_eff(ll, fl, lead, valid):
+    load = jnp.where(lead[:, None], ll, fl)
+    return jnp.where(valid[:, None], load, 0.0)[:, 3]
+
+bench("real_arrays", f_eff, ll, fl, lead, valid)
+ll2, fl2 = jnp.array(np.asarray(ll)), jnp.array(np.asarray(fl))
+lead2, valid2 = jnp.array(np.asarray(lead)), jnp.array(np.asarray(valid))
+bench("roundtrip_copies", f_eff, ll2, fl2, lead2, valid2)
+bench("just_where_bool", lambda a, b: jnp.where(a, b[:, 3], 0.0), lead, ll)
+bench("just_colsum", lambda a, b: a[:, 3] + b[:, 3], ll, fl)
+bench("full_st_env_args", lambda env, st: st.effective_load(env)[:, 3], env, st)

@@ -1,0 +1,69 @@
+import sys, os
+sys.path.insert(0, "/root/repo")
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', '/tmp/jax_cache_cc_tpu')
+import jax, jax.numpy as jnp
+jax.config.update('jax_compilation_cache_dir', '/tmp/jax_cache_cc_tpu')
+import time, dataclasses
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate_scale
+from cruise_control_tpu.model.cluster_tensor import pad_cluster
+from cruise_control_tpu.analyzer.env import make_env, padded_partition_table, BalancingConstraint, OptimizationOptions
+from cruise_control_tpu.analyzer.state import init_state
+from cruise_control_tpu.analyzer.goals import make_goals
+from cruise_control_tpu.analyzer.goals.base import legit_move_mask, NEG_INF
+from cruise_control_tpu.analyzer import engine as E
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, _budget_scale
+
+shape = sys.argv[1] if len(sys.argv) > 1 else "r3"
+if shape == "r3":
+    spec = RandomClusterSpec(num_brokers=1000, num_racks=20, num_topics=400,
+                             num_partitions=50000, max_replication=3, skew=1.0,
+                             seed=3141, target_cpu_util=0.45)
+else:
+    spec = RandomClusterSpec(num_brokers=7000, num_racks=40, num_topics=2000,
+                             num_partitions=500000, max_replication=3, skew=1.0,
+                             seed=3142, target_cpu_util=0.45)
+ct, meta = generate_scale(spec)
+ct, meta = pad_cluster(ct, meta)
+opt = GoalOptimizer()
+params = dataclasses.replace(
+    opt._params,
+    num_candidates=min(1760, max(64, ct.num_brokers // 4, ct.num_replicas // 64)),
+    num_leader_candidates=min(1024, max(32, ct.num_brokers // 8)),
+    num_swap_candidates=max(32, ct.num_brokers // 32),
+    num_dst_choices=min(128, max(16, ct.num_brokers // 100)))
+K = params.num_candidates
+print("R", ct.num_replicas, "B", ct.num_brokers, "K", K, flush=True)
+env = make_env(ct, meta, partition_table=padded_partition_table(ct))
+st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                ct.replica_offline, ct.replica_disk)
+goal = make_goals(["DiskUsageDistributionGoal"], BalancingConstraint(), OptimizationOptions())[0]
+zero = jnp.int32(0)
+
+def stage_key(env, st):
+    sev = goal.broker_severity(env, st)
+    return E._stall_explore(goal.replica_key(env, st, sev), zero)
+
+def stage_topk(env, st):
+    key = stage_key(env, st)
+    return E._top_candidates(key, K, exact=goal.is_hard)
+
+def stage_score(env, st):
+    kv, cand = stage_topk(env, st)
+    mask = legit_move_mask(env, st, cand, goal.options)
+    score = jnp.where(mask & (kv > NEG_INF)[:, None],
+                      goal.move_score(env, st, cand), NEG_INF)
+    return score
+
+def stage_full(env, st):
+    sev = goal.broker_severity(env, st)
+    return E._move_branch_batched(env, st, goal, (), params, sev, zero)
+
+for name, fn in (("key", stage_key), ("key+topk", stage_topk),
+                 ("key+topk+score", stage_score), ("full_pass", stage_full)):
+    f = jax.jit(fn)
+    r = f(env, st); jax.block_until_ready(jax.tree_util.tree_leaves(r)[0])
+    t0 = time.monotonic()
+    for _ in range(20):
+        r = f(env, st)
+    jax.block_until_ready(jax.tree_util.tree_leaves(r)[0])
+    print(f"{name}: {(time.monotonic()-t0)/20*1e3:.1f}ms", flush=True)

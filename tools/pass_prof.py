@@ -1,0 +1,63 @@
+import sys, os as _os
+sys.path.insert(0, "/root/repo")
+import os, time, sys
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', '/tmp/jax_cache_cc_tpu')
+import jax, jax.numpy as jnp
+jax.config.update('jax_compilation_cache_dir', '/tmp/jax_cache_cc_tpu')
+import dataclasses
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate_scale
+from cruise_control_tpu.model.cluster_tensor import pad_cluster
+from cruise_control_tpu.analyzer.env import make_env, padded_partition_table, BalancingConstraint, OptimizationOptions
+from cruise_control_tpu.analyzer.state import init_state
+from cruise_control_tpu.analyzer.goals import make_goals
+from cruise_control_tpu.analyzer import engine as E
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, _budget_scale
+
+shape = sys.argv[1] if len(sys.argv) > 1 else "r3"
+if shape == "r3":
+    spec = RandomClusterSpec(num_brokers=1000, num_racks=20, num_topics=400,
+                             num_partitions=50000, max_replication=3, skew=1.0,
+                             seed=3141, target_cpu_util=0.45)
+else:
+    spec = RandomClusterSpec(num_brokers=7000, num_racks=40, num_topics=2000,
+                             num_partitions=500000, max_replication=3, skew=1.0,
+                             seed=3142, target_cpu_util=0.45)
+ct, meta = generate_scale(spec)
+ct, meta = pad_cluster(ct, meta)
+opt = GoalOptimizer()
+params = opt._scaled_params(ct) if hasattr(opt, '_scaled_params') else None
+if params is None:
+    params = dataclasses.replace(
+        opt._params,
+        num_candidates=min(1760, max(64, ct.num_brokers // 4, ct.num_replicas // 64)),
+        num_leader_candidates=min(1024, max(32, ct.num_brokers // 8)),
+        num_swap_candidates=max(32, ct.num_brokers // 32),
+        num_dst_choices=min(128, max(16, ct.num_brokers // 100)),
+        tail_pass_budget=min(1024, 64 * _budget_scale(ct) ** 2),
+        stall_retries=min(32, 8 * _budget_scale(ct)))
+print("R", ct.num_replicas, "B", ct.num_brokers, "K", params.num_candidates,
+      "T", params.num_dst_choices, "tail", params.tail_pass_budget, flush=True)
+env = make_env(ct, meta, partition_table=padded_partition_table(ct))
+st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                ct.replica_offline, ct.replica_disk)
+goals = make_goals(["DiskUsageDistributionGoal"], BalancingConstraint(), OptimizationOptions())
+goal = goals[0]
+
+zero = jnp.int32(0)
+@jax.jit
+def one_pass(env, st):
+    sev = goal.broker_severity(env, st)
+    return E._move_branch_batched(env, st, goal, (), params, sev, zero)
+
+@jax.jit
+def one_swap(env, st):
+    sev = goal.broker_severity(env, st)
+    return E._swap_branch_batched(env, st, goal, (), params, sev, zero)
+
+for name, fn in (("move_pass", one_pass), ("swap_pass", one_swap)):
+    t0=time.monotonic(); r = fn(env, st); jax.block_until_ready(r[0].util); tc=time.monotonic()-t0
+    t0 = time.monotonic()
+    for _ in range(20):
+        r = fn(env, st)
+    jax.block_until_ready(r[0].util)
+    print(f"{name}: compile+1={tc:.2f}s warm={(time.monotonic()-t0)/20*1e3:.1f}ms n={int(r[1])}", flush=True)
