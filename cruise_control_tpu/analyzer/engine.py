@@ -172,6 +172,37 @@ class EngineParams:
     finisher_swap_passes: int = 64
 
 
+# EngineParams is a JAX PYTREE: the pure BUDGET fields (loop caps, gain
+# threshold, plateau dials) are traced leaves, everything shape-affecting
+# (candidate-pool sizes, chunk sizes, subprogram gates) is static aux data.
+# The jitted engine programs take the params object as an ARGUMENT, so
+# changing a budget re-uses the compiled executable — before this split every
+# budget tweak (including the optimizer's per-cluster budget scaling) forced
+# a full recompile of every goal program, which dominated the bench ladder's
+# cold wall on the 1-core host (BENCH_r04: rung-2 cold 734 s, almost all
+# XLA compiles of budget-variant duplicates).
+_DYN_FIELDS = ("max_iters", "min_gain", "stall_retries", "tail_pass_budget",
+               "tail_total_budget", "sat_stall_retries", "sat_tail_passes",
+               "stat_window", "stat_slope_min")
+_STATIC_FIELDS = tuple(f.name for f in dataclasses.fields(EngineParams)
+                       if f.name not in _DYN_FIELDS)
+
+
+def _params_flatten(p: EngineParams):
+    return (tuple(getattr(p, f) for f in _DYN_FIELDS),
+            tuple(getattr(p, f) for f in _STATIC_FIELDS))
+
+
+def _params_unflatten(aux, children) -> EngineParams:
+    kw = dict(zip(_STATIC_FIELDS, aux))
+    kw.update(zip(_DYN_FIELDS, children))
+    return EngineParams(**kw)
+
+
+jax.tree_util.register_pytree_node(EngineParams, _params_flatten,
+                                   _params_unflatten)
+
+
 def _wave_budget_capable(g: GoalKernel, leadership: bool = False) -> bool:
     """Can multi-action waves preserve this goal's acceptance semantics?
     Yes when it provides cumulative budgets (per-broker or per-(topic,
@@ -756,28 +787,34 @@ def _finisher_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     kv_all, cand_all = jax.lax.top_k(gain[:env.num_replicas], K * W)  # exact
     severity = goal.broker_severity(env, st)
     zero_stall = jnp.int32(0)
-    total = jnp.int32(0)
-    go = jnp.bool_(True)
-    for w in range(W):
+
+    # ROLLED wave loop: one compiled wave body driven by a while_loop (the
+    # former W-way Python unroll multiplied the finisher subprogram's compile
+    # size by W and pinned W at 6); selection within later bands is stale but
+    # every application is re-scored exact against the live state, so W can
+    # be raised freely to amortize the exhaustive scan over more work. Exits
+    # early once a wave admits nothing.
+    def wave_body(carry):
+        s, w, total, _go = carry
         cand = jax.lax.dynamic_slice(cand_all, (w * K,), (K,))
         kv = jax.lax.dynamic_slice(kv_all, (w * K,), (K,))
-        kv = jnp.where((kv > params.min_gain) & go, kv, NEG_INF)
-
-        def wave_body(_i, carry, cand=cand, kv=kv):
-            s, _n = carry
-            if leadership:
-                return _leadership_branch_batched(
-                    env, s, goal, prev_goals, params, severity, zero_stall,
-                    cand=cand, kv=kv)
-            return _move_branch_batched(env, s, goal, prev_goals, params,
+        kv = jnp.where(kv > params.min_gain, kv, NEG_INF)
+        if leadership:
+            s, n = _leadership_branch_batched(
+                env, s, goal, prev_goals, params, severity, zero_stall,
+                cand=cand, kv=kv)
+        else:
+            s, n = _move_branch_batched(env, s, goal, prev_goals, params,
                                         severity, zero_stall,
                                         cand=cand, kv=kv)
+        return s, w + 1, total + n, n > 0
 
-        # 0/1-trip fori_loop keeps state aliasing (a cond would copy it)
-        st, n = jax.lax.fori_loop(0, jnp.where(go, 1, 0), wave_body,
-                                  (st, jnp.int32(0)))
-        total += n
-        go = go & (n > 0)
+    def wave_cond(carry):
+        _s, w, _total, go = carry
+        return go & (w < W)
+
+    st, _w, total, _go = jax.lax.while_loop(
+        wave_cond, wave_body, (st, jnp.int32(0), jnp.int32(0), jnp.bool_(True)))
     return st, total
 
 
@@ -896,24 +933,26 @@ def optimize_goal(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     it because each goal consumes the previous goal's output; without
     donation XLA preserves the inputs, which costs a full state copy
     (~hundreds of MB) per goal at 1M-replica scale."""
-    fn = _compiled_optimize(type(goal), goal, tuple(prev_goals), params,
-                            donate_state)
-    return fn(env, st)
+    fn = _compiled_optimize(type(goal), goal, tuple(prev_goals), donate_state)
+    return fn(env, st, params)
 
 
 @lru_cache(maxsize=256)
 def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
-                       params: EngineParams, donate_state: bool = False):
-    """Build + cache the jitted loop for a (goal, prev_goals, params) combo.
+                       donate_state: bool = False):
+    """Build + cache the jitted loop for a (goal, prev_goals) combo.
 
     Goals are frozen dataclasses, hashable by value, so the cache key is the
     full static configuration — the analogue of GoalOptimizer's per-goal
-    setup, paid once per goal config per process.
+    setup, paid once per goal config per process. EngineParams rides in as a
+    pytree ARGUMENT: its budget leaves are traced (budget changes reuse the
+    executable), its shape fields are static treedef data (jit retraces on
+    change).
     """
     del goal_cls  # participates in the cache key only
 
     @partial(jax.jit, donate_argnums=(1,) if donate_state else ())
-    def run(env: ClusterEnv, st: EngineState):
+    def run(env: ClusterEnv, st: EngineState, params: EngineParams):
         return _goal_loop(env, st, goal, prev_goals, params)
 
     return run
@@ -1042,11 +1081,12 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
     def cond_fn(carry):
         _st, it, _n, stall, dribble, sat, _ws, _wd, plateau, tailp = carry
+        # jnp.minimum, not min(): budget fields are traced pytree leaves
         stall_cap = jnp.where(
-            sat, min(params.stall_retries, params.sat_stall_retries),
+            sat, jnp.minimum(params.stall_retries, params.sat_stall_retries),
             params.stall_retries)
         tail_cap = jnp.where(
-            sat, min(params.tail_pass_budget, params.sat_tail_passes),
+            sat, jnp.minimum(params.tail_pass_budget, params.sat_tail_passes),
             params.tail_pass_budget)
         return ((stall <= stall_cap)
                 & (dribble <= tail_cap)

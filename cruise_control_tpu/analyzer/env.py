@@ -200,8 +200,10 @@ def make_env(ct: ClusterTensor, meta: ClusterMeta,
     # numpy, and a jitted program re-uploads every numpy argument on EVERY
     # execution — over a tunneled TPU that re-upload (~45 MB at the 1M rung)
     # was measured at 60-600 ms per program launch, dominating the segmented
-    # chain and the small-cluster per-pass cost. Committed device buffers
-    # make each subsequent launch pass handles only.
+    # chain and the small-cluster per-pass cost. The resulting on-device
+    # (uncommitted — no explicit device is passed) buffers make each
+    # subsequent launch pass handles only; nothing here relies on placement
+    # commitment, only on avoiding the per-launch host->device re-upload.
     return jax.device_put(ClusterEnv(
         leader_load=ct.leader_load,
         follower_load=ct.follower_load,

@@ -404,7 +404,7 @@ class GoalOptimizer:
             _tick.t0 = time.monotonic()
 
             st, out_dev = _compiled_prefix_chain(
-                gclasses, tuple(goals), split, params)(env, st)
+                gclasses, tuple(goals), split)(env, st, params)
             _tick(f"prefix({split})")
             tail_infos_dev = []
             prev = tuple(goals[:split])
@@ -550,16 +550,18 @@ class GoalOptimizer:
 
 
 @lru_cache(maxsize=64)
-def _compiled_prefix_chain(goal_classes: tuple, goals: tuple, split: int,
-                           params: EngineParams):
+def _compiled_prefix_chain(goal_classes: tuple, goals: tuple, split: int):
     """ONE jitted program for the chain's head: initial stats + EVERY
     goal's violated-before flag, then the loops of goals[:split] (the
-    goals without deep tails — they converge in bounded passes)."""
+    goals without deep tails — they converge in bounded passes).
+    EngineParams arrives as a traced-pytree argument (see engine.py): budget
+    changes — including the optimizer's per-cluster scaling — reuse the
+    compiled executable."""
     from cruise_control_tpu.analyzer.engine import _goal_loop
     del goal_classes  # cache key only
 
     @partial(jax.jit, donate_argnums=(1,))
-    def run(env: ClusterEnv, st: EngineState):
+    def run(env: ClusterEnv, st: EngineState, params: EngineParams):
         out = {"stats_before": _stats_device(env, st),
                "viol_before": [g.violated(env, st) for g in goals]}
         infos = []

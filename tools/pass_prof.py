@@ -1,6 +1,5 @@
-import sys, os as _os
-sys.path.insert(0, "/root/repo")
-import os, time, sys
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', '/tmp/jax_cache_cc_tpu')
 import jax, jax.numpy as jnp
 jax.config.update('jax_compilation_cache_dir', '/tmp/jax_cache_cc_tpu')

@@ -1,0 +1,79 @@
+"""Round-5 budget sweep at the headline rung.
+
+EngineParams' budget fields are traced pytree leaves now, so every config
+below shares ONE set of compiled programs — the sweep pays a single compile
+(usually a persistent-cache hit) and then ~25 s per warm config instead of
+~15 min of XLA recompiles per config on this 1-core host.
+
+Usage: python tools/r5_sweep.py [config ...]   (default: all)
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_cc_tpu")
+import dataclasses  # noqa: E402
+
+from cruise_control_tpu.analyzer.engine import EngineParams  # noqa: E402
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer  # noqa: E402
+from cruise_control_tpu.model.random_cluster import (  # noqa: E402
+    RandomClusterSpec, generate_scale,
+)
+
+CONFIGS = {
+    "default": {},
+    "tail48": {"tail_total_budget": 48, "tail_pass_budget": 32},
+    "tail16": {"tail_total_budget": 16, "tail_pass_budget": 16,
+               "stall_retries": 4},
+    "satlean": {"sat_tail_passes": 4, "sat_stall_retries": 1},
+    "slope": {"stat_window": 12, "stat_slope_min": 3e-3},
+    "lean": {"tail_total_budget": 48, "tail_pass_budget": 32,
+             "sat_tail_passes": 4, "sat_stall_retries": 1,
+             "stat_window": 12, "stat_slope_min": 3e-3},
+}
+
+
+def main():
+    names = sys.argv[1:] or list(CONFIGS)
+    print("generating rung-4 cluster...", flush=True)
+    ct, meta = generate_scale(RandomClusterSpec(
+        num_brokers=7000, num_racks=40, num_topics=2000,
+        num_partitions=500000, max_replication=3, skew=1.0, seed=3142,
+        target_cpu_util=0.45))
+    warmed = False
+    for name in names:
+        params = dataclasses.replace(EngineParams(), **CONFIGS[name])
+        opt = GoalOptimizer(engine_params=params)
+        runs = 2 if not warmed else 1   # first config warms the compile cache
+        for i in range(runs):
+            t0 = time.monotonic()
+            res = opt.optimizations(ct, meta, raise_on_failure=False,
+                                    skip_hard_goal_check=True)
+            wall = time.monotonic() - t0
+        warmed = True
+        out = {
+            "config": name,
+            "wall_s": round(wall, 2),
+            "violations_after": len(res.violated_goals_after),
+            "violated": res.violated_goals_after,
+            "exhausted": [g.name for g in res.goal_results if g.hit_max_iters],
+            "proven": [g.name for g in res.goal_results
+                       if g.violated_after and g.fixpoint_proven],
+            "moves": res.num_replica_movements,
+            "leads": res.num_leadership_movements,
+            "deep": {g.name[:12]: {"passes": g.passes,
+                                   "fin_rounds": g.finisher_rounds,
+                                   "actions": g.iterations}
+                     for g in res.goal_results
+                     if g.passes > 40 or g.finisher_rounds > 0},
+        }
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,7 @@ for rep in range(2):
                   if getattr(g, "deep_tail", False)), len(goals))
     t0 = time.monotonic()
     st, out = _compiled_prefix_chain(tuple(type(g) for g in goals),
-                                     tuple(goals), split, params)(env, st)
+                                     tuple(goals), split)(env, st, params)
     jax.block_until_ready(st.util)
     print(f"rep{rep} prefix({split} goals): {time.monotonic()-t0:.2f}s", flush=True)
     prev = tuple(goals[:split])
