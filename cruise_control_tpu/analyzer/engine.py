@@ -188,9 +188,27 @@ _STATIC_FIELDS = tuple(f.name for f in dataclasses.fields(EngineParams)
                        if f.name not in _DYN_FIELDS)
 
 
+# declared field type per name ("int" / "float" annotation strings under
+# `from __future__ import annotations`)
+_FIELD_TYPES = {f.name: (float if f.type == "float" else int)
+                for f in dataclasses.fields(EngineParams)}
+
+
+def _norm_leaf(name: str, v):
+    """Normalize a concrete leaf to its declared Python scalar type: numpy
+    ints/floats from config (or a float literal in an int budget) would
+    otherwise change the traced-leaf dtype and silently force a retrace of
+    every engine program (defeating warmup + the persistent cache). Tracers
+    and arrays pass through untouched."""
+    import numpy as _np
+    if isinstance(v, (bool, int, float, _np.integer, _np.floating)):
+        return _FIELD_TYPES[name](v)
+    return v
+
+
 def _params_flatten(p: EngineParams):
-    return (tuple(getattr(p, f) for f in _DYN_FIELDS),
-            tuple(getattr(p, f) for f in _STATIC_FIELDS))
+    return (tuple(_norm_leaf(f, getattr(p, f)) for f in _DYN_FIELDS),
+            tuple(_norm_leaf(f, getattr(p, f)) for f in _STATIC_FIELDS))
 
 
 def _params_unflatten(aux, children) -> EngineParams:
@@ -199,8 +217,13 @@ def _params_unflatten(aux, children) -> EngineParams:
     return EngineParams(**kw)
 
 
-jax.tree_util.register_pytree_node(EngineParams, _params_flatten,
-                                   _params_unflatten)
+try:
+    jax.tree_util.register_pytree_node(EngineParams, _params_flatten,
+                                       _params_unflatten)
+except ValueError:
+    # already registered: importlib.reload / repeated-import pytest modes
+    # re-execute this module against the live registry
+    pass
 
 
 def _wave_budget_capable(g: GoalKernel, leadership: bool = False) -> bool:

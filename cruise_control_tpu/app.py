@@ -95,6 +95,10 @@ class CruiseControl:
         # per-run option derivation
         self._options_generator = self.config.get_configured_instance(
             "optimization.options.generator.class")
+        # analyzer.warmup.on.start: compile the engine programs for the
+        # current cluster shape in the background at service startup
+        self._warmup_on_start = self.config.get_boolean(
+            "analyzer.warmup.on.start")
         self._wire_detectors()
         self._proposal_cache: OptimizerResult | None = None
         self._proposal_cache_generation = None
@@ -211,6 +215,50 @@ class CruiseControl:
         self.load_monitor.start_up()
         if proposal_precompute:
             self.start_proposal_precompute()
+            if self._warmup_on_start:
+                # service startup only (precompute path): unit tests calling
+                # bare start_up() must not get a compile thread underneath
+                threading.Thread(target=self._warmup_quietly,
+                                 name="engine-warmup", daemon=True).start()
+
+    def _warmup_quietly(self) -> None:
+        try:
+            import logging
+            logging.getLogger(__name__).info("engine warmup done: %s",
+                                             self.warmup())
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception(
+                "engine warmup failed (serving continues cold)")
+
+    def warmup(self, goal_names=None) -> dict:
+        """Pre-compile the engine programs for the CURRENT cluster's shape
+        (GoalOptimizer.warmup) — callable before any samples exist: shapes
+        come from backend metadata alone, so a freshly-booted service can pay
+        its trace/compile cost while the monitor is still filling windows.
+        Wired to startup via analyzer.warmup.on.start."""
+        snap_fn = getattr(self.backend, "snapshot", None)
+        if snap_fn is not None:
+            snap = snap_fn()
+        else:
+            from cruise_control_tpu.backend.interface import snapshot_from_metadata
+            snap = snapshot_from_metadata(self.backend.brokers(),
+                                          self.backend.partitions())
+        if not snap.num_replicas:
+            return {"skipped": "cluster has no replicas"}
+        nrep = np.diff(snap.rep_ptr)
+        out = self.goal_optimizer.warmup(
+            num_brokers=len(snap.broker_ids),
+            num_replicas=snap.num_replicas,
+            num_partitions=snap.num_partitions,
+            num_topics=max(len(snap.topics), 1),
+            num_racks=max(len(set(snap.broker_rack)), 1),
+            logdirs_per_broker=max((len(l) for l in snap.broker_logdirs),
+                                   default=1),
+            max_replication=int(nrep.max()),
+            goal_names=goal_names)
+        out["operation"] = "WARMUP"
+        return out
 
     def start_proposal_precompute(self) -> None:
         """num.proposal.precompute.threads background workers keep the

@@ -1,6 +1,8 @@
 from cruise_control_tpu.backend.interface import (
-    BrokerNode, ClusterBackend, PartitionInfo,
+    BrokerNode, ClusterBackend, ClusterSnapshot, PartitionInfo,
+    snapshot_from_metadata,
 )
 from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
 
-__all__ = ["BrokerNode", "ClusterBackend", "PartitionInfo", "SimulatedClusterBackend"]
+__all__ = ["BrokerNode", "ClusterBackend", "ClusterSnapshot", "PartitionInfo",
+           "SimulatedClusterBackend", "snapshot_from_metadata"]

@@ -14,6 +14,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Protocol
 
+import numpy as np
+
 
 @dataclasses.dataclass
 class BrokerNode:
@@ -42,12 +44,107 @@ class PartitionInfo:
     #                                     from replicas on alive brokers
 
 
+@dataclasses.dataclass
+class ClusterSnapshot:
+    """Columnar cluster metadata: the dense-array twin of ``partitions()``.
+
+    At 500k partitions the dict-of-PartitionInfo snapshot costs tens of
+    seconds of host time to build AND to consume (per-replica Python loops in
+    the model build); this carries the same information as flat numpy arrays
+    so the monitor can assemble a ClusterTensor with array joins.
+
+    Layout contracts (the model build and the dict path must stay
+    bit-identical):
+    - ``partition_keys`` is SORTED by (topic, partition); all per-partition
+      arrays and the CSR replica axis follow that order.
+    - replicas keep their metadata order (preferred leader first).
+    - ``rep_disk`` indexes each replica's logdir within its broker's
+      ``broker_logdirs`` row, which mirrors ``BrokerNode.logdirs`` order
+      (``["/logdir0"]`` when a broker reports none); replicas whose logdir is
+      unknown/unresolvable map to index 0, matching the dict path's fallback.
+    """
+    generation: int
+    topics: list                     # sorted topic names
+    partition_keys: list             # sorted [(topic, partition)]
+    partition_topic: np.ndarray      # i64[P] index into topics
+    partition_leader: np.ndarray     # i64[P] leader broker id (-1 = none)
+    rep_ptr: np.ndarray              # i64[P + 1] CSR offsets into the rep_* axes
+    rep_bid: np.ndarray              # i64[Rv] broker id per replica
+    rep_leader: np.ndarray           # bool[Rv] replica is the partition leader
+    rep_disk: np.ndarray             # i64[Rv] logdir index on its broker
+    broker_ids: np.ndarray           # i64[B] sorted broker ids
+    broker_alive: np.ndarray         # bool[B]
+    broker_rack: list                # [B] rack names
+    broker_logdirs: list             # [B] per-broker logdir name lists
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.partition_keys)
+
+    @property
+    def num_replicas(self) -> int:
+        return int(self.rep_bid.shape[0])
+
+
+def snapshot_from_metadata(brokers: dict, partitions: dict,
+                           generation: int = -1) -> ClusterSnapshot:
+    """Derive a ClusterSnapshot from the dict-shaped metadata — the default
+    shim for backends that do not maintain columnar state natively (e.g. the
+    RPC adapter). One tight pass over the partition dict instead of the
+    model build's former per-replica generator sweeps."""
+    tps = sorted(partitions)
+    P = len(tps)
+    broker_ids = np.asarray(sorted(brokers), dtype=np.int64)
+    broker_alive = np.asarray([brokers[b].alive for b in broker_ids], bool) \
+        if P or len(broker_ids) else np.zeros(0, bool)
+    broker_rack = [brokers[b].rack for b in broker_ids]
+    broker_logdirs = [list(brokers[b].logdirs) or ["/logdir0"]
+                      for b in broker_ids]
+    dix = {(int(b), ld): d for b, lds in zip(broker_ids, broker_logdirs)
+           for d, ld in enumerate(lds)}
+    topics: list = []
+    tindex: dict = {}
+    ptopic = np.empty(P, np.int64)
+    pleader = np.empty(P, np.int64)
+    nrep = np.empty(P, np.int64)
+    rep_bid: list = []
+    rep_leader: list = []
+    rep_disk: list = []
+    for i, tp in enumerate(tps):
+        info = partitions[tp]
+        t = tp[0]
+        ti = tindex.get(t)
+        if ti is None:
+            ti = tindex[t] = len(topics)
+            topics.append(t)
+        ptopic[i] = ti
+        pleader[i] = info.leader
+        nrep[i] = len(info.replicas)
+        ld_of = info.logdir_by_broker
+        for b in info.replicas:
+            rep_bid.append(b)
+            rep_leader.append(b == info.leader)
+            rep_disk.append(dix.get((b, ld_of.get(b)), 0))
+    rep_ptr = np.zeros(P + 1, np.int64)
+    np.cumsum(nrep, out=rep_ptr[1:])
+    # topics were discovered in sorted-key order, so they are already sorted
+    return ClusterSnapshot(
+        generation=generation, topics=topics, partition_keys=tps,
+        partition_topic=ptopic, partition_leader=pleader, rep_ptr=rep_ptr,
+        rep_bid=np.asarray(rep_bid, np.int64),
+        rep_leader=np.asarray(rep_leader, bool),
+        rep_disk=np.asarray(rep_disk, np.int64),
+        broker_ids=broker_ids, broker_alive=broker_alive,
+        broker_rack=broker_rack, broker_logdirs=broker_logdirs)
+
+
 class ClusterBackend(Protocol):
     """Everything the monitor/executor/detector layers need from the cluster."""
 
     # -- metadata (MetadataClient role) --
     def brokers(self) -> dict: ...                       # id -> BrokerNode
     def partitions(self) -> dict: ...                    # (topic, part) -> PartitionInfo
+    def snapshot(self) -> ClusterSnapshot: ...           # columnar metadata
     def metadata_generation(self) -> int: ...
 
     # -- metrics (metrics-reporter topic / Prometheus role) --

@@ -30,7 +30,9 @@ import sys
 import threading
 from dataclasses import asdict
 
-from cruise_control_tpu.backend.interface import BrokerNode, PartitionInfo
+from cruise_control_tpu.backend.interface import (
+    BrokerNode, PartitionInfo, snapshot_from_metadata,
+)
 
 
 class RpcError(Exception):
@@ -136,6 +138,27 @@ class RpcClusterBackend:
                 bytes_out_rate=info["bytes_out_rate"],
                 cpu_util=info["cpu_util"])
         return out
+
+    def snapshot(self):
+        """Columnar metadata (ClusterBackend.snapshot): derived client-side
+        from the wire ``brokers``/``partitions`` payloads via the default
+        shim, cached per metadata generation — the sidecar protocol stays
+        unchanged. A generation bump between the two wire reads retries once
+        so the arrays can never mix two metadata epochs."""
+        for _ in range(2):
+            gen = self._call("metadata_generation")
+            cached = getattr(self, "_snapshot_cache", None)
+            if cached is not None and cached[0] == gen:
+                return cached[1]
+            brokers = self.brokers()
+            partitions = self.partitions()
+            if self._call("metadata_generation") == gen:
+                snap = snapshot_from_metadata(brokers, partitions, gen)
+                self._snapshot_cache = (gen, snap)
+                return snap
+        # metadata churning: return the freshest derivation uncached
+        return snapshot_from_metadata(self.brokers(), self.partitions(),
+                                      self._call("metadata_generation"))
 
     def metadata_generation(self) -> int:
         return self._call("metadata_generation")

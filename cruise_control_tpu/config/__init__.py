@@ -2,11 +2,12 @@ from cruise_control_tpu.config.configdef import (
     Config, ConfigDef, ConfigException, ConfigKey, Importance, Type, resolve_class,
 )
 from cruise_control_tpu.config.defaults import (
-    CRUISE_CONTROL_CONFIG_DEF, DEFAULT_GOALS, DEFAULT_HARD_GOALS, cruise_control_config,
+    CRUISE_CONTROL_CONFIG_DEF, DEFAULT_GOALS, DEFAULT_HARD_GOALS,
+    configure_compilation_cache, cruise_control_config,
 )
 
 __all__ = [
     "Config", "ConfigDef", "ConfigException", "ConfigKey", "Importance", "Type",
     "resolve_class", "CRUISE_CONTROL_CONFIG_DEF", "DEFAULT_GOALS", "DEFAULT_HARD_GOALS",
-    "cruise_control_config",
+    "configure_compilation_cache", "cruise_control_config",
 ]

@@ -698,6 +698,30 @@ for _ep in _EndPoint:
 # --------------------------------------------------------------------------
 _D.define(name="tpu.mesh.axis.brokers", type=Type.INT, default=1, validator=at_least(1),
           doc="Device-mesh size along the candidate-destination (broker) axis for sharded scoring.")
+_D.define(name="jax.compilation.cache.dir", type=Type.STRING,
+          default="/tmp/jax_cache_cc_tpu",
+          doc="Persistent XLA compilation cache directory, applied at "
+              "GoalOptimizer construction (configure_compilation_cache): a "
+              "restarted process reloads its compiled goal programs instead "
+              "of re-tracing the whole chain. '' disables; an explicit "
+              "JAX_COMPILATION_CACHE_DIR env var / prior jax.config setup "
+              "always wins.")
+_D.define(name="jax.persistent.cache.min.entry.size.bytes", type=Type.LONG, default=0,
+          doc="Smallest compiled executable worth persisting (0 = keep all; "
+              "jax_persistent_cache_min_entry_size_bytes).")
+_D.define(name="jax.persistent.cache.min.compile.time.secs", type=Type.DOUBLE, default=1.0,
+          doc="Shortest compile worth persisting "
+              "(jax_persistent_cache_min_compile_time_secs).")
+_D.define(name="analyzer.warmup.on.start", type=Type.BOOLEAN, default=False,
+          doc="Pre-compile the bucketed engine programs for the current "
+              "cluster shape in a background thread at service startup "
+              "(GoalOptimizer.warmup): the first real proposal then runs at "
+              "warm speed instead of paying the full trace+compile wall.")
+_D.define(name="monitor.use.columnar.snapshot", type=Type.BOOLEAN, default=True,
+          doc="Build cluster models from the backend's columnar "
+              "ClusterSnapshot (array joins; seconds at 500k partitions) "
+              "instead of the per-partition metadata dict (legacy path, "
+              "kept for equivalence testing).")
 _D.define(name="tpu.donate.state", type=Type.BOOLEAN, default=False,
           doc="Donate engine state buffers between per-goal programs to halve "
               "peak HBM. Off by default: ownership transfer serializes the "
@@ -705,6 +729,40 @@ _D.define(name="tpu.donate.state", type=Type.BOOLEAN, default=False,
               "enable only when HBM-bound.")
 
 CRUISE_CONTROL_CONFIG_DEF = _D
+
+
+def configure_compilation_cache(config=None) -> bool:
+    """Library-level persistent-compile-cache setup (the jax.compilation.*
+    keys). Called from GoalOptimizer construction so EVERY process using the
+    library — the e2e service, not just bench.py — amortizes goal-program
+    compiles across restarts. Idempotent, and deliberately deferential: an
+    already-configured cache dir (JAX_COMPILATION_CACHE_DIR env var, which
+    jax folds into its config at import, or an explicit jax.config.update by
+    the host process) is never overridden. Returns True when this call
+    applied the config."""
+    import jax
+
+    if config is not None:
+        cache_dir = config.get_string("jax.compilation.cache.dir")
+        min_entry = int(config.get_int(
+            "jax.persistent.cache.min.entry.size.bytes"))
+        min_secs = float(config.get_double(
+            "jax.persistent.cache.min.compile.time.secs"))
+    else:
+        cache_dir = CRUISE_CONTROL_CONFIG_DEF.keys()[
+            "jax.compilation.cache.dir"].default
+        min_entry, min_secs = 0, 1.0
+    if getattr(configure_compilation_cache, "_done", False):
+        return False
+    configure_compilation_cache._done = True
+    if jax.config.jax_compilation_cache_dir:
+        return False        # env var / bench.py / conftest got there first
+    if not cache_dir:
+        return False
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", min_entry)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", min_secs)
+    return True
 
 
 def cruise_control_config(props=None, ignore_unknown: bool = False):

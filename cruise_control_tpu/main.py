@@ -283,6 +283,8 @@ def main(argv=None) -> int:
     # startUp order mirrors KafkaCruiseControl.startUp (:201-207): monitor
     # replay, sampling schedule, proposal precompute, anomaly detection,
     # then the web server (KafkaCruiseControl.java:201-207 start order)
+    # startUp also spawns the analyzer.warmup.on.start background compile
+    # thread (CruiseControl.start_up) — off the serving critical path
     cc.start_up(proposal_precompute=True)
     sampling = build_sampling_loop(cc, config)
     sampling.start()

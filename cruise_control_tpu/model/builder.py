@@ -172,7 +172,8 @@ class ClusterModelBuilder:
                           replica_is_leader: np.ndarray,
                           replica_offline: np.ndarray,
                           leader_load: np.ndarray, follower_load: np.ndarray,
-                          pad_replicas_to: int | None = None
+                          pad_replicas_to: int | None = None,
+                          partition_topic: np.ndarray | None = None
                           ) -> tuple[ClusterTensor, ClusterMeta]:
         """Vectorized assembly: topology from prior ``add_broker`` calls,
         replica population directly from dense arrays — the monitor's fast
@@ -183,12 +184,17 @@ class ClusterModelBuilder:
         (topic, partition) IN the order the arrays were built against);
         ``replica_broker`` is an INDEX into sorted broker ids;
         ``replica_disk`` an index into that broker's logdir list.
+        ``partition_topic`` (optional, i-ints[P]) is each partition's index
+        into the SORTED ``topics`` list — a caller that already holds it (the
+        columnar snapshot path) skips the per-partition dict lookups here.
         """
         if not self._brokers:
             raise ValueError("no brokers")
         broker_ids = sorted(self._brokers)
         racks = sorted({s.rack for s in self._brokers.values()})
         ridx = {r: i for i, r in enumerate(racks)}
+        given_topics = list(topics)
+        given_partition_topic = partition_topic
         topics = sorted(set(topics) | self._excluded_topics)
         tidx = {t: i for i, t in enumerate(topics)}
 
@@ -211,7 +217,12 @@ class ClusterModelBuilder:
             bad = int(np.argmax(leaders_per_part > 1))
             raise ValueError(f"two leaders for {partitions[bad]}")
 
-        if partitions:
+        if (given_partition_topic is not None and partitions
+                and topics == sorted(set(given_topics))):
+            # the caller's indices are valid iff excluded topics didn't
+            # change the sorted topic list
+            partition_topic = np.asarray(given_partition_topic, np.int32)
+        elif partitions:
             partition_topic = np.fromiter(
                 (tidx[t] for t, _ in partitions), dtype=np.int32,
                 count=len(partitions))
@@ -297,25 +308,51 @@ class ClusterModelBuilder:
         leader_load = np.zeros((R, M), np.float32)
         follower_load = np.zeros((R, M), np.float32)
 
-        leaders_seen: dict[int, int] = {}
-        for j, r in enumerate(self._replicas):
-            s = specs[r.broker_id]
-            replica_broker[j] = bidx[r.broker_id]
-            if r.logdir is not None:
-                replica_disk[j] = s.logdirs.index(r.logdir)
-            p = pidx[(r.topic, r.partition)]
-            replica_partition[j] = p
-            replica_topic[j] = tidx[r.topic]
-            replica_is_leader[j] = r.is_leader
-            if r.is_leader:
-                if p in leaders_seen:
-                    raise ValueError(f"two leaders for {r.topic}-{r.partition}")
-                leaders_seen[p] = j
-            replica_valid[j] = True
-            dead_disk = s.logdirs[replica_disk[j]] in s.dead_disks
-            replica_offline[j] = r.offline or (not s.alive) or dead_disk
-            leader_load[j] = r.leader_load
-            follower_load[j] = r.follower_load
+        if R_valid:
+            # one attribute-extraction pass over the replica specs, then
+            # vectorized index math — the per-replica Python loop cost
+            # minutes at the 1M-replica scale this path sees in tests/tools
+            reps = self._replicas
+            dix = {(b, ld): d for b, s in specs.items()
+                   for d, ld in enumerate(s.logdirs)}
+            replica_broker[:R_valid] = np.fromiter(
+                (bidx[r.broker_id] for r in reps), np.int32, R_valid)
+            try:
+                replica_disk[:R_valid] = np.fromiter(
+                    (0 if r.logdir is None else dix[(r.broker_id, r.logdir)]
+                     for r in reps), np.int32, R_valid)
+            except KeyError as e:   # match list.index's ValueError contract
+                raise ValueError(f"unknown logdir for replica: {e}") from None
+            replica_partition[:R_valid] = np.fromiter(
+                (pidx[(r.topic, r.partition)] for r in reps), np.int32,
+                R_valid)
+            replica_topic[:R_valid] = np.fromiter(
+                (tidx[r.topic] for r in reps), np.int32, R_valid)
+            replica_is_leader[:R_valid] = np.fromiter(
+                (r.is_leader for r in reps), bool, R_valid)
+            replica_valid[:R_valid] = True
+            leaders_per_part = np.bincount(
+                replica_partition[:R_valid][replica_is_leader[:R_valid]],
+                minlength=P)
+            if (leaders_per_part > 1).any():
+                bad = partitions[int(np.argmax(leaders_per_part > 1))]
+                raise ValueError(f"two leaders for {bad[0]}-{bad[1]}")
+            # per-(broker, disk) deadness table shared by all replicas
+            sorted_specs = [specs[b] for b in broker_ids]
+            D = max(len(s.logdirs) for s in sorted_specs)
+            dead_tbl = np.zeros((len(broker_ids), D), bool)
+            alive_tbl = np.zeros(len(broker_ids), bool)
+            for i, s in enumerate(sorted_specs):
+                alive_tbl[i] = s.alive
+                for d, ld in enumerate(s.logdirs):
+                    dead_tbl[i, d] = ld in s.dead_disks
+            flagged = np.fromiter((r.offline for r in reps), bool, R_valid)
+            rb = replica_broker[:R_valid]
+            replica_offline[:R_valid] = (
+                flagged | ~alive_tbl[rb]
+                | dead_tbl[rb, replica_disk[:R_valid]])
+            leader_load[:R_valid] = [r.leader_load for r in reps]
+            follower_load[:R_valid] = [r.follower_load for r in reps]
         # padded rows point at broker 0 but are masked everywhere by replica_valid
 
         partition_topic = np.zeros(P, np.int32)

@@ -361,3 +361,75 @@ def min_leader_unsatisfiable():
     each requiring a leader -> impossible."""
     T = TOPIC_MIN_LEADER
     return _min_leader_cluster({(T, 0): (0, [2]), (T, 1): (0, [1])})
+
+
+def synthetic_cluster(num_brokers: int, num_replicas: int,
+                      num_partitions: int | None = None,
+                      num_topics: int = 8, num_racks: int = 4,
+                      logdirs_per_broker: int = 1,
+                      max_replication: int | None = None):
+    """Shape-accurate throwaway cluster for GoalOptimizer.warmup: the engine
+    programs are compiled per PADDED shape bucket, so a synthetic cluster
+    with the same broker/replica/partition/topic counts (plus rack count,
+    logdir width and max RF — the remaining static axes) compiles exactly
+    the programs a real cluster of that shape will execute. Built fully
+    vectorized: warmup must not reintroduce the host-side build cost it
+    exists to hide.
+
+    Loads are smooth and non-degenerate (every resource non-zero) so the
+    compiled programs are the generic ones, but warmup runs them under
+    near-zero traced budgets — the values never matter."""
+    num_partitions = num_partitions or max(1, num_replicas // 2)
+    P = min(num_partitions, num_replicas)
+    R = max(num_replicas, P)
+    B = max(num_brokers, 1)
+    F = min(max_replication or -(-R // P), B)
+    if R > P * F:
+        raise ValueError(f"{R} replicas do not fit {P} partitions at RF<={F}")
+    b = ClusterModelBuilder()
+    for i in range(B):
+        b.add_broker(i, rack=f"rack{i % max(num_racks, 1)}",
+                     logdirs=[f"/d{j}" for j in range(max(logdirs_per_broker, 1))])
+    nrep = np.full(P, R // P, np.int64)
+    nrep[:R % P] += 1
+    # guarantee the max-RF static axis: bump the first partition to F by
+    # stealing surplus replicas from the tail
+    need = int(F - nrep[0])
+    if need > 0:
+        donors = np.flatnonzero(nrep[1:] > 1)[::-1][:need] + 1
+        if donors.size < need:
+            need = int(donors.size)
+        nrep[donors[:need]] -= 1
+        nrep[0] += need
+    rep_ptr = np.zeros(P + 1, np.int64)
+    np.cumsum(nrep, out=rep_ptr[1:])
+    rep_part = np.repeat(np.arange(P, dtype=np.int64), nrep)
+    rank = np.arange(R, dtype=np.int64) - rep_ptr[rep_part]
+    rep_bidx = ((rep_part + rank) % B).astype(np.int64)
+    rep_disk = ((rep_part + rank) % max(logdirs_per_broker, 1)).astype(np.int64)
+    rep_leader = rank == 0
+    M = len(Resource)
+    leader_load = np.zeros((R, M), np.float32)
+    leader_load[:, Resource.CPU] = 0.5 + (rep_part % 7) * 0.1
+    leader_load[:, Resource.NW_IN] = 5.0 + (rep_part % 11)
+    leader_load[:, Resource.NW_OUT] = 10.0 + (rep_part % 13)
+    leader_load[:, Resource.DISK] = 50.0 + (rep_part % 17) * 10.0
+    follower_load = leader_load.copy()
+    follower_load[:, Resource.CPU] *= 0.5
+    follower_load[:, Resource.NW_OUT] = 0.0
+    T = max(num_topics, 1)
+    topics = [f"warmup{t}" for t in range(T)]
+    partitions = [(topics[p % T], p) for p in range(P)]
+    partition_topic = np.arange(P, dtype=np.int64) % T
+    # topic names sort lexicographically only up to 10 topics; recompute
+    # indices against the sorted list the builder will use
+    order = sorted(range(T), key=topics.__getitem__)
+    remap = np.empty(T, np.int64)
+    remap[order] = np.arange(T)
+    return b.build_from_arrays(
+        topics=sorted(topics), partitions=partitions,
+        replica_partition=rep_part, replica_broker=rep_bidx,
+        replica_disk=rep_disk, replica_is_leader=rep_leader,
+        replica_offline=np.zeros(R, bool),
+        leader_load=leader_load, follower_load=follower_load,
+        partition_topic=remap[partition_topic])

@@ -226,8 +226,13 @@ class MetricSampleAggregator:
             if self._current_window is not None and window < self._oldest_window:
                 return 0
             self._roll_to(max(window, self._current_window or window))
-            rows = _np.fromiter((self._entity_row(e) for e in entities),
-                                dtype=_np.int64, count=n)
+            try:
+                # steady state: every entity is known — C-speed dict gets
+                rows = _np.fromiter(map(self._entities.__getitem__, entities),
+                                    dtype=_np.int64, count=n)
+            except KeyError:
+                rows = _np.fromiter((self._entity_row(e) for e in entities),
+                                    dtype=_np.int64, count=n)
             slot = (window - self._oldest_window
                     if window < self._current_window else self._num_windows)
             if slot < 0:
@@ -235,14 +240,24 @@ class MetricSampleAggregator:
             cols = _np.asarray([self._metric_def.info(m).metric_id
                                 for m in metric_names], dtype=_np.int64)
             values = _np.asarray(values, dtype=float)
-            # np.*.at: duplicate entities within one batch accumulate
-            # exactly like repeated add_sample calls would
-            _np.add.at(self._sum[:, slot, :],
-                       (rows[:, None], cols[None, :]), values)
-            _np.maximum.at(self._max[:, slot, :],
-                           (rows[:, None], cols[None, :]), values)
-            self._latest[rows[:, None], slot, cols[None, :]] = values
-            _np.add.at(self._counts[:, slot], rows, 1)
+            idx = (rows[:, None], cols[None, :])
+            if _np.unique(rows).size == n:
+                # the common columnar round: ONE sample per entity — plain
+                # fancy indexing instead of the (much slower) ufunc.at
+                # scatter; both slices are views, writes land in the ring
+                ssum = self._sum[:, slot, :]
+                smax = self._max[:, slot, :]
+                ssum[idx] += values
+                smax[idx] = _np.maximum(smax[idx], values)
+                self._latest[rows[:, None], slot, cols[None, :]] = values
+                self._counts[rows, slot] += 1
+            else:
+                # np.*.at: duplicate entities within one batch accumulate
+                # exactly like repeated add_sample calls would
+                _np.add.at(self._sum[:, slot, :], idx, values)
+                _np.maximum.at(self._max[:, slot, :], idx, values)
+                self._latest[rows[:, None], slot, cols[None, :]] = values
+                _np.add.at(self._counts[:, slot], rows, 1)
             self._dirty = True
             return n
 

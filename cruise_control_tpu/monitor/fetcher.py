@@ -83,6 +83,7 @@ class MetricFetcherManager:
                             "samples: %s", e)
                 continue
             merged.partition_samples.extend(s.partition_samples)
+            merged.partition_blocks.extend(s.partition_blocks)
             for bs in s.broker_samples:
                 key = (bs.broker_id, bs.ts_ms)
                 if key not in broker_seen:

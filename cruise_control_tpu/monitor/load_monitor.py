@@ -153,12 +153,31 @@ class LoadMonitor:
                                      if config else 300_000)
         self._partition_list_cache: list | None = None
         self._partition_list_ts = -1e18
+        # monitor.use.columnar.snapshot: consume the backend's columnar
+        # ClusterSnapshot in cluster_model (the dict path stays available for
+        # equivalence testing / exotic backends)
+        self._use_snapshot = (config.get_boolean("monitor.use.columnar.snapshot")
+                              if config else True)
+        # (partition -> index) map reused across model builds, keyed by the
+        # snapshot's metadata generation
+        self._pidx_cache: tuple | None = None
         # an extra store recording samples DURING execution
         # (sample.partition.metric.store.on.execution.class); consulted by
         # samplers via on_execution_store
         self.on_execution_store = (config.get_configured_instance(
             "sample.partition.metric.store.on.execution.class")
             if config else None)
+
+    def _snapshot(self):
+        """Columnar metadata: the backend's native ``snapshot()`` when it has
+        one, else derived from the dict metadata via the protocol shim."""
+        snap_fn = getattr(self._backend, "snapshot", None)
+        if snap_fn is not None:
+            return snap_fn()
+        from cruise_control_tpu.backend.interface import snapshot_from_metadata
+        return snapshot_from_metadata(self._backend.brokers(),
+                                      self._backend.partitions(),
+                                      self._backend.metadata_generation())
 
     def _metadata_factor(self) -> float:
         if self._backend is None:
@@ -170,10 +189,10 @@ class LoadMonitor:
         cached = getattr(self, "_metadata_factor_cache", None)
         if cached is not None and now - cached[0] < self._metadata_max_age_ms:
             return cached[1]
-        parts = self._backend.partitions()
-        num_replicas = sum(len(p.replicas) for p in parts.values())
-        brokers_with = {b for p in parts.values() for b in p.replicas}
-        value = num_replicas * (len(brokers_with)
+        snap = self._snapshot()
+        num_replicas = snap.num_replicas
+        brokers_with = np.unique(snap.rep_bid).size
+        value = num_replicas * (brokers_with
                                 ** self._metadata_factor_exponent)
         self._metadata_factor_cache = (now, value)
         return value
@@ -310,7 +329,11 @@ class LoadMonitor:
         if self._fetchers is not None and self._backend is not None:
             if (self._partition_list_cache is None
                     or now - self._partition_list_ts >= self._metadata_max_age_ms):
-                self._partition_list_cache = list(self._backend.partitions())
+                # the columnar snapshot carries the sorted key list already —
+                # no need to materialize the PartitionInfo dict for it
+                self._partition_list_cache = (
+                    list(self._snapshot().partition_keys) if self._use_snapshot
+                    else list(self._backend.partitions()))
                 self._partition_list_ts = now
             samples = self._fetchers.fetch_once(now, self._partition_list_cache)
         else:
@@ -327,6 +350,12 @@ class LoadMonitor:
 
     def _ingest(self, samples: Samples) -> int:
         n = 0
+        # columnar blocks (one per sampling round on the fast path) feed the
+        # aggregator's bulk scatter directly — zero per-partition objects
+        for block in getattr(samples, "partition_blocks", ()):
+            n += self._partition_agg.add_samples(block.entities, block.ts_ms,
+                                                 block.values,
+                                                 list(block.metric_names))
         n += self._ingest_bulk(self._partition_agg, samples.partition_samples,
                                lambda s: (s.topic, s.partition))
         n += self._ingest_bulk(self._broker_agg, samples.broker_samples,
@@ -376,31 +405,70 @@ class LoadMonitor:
     def num_valid_windows(self) -> int:
         return len(self._partition_agg.aggregate().window_starts_ms)
 
+    def _num_partitions(self) -> int:
+        if self._backend is None:
+            return 0
+        if self._use_snapshot:
+            return self._snapshot().num_partitions
+        return len(self._backend.partitions())
+
     def monitored_partitions_percentage(self) -> float:
         agg = self._partition_agg.aggregate()
-        total = len(self._backend.partitions()) if self._backend else len(agg.entities)
+        total = self._num_partitions() if self._backend else len(agg.entities)
         if total == 0:
             return 0.0
         return float(agg.entity_valid.sum()) / total
 
     # --------------------------------------------------------------- model
+    def _entity_rows(self, agg, tps: list, generation: int) -> np.ndarray:
+        """i64[P]: aggregator entity row for each partition key (-1 when the
+        partition was never sampled). The (partition -> index) dict is cached
+        per metadata generation — at 500k partitions rebuilding it every
+        model build is the dominant remaining Python cost."""
+        cached = self._pidx_cache
+        if cached is not None and cached[0] == (generation, len(tps)):
+            pidx = cached[1]
+        else:
+            pidx = {tp: i for i, tp in enumerate(tps)}
+            self._pidx_cache = ((generation, len(tps)), pidx)
+        rows = np.full(len(tps), -1, np.int64)
+        get = pidx.get
+        for j, e in enumerate(agg.entities):
+            i = get(e)
+            if i is not None:
+                rows[i] = j
+        return rows
+
     def cluster_model(self, requirements: ModelCompletenessRequirements | None = None,
-                      allow_capacity_estimation: bool = True):
+                      allow_capacity_estimation: bool = True,
+                      use_snapshot: bool | None = None):
         """Build (ClusterTensor, ClusterMeta) from current metadata + windows
-        (LoadMonitor.clusterModel :539-591)."""
+        (LoadMonitor.clusterModel :539-591).
+
+        ``use_snapshot`` overrides monitor.use.columnar.snapshot: True builds
+        from the backend's columnar ClusterSnapshot (array joins end to end),
+        False from the legacy ``partitions()`` dict (per-replica generator
+        loops) — both produce bit-identical tensors."""
         if self._backend is None:
             raise RuntimeError("LoadMonitor has no cluster backend")
         req = requirements or ModelCompletenessRequirements()
+        use_snap = self._use_snapshot if use_snapshot is None else use_snapshot
         with self._model_timer.time(), self._model_semaphore:
             agg = self._partition_agg.aggregate()
             if len(agg.window_starts_ms) < req.min_required_num_windows:
                 raise NotEnoughValidWindowsError(
                     f"{len(agg.window_starts_ms)} valid windows < required "
                     f"{req.min_required_num_windows}")
-            partitions = self._backend.partitions()
-            if partitions:
-                valid_frac = (float(agg.entity_valid.sum()) / len(partitions)
-                              if len(partitions) else 0.0)
+            snap = None
+            partitions = None
+            if use_snap:
+                snap = self._snapshot()
+                num_partitions = snap.num_partitions
+            else:
+                partitions = self._backend.partitions()
+                num_partitions = len(partitions)
+            if num_partitions:
+                valid_frac = float(agg.entity_valid.sum()) / num_partitions
                 if valid_frac < req.min_monitored_partitions_percentage:
                     raise NotEnoughValidWindowsError(
                         f"monitored partition ratio {valid_frac:.3f} < required "
@@ -491,12 +559,18 @@ class LoadMonitor:
 
             # map entity rows -> the (sorted) partition list, then flatten the
             # per-partition replica lists into dense arrays
-            tps = sorted(partitions)
-            infos = [partitions[tp] for tp in tps]
-            P = len(tps)
-            row_of = {e: i for i, e in enumerate(agg.entities)}
-            rows = np.fromiter((row_of.get(tp, -1) for tp in tps),
-                               dtype=np.int64, count=P)
+            if use_snap:
+                tps = snap.partition_keys
+                infos = None
+                P = num_partitions
+                rows = self._entity_rows(agg, tps, snap.generation)
+            else:
+                tps = sorted(partitions)
+                infos = [partitions[tp] for tp in tps]
+                P = len(tps)
+                row_of = {e: i for i, e in enumerate(agg.entities)}
+                rows = np.fromiter((row_of.get(tp, -1) for tp in tps),
+                                   dtype=np.int64, count=P)
             has = rows >= 0
             rr = np.clip(rows, 0, None)
 
@@ -524,22 +598,31 @@ class LoadMonitor:
                     dixmap[(b, ld)] = d
                     dead_arr[bi, d] = ld in dead
 
-            nrep = np.fromiter((len(i.replicas) for i in infos),
-                               dtype=np.int64, count=P)
+            if use_snap:
+                # the snapshot already carries the flattened replica axis;
+                # its rep_disk indices follow BrokerNode.logdirs order — the
+                # same order lds_by_broker/dixmap were built from
+                nrep = np.diff(snap.rep_ptr)
+                rep_bid = snap.rep_bid
+                rep_leader = snap.rep_leader
+                rep_disk = np.minimum(snap.rep_disk, Dmax - 1)
+            else:
+                nrep = np.fromiter((len(i.replicas) for i in infos),
+                                   dtype=np.int64, count=P)
+                rep_bid = np.fromiter((b for i in infos for b in i.replicas),
+                                      dtype=np.int64, count=int(nrep.sum()))
+                rep_leader = np.fromiter(
+                    (b == i.leader for i in infos for b in i.replicas),
+                    dtype=bool, count=int(nrep.sum()))
+                # logdir index per replica; unknown/unassigned dirs default to
+                # index 0 INCLUDING its deadness (a replica whose logdir we
+                # can't resolve on a broker whose first dir is dead must stay
+                # self-healing-eligible)
+                rep_disk = np.fromiter(
+                    (dixmap.get((b, i.logdir_by_broker.get(b)), 0)
+                     for i in infos for b in i.replicas),
+                    dtype=np.int64, count=int(nrep.sum()))
             rep_part = np.repeat(np.arange(P, dtype=np.int64), nrep)
-            rep_bid = np.fromiter((b for i in infos for b in i.replicas),
-                                  dtype=np.int64, count=int(nrep.sum()))
-            rep_leader = np.fromiter(
-                (b == i.leader for i in infos for b in i.replicas),
-                dtype=bool, count=int(nrep.sum()))
-            # logdir index per replica; unknown/unassigned dirs default to
-            # index 0 INCLUDING its deadness (a replica whose logdir we can't
-            # resolve on a broker whose first dir is dead must stay
-            # self-healing-eligible)
-            rep_disk = np.fromiter(
-                (dixmap.get((b, i.logdir_by_broker.get(b)), 0)
-                 for i in infos for b in i.replicas),
-                dtype=np.int64, count=int(nrep.sum()))
             rep_bidx = np.searchsorted(sorted_bids, rep_bid)
             # a replica on a broker id absent from brokers() is metadata
             # corruption — fail loudly (the pre-vectorized path's KeyError)
@@ -562,13 +645,19 @@ class LoadMonitor:
             follower_load[:, Resource.CPU] = fcpu_p[rep_part]
             follower_load[:, Resource.NW_OUT] = 0.0
 
-            topics = sorted({t for t, _ in tps})
+            if use_snap:
+                topics = list(snap.topics)
+                partition_topic = snap.partition_topic
+            else:
+                topics = sorted({t for t, _ in tps})
+                partition_topic = None
             return builder.build_from_arrays(
                 topics=topics, partitions=tps,
                 replica_partition=rep_part, replica_broker=rep_bidx,
                 replica_disk=rep_disk, replica_is_leader=rep_leader,
                 replica_offline=rep_offline,
-                leader_load=leader_load, follower_load=follower_load)
+                leader_load=leader_load, follower_load=follower_load,
+                partition_topic=partition_topic)
 
     # ---------------------------------------------------------------- state
     def state_json(self) -> dict:
@@ -597,7 +686,7 @@ class LoadMonitor:
             "numMonitoredWindows": len(agg.window_starts_ms),
             "monitoredPartitionsPercentage":
                 float(agg.entity_valid.mean()) if agg.entity_valid.size else 0.0,
-            "totalNumPartitions": len(self._backend.partitions()) if self._backend else 0,
+            "totalNumPartitions": self._num_partitions(),
             "loadGeneration": self._partition_agg.generation,
         }
         if self._state == LoadMonitorState.BOOTSTRAPPING:

@@ -76,7 +76,7 @@ class FileSampleStore:
             return
         with self._lock:
             self._open()
-            for s in samples.partition_samples:
+            for s in samples.all_partition_samples():
                 self._pf.write(json.dumps({"t": s.topic, "p": s.partition,
                                            "ts": s.ts_ms, "v": s.values}) + "\n")
             for s in samples.broker_samples:
@@ -161,11 +161,11 @@ class TopicSampleStore:
     def store_samples(self, samples: Samples) -> None:
         if self._ptopic is None:
             return
-        if samples.partition_samples:
+        if samples.num_partition_samples():
             self._ptopic.append([
                 json.dumps({"t": s.topic, "p": s.partition, "ts": s.ts_ms,
                             "v": s.values}).encode("utf-8")
-                for s in samples.partition_samples])
+                for s in samples.all_partition_samples()])
         if samples.broker_samples:
             self._btopic.append([
                 json.dumps({"b": s.broker_id, "ts": s.ts_ms,
@@ -227,4 +227,5 @@ class OnExecutionSampleStore(TopicSampleStore):
         if self._executor is not None and not self._executor.has_ongoing_execution():
             return
         super().store_samples(
-            Samples(samples.partition_samples, []))
+            Samples(samples.partition_samples, [],
+                    partition_blocks=list(samples.partition_blocks)))

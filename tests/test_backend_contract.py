@@ -143,3 +143,26 @@ def test_full_service_over_rpc_backend():
         assert cc.executor.state == "NO_TASK_IN_PROGRESS"
     finally:
         be.close()
+
+
+def test_columnar_snapshot_contract(backend):
+    """snapshot() (native columnar on the simulated backend, shim-derived on
+    the wire adapter) matches the dict metadata exactly."""
+    import numpy as np
+
+    from cruise_control_tpu.backend.interface import snapshot_from_metadata
+
+    backend.kill_broker(3)
+    snap = backend.snapshot()
+    shim = snapshot_from_metadata(backend.brokers(), backend.partitions())
+    assert snap.partition_keys == shim.partition_keys
+    assert snap.topics == shim.topics
+    assert snap.broker_logdirs == shim.broker_logdirs
+    for f in ("partition_topic", "partition_leader", "rep_ptr", "rep_bid",
+              "rep_leader", "rep_disk", "broker_ids", "broker_alive"):
+        assert np.array_equal(getattr(snap, f), getattr(shim, f)), f
+    # cached per metadata generation; a mutation invalidates
+    assert backend.snapshot() is not None
+    backend.restart_broker(3)
+    snap2 = backend.snapshot()
+    assert bool(snap2.broker_alive[list(snap2.broker_ids).index(3)])

@@ -1,0 +1,207 @@
+"""Columnar ClusterSnapshot + warmup/no-retrace coverage.
+
+The tentpole invariants of the columnar monitor->model path:
+1. The simulated backend's INCREMENTALLY-maintained snapshot equals the
+   protocol shim's derivation from the dict metadata — through every mutator.
+2. cluster_model(use_snapshot=True) is bit-identical to the legacy
+   partitions()-dict build on a randomized cluster with dead brokers, dead
+   disks and offline replicas.
+3. Columnar sampling ingests the same windows as per-sample objects.
+4. EngineParams pytree leaves normalize numpy scalars (no silent retrace)
+   and the module survives re-registration (importlib.reload).
+5. GoalOptimizer.warmup pre-compiles everything a same-bucket real cluster
+   needs: the follow-up optimizations() triggers ZERO new XLA compiles.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.backend.interface import snapshot_from_metadata
+from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.sampling.samplers import SimulatedMetricSampler
+
+ARRAY_FIELDS = ("partition_topic", "partition_leader", "rep_ptr", "rep_bid",
+                "rep_leader", "rep_disk", "broker_ids", "broker_alive")
+LIST_FIELDS = ("topics", "partition_keys", "broker_rack", "broker_logdirs")
+
+
+def _rich_backend(seed=0, num_brokers=10, num_partitions=60):
+    """Randomized cluster: JBOD brokers, mixed RF, dead broker + dead disk."""
+    rng = np.random.default_rng(seed)
+    be = SimulatedClusterBackend()
+    for b in range(num_brokers):
+        be.add_broker(b, f"r{b % 3}",
+                      logdirs={f"/d{j}": 50_000.0 for j in range(1 + b % 3)})
+    for p in range(num_partitions):
+        rf = 1 + int(rng.integers(0, 3))
+        reps = [int(x) for x in rng.choice(num_brokers, size=rf,
+                                           replace=False)]
+        be.create_partition(f"t{p % 6}", p, reps,
+                            size_mb=float(rng.uniform(10, 500)),
+                            bytes_in_rate=float(rng.uniform(1, 50)),
+                            bytes_out_rate=float(rng.uniform(1, 100)),
+                            cpu_util=float(rng.uniform(0.1, 5)))
+    be.kill_broker(num_brokers - 1)        # offline replicas via dead broker
+    be.fail_disk(1, "/d1")                 # offline replicas via dead disk
+    return be
+
+
+def _assert_snapshot_equal(a, b):
+    for f in ARRAY_FIELDS:
+        va, vb = getattr(a, f), getattr(b, f)
+        assert np.array_equal(va, vb), (f, va, vb)
+    for f in LIST_FIELDS:
+        assert getattr(a, f) == getattr(b, f), f
+
+
+def test_snapshot_matches_shim_derivation():
+    be = _rich_backend()
+    _assert_snapshot_equal(be.snapshot(),
+                           snapshot_from_metadata(be.brokers(),
+                                                  be.partitions()))
+
+
+def test_snapshot_incremental_after_mutations():
+    """Every partition mutator keeps the columnar rows in sync: snapshot()
+    after reassignments/advance, leader elections, logdir moves, broker
+    death/restart and late partition creation still equals the shim."""
+    be = _rich_backend(seed=3)
+    be.snapshot()                                   # prime the cache
+    be.alter_partition_reassignments({("t0", 0): [2, 3, 4]})
+    be.advance(10 * 60_000.0)                       # complete the copy
+    info = be.partitions()[("t1", 1)]
+    alive = [b for b in info.replicas if be.brokers()[b].alive]
+    if len(alive) > 1:
+        be.elect_leaders({("t1", 1): alive[-1]})
+    (b0,) = [b for b in be.partitions()[("t0", 0)].replicas][:1]
+    ld = list(be.brokers()[b0].logdirs)[-1]
+    be.alter_replica_logdirs({("t0", 0, b0): ld})
+    be.kill_broker(2)
+    be.restart_broker(2)
+    be.create_partition("late-topic", 999, [0, 2])  # re-sorts the key order
+    _assert_snapshot_equal(be.snapshot(),
+                           snapshot_from_metadata(be.brokers(),
+                                                  be.partitions()))
+
+
+def _monitored(be, columnar=True, rounds=8):
+    lm = LoadMonitor(backend=be,
+                     sampler=SimulatedMetricSampler(be, columnar=columnar))
+    lm.start_up()
+    for i in range(rounds):
+        lm.sample_once(now_ms=i * 300_000.0)
+    return lm
+
+
+def test_columnar_model_bit_identical_to_legacy():
+    be = _rich_backend(seed=1)
+    lm = _monitored(be)
+    ct_snap, meta_snap = lm.cluster_model(use_snapshot=True)
+    ct_dict, meta_dict = lm.cluster_model(use_snapshot=False)
+    assert int(np.asarray(ct_snap.replica_offline).sum()) > 0  # scenario real
+    for f in dataclasses.fields(ct_snap):
+        a = np.asarray(getattr(ct_snap, f.name))
+        b = np.asarray(getattr(ct_dict, f.name))
+        assert a.dtype == b.dtype, f.name
+        assert np.array_equal(a, b), f.name
+    for f in ("topic_names", "partition_ids", "broker_ids", "rack_ids",
+              "logdirs", "num_racks", "num_valid_replicas"):
+        assert getattr(meta_snap, f) == getattr(meta_dict, f), f
+
+
+def test_columnar_sampling_equals_per_sample_objects():
+    """A columnar sampling round lands in the same aggregator windows as the
+    legacy per-partition sample objects (backend noise must be 0)."""
+    be = _rich_backend(seed=2)
+    lm_col = _monitored(be, columnar=True)
+    lm_obj = _monitored(be, columnar=False)
+    ct_a, _ = lm_col.cluster_model()
+    ct_b, _ = lm_obj.cluster_model()
+    np.testing.assert_allclose(np.asarray(ct_a.leader_load),
+                               np.asarray(ct_b.leader_load), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(ct_a.broker_utilization()),
+                               np.asarray(ct_b.broker_utilization()),
+                               rtol=1e-6)
+
+
+def test_columnar_sampler_emits_blocks():
+    be = _rich_backend(seed=4)
+    samples = SimulatedMetricSampler(be).get_samples(0.0)
+    assert not samples.partition_samples and samples.partition_blocks
+    block = samples.partition_blocks[0]
+    assert samples.num_partition_samples() == len(block)
+    rows = list(samples.all_partition_samples())     # lazy expansion
+    assert len(rows) == len(block)
+    assert rows[0].values.keys() == {"CPU_USAGE", "DISK_USAGE",
+                                     "LEADER_BYTES_IN", "LEADER_BYTES_OUT"}
+
+
+def test_engine_params_normalizes_numpy_leaves():
+    """ADVICE r5: numpy-typed config values must not change the traced-leaf
+    dtypes (a silent full retrace of every goal program)."""
+    import jax
+
+    from cruise_control_tpu.analyzer.engine import EngineParams
+    p_py = EngineParams(max_iters=64, min_gain=1e-9)
+    p_np = EngineParams(max_iters=np.int64(64), min_gain=np.float64(1e-9),
+                        stall_retries=np.int32(8), stat_slope_min=np.float64(1e-3))
+    leaves_py, tree_py = jax.tree_util.tree_flatten(p_py)
+    leaves_np, tree_np = jax.tree_util.tree_flatten(p_np)
+    assert tree_py == tree_np            # static aux data identical
+    assert [type(x) for x in leaves_py] == [type(x) for x in leaves_np]
+    assert leaves_py == leaves_np
+
+
+def test_engine_module_reload_safe():
+    """ADVICE r5: module-level pytree registration must survive
+    importlib.reload (ValueError on re-registration)."""
+    import importlib
+
+    import cruise_control_tpu.analyzer.engine as engine
+    importlib.reload(engine)             # would raise before the guard
+    importlib.reload(engine)
+
+
+@pytest.mark.slow
+def test_warmup_then_zero_retrace():
+    """GoalOptimizer.warmup on a shape-matched synthetic cluster compiles
+    everything: a real same-bucket cluster then optimizes with ZERO new XLA
+    compiles (the retrace-regression certificate for the compile-cache +
+    warmup work)."""
+    import jax
+
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+
+    goals = ["ReplicaCapacityGoal", "ReplicaDistributionGoal",
+             "LeaderReplicaDistributionGoal"]
+    opt = GoalOptimizer()
+    opt.warmup(num_brokers=10, num_replicas=500, num_partitions=240,
+               num_topics=6, num_racks=3, logdirs_per_broker=3,
+               max_replication=3, goal_names=goals)
+
+    be = _rich_backend(seed=7, num_brokers=10, num_partitions=240)
+    lm = _monitored(be)
+    ct, meta = lm.cluster_model()
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    prev = bool(jax.config.jax_log_compiles)
+    jax.config.update("jax_log_compiles", True)
+    logging.getLogger("jax").addHandler(handler)
+    try:
+        res = opt.optimizations(ct, meta, goal_names=goals,
+                                raise_on_failure=False,
+                                skip_hard_goal_check=True)
+    finally:
+        logging.getLogger("jax").removeHandler(handler)
+        jax.config.update("jax_log_compiles", prev)
+    assert res.goal_results
+    compiles = [r.getMessage() for r in records
+                if "Compiling" in r.getMessage()]
+    assert not compiles, compiles[:5]
