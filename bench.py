@@ -27,10 +27,13 @@ timeout is a bench that doesn't exist):
 - SIGTERM/SIGINT print the final summary before exiting (timeout(1) sends
   SIGTERM first).
 
-Usage: bench.py [rung ...] [--profile] [--skip-cold]
+Usage: bench.py [rung ...] [--profile] [--skip-cold] [--scenario [name]]
   --profile    block per goal for honest per-goal seconds (adds tunnel
                round-trips; not for wall-clock claims)
   --skip-cold  one timed run per rung (trusts the persistent compile cache)
+  --scenario   run the self-healing scenario rung (sim/ catalog name,
+               default broker-death-50b-1k); emits a "scenario" block with
+               time_to_detect_ms / time_to_heal_ms into the summary JSON
 
 Final line: {"metric": ..., "value": warm_wall_s_at_7k_1M, "unit": "s",
              "vs_baseline": 10.0 / value, "rungs": [...]}
@@ -75,6 +78,7 @@ RUNG_COST_EST = {
     "5": (1700, 500),
     "e2e": (400, 120),
     "e2e7k": (1500, 700),
+    "scenario": (150, 60),
 }
 
 
@@ -120,6 +124,7 @@ class Summary:
     def __init__(self):
         self.rungs: list[dict] = []
         self.headline: dict | None = None
+        self.scenario: dict | None = None   # self-healing closed-loop latency
 
     def emit(self, final: bool = False) -> None:
         # value is the HEADLINE (rung 4) number only: reporting another
@@ -135,6 +140,10 @@ class Summary:
             "complete": final,
             "rungs": self.rungs,
         }
+        if self.scenario is not None:
+            # self-healing latency block (sim/ scenario engine): tracks
+            # time-to-detect / time-to-heal in SIMULATED ms across rounds
+            out["scenario"] = self.scenario
         line = json.dumps(out)
         print(line, flush=True)
         try:
@@ -251,15 +260,29 @@ def main() -> None:
         RandomClusterSpec, generate, generate_scale,
     )
 
-    flags = {a for a in sys.argv[1:] if a.startswith("--")}
-    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    argv = sys.argv[1:]
+    scenario_name = "broker-death-50b-1k"
+    if "--scenario" in argv:
+        # --scenario [name]: run the self-healing scenario rung (alone when
+        # no other rung ids are given)
+        i = argv.index("--scenario")
+        if i + 1 < len(argv) and not argv[i + 1].startswith("--"):
+            scenario_name = argv[i + 1]
+            argv = argv[:i] + argv[i + 2:]
+        else:
+            argv = argv[:i] + argv[i + 1:]
+        argv.append("scenario")
+    flags = {a for a in argv if a.startswith("--")}
+    args = [a for a in argv if not a.startswith("--")]
     profile = "--profile" in flags
     skip_cold = "--skip-cold" in flags
     repeats = 1 if skip_cold else 2
     # headline first: a harness timeout can then never cost the headline;
     # e2e7k (the monitor path at headline scale) before the smaller e2e so
-    # the budget gate drops the cheaper duplicate first
-    order = args if args else ["4", "5", "2", "3", "1", "e2e7k", "e2e"]
+    # the budget gate drops the cheaper duplicate first; the scenario rung
+    # (self-healing latency) is cheap and rides at the end
+    order = args if args else ["4", "5", "2", "3", "1", "e2e7k", "e2e",
+                               "scenario"]
 
     for rung_id in order:
         if rung_id not in RUNG_COST_EST:
@@ -336,6 +359,12 @@ def main() -> None:
             # the synthetic rungs skip
             rung = run_e2e_rung(skip_cold=skip_cold)
 
+        elif rung_id == "scenario":
+            # closed self-healing loop under a scripted broker death
+            # (sim/ScenarioRunner): detect/heal latency in SIMULATED ms plus
+            # the host wall-clock of driving the whole loop
+            rung = run_scenario_rung(scenario_name)
+
         elif rung_id == "e2e7k":
             # the full monitor path at HEADLINE scale: backend -> samples ->
             # windows -> ClusterTensor at 7,000 brokers / 500k partitions /
@@ -350,6 +379,36 @@ def main() -> None:
 
     log(f"total bench time {time.monotonic() - T_START:.1f}s")
     SUMMARY.emit(final=True)
+
+
+def run_scenario_rung(name: str) -> dict:
+    """Drive the closed self-healing loop (monitor -> detect -> optimize ->
+    execute) under a scripted fault and report its latency: time_to_detect /
+    time_to_heal are SIMULATED ms (the loop's reaction time), wall_s is the
+    host cost of running the whole loop."""
+    from cruise_control_tpu.sim import SCENARIOS, run_scenario
+
+    log(f"rung scenario: closed-loop self-healing ({name})")
+    t0 = time.monotonic()
+    r = run_scenario(SCENARIOS[name])
+    rung = r.to_json()
+    rung["config"] = f"scenario-{name}"
+    rung["wall_s"] = round(time.monotonic() - t0, 2)
+    SUMMARY.scenario = {
+        "name": name,
+        "converged": r.converged,
+        "time_to_detect_ms": r.time_to_detect_ms,
+        "time_to_heal_ms": r.time_to_heal_ms,
+        "proposals": r.proposals,
+        "executor_tasks": r.executor_tasks,
+        "wall_s": rung["wall_s"],
+        "failures": list(r.failures),
+    }
+    log(f"  [scenario] converged={r.converged} "
+        f"detect={r.time_to_detect_ms}ms heal={r.time_to_heal_ms}ms "
+        f"proposals={r.proposals} tasks={r.executor_tasks} "
+        f"wall={rung['wall_s']}s")
+    return rung
 
 
 def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,

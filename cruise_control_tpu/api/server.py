@@ -15,6 +15,7 @@ from __future__ import annotations
 import http.cookies
 import json
 import threading
+from concurrent import futures
 import traceback
 import urllib.parse
 import uuid as uuid_mod
@@ -329,6 +330,12 @@ class CruiseControlServer:
         try:
             result = task.future.result(timeout=self.max_block_ms / 1000.0)
             return 200, result, headers
+        except futures.TimeoutError:
+            # NB: concurrent.futures.TimeoutError only became an alias of the
+            # builtin TimeoutError in Python 3.11 — catching the builtin alone
+            # turns every still-running op into a 500 on 3.10
+            return 202, wrap({"progress": task.progress.to_json(),
+                              "operation": endpoint.path}), headers
         except TimeoutError:
             return 202, wrap({"progress": task.progress.to_json(),
                               "operation": endpoint.path}), headers

@@ -313,12 +313,17 @@ class CruiseControl:
         self.load_monitor.shutdown()
 
     # ------------------------------------------------------------ helpers
+    @property
+    def ops_history(self) -> list:
+        """Executed-operation records ({operation, reason, ms, numProposals,
+        executed}) — read by /state consumers and the scenario engine."""
+        return list(self._ops_history)
+
     def _now_ms(self) -> float:
         now = getattr(self.backend, "now_ms", None)
-        if now is None:
+        if now is None:   # clockless stub backend: fall back to wall time
             return time.time() * 1000.0
-        # simulated backend exposes a property; the RPC client a method
-        return float(now() if callable(now) else now)
+        return float(now())
 
     def _model(self, requirements=None):
         return self.load_monitor.cluster_model(requirements)

@@ -141,6 +141,11 @@ def snapshot_from_metadata(brokers: dict, partitions: dict,
 class ClusterBackend(Protocol):
     """Everything the monitor/executor/detector layers need from the cluster."""
 
+    # -- clock --
+    # canonical accessor: every backend exposes now_ms() as a METHOD (the
+    # simulated backend advances it via advance(); wire clients forward it)
+    def now_ms(self) -> float: ...
+
     # -- metadata (MetadataClient role) --
     def brokers(self) -> dict: ...                       # id -> BrokerNode
     def partitions(self) -> dict: ...                    # (topic, part) -> PartitionInfo

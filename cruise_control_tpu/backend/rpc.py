@@ -348,9 +348,7 @@ def _dispatch(backend, method: str, p: dict):
             setter(p["topic"], p["key"], p.get("value"))
         return None
     if method == "now_ms":
-        # property on the simulated backend, method on wire clients
-        clock = backend.now_ms
-        return float(clock() if callable(clock) else clock)
+        return float(backend.now_ms())
     # simulated-cluster controls (fault injection / setup over the wire)
     if method in ("add_broker", "create_partition", "kill_broker",
                   "restart_broker", "fail_disk", "advance"):

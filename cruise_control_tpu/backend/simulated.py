@@ -49,6 +49,11 @@ class SimulatedClusterBackend:
         self._noise = metric_noise
         self._rng = np.random.default_rng(seed)
         self._metric_overrides: dict[int, dict[str, float]] = {}
+        self._silenced: set[int] = set()    # brokers with a metric gap
+        # (at_ms, seq, callback) fault events fired at their exact simulated
+        # time from advance() — the scenario engine's injection mechanism
+        self._scheduled: list[tuple] = []
+        self._sched_seq = 0
         self._topic_configs: dict[str, dict] = {}
         self._partitions_snapshot: tuple | None = None   # (meta_gen, dict)
         # --- incremental columnar state (ClusterSnapshot source) ---
@@ -244,13 +249,58 @@ class SimulatedClusterBackend:
             self._brokers[broker_id].dead_logdirs.add(logdir)
             self._meta_gen += 1
 
+    def set_metric_silence(self, broker_id: int, silent: bool) -> None:
+        """Fault injection: a silenced broker stops emitting broker metrics
+        and leader partition metrics (a reporting gap, NOT a failure — the
+        broker stays alive in metadata)."""
+        with self._lock:
+            if silent:
+                self._silenced.add(broker_id)
+            else:
+                self._silenced.discard(broker_id)
+
     # ---------------------------------------------------------------- clock
-    @property
     def now_ms(self) -> float:
+        """Canonical ClusterBackend clock accessor (method, like the RPC
+        client and every other backend — see ClusterBackend protocol)."""
         return self._now_ms
 
+    def schedule_at(self, at_ms: float, callback) -> None:
+        """Register ``callback(now_ms)`` to fire when simulated time reaches
+        ``at_ms`` — from whichever ``advance`` call crosses it, including the
+        executor's own progress-poll sleeps. This is what lets the scenario
+        engine inject a broker death in the middle of a blocking proposal
+        execution at an exact, reproducible simulated time."""
+        with self._lock:
+            self._scheduled.append((float(at_ms), self._sched_seq, callback))
+            self._sched_seq += 1
+
     def advance(self, dt_ms: float) -> None:
-        """Advance simulated time: progress in-flight reassignments."""
+        """Advance simulated time, stopping at every scheduled fault event so
+        callbacks observe (and mutate) the cluster at their exact time."""
+        remaining = float(dt_ms)
+        while True:
+            # fire everything due at the CURRENT time first (an event
+            # scheduled at exactly now must not slip a whole step)
+            with self._lock:
+                now = self._now_ms
+                due = sorted(e for e in self._scheduled if e[0] <= now)
+                self._scheduled = [e for e in self._scheduled if e[0] > now]
+            for _, _, cb in due:
+                cb(now)
+            if remaining <= 0:
+                return
+            with self._lock:
+                pending = [t for t, _, _ in self._scheduled if t > now]
+                next_due = min(pending) if pending else None
+            step = remaining
+            if next_due is not None and next_due < now + remaining:
+                step = max(next_due - now, 0.0)
+            self._advance_step(step)
+            remaining -= step
+
+    def _advance_step(self, dt_ms: float) -> None:
+        """Progress in-flight reassignments over an event-free interval."""
         with self._lock:
             self._now_ms += dt_ms
             rate_kbps = (self._throttle / 1024.0 if self._throttle
@@ -279,8 +329,14 @@ class SimulatedClusterBackend:
                     info.replicas = [b for b in fl.target]
                     for b in removed:
                         info.logdir_by_broker.pop(b, None)
-                    if info.leader not in info.replicas:
-                        info.leader = info.replicas[0] if info.replicas else -1
+                    if (info.leader not in info.replicas
+                            or not self._brokers[info.leader].alive):
+                        # a broker may die mid-reassignment: leadership must
+                        # land on an ALIVE member of the new replica list
+                        # (ISR election role), never a dead target
+                        alive = [b for b in info.replicas
+                                 if self._brokers[b].alive]
+                        info.leader = alive[0] if alive else -1
                     done_tps.append(tp)
                     touched = True
                 if touched:
@@ -330,7 +386,8 @@ class SimulatedClusterBackend:
         with self._lock:
             out = {}
             for tp, info in self._partitions.items():
-                if info.leader < 0 or not self._brokers[info.leader].alive:
+                if (info.leader < 0 or not self._brokers[info.leader].alive
+                        or info.leader in self._silenced):
                     continue
                 out[tp] = {
                     "CPU_USAGE": self._jitter(info.cpu_util),
@@ -352,7 +409,8 @@ class SimulatedClusterBackend:
             n = len(self._c_tps)
             leader = self._c_leader[:n]
             alive_ids = np.asarray(
-                sorted(b for b, node in self._brokers.items() if node.alive),
+                sorted(b for b, node in self._brokers.items()
+                       if node.alive and b not in self._silenced),
                 np.int64)
             mask = (leader >= 0) & np.isin(leader, alive_ids)
             rows = np.flatnonzero(mask)
@@ -380,7 +438,7 @@ class SimulatedClusterBackend:
             out = {}
             for bi, b in enumerate(ids.tolist()):
                 node = self._brokers[b]
-                if not node.alive:
+                if not node.alive or b in self._silenced:
                     continue
                 cpu, lin, lout = sums[bi]
                 out[b] = {

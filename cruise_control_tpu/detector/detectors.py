@@ -200,16 +200,25 @@ class SlowBrokerFinder:
                 "slow.broker.self.healing.unfixable.ratio")
 
     def run_once(self, broker_metrics: dict, now_ms: float) -> list:
-        """broker_metrics: broker -> {metric: value} (latest)."""
-        flush = {b: m.get("BROKER_LOG_FLUSH_TIME_MS_999TH", 0.0)
-                 for b, m in broker_metrics.items()}
-        rate = {b: m.get("ALL_TOPIC_BYTES_IN", 0.0) for b, m in broker_metrics.items()}
-        if not flush:
+        """broker_metrics: broker -> {metric: value} (latest).
+
+        The slow screen runs over a dense ``[brokers x 2]`` array
+        (flush-time 999th, byte-in rate): one densify pass, then the
+        percentile and both comparisons in numpy — the only remaining
+        python-loop state is the (sparse) escalation-score dict, so the
+        per-round cost stays flat at 7k brokers."""
+        if not broker_metrics:
             return []
-        slow_now = {b for b in flush
-                    if flush[b] > self.flush_time_threshold_ms
-                    and rate.get(b, 0.0) < max(self.bytes_rate_threshold,
-                                               np.median(list(rate.values())))}
+        ids = list(broker_metrics)
+        vals = np.empty((len(ids), 2), dtype=np.float64)
+        for i, m in enumerate(broker_metrics.values()):
+            vals[i, 0] = m.get("BROKER_LOG_FLUSH_TIME_MS_999TH", 0.0)
+            vals[i, 1] = m.get("ALL_TOPIC_BYTES_IN", 0.0)
+        rate_cut = max(self.bytes_rate_threshold, float(np.median(vals[:, 1])))
+        mask = (vals[:, 0] > self.flush_time_threshold_ms) \
+            & (vals[:, 1] < rate_cut)
+        slow_now = {ids[i] for i in np.flatnonzero(mask)}
+        n_reporting = len(ids)
         for b in list(self._scores):
             if b not in slow_now:
                 self._scores[b] = max(0, self._scores[b] - 1)
@@ -222,7 +231,7 @@ class SlowBrokerFinder:
         to_demote = {b: s for b, s in self._scores.items()
                      if self.demotion_score <= s < self.decommission_score}
         fixable = (len(to_remove) + len(to_demote)
-                   <= self.unfixable_ratio * max(len(flush), 1))
+                   <= self.unfixable_ratio * max(n_reporting, 1))
         out = []
         if to_remove:
             out.append(SlowBrokers(anomaly_type=AnomalyType.METRIC_ANOMALY,

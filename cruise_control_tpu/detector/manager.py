@@ -97,8 +97,13 @@ class AnomalyDetectorManager:
                 continue
             if next_due is None:
                 # deterministic phase jitter: spread detectors so they don't
-                # all fire on the same tick (reference uses RANDOM.nextInt)
-                jitter = (hash(name) % 10_000) / 10_000.0 * interval * 0.1
+                # all fire on the same tick (reference uses RANDOM.nextInt).
+                # crc32, not hash(): PYTHONHASHSEED randomizes str hashes
+                # between processes, which would break scenario-timeline
+                # reproducibility across pytest runs.
+                import zlib
+                jitter = (zlib.crc32(name.encode()) % 10_000) / 10_000.0 \
+                    * interval * 0.1
                 slot[2] = now_ms + interval / 2 + jitter
                 continue
             if now_ms >= next_due:

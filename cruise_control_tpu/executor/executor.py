@@ -47,9 +47,7 @@ class SimClock:
         self._backend = backend
 
     def now_ms(self) -> float:
-        # simulated backend exposes a property; the RPC client a method
-        now = self._backend.now_ms
-        return float(now() if callable(now) else now)
+        return float(self._backend.now_ms())
 
     def sleep_ms(self, ms: float) -> None:
         self._backend.advance(ms)
@@ -724,11 +722,19 @@ class Executor:
                 return
             elections = {}
             partitions = self._backend.partitions()
+            brokers = self._backend.brokers()
             for t in batch:
                 t.transition(TaskState.IN_PROGRESS, self._clock.now_ms())
                 info = partitions.get(t.tp)
-                if info is not None and t.proposal.new_leader in info.replicas:
-                    elections[t.tp] = t.proposal.new_leader
+                target = t.proposal.new_leader
+                # the target may have died since the proposal was computed
+                # (fault mid-execution): submitting the election would only
+                # fail backend-side — mark the task DEAD like the reference
+                # abandoning a leadership task with an ineligible target
+                if (info is not None and target in info.replicas
+                        and brokers.get(target) is not None
+                        and brokers[target].alive):
+                    elections[t.tp] = target
                 else:
                     t.transition(TaskState.DEAD, self._clock.now_ms())
             if elections:
@@ -774,7 +780,18 @@ class Executor:
                                          if t.state is TaskState.PENDING)
             out["numAbortedTasks"] = sum(1 for t in tasks
                                          if t.state is TaskState.ABORTED)
+            # full per-state census: every task is in exactly one state and
+            # the counts must sum to the plan (the scenario engine's
+            # executor-accounting invariant reads this)
+            by_state: dict[str, int] = {}
+            for t in tasks:
+                by_state[t.state.name] = by_state.get(t.state.name, 0) + 1
+            out["numTasksByState"] = by_state
         out["executionHistory"] = self._history[-5:]
+        out["numExecutions"] = len(self._history)
+        out["numCompletedTasksTotal"] = sum(h["numCompleted"]
+                                            for h in self._history)
+        out["numPlannedTasksTotal"] = sum(h["numTasks"] for h in self._history)
         if self._cfg.adjuster_enabled:
             out["concurrencyAdjuster"] = {
                 "perBrokerCap": self._cfg.per_broker_cap,

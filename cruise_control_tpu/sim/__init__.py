@@ -1,0 +1,28 @@
+"""Deterministic fault-injection scenario engine.
+
+Closes the self-healing loop end to end on simulated time: scripted fault
+timelines (scenario.py) drive a SimulatedClusterBackend + LoadMonitor +
+AnomalyDetectorManager + GoalOptimizer + Executor stack (runner.py), with
+cluster-safety invariants checked every tick and at convergence
+(invariants.py) and a catalog of required failure modes (catalog.py).
+"""
+from cruise_control_tpu.sim.catalog import SCENARIOS
+from cruise_control_tpu.sim.invariants import (
+    check_converged, check_executor_accounting, check_tick,
+)
+from cruise_control_tpu.sim.runner import (
+    BASE_CONFIG, ScenarioResult, ScenarioRunner, run_scenario,
+)
+from cruise_control_tpu.sim.scenario import (
+    ClusterSpec, Scenario, ScenarioEvent, broker_death, broker_restart,
+    build_backend, clear_slow_broker, disk_failure, maintenance_event,
+    metric_gap, slow_broker, topic_creation,
+)
+
+__all__ = [
+    "SCENARIOS", "check_converged", "check_executor_accounting", "check_tick",
+    "BASE_CONFIG", "ScenarioResult", "ScenarioRunner", "run_scenario",
+    "ClusterSpec", "Scenario", "ScenarioEvent", "broker_death",
+    "broker_restart", "build_backend", "clear_slow_broker", "disk_failure",
+    "maintenance_event", "metric_gap", "slow_broker", "topic_creation",
+]

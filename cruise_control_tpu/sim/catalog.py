@@ -1,0 +1,164 @@
+"""Scenario catalog: the scripted failure modes every PR must survive.
+
+Each entry is a full closed-loop run (monitor -> detect -> notifier ladder
+-> optimizer -> executor -> backend) with convergence bounds in SIMULATED
+milliseconds. The smoke scenario is sized to stay inside the shared
+small-fixture compile bucket (pad_cluster floors: <=16 brokers, <=1024
+replicas, <=256 partitions, <=16 topics), so the tier-1 suite reuses the
+same compiled engine programs as the rest of the fast tier instead of
+paying a fresh XLA compile; the larger 50-broker / 1k-partition variant and
+the compound cascade live in the slow tier.
+
+``GV_OFF`` disables goal-violation detection where it would only add
+optimizer noise to a scenario about a different detector (its first run is
+scheduled at interval/2 — an astronomically large interval never fires).
+"""
+from __future__ import annotations
+
+from cruise_control_tpu.sim.scenario import (
+    ClusterSpec, Scenario, broker_death, clear_slow_broker, disk_failure,
+    maintenance_event, metric_gap, slow_broker, topic_creation,
+)
+
+GV_OFF = ("goal.violation.detection.interval.ms", 10_000_000_000)
+
+_SMALL = ClusterSpec(num_brokers=12, num_racks=3,
+                     topics=(("t0", 60, 2), ("t1", 60, 2)))
+
+
+BROKER_DEATH_SMOKE = Scenario(
+    name="broker-death-smoke",
+    cluster=_SMALL,
+    events=(broker_death(0.0, [3]),),
+    duration_ms=900_000.0,
+    tick_ms=15_000.0,
+    # tier-1 budget: one detection pass before the grace ladder expires
+    # (120 s backoff) and a 3-goal evacuation chain — the full 8-goal
+    # self-healing chain is exercised by the slow-tier scenarios
+    config=(GV_OFF,
+            ("broker.failure.detection.backoff.ms", 120_000),
+            ("self.healing.goals",
+             "ReplicaCapacityGoal,DiskCapacityGoal,ReplicaDistributionGoal")),
+    max_detect_ms=120_000.0,     # backoff/2 + scheduler phase + tick grid
+    max_heal_ms=300_000.0,       # detect + 60 s grace + evacuation
+    expect_detect_types=("BROKER_FAILURE",),
+    expect_empty_brokers=(3,),
+)
+
+BROKER_DEATH_50B = Scenario(
+    name="broker-death-50b-1k",
+    cluster=ClusterSpec(num_brokers=50, num_racks=5,
+                        topics=(("t0", 250, 2), ("t1", 250, 2),
+                                ("t2", 250, 2), ("t3", 250, 2))),
+    events=(broker_death(0.0, [7]),),
+    duration_ms=1_800_000.0,
+    tick_ms=15_000.0,
+    config=(GV_OFF,),
+    max_detect_ms=120_000.0,
+    max_heal_ms=600_000.0,
+    expect_detect_types=("BROKER_FAILURE",),
+    expect_empty_brokers=(7,),
+)
+
+DISK_FAILURE = Scenario(
+    name="disk-failure",
+    cluster=ClusterSpec(num_brokers=12, num_racks=3,
+                        topics=(("t0", 60, 2), ("t1", 60, 2)),
+                        logdirs_per_broker=2),
+    events=(disk_failure(0.0, broker_id=2, logdir="/logdir1"),),
+    duration_ms=900_000.0,
+    tick_ms=15_000.0,
+    config=(GV_OFF,),
+    max_detect_ms=120_000.0,
+    max_heal_ms=300_000.0,
+    expect_detect_types=("DISK_FAILURE",),
+)
+
+SLOW_BROKER = Scenario(
+    name="slow-broker-demotion",
+    cluster=_SMALL,
+    events=(slow_broker(0.0, broker_id=5, flush_ms=5000.0, bytes_in=1.0),
+            clear_slow_broker(300_000.0, broker_id=5)),
+    duration_ms=1_200_000.0,
+    tick_ms=15_000.0,
+    config=(GV_OFF,
+            ("metric.anomaly.detection.interval.ms", 30_000),
+            ("slow.broker.demotion.score", 3)),
+    max_detect_ms=240_000.0,     # needs demotion_score consecutive hits
+    max_heal_ms=600_000.0,
+    expect_detect_types=("METRIC_ANOMALY",),
+    expect_nonleader_brokers=(5,),
+)
+
+METRIC_GAP = Scenario(
+    name="metric-gap",
+    cluster=_SMALL,
+    events=(metric_gap(0.0, 180_000.0, [1, 2]),),
+    duration_ms=900_000.0,
+    tick_ms=15_000.0,
+    # GV stays ON here: the loop keeps running its normal detection under
+    # partial metric blindness and must not misread the gap as a failure
+    config=(),
+    expects_heal=True,           # convergence = nothing broke, nothing moved
+    forbid_detect_types=("BROKER_FAILURE", "DISK_FAILURE"),
+    settle_ticks=4,              # give a spurious failure time to surface
+)
+
+MAINTENANCE_REMOVE = Scenario(
+    name="maintenance-remove-broker",
+    cluster=_SMALL,
+    events=(maintenance_event(0.0, "REMOVE_BROKER", brokers=[4]),),
+    duration_ms=900_000.0,
+    tick_ms=15_000.0,
+    config=(GV_OFF,),
+    max_detect_ms=90_000.0,      # plans poll on the base interval, no ladder
+    max_heal_ms=300_000.0,
+    expect_detect_types=("MAINTENANCE_EVENT",),
+    expect_empty_brokers=(4,),
+)
+
+TOPIC_CREATION = Scenario(
+    name="topic-creation",
+    cluster=_SMALL,
+    events=(topic_creation(0.0, "tnew", partitions=20, rf=2, size_mb=80.0),),
+    duration_ms=900_000.0,
+    tick_ms=15_000.0,
+    config=(GV_OFF,),
+    expects_heal=True,           # converge with the new topic replicated+led
+    settle_ticks=2,
+)
+
+COMPOUND_CASCADE = Scenario(
+    name="compound-cascade",
+    cluster=ClusterSpec(num_brokers=16, num_racks=4,
+                        topics=(("t0", 100, 2), ("t1", 100, 2)),
+                        skew=1.5),
+    events=(
+        # 1) operator rebalance of a skewed cluster (long, throttled run)
+        maintenance_event(0.0, "REBALANCE"),
+        # 2) broker dies while the rebalance is still copying (the plan is
+        # detected by ~60 s and the throttled execution runs for simulated
+        # minutes, so 90 s lands provably mid-flight)
+        broker_death(90_000.0, [2]),
+        # 3) operator plan lands mid-flight of the recovery
+        maintenance_event(120_000.0, "DEMOTE_BROKER", brokers=[5]),
+    ),
+    duration_ms=3_600_000.0,
+    tick_ms=30_000.0,
+    config=(
+        # throttle so replica copies take ~50 simulated s each — the death
+        # provably lands inside the rebalance execution window
+        ("default.replication.throttle", 2 * 1024 * 1024),
+        ("goal.violation.detection.interval.ms", 300_000),
+    ),
+    max_heal_ms=1_800_000.0,
+    expect_detect_types=("MAINTENANCE_EVENT", "BROKER_FAILURE"),
+    expect_empty_brokers=(2,),
+)
+
+SCENARIOS = {
+    s.name: s for s in (
+        BROKER_DEATH_SMOKE, BROKER_DEATH_50B, DISK_FAILURE, SLOW_BROKER,
+        METRIC_GAP, MAINTENANCE_REMOVE, TOPIC_CREATION, COMPOUND_CASCADE,
+    )
+}

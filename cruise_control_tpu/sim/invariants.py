@@ -1,0 +1,105 @@
+"""Cluster-safety invariants evaluated by the scenario engine.
+
+Two tiers, mirroring what the reference's integration harness asserts
+implicitly through Kafka itself:
+
+- ``check_tick``: must hold at EVERY simulated tick, even mid-heal —
+  structural consistency of the metadata (leaders are members, no duplicate
+  replicas, dead brokers never lead, in-flight reassignment targets exist)
+  and executor task accounting (every task is in exactly one state, counts
+  sum to the plan).
+- ``check_converged``: must hold once the loop has settled — replication
+  factor restored to the expected value per partition, no replica resident
+  on a dead broker or dead logdir, every partition led by an alive broker,
+  nothing left in flight.
+
+Both return a list of human-readable violation strings (empty = pass), so a
+scenario failure names every broken invariant at once instead of dying on
+the first assert.
+"""
+from __future__ import annotations
+
+
+def check_tick(backend, executor=None) -> list:
+    """Structural invariants that may never break, even mid-heal."""
+    violations = []
+    brokers = backend.brokers()
+    partitions = backend.partitions()
+    for tp, info in partitions.items():
+        if len(set(info.replicas)) != len(info.replicas):
+            violations.append(f"{tp}: duplicate replicas {info.replicas}")
+        unknown = [b for b in info.replicas if b not in brokers]
+        if unknown:
+            violations.append(f"{tp}: replicas on unknown brokers {unknown}")
+        if info.leader != -1:
+            if info.leader not in info.replicas:
+                violations.append(
+                    f"{tp}: leader {info.leader} not in replicas {info.replicas}")
+            node = brokers.get(info.leader)
+            if node is not None and not node.alive:
+                violations.append(f"{tp}: led by dead broker {info.leader}")
+    for tp, fl in backend.ongoing_reassignments().items():
+        if tp not in partitions:
+            violations.append(f"in-flight reassignment for unknown {tp}")
+        for b in fl["target"]:
+            if b not in brokers:
+                violations.append(
+                    f"{tp}: reassignment targets unknown broker {b}")
+    if executor is not None:
+        violations.extend(check_executor_accounting(executor))
+    return violations
+
+
+def check_executor_accounting(executor) -> list:
+    """Every task in exactly one state; state counts sum to the plan size
+    (the Executor.java sanity the reference asserts via its task tracker)."""
+    st = executor.state_json()
+    total = st.get("numTotalTasks")
+    if total is None:
+        return []
+    by_state = st.get("numTasksByState", {})
+    s = sum(by_state.values())
+    if s != total:
+        return [f"executor task accounting: states sum to {s}, "
+                f"total {total} ({by_state})"]
+    return []
+
+
+def check_converged(backend, expected_rf: dict) -> list:
+    """The settled-state contract: RF restored, nothing on dead hardware,
+    everything led, nothing in flight."""
+    violations = []
+    brokers = backend.brokers()
+    partitions = backend.partitions()
+    ongoing = backend.ongoing_reassignments()
+    if ongoing:
+        violations.append(f"{len(ongoing)} reassignments still in flight")
+    for tp, rf in expected_rf.items():
+        info = partitions.get(tp)
+        if info is None:
+            violations.append(f"{tp}: partition vanished")
+            continue
+        n = len(set(info.replicas))
+        if n != rf:
+            violations.append(f"{tp}: RF {n} != expected {rf}")
+        for b in info.replicas:
+            node = brokers.get(b)
+            if node is None or not node.alive:
+                violations.append(f"{tp}: replica on dead broker {b}")
+            else:
+                ld = info.logdir_by_broker.get(b)
+                if ld is not None and ld in node.dead_logdirs:
+                    violations.append(f"{tp}: replica on dead disk {b}:{ld}")
+        if info.leader < 0:
+            violations.append(f"{tp}: no leader")
+    return violations
+
+
+def replicas_on(backend, broker_id: int) -> int:
+    return sum(1 for info in backend.partitions().values()
+               if broker_id in info.replicas)
+
+
+def leaderships_on(backend, broker_id: int) -> int:
+    return sum(1 for info in backend.partitions().values()
+               if info.leader == broker_id)

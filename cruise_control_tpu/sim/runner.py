@@ -1,0 +1,363 @@
+"""ScenarioRunner: the closed self-healing loop on simulated time.
+
+Wires a ``SimulatedClusterBackend``, ``LoadMonitor``,
+``AnomalyDetectorManager``, ``GoalOptimizer`` and ``Executor`` (all on the
+backend's simulated clock) into one deterministic loop and drives a
+:class:`~cruise_control_tpu.sim.scenario.Scenario` against it:
+
+    warm-fill metric windows
+    -> per tick: advance clock (scheduled faults fire at exact times,
+       including inside a blocking proposal execution's progress sleeps)
+       -> sampling round -> run_due detection -> handle_anomalies
+       (FIX routes through the same optimizer/executor path as REST)
+       -> tick invariants -> convergence check
+
+Determinism: everything flows from (scenario, seed) — the backend RNG is
+seeded, no background threads run (bare ``start_up``), all timestamps are
+simulated, and the recorded timeline excludes process-dependent values
+(anomaly ids, wall clock). Identical inputs therefore produce a
+bit-identical event timeline, which the test suite asserts.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import tempfile
+
+from cruise_control_tpu.sim import invariants
+from cruise_control_tpu.sim.scenario import Scenario, build_backend
+
+LOG = logging.getLogger("cruise_control_tpu.sim")
+
+# Scenario-speed service config: short grace ladders and detection cadences
+# (minutes of simulated time instead of the production half-hours), tiny
+# metric windows, and an always-fresh partition-universe cache so topic
+# creation is visible to the next sampling round. Scenarios override freely.
+BASE_CONFIG = {
+    "self.healing.enabled": True,
+    "anomaly.detection.interval.ms": 30_000,
+    "broker.failure.detection.backoff.ms": 30_000,
+    "goal.violation.detection.interval.ms": 120_000,
+    "broker.failure.alert.threshold.ms": 30_000,
+    "broker.failure.self.healing.threshold.ms": 60_000,
+    "num.metrics.windows": 5,
+    "min.samples.per.metrics.window": 1,
+    "metrics.window.ms": 60_000,
+    "metadata.max.age.ms": 1,
+    "anomaly.detection.goals": "DiskCapacityGoal,ReplicaDistributionGoal",
+    # the topic-RF finder's default target (RF 3) would "fix" every RF-2
+    # scenario cluster underneath the scripted faults — never schedule it
+    # unless a scenario opts back in
+    "topic.anomaly.detection.interval.ms": 10_000_000_000,
+}
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    name: str
+    seed: int
+    converged: bool = False
+    time_to_detect_ms: float | None = None
+    time_to_heal_ms: float | None = None
+    proposals: int = 0
+    executor_tasks: int = 0
+    executions: int = 0
+    ticks: int = 0
+    sim_duration_ms: float = 0.0
+    timeline: list = dataclasses.field(default_factory=list)
+    invariant_violations: list = dataclasses.field(default_factory=list)
+    failures: list = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+    def assert_ok(self) -> None:
+        if self.failures:
+            raise AssertionError(
+                f"scenario {self.name!r} failed:\n  "
+                + "\n  ".join(self.failures)
+                + "\ntimeline:\n  "
+                + "\n  ".join(json.dumps(e) for e in self.timeline))
+
+    def to_json(self) -> dict:
+        return {
+            "scenario": self.name, "seed": self.seed,
+            "converged": self.converged,
+            "time_to_detect_ms": self.time_to_detect_ms,
+            "time_to_heal_ms": self.time_to_heal_ms,
+            "proposals": self.proposals,
+            "executor_tasks": self.executor_tasks,
+            "executions": self.executions,
+            "ticks": self.ticks,
+            "sim_duration_ms": self.sim_duration_ms,
+            "num_invariant_violations": len(self.invariant_violations),
+            "failures": list(self.failures),
+        }
+
+
+class ScenarioRunner:
+    def __init__(self, scenario: Scenario, seed: int = 0,
+                 settle_ticks: int | None = None, workdir: str | None = None):
+        self.scenario = scenario
+        self.seed = seed
+        self.settle_ticks = (settle_ticks if settle_ticks is not None
+                             else scenario.settle_ticks)
+        self._workdir = workdir
+        self.backend = None
+        self.cc = None
+        self.result = ScenarioResult(name=scenario.name, seed=seed)
+        self.expected_rf: dict = {}
+        self._t0 = 0.0                    # scenario start (abs sim ms)
+        self._first_fault_ms: float | None = None   # abs sim ms
+        self._events_pending = 0
+        self._spool_dir: str | None = None
+
+    # ------------------------------------------------------------- wiring
+    def _build(self):
+        from cruise_control_tpu.app import CruiseControl
+        from cruise_control_tpu.config import cruise_control_config
+
+        sc = self.scenario
+        spec = dataclasses.replace(sc.cluster, seed=sc.cluster.seed + self.seed)
+        self.backend = build_backend(spec)
+        props = dict(BASE_CONFIG)
+        props.update(sc.config_dict())
+        if any(e.kind == "maintenance_event" for e in sc.events) \
+                and "maintenance.event.path" not in props:
+            self._spool_dir = self._workdir or tempfile.mkdtemp(
+                prefix="cc_sim_maint_")
+            props["maintenance.event.path"] = self._spool_dir
+        self.cc = CruiseControl(self.backend, cruise_control_config(props))
+        # bare start_up: monitor replay only — NO precompute/detection
+        # threads, the loop must be single-threaded to be deterministic
+        self.cc.start_up()
+        self.expected_rf = {tp: len(set(info.replicas))
+                            for tp, info in self.backend.partitions().items()}
+
+    def _now(self) -> float:
+        return self.backend.now_ms()
+
+    def _record(self, kind: str, now_abs: float, **detail) -> None:
+        entry = {"t": round(now_abs - self._t0, 1), "kind": kind}
+        entry.update(detail)
+        self.result.timeline.append(entry)
+
+    # ------------------------------------------------------ fault injection
+    def _schedule_events(self) -> None:
+        for ev in sorted(self.scenario.events, key=lambda e: e.at_ms):
+            self._events_pending += 1
+            self.backend.schedule_at(
+                self._t0 + ev.at_ms,
+                lambda now, ev=ev: self._fire(ev, now))
+
+    def _fire(self, ev, now: float) -> None:
+        be, p = self.backend, ev.params
+        # recovery events don't start the detection clock; everything else
+        # (faults AND operator plans) is something the loop must react to
+        if ev.kind not in ("broker_restart", "clear_slow_broker") \
+                and self._first_fault_ms is None:
+            self._first_fault_ms = now
+        if ev.kind == "broker_death":
+            for b in p["brokers"]:
+                be.kill_broker(b)
+        elif ev.kind == "broker_restart":
+            for b in p["brokers"]:
+                be.restart_broker(b)
+        elif ev.kind == "disk_failure":
+            be.fail_disk(p["broker"], p["logdir"])
+        elif ev.kind == "slow_broker":
+            be.override_broker_metric(
+                p["broker"], "BROKER_LOG_FLUSH_TIME_MS_999TH", p["flush_ms"])
+            be.override_broker_metric(
+                p["broker"], "ALL_TOPIC_BYTES_IN", p["bytes_in"])
+        elif ev.kind == "clear_slow_broker":
+            be.override_broker_metric(
+                p["broker"], "BROKER_LOG_FLUSH_TIME_MS_999TH", None)
+            be.override_broker_metric(p["broker"], "ALL_TOPIC_BYTES_IN", None)
+        elif ev.kind == "metric_gap":
+            for b in p["brokers"]:
+                be.set_metric_silence(b, True)
+            self._events_pending += 1   # horizon extends to the gap end
+
+            def _end_gap(now_end, brokers=tuple(p["brokers"])):
+                for b in brokers:
+                    be.set_metric_silence(b, False)
+                self._events_pending -= 1
+                self._record("inject", now_end, event="metric_gap_end",
+                             brokers=list(brokers))
+            be.schedule_at(self._t0 + p["until_ms"], _end_gap)
+        elif ev.kind == "topic_creation":
+            num_brokers = len(be.brokers())
+            rf = min(p["rf"], num_brokers)
+            from cruise_control_tpu.sim.scenario import hash_stable
+            for i in range(p["partitions"]):
+                lead = (hash_stable(p["topic"]) + i) % num_brokers
+                replicas = [(lead + j) % num_brokers for j in range(rf)]
+                be.create_partition(p["topic"], i, replicas,
+                                    size_mb=p["size_mb"],
+                                    bytes_in_rate=p["size_mb"] / 10.0,
+                                    bytes_out_rate=p["size_mb"] / 5.0,
+                                    cpu_util=p["size_mb"] / 300.0)
+                self.expected_rf[(p["topic"], i)] = rf
+        elif ev.kind == "maintenance_event":
+            spool = os.path.join(self._spool_dir, "maintenance_events.jsonl")
+            with open(spool, "a") as f:
+                f.write(json.dumps({"type": p["plan_type"],
+                                    "brokers": p["brokers"],
+                                    "topics": p["topics"]}) + "\n")
+        else:
+            raise ValueError(f"unknown scenario event kind {ev.kind!r}")
+        self._events_pending -= 1
+        self._record("inject", now, event=ev.label(),
+                     during_execution=self.cc.executor.has_ongoing_execution())
+
+    # -------------------------------------------------------------- the loop
+    def run(self) -> ScenarioResult:
+        sc = self.scenario
+        self._build()
+        lm, ad = self.cc.load_monitor, self.cc.anomaly_detector
+        window_ms = float(self.cc.config.get_int("metrics.window.ms"))
+        warm_rounds = self.cc.config.get_int("num.metrics.windows") + 1
+        for _ in range(warm_rounds):
+            self.backend.advance(window_ms)
+            lm.sample_once(now_ms=self._now())
+        self._t0 = self._now()
+        self._schedule_events()
+
+        end = self._t0 + sc.duration_ms
+        horizon_ms = max((max(e.at_ms, e.params.get("until_ms", 0.0))
+                          for e in sc.events), default=0.0)
+        settled = 0
+        heal_candidate_ms: float | None = None
+        while self._now() < end:
+            self.result.ticks += 1
+            # a FIX execution may have advanced simulated time well past the
+            # nominal grid already; ticks are relative, not grid-aligned
+            self.backend.advance(sc.tick_ms)
+            now = self._now()
+            lm.sample_once(now_ms=now)
+            ad.run_due(now)
+            for h in ad.handle_anomalies(now):
+                self._record_handled(h, self._now())
+            now = self._now()   # a FIX execution advances simulated time
+            viol = invariants.check_tick(self.backend, self.cc.executor)
+            if viol:
+                self.result.invariant_violations.extend(
+                    f"t={now - self._t0:.0f}: {v}" for v in viol)
+                self._record("invariant_violation", now, violations=viol)
+            if (self._events_pending == 0 and now >= self._t0 + horizon_ms
+                    and not self.cc.executor.has_ongoing_execution()):
+                conv = invariants.check_converged(self.backend,
+                                                  self.expected_rf)
+                conv.extend(self._extra_convergence_checks())
+                if not conv:
+                    if heal_candidate_ms is None:
+                        heal_candidate_ms = now
+                    settled += 1
+                    if settled >= self.settle_ticks:
+                        self.result.converged = True
+                        break
+                else:
+                    heal_candidate_ms = None
+                    settled = 0
+        self._finalize(heal_candidate_ms)
+        return self.result
+
+    def _extra_convergence_checks(self) -> list:
+        out = []
+        for b in self.scenario.expect_empty_brokers:
+            n = invariants.replicas_on(self.backend, b)
+            if n:
+                out.append(f"broker {b} still hosts {n} replicas")
+        for b in self.scenario.expect_nonleader_brokers:
+            n = invariants.leaderships_on(self.backend, b)
+            if n:
+                out.append(f"broker {b} still leads {n} partitions")
+        return out
+
+    def _record_handled(self, h: dict, now_abs: float) -> None:
+        """Normalize one handled-anomaly entry for the timeline: drop
+        process-dependent fields (anomaly ids), compress fix results to
+        scalar movement counts."""
+        a = h["anomaly"]
+        entry = {"type": a["type"], "action": h["action"],
+                 "detected_t": round(a["detectedMs"] - self._t0, 1),
+                 "description": a["description"]}
+        if self._first_fault_ms is not None \
+                and self.result.time_to_detect_ms is None \
+                and a["detectedMs"] >= self._first_fault_ms \
+                and (not self.scenario.expect_detect_types
+                     or a["type"] in self.scenario.expect_detect_types):
+            self.result.time_to_detect_ms = round(
+                a["detectedMs"] - self._first_fault_ms, 1)
+        fix = h.get("fixResult")
+        if isinstance(fix, dict):
+            entry["fix"] = {"operation": fix.get("operation"),
+                            "executed": fix.get("executed", False)}
+            summary = (fix.get("result") or {}).get("summary", {})
+            for k in ("numReplicaMovements", "numLeaderMovements"):
+                if k in summary:
+                    entry["fix"][k] = summary[k]
+            if "numPartitionsChanged" in fix:
+                entry["fix"]["numPartitionsChanged"] = fix["numPartitionsChanged"]
+        if "fixError" in h:
+            entry["fixError"] = h["fixError"]
+        self._record("anomaly", now_abs, **entry)
+
+    def _finalize(self, heal_candidate_ms: float | None) -> None:
+        sc, r = self.scenario, self.result
+        r.sim_duration_ms = round(self._now() - self._t0, 1)
+        if r.converged and self._first_fault_ms is not None \
+                and heal_candidate_ms is not None:
+            r.time_to_heal_ms = round(
+                max(heal_candidate_ms - self._first_fault_ms, 0.0), 1)
+        r.proposals = sum(op["numProposals"]
+                          for op in self.cc.ops_history if op["executed"])
+        est = self.cc.executor.state_json()
+        r.executor_tasks = est.get("numPlannedTasksTotal", 0)
+        r.executions = est.get("numExecutions", 0)
+        # ------------------------------------------- the scenario contract
+        if sc.expects_heal and not r.converged:
+            r.failures.append(
+                "did not converge within "
+                f"{sc.duration_ms:.0f} simulated ms: "
+                + "; ".join(invariants.check_converged(self.backend,
+                                                       self.expected_rf)
+                            + self._extra_convergence_checks())[:2000])
+        if r.invariant_violations:
+            r.failures.append(
+                f"{len(r.invariant_violations)} tick-invariant violations "
+                f"(first: {r.invariant_violations[0]})")
+        handled_types = {e["type"] for e in r.timeline
+                         if e["kind"] == "anomaly"}
+        for t in sc.expect_detect_types:
+            if t not in handled_types:
+                r.failures.append(f"expected anomaly type {t} never handled")
+        for t in sc.forbid_detect_types:
+            if t in handled_types:
+                r.failures.append(f"forbidden anomaly type {t} was handled")
+        if sc.max_detect_ms is not None and (
+                r.time_to_detect_ms is None
+                or r.time_to_detect_ms > sc.max_detect_ms):
+            r.failures.append(f"time_to_detect {r.time_to_detect_ms} ms "
+                              f"exceeds bound {sc.max_detect_ms:.0f} ms")
+        if sc.max_heal_ms is not None and sc.expects_heal and (
+                r.time_to_heal_ms is None
+                or r.time_to_heal_ms > sc.max_heal_ms):
+            r.failures.append(f"time_to_heal {r.time_to_heal_ms} ms "
+                              f"exceeds bound {sc.max_heal_ms:.0f} ms")
+        fix_errors = [e for e in r.timeline if e.get("fixError")]
+        if fix_errors:
+            r.failures.append(f"{len(fix_errors)} self-healing fixes raised "
+                              f"(first: {fix_errors[0]['fixError']})")
+        self.cc.shutdown()
+
+
+def run_scenario(scenario: Scenario, seed: int = 0,
+                 settle_ticks: int | None = None) -> ScenarioResult:
+    """Build + run one scenario; returns the (deterministic) result."""
+    return ScenarioRunner(scenario, seed=seed,
+                          settle_ticks=settle_ticks).run()

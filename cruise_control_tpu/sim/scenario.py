@@ -1,0 +1,179 @@
+"""Scenario DSL: scripted fault timelines against the simulated cluster.
+
+A :class:`Scenario` is a deterministic description of (1) the cluster to
+build, (2) a list of timed fault events, and (3) the convergence contract
+the self-healing loop must meet. The reference project proves its healing
+behavior with JVM integration harnesses (CCKafkaIntegrationTestHarness +
+the detector/executor integration tests); here the whole loop runs
+in-process on simulated time, so scenarios are cheap enough to run on every
+PR and strong enough to assert convergence bounds in simulated milliseconds.
+
+Events are plain (at_ms, kind, params) records — constructed through the
+helpers below — applied to the backend at their exact simulated time by
+:class:`~cruise_control_tpu.sim.runner.ScenarioRunner`, including mid-flight
+of a blocking proposal execution (the backend clock fires scheduled events
+from ``advance``).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioEvent:
+    """One timed fault. ``at_ms`` is relative to scenario start (after the
+    runner's metric-window warm-fill)."""
+    at_ms: float
+    kind: str
+    params: dict = dataclasses.field(default_factory=dict)
+
+    def label(self) -> str:
+        inner = ",".join(f"{k}={self.params[k]}" for k in sorted(self.params))
+        return f"{self.kind}({inner})"
+
+
+def broker_death(at_ms: float, broker_ids) -> ScenarioEvent:
+    """Kill brokers (BrokerFailureDetector -> remove_brokers heal path)."""
+    return ScenarioEvent(at_ms, "broker_death",
+                         {"brokers": sorted(int(b) for b in broker_ids)})
+
+
+def broker_restart(at_ms: float, broker_ids) -> ScenarioEvent:
+    return ScenarioEvent(at_ms, "broker_restart",
+                         {"brokers": sorted(int(b) for b in broker_ids)})
+
+
+def disk_failure(at_ms: float, broker_id: int, logdir: str) -> ScenarioEvent:
+    """Fail one logdir (DiskFailureDetector -> fix_offline_replicas path)."""
+    return ScenarioEvent(at_ms, "disk_failure",
+                         {"broker": int(broker_id), "logdir": logdir})
+
+
+def slow_broker(at_ms: float, broker_id: int, flush_ms: float = 5000.0,
+                bytes_in: float = 1.0) -> ScenarioEvent:
+    """Pin a broker's log-flush percentile high with a low byte rate —
+    the SlowBrokerFinder signature (slow, not busy)."""
+    return ScenarioEvent(at_ms, "slow_broker",
+                         {"broker": int(broker_id), "flush_ms": float(flush_ms),
+                          "bytes_in": float(bytes_in)})
+
+
+def clear_slow_broker(at_ms: float, broker_id: int) -> ScenarioEvent:
+    return ScenarioEvent(at_ms, "clear_slow_broker", {"broker": int(broker_id)})
+
+
+def metric_gap(at_ms: float, until_ms: float, broker_ids) -> ScenarioEvent:
+    """Silence metric emission from brokers over [at_ms, until_ms): the
+    monitor sees a reporting gap, NOT a broker failure — the loop must not
+    self-heal a healthy-but-quiet broker."""
+    return ScenarioEvent(at_ms, "metric_gap",
+                         {"until_ms": float(until_ms),
+                          "brokers": sorted(int(b) for b in broker_ids)})
+
+
+def topic_creation(at_ms: float, topic: str, partitions: int, rf: int,
+                   size_mb: float = 100.0) -> ScenarioEvent:
+    """Create a topic mid-run: the invariant checker starts tracking its
+    expected RF, and the loop must converge with it fully replicated."""
+    return ScenarioEvent(at_ms, "topic_creation",
+                         {"topic": topic, "partitions": int(partitions),
+                          "rf": int(rf), "size_mb": float(size_mb)})
+
+
+def maintenance_event(at_ms: float, plan_type: str, brokers=(),
+                      topics=None) -> ScenarioEvent:
+    """Spool an operator maintenance plan (MaintenanceEventDetector path)."""
+    return ScenarioEvent(at_ms, "maintenance_event",
+                         {"plan_type": plan_type,
+                          "brokers": sorted(int(b) for b in brokers),
+                          "topics": dict(topics or {})})
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterSpec:
+    """Deterministic cluster seed (all randomness flows from ``seed``)."""
+    num_brokers: int = 12
+    num_racks: int = 3
+    topics: tuple = (("t0", 60, 2), ("t1", 60, 2))  # (name, partitions, rf)
+    logdirs_per_broker: int = 1
+    logdir_capacity_mb: float = 500_000.0
+    size_mb_mean: float = 100.0
+    bytes_in_mean: float = 50.0
+    skew: float = 0.0     # > 0 concentrates leadership on low broker ids
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """One scripted run: cluster + events + convergence contract.
+
+    ``max_detect_ms`` / ``max_heal_ms`` are bounds in SIMULATED ms measured
+    from the first injected fault; ``expect_detect_types`` /
+    ``forbid_detect_types`` constrain which anomaly types the handler loop
+    may process; ``expect_empty_brokers`` / ``expect_nonleader_brokers`` are
+    extra convergence conditions on top of the global invariants.
+    """
+    name: str
+    cluster: ClusterSpec = ClusterSpec()
+    events: tuple = ()
+    duration_ms: float = 1_800_000.0
+    tick_ms: float = 15_000.0
+    config: tuple = ()                    # ((key, value), ...) config overrides
+    expects_heal: bool = True             # False: survival-only scenarios
+    max_detect_ms: float | None = None
+    max_heal_ms: float | None = None
+    expect_detect_types: tuple = ()
+    forbid_detect_types: tuple = ()
+    expect_empty_brokers: tuple = ()      # brokers hosting 0 replicas at end
+    expect_nonleader_brokers: tuple = ()  # brokers leading 0 partitions at end
+    settle_ticks: int = 2                 # convergence must hold N ticks
+
+    def config_dict(self) -> dict:
+        return {k: v for k, v in self.config}
+
+
+def build_backend(spec: ClusterSpec, metric_noise: float = 0.0):
+    """ClusterSpec -> seeded SimulatedClusterBackend. Placement is a pure
+    function of the spec (leader choice optionally skewed toward low broker
+    ids; followers round-robin over the remaining brokers), so two builds of
+    the same spec are bit-identical."""
+    import numpy as np
+
+    from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+
+    be = SimulatedClusterBackend(metric_noise=metric_noise, seed=spec.seed)
+    logdirs = {f"/logdir{d}": spec.logdir_capacity_mb
+               for d in range(spec.logdirs_per_broker)}
+    for b in range(spec.num_brokers):
+        be.add_broker(b, rack=f"r{b % spec.num_racks}", logdirs=dict(logdirs))
+    rng = np.random.default_rng(spec.seed)
+    B = spec.num_brokers
+    for topic, num_partitions, rf in spec.topics:
+        rf = min(rf, B)
+        for p in range(num_partitions):
+            if spec.skew > 0:
+                # exponential preference for low broker ids -> imbalance
+                # the goal chain has real work against
+                lead = int(min(rng.exponential(B / (2.0 + spec.skew)), B - 1))
+            else:
+                lead = (hash_stable(topic) + p) % B
+            replicas = [lead] + [(lead + 1 + i) % B for i in range(rf - 1)]
+            size = float(max(rng.exponential(spec.size_mb_mean), 1.0))
+            # spread replicas across logdirs so JBOD scenarios have real
+            # work (the backend default would put everything on /logdir0)
+            ld_of = {b: f"/logdir{(p + b) % spec.logdirs_per_broker}"
+                     for b in replicas}
+            be.create_partition(
+                topic, p, replicas, logdir_by_broker=ld_of, size_mb=size,
+                bytes_in_rate=float(max(rng.exponential(spec.bytes_in_mean), 0.1)),
+                bytes_out_rate=float(
+                    max(2.0 * rng.exponential(spec.bytes_in_mean), 0.1)),
+                cpu_util=float(size / 300.0))
+    return be
+
+
+def hash_stable(s: str) -> int:
+    """Process-independent string hash (PYTHONHASHSEED randomizes ``hash``,
+    which would make placement differ between pytest runs)."""
+    import zlib
+    return zlib.crc32(s.encode("utf-8"))

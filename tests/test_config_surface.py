@@ -645,8 +645,8 @@ def test_every_canonical_key_is_consumed(tmp_path):
         cc.load_monitor.sample_once(now_ms=300000.0)
         # self-healing fix path reads the healing-goal + exclusion keys
         be.kill_broker(3)
-        cc.anomaly_detector.run_detection_round(be.now_ms + 1.0)
-        cc.anomaly_detector.handle_anomalies(be.now_ms + 2.0)
+        cc.anomaly_detector.run_detection_round(be.now_ms() + 1.0)
+        cc.anomaly_detector.handle_anomalies(be.now_ms() + 2.0)
         cc.cached_proposals()
         cc.start_proposal_precompute()
         cc.partition_load(limit=3)

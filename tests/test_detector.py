@@ -117,18 +117,50 @@ def test_end_to_end_self_healing_broker_failure():
     cc = _cc(be)
     be.kill_broker(3)
     # detection: queue BrokerFailures
-    n = cc.anomaly_detector.run_detection_round(now_ms=be.now_ms + 1000)
+    n = cc.anomaly_detector.run_detection_round(now_ms=be.now_ms() + 1000)
     assert n >= 1
     # before grace expiry: CHECK (deferred)
-    handled = cc.anomaly_detector.handle_anomalies(now_ms=be.now_ms + 1000)
+    handled = cc.anomaly_detector.handle_anomalies(now_ms=be.now_ms() + 1000)
     assert any(h["action"] == "CHECK" for h in handled)
     # after self-healing threshold: FIX fires and replicas move off broker 3
-    handled = cc.anomaly_detector.handle_anomalies(now_ms=be.now_ms + 10_000)
+    handled = cc.anomaly_detector.handle_anomalies(now_ms=be.now_ms() + 10_000)
     assert any(h["action"] == "FIX" for h in handled)
     for info in be.partitions().values():
         assert 3 not in info.replicas
     st = cc.anomaly_detector.state_json()
     assert st["numSelfHealingActions"] >= 1
+
+
+def test_deferred_check_refires_through_fix_path():
+    """CHECK -> deferred -> FIX: an anomaly the notifier defers must re-fire
+    from the manager's deferred queue after its due time — WITHOUT another
+    detection round — and route through the same fix() path as REST-initiated
+    healing (AnomalyDetectorManager handler-loop contract)."""
+    be = _backend()
+    cc = _cc(be)
+    be.kill_broker(2)
+    ad = cc.anomaly_detector
+    t = be.now_ms() + 1000
+    assert ad.run_detection_round(now_ms=t) >= 1
+    handled = ad.handle_anomalies(now_ms=t)
+    # grace ladder: verdict is CHECK, anomaly parked in the deferred queue
+    assert [h["action"] for h in handled
+            if h["anomaly"]["type"] == "BROKER_FAILURE"] == ["CHECK"]
+    assert ad.num_queued() == 0
+    assert len(ad._deferred) == 1
+    # before the re-check due time: nothing drains
+    assert ad.handle_anomalies(now_ms=t + 50) == []
+    assert len(ad._deferred) == 1
+    # past the self-healing threshold: the SAME deferred anomaly re-fires
+    # and its fix() runs the remove-broker evacuation
+    handled = ad.handle_anomalies(now_ms=t + 10_000)
+    fix = [h for h in handled if h["anomaly"]["type"] == "BROKER_FAILURE"]
+    assert [h["action"] for h in fix] == ["FIX"]
+    assert "fixResult" in fix[0]
+    assert ad._deferred == []
+    for info in be.partitions().values():
+        assert 2 not in info.replicas
+    assert ad.state_json()["numSelfHealingActions"] >= 1
 
 
 def test_goal_violation_detector_reports():
