@@ -5,10 +5,12 @@ from cruise_control_tpu.analyzer.engine import EngineParams, optimize_goal
 from cruise_control_tpu.analyzer.optimizer import (
     GoalOptimizer, OptimizationFailureError, OptimizerResult,
 )
+from cruise_control_tpu.analyzer.session import ResidentClusterSession
 from cruise_control_tpu.analyzer.state import EngineState, init_state, refresh
 
 __all__ = [
     "BalancingConstraint", "ClusterEnv", "OptimizationOptions", "make_env",
     "EngineParams", "optimize_goal", "EngineState", "init_state", "refresh",
     "GoalOptimizer", "OptimizationFailureError", "OptimizerResult",
+    "ResidentClusterSession",
 ]

@@ -142,10 +142,10 @@ def _balancedness(goals, results_violated: dict,
     return 100.0 * got / total if total else 100.0
 
 
-def _budget_scale(ct) -> int:
+def _budget_scale(num_replicas: int) -> int:
     """How many times cheaper an engine pass is than at the 512k-replica
     reference point (pass cost ~linear in R); floors at 1."""
-    return max(1, (512 * 1024) // max(ct.num_replicas, 1024))
+    return max(1, (512 * 1024) // max(num_replicas, 1024))
 
 
 @lru_cache(maxsize=256)
@@ -291,13 +291,14 @@ class GoalOptimizer:
                           "topics": ct.num_topics},
                 "goals": list(goal_names or self._default_goal_names)}
 
-    def optimizations(self, ct: ClusterTensor, meta: ClusterMeta,
+    def optimizations(self, ct: ClusterTensor | None, meta: ClusterMeta | None = None,
                       goal_names: list[str] | None = None,
                       options: OptimizationOptions = OptimizationOptions(),
                       skip_hard_goal_check: bool = False,
                       raise_on_failure: bool = True,
                       measure_goal_durations: bool = False,
-                      min_leader_topic_pattern: str | None = None) -> OptimizerResult:
+                      min_leader_topic_pattern: str | None = None,
+                      session=None) -> OptimizerResult:
         """``measure_goal_durations=True`` blocks after every goal to time it
         honestly (proposal-computation-timer per goal); the default pipelines
         all goal programs asynchronously — one device round-trip for the whole
@@ -307,12 +308,21 @@ class GoalOptimizer:
         ``min_leader_topic_pattern`` (regex) marks the topics subject to
         MinTopicLeadersPerBrokerGoal; defaults to the
         ``topics.with.min.leaders.per.broker`` config key
-        (AnalyzerConfig.TOPICS_WITH_MIN_LEADERS_PER_BROKER_CONFIG role)."""
+        (AnalyzerConfig.TOPICS_WITH_MIN_LEADERS_PER_BROKER_CONFIG role).
+
+        ``session`` (a ResidentClusterSession, already synced): start from
+        the device-RESIDENT padded env/state instead of rebuilding —
+        ``ct``/``meta`` may be None, pad_cluster / membership-table build /
+        make_env / init_state and their full H2D upload are all skipped, and
+        the topic-exclusion + min-leaders masks are the ones baked into the
+        resident env. This is the steady-state service fast path
+        (GoalOptimizer.java precompute thread over the live ClusterModel)."""
         with self._proposal_timer.time():
             return self._optimizations(ct, meta, goal_names, options,
                                        skip_hard_goal_check, raise_on_failure,
                                        measure_goal_durations,
-                                       min_leader_topic_pattern)
+                                       min_leader_topic_pattern,
+                                       session=session)
 
     def _min_leader_mask(self, meta, pattern: str | None):
         """bool[T] mask of topics matching the min-leaders regex."""
@@ -330,7 +340,8 @@ class GoalOptimizer:
     def _optimizations(self, ct, meta, goal_names, options,
                        skip_hard_goal_check, raise_on_failure,
                        measure_goal_durations,
-                       min_leader_topic_pattern=None) -> OptimizerResult:
+                       min_leader_topic_pattern=None,
+                       session=None) -> OptimizerResult:
         names = goal_names or self._default_goal_names
         # honour hard-goal enforcement (KafkaCruiseControl sanityCheckHardGoalPresence)
         if goal_names and not skip_hard_goal_check:
@@ -344,8 +355,22 @@ class GoalOptimizer:
         goals = make_goals(known, self._constraint, options)
         run_preferred = "PreferredLeaderElectionGoal" in names
 
-        # bucket-pad shapes so similar clusters share compiled engine programs
-        ct, meta = pad_cluster(ct, meta)
+        if session is not None:
+            # resident fast path: the session owns the padded device env +
+            # observed engine state; the snapshot->pad->upload rebuild is
+            # skipped entirely. The state copy is defensive — the fused
+            # chain donates its state argument's buffers and the resident
+            # state must survive this round.
+            (env, st, meta, part_table, initial_broker, initial_leader,
+             initial_disk, host_valid, host_part) = session.optimizer_inputs()
+            num_replicas = env.num_replicas
+            num_brokers = env.num_brokers
+        else:
+            # bucket-pad shapes so similar clusters share compiled engine
+            # programs
+            ct, meta = pad_cluster(ct, meta)
+            num_replicas = ct.num_replicas
+            num_brokers = ct.num_brokers
         # scale the candidate set with cluster size: a wave lands up to K
         # moves, so K ~ B/4 keeps pass count (and wall clock) roughly flat;
         # candidate selection is an approx_max_k partial reduction, so a
@@ -360,20 +385,20 @@ class GoalOptimizer:
             # failure mode as the swap-pool >=220 fault; 1760 is the
             # largest bisect-proven-safe pool)
             num_candidates=min(1760, max(self._params.num_candidates,
-                                         ct.num_brokers // 4,
-                                         ct.num_replicas // 64)),
+                                         num_brokers // 4,
+                                         num_replicas // 64)),
             num_leader_candidates=min(1024, max(self._params.num_leader_candidates,
-                                                ct.num_brokers // 8)),
+                                                num_brokers // 8)),
             # swaps are the stall-breaking last resort: the [K1, K2] pair
             # scoring is quadratic, so grow the pool sub-linearly (the
             # TPU-fault hard clamp lives in engine._swap_branch_batched)
             num_swap_candidates=max(self._params.num_swap_candidates,
-                                    ct.num_brokers // 32),
+                                    num_brokers // 32),
             # destination-affinity classes scale with broker count: at 7k
             # brokers T=16 collapses the wave's destination variety (rung-4
             # A/B: T=64 was 21% faster AND left one fewer goal violated)
             num_dst_choices=min(128, max(self._params.num_dst_choices,
-                                         ct.num_brokers // 100)),
+                                         num_brokers // 100)),
             # exploration budgets scale with how CHEAP a pass is: per-pass
             # cost is ~linear in R, so smaller clusters afford far deeper
             # stall/dribble tails. Measured at 100k replicas: 1024/32
@@ -381,46 +406,50 @@ class GoalOptimizer:
             # at 1M replicas tripling the tail bought nothing (PERF.md), so
             # the headline rung keeps the lean 64/8.
             tail_pass_budget=min(
-                1024, self._params.tail_pass_budget * _budget_scale(ct) ** 2),
+                1024,
+                self._params.tail_pass_budget * _budget_scale(num_replicas) ** 2),
             stall_retries=min(
-                32, self._params.stall_retries * _budget_scale(ct)),
+                32, self._params.stall_retries * _budget_scale(num_replicas)),
             # small clusters skip the finisher subprogram entirely
             # (analyzer.finisher.min.replicas): the plateau-fixpoint proof
             # covers certificates there, and the subprogram multiplies the
             # small-fixture compile population's cost
             finisher_rounds=(0 if (self._finisher_min_replicas >= 0
-                                   and ct.num_replicas
+                                   and num_replicas
                                    < self._finisher_min_replicas)
                              else self._params.finisher_rounds))
 
-        tml = self._min_leader_mask(meta, min_leader_topic_pattern)
-        if tml is not None and tml.shape[0] < ct.num_topics:
-            tml = np.pad(tml, (0, ct.num_topics - tml.shape[0]))
-        # the membership table is built ON HOST once and shared with proposal
-        # diffing below — fetching it back from the device costs ~8 MB per
-        # optimization over a tunneled TPU
-        part_table = padded_partition_table(ct)
-        env = make_env(ct, meta, topic_min_leaders_mask=tml,
-                       partition_table=part_table)
-        st = init_state(env, ct.replica_broker, ct.replica_is_leader,
-                        ct.replica_offline, ct.replica_disk)
-        if self._mesh_axis_brokers > 1:
-            # tpu.mesh.axis.brokers: place env+state on an n-device mesh so
-            # the same chain runs GSPMD-sharded (parallel/sharding.py; the
-            # multichip dryrun drives this path with virtual devices)
-            from cruise_control_tpu.parallel import make_mesh, shard_cluster
-            mesh = make_mesh(self._mesh_axis_brokers)
-            env, st = shard_cluster(env, st, mesh)
-        # the initial assignment is exactly what init_state was given — take
-        # the host copies instead of a ~6 MB device round-trip (pad_cluster
-        # returns numpy; np.asarray is free there)
-        initial_broker = np.asarray(ct.replica_broker, np.int32)
-        initial_leader = np.asarray(ct.replica_is_leader, bool)
-        initial_disk = np.asarray(ct.replica_disk, np.int32)
+        if session is None:
+            tml = self._min_leader_mask(meta, min_leader_topic_pattern)
+            if tml is not None and tml.shape[0] < ct.num_topics:
+                tml = np.pad(tml, (0, ct.num_topics - tml.shape[0]))
+            # the membership table is built ON HOST once and shared with
+            # proposal diffing below — fetching it back from the device costs
+            # ~8 MB per optimization over a tunneled TPU
+            part_table = padded_partition_table(ct)
+            env = make_env(ct, meta, topic_min_leaders_mask=tml,
+                           partition_table=part_table)
+            st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                            ct.replica_offline, ct.replica_disk)
+            if self._mesh_axis_brokers > 1:
+                # tpu.mesh.axis.brokers: place env+state on an n-device mesh
+                # so the same chain runs GSPMD-sharded (parallel/sharding.py;
+                # the multichip dryrun drives this path with virtual devices)
+                from cruise_control_tpu.parallel import make_mesh, shard_cluster
+                mesh = make_mesh(self._mesh_axis_brokers)
+                env, st = shard_cluster(env, st, mesh)
+            # the initial assignment is exactly what init_state was given —
+            # take the host copies instead of a ~6 MB device round-trip
+            # (pad_cluster returns numpy; np.asarray is free there)
+            initial_broker = np.asarray(ct.replica_broker, np.int32)
+            initial_leader = np.asarray(ct.replica_is_leader, bool)
+            initial_disk = np.asarray(ct.replica_disk, np.int32)
+            host_valid = np.asarray(ct.replica_valid, bool)
+            host_part = np.asarray(ct.replica_partition, np.int32)
 
         use_fused = (not measure_goal_durations
                      and self._fused_min_replicas >= 0
-                     and ct.num_replicas >= self._fused_min_replicas)
+                     and num_replicas >= self._fused_min_replicas)
         if use_fused:
             # SEGMENTED chain: initial stats + violations + every goal up to
             # the first deep-tail goal run as ONE fused program (on a
@@ -439,14 +468,19 @@ class GoalOptimizer:
                           if getattr(g, "deep_tail", False)), len(goals))
             gclasses = tuple(type(g) for g in goals)
             # CC_PROFILE_SEGMENTS=1: block + log per segment (debug only —
-            # blocking defeats the async dispatch pipeline)
+            # blocking defeats the async dispatch pipeline). Segment timings
+            # are kept and surfaced into GoalResult.duration_s below, so a
+            # profiled fused run reports honest per-segment seconds instead
+            # of all-zeros.
             import os as _os
             _prof = bool(_os.environ.get("CC_PROFILE_SEGMENTS"))
+            seg_seconds: dict[str, float] = {}
 
             def _tick(label):
                 if _prof:
                     jax.block_until_ready(st.util)
                     now = time.monotonic()
+                    seg_seconds[label] = now - _tick.t0
                     print(f"[segment] {label}: {now - _tick.t0:.2f}s",
                           flush=True)
                     _tick.t0 = now
@@ -468,10 +502,14 @@ class GoalOptimizer:
                 _tick(g.name)
             st, fin_dev = _compiled_chain_final(gclasses, tuple(goals),
                                                 ple)(env, st)
+            _tick("final")
             out = jax.device_get(out_dev)
             fin = jax.device_get(fin_dev)
             infos = out["infos"] + jax.device_get(tail_infos_dev)
-            ple_dur = 0.0   # fused segments: no per-pass timing
+            # fused segments carry no per-pass timing unless profiling
+            # blocked per segment: the closing program's seconds stand in
+            # for the PLE pass it contains
+            ple_dur = seg_seconds.get("final", 0.0)
             viol0, sb = out["viol_before"], out["stats_before"]
             sa, packed = fin["stats_after"], fin["packed"]
             if run_preferred:
@@ -479,7 +517,15 @@ class GoalOptimizer:
             stats_before = _stats_to_json(sb)
             stats_after = _stats_to_json(sa)
             violated_before = {g.name: bool(v) for g, v in zip(goals, viol0)}
-            durations = [0.0] * len(goals)   # fused segments: not per-goal timed
+            if _prof:
+                # tail goals ran as their own segments (exact seconds); the
+                # prefix goals share one program, so its wall is split evenly
+                # across them — segment-honest, per-goal approximate
+                prefix_s = seg_seconds.get(f"prefix({split})", 0.0)
+                durations = [prefix_s / max(split, 1)] * split \
+                    + [seg_seconds.get(g.name, 0.0) for g in goals[split:]]
+            else:
+                durations = [0.0] * len(goals)
         else:
             stats_before = cluster_stats_state(env, st)
             viol0 = jax.device_get(_compiled_violations(tuple(goals))(env, st))
@@ -553,8 +599,7 @@ class GoalOptimizer:
         proposals = diff_proposals(
             env, meta, initial_broker, initial_leader, initial_disk, st,
             final=(final_broker, final_leader, final_disk),
-            host_statics=(part_table, np.asarray(ct.replica_valid, bool),
-                          np.asarray(ct.replica_partition, np.int32)))
+            host_statics=(part_table, host_valid, host_part))
         n_moves = proposals.num_replica_additions
         n_lead = proposals.num_leadership_changes
         data_mb = float(data_mb)

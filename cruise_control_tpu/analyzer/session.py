@@ -1,0 +1,554 @@
+"""ResidentClusterSession: device-resident cluster model with delta ingest.
+
+The reference keeps ONE in-memory ``ClusterModel`` continuously updated and
+only re-runs ``GoalOptimizer.optimizations()`` on it between proposal rounds
+(GoalOptimizer.java:139-339 precompute thread, LoadMonitor metadata
+listener). Our service path used to rebuild everything per round — snapshot
+-> ``ClusterTensor`` -> ``pad_cluster`` -> fresh ``make_env``/``init_state``
+-> full H2D upload — which at the 7k-broker rung costs 80 s+ against a ~7 s
+warm optimizer. This session is the TPU-native equivalent of the resident
+model: it owns the padded ``ClusterEnv``/``EngineState`` for one shape
+bucket, and between optimize rounds the monitor/backend feed it *deltas*:
+
+- **metric-window refresh** — fresh ``leader_load``/``follower_load``
+  [R, M] rows every round (assembled by the same
+  ``LoadMonitor.partition_load_columns``/``replica_load_rows`` code the full
+  build uses, so the two can never diverge), uploaded into a fresh buffer so
+  the H2D transfer overlaps the previous round's still-in-flight compute;
+- **replica churn** — broker / leadership / logdir changes scatter into the
+  slots they already occupy (``model/delta.diff_snapshots``);
+- **partition/topic creation** — appended rows scatter into the padded
+  axes' free tail slots while they last;
+- **broker flips** — liveness / demotion / capacity / dead-disk changes
+  re-upload the (small) broker-axis arrays; per-replica offline flags are
+  recomputed on device.
+
+Every sync ends in one jitted ``_sync_finalize`` program that re-derives the
+dependent quantities (offline flags, destination candidacy, topic-exclusion
+hoist) and refreshes the engine state — the same ``refresh`` the from-scratch
+path runs, so a session that ingested a delta stream is bit-identical to a
+rebuild of the final cluster (asserted in tests/test_session.py).
+
+Epoch/fingerprint fallback: any change the delta path cannot express
+in-place — shape-bucket growth, broker/rack/logdir set changes, partition
+deletion or non-append key churn, per-partition RF changes — or accumulated
+churn beyond ``analyzer.session.max.delta.fraction`` of the epoch's replicas
+triggers a full rebuild (a new epoch). Correctness never depends on the
+delta path applying; it is purely a fast path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer.env import make_env, padded_partition_table
+from cruise_control_tpu.analyzer.state import init_state, refresh
+from cruise_control_tpu.model.cluster_tensor import bucket_size, pad_cluster
+from cruise_control_tpu.model.delta import (
+    SnapshotDelta, diff_snapshots, replica_slot_values,
+)
+
+LOG = logging.getLogger(__name__)
+
+DEFAULT_MAX_DELTA_FRACTION = 0.25
+
+
+# ---------------------------------------------------------------------------
+# jitted delta programs (shapes bucketed -> a handful of compiled variants)
+# ---------------------------------------------------------------------------
+@jax.jit
+def _sync_finalize(env, st, leader_rows, follower_rows):
+    """Close a sync: swap in the new load rows, re-derive the env quantities
+    that depend on mutable inputs (destination candidacy, the topic-exclusion
+    hoist), recompute per-replica offline flags from broker/disk liveness at
+    the observed assignment, and refresh all derived engine state. Matches
+    ``make_env`` + ``init_state`` term for term — bit-exactness with the
+    from-scratch build rests on this program."""
+    env = dataclasses.replace(
+        env,
+        leader_load=leader_rows,
+        follower_load=follower_rows,
+        replica_topic_excluded=env.topic_excluded[env.replica_topic],
+        dst_candidate=env.broker_alive & ~env.broker_excluded_for_replica_move)
+    off = (~env.broker_alive[st.replica_broker]
+           | ~env.broker_disk_alive[st.replica_broker, st.replica_disk])
+    st = dataclasses.replace(st, replica_offline=off & env.replica_valid)
+    return env, refresh(env, st)
+
+
+@jax.jit
+def _scatter_state(st, idx, broker, disk, leader):
+    """Write churned replica slots into the observed assignment. ``idx`` is
+    padded with R (out-of-bounds -> dropped) so all small deltas share one
+    compiled program per bucket size."""
+    return dataclasses.replace(
+        st,
+        replica_broker=st.replica_broker.at[idx].set(broker, mode="drop"),
+        replica_disk=st.replica_disk.at[idx].set(disk, mode="drop"),
+        replica_is_leader=st.replica_is_leader.at[idx].set(leader, mode="drop"))
+
+
+@jax.jit
+def _scatter_env_churn(env, idx, orig):
+    """Churned replicas re-anchor their original broker (the rebuild sets
+    original := current, so the session must too)."""
+    return dataclasses.replace(
+        env,
+        replica_original_broker=env.replica_original_broker
+        .at[idx].set(orig, mode="drop"))
+
+
+@jax.jit
+def _scatter_env_append(env, idx, part, topic, orig, prows, prow_vals, ptop,
+                        tidx, texcl, tml):
+    """Land appended partitions/topics in the padded axes' free tail slots:
+    replica identity rows, membership-table rows, partition->topic links and
+    the new topics' exclusion / min-leaders flags."""
+    return dataclasses.replace(
+        env,
+        replica_partition=env.replica_partition.at[idx].set(part, mode="drop"),
+        replica_topic=env.replica_topic.at[idx].set(topic, mode="drop"),
+        replica_valid=env.replica_valid.at[idx].set(True, mode="drop"),
+        replica_original_broker=env.replica_original_broker
+        .at[idx].set(orig, mode="drop"),
+        partition_replicas=env.partition_replicas
+        .at[prows].set(prow_vals, mode="drop"),
+        partition_topic=env.partition_topic.at[prows].set(ptop, mode="drop"),
+        topic_excluded=env.topic_excluded.at[tidx].set(texcl, mode="drop"),
+        topic_min_leaders=env.topic_min_leaders.at[tidx].set(tml, mode="drop"))
+
+
+def _pad_idx(idx: np.ndarray, n: int, oob: int, minimum: int) -> np.ndarray:
+    """Bucket-pad a scatter index vector with an out-of-bounds sentinel so
+    delta sizes share compiled programs."""
+    nb = bucket_size(max(n, 1), minimum)
+    out = np.full(nb, oob, np.int32)
+    out[:n] = idx
+    return out
+
+
+def _pad_vals(vals: np.ndarray, nb: int, fill=0) -> np.ndarray:
+    out = np.full((nb,) + vals.shape[1:], fill, vals.dtype)
+    out[:vals.shape[0]] = vals
+    return out
+
+
+class ResidentClusterSession:
+    """Owner of the device-resident (env, state) for one shape bucket.
+
+    Thread-safe: ``sync`` and ``optimizer_inputs`` serialize on ``lock``.
+    The resident state always reflects the *observed* cluster — optimizer
+    runs start from a defensive copy (the fused chain donates its state
+    buffers) and their proposed moves only come back via the backend and the
+    next sync's deltas.
+    """
+
+    def __init__(self, monitor, config=None):
+        self._monitor = monitor
+        if config is not None:
+            self._max_delta_fraction = config.get_double(
+                "analyzer.session.max.delta.fraction")
+            self._excluded_pattern = config.get_string(
+                "topics.excluded.from.partition.movement")
+            self._min_leader_pattern = config.get_string(
+                "topics.with.min.leaders.per.broker")
+        else:
+            self._max_delta_fraction = DEFAULT_MAX_DELTA_FRACTION
+            self._excluded_pattern = ""
+            self._min_leader_pattern = ""
+        self.lock = threading.RLock()
+        # resident device state + host companions
+        self.env = None
+        self.state = None
+        self.meta = None
+        self.part_table: np.ndarray | None = None    # host [Pp, F] mirror
+        # host mirrors of the observed padded assignment (proposal diffing
+        # and delta bookkeeping without device round-trips)
+        self._h: dict[str, np.ndarray] = {}
+        self._rep_part: np.ndarray | None = None     # i64[R_valid] CSR links
+        self._broker_mirror: dict[str, np.ndarray] = {}
+        self._prev_snapshot = None
+        self._epoch_replicas = 0       # valid replicas at epoch start
+        self._cum_churn = 0
+        # observability
+        self.epoch = 0
+        self.rebuild_rounds = 0
+        self.delta_rounds = 0
+        self.last_sync_info: dict = {}
+
+    # ------------------------------------------------------------- public
+    def sync(self, allow_capacity_estimation: bool = True) -> dict:
+        """Bring the resident state up to the monitor's latest windows and
+        the backend's latest metadata. Returns {"mode": "delta"|"rebuild",
+        ...}; raises NotEnoughValidWindowsError before any window exists."""
+        from cruise_control_tpu.monitor.load_monitor import (
+            NotEnoughValidWindowsError,
+        )
+        with self.lock:
+            t0 = time.monotonic()
+            mon = self._monitor
+            agg = mon._partition_agg.aggregate()
+            if not agg.window_starts_ms:
+                raise NotEnoughValidWindowsError("0 valid windows < required 1")
+            snap = mon._snapshot()
+            if self.env is None:
+                return self._rebuild("cold start", allow_capacity_estimation)
+            delta = None
+            if snap.generation != self._prev_snapshot.generation:
+                delta = diff_snapshots(self._prev_snapshot, snap)
+                reason = self._delta_blocker(snap, delta)
+                if reason is None:
+                    reason = self._refresh_brokers(allow_capacity_estimation)
+                if reason is not None:
+                    return self._rebuild(reason, allow_capacity_estimation)
+                self._apply_topology_delta(snap, delta)
+                self._cum_churn += delta.churn
+                self._prev_snapshot = snap
+            self._refresh_metrics(agg, snap)
+            self.delta_rounds += 1
+            info = {
+                "mode": "delta",
+                "epoch": self.epoch,
+                "churn": 0 if delta is None else delta.churn,
+                "cum_churn_fraction": round(
+                    self._cum_churn / max(self._epoch_replicas, 1), 4),
+                "sync_s": round(time.monotonic() - t0, 4),
+            }
+            self.last_sync_info = info
+            return info
+
+    def optimizer_inputs(self) -> tuple:
+        """(env, state-copy, meta, part_table, initial_broker, initial_leader,
+        initial_disk, host_valid, host_partition) for
+        ``GoalOptimizer.optimizations(session=...)``. The state is a fresh
+        device copy — the fused chain donates its state argument's buffers,
+        and the resident state must survive the round."""
+        with self.lock:
+            st = jax.tree_util.tree_map(jnp.copy, self.state)
+            # host arrays are copied: a later sync's in-place delta writes
+            # must not race an optimization still diffing proposals
+            return (self.env, st, self.meta, self.part_table.copy(),
+                    self._h["replica_broker"].copy(),
+                    self._h["replica_is_leader"].copy(),
+                    self._h["replica_disk"].copy(),
+                    self._h["replica_valid"].copy(),
+                    self._h["replica_partition"].copy())
+
+    def invalidate(self) -> None:
+        """Force the next sync to rebuild (new epoch)."""
+        with self.lock:
+            self.env = None
+            self.state = None
+
+    def state_json(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "rebuildRounds": self.rebuild_rounds,
+            "deltaRounds": self.delta_rounds,
+            "lastSync": dict(self.last_sync_info),
+        }
+
+    # ----------------------------------------------------------- fallback
+    def _delta_blocker(self, snap, delta: SnapshotDelta) -> str | None:
+        """Why this delta cannot be applied in place (None = it can)."""
+        if not delta.compatible:
+            return delta.reason
+        env = self.env
+        if delta.num_replicas_after > env.num_replicas:
+            return "replica pad slots exhausted"
+        if delta.num_partitions_after > env.num_partitions:
+            return "partition pad slots exhausted"
+        if delta.num_topics_after > int(env.topic_excluded.shape[0]):
+            return "topic pad slots exhausted"
+        if delta.num_partitions_after > delta.num_partitions_before:
+            nrep_app = np.diff(
+                snap.rep_ptr[delta.num_partitions_before:])
+            if nrep_app.size and int(nrep_app.max()) > env.max_rf:
+                return "membership-table width exceeded"
+        if (self._cum_churn + delta.churn
+                > self._max_delta_fraction * max(self._epoch_replicas, 1)):
+            return (f"churn budget exceeded "
+                    f"({self._cum_churn + delta.churn} slots "
+                    f"> {self._max_delta_fraction:.2f} of "
+                    f"{self._epoch_replicas})")
+        return None
+
+    # ------------------------------------------------------------ rebuild
+    def _rebuild(self, reason: str, allow_capacity_estimation: bool) -> dict:
+        t0 = time.monotonic()
+        mon = self._monitor
+        # the model must correspond to ONE metadata generation: retry if a
+        # concurrent mutator bumped it mid-build
+        for _ in range(4):
+            snap = mon._snapshot()
+            ct, meta = mon.cluster_model(
+                allow_capacity_estimation=allow_capacity_estimation)
+            if mon._snapshot().generation == snap.generation:
+                break
+        ct = self._apply_excluded_pattern(ct, meta)
+        ct, meta = pad_cluster(ct, meta)
+        part_table = padded_partition_table(ct)
+        tml = self._tml_mask(meta, ct.num_topics)
+        env = make_env(ct, meta, topic_min_leaders_mask=tml,
+                       partition_table=part_table)
+        st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                        ct.replica_offline, ct.replica_disk)
+        # pre-warm every delta program for this epoch's shapes with no-op
+        # scatters (all indices out of bounds -> dropped) and a same-rows
+        # finalize: steady rounds — including their FIRST real churn — then
+        # run with ZERO new XLA compiles, which bench.py asserts per rung
+        Rp = env.num_replicas
+        Pp = env.num_partitions
+        Tp = int(env.topic_excluded.shape[0])
+        ridx = np.full(bucket_size(1, 64), Rp, np.int32)
+        zi = np.zeros(ridx.shape[0], np.int32)
+        zb = np.zeros(ridx.shape[0], bool)
+        st = _scatter_state(st, ridx, zi, zi, zb)
+        env = _scatter_env_churn(env, ridx, zi)
+        prows = np.full(bucket_size(1, 16), Pp, np.int32)
+        prow_vals = np.full((prows.shape[0], env.max_rf), -1, np.int32)
+        ptop = np.zeros(prows.shape[0], np.int32)
+        tidx = np.full(bucket_size(1, 8), Tp, np.int32)
+        tz = np.zeros(tidx.shape[0], bool)
+        env = _scatter_env_append(env, ridx, zi, zi, zi, prows, prow_vals,
+                                  ptop, tidx, tz, tz)
+        env, st = _sync_finalize(env, st, env.leader_load, env.follower_load)
+        self.env, self.state = env, st
+        # session-owned meta: appended partitions/topics extend these lists
+        self.meta = dataclasses.replace(
+            meta, topic_names=list(meta.topic_names),
+            partition_ids=list(meta.partition_ids))
+        self.part_table = np.ascontiguousarray(part_table)
+        self._h = {
+            "replica_broker": np.asarray(ct.replica_broker, np.int32).copy(),
+            "replica_is_leader": np.asarray(ct.replica_is_leader, bool).copy(),
+            "replica_disk": np.asarray(ct.replica_disk, np.int32).copy(),
+            "replica_valid": np.asarray(ct.replica_valid, bool).copy(),
+            "replica_partition": np.asarray(ct.replica_partition,
+                                            np.int32).copy(),
+        }
+        Rv = meta.num_valid_replicas
+        self._rep_part = self._h["replica_partition"][:Rv].astype(np.int64)
+        self._broker_mirror = self._broker_dense_padded_from_ct(ct)
+        self._prev_snapshot = snap
+        self._epoch_replicas = Rv
+        self._cum_churn = 0
+        self.epoch += 1
+        self.rebuild_rounds += 1
+        info = {
+            "mode": "rebuild",
+            "reason": reason,
+            "epoch": self.epoch,
+            "shape": {"replicas": env.num_replicas,
+                      "brokers": env.num_brokers,
+                      "partitions": env.num_partitions,
+                      "topics": int(env.topic_excluded.shape[0]),
+                      "max_rf": env.max_rf},
+            "sync_s": round(time.monotonic() - t0, 4),
+        }
+        self.last_sync_info = info
+        LOG.info("resident session rebuild (epoch %d): %s", self.epoch, reason)
+        return info
+
+    def _apply_excluded_pattern(self, ct, meta):
+        """Configured topics.excluded.from.partition.movement applies to
+        every session-served optimization (the precompute path's semantics;
+        per-request custom exclusions bypass the session entirely)."""
+        if not self._excluded_pattern:
+            return ct
+        rx = re.compile(self._excluded_pattern)
+        excl = np.asarray(ct.topic_excluded).copy()
+        for i, name in enumerate(meta.topic_names):
+            if rx.fullmatch(name):
+                excl[i] = True
+        return dataclasses.replace(ct, topic_excluded=jnp.asarray(excl))
+
+    def _tml_mask(self, meta, padded_T: int):
+        if not self._min_leader_pattern:
+            return None
+        rx = re.compile(self._min_leader_pattern)
+        m = np.asarray([bool(rx.fullmatch(t)) for t in meta.topic_names], bool)
+        if m.shape[0] < padded_T:
+            m = np.pad(m, (0, padded_T - m.shape[0]))
+        return m
+
+    def _topic_flags(self, name: str) -> tuple[bool, bool]:
+        """(excluded, min_leaders) flags an appended topic gets."""
+        excl = bool(self._excluded_pattern
+                    and re.fullmatch(self._excluded_pattern, name))
+        tml = bool(self._min_leader_pattern
+                   and re.fullmatch(self._min_leader_pattern, name))
+        return excl, tml
+
+    # ------------------------------------------------------- broker axis
+    @staticmethod
+    def _pad_b(a: np.ndarray, Bp: int, fill) -> np.ndarray:
+        if a.shape[0] == Bp:
+            return np.asarray(a)
+        width = [(0, Bp - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(np.asarray(a), width, constant_values=fill)
+
+    _BROKER_FIELDS = (
+        # (ClusterEnv field, pad fill) — pad brokers are dead, excluded,
+        # zero-capacity (pad_cluster's fills)
+        ("broker_capacity", 0.0), ("broker_rack", 0), ("broker_alive", False),
+        ("broker_new", False), ("broker_demoted", False),
+        ("broker_excluded_for_replica_move", True),
+        ("broker_excluded_for_leadership", True),
+        ("broker_disk_capacity", 0.0), ("broker_disk_alive", False),
+    )
+
+    def _broker_dense_padded_from_ct(self, ct) -> dict:
+        return {name: np.asarray(getattr(ct, name)).copy()
+                for name, _ in self._BROKER_FIELDS}
+
+    def _refresh_brokers(self, allow_capacity_estimation: bool) -> str | None:
+        """Recompute the (small) broker-axis arrays exactly as the model
+        build would and upload the changed ones; returns a rebuild reason
+        when the change is structural (broker/rack/logdir set)."""
+        from cruise_control_tpu.model.builder import ClusterModelBuilder
+        mon = self._monitor
+        brokers = mon._backend.brokers()
+        builder = ClusterModelBuilder()
+        lds_by_broker, _dead = mon.populate_brokers(
+            builder, brokers,
+            allow_capacity_estimation=allow_capacity_estimation)
+        broker_ids = sorted(brokers)
+        if broker_ids != self.meta.broker_ids:
+            return "broker set changed"
+        racks = sorted({s.rack for s in builder._brokers.values()})
+        if racks != self.meta.rack_ids:
+            return "rack set changed"
+        if [lds_by_broker[b] for b in broker_ids] != self.meta.logdirs:
+            return "logdir layout changed"
+        ridx = {r: i for i, r in enumerate(racks)}
+        (cap, rack, alive, new, demoted, excl_move, excl_lead,
+         disk_cap, disk_alive, _lds) = builder.broker_arrays(broker_ids, ridx)
+        Bp = self.env.num_brokers
+        D = int(self.env.broker_disk_capacity.shape[1])
+        if disk_cap.shape[1] != D:
+            return "disk-axis width changed"
+        dense = dict(zip((n for n, _ in self._BROKER_FIELDS),
+                         (cap, rack, alive, new, demoted, excl_move,
+                          excl_lead, disk_cap, disk_alive)))
+        changed = {}
+        for name, fill in self._BROKER_FIELDS:
+            padded = self._pad_b(dense[name], Bp, fill)
+            if not np.array_equal(padded, self._broker_mirror[name]):
+                changed[name] = padded
+        if changed:
+            self._broker_mirror.update(changed)
+            self.env = dataclasses.replace(
+                self.env, **{name: jnp.asarray(a)
+                             for name, a in changed.items()})
+        return None
+
+    # ------------------------------------------------------ replica churn
+    def _apply_topology_delta(self, snap, delta: SnapshotDelta) -> None:
+        env, st = self.env, self.state
+        Rp = env.num_replicas
+        Pp = env.num_partitions
+        Tp = int(env.topic_excluded.shape[0])
+        D = int(env.broker_disk_capacity.shape[1])
+        sorted_bids = np.asarray(self.meta.broker_ids, np.int64)
+        h = self._h
+        if delta.num_changed:
+            slots = delta.changed_slots
+            vals = replica_slot_values(snap, slots, sorted_bids, D)
+            idx = _pad_idx(slots.astype(np.int32), delta.num_changed, Rp, 64)
+            nb = idx.shape[0]
+            broker = _pad_vals(vals["broker"], nb)
+            disk = _pad_vals(vals["disk"], nb)
+            leader = _pad_vals(vals["leader"], nb)
+            st = _scatter_state(st, idx, broker, disk, leader)
+            env = _scatter_env_churn(env, idx, broker)
+            h["replica_broker"][slots] = vals["broker"]
+            h["replica_disk"][slots] = vals["disk"]
+            h["replica_is_leader"][slots] = vals["leader"]
+        if delta.num_appended_replicas or (
+                delta.num_partitions_after > delta.num_partitions_before):
+            p_lo, p_hi = (delta.num_partitions_before,
+                          delta.num_partitions_after)
+            r_lo, r_hi = delta.num_replicas_before, delta.num_replicas_after
+            slots = np.arange(r_lo, r_hi, dtype=np.int64)
+            vals = replica_slot_values(snap, slots, sorted_bids, D)
+            nrep_app = np.diff(snap.rep_ptr[p_lo:p_hi + 1])
+            rep_part_new = np.repeat(np.arange(p_lo, p_hi, dtype=np.int64),
+                                     nrep_app)
+            topic_of_new = snap.partition_topic[rep_part_new]
+            # appended membership-table rows: rank of each new replica
+            # within its partition
+            starts = snap.rep_ptr[p_lo:p_hi] - r_lo
+            rank = np.arange(r_hi - r_lo) - np.repeat(starts, nrep_app)
+            F = env.max_rf
+            prow_vals = np.full((p_hi - p_lo, F), -1, np.int32)
+            prow_vals[rep_part_new - p_lo, rank] = slots
+            # appended topics: exclusion/min-leaders flags from the
+            # configured patterns (what a rebuild would compute)
+            t_lo, t_hi = delta.num_topics_before, delta.num_topics_after
+            new_topics = list(snap.topics[t_lo:t_hi])
+            flags = [self._topic_flags(t) for t in new_topics]
+            n_t = len(new_topics)
+            tidx = _pad_idx(np.arange(t_lo, t_hi, dtype=np.int32), n_t, Tp, 8)
+            ntb = tidx.shape[0]
+            texcl = _pad_vals(np.asarray([f[0] for f in flags], bool), ntb)
+            tml = _pad_vals(np.asarray([f[1] for f in flags], bool), ntb)
+            n_r = r_hi - r_lo
+            idx = _pad_idx(slots.astype(np.int32), n_r, Rp, 64)
+            nb = idx.shape[0]
+            broker = _pad_vals(vals["broker"], nb)
+            disk = _pad_vals(vals["disk"], nb)
+            leader = _pad_vals(vals["leader"], nb)
+            part = _pad_vals(rep_part_new.astype(np.int32), nb)
+            topic = _pad_vals(topic_of_new.astype(np.int32), nb)
+            n_p = p_hi - p_lo
+            prows = _pad_idx(np.arange(p_lo, p_hi, dtype=np.int32), n_p, Pp, 16)
+            npb = prows.shape[0]
+            prow_vals_p = _pad_vals(prow_vals, npb, -1)
+            ptop = _pad_vals(snap.partition_topic[p_lo:p_hi]
+                             .astype(np.int32), npb)
+            st = _scatter_state(st, idx, broker, disk, leader)
+            env = _scatter_env_append(env, idx, part, topic, broker, prows,
+                                      prow_vals_p, ptop, tidx, texcl, tml)
+            # host companions follow
+            h["replica_broker"][slots] = vals["broker"]
+            h["replica_disk"][slots] = vals["disk"]
+            h["replica_is_leader"][slots] = vals["leader"]
+            h["replica_valid"][slots] = True
+            h["replica_partition"][slots] = rep_part_new.astype(np.int32)
+            self.part_table[p_lo:p_hi] = prow_vals
+            self._rep_part = np.concatenate([self._rep_part, rep_part_new])
+            self.meta.partition_ids.extend(snap.partition_keys[p_lo:p_hi])
+            self.meta.topic_names.extend(new_topics)
+            self.meta.num_valid_replicas = r_hi
+        self.env, self.state = env, st
+
+    # ------------------------------------------------------ metric refresh
+    def _refresh_metrics(self, agg, snap) -> None:
+        """Per-round metric-window refresh: assemble the [R, M] load rows
+        with the SAME monitor code the full build uses, upload them into
+        fresh buffers (the device_put is async on an accelerator, so the H2D
+        copy overlaps the previous round's in-flight compute — the
+        double-buffer effect without reusing memory an old env may still
+        alias), then run the finalize program."""
+        mon = self._monitor
+        cols = mon.partition_load_columns(snap.partition_keys,
+                                          snap.generation, agg=agg)
+        lead, foll = mon.replica_load_rows(cols, self._rep_part)
+        Rp = self.env.num_replicas
+        Rv = lead.shape[0]
+        lead_p = np.zeros((Rp, lead.shape[1]), np.float32)
+        foll_p = np.zeros((Rp, foll.shape[1]), np.float32)
+        lead_p[:Rv] = lead
+        foll_p[:Rv] = foll
+        lead_dev = jax.device_put(lead_p)
+        foll_dev = jax.device_put(foll_p)
+        self.env, self.state = _sync_finalize(self.env, self.state,
+                                              lead_dev, foll_dev)

@@ -99,6 +99,19 @@ class CruiseControl:
         # current cluster shape in the background at service startup
         self._warmup_on_start = self.config.get_boolean(
             "analyzer.warmup.on.start")
+        # analyzer.resident.session.enabled: ONE device-resident padded
+        # env/state per shape bucket, fed monitor/backend deltas between
+        # optimize rounds — the steady-state precompute and self-healing FIX
+        # rounds skip the snapshot->pad->upload rebuild (the reference's
+        # continuously-updated ClusterModel role, GoalOptimizer.java:139-339).
+        # Disabled under a sharded mesh: the session pins single-device
+        # placement.
+        self.resident_session = None
+        if (self.config.get_boolean("analyzer.resident.session.enabled")
+                and self.config.get_int("tpu.mesh.axis.brokers") <= 1):
+            from cruise_control_tpu.analyzer.session import ResidentClusterSession
+            self.resident_session = ResidentClusterSession(
+                self.load_monitor, config=self.config)
         self._wire_detectors()
         self._proposal_cache: OptimizerResult | None = None
         self._proposal_cache_generation = None
@@ -380,6 +393,40 @@ class CruiseControl:
                     ct, broker_excluded_for_leadership=jnp.asarray(excl))
         return ct
 
+    def _usable_session(self, excluded_topics: str | None,
+                        exclude_removed: bool, exclude_demoted: bool,
+                        allow_capacity_estimation: bool = True):
+        """The synced resident session when this operation can run on it, or
+        None to take the full model-build path. Custom topic exclusions and
+        non-empty broker blocklists need per-request env mutation the
+        resident state does not carry, so they fall back; so does any sync
+        failure (the session is purely a fast path — never a correctness
+        dependency). NotEnoughValidWindowsError propagates like the model
+        build's own completeness gate."""
+        from cruise_control_tpu.monitor.load_monitor import (
+            NotEnoughValidWindowsError,
+        )
+        sess = self.resident_session
+        if sess is None:
+            return None
+        if excluded_topics is not None:
+            return None     # request-specific regex (configured one is baked in)
+        if exclude_removed and self.executor.recently_removed_brokers():
+            return None
+        if exclude_demoted and self.executor.recently_demoted_brokers():
+            return None
+        try:
+            sess.sync(allow_capacity_estimation=allow_capacity_estimation)
+        except NotEnoughValidWindowsError:
+            raise
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception(
+                "resident session sync failed; falling back to full rebuild")
+            sess.invalidate()
+            return None
+        return sess
+
     def _self_healing_goals(self) -> list:
         """Goals self-healing fixes optimize: AnomalyDetectorConfig
         ``self.healing.goals`` when set, else the built-in evacuation chain."""
@@ -401,14 +448,15 @@ class CruiseControl:
     def _run_optimization(self, operation: str, reason: str, ct, meta,
                           goal_names=None, options=OptimizationOptions(),
                           dry_run: bool = True, skip_hard_goal_check: bool = False,
-                          execute_kw: dict | None = None) -> OperationResult:
+                          execute_kw: dict | None = None,
+                          session=None) -> OperationResult:
         goals = goal_names or effective_default_goals(self.config)
         # optimization.options.generator.class seam: deployments may rewrite
         # the options of any internally-triggered optimization
         options = self._options_generator.optimization_options(options, operation)
         res = self.goal_optimizer.optimizations(
             ct, meta, goal_names=goals, options=options,
-            skip_hard_goal_check=skip_hard_goal_check)
+            skip_hard_goal_check=skip_hard_goal_check, session=session)
         op = OperationResult(operation=operation, reason=reason,
                              optimizer_result=res)
         if not dry_run and res.proposals:
@@ -451,12 +499,21 @@ class CruiseControl:
             # fail before optimizing — a typo'd strategy must 400, not burn
             # an optimization then 500 at execute time
             self.executor.validate_strategies(replica_movement_strategies)
-        ct, meta = self._model()
-        ct = self._apply_excluded_topics(ct, meta, excluded_topics)
         excl_rm, excl_dm = self._self_healing_exclusions(
             exclude_recently_removed_brokers, exclude_recently_demoted_brokers,
             self_healing)
-        ct = self._apply_broker_exclusions(ct, meta, excl_rm, excl_dm)
+        # steady-state fast path: plain rebalances (incl. the detector's FIX
+        # firings) start from the device-resident session instead of
+        # rebuilding the model; mode-specific goal rewrites and per-request
+        # exclusions keep the full build
+        session = (None if (kafka_assigner or rebalance_disk)
+                   else self._usable_session(excluded_topics, excl_rm, excl_dm))
+        if session is not None:
+            ct = meta = None
+        else:
+            ct, meta = self._model()
+            ct = self._apply_excluded_topics(ct, meta, excluded_topics)
+            ct = self._apply_broker_exclusions(ct, meta, excl_rm, excl_dm)
         options = OptimizationOptions(
             triggered_by_goal_violation=triggered_by_goal_violation)
         if kafka_assigner:
@@ -481,7 +538,7 @@ class CruiseControl:
                                     dry_run=dry_run,
                                     skip_hard_goal_check=skip_hard_goal_check
                                     or self_healing,
-                                    execute_kw=execute_kw)
+                                    execute_kw=execute_kw, session=session)
         return op.to_json()
 
     def remove_brokers(self, broker_ids: list, dry_run: bool = False,
@@ -567,16 +624,22 @@ class CruiseControl:
                              exclude_recently_demoted_brokers: bool = False,
                              reason: str = "fix offline replicas") -> dict:
         """POST /fix_offline_replicas (FixOfflineReplicasRunnable role)."""
-        ct, meta = self._model()
-        ct = self._apply_excluded_topics(ct, meta, excluded_topics)
         excl_rm, excl_dm = self._self_healing_exclusions(
             exclude_recently_removed_brokers, exclude_recently_demoted_brokers,
             self_healing)
-        ct = self._apply_broker_exclusions(ct, meta, excl_rm, excl_dm)
+        # self-healing FIX firings hit this path: the resident session makes
+        # time-to-heal bounded by the warm optimizer, not a model rebuild
+        session = self._usable_session(excluded_topics, excl_rm, excl_dm)
+        if session is not None:
+            ct = meta = None
+        else:
+            ct, meta = self._model()
+            ct = self._apply_excluded_topics(ct, meta, excluded_topics)
+            ct = self._apply_broker_exclusions(ct, meta, excl_rm, excl_dm)
         op = self._run_optimization(
             "FIX_OFFLINE_REPLICAS", reason, ct, meta, self._self_healing_goals(),
             OptimizationOptions(fix_offline_replicas_only=True),
-            dry_run=dry_run, skip_hard_goal_check=True)
+            dry_run=dry_run, skip_hard_goal_check=True, session=session)
         return op.to_json()
 
     def fix_topic_replication_factor(self, bad_topics: dict,
@@ -745,15 +808,27 @@ class CruiseControl:
             gen = self.load_monitor.model_generation().as_tuple()
             # allow.capacity.estimation.on.proposal.precompute: whether the
             # precompute path tolerates estimated broker capacities
-            ct, meta = self.load_monitor.cluster_model(
-                allow_capacity_estimation=self.config.get_boolean(
-                    "allow.capacity.estimation.on.proposal.precompute"))
-            # the configured exclusion regex applies to precomputed proposals
-            ct = self._apply_excluded_topics(ct, meta, None)
+            allow_est = self.config.get_boolean(
+                "allow.capacity.estimation.on.proposal.precompute")
+            # steady-state fast path: the resident session ingests this
+            # round's metric/topology deltas and the optimizer starts from
+            # the device-resident state — the snapshot->pad->upload rebuild
+            # only happens on epoch changes (shape growth / churn budget)
+            session = self._usable_session(None, False, False,
+                                           allow_capacity_estimation=allow_est)
+            if session is not None:
+                ct = meta = None
+            else:
+                ct, meta = self.load_monitor.cluster_model(
+                    allow_capacity_estimation=allow_est)
+                # the configured exclusion regex applies to precomputed
+                # proposals (the session bakes it in at rebuild)
+                ct = self._apply_excluded_topics(ct, meta, None)
             # the precompute path records violations instead of failing the
             # cache refresh (GoalOptimizer.java precompute thread logs+retries)
             res = self.goal_optimizer.optimizations(ct, meta,
-                                                    raise_on_failure=False)
+                                                    raise_on_failure=False,
+                                                    session=session)
             with self._cache_lock:
                 self._proposal_cache = res
                 self._proposal_cache_generation = gen
@@ -780,6 +855,9 @@ class CruiseControl:
                 # AnalyzerState.java goalReadiness catalog role)
                 "supportedGoals": sorted(GOAL_CLASSES),
             }
+            if self.resident_session is not None:
+                out["AnalyzerState"]["residentSession"] = \
+                    self.resident_session.state_json()
         if "ANOMALY_DETECTOR" in substates:
             out["AnomalyDetectorState"] = self.anomaly_detector.state_json()
         if "SENSORS" in substates:

@@ -156,6 +156,21 @@ _D.define(name="analyzer.fused.chain.min.replicas", type=Type.INT, default=65_53
               "per goal — each execution costs ~1 s fixed overhead on a "
               "tunneled TPU); below it per-goal programs keep compiles small. "
               "-1 disables fusion.")
+_D.define(name="analyzer.resident.session.enabled", type=Type.BOOLEAN, default=True,
+          doc="TPU-specific: keep ONE device-resident padded ClusterEnv/"
+              "EngineState per shape bucket (analyzer/session.py) and feed it "
+              "monitor/backend DELTAS between proposal rounds, so the "
+              "steady-state precompute and self-healing FIX rounds skip the "
+              "snapshot->pad->upload model rebuild (the reference's "
+              "continuously-updated ClusterModel + GoalOptimizer precompute "
+              "thread role). Requests with custom topic/broker exclusions "
+              "fall back to the full build automatically.")
+_D.define(name="analyzer.session.max.delta.fraction", type=Type.DOUBLE, default=0.25,
+          validator=at_least(0.0),
+          doc="Resident-session churn budget: when the replica slots touched "
+              "by deltas since the epoch's rebuild exceed this fraction of "
+              "the cluster's replicas, the next round rebuilds from scratch "
+              "(a fresh epoch) instead of applying further deltas.")
 _D.define(name="goal.balancedness.priority.weight", type=Type.DOUBLE, default=1.1,
           validator=at_least(1.0),
           doc="Balancedness score: weight step per goal priority rank "

@@ -1,9 +1,11 @@
 from cruise_control_tpu.model.builder import ClusterModelBuilder, split_leader_follower
 from cruise_control_tpu.model.cluster_tensor import ClusterMeta, ClusterTensor
+from cruise_control_tpu.model.delta import SnapshotDelta, diff_snapshots
 from cruise_control_tpu.model.sanity import SanityCheckError, sanity_check
 from cruise_control_tpu.model.stats import ClusterStats, cluster_stats
 
 __all__ = [
     "ClusterModelBuilder", "ClusterMeta", "ClusterTensor", "ClusterStats",
-    "SanityCheckError", "cluster_stats", "sanity_check", "split_leader_follower",
+    "SanityCheckError", "SnapshotDelta", "cluster_stats", "diff_snapshots",
+    "sanity_check", "split_leader_follower",
 ]

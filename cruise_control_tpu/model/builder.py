@@ -131,6 +131,12 @@ class ClusterModelBuilder:
         return self
 
     # ---- assembly ----
+    def broker_arrays(self, broker_ids: list, ridx: dict):
+        """Public alias of :meth:`_broker_arrays` — the resident session's
+        broker-axis refresh recomputes these dense arrays without running a
+        full build (analyzer/session.py)."""
+        return self._broker_arrays(broker_ids, ridx)
+
     def _broker_arrays(self, broker_ids: list, ridx: dict):
         """Dense broker topology arrays shared by both assembly paths."""
         B = len(broker_ids)

@@ -364,26 +364,24 @@ class LoadMonitor:
 
     @staticmethod
     def _ingest_bulk(agg, sample_list, entity_of) -> int:
-        """Group samples into (ts, metric-name-set) batches and bulk-add
-        them; mixed batches fall back to the per-sample path. A normal
-        sampling round is ONE batch (the sampler stamps every sample with
-        the same collection time), so ingestion is a single vectorized
-        scatter instead of N python calls."""
+        """Group samples by (timestamp, metric-name-tuple) and bulk-add each
+        group. A normal sampling round is ONE group (the sampler stamps every
+        sample with the same collection time), so ingestion is a single
+        vectorized scatter; heterogeneous rounds (mixed samplers / stores
+        replaying different metric sets) become one scatter PER group instead
+        of N python add_sample calls — at 500k partitions the per-sample
+        fallback alone cost ~10 s/round."""
         if not sample_list:
             return 0
-        n = 0
-        names0 = tuple(sample_list[0].values)
-        ts0 = sample_list[0].ts_ms
-        uniform = all(s.ts_ms == ts0 and tuple(s.values) == names0
-                      for s in sample_list)
-        if uniform:
-            values = np.array([[s.values[m] for m in names0]
-                               for s in sample_list], dtype=float)
-            return agg.add_samples([entity_of(s) for s in sample_list],
-                                   ts0, values, list(names0))
+        groups: dict[tuple, list] = {}
         for s in sample_list:
-            if agg.add_sample(entity_of(s), s.ts_ms, s.values):
-                n += 1
+            groups.setdefault((s.ts_ms, tuple(s.values)), []).append(s)
+        n = 0
+        for (ts, names), group in groups.items():
+            values = np.array([[s.values[m] for m in names] for s in group],
+                              dtype=float)
+            n += agg.add_samples([entity_of(s) for s in group], ts, values,
+                                 list(names))
         return n
 
     # ---------------------------------------------------------- completeness
@@ -439,6 +437,138 @@ class LoadMonitor:
                 rows[i] = j
         return rows
 
+    def populate_brokers(self, builder, brokers=None, logdir_state=None,
+                         allow_capacity_estimation: bool = True):
+        """Register every broker (capacities, logdirs, dead disks) on
+        ``builder`` exactly as the model build does; returns
+        ``(lds_by_broker, dead_by_broker)``. Shared by ``cluster_model`` and
+        the resident session's broker-axis refresh so the two can never
+        diverge on capacity/logdir semantics."""
+        if brokers is None:
+            brokers = self._backend.brokers()
+        if logdir_state is None:
+            logdir_state = self._backend.describe_logdirs()
+        lds_by_broker: dict = {}     # broker id -> ordered logdir names
+        dead_by_broker: dict = {}    # broker id -> set of dead names
+        for b, node in brokers.items():
+            cap_info = self._capacity.capacity_for(b)
+            if cap_info.estimated and not allow_capacity_estimation:
+                raise RuntimeError(
+                    f"capacity estimation not allowed but required for broker {b}")
+            logdirs = list(node.logdirs) or ["/logdir0"]
+            if cap_info.disk_capacity_by_logdir:
+                # match resolver capacities to broker logdirs BY NAME;
+                # unknown dirs fall back to an even share of total DISK
+                per = cap_info.capacity[Resource.DISK] / len(logdirs)
+                disk_caps = [cap_info.disk_capacity_by_logdir.get(ld, per)
+                             for ld in logdirs]
+            elif cap_info.estimated:
+                # estimation fallback: the backend's reported logdir sizes
+                # stand in for unknown real capacities
+                per = cap_info.capacity[Resource.DISK] / len(logdirs)
+                disk_caps = [node.logdirs.get(ld, per) for ld in logdirs]
+            else:
+                # a configured resolver entry is authoritative
+                # (BrokerCapacityConfigFileResolver precedence)
+                per = cap_info.capacity[Resource.DISK] / len(logdirs)
+                disk_caps = [per] * len(logdirs)
+            dead = set(node.dead_logdirs)
+            dead |= {ld for ld, ok in logdir_state.get(b, {}).items() if not ok}
+            lds_by_broker[b] = logdirs
+            dead_by_broker[b] = dead
+            builder.add_broker(
+                b, rack=node.rack, alive=node.alive,
+                capacity={Resource.CPU: cap_info.capacity[Resource.CPU],
+                          Resource.DISK: sum(disk_caps),
+                          Resource.NW_IN: cap_info.capacity[Resource.NW_IN],
+                          Resource.NW_OUT: cap_info.capacity[Resource.NW_OUT]},
+                logdirs=logdirs, disk_capacity=disk_caps, dead_disks=dead)
+        return lds_by_broker, dead_by_broker
+
+    def _reduced_entity_loads(self, agg):
+        """Window-reduce the aggregator: AVG for CPU/NW, LATEST for DISK over
+        VALID windows only (RawMetricValues.isValid :166 role), with the
+        optional trained linear-regression CPU substitution. Returns
+        per-entity ``(cpu_e, lin_e, lout_e, disk_e)``."""
+        use_lr = (self._config is not None
+                  and self._config.get_boolean("use.linear.regression.model")
+                  and self.lr_cpu_model.trained)
+        mdef = PARTITION_METRIC_DEF
+        id_cpu = mdef.info("CPU_USAGE").metric_id
+        id_din = mdef.info("DISK_USAGE").metric_id
+        id_lin = mdef.info("LEADER_BYTES_IN").metric_id
+        id_lout = mdef.info("LEADER_BYTES_OUT").metric_id
+        from cruise_control_tpu.monitor.aggregator.sample_aggregator import (
+            Extrapolation,
+        )
+        # zero-filled NO_VALID_EXTRAPOLATION windows would dilute the
+        # mean (and LATEST could read a hole): reduce over valid windows only
+        E = len(agg.entities)
+        W = agg.values.shape[1] if E else 0
+        wmask = agg.extrapolations != Extrapolation.NO_VALID_EXTRAPOLATION
+        any_valid = wmask.any(axis=1) if E else np.zeros(0, bool)
+        nvalid = np.maximum(wmask.sum(axis=1), 1) if E else np.zeros(0)
+        if not E:
+            z = np.zeros(0)
+            return z, z, z, z
+        mean = ((agg.values * wmask[:, :, None]).sum(axis=1)
+                / nvalid[:, None])
+        last = W - 1 - np.argmax(wmask[:, ::-1], axis=1)
+        disk_e = agg.values[np.arange(E), last, id_din]
+        cpu_e = np.where(any_valid, mean[:, id_cpu], 0.0)
+        lin_e = np.where(any_valid, mean[:, id_lin], 0.0)
+        lout_e = np.where(any_valid, mean[:, id_lout], 0.0)
+        disk_e = np.where(any_valid, disk_e, 0.0)
+        if use_lr:
+            cpu_e = np.where(
+                any_valid,
+                np.maximum(0.0, self.lr_cpu_model.predict(lin_e, lout_e)),
+                0.0)
+        return cpu_e, lin_e, lout_e, disk_e
+
+    def partition_load_columns(self, tps: list, generation: int,
+                               agg=None, rows: np.ndarray | None = None):
+        """Per-partition load columns aligned to ``tps``:
+        ``(cpu_p, lin_p, lout_p, disk_p, fcpu_p)``. This is the
+        metric-refresh half of ``cluster_model`` on its own — the resident
+        session re-reads it every round without touching topology."""
+        if agg is None:
+            agg = self._partition_agg.aggregate()
+        cpu_e, lin_e, lout_e, disk_e = self._reduced_entity_loads(agg)
+        E = len(agg.entities)
+        P = len(tps)
+        if rows is None:
+            rows = self._entity_rows(agg, tps, generation)
+        has = rows >= 0
+        rr = np.clip(rows, 0, None)
+
+        def per_part(x):
+            return np.where(has, x[rr], 0.0) if E else np.zeros(P)
+
+        cpu_p, lin_p, lout_p, disk_p = (per_part(x) for x in
+                                        (cpu_e, lin_e, lout_e, disk_e))
+        fcpu_p = estimate_follower_cpu_util(cpu_p, lin_p, lout_p,
+                                            self._cpu_params)
+        return cpu_p, lin_p, lout_p, disk_p, fcpu_p
+
+    @staticmethod
+    def replica_load_rows(cols, rep_part: np.ndarray):
+        """Gather partition load columns to the replica axis: the
+        ``(leader_load, follower_load)`` f32[Rv, M] rows the model build and
+        the session's metric-window refresh both upload."""
+        cpu_p, lin_p, lout_p, disk_p, fcpu_p = cols
+        Rv = rep_part.shape[0]
+        M = len(Resource)
+        leader_load = np.zeros((Rv, M), np.float32)
+        leader_load[:, Resource.CPU] = cpu_p[rep_part]
+        leader_load[:, Resource.NW_IN] = lin_p[rep_part]
+        leader_load[:, Resource.NW_OUT] = lout_p[rep_part]
+        leader_load[:, Resource.DISK] = disk_p[rep_part]
+        follower_load = leader_load.copy()
+        follower_load[:, Resource.CPU] = fcpu_p[rep_part]
+        follower_load[:, Resource.NW_OUT] = 0.0
+        return leader_load, follower_load
+
     def cluster_model(self, requirements: ModelCompletenessRequirements | None = None,
                       allow_capacity_estimation: bool = True,
                       use_snapshot: bool | None = None):
@@ -474,91 +604,17 @@ class LoadMonitor:
                         f"monitored partition ratio {valid_frac:.3f} < required "
                         f"{req.min_monitored_partitions_percentage:.3f}")
             brokers = self._backend.brokers()
-            logdir_state = self._backend.describe_logdirs()
-
             builder = ClusterModelBuilder()
-            lds_by_broker: dict = {}     # broker id -> ordered logdir names
-            dead_by_broker: dict = {}    # broker id -> set of dead names
-            for b, node in brokers.items():
-                cap_info = self._capacity.capacity_for(b)
-                if cap_info.estimated and not allow_capacity_estimation:
-                    raise RuntimeError(
-                        f"capacity estimation not allowed but required for broker {b}")
-                logdirs = list(node.logdirs) or ["/logdir0"]
-                if cap_info.disk_capacity_by_logdir:
-                    # match resolver capacities to broker logdirs BY NAME;
-                    # unknown dirs fall back to an even share of total DISK
-                    per = cap_info.capacity[Resource.DISK] / len(logdirs)
-                    disk_caps = [cap_info.disk_capacity_by_logdir.get(ld, per)
-                                 for ld in logdirs]
-                elif cap_info.estimated:
-                    # estimation fallback: the backend's reported logdir sizes
-                    # stand in for unknown real capacities
-                    per = cap_info.capacity[Resource.DISK] / len(logdirs)
-                    disk_caps = [node.logdirs.get(ld, per) for ld in logdirs]
-                else:
-                    # a configured resolver entry is authoritative
-                    # (BrokerCapacityConfigFileResolver precedence)
-                    per = cap_info.capacity[Resource.DISK] / len(logdirs)
-                    disk_caps = [per] * len(logdirs)
-                dead = set(node.dead_logdirs)
-                dead |= {ld for ld, ok in logdir_state.get(b, {}).items() if not ok}
-                lds_by_broker[b] = logdirs
-                dead_by_broker[b] = dead
-                builder.add_broker(
-                    b, rack=node.rack, alive=node.alive,
-                    capacity={Resource.CPU: cap_info.capacity[Resource.CPU],
-                              Resource.DISK: sum(disk_caps),
-                              Resource.NW_IN: cap_info.capacity[Resource.NW_IN],
-                              Resource.NW_OUT: cap_info.capacity[Resource.NW_OUT]},
-                    logdirs=logdirs, disk_capacity=disk_caps, dead_disks=dead)
+            lds_by_broker, dead_by_broker = self.populate_brokers(
+                builder, brokers,
+                allow_capacity_estimation=allow_capacity_estimation)
 
             # window-reduce AVG for CPU/NW, LATEST for DISK — vectorized over
-            # every entity at once: the former per-partition Python loop was
-            # minutes of host time at 500k partitions, this is one masked
-            # mean over [E, W, M] (LoadMonitor.java:539-591 +
-            # cluster-model-creation-timer LoadMonitor.java:173 role).
-            # Experimental LR CPU model (use.linear.regression.model +
-            # LinearRegressionModelParameters role): when trained + enabled,
-            # leader CPU comes from the fitted cpu ~ a*bytes_in + b*bytes_out
-            use_lr = (self._config is not None
-                      and self._config.get_boolean("use.linear.regression.model")
-                      and self.lr_cpu_model.trained)
-            mdef = PARTITION_METRIC_DEF
-            id_cpu = mdef.info("CPU_USAGE").metric_id
-            id_din = mdef.info("DISK_USAGE").metric_id
-            id_lin = mdef.info("LEADER_BYTES_IN").metric_id
-            id_lout = mdef.info("LEADER_BYTES_OUT").metric_id
-            from cruise_control_tpu.monitor.aggregator.sample_aggregator import (
-                Extrapolation,
-            )
-            # zero-filled NO_VALID_EXTRAPOLATION windows would dilute the
-            # mean (and LATEST could read a hole): reduce over valid windows
-            # only (RawMetricValues.isValid :166 role)
-            E = len(agg.entities)
-            W = agg.values.shape[1] if E else 0
-            wmask = agg.extrapolations != Extrapolation.NO_VALID_EXTRAPOLATION
-            any_valid = wmask.any(axis=1) if E else np.zeros(0, bool)
-            nvalid = np.maximum(wmask.sum(axis=1), 1) if E else np.zeros(0)
-            if E:
-                mean = ((agg.values * wmask[:, :, None]).sum(axis=1)
-                        / nvalid[:, None])
-                last = W - 1 - np.argmax(wmask[:, ::-1], axis=1)
-                disk_e = agg.values[np.arange(E), last, id_din]
-                cpu_e = np.where(any_valid, mean[:, id_cpu], 0.0)
-                lin_e = np.where(any_valid, mean[:, id_lin], 0.0)
-                lout_e = np.where(any_valid, mean[:, id_lout], 0.0)
-                disk_e = np.where(any_valid, disk_e, 0.0)
-                if use_lr:
-                    cpu_e = np.where(
-                        any_valid,
-                        np.maximum(0.0, self.lr_cpu_model.predict(lin_e, lout_e)),
-                        0.0)
-            else:
-                cpu_e = lin_e = lout_e = disk_e = np.zeros(0)
-
-            # map entity rows -> the (sorted) partition list, then flatten the
-            # per-partition replica lists into dense arrays
+            # every entity at once: one masked mean over [E, W, M]
+            # (LoadMonitor.java:539-591 + cluster-model-creation-timer role),
+            # then map entity rows -> the (sorted) partition list
+            # (_reduced_entity_loads / partition_load_columns — shared with
+            # the resident session's per-round metric refresh)
             if use_snap:
                 tps = snap.partition_keys
                 infos = None
@@ -571,16 +627,7 @@ class LoadMonitor:
                 row_of = {e: i for i, e in enumerate(agg.entities)}
                 rows = np.fromiter((row_of.get(tp, -1) for tp in tps),
                                    dtype=np.int64, count=P)
-            has = rows >= 0
-            rr = np.clip(rows, 0, None)
-
-            def per_part(x):
-                return np.where(has, x[rr], 0.0) if E else np.zeros(P)
-
-            cpu_p, lin_p, lout_p, disk_p = (per_part(x) for x in
-                                            (cpu_e, lin_e, lout_e, disk_e))
-            fcpu_p = estimate_follower_cpu_util(cpu_p, lin_p, lout_p,
-                                                self._cpu_params)
+            cols = self.partition_load_columns(tps, -1, agg=agg, rows=rows)
 
             broker_ids = sorted(brokers)
             sorted_bids = np.asarray(broker_ids, dtype=np.int64)
@@ -634,16 +681,7 @@ class LoadMonitor:
                     f"{sorted(set(rep_bid[bad].tolist()))[:5]}")
             rep_offline = (~alive_b[rep_bidx]) | dead_arr[rep_bidx, rep_disk]
 
-            Rv = rep_part.shape[0]
-            M = len(Resource)
-            leader_load = np.zeros((Rv, M), np.float32)
-            leader_load[:, Resource.CPU] = cpu_p[rep_part]
-            leader_load[:, Resource.NW_IN] = lin_p[rep_part]
-            leader_load[:, Resource.NW_OUT] = lout_p[rep_part]
-            leader_load[:, Resource.DISK] = disk_p[rep_part]
-            follower_load = leader_load.copy()
-            follower_load[:, Resource.CPU] = fcpu_p[rep_part]
-            follower_load[:, Resource.NW_OUT] = 0.0
+            leader_load, follower_load = self.replica_load_rows(cols, rep_part)
 
             if use_snap:
                 topics = list(snap.topics)

@@ -1,0 +1,309 @@
+"""ResidentClusterSession: delta ingest vs from-scratch rebuild.
+
+The tentpole invariants of the device-resident service path:
+1. A session that ingested a scripted delta stream (leadership flips,
+   replica churn, broker death, disk failure, appended topic, metric-window
+   refreshes) produces an env/state BIT-IDENTICAL to a from-scratch rebuild
+   of the final cluster — including pad slots and shape buckets.
+2. A second session round adds ZERO new jit traces (the steady-state
+   round's zero-XLA-compile contract bench.py records per e2e rung).
+3. GoalOptimizer.optimizations(session=...) returns the same result as the
+   (ct, meta) model path, and the resident state survives the round (the
+   fused chain donates its state argument).
+4. Every delta the session cannot express in place falls back to a rebuild
+   (new epoch): partition deletion, broker-set change, churn budget.
+5. CruiseControl wires the precompute/proposals path through the session.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.env import make_env, padded_partition_table
+from cruise_control_tpu.analyzer.session import ResidentClusterSession
+from cruise_control_tpu.analyzer.state import init_state
+from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+from cruise_control_tpu.model.cluster_tensor import pad_cluster
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.sampling.samplers import SimulatedMetricSampler
+
+
+def _backend(seed=0, num_brokers=10, num_partitions=60, rf=2, jbod=True):
+    rng = np.random.default_rng(seed)
+    be = SimulatedClusterBackend()
+    for b in range(num_brokers):
+        logdirs = ({f"/d{j}": 50_000.0 for j in range(1 + b % 3)}
+                   if jbod else None)
+        be.add_broker(b, f"r{b % 3}", logdirs=logdirs)
+    for p in range(num_partitions):
+        reps = [int(x) for x in rng.choice(num_brokers, size=rf,
+                                           replace=False)]
+        be.create_partition(f"t{p % 6}", p, reps,
+                            size_mb=float(rng.uniform(10, 500)),
+                            bytes_in_rate=float(rng.uniform(1, 50)),
+                            bytes_out_rate=float(rng.uniform(1, 100)),
+                            cpu_util=float(rng.uniform(0.1, 5)))
+    return be
+
+
+def _monitored(be, rounds=6, start_round=0):
+    lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    for i in range(start_round, start_round + rounds):
+        lm.sample_once(now_ms=i * 300_000.0)
+    return lm
+
+
+def _reference(lm):
+    """From-scratch build of the CURRENT cluster, padded exactly like the
+    session's rebuild."""
+    ct, meta = lm.cluster_model()
+    ct, meta = pad_cluster(ct, meta)
+    table = padded_partition_table(ct)
+    env = make_env(ct, meta, partition_table=table)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    return env, st, meta, table
+
+
+def _assert_bit_exact(sess, lm):
+    env, st, meta, table = _reference(lm)
+    for f in dataclasses.fields(env):
+        a = np.asarray(getattr(sess.env, f.name))
+        b = np.asarray(getattr(env, f.name))
+        assert a.dtype == b.dtype, f"env.{f.name} dtype"
+        assert np.array_equal(a, b), f"env.{f.name}"
+    for f in dataclasses.fields(st):
+        a = np.asarray(getattr(sess.state, f.name))
+        b = np.asarray(getattr(st, f.name))
+        assert a.dtype == b.dtype, f"state.{f.name} dtype"
+        assert np.array_equal(a, b), f"state.{f.name}"
+    assert np.array_equal(sess.part_table, table)
+    assert sess.meta.topic_names == meta.topic_names
+    assert sess.meta.partition_ids == meta.partition_ids
+    assert sess.meta.broker_ids == meta.broker_ids
+    assert sess.meta.num_valid_replicas == meta.num_valid_replicas
+
+
+def _scripted_delta_stream(be, lm):
+    """Leadership flip + same-RF replica churn + broker death + disk failure
+    + appended (sorts-last) topic + fresh metric windows."""
+    info = be.partitions()[("t1", 1)]
+    be.elect_leaders({("t1", 1): info.replicas[-1]})
+    be.alter_partition_reassignments({("t0", 0): [7, 8]})
+    be.advance(10 * 60_000.0)                       # complete the copy
+    be.kill_broker(9)
+    be.fail_disk(1, "/d1")
+    be.create_partition("zz-late", 0, [0, 2], size_mb=100.0,
+                        bytes_in_rate=10.0, bytes_out_rate=20.0, cpu_util=1.0)
+    be.create_partition("zz-late", 1, [3, 4], size_mb=50.0,
+                        bytes_in_rate=5.0, bytes_out_rate=10.0, cpu_util=0.5)
+    for i in range(6, 9):
+        lm.sample_once(now_ms=i * 300_000.0)
+
+
+def test_session_delta_bit_exact_vs_rebuild():
+    """The tentpole certificate: after a scripted delta stream the resident
+    env/state is bit-identical to a from-scratch rebuild of the final
+    cluster — every leaf, including pad slots."""
+    be = _backend()
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    assert sess.sync()["mode"] == "rebuild"
+    _assert_bit_exact(sess, lm)
+
+    _scripted_delta_stream(be, lm)
+    info = sess.sync()
+    assert info["mode"] == "delta", info
+    assert info["churn"] > 0
+    _assert_bit_exact(sess, lm)
+
+    # metric-only follow-up round (no metadata change) stays delta-mode
+    lm.sample_once(now_ms=9 * 300_000.0)
+    assert sess.sync()["mode"] == "delta"
+    _assert_bit_exact(sess, lm)
+    assert sess.epoch == 1          # one rebuild, everything else deltas
+
+
+def test_session_second_round_zero_new_traces():
+    """Steady-state contract: once a session epoch exists, further sync
+    rounds — including their first real churn — trigger ZERO new jit
+    traces (the delta programs are pre-warmed at rebuild)."""
+    import jax
+
+    be = _backend(seed=3)
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    sess.sync()
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    prev = bool(jax.config.jax_log_compiles)
+    jax.config.update("jax_log_compiles", True)
+    logging.getLogger("jax").addHandler(handler)
+    try:
+        _scripted_delta_stream(be, lm)
+        assert sess.sync()["mode"] == "delta"
+        lm.sample_once(now_ms=9 * 300_000.0)
+        assert sess.sync()["mode"] == "delta"
+    finally:
+        logging.getLogger("jax").removeHandler(handler)
+        jax.config.update("jax_log_compiles", prev)
+    compiles = [r.getMessage() for r in records
+                if "Compiling" in r.getMessage()]
+    assert not compiles, compiles[:5]
+
+
+def test_session_optimizations_matches_model_path():
+    """optimizations(session=...) == optimizations(ct, meta) on the same
+    cluster, and the resident state survives the (donating) fused chain."""
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+
+    be = _backend(seed=1, jbod=False)
+    lm = _monitored(be)
+    goals = ["ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+    opt = GoalOptimizer()
+    ct, meta = lm.cluster_model()
+    res_a = opt.optimizations(ct, meta, goal_names=goals,
+                              raise_on_failure=False,
+                              skip_hard_goal_check=True)
+
+    sess = ResidentClusterSession(lm)
+    sess.sync()
+    res_b = opt.optimizations(None, session=sess, goal_names=goals,
+                              raise_on_failure=False,
+                              skip_hard_goal_check=True)
+    assert res_a.violated_goals_before == res_b.violated_goals_before
+    assert res_a.violated_goals_after == res_b.violated_goals_after
+    assert res_a.num_replica_movements == res_b.num_replica_movements
+    assert res_a.num_leadership_movements == res_b.num_leadership_movements
+    assert len(res_a.proposals) == len(res_b.proposals)
+
+    # the optimizer ran on a copy: the resident state still reflects the
+    # OBSERVED cluster and the next round is a cheap delta
+    assert sess.sync()["mode"] == "delta"
+    res_c = opt.optimizations(None, session=sess, goal_names=goals,
+                              raise_on_failure=False,
+                              skip_hard_goal_check=True)
+    assert res_c.num_replica_movements == res_b.num_replica_movements
+
+
+def test_session_fallback_triggers_rebuild():
+    """Deltas the session cannot express in place start a new epoch."""
+    from cruise_control_tpu.config import cruise_control_config
+
+    be = _backend(seed=2)
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    sess.sync()
+    epoch0 = sess.epoch
+
+    # broker-set change -> rebuild
+    be.add_broker(99, "r0")
+    lm.sample_once(now_ms=6 * 300_000.0)
+    info = sess.sync()
+    assert info["mode"] == "rebuild" and sess.epoch == epoch0 + 1
+    assert "broker set" in info["reason"]
+
+    # RF change on an existing partition -> rebuild
+    be.alter_partition_reassignments({("t0", 0): [0, 1, 2]})
+    be.advance(10 * 60_000.0)
+    lm.sample_once(now_ms=7 * 300_000.0)
+    info = sess.sync()
+    assert info["mode"] == "rebuild" and "replication factor" in info["reason"]
+
+    # churn budget: a zero-fraction budget rebuilds on ANY churn
+    tight = ResidentClusterSession(lm, config=cruise_control_config(
+        {"analyzer.session.max.delta.fraction": 0.0}))
+    tight.sync()
+    be.elect_leaders({("t1", 1): be.partitions()[("t1", 1)].replicas[-1]})
+    lm.sample_once(now_ms=8 * 300_000.0)
+    info = tight.sync()
+    assert info["mode"] == "rebuild" and "churn budget" in info["reason"]
+
+    # metric-only rounds still ride the delta path after all that
+    lm.sample_once(now_ms=9 * 300_000.0)
+    assert sess.sync()["mode"] == "delta"
+
+
+def test_app_proposals_and_rebalance_ride_the_session():
+    """CruiseControl wires cached_proposals (the precompute loop's entry)
+    and plain rebalances through the resident session; custom exclusions
+    bypass it."""
+    from cruise_control_tpu.app import CruiseControl
+    from cruise_control_tpu.config import cruise_control_config
+
+    be = _backend(seed=4, jbod=False)
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1,
+        "goals": "ReplicaCapacityGoal,ReplicaDistributionGoal",
+        "hard.goals": "ReplicaCapacityGoal",
+        "anomaly.detection.goals": "ReplicaDistributionGoal"}))
+    cc.start_up()
+    assert cc.resident_session is not None
+    for i in range(6):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+
+    res1 = cc.cached_proposals(force_refresh=True)
+    assert cc.resident_session.epoch == 1
+    assert cc.resident_session.last_sync_info["mode"] == "rebuild"
+    cc.load_monitor.sample_once(now_ms=6 * 300_000.0)
+    res2 = cc.cached_proposals(force_refresh=True)
+    assert cc.resident_session.last_sync_info["mode"] == "delta"
+    assert cc.resident_session.delta_rounds >= 1
+    assert len(res2.proposals) == len(res1.proposals)
+
+    # a dry-run rebalance rides the session too (no model rebuild)...
+    rebuilds = cc.resident_session.rebuild_rounds
+    out = cc.rebalance(dry_run=True)
+    assert out["operation"] == "REBALANCE"
+    assert cc.resident_session.rebuild_rounds == rebuilds
+    # ...while a request-specific exclusion regex bypasses it
+    out = cc.rebalance(dry_run=True, excluded_topics="t0")
+    assert out["operation"] == "REBALANCE"
+
+
+def test_ingest_bulk_groups_heterogeneous_batches():
+    """Monitor ingestion groups mixed (ts, metric-name-set) sample lists and
+    bulk-scatters each group — same windows as per-sample adds."""
+    from cruise_control_tpu.monitor.metricdef import PARTITION_METRIC_DEF
+    from cruise_control_tpu.monitor.aggregator.sample_aggregator import (
+        MetricSampleAggregator,
+    )
+    from cruise_control_tpu.monitor.sampling.samplers import PartitionSample
+
+    names_a = {"CPU_USAGE": 1.0, "DISK_USAGE": 2.0,
+               "LEADER_BYTES_IN": 3.0, "LEADER_BYTES_OUT": 4.0}
+    samples = []
+    rng = np.random.default_rng(5)
+    for p in range(40):
+        vals = ({k: float(rng.uniform(1, 9)) for k in names_a}
+                if p % 3 else {"CPU_USAGE": float(rng.uniform(1, 9)),
+                               "DISK_USAGE": float(rng.uniform(1, 9))})
+        ts = 300_000.0 if p % 5 else 600_000.0       # two timestamps too
+        samples.append(PartitionSample(topic="t", partition=p, ts_ms=ts,
+                                       values=vals))
+
+    def agg():
+        return MetricSampleAggregator(5, 300_000, 1, 5, PARTITION_METRIC_DEF)
+
+    a = agg()
+    n_bulk = LoadMonitor._ingest_bulk(a, samples, lambda s: (s.topic, s.partition))
+    b = agg()
+    n_one = sum(b.add_sample((s.topic, s.partition), s.ts_ms, s.values)
+                for s in samples)
+    assert n_bulk == n_one == len(samples)
+    ra, rb = a.aggregate(), b.aggregate()
+    # grouping may change entity FIRST-SEEN order (rows are always keyed by
+    # entity downstream) — compare per entity, not positionally
+    assert sorted(ra.entities) == sorted(rb.entities)
+    for e in ra.entities:
+        np.testing.assert_array_equal(ra.values_for(e), rb.values_for(e))
+        ia, ib = ra.entities.index(e), rb.entities.index(e)
+        np.testing.assert_array_equal(ra.extrapolations[ia],
+                                      rb.extrapolations[ib])
+        assert ra.entity_valid[ia] == rb.entity_valid[ib]

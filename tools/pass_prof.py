@@ -32,8 +32,8 @@ if params is None:
         num_leader_candidates=min(1024, max(32, ct.num_brokers // 8)),
         num_swap_candidates=max(32, ct.num_brokers // 32),
         num_dst_choices=min(128, max(16, ct.num_brokers // 100)),
-        tail_pass_budget=min(1024, 64 * _budget_scale(ct) ** 2),
-        stall_retries=min(32, 8 * _budget_scale(ct)))
+        tail_pass_budget=min(1024, 64 * _budget_scale(ct.num_replicas) ** 2),
+        stall_retries=min(32, 8 * _budget_scale(ct.num_replicas)))
 print("R", ct.num_replicas, "B", ct.num_brokers, "K", params.num_candidates,
       "T", params.num_dst_choices, "tail", params.tail_pass_budget, flush=True)
 env = make_env(ct, meta, partition_table=padded_partition_table(ct))
