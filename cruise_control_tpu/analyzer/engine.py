@@ -43,8 +43,8 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer.env import ClusterEnv
 from cruise_control_tpu.analyzer.goals.base import (
-    WAVE_DIMS, GoalKernel, legit_disk_move_mask, legit_leadership_mask,
-    legit_move_mask, legit_swap_mask,
+    WAVE_DIMS, WAVE_ZERO_EXEMPT_DIMS, GoalKernel, legit_disk_move_mask,
+    legit_leadership_mask, legit_move_mask, legit_swap_mask,
 )
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.analyzer.state import (
@@ -62,16 +62,21 @@ import os as _os  # noqa: E402
 _DEBUG_DISABLE = set((_os.environ.get("CC_DEBUG_DISABLE") or "").split(","))
 
 
-def _stall_explore(key: Array, stall: Array, salt: int = 0) -> Array:
+def _stall_explore(key: Array, stall: Array, salt: int = 0,
+                   idx: Array | None = None) -> Array:
     """Re-key candidates for a STALLED pass: the ranked order just yielded
     zero actions, so rank the eligible set by a (replica, stall)-salted hash
     instead — each retry pass surfaces a fresh pseudo-random top-K subset.
     Ineligible rows stay -inf; offline-healing candidates (key >= 1e12) keep
     priority via a +2.0 bump — adding the full 1e12 would absorb the [0,1)
     hash below the f32 ulp (65536 at 1e12) and freeze their retry order.
-    ``salt`` decorrelates pools salted in the same pass (swap out vs in)."""
-    R = key.shape[0]
-    h = (jnp.arange(R, dtype=jnp.uint32) * jnp.uint32(2246822519)
+    ``salt`` decorrelates pools salted in the same pass (swap out vs in).
+    ``idx`` supplies the ORIGINAL replica ids when ``key`` is a compacted
+    eligible prefix (the hash must depend on the replica, not its compacted
+    position, for compacted and full sweeps to rank identically)."""
+    if idx is None:
+        idx = jnp.arange(key.shape[0], dtype=jnp.uint32)
+    h = (idx.astype(jnp.uint32) * jnp.uint32(2246822519)
          + (stall.astype(jnp.uint32) + jnp.uint32(salt))
          * jnp.uint32(3266489917))
     h = (h ^ (h >> 15)) * jnp.uint32(2654435761)
@@ -92,6 +97,52 @@ def _top_candidates(key: Array, k: int, exact: bool = False):
     if exact or k >= key.shape[0]:
         return jax.lax.top_k(key, k)
     return jax.lax.approx_max_k(key, k, recall_target=0.95)
+
+
+def _select_candidates(key: Array, k: int, stall: Array, exact: bool,
+                       params: EngineParams, salt: int = 0):
+    """(kv f32[k], cand i32[k]) — stall-salted top-k candidate selection,
+    shared by the move / leadership / swap branches.
+
+    With ``params.compact_keying`` the selection runs over the goal's
+    ELIGIBLE PREFIX: rows with key > -inf are compacted to the front
+    (_compact_eligible — cumsum + one scatter, no sort) and the salt + top-k
+    sweep only the static pool, so per-pass selection cost tracks the goal's
+    REMAINING work instead of R. When the eligible set overflows the pool
+    the full-R sweep runs instead (traced branch). Selection equivalence:
+    gathered key values are identical, top_k ties break by compacted
+    position == replica-id order, the salt hashes the ORIGINAL replica id,
+    and overflowed/padded slots surface with kv = -inf, which every
+    downstream stage masks out — certified bit-identical against the full
+    sweep in tests/test_pass_pipeline.py (on TPU the full path's
+    approx_max_k has 0.95 recall, so compaction there is an exactness
+    UPGRADE rather than bit-identical)."""
+    R = key.shape[0]
+    k = min(k, R)
+    pool = min(R, max(params.compact_pool, 2 * k))
+    if not params.compact_keying or pool >= R:
+        salted = _stall_explore(key, stall, salt=salt)
+        return _top_candidates(salted, k, exact=exact)
+    eligible = key > NEG_INF
+    n_elig = jnp.sum(eligible).astype(jnp.int32)   # cheap overflow probe
+
+    def pooled(_):
+        # compaction (cumsum + one scatter) lives INSIDE the taken branch:
+        # overflowing passes — the early, work-rich regime — pay only the
+        # count reduction above before falling back to the full sweep
+        order, _n = _compact_eligible(eligible, pool)
+        idx = jnp.minimum(order, R - 1)
+        kcol = jnp.where(order < R, key[idx], NEG_INF)
+        salted = _stall_explore(kcol, stall, salt=salt, idx=idx)
+        kv, pos = jax.lax.top_k(salted, k)
+        return kv, idx[pos]
+
+    def full(_):
+        salted = _stall_explore(key, stall, salt=salt)
+        kv, cand = _top_candidates(salted, k, exact=exact)
+        return kv, cand
+
+    return jax.lax.cond(n_elig <= pool, pooled, full, None)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -170,6 +221,45 @@ class EngineParams:
     # window-bounded, and the windows were measured holding 10k+ positive
     # pairs after the move/lead fixpoint at the 1M rung
     finisher_swap_passes: int = 64
+    # ---- pass-pipeline knobs (PR 4) ----
+    # MULTI-WAVE PASSES: admission waves per budgeted move pass. One pass
+    # ranks K*max_pass_waves candidates (rank-banded like _finisher_wave);
+    # wave w re-scores band w's K rows against the LIVE state and runs the
+    # full spread+admission+apply stage, stopping early once a wave admits
+    # nothing. The O(R) re-keying + candidate selection is paid once per
+    # pass instead of once per wave's worth of actions. ``pass_waves`` is a
+    # TRACED budget leaf (toggling it reuses the compiled program);
+    # ``max_pass_waves`` is the static selection-width / loop bound.
+    # pass_waves=1 is bit-identical to the single-wave pipeline (band 0 of
+    # the widened selection IS the legacy top-K; certified in
+    # tests/test_pass_pipeline.py).
+    pass_waves: int = 1
+    max_pass_waves: int = 4
+    # ELIGIBLE-SET-COMPACTED KEYING: run the stall-salt + top-k candidate
+    # selection over the goal's compacted eligible prefix (key > -inf rows,
+    # _compact_eligible) whenever it fits the static pool — selection cost
+    # then tracks the goal's REMAINING work instead of R. Falls back to the
+    # full-R sweep when the eligible set overflows the pool. Bit-identical
+    # to the full sweep on the CPU/test platform (approx_max_k lowers to
+    # exact top_k there; certified in tests/test_pass_pipeline.py); on TPU
+    # it swaps the full path's 0.95-recall approx selection for an exact
+    # one over the prefix. DEFAULT OFF: measured on the 1-core CPU bench
+    # host, XLA:CPU's generic scatter makes the compaction cost ~5 ms at
+    # 100k rows while the full-R selection it replaces costs <1 ms — the
+    # knob is for accelerator deployments, where top-k over R dominates
+    # and scatters are O(pool) per index (see docs/PERF.md round 6).
+    compact_keying: bool = False
+    compact_pool: int = 8192          # eligible-prefix pool rows (static)
+    # PASS-INVARIANT CHAIN CACHING: fold every prev-goal accept_move veto
+    # with an interval form (GoalKernel.accept_move_rooms) into ONE combined
+    # per-broker room table per pass — one vectorized comparison against the
+    # wave's delta rows replaces up to ~12 per-goal [K, B] masks per branch
+    # (and per exhaustive-scan chunk). Mathematically exact; bitwise it can
+    # differ from the per-goal masks by one f32 ulp at a band edge (the
+    # rooms subtract per broker once where the masks add per (k, b) pair) —
+    # within every goal's own epsilon tolerance, and certified bit-identical
+    # on the seeded parity fixtures. Knob off restores per-goal masks.
+    chain_cache: bool = True
 
 
 # EngineParams is a JAX PYTREE: the pure BUDGET fields (loop caps, gain
@@ -183,14 +273,14 @@ class EngineParams:
 # XLA compiles of budget-variant duplicates).
 _DYN_FIELDS = ("max_iters", "min_gain", "stall_retries", "tail_pass_budget",
                "tail_total_budget", "sat_stall_retries", "sat_tail_passes",
-               "stat_window", "stat_slope_min")
+               "stat_window", "stat_slope_min", "pass_waves")
 _STATIC_FIELDS = tuple(f.name for f in dataclasses.fields(EngineParams)
                        if f.name not in _DYN_FIELDS)
 
 
-# declared field type per name ("int" / "float" annotation strings under
-# `from __future__ import annotations`)
-_FIELD_TYPES = {f.name: (float if f.type == "float" else int)
+# declared field type per name ("int" / "float" / "bool" annotation strings
+# under `from __future__ import annotations`)
+_FIELD_TYPES = {f.name: {"float": float, "bool": bool}.get(f.type, int)
                 for f in dataclasses.fields(EngineParams)}
 
 
@@ -351,107 +441,150 @@ def _group_cumsum(groups: Array, d: Array):
     return cum, rank
 
 
-def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-                         prev_goals: tuple, params: EngineParams,
-                         severity: Array, stall: Array,
-                         cand: Array | None = None, kv: Array | None = None):
-    """Score once, wave-apply the independent winners, re-score leftovers.
-
-    A pass is three stages:
-
-    1. SCORE [K, B]: rank candidate replicas (top-k of the goal's key),
-       mask by legitimacy + prev-goal acceptance, score every destination.
-    2. WAVE (vectorized): each sorted candidate is assigned one of its top-T
-       destinations by position (row j takes its (j mod T)-th best) — goals
-       whose destination ranking is row-independent (capacity headroom, rack
-       utilization) would otherwise point every row at the SAME best broker
-       and starve the wave. Admission, in score order:
-       - partition first-touch (rack/sibling constraints stay single-move
-         exact) and, on the budgeted path, (topic, broker) pair first-use
-         (topic-count constraints likewise);
-       - BUDGETED admission (when every chain goal supports it): a broker
-         may source/absorb MANY wave moves while the per-broker cumulative
-         delta stays inside the combined slack of every goal's band
-         (GoalKernel.wave_budgets) — interval constraints on monotone sums
-         hold for every prefix and any interleaving, so each admitted move
-         is valid in application order. This is what collapses pass counts
-         when one broker must shed dozens of replicas;
-       - otherwise the conservative rule: every broker participates at most
-         once, in one role.
-       Winners all apply in ONE batched scatter update
-       (`apply_moves_batched`); first-use/budget checks are scatter-mins and
-       segment cumsums, not scans. Positive non-winners are simply retried
-       by the next pass's full re-score (sequential leftover re-validation
-       was measured slower AND lower-quality; the finisher catches tails).
-
-    Compared to one-move-per-pass, a pass lands up to K moves for little
-    more than one scoring sweep (reference hot loop it replaces:
-    ResourceDistributionGoal.java:384-862).
-
-    ``cand``/``kv`` override the heuristic-key candidate selection — the
-    finisher passes the top TRUE-gain replicas from an exhaustive scan and
-    reuses this whole wave stage (re-score, destination spread, budgeted
-    admission) unchanged."""
-    if cand is None:
-        key = _stall_explore(goal.replica_key(env, st, severity), stall)
-        kv, cand = _top_candidates(key,
-                                   min(params.num_candidates, env.num_replicas),
-                                   exact=goal.is_hard)
-    mask = legit_move_mask(env, st, cand, goal.options)
+def _combined_move_rooms(prev_goals: tuple, env: ClusterEnv, st: EngineState):
+    """({dim: (src_room[B] | None, dst_room[B] | None)}, custom: tuple) —
+    fold the interval-form accept_move vetoes of the chain into per-dim MIN
+    room tables (the pass-invariant chain cache: [B]-level work once per
+    pass instead of one [K, B] mask per goal per branch). Goals without an
+    interval form come back in ``custom`` for the per-goal mask path; goals
+    that never veto moves (default accept_move) drop out entirely."""
+    rooms: dict = {}
+    custom = []
     for g in prev_goals:
+        rm = g.accept_move_rooms(env, st)
+        if rm is None:
+            if type(g).accept_move is not GoalKernel.accept_move:
+                custom.append(g)
+            continue
+        for dim, (s, d) in rm.items():
+            cs, cd = rooms.get(dim, (None, None))
+            rooms[dim] = (
+                s if cs is None else cs if s is None else jnp.minimum(cs, s),
+                d if cd is None else cd if d is None else jnp.minimum(cd, d))
+    return rooms, tuple(custom)
+
+
+def _rooms_move_mask(rooms: dict, d: Array, src_b: Array) -> Array:
+    """bool[K, B] acceptance of the delta rows ``d[K, WAVE_DIMS]`` against
+    the combined rooms: one comparison per constrained dim (source side
+    collapses to [K] — each row has ONE source broker)."""
+    K = d.shape[0]
+    src_ok = jnp.ones(K, bool)
+    mask = None
+    for dim in sorted(rooms):
+        s, dstr = rooms[dim]
+        dd = d[:, dim]
+        exempt = (dd == 0) if dim in WAVE_ZERO_EXEMPT_DIMS else None
+        if s is not None:
+            ok = dd <= s[src_b]
+            if exempt is not None:
+                ok = ok | exempt
+            src_ok = src_ok & ok
+        if dstr is not None:
+            ok = dd[:, None] <= dstr[None, :]
+            if exempt is not None:
+                ok = ok | exempt[:, None]
+            mask = ok if mask is None else mask & ok
+    full = src_ok[:, None]
+    return full if mask is None else mask & full
+
+
+def _move_delta_rows(env: ClusterEnv, st: EngineState, cand: Array) -> Array:
+    """f32[K, WAVE_DIMS] wave-delta rows of candidate MOVES (what each move
+    removes from its source and adds to its destination) — shared by the
+    rooms acceptance check and the budgeted wave admission."""
+    K = cand.shape[0]
+    lead = st.replica_is_leader[cand]
+    eff = jnp.where(lead[:, None], env.leader_load[cand],
+                    env.follower_load[cand])
+    one = jnp.ones((K, 1), eff.dtype)
+    return jnp.concatenate([
+        eff, one, lead[:, None].astype(eff.dtype),
+        env.leader_load[cand, Resource.NW_OUT][:, None],
+        jnp.zeros((K, 1), eff.dtype),   # leader NW_IN: moves unconstrained
+    ], axis=1)
+
+
+def _move_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+               prev_goals: tuple, params: EngineParams,
+               cand: Array, kv: Array):
+    """ONE scored admission wave over ``cand`` (the former body of
+    _move_branch_batched; see that docstring for the stage walkthrough).
+    Re-scores its candidates against the LIVE state, fans destinations out
+    across affinity classes, admits under the chain's cumulative budgets and
+    applies the winners in one batched scatter."""
+    K = cand.shape[0]
+    B = env.num_brokers
+    mask = legit_move_mask(env, st, cand, goal.options)
+    d_rows = _move_delta_rows(env, st, cand)                        # [K, 8]
+    src_b = st.replica_broker[cand]
+    if params.chain_cache:
+        # pass-invariant chain cache: every interval-form prev-goal veto is
+        # ONE combined per-dim comparison ([B]-level rooms, refreshed per
+        # applied wave) instead of a [K, B] mask per goal
+        rooms, custom = _combined_move_rooms(prev_goals, env, st)
+        if rooms:
+            mask = mask & _rooms_move_mask(rooms, d_rows, src_b)
+    else:
+        custom = tuple(g for g in prev_goals
+                       if type(g).accept_move is not GoalKernel.accept_move)
+    for g in custom:
         mask = mask & g.accept_move(env, st, cand)
     score = goal.move_score(env, st, cand)
     score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
-    best_val = jnp.max(score, axis=1)                               # [K]
-    order = jnp.argsort(-best_val)                                  # best first
-    K = score.shape[0]
 
     # ---- stage 2: independent-wave selection in score order ----
-    r_sorted = cand[order]                                          # [K]
-    src_s = st.replica_broker[r_sorted]
-    p_s = env.replica_partition[r_sorted]
+    # per-row destination spread: the row at sorted position j prefers its
+    # best destination within column class (j mod T) whenever that class
+    # holds ANY positive-scoring destination, else falls back to its global
+    # best — rows with identical preference rankings (capacity headroom,
+    # rack utilization) fan out across T destination classes instead of all
+    # colliding on one broker and starving the wave; correctness is
+    # untouched because the applied value is the REAL score at the chosen
+    # destination. Computed in UNSORTED row space (the class comes from the
+    # row's sort rank) so the [K, B] score matrix is never permuted, and the
+    # class-restricted argmax runs on the [K, B/T] strided view instead of a
+    # masked full-width sweep — the former sorted-space pipeline's gather +
+    # two full [K, B] sweeps were the single largest per-pass cost.
     posn = jnp.arange(K, dtype=jnp.int32)
-    # per-row destination spread: row at sorted position j prefers its best
-    # destination within column class (j mod T) whenever that class holds ANY
-    # positive-scoring destination, else falls back to its global best — rows
-    # with identical preference rankings (capacity headroom, rack utilization)
-    # fan out across T destination classes instead of all colliding on one
-    # broker and starving the wave; correctness is untouched because the
-    # applied value is the REAL score at the chosen destination
-    T = min(params.num_dst_choices, env.num_brokers)
-    score_s = score[order]                                          # [K, B]
-    colid = jnp.arange(env.num_brokers, dtype=jnp.int32)[None, :]
-    affinity = (colid % T) == (posn[:, None] % T)
-    aff_score = jnp.where(affinity, score_s, NEG_INF)
-    aff_dst = jnp.argmax(aff_score, axis=1).astype(jnp.int32)
-    aff_val = aff_score[posn, aff_dst]
-    glob_dst = jnp.argmax(score_s, axis=1).astype(jnp.int32)
+    glob_dst = jnp.argmax(score, axis=1).astype(jnp.int32)
+    best_val = score[posn, glob_dst]                                # == max
+    order = jnp.argsort(-best_val)                                  # best first
+    rank = jnp.zeros(K, jnp.int32).at[order].set(posn)              # inv perm
+    T = min(params.num_dst_choices, B)
+    cls = rank % T
+    Bp = -(-B // T) * T
+    scp = (jnp.pad(score, ((0, 0), (0, Bp - B)), constant_values=NEG_INF)
+           if Bp > B else score)
+    aff = jnp.take_along_axis(scp.reshape(K, Bp // T, T),
+                              cls[:, None, None], axis=2)[..., 0]   # [K, B/T]
+    aff_j = jnp.argmax(aff, axis=1).astype(jnp.int32)
+    aff_val = aff[posn, aff_j]
+    aff_dst = aff_j * T + cls            # strided col j*T + cls == class col
     use_aff = aff_val > params.min_gain
-    dst_s = jnp.where(use_aff, aff_dst, glob_dst)
-    val_s = jnp.where(use_aff, aff_val, score_s[posn, glob_dst])
+    dst_u = jnp.where(use_aff, aff_dst, glob_dst)
+    val_u = jnp.where(use_aff, aff_val, best_val)
+
+    r_sorted = cand[order]                                          # [K]
+    src_s = src_b[order]
+    dst_s = dst_u[order]
+    val_s = val_u[order]
+    d = d_rows[order]                                   # [K, WAVE_DIMS]
+    p_s = env.replica_partition[r_sorted]
     wave_ok = val_s > params.min_gain
     INF = jnp.int32(K + 1)
     guarded = jnp.where(wave_ok, posn, INF)
-    B = env.num_brokers
     first_part = jnp.full(env.num_partitions, INF, jnp.int32).at[p_s].min(guarded)
     part_ok = first_part[p_s] == posn
 
     if all(_wave_budget_capable(g) for g in (goal, *prev_goals)):
         # ---- budgeted admission: MANY moves per broker per wave ----
         lead_s = st.replica_is_leader[r_sorted]
-        eff = jnp.where(lead_s[:, None], env.leader_load[r_sorted],
-                        env.follower_load[r_sorted])
-        one = jnp.ones((K, 1), eff.dtype)
-        d = jnp.concatenate([
-            eff, one, lead_s[:, None].astype(eff.dtype),
-            env.leader_load[r_sorted, Resource.NW_OUT][:, None],
-            jnp.zeros((K, 1), eff.dtype),   # leader NW_IN: moves unconstrained
-        ], axis=1)                                              # [K, WAVE_DIMS]
         win = part_ok & _wave_admission(
             env, st, goal, prev_goals, d, d, src_s, dst_s, wave_ok,
             env.replica_topic[r_sorted], posn,
-            d_count=jnp.ones(K, eff.dtype),
-            d_leader=lead_s.astype(eff.dtype),
+            d_count=jnp.ones(K, d.dtype),
+            d_leader=lead_s.astype(d.dtype),
             gain_escape=st.replica_offline[r_sorted])
     else:
         # legacy conservative wave: each broker participates at most once
@@ -465,6 +598,79 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     # re-score (sequential leftover re-validation was measured slower AND
     # lower-quality at rung 3, and the finisher phase now catches the tail)
     return st, n_applied
+
+
+def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                         prev_goals: tuple, params: EngineParams,
+                         severity: Array, stall: Array,
+                         cand: Array | None = None, kv: Array | None = None):
+    """Key once, wave-apply up to ``pass_waves`` rank-banded admission waves.
+
+    A pass is three stages:
+
+    1. SELECT: rank candidate replicas — top-(K * max_pass_waves) of the
+       goal's (stall-salted) key, over the compacted eligible prefix when it
+       fits (_select_candidates).
+    2. SCORE [K, B] + WAVE, per band (``_move_wave``): mask by legitimacy +
+       prev-goal acceptance (interval-form vetoes folded into ONE combined
+       rooms comparison — the pass-invariant chain cache), score every
+       destination, fan rows across destination-affinity classes, then
+       budgeted admission, in score order:
+       - partition first-touch (rack/sibling constraints stay single-move
+         exact) and per-(topic, broker) cumulative budgets;
+       - BUDGETED admission (when every chain goal supports it): a broker
+         may source/absorb MANY wave moves while the per-broker cumulative
+         delta stays inside the combined slack of every goal's band
+         (GoalKernel.wave_budgets) — interval constraints on monotone sums
+         hold for every prefix and any interleaving, so each admitted move
+         is valid in application order. This is what collapses pass counts
+         when one broker must shed dozens of replicas;
+       - otherwise the conservative rule: every broker participates at most
+         once, in one role.
+       Winners all apply in ONE batched scatter update
+       (`apply_moves_batched`).
+    3. MULTI-WAVE (params.pass_waves > 1): later rank bands re-run stage 2
+       against the live state — band selection is stale but every applied
+       action is re-scored exact (the _finisher_wave banding argument) — so
+       the tail regime lands several waves of actions per O(R) re-keying.
+       Stops at the first wave that admits nothing.
+
+    Compared to one-move-per-pass, a pass lands up to K*waves moves for one
+    selection sweep (reference hot loop it replaces:
+    ResourceDistributionGoal.java:384-862).
+
+    ``cand``/``kv`` override the heuristic-key candidate selection — the
+    finisher passes the top TRUE-gain replicas from an exhaustive scan (and
+    runs its own rank banding), reusing the single-wave stage unchanged.
+
+    Returns (state, n_applied, waves_run)."""
+    if cand is not None:
+        st, n = _move_wave(env, st, goal, prev_goals, params, cand, kv)
+        return st, n, jnp.int32(1)
+    K = min(params.num_candidates, env.num_replicas)
+    W = max(1, min(params.max_pass_waves, env.num_replicas // max(K, 1)))
+    key = goal.replica_key(env, st, severity)
+    kv_all, cand_all = _select_candidates(key, K * W, stall, goal.is_hard,
+                                          params)
+    if W == 1:
+        st, n = _move_wave(env, st, goal, prev_goals, params, cand_all, kv_all)
+        return st, n, jnp.int32(1)
+
+    def wave_body(carry):
+        s, w, total, _go = carry
+        c = jax.lax.dynamic_slice(cand_all, (w * K,), (K,))
+        v = jax.lax.dynamic_slice(kv_all, (w * K,), (K,))
+        s, n = _move_wave(env, s, goal, prev_goals, params, c, v)
+        return s, w + 1, total + n, n > 0
+
+    def wave_cond(carry):
+        _s, w, _total, go = carry
+        return go & (w < jnp.clip(params.pass_waves, 1, W))
+
+    st, waves, total, _go = jax.lax.while_loop(
+        wave_cond, wave_body,
+        (st, jnp.int32(0), jnp.int32(0), jnp.bool_(True)))
+    return st, total, waves
 
 
 def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
@@ -481,10 +687,11 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
     chains with non-budget-capable goals. ``cand``/``kv`` override candidate
     selection (see _move_branch_batched)."""
     if cand is None:
-        lkey = _stall_explore(goal.leader_key(env, st, severity), stall)
-        lkv, lcand = _top_candidates(lkey, min(params.num_leader_candidates,
-                                               env.num_replicas),
-                                     exact=goal.is_hard)
+        lkey = goal.leader_key(env, st, severity)
+        lkv, lcand = _select_candidates(lkey,
+                                        min(params.num_leader_candidates,
+                                            env.num_replicas),
+                                        stall, goal.is_hard, params)
     else:
         lkv, lcand = kv, cand
     lmask = legit_leadership_mask(env, st, lcand)
@@ -578,10 +785,9 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     k = min(params.num_swap_candidates, env.num_replicas, 128)
     okey = goal.swap_out_key(env, st, severity)
     ikey = goal.swap_in_key(env, st, severity)
-    okey = _stall_explore(okey, stall)
-    ikey = _stall_explore(ikey, stall, salt=101)   # decorrelate from okey
-    okv, cand_out = _top_candidates(okey, k, exact=goal.is_hard)
-    ikv, cand_in = _top_candidates(ikey, k, exact=goal.is_hard)
+    okv, cand_out = _select_candidates(okey, k, stall, goal.is_hard, params)
+    ikv, cand_in = _select_candidates(ikey, k, stall, goal.is_hard, params,
+                                      salt=101)   # decorrelate from okey
     mask = legit_swap_mask(env, st, cand_out, cand_in)
     for g in prev_goals:
         mask = mask & g.accept_swap(env, st, cand_out, cand_in)
@@ -687,7 +893,8 @@ def _compact_eligible(eligible: Array, pad_len: int):
 
 
 def _exhaustive_move_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-                          prev_goals: tuple, chunk: int):
+                          prev_goals: tuple, chunk: int,
+                          chain_cache: bool = True):
     """(gain f32[R], dst i32[R]) — every replica's best single-move gain
     over ALL destinations under full legitimacy + chain acceptance (NEG_INF
     where none exists). Unlike the budgeted passes' top-K windows this scan
@@ -707,6 +914,15 @@ def _exhaustive_move_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     chunk = min(chunk, R)
     eligible = goal.replica_key(env, st, goal.broker_severity(env, st)) > NEG_INF
     order, n_eligible = _compact_eligible(eligible, -(-R // chunk) * chunk)
+    # the state is FIXED for the whole scan, so the chain cache pays once:
+    # the combined rooms ([B]-level) are hoisted out of the chunk loop and
+    # each chunk runs one folded comparison instead of a mask per prev goal
+    if chain_cache:
+        rooms, custom = _combined_move_rooms(prev_goals, env, st)
+    else:
+        rooms, custom = {}, tuple(
+            g for g in prev_goals
+            if type(g).accept_move is not GoalKernel.accept_move)
 
     def body(i, carry):
         gain, dst = carry
@@ -715,7 +931,10 @@ def _exhaustive_move_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         cand = jnp.minimum(idx, R - 1)
         mask = legit_move_mask(env, st, cand, goal.options)
         mask = mask & (idx < R)[:, None]     # sentinel / padded rows
-        for g in prev_goals:
+        if rooms:
+            mask = mask & _rooms_move_mask(rooms, _move_delta_rows(env, st, cand),
+                                           st.replica_broker[cand])
+        for g in custom:
             mask = mask & g.accept_move(env, st, cand)
         score = jnp.where(mask, goal.move_score(env, st, cand), NEG_INF)
         d = jnp.argmax(score, axis=1).astype(jnp.int32)
@@ -827,9 +1046,9 @@ def _finisher_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                 env, s, goal, prev_goals, params, severity, zero_stall,
                 cand=cand, kv=kv)
         else:
-            s, n = _move_branch_batched(env, s, goal, prev_goals, params,
-                                        severity, zero_stall,
-                                        cand=cand, kv=kv)
+            s, n, _w = _move_branch_batched(env, s, goal, prev_goals, params,
+                                            severity, zero_stall,
+                                            cand=cand, kv=kv)
         return s, w + 1, total + n, n > 0
 
     def wave_cond(carry):
@@ -865,7 +1084,8 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         applied = zero
         if use_moves:
             gain, _ = _exhaustive_move_scan(env, st, goal, prev_goals,
-                                            params.scan_chunk)
+                                            params.scan_chunk,
+                                            chain_cache=params.chain_cache)
             mleft = jnp.sum(gain > params.min_gain).astype(jnp.int32)
             st, n = _finisher_wave(env, st, goal, prev_goals, params,
                                    gain, leadership=False)
@@ -995,8 +1215,8 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     stat_before = goal.stat(env, st)
 
     def step(carry):
-        st, it, n_applied, stall, dribble, _sat, win_stat, win_dribble, \
-            plateau, tailp = carry
+        (st, it, n_applied, stall, dribble, _sat, win_stat, win_dribble,
+         plateau, tailp, b_moves, b_leads, b_swaps, b_disk, b_waves) = carry
         severity = goal.broker_severity(env, st)
         # every pass inside the tail regime (any stall/dribble recorded)
         # counts toward tail_total_budget — salted passes reset the
@@ -1036,31 +1256,34 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         #     pass lands up to K moves); for leadership-primary goals they
         #     are the FALLBACK, gated behind a fruitless leadership pass
         #     (zero/one-trip fori_loop, not lax.cond — a cond carrying the
-        #     full EngineState defeats XLA aliasing and copies it)
+        #     full EngineState defeats XLA aliasing and copies it). The
+        #     gated bodies reuse the PASS-START severity: a zero-action
+        #     branch leaves every state leaf untouched (masked scatters are
+        #     no-ops), so when the gate opens the state — and therefore the
+        #     severity — is provably the one this pass started from.
         n_moves = jnp.int32(0)
+        n_waves = jnp.int32(0)
         if goal.uses_replica_moves:
             if lead_first:
                 def move_body(_i, carry):
-                    s, _n = carry
+                    s, _n, _w = carry
                     return _move_branch_batched(
-                        env, s, goal, prev_goals, params,
-                        goal.broker_severity(env, s), explore)
-                st, n_moves = jax.lax.fori_loop(
+                        env, s, goal, prev_goals, params, severity, explore)
+                st, n_moves, n_waves = jax.lax.fori_loop(
                     0, jnp.where(n_leads == 0, 1, 0), move_body,
-                    (st, jnp.int32(0)))
+                    (st, jnp.int32(0), jnp.int32(0)))
             else:
-                st, n_moves = _move_branch_batched(env, st, goal,
-                                                   prev_goals, params,
-                                                   severity, explore)
+                st, n_moves, n_waves = _move_branch_batched(
+                    env, st, goal, prev_goals, params, severity, explore)
 
         # 2. leadership transfers — only when no move landed; same
-        #    zero/one trip-count gating
+        #    zero/one trip-count gating (and the same severity-reuse
+        #    argument: the gate only opens on an untouched state)
         if goal.uses_leadership_moves and not lead_first:
             def lead_body(_i, carry):
                 s, _n = carry
                 return _leadership_branch_batched(
-                    env, s, goal, prev_goals, params,
-                    goal.broker_severity(env, s), explore)
+                    env, s, goal, prev_goals, params, severity, explore)
             st, n_leads = jax.lax.fori_loop(
                 0, jnp.where(n_moves == 0, 1, 0), lead_body,
                 (st, jnp.int32(0)))
@@ -1072,13 +1295,16 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
             def swap_body(_i, carry):
                 s, _n = carry
                 return _swap_branch_batched(env, s, goal, prev_goals,
-                                            params,
-                                            goal.broker_severity(env, s),
-                                            explore)
+                                            params, severity, explore)
             st, n_swaps = jax.lax.fori_loop(
                 0, jnp.where((n_moves + n_leads) == 0, 1, 0), swap_body,
                 (st, jnp.int32(0)))
 
+        b_moves = b_moves + n_moves
+        b_leads = b_leads + n_leads
+        b_swaps = b_swaps + n_swaps
+        b_disk = b_disk + n_disk
+        b_waves = b_waves + n_waves
         applied = n_disk + n_moves + n_leads + n_swaps
         # fruitless pass -> escalate exploration; any action resets it
         stall = jnp.where(applied > 0, jnp.int32(0), stall + 1)
@@ -1100,10 +1326,12 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         win_stat = jnp.where(roll, stat_now, win_stat)
         win_dribble = jnp.where(roll, dribble, win_dribble)
         return (st, it + 1, n_applied + applied, stall, dribble, sat,
-                win_stat, win_dribble, plateau, tailp)
+                win_stat, win_dribble, plateau, tailp,
+                b_moves, b_leads, b_swaps, b_disk, b_waves)
 
     def cond_fn(carry):
-        _st, it, _n, stall, dribble, sat, _ws, _wd, plateau, tailp = carry
+        (_st, it, _n, stall, dribble, sat, _ws, _wd, plateau, tailp,
+         *_counters) = carry
         # jnp.minimum, not min(): budget fields are traced pytree leaves
         stall_cap = jnp.where(
             sat, jnp.minimum(params.stall_retries, params.sat_stall_retries),
@@ -1118,10 +1346,13 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                 & ~plateau)
 
     (st, iters, n_applied, stall, dribble, _sat, _ws, _wd,
-     plateau, tailp) = jax.lax.while_loop(
+     plateau, tailp, b_moves, b_leads, b_swaps, b_disk,
+     b_waves) = jax.lax.while_loop(
         cond_fn, step, (st, jnp.int32(0), jnp.int32(0), jnp.int32(0),
                         jnp.int32(0), jnp.bool_(False), jnp.float32(jnp.inf),
-                        jnp.int32(0), jnp.bool_(False), jnp.int32(0)))
+                        jnp.int32(0), jnp.bool_(False), jnp.int32(0),
+                        jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                        jnp.int32(0), jnp.int32(0)))
     # FINISHER: a goal still violated at budget exit gets exhaustive-scan
     # rounds that either converge it to a machine-checked single-action
     # fixpoint (proven) or land the true best remaining actions trying
@@ -1155,5 +1386,15 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                 "leads_remaining": leads_left,
                 "swap_window_remaining": swaps_left,
                 "stat_before": stat_before,
+                # per-branch action split of the BUDGETED loop (finisher
+                # actions are fin_applied) + total admission waves run —
+                # the bench's pass-level profile (per-pass action yield =
+                # iterations / passes; waves / passes = band utilization)
+                "move_actions": b_moves,
+                "lead_actions": b_leads,
+                "swap_actions": b_swaps,
+                "disk_actions": b_disk,
+                "move_waves": b_waves,
+                "finisher_actions": fin_applied,
                 "stat": goal.stat(env, st)}
 

@@ -63,6 +63,15 @@ WAVE_LEADER_COUNT = 5
 WAVE_POT_NW_OUT = 6
 WAVE_LEADER_NW_IN = 7
 
+# Wave-delta dims whose ZERO-delta rows are exempt from accept_move_rooms
+# comparisons: the leader-count dim encodes CONDITIONAL acceptance
+# (LeaderReplicaDistributionGoal accepts every follower move outright — only
+# rows that actually relocate a leader are band-checked), whereas a
+# zero-valued resource/count delta still probes the destination's band
+# position in the goals' own mask arithmetic (a zero-load replica may NOT
+# land on a broker already above its upper bound).
+WAVE_ZERO_EXEMPT_DIMS = (WAVE_LEADER_COUNT,)
+
 
 @dataclasses.dataclass(frozen=True)
 class GoalKernel:
@@ -130,6 +139,24 @@ class GoalKernel:
         the combined slack — the admitted set then satisfies this goal's
         acceptance in ANY application order (prefix sums of nonnegative deltas
         are monotone). Return None when not applicable (see ``wave_safe``)."""
+        return None
+
+    def accept_move_rooms(self, env: ClusterEnv, st: EngineState):
+        """Optional ``{dim: (src_room[B] | None, dst_room[B] | None)}``: this
+        goal's accept_move veto in per-broker INTERVAL form. A move whose
+        wave-delta row is ``d[WAVE_DIMS]`` (engine convention, see WAVE_DIMS)
+        is accepted iff for every listed dim ``d[dim] <= src_room[src]`` and
+        ``d[dim] <= dst_room[dst]`` (None = that side unconstrained; dims in
+        WAVE_ZERO_EXEMPT_DIMS additionally accept zero-delta rows outright).
+
+        The engine folds every chain goal's rooms into ONE combined table
+        per pass (min over goals per dim) and applies a single vectorized
+        comparison, replacing one [K, B] mask per prev goal per branch (and
+        per exhaustive-scan chunk) — the pass-invariant chain cache. The
+        room form must be EXACTLY the goal's accept_move (bitwise up to one
+        f32 ulp at a band edge from the per-broker subtraction; certified in
+        tests/test_pass_pipeline.py). Return None when the veto has no
+        interval form (topic/rack-structured vetoes keep their masks)."""
         return None
 
     def wave_topic_budgets(self, env: ClusterEnv, st: EngineState,

@@ -72,6 +72,12 @@ class CapacityGoal(GoalKernel):
         limit = self._limit(env) + RESOURCE_EPS[self.resource]
         return (st.util[None, :, self.resource] + l[:, None]) <= limit[None, :]
 
+    def accept_move_rooms(self, env: ClusterEnv, st: EngineState):
+        """Interval form of accept_move: destination headroom to the
+        capacity limit on this resource; sources unconstrained."""
+        limit = self._limit(env) + RESOURCE_EPS[self.resource]
+        return {int(self.resource): (None, limit - st.util[:, self.resource])}
+
     def wave_budgets(self, env: ClusterEnv, st: EngineState):
         """Destination headroom to the capacity limit; sources unconstrained
         (cumulative form of accept_move)."""
@@ -206,6 +212,12 @@ class ReplicaCapacityGoal(GoalKernel):
     def accept_move(self, env: ClusterEnv, st: EngineState, cand):
         ok = (st.replica_count[None, :] + 1) <= self._max()
         return jnp.broadcast_to(ok, (cand.shape[0], env.num_brokers))
+
+    def accept_move_rooms(self, env: ClusterEnv, st: EngineState):
+        """Interval form: a move's count delta (1) must fit the destination's
+        remaining replica-count headroom (counts are f32-exact)."""
+        c = st.replica_count.astype(jnp.float32)
+        return {WAVE_COUNT: (None, float(self._max()) - c)}
 
     def wave_budgets(self, env: ClusterEnv, st: EngineState):
         """Destination replica-count headroom to the per-broker cap."""

@@ -167,6 +167,16 @@ class ResourceDistributionGoal(GoalKernel):
         src_was_excess = (util[src] > upper[src])[:, None]
         return dst_ok & (src_ok | src_was_excess)
 
+    def accept_move_rooms(self, env: ClusterEnv, st: EngineState):
+        """Interval form of accept_move: the resource delta must fit the
+        destination's room to its upper bound and the source's room to its
+        lower bound; an already-excess source may shed anything."""
+        lower, upper = self._limits(env, st)
+        util = st.util[:, self.resource]
+        eps = RESOURCE_EPS[self.resource]
+        src = jnp.where(util > upper, jnp.inf, util - lower + eps)
+        return {int(self.resource): (src, upper - util + eps)}
+
     # -- leadership (CPU & NW_OUT follow leadership) --
     def leader_key(self, env: ClusterEnv, st: EngineState, severity):
         lower, upper = self._limits(env, st)
@@ -370,6 +380,15 @@ class ReplicaDistributionGoal(GoalKernel):
         src_ok = ((c[src] - 1 >= lower[src]) | (c[src] > upper[src]))[:, None]
         return dst_ok & src_ok
 
+    def accept_move_rooms(self, env: ClusterEnv, st: EngineState):
+        """Interval form of accept_move on the count dim (every move's count
+        delta is exactly 1; counts are f32-exact, so this is bitwise the
+        mask's band check)."""
+        lower, upper = self._limits(env, st)
+        c = st.replica_count.astype(jnp.float32)
+        src = jnp.where(c > upper, jnp.inf, c - lower)
+        return {WAVE_COUNT: (src, upper - c)}
+
     def wave_budgets(self, env: ClusterEnv, st: EngineState):
         """Replica-count band slack (cumulative form of accept_move: shedding
         stepwise from excess may continue down to lower)."""
@@ -450,6 +469,16 @@ class LeaderReplicaDistributionGoal(GoalKernel):
         src_ok = ((c[src] - 1 >= lower[src]) | (c[src] > upper[src]))[:, None]
         moving_leader = is_leader[:, None]
         return jnp.where(moving_leader, dst_ok & src_ok, True)
+
+    def accept_move_rooms(self, env: ClusterEnv, st: EngineState):
+        """Interval form of accept_move: only rows whose leader-count delta
+        is 1 (moving a leader) are band-checked — follower moves carry a
+        zero delta and the leader-count dim is zero-exempt
+        (WAVE_ZERO_EXEMPT_DIMS), reproducing the mask's conditional."""
+        lower, upper = self._limits(env, st)
+        c = st.leader_count.astype(jnp.float32)
+        src = jnp.where(c > upper, jnp.inf, c - lower)
+        return {WAVE_LEADER_COUNT: (src, upper - c)}
 
     def wave_budgets(self, env: ClusterEnv, st: EngineState):
         """Leader-count band slack; follower moves carry a zero leader-count

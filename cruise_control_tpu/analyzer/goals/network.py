@@ -61,6 +61,12 @@ class PotentialNwOutGoal(GoalKernel):
         limit = self._limit(env) + RESOURCE_EPS[NW_OUT]
         return st.potential_nw_out[None, :] + pot[:, None] <= limit[None, :]
 
+    def accept_move_rooms(self, env: ClusterEnv, st: EngineState):
+        """Interval form: the move's potential-NW_OUT delta must fit the
+        destination's headroom to the potential limit."""
+        limit = self._limit(env) + RESOURCE_EPS[NW_OUT]
+        return {WAVE_POT_NW_OUT: (None, limit - st.potential_nw_out)}
+
     def wave_budgets(self, env: ClusterEnv, st: EngineState):
         """Destination headroom to the potential-NW_OUT limit."""
         limit = self._limit(env) + RESOURCE_EPS[NW_OUT]

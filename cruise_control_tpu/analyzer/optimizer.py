@@ -72,6 +72,14 @@ class GoalResult:
     swap_window_remaining: int = -1
     finisher_rounds: int = 0
     plateau_exit: bool = False    # stat-slope plateau cut the tail
+    # per-branch split of the budgeted loop's applied actions + admission
+    # waves run (engine pass-level profile; iterations/passes = action yield)
+    move_actions: int = 0
+    lead_actions: int = 0
+    swap_actions: int = 0
+    disk_actions: int = 0
+    move_waves: int = 0
+    finisher_actions: int = 0
 
 
 @dataclasses.dataclass
@@ -198,6 +206,15 @@ class GoalOptimizer:
                 num_dst_choices=config.get_int("analyzer.destination.spread"),
                 stall_retries=config.get_int("analyzer.stall.retries"),
                 tail_pass_budget=config.get_int("analyzer.tail.pass.budget"),
+                # pass-pipeline knobs (engine.py PR-4 block): waves per pass
+                # (traced; the static loop bound tracks the configured value
+                # so config-raised wave counts stay reachable), compacted
+                # candidate selection, interval-form chain-acceptance cache
+                pass_waves=config.get_int("analyzer.pass.waves"),
+                max_pass_waves=max(config.get_int("analyzer.pass.waves"),
+                                   EngineParams.max_pass_waves),
+                compact_keying=config.get_boolean("analyzer.compact.keying"),
+                chain_cache=config.get_boolean("analyzer.chain.cache"),
             )
         self._params = engine_params or EngineParams()
         # analyzer.fused.chain.min.replicas: at/above this cluster size the
@@ -410,6 +427,14 @@ class GoalOptimizer:
                 self._params.tail_pass_budget * _budget_scale(num_replicas) ** 2),
             stall_retries=min(
                 32, self._params.stall_retries * _budget_scale(num_replicas)),
+            # multi-wave passes engage where the O(R) per-pass keying is
+            # worth amortizing: at >= 256k replicas each budgeted pass runs
+            # up to max_pass_waves rank-banded admission waves off ONE
+            # keying + selection (engine._move_branch_batched). pass_waves
+            # is a TRACED leaf — this scaling never forces a recompile.
+            pass_waves=min(max(1, self._params.max_pass_waves),
+                           max(self._params.pass_waves,
+                               4 if num_replicas >= 262_144 else 1)),
             # small clusters skip the finisher subprogram entirely
             # (analyzer.finisher.min.replicas): the plateau-fixpoint proof
             # covers certificates there, and the subprogram multiplies the
@@ -577,6 +602,12 @@ class GoalOptimizer:
                     info.get("swap_window_remaining", -1)),
                 finisher_rounds=int(info.get("finisher_rounds", 0)),
                 plateau_exit=bool(info.get("plateau_exit", False)),
+                move_actions=int(info.get("move_actions", 0)),
+                lead_actions=int(info.get("lead_actions", 0)),
+                swap_actions=int(info.get("swap_actions", 0)),
+                disk_actions=int(info.get("disk_actions", 0)),
+                move_waves=int(info.get("move_waves", 0)),
+                finisher_actions=int(info.get("finisher_actions", 0)),
             )
             for g, info, dur in zip(goals, infos, durations)
         ]

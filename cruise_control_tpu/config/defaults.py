@@ -150,6 +150,29 @@ _D.define(name="analyzer.tail.pass.budget", type=Type.INT, default=64, validator
           doc="TPU-specific: cumulative low-yield passes allowed per goal — the "
               "bounded convergence tail (reference analogue: the 1 s-per-broker "
               "swap cap, ResourceDistributionGoal.java:58).")
+_D.define(name="analyzer.pass.waves", type=Type.INT, default=4, validator=at_least(1),
+          doc="TPU-specific: rank-banded admission waves per budgeted engine "
+              "pass — one O(R) candidate keying feeds up to this many scored "
+              "[K, B] waves against the live state (engine pass pipeline; "
+              "1 = legacy single-wave passes, bit-identical to pre-wave "
+              "behavior). Traced budget leaf: changing it reuses compiled "
+              "programs. The optimizer additionally raises it to 4 at "
+              ">= 256k-replica clusters.")
+_D.define(name="analyzer.compact.keying", type=Type.BOOLEAN, default=False,
+          doc="TPU-specific: run per-pass candidate selection (stall salt + "
+              "top-k) over the goal's compacted eligible prefix when it fits "
+              "the pool, so selection cost tracks remaining work instead of "
+              "R (engine._select_candidates; exact on CPU, exactness UPGRADE "
+              "over approx top-k on TPU). Default off: on CPU hosts the "
+              "compaction scatter costs more than the full-R selection it "
+              "replaces (docs/PERF.md round 6); enable on accelerators.")
+_D.define(name="analyzer.chain.cache", type=Type.BOOLEAN, default=True,
+          doc="TPU-specific: fold interval-form prev-goal accept_move vetoes "
+              "into one combined per-broker room table per pass "
+              "(GoalKernel.accept_move_rooms) instead of one [K, B] mask per "
+              "chain goal per branch and per finisher-scan chunk. "
+              "Mathematically exact; bitwise within one f32 ulp of the "
+              "per-goal masks at band edges. Off = per-goal masks.")
 _D.define(name="analyzer.fused.chain.min.replicas", type=Type.INT, default=65_536,
           doc="TPU-specific: at/above this cluster size the whole goal chain "
               "compiles into ONE device program (one dispatch instead of one "

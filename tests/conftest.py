@@ -42,3 +42,21 @@ if not os.environ.get("CC_TPU_NO_COMPILE_CACHE"):
                       os.environ["JAX_COMPILATION_CACHE_DIR"])
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def pytest_configure(config):
+    """Register the suite's markers PROGRAMMATICALLY, in addition to
+    pytest.ini's ``markers`` section. The ini registration only applies when
+    pytest's rootdir resolution actually picks this repo's pytest.ini up —
+    invocations anchored elsewhere (absolute test paths from another cwd, an
+    ancestor config file shadowing ours, ``-c``/``--rootdir`` overrides)
+    silently lose it, and every ``@pytest.mark.slow`` application then emits
+    a PytestUnknownMarkWarning (15 of them during one observed fast-tier
+    collection). Conftest-based registration travels WITH the test tree, so
+    the marker is known under every invocation that can collect these tests;
+    pytest.ini additionally escalates the warning to an error so an
+    unregistered mark can never silently reappear where the ini applies."""
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running quality proofs / large-scale tests; the fast "
+        "tier (pytest -m \"not slow\") still covers every layer")

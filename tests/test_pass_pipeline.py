@@ -1,0 +1,274 @@
+"""Pass-pipeline parity certification (PR 4 tentpole contract).
+
+The engine's warm-pass fast paths — eligible-set-compacted candidate keying
+(`engine._select_candidates`), the pass-invariant chain-acceptance cache
+(`GoalKernel.accept_move_rooms` folded by `engine._combined_move_rooms`) and
+rank-banded multi-wave passes (`EngineParams.pass_waves`) — must be
+TOGGLEABLE and, on seeded fixtures, BIT-IDENTICAL to the knobs-off pipeline:
+same final assignments, same violation outcomes, same fixpoint certificates.
+These tests are that certificate, plus the zero-new-XLA-compiles contract for
+budget-leaf knob toggles (EngineParams' traced leaves must never force a
+recompile).
+"""
+import dataclasses
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import engine as E
+from cruise_control_tpu.analyzer import init_state, make_env
+from cruise_control_tpu.analyzer.engine import EngineParams
+from cruise_control_tpu.analyzer.goals import make_goals
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate
+
+# knob-on / knob-off parameter points. max_pass_waves is static (selection
+# width + wave-loop bound); pass_waves is the TRACED wave count. The OFF
+# point is the legacy single-wave, full-R-keying, per-goal-mask pipeline.
+# PARAMS_ON is the certified-bit-identical pipeline point: compacted
+# keying + chain cache + the widened selection / wave-loop machinery at ONE
+# wave. pass_waves > 1 (PARAMS_WAVES) is a deliberate greedy-order change —
+# later bands are stale-ranked exploration, the same contract as the
+# engine's 0.95-recall approx top-k — so its parity clause is OUTCOME
+# parity (violations + certificates), not bitwise assignments, plus an
+# exact fallback at pass_waves=1.
+PARAMS_OFF = EngineParams(max_pass_waves=1, pass_waves=1,
+                          compact_keying=False, chain_cache=False)
+PARAMS_ON = EngineParams(max_pass_waves=4, pass_waves=1,
+                         compact_keying=True, chain_cache=True)
+PARAMS_WAVES = EngineParams(max_pass_waves=4, pass_waves=4,
+                            compact_keying=True, chain_cache=True)
+
+CHAIN = ["RackAwareGoal", "DiskCapacityGoal", "CpuCapacityGoal",
+         "ReplicaDistributionGoal", "DiskUsageDistributionGoal",
+         "LeaderReplicaDistributionGoal"]
+
+
+def _cluster(seed=777):
+    """Seeded fixture big enough that K (64) < R: the widened selection has
+    real rank bands and the compaction pool has a real eligible prefix."""
+    return generate(RandomClusterSpec(
+        num_brokers=24, num_racks=4, num_topics=12, num_partitions=300,
+        max_replication=2, skew=2.0, seed=seed))
+
+
+def _run(params, ct, meta, goal_names=CHAIN):
+    opt = GoalOptimizer(engine_params=params)
+    return opt.optimizations(ct, meta, goal_names=goal_names,
+                             raise_on_failure=False,
+                             skip_hard_goal_check=True)
+
+
+def _assert_bit_identical(ra, rb, label):
+    np.testing.assert_array_equal(
+        np.asarray(ra.final_state.replica_broker),
+        np.asarray(rb.final_state.replica_broker), err_msg=label)
+    np.testing.assert_array_equal(
+        np.asarray(ra.final_state.replica_is_leader),
+        np.asarray(rb.final_state.replica_is_leader), err_msg=label)
+    np.testing.assert_array_equal(
+        np.asarray(ra.final_state.replica_disk),
+        np.asarray(rb.final_state.replica_disk), err_msg=label)
+    assert ra.violated_goals_before == rb.violated_goals_before, label
+    assert ra.violated_goals_after == rb.violated_goals_after, label
+    assert ra.num_replica_movements == rb.num_replica_movements, label
+    assert ra.num_leadership_movements == rb.num_leadership_movements, label
+    for ga, gb in zip(ra.goal_results, rb.goal_results):
+        assert (ga.fixpoint_proven, ga.hit_max_iters, ga.moves_remaining,
+                ga.leads_remaining, ga.swap_window_remaining) == \
+               (gb.fixpoint_proven, gb.hit_max_iters, gb.moves_remaining,
+                gb.leads_remaining, gb.swap_window_remaining), \
+            (label, ga.name)
+
+
+def test_pipeline_knobs_bit_identical_to_legacy():
+    """All three knobs ON vs all OFF: bit-identical assignments, violation
+    outcomes and certificate fields on the seeded fixture."""
+    ct, meta = _cluster()
+    _assert_bit_identical(_run(PARAMS_ON, ct, meta),
+                          _run(PARAMS_OFF, ct, meta), "all-knobs")
+
+
+@pytest.mark.parametrize("knob", [
+    {"compact_keying": True},
+    {"chain_cache": True},
+    {"max_pass_waves": 4},          # widened selection + wave loop, 1 wave
+])
+def test_each_knob_falls_back_cleanly(knob):
+    """Each knob toggled INDIVIDUALLY against the all-off baseline stays
+    bit-identical — so disabling any one of them in production falls back
+    to a certified-equivalent pipeline."""
+    ct, meta = _cluster(seed=778)
+    pa = dataclasses.replace(PARAMS_OFF, **knob)
+    _assert_bit_identical(_run(pa, ct, meta), _run(PARAMS_OFF, ct, meta),
+                          str(knob))
+
+
+def test_multi_wave_outcome_parity_and_exact_fallback():
+    """pass_waves > 1 reorders the greedy trajectory by design (stale-ranked
+    later bands). Its contract: IDENTICAL violation outcomes and
+    certificate fields on the seeded fixture — and setting pass_waves back
+    to 1 (a traced leaf, no recompile) is bit-identical to the legacy
+    pipeline again."""
+    ct, meta = _cluster(seed=777)
+    rw = _run(PARAMS_WAVES, ct, meta)
+    r1 = _run(PARAMS_OFF, ct, meta)
+    assert rw.violated_goals_before == r1.violated_goals_before
+    assert rw.violated_goals_after == r1.violated_goals_after
+    for gw, g1 in zip(rw.goal_results, r1.goal_results):
+        assert (gw.fixpoint_proven, gw.hit_max_iters) == \
+               (g1.fixpoint_proven, g1.hit_max_iters), gw.name
+    # multi-wave actually exercised the wave machinery
+    assert sum(g.move_waves for g in rw.goal_results) > 0
+    # exact fallback: waves dialed back to 1 == legacy, bit for bit
+    _assert_bit_identical(
+        _run(dataclasses.replace(PARAMS_WAVES, pass_waves=1), ct, meta),
+        r1, "waves-fallback")
+
+
+def test_rooms_exactly_reproduce_accept_move_masks():
+    """Every goal exposing accept_move_rooms must reproduce its own
+    accept_move mask EXACTLY through the folded rooms comparison (the
+    chain-cache's soundness contract), on the seeded fixture's initial
+    state over every valid replica."""
+    ct, meta = _cluster(seed=779)
+    env = make_env(ct, meta)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    cand = jnp.arange(env.num_replicas, dtype=jnp.int32)
+    d = E._move_delta_rows(env, st, cand)
+    src_b = st.replica_broker[cand]
+    goals = make_goals([
+        "DiskCapacityGoal", "CpuCapacityGoal", "NetworkInboundCapacityGoal",
+        "NetworkOutboundCapacityGoal", "ReplicaCapacityGoal",
+        "PotentialNwOutGoal", "ReplicaDistributionGoal",
+        "LeaderReplicaDistributionGoal", "DiskUsageDistributionGoal",
+        "CpuUsageDistributionGoal", "NetworkInboundUsageDistributionGoal",
+        "NetworkOutboundUsageDistributionGoal"])
+    checked = 0
+    for g in goals:
+        rooms = g.accept_move_rooms(env, st)
+        assert rooms is not None, g.name
+        ref = np.asarray(g.accept_move(env, st, cand))
+        got = np.asarray(E._rooms_move_mask(rooms, d, src_b))
+        valid = np.asarray(env.replica_valid)
+        np.testing.assert_array_equal(got[valid], ref[valid], err_msg=g.name)
+        checked += 1
+    assert checked == 12
+
+
+def test_combined_rooms_match_sequential_masks():
+    """The FOLDED (min-combined) rooms of a whole chain equal the AND of the
+    per-goal masks — folding must not lose a veto."""
+    ct, meta = _cluster(seed=780)
+    env = make_env(ct, meta)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    cand = jnp.arange(env.num_replicas, dtype=jnp.int32)
+    goals = tuple(make_goals([
+        "DiskCapacityGoal", "ReplicaCapacityGoal", "ReplicaDistributionGoal",
+        "DiskUsageDistributionGoal", "LeaderReplicaDistributionGoal"]))
+    rooms, custom = E._combined_move_rooms(goals, env, st)
+    assert not custom          # all five have interval forms
+    got = np.asarray(E._rooms_move_mask(
+        rooms, E._move_delta_rows(env, st, cand), st.replica_broker[cand]))
+    ref = np.ones_like(got)
+    for g in goals:
+        ref &= np.asarray(g.accept_move(env, st, cand))
+    valid = np.asarray(env.replica_valid)
+    np.testing.assert_array_equal(got[valid], ref[valid])
+
+
+def test_compacted_selection_matches_full_sweep():
+    """_select_candidates with compaction ON == full-R sweep, across
+    eligibility regimes (sparse, dense, pool overflow) and stall salting.
+    Padding slots may differ but only with kv == -inf (inert downstream)."""
+    rng = np.random.default_rng(42)
+    R = 4096
+    base = jnp.asarray(rng.random(R), jnp.float32)
+    p_on = EngineParams(compact_keying=True, compact_pool=1024)
+    p_off = EngineParams(compact_keying=False)
+    for frac in (0.01, 0.1, 0.5, 1.0):   # 0.5/1.0 overflow the 1024 pool
+        elig = jnp.asarray(rng.random(R) < frac)
+        key = jnp.where(elig, base, -jnp.inf)
+        for stall in (0, 3):
+            for exact in (False, True):
+                kv_c, c_c = E._select_candidates(
+                    key, 64, jnp.int32(stall), exact, p_on)
+                kv_f, c_f = E._select_candidates(
+                    key, 64, jnp.int32(stall), exact, p_off)
+                np.testing.assert_array_equal(np.asarray(kv_c),
+                                              np.asarray(kv_f),
+                                              err_msg=f"{frac}/{stall}")
+                live = np.asarray(kv_f) > -np.inf
+                np.testing.assert_array_equal(np.asarray(c_c)[live],
+                                              np.asarray(c_f)[live],
+                                              err_msg=f"{frac}/{stall}")
+
+
+def test_budget_leaf_toggle_zero_recompiles():
+    """Toggling ONLY traced budget leaves — pass_waves included — must reuse
+    the compiled goal program: zero new XLA compiles (the EngineParams
+    pytree-split contract that keeps warmup + the persistent cache honest)."""
+    ct, meta = _cluster(seed=781)
+    opt = GoalOptimizer(engine_params=PARAMS_ON)
+    kw = dict(goal_names=CHAIN, raise_on_failure=False,
+              skip_hard_goal_check=True)
+    opt.optimizations(ct, meta, **kw)    # compile
+
+    class Counter(logging.Handler):
+        def __init__(self):
+            super().__init__(level=logging.DEBUG)
+            self.count = 0
+
+        def emit(self, record):
+            if "Compiling" in record.getMessage():
+                self.count += 1
+
+    handler = Counter()
+    prev = bool(jax.config.jax_log_compiles)
+    jax.config.update("jax_log_compiles", True)
+    logging.getLogger("jax").addHandler(handler)
+    try:
+        for tweak in ({"pass_waves": 2}, {"pass_waves": 1},
+                      {"tail_pass_budget": 7, "stall_retries": 3},
+                      {"max_iters": 11, "sat_tail_passes": 2}):
+            opt2 = GoalOptimizer(engine_params=dataclasses.replace(
+                PARAMS_ON, **tweak))
+            opt2.optimizations(ct, meta, **kw)
+    finally:
+        logging.getLogger("jax").removeHandler(handler)
+        jax.config.update("jax_log_compiles", prev)
+    assert handler.count == 0, f"{handler.count} recompiles on budget toggles"
+
+
+@pytest.mark.slow
+def test_finisher_certificate_parity_with_knobs():
+    """Certificate parity under the knobs with the exhaustive finisher
+    FORCED on (small clusters normally skip it): the fixpoint certificate
+    fields and the final state must be bit-identical knobs-on vs knobs-off
+    — the chain cache also rewires the finisher's exhaustive move scan."""
+    ct, meta = _cluster(seed=782)
+    from cruise_control_tpu.model.cluster_tensor import pad_cluster
+    ct, meta = pad_cluster(ct, meta)
+    env = make_env(ct, meta)
+    st0 = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                     ct.replica_offline, ct.replica_disk)
+    goals = make_goals(CHAIN)
+    prev = tuple(goals[:-2])
+    goal = goals[-2]                      # DiskUsageDistributionGoal
+    outs = []
+    for p in (PARAMS_ON, PARAMS_OFF):
+        p = dataclasses.replace(p, finisher_rounds=2, tail_pass_budget=6,
+                                stall_retries=2, tail_total_budget=12)
+        st, info = E.optimize_goal(env, st0, goal, prev, p)
+        outs.append((jax.device_get(st), jax.device_get(info)))
+    (st_a, info_a), (st_b, info_b) = outs
+    np.testing.assert_array_equal(np.asarray(st_a.replica_broker),
+                                  np.asarray(st_b.replica_broker))
+    for k in ("fixpoint_proven", "moves_remaining", "leads_remaining",
+              "swap_window_remaining", "violated_after", "iterations"):
+        assert np.asarray(info_a[k]) == np.asarray(info_b[k]), k

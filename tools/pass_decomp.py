@@ -1,0 +1,179 @@
+"""Decompose one budgeted move pass into its stages and time each at a bench
+shape — which O(R) / O(K*B) pieces dominate the warm per-pass cost, and how
+the cost scales with chain depth (prev-goal acceptance masks).
+
+Usage: pass_decomp.py [r3|r4] [chain_len]
+"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', '/tmp/jax_cache_cc_tpu')
+import jax, jax.numpy as jnp
+jax.config.update('jax_compilation_cache_dir', '/tmp/jax_cache_cc_tpu')
+import dataclasses
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate_scale
+from cruise_control_tpu.model.cluster_tensor import pad_cluster
+from cruise_control_tpu.analyzer.env import make_env, padded_partition_table, BalancingConstraint, OptimizationOptions
+from cruise_control_tpu.analyzer.state import init_state
+from cruise_control_tpu.analyzer.goals import make_goals
+from cruise_control_tpu.analyzer.goals.base import legit_move_mask, NEG_INF
+from cruise_control_tpu.analyzer import engine as E
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, _budget_scale
+
+shape = sys.argv[1] if len(sys.argv) > 1 else "r3"
+chain_len = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+if shape == "r3":
+    spec = RandomClusterSpec(num_brokers=1000, num_racks=20, num_topics=400,
+                             num_partitions=50000, max_replication=3, skew=1.0,
+                             seed=3141, target_cpu_util=0.45)
+else:
+    spec = RandomClusterSpec(num_brokers=7000, num_racks=40, num_topics=2000,
+                             num_partitions=500000, max_replication=3, skew=1.0,
+                             seed=3142, target_cpu_util=0.45)
+ct, meta = generate_scale(spec)
+ct, meta = pad_cluster(ct, meta)
+opt = GoalOptimizer()
+params = dataclasses.replace(
+    opt._params,
+    num_candidates=min(1760, max(64, ct.num_brokers // 4, ct.num_replicas // 64)),
+    num_leader_candidates=min(1024, max(32, ct.num_brokers // 8)),
+    num_swap_candidates=max(32, ct.num_brokers // 32),
+    num_dst_choices=min(128, max(16, ct.num_brokers // 100)))
+print("R", ct.num_replicas, "B", ct.num_brokers, "K", params.num_candidates, flush=True)
+env = make_env(ct, meta, partition_table=padded_partition_table(ct))
+st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                ct.replica_offline, ct.replica_disk)
+CHAIN = ["RackAwareGoal", "MinTopicLeadersPerBrokerGoal", "ReplicaCapacityGoal",
+         "DiskCapacityGoal", "NetworkInboundCapacityGoal",
+         "NetworkOutboundCapacityGoal", "CpuCapacityGoal",
+         "ReplicaDistributionGoal", "PotentialNwOutGoal",
+         "DiskUsageDistributionGoal", "NetworkInboundUsageDistributionGoal",
+         "NetworkOutboundUsageDistributionGoal", "CpuUsageDistributionGoal",
+         "LeaderReplicaDistributionGoal", "LeaderBytesInDistributionGoal",
+         "TopicReplicaDistributionGoal"]
+goals = make_goals(CHAIN[:chain_len + 1], BalancingConstraint(), OptimizationOptions())
+goal = goals[-1]
+prev = tuple(goals[:-1])
+K = min(params.num_candidates, env.num_replicas)
+zero = jnp.int32(0)
+
+@jax.jit
+def sev_f(env, st):
+    return goal.broker_severity(env, st)
+
+@jax.jit
+def key_f(env, st, sev):
+    return goal.replica_key(env, st, sev)
+
+@jax.jit
+def salt_topk_f(key):
+    key = E._stall_explore(key, zero)
+    return E._top_candidates(key, K, exact=goal.is_hard)
+
+@jax.jit
+def legit_f(env, st, cand):
+    return legit_move_mask(env, st, cand, goal.options)
+
+@jax.jit
+def accepts_f(env, st, cand):
+    m = jnp.ones((cand.shape[0], env.num_brokers), bool)
+    for g in prev:
+        m = m & g.accept_move(env, st, cand)
+    return m
+
+@jax.jit
+def score_f(env, st, cand):
+    return goal.move_score(env, st, cand)
+
+@jax.jit
+def full_branch(env, st):
+    sev = goal.broker_severity(env, st)
+    return E._move_branch_batched(env, st, goal, prev, params, sev, zero)
+
+@jax.jit
+def full_branch_nochain(env, st):
+    sev = goal.broker_severity(env, st)
+    return E._move_branch_batched(env, st, goal, (), params, sev, zero)
+
+
+def bench(name, fn, *args, n=20):
+    r = fn(*args)
+    jax.block_until_ready(r)
+    t0 = time.monotonic()
+    for _ in range(n):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    print(f"{name:28s} {(time.monotonic() - t0) / n * 1e3:8.2f} ms", flush=True)
+    return r
+
+
+sev = bench("broker_severity", sev_f, env, st)
+key = bench("replica_key [R]", key_f, env, st, sev)
+kv, cand = bench("salt+topk [R]", salt_topk_f, key)
+bench("legit_move_mask [K,B]", legit_f, env, st, cand)
+bench(f"accepts x{len(prev)} [K,B]", accepts_f, env, st, cand)
+bench("move_score [K,B]", score_f, env, st, cand)
+bench(f"FULL branch chain={len(prev)}", full_branch, env, st)
+bench("FULL branch chain=0", full_branch_nochain, env, st)
+
+
+# ---- wave-stage decomposition (chain=0): where do the other ~15 ms go? ----
+@jax.jit
+def stage_score(env, st, cand, kv):
+    mask = legit_move_mask(env, st, cand, goal.options)
+    score = goal.move_score(env, st, cand)
+    score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
+    best_val = jnp.max(score, axis=1)
+    order = jnp.argsort(-best_val)
+    return score, best_val, order
+
+@jax.jit
+def stage_spread(env, st, score, best_val, order):
+    K = score.shape[0]
+    posn = jnp.arange(K, dtype=jnp.int32)
+    T = min(params.num_dst_choices, env.num_brokers)
+    score_s = score[order]
+    colid = jnp.arange(env.num_brokers, dtype=jnp.int32)[None, :]
+    affinity = (colid % T) == (posn[:, None] % T)
+    aff_score = jnp.where(affinity, score_s, NEG_INF)
+    aff_dst = jnp.argmax(aff_score, axis=1).astype(jnp.int32)
+    aff_val = aff_score[posn, aff_dst]
+    glob_dst = jnp.argmax(score_s, axis=1).astype(jnp.int32)
+    use_aff = aff_val > params.min_gain
+    dst_s = jnp.where(use_aff, aff_dst, glob_dst)
+    val_s = jnp.where(use_aff, aff_val, score_s[posn, glob_dst])
+    return dst_s, val_s
+
+@jax.jit
+def stage_admit_apply(env, st, cand, order, dst_s, val_s):
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.analyzer.state import apply_moves_batched
+    K = cand.shape[0]
+    posn = jnp.arange(K, dtype=jnp.int32)
+    r_sorted = cand[order]
+    src_s = st.replica_broker[r_sorted]
+    p_s = env.replica_partition[r_sorted]
+    wave_ok = val_s > params.min_gain
+    INF = jnp.int32(K + 1)
+    guarded = jnp.where(wave_ok, posn, INF)
+    first_part = jnp.full(env.num_partitions, INF, jnp.int32).at[p_s].min(guarded)
+    part_ok = first_part[p_s] == posn
+    lead_s = st.replica_is_leader[r_sorted]
+    eff = jnp.where(lead_s[:, None], env.leader_load[r_sorted],
+                    env.follower_load[r_sorted])
+    one = jnp.ones((K, 1), eff.dtype)
+    d = jnp.concatenate([
+        eff, one, lead_s[:, None].astype(eff.dtype),
+        env.leader_load[r_sorted, Resource.NW_OUT][:, None],
+        jnp.zeros((K, 1), eff.dtype)], axis=1)
+    win = part_ok & E._wave_admission(
+        env, st, goal, (), d, d, src_s, dst_s, wave_ok,
+        env.replica_topic[r_sorted], posn,
+        d_count=jnp.ones(K, eff.dtype),
+        d_leader=lead_s.astype(eff.dtype),
+        gain_escape=st.replica_offline[r_sorted])
+    st = apply_moves_batched(env, st, r_sorted, dst_s, win)
+    return st, jnp.sum(win)
+
+score, best_val, order = bench("stage: mask+score+sort", stage_score, env, st, cand, kv)
+dst_s, val_s = bench("stage: dst spread", stage_spread, env, st, score, best_val, order)
+bench("stage: admission+apply", stage_admit_apply, env, st, cand, order, dst_s, val_s)

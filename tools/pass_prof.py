@@ -1,3 +1,13 @@
+"""Pass-level engine profile: per-branch warm seconds and per-pass action
+yield at a bench shape, across chain depths and knob settings.
+
+This is the measurement harness behind docs/PERF.md's pass-pipeline table:
+for each hot branch (move / leadership / swap) it reports the warm per-pass
+wall, the actions a single pass lands from the initial state, and the effect
+of the pass-pipeline knobs (chain cache, compacted keying, multi-wave).
+
+Usage: pass_prof.py [r3|r4] [chain_len=10]
+"""
 import os, sys, time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault('JAX_COMPILATION_CACHE_DIR', '/tmp/jax_cache_cc_tpu')
@@ -13,6 +23,7 @@ from cruise_control_tpu.analyzer import engine as E
 from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, _budget_scale
 
 shape = sys.argv[1] if len(sys.argv) > 1 else "r3"
+chain_len = int(sys.argv[2]) if len(sys.argv) > 2 else 10
 if shape == "r3":
     spec = RandomClusterSpec(num_brokers=1000, num_racks=20, num_topics=400,
                              num_partitions=50000, max_replication=3, skew=1.0,
@@ -24,39 +35,89 @@ else:
 ct, meta = generate_scale(spec)
 ct, meta = pad_cluster(ct, meta)
 opt = GoalOptimizer()
-params = opt._scaled_params(ct) if hasattr(opt, '_scaled_params') else None
-if params is None:
-    params = dataclasses.replace(
-        opt._params,
-        num_candidates=min(1760, max(64, ct.num_brokers // 4, ct.num_replicas // 64)),
-        num_leader_candidates=min(1024, max(32, ct.num_brokers // 8)),
-        num_swap_candidates=max(32, ct.num_brokers // 32),
-        num_dst_choices=min(128, max(16, ct.num_brokers // 100)),
-        tail_pass_budget=min(1024, 64 * _budget_scale(ct.num_replicas) ** 2),
-        stall_retries=min(32, 8 * _budget_scale(ct.num_replicas)))
-print("R", ct.num_replicas, "B", ct.num_brokers, "K", params.num_candidates,
-      "T", params.num_dst_choices, "tail", params.tail_pass_budget, flush=True)
+base = dataclasses.replace(
+    opt._params,
+    num_candidates=min(1760, max(64, ct.num_brokers // 4, ct.num_replicas // 64)),
+    num_leader_candidates=min(1024, max(32, ct.num_brokers // 8)),
+    num_swap_candidates=max(32, ct.num_brokers // 32),
+    num_dst_choices=min(128, max(16, ct.num_brokers // 100)),
+    tail_pass_budget=min(1024, 64 * _budget_scale(ct.num_replicas) ** 2),
+    stall_retries=min(32, 8 * _budget_scale(ct.num_replicas)))
+print(f"R {ct.num_replicas} B {ct.num_brokers} K {base.num_candidates} "
+      f"T {base.num_dst_choices}", flush=True)
 env = make_env(ct, meta, partition_table=padded_partition_table(ct))
 st = init_state(env, ct.replica_broker, ct.replica_is_leader,
                 ct.replica_offline, ct.replica_disk)
-goals = make_goals(["DiskUsageDistributionGoal"], BalancingConstraint(), OptimizationOptions())
-goal = goals[0]
-
+CHAIN = ["RackAwareGoal", "MinTopicLeadersPerBrokerGoal", "ReplicaCapacityGoal",
+         "DiskCapacityGoal", "NetworkInboundCapacityGoal",
+         "NetworkOutboundCapacityGoal", "CpuCapacityGoal",
+         "ReplicaDistributionGoal", "PotentialNwOutGoal",
+         "DiskUsageDistributionGoal", "NetworkInboundUsageDistributionGoal",
+         "NetworkOutboundUsageDistributionGoal", "CpuUsageDistributionGoal",
+         "LeaderReplicaDistributionGoal", "LeaderBytesInDistributionGoal",
+         "TopicReplicaDistributionGoal"]
+goals = make_goals(CHAIN[:chain_len + 1], BalancingConstraint(), OptimizationOptions())
+goal = goals[-1]
+prev = tuple(goals[:-1])
 zero = jnp.int32(0)
-@jax.jit
-def one_pass(env, st):
-    sev = goal.broker_severity(env, st)
-    return E._move_branch_batched(env, st, goal, (), params, sev, zero)
 
-@jax.jit
-def one_swap(env, st):
-    sev = goal.broker_severity(env, st)
-    return E._swap_branch_batched(env, st, goal, (), params, sev, zero)
+# knob grid: legacy (all off), each knob alone, all on
+GRID = [
+    ("legacy        ", dict(max_pass_waves=1, pass_waves=1,
+                            compact_keying=False, chain_cache=False)),
+    ("chain_cache   ", dict(max_pass_waves=1, pass_waves=1,
+                            compact_keying=False, chain_cache=True)),
+    ("compact_keying", dict(max_pass_waves=1, pass_waves=1,
+                            compact_keying=True, chain_cache=False)),
+    ("waves=4       ", dict(max_pass_waves=4, pass_waves=4,
+                            compact_keying=False, chain_cache=False)),
+    ("ALL ON        ", dict(max_pass_waves=4, pass_waves=4,
+                            compact_keying=True, chain_cache=True)),
+]
 
-for name, fn in (("move_pass", one_pass), ("swap_pass", one_swap)):
-    t0=time.monotonic(); r = fn(env, st); jax.block_until_ready(r[0].util); tc=time.monotonic()-t0
+
+def bench(name, fn, *args, n=20):
+    r = fn(*args)
+    jax.block_until_ready(jax.tree_util.tree_leaves(r)[0])
     t0 = time.monotonic()
-    for _ in range(20):
-        r = fn(env, st)
-    jax.block_until_ready(r[0].util)
-    print(f"{name}: compile+1={tc:.2f}s warm={(time.monotonic()-t0)/20*1e3:.1f}ms n={int(r[1])}", flush=True)
+    for _ in range(n):
+        r = fn(*args)
+    jax.block_until_ready(jax.tree_util.tree_leaves(r)[0])
+    ms = (time.monotonic() - t0) / n * 1e3
+    return ms, r
+
+
+print(f"\n== {goal.name} (chain depth {len(prev)}) per-branch warm pass ==")
+for label, kn in GRID:
+    params = dataclasses.replace(base, **kn)
+
+    @jax.jit
+    def move_pass(env, st, params=params):
+        sev = goal.broker_severity(env, st)
+        return E._move_branch_batched(env, st, goal, prev, params, sev, zero)
+
+    @jax.jit
+    def swap_pass(env, st, params=params):
+        sev = goal.broker_severity(env, st)
+        return E._swap_branch_batched(env, st, goal, prev, params, sev, zero)
+
+    ms_m, rm = bench("move", move_pass, env, st)
+    ms_s, rs = bench("swap", swap_pass, env, st)
+    n_m, w_m = int(rm[1]), int(rm[2])
+    print(f"{label} move={ms_m:7.1f}ms n={n_m:4d} waves={w_m} "
+          f"yield={n_m / max(ms_m, 1e-9):6.1f}/ms | "
+          f"swap={ms_s:6.1f}ms n={int(rs[1])}", flush=True)
+
+lead_goal = next((g for g in goals if g.uses_leadership_moves), None)
+if lead_goal is not None:
+    lprev = tuple(goals[:goals.index(lead_goal)])
+
+    @jax.jit
+    def lead_pass(env, st):
+        sev = lead_goal.broker_severity(env, st)
+        return E._leadership_branch_batched(env, st, lead_goal, lprev, base,
+                                            sev, zero)
+
+    ms_l, rl = bench("lead", lead_pass, env, st)
+    print(f"\n{lead_goal.name} leadership pass: {ms_l:.1f}ms "
+          f"n={int(rl[1])}")
