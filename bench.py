@@ -28,12 +28,16 @@ timeout is a bench that doesn't exist):
   SIGTERM first).
 
 Usage: bench.py [rung ...] [--profile] [--skip-cold] [--scenario [name]]
+               [--rung name]
   --profile    block per goal for honest per-goal seconds (adds tunnel
                round-trips; not for wall-clock claims)
   --skip-cold  one timed run per rung (trusts the persistent compile cache)
   --scenario   run the self-healing scenario rung (sim/ catalog name,
                default broker-death-50b-1k); emits a "scenario" block with
                time_to_detect_ms / time_to_heal_ms into the summary JSON
+  --rung NAME  run only the named rung(s) (repeatable; same ids as the
+               positional form: 1..5, e2e, e2e7k, scenario) — the same-day
+               A/B workflow's "rerun one rung without paying the ladder"
 
 Final line: {"metric": ..., "value": warm_wall_s_at_7k_1M, "unit": "s",
              "vs_baseline": 10.0 / value, "rungs": [...]}
@@ -178,6 +182,33 @@ class Summary:
 SUMMARY = Summary()
 
 
+def device_mem_figures(env=None, state=None) -> dict:
+    """Per-rung device-memory block: bytes of the uploaded ClusterEnv, bytes
+    of the resident EngineState, and — when the backend exposes allocator
+    stats (TPU/GPU; CPU usually doesn't) — the device's peak allocation.
+    The env/state byte counts are exact leaf sums, so BENCH JSONs can track
+    the compact-table and precision-policy diets rung by rung."""
+    import jax
+
+    def _bytes(tree):
+        return int(sum(x.nbytes for x in jax.tree_util.tree_leaves(tree)
+                       if hasattr(x, "nbytes")))
+
+    out = {}
+    if env is not None:
+        out["env_bytes"] = _bytes(env)
+    if state is not None:
+        out["state_bytes"] = _bytes(state)
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        for k in ("peak_bytes_in_use", "bytes_in_use"):
+            if k in stats:
+                out[k] = int(stats[k])
+    except Exception:   # noqa: BLE001 — stats are best-effort observability
+        pass
+    return out
+
+
 def _on_term(signum, frame):
     log(f"signal {signum}: emitting partial summary and exiting")
     SUMMARY.emit(final=False)
@@ -208,6 +239,7 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
     opt = GoalOptimizer(engine_params=params)
     walls = []
     res = None
+    warm_skip_reason = None
     for i in range(repeats):
         t0 = time.monotonic()
         # default: async-pipelined chain (one device round-trip); --profile
@@ -219,9 +251,16 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
         walls.append(time.monotonic() - t0)
         log(f"  [{name}] run {i}: {walls[-1]:.2f}s")
         # further repeats only refine the number — stop if the next one
-        # would push past the budget (what we have stands, conservatively)
+        # would push past the budget (what we have stands, conservatively).
+        # A skipped warm re-run RECORDS its reason: every unmeasured
+        # warm field must carry the budget-gate explanation (the
+        # warm_skip_reason convention; silent warm_measured=false was the
+        # BENCH_r05 e2e-7000b-500000p bug).
         if i < repeats - 1 and walls[-1] * 1.1 > remaining_budget():
-            log(f"  [{name}] skipping remaining repeats (budget)")
+            warm_skip_reason = (
+                f"wall budget: warm re-run (~{walls[-1]:.0f}s est) > "
+                f"{remaining_budget():.0f}s remaining")
+            log(f"  [{name}] {warm_skip_reason}")
             break
     warm_walls = walls if all_warm else (walls[1:] or walls)
     rung = {
@@ -229,6 +268,8 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
         "wall_s_cold": round(walls[0], 3),
         "wall_s": round(min(warm_walls), 3),
         "warm_measured": all_warm or len(walls) > 1,
+        # per-rung device-memory figures (engine memory diet observability)
+        "device_mem": device_mem_figures(res.env, res.final_state),
         "violations_before": len(res.violated_goals_before),
         "violations_after": len(res.violated_goals_after),
         "violated_goals_after": res.violated_goals_after,
@@ -248,6 +289,10 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
         "num_replica_movements": res.num_replica_movements,
         "num_leadership_movements": res.num_leadership_movements,
     }
+    if warm_skip_reason is not None:
+        rung["warm_skip_reason"] = warm_skip_reason
+    elif not rung["warm_measured"]:
+        rung["warm_skip_reason"] = "single run requested (repeats=1)"
     # pass-level profile (engine per-branch counters — free, no blocking):
     # passes, per-branch action split, admission waves and action yield per
     # goal, so BENCH JSONs can track pass-level regressions round to round
@@ -310,6 +355,15 @@ def main() -> None:
         else:
             argv = argv[:i] + argv[i + 1:]
         argv.append("scenario")
+    # --rung NAME (repeatable): explicit single-rung filter for same-day
+    # A/Bs; equivalent to the positional rung-id form
+    while "--rung" in argv:
+        i = argv.index("--rung")
+        if i + 1 >= len(argv) or argv[i + 1].startswith("--"):
+            log("--rung requires a rung id")
+            argv = argv[:i] + argv[i + 1:]
+            continue
+        argv = argv[:i] + argv[i + 2:] + [argv[i + 1]]
     flags = {a for a in argv if a.startswith("--")}
     args = [a for a in argv if not a.startswith("--")]
     profile = "--profile" in flags
@@ -589,11 +643,18 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
         "optimize_compiles": compiles,
         "violations_after": len(res.violated_goals_after),
         "num_replica_movements": res.num_replica_movements,
+        "device_mem": device_mem_figures(res.env, res.final_state),
     }
     if warm_skip_reason is not None:
         rung["warm_skip_reason"] = warm_skip_reason
+    elif not rung["warm_measured"]:
+        # every unmeasured warm field carries an explicit reason — incl.
+        # the largest (e2e-7000b-500000p) rung (the BENCH_r05 gap)
+        rung["warm_skip_reason"] = "single optimize run requested"
     if steady_walls:
         # full service round on the resident-session path (last = steadiest)
+        sess_mem = (device_mem_figures(sess.env, sess.state)
+                    if sess is not None else {})
         rung.update({
             "round_s_steady": round(steady, 3),
             "round_s_steady_runs": [round(w, 3) for w in steady_walls],
@@ -604,6 +665,10 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
             "steady_speedup_vs_cold": (round(cold_path / steady, 2)
                                        if steady > 0 else None),
             "num_replica_movements_steady": res2.num_replica_movements,
+            # resident-session device footprint + donation observability
+            "steady_device_mem": sess_mem,
+            "steady_donated_rounds": (sess.donated_rounds
+                                      if sess is not None else 0),
         })
         if steady_compiles[-1] > 0:
             log(f"  [e2e] WARNING: last steady round recompiled "

@@ -55,6 +55,52 @@ from cruise_control_tpu.analyzer.state import (
 Array = jax.Array
 NEG_INF = -jnp.inf
 
+# ---------------------------------------------------------------------------
+# Precision policy (engine memory diet)
+# ---------------------------------------------------------------------------
+# ACCOUNTING dtype: everything whose value feeds state updates, wave-budget
+# admission, violation measures, or fixpoint certificates. Pinned to float32
+# EXPLICITLY (not inherited from whatever dtype happens to flow in): the
+# policy's contract is that bf16 sweep scoring can never leak into the
+# quantities that define outcomes. `min_gain` granularity (1e-9) alone rules
+# bf16 out for accounting — one bf16 ulp near 1.0 is ~4e-3.
+ACCT_DTYPE = jnp.float32
+
+# Float leaves the SCORING sweeps read, cast to the compute dtype when the
+# policy asks for bf16. Wide [K, B]/[KL, F]/[K1, K2] score fusions and the
+# [R]-sized candidate keyings are HBM-bandwidth-bound on TPU — halving their
+# bytes halves per-pass traffic. The TRUE f32 env/state keeps flowing to
+# masks, chain-acceptance rooms, wave admission, applies, severity/violation
+# measures and the exhaustive certificate scans.
+_SWEEP_ENV_FIELDS = ("leader_load", "follower_load", "broker_capacity",
+                     "broker_disk_capacity")
+_SWEEP_STATE_FIELDS = ("util", "leader_util", "potential_nw_out", "disk_util")
+
+
+def _sweep_env(env: ClusterEnv, params: "EngineParams") -> ClusterEnv:
+    """Compute-dtype shadow of the env's float leaves for score sweeps.
+    Identity unless the policy resolved to bf16 ("auto" reaching the engine
+    unresolved — direct engine callers — means f32): the f32 pipeline is
+    BIT-IDENTICAL to pre-policy behavior. Built once per goal program (the
+    casts are loop-invariant, so XLA materializes them once, not per pass)."""
+    if params.compute_dtype != "bfloat16":
+        return env
+    dt = jnp.bfloat16
+    return dataclasses.replace(
+        env, **{f: getattr(env, f).astype(dt) for f in _SWEEP_ENV_FIELDS})
+
+
+def _sweep_state(st: EngineState, params: "EngineParams") -> EngineState:
+    """Per-pass compute-dtype shadow of the mutable [B]-level float leaves
+    (cheap: broker-axis sized). The assignment/count leaves pass through
+    untouched — goals cast counts via ``st.util.dtype``, so the shadow's
+    dtype steers the whole score fusion."""
+    if params.compute_dtype != "bfloat16":
+        return st
+    dt = jnp.bfloat16
+    return dataclasses.replace(
+        st, **{f: getattr(st, f).astype(dt) for f in _SWEEP_STATE_FIELDS})
+
 # debug bisect knob (CC_DEBUG_DISABLE=swap|swap_apply|swap_admit): carve
 # pieces out of the compiled program to localize device faults; unset in
 # normal operation
@@ -73,7 +119,13 @@ def _stall_explore(key: Array, stall: Array, salt: int = 0,
     ``salt`` decorrelates pools salted in the same pass (swap out vs in).
     ``idx`` supplies the ORIGINAL replica ids when ``key`` is a compacted
     eligible prefix (the hash must depend on the replica, not its compacted
-    position, for compacted and full sweeps to rank identically)."""
+    position, for compacted and full sweeps to rank identically).
+
+    The offline-priority detection threshold is 5e11, not 1e12: under the
+    bf16 compute policy the goals' ``key + 1e12`` bump rounds to ~9.96e11
+    (8 mantissa bits), and an exact >= 1e12 test would silently drop offline
+    replicas' retry priority. No normal key is within orders of magnitude of
+    5e11, so the f32 behavior is unchanged bit for bit."""
     if idx is None:
         idx = jnp.arange(key.shape[0], dtype=jnp.uint32)
     h = (idx.astype(jnp.uint32) * jnp.uint32(2246822519)
@@ -82,7 +134,7 @@ def _stall_explore(key: Array, stall: Array, salt: int = 0,
     h = (h ^ (h >> 15)) * jnp.uint32(2654435761)
     r01 = (h >> 8).astype(jnp.float32) / jnp.float32(1 << 24)
     salted = jnp.where(key > NEG_INF,
-                       r01 + jnp.where(key >= 1e12, 2.0, 0.0), NEG_INF)
+                       r01 + jnp.where(key >= 5e11, 2.0, 0.0), NEG_INF)
     return jnp.where(stall > 0, salted, key)
 
 
@@ -260,6 +312,32 @@ class EngineParams:
     # within every goal's own epsilon tolerance, and certified bit-identical
     # on the seeded parity fixtures. Knob off restores per-goal masks.
     chain_cache: bool = True
+    # ---- precision policy (PR 5) ----
+    # Compute dtype of the wide SCORE SWEEPS: the [K, B]/[KL, F]/[K1, K2]
+    # candidate scoring fusions and the [R]-sized candidate keyings — the
+    # engine's HBM-bandwidth wall on TPU. "bfloat16" halves their per-pass
+    # traffic. STRICTLY the BUDGETED loop's scoring/ranking: gain
+    # accounting, min_gain acceptance values' application, severity and
+    # violation measures, wave budgets/admission, state updates and the
+    # ENTIRE finisher (exhaustive certificate scans AND their applied
+    # waves — a bf16 re-score cannot see the tail gains the f32 scan
+    # finds, one ulp below utilization magnitude) stay in ACCT_DTYPE (f32)
+    # — so violation counts and certificate sets are outcome-identical on
+    # the parity fixtures (tests/test_dtype_policy.py), the same contract
+    # as pass_waves>1: marginal rank flips re-validate against live f32
+    # state at application, and the f32 finisher converges whatever the
+    # bf16 budgeted tail leaves on the table.
+    # STATIC field (documented recompile on change — the dtype is part of
+    # the compiled program, unlike the traced budget leaves); "float32" is
+    # bit-identical to the pre-policy pipeline. Default "auto": the
+    # OPTIMIZER resolves it from the analyzer.compute.dtype config key —
+    # currently to float32 everywhere (bf16 is opt-in; the planned
+    # >= 256k-replica auto-on is held back by the measured rung-4 quality
+    # gap, see the optimizer's resolution comment + docs/PERF.md round 7).
+    # An "auto" that reaches the engine unresolved (direct engine callers,
+    # tools) runs f32. Explicit "float32"/"bfloat16" — including via
+    # CC_ENGINE_OVERRIDES — pins the mode.
+    compute_dtype: str = "auto"
 
 
 # EngineParams is a JAX PYTREE: the pure BUDGET fields (loop caps, gain
@@ -278,9 +356,9 @@ _STATIC_FIELDS = tuple(f.name for f in dataclasses.fields(EngineParams)
                        if f.name not in _DYN_FIELDS)
 
 
-# declared field type per name ("int" / "float" / "bool" annotation strings
-# under `from __future__ import annotations`)
-_FIELD_TYPES = {f.name: {"float": float, "bool": bool}.get(f.type, int)
+# declared field type per name ("int" / "float" / "bool" / "str" annotation
+# strings under `from __future__ import annotations`)
+_FIELD_TYPES = {f.name: {"float": float, "bool": bool, "str": str}.get(f.type, int)
                 for f in dataclasses.fields(EngineParams)}
 
 
@@ -356,6 +434,11 @@ def _wave_admission(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     B = env.num_brokers
     K = posn.shape[0]
     nT = env.topic_excluded.shape[0]
+    # compact tables: the group-id arithmetic below (topic * B + broker)
+    # overflows int16 at real shapes — upcast the index columns once here
+    topics = topics.astype(jnp.int32)
+    src_b = src_b.astype(jnp.int32)
+    dst_b = dst_b.astype(jnp.int32)
     # per-(topic, broker) cumulative budgets — replaces the former blanket
     # (topic, broker) first-use rule, which capped waves at ONE move per
     # topic per broker and collapsed wave yield wherever one topic dominates
@@ -383,8 +466,10 @@ def _wave_admission(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
     d_src = jnp.where(wave_ok[:, None], d_src, 0.0)
     d_dst = jnp.where(wave_ok[:, None], d_dst, 0.0)
-    src_slack = jnp.full((B, WAVE_DIMS), jnp.inf, d_src.dtype)
-    dst_slack = jnp.full((B, WAVE_DIMS), jnp.inf, d_src.dtype)
+    # wave-slack fills in the ACCOUNTING dtype by policy (admission math is
+    # never allowed to inherit a sweep dtype)
+    src_slack = jnp.full((B, WAVE_DIMS), jnp.inf, ACCT_DTYPE)
+    dst_slack = jnp.full((B, WAVE_DIMS), jnp.inf, ACCT_DTYPE)
     for g in (goal, *prev_goals):
         bud = g.wave_budgets(env, st)
         if bud is not None:
@@ -507,12 +592,20 @@ def _move_delta_rows(env: ClusterEnv, st: EngineState, cand: Array) -> Array:
 
 def _move_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                prev_goals: tuple, params: EngineParams,
-               cand: Array, kv: Array):
+               cand: Array, kv: Array, env_sw: ClusterEnv | None = None):
     """ONE scored admission wave over ``cand`` (the former body of
     _move_branch_batched; see that docstring for the stage walkthrough).
     Re-scores its candidates against the LIVE state, fans destinations out
     across affinity classes, admits under the chain's cumulative budgets and
-    applies the winners in one batched scatter."""
+    applies the winners in one batched scatter.
+
+    ``env_sw`` is the precision policy's compute-dtype env shadow: when
+    given, the [K, B] score fusion (and only it) reads the shadow;
+    legitimacy, chain acceptance, delta rows, admission budgets and the
+    apply always read the TRUE f32 env/state. ``env_sw=None`` is EXACT mode
+    — the score fusion runs f32 regardless of the policy (the finisher's
+    waves use it: a bf16 re-score could not see the tail gains its own f32
+    scan just found, and certificate convergence would stall)."""
     K = cand.shape[0]
     B = env.num_brokers
     mask = legit_move_mask(env, st, cand, goal.options)
@@ -530,7 +623,10 @@ def _move_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                        if type(g).accept_move is not GoalKernel.accept_move)
     for g in custom:
         mask = mask & g.accept_move(env, st, cand)
-    score = goal.move_score(env, st, cand)
+    if env_sw is not None:
+        score = goal.move_score(env_sw, _sweep_state(st, params), cand)
+    else:
+        score = goal.move_score(env, st, cand)          # exact (f32) mode
     score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
 
     # ---- stage 2: independent-wave selection in score order ----
@@ -603,7 +699,8 @@ def _move_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                          prev_goals: tuple, params: EngineParams,
                          severity: Array, stall: Array,
-                         cand: Array | None = None, kv: Array | None = None):
+                         cand: Array | None = None, kv: Array | None = None,
+                         env_sw: ClusterEnv | None = None):
     """Key once, wave-apply up to ``pass_waves`` rank-banded admission waves.
 
     A pass is three stages:
@@ -643,24 +740,33 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     finisher passes the top TRUE-gain replicas from an exhaustive scan (and
     runs its own rank banding), reusing the single-wave stage unchanged.
 
+    ``env_sw=None`` = exact (f32) mode — see _move_wave.
     Returns (state, n_applied, waves_run)."""
     if cand is not None:
-        st, n = _move_wave(env, st, goal, prev_goals, params, cand, kv)
+        st, n = _move_wave(env, st, goal, prev_goals, params, cand, kv,
+                           env_sw)
         return st, n, jnp.int32(1)
     K = min(params.num_candidates, env.num_replicas)
     W = max(1, min(params.max_pass_waves, env.num_replicas // max(K, 1)))
-    key = goal.replica_key(env, st, severity)
+    # candidate keying runs in the compute dtype (an [R]-sized sweep); the
+    # severity argument stays the f32 measure — goals mix it in comparisons,
+    # never into applied values
+    if env_sw is not None:
+        key = goal.replica_key(env_sw, _sweep_state(st, params), severity)
+    else:
+        key = goal.replica_key(env, st, severity)
     kv_all, cand_all = _select_candidates(key, K * W, stall, goal.is_hard,
                                           params)
     if W == 1:
-        st, n = _move_wave(env, st, goal, prev_goals, params, cand_all, kv_all)
+        st, n = _move_wave(env, st, goal, prev_goals, params, cand_all,
+                           kv_all, env_sw)
         return st, n, jnp.int32(1)
 
     def wave_body(carry):
         s, w, total, _go = carry
         c = jax.lax.dynamic_slice(cand_all, (w * K,), (K,))
         v = jax.lax.dynamic_slice(kv_all, (w * K,), (K,))
-        s, n = _move_wave(env, s, goal, prev_goals, params, c, v)
+        s, n = _move_wave(env, s, goal, prev_goals, params, c, v, env_sw)
         return s, w + 1, total + n, n > 0
 
     def wave_cond(carry):
@@ -677,7 +783,8 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
                                prev_goals: tuple, params: EngineParams,
                                severity: Array, stall: Array,
                                cand: Array | None = None,
-                               kv: Array | None = None):
+                               kv: Array | None = None,
+                               env_sw: ClusterEnv | None = None):
     """Leadership analogue of _move_branch_batched: one [KL, F] scoring pass,
     then budgeted wave admission (each candidate is a distinct partition's
     leader, so rows never conflict on partition state; per-broker cumulative
@@ -685,9 +792,12 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
     combined band slack), one batched apply, sequential re-scored leftovers
     when the wave was thin. Falls back to fully sequential application for
     chains with non-budget-capable goals. ``cand``/``kv`` override candidate
-    selection (see _move_branch_batched)."""
+    selection (see _move_branch_batched). ``env_sw=None`` = exact (f32)
+    mode (see _move_wave)."""
+    env_sc = env_sw if env_sw is not None else env
+    st_sw = _sweep_state(st, params) if env_sw is not None else st
     if cand is None:
-        lkey = goal.leader_key(env, st, severity)
+        lkey = goal.leader_key(env_sc, st_sw, severity)
         lkv, lcand = _select_candidates(lkey,
                                         min(params.num_leader_candidates,
                                             env.num_replicas),
@@ -697,7 +807,9 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
     lmask = legit_leadership_mask(env, st, lcand)
     for g in prev_goals:
         lmask = lmask & g.accept_leadership(env, st, lcand)
-    lscore = goal.leadership_score(env, st, lcand)
+    # [KL, F] score fusion in the compute dtype; acceptance masks above and
+    # the sequential re-score fallback below stay on the true f32 state
+    lscore = goal.leadership_score(env_sc, st_sw, lcand)
     lscore = jnp.where(lmask & (lkv > NEG_INF)[:, None], lscore, NEG_INF)
     best_val = jnp.max(lscore, axis=1)
     order = jnp.argsort(-best_val)
@@ -753,8 +865,8 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
                           leadership_deltas(r_sorted), leadership_deltas(dst_rep),
                           src_b, dst_b, wave_ok,
                           env.replica_topic[r_sorted], posn,
-                          d_count=jnp.zeros(KL, st.util.dtype),
-                          d_leader=jnp.ones(KL, st.util.dtype))
+                          d_count=jnp.zeros(KL, ACCT_DTYPE),
+                          d_leader=jnp.ones(KL, ACCT_DTYPE))
     st = apply_leaderships_batched(env, st, r_sorted, dst_rep, win)
     n_applied = jnp.sum(win).astype(jnp.int32)
     return st, n_applied
@@ -762,7 +874,8 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
 
 def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                          prev_goals: tuple, params: EngineParams,
-                         severity: Array, stall: Array):
+                         severity: Array, stall: Array,
+                         env_sw: ClusterEnv | None = None):
     """Swap analogue of _move_branch_batched: one [K1, K2] scoring pass, then
     a WAVE of independent swaps applies in one batched update. Admission, in
     score order, pairs each out-candidate with its best counterparty and
@@ -783,15 +896,19 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     # alignment is not the trigger) — enforced HERE so every caller is safe,
     # not just GoalOptimizer
     k = min(params.num_swap_candidates, env.num_replicas, 128)
-    okey = goal.swap_out_key(env, st, severity)
-    ikey = goal.swap_in_key(env, st, severity)
+    env_sc = env_sw if env_sw is not None else env
+    st_sw = _sweep_state(st, params) if env_sw is not None else st
+    okey = goal.swap_out_key(env_sc, st_sw, severity)
+    ikey = goal.swap_in_key(env_sc, st_sw, severity)
     okv, cand_out = _select_candidates(okey, k, stall, goal.is_hard, params)
     ikv, cand_in = _select_candidates(ikey, k, stall, goal.is_hard, params,
                                       salt=101)   # decorrelate from okey
     mask = legit_swap_mask(env, st, cand_out, cand_in)
     for g in prev_goals:
         mask = mask & g.accept_swap(env, st, cand_out, cand_in)
-    score = goal.swap_score(env, st, cand_out, cand_in)
+    # [K1, K2] pair scoring in the compute dtype; acceptance + admission +
+    # the batched apply stay on the true f32 state
+    score = goal.swap_score(env_sc, st_sw, cand_out, cand_in)
     score = jnp.where(mask & (okv > NEG_INF)[:, None] & (ikv > NEG_INF)[None, :],
                       score, NEG_INF)
     K1, K2 = score.shape
@@ -847,17 +964,22 @@ def _rescore_disk_move_row(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
 def _disk_move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                               prev_goals: tuple, params: EngineParams,
-                              severity: Array, stall: Array):
+                              severity: Array, stall: Array,
+                              env_sw: ClusterEnv | None = None):
     """Intra-broker analogue of _move_branch_batched: destinations are the D
     logdirs of each candidate's own broker (IntraBrokerDiskUsageDistribution
-    Goal.java:518 hot loop role). [K, D] scoring, per-move [1, D] re-score."""
-    key = _stall_explore(goal.replica_key(env, st, severity), stall)
+    Goal.java:518 hot loop role). [K, D] scoring, per-move [1, D] re-score.
+    The [K, D] selection sweep runs in the compute dtype; the per-move
+    re-score (_rescore_disk_move_row) re-validates in f32."""
+    env_sc = env_sw if env_sw is not None else env
+    st_sw = _sweep_state(st, params) if env_sw is not None else st
+    key = _stall_explore(goal.replica_key(env_sc, st_sw, severity), stall)
     kv, cand = _top_candidates(key, min(params.num_candidates, env.num_replicas),
                                exact=goal.is_hard)
     mask = legit_disk_move_mask(env, st, cand)
     for g in prev_goals:
         mask = mask & g.accept_disk_move(env, st, cand)
-    score = goal.disk_move_score(env, st, cand)
+    score = goal.disk_move_score(env_sc, st_sw, cand)
     score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
     best_val = jnp.max(score, axis=1)
     order = jnp.argsort(-best_val)
@@ -945,7 +1067,7 @@ def _exhaustive_move_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         dst = dst.at[idx].set(d, mode="drop")
         return gain, dst
 
-    gain0 = jnp.full(R, NEG_INF, st.util.dtype)
+    gain0 = jnp.full(R, NEG_INF, ACCT_DTYPE)   # certificate counts: f32
     dst0 = jnp.zeros(R, jnp.int32)
     n_chunks = jnp.maximum(-(-n_eligible // chunk), 0)
     return jax.lax.fori_loop(0, n_chunks, body, (gain0, dst0))
@@ -981,7 +1103,7 @@ def _exhaustive_lead_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         dst = dst.at[idx].set(d, mode="drop")
         return gain, dst
 
-    gain0 = jnp.full(R, NEG_INF, st.util.dtype)
+    gain0 = jnp.full(R, NEG_INF, ACCT_DTYPE)   # certificate counts: f32
     dst0 = jnp.zeros(R, jnp.int32)
     n_chunks = jnp.maximum(-(-n_eligible // chunk), 0)
     return jax.lax.fori_loop(0, n_chunks, body, (gain0, dst0))
@@ -1041,6 +1163,12 @@ def _finisher_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         cand = jax.lax.dynamic_slice(cand_all, (w * K,), (K,))
         kv = jax.lax.dynamic_slice(kv_all, (w * K,), (K,))
         kv = jnp.where(kv > params.min_gain, kv, NEG_INF)
+        # exact (f32) re-scoring: under the bf16 policy a compute-dtype
+        # re-score could not SEE the tail gains the f32 scan just found
+        # (they round to zero one bf16 ulp below utilization magnitude) and
+        # the certificate loop would stall unproven — the finisher is the
+        # machinery that pins bf16 outcomes to the f32 pipeline's, so every
+        # stage of it runs in ACCT_DTYPE
         if leadership:
             s, n = _leadership_branch_batched(
                 env, s, goal, prev_goals, params, severity, zero_stall,
@@ -1067,7 +1195,10 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     positive-gain actions: wave-apply the top true-gain moves, then
     transfers. Exits when a round's scans BOTH return zero (nothing was
     applied that round either, so the certificate holds at the exit state)
-    or at finisher_rounds. Returns
+    or at finisher_rounds. The exhaustive scans and the certificate counts
+    run in ACCT_DTYPE (f32) regardless of the compute policy — the fixpoint
+    certificate is an f32 statement; only the applied waves' [K, B]
+    re-scoring rides the compute dtype. Returns
     (st, proven, moves_left, leads_left, swaps_window_left, rounds,
     n_applied)."""
     use_moves = goal.uses_replica_moves
@@ -1213,6 +1344,10 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     watchdog at the 1M rung. Deep-tail goals run as their own per-goal
     programs with the finisher inline at their chain position."""
     stat_before = goal.stat(env, st)
+    # precision policy: the env's float leaves are cast to the compute dtype
+    # ONCE per program (loop-invariant — XLA hoists the casts out of the
+    # while_loop); identity under the default f32 policy
+    env_sw = _sweep_env(env, params)
 
     def step(carry):
         (st, it, n_applied, stall, dribble, _sat, win_stat, win_dribble,
@@ -1239,7 +1374,8 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         if goal.uses_disk_moves:
             st, n_disk = _disk_move_branch_batched(env, st, goal,
                                                    prev_goals, params,
-                                                   severity, explore)
+                                                   severity, explore,
+                                                   env_sw=env_sw)
 
         lead_first = goal.uses_leadership_moves and goal.leadership_primary
 
@@ -1250,7 +1386,8 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         n_leads = jnp.int32(0)
         if lead_first:
             st, n_leads = _leadership_branch_batched(
-                env, st, goal, prev_goals, params, severity, explore)
+                env, st, goal, prev_goals, params, severity, explore,
+                env_sw=env_sw)
 
         # 1b. replica moves (cheapest per unit of work on TPU: one scoring
         #     pass lands up to K moves); for leadership-primary goals they
@@ -1268,13 +1405,15 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                 def move_body(_i, carry):
                     s, _n, _w = carry
                     return _move_branch_batched(
-                        env, s, goal, prev_goals, params, severity, explore)
+                        env, s, goal, prev_goals, params, severity, explore,
+                        env_sw=env_sw)
                 st, n_moves, n_waves = jax.lax.fori_loop(
                     0, jnp.where(n_leads == 0, 1, 0), move_body,
                     (st, jnp.int32(0), jnp.int32(0)))
             else:
                 st, n_moves, n_waves = _move_branch_batched(
-                    env, st, goal, prev_goals, params, severity, explore)
+                    env, st, goal, prev_goals, params, severity, explore,
+                    env_sw=env_sw)
 
         # 2. leadership transfers — only when no move landed; same
         #    zero/one trip-count gating (and the same severity-reuse
@@ -1283,7 +1422,8 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
             def lead_body(_i, carry):
                 s, _n = carry
                 return _leadership_branch_batched(
-                    env, s, goal, prev_goals, params, severity, explore)
+                    env, s, goal, prev_goals, params, severity, explore,
+                    env_sw=env_sw)
             st, n_leads = jax.lax.fori_loop(
                 0, jnp.where(n_moves == 0, 1, 0), lead_body,
                 (st, jnp.int32(0)))
@@ -1295,7 +1435,8 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
             def swap_body(_i, carry):
                 s, _n = carry
                 return _swap_branch_batched(env, s, goal, prev_goals,
-                                            params, severity, explore)
+                                            params, severity, explore,
+                                            env_sw=env_sw)
             st, n_swaps = jax.lax.fori_loop(
                 0, jnp.where((n_moves + n_leads) == 0, 1, 0), swap_body,
                 (st, jnp.int32(0)))
@@ -1349,7 +1490,11 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
      plateau, tailp, b_moves, b_leads, b_swaps, b_disk,
      b_waves) = jax.lax.while_loop(
         cond_fn, step, (st, jnp.int32(0), jnp.int32(0), jnp.int32(0),
-                        jnp.int32(0), jnp.bool_(False), jnp.float32(jnp.inf),
+                        jnp.int32(0), jnp.bool_(False),
+                        # stat-window carry in the ACCOUNTING dtype by policy
+                        # (goal.stat is an f32 measure; the plateau exit must
+                        # never inherit a sweep dtype)
+                        jnp.asarray(jnp.inf, ACCT_DTYPE),
                         jnp.int32(0), jnp.bool_(False), jnp.int32(0),
                         jnp.int32(0), jnp.int32(0), jnp.int32(0),
                         jnp.int32(0), jnp.int32(0)))

@@ -180,10 +180,28 @@ def padded_partition_table(ct: ClusterTensor) -> np.ndarray:
     return table
 
 
+@jax.jit
+def _expand_env(env: ClusterEnv, valid_packed) -> ClusterEnv:
+    """Close a packed env upload on device: unpack the bit-packed validity
+    mask and derive the mutable-input-dependent leaves (topic-exclusion hoist,
+    destination candidacy) — the same derivations session._sync_finalize
+    re-runs every round, so the two paths can never diverge."""
+    R = env.replica_partition.shape[0]
+    valid = jnp.unpackbits(valid_packed)[:R].astype(bool)
+    return dataclasses.replace(
+        env,
+        replica_valid=valid,
+        replica_topic_excluded=env.topic_excluded[env.replica_topic],
+        dst_candidate=env.broker_alive & ~env.broker_excluded_for_replica_move)
+
+
 def make_env(ct: ClusterTensor, meta: ClusterMeta,
              topic_min_leaders_mask: np.ndarray | None = None,
-             partition_table: np.ndarray | None = None) -> ClusterEnv:
-    from cruise_control_tpu.model.cluster_tensor import bucket_size
+             partition_table: np.ndarray | None = None,
+             compact: bool = True) -> ClusterEnv:
+    from cruise_control_tpu.model.cluster_tensor import (
+        broker_index_dtype, bucket_size, rack_index_dtype, topic_index_dtype,
+    )
     table = (padded_partition_table(ct) if partition_table is None
              else partition_table)
     # the rack-axis size is bucketed like the RF width; the SEMANTIC rack
@@ -191,7 +209,17 @@ def make_env(ct: ClusterTensor, meta: ClusterMeta,
     T = ct.num_topics
     tml = (np.zeros(T, bool) if topic_min_leaders_mask is None
            else np.asarray(topic_min_leaders_mask, bool))
-    dst_ok = np.asarray(ct.broker_alive) & ~np.asarray(ct.broker_excluded_for_replica_move)
+    # COMPACT TABLES (engine memory diet): broker/rack/topic index columns are
+    # stored narrow whenever the axis fits — index values are exact in any
+    # integer dtype and every overflow-capable arithmetic site upcasts, so
+    # this only changes upload + gather bytes, never results. The cast runs
+    # on HOST so the device upload itself is the compact representation.
+    b_dt = broker_index_dtype(ct.num_brokers, compact)
+    t_dt = topic_index_dtype(T, compact)
+    k_dt = rack_index_dtype(meta.num_racks, compact)
+    # bit-packed eligibility upload: the [R] validity mask travels as uint8
+    # bits (R/8 bytes instead of R) and is unpacked once on device
+    valid_packed = np.packbits(np.asarray(ct.replica_valid, bool))
     # new-broker mode is enforced per-replica in legit_move_mask/legit_swap_
     # mask (destinations limited to new brokers or the replica's own
     # original broker — GoalUtils.eligibleBrokers:163), not via this
@@ -201,14 +229,17 @@ def make_env(ct: ClusterTensor, meta: ClusterMeta,
     # execution — over a tunneled TPU that re-upload (~45 MB at the 1M rung)
     # was measured at 60-600 ms per program launch, dominating the segmented
     # chain and the small-cluster per-pass cost. The resulting on-device
-    # (uncommitted — no explicit device is passed) buffers make each
-    # subsequent launch pass handles only; nothing here relies on placement
-    # commitment, only on avoiding the per-launch host->device re-upload.
-    return jax.device_put(ClusterEnv(
+    # buffers make each subsequent launch pass handles only; nothing here
+    # relies on placement commitment, only on avoiding the per-launch
+    # host->device re-upload. replica_valid / replica_topic_excluded /
+    # dst_candidate are placeholders here — _expand_env derives them on
+    # device from the packed/base columns (they never ride the upload).
+    R = int(np.asarray(ct.replica_partition).shape[0])
+    env = jax.device_put(ClusterEnv(
         leader_load=ct.leader_load,
         follower_load=ct.follower_load,
         broker_capacity=ct.broker_capacity,
-        broker_rack=ct.broker_rack,
+        broker_rack=np.asarray(ct.broker_rack).astype(k_dt),
         broker_alive=ct.broker_alive,
         broker_new=ct.broker_new,
         broker_demoted=ct.broker_demoted,
@@ -217,19 +248,22 @@ def make_env(ct: ClusterTensor, meta: ClusterMeta,
         broker_disk_capacity=ct.broker_disk_capacity,
         broker_disk_alive=ct.broker_disk_alive,
         replica_partition=ct.replica_partition,
-        replica_topic=ct.replica_topic,
-        replica_topic_excluded=ct.topic_excluded[ct.replica_topic],
-        replica_valid=ct.replica_valid,
-        replica_original_broker=ct.replica_original_broker,
+        replica_topic=np.asarray(ct.replica_topic).astype(t_dt),
+        replica_topic_excluded=np.zeros(R, bool),
+        replica_valid=np.zeros(R, bool),
+        replica_original_broker=np.asarray(
+            ct.replica_original_broker).astype(b_dt),
         partition_replicas=jnp.asarray(table),
-        partition_topic=ct.partition_topic,
+        partition_topic=np.asarray(ct.partition_topic).astype(t_dt),
         topic_excluded=ct.topic_excluded,
         topic_min_leaders=jnp.asarray(tml),
-        dst_candidate=jnp.asarray(dst_ok),
+        dst_candidate=np.zeros(int(np.asarray(ct.broker_alive).shape[0]),
+                               bool),
         num_real_racks=jnp.asarray(meta.num_racks, jnp.int32),
         num_racks=bucket_size(meta.num_racks, 8),
         max_rf=int(table.shape[1]),
     ))
+    return _expand_env(env, jax.device_put(valid_packed))
 
 
 # ---------------------------------------------------------------------------

@@ -254,24 +254,34 @@ def broker_lookup(rb: Array, *cols: Array) -> Array:
     packed [R,4]<-[B,4] row gather is ~2 ms — the seven broker-value gathers
     inside one scoring pass were ~75% of the whole pass. Every kernel that
     needs several broker-level values at replica granularity must fetch them
-    through one packed table, padded to >= 4 columns for the fast path."""
+    through one packed table, padded to >= 4 columns for the fast path.
+
+    The packed table follows the COLUMNS' float dtype (precision policy):
+    under the bf16 compute policy the goals' broker columns arrive bf16 and
+    the [R]<-[B, 4] gather moves half the bytes; int columns alone fall back
+    to float32, so f32 callers are bit-identical to the pre-policy table."""
     k = len(cols)
     cols = list(cols) + [cols[0]] * max(0, 4 - k)
-    table = jnp.stack([c.astype(jnp.float32) for c in cols], axis=1)
+    dt = jnp.result_type(*cols)
+    if not jnp.issubdtype(dt, jnp.floating):
+        dt = jnp.float32
+    table = jnp.stack([c.astype(dt) for c in cols], axis=1)
     return table[rb][:, :k]
 
 
-def spread_jitter(num_replicas: int) -> Array:
-    """f32[R] deterministic per-replica multiplier in [0.5, 1.0) used to mix
+def spread_jitter(num_replicas: int, dtype=jnp.float32) -> Array:
+    """[R] deterministic per-replica multiplier in [0.5, 1.0) used to mix
     candidate keys ACROSS brokers. Count-goal keys of the form
     ``1 - load/broker_total`` are ~1.0 for EVERY light replica of a broker
     with many of them, so one such broker would monopolize the top-k pool
     and starve other violating brokers (pass-count explosion). Scaling each
     key by a hash-derived factor gives every broker top-k representation
     roughly proportional to its candidate count while still preferring
-    lighter replicas. Pure elementwise — no gathers."""
+    lighter replicas. Pure elementwise — no gathers. ``dtype`` follows the
+    caller's compute dtype so a bf16 key sweep stays bf16 end to end."""
     h = (jnp.arange(num_replicas, dtype=jnp.uint32) * jnp.uint32(2654435761))
-    return 0.5 + (h >> 9).astype(jnp.float32) / jnp.float32(1 << 24)
+    return (0.5 + (h >> 9).astype(jnp.float32) / jnp.float32(1 << 24)) \
+        .astype(dtype)
 
 
 def candidate_load(env: ClusterEnv, st: EngineState, cand: Array) -> Array:

@@ -193,7 +193,7 @@ class ReplicaCapacityGoal(GoalKernel):
 
     def broker_severity(self, env: ClusterEnv, st: EngineState):
         limit = jnp.where(env.broker_alive, self._max(), 0)
-        return (st.replica_count - limit).astype(jnp.float32)
+        return (st.replica_count - limit).astype(st.util.dtype)
 
     def replica_key(self, env: ClusterEnv, st: EngineState, severity):
         on_bad = severity[st.replica_broker] > 0
@@ -205,7 +205,7 @@ class ReplicaCapacityGoal(GoalKernel):
 
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
         feasible = (st.replica_count[None, :] + 1) <= self._max()
-        headroom = jnp.maximum(self._max() - st.replica_count, 0)[None, :].astype(jnp.float32)
+        headroom = jnp.maximum(self._max() - st.replica_count, 0)[None, :].astype(st.util.dtype)
         score = 1.0 + 0.001 * headroom / max(self._max(), 1)
         return jnp.where(feasible, score, NEG_INF)
 
@@ -216,12 +216,12 @@ class ReplicaCapacityGoal(GoalKernel):
     def accept_move_rooms(self, env: ClusterEnv, st: EngineState):
         """Interval form: a move's count delta (1) must fit the destination's
         remaining replica-count headroom (counts are f32-exact)."""
-        c = st.replica_count.astype(jnp.float32)
+        c = st.replica_count.astype(st.util.dtype)
         return {WAVE_COUNT: (None, float(self._max()) - c)}
 
     def wave_budgets(self, env: ClusterEnv, st: EngineState):
         """Destination replica-count headroom to the per-broker cap."""
-        c = st.replica_count.astype(jnp.float32)
+        c = st.replica_count.astype(st.util.dtype)
         B = env.num_brokers
         src = jnp.full((B, WAVE_DIMS), jnp.inf, c.dtype)
         dst = jnp.full((B, WAVE_DIMS), jnp.inf, c.dtype)
@@ -229,7 +229,7 @@ class ReplicaCapacityGoal(GoalKernel):
         return src, dst
 
     def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
-        c = st.replica_count.astype(jnp.float32)
+        c = st.replica_count.astype(st.util.dtype)
         excess = jnp.maximum(c - float(self._max()), 0.0)
         return excess, jnp.zeros_like(excess), WAVE_COUNT
 

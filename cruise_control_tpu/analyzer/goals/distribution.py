@@ -328,7 +328,7 @@ class ReplicaDistributionGoal(GoalKernel):
         # land on alive ones (ReplicaDistributionAbstractGoal._avgReplicasOnAliveBroker)
         total = jnp.sum(st.replica_count)
         lower, upper = _count_limits(
-            total.astype(jnp.float32), n_alive.astype(jnp.float32),
+            total.astype(st.util.dtype), n_alive.astype(st.util.dtype),
             self.constraint.replica_balance_percentage,
             self.options.triggered_by_goal_violation,
             self.constraint.goal_violation_distribution_threshold_multiplier)
@@ -338,12 +338,12 @@ class ReplicaDistributionGoal(GoalKernel):
 
     def broker_severity(self, env: ClusterEnv, st: EngineState):
         lower, upper = self._limits(env, st)
-        c = st.replica_count.astype(jnp.float32)
+        c = st.replica_count.astype(st.util.dtype)
         return jnp.maximum(c - upper, lower - c)
 
     def replica_key(self, env: ClusterEnv, st: EngineState, severity):
         lower, upper = self._limits(env, st)
-        c = st.replica_count.astype(jnp.float32)
+        c = st.replica_count.astype(st.util.dtype)
         per = broker_lookup(st.replica_broker, c - upper, c - 1.0 - lower,
                             jnp.sum(st.util, axis=1))
         over = per[:, 0] > 0
@@ -356,13 +356,13 @@ class ReplicaDistributionGoal(GoalKernel):
         # jitter keeps one many-light-replica broker from monopolizing the
         # top-k pool (see spread_jitter)
         tiebreak = ((1.0 - load / jnp.maximum(per[:, 2], 1e-9))
-                    * spread_jitter(env.num_replicas))
+                    * spread_jitter(env.num_replicas, st.util.dtype))
         key = jnp.where(movable | offline, tiebreak, NEG_INF)
         return jnp.where(offline, key + 1e12, key)
 
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
         lower, upper = self._limits(env, st)
-        c = st.replica_count.astype(jnp.float32)
+        c = st.replica_count.astype(st.util.dtype)
         src = st.replica_broker[cand]
         gain, feasible = _gain(c[src][:, None], c[None, :], 1.0,
                                lower[src][:, None], upper[src][:, None],
@@ -374,7 +374,7 @@ class ReplicaDistributionGoal(GoalKernel):
 
     def accept_move(self, env: ClusterEnv, st: EngineState, cand):
         lower, upper = self._limits(env, st)
-        c = st.replica_count.astype(jnp.float32)
+        c = st.replica_count.astype(st.util.dtype)
         src = st.replica_broker[cand]
         dst_ok = c[None, :] + 1 <= upper[None, :]
         src_ok = ((c[src] - 1 >= lower[src]) | (c[src] > upper[src]))[:, None]
@@ -385,7 +385,7 @@ class ReplicaDistributionGoal(GoalKernel):
         delta is exactly 1; counts are f32-exact, so this is bitwise the
         mask's band check)."""
         lower, upper = self._limits(env, st)
-        c = st.replica_count.astype(jnp.float32)
+        c = st.replica_count.astype(st.util.dtype)
         src = jnp.where(c > upper, jnp.inf, c - lower)
         return {WAVE_COUNT: (src, upper - c)}
 
@@ -393,7 +393,7 @@ class ReplicaDistributionGoal(GoalKernel):
         """Replica-count band slack (cumulative form of accept_move: shedding
         stepwise from excess may continue down to lower)."""
         lower, upper = self._limits(env, st)
-        c = st.replica_count.astype(jnp.float32)
+        c = st.replica_count.astype(st.util.dtype)
         B = env.num_brokers
         src = jnp.full((B, WAVE_DIMS), jnp.inf, c.dtype)
         dst = jnp.full((B, WAVE_DIMS), jnp.inf, c.dtype)
@@ -403,7 +403,7 @@ class ReplicaDistributionGoal(GoalKernel):
 
     def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
         lower, upper = self._limits(env, st)
-        c = st.replica_count.astype(jnp.float32)
+        c = st.replica_count.astype(st.util.dtype)
         return (jnp.maximum(c - upper, 0.0), jnp.maximum(lower - c, 0.0),
                 WAVE_COUNT)
 
@@ -428,7 +428,7 @@ class LeaderReplicaDistributionGoal(GoalKernel):
         n_alive = jnp.sum(env.broker_alive)
         total = jnp.sum(st.leader_count)
         lower, upper = _count_limits(
-            total.astype(jnp.float32), n_alive.astype(jnp.float32),
+            total.astype(st.util.dtype), n_alive.astype(st.util.dtype),
             self.constraint.leader_replica_balance_percentage,
             self.options.triggered_by_goal_violation,
             self.constraint.goal_violation_distribution_threshold_multiplier)
@@ -438,13 +438,13 @@ class LeaderReplicaDistributionGoal(GoalKernel):
 
     def broker_severity(self, env: ClusterEnv, st: EngineState):
         lower, upper = self._limits(env, st)
-        c = st.leader_count.astype(jnp.float32)
+        c = st.leader_count.astype(st.util.dtype)
         return jnp.maximum(c - upper, lower - c)
 
     # replica moves: only leaders help
     def replica_key(self, env: ClusterEnv, st: EngineState, severity):
         lower, upper = self._limits(env, st)
-        c = st.leader_count.astype(jnp.float32)
+        c = st.leader_count.astype(st.util.dtype)
         over = broker_lookup(st.replica_broker, c - upper)[:, 0] > 0
         load = jnp.sum(st.effective_load(env), axis=1)
         movable = env.replica_valid & st.replica_is_leader & over & ~st.replica_offline
@@ -452,7 +452,7 @@ class LeaderReplicaDistributionGoal(GoalKernel):
 
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
         lower, upper = self._limits(env, st)
-        c = st.leader_count.astype(jnp.float32)
+        c = st.leader_count.astype(st.util.dtype)
         src = st.replica_broker[cand]
         gain, feasible = _gain(c[src][:, None], c[None, :], 1.0,
                                lower[src][:, None], upper[src][:, None],
@@ -462,7 +462,7 @@ class LeaderReplicaDistributionGoal(GoalKernel):
 
     def accept_move(self, env: ClusterEnv, st: EngineState, cand):
         lower, upper = self._limits(env, st)
-        c = st.leader_count.astype(jnp.float32)
+        c = st.leader_count.astype(st.util.dtype)
         src = st.replica_broker[cand]
         is_leader = st.replica_is_leader[cand]
         dst_ok = c[None, :] + 1 <= upper[None, :]
@@ -476,7 +476,7 @@ class LeaderReplicaDistributionGoal(GoalKernel):
         zero delta and the leader-count dim is zero-exempt
         (WAVE_ZERO_EXEMPT_DIMS), reproducing the mask's conditional."""
         lower, upper = self._limits(env, st)
-        c = st.leader_count.astype(jnp.float32)
+        c = st.leader_count.astype(st.util.dtype)
         src = jnp.where(c > upper, jnp.inf, c - lower)
         return {WAVE_LEADER_COUNT: (src, upper - c)}
 
@@ -484,7 +484,7 @@ class LeaderReplicaDistributionGoal(GoalKernel):
         """Leader-count band slack; follower moves carry a zero leader-count
         delta, so the conditionality of accept_move is preserved exactly."""
         lower, upper = self._limits(env, st)
-        c = st.leader_count.astype(jnp.float32)
+        c = st.leader_count.astype(st.util.dtype)
         B = env.num_brokers
         src = jnp.full((B, WAVE_DIMS), jnp.inf, c.dtype)
         dst = jnp.full((B, WAVE_DIMS), jnp.inf, c.dtype)
@@ -494,13 +494,13 @@ class LeaderReplicaDistributionGoal(GoalKernel):
 
     def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
         lower, upper = self._limits(env, st)
-        c = st.leader_count.astype(jnp.float32)
+        c = st.leader_count.astype(st.util.dtype)
         return (jnp.maximum(c - upper, 0.0), jnp.maximum(lower - c, 0.0),
                 WAVE_LEADER_COUNT)
 
     def leader_key(self, env: ClusterEnv, st: EngineState, severity):
         lower, upper = self._limits(env, st)
-        c = st.leader_count.astype(jnp.float32)
+        c = st.leader_count.astype(st.util.dtype)
         per = broker_lookup(st.replica_broker, c - upper,
                             st.leader_util[:, 2])
         over = per[:, 0] > 0
@@ -509,7 +509,7 @@ class LeaderReplicaDistributionGoal(GoalKernel):
         # light partitions first; hash jitter prevents one leader-heavy
         # broker from monopolizing the pool (see spread_jitter)
         tiebreak = ((1.0 - nw / jnp.maximum(per[:, 1], 1e-9))
-                    * spread_jitter(env.num_replicas))
+                    * spread_jitter(env.num_replicas, st.util.dtype))
         return jnp.where(ok, tiebreak, NEG_INF)
 
     def leadership_score(self, env: ClusterEnv, st: EngineState, cand):
@@ -517,7 +517,7 @@ class LeaderReplicaDistributionGoal(GoalKernel):
         m = jnp.clip(members, 0)
         dst_broker = st.replica_broker[m]
         lower, upper = self._limits(env, st)
-        c = st.leader_count.astype(jnp.float32)
+        c = st.leader_count.astype(st.util.dtype)
         src = st.replica_broker[cand]
         gain, feasible = _gain(c[src][:, None], c[dst_broker], 1.0,
                                lower[src][:, None], upper[src][:, None],
@@ -529,7 +529,7 @@ class LeaderReplicaDistributionGoal(GoalKernel):
         m = jnp.clip(members, 0)
         dst_broker = st.replica_broker[m]
         lower, upper = self._limits(env, st)
-        c = st.leader_count.astype(jnp.float32)
+        c = st.leader_count.astype(st.util.dtype)
         src = st.replica_broker[cand]
         dst_ok = c[dst_broker] + 1 <= upper[dst_broker]
         src_ok = ((c[src] - 1 >= lower[src]) | (c[src] > upper[src]))[:, None]

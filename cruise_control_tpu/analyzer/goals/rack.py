@@ -38,7 +38,7 @@ class RackAwareGoal(GoalKernel):
         """Severity = count of rack-violating (or offline) replicas per broker."""
         viol = (_replica_corack_count(env, st) > 0) & env.replica_valid
         viol = viol | (st.replica_offline & env.replica_valid)
-        return jax.ops.segment_sum(viol.astype(jnp.float32), st.replica_broker,
+        return jax.ops.segment_sum(viol.astype(st.util.dtype), st.replica_broker,
                                    num_segments=env.num_brokers)
 
     def replica_key(self, env: ClusterEnv, st: EngineState, severity):
@@ -107,7 +107,7 @@ class RackAwareDistributionGoal(GoalKernel):
         count = st.part_rack_count[env.replica_partition, rack]
         viol = (count > limit[env.replica_partition]) & env.replica_valid
         viol = viol | (st.replica_offline & env.replica_valid)
-        return jax.ops.segment_sum(viol.astype(jnp.float32), st.replica_broker,
+        return jax.ops.segment_sum(viol.astype(st.util.dtype), st.replica_broker,
                                    num_segments=env.num_brokers)
 
     def replica_key(self, env: ClusterEnv, st: EngineState, severity):

@@ -30,8 +30,12 @@ class TopicReplicaDistributionGoal(GoalKernel):
 
     def _limits(self, env: ClusterEnv, st: EngineState):
         """(lower[T], upper[T]) per-topic per-broker count limits."""
-        n_alive = jnp.maximum(jnp.sum(env.broker_alive), 1).astype(jnp.float32)
-        topic_total = jnp.sum(st.topic_broker_count, axis=1).astype(jnp.float32)  # [T]
+        n_alive = jnp.maximum(jnp.sum(env.broker_alive), 1).astype(st.util.dtype)
+        # compact tables: sum the int16 counts in int32 (a topic CAN hold
+        # >32k replicas cluster-wide even though no single (topic, broker)
+        # cell does), then cast to the compute dtype
+        topic_total = jnp.sum(st.topic_broker_count.astype(jnp.int32),
+                              axis=1).astype(st.util.dtype)  # [T]
         avg = topic_total / n_alive
         pct = self.constraint.topic_replica_balance_percentage
         if self.options.triggered_by_goal_violation:
@@ -52,16 +56,16 @@ class TopicReplicaDistributionGoal(GoalKernel):
 
     def broker_severity(self, env: ClusterEnv, st: EngineState):
         lower, upper = self._limits(env, st)                        # [T]
-        c = st.topic_broker_count.astype(jnp.float32)               # [T, B]
+        c = st.topic_broker_count.astype(st.util.dtype)               # [T, B]
         over = jnp.maximum(c - upper[:, None], 0.0)
         under = jnp.maximum(lower[:, None] - c, 0.0)
         sev = jnp.sum(over + under, axis=0)                         # [B]
         return jnp.where(env.broker_alive, sev,
-                         jnp.maximum(sev, st.replica_count.astype(jnp.float32)))
+                         jnp.maximum(sev, st.replica_count.astype(st.util.dtype)))
 
     def replica_key(self, env: ClusterEnv, st: EngineState, severity):
         lower, upper = self._limits(env, st)
-        c = st.topic_broker_count.astype(jnp.float32)
+        c = st.topic_broker_count.astype(st.util.dtype)
         t = env.replica_topic
         b = st.replica_broker
         over = c[t, b] > upper[t]
@@ -93,8 +97,8 @@ class TopicReplicaDistributionGoal(GoalKernel):
     def move_score(self, env: ClusterEnv, st: EngineState, cand):
         t = env.replica_topic[cand]
         src = st.replica_broker[cand]
-        rows = st.topic_broker_count[t].astype(jnp.float32)         # [K, B]
-        n_alive = jnp.maximum(jnp.sum(env.broker_alive), 1).astype(jnp.float32)
+        rows = st.topic_broker_count[t].astype(st.util.dtype)         # [K, B]
+        n_alive = jnp.maximum(jnp.sum(env.broker_alive), 1).astype(st.util.dtype)
         # topic totals are invariant under moves -> row sums are exact
         lower, upper = self._limits_from_avg(jnp.sum(rows, axis=1) / n_alive)
         K = cand.shape[0]
@@ -116,8 +120,8 @@ class TopicReplicaDistributionGoal(GoalKernel):
     def accept_move(self, env: ClusterEnv, st: EngineState, cand):
         t = env.replica_topic[cand]
         src = st.replica_broker[cand]
-        rows = st.topic_broker_count[t].astype(jnp.float32)         # [K, B]
-        n_alive = jnp.maximum(jnp.sum(env.broker_alive), 1).astype(jnp.float32)
+        rows = st.topic_broker_count[t].astype(st.util.dtype)         # [K, B]
+        n_alive = jnp.maximum(jnp.sum(env.broker_alive), 1).astype(st.util.dtype)
         lower, upper = self._limits_from_avg(jnp.sum(rows, axis=1) / n_alive)
         K = cand.shape[0]
         dst_ok = rows + 1.0 <= upper[:, None]
@@ -132,7 +136,7 @@ class TopicReplicaDistributionGoal(GoalKernel):
         t = env.replica_topic
         b = st.replica_broker
         lower, upper = self._limits(env, st)
-        over = st.topic_broker_count[t, b].astype(jnp.float32) > upper[t]
+        over = st.topic_broker_count[t, b].astype(st.util.dtype) > upper[t]
         load = jnp.sum(st.effective_load(env), axis=1)
         ok = env.replica_valid & over & ~st.replica_offline
         return jnp.where(ok, -load, NEG_INF)
@@ -141,7 +145,7 @@ class TopicReplicaDistributionGoal(GoalKernel):
         t = env.replica_topic
         b = st.replica_broker
         lower, _upper = self._limits(env, st)
-        can_leave = (st.topic_broker_count[t, b].astype(jnp.float32) - 1.0
+        can_leave = (st.topic_broker_count[t, b].astype(st.util.dtype) - 1.0
                      >= lower[t])
         load = jnp.sum(st.effective_load(env), axis=1)
         ok = env.replica_valid & can_leave & ~st.replica_offline
@@ -153,7 +157,7 @@ class TopicReplicaDistributionGoal(GoalKernel):
         bo = st.replica_broker[cand_out]
         bi = st.replica_broker[cand_in]
         lower, upper = self._limits(env, st)
-        c = st.topic_broker_count.astype(jnp.float32)
+        c = st.topic_broker_count.astype(st.util.dtype)
 
         def viol(cc, lo, up):
             return jnp.maximum(cc - up, 0.0) + jnp.maximum(lo - cc, 0.0)
@@ -186,12 +190,13 @@ class TopicReplicaDistributionGoal(GoalKernel):
         may shed a pair down to the topic's lower limit and fill one up to
         its upper limit (topic totals are move-invariant, so the pre-wave
         limits hold throughout the wave)."""
-        n_alive = jnp.maximum(jnp.sum(env.broker_alive), 1).astype(jnp.float32)
-        topic_total = jnp.sum(st.topic_broker_count, axis=1)        # [T]
-        avg = topic_total[topics].astype(jnp.float32) / n_alive     # [K]
+        n_alive = jnp.maximum(jnp.sum(env.broker_alive), 1).astype(st.util.dtype)
+        topic_total = jnp.sum(st.topic_broker_count.astype(jnp.int32),
+                              axis=1)                               # [T]
+        avg = topic_total[topics].astype(st.util.dtype) / n_alive   # [K]
         lower, upper = self._limits_from_avg(avg)
-        c_src = st.topic_broker_count[topics, src_b].astype(jnp.float32)
-        c_dst = st.topic_broker_count[topics, dst_b].astype(jnp.float32)
+        c_src = st.topic_broker_count[topics, src_b].astype(st.util.dtype)
+        c_dst = st.topic_broker_count[topics, dst_b].astype(st.util.dtype)
         return d_count, c_src - lower, upper - c_dst
 
 
@@ -215,7 +220,7 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
 
     def _deficit(self, env: ClusterEnv, st: EngineState):
         """f32[T, B] missing leaders per (min-leader topic, eligible broker)."""
-        c = st.topic_leader_count.astype(jnp.float32)
+        c = st.topic_leader_count.astype(st.util.dtype)
         need = jnp.where(env.topic_min_leaders[:, None] & self._eligible(env)[None, :],
                          float(self._min()), 0.0)
         return jnp.maximum(need - c, 0.0)
@@ -230,7 +235,7 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
     def replica_key(self, env: ClusterEnv, st: EngineState, severity):
         t = env.replica_topic
         b = st.replica_broker
-        surplus = st.topic_leader_count[t, b].astype(jnp.float32) > float(self._min())
+        surplus = st.topic_leader_count[t, b].astype(st.util.dtype) > float(self._min())
         is_min_topic = env.topic_min_leaders[t]
         load = jnp.sum(st.effective_load(env), axis=1)
         movable = (env.replica_valid & st.replica_is_leader & is_min_topic
@@ -242,7 +247,7 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
     def _deficit_rows(self, env: ClusterEnv, st: EngineState, t):
         """f32[K, B] deficit rows for candidate topics (gather-first: never
         materializes a full [T, B] float table in per-candidate paths)."""
-        c = st.topic_leader_count[t].astype(jnp.float32)            # [K, B]
+        c = st.topic_leader_count[t].astype(st.util.dtype)            # [K, B]
         need = jnp.where(env.topic_min_leaders[t][:, None]
                          & self._eligible(env)[None, :], float(self._min()), 0.0)
         return jnp.maximum(need - c, 0.0)
@@ -260,7 +265,7 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
         drop below the minimum."""
         t = env.replica_topic[cand]
         src = st.replica_broker[cand]
-        c_ts = st.topic_leader_count[t, src].astype(jnp.float32)    # [K]
+        c_ts = st.topic_leader_count[t, src].astype(st.util.dtype)    # [K]
         guarded = (env.topic_min_leaders[t] & st.replica_is_leader[cand]
                    & self._eligible(env)[src])
         src_ok = (c_ts - 1.0 >= float(self._min())) | ~guarded
@@ -270,7 +275,7 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
     def leader_key(self, env: ClusterEnv, st: EngineState, severity):
         t = env.replica_topic
         b = st.replica_broker
-        surplus = st.topic_leader_count[t, b].astype(jnp.float32) > float(self._min())
+        surplus = st.topic_leader_count[t, b].astype(st.util.dtype) > float(self._min())
         ok = (env.replica_valid & st.replica_is_leader & env.topic_min_leaders[t]
               & surplus & ~st.replica_offline)
         return jnp.where(ok, 1.0, NEG_INF)
@@ -288,7 +293,7 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
     def accept_leadership(self, env: ClusterEnv, st: EngineState, cand):
         t = env.replica_topic[cand]
         src = st.replica_broker[cand]
-        c_ts = st.topic_leader_count[t, src].astype(jnp.float32)    # [K]
+        c_ts = st.topic_leader_count[t, src].astype(st.util.dtype)    # [K]
         guarded = env.topic_min_leaders[t] & self._eligible(env)[src]
         src_ok = (c_ts - 1.0 >= float(self._min())) | ~guarded
         return jnp.broadcast_to(src_ok[:, None], (cand.shape[0], env.max_rf))
@@ -298,7 +303,7 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
         """Cumulative form of the leader-minimum veto: a wave may drain
         leaders of a guarded (topic, src) pair down to the minimum; gaining
         leaders never violates a minimum (dst unconstrained)."""
-        c_ts = st.topic_leader_count[topics, src_b].astype(jnp.float32)
+        c_ts = st.topic_leader_count[topics, src_b].astype(st.util.dtype)
         guarded = env.topic_min_leaders[topics] & self._eligible(env)[src_b]
         src_slack = jnp.where(guarded, c_ts - float(self._min()), jnp.inf)
         dst_slack = jnp.full_like(src_slack, jnp.inf)

@@ -240,6 +240,16 @@ class GoalOptimizer:
         # optimizations(); default off)
         self._donate_state = (config.get_boolean("tpu.donate.state")
                               if config is not None else False)
+        # analyzer.compute.dtype: precision policy of the engine's score
+        # sweeps (EngineParams.compute_dtype doc). "auto" (default) runs f32
+        # below 256k replicas and bf16 at/above — the same threshold as the
+        # pass.waves auto-raise; explicit "float32"/"bfloat16" pins it.
+        self._compute_dtype = (config.get_string("analyzer.compute.dtype")
+                               if config is not None else "auto")
+        # analyzer.compact.tables: int16/int8 index + count tables in the
+        # device env/state (model/cluster_tensor.py compact policy)
+        self._compact_tables = (config.get_boolean("analyzer.compact.tables")
+                                if config is not None else True)
         self._balancedness_priority_weight = (
             config.get_double("goal.balancedness.priority.weight")
             if config is not None else BALANCEDNESS_PRIORITY_WEIGHT)
@@ -375,9 +385,11 @@ class GoalOptimizer:
         if session is not None:
             # resident fast path: the session owns the padded device env +
             # observed engine state; the snapshot->pad->upload rebuild is
-            # skipped entirely. The state copy is defensive — the fused
-            # chain donates its state argument's buffers and the resident
-            # state must survive this round.
+            # skipped entirely. Under the session's donation protocol
+            # (analyzer.session.donation) the state handed over here IS the
+            # resident buffer set — the fused chain donates it and the
+            # session rematerializes from its host mirrors at the next
+            # sync; with donation off it is a defensive device copy.
             (env, st, meta, part_table, initial_broker, initial_leader,
              initial_disk, host_valid, host_part) = session.optimizer_inputs()
             num_replicas = env.num_replicas
@@ -442,7 +454,27 @@ class GoalOptimizer:
             finisher_rounds=(0 if (self._finisher_min_replicas >= 0
                                    and num_replicas
                                    < self._finisher_min_replicas)
-                             else self._params.finisher_rounds))
+                             else self._params.finisher_rounds),
+            # precision policy: an explicitly pinned EngineParams dtype
+            # wins; else the config key decides. "auto" currently resolves
+            # to float32 EVERYWHERE: the same-day rung-4 A/B (docs/PERF.md
+            # round 7) measured bf16 budgeted tails leaving 6 goals violated
+            # vs f32's 3 at the 1M rung — per-move tail gains sit below one
+            # bf16 ulp of the utilizations they are differences of, and the
+            # prefix-chain goals have no finisher to drain what the bf16
+            # sweep cannot see — so the >= 256k auto-on threshold (the
+            # pass.waves analogue) stays held back until pair-exact f32
+            # re-scoring closes the quality gap. bf16 remains a certified
+            # OPT-IN (outcome parity on the converging parity fixtures,
+            # tests/test_dtype_policy.py). Resolution depends only on
+            # config, so one cluster always compiles one dtype variant
+            # (compute_dtype is STATIC — flipping it is a documented
+            # recompile).
+            compute_dtype=(self._params.compute_dtype
+                           if self._params.compute_dtype != "auto"
+                           else self._compute_dtype if self._compute_dtype
+                           in ("float32", "bfloat16")
+                           else "float32"))
 
         if session is None:
             tml = self._min_leader_mask(meta, min_leader_topic_pattern)
@@ -453,7 +485,8 @@ class GoalOptimizer:
             # ~8 MB per optimization over a tunneled TPU
             part_table = padded_partition_table(ct)
             env = make_env(ct, meta, topic_min_leaders_mask=tml,
-                           partition_table=part_table)
+                           partition_table=part_table,
+                           compact=self._compact_tables)
             st = init_state(env, ct.replica_broker, ct.replica_is_leader,
                             ct.replica_offline, ct.replica_disk)
             if self._mesh_axis_brokers > 1:
@@ -768,7 +801,8 @@ def _stats_device(env: ClusterEnv, st: EngineState):
     rc = four_masked(st.replica_count, alive, n)
     lc = four_masked(st.leader_count, alive, n)
     pot = four_masked(st.potential_nw_out, alive, n)
-    tbc = jnp.where(alive[None, :], st.topic_broker_count, 0)
+    # compact tables: row sums over int16 counts must accumulate in int32
+    tbc = jnp.where(alive[None, :], st.topic_broker_count.astype(jnp.int32), 0)
     real = tbc.sum(axis=1) > 0
     nt = jnp.maximum(real.sum().astype(jnp.float32), 1.0)
     tmask = real[:, None] & alive[None, :]

@@ -35,6 +35,20 @@ deletion or non-append key churn, per-partition RF changes — or accumulated
 churn beyond ``analyzer.session.max.delta.fraction`` of the epoch's replicas
 triggers a full rebuild (a new epoch). Correctness never depends on the
 delta path applying; it is purely a fast path.
+
+Donation-safe double buffering (``analyzer.session.donation``, default on):
+the session owns TWO logical EngineState slots — the resident slot its last
+finalize produced, and the working slot the optimizer's fused chain carves
+out of it by BUFFER DONATION. ``optimizer_inputs`` hands the resident state
+over outright (no defensive full-state copy) and marks it LENT; the chain
+donates those buffers and its result lands in them. The next ``sync`` does
+not need the donated slot back: the observed assignment lives in the
+session's host mirrors (maintained for proposal diffing anyway), and the
+``_sync_finalize`` program the sync already runs rematerializes the full
+resident state from those mirrors (~3 MB of packed assignment upload riding
+next to the ~30 MB of fresh metric rows). Net effect per steady round: the
+former tree-copy of the ENTIRE device state (hundreds of MB at the 1M rung,
+plus its allocation spike) is gone; the buffers simply swap roles.
 """
 from __future__ import annotations
 
@@ -49,7 +63,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from cruise_control_tpu.analyzer.env import make_env, padded_partition_table
-from cruise_control_tpu.analyzer.state import init_state, refresh
+from cruise_control_tpu.analyzer.state import (
+    EngineState, refresh, state_index_dtypes,
+)
 from cruise_control_tpu.model.cluster_tensor import bucket_size, pad_cluster
 from cruise_control_tpu.model.delta import (
     SnapshotDelta, diff_snapshots, replica_slot_values,
@@ -64,35 +80,51 @@ DEFAULT_MAX_DELTA_FRACTION = 0.25
 # jitted delta programs (shapes bucketed -> a handful of compiled variants)
 # ---------------------------------------------------------------------------
 @jax.jit
-def _sync_finalize(env, st, leader_rows, follower_rows):
+def _sync_finalize(env, broker, lead_packed, disk, leader_rows,
+                   follower_rows):
     """Close a sync: swap in the new load rows, re-derive the env quantities
     that depend on mutable inputs (destination candidacy, the topic-exclusion
-    hoist), recompute per-replica offline flags from broker/disk liveness at
-    the observed assignment, and refresh all derived engine state. Matches
-    ``make_env`` + ``init_state`` term for term — bit-exactness with the
-    from-scratch build rests on this program."""
+    hoist), and MATERIALIZE the full engine state from the observed
+    assignment — broker/disk index columns in the compact dtypes, leadership
+    bit-packed (R/8 upload bytes), offline flags recomputed from broker/disk
+    liveness, derived tallies via the same ``refresh`` the from-scratch build
+    runs. Matches ``make_env`` + ``init_state`` term for term — bit-exactness
+    with a rebuild rests on this program. Building the state HERE (instead of
+    scatter-patching a resident copy) is what makes the optimizer's buffer
+    donation safe: the previous state's buffers may already belong to an
+    in-flight chain, and this program never touches them."""
     env = dataclasses.replace(
         env,
         leader_load=leader_rows,
         follower_load=follower_rows,
         replica_topic_excluded=env.topic_excluded[env.replica_topic],
         dst_candidate=env.broker_alive & ~env.broker_excluded_for_replica_move)
-    off = (~env.broker_alive[st.replica_broker]
-           | ~env.broker_disk_alive[st.replica_broker, st.replica_disk])
-    st = dataclasses.replace(st, replica_offline=off & env.replica_valid)
+    R = env.num_replicas
+    lead = jnp.unpackbits(lead_packed)[:R].astype(bool)
+    off = (~env.broker_alive[broker]
+           | ~env.broker_disk_alive[broker, disk]) & env.replica_valid
+    st = EngineState(
+        replica_broker=broker, replica_is_leader=lead, replica_offline=off,
+        replica_disk=disk,
+        # derived leaves: dead placeholders (refresh recomputes every one of
+        # them, so XLA dead-code-eliminates these zeros — no allocation)
+        util=jnp.zeros_like(env.broker_capacity),
+        leader_util=jnp.zeros_like(env.broker_capacity),
+        potential_nw_out=jnp.zeros(env.num_brokers,
+                                   env.broker_capacity.dtype),
+        replica_count=jnp.zeros(env.num_brokers, jnp.int32),
+        leader_count=jnp.zeros(env.num_brokers, jnp.int32),
+        part_rack_count=jnp.zeros((env.num_partitions, env.num_racks),
+                                  jnp.int32),
+        topic_broker_count=jnp.zeros((env.topic_excluded.shape[0],
+                                      env.num_brokers), jnp.int32),
+        topic_leader_count=jnp.zeros((env.topic_excluded.shape[0],
+                                      env.num_brokers), jnp.int32),
+        disk_util=jnp.zeros_like(env.broker_disk_capacity),
+        moved=jnp.zeros(R, bool),
+        leadership_moved=jnp.zeros(R, bool),
+    )
     return env, refresh(env, st)
-
-
-@jax.jit
-def _scatter_state(st, idx, broker, disk, leader):
-    """Write churned replica slots into the observed assignment. ``idx`` is
-    padded with R (out-of-bounds -> dropped) so all small deltas share one
-    compiled program per bucket size."""
-    return dataclasses.replace(
-        st,
-        replica_broker=st.replica_broker.at[idx].set(broker, mode="drop"),
-        replica_disk=st.replica_disk.at[idx].set(disk, mode="drop"),
-        replica_is_leader=st.replica_is_leader.at[idx].set(leader, mode="drop"))
 
 
 @jax.jit
@@ -102,7 +134,8 @@ def _scatter_env_churn(env, idx, orig):
     return dataclasses.replace(
         env,
         replica_original_broker=env.replica_original_broker
-        .at[idx].set(orig, mode="drop"))
+        .at[idx].set(orig.astype(env.replica_original_broker.dtype),
+                     mode="drop"))
 
 
 @jax.jit
@@ -110,17 +143,21 @@ def _scatter_env_append(env, idx, part, topic, orig, prows, prow_vals, ptop,
                         tidx, texcl, tml):
     """Land appended partitions/topics in the padded axes' free tail slots:
     replica identity rows, membership-table rows, partition->topic links and
-    the new topics' exclusion / min-leaders flags."""
+    the new topics' exclusion / min-leaders flags. Scatter values arrive as
+    int32 host payloads and cast to the env's (possibly compact) dtypes."""
     return dataclasses.replace(
         env,
         replica_partition=env.replica_partition.at[idx].set(part, mode="drop"),
-        replica_topic=env.replica_topic.at[idx].set(topic, mode="drop"),
+        replica_topic=env.replica_topic
+        .at[idx].set(topic.astype(env.replica_topic.dtype), mode="drop"),
         replica_valid=env.replica_valid.at[idx].set(True, mode="drop"),
         replica_original_broker=env.replica_original_broker
-        .at[idx].set(orig, mode="drop"),
+        .at[idx].set(orig.astype(env.replica_original_broker.dtype),
+                     mode="drop"),
         partition_replicas=env.partition_replicas
         .at[prows].set(prow_vals, mode="drop"),
-        partition_topic=env.partition_topic.at[prows].set(ptop, mode="drop"),
+        partition_topic=env.partition_topic
+        .at[prows].set(ptop.astype(env.partition_topic.dtype), mode="drop"),
         topic_excluded=env.topic_excluded.at[tidx].set(texcl, mode="drop"),
         topic_min_leaders=env.topic_min_leaders.at[tidx].set(tml, mode="drop"))
 
@@ -144,10 +181,13 @@ class ResidentClusterSession:
     """Owner of the device-resident (env, state) for one shape bucket.
 
     Thread-safe: ``sync`` and ``optimizer_inputs`` serialize on ``lock``.
-    The resident state always reflects the *observed* cluster — optimizer
-    runs start from a defensive copy (the fused chain donates its state
-    buffers) and their proposed moves only come back via the backend and the
-    next sync's deltas.
+    The resident state always reflects the *observed* cluster — with the
+    donation protocol (``analyzer.session.donation``) an optimizer run takes
+    the resident state's buffers outright (the fused chain donates them; the
+    round's result lands in them) and the next sync rematerializes the
+    observed state from the host assignment mirrors; with donation off, runs
+    start from a defensive full-state copy. Either way proposed moves only
+    come back via the backend and the next sync's deltas.
     """
 
     def __init__(self, monitor, config=None):
@@ -159,10 +199,14 @@ class ResidentClusterSession:
                 "topics.excluded.from.partition.movement")
             self._min_leader_pattern = config.get_string(
                 "topics.with.min.leaders.per.broker")
+            self._donation = config.get_boolean("analyzer.session.donation")
+            self._compact = config.get_boolean("analyzer.compact.tables")
         else:
             self._max_delta_fraction = DEFAULT_MAX_DELTA_FRACTION
             self._excluded_pattern = ""
             self._min_leader_pattern = ""
+            self._donation = True
+            self._compact = True
         self.lock = threading.RLock()
         # resident device state + host companions
         self.env = None
@@ -181,6 +225,7 @@ class ResidentClusterSession:
         self.epoch = 0
         self.rebuild_rounds = 0
         self.delta_rounds = 0
+        self.donated_rounds = 0        # optimizer rounds served without a copy
         self.last_sync_info: dict = {}
 
     # ------------------------------------------------------------- public
@@ -225,13 +270,25 @@ class ResidentClusterSession:
             return info
 
     def optimizer_inputs(self) -> tuple:
-        """(env, state-copy, meta, part_table, initial_broker, initial_leader,
+        """(env, state, meta, part_table, initial_broker, initial_leader,
         initial_disk, host_valid, host_partition) for
-        ``GoalOptimizer.optimizations(session=...)``. The state is a fresh
-        device copy — the fused chain donates its state argument's buffers,
-        and the resident state must survive the round."""
+        ``GoalOptimizer.optimizations(session=...)``.
+
+        Donation protocol (default): the RESIDENT state itself is handed
+        over and marked lent — the fused chain donates its buffers and the
+        round's result lands in them (the double-buffer swap); no defensive
+        copy, no allocation spike. The next sync (or the next call here)
+        rematerializes the observed state from the host mirrors via the
+        finalize program it runs anyway. With ``analyzer.session.donation``
+        off, a fresh device copy is returned instead (legacy behavior)."""
         with self.lock:
-            st = jax.tree_util.tree_map(jnp.copy, self.state)
+            self._ensure_state()
+            if self._donation:
+                st = self.state
+                self.state = None       # lent: the chain may donate it
+                self.donated_rounds += 1
+            else:
+                st = jax.tree_util.tree_map(jnp.copy, self.state)
             # host arrays are copied: a later sync's in-place delta writes
             # must not race an optimization still diffing proposals
             return (self.env, st, self.meta, self.part_table.copy(),
@@ -252,8 +309,27 @@ class ResidentClusterSession:
             "epoch": self.epoch,
             "rebuildRounds": self.rebuild_rounds,
             "deltaRounds": self.delta_rounds,
+            "donatedRounds": self.donated_rounds,
             "lastSync": dict(self.last_sync_info),
         }
+
+    # ------------------------------------------------- state materialization
+    def _ensure_state(self) -> None:
+        """Rematerialize the resident state from the host mirrors if the
+        last round took (and possibly donated) it; no-op when resident."""
+        if self.state is None and self.env is not None:
+            self._materialize(self.env.leader_load, self.env.follower_load)
+
+    def _materialize(self, leader_rows, follower_rows) -> None:
+        """Run the finalize program: observed assignment (compact dtypes,
+        leadership bit-packed) + load rows -> fresh resident (env, state)."""
+        b_dt, d_dt, _ = state_index_dtypes(self.env)
+        h = self._h
+        broker = jnp.asarray(h["replica_broker"].astype(b_dt))
+        disk = jnp.asarray(h["replica_disk"].astype(d_dt))
+        lead_packed = jnp.asarray(np.packbits(h["replica_is_leader"]))
+        self.env, self.state = _sync_finalize(
+            self.env, broker, lead_packed, disk, leader_rows, follower_rows)
 
     # ----------------------------------------------------------- fallback
     def _delta_blocker(self, snap, delta: SnapshotDelta) -> str | None:
@@ -297,20 +373,16 @@ class ResidentClusterSession:
         part_table = padded_partition_table(ct)
         tml = self._tml_mask(meta, ct.num_topics)
         env = make_env(ct, meta, topic_min_leaders_mask=tml,
-                       partition_table=part_table)
-        st = init_state(env, ct.replica_broker, ct.replica_is_leader,
-                        ct.replica_offline, ct.replica_disk)
-        # pre-warm every delta program for this epoch's shapes with no-op
-        # scatters (all indices out of bounds -> dropped) and a same-rows
-        # finalize: steady rounds — including their FIRST real churn — then
-        # run with ZERO new XLA compiles, which bench.py asserts per rung
+                       partition_table=part_table, compact=self._compact)
+        # pre-warm the env delta programs for this epoch's shapes with no-op
+        # scatters (all indices out of bounds -> dropped): steady rounds —
+        # including their FIRST real churn — then run with ZERO new XLA
+        # compiles, which bench.py asserts per rung
         Rp = env.num_replicas
         Pp = env.num_partitions
         Tp = int(env.topic_excluded.shape[0])
         ridx = np.full(bucket_size(1, 64), Rp, np.int32)
         zi = np.zeros(ridx.shape[0], np.int32)
-        zb = np.zeros(ridx.shape[0], bool)
-        st = _scatter_state(st, ridx, zi, zi, zb)
         env = _scatter_env_churn(env, ridx, zi)
         prows = np.full(bucket_size(1, 16), Pp, np.int32)
         prow_vals = np.full((prows.shape[0], env.max_rf), -1, np.int32)
@@ -319,8 +391,7 @@ class ResidentClusterSession:
         tz = np.zeros(tidx.shape[0], bool)
         env = _scatter_env_append(env, ridx, zi, zi, zi, prows, prow_vals,
                                   ptop, tidx, tz, tz)
-        env, st = _sync_finalize(env, st, env.leader_load, env.follower_load)
-        self.env, self.state = env, st
+        self.env = env
         # session-owned meta: appended partitions/topics extend these lists
         self.meta = dataclasses.replace(
             meta, topic_names=list(meta.topic_names),
@@ -334,6 +405,10 @@ class ResidentClusterSession:
             "replica_partition": np.asarray(ct.replica_partition,
                                             np.int32).copy(),
         }
+        # the epoch's state comes from the SAME finalize program every later
+        # sync runs (mirrors -> device): init_state's twin, and the per-round
+        # program is warm from round one
+        self._materialize(env.leader_load, env.follower_load)
         Rv = meta.num_valid_replicas
         self._rep_part = self._h["replica_partition"][:Rv].astype(np.int64)
         self._broker_mirror = self._broker_dense_padded_from_ct(ct)
@@ -445,14 +520,23 @@ class ResidentClusterSession:
                 changed[name] = padded
         if changed:
             self._broker_mirror.update(changed)
+            # upload in the RESIDENT leaf's dtype (compact tables keep e.g.
+            # broker_rack int16 — a stray int32 upload would flip the leaf
+            # dtype and force engine recompiles)
             self.env = dataclasses.replace(
-                self.env, **{name: jnp.asarray(a)
-                             for name, a in changed.items()})
+                self.env,
+                **{name: jnp.asarray(np.asarray(a).astype(
+                    getattr(self.env, name).dtype))
+                   for name, a in changed.items()})
         return None
 
     # ------------------------------------------------------ replica churn
     def _apply_topology_delta(self, snap, delta: SnapshotDelta) -> None:
-        env, st = self.env, self.state
+        """Apply churn/appends to the ENV (device scatters) and the host
+        assignment mirrors. The engine-state side needs no device scatters
+        anymore: every sync rematerializes the state from the mirrors inside
+        ``_sync_finalize`` (the donation protocol's restore path)."""
+        env = self.env
         Rp = env.num_replicas
         Pp = env.num_partitions
         Tp = int(env.topic_excluded.shape[0])
@@ -465,9 +549,6 @@ class ResidentClusterSession:
             idx = _pad_idx(slots.astype(np.int32), delta.num_changed, Rp, 64)
             nb = idx.shape[0]
             broker = _pad_vals(vals["broker"], nb)
-            disk = _pad_vals(vals["disk"], nb)
-            leader = _pad_vals(vals["leader"], nb)
-            st = _scatter_state(st, idx, broker, disk, leader)
             env = _scatter_env_churn(env, idx, broker)
             h["replica_broker"][slots] = vals["broker"]
             h["replica_disk"][slots] = vals["disk"]
@@ -504,8 +585,6 @@ class ResidentClusterSession:
             idx = _pad_idx(slots.astype(np.int32), n_r, Rp, 64)
             nb = idx.shape[0]
             broker = _pad_vals(vals["broker"], nb)
-            disk = _pad_vals(vals["disk"], nb)
-            leader = _pad_vals(vals["leader"], nb)
             part = _pad_vals(rep_part_new.astype(np.int32), nb)
             topic = _pad_vals(topic_of_new.astype(np.int32), nb)
             n_p = p_hi - p_lo
@@ -514,7 +593,6 @@ class ResidentClusterSession:
             prow_vals_p = _pad_vals(prow_vals, npb, -1)
             ptop = _pad_vals(snap.partition_topic[p_lo:p_hi]
                              .astype(np.int32), npb)
-            st = _scatter_state(st, idx, broker, disk, leader)
             env = _scatter_env_append(env, idx, part, topic, broker, prows,
                                       prow_vals_p, ptop, tidx, texcl, tml)
             # host companions follow
@@ -528,16 +606,18 @@ class ResidentClusterSession:
             self.meta.partition_ids.extend(snap.partition_keys[p_lo:p_hi])
             self.meta.topic_names.extend(new_topics)
             self.meta.num_valid_replicas = r_hi
-        self.env, self.state = env, st
+        self.env = env
 
     # ------------------------------------------------------ metric refresh
     def _refresh_metrics(self, agg, snap) -> None:
         """Per-round metric-window refresh: assemble the [R, M] load rows
         with the SAME monitor code the full build uses, upload them into
         fresh buffers (the device_put is async on an accelerator, so the H2D
-        copy overlaps the previous round's in-flight compute — the
-        double-buffer effect without reusing memory an old env may still
-        alias), then run the finalize program."""
+        copy overlaps the previous round's in-flight compute), then run the
+        finalize program — which also rematerializes the engine state from
+        the host assignment mirrors (the packed assignment rides as ~3 MB
+        next to the ~30 MB of load rows at the 1M rung), so a state lent to
+        (and donated by) the previous optimizer round needs no device copy."""
         mon = self._monitor
         cols = mon.partition_load_columns(snap.partition_keys,
                                           snap.generation, agg=agg)
@@ -550,5 +630,4 @@ class ResidentClusterSession:
         foll_p[:Rv] = foll
         lead_dev = jax.device_put(lead_p)
         foll_dev = jax.device_put(foll_p)
-        self.env, self.state = _sync_finalize(self.env, self.state,
-                                              lead_dev, foll_dev)
+        self._materialize(lead_dev, foll_dev)

@@ -15,11 +15,26 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from cruise_control_tpu.common.resources import Resource
 from cruise_control_tpu.analyzer.env import ClusterEnv
 
 Array = jax.Array
+
+
+def state_index_dtypes(env: ClusterEnv):
+    """(broker_dt, disk_dt, count_dt) — the COMPACT-table dtypes this env's
+    engine state uses (model/cluster_tensor.py compact policy). Derived from
+    the env so every builder (init_state, the resident session's finalize)
+    lands on identical dtypes: the env's broker-index columns are int16 iff
+    the compact policy engaged at make_env time."""
+    b_dt = env.replica_original_broker.dtype
+    compact = b_dt == jnp.int16
+    d_dt = (jnp.int8 if compact and env.broker_disk_capacity.shape[1] <= 127
+            else jnp.int32)
+    c_dt = jnp.int16 if compact else jnp.int32
+    return b_dt, d_dt, jnp.dtype(c_dt)
 
 
 @partial(jax.tree_util.register_dataclass,
@@ -54,9 +69,31 @@ class EngineState:
 
 def init_state(env: ClusterEnv, replica_broker: Array, replica_is_leader: Array,
                replica_offline: Array, replica_disk: Array) -> EngineState:
+    b_dt, d_dt, _ = state_index_dtypes(env)
+    # compact upload: broker/disk index columns cast ON HOST to the policy
+    # dtype; the two [R] bool flags travel bit-packed (R/8 bytes) and expand
+    # on device inside the jitted init — see make_env for the env-side twin
+    rb = np.asarray(jax.device_get(replica_broker)).astype(b_dt)
+    rd = np.asarray(jax.device_get(replica_disk)).astype(d_dt)
+    lead_packed = np.packbits(np.asarray(jax.device_get(replica_is_leader),
+                                         bool))
+    off_packed = np.packbits(np.asarray(jax.device_get(replica_offline),
+                                        bool))
+    # _init_packed is jitted, so every leaf of its output — including the
+    # numpy assignment arrays passed through — comes back as a committed
+    # device array (the env-side analogue is make_env's _expand_env)
+    return _init_packed(env, rb, lead_packed, off_packed, rd)
+
+
+@jax.jit
+def _init_packed(env: ClusterEnv, replica_broker: Array, lead_packed: Array,
+                 off_packed: Array, replica_disk: Array) -> EngineState:
+    R = env.num_replicas
     st = EngineState(
-        replica_broker=replica_broker, replica_is_leader=replica_is_leader,
-        replica_offline=replica_offline, replica_disk=replica_disk,
+        replica_broker=replica_broker,
+        replica_is_leader=jnp.unpackbits(lead_packed)[:R].astype(bool),
+        replica_offline=jnp.unpackbits(off_packed)[:R].astype(bool),
+        replica_disk=replica_disk,
         util=jnp.zeros_like(env.broker_capacity),
         leader_util=jnp.zeros_like(env.broker_capacity),
         potential_nw_out=jnp.zeros(env.num_brokers, env.broker_capacity.dtype),
@@ -69,16 +106,18 @@ def init_state(env: ClusterEnv, replica_broker: Array, replica_is_leader: Array,
         moved=jnp.zeros(env.num_replicas, bool),
         leadership_moved=jnp.zeros(env.num_replicas, bool),
     )
-    # refresh is jitted, so every leaf of its output — including the numpy
-    # assignment arrays passed through — comes back as a committed device
-    # array (the env-side analogue needs an explicit device_put; see make_env)
     return refresh(env, st)
 
 
 @jax.jit
 def refresh(env: ClusterEnv, st: EngineState) -> EngineState:
-    """Recompute all derived state from the assignment (ground truth)."""
+    """Recompute all derived state from the assignment (ground truth).
+
+    Flat-index math over compact (int16) index columns upcasts to int32
+    first — topic * B + broker overflows int16 at real topic/broker counts;
+    the big count tables come back in the compact count dtype."""
     B = env.num_brokers
+    _, _, c_dt = state_index_dtypes(env)
     load = st.effective_load(env)
     util = jax.ops.segment_sum(load, st.replica_broker, num_segments=B)
     lead_mask = (st.replica_is_leader & env.replica_valid)[:, None]
@@ -92,23 +131,27 @@ def refresh(env: ClusterEnv, st: EngineState) -> EngineState:
     lc = jax.ops.segment_sum((env.replica_valid & st.replica_is_leader).astype(jnp.int32),
                              st.replica_broker, num_segments=B)
     rack = env.broker_rack[st.replica_broker]
-    flat = env.replica_partition * env.num_racks + rack
+    flat = env.replica_partition * env.num_racks + rack.astype(jnp.int32)
     prc = jax.ops.segment_sum(env.replica_valid.astype(jnp.int32), flat,
                               num_segments=env.num_partitions * env.num_racks
                               ).reshape(env.num_partitions, env.num_racks)
     T = env.topic_excluded.shape[0]
-    tflat = env.replica_topic * B + st.replica_broker
+    tflat = (env.replica_topic.astype(jnp.int32) * B
+             + st.replica_broker.astype(jnp.int32))
     tbc = jax.ops.segment_sum(env.replica_valid.astype(jnp.int32), tflat,
                               num_segments=T * B).reshape(T, B)
     tlc = jax.ops.segment_sum((env.replica_valid & st.replica_is_leader).astype(jnp.int32),
                               tflat, num_segments=T * B).reshape(T, B)
     D = env.broker_disk_capacity.shape[1]
-    dflat = st.replica_broker * D + st.replica_disk
+    dflat = (st.replica_broker.astype(jnp.int32) * D
+             + st.replica_disk.astype(jnp.int32))
     du = jax.ops.segment_sum(load[:, Resource.DISK], dflat,
                              num_segments=B * D).reshape(B, D)
     return dataclasses.replace(st, util=util, leader_util=leader_util, potential_nw_out=pot,
-                               replica_count=rc, leader_count=lc, part_rack_count=prc,
-                               topic_broker_count=tbc, topic_leader_count=tlc, disk_util=du)
+                               replica_count=rc, leader_count=lc,
+                               part_rack_count=prc.astype(c_dt),
+                               topic_broker_count=tbc.astype(c_dt),
+                               topic_leader_count=tlc.astype(c_dt), disk_util=du)
 
 
 def apply_move(env: ClusterEnv, st: EngineState, replica: Array, dst: Array,
@@ -138,11 +181,15 @@ def apply_move(env: ClusterEnv, st: EngineState, replica: Array, dst: Array,
     rc = st.replica_count.at[src].add(-one).at[dst].add(one)
     lc = st.leader_count.at[src].add(-lone).at[dst].add(lone)
     p = env.replica_partition[replica]
-    prc = (st.part_rack_count.at[p, env.broker_rack[src]].add(-one)
-                             .at[p, env.broker_rack[dst]].add(one))
+    # compact count tables: updates cast to the table's (int16) dtype —
+    # +-1 deltas are exact in any integer dtype
+    onec = en.astype(st.part_rack_count.dtype)
+    lonec = (en & is_leader).astype(st.topic_leader_count.dtype)
+    prc = (st.part_rack_count.at[p, env.broker_rack[src]].add(-onec)
+                             .at[p, env.broker_rack[dst]].add(onec))
     t = env.replica_topic[replica]
-    tbc = st.topic_broker_count.at[t, src].add(-one).at[t, dst].add(one)
-    tlc = st.topic_leader_count.at[t, src].add(-lone).at[t, dst].add(lone)
+    tbc = st.topic_broker_count.at[t, src].add(-onec).at[t, dst].add(onec)
+    tlc = st.topic_leader_count.at[t, src].add(-lonec).at[t, dst].add(lonec)
     # destination logdir: the alive disk with the most free space on dst
     # (the engine's move candidates don't carry a disk axis; placement policy
     # mirrors the executor's least-loaded-logdir default)
@@ -155,11 +202,13 @@ def apply_move(env: ClusterEnv, st: EngineState, replica: Array, dst: Array,
     return dataclasses.replace(
         st,
         replica_broker=st.replica_broker.at[replica].set(
-            jnp.where(en, jnp.asarray(dst, jnp.int32), src)),
+            jnp.where(en, jnp.asarray(dst, jnp.int32), src)
+            .astype(st.replica_broker.dtype)),
         replica_offline=st.replica_offline.at[replica].set(
             st.replica_offline[replica] & ~en),
         replica_disk=st.replica_disk.at[replica].set(
-            jnp.where(en, dst_disk, src_disk)),
+            jnp.where(en, dst_disk, src_disk)
+            .astype(st.replica_disk.dtype)),
         util=util, leader_util=leader_util, potential_nw_out=pot,
         replica_count=rc, leader_count=lc, part_rack_count=prc,
         topic_broker_count=tbc, topic_leader_count=tlc, disk_util=du,
@@ -185,7 +234,8 @@ def apply_leadership(env: ClusterEnv, st: EngineState, src_replica: Array,
     one = en.astype(jnp.int32)
     lc = st.leader_count.at[bs].add(-one).at[bd].add(one)
     t = env.replica_topic[src_replica]
-    tlc = st.topic_leader_count.at[t, bs].add(-one).at[t, bd].add(one)
+    onec = en.astype(st.topic_leader_count.dtype)
+    tlc = st.topic_leader_count.at[t, bs].add(-onec).at[t, bd].add(onec)
     lead = (st.replica_is_leader
             .at[src_replica].set(st.replica_is_leader[src_replica] & ~en)
             .at[dst_replica].set(st.replica_is_leader[dst_replica] | en))
@@ -217,7 +267,8 @@ def apply_leaderships_batched(env: ClusterEnv, st: EngineState,
     one = en.astype(jnp.int32)
     lc = st.leader_count.at[bs].add(-one).at[bd].add(one)
     t = env.replica_topic[src_replicas]
-    tlc = st.topic_leader_count.at[t, bs].add(-one).at[t, bd].add(one)
+    onec = en.astype(st.topic_leader_count.dtype)
+    tlc = st.topic_leader_count.at[t, bs].add(-onec).at[t, bd].add(onec)
     # duplicate-safe leadership flip: gather/.set would let a MASKED row whose
     # dst index collides with an enabled row's src/dst write back a stale
     # pre-wave value (top-k pads rows with arbitrary replicas). OR/AND-style
@@ -270,11 +321,13 @@ def apply_moves_batched(env: ClusterEnv, st: EngineState, replicas: Array,
     rc = st.replica_count.at[src].add(-one).at[dsts].add(one)
     lc = st.leader_count.at[src].add(-lone).at[dsts].add(lone)
     pidx = env.replica_partition[replicas]
-    prc = (st.part_rack_count.at[pidx, env.broker_rack[src]].add(-one)
-                             .at[pidx, env.broker_rack[dsts]].add(one))
+    onec = mask.astype(st.part_rack_count.dtype)
+    lonec = (mask & is_leader).astype(st.topic_leader_count.dtype)
+    prc = (st.part_rack_count.at[pidx, env.broker_rack[src]].add(-onec)
+                             .at[pidx, env.broker_rack[dsts]].add(onec))
     tidx = env.replica_topic[replicas]
-    tbc = st.topic_broker_count.at[tidx, src].add(-one).at[tidx, dsts].add(one)
-    tlc = st.topic_leader_count.at[tidx, src].add(-lone).at[tidx, dsts].add(lone)
+    tbc = st.topic_broker_count.at[tidx, src].add(-onec).at[tidx, dsts].add(onec)
+    tlc = st.topic_leader_count.at[tidx, src].add(-lonec).at[tidx, dsts].add(lonec)
     # destination logdir: most-free alive disk on dst at pre-wave state
     free = jnp.where(env.broker_disk_alive[dsts],
                      env.broker_disk_capacity[dsts] - st.disk_util[dsts],
@@ -288,8 +341,9 @@ def apply_moves_batched(env: ClusterEnv, st: EngineState, replicas: Array,
     return dataclasses.replace(
         st,
         replica_broker=st.replica_broker.at[widx].set(
-            jnp.asarray(dsts, jnp.int32), mode="drop"),
-        replica_disk=st.replica_disk.at[widx].set(dst_disk, mode="drop"),
+            jnp.asarray(dsts).astype(st.replica_broker.dtype), mode="drop"),
+        replica_disk=st.replica_disk.at[widx].set(
+            dst_disk.astype(st.replica_disk.dtype), mode="drop"),
         replica_offline=st.replica_offline.at[widx].set(False, mode="drop"),
         util=util, leader_util=leader_util, potential_nw_out=pot,
         replica_count=rc, leader_count=lc, part_rack_count=prc,
@@ -317,7 +371,8 @@ def apply_disk_move(env: ClusterEnv, st: EngineState, replica: Array,
     return dataclasses.replace(
         st,
         replica_disk=st.replica_disk.at[replica].set(
-            jnp.where(en, jnp.asarray(dst_disk, jnp.int32), src_disk)),
+            jnp.where(en, jnp.asarray(dst_disk, jnp.int32), src_disk)
+            .astype(st.replica_disk.dtype)),
         replica_offline=st.replica_offline.at[replica].set(
             st.replica_offline[replica] & ~heals),
         disk_util=du,

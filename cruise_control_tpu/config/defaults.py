@@ -173,6 +173,44 @@ _D.define(name="analyzer.chain.cache", type=Type.BOOLEAN, default=True,
               "chain goal per branch and per finisher-scan chunk. "
               "Mathematically exact; bitwise within one f32 ulp of the "
               "per-goal masks at band edges. Off = per-goal masks.")
+_D.define(name="analyzer.compute.dtype", type=Type.STRING, default="auto",
+          validator=in_set("auto", "float32", "bfloat16"),
+          validator_doc="one of: auto, float32, bfloat16",
+          doc="TPU-specific: precision policy of the engine's wide score "
+              "sweeps (the [K, B]/[KL, F] candidate scoring + [R] keying "
+              "fusions — the HBM-bandwidth wall). bfloat16 halves their "
+              "per-pass traffic; gain accounting, min-gain application, "
+              "severity/violation measures and the fixpoint-certificate "
+              "scans ALWAYS stay float32, so violation counts and "
+              "certificate sets match the f32 pipeline on the certified "
+              "parity fixtures (tests/test_dtype_policy.py). bfloat16 is "
+              "OPT-IN: 'auto' currently resolves to float32 everywhere — "
+              "the rung-4 A/B (docs/PERF.md round 7) measured bf16 budgeted "
+              "tails costing violations at the 1M rung (tail gains round "
+              "below one bf16 ulp), so the planned >= 256k auto-on "
+              "threshold is held back until pair-exact f32 re-scoring "
+              "lands. STATIC knob: changing it recompiles the engine "
+              "programs (documented; budget knobs stay traced).")
+_D.define(name="analyzer.compact.tables", type=Type.BOOLEAN, default=True,
+          doc="TPU-specific: store the device cluster tables compact — "
+              "int16 broker/rack/topic index columns where the axis fits, "
+              "int8 logdir indices, int16 (topic x broker) / (partition x "
+              "rack) count tables, bit-packed eligibility-mask uploads — "
+              "cutting the cold env upload and the per-pass gather/scatter "
+              "bytes. Index values are exact in any integer dtype and every "
+              "overflow-capable arithmetic site upcasts to int32, so results "
+              "are bit-identical to int32 tables (certified in "
+              "tests/test_dtype_policy.py). Off = int32 everywhere.")
+_D.define(name="analyzer.session.donation", type=Type.BOOLEAN, default=True,
+          doc="TPU-specific: resident-session double-buffer protocol — hand "
+              "the device-RESIDENT EngineState to the optimizer for buffer "
+              "DONATION (the fused chain reuses its input buffers for the "
+              "round's result) instead of defensively copying the full "
+              "state every round; the next sync rematerializes the observed "
+              "state from the session's host assignment mirrors inside the "
+              "finalize program it already runs. Eliminates a full-state "
+              "device copy (and its allocation spike) from every steady "
+              "round. Off = defensive copy (pre-PR-5 behavior).")
 _D.define(name="analyzer.fused.chain.min.replicas", type=Type.INT, default=65_536,
           doc="TPU-specific: at/above this cluster size the whole goal chain "
               "compiles into ONE device program (one dispatch instead of one "

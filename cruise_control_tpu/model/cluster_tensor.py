@@ -264,6 +264,56 @@ class ClusterMeta:
         return self.partition_ids.index((topic, partition))
 
 
+# ---------------------------------------------------------------------------
+# Compact device-table dtypes (engine memory diet)
+# ---------------------------------------------------------------------------
+# The resident ClusterEnv/EngineState carries several index/count tables whose
+# values are bounded far below int32: broker and rack indices fit int16 for
+# every cluster under 32k brokers, logdir indices fit int8, and the per-
+# (topic, broker) / (partition, rack) count tables never approach 32k per cell
+# (a single (topic, broker) pair holding 32k+ replicas would dwarf
+# max.replicas.per.broker). Storing them compact halves-to-quarters both the
+# cold env upload and the per-pass gather/scatter bytes — on TPU the engine is
+# HBM-bandwidth-bound, so table bytes are wall-clock. All index *values* are
+# exact in any integer dtype; every arithmetic site that could overflow a
+# narrow dtype (flat-index math like topic*B+broker) upcasts to int32 first,
+# so compact and int32 tables are bit-identical in behavior
+# (tests/test_dtype_policy.py certifies it end to end).
+COMPACT_IDX_MAX16 = 32_767
+COMPACT_IDX_MAX8 = 127
+
+
+def broker_index_dtype(num_brokers: int, compact: bool = True):
+    """Dtype for broker-valued index arrays (replica_broker and friends)."""
+    return np.int16 if (compact and num_brokers <= COMPACT_IDX_MAX16) \
+        else np.int32
+
+
+def rack_index_dtype(num_racks: int, compact: bool = True):
+    return np.int16 if (compact and num_racks <= COMPACT_IDX_MAX16) \
+        else np.int32
+
+
+def topic_index_dtype(num_topics: int, compact: bool = True):
+    return np.int16 if (compact and num_topics <= COMPACT_IDX_MAX16) \
+        else np.int32
+
+
+def disk_index_dtype(num_disks: int, compact: bool = True):
+    """Dtype for logdir-valued index arrays (replica_disk)."""
+    return np.int8 if (compact and num_disks <= COMPACT_IDX_MAX8) \
+        else np.int32
+
+
+def count_table_dtype(compact: bool = True):
+    """Dtype of the big per-(topic, broker) / (partition, rack) count tables.
+    int16 under the compact policy: cells count replicas of ONE topic (or
+    partition) on ONE broker (or rack), bounded in practice by
+    max.replicas.per.broker (default 10k) — far under 32k. Sums over these
+    tables upcast to int32 before reducing."""
+    return np.int16 if compact else np.int32
+
+
 def bucket_size(n: int, minimum: int = 8) -> int:
     """Round up to the next size in a {1, 1.25, 1.5, 1.75} x 2^k ladder.
 

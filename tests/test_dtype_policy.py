@@ -1,0 +1,318 @@
+"""Precision-policy / compact-table / donation certification (PR 5).
+
+The engine memory diet's three contracts:
+
+1. PRECISION POLICY (``EngineParams.compute_dtype``): bf16 score sweeps must
+   be OUTCOME-parity with the f32 pipeline on the seeded parity fixtures —
+   identical final violation counts/sets and fixpoint-certificate sets (the
+   same contract as ``pass_waves > 1``: the greedy trajectory may reorder,
+   outcomes may not change) — while the explicit "float32" policy stays
+   BIT-identical to the default pipeline. The knob is a STATIC field
+   (documented recompile); the budget leaves stay traced (zero new compiles
+   on budget toggles, the test_pass_pipeline contract re-asserted here under
+   the bf16 variant).
+2. COMPACT TABLES (``analyzer.compact.tables``): int16/int8 index + count
+   tables are BIT-identical to int32 tables — indices are exact in any
+   integer dtype and every overflow-capable arithmetic site upcasts.
+3. SESSION DONATION (``analyzer.session.donation``): the resident session's
+   double-buffer protocol (hand the resident state to the chain for buffer
+   donation; rematerialize from host mirrors at the next sync) produces the
+   same optimization results as the legacy defensive-copy protocol, and the
+   post-round sync restores a state bit-identical to a from-scratch rebuild.
+
+Only the pre-registered ``slow`` marker is used (tests/conftest.py
+pytest_configure keeps unknown marks an error); the fast-tier cases here run
+on every tier-1 invocation.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.engine import EngineParams
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.config import cruise_control_config
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate
+
+CHAIN = ["RackAwareGoal", "DiskCapacityGoal", "CpuCapacityGoal",
+         "ReplicaDistributionGoal", "DiskUsageDistributionGoal",
+         "LeaderReplicaDistributionGoal"]
+
+FULL_CHAIN = ["RackAwareGoal", "MinTopicLeadersPerBrokerGoal",
+              "ReplicaCapacityGoal", "DiskCapacityGoal",
+              "NetworkInboundCapacityGoal", "NetworkOutboundCapacityGoal",
+              "CpuCapacityGoal", "ReplicaDistributionGoal",
+              "PotentialNwOutGoal", "DiskUsageDistributionGoal",
+              "NetworkInboundUsageDistributionGoal",
+              "NetworkOutboundUsageDistributionGoal",
+              "CpuUsageDistributionGoal", "LeaderReplicaDistributionGoal",
+              "LeaderBytesInDistributionGoal",
+              "TopicReplicaDistributionGoal"]
+
+
+def _cluster(seed=777):
+    return generate(RandomClusterSpec(
+        num_brokers=24, num_racks=4, num_topics=12, num_partitions=300,
+        max_replication=2, skew=2.0, seed=seed))
+
+
+def _run(ct, meta, params=None, config=None, goal_names=CHAIN):
+    opt = GoalOptimizer(config=config, engine_params=params)
+    return opt.optimizations(ct, meta, goal_names=goal_names,
+                             raise_on_failure=False,
+                             skip_hard_goal_check=True)
+
+
+def _assert_outcome_parity(ra, rb, label):
+    """The bf16 contract: violation counts/sets and certificate sets equal."""
+    assert ra.violated_goals_before == rb.violated_goals_before, label
+    assert ra.violated_goals_after == rb.violated_goals_after, label
+    cert_a = {g.name for g in ra.goal_results
+              if g.violated_after and g.fixpoint_proven}
+    cert_b = {g.name for g in rb.goal_results
+              if g.violated_after and g.fixpoint_proven}
+    assert cert_a == cert_b, label
+
+
+# --------------------------------------------------------------- precision
+def test_bf16_outcome_parity_fast():
+    """Tier-1 dtype-parity: bf16 sweeps vs f32 on the seeded fixture —
+    identical violation counts/sets and certificate sets (small-shape case;
+    the full-ladder matrix is the slow variant below)."""
+    ct, meta = _cluster(seed=777)
+    rf = _run(ct, meta, params=EngineParams(compute_dtype="float32"))
+    rb = _run(ct, meta, params=EngineParams(compute_dtype="bfloat16"))
+    _assert_outcome_parity(rf, rb, "bf16-fast")
+
+
+def test_f32_policy_bit_identical_to_default():
+    """The f32 fallback is EXACT: an explicit float32 policy produces the
+    byte-identical final assignment of the default pipeline (the policy adds
+    no casts on the f32 path)."""
+    ct, meta = _cluster(seed=778)
+    ra = _run(ct, meta, params=EngineParams())
+    rb = _run(ct, meta, params=EngineParams(compute_dtype="float32"))
+    np.testing.assert_array_equal(
+        np.asarray(ra.final_state.replica_broker),
+        np.asarray(rb.final_state.replica_broker))
+    np.testing.assert_array_equal(
+        np.asarray(ra.final_state.replica_is_leader),
+        np.asarray(rb.final_state.replica_is_leader))
+    assert ra.violated_goals_after == rb.violated_goals_after
+
+
+def test_dtype_is_static_budgets_stay_traced():
+    """compute_dtype is a STATIC pytree field — flipping it changes the
+    treedef (a documented recompile) — while budget toggles on the bf16
+    variant still reuse compiled programs (zero new XLA compiles)."""
+    import logging
+
+    pf = EngineParams(compute_dtype="float32")
+    pb = EngineParams(compute_dtype="bfloat16")
+    assert (jax.tree_util.tree_structure(pf)
+            != jax.tree_util.tree_structure(pb))
+    # budget leaves traced: same treedef regardless of budget values
+    assert (jax.tree_util.tree_structure(pb)
+            == jax.tree_util.tree_structure(
+                dataclasses.replace(pb, tail_pass_budget=7, pass_waves=2)))
+
+    ct, meta = _cluster(seed=779)
+    kw = dict(goal_names=CHAIN, raise_on_failure=False,
+              skip_hard_goal_check=True)
+    GoalOptimizer(engine_params=pb).optimizations(ct, meta, **kw)  # compile
+
+    class Counter(logging.Handler):
+        def __init__(self):
+            super().__init__(level=logging.DEBUG)
+            self.count = 0
+
+        def emit(self, record):
+            if "Compiling" in record.getMessage():
+                self.count += 1
+
+    handler = Counter()
+    prev = bool(jax.config.jax_log_compiles)
+    jax.config.update("jax_log_compiles", True)
+    logging.getLogger("jax").addHandler(handler)
+    try:
+        for tweak in ({"pass_waves": 2}, {"tail_pass_budget": 7},
+                      {"max_iters": 11, "stall_retries": 3}):
+            opt = GoalOptimizer(engine_params=dataclasses.replace(pb, **tweak))
+            opt.optimizations(ct, meta, **kw)
+    finally:
+        logging.getLogger("jax").removeHandler(handler)
+        jax.config.update("jax_log_compiles", prev)
+    assert handler.count == 0, \
+        f"{handler.count} recompiles on budget toggles under bf16"
+
+
+@pytest.mark.slow
+def test_bf16_outcome_parity_matrix():
+    """Full parity matrix: the DEFAULT goal chain across the certified
+    parity seeds, f32 vs bf16, with the exhaustive finisher FORCED on
+    (small fixtures normally skip it; it is the all-f32 machinery that pins
+    bf16 outcomes — deep-tail gains sit below one bf16 ulp of the
+    utilizations they are differences of, so only the f32 finisher can
+    drain them) — identical violation counts/sets and fixpoint-certificate
+    sets on every seeded fixture.
+
+    Like the pass_waves>1 contract this parity is EMPIRICAL on the
+    certified fixtures: a reordered greedy trajectory can land a soft goal
+    on a different (equally fixpoint-proven) plateau on adversarial
+    instances — observed at seed 992 (f32 leaves one more goal violated)
+    and seed 995 (bf16 leaves one FEWER violated) — which is exactly why
+    the f32 fallback is pinned exact and the certificates themselves are
+    always f32 statements."""
+    cfg = cruise_control_config({"analyzer.compute.dtype": "auto",
+                                 "analyzer.finisher.min.replicas": 0})
+    for seed in (777, 881, 883, 1234):
+        ct, meta = _cluster(seed=seed)
+        rf = _run(ct, meta, params=EngineParams(compute_dtype="float32"),
+                  config=cfg, goal_names=FULL_CHAIN)
+        rb = _run(ct, meta, params=EngineParams(compute_dtype="bfloat16"),
+                  config=cfg, goal_names=FULL_CHAIN)
+        _assert_outcome_parity(rf, rb, f"seed={seed}")
+
+
+# ----------------------------------------------------------- compact tables
+def test_compact_tables_bit_identical():
+    """Compact (int16/int8) vs int32 device tables: byte-identical final
+    assignments and identical outcomes — the diet changes representation,
+    never results."""
+    ct, meta = _cluster(seed=880)
+    r_on = _run(ct, meta, config=cruise_control_config(
+        {"analyzer.compute.dtype": "float32",
+         "analyzer.compact.tables": True}))
+    r_off = _run(ct, meta, config=cruise_control_config(
+        {"analyzer.compute.dtype": "float32",
+         "analyzer.compact.tables": False}))
+    # the knob actually changes the resident representation...
+    assert r_on.final_state.replica_broker.dtype == np.int16
+    assert r_on.final_state.replica_disk.dtype == np.int8
+    assert r_on.final_state.topic_broker_count.dtype == np.int16
+    assert r_off.final_state.replica_broker.dtype == np.int32
+    assert r_off.final_state.topic_broker_count.dtype == np.int32
+    # ...and the smaller representation is actually smaller
+    def tree_bytes(tree):
+        return sum(x.nbytes for x in jax.tree_util.tree_leaves(tree))
+    assert tree_bytes(r_on.final_state) < tree_bytes(r_off.final_state)
+    assert tree_bytes(r_on.env) < tree_bytes(r_off.env)
+    # ...without changing a single result bit
+    np.testing.assert_array_equal(
+        np.asarray(r_on.final_state.replica_broker, np.int32),
+        np.asarray(r_off.final_state.replica_broker, np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(r_on.final_state.replica_is_leader),
+        np.asarray(r_off.final_state.replica_is_leader))
+    np.testing.assert_array_equal(
+        np.asarray(r_on.final_state.replica_disk, np.int32),
+        np.asarray(r_off.final_state.replica_disk, np.int32))
+    assert r_on.violated_goals_after == r_off.violated_goals_after
+    assert r_on.num_replica_movements == r_off.num_replica_movements
+    assert r_on.num_leadership_movements == r_off.num_leadership_movements
+
+
+# --------------------------------------------------------- session donation
+def _session_fixture(seed=0):
+    from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+    from cruise_control_tpu.monitor.sampling.samplers import (
+        SimulatedMetricSampler,
+    )
+
+    rng = np.random.default_rng(seed)
+    be = SimulatedClusterBackend()
+    for b in range(10):
+        be.add_broker(b, f"r{b % 3}")
+    for p in range(60):
+        reps = [int(x) for x in rng.choice(10, size=2, replace=False)]
+        be.create_partition(f"t{p % 6}", p, reps,
+                            size_mb=float(rng.uniform(10, 500)),
+                            bytes_in_rate=float(rng.uniform(1, 50)),
+                            bytes_out_rate=float(rng.uniform(1, 100)),
+                            cpu_util=float(rng.uniform(0.1, 5)))
+    lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    for i in range(6):
+        lm.sample_once(now_ms=i * 300_000.0)
+    return be, lm
+
+
+def test_session_donation_no_copy_parity():
+    """Donation protocol vs defensive copy: identical optimization results
+    round after round, the donated session hands its resident buffers out
+    (state is LENT — None — until the next sync rematerializes it), and the
+    restored state matches a from-scratch rebuild leaf for leaf."""
+    from cruise_control_tpu.analyzer.env import (
+        make_env, padded_partition_table,
+    )
+    from cruise_control_tpu.analyzer.session import ResidentClusterSession
+    from cruise_control_tpu.analyzer.state import init_state
+    from cruise_control_tpu.model.cluster_tensor import pad_cluster
+
+    goals = ["ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+    opt = GoalOptimizer()
+
+    _, lm_a = _session_fixture(seed=11)
+    _, lm_b = _session_fixture(seed=11)
+    don = ResidentClusterSession(lm_a)                 # donation on (default)
+    cop = ResidentClusterSession(lm_b, config=cruise_control_config(
+        {"analyzer.session.donation": False}))
+    don.sync()
+    cop.sync()
+    assert don._donation and not cop._donation
+
+    for rnd in range(2):
+        res_d = opt.optimizations(None, session=don, goal_names=goals,
+                                  raise_on_failure=False,
+                                  skip_hard_goal_check=True)
+        # protocol evidence: the resident slot was handed over, not copied
+        assert don.state is None, rnd
+        assert don.donated_rounds == rnd + 1
+        res_c = opt.optimizations(None, session=cop, goal_names=goals,
+                                  raise_on_failure=False,
+                                  skip_hard_goal_check=True)
+        assert cop.state is not None                    # copy path keeps it
+        assert res_d.violated_goals_after == res_c.violated_goals_after
+        assert res_d.num_replica_movements == res_c.num_replica_movements
+        assert (res_d.num_leadership_movements
+                == res_c.num_leadership_movements)
+        lm_a.sample_once(now_ms=(6 + rnd) * 300_000.0)
+        lm_b.sample_once(now_ms=(6 + rnd) * 300_000.0)
+        assert don.sync()["mode"] == "delta"
+        assert cop.sync()["mode"] == "delta"
+
+    # the post-donation restore is bit-exact vs a from-scratch rebuild
+    ct, meta = lm_a.cluster_model()
+    ct, meta = pad_cluster(ct, meta)
+    table = padded_partition_table(ct)
+    env = make_env(ct, meta, partition_table=table)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    for f in dataclasses.fields(st):
+        a = np.asarray(getattr(don.state, f.name))
+        b = np.asarray(getattr(st, f.name))
+        assert a.dtype == b.dtype, f"state.{f.name} dtype"
+        assert np.array_equal(a, b), f"state.{f.name}"
+
+
+def test_back_to_back_rounds_without_sync():
+    """Two optimizer rounds with no sync in between: the second call
+    rematerializes from the mirrors (no donated-buffer reuse) and returns
+    the same result."""
+    from cruise_control_tpu.analyzer.session import ResidentClusterSession
+
+    goals = ["ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+    _, lm = _session_fixture(seed=12)
+    sess = ResidentClusterSession(lm)
+    sess.sync()
+    opt = GoalOptimizer()
+    r1 = opt.optimizations(None, session=sess, goal_names=goals,
+                           raise_on_failure=False, skip_hard_goal_check=True)
+    r2 = opt.optimizations(None, session=sess, goal_names=goals,
+                           raise_on_failure=False, skip_hard_goal_check=True)
+    assert r1.violated_goals_after == r2.violated_goals_after
+    assert r1.num_replica_movements == r2.num_replica_movements

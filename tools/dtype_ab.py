@@ -1,0 +1,123 @@
+"""Knob-grid A/B harness for the engine memory diet (PR 5):
+
+    {analyzer.compute.dtype} x {analyzer.compact.tables} x {donation}
+
+per cell: cold + warm full-chain optimize on a bench shape, reporting warm
+wall, violation counts before/after, fixpoint certificates, the per-branch
+pass profile (passes / moves / leads / swaps / waves per goal — the
+tools/pass_prof.py fields, here from the optimizer's own GoalResult
+counters), and the device env/state byte footprint. The donation axis drives
+``tpu.donate.state`` (per-goal buffer donation on the direct optimizer path;
+the resident session's ``analyzer.session.donation`` double-buffer protocol
+is exercised by the bench's e2e steady rounds and tests/test_dtype_policy).
+
+Usage: dtype_ab.py [r2|r3|r4] [--cells dtype,compact,donate;...]
+  e.g.  dtype_ab.py r3
+        dtype_ab.py r2 --cells float32,on,off;bfloat16,on,off
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer  # noqa: E402
+from cruise_control_tpu.config import cruise_control_config  # noqa: E402
+from cruise_control_tpu.model.random_cluster import (  # noqa: E402
+    RandomClusterSpec, generate, generate_scale,
+)
+
+SHAPES = {
+    "r2": lambda: generate(RandomClusterSpec(
+        num_brokers=100, num_racks=10, num_topics=40, num_partitions=5000,
+        max_replication=3, skew=1.0, seed=3140, target_cpu_util=0.45)),
+    "r3": lambda: generate_scale(RandomClusterSpec(
+        num_brokers=1000, num_racks=20, num_topics=200, num_partitions=50000,
+        max_replication=3, skew=1.5, seed=3141, target_cpu_util=0.45)),
+    "r4": lambda: generate_scale(RandomClusterSpec(
+        num_brokers=7000, num_racks=40, num_topics=2000,
+        num_partitions=500000, max_replication=3, skew=1.0, seed=3142,
+        target_cpu_util=0.45)),
+}
+
+
+def tree_bytes(tree) -> int:
+    return int(sum(x.nbytes for x in jax.tree_util.tree_leaves(tree)
+                   if hasattr(x, "nbytes")))
+
+
+def run_cell(ct, meta, dtype: str, compact: bool, donate: bool) -> dict:
+    cfg = cruise_control_config({
+        "analyzer.compute.dtype": dtype,
+        "analyzer.compact.tables": compact,
+        "tpu.donate.state": donate,
+    })
+    opt = GoalOptimizer(config=cfg)
+    walls = []
+    res = None
+    for _ in range(2):                      # cold (compile) + warm
+        t0 = time.monotonic()
+        res = opt.optimizations(ct, meta, raise_on_failure=False,
+                                skip_hard_goal_check=True)
+        walls.append(time.monotonic() - t0)
+    return {
+        "cell": {"dtype": dtype, "compact": compact, "donate": donate},
+        "wall_s_cold": round(walls[0], 2),
+        "wall_s_warm": round(walls[-1], 2),
+        "violations_before": len(res.violated_goals_before),
+        "violations_after": len(res.violated_goals_after),
+        "violated_goals_after": res.violated_goals_after,
+        "fixpoint_proven": [g.name for g in res.goal_results
+                            if g.violated_after and g.fixpoint_proven],
+        "env_bytes": tree_bytes(res.env),
+        "state_bytes": tree_bytes(res.final_state),
+        "pass_profile": {
+            g.name: {"passes": g.passes, "moves": g.move_actions,
+                     "leads": g.lead_actions, "swaps": g.swap_actions,
+                     "disk": g.disk_actions, "waves": g.move_waves,
+                     "finisher": g.finisher_actions}
+            for g in res.goal_results if g.passes or g.iterations
+        },
+    }
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    shape = argv[0] if argv and not argv[0].startswith("--") else "r2"
+    cells = None
+    if "--cells" in argv:
+        spec = argv[argv.index("--cells") + 1]
+        cells = []
+        for c in spec.split(";"):
+            d, co, dn = c.split(",")
+            cells.append((d, co == "on", dn == "on"))
+    if cells is None:
+        cells = [(d, co, dn)
+                 for d in ("float32", "bfloat16")
+                 for co in (True, False)
+                 for dn in (False, True)]
+    ct, meta = SHAPES[shape]()
+    print(f"shape {shape}: B={ct.num_brokers} R={ct.num_replicas}",
+          file=sys.stderr, flush=True)
+    out = []
+    for d, co, dn in cells:
+        cell = run_cell(ct, meta, d, co, dn)
+        out.append(cell)
+        print(f"  {d:9s} compact={int(co)} donate={int(dn)}: "
+              f"warm={cell['wall_s_warm']}s "
+              f"viol={cell['violations_before']}->"
+              f"{cell['violations_after']} "
+              f"env={cell['env_bytes'] / 1e6:.1f}MB "
+              f"state={cell['state_bytes'] / 1e6:.1f}MB",
+              file=sys.stderr, flush=True)
+    print(json.dumps({"shape": shape, "cells": out}))
+
+
+if __name__ == "__main__":
+    main()
