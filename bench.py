@@ -28,9 +28,12 @@ timeout is a bench that doesn't exist):
   SIGTERM first).
 
 Usage: bench.py [rung ...] [--profile] [--skip-cold] [--scenario [name]]
-               [--rung name]
+               [--rung name] [--profile-level off|pass|stage]
   --profile    block per goal for honest per-goal seconds (adds tunnel
                round-trips; not for wall-clock claims)
+  --profile-level  analyzer.profile.level for every rung optimizer: pass =
+               zero-cost pass counters in the RoundTrace, stage = blocking
+               per-segment seconds (the retired CC_PROFILE_SEGMENTS hack)
   --skip-cold  one timed run per rung (trusts the persistent compile cache)
   --scenario   run the self-healing scenario rung (sim/ catalog name,
                default broker-death-50b-1k); emits a "scenario" block with
@@ -46,12 +49,10 @@ vs_baseline > 1 means faster than the BASELINE.json <10 s target.
 from __future__ import annotations
 
 import json
-import logging
 import os
 import signal
 import sys
 import time
-from contextlib import contextmanager
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
@@ -90,36 +91,10 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-class CompileCounter(logging.Handler):
-    """Counts XLA compiles during a phase via jax_log_compiles records, so
-    BENCH JSONs show WHERE trace/compile regressions land (a warm phase must
-    report 0)."""
-
-    def __init__(self):
-        super().__init__(level=logging.DEBUG)
-        self.count = 0
-
-    def emit(self, record):
-        try:
-            if "Compiling" in record.getMessage():
-                self.count += 1
-        except Exception:   # noqa: BLE001 — counting must never break a rung
-            pass
-
-
-@contextmanager
-def count_compiles():
-    import jax
-    prev = bool(jax.config.jax_log_compiles)
-    handler = CompileCounter()
-    jax.config.update("jax_log_compiles", True)
-    jax_logger = logging.getLogger("jax")
-    jax_logger.addHandler(handler)
-    try:
-        yield handler
-    finally:
-        jax_logger.removeHandler(handler)
-        jax.config.update("jax_log_compiles", prev)
+# phase-scoped XLA compile counting (a warm phase must report 0): the
+# counter bench carried privately through r05 now lives in the library
+# (common/tracing.py) so the service and the sim count the same way
+from cruise_control_tpu.common.tracing import count_compiles  # noqa: E402
 
 
 class Summary:
@@ -186,19 +161,19 @@ def device_mem_figures(env=None, state=None) -> dict:
     """Per-rung device-memory block: bytes of the uploaded ClusterEnv, bytes
     of the resident EngineState, and — when the backend exposes allocator
     stats (TPU/GPU; CPU usually doesn't) — the device's peak allocation.
-    The env/state byte counts are exact leaf sums, so BENCH JSONs can track
-    the compact-table and precision-policy diets rung by rung."""
+    The env/state byte counts are exact leaf sums (the library's
+    tree_device_bytes — the same figures the flight recorder stamps into
+    every RoundTrace), so BENCH JSONs can track the compact-table and
+    precision-policy diets rung by rung."""
     import jax
 
-    def _bytes(tree):
-        return int(sum(x.nbytes for x in jax.tree_util.tree_leaves(tree)
-                       if hasattr(x, "nbytes")))
+    from cruise_control_tpu.common.tracing import tree_device_bytes
 
     out = {}
     if env is not None:
-        out["env_bytes"] = _bytes(env)
+        out["env_bytes"] = tree_device_bytes(env)
     if state is not None:
-        out["state_bytes"] = _bytes(state)
+        out["state_bytes"] = tree_device_bytes(state)
     try:
         stats = jax.local_devices()[0].memory_stats() or {}
         for k in ("peak_bytes_in_use", "bytes_in_use"):
@@ -224,9 +199,13 @@ def remaining_budget() -> float:
 
 
 def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
-             profile: bool = False, all_warm: bool = False) -> dict:
+             profile: bool = False, all_warm: bool = False,
+             profile_level: str | None = None) -> dict:
     """``all_warm``: every run hits a warm cache (--skip-cold), so the
-    reported wall is the min over ALL runs, not runs[1:]."""
+    reported wall is the min over ALL runs, not runs[1:].
+    ``profile_level``: analyzer.profile.level for the rung's optimizer
+    (--profile-level pass|stage; pass is the zero-cost counters level the
+    PERF round-8 overhead claim is measured against)."""
     import dataclasses
 
     from cruise_control_tpu.analyzer.engine import EngineParams
@@ -236,7 +215,7 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
     ov = os.environ.get("CC_ENGINE_OVERRIDES")
     params = (dataclasses.replace(EngineParams(), **json.loads(ov))
               if ov else None)
-    opt = GoalOptimizer(engine_params=params)
+    opt = GoalOptimizer(engine_params=params, profile_level=profile_level)
     walls = []
     res = None
     warm_skip_reason = None
@@ -296,6 +275,10 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
     # pass-level profile (engine per-branch counters — free, no blocking):
     # passes, per-branch action split, admission waves and action yield per
     # goal, so BENCH JSONs can track pass-level regressions round to round
+    # flight recorder: the rung's last RoundTrace — the SAME schema the
+    # service serves (/state?substates=ROUND_TRACES), so BENCH files and the
+    # live endpoint are directly comparable
+    rung["last_round_trace"] = opt.recorder.last_json()
     rung["pass_profile"] = {
         g.name: {
             "passes": g.passes,
@@ -355,6 +338,17 @@ def main() -> None:
         else:
             argv = argv[:i] + argv[i + 1:]
         argv.append("scenario")
+    # --profile-level off|pass|stage: analyzer.profile.level for every rung
+    # optimizer (pass = zero-cost counters; stage = blocking per-segment)
+    profile_level = None
+    while "--profile-level" in argv:
+        i = argv.index("--profile-level")
+        if i + 1 >= len(argv) or argv[i + 1].startswith("--"):
+            log("--profile-level requires off|pass|stage")
+            argv = argv[:i] + argv[i + 1:]
+            continue
+        profile_level = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
     # --rung NAME (repeatable): explicit single-rung filter for same-day
     # A/Bs; equivalent to the positional rung-id form
     while "--rung" in argv:
@@ -389,7 +383,8 @@ def main() -> None:
             ct, meta = small_cluster()
             rung = run_rung("deterministic-3broker", ct, meta,
                             goal_names=["DiskUsageDistributionGoal"],
-                            repeats=repeats, profile=profile)
+                            repeats=repeats, profile=profile,
+                profile_level=profile_level)
 
         elif rung_id == "2":
             log("rung 2: 100 brokers / 10k replicas")
@@ -399,7 +394,7 @@ def main() -> None:
                 target_cpu_util=0.45))
             log(f"  generated {meta.num_valid_replicas} replicas")
             rung = run_rung("100b-10k", ct, meta, repeats=repeats,
-                            profile=profile)
+                            profile=profile, profile_level=profile_level)
 
         elif rung_id == "3":
             log("rung 3: 1,000 brokers / 100k replicas (skewed)")
@@ -409,7 +404,7 @@ def main() -> None:
                 target_cpu_util=0.45))
             log(f"  generated {meta.num_valid_replicas} replicas")
             rung = run_rung("1000b-100k", ct, meta, repeats=repeats,
-                            profile=profile)
+                            profile=profile, profile_level=profile_level)
 
         elif rung_id == "4":
             log("rung 4: 7,000 brokers / 1M replicas (north star)")
@@ -422,7 +417,8 @@ def main() -> None:
             # dispatches per run is several seconds run to run
             rung = run_rung("7000b-1M", ct, meta,
                             repeats=max(repeats, 3) if not skip_cold else 2,
-                            profile=profile, all_warm=skip_cold)
+                            profile=profile, all_warm=skip_cold,
+                            profile_level=profile_level)
             SUMMARY.headline = rung
 
         elif rung_id == "5":
@@ -443,7 +439,8 @@ def main() -> None:
                 "CpuCapacityGoal", "ReplicaDistributionGoal",
                 "IntraBrokerDiskCapacityGoal",
                 "IntraBrokerDiskUsageDistributionGoal"],
-                repeats=repeats, profile=profile)
+                repeats=repeats, profile=profile,
+                profile_level=profile_level)
 
         elif rung_id == "e2e":
             # samples -> windows -> ClusterTensor -> proposals END TO END at
@@ -496,6 +493,11 @@ def run_scenario_rung(name: str) -> dict:
         "executor_tasks": r.executor_tasks,
         "wall_s": rung["wall_s"],
         "failures": list(r.failures),
+        # the run's detect/heal latency TIMERS (simulated seconds) — the
+        # sensor catalog chaos campaigns will aggregate distributions from
+        "latency_timers": {k: v for k, v in r.sensors.items()
+                           if "time-to-" in k or "self-healing-fix" in k},
+        "num_round_traces": len(r.round_traces),
     }
     log(f"  [scenario] converged={r.converged} "
         f"detect={r.time_to_detect_ms}ms heal={r.time_to_heal_ms}ms "
@@ -677,6 +679,12 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
         rung["steady_skip_reason"] = steady_skip_reason
     if warmup_s is not None:
         rung["warmup_s"] = round(warmup_s, 2)
+    # observability handoff: the service's own sensor snapshot + the flight
+    # recorder's last RoundTrace — BENCH_* files carry the SAME schema the
+    # live service serves (/metrics, /state?substates=ROUND_TRACES), so a
+    # bench rung and a production scrape are directly comparable
+    rung["sensors"] = cc.sensors.to_json()
+    rung["last_round_trace"] = cc.flight_recorder.last_json()
     log(f"  [e2e] seed={seed_s:.1f}s sample={sample_s / rounds:.2f}s/round "
         f"snapshot={snapshot_s:.2f}s model={model_s:.2f}s "
         f"optimize cold={walls[0]:.2f}s warm={walls[-1]:.2f}s "

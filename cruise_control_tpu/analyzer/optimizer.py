@@ -180,8 +180,10 @@ def _compiled_ple(ple):
 
 class GoalOptimizer:
     def __init__(self, config=None, constraint: BalancingConstraint | None = None,
-                 engine_params: EngineParams | None = None, sensors=None):
+                 engine_params: EngineParams | None = None, sensors=None,
+                 recorder=None, profile_level: str | None = None):
         from cruise_control_tpu.common.sensors import MetricRegistry
+        from cruise_control_tpu.common.tracing import XlaCompileListener
         from cruise_control_tpu.config.defaults import configure_compilation_cache
         # library-level persistent compile cache (jax.compilation.* keys):
         # every process that optimizes — the e2e service included, not just
@@ -190,6 +192,25 @@ class GoalOptimizer:
         self._sensors = sensors if sensors is not None else MetricRegistry()
         # GoalOptimizer.java:125 proposal-computation-timer
         self._proposal_timer = self._sensors.timer("proposal-computation-timer")
+        # library-level compile sensor: every optimizing process counts its
+        # XLA backend compiles (bench-only counting promoted to the library)
+        self._compile_listener = XlaCompileListener.install()
+        self._compile_listener.register_gauges(self._sensors)
+        # flight recorder: always-on per-round traces (common/tracing.py);
+        # a private recorder when the facade didn't hand one over, so
+        # library-only callers (bench, tools) still get traces
+        from cruise_control_tpu.common.tracing import FlightRecorder
+        self.recorder = recorder if recorder is not None else FlightRecorder()
+        # analyzer.profile.level (off|pass|stage): retires CC_PROFILE_SEGMENTS
+        # — the env var stays honored as a deprecated alias for "stage" when
+        # the knob is left at its default
+        if profile_level is None and config is not None:
+            profile_level = config.get_string("analyzer.profile.level")
+        if not profile_level or profile_level == "off":
+            import os as _os
+            if _os.environ.get("CC_PROFILE_SEGMENTS"):
+                profile_level = "stage"
+        self._profile_level = profile_level or "off"
         self._config = config
         if constraint is None:
             constraint = (BalancingConstraint.from_config(config) if config is not None
@@ -369,6 +390,8 @@ class GoalOptimizer:
                        measure_goal_durations,
                        min_leader_topic_pattern=None,
                        session=None) -> OptimizerResult:
+        t_round = time.monotonic()
+        compiles0 = self._compile_listener.count
         names = goal_names or self._default_goal_names
         # honour hard-goal enforcement (KafkaCruiseControl sanityCheckHardGoalPresence)
         if goal_names and not skip_hard_goal_check:
@@ -382,6 +405,9 @@ class GoalOptimizer:
         goals = make_goals(known, self._constraint, options)
         run_preferred = "PreferredLeaderElectionGoal" in names
 
+        session_info = dict(session.last_sync_info) if session is not None else None
+        donated = session is not None and bool(getattr(session, "_donation",
+                                                       False))
         if session is not None:
             # resident fast path: the session owns the padded device env +
             # observed engine state; the snapshot->pad->upload rebuild is
@@ -525,13 +551,14 @@ class GoalOptimizer:
             split = next((i for i, g in enumerate(goals)
                           if getattr(g, "deep_tail", False)), len(goals))
             gclasses = tuple(type(g) for g in goals)
-            # CC_PROFILE_SEGMENTS=1: block + log per segment (debug only —
-            # blocking defeats the async dispatch pipeline). Segment timings
-            # are kept and surfaced into GoalResult.duration_s below, so a
-            # profiled fused run reports honest per-segment seconds instead
-            # of all-zeros.
-            import os as _os
-            _prof = bool(_os.environ.get("CC_PROFILE_SEGMENTS"))
+            # analyzer.profile.level=stage: block + log per segment (debug
+            # only — blocking defeats the async dispatch pipeline it
+            # measures). Segment timings are kept and surfaced into
+            # GoalResult.duration_s below, so a stage-profiled fused run
+            # reports honest per-segment seconds instead of all-zeros.
+            # "pass" costs nothing here: the pass-level profile rides in the
+            # info dicts the chain returns anyway.
+            _prof = self._profile_level == "stage"
             seg_seconds: dict[str, float] = {}
 
             def _tick(label):
@@ -685,6 +712,25 @@ class GoalOptimizer:
         result.final_state = st          # for executor / tests
         result.env = env
         result.meta = meta               # for loadAfterOptimization rendering
+
+        # flight recorder: one RoundTrace per round, from data this method
+        # already computed — host-side dict assembly + device-array METADATA
+        # reads only (no block_until_ready, no copies: the async pipeline and
+        # the session's donation protocol are untouched). Recorded before the
+        # hard-goal failure raise so failed rounds leave a trace too.
+        result.round_trace = self.recorder.record_round(
+            wall_s=time.monotonic() - t_round,
+            goal_results=goal_results,
+            compiles=self._compile_listener.count - compiles0,
+            env=env, state=st,
+            num_proposals=len(proposals),
+            num_replica_movements=n_moves,
+            num_leadership_movements=n_lead,
+            session_info=session_info, donated=donated,
+            profile_level=self._profile_level,
+            durations_measured=(measure_goal_durations
+                                or (use_fused
+                                    and self._profile_level == "stage")))
 
         if raise_on_failure:
             failed = [r.name + (" (iteration budget exhausted)" if r.hit_max_iters else "")

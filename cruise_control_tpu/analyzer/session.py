@@ -313,6 +313,15 @@ class ResidentClusterSession:
             "lastSync": dict(self.last_sync_info),
         }
 
+    def device_bytes(self) -> dict:
+        """Resident device footprint {env_bytes, state_bytes}: exact leaf
+        sums over array METADATA (no sync, no copy — gauge-safe). A state
+        currently lent to an in-flight optimizer round reads 0 state bytes."""
+        from cruise_control_tpu.common.tracing import tree_device_bytes
+        with self.lock:
+            return {"env_bytes": tree_device_bytes(self.env),
+                    "state_bytes": tree_device_bytes(self.state)}
+
     # ------------------------------------------------- state materialization
     def _ensure_state(self) -> None:
         """Rematerialize the resident state from the host mirrors if the

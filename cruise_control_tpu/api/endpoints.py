@@ -6,6 +6,11 @@ parsing) and servlet/KafkaCruiseControlServletUtils.java. The reference
 instantiates one Parameters class per endpoint; here each endpoint declares a
 flat spec of typed parameters, parsed/validated in one pass — unknown or
 ill-typed parameters are a 400, like ParameterUtils does.
+
+``GET /metrics`` (Prometheus text exposition of the sensor registry) is
+deliberately NOT an EndPoint member: it keeps the reference's 20-endpoint
+catalog intact, takes no parameters, and serves text/plain — the server
+routes it before endpoint dispatch (api/server.py), authorized like STATE.
 """
 from __future__ import annotations
 

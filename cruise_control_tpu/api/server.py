@@ -237,14 +237,26 @@ class CruiseControlServer:
         """Returns (status_code, body_dict, extra_headers)."""
         import time as _time
         t0 = _time.monotonic()
-        status, body, headers = self._handle(method, endpoint, params, client,
-                                             task_id_header)
-        # per-endpoint success timer (KafkaCruiseControlServlet.java:64);
-        # 202 progress polls / purgatory parks are NOT completed executions —
-        # recording them would make the timer describe polling, not latency
         sensors = getattr(self.app, "sensors", None)
+        try:
+            status, body, headers = self._handle(method, endpoint, params,
+                                                 client, task_id_header)
+        except Exception:
+            # parameter/validation errors raised mid-handling surface as
+            # 4xx/5xx upstream — they are failed executions too
+            if sensors is not None:
+                sensors.timer(f"{endpoint.path}-failed-request-execution-timer"
+                              ).record(_time.monotonic() - t0)
+            raise
+        # per-endpoint success/failure timers (KafkaCruiseControlServlet
+        # .java:64 successfulRequestExecutionTimer + its failed twin); 202
+        # progress polls / purgatory parks are NEITHER completed NOR failed
+        # executions — recording them would make the timers describe polling
         if sensors is not None and status == 200:
             sensors.timer(f"{endpoint.path}-successful-request-execution-timer"
+                          ).record(_time.monotonic() - t0)
+        elif sensors is not None and status >= 400:
+            sensors.timer(f"{endpoint.path}-failed-request-execution-timer"
                           ).record(_time.monotonic() - t0)
         return status, body, headers
 
@@ -581,6 +593,32 @@ def _make_handler(server: CruiseControlServer):
                 # the canonical prefix keeps working under a custom mount
                 path = path[len(URL_PREFIX):]
             name = path.strip("/").split("/")[0]
+            if name == "metrics" and method == "GET":
+                # GET /metrics: Prometheus text exposition of the whole
+                # MetricRegistry + flight-recorder last-round gauges. Not an
+                # EndPoint enum member (the reference's 20-endpoint catalog
+                # stays intact); authorized like /state — a monitor-level
+                # read — and served as text/plain, not JSON.
+                try:
+                    _, role = server.security.authenticate(
+                        self.headers, client_ip=self.client_address[0])
+                    if not server.security.authorize(role, EndPoint.STATE,
+                                                     "GET"):
+                        raise AuthError(
+                            f"role {role} may not access GET /metrics", 403)
+                except AuthError as e:
+                    self._send(e.status, error_json(str(e)), {})
+                    return
+                try:
+                    text = server.app.metrics_text()
+                except Exception as e:  # noqa: BLE001 — rendered as the error body
+                    self._send(500, error_json(f"{type(e).__name__}: {e}",
+                                               traceback.format_exc()), {})
+                    return
+                self._send_raw(
+                    200, text.encode("utf-8"),
+                    "text/plain; version=0.0.4; charset=utf-8", {})
+                return
             endpoint = EndPoint.from_path(name)
             if endpoint is None:
                 if method == "GET" and self._serve_ui(parsed.path):

@@ -62,16 +62,27 @@ class OperationResult:
 class CruiseControl:
     def __init__(self, backend, config=None):
         from cruise_control_tpu.common.sensors import MetricRegistry
+        from cruise_control_tpu.common.tracing import FlightRecorder
         self.config = config or cruise_control_config()
         self.backend = backend
         # one registry for the whole app — the MetricRegistry -> JMX domain
         # kafka.cruisecontrol role (KafkaCruiseControlApp.java:29,40); exported
-        # via /state?substates=SENSORS
+        # via /state?substates=SENSORS and GET /metrics (Prometheus text)
         self.sensors = MetricRegistry()
+        # one flight recorder for the whole app: every optimization round
+        # leaves a RoundTrace (common/tracing.py), served by
+        # /state?substates=ROUND_TRACES; traces carry the backend clock so
+        # the sim's records live on simulated time
+        self.flight_recorder = FlightRecorder(
+            capacity=self.config.get_int("flight.recorder.capacity"),
+            clock_ms=self._now_ms)
+        self.flight_recorder.register_gauges(self.sensors)
         self.load_monitor = LoadMonitor(config=self.config, backend=backend,
-                                        sensors=self.sensors)
+                                        sensors=self.sensors,
+                                        recorder=self.flight_recorder)
         self.goal_optimizer = GoalOptimizer(config=self.config,
-                                            sensors=self.sensors)
+                                            sensors=self.sensors,
+                                            recorder=self.flight_recorder)
         self.executor = Executor(backend, config=self.config,
                                  sensors=self.sensors)
         oes = self.load_monitor.on_execution_store
@@ -112,6 +123,20 @@ class CruiseControl:
             from cruise_control_tpu.analyzer.session import ResidentClusterSession
             self.resident_session = ResidentClusterSession(
                 self.load_monitor, config=self.config)
+            # runtime sensors over the resident session: device footprint,
+            # delta-vs-epoch round split and donation counts — the steady
+            # path's health at a glance (and in every Prometheus scrape)
+            sess = self.resident_session
+            self.sensors.gauge("resident-session-state-bytes",
+                               lambda: sess.device_bytes()["state_bytes"])
+            self.sensors.gauge("resident-session-env-bytes",
+                               lambda: sess.device_bytes()["env_bytes"])
+            self.sensors.gauge("resident-session-delta-rounds",
+                               lambda: sess.delta_rounds)
+            self.sensors.gauge("resident-session-rebuild-rounds",
+                               lambda: sess.rebuild_rounds)
+            self.sensors.gauge("resident-session-donated-rounds",
+                               lambda: sess.donated_rounds)
         self._wire_detectors()
         self._proposal_cache: OptimizerResult | None = None
         self._proposal_cache_generation = None
@@ -454,6 +479,8 @@ class CruiseControl:
         # optimization.options.generator.class seam: deployments may rewrite
         # the options of any internally-triggered optimization
         options = self._options_generator.optimization_options(options, operation)
+        # tag this thread's next round trace with the operation name
+        self.flight_recorder.note_operation(operation)
         res = self.goal_optimizer.optimizations(
             ct, meta, goal_names=goals, options=options,
             skip_hard_goal_check=skip_hard_goal_check, session=session)
@@ -826,6 +853,7 @@ class CruiseControl:
                 ct = self._apply_excluded_topics(ct, meta, None)
             # the precompute path records violations instead of failing the
             # cache refresh (GoalOptimizer.java precompute thread logs+retries)
+            self.flight_recorder.note_operation("PROPOSALS")
             res = self.goal_optimizer.optimizations(ct, meta,
                                                     raise_on_failure=False,
                                                     session=session)
@@ -862,7 +890,19 @@ class CruiseControl:
             out["AnomalyDetectorState"] = self.anomaly_detector.state_json()
         if "SENSORS" in substates:
             out["Sensors"] = self.sensors.to_json()
+        if "ROUND_TRACES" in substates:
+            # flight recorder: the bounded ring of per-round traces
+            out["RoundTraces"] = self.flight_recorder.to_json()
         return out
+
+    def metrics_text(self) -> str:
+        """GET /metrics: the whole MetricRegistry — timers as summaries,
+        meters as counters+rates, gauges (incl. the flight recorder's
+        last-round gauges) — in Prometheus text exposition format. The ingest
+        side already speaks Prometheus (monitor/sampling/prometheus.py), so a
+        CC instance can scrape itself."""
+        from cruise_control_tpu.common.tracing import render_prometheus
+        return render_prometheus(self.sensors.to_json())
 
     def kafka_cluster_state(self, verbose: bool = False) -> dict:
         """GET /kafka_cluster_state

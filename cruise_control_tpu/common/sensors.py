@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import logging
 import math
+import random
 import threading
 import time
 
@@ -37,6 +38,11 @@ class Timer:
         self._total = 0.0
         self._max = 0.0
         self._values: list[float] = []
+        # per-timer seeded RNG for reservoir sampling: the hot path must not
+        # touch the GLOBAL random module — perturbing its state from a timer
+        # would break the sim's bit-identical (scenario, seed) timelines for
+        # anything seeding/consuming the global stream
+        self._rng = random.Random(self.RESERVOIR)
 
     def record(self, seconds: float) -> None:
         with self._lock:
@@ -46,8 +52,7 @@ class Timer:
             if len(self._values) < self.RESERVOIR:
                 self._values.append(seconds)
             else:  # vitter's algorithm R: uniform over the full history
-                import random
-                j = random.randrange(self._count)
+                j = self._rng.randrange(self._count)
                 if j < self.RESERVOIR:
                     self._values[j] = seconds
 
@@ -69,6 +74,7 @@ class Timer:
         return {
             "type": "timer", "count": count,
             "meanSec": round(total / count, 6) if count else 0.0,
+            "totalSec": round(total, 6),   # exact _sum for /metrics summaries
             "maxSec": round(mx, 6),
             "p50Sec": round(self._percentile(vals, 0.50), 6),
             "p95Sec": round(self._percentile(vals, 0.95), 6),
@@ -102,19 +108,26 @@ class Meter:
         self._bucket_count = 0
         self._prev_rate = 0.0
 
+    def _roll(self, now: float) -> None:
+        """Caller holds the lock. Close the trailing bucket once it spans a
+        minute. Rolling ONLY on mark() was a bug: after events stop, the
+        "one-minute" rate kept being computed over an ever-growing window —
+        reads must roll (and thereby decay toward zero) too."""
+        if now - self._bucket_start >= 60.0:
+            self._prev_rate = self._bucket_count / (now - self._bucket_start)
+            self._bucket_start = now
+            self._bucket_count = 0
+
     def mark(self, n: int = 1) -> None:
         with self._lock:
-            now = self._clock()
-            if now - self._bucket_start >= 60.0:
-                self._prev_rate = self._bucket_count / (now - self._bucket_start)
-                self._bucket_start = now
-                self._bucket_count = 0
+            self._roll(self._clock())
             self._count += n
             self._bucket_count += n
 
     def to_json(self) -> dict:
         with self._lock:
             now = self._clock()
+            self._roll(now)
             elapsed = max(now - self._start, 1e-9)
             bucket_elapsed = max(now - self._bucket_start, 1e-9)
             recent = (self._bucket_count / bucket_elapsed

@@ -1,0 +1,402 @@
+"""Flight recorder: always-on per-round traces + runtime compile sensors.
+
+The reference's operability rests on its Dropwizard sensor catalog
+(proposal-computation-timer, cluster-model-creation-timer, per-endpoint
+request timers — docs/wiki Sensors.md); what it cannot answer is "what did
+THIS proposal round spend its time on?". Until now neither could we: per-stage
+timing, XLA compile events and device memory were only visible through
+``bench.py``'s private bookkeeping or the blocking ``CC_PROFILE_SEGMENTS``
+debug hack. This module is the library-level answer:
+
+- :class:`RoundTrace` — one record per optimization round, assembled from data
+  the engine already computes (per-goal ``GoalResult`` counters, the pass
+  profile, session sync mode/seconds/donation, the last sampling round's
+  seconds, XLA compile count delta, env/state device bytes). Assembly costs a
+  few dict builds and ``nbytes`` reads on device-array *metadata* — no
+  synchronization, no device copies, so the async dispatch pipeline and the
+  donation protocol are untouched.
+- :class:`FlightRecorder` — a bounded thread-safe ring buffer of traces,
+  served by ``/state?substates=ROUND_TRACES`` and snapshotted by ``bench.py``
+  and the sim ``ScenarioRunner`` (one schema everywhere).
+- :class:`XlaCompileListener` — promotes bench-only compile counting to a
+  library-level sensor: a process-wide ``jax.monitoring`` duration listener
+  counting backend compiles (a persistent-cache hit deserializes and does NOT
+  count — exactly the "new executable built" semantics the zero-new-compile
+  contracts assert).
+- :class:`CompileCounter` / :func:`count_compiles` — the log-record-based
+  counter bench.py used to carry privately; kept because its semantics
+  ("Compiling ..." records, which include cache-served compiles) are what the
+  BENCH_* trajectory files were measured with.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+DEFAULT_CAPACITY = 64
+
+# jax.monitoring event emitted once per XLA backend compile (not emitted when
+# the persistent compilation cache serves the executable)
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+# ---------------------------------------------------------------------------
+# compile sensors
+# ---------------------------------------------------------------------------
+class XlaCompileListener:
+    """Process-wide XLA compile counter (jax.monitoring based).
+
+    ``install()`` registers the jax.monitoring listener once per process and
+    returns the singleton; every GoalOptimizer construction calls it, so any
+    process that optimizes — the service, the sim runner, bench — carries the
+    sensor. Reads are cheap ints; the flight recorder uses count deltas to
+    attribute compiles to rounds, and the registry exposes the running totals
+    as ``xla-compile-count`` / ``xla-compile-seconds`` gauges.
+    """
+
+    _instance: "XlaCompileListener | None" = None
+    _install_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._seconds = 0.0
+
+    @classmethod
+    def install(cls) -> "XlaCompileListener":
+        with cls._install_lock:
+            if cls._instance is None:
+                inst = cls()
+                import jax.monitoring
+
+                def on_duration(name: str, secs: float, **kw) -> None:
+                    if name == _BACKEND_COMPILE_EVENT:
+                        with inst._lock:
+                            inst._count += 1
+                            inst._seconds += float(secs)
+
+                jax.monitoring.register_event_duration_secs_listener(
+                    on_duration)
+                cls._instance = inst
+            return cls._instance
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def seconds(self) -> float:
+        with self._lock:
+            return self._seconds
+
+    def register_gauges(self, sensors) -> None:
+        sensors.gauge("xla-compile-count", lambda: self.count)
+        sensors.gauge("xla-compile-seconds", lambda: round(self.seconds, 3))
+
+
+class CompileCounter:
+    """Counts XLA compiles during a phase via jax_log_compiles records
+    (the counter bench.py carried privately; semantics preserved: counts
+    "Compiling ..." log records, which fire even when the persistent cache
+    serves the executable)."""
+
+    def __init__(self):
+        import logging
+
+        class _H(logging.Handler):
+            def __init__(self, outer):
+                super().__init__(level=logging.DEBUG)
+                self._outer = outer
+
+            def emit(self, record):
+                try:
+                    if "Compiling" in record.getMessage():
+                        self._outer.count += 1
+                except Exception:  # noqa: BLE001 — counting must never break a run
+                    pass
+
+        self.count = 0
+        self._handler = _H(self)
+
+    @property
+    def handler(self):
+        return self._handler
+
+
+@contextmanager
+def count_compiles():
+    """``with count_compiles() as c: ...; c.count`` — the bench.py phase
+    counter, now shared library code."""
+    import logging
+
+    import jax
+    prev = bool(jax.config.jax_log_compiles)
+    counter = CompileCounter()
+    jax.config.update("jax_log_compiles", True)
+    jax_logger = logging.getLogger("jax")
+    jax_logger.addHandler(counter.handler)
+    try:
+        yield counter
+    finally:
+        jax_logger.removeHandler(counter.handler)
+        jax.config.update("jax_log_compiles", prev)
+
+
+def tree_device_bytes(tree) -> int:
+    """Exact leaf-sum bytes of a device pytree — array METADATA only (no
+    transfer, no block): safe on in-flight/donated-lineage buffers."""
+    import jax
+    return int(sum(x.nbytes for x in jax.tree_util.tree_leaves(tree)
+                   if hasattr(x, "nbytes")))
+
+
+# ---------------------------------------------------------------------------
+# round traces
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class RoundTrace:
+    """One optimization round, flight-recorder schema (all host-side data the
+    round computed anyway; per-goal seconds are honest only at
+    ``analyzer.profile.level=stage`` or ``measure_goal_durations=True`` —
+    ``durations_measured`` says which)."""
+    round_id: int
+    ts_ms: float
+    operation: str | None           # REBALANCE / PROPOSALS / FIX_* / None
+    wall_s: float                   # whole optimizations() call
+    sampling_s: float | None        # last noted monitor sampling round
+    sync_mode: str | None           # resident session: "delta" | "rebuild"
+    sync_s: float | None
+    donated: bool                   # this round took the resident state
+    profile_level: str              # off | pass | stage
+    durations_measured: bool
+    compiles: int                   # XLA backend compiles during the round
+    env_bytes: int
+    state_bytes: int
+    num_proposals: int
+    num_replica_movements: int
+    num_leadership_movements: int
+    goals: list = dataclasses.field(default_factory=list)
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["wall_s"] = round(out["wall_s"], 4)
+        return out
+
+
+def goal_trace_rows(goal_results) -> list[dict]:
+    """Per-goal trace rows from GoalResult records — the engine's pass-level
+    profile (passes, per-branch action split, admission waves, finisher
+    actions) plus the violation flags and (when measured) seconds."""
+    return [{
+        "name": g.name,
+        "duration_s": round(g.duration_s, 4),
+        "violated_before": g.violated_before,
+        "violated_after": g.violated_after,
+        "iterations": g.iterations,
+        "passes": g.passes,
+        "moves": g.move_actions,
+        "leads": g.lead_actions,
+        "swaps": g.swap_actions,
+        "disk": g.disk_actions,
+        "waves": g.move_waves,
+        "finisher": g.finisher_actions,
+    } for g in goal_results]
+
+
+class FlightRecorder:
+    """Bounded thread-safe ring buffer of :class:`RoundTrace` records.
+
+    Always on and deliberately cheap: ``record`` is a lock + deque append.
+    ``clock_ms`` is injectable so traces carry the backend's clock (simulated
+    time in the sim; wall time in the service). ``note_sampling`` /
+    ``note_operation`` let the layers that know those facts (monitor, facade)
+    annotate the NEXT recorded round without the optimizer needing to know
+    either — the operation note is thread-local so concurrent user-task
+    rounds can't cross-tag each other.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, clock_ms=None):
+        self.capacity = int(capacity)
+        self.clock_ms = clock_ms or (lambda: time.time() * 1000.0)
+        self._lock = threading.Lock()
+        self._traces: deque[RoundTrace] = deque(maxlen=self.capacity)
+        self._recorded = 0
+        self._next_id = 0
+        self._sampling_s: float | None = None
+        self._tl = threading.local()
+
+    # ------------------------------------------------------------ annotate
+    def note_sampling(self, seconds: float) -> None:
+        with self._lock:
+            self._sampling_s = round(float(seconds), 4)
+
+    def note_operation(self, operation: str) -> None:
+        self._tl.operation = operation
+
+    def _take_operation(self) -> str | None:
+        op = getattr(self._tl, "operation", None)
+        self._tl.operation = None
+        return op
+
+    # -------------------------------------------------------------- record
+    def next_round_id(self) -> int:
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            return rid
+
+    def record(self, trace: RoundTrace) -> None:
+        with self._lock:
+            self._traces.append(trace)
+            self._recorded += 1
+
+    def record_round(self, *, wall_s: float, goal_results, compiles: int,
+                     env, state, num_proposals: int,
+                     num_replica_movements: int,
+                     num_leadership_movements: int,
+                     session_info: dict | None = None, donated: bool = False,
+                     profile_level: str = "off",
+                     durations_measured: bool = False) -> RoundTrace:
+        """Assemble + record one round from what the optimizer already holds.
+        Never raises into the optimization path."""
+        info = session_info or {}
+        with self._lock:
+            sampling_s = self._sampling_s
+        try:
+            trace = RoundTrace(
+                round_id=self.next_round_id(),
+                ts_ms=float(self.clock_ms()),
+                operation=self._take_operation(),
+                wall_s=wall_s,
+                sampling_s=sampling_s,
+                sync_mode=info.get("mode"),
+                sync_s=info.get("sync_s"),
+                donated=donated,
+                profile_level=profile_level,
+                durations_measured=durations_measured,
+                compiles=int(compiles),
+                env_bytes=tree_device_bytes(env),
+                state_bytes=tree_device_bytes(state),
+                num_proposals=int(num_proposals),
+                num_replica_movements=int(num_replica_movements),
+                num_leadership_movements=int(num_leadership_movements),
+                goals=goal_trace_rows(goal_results),
+            )
+        except Exception:  # noqa: BLE001 — tracing must never fail a round
+            import logging
+            logging.getLogger(__name__).exception("round trace assembly failed")
+            return None
+        self.record(trace)
+        return trace
+
+    # ---------------------------------------------------------------- read
+    def last(self) -> RoundTrace | None:
+        with self._lock:
+            return self._traces[-1] if self._traces else None
+
+    def last_json(self) -> dict | None:
+        t = self.last()
+        return t.to_json() if t is not None else None
+
+    def traces(self) -> list[RoundTrace]:
+        with self._lock:
+            return list(self._traces)
+
+    def to_json(self) -> dict:
+        with self._lock:
+            traces = list(self._traces)
+            recorded = self._recorded
+        return {"capacity": self.capacity, "recorded": recorded,
+                "traces": [t.to_json() for t in traces]}
+
+    def register_gauges(self, sensors) -> None:
+        """Last-round gauges on the MetricRegistry, so /metrics carries the
+        newest round without parsing the trace substate."""
+        def field(name, default=0):
+            def read():
+                t = self.last()
+                v = getattr(t, name, None) if t is not None else None
+                return default if v is None else v
+            return read
+
+        sensors.gauge("round-traces-recorded",
+                      lambda: self.to_json()["recorded"])
+        sensors.gauge("last-round-wall-seconds", field("wall_s", 0.0))
+        sensors.gauge("last-round-sampling-seconds", field("sampling_s", 0.0))
+        sensors.gauge("last-round-sync-seconds", field("sync_s", 0.0))
+        sensors.gauge("last-round-compiles", field("compiles"))
+        sensors.gauge("last-round-env-bytes", field("env_bytes"))
+        sensors.gauge("last-round-state-bytes", field("state_bytes"))
+        sensors.gauge("last-round-proposals", field("num_proposals"))
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+def _prom_name(name: str, suffix: str = "") -> str:
+    import re
+    base = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if base and base[0].isdigit():
+        base = "_" + base
+    return f"cc_{base}{suffix}"
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f)
+
+
+def render_prometheus(registry_json: dict) -> str:
+    """Render one MetricRegistry snapshot (``MetricRegistry.to_json()``) in
+    Prometheus text exposition format 0.0.4.
+
+    Timers render as summaries (quantiles + _sum/_count) plus a ``_max``
+    gauge; meters as a ``_total`` counter plus a one-minute-rate gauge;
+    gauges as gauges (non-numeric / errored gauges are skipped — a dead gauge
+    must not poison the scrape). The ingest side of this repo already parses
+    this family of formats (monitor/sampling/prometheus.py), so a CC instance
+    can scrape itself — the round-trip the tests run.
+    """
+    lines: list[str] = []
+    for name in sorted(registry_json):
+        snap = registry_json[name]
+        kind = snap.get("type")
+        if kind == "timer":
+            m = _prom_name(name, "_seconds")
+            total = snap.get("totalSec",
+                             snap.get("meanSec", 0.0) * snap.get("count", 0))
+            lines.append(f"# TYPE {m} summary")
+            for q, key in (("0.5", "p50Sec"), ("0.95", "p95Sec"),
+                           ("0.99", "p99Sec")):
+                lines.append(f'{m}{{quantile="{q}"}} {_fmt(snap[key])}')
+            lines.append(f"{m}_sum {_fmt(total)}")
+            lines.append(f"{m}_count {snap['count']}")
+            mx = _prom_name(name, "_seconds_max")
+            lines.append(f"# TYPE {mx} gauge")
+            lines.append(f"{mx} {_fmt(snap['maxSec'])}")
+        elif kind == "meter":
+            m = _prom_name(name, "_total")
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {snap['count']}")
+            r = _prom_name(name, "_one_minute_rate")
+            lines.append(f"# TYPE {r} gauge")
+            lines.append(f"{r} {_fmt(snap['oneMinuteRatePerSec'])}")
+        elif kind == "gauge":
+            if "value" not in snap:
+                continue        # errored gauge: skip, never poison the scrape
+            try:
+                val = _fmt(snap["value"])
+            except (TypeError, ValueError):
+                continue        # non-numeric gauge (strings etc.)
+            m = _prom_name(name)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {val}")
+    return "\n".join(lines) + "\n"

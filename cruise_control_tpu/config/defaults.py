@@ -232,6 +232,27 @@ _D.define(name="analyzer.session.max.delta.fraction", type=Type.DOUBLE, default=
               "by deltas since the epoch's rebuild exceed this fraction of "
               "the cluster's replicas, the next round rebuilds from scratch "
               "(a fresh epoch) instead of applying further deltas.")
+_D.define(name="analyzer.profile.level", type=Type.STRING, default="off",
+          validator=in_set("off", "pass", "stage"),
+          validator_doc="one of: off, pass, stage",
+          doc="TPU-specific: per-round engine profiling depth (retires the "
+              "CC_PROFILE_SEGMENTS env hack; the env var is still honored as "
+              "a deprecated alias for 'stage' when this key is left at its "
+              "default). 'pass' surfaces the already-traced pass-level "
+              "profile (passes, per-branch action split, admission waves, "
+              "finisher actions) into the flight recorder at ZERO device "
+              "cost — the async dispatch pipeline is untouched; 'stage' "
+              "additionally blocks per fused-chain segment "
+              "(block_until_ready) so GoalResult.duration_s carries honest "
+              "per-segment seconds — debug only, it serializes the dispatch "
+              "pipeline it measures. Host-side knob: toggling it never "
+              "triggers a recompile (certified in tests/test_tracing.py).")
+_D.define(name="flight.recorder.capacity", type=Type.INT, default=64,
+          validator=at_least(1),
+          doc="Flight recorder ring-buffer size: how many per-round traces "
+              "(common/tracing.py RoundTrace) are retained and served by "
+              "/state?substates=ROUND_TRACES. Recording is always on; the "
+              "buffer bound is the memory cap.")
 _D.define(name="goal.balancedness.priority.weight", type=Type.DOUBLE, default=1.1,
           validator=at_least(1.0),
           doc="Balancedness score: weight step per goal priority rank "

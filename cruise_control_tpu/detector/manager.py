@@ -149,6 +149,7 @@ class AnomalyDetectorManager:
             verdict = self._notifier.on_anomaly(anomaly, now_ms)
             entry = {"anomaly": anomaly.to_json(), "action": verdict.action.value}
             if verdict.action is Action.FIX and self._cc is not None:
+                sensors = getattr(self._cc, "sensors", None)
                 try:
                     if (anomaly.anomaly_type is AnomalyType.MAINTENANCE_EVENT
                             and self._maintenance_stops_ongoing
@@ -159,9 +160,25 @@ class AnomalyDetectorManager:
                     result = anomaly.fix(self._cc)
                     entry["fixResult"] = result
                     self._self_healing_actions += 1
+                    if sensors is not None:
+                        # heal-latency timers (sensor catalog): detection ->
+                        # FIX-complete per anomaly type, on the injected
+                        # clock (simulated seconds in the sim — chaos
+                        # campaigns get time-to-heal distributions for free;
+                        # a blocking FIX execution advances that clock)
+                        end_ms = (self._clock.now_ms()
+                                  if self._clock is not None else now_ms)
+                        sensors.timer(
+                            f"{anomaly.anomaly_type.name.lower()}"
+                            "-self-healing-fix-timer").record(
+                            max(end_ms - anomaly.detected_ms, 0.0) / 1000.0)
+                        sensors.timer("anomaly-detection-to-fix-timer").record(
+                            max(now_ms - anomaly.detected_ms, 0.0) / 1000.0)
                 except Exception as e:
                     LOG.exception("self-healing fix failed for %s", anomaly)
                     entry["fixError"] = str(e)
+                    if sensors is not None:
+                        sensors.meter("self-healing-fix-failures").mark()
             elif verdict.action is Action.CHECK:
                 with self._lock:
                     self._deferred.append((now_ms + verdict.delay_ms, anomaly))

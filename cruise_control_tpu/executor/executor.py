@@ -305,11 +305,15 @@ class Executor:
         from cruise_control_tpu.common.sensors import MetricRegistry
         self._sensors = sensors if sensors is not None else MetricRegistry()
         # Executor sensor catalog (Sensors.md): ongoing-execution gauge +
-        # started/stopped execution meters
+        # started/stopped execution meters + the proposal-execution-timer
+        # (whole 3-phase execution wall, on the injected clock — simulated
+        # seconds in the sim, so heal executions feed the same catalog the
+        # chaos campaigns aggregate)
         self._sensors.gauge("ongoing-execution",
                             lambda: int(self.has_ongoing_execution()))
         self._execution_meter = self._sensors.meter("execution-started")
         self._execution_stopped_meter = self._sensors.meter("execution-stopped")
+        self._execution_timer = self._sensors.timer("proposal-execution-timer")
         self._backend = backend
         self._cfg = (ExecutorConfigView.from_config(config) if config is not None
                      else ExecutorConfigView())
@@ -577,6 +581,7 @@ class Executor:
     # ------------------------------------------------------------ internals
     def _run_execution(self, planner: ExecutionTaskPlanner) -> None:
         throttled, throttled_topics = False, []
+        t0_ms = self._clock.now_ms()
         try:
             throttled, throttled_topics = self._set_throttles(planner)
             self._inter_broker_phase(planner)
@@ -586,6 +591,8 @@ class Executor:
                 self._leadership_phase(planner)
         finally:
             self._clear_throttles(throttled, throttled_topics)
+            self._execution_timer.record(
+                max(self._clock.now_ms() - t0_ms, 0.0) / 1000.0)
             done = sum(1 for t in planner.all_tasks
                        if t.state is TaskState.COMPLETED)
             self._history.append({

@@ -79,11 +79,15 @@ class LoadMonitorState:
 
 class LoadMonitor:
     def __init__(self, config=None, backend=None, sampler=None, sample_store=None,
-                 capacity_resolver=None, sensors=None):
+                 capacity_resolver=None, sensors=None, recorder=None):
         from cruise_control_tpu.common.sensors import MetricRegistry
         self._sensors = sensors if sensors is not None else MetricRegistry()
+        # flight recorder (common/tracing.py): sampling rounds note their
+        # seconds so the next optimization's RoundTrace carries sampling_s
+        self._recorder = recorder
         # sensor catalog (LoadMonitor.java:180-195 gauges + :173 timer)
         self._model_timer = self._sensors.timer("cluster-model-creation-timer")
+        self._sampling_timer = self._sensors.timer("metric-sampling-timer")
         self._sensors.gauge(
             "valid-windows",
             lambda: len(self._partition_agg.aggregate().window_starts_ms))
@@ -323,6 +327,7 @@ class LoadMonitor:
         .fetchMetricSamples path). Returns #samples ingested."""
         if self._state == LoadMonitorState.PAUSED or self._sampler is None:
             return 0
+        t0 = time.monotonic()
         now = now_ms if now_ms is not None else time.time() * 1000.0
         # the fetcher pool splits the partition universe across concurrent
         # fetchers (MetricFetcherManager + partition assignor role)
@@ -346,6 +351,10 @@ class LoadMonitor:
             # store that keeps only mid-execution samples (its own class
             # gates on executor.has_ongoing_execution)
             self.on_execution_store.store_samples(samples)
+        dur = time.monotonic() - t0
+        self._sampling_timer.record(dur)
+        if self._recorder is not None:
+            self._recorder.note_sampling(dur)
         return n
 
     def _ingest(self, samples: Samples) -> int:

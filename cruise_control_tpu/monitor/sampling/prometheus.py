@@ -24,6 +24,41 @@ from cruise_control_tpu.monitor.sampling.samplers import (
 )
 
 
+def parse_prometheus_text(text: str) -> dict:
+    """Parse Prometheus text exposition format (0.0.4) into
+    ``{(metric_name, (sorted (label, value) pairs)): float}``.
+
+    The counterpart of ``common/tracing.render_prometheus`` — a CC instance
+    scrapes ITSELF through this (GET /metrics -> parse -> samples), and the
+    tests round-trip every registered sensor through it. Handles the subset
+    the exposition side emits (and any standard exporter's gauges/counters/
+    summaries): ``# TYPE``/``# HELP`` comments, ``name{labels} value`` and
+    ``name value`` sample lines; timestamps are accepted and ignored."""
+    import re
+    samples: dict = {}
+    line_rx = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                         r"(?:\{([^}]*)\})?\s+(\S+)(?:\s+(-?\d+))?$")
+    label_rx = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = line_rx.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line: {raw!r}")
+        name, labelstr, value = m.group(1), m.group(2), m.group(3)
+        labels = tuple(sorted(
+            (k, v.replace('\\"', '"').replace("\\\\", "\\")
+             .replace("\\n", "\n"))
+            for k, v in label_rx.findall(labelstr or "")))
+        if value in ("+Inf", "-Inf", "Nan", "NaN"):
+            val = float(value.replace("Inf", "inf"))
+        else:
+            val = float(value)
+        samples[(name, labels)] = val
+    return samples
+
+
 class DefaultPrometheusQuerySupplier:
     """PromQL per model metric (DefaultPrometheusQuerySupplier.java role,
     node-exporter + JMX-exporter default naming)."""

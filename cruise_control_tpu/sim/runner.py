@@ -77,6 +77,14 @@ class ScenarioResult:
     timeline: list = dataclasses.field(default_factory=list)
     invariant_violations: list = dataclasses.field(default_factory=list)
     failures: list = dataclasses.field(default_factory=list)
+    # flight-recorder consumption: the app's RoundTrace ring (timestamps on
+    # SIMULATED time) and the final sensor snapshot — the same records the
+    # service serves via /state?substates=ROUND_TRACES and GET /metrics,
+    # replacing any runner-private bookkeeping. Excluded from to_json(): wall
+    # seconds inside them are process-dependent, the timeline must stay
+    # bit-identical per (scenario, seed).
+    round_traces: list = dataclasses.field(default_factory=list)
+    sensors: dict = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -102,6 +110,7 @@ class ScenarioResult:
             "ticks": self.ticks,
             "sim_duration_ms": self.sim_duration_ms,
             "num_invariant_violations": len(self.invariant_violations),
+            "num_round_traces": len(self.round_traces),
             "failures": list(self.failures),
         }
 
@@ -361,6 +370,19 @@ class ScenarioRunner:
         if fix_errors:
             r.failures.append(f"{len(fix_errors)} self-healing fixes raised "
                               f"(first: {fix_errors[0]['fixError']})")
+        # detect/heal latency TIMERS (simulated seconds): scenario runs
+        # populate the same sensor catalog chaos campaigns will aggregate
+        if r.time_to_detect_ms is not None:
+            self.cc.sensors.timer("time-to-detect-timer").record(
+                r.time_to_detect_ms / 1000.0)
+        if r.time_to_heal_ms is not None:
+            self.cc.sensors.timer("time-to-heal-timer").record(
+                r.time_to_heal_ms / 1000.0)
+        # hand the flight recorder's rounds + the sensor snapshot to the
+        # caller — bench --scenario and the tests read THESE, not private
+        # runner bookkeeping
+        r.round_traces = self.cc.flight_recorder.to_json()["traces"]
+        r.sensors = self.cc.sensors.to_json()
         self.cc.shutdown()
 
 

@@ -63,9 +63,17 @@ def test_app_sensor_catalog(sim_app):
     sensors = app.state_json(substates=["SENSORS"])["Sensors"]
     assert sensors["proposal-computation-timer"]["count"] >= 1
     assert sensors["cluster-model-creation-timer"]["count"] >= 1
+    assert sensors["metric-sampling-timer"]["count"] >= 1
     assert sensors["valid-windows"]["value"] >= 1
     assert 0.0 <= sensors["monitored-partitions-percentage"]["value"] <= 1.0
     assert sensors["ongoing-execution"]["value"] == 0
+    # registered at wiring time, idle until an execution runs
+    assert sensors["proposal-execution-timer"]["count"] == 0
+    # runtime sensors (PR 6): compile listener + resident-session gauges +
+    # flight-recorder last-round gauges ride in the same registry
+    assert sensors["xla-compile-count"]["value"] >= 0
+    assert sensors["resident-session-delta-rounds"]["value"] >= 0
+    assert sensors["last-round-wall-seconds"]["value"] > 0
 
 
 @pytest.fixture

@@ -168,6 +168,40 @@ def test_smoke_timeline_is_bit_identical_across_runs(smoke_runs):
     assert r1.to_json() == r2.to_json()
 
 
+def test_flight_recorder_matches_runner_accounting(smoke_runs):
+    """The scenario run populates the library-level detect/heal latency
+    timers, and the flight recorder's RoundTraces agree with the runner's
+    own time_to_heal_ms accounting — the runner consumes the SAME records
+    the service serves, not private bookkeeping."""
+    import pytest as _pytest
+    r, r2 = smoke_runs
+    # detect/heal TIMERS (simulated seconds) match the runner's numbers
+    assert r.sensors["time-to-detect-timer"]["count"] == 1
+    assert r.sensors["time-to-detect-timer"]["maxSec"] == _pytest.approx(
+        r.time_to_detect_ms / 1000.0)
+    assert r.sensors["time-to-heal-timer"]["count"] == 1
+    assert r.sensors["time-to-heal-timer"]["maxSec"] == _pytest.approx(
+        r.time_to_heal_ms / 1000.0)
+    # the manager's per-type heal timer fired for the broker-failure FIX
+    heal = r.sensors["broker_failure-self-healing-fix-timer"]
+    assert heal["count"] >= 1
+    # the executor timed its healing execution on the SIMULATED clock
+    assert r.sensors["proposal-execution-timer"]["count"] >= 1
+    # a FIX that completed at heal time can never exceed fault->heal latency
+    assert heal["maxSec"] * 1000.0 <= r.time_to_heal_ms + 1e-6
+    # the recorder captured the healing optimization round(s): the broker
+    # failure fixes via REMOVE_BROKER; traces live on SIMULATED time
+    fix_traces = [t for t in r.round_traces
+                  if t["operation"] == "REMOVE_BROKER"]
+    assert fix_traces, [t["operation"] for t in r.round_traces]
+    assert all(t["num_proposals"] > 0 for t in fix_traces)
+    # trace timestamps are simulated ms -> deterministic across reruns
+    assert [t["ts_ms"] for t in r.round_traces] == \
+        [t["ts_ms"] for t in r2.round_traces]
+    assert [t["operation"] for t in r.round_traces] == \
+        [t["operation"] for t in r2.round_traces]
+
+
 def test_different_seed_changes_cluster_not_contract():
     sc = SCENARIOS["broker-death-smoke"]
     r = run_scenario(sc, seed=3)

@@ -1,0 +1,419 @@
+"""Flight recorder + Prometheus exposition + profile-level certification.
+
+Covers the PR-6 observability contracts:
+- sensor fixes: per-timer reservoir RNG (the global ``random`` module must
+  never be touched from the hot path), meter one-minute-rate decay on read;
+- flight recorder: ring-buffer bounds + thread safety under concurrent
+  rounds, RoundTrace assembly in the optimizer, /state?substates=ROUND_TRACES;
+- GET /metrics: valid Prometheus text exposition for EVERY registered
+  timer/meter/gauge, proven by round-tripping through the in-repo sampler
+  side's text parser (monitor/sampling/prometheus.parse_prometheus_text);
+- per-endpoint failed-request timers (KafkaCruiseControlServlet parity);
+- ``analyzer.profile.level``: toggling off/pass/stage is zero-new-XLA-compile
+  and bit-identical in optimizer outcomes (the retired CC_PROFILE_SEGMENTS
+  hack's replacement must not perturb the thing it measures).
+"""
+import json
+import random
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.sensors import Meter, MetricRegistry, Timer
+from cruise_control_tpu.common.tracing import (
+    FlightRecorder, RoundTrace, XlaCompileListener, render_prometheus,
+    tree_device_bytes,
+)
+from cruise_control_tpu.monitor.sampling.prometheus import (
+    parse_prometheus_text,
+)
+
+
+# ------------------------------------------------------------- sensor fixes
+def test_timer_reservoir_leaves_global_rng_alone():
+    """Reservoir sampling past the bound must not consume the GLOBAL random
+    stream — that would perturb seeded (scenario, seed) determinism for any
+    co-resident consumer of the module-level RNG."""
+    random.seed(12345)
+    state_before = random.getstate()
+    t = Timer()
+    for i in range(Timer.RESERVOIR + 500):   # 500 reservoir replacements
+        t.record(float(i % 7) / 100.0)
+    assert random.getstate() == state_before
+    snap = t.to_json()
+    assert snap["count"] == Timer.RESERVOIR + 500
+    assert snap["totalSec"] == pytest.approx(
+        sum(float(i % 7) / 100.0 for i in range(Timer.RESERVOIR + 500)))
+
+
+def test_timer_reservoir_is_deterministic_per_timer():
+    a, b = Timer(), Timer()
+    for i in range(Timer.RESERVOIR + 200):
+        a.record(float(i)); b.record(float(i))
+    assert a.to_json() == b.to_json()
+
+
+def test_meter_one_minute_rate_decays_on_read():
+    """The trailing bucket must roll on READ too: after events stop, the
+    one-minute rate decays toward zero instead of averaging the whole gap."""
+    now = [0.0]
+    m = Meter(clock=lambda: now[0])
+    for _ in range(60):
+        m.mark()
+    now[0] = 59.0
+    assert m.to_json()["oneMinuteRatePerSec"] == pytest.approx(60 / 59.0)
+    # events stop; ten minutes later the "one-minute" rate must be ~0, not
+    # 60 events / 659 s mislabeled as a one-minute rate
+    now[0] = 659.0
+    first = m.to_json()["oneMinuteRatePerSec"]
+    assert first <= 60 / 600.0 + 1e-9
+    now[0] = 725.0   # a further window with zero events -> hard zero
+    assert m.to_json()["oneMinuteRatePerSec"] == 0.0
+    assert m.to_json()["count"] == 60
+
+
+# --------------------------------------------------------- flight recorder
+def _mk_trace(rec: FlightRecorder, i: int) -> RoundTrace:
+    return RoundTrace(
+        round_id=rec.next_round_id(), ts_ms=float(i), operation="REBALANCE",
+        wall_s=0.1, sampling_s=None, sync_mode=None, sync_s=None,
+        donated=False, profile_level="off", durations_measured=False,
+        compiles=0, env_bytes=0, state_bytes=0, num_proposals=i,
+        num_replica_movements=0, num_leadership_movements=0, goals=[])
+
+
+def test_ring_buffer_bounds_and_thread_safety():
+    rec = FlightRecorder(capacity=8, clock_ms=lambda: 0.0)
+    threads = [threading.Thread(
+        target=lambda: [rec.record(_mk_trace(rec, i)) for i in range(50)])
+        for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = rec.to_json()
+    assert snap["capacity"] == 8
+    assert snap["recorded"] == 200
+    assert len(snap["traces"]) == 8
+    # round ids are unique even under concurrency
+    ids = [t.round_id for t in rec.traces()]
+    assert len(set(ids)) == len(ids)
+    assert rec.last() is not None
+
+
+def test_recorder_notes_are_thread_local_and_consumed_once():
+    rec = FlightRecorder(capacity=4)
+    rec.note_operation("REBALANCE")
+    seen = {}
+
+    def other():
+        seen["op"] = rec._take_operation()
+
+    t = threading.Thread(target=other)
+    t.start(); t.join()
+    assert seen["op"] is None             # another thread can't steal the tag
+    assert rec._take_operation() == "REBALANCE"
+    assert rec._take_operation() is None  # consumed exactly once
+
+
+def test_record_round_assembles_from_engine_data():
+    from cruise_control_tpu.analyzer.optimizer import GoalResult
+    rec = FlightRecorder(capacity=4, clock_ms=lambda: 1234.0)
+    rec.note_sampling(0.25)
+    rec.note_operation("PROPOSALS")
+    gr = GoalResult(name="RackAwareGoal", violated_before=True,
+                    violated_after=False, iterations=3, duration_s=0.5,
+                    stat_after=0.0, passes=2, move_actions=3, move_waves=2)
+    arrays = {"a": np.zeros((4, 4), np.float32)}   # 64 bytes of "device" tree
+    trace = rec.record_round(
+        wall_s=1.5, goal_results=[gr], compiles=2, env=arrays,
+        state={"b": np.zeros(8, np.int32)}, num_proposals=7,
+        num_replica_movements=5, num_leadership_movements=2,
+        session_info={"mode": "delta", "sync_s": 0.04}, donated=True,
+        profile_level="pass")
+    assert trace is rec.last()
+    j = trace.to_json()
+    assert j["ts_ms"] == 1234.0 and j["operation"] == "PROPOSALS"
+    assert j["sampling_s"] == 0.25 and j["sync_mode"] == "delta"
+    assert j["donated"] is True and j["compiles"] == 2
+    assert j["env_bytes"] == 64 and j["state_bytes"] == 32
+    assert j["goals"][0]["name"] == "RackAwareGoal"
+    assert j["goals"][0]["waves"] == 2 and j["goals"][0]["moves"] == 3
+    # the operation tag was consumed: an untagged round records None
+    t2 = rec.record_round(wall_s=0.1, goal_results=[], compiles=0, env=None,
+                          state=None, num_proposals=0,
+                          num_replica_movements=0, num_leadership_movements=0)
+    assert t2.operation is None and t2.sampling_s == 0.25
+
+
+def test_tree_device_bytes_none_and_metadata_only():
+    assert tree_device_bytes(None) == 0
+    import jax.numpy as jnp
+    x = jnp.zeros((16, 16), jnp.float32)
+    assert tree_device_bytes({"x": x, "y": None}) == 16 * 16 * 4
+
+
+# --------------------------------------------- Prometheus text round-trip
+def test_render_parse_roundtrip_unit():
+    reg = MetricRegistry()
+    t = reg.timer("proposal-computation-timer")
+    for v in (0.1, 0.2, 0.4):
+        t.record(v)
+    reg.meter("execution-started").mark(5)
+    reg.gauge("valid-windows", lambda: 3)
+    reg.gauge("weird/name with spaces", lambda: 1.5)
+    reg.gauge("broken-gauge", lambda: 1 / 0)     # must be skipped, not fatal
+    reg.gauge("string-gauge", lambda: "not-a-number")   # skipped too
+    text = render_prometheus(reg.to_json())
+    samples = parse_prometheus_text(text)
+    assert samples[("cc_proposal_computation_timer_seconds_count", ())] == 3
+    assert samples[("cc_proposal_computation_timer_seconds_sum", ())] == \
+        pytest.approx(0.7)
+    assert samples[("cc_proposal_computation_timer_seconds",
+                    (("quantile", "0.5"),))] == pytest.approx(0.2)
+    assert samples[("cc_proposal_computation_timer_seconds_max", ())] == \
+        pytest.approx(0.4)
+    assert samples[("cc_execution_started_total", ())] == 5
+    assert samples[("cc_valid_windows", ())] == 3
+    assert samples[("cc_weird_name_with_spaces", ())] == 1.5
+    assert not any("broken" in k[0] or "string_gauge" in k[0] for k in samples)
+
+
+def test_every_sensor_kind_round_trips():
+    """Every registered timer/meter/gauge must land in the exposition with
+    its value intact — the acceptance-criterion round-trip, sensor by
+    sensor."""
+    reg = MetricRegistry()
+    for i in range(5):
+        tm = reg.timer(f"t{i}-timer")
+        for j in range(i + 1):
+            tm.record(0.01 * (j + 1))
+        reg.meter(f"m{i}-meter").mark(i)
+        reg.gauge(f"g{i}-gauge", lambda i=i: i * 1.5)
+    snap = reg.to_json()
+    samples = parse_prometheus_text(render_prometheus(snap))
+    for name, s in snap.items():
+        base = "cc_" + name.replace("-", "_")
+        if s["type"] == "timer":
+            assert samples[(base + "_seconds_count", ())] == s["count"]
+            assert samples[(base + "_seconds_sum", ())] == \
+                pytest.approx(s["totalSec"])
+            for q, key in (("0.5", "p50Sec"), ("0.95", "p95Sec"),
+                           ("0.99", "p99Sec")):
+                assert samples[(base + "_seconds", (("quantile", q),))] == \
+                    pytest.approx(s[key])
+        elif s["type"] == "meter":
+            assert samples[(base + "_total", ())] == s["count"]
+            assert samples[(base + "_one_minute_rate", ())] == \
+                pytest.approx(s["oneMinuteRatePerSec"])
+        else:
+            assert samples[(base, ())] == pytest.approx(s["value"])
+
+
+# ------------------------------------------------------- HTTP: app + server
+def _backend(n_brokers=4, rf=2, n_parts=12):
+    from cruise_control_tpu.backend import SimulatedClusterBackend
+    be = SimulatedClusterBackend()
+    for b in range(n_brokers):
+        be.add_broker(b, f"r{b % 2}")
+    for p in range(n_parts):
+        replicas = [(p + i) % n_brokers for i in range(rf)]
+        be.create_partition("t", p, replicas, size_mb=100.0 + 40 * (p % 3),
+                            bytes_in_rate=50.0, bytes_out_rate=100.0,
+                            cpu_util=2.0)
+    return be
+
+
+@pytest.fixture(scope="module")
+def served_app():
+    from cruise_control_tpu.api import CruiseControlServer
+    from cruise_control_tpu.app import CruiseControl
+    from cruise_control_tpu.config import cruise_control_config
+    cc = CruiseControl(_backend(), cruise_control_config({
+        "num.metrics.windows": 5, "min.samples.per.metrics.window": 1,
+        "flight.recorder.capacity": 16}))
+    cc.start_up()
+    for i in range(12):
+        cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+    cc.rebalance(dry_run=True)
+    srv = CruiseControlServer(cc, port=0, max_block_ms=120_000.0)
+    srv.start()
+    yield cc, srv
+    srv.stop()
+    cc.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=300) as resp:
+        return resp.status, resp.read().decode(), dict(resp.headers)
+
+
+def test_metrics_endpoint_serves_every_sensor(served_app):
+    """GET /metrics: valid exposition for the WHOLE registry, verified by
+    parsing with the ingest side's text parser (the self-scrape round-trip)."""
+    cc, srv = served_app
+    status, text, headers = _get(f"{srv.base_url}/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    samples = parse_prometheus_text(text)       # raises on any invalid line
+    snap = cc.sensors.to_json()
+    # every registered sensor is present under its exposition name
+    for name, s in snap.items():
+        base = "cc_" + "".join(ch if ch.isalnum() else "_" for ch in name)
+        if s["type"] == "timer":
+            assert (base + "_seconds_count", ()) in samples, name
+        elif s["type"] == "meter":
+            assert (base + "_total", ()) in samples, name
+        elif "value" in s and isinstance(s["value"], (int, float)):
+            assert (base, ()) in samples, name
+    # the reference catalog's flagships + this PR's runtime sensors made it
+    assert samples[("cc_proposal_computation_timer_seconds_count", ())] >= 1
+    assert samples[("cc_cluster_model_creation_timer_seconds_count", ())] >= 1
+    assert samples[("cc_metric_sampling_timer_seconds_count", ())] >= 12
+    assert ("cc_xla_compile_count", ()) in samples
+    # flight-recorder last-round gauges ride in the same scrape
+    assert samples[("cc_last_round_wall_seconds", ())] > 0
+    assert samples[("cc_round_traces_recorded", ())] >= 1
+    # prefix-less URL works too (Prometheus default scrape path)
+    base_root = srv.base_url.rsplit("/kafkacruisecontrol", 1)[0]
+    status2, text2, _ = _get(f"{base_root}/metrics")
+    assert status2 == 200 and "cc_proposal_computation_timer" in text2
+
+
+def test_round_traces_substate(served_app):
+    cc, srv = served_app
+    status, text, _ = _get(f"{srv.base_url}/state?substates=ROUND_TRACES")
+    assert status == 200
+    body = json.loads(text)
+    rt = body["RoundTraces"]
+    assert rt["capacity"] == 16 and rt["recorded"] >= 1
+    trace = rt["traces"][-1]
+    assert trace["operation"] in ("REBALANCE", "PROPOSALS")
+    assert trace["wall_s"] > 0 and trace["env_bytes"] > 0
+    assert trace["sampling_s"] is not None   # monitor noted its round
+    names = {g["name"] for g in trace["goals"]}
+    assert "RackAwareGoal" in names
+    # default /state stays trace-free (payload bound)
+    status, text, _ = _get(f"{srv.base_url}/state")
+    assert "RoundTraces" not in json.loads(text)
+
+
+def test_failed_request_timer_recorded(served_app):
+    """Non-200 responses record the failed-request twin of the per-endpoint
+    success timer (KafkaCruiseControlServlet parity)."""
+    cc, srv = served_app
+    req = urllib.request.Request(f"{srv.base_url}/review", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=60)
+    assert ei.value.code == 400      # two-step verification is not enabled
+    snap = cc.sensors.to_json()
+    assert snap["review-failed-request-execution-timer"]["count"] >= 1
+    assert "review-successful-request-execution-timer" not in snap
+
+
+def test_trace_view_renders_served_trace(served_app):
+    cc, _ = served_app
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        "trace_view", pathlib.Path(__file__).parent.parent
+        / "tools" / "trace_view.py")
+    tv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tv)
+    doc = {"RoundTraces": cc.flight_recorder.to_json()}
+    traces = tv._collect(doc)
+    assert traces
+    out = tv.render(traces[-1])
+    assert "RackAwareGoal" in out and "compiles" in out
+
+
+# ------------------------------------- analyzer.profile.level certification
+CHAIN = ["RackAwareGoal", "DiskCapacityGoal", "ReplicaDistributionGoal",
+         "DiskUsageDistributionGoal"]
+
+
+def _profile_cfg(level):
+    from cruise_control_tpu.config import cruise_control_config
+    return cruise_control_config({
+        # force the fused chain on the small fixture: the profile knob's
+        # stage path lives there
+        "analyzer.fused.chain.min.replicas": 0,
+        "analyzer.profile.level": level,
+    })
+
+
+@pytest.fixture(scope="module")
+def profile_runs():
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.model.random_cluster import (
+        RandomClusterSpec, generate,
+    )
+    ct, meta = generate(RandomClusterSpec(
+        num_brokers=16, num_racks=4, num_topics=8, num_partitions=200,
+        max_replication=2, skew=1.5, seed=4242))
+    kw = dict(goal_names=CHAIN, raise_on_failure=False,
+              skip_hard_goal_check=True)
+    listener = XlaCompileListener.install()
+    results, compiles = {}, {}
+    for level in ("off", "pass", "stage"):
+        c0 = listener.count
+        opt = GoalOptimizer(config=_profile_cfg(level))
+        results[level] = opt.optimizations(ct, meta, **kw)
+        compiles[level] = listener.count - c0
+    return results, compiles
+
+
+def test_profile_level_toggle_zero_new_compiles(profile_runs):
+    """off -> pass -> stage reuse the SAME compiled programs: the profiling
+    knob is host-side only (the PR 4/5 toggling contract)."""
+    _, compiles = profile_runs
+    assert compiles["pass"] == 0, compiles
+    assert compiles["stage"] == 0, compiles
+
+
+def test_profile_level_outcomes_bit_identical(profile_runs):
+    results, _ = profile_runs
+    base = results["off"]
+    for level in ("pass", "stage"):
+        res = results[level]
+        np.testing.assert_array_equal(
+            np.asarray(base.final_state.replica_broker),
+            np.asarray(res.final_state.replica_broker), err_msg=level)
+        np.testing.assert_array_equal(
+            np.asarray(base.final_state.replica_is_leader),
+            np.asarray(res.final_state.replica_is_leader), err_msg=level)
+        assert base.violated_goals_after == res.violated_goals_after
+        for g0, g1 in zip(base.goal_results, res.goal_results):
+            assert (g0.name, g0.iterations, g0.passes, g0.violated_after,
+                    g0.move_actions, g0.move_waves) == \
+                   (g1.name, g1.iterations, g1.passes, g1.violated_after,
+                    g1.move_actions, g1.move_waves)
+
+
+def test_profile_levels_surface_where_promised(profile_runs):
+    """pass: zero-cost counters in the trace (durations stay 0 — honesty);
+    stage: per-segment seconds land in GoalResult.duration_s."""
+    results, _ = profile_runs
+    t_off = results["off"].round_trace
+    t_pass = results["pass"].round_trace
+    t_stage = results["stage"].round_trace
+    assert t_off.profile_level == "off"
+    assert t_pass.profile_level == "pass"
+    assert not t_pass.durations_measured
+    assert any(g["passes"] > 0 for g in t_pass.goals)
+    assert t_stage.durations_measured
+    assert sum(g.duration_s for g in results["stage"].goal_results) > 0
+    assert sum(g["duration_s"] for g in t_stage.goals) > 0
+
+
+def test_profile_env_var_is_deprecated_alias(monkeypatch):
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    monkeypatch.setenv("CC_PROFILE_SEGMENTS", "1")
+    assert GoalOptimizer()._profile_level == "stage"
+    # an explicit config knob wins over the legacy env var
+    assert GoalOptimizer(profile_level="pass")._profile_level == "pass"
+    monkeypatch.delenv("CC_PROFILE_SEGMENTS")
+    assert GoalOptimizer()._profile_level == "off"

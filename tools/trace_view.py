@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Render recorded flight-recorder round traces as a text flamegraph/table.
+
+Input (file path or ``-`` for stdin), any of:
+  - a ``/state?substates=ROUND_TRACES`` response (or its ``RoundTraces`` value)
+  - a BENCH_*.json summary (rungs[].last_round_trace)
+  - a raw RoundTrace JSON object or a JSON list of them
+
+Usage:
+  tools/trace_view.py TRACES.json [--last] [--width 48]
+
+Per trace it prints the round header (operation, wall, sampling/sync split,
+compiles, device bytes) and a per-goal table with bars: bar length tracks
+``duration_s`` when the trace carries honest per-goal seconds
+(``durations_measured`` — analyzer.profile.level=stage or --profile runs)
+and the applied-action count otherwise, with pass/wave/finisher counters
+alongside — the pass-level profile every trace carries for free.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _collect(doc) -> list[dict]:
+    """Find RoundTrace dicts in any of the accepted document shapes."""
+    if isinstance(doc, list):
+        return [t for t in doc if isinstance(t, dict) and "goals" in t]
+    if not isinstance(doc, dict):
+        return []
+    if "goals" in doc and "round_id" in doc:
+        return [doc]
+    out: list[dict] = []
+    # /state response: {"RoundTraces": {"traces": [...]}} (maybe nested in
+    # the wrap() envelope); recorder snapshot: {"traces": [...]}
+    for key in ("RoundTraces", "json"):
+        if key in doc:
+            out.extend(_collect(doc[key]))
+    if "traces" in doc:
+        out.extend(_collect(doc["traces"]))
+    # BENCH summary: rungs[].last_round_trace
+    for rung in doc.get("rungs", []) or []:
+        if isinstance(rung, dict) and rung.get("last_round_trace"):
+            out.extend(_collect(rung["last_round_trace"]))
+    if doc.get("last_round_trace"):
+        out.extend(_collect(doc["last_round_trace"]))
+    return out
+
+
+def _bar(frac: float, width: int) -> str:
+    n = max(0, min(width, round(frac * width)))
+    return "█" * n + "·" * (width - n)
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GB"
+
+
+def render(trace: dict, width: int = 48) -> str:
+    lines = []
+    head = (f"round {trace.get('round_id')}"
+            f" · {trace.get('operation') or 'OPTIMIZE'}"
+            f" · wall {trace.get('wall_s', 0):.3f}s"
+            f" · {trace.get('compiles', 0)} compiles"
+            f" · profile={trace.get('profile_level', 'off')}")
+    lines.append(head)
+    parts = []
+    if trace.get("sampling_s") is not None:
+        parts.append(f"sampling {trace['sampling_s']:.3f}s")
+    if trace.get("sync_mode"):
+        parts.append(f"sync {trace['sync_s']:.3f}s ({trace['sync_mode']}"
+                     f"{', donated' if trace.get('donated') else ''})")
+    parts.append(f"env {_fmt_bytes(trace.get('env_bytes'))}")
+    parts.append(f"state {_fmt_bytes(trace.get('state_bytes'))}")
+    parts.append(f"{trace.get('num_proposals', 0)} proposals")
+    lines.append("  " + " · ".join(parts))
+    goals = trace.get("goals", [])
+    measured = bool(trace.get("durations_measured")) and any(
+        g.get("duration_s", 0) > 0 for g in goals)
+    metric = "duration_s" if measured else "iterations"
+    top = max((g.get(metric, 0) or 0 for g in goals), default=0) or 1
+    unit = "s" if measured else " actions"
+    lines.append(f"  per-goal bars: {metric}"
+                 f"{'' if measured else ' (per-goal seconds need profile.level=stage)'}")
+    name_w = max((len(g["name"]) for g in goals), default=4)
+    for g in goals:
+        v = g.get(metric, 0) or 0
+        flags = "".join((
+            "V" if g.get("violated_after") else "·",
+            "v" if g.get("violated_before") else "·"))
+        detail = (f"p={g.get('passes', 0):<4} w={g.get('waves', 0):<4} "
+                  f"m={g.get('moves', 0)} l={g.get('leads', 0)} "
+                  f"s={g.get('swaps', 0)} d={g.get('disk', 0)} "
+                  f"f={g.get('finisher', 0)}")
+        val = f"{v:.3f}{unit}" if measured else f"{int(v)}{unit}"
+        lines.append(f"  {g['name']:<{name_w}} {flags} "
+                     f"{_bar(v / top, width)} {val:>12}  {detail}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    width = 48
+    if "--width" in argv:
+        width = int(argv[argv.index("--width") + 1])
+        args = [a for a in args if a != str(width)]
+    if not args:
+        print(__doc__, file=sys.stderr)
+        return 2
+    raw = (sys.stdin.read() if args[0] == "-"
+           else open(args[0]).read())
+    # BENCH files are one JSON document per line; take the last parseable one
+    doc = None
+    for line in [raw] + raw.strip().splitlines()[::-1]:
+        try:
+            doc = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if doc is None:
+        print("no parseable JSON document found", file=sys.stderr)
+        return 1
+    traces = _collect(doc)
+    if not traces:
+        print("no round traces found in document", file=sys.stderr)
+        return 1
+    if "--last" in argv:
+        traces = traces[-1:]
+    for t in traces:
+        print(render(t, width=width))
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    # die quietly when the pipe closes (`trace_view ... | head`)
+    import signal
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    raise SystemExit(main(sys.argv[1:]))
