@@ -42,8 +42,14 @@ Usage: bench.py [rung ...] [--profile] [--skip-cold] [--scenario [name]]
                positional form: 1..5, e2e, e2e7k, scenario) — the same-day
                A/B workflow's "rerun one rung without paying the ladder"
 
-Final line: {"metric": ..., "value": warm_wall_s_at_7k_1M, "unit": "s",
-             "vs_baseline": 10.0 / value, "rungs": [...]}
+Output contract: after every rung the FULL cumulative summary prints as a
+pretty block, followed by ONE compact machine-parseable JSON line (the same
+document with bulky per-rung blobs — last_round_trace, sensors,
+pass_profile — stripped; see BULKY_RUNG_KEYS). The compact line is always
+the last stdout line and is small enough that no tail capture truncates it
+(the BENCH_r05 "parsed": null bug); BENCH_partial.json keeps the full
+document. Final line: {"metric": ..., "value": warm_wall_s_at_7k_1M,
+"unit": "s", "vs_baseline": 10.0 / value, "rungs": [...]};
 vs_baseline > 1 means faster than the BASELINE.json <10 s target.
 """
 from __future__ import annotations
@@ -97,6 +103,30 @@ def log(msg: str) -> None:
 from cruise_control_tpu.common.tracing import count_compiles  # noqa: E402
 
 
+# rung keys too bulky for the machine-parseable LAST line: the driver parses
+# only the final stdout line, and BENCH_r05's single line — megabytes of
+# embedded trace/sensor blobs — came back truncated mid-line by the tail
+# capture, recording "parsed": null. The compact line drops these (they stay
+# in the pretty block above and in BENCH_partial.json).
+BULKY_RUNG_KEYS = ("last_round_trace", "sensors", "pass_profile",
+                   "goal_seconds", "goal_passes", "goal_actions",
+                   "steady_phases", "actions_remaining", "device_mem",
+                   "steady_device_mem", "violated_goals_after",
+                   "budget_exhausted", "fixpoint_proven", "latency_timers")
+
+
+def compact_summary(out: dict) -> dict:
+    """The final-line document: the full summary with per-rung bulky blobs
+    stripped — every scalar a trajectory comparison needs, small enough that
+    no tail capture can truncate it."""
+    compact = {k: v for k, v in out.items() if k != "rungs"}
+    compact["rungs"] = [
+        r if not isinstance(r, dict)
+        else {k: v for k, v in r.items() if k not in BULKY_RUNG_KEYS}
+        for r in out.get("rungs", [])]
+    return compact
+
+
 class Summary:
     """Cumulative result document, re-emitted after every rung."""
 
@@ -145,11 +175,16 @@ class Summary:
             # self-healing latency block (sim/ scenario engine): tracks
             # time-to-detect / time-to-heal in SIMULATED ms across rounds
             out["scenario"] = self.scenario
-        line = json.dumps(out)
-        print(line, flush=True)
+        # pretty block first (humans + trace_view's whole-file parse of
+        # BENCH_partial.json), then ONE compact machine-parseable line —
+        # always the last stdout line, small enough that the driver's tail
+        # capture can never truncate it (the BENCH_r05 "parsed": null bug)
+        full = json.dumps(out)
+        print(json.dumps(out, indent=1), flush=True)
+        print(json.dumps(compact_summary(out)), flush=True)
         try:
             with open("BENCH_partial.json", "w") as f:
-                f.write(line + "\n")
+                f.write(full + "\n")
         except OSError:
             pass
 
@@ -288,6 +323,10 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
             "disk": g.disk_actions,
             "waves": g.move_waves,
             "finisher": g.finisher_actions,
+            # segment-parallel finisher phase: segments the applied waves
+            # spread over (0 = legacy) + boundary rows re-validated
+            "segments": g.finisher_segments,
+            "boundary": g.finisher_boundary,
             "yield_per_pass": round(g.iterations / g.passes, 2) if g.passes else 0.0,
         }
         for g in res.goal_results if g.passes or g.iterations

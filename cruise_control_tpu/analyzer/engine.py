@@ -66,19 +66,24 @@ NEG_INF = -jnp.inf
 # bf16 out for accounting — one bf16 ulp near 1.0 is ~4e-3.
 ACCT_DTYPE = jnp.float32
 
-# Float leaves the SCORING sweeps read, cast to the compute dtype when the
-# policy asks for bf16. Wide [K, B]/[KL, F]/[K1, K2] score fusions and the
-# [R]-sized candidate keyings are HBM-bandwidth-bound on TPU — halving their
-# bytes halves per-pass traffic. The TRUE f32 env/state keeps flowing to
-# masks, chain-acceptance rooms, wave admission, applies, severity/violation
-# measures and the exhaustive certificate scans.
-_SWEEP_ENV_FIELDS = ("leader_load", "follower_load", "broker_capacity",
-                     "broker_disk_capacity")
-_SWEEP_STATE_FIELDS = ("util", "leader_util", "potential_nw_out", "disk_util")
+# Float leaves the SCORING sweeps read in the compute dtype when the policy
+# asks for bf16: the [R, M] per-replica load tables — THE HBM-bandwidth wall
+# of the [K, B]/[KL, F]/[K1, K2] score fusions and the [R]-sized candidate
+# keyings (every sweep streams them; halving their bytes halves per-pass
+# traffic). Broker-level accounting deliberately does NOT ride bf16 anymore:
+# PR 5 cast the [B]-level accumulators too, and the rung-4 A/B showed the
+# cost — tail gains are DIFFERENCES of utilizations, and one bf16 ulp of the
+# accumulator magnitude swallows them (10→6 vs 10→3 violations at 1M). The
+# [B, M] tables are tiny (and TPU gathers pay per index, not per byte), so
+# keeping them f32 costs no bandwidth while making bf16 score arithmetic
+# f32-accurate wherever it differences broker state. The TRUE f32 env/state
+# keeps flowing to masks, chain-acceptance rooms, wave admission, applies,
+# severity/violation measures and the exhaustive certificate scans.
+_SWEEP_ENV_FIELDS = ("leader_load", "follower_load")
 
 
 def _sweep_env(env: ClusterEnv, params: "EngineParams") -> ClusterEnv:
-    """Compute-dtype shadow of the env's float leaves for score sweeps.
+    """Compute-dtype shadow of the env's [R, M] load tables for score sweeps.
     Identity unless the policy resolved to bf16 ("auto" reaching the engine
     unresolved — direct engine callers — means f32): the f32 pipeline is
     BIT-IDENTICAL to pre-policy behavior. Built once per goal program (the
@@ -91,15 +96,22 @@ def _sweep_env(env: ClusterEnv, params: "EngineParams") -> ClusterEnv:
 
 
 def _sweep_state(st: EngineState, params: "EngineParams") -> EngineState:
-    """Per-pass compute-dtype shadow of the mutable [B]-level float leaves
-    (cheap: broker-axis sized). The assignment/count leaves pass through
-    untouched — goals cast counts via ``st.util.dtype``, so the shadow's
-    dtype steers the whole score fusion."""
+    """Per-pass COMPENSATED accounting view for the bf16 sweeps (identity
+    under f32): the broker accumulators the scores difference read ``util +
+    util_residual`` (the Kahan residuals state.py's applies maintain) in
+    f32 — the accounting truth at near-twice-f32 accuracy — instead of a
+    bf16 downcast. The bf16 savings stay where the bytes are (the [R, M]
+    load streams, ``_sweep_env``); the [B]-level view is broker-axis sized
+    and costs two adds per pass. This is what lets ``compute.dtype=auto``
+    resolve to bf16 with violation parity: a tail gain f32 sees is a
+    difference of compensated f32 accumulators here too, never a bf16
+    rounding casualty."""
     if params.compute_dtype != "bfloat16":
         return st
-    dt = jnp.bfloat16
     return dataclasses.replace(
-        st, **{f: getattr(st, f).astype(dt) for f in _SWEEP_STATE_FIELDS})
+        st,
+        util=st.util + st.util_residual,
+        leader_util=st.leader_util + st.leader_util_residual)
 
 # debug bisect knob (CC_DEBUG_DISABLE=swap|swap_apply|swap_admit): carve
 # pieces out of the compiled program to localize device faults; unset in
@@ -312,6 +324,31 @@ class EngineParams:
     # within every goal's own epsilon tolerance, and certified bit-identical
     # on the seeded parity fixtures. Knob off restores per-goal masks.
     chain_cache: bool = True
+    # ---- segment-parallel finisher (PR 7) ----
+    # Destination-SEGMENT spread of the finisher's applied waves: brokers are
+    # partitioned into interaction-disjoint segments (a greedy striped
+    # coloring over the chain's combined accept_move room tables — brokers
+    # ranked by remaining destination room, dealt round-robin, so every
+    # segment holds comparable admission headroom) and one rank-banded wave
+    # runs per segment IN A SINGLE batched program: each scan candidate
+    # contributes its best destination per segment, the flattened
+    # [K * segments] action rows are admitted together in score order under
+    # the chain's cumulative budgets, and applied in one scatter. Validity is
+    # the _finisher_wave argument taken further: segment-interior actions
+    # touch disjoint brokers by construction, and the few BOUNDARY actions
+    # (rows sharing a broker with an earlier admitted row) are re-validated
+    # against the cumulative post-apply deltas by the budgeted admission —
+    # so the applied set is certified equivalent to some sequential order,
+    # exactly like a multi-wave pass. The win: one [K, B] scoring pass lands
+    # up to segments x K actions instead of K, so finisher convergence takes
+    # ~segments x fewer exhaustive 0.65 s scans — the sequential tail that
+    # dominates the rung-4/5 warm wall (docs/PERF.md round 9).
+    # ``finisher_segments`` is the ACTIVE segment count — a TRACED budget
+    # leaf (toggling it reuses the compiled program); ``max_finisher_
+    # segments`` is the static spread width / shape bound. 0 or 1 static
+    # compiles the legacy single-destination-per-candidate wave.
+    finisher_segments: int = 8
+    max_finisher_segments: int = 8
     # ---- precision policy (PR 5) ----
     # Compute dtype of the wide SCORE SWEEPS: the [K, B]/[KL, F]/[K1, K2]
     # candidate scoring fusions and the [R]-sized candidate keyings — the
@@ -331,12 +368,15 @@ class EngineParams:
     # the compiled program, unlike the traced budget leaves); "float32" is
     # bit-identical to the pre-policy pipeline. Default "auto": the
     # OPTIMIZER resolves it from the analyzer.compute.dtype config key —
-    # currently to float32 everywhere (bf16 is opt-in; the planned
-    # >= 256k-replica auto-on is held back by the measured rung-4 quality
-    # gap, see the optimizer's resolution comment + docs/PERF.md round 7).
-    # An "auto" that reaches the engine unresolved (direct engine callers,
-    # tools) runs f32. Explicit "float32"/"bfloat16" — including via
-    # CC_ENGINE_OVERRIDES — pins the mode.
+    # since the compensated-accounting rework (PR 7: bf16 stays on the
+    # [R, M] load streams only, broker accumulators read the f32 Kahan-
+    # compensated sums) "auto" resolves to bfloat16 at >= 256k replicas
+    # (the pass.waves threshold) and float32 below — see
+    # optimizer._resolve_compute_dtype + docs/PERF.md round 9 for the
+    # violation-parity evidence that unblocked it (round 7 had it held
+    # back). An "auto" that reaches the engine unresolved (direct engine
+    # callers, tools) runs f32. Explicit "float32"/"bfloat16" — including
+    # via CC_ENGINE_OVERRIDES — pins the mode.
     compute_dtype: str = "auto"
 
 
@@ -351,7 +391,8 @@ class EngineParams:
 # XLA compiles of budget-variant duplicates).
 _DYN_FIELDS = ("max_iters", "min_gain", "stall_retries", "tail_pass_budget",
                "tail_total_budget", "sat_stall_retries", "sat_tail_passes",
-               "stat_window", "stat_slope_min", "pass_waves")
+               "stat_window", "stat_slope_min", "pass_waves",
+               "finisher_segments")
 _STATIC_FIELDS = tuple(f.name for f in dataclasses.fields(EngineParams)
                        if f.name not in _DYN_FIELDS)
 
@@ -1132,6 +1173,223 @@ def _swap_window_positives(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     return jnp.sum(score > params.min_gain).astype(jnp.int32)
 
 
+def _segment_broker_order(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                          prev_goals: tuple, params: EngineParams, S: int):
+    """i32[Bp] (Bp = ceil(B/S)*S) — the GREEDY SEGMENT COLORING of the broker
+    axis, encoded as a column order: brokers ranked by remaining DESTINATION
+    room (the acceptance headroom that decides how much work a wave can land
+    there), then dealt round-robin — ordered column j belongs to segment
+    j % S, so each of the S segments holds ~B/S brokers with comparable
+    admission headroom instead of one segment hoarding every open
+    destination. The room key comes from the active goal's own
+    ``segment_room_key`` when it has one, else from the chain's combined
+    accept_move room tables (_combined_move_rooms — the same per-dim dst
+    rooms the acceptance check uses; min over constrained dims), else from
+    the static capacity stripe (env.capacity_stripe_key). Two candidate
+    actions CONFLICT only when they touch a common broker; the coloring
+    spreads high-room brokers across segments so same-segment waves rarely
+    conflict, and the few cross-rows that do are exactly the boundary
+    actions the cumulative-budget admission re-validates. Pad columns
+    (>= B) rank last and carry NEG_INF scores downstream."""
+    B = env.num_brokers
+    key = goal.segment_room_key(env, st)
+    if key is None:
+        rooms, _custom = _combined_move_rooms((goal, *prev_goals), env, st)
+        dst_rooms = [d for (_s, d) in rooms.values() if d is not None]
+        if dst_rooms:
+            key = dst_rooms[0]
+            for d in dst_rooms[1:]:
+                key = jnp.minimum(key, d)
+        else:
+            from cruise_control_tpu.analyzer.env import capacity_stripe_key
+            key = capacity_stripe_key(env)
+    key = jnp.where(env.dst_candidate, key.astype(ACCT_DTYPE), NEG_INF)
+    order = jnp.argsort(-key).astype(jnp.int32)                   # [B]
+    Bp = -(-B // S) * S
+    if Bp > B:
+        order = jnp.concatenate(
+            [order, jnp.arange(B, Bp, dtype=jnp.int32)])
+    return order
+
+
+def _segment_move_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                       prev_goals: tuple, params: EngineParams,
+                       cand: Array, kv: Array):
+    """ONE segment-parallel finisher wave over ``cand``: the [K, B] exact
+    (f32) re-score runs once, then instead of each candidate surfacing one
+    destination, every candidate contributes its best destination IN EACH of
+    the S broker segments, and all K*S candidate-action rows are admitted
+    together in score order under the chain's cumulative budgets and applied
+    in one batched scatter. Sequential-equivalence certificate: (a) each
+    candidate replica applies at most once (first surviving segment row in
+    score order); (b) partition first-touch keeps rack/sibling constraints
+    single-move exact; (c) per-broker/per-topic cumulative budgets hold for
+    every prefix, so rows that share a broker — the cross-segment BOUNDARY
+    actions — are re-validated against the accumulated deltas of every
+    earlier admitted row, exactly the _finisher_wave re-score-exact argument
+    folded into the admission. Segment-interior rows touch disjoint brokers
+    by construction and commute. Returns (state, n_applied, n_boundary)."""
+    K = cand.shape[0]
+    B = env.num_brokers
+    S = max(2, min(params.max_finisher_segments, B))
+    mask = legit_move_mask(env, st, cand, goal.options)
+    d_rows = _move_delta_rows(env, st, cand)                      # [K, 8]
+    src_b = st.replica_broker[cand]
+    if params.chain_cache:
+        rooms, custom = _combined_move_rooms(prev_goals, env, st)
+        if rooms:
+            mask = mask & _rooms_move_mask(rooms, d_rows, src_b)
+    else:
+        custom = tuple(g for g in prev_goals
+                       if type(g).accept_move is not GoalKernel.accept_move)
+    for g in custom:
+        mask = mask & g.accept_move(env, st, cand)
+    score = goal.move_score(env, st, cand)         # finisher: exact f32
+    score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
+
+    # per-segment best destination via the room-ordered strided view:
+    # ordered column q*S + s belongs to segment s
+    order_b = _segment_broker_order(env, st, goal, prev_goals, params, S)
+    Bp = order_b.shape[0]
+    scp = (jnp.pad(score, ((0, 0), (0, Bp - B)), constant_values=NEG_INF)
+           if Bp > B else score)
+    scp = scp[:, order_b]                                         # [K, Bp]
+    seg_view = scp.reshape(K, Bp // S, S)
+    q_best = jnp.argmax(seg_view, axis=1).astype(jnp.int32)       # [K, S]
+    vals = jnp.take_along_axis(seg_view, q_best[:, None, :],
+                               axis=1)[:, 0, :]                   # [K, S]
+    dsts = order_b[q_best * S + jnp.arange(S, dtype=jnp.int32)[None, :]]
+    # active segment count is a traced budget leaf: inactive segments' rows
+    # mask to -inf (same compiled program for any setting)
+    active = jnp.clip(params.finisher_segments, 1, S)
+    vals = jnp.where(jnp.arange(S)[None, :] < active, vals, NEG_INF)
+
+    KS = K * S
+    k_of = jnp.repeat(jnp.arange(K, dtype=jnp.int32), S)
+    val_f = vals.reshape(KS)
+    order_r = jnp.argsort(-val_f)
+    posn = jnp.arange(KS, dtype=jnp.int32)
+    k_s = k_of[order_r]
+    r_sorted = cand[k_s]
+    src_s = src_b[k_s]
+    dst_s = dsts.reshape(KS).astype(jnp.int32)[order_r]
+    val_s = val_f[order_r]
+    d = d_rows[k_s]
+    wave_ok = val_s > params.min_gain
+    INF = jnp.int32(KS + 1)
+    guarded = jnp.where(wave_ok, posn, INF)
+    # reconciliation (a): one applied destination per candidate replica —
+    # the best surviving segment row wins, its siblings drop (they'd be
+    # duplicate moves of one replica)
+    first_k = jnp.full(K, INF, jnp.int32).at[k_s].min(guarded)
+    k_ok = first_k[k_s] == posn
+    p_s = env.replica_partition[r_sorted]
+    first_part = (jnp.full(env.num_partitions, INF, jnp.int32)
+                  .at[p_s].min(jnp.where(k_ok, guarded, INF)))
+    part_ok = first_part[p_s] == posn
+    lead_s = st.replica_is_leader[r_sorted]
+    win = part_ok & _wave_admission(
+        env, st, goal, prev_goals, d, d, src_s, dst_s, wave_ok & k_ok,
+        env.replica_topic[r_sorted], posn,
+        d_count=jnp.ones(KS, d.dtype),
+        d_leader=lead_s.astype(d.dtype),
+        gain_escape=st.replica_offline[r_sorted])
+    st = apply_moves_batched(env, st, r_sorted, dst_s, win)
+    # boundary re-validations: admitted rows sharing a broker (either role)
+    # with an EARLIER admitted row — the cross-segment interactions whose
+    # validity rests on the cumulative-budget re-validation, surfaced as an
+    # observability counter (RoundTrace / pass_profile)
+    wposn = jnp.where(win, posn, INF)
+    first_b = (jnp.full(B, INF, jnp.int32)
+               .at[src_s].min(wposn).at[dst_s].min(wposn))
+    boundary = win & ((first_b[src_s] != posn) | (first_b[dst_s] != posn))
+    return (st, jnp.sum(win).astype(jnp.int32),
+            jnp.sum(boundary).astype(jnp.int32))
+
+
+def _segment_lead_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                       prev_goals: tuple, params: EngineParams,
+                       cand: Array, kv: Array):
+    """Leadership analogue of _segment_move_wave: each candidate leader
+    contributes its best follower per destination-broker segment; the
+    flattened [KL * S] transfer rows are admitted together under the chain's
+    cumulative budgets (rows of one candidate deduped by score-order
+    first-touch — a partition transfers leadership once per wave) and
+    applied in one batched scatter. Returns (state, n_applied, n_boundary)."""
+    KL = cand.shape[0]
+    B = env.num_brokers
+    S = max(2, min(params.max_finisher_segments, B))
+    lmask = legit_leadership_mask(env, st, cand)
+    for g in prev_goals:
+        lmask = lmask & g.accept_leadership(env, st, cand)
+    lscore = goal.leadership_score(env, st, cand)  # finisher: exact f32
+    lscore = jnp.where(lmask & (kv > NEG_INF)[:, None], lscore, NEG_INF)
+    members = env.partition_replicas[env.replica_partition[cand]]  # [KL, F]
+    dst_rep_all = jnp.clip(members, 0)
+    dst_broker_all = st.replica_broker[dst_rep_all]                # [KL, F]
+
+    order_b = _segment_broker_order(env, st, goal, prev_goals, params, S)
+    Bp = order_b.shape[0]
+    colrank = (jnp.zeros(Bp, jnp.int32)
+               .at[order_b].set(jnp.arange(Bp, dtype=jnp.int32)))
+    color = colrank % S                                            # [Bp]
+    seg_of = color[dst_broker_all]                                 # [KL, F]
+    active = jnp.clip(params.finisher_segments, 1, S)
+    rows_v, rows_f = [], []
+    posn_k = jnp.arange(KL)
+    for s in range(S):              # S static, F small: S masked argmaxes
+        ms = jnp.where(seg_of == s, lscore, NEG_INF)
+        f = jnp.argmax(ms, axis=1).astype(jnp.int32)
+        v = jnp.where(s < active, ms[posn_k, f], NEG_INF)
+        rows_v.append(v)
+        rows_f.append(f)
+    vals = jnp.stack(rows_v, axis=1)                               # [KL, S]
+    fbest = jnp.stack(rows_f, axis=1)                              # [KL, S]
+
+    KS = KL * S
+    k_of = jnp.repeat(jnp.arange(KL, dtype=jnp.int32), S)
+    val_f = vals.reshape(KS)
+    order_r = jnp.argsort(-val_f)
+    posn = jnp.arange(KS, dtype=jnp.int32)
+    k_s = k_of[order_r]
+    r_sorted = cand[k_s]
+    f_s = fbest.reshape(KS)[order_r]
+    dst_rep = dst_rep_all[k_s, f_s]
+    val_s = val_f[order_r]
+    wave_ok = val_s > params.min_gain
+    INF = jnp.int32(KS + 1)
+    guarded = jnp.where(wave_ok, posn, INF)
+    # one transfer per candidate leader (rows of one k are alternatives)
+    first_k = jnp.full(KL, INF, jnp.int32).at[k_s].min(guarded)
+    k_ok = first_k[k_s] == posn
+    src_b = st.replica_broker[r_sorted]
+    dst_b = st.replica_broker[dst_rep]
+
+    def leadership_deltas(rep):
+        delta = env.leader_load[rep] - env.follower_load[rep]
+        zero = jnp.zeros((KS, 1), delta.dtype)
+        one = jnp.ones((KS, 1), delta.dtype)
+        return jnp.concatenate([
+            delta, zero, one, zero,
+            env.leader_load[rep, Resource.NW_IN][:, None],
+        ], axis=1)
+
+    win = _wave_admission(env, st, goal, prev_goals,
+                          leadership_deltas(r_sorted),
+                          leadership_deltas(dst_rep),
+                          src_b, dst_b, wave_ok & k_ok,
+                          env.replica_topic[r_sorted], posn,
+                          d_count=jnp.zeros(KS, ACCT_DTYPE),
+                          d_leader=jnp.ones(KS, ACCT_DTYPE))
+    st = apply_leaderships_batched(env, st, r_sorted, dst_rep, win)
+    wposn = jnp.where(win, posn, INF)
+    first_b = (jnp.full(B, INF, jnp.int32)
+               .at[src_b].min(wposn).at[dst_b].min(wposn))
+    boundary = win & ((first_b[src_b] != posn) | (first_b[dst_b] != posn))
+    return (st, jnp.sum(win).astype(jnp.int32),
+            jnp.sum(boundary).astype(jnp.int32))
+
+
 def _finisher_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                    prev_goals: tuple, params: EngineParams,
                    gain: Array, leadership: bool):
@@ -1151,15 +1409,23 @@ def _finisher_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     kv_all, cand_all = jax.lax.top_k(gain[:env.num_replicas], K * W)  # exact
     severity = goal.broker_severity(env, st)
     zero_stall = jnp.int32(0)
+    # segment-parallel waves need every chain goal's acceptance in cumulative
+    # (budget) form — the boundary re-validation IS the budget check. A chain
+    # with a non-budget-capable goal falls back to the legacy wave (as does
+    # max_finisher_segments < 2, the static off switch).
+    use_seg = (params.max_finisher_segments >= 2
+               and all(_wave_budget_capable(g, leadership=leadership)
+                       for g in (goal, *prev_goals)))
 
     # ROLLED wave loop: one compiled wave body driven by a while_loop (the
     # former W-way Python unroll multiplied the finisher subprogram's compile
     # size by W and pinned W at 6); selection within later bands is stale but
     # every application is re-scored exact against the live state, so W can
     # be raised freely to amortize the exhaustive scan over more work. Exits
-    # early once a wave admits nothing.
+    # early once a wave admits nothing. With segments on, each band lands up
+    # to K * finisher_segments actions off its one exact re-score.
     def wave_body(carry):
-        s, w, total, _go = carry
+        s, w, total, bnd, _go = carry
         cand = jax.lax.dynamic_slice(cand_all, (w * K,), (K,))
         kv = jax.lax.dynamic_slice(kv_all, (w * K,), (K,))
         kv = jnp.where(kv > params.min_gain, kv, NEG_INF)
@@ -1169,23 +1435,33 @@ def _finisher_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         # the certificate loop would stall unproven — the finisher is the
         # machinery that pins bf16 outcomes to the f32 pipeline's, so every
         # stage of it runs in ACCT_DTYPE
+        nb = jnp.int32(0)
         if leadership:
-            s, n = _leadership_branch_batched(
-                env, s, goal, prev_goals, params, severity, zero_stall,
-                cand=cand, kv=kv)
+            if use_seg:
+                s, n, nb = _segment_lead_wave(env, s, goal, prev_goals,
+                                              params, cand, kv)
+            else:
+                s, n = _leadership_branch_batched(
+                    env, s, goal, prev_goals, params, severity, zero_stall,
+                    cand=cand, kv=kv)
         else:
-            s, n, _w = _move_branch_batched(env, s, goal, prev_goals, params,
-                                            severity, zero_stall,
-                                            cand=cand, kv=kv)
-        return s, w + 1, total + n, n > 0
+            if use_seg:
+                s, n, nb = _segment_move_wave(env, s, goal, prev_goals,
+                                              params, cand, kv)
+            else:
+                s, n, _w = _move_branch_batched(env, s, goal, prev_goals,
+                                                params, severity, zero_stall,
+                                                cand=cand, kv=kv)
+        return s, w + 1, total + n, bnd + nb, n > 0
 
     def wave_cond(carry):
-        _s, w, _total, go = carry
+        _s, w, _total, _bnd, go = carry
         return go & (w < W)
 
-    st, _w, total, _go = jax.lax.while_loop(
-        wave_cond, wave_body, (st, jnp.int32(0), jnp.int32(0), jnp.bool_(True)))
-    return st, total
+    st, _w, total, boundary, _go = jax.lax.while_loop(
+        wave_cond, wave_body,
+        (st, jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.bool_(True)))
+    return st, total, boundary
 
 
 def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
@@ -1200,16 +1476,16 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     certificate is an f32 statement; only the applied waves' [K, B]
     re-scoring rides the compute dtype. Returns
     (st, proven, moves_left, leads_left, swaps_window_left, rounds,
-    n_applied)."""
+    n_applied, n_boundary, segments)."""
     use_moves = goal.uses_replica_moves
     use_leads = goal.uses_leadership_moves
     zero = jnp.int32(0)
     if params.finisher_rounds <= 0 or not (use_moves or use_leads):
         return (st, jnp.bool_(False), jnp.int32(-1), jnp.int32(-1),
-                jnp.int32(-1), zero, zero)
+                jnp.int32(-1), zero, zero, zero, zero)
 
     def round_body(carry):
-        st, rounds, prev_m, prev_l, total, _done, _clean = carry
+        st, rounds, prev_m, prev_l, total, bnd, _done, _clean = carry
         mleft = zero
         lleft = zero
         applied = zero
@@ -1218,16 +1494,18 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                                             params.scan_chunk,
                                             chain_cache=params.chain_cache)
             mleft = jnp.sum(gain > params.min_gain).astype(jnp.int32)
-            st, n = _finisher_wave(env, st, goal, prev_goals, params,
-                                   gain, leadership=False)
+            st, n, nb = _finisher_wave(env, st, goal, prev_goals, params,
+                                       gain, leadership=False)
             applied += n
+            bnd += nb
         if use_leads:
             gain, _ = _exhaustive_lead_scan(env, st, goal, prev_goals,
                                             params.scan_chunk)
             lleft = jnp.sum(gain > params.min_gain).astype(jnp.int32)
-            st, n = _finisher_wave(env, st, goal, prev_goals, params,
-                                   gain, leadership=True)
+            st, n, nb = _finisher_wave(env, st, goal, prev_goals, params,
+                                       gain, leadership=True)
             applied += n
+            bnd += nb
         if goal.uses_swaps and params.finisher_swap_passes > 0:
             # swap tail: once moves+transfers are drained this round, salted
             # swap passes (each pass a fresh pseudo-random window) drain the
@@ -1268,19 +1546,20 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         # nothing — an exit right after applied actions (rounds cap /
         # stagnation / swap-tail applies) leaves the scans' counts stale
         # against the mutated state
-        return (st, rounds + 1, mleft, lleft, total + applied, done,
+        return (st, rounds + 1, mleft, lleft, total + applied, bnd, done,
                 applied == 0)
 
     def cond(carry):
-        _st, rounds, _m, _l, _t, done, _clean = carry
+        _st, rounds, _m, _l, _t, _b, done, _clean = carry
         return run & ~done & (rounds < params.finisher_rounds)
 
     # far above any real count (counts are <= R) so the first round can
     # never trip the stagnation exit, yet small enough that *7 stays well
     # inside int32
     big = jnp.int32(2**27)
-    st, rounds, mleft, lleft, n_applied, done, clean = jax.lax.while_loop(
-        cond, round_body, (st, zero, big, big, zero, jnp.bool_(False),
+    (st, rounds, mleft, lleft, n_applied, n_boundary, done,
+     clean) = jax.lax.while_loop(
+        cond, round_body, (st, zero, big, big, zero, zero, jnp.bool_(False),
                            jnp.bool_(False)))
     mleft = jnp.where(run, mleft, -1)   # -1 = finisher did not run
     lleft = jnp.where(run, lleft, -1)
@@ -1294,7 +1573,23 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         swleft = jnp.int32(-1)
         swaps_proven = jnp.bool_(True)
     proven = run & clean & moves_proven & leads_proven & swaps_proven
-    return st, proven, mleft, lleft, swleft, rounds, n_applied
+    # observability: segments the applied waves actually spread over (0 =
+    # legacy single-destination waves — static off switch or a chain goal
+    # without cumulative budgets on every action kind it vetoes)
+    seg_capable = (params.max_finisher_segments >= 2 and (
+        (use_moves and all(_wave_budget_capable(g)
+                           for g in (goal, *prev_goals)))
+        or (use_leads and all(_wave_budget_capable(g, leadership=True)
+                              for g in (goal, *prev_goals)))))
+    if seg_capable:
+        segments = jnp.where(
+            run, jnp.clip(params.finisher_segments, 1,
+                          max(2, min(params.max_finisher_segments,
+                                     env.num_brokers))), 0).astype(jnp.int32)
+    else:
+        segments = zero
+    return (st, proven, mleft, lleft, swleft, rounds, n_applied,
+            n_boundary, segments)
 
 
 def optimize_goal(env: ClusterEnv, st: EngineState, goal: GoalKernel,
@@ -1504,11 +1799,13 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     viol_pre = goal.violated(env, st)
     if finisher:
         (st, fin_proven, moves_left, leads_left, swaps_left, fin_rounds,
-         fin_applied) = _finisher(env, st, goal, prev_goals, params, viol_pre)
+         fin_applied, fin_boundary, fin_segments) = _finisher(
+            env, st, goal, prev_goals, params, viol_pre)
     else:
         fin_proven = jnp.bool_(False)
         moves_left = leads_left = swaps_left = jnp.int32(-1)
         fin_rounds = fin_applied = jnp.int32(0)
+        fin_boundary = fin_segments = jnp.int32(0)
     violated = goal.violated(env, st)
     # stopped by the iteration cap, the dribble tail budget, OR a stat-slope
     # plateau while still violated and applying actions = budget exhausted,
@@ -1541,5 +1838,11 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                 "disk_actions": b_disk,
                 "move_waves": b_waves,
                 "finisher_actions": fin_applied,
+                # segment-parallel finisher observability: segments the
+                # applied waves spread destinations over (0 = legacy waves)
+                # and how many admitted rows were cross-segment BOUNDARY
+                # actions re-validated by the cumulative-budget admission
+                "finisher_segments": fin_segments,
+                "finisher_boundary": fin_boundary,
                 "stat": goal.stat(env, st)}
 

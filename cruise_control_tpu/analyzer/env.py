@@ -266,6 +266,18 @@ def make_env(ct: ClusterTensor, meta: ClusterMeta,
     return _expand_env(env, jax.device_put(valid_packed))
 
 
+def capacity_stripe_key(env: ClusterEnv) -> Array:
+    """f32[B] static fallback key for the segment-parallel finisher's broker
+    coloring (engine._segment_broker_order) when neither the active goal nor
+    the chain exposes a room table: total configured capacity of each
+    allowed destination broker (-inf elsewhere). Capacity is the best
+    state-independent proxy for how much wave work a broker can absorb, and
+    ranking by it keeps the round-robin stripe from packing all the large
+    brokers into one segment."""
+    return jnp.where(env.dst_candidate,
+                     jnp.sum(env.broker_capacity, axis=1), -jnp.inf)
+
+
 # ---------------------------------------------------------------------------
 # Threshold math (GoalUtils.java:515 computeResourceUtilizationBalanceThreshold)
 # ---------------------------------------------------------------------------

@@ -176,6 +176,26 @@ class GoalKernel:
         constraint."""
         return None
 
+    def segment_room_key(self, env: ClusterEnv, st: EngineState):
+        """Optional f32[B] DESTINATION-room ranking for the segment-parallel
+        finisher's broker coloring (engine._segment_broker_order): how much
+        of this goal's work a wave could still land on each broker, in the
+        goal's own accounting units (larger = more room; the engine masks
+        non-candidate destinations itself). The greedy coloring ranks
+        brokers by this key and deals them round-robin into segments so
+        every segment holds comparable admission headroom — a pure
+        LOAD-BALANCING heuristic: correctness of the segmented wave rests
+        on the cumulative-budget admission, never on the coloring. Return
+        None to fall back to the chain's combined accept_move room tables
+        (or the static capacity stripe when the chain has none).
+
+        ACCOUNTING NOTE (Kahan residuals): like every accounting read, room
+        keys are computed from ``st.util`` — the raw f32 accumulator. The
+        compensated sums (``st.util + st.util_residual``) are what the bf16
+        sweep policy reads (engine._sweep_state); kernels never need to add
+        the residual themselves."""
+        return None
+
     def wave_gain_budgets(self, env: ClusterEnv, st: EngineState):
         """Optional ``(src_gain[B], dst_gain[B], dim)`` for the ACTIVE goal:
         the remaining genuinely-useful shed (src excess above its target) and

@@ -94,6 +94,11 @@ class CapacityGoal(GoalKernel):
         excess = jnp.maximum(util - self._limit(env), 0.0)
         return excess, jnp.zeros_like(excess), self.resource
 
+    def segment_room_key(self, env: ClusterEnv, st: EngineState):
+        """Segment coloring key: destination headroom to the capacity limit
+        (the same room accept_move enforces)."""
+        return self._limit(env) - st.util[:, self.resource]
+
     # -- leadership (CPU / NW_OUT shift with leadership) --
     def leader_key(self, env: ClusterEnv, st: EngineState, severity):
         on_bad = severity[st.replica_broker] > 0
@@ -232,6 +237,11 @@ class ReplicaCapacityGoal(GoalKernel):
         c = st.replica_count.astype(st.util.dtype)
         excess = jnp.maximum(c - float(self._max()), 0.0)
         return excess, jnp.zeros_like(excess), WAVE_COUNT
+
+    def segment_room_key(self, env: ClusterEnv, st: EngineState):
+        """Segment coloring key: replica-count headroom to the per-broker
+        cap."""
+        return float(self._max()) - st.replica_count.astype(st.util.dtype)
 
     def accept_swap(self, env: ClusterEnv, st: EngineState, cand_out, cand_in):
         """Swaps are count-neutral -> always accepted

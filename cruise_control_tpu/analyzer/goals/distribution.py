@@ -152,6 +152,12 @@ class ResourceDistributionGoal(GoalKernel):
         return (jnp.maximum(util - upper, 0.0), jnp.maximum(lower - util, 0.0),
                 self.resource)
 
+    def segment_room_key(self, env: ClusterEnv, st: EngineState):
+        """Segment coloring key: room to this resource's upper band limit —
+        deficit brokers (the wave's real destinations) rank first."""
+        _lower, upper = self._limits(env, st)
+        return upper - st.util[:, self.resource]
+
     def accept_move(self, env: ClusterEnv, st: EngineState, cand):
         """Veto (as an already-optimized goal): moving cand -> dst must not push
         dst above upper, nor drop src below lower
@@ -407,6 +413,11 @@ class ReplicaDistributionGoal(GoalKernel):
         return (jnp.maximum(c - upper, 0.0), jnp.maximum(lower - c, 0.0),
                 WAVE_COUNT)
 
+    def segment_room_key(self, env: ClusterEnv, st: EngineState):
+        """Segment coloring key: replica-count room to the upper band."""
+        _lower, upper = self._limits(env, st)
+        return upper - st.replica_count.astype(st.util.dtype)
+
     def accept_swap(self, env: ClusterEnv, st: EngineState, cand_out, cand_in):
         """Swaps are count-neutral -> always accepted
         (ReplicaDistributionGoal.java:122 INTER_BROKER_REPLICA_SWAP: ACCEPT)."""
@@ -497,6 +508,11 @@ class LeaderReplicaDistributionGoal(GoalKernel):
         c = st.leader_count.astype(st.util.dtype)
         return (jnp.maximum(c - upper, 0.0), jnp.maximum(lower - c, 0.0),
                 WAVE_LEADER_COUNT)
+
+    def segment_room_key(self, env: ClusterEnv, st: EngineState):
+        """Segment coloring key: leader-count room to the upper band."""
+        _lower, upper = self._limits(env, st)
+        return upper - st.leader_count.astype(st.util.dtype)
 
     def leader_key(self, env: ClusterEnv, st: EngineState, severity):
         lower, upper = self._limits(env, st)

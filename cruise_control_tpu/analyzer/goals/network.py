@@ -80,6 +80,10 @@ class PotentialNwOutGoal(GoalKernel):
         excess = jnp.maximum(st.potential_nw_out - self._limit(env), 0.0)
         return excess, jnp.zeros_like(excess), WAVE_POT_NW_OUT
 
+    def segment_room_key(self, env: ClusterEnv, st: EngineState):
+        """Segment coloring key: potential-NW_OUT headroom to the limit."""
+        return self._limit(env) - st.potential_nw_out
+
 
 @dataclasses.dataclass(frozen=True)
 class LeaderBytesInDistributionGoal(GoalKernel):
@@ -153,3 +157,8 @@ class LeaderBytesInDistributionGoal(GoalKernel):
         upper = self._limits(env, st)
         excess = jnp.maximum(st.leader_util[:, NW_IN] - upper, 0.0)
         return excess, jnp.zeros_like(excess), WAVE_LEADER_NW_IN
+
+    def segment_room_key(self, env: ClusterEnv, st: EngineState):
+        """Segment coloring key: leader-bytes-in headroom to the upper
+        limit (leadership transfer destinations)."""
+        return self._limits(env, st) - st.leader_util[:, NW_IN]

@@ -37,6 +37,37 @@ from cruise_control_tpu.model.cluster_tensor import ClusterMeta, ClusterTensor, 
 BALANCEDNESS_PRIORITY_WEIGHT = 1.1
 BALANCEDNESS_STRICTNESS_WEIGHT = 1.5
 
+# "auto" precision-policy threshold: the same >= 256k-replica bar as the
+# pass.waves auto-raise — below it the [R, M] load streams are small enough
+# that bf16 buys nothing worth a second compiled dtype variant
+BF16_AUTO_MIN_REPLICAS = 262_144
+
+
+def _resolve_compute_dtype(pinned: str, config_dtype: str | None,
+                           num_replicas: int) -> str:
+    """Resolve the engine's score-sweep precision policy for one cluster:
+
+    - an explicitly pinned ``EngineParams.compute_dtype`` wins;
+    - an explicit config value ("float32"/"bfloat16") pins the mode;
+    - "auto" resolves by cluster size: **bfloat16 at >= 256k replicas**,
+      float32 below. The auto-on that PR 5 held back (rung-4 bf16 tails cost
+      violations, docs/PERF.md round 7) is unblocked by the compensated-
+      accounting rework: bf16 now rides ONLY the [R, M] load streams while
+      the broker accumulators the scores difference read the f32
+      Kahan-compensated sums (engine._sweep_state), and the segment-parallel
+      finisher drains whatever a quantized selection still leaves — measured
+      violation parity with f32 at the 1M rung, docs/PERF.md round 9.
+
+    Resolution depends only on (params, config, padded shape bucket), so one
+    cluster always compiles exactly one dtype variant (compute_dtype is
+    STATIC — flipping it is a documented recompile)."""
+    if pinned != "auto":
+        return pinned
+    if config_dtype in ("float32", "bfloat16"):
+        return config_dtype
+    return ("bfloat16" if num_replicas >= BF16_AUTO_MIN_REPLICAS
+            else "float32")
+
 
 class OptimizationFailureError(Exception):
     """A hard goal could not be satisfied
@@ -80,6 +111,11 @@ class GoalResult:
     disk_actions: int = 0
     move_waves: int = 0
     finisher_actions: int = 0
+    # segment-parallel finisher profile: destination segments the applied
+    # waves spread over (0 = legacy single-destination waves) and admitted
+    # cross-segment boundary rows re-validated by the budgeted admission
+    finisher_segments: int = 0
+    finisher_boundary: int = 0
 
 
 @dataclasses.dataclass
@@ -236,6 +272,12 @@ class GoalOptimizer:
                                    EngineParams.max_pass_waves),
                 compact_keying=config.get_boolean("analyzer.compact.keying"),
                 chain_cache=config.get_boolean("analyzer.chain.cache"),
+                # segment-parallel finisher: the config value is both the
+                # static spread width and the traced active count (0 / 1
+                # compiles the legacy single-destination waves)
+                finisher_segments=config.get_int("analyzer.finisher.segments"),
+                max_finisher_segments=config.get_int(
+                    "analyzer.finisher.segments"),
             )
         self._params = engine_params or EngineParams()
         # analyzer.fused.chain.min.replicas: at/above this cluster size the
@@ -481,26 +523,13 @@ class GoalOptimizer:
                                    and num_replicas
                                    < self._finisher_min_replicas)
                              else self._params.finisher_rounds),
-            # precision policy: an explicitly pinned EngineParams dtype
-            # wins; else the config key decides. "auto" currently resolves
-            # to float32 EVERYWHERE: the same-day rung-4 A/B (docs/PERF.md
-            # round 7) measured bf16 budgeted tails leaving 6 goals violated
-            # vs f32's 3 at the 1M rung — per-move tail gains sit below one
-            # bf16 ulp of the utilizations they are differences of, and the
-            # prefix-chain goals have no finisher to drain what the bf16
-            # sweep cannot see — so the >= 256k auto-on threshold (the
-            # pass.waves analogue) stays held back until pair-exact f32
-            # re-scoring closes the quality gap. bf16 remains a certified
-            # OPT-IN (outcome parity on the converging parity fixtures,
-            # tests/test_dtype_policy.py). Resolution depends only on
-            # config, so one cluster always compiles one dtype variant
-            # (compute_dtype is STATIC — flipping it is a documented
-            # recompile).
-            compute_dtype=(self._params.compute_dtype
-                           if self._params.compute_dtype != "auto"
-                           else self._compute_dtype if self._compute_dtype
-                           in ("float32", "bfloat16")
-                           else "float32"))
+            # precision policy: see _resolve_compute_dtype — "auto" now
+            # resolves to bfloat16 at >= 256k replicas (compensated
+            # accounting + the segment-parallel finisher closed the rung-4
+            # violation gap that held it back, docs/PERF.md round 9)
+            compute_dtype=_resolve_compute_dtype(
+                self._params.compute_dtype, self._compute_dtype,
+                num_replicas))
 
         if session is None:
             tml = self._min_leader_mask(meta, min_leader_topic_pattern)
@@ -668,6 +697,8 @@ class GoalOptimizer:
                 disk_actions=int(info.get("disk_actions", 0)),
                 move_waves=int(info.get("move_waves", 0)),
                 finisher_actions=int(info.get("finisher_actions", 0)),
+                finisher_segments=int(info.get("finisher_segments", 0)),
+                finisher_boundary=int(info.get("finisher_boundary", 0)),
             )
             for g, info, dur in zip(goals, infos, durations)
         ]

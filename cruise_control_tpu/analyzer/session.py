@@ -123,6 +123,13 @@ def _sync_finalize(env, broker, lead_packed, disk, leader_rows,
         disk_util=jnp.zeros_like(env.broker_disk_capacity),
         moved=jnp.zeros(R, bool),
         leadership_moved=jnp.zeros(R, bool),
+        # Kahan accounting residuals: dead placeholders like the other
+        # derived leaves — refresh() zeroes them (a finalize IS a
+        # from-scratch recompute, so the compensation correctly restarts;
+        # carrying a donated-away round's residuals forward would compensate
+        # an accumulator that no longer exists)
+        util_residual=jnp.zeros_like(env.broker_capacity),
+        leader_util_residual=jnp.zeros_like(env.broker_capacity),
     )
     return env, refresh(env, st)
 

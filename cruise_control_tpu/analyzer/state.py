@@ -42,7 +42,8 @@ def state_index_dtypes(env: ClusterEnv):
                       "replica_disk", "util", "leader_util", "potential_nw_out",
                       "replica_count", "leader_count", "part_rack_count",
                       "topic_broker_count", "topic_leader_count", "disk_util",
-                      "moved", "leadership_moved"],
+                      "moved", "leadership_moved",
+                      "util_residual", "leader_util_residual"],
          meta_fields=[])
 @dataclasses.dataclass(frozen=True)
 class EngineState:
@@ -61,10 +62,36 @@ class EngineState:
     disk_util: Array           # f32[B, D] DISK load per (broker, logdir) (JBOD)
     moved: Array               # bool[R] replica has been relocated this optimization
     leadership_moved: Array    # bool[R] leadership changed on this replica
+    # Compensated (Kahan/Neumaier-style) accounting residuals: the f32
+    # rounding error the incremental scatter updates shave off ``util`` /
+    # ``leader_util`` per applied wave, accumulated so ``util +
+    # util_residual`` is the utilization sum at (near-)twice-f32 accuracy.
+    # ``refresh`` (the from-scratch truth) zeroes them. The accumulators
+    # themselves stay BIT-IDENTICAL to the pre-residual pipeline — the
+    # residual rides beside, it never feeds back into ``util`` — so the f32
+    # engine is unchanged; the bf16 sweep policy reads the compensated view
+    # (engine._sweep_state) so tail gains one ulp below the accumulator
+    # magnitude stay visible to candidate scoring.
+    util_residual: Array        # f32[B, M]
+    leader_util_residual: Array  # f32[B, M]
 
     def effective_load(self, env: ClusterEnv) -> Array:
         load = jnp.where(self.replica_is_leader[:, None], env.leader_load, env.follower_load)
         return jnp.where(env.replica_valid[:, None], load, 0.0)
+
+
+def _kahan_scatter2(acc: Array, res: Array, idx_a, d_a, idx_b, d_b):
+    """Compensated pair-scatter (the remove-from-src / add-to-dst update
+    every apply runs): the accumulator update is EXACTLY the legacy chained
+    ``.at[a].add(d_a).at[b].add(d_b)`` — bit-identical bits — while the f32
+    rounding error of that update, estimated Neumaier-style against the
+    per-broker aggregate delta, folds into ``res``. First-order exact in the
+    regime the residual exists for (|delta| far below |acc|, where the
+    addition cancels the delta's low bits); the estimate's own error is
+    second-order. Returns (new_acc, new_res)."""
+    new = acc.at[idx_a].add(d_a).at[idx_b].add(d_b)
+    agg = jnp.zeros_like(acc).at[idx_a].add(d_a).at[idx_b].add(d_b)
+    return new, res + ((acc - new) + agg)
 
 
 def init_state(env: ClusterEnv, replica_broker: Array, replica_is_leader: Array,
@@ -105,6 +132,8 @@ def _init_packed(env: ClusterEnv, replica_broker: Array, lead_packed: Array,
         disk_util=jnp.zeros_like(env.broker_disk_capacity),
         moved=jnp.zeros(env.num_replicas, bool),
         leadership_moved=jnp.zeros(env.num_replicas, bool),
+        util_residual=jnp.zeros_like(env.broker_capacity),
+        leader_util_residual=jnp.zeros_like(env.broker_capacity),
     )
     return refresh(env, st)
 
@@ -151,7 +180,11 @@ def refresh(env: ClusterEnv, st: EngineState) -> EngineState:
                                replica_count=rc, leader_count=lc,
                                part_rack_count=prc.astype(c_dt),
                                topic_broker_count=tbc.astype(c_dt),
-                               topic_leader_count=tlc.astype(c_dt), disk_util=du)
+                               topic_leader_count=tlc.astype(c_dt), disk_util=du,
+                               # from-scratch recompute IS the accounting
+                               # truth: the compensation restarts at zero
+                               util_residual=jnp.zeros_like(util),
+                               leader_util_residual=jnp.zeros_like(util))
 
 
 def apply_move(env: ClusterEnv, st: EngineState, replica: Array, dst: Array,
@@ -171,9 +204,11 @@ def apply_move(env: ClusterEnv, st: EngineState, replica: Array, dst: Array,
     is_leader = st.replica_is_leader[replica]
     load = jnp.where(is_leader, env.leader_load[replica], env.follower_load[replica])
     load = jnp.where(en, load, 0.0)
-    util = st.util.at[src].add(-load).at[dst].add(load)
+    util, util_res = _kahan_scatter2(st.util, st.util_residual,
+                                     src, -load, dst, load)
     lead_load = jnp.where(en & is_leader, env.leader_load[replica], 0.0)
-    leader_util = st.leader_util.at[src].add(-lead_load).at[dst].add(lead_load)
+    leader_util, lead_res = _kahan_scatter2(
+        st.leader_util, st.leader_util_residual, src, -lead_load, dst, lead_load)
     pot_delta = jnp.where(en, env.leader_load[replica, Resource.NW_OUT], 0.0)
     pot = st.potential_nw_out.at[src].add(-pot_delta).at[dst].add(pot_delta)
     one = en.astype(jnp.int32)
@@ -212,6 +247,7 @@ def apply_move(env: ClusterEnv, st: EngineState, replica: Array, dst: Array,
         util=util, leader_util=leader_util, potential_nw_out=pot,
         replica_count=rc, leader_count=lc, part_rack_count=prc,
         topic_broker_count=tbc, topic_leader_count=tlc, disk_util=du,
+        util_residual=util_res, leader_util_residual=lead_res,
         moved=st.moved.at[replica].set(st.moved[replica] | en),
     )
 
@@ -228,9 +264,12 @@ def apply_leadership(env: ClusterEnv, st: EngineState, src_replica: Array,
     # src loses (leader - follower) delta; dst gains it
     delta_s = (env.leader_load[src_replica] - env.follower_load[src_replica]) * enf
     delta_d = (env.leader_load[dst_replica] - env.follower_load[dst_replica]) * enf
-    util = st.util.at[bs].add(-delta_s).at[bd].add(delta_d)
-    leader_util = (st.leader_util.at[bs].add(-env.leader_load[src_replica] * enf)
-                                  .at[bd].add(env.leader_load[dst_replica] * enf))
+    util, util_res = _kahan_scatter2(st.util, st.util_residual,
+                                     bs, -delta_s, bd, delta_d)
+    leader_util, lead_res = _kahan_scatter2(
+        st.leader_util, st.leader_util_residual,
+        bs, -env.leader_load[src_replica] * enf,
+        bd, env.leader_load[dst_replica] * enf)
     one = en.astype(jnp.int32)
     lc = st.leader_count.at[bs].add(-one).at[bd].add(one)
     t = env.replica_topic[src_replica]
@@ -242,6 +281,8 @@ def apply_leadership(env: ClusterEnv, st: EngineState, src_replica: Array,
     return dataclasses.replace(st, replica_is_leader=lead, util=util,
                                leader_util=leader_util, leader_count=lc,
                                topic_leader_count=tlc,
+                               util_residual=util_res,
+                               leader_util_residual=lead_res,
                                leadership_moved=st.leadership_moved
                                .at[src_replica].set(st.leadership_moved[src_replica] | en)
                                .at[dst_replica].set(st.leadership_moved[dst_replica] | en))
@@ -261,9 +302,12 @@ def apply_leaderships_batched(env: ClusterEnv, st: EngineState,
     bd = st.replica_broker[dst_replicas]
     delta_s = (env.leader_load[src_replicas] - env.follower_load[src_replicas]) * enf
     delta_d = (env.leader_load[dst_replicas] - env.follower_load[dst_replicas]) * enf
-    util = st.util.at[bs].add(-delta_s).at[bd].add(delta_d)
-    leader_util = (st.leader_util.at[bs].add(-env.leader_load[src_replicas] * enf)
-                                  .at[bd].add(env.leader_load[dst_replicas] * enf))
+    util, util_res = _kahan_scatter2(st.util, st.util_residual,
+                                     bs, -delta_s, bd, delta_d)
+    leader_util, lead_res = _kahan_scatter2(
+        st.leader_util, st.leader_util_residual,
+        bs, -env.leader_load[src_replicas] * enf,
+        bd, env.leader_load[dst_replicas] * enf)
     one = en.astype(jnp.int32)
     lc = st.leader_count.at[bs].add(-one).at[bd].add(one)
     t = env.replica_topic[src_replicas]
@@ -280,7 +324,9 @@ def apply_leaderships_batched(env: ClusterEnv, st: EngineState,
     lmoved = st.leadership_moved | cleared | granted
     return dataclasses.replace(st, replica_is_leader=lead, util=util,
                                leader_util=leader_util, leader_count=lc,
-                               topic_leader_count=tlc, leadership_moved=lmoved)
+                               topic_leader_count=tlc, leadership_moved=lmoved,
+                               util_residual=util_res,
+                               leader_util_residual=lead_res)
 
 
 def apply_moves_batched(env: ClusterEnv, st: EngineState, replicas: Array,
@@ -310,10 +356,12 @@ def apply_moves_batched(env: ClusterEnv, st: EngineState, replicas: Array,
     load = jnp.where(is_leader[:, None], env.leader_load[replicas],
                      env.follower_load[replicas])
     load = jnp.where(mask[:, None], load, 0.0)
-    util = st.util.at[src].add(-load).at[dsts].add(load)
+    util, util_res = _kahan_scatter2(st.util, st.util_residual,
+                                     src, -load, dsts, load)
     lead_load = jnp.where((mask & is_leader)[:, None],
                           env.leader_load[replicas], 0.0)
-    leader_util = st.leader_util.at[src].add(-lead_load).at[dsts].add(lead_load)
+    leader_util, lead_res = _kahan_scatter2(
+        st.leader_util, st.leader_util_residual, src, -lead_load, dsts, lead_load)
     pot_delta = jnp.where(mask, env.leader_load[replicas, Resource.NW_OUT], 0.0)
     pot = st.potential_nw_out.at[src].add(-pot_delta).at[dsts].add(pot_delta)
     one = mask.astype(jnp.int32)
@@ -348,6 +396,7 @@ def apply_moves_batched(env: ClusterEnv, st: EngineState, replicas: Array,
         util=util, leader_util=leader_util, potential_nw_out=pot,
         replica_count=rc, leader_count=lc, part_rack_count=prc,
         topic_broker_count=tbc, topic_leader_count=tlc, disk_util=du,
+        util_residual=util_res, leader_util_residual=lead_res,
         moved=st.moved.at[widx].set(True, mode="drop"),
     )
 

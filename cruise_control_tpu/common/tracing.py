@@ -204,6 +204,11 @@ def goal_trace_rows(goal_results) -> list[dict]:
         "disk": g.disk_actions,
         "waves": g.move_waves,
         "finisher": g.finisher_actions,
+        # segment-parallel finisher phase (PR 7): segments the applied waves
+        # spread destinations over (0 = legacy waves) and admitted
+        # cross-segment boundary rows re-validated by the budgeted admission
+        "fin_segments": getattr(g, "finisher_segments", 0),
+        "fin_boundary": getattr(g, "finisher_boundary", 0),
     } for g in goal_results]
 
 

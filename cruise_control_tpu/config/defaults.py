@@ -150,6 +150,22 @@ _D.define(name="analyzer.tail.pass.budget", type=Type.INT, default=64, validator
           doc="TPU-specific: cumulative low-yield passes allowed per goal — the "
               "bounded convergence tail (reference analogue: the 1 s-per-broker "
               "swap cap, ResourceDistributionGoal.java:58).")
+_D.define(name="analyzer.finisher.segments", type=Type.INT, default=8,
+          validator=at_least(0),
+          doc="TPU-specific: destination-segment spread of the exhaustive "
+              "finisher's applied waves — brokers are partitioned into this "
+              "many interaction-disjoint segments (greedy room-ranked "
+              "striped coloring over the chain's combined acceptance room "
+              "tables) and every scan candidate contributes its best "
+              "destination PER SEGMENT, so one [K, B] re-score lands up to "
+              "segments x K actions in a single batched admission+apply "
+              "instead of K. Cross-segment boundary rows are re-validated "
+              "by the cumulative-budget admission, so the applied set stays "
+              "certified equivalent to some sequential order (the "
+              "_finisher_wave argument). 0 or 1 = legacy single-destination "
+              "waves. The active count is a traced budget leaf (toggling "
+              "reuses compiled programs); the configured value also sets "
+              "the static spread width.")
 _D.define(name="analyzer.pass.waves", type=Type.INT, default=4, validator=at_least(1),
           doc="TPU-specific: rank-banded admission waves per budgeted engine "
               "pass — one O(R) candidate keying feeds up to this many scored "
@@ -177,20 +193,21 @@ _D.define(name="analyzer.compute.dtype", type=Type.STRING, default="auto",
           validator=in_set("auto", "float32", "bfloat16"),
           validator_doc="one of: auto, float32, bfloat16",
           doc="TPU-specific: precision policy of the engine's wide score "
-              "sweeps (the [K, B]/[KL, F] candidate scoring + [R] keying "
-              "fusions — the HBM-bandwidth wall). bfloat16 halves their "
-              "per-pass traffic; gain accounting, min-gain application, "
-              "severity/violation measures and the fixpoint-certificate "
-              "scans ALWAYS stay float32, so violation counts and "
-              "certificate sets match the f32 pipeline on the certified "
-              "parity fixtures (tests/test_dtype_policy.py). bfloat16 is "
-              "OPT-IN: 'auto' currently resolves to float32 everywhere — "
-              "the rung-4 A/B (docs/PERF.md round 7) measured bf16 budgeted "
-              "tails costing violations at the 1M rung (tail gains round "
-              "below one bf16 ulp), so the planned >= 256k auto-on "
-              "threshold is held back until pair-exact f32 re-scoring "
-              "lands. STATIC knob: changing it recompiles the engine "
-              "programs (documented; budget knobs stay traced).")
+              "sweeps. bfloat16 halves the [R, M] per-replica load streams "
+              "— the HBM-bandwidth wall of the [K, B]/[KL, F] scoring and "
+              "[R] keying fusions — while the broker-level accumulators "
+              "the scores difference read the f32 Kahan-COMPENSATED sums "
+              "(util + residual; engine._sweep_state), and gain accounting, "
+              "min-gain application, severity/violation measures and the "
+              "fixpoint-certificate scans ALWAYS stay float32. Violation "
+              "counts and certificate sets match the f32 pipeline on the "
+              "certified parity fixtures (tests/test_dtype_policy.py). "
+              "'auto' resolves to bfloat16 at >= 256k replicas and float32 "
+              "below (the compensated accounting + segment-parallel "
+              "finisher closed the rung-4 violation gap that held auto-on "
+              "back through round 7; docs/PERF.md round 9). STATIC knob: "
+              "changing it recompiles the engine programs (documented; "
+              "budget knobs stay traced).")
 _D.define(name="analyzer.compact.tables", type=Type.BOOLEAN, default=True,
           doc="TPU-specific: store the device cluster tables compact — "
               "int16 broker/rack/topic index columns where the axis fits, "

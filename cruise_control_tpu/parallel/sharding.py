@@ -50,7 +50,7 @@ _ENV_BROKER_AXES = {
 _STATE_BROKER_AXES = {
     "util": 0, "leader_util": 0, "potential_nw_out": 0, "replica_count": 0,
     "leader_count": 0, "topic_broker_count": 1, "topic_leader_count": 1,
-    "disk_util": 0,
+    "disk_util": 0, "util_residual": 0, "leader_util_residual": 0,
 }
 # replica-dim leaves sharded along the same device axis
 _ENV_REPLICA_AXES = {

@@ -1,0 +1,96 @@
+"""bench.py summary emission contract: the LAST stdout line is one compact,
+machine-parseable JSON document.
+
+BENCH_r05 recorded ``"parsed": null`` because the single emitted line —
+megabytes of embedded last_round_trace/sensors blobs — was truncated
+mid-line by the driver's tail capture. The fix under test: ``Summary.emit``
+prints the full document as a pretty block first, then ONE compact line
+(bulky per-rung blobs stripped) that is always last and always small.
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load_bench():
+    """Import bench.py by path (it is a script at the repo root, not a
+    package module); reuse an already-imported instance so repeated tests
+    don't re-register signal handlers."""
+    if "cc_bench" in sys.modules:
+        return sys.modules["cc_bench"]
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+    spec = importlib.util.spec_from_file_location("cc_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["cc_bench"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_final_line_is_compact_parseable_json(tmp_path, monkeypatch, capsys):
+    bench = _load_bench()
+    monkeypatch.chdir(tmp_path)          # emit writes BENCH_partial.json
+    s = bench.Summary()
+    s.headline_requested = True
+    # a rung fat enough to reproduce the truncation hazard: the embedded
+    # trace/sensor blobs are what blew the old single line past the tail cap
+    fat_rung = {
+        "config": "7000b-1M", "wall_s": 123.4, "wall_s_cold": 456.7,
+        "warm_measured": True, "violations_before": 10,
+        "violations_after": 3, "violated_goals_after": ["A", "B", "C"],
+        "num_replica_movements": 321888,
+        "last_round_trace": {"goals": [{"name": f"G{i}", "passes": i,
+                                        "fin_segments": 8,
+                                        "fin_boundary": i * 3}
+                                       for i in range(16)],
+                             "blob": "x" * 200_000},
+        "sensors": {f"sensor-{i}": {"type": "gauge", "value": i}
+                    for i in range(400)},
+        "pass_profile": {f"G{i}": {"passes": i, "segments": 8,
+                                   "boundary": i} for i in range(16)},
+    }
+    s.rungs.append(fat_rung)
+    s.headline = fat_rung
+    s.emit(final=True)
+    out = capsys.readouterr().out
+    lines = out.rstrip("\n").splitlines()
+    # the pretty block is above; the LAST line alone must parse
+    last = lines[-1]
+    doc = json.loads(last)
+    # compact: small enough that no tail capture truncates it mid-line
+    assert len(last) < 16_384, len(last)
+    assert doc["complete"] is True
+    assert doc["value"] == 123.4
+    assert doc["unit"] == "s"
+    assert doc["rungs"][0]["config"] == "7000b-1M"
+    assert doc["rungs"][0]["violations_after"] == 3
+    for bulky in bench.BULKY_RUNG_KEYS:
+        assert bulky not in doc["rungs"][0], bulky
+    # the pretty block above the line still carries the FULL document
+    pretty = "\n".join(lines[:-1])
+    full = json.loads(pretty)
+    assert "last_round_trace" in full["rungs"][0]
+    # BENCH_partial.json keeps the full single-line document (trace_view's
+    # whole-file parse input)
+    with open(tmp_path / "BENCH_partial.json") as f:
+        partial = json.loads(f.read())
+    assert "last_round_trace" in partial["rungs"][0]
+
+
+def test_final_line_without_headline(tmp_path, monkeypatch, capsys):
+    """A scenario-only / headline-less run still ends in one parseable
+    compact line with honest metric attribution (the r05 convention)."""
+    bench = _load_bench()
+    monkeypatch.chdir(tmp_path)
+    s = bench.Summary()
+    s.headline_requested = False
+    s.rungs.append({"config": "100b-10k", "wall_s": 0.7,
+                    "last_round_trace": {"goals": []}})
+    s.emit(final=True)
+    last = capsys.readouterr().out.rstrip("\n").splitlines()[-1]
+    doc = json.loads(last)
+    assert doc["complete"] is True
+    assert doc["value"] == 0.7
+    assert "100b-10k" in doc["metric"]

@@ -1,0 +1,283 @@
+"""Segment-parallel finisher + compensated accounting certification (PR 7).
+
+The two contracts this PR adds to the engine:
+
+1. SEGMENT-PARALLEL FINISHER (``EngineParams.max_finisher_segments`` /
+   ``finisher_segments``): the finisher's applied waves spread each scan
+   candidate across interaction-disjoint broker segments and admit the
+   flattened [K * S] action rows in ONE batched program. Parity bar (the
+   PR 4/5 style): segments-on == segments-off identical violation sets and
+   ``fixpoint_proven`` certificate sets on the seeded parity fixtures with
+   the finisher forced on; the ACTIVE segment count is a traced budget leaf
+   (toggling it compiles nothing new); the applied set stays consistent
+   with a from-scratch ``refresh`` (the sequential-equivalence evidence —
+   every derived tally matches the assignment the wave produced).
+
+2. COMPENSATED (Kahan) ACCOUNTING (``EngineState.util_residual`` /
+   ``leader_util_residual``): the f32 rounding error of the incremental
+   scatter accounting is carried beside the accumulators, the bf16 sweep
+   policy reads the compensated sums (engine._sweep_state), and the
+   compensation may never LOSE accuracy — ``util + residual`` is at least
+   as close to the exact sum as ``util`` alone, so a tail gain f32 sees is
+   never a rounding casualty of the compensated path.
+
+Only the pre-registered ``slow`` marker is used (tests/conftest.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.engine import (
+    EngineParams, _sweep_state, optimize_goal,
+)
+from cruise_control_tpu.analyzer.env import make_env, padded_partition_table
+from cruise_control_tpu.analyzer.goals import make_goals
+from cruise_control_tpu.analyzer.optimizer import (
+    BF16_AUTO_MIN_REPLICAS, GoalOptimizer, _resolve_compute_dtype,
+)
+from cruise_control_tpu.analyzer.state import (
+    apply_moves_batched, init_state, refresh,
+)
+from cruise_control_tpu.config import cruise_control_config
+from cruise_control_tpu.model.cluster_tensor import pad_cluster
+from cruise_control_tpu.model.random_cluster import RandomClusterSpec, generate
+
+CHAIN = ["RackAwareGoal", "DiskCapacityGoal", "CpuCapacityGoal",
+         "ReplicaDistributionGoal", "DiskUsageDistributionGoal",
+         "LeaderReplicaDistributionGoal"]
+
+
+def _cluster(seed=777, brokers=24, partitions=300):
+    return generate(RandomClusterSpec(
+        num_brokers=brokers, num_racks=4, num_topics=12,
+        num_partitions=partitions, max_replication=2, skew=2.0, seed=seed))
+
+
+def _run(ct, meta, params, config=None):
+    opt = GoalOptimizer(config=config, engine_params=params)
+    return opt.optimizations(ct, meta, goal_names=CHAIN,
+                             raise_on_failure=False,
+                             skip_hard_goal_check=True)
+
+
+# ------------------------------------------------------------ outcome parity
+def test_segments_on_off_outcome_parity():
+    """Segments-on vs segments-off (static legacy waves): identical
+    violation sets and fixpoint-certificate sets on the seeded parity
+    fixtures, finisher forced on (small fixtures normally skip it)."""
+    cfg = cruise_control_config({"analyzer.finisher.min.replicas": 0})
+    for seed in (777, 881):
+        ct, meta = _cluster(seed=seed)
+        r_on = _run(ct, meta, EngineParams(finisher_segments=8,
+                                           max_finisher_segments=8),
+                    config=cfg)
+        r_off = _run(ct, meta, EngineParams(finisher_segments=0,
+                                            max_finisher_segments=0),
+                     config=cfg)
+        assert (r_on.violated_goals_after
+                == r_off.violated_goals_after), f"seed={seed}"
+        cert_on = {g.name for g in r_on.goal_results
+                   if g.violated_after and g.fixpoint_proven}
+        cert_off = {g.name for g in r_off.goal_results
+                    if g.violated_after and g.fixpoint_proven}
+        assert cert_on == cert_off, f"seed={seed}"
+        # the off run reports the legacy wave (segments=0) in its profile
+        assert all(g.finisher_segments == 0 for g in r_off.goal_results)
+
+
+def test_segment_waves_apply_and_stay_refresh_consistent():
+    """With the budgeted loop crippled the segmented finisher must land the
+    actions itself; afterwards EVERY derived tally matches a from-scratch
+    refresh of the assignment it produced (the sequential-equivalence
+    evidence: the batched segment wave bookkeeping equals rebuilding from
+    the final placement), and the segment/boundary counters surface."""
+    ct, meta = _cluster(seed=881, brokers=32, partitions=800)
+    ct, meta = pad_cluster(ct, meta)
+    env = make_env(ct, meta, partition_table=padded_partition_table(ct))
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    goals = make_goals(["DiskUsageDistributionGoal",
+                        "LeaderReplicaDistributionGoal"])
+    params = EngineParams(max_iters=2, stall_retries=0, tail_pass_budget=1,
+                          tail_total_budget=2, finisher_rounds=10,
+                          finisher_candidates=64, finisher_waves=4,
+                          finisher_segments=8, max_finisher_segments=8)
+    prev = ()
+    fin_actions = 0
+    segs = 0
+    for g in goals:
+        st, info = optimize_goal(env, st, g, prev, params)
+        prev = prev + (g,)
+        fin_actions += int(info["finisher_actions"])
+        segs = max(segs, int(info["finisher_segments"]))
+    assert fin_actions > 0, "crippled budgets: the finisher must act"
+    assert segs == 8
+    r = refresh(env, st)
+    np.testing.assert_array_equal(np.asarray(st.replica_count),
+                                  np.asarray(r.replica_count))
+    np.testing.assert_array_equal(np.asarray(st.leader_count),
+                                  np.asarray(r.leader_count))
+    np.testing.assert_array_equal(np.asarray(st.part_rack_count),
+                                  np.asarray(r.part_rack_count))
+    np.testing.assert_array_equal(np.asarray(st.topic_broker_count),
+                                  np.asarray(r.topic_broker_count))
+    # float tallies: incremental vs recomputed within f32 accumulation noise
+    np.testing.assert_allclose(np.asarray(st.util), np.asarray(r.util),
+                               rtol=1e-4, atol=1e-2)
+
+
+def test_segment_toggle_is_traced_zero_new_compiles():
+    """``finisher_segments`` (active count) is a traced budget leaf —
+    toggling it reuses the compiled programs; ``max_finisher_segments``
+    (spread width) is static — flipping it changes the treedef (documented
+    recompile)."""
+    import logging
+
+    p8 = EngineParams(finisher_segments=8, max_finisher_segments=8)
+    assert (jax.tree_util.tree_structure(p8)
+            == jax.tree_util.tree_structure(
+                dataclasses.replace(p8, finisher_segments=1)))
+    assert (jax.tree_util.tree_structure(p8)
+            != jax.tree_util.tree_structure(
+                dataclasses.replace(p8, max_finisher_segments=0)))
+
+    cfg = cruise_control_config({"analyzer.finisher.min.replicas": 0})
+    ct, meta = _cluster(seed=779)
+    _run(ct, meta, p8, config=cfg)       # compile
+
+    class Counter(logging.Handler):
+        def __init__(self):
+            super().__init__(level=logging.DEBUG)
+            self.count = 0
+
+        def emit(self, record):
+            if "Compiling" in record.getMessage():
+                self.count += 1
+
+    handler = Counter()
+    prev = bool(jax.config.jax_log_compiles)
+    jax.config.update("jax_log_compiles", True)
+    logging.getLogger("jax").addHandler(handler)
+    try:
+        for segs in (1, 3, 8):
+            _run(ct, meta, dataclasses.replace(p8, finisher_segments=segs),
+                 config=cfg)
+    finally:
+        logging.getLogger("jax").removeHandler(handler)
+        jax.config.update("jax_log_compiles", prev)
+    assert handler.count == 0, \
+        f"{handler.count} recompiles on finisher_segments toggles"
+
+
+# ------------------------------------------------ compensated accounting
+def test_kahan_residual_never_loses_accuracy():
+    """Apply waves of deliberately cancellation-heavy moves (tiny loads
+    against large accumulators — the tail-gain regime): ``util + residual``
+    must be at least as close to the f64-exact accounting as ``util``
+    alone, elementwise, and strictly closer somewhere (the compensation
+    does real work on this construction)."""
+    ct, meta = _cluster(seed=42, brokers=16, partitions=400)
+    ct, meta = pad_cluster(ct, meta)
+    env = make_env(ct, meta, partition_table=padded_partition_table(ct))
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    rng = np.random.default_rng(0)
+    R = env.num_replicas
+    B = env.num_brokers
+    valid = np.asarray(ct.replica_valid)
+    # f64 shadow of the accounting the waves perform
+    exact = np.asarray(st.util, np.float64)
+    lead = np.asarray(st.replica_is_leader)
+    ll = np.asarray(env.leader_load, np.float64)
+    fl = np.asarray(env.follower_load, np.float64)
+    part = np.asarray(env.replica_partition)
+    stx = st
+    moved_parts: set[int] = set()
+    for wave in range(6):
+        picks, dsts = [], []
+        for r in rng.permutation(np.flatnonzero(valid))[:200]:
+            if int(part[r]) in moved_parts or len(picks) >= 16:
+                continue
+            moved_parts.add(int(part[r]))
+            picks.append(int(r))
+            dsts.append(int(rng.integers(0, B)))
+        picks_a = jnp.asarray(picks, jnp.int32)
+        dsts_a = jnp.asarray(dsts, jnp.int32)
+        mask = jnp.ones(len(picks), bool)
+        src = np.asarray(stx.replica_broker)[picks]
+        stx = apply_moves_batched(env, stx, picks_a, dsts_a, mask)
+        for i, r in enumerate(picks):
+            row = ll[r] if lead[r] else fl[r]
+            exact[src[i]] -= row
+            exact[dsts[i]] += row
+    raw_err = np.abs(np.asarray(stx.util, np.float64) - exact)
+    comp_err = np.abs(np.asarray(stx.util, np.float64)
+                      + np.asarray(stx.util_residual, np.float64) - exact)
+    # never lose: compensated error <= raw error everywhere (tiny slack for
+    # the second-order error of the estimate itself)
+    assert np.all(comp_err <= raw_err + 1e-4), \
+        (comp_err.max(), raw_err.max())
+    if raw_err.max() > 0:
+        assert comp_err.sum() <= raw_err.sum()
+
+
+def test_sweep_state_reads_compensated_view():
+    """Under the bf16 policy the sweep view's broker accumulators are the
+    COMPENSATED f32 sums (util + residual) — not a bf16 downcast; under f32
+    the view is the identity (bit-exact fallback)."""
+    ct, meta = _cluster(seed=43, brokers=16, partitions=200)
+    ct, meta = pad_cluster(ct, meta)
+    env = make_env(ct, meta, partition_table=padded_partition_table(ct))
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    # plant a residual the view must surface
+    st = dataclasses.replace(
+        st, util_residual=jnp.full_like(st.util, 1e-3))
+    sw = _sweep_state(st, EngineParams(compute_dtype="bfloat16"))
+    assert sw.util.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(sw.util),
+                               np.asarray(st.util + st.util_residual))
+    assert _sweep_state(st, EngineParams(compute_dtype="float32")) is st
+    assert _sweep_state(st, EngineParams()) is st
+
+
+def test_auto_dtype_resolution():
+    """'auto' resolves to bf16 at the >= 256k-replica threshold and f32
+    below; explicit pins win at every level."""
+    assert _resolve_compute_dtype("auto", "auto", 1000) == "float32"
+    assert _resolve_compute_dtype(
+        "auto", "auto", BF16_AUTO_MIN_REPLICAS) == "bfloat16"
+    assert _resolve_compute_dtype(
+        "auto", "auto", BF16_AUTO_MIN_REPLICAS - 1) == "float32"
+    assert _resolve_compute_dtype(
+        "auto", "float32", 10 * BF16_AUTO_MIN_REPLICAS) == "float32"
+    assert _resolve_compute_dtype("auto", "bfloat16", 1000) == "bfloat16"
+    assert _resolve_compute_dtype("float32", "bfloat16", 10**7) == "float32"
+    assert _resolve_compute_dtype("bfloat16", "float32", 8) == "bfloat16"
+
+
+@pytest.mark.slow
+def test_segments_parity_bf16_matrix():
+    """Slow matrix: {segments on/off} x {f32/bf16} on the parity seeds with
+    the finisher forced — violation and certificate sets identical across
+    all four cells per seed (the rung-ladder A/B's fixture-scale mirror)."""
+    cfg = cruise_control_config({"analyzer.finisher.min.replicas": 0})
+    for seed in (777, 881, 1234):
+        ct, meta = _cluster(seed=seed)
+        cells = {}
+        for segs in (8, 0):
+            for dt in ("float32", "bfloat16"):
+                r = _run(ct, meta, EngineParams(
+                    finisher_segments=segs, max_finisher_segments=segs,
+                    compute_dtype=dt), config=cfg)
+                cells[(segs, dt)] = (
+                    tuple(r.violated_goals_after),
+                    frozenset(g.name for g in r.goal_results
+                              if g.violated_after and g.fixpoint_proven))
+        vals = set(cells.values())
+        assert len(vals) == 1, f"seed={seed}: {cells}"

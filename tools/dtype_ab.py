@@ -1,19 +1,23 @@
-"""Knob-grid A/B harness for the engine memory diet (PR 5):
+"""Knob-grid A/B harness for the engine memory diet (PR 5) and the
+segment-parallel finisher (PR 7):
 
     {analyzer.compute.dtype} x {analyzer.compact.tables} x {donation}
+      x {analyzer.finisher.segments}
 
 per cell: cold + warm full-chain optimize on a bench shape, reporting warm
 wall, violation counts before/after, fixpoint certificates, the per-branch
-pass profile (passes / moves / leads / swaps / waves per goal — the
-tools/pass_prof.py fields, here from the optimizer's own GoalResult
-counters), and the device env/state byte footprint. The donation axis drives
-``tpu.donate.state`` (per-goal buffer donation on the direct optimizer path;
-the resident session's ``analyzer.session.donation`` double-buffer protocol
-is exercised by the bench's e2e steady rounds and tests/test_dtype_policy).
+pass profile (passes / moves / leads / swaps / waves / finisher segments +
+boundary re-validations per goal — the tools/pass_prof.py fields, here from
+the optimizer's own GoalResult counters), and the device env/state byte
+footprint. The donation axis drives ``tpu.donate.state`` (per-goal buffer
+donation on the direct optimizer path; the resident session's
+``analyzer.session.donation`` double-buffer protocol is exercised by the
+bench's e2e steady rounds and tests/test_dtype_policy).
 
-Usage: dtype_ab.py [r2|r3|r4] [--cells dtype,compact,donate;...]
+Usage: dtype_ab.py [r2|r3|r4] [--cells dtype,compact,donate[,segments];...]
   e.g.  dtype_ab.py r3
-        dtype_ab.py r2 --cells float32,on,off;bfloat16,on,off
+        dtype_ab.py r4 --cells float32,on,off,8;float32,on,off,0
+        dtype_ab.py r4 --cells auto,on,off,8;bfloat16,on,off,0
 """
 import json
 import os
@@ -52,11 +56,13 @@ def tree_bytes(tree) -> int:
                    if hasattr(x, "nbytes")))
 
 
-def run_cell(ct, meta, dtype: str, compact: bool, donate: bool) -> dict:
+def run_cell(ct, meta, dtype: str, compact: bool, donate: bool,
+             segments: int = 8) -> dict:
     cfg = cruise_control_config({
         "analyzer.compute.dtype": dtype,
         "analyzer.compact.tables": compact,
         "tpu.donate.state": donate,
+        "analyzer.finisher.segments": segments,
     })
     opt = GoalOptimizer(config=cfg)
     walls = []
@@ -67,7 +73,8 @@ def run_cell(ct, meta, dtype: str, compact: bool, donate: bool) -> dict:
                                 skip_hard_goal_check=True)
         walls.append(time.monotonic() - t0)
     return {
-        "cell": {"dtype": dtype, "compact": compact, "donate": donate},
+        "cell": {"dtype": dtype, "compact": compact, "donate": donate,
+                 "segments": segments},
         "wall_s_cold": round(walls[0], 2),
         "wall_s_warm": round(walls[-1], 2),
         "violations_before": len(res.violated_goals_before),
@@ -81,7 +88,9 @@ def run_cell(ct, meta, dtype: str, compact: bool, donate: bool) -> dict:
             g.name: {"passes": g.passes, "moves": g.move_actions,
                      "leads": g.lead_actions, "swaps": g.swap_actions,
                      "disk": g.disk_actions, "waves": g.move_waves,
-                     "finisher": g.finisher_actions}
+                     "finisher": g.finisher_actions,
+                     "segments": g.finisher_segments,
+                     "boundary": g.finisher_boundary}
             for g in res.goal_results if g.passes or g.iterations
         },
     }
@@ -95,10 +104,12 @@ def main() -> None:
         spec = argv[argv.index("--cells") + 1]
         cells = []
         for c in spec.split(";"):
-            d, co, dn = c.split(",")
-            cells.append((d, co == "on", dn == "on"))
+            parts = c.split(",")
+            d, co, dn = parts[:3]
+            segs = int(parts[3]) if len(parts) > 3 else 8
+            cells.append((d, co == "on", dn == "on", segs))
     if cells is None:
-        cells = [(d, co, dn)
+        cells = [(d, co, dn, 8)
                  for d in ("float32", "bfloat16")
                  for co in (True, False)
                  for dn in (False, True)]
@@ -106,10 +117,10 @@ def main() -> None:
     print(f"shape {shape}: B={ct.num_brokers} R={ct.num_replicas}",
           file=sys.stderr, flush=True)
     out = []
-    for d, co, dn in cells:
-        cell = run_cell(ct, meta, d, co, dn)
+    for d, co, dn, segs in cells:
+        cell = run_cell(ct, meta, d, co, dn, segs)
         out.append(cell)
-        print(f"  {d:9s} compact={int(co)} donate={int(dn)}: "
+        print(f"  {d:9s} compact={int(co)} donate={int(dn)} segs={segs}: "
               f"warm={cell['wall_s_warm']}s "
               f"viol={cell['violations_before']}->"
               f"{cell['violations_after']} "

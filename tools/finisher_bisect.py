@@ -38,8 +38,8 @@ elif which == "wave":
     def w(e, s):
         g, d = E._exhaustive_move_scan(e, s, goal, (), params.scan_chunk)
         return E._finisher_wave(e, s, goal, (), params, g, leadership=False)
-    s2, n = jax.jit(w)(env, st); jax.block_until_ready(s2.util)
-    print("wave ok applied", int(n), flush=True)
+    s2, n, nb = jax.jit(w)(env, st); jax.block_until_ready(s2.util)
+    print("wave ok applied", int(n), "boundary", int(nb), flush=True)
 elif which == "finisher":
     def w(e, s):
         return E._finisher(e, s, goal, (), params, jnp.bool_(True))

@@ -177,3 +177,35 @@ def stage_admit_apply(env, st, cand, order, dst_s, val_s):
 score, best_val, order = bench("stage: mask+score+sort", stage_score, env, st, cand, kv)
 dst_s, val_s = bench("stage: dst spread", stage_spread, env, st, score, best_val, order)
 bench("stage: admission+apply", stage_admit_apply, env, st, cand, order, dst_s, val_s)
+
+
+# ---- finisher-segment stage (PR 7): one exhaustive scan feeding one
+# segment-parallel wave vs the legacy single-destination wave — the
+# per-round cost split of the segmented finisher at this shape ----
+KF = min(params.finisher_candidates, env.num_replicas)
+
+@jax.jit
+def stage_fin_scan(env, st):
+    return E._exhaustive_move_scan(env, st, goal, prev, params.scan_chunk,
+                                   chain_cache=params.chain_cache)
+
+@jax.jit
+def stage_seg_wave(env, st, gain):
+    kv, fcand = jax.lax.top_k(gain[:env.num_replicas], KF)
+    kv = jnp.where(kv > params.min_gain, kv, NEG_INF)
+    return E._segment_move_wave(env, st, goal, prev, params, fcand, kv)
+
+@jax.jit
+def stage_legacy_wave(env, st, gain):
+    kv, fcand = jax.lax.top_k(gain[:env.num_replicas], KF)
+    kv = jnp.where(kv > params.min_gain, kv, NEG_INF)
+    sev = goal.broker_severity(env, st)
+    return E._move_branch_batched(env, st, goal, prev, params, sev, zero,
+                                  cand=fcand, kv=kv)
+
+gain, _dst = bench("stage: finisher scan [R,B]", stage_fin_scan, env, st)
+_st2, n_seg, n_bnd = bench(f"stage: segment wave S={params.max_finisher_segments}",
+                           stage_seg_wave, env, st, gain)
+_st3, n_leg, _w = bench("stage: legacy wave S=1", stage_legacy_wave, env, st, gain)
+print(f"segment wave applied {int(n_seg)} ({int(n_bnd)} boundary) vs "
+      f"legacy {int(n_leg)} per re-score", flush=True)

@@ -97,6 +97,11 @@ def render(trace: dict, width: int = 48) -> str:
                   f"m={g.get('moves', 0)} l={g.get('leads', 0)} "
                   f"s={g.get('swaps', 0)} d={g.get('disk', 0)} "
                   f"f={g.get('finisher', 0)}")
+        # segment-parallel finisher phase (fin_segments=0 = legacy waves):
+        # show segments + boundary re-validations only where the phase ran
+        if g.get("fin_segments"):
+            detail += (f" seg={g['fin_segments']}"
+                       f" b={g.get('fin_boundary', 0)}")
         val = f"{v:.3f}{unit}" if measured else f"{int(v)}{unit}"
         lines.append(f"  {g['name']:<{name_w}} {flags} "
                      f"{_bar(v / top, width)} {val:>12}  {detail}")
@@ -114,18 +119,25 @@ def main(argv: list[str]) -> int:
         return 2
     raw = (sys.stdin.read() if args[0] == "-"
            else open(args[0]).read())
-    # BENCH files are one JSON document per line; take the last parseable one
-    doc = None
+    # BENCH files are one JSON document per line; scan from the last line
+    # back and take the first parseable document that CARRIES traces (the
+    # bench's compact machine-parseable final line strips the bulky
+    # last_round_trace blobs — the full document is the pretty block /
+    # earlier line above it)
+    traces: list[dict] = []
+    parsed_any = False
     for line in [raw] + raw.strip().splitlines()[::-1]:
         try:
             doc = json.loads(line)
-            break
         except json.JSONDecodeError:
             continue
-    if doc is None:
+        parsed_any = True
+        traces = _collect(doc)
+        if traces:
+            break
+    if not parsed_any:
         print("no parseable JSON document found", file=sys.stderr)
         return 1
-    traces = _collect(doc)
     if not traces:
         print("no round traces found in document", file=sys.stderr)
         return 1
