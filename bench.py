@@ -28,6 +28,7 @@ timeout is a bench that doesn't exist):
   SIGTERM first).
 
 Usage: bench.py [rung ...] [--profile] [--skip-cold] [--scenario [name]]
+               [--campaign [name]] [--campaign-seed N]
                [--rung name] [--profile-level off|pass|stage]
   --profile    block per goal for honest per-goal seconds (adds tunnel
                round-trips; not for wall-clock claims)
@@ -38,6 +39,13 @@ Usage: bench.py [rung ...] [--profile] [--skip-cold] [--scenario [name]]
   --scenario   run the self-healing scenario rung (sim/ catalog name,
                default broker-death-50b-1k); emits a "scenario" block with
                time_to_detect_ms / time_to_heal_ms into the summary JSON
+  --campaign   run the seeded chaos-campaign rung (sim/campaign.py catalog
+               name, default micro); emits a "campaign" block with
+               per-fault-type time-to-detect/heal/actions SLO distributions
+               (p50/p95/max, simulated ms) + verifier/invariant verdicts,
+               and writes the full episode log to CAMPAIGN_<name>_s<seed>.json
+  --campaign-seed  campaign seed (default 0); same (campaign, seed) =>
+               bit-identical episode log
   --rung NAME  run only the named rung(s) (repeatable; same ids as the
                positional form: 1..5, e2e, e2e7k, scenario) — the same-day
                A/B workflow's "rerun one rung without paying the ladder"
@@ -90,6 +98,7 @@ RUNG_COST_EST = {
     "e2e": (450, 150),
     "e2e7k": (1600, 760),
     "scenario": (150, 60),
+    "campaign": (300, 120),
 }
 
 
@@ -112,7 +121,12 @@ BULKY_RUNG_KEYS = ("last_round_trace", "sensors", "pass_profile",
                    "goal_seconds", "goal_passes", "goal_actions",
                    "steady_phases", "actions_remaining", "device_mem",
                    "steady_device_mem", "violated_goals_after",
-                   "budget_exhausted", "fixpoint_proven", "latency_timers")
+                   "budget_exhausted", "fixpoint_proven", "latency_timers",
+                   # campaign rung: the SLO block lives in the top-level
+                   # "campaign" summary; the per-rung copy is the bulky twin.
+                   # scenario_spec is the scenario rung's replay payload —
+                   # full document only (BENCH_partial.json / pretty block)
+                   "slo", "provision_actions", "scenario_spec")
 
 
 def compact_summary(out: dict) -> dict:
@@ -134,6 +148,7 @@ class Summary:
         self.rungs: list[dict] = []
         self.headline: dict | None = None
         self.scenario: dict | None = None   # self-healing closed-loop latency
+        self.campaign: dict | None = None   # chaos-campaign SLO distributions
         self.headline_requested = True      # set from the requested rung list
 
     def emit(self, final: bool = False) -> None:
@@ -152,6 +167,11 @@ class Summary:
                 metric = (f"self-healing scenario wall-clock "
                           f"({self.scenario['name']})")
                 value = self.scenario["wall_s"]
+            elif self.campaign is not None:
+                metric = (f"chaos campaign wall-clock "
+                          f"({self.campaign['name']}, "
+                          f"{self.campaign['num_episodes']} episodes)")
+                value = self.campaign["wall_s"]
             elif ran:
                 metric = f"rebalance proposal wall-clock @ {ran[0]['config']}"
                 value = ran[0].get("wall_s")
@@ -175,6 +195,10 @@ class Summary:
             # self-healing latency block (sim/ scenario engine): tracks
             # time-to-detect / time-to-heal in SIMULATED ms across rounds
             out["scenario"] = self.scenario
+        if self.campaign is not None:
+            # chaos-campaign block (sim/campaign.py): per-fault-type SLO
+            # distributions (p50/p95/max, SIMULATED ms) + verifier verdicts
+            out["campaign"] = self.campaign
         # pretty block first (humans + trace_view's whole-file parse of
         # BENCH_partial.json), then ONE compact machine-parseable line —
         # always the last stdout line, small enough that the driver's tail
@@ -377,6 +401,23 @@ def main() -> None:
         else:
             argv = argv[:i] + argv[i + 1:]
         argv.append("scenario")
+    campaign_name = "micro"
+    campaign_seed = 0
+    if "--campaign" in argv:
+        # --campaign [name] [--campaign-seed N]: run the seeded chaos
+        # campaign rung (sim/campaign.py catalog), emitting per-fault-type
+        # time-to-detect/heal/actions SLO distributions
+        i = argv.index("--campaign")
+        if i + 1 < len(argv) and not argv[i + 1].startswith("--"):
+            campaign_name = argv[i + 1]
+            argv = argv[:i] + argv[i + 2:]
+        else:
+            argv = argv[:i] + argv[i + 1:]
+        argv.append("campaign")
+    if "--campaign-seed" in argv:
+        i = argv.index("--campaign-seed")
+        campaign_seed = int(argv[i + 1])
+        argv = argv[:i] + argv[i + 2:]
     # --profile-level off|pass|stage: analyzer.profile.level for every rung
     # optimizer (pass = zero-cost counters; stage = blocking per-segment)
     profile_level = None
@@ -494,6 +535,11 @@ def main() -> None:
             # the host wall-clock of driving the whole loop
             rung = run_scenario_rung(scenario_name)
 
+        elif rung_id == "campaign":
+            # seeded chaos campaign (sim/campaign.py): randomized compound
+            # fault schedules -> per-fault-type SLO distributions
+            rung = run_campaign_rung(campaign_name, campaign_seed)
+
         elif rung_id == "e2e7k":
             # the full monitor path at HEADLINE scale: backend -> samples ->
             # windows -> ClusterTensor at 7,000 brokers / 500k partitions /
@@ -542,6 +588,53 @@ def run_scenario_rung(name: str) -> dict:
         f"detect={r.time_to_detect_ms}ms heal={r.time_to_heal_ms}ms "
         f"proposals={r.proposals} tasks={r.executor_tasks} "
         f"wall={rung['wall_s']}s")
+    return rung
+
+
+def run_campaign_rung(name: str, seed: int = 0) -> dict:
+    """Run one seeded chaos campaign (sim/campaign.py) and report its SLO
+    distributions: per fault type, time-to-detect / time-to-heal /
+    actions-per-heal p50/p95/max in SIMULATED ms, plus verifier verdicts and
+    provisioner actuations. Same (campaign, seed) => bit-identical episode
+    log; the full log (with timelines) goes to CAMPAIGN_<name>_s<seed>.json
+    for tools/campaign_view.py."""
+    from cruise_control_tpu.sim import run_campaign
+
+    log(f"rung campaign: seeded chaos campaign ({name}, seed {seed})")
+    t0 = time.monotonic()
+    res = run_campaign(name, seed=seed)
+    wall = round(time.monotonic() - t0, 2)
+    doc = res.to_json()
+    rung = {
+        "config": f"campaign-{name}-s{seed}",
+        "wall_s": wall,
+        "num_episodes": doc["num_episodes"],
+        "converged_episodes": doc["converged_episodes"],
+        "total_verified_optimizations": doc["total_verified_optimizations"],
+        "total_verifier_violations": doc["total_verifier_violations"],
+        "total_invariant_violations": doc["total_invariant_violations"],
+        "total_concurrency_adjustments": doc["total_concurrency_adjustments"],
+        "provision_actions": doc["provision_actions"],
+        "failures": doc["failures"],
+        "slo": doc["slo"],
+    }
+    SUMMARY.campaign = {"name": name, "seed": seed, "wall_s": wall,
+                        **{k: rung[k] for k in (
+                            "num_episodes", "converged_episodes",
+                            "total_verified_optimizations",
+                            "total_verifier_violations",
+                            "total_invariant_violations", "failures", "slo")}}
+    out_path = f"CAMPAIGN_{name}_s{seed}.json"
+    try:
+        with open(out_path, "w") as f:
+            json.dump(res.episode_log_json(), f, indent=1)
+        log(f"  [campaign] full episode log -> {out_path}")
+    except OSError:
+        pass
+    log(f"  [campaign] {doc['converged_episodes']}/{doc['num_episodes']} "
+        f"episodes converged, "
+        f"{doc['total_verified_optimizations']} optimizations verified "
+        f"({doc['total_verifier_violations']} violations), wall={wall}s")
     return rung
 
 
@@ -718,6 +811,59 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
         rung["steady_skip_reason"] = steady_skip_reason
     if warmup_s is not None:
         rung["warmup_s"] = round(warmup_s, 2)
+    # ---- restart recovery (durable sample store replay) ----
+    # record ONE final sampling round into a FileSampleStore (attached late
+    # so the timed sampling figures above stay store-free), then boot a
+    # FRESH CruiseControl over the same backend and time store replay +
+    # first model build — the service's actual restart-to-serving wall
+    # (ROADMAP: "a restart forfeits all windows" is closed by this path).
+    restart_est = model_s + 3 * (sample_s / rounds) + 5.0
+    if restart_est > remaining_budget():
+        rung["restart_skip_reason"] = (
+            f"wall budget: restart recovery (~{restart_est:.0f}s est) > "
+            f"{remaining_budget():.0f}s remaining")
+        log(f"  [e2e] {rung['restart_skip_reason']}")
+    else:
+        import shutil
+        import tempfile
+
+        from cruise_control_tpu.monitor.sampling.sample_store import (
+            FileSampleStore,
+        )
+        store_dir = tempfile.mkdtemp(prefix="cc_bench_samples_")
+        try:
+            store = FileSampleStore()
+            store.configure(None, path=store_dir)
+            cc.load_monitor.attach_sample_store(store)
+            t0 = time.monotonic()
+            # two rounds: the aggregator only counts CLOSED windows, so the
+            # second round is what makes the first replayable into a model
+            cc.load_monitor.sample_once(now_ms=(rounds + 2) * 300_000.0)
+            cc.load_monitor.sample_once(now_ms=(rounds + 3) * 300_000.0)
+            store_round_s = (time.monotonic() - t0) / 2
+            store.close()
+            cc2 = CruiseControl(be, cruise_control_config({
+                "num.metrics.windows": 5,
+                "min.samples.per.metrics.window": 1,
+                "sample.store.path": store_dir}))
+            t0 = time.monotonic()
+            replayed = cc2.load_monitor.start_up()
+            replay_s = time.monotonic() - t0
+            t0 = time.monotonic()
+            cc2.load_monitor.cluster_model()
+            recovery_model_s = time.monotonic() - t0
+            cc2.shutdown()
+            rung.update({
+                "store_round_s": round(store_round_s, 3),
+                "restart_replayed_samples": replayed,
+                "restart_replay_s": round(replay_s, 3),
+                # headline: replay + model build = restart-to-serving wall
+                "restart_recovery_s": round(replay_s + recovery_model_s, 3),
+            })
+            log(f"  [e2e] restart recovery: replay {replay_s:.2f}s "
+                f"({replayed} samples) + model {recovery_model_s:.2f}s")
+        finally:
+            shutil.rmtree(store_dir, ignore_errors=True)
     # observability handoff: the service's own sensor snapshot + the flight
     # recorder's last RoundTrace — BENCH_* files carry the SAME schema the
     # live service serves (/metrics, /state?substates=ROUND_TRACES), so a

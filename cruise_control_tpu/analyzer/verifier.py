@@ -1,0 +1,114 @@
+"""Library-level optimization validity checks (OptimizationVerifier role).
+
+Reference: analyzer/OptimizationVerifier.java:53 — the randomized
+self-healing oracle (RandomSelfHealingTest) runs every optimization result
+through a verification chain before trusting it. The test-suite twin
+(tests/optimization_verifier.py) asserts; this module REPORTS — it returns
+violation strings so the scenario engine and chaos campaigns can fold
+verifier verdicts into their deterministic episode logs instead of dying on
+the first bad proposal.
+
+Checks:
+
+- ``verify_no_regression``: rolling per-goal monotonicity — each goal's own
+  statistic must not worsen during its own run (OptimizationVerifier
+  verifyRegression :94-117 semantics), and the optimization may never
+  increase the offline-replica count.
+- ``verify_no_dead_placement``: no valid replica ends the optimization on a
+  dead broker and no offline replica survives when the run was asked to fix
+  them (BROKEN_BROKERS).
+- ``verify_proposals``: per-proposal structural validity — non-empty replica
+  list, no duplicate target brokers, the new leader a member of the new
+  replica list, every added replica targeting an alive broker, and no
+  proposal that silently changes replication factor (RF may only change when
+  the operation is an explicit RF repair).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# operations allowed to change a partition's replication factor on purpose
+RF_CHANGING_OPERATIONS = {"TOPIC_REPLICATION_FACTOR"}
+
+
+def verify_no_regression(res) -> list:
+    out = []
+    for g in res.goal_results:
+        if g.stat_after > g.stat_before * 1.0001 + 1e-6:
+            out.append(f"{g.name} regressed its own stat during its run: "
+                       f"{g.stat_before:.4f} -> {g.stat_after:.4f}")
+    before = res.stats_before.get("num_offline_replicas", 0)
+    after = res.stats_after.get("num_offline_replicas", 0)
+    if after > before:
+        out.append(f"offline replicas increased: {before} -> {after}")
+    return out
+
+
+def verify_no_dead_placement(res) -> list:
+    env, st = res.env, res.final_state
+    alive = np.asarray(env.broker_alive)
+    rb = np.asarray(st.replica_broker)
+    valid = np.asarray(env.replica_valid)
+    on_dead = valid & ~alive[np.clip(rb, 0, alive.shape[0] - 1)]
+    out = []
+    if on_dead.any():
+        out.append(f"{int(on_dead.sum())} replicas placed on dead brokers")
+    return out
+
+
+def verify_proposals(res, operation: str = "", max_proposals: int = 10_000) -> list:
+    """Structural validity of every emitted proposal (bounded by
+    ``max_proposals`` — sim clusters are far below the bound; at production
+    scale a sampled prefix still catches systematic breakage)."""
+    meta = getattr(res, "meta", None)
+    alive_ids = None
+    if meta is not None:
+        alive = np.asarray(res.env.broker_alive)
+        alive_ids = {int(meta.broker_ids[i]) for i in np.flatnonzero(alive)}
+    out = []
+    for i, p in enumerate(res.proposals):
+        if i >= max_proposals:
+            out.append(f"verification truncated at {max_proposals} proposals")
+            break
+        new_b = [b for b, _ in p.new_replicas]
+        if not new_b:
+            out.append(f"{p.tp}: proposal empties the partition")
+            continue
+        if len(set(new_b)) != len(new_b):
+            out.append(f"{p.tp}: duplicate brokers in new replicas {new_b}")
+        if p.new_leader >= 0 and p.new_leader not in new_b:
+            # -1 = leaderless (e.g. the sole replica sat on a dead broker):
+            # no election is submitted; the backend elects an alive member
+            # when the copy completes
+            out.append(f"{p.tp}: new leader {p.new_leader} not in "
+                       f"new replicas {new_b}")
+        if alive_ids is not None:
+            bad = [b for b in p.replicas_to_add if b not in alive_ids]
+            if bad:
+                out.append(f"{p.tp}: replicas added on dead/unknown "
+                           f"brokers {bad}")
+        if (len(new_b) != len(p.old_replicas)
+                and operation not in RF_CHANGING_OPERATIONS):
+            out.append(f"{p.tp}: replication factor changed "
+                       f"{len(p.old_replicas)} -> {len(new_b)} by "
+                       f"non-RF operation {operation or 'OPTIMIZE'}")
+    return out
+
+
+def verify_operation_result(operation: str, res) -> list:
+    """The per-optimization validity pass the scenario engine and chaos
+    campaigns run on EVERY heal. Returns violation strings (empty = pass).
+
+    Deliberately relative, not absolute: an optimization computed while a
+    broker sits inside the failure grace ladder legitimately leaves that
+    broker's replicas in place (the BROKER_FAILURE fix owns the evacuation),
+    so the absolute ``verify_no_dead_placement`` is not part of this chain —
+    the offline count must merely never increase and no proposal may ADD a
+    replica on dead hardware. Post-convergence absolutes are the invariant
+    checker's job (sim/invariants.check_converged)."""
+    if res is None:
+        return []
+    out = []
+    out.extend(verify_no_regression(res))
+    out.extend(verify_proposals(res, operation))
+    return out

@@ -137,6 +137,12 @@ class CruiseControl:
                                lambda: sess.rebuild_rounds)
             self.sensors.gauge("resident-session-donated-rounds",
                                lambda: sess.donated_rounds)
+        # optimization observers: callables ``(operation, reason, res,
+        # executed)`` invoked after EVERY facade optimization (REST and
+        # self-healing alike). The scenario engine hangs its per-heal
+        # OptimizationVerifier pass here; observer failures are recorded but
+        # never break the operation.
+        self.optimization_observers: list = []
         self._wire_detectors()
         self._proposal_cache: OptimizerResult | None = None
         self._proposal_cache_generation = None
@@ -159,7 +165,15 @@ class CruiseControl:
             self.backend,
             anomaly_cls=self.config.get_class("disk.failures.class"))
         # provisioner.class: right-sizing SPI invoked on UNDER/OVER_PROVISIONED
-        provisioner = self.config.get_configured_instance("provisioner.class")
+        # verdicts; an actuating implementation (SimulatedProvisioner) gets
+        # the backend to resize and the facade to drain through
+        provisioner = self.config.get_configured_instance(
+            "provisioner.class", backend=self.backend, cruise_control=self,
+            actuation_cooldown_ms=float(self.config.get_int(
+                "provision.actuation.cooldown.ms")),
+            max_added_brokers=self.config.get_int(
+                "provision.max.added.brokers"))
+        self.provisioner = provisioner
         goal_vd = GoalViolationDetector(
             self.goal_optimizer, self.load_monitor,
             self.config.get_list("anomaly.detection.goals"),
@@ -498,6 +512,13 @@ class CruiseControl:
                                   "ms": self._now_ms(),
                                   "numProposals": len(res.proposals),
                                   "executed": op.executed})
+        for observer in self.optimization_observers:
+            try:
+                observer(operation, reason, res, op.executed)
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "optimization observer failed for %s", operation)
         if op.executed:
             # dedicated operation log channel (OPERATION_LOGGER, Executor.java:1037)
             from cruise_control_tpu.common.sensors import OPERATION_LOGGER
@@ -629,7 +650,14 @@ class CruiseControl:
     def demote_brokers(self, broker_ids: list, dry_run: bool = False,
                        reason: str = "demote brokers") -> dict:
         """POST /demote_broker: move leadership away and prevent new leadership
-        (DemoteBrokerRunnable + PreferredLeaderElectionGoal role)."""
+        (DemoteBrokerRunnable + PreferredLeaderElectionGoal role).
+
+        PLE ONLY, like the reference: demotion is a leadership operation.
+        The chain used to include LeaderReplicaDistributionGoal, whose
+        fallback REPLICA moves run without RackAwareGoal in the chain to
+        veto destinations — a chaos campaign caught it parking replicas on
+        co-rack brokers, a permanent hard-goal violation that offline-only
+        heals can never repair."""
         ct, meta = self._model()
         demoted = np.asarray(ct.broker_demoted).copy()
         for b in broker_ids:
@@ -638,7 +666,7 @@ class CruiseControl:
         ct = dataclasses.replace(ct, broker_demoted=jnp.asarray(demoted))
         op = self._run_optimization(
             "DEMOTE_BROKER", reason, ct, meta,
-            ["LeaderReplicaDistributionGoal", "PreferredLeaderElectionGoal"],
+            ["PreferredLeaderElectionGoal"],
             OptimizationOptions(), dry_run=dry_run, skip_hard_goal_check=True)
         if op.executed:
             self.executor.note_demoted_brokers(broker_ids)
@@ -672,13 +700,23 @@ class CruiseControl:
     def fix_topic_replication_factor(self, bad_topics: dict,
                                      reason: str = "fix topic RF") -> dict:
         """Topic RF healing: under-replicated topics get replicas added on
-        least-loaded alive brokers (UpdateTopicConfigurationRunnable role)."""
+        the least-loaded alive brokers, over-replicated ones shrink to
+        target, and the repair PLAN executes through the executor like every
+        other fix (UpdateTopicConfigurationRunnable role) — throttled,
+        concurrency-capped, task-accounted, visible in state_json instead of
+        a raw metadata write behind the executor's back."""
+        from cruise_control_tpu.analyzer.proposals import ExecutionProposal
         default_rf = self.config.get_int("self.healing.target.topic.replication.factor")
         partitions = self.backend.partitions()
         brokers = self.backend.brokers()
-        alive = [b for b, n in brokers.items() if n.alive]
-        assignments = {}
-        for (topic, part), info in partitions.items():
+        # least-loaded first: replica count per alive broker, ties by id
+        counts = {b: 0 for b, n in brokers.items() if n.alive}
+        for info in partitions.values():
+            for b in info.replicas:
+                if b in counts:
+                    counts[b] += 1
+        proposals = []
+        for (topic, part), info in sorted(partitions.items()):
             if topic not in bad_topics:
                 continue
             # per-topic target RF when the caller supplied one (the
@@ -693,18 +731,35 @@ class CruiseControl:
                 target_rf = default_rf
             replicas = list(info.replicas)
             if len(replicas) < target_rf:
-                candidates = [b for b in alive if b not in replicas]
+                candidates = sorted((b for b in counts if b not in replicas),
+                                    key=lambda b: (counts[b], b))
                 need = target_rf - len(replicas)
-                replicas.extend(candidates[:need])
+                for b in candidates[:need]:
+                    replicas.append(b)
+                    counts[b] += 1
             elif len(replicas) > target_rf:
                 keep = [info.leader] + [b for b in replicas if b != info.leader]
                 replicas = keep[:target_rf]
             if replicas != info.replicas:
-                assignments[(topic, part)] = replicas
-        if assignments:
-            self.backend.alter_partition_reassignments(assignments)
+                proposals.append(ExecutionProposal(
+                    topic=topic, partition=part,
+                    old_leader=info.leader, new_leader=info.leader,
+                    old_replicas=tuple((b, 0) for b in info.replicas),
+                    new_replicas=tuple((b, 0) for b in replicas)))
+        executed = False
+        if proposals:
+            sizes = {tp: i.size_mb for tp, i in partitions.items()}
+            self.executor.execute_proposals(
+                proposals,
+                context={"partition_size_mb": sizes,
+                         "operation": f"TOPIC_REPLICATION_FACTOR: {reason}"})
+            executed = True
+        self._ops_history.append({
+            "operation": "TOPIC_REPLICATION_FACTOR", "reason": reason,
+            "ms": self._now_ms(), "numProposals": len(proposals),
+            "executed": executed})
         return {"operation": "TOPIC_REPLICATION_FACTOR", "reason": reason,
-                "numPartitionsChanged": len(assignments)}
+                "numPartitionsChanged": len(proposals), "executed": executed}
 
     # ------------------------------------------------------- admin surface
     def pause_sampling(self, reason: str = "operator request") -> dict:

@@ -50,6 +50,7 @@ class SimulatedClusterBackend:
         self._rng = np.random.default_rng(seed)
         self._metric_overrides: dict[int, dict[str, float]] = {}
         self._silenced: set[int] = set()    # brokers with a metric gap
+        self._leadership_latency_ms = 0.0   # slow-election fault injection
         # (at_ms, seq, callback) fault events fired at their exact simulated
         # time from advance() — the scenario engine's injection mechanism
         self._scheduled: list[tuple] = []
@@ -247,6 +248,72 @@ class SimulatedClusterBackend:
     def fail_disk(self, broker_id: int, logdir: str) -> None:
         with self._lock:
             self._brokers[broker_id].dead_logdirs.add(logdir)
+            self._meta_gen += 1
+
+    def shrink_replicas(self, topic: str, target_rf: int) -> int:
+        """Fault injection: drop tail replicas of every partition of
+        ``topic`` down to ``target_rf`` (the under-replicated-topic anomaly a
+        TopicReplicationFactorAnomalyFinder must detect and repair). The
+        leader survives when it can; partitions with an in-flight
+        reassignment are skipped (their replica list is owned by the copy
+        machinery). Returns the number of partitions shrunk."""
+        with self._lock:
+            changed = 0
+            for tp, info in self._partitions.items():
+                if (tp[0] != topic or tp in self._inflight
+                        or len(info.replicas) <= target_rf):
+                    continue
+                keep = list(info.replicas)
+                if info.leader in keep:
+                    keep = [info.leader] + [b for b in keep if b != info.leader]
+                dropped = keep[max(target_rf, 1):]
+                info.replicas = keep[:max(target_rf, 1)]
+                for b in dropped:
+                    info.logdir_by_broker.pop(b, None)
+                if info.leader not in info.replicas:
+                    alive = [b for b in info.replicas
+                             if self._brokers[b].alive]
+                    info.leader = alive[0] if alive else -1
+                self._c_update(tp)
+                changed += 1
+            if changed:
+                self._meta_gen += 1
+            return changed
+
+    def scale_partition_load(self, factor: float, topics=None) -> None:
+        """Fault injection: multiply the cpu/bytes-in/bytes-out rates of every
+        partition (optionally restricted to ``topics``) — a traffic surge the
+        GoalViolationDetector's provision math must flag UNDER_PROVISIONED.
+        Disk size is deliberately untouched: a surge is load, not data."""
+        with self._lock:
+            for tp, info in self._partitions.items():
+                if topics is not None and tp[0] not in topics:
+                    continue
+                info.cpu_util *= factor
+                info.bytes_in_rate *= factor
+                info.bytes_out_rate *= factor
+                self._c_update(tp)
+            self._meta_gen += 1
+
+    def decommission_broker(self, broker_id: int) -> None:
+        """Remove an EMPTY broker from the cluster (the provisioner's
+        OVER_PROVISIONED actuation; the reference delegates this to a cloud
+        autoscaler behind the Provisioner SPI). Refuses while the broker
+        still hosts replicas or is a reassignment target — drain first."""
+        with self._lock:
+            hosting = sum(1 for info in self._partitions.values()
+                          if broker_id in info.replicas)
+            if hosting:
+                raise RuntimeError(
+                    f"broker {broker_id} still hosts {hosting} replicas")
+            for tp, fl in self._inflight.items():
+                if broker_id in fl.target or broker_id in fl.adding:
+                    raise RuntimeError(
+                        f"broker {broker_id} is a reassignment target for {tp}")
+            del self._brokers[broker_id]
+            self._c_dix.pop(broker_id, None)
+            self._metric_overrides.pop(broker_id, None)
+            self._silenced.discard(broker_id)
             self._meta_gen += 1
 
     def set_metric_silence(self, broker_id: int, silent: bool) -> None:
@@ -498,6 +565,15 @@ class SimulatedClusterBackend:
                 info.replicas = [b for b in info.replicas]
             self._meta_gen += 1
 
+    def set_leadership_latency_ms(self, ms: float) -> None:
+        """Fault injection: preferred-leader elections stop landing
+        instantly — each submitted election takes effect ``ms`` simulated ms
+        later (from whichever ``advance`` crosses it). Lets the executor's
+        ``leader.movement.timeout.ms`` abandonment path and the campaign's
+        slow-progress scenarios run against real (simulated) slowness."""
+        with self._lock:
+            self._leadership_latency_ms = max(float(ms), 0.0)
+
     def elect_leaders(self, tps_to_leader: dict) -> None:
         with self._lock:
             for tp, leader in tps_to_leader.items():
@@ -506,9 +582,28 @@ class SimulatedClusterBackend:
                     raise ValueError(f"{leader} not a replica of {tp}")
                 if not self._brokers[leader].alive:
                     raise ValueError(f"broker {leader} is dead")
-                info.leader = leader
-                self._c_update(tp)
-            self._meta_gen += 1
+            latency = self._leadership_latency_ms
+            if latency <= 0:
+                for tp, leader in tps_to_leader.items():
+                    self._partitions[tp].leader = leader
+                    self._c_update(tp)
+                self._meta_gen += 1
+                return
+            # slow-election mode: validation happened above (submission
+            # succeeds), but the flip lands later; by then the cluster may
+            # have changed, so the apply re-validates and silently drops a
+            # now-ineligible election (a lost election, like the real thing)
+            for tp, leader in tps_to_leader.items():
+                def _apply(now, tp=tp, leader=leader):
+                    with self._lock:
+                        info = self._partitions.get(tp)
+                        if (info is None or leader not in info.replicas
+                                or not self._brokers[leader].alive):
+                            return
+                        info.leader = leader
+                        self._c_update(tp)
+                        self._meta_gen += 1
+                self.schedule_at(self._now_ms + latency, _apply)
 
     def alter_replica_logdirs(self, moves: dict) -> None:
         """Intra-broker move: {(topic, part, broker): logdir}

@@ -577,6 +577,16 @@ _D.define(name="provisioner.class", type=Type.CLASS,
           default="cruise_control_tpu.detector.provisioner.NoopProvisioner",
           doc="Provisioner SPI for cluster right-sizing.")
 _D.define(name="provision.partition.size.threshold.mb", type=Type.DOUBLE, default=1_000_000.0)
+_D.define(name="provision.actuation.cooldown.ms", type=Type.LONG, default=600_000,
+          doc="Minimum simulated/wall ms between two provisioner actuations "
+              "(SimulatedProvisioner): a detection round re-asserting "
+              "UNDER_PROVISIONED before the previous resize took effect must "
+              "not add brokers again.")
+_D.define(name="provision.max.added.brokers", type=Type.INT, default=4,
+          validator=at_least(1),
+          doc="Lifetime cap on brokers the SimulatedProvisioner may add — "
+              "bounds runaway scale-up and keeps sim clusters inside their "
+              "padded engine shape bucket.")
 _D.define(name="topic.anomaly.finder.class", type=Type.LIST,
           default=["cruise_control_tpu.detector.topic_anomaly.TopicReplicationFactorAnomalyFinder"])
 _D.define(name="self.healing.target.topic.replication.factor", type=Type.INT, default=3)

@@ -85,7 +85,12 @@ class GoalViolationDetector:
                                              floors=self._provision_floors)
             self.last_provision = rec
             if rec.status is not ProvisionStatus.RIGHT_SIZED:
-                self._provisioner.rightsize([rec])
+                # GoalViolationDetector.java:228: the verdict flows straight
+                # into Provisioner.rightsize — an actuating provisioner
+                # resizes the cluster here, mid-detection-round
+                self._provisioner.rightsize(
+                    [rec], context={"now_ms": now_ms,
+                                    "balancedness": res.balancedness_before})
         if not fixable and not unfixable:
             return []
         return [self._anomaly_cls(

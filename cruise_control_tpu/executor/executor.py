@@ -751,8 +751,12 @@ class Executor:
     def _await_leadership(self, elections: dict, planner, batch: list) -> None:
         """Wait for submitted elections to take effect, up to
         leader.movement.timeout.ms per batch (ExecutorConfig.java:139-141);
-        a task whose election hasn't landed by then is marked DEAD, like the
-        reference abandoning a leadership task that exceeds the timeout."""
+        a task whose election hasn't landed by then is ABANDONED — it was
+        submitted and started, so it transitions IN_PROGRESS -> ABORTING ->
+        ABORTED (the reference's abandoned-leadership-task accounting;
+        ``numAbortedTasks`` in state_json carries the census). DEAD stays
+        reserved for elections that were never submittable (ineligible
+        target, handled in the phase loop above)."""
         pending = {t.tp: t for t in batch if t.tp in elections}
         deadline = self._clock.now_ms() + self._cfg.leader_movement_timeout_ms
         while pending:
@@ -766,9 +770,14 @@ class Executor:
             if not pending:
                 return
             if self._clock.now_ms() >= deadline or self._stop_requested:
+                now = self._clock.now_ms()
                 for t in pending.values():
-                    t.transition(TaskState.DEAD, self._clock.now_ms())
-                    LOG.warning("leadership movement timed out for %s", t.tp)
+                    t.transition(TaskState.ABORTING, now)
+                    t.transition(TaskState.ABORTED, now)
+                    self._sensors.meter("leadership-movement-timeouts").mark()
+                    LOG.warning("leadership movement timed out for %s "
+                                "(abandoned after %.0f ms)", t.tp,
+                                self._cfg.leader_movement_timeout_ms)
                 return
             self._clock.sleep_ms(min(
                 self._cfg.progress_check_interval_ms,
@@ -803,6 +812,7 @@ class Executor:
             out["concurrencyAdjuster"] = {
                 "perBrokerCap": self._cfg.per_broker_cap,
                 "leadershipCap": self._cfg.leadership_cap,
+                "numAdjustments": len(self._adjuster.history),
                 "recentAdjustments": list(self._adjuster.history)[-5:],
             }
         return out

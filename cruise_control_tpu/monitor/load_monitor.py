@@ -183,6 +183,15 @@ class LoadMonitor:
                                       self._backend.partitions(),
                                       self._backend.metadata_generation())
 
+    def attach_sample_store(self, store) -> None:
+        """Late-bind a sample store: subsequent sampling rounds are recorded
+        to it (and replayed by a fresh monitor's ``start_up``). The bench's
+        restart-recovery measurement uses this to record only its final
+        rounds instead of paying store writes inside every timed sampling
+        figure; service deployments configure ``sample.store.path`` and get
+        the store from construction."""
+        self._store = store
+
     def _metadata_factor(self) -> float:
         if self._backend is None:
             return 0.0

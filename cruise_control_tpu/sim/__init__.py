@@ -15,8 +15,13 @@ from cruise_control_tpu.sim.runner import (
 )
 from cruise_control_tpu.sim.scenario import (
     ClusterSpec, Scenario, ScenarioEvent, broker_death, broker_restart,
-    build_backend, clear_slow_broker, disk_failure, maintenance_event,
-    metric_gap, slow_broker, topic_creation,
+    build_backend, clear_slow_broker, disk_failure, load_surge,
+    maintenance_event, metric_gap, rf_drop, scenario_from_json,
+    scenario_to_json, slow_broker, topic_creation,
+)
+from cruise_control_tpu.sim.campaign import (
+    CAMPAIGNS, CampaignResult, CampaignRunner, CampaignSpec,
+    generate_episode, run_campaign,
 )
 
 __all__ = [
@@ -24,5 +29,8 @@ __all__ = [
     "BASE_CONFIG", "ScenarioResult", "ScenarioRunner", "run_scenario",
     "ClusterSpec", "Scenario", "ScenarioEvent", "broker_death",
     "broker_restart", "build_backend", "clear_slow_broker", "disk_failure",
-    "maintenance_event", "metric_gap", "slow_broker", "topic_creation",
+    "load_surge", "maintenance_event", "metric_gap", "rf_drop",
+    "scenario_from_json", "scenario_to_json", "slow_broker", "topic_creation",
+    "CAMPAIGNS", "CampaignResult", "CampaignRunner", "CampaignSpec",
+    "generate_episode", "run_campaign",
 ]

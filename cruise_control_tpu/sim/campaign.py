@@ -1,0 +1,483 @@
+"""Seeded chaos campaigns: randomized compound-fault fuzzing of the
+self-healing loop.
+
+The reference's real correctness oracle for self-healing is randomized, not
+scripted: RandomSelfHealingTest draws fault sequences and runs every
+resulting plan through OptimizationVerifier (SURVEY §4). This module is that
+oracle for the in-process loop: a :class:`CampaignSpec` describes a fault
+mix, a seeded generator (:func:`generate_episode`) draws compound fault
+schedules from it — broker deaths + disk failures + metric gaps + slow
+brokers + topic churn + RF drops + maintenance plans + load surges, with
+configurable rates and overlap windows, deliberately landing mid-flight of
+throttled executions — and :class:`CampaignRunner` runs N episodes through
+the PR-2 :class:`~cruise_control_tpu.sim.runner.ScenarioRunner`, which
+checks the two-tier invariants every tick and an OptimizationVerifier-style
+per-proposal validity pass on every heal
+(:mod:`cruise_control_tpu.analyzer.verifier`).
+
+Determinism contract (the PR-2 bar): everything flows from
+``(campaign, seed)`` — the schedule generator seeds ``random.Random`` with a
+string (process-independent under PYTHONHASHSEED), cluster seeds derive from
+it, and every episode runs on simulated time — so the same (campaign, seed)
+produces a bit-identical episode log and verdicts, asserted in tests.
+
+SLO aggregation: per fault kind, time-to-detect / time-to-heal /
+actions-per-heal are extracted from the deterministic episode timelines and
+summarized as nearest-rank p50/p95/max distributions — the block
+``bench.py --campaign`` emits.
+
+Episode 0 of a campaign with ``provision_episode=True`` is the provisioner
+closure: a calibrated ``load_surge`` drives the GoalViolationDetector's
+capacity math UNDER_PROVISIONED, the verdict actuates a simulated broker add
+(``SimulatedProvisioner`` -> ``backend.add_broker``), and the episode
+contract asserts the campaign observes the cluster re-converging after the
+resize (``expect_provision=("add_broker",)``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+
+from cruise_control_tpu.sim.scenario import (
+    ClusterSpec, Scenario, ScenarioEvent, build_backend,
+)
+
+# fault kind -> the anomaly type its detection must surface as (kinds
+# mapping to None are survival faults: the loop must NOT misread them)
+FAULT_ANOMALY_TYPE = {
+    "broker_death": "BROKER_FAILURE",
+    "disk_failure": "DISK_FAILURE",
+    "slow_broker": "METRIC_ANOMALY",
+    "rf_drop": "TOPIC_ANOMALY",
+    "maintenance_event": "MAINTENANCE_EVENT",
+    "load_surge": "GOAL_VIOLATION",
+}
+
+# NW_IN capacity threshold the provision calibration assumes (config default
+# network.inbound.capacity.threshold)
+_NW_IN_THRESHOLD = 0.8
+
+
+@dataclasses.dataclass(frozen=True)
+class CampaignSpec:
+    """One campaign: a cluster, a fault mix, and an episode budget."""
+    name: str
+    cluster: ClusterSpec = ClusterSpec(logdirs_per_broker=2)
+    episodes: int = 2
+    min_faults: int = 1
+    max_faults: int = 3
+    # weighted fault mix the schedule generator draws from (each kind at most
+    # once per episode; weights are relative rates)
+    fault_weights: tuple = (
+        ("broker_death", 3.0), ("disk_failure", 2.0), ("slow_broker", 1.5),
+        ("metric_gap", 1.0), ("topic_creation", 1.0), ("rf_drop", 1.5),
+        ("maintenance_event", 1.5),
+    )
+    # faults land inside this window from scenario start — short enough that
+    # later faults overlap the heals (and throttled executions) of earlier
+    # ones, which is the point of a COMPOUND schedule
+    overlap_window_ms: float = 240_000.0
+    duration_ms: float = 2_400_000.0
+    tick_ms: float = 15_000.0
+    config: tuple = ()          # extra config overrides for every episode
+    # episode 0 = calibrated surge -> UNDER_PROVISIONED -> broker add
+    provision_episode: bool = False
+    surge_factor: float = 1.7
+    pre_surge_utilization: float = 0.65
+
+    def config_dict(self) -> dict:
+        return {k: v for k, v in self.config}
+
+
+# ----------------------------------------------------------- schedule draw
+def _episode_rng(spec: CampaignSpec, seed: int, episode: int) -> random.Random:
+    """String-seeded Random: deterministic across processes (int hashing of
+    tuples would be PYTHONHASHSEED-stable too, but a string seed is explicit
+    about it) and unique per (campaign, seed, episode)."""
+    return random.Random(f"{spec.name}/{seed}/{episode}")
+
+
+def _provision_nw_capacity(cluster: ClusterSpec, pre_util: float) -> float:
+    """Calibrate default.broker.capacity.nw.in so the built cluster sits at
+    ``pre_util`` of its allowed aggregate NW_IN capacity — the surge factor
+    then lands a KNOWN distance over the line, keeping the UNDER_PROVISIONED
+    deficit (and the broker add count) small and deterministic for every
+    cluster seed instead of hand-tuned for one."""
+    be = build_backend(cluster)
+    total = sum(info.bytes_in_rate * len(info.replicas)
+                for info in be.partitions().values())
+    return max(total / (_NW_IN_THRESHOLD * cluster.num_brokers * pre_util),
+               1.0)
+
+
+def _provision_episode(spec: CampaignSpec, cluster: ClusterSpec,
+                       episode: int) -> Scenario:
+    cap = round(_provision_nw_capacity(cluster, spec.pre_surge_utilization), 3)
+    config = dict(spec.config_dict())
+    config.update({
+        "default.broker.capacity.nw.in": cap,
+        "provisioner.class":
+            "cruise_control_tpu.detector.provisioner.SimulatedProvisioner",
+        "provision.actuation.cooldown.ms": 300_000,
+        # 12 -> at most 16 brokers: stays inside the padded engine bucket
+        "provision.max.added.brokers": 4,
+        # capacity detection goal so the violation is fixable post-add
+        "anomaly.detection.goals":
+            "NetworkInboundCapacityGoal,DiskCapacityGoal,"
+            "ReplicaDistributionGoal",
+        "goal.violation.detection.interval.ms": 120_000,
+    })
+    return Scenario(
+        name=f"{spec.name}-ep{episode}-provision",
+        cluster=cluster,
+        events=(ScenarioEvent(0.0, "load_surge",
+                              {"factor": float(spec.surge_factor),
+                               "topics": None}),),
+        duration_ms=spec.duration_ms, tick_ms=spec.tick_ms,
+        config=tuple(sorted(config.items())),
+        expects_heal=True,
+        expect_detect_types=("GOAL_VIOLATION",),
+        expect_provision=("add_broker",),
+        settle_ticks=2)
+
+
+def generate_episode(spec: CampaignSpec, seed: int, episode: int) -> Scenario:
+    """Draw one episode's compound fault schedule from the campaign's seeded
+    RNG. Pure function of (spec, seed, episode)."""
+    rng = _episode_rng(spec, seed, episode)
+    cluster = dataclasses.replace(
+        spec.cluster, seed=spec.cluster.seed + rng.randrange(1 << 20))
+    if spec.provision_episode and episode == 0:
+        return _provision_episode(spec, cluster, episode)
+
+    B = cluster.num_brokers
+    n_faults = rng.randint(spec.min_faults, spec.max_faults)
+    kinds, pool = [], list(spec.fault_weights)
+    for _ in range(n_faults):
+        if not pool:
+            break
+        total_w = sum(w for _, w in pool)
+        x = rng.uniform(0.0, total_w)
+        acc = 0.0
+        for i, (k, w) in enumerate(pool):
+            acc += w
+            if x <= acc:
+                kinds.append(k)
+                del pool[i]     # each kind at most once per episode
+                break
+    kinds.sort(key=lambda k: dict(spec.fault_weights)[k], reverse=True)
+
+    used: set[int] = set()      # brokers already targeted by some fault
+
+    def pick_brokers(n: int) -> list:
+        free = [b for b in range(B) if b not in used]
+        chosen = sorted(rng.sample(free, min(n, len(free))))
+        used.update(chosen)
+        return chosen
+
+    events: list[ScenarioEvent] = []
+    expect_types: set[str] = set()
+    config = dict(spec.config_dict())
+    # every episode: throttled copies (replica moves span simulated minutes,
+    # so later faults land mid-flight of earlier heals) + the AIMD adjuster
+    # live on a tight cadence (campaigns cover throttle back-off/recovery)
+    config.setdefault("default.replication.throttle", 2 * 1024 * 1024)
+    config.setdefault("concurrency.adjuster.enabled", True)
+    config.setdefault("concurrency.adjuster.interval.ms", 30_000)
+
+    def t_in_window() -> float:
+        return round(rng.uniform(0.0, spec.overlap_window_ms), 1)
+
+    for kind in kinds:
+        if kind == "broker_death":
+            brokers = pick_brokers(1)
+            events.append(ScenarioEvent(t_in_window(), "broker_death",
+                                        {"brokers": brokers}))
+            expect_types.add("BROKER_FAILURE")
+        elif kind == "disk_failure":
+            b = pick_brokers(1)[0]
+            d = rng.randrange(max(cluster.logdirs_per_broker, 1))
+            events.append(ScenarioEvent(t_in_window(), "disk_failure",
+                                        {"broker": b, "logdir": f"/logdir{d}"}))
+            expect_types.add("DISK_FAILURE")
+        elif kind == "slow_broker":
+            b = pick_brokers(1)[0]
+            t = t_in_window()
+            events.append(ScenarioEvent(t, "slow_broker",
+                                        {"broker": b, "flush_ms": 5000.0,
+                                         "bytes_in": 1.0}))
+            events.append(ScenarioEvent(
+                t + round(rng.uniform(250_000.0, 350_000.0), 1),
+                "clear_slow_broker", {"broker": b}))
+            # detection CONTRACT only when no heavyweight heal shares the
+            # episode: a multi-minute throttled evacuation legitimately eats
+            # the finder's consecutive-hit cadence (run_due fires once per
+            # tick). The fault still perturbs — the AIMD adjuster sees the
+            # slow broker's metrics during whatever executions run.
+            if not {"broker_death", "disk_failure",
+                    "maintenance_event"} & set(kinds):
+                expect_types.add("METRIC_ANOMALY")
+            config.setdefault("metric.anomaly.detection.interval.ms", 30_000)
+            config.setdefault("slow.broker.demotion.score", 2)
+        elif kind == "metric_gap":
+            brokers = pick_brokers(2)
+            t = t_in_window()
+            events.append(ScenarioEvent(
+                t, "metric_gap",
+                {"until_ms": t + round(rng.uniform(60_000.0, 180_000.0), 1),
+                 "brokers": brokers}))
+        elif kind == "topic_creation":
+            events.append(ScenarioEvent(
+                t_in_window(), "topic_creation",
+                {"topic": f"chaos{episode}", "partitions": rng.randint(8, 16),
+                 "rf": 2, "size_mb": 80.0}))
+        elif kind == "rf_drop":
+            topic, _parts, rf = spec.cluster.topics[
+                rng.randrange(len(spec.cluster.topics))]
+            events.append(ScenarioEvent(
+                t_in_window(), "rf_drop",
+                {"topic": topic, "target_rf": max(int(rf) - 1, 1)}))
+            expect_types.add("TOPIC_ANOMALY")
+            # repair target = the build RF; give the finder a real cadence
+            config.setdefault("self.healing.target.topic.replication.factor",
+                              int(rf))
+            config.setdefault("topic.anomaly.detection.interval.ms", 60_000)
+        elif kind == "maintenance_event":
+            plan = rng.choice(("REMOVE_BROKER", "DEMOTE_BROKER", "REBALANCE"))
+            brokers = pick_brokers(1) if plan != "REBALANCE" else []
+            events.append(ScenarioEvent(t_in_window(), "maintenance_event",
+                                        {"plan_type": plan, "brokers": brokers,
+                                         "topics": {}}))
+            expect_types.add("MAINTENANCE_EVENT")
+        else:
+            raise ValueError(f"unknown fault kind {kind!r}")
+
+    events.sort(key=lambda e: (e.at_ms, e.kind))
+    forbid: tuple = ()
+    if "BROKER_FAILURE" not in expect_types \
+            and "DISK_FAILURE" not in expect_types \
+            and any(e.kind == "metric_gap" for e in events):
+        # a pure reporting gap must never be misread as hardware failure
+        forbid = ("BROKER_FAILURE", "DISK_FAILURE")
+    # goal-violation detection stays off in compound episodes (it only adds
+    # optimizer noise between the targeted detectors); the provision episode
+    # is the GV-detector closure
+    config.setdefault("goal.violation.detection.interval.ms", 10_000_000_000)
+    return Scenario(
+        name=f"{spec.name}-ep{episode}",
+        cluster=cluster,
+        events=tuple(events),
+        duration_ms=spec.duration_ms, tick_ms=spec.tick_ms,
+        config=tuple(sorted(config.items())),
+        expects_heal=True,
+        expect_detect_types=tuple(sorted(expect_types)),
+        forbid_detect_types=forbid,
+        settle_ticks=2)
+
+
+# ------------------------------------------------------------ SLO extraction
+def _nearest_rank(sorted_vals: list, q: float):
+    if not sorted_vals:
+        return None
+    k = max(0, math.ceil(q * len(sorted_vals)) - 1)
+    return sorted_vals[min(k, len(sorted_vals) - 1)]
+
+
+def _dist(vals: list) -> dict:
+    vals = sorted(v for v in vals if v is not None)
+    if not vals:
+        return {"n": 0, "p50": None, "p95": None, "max": None}
+    return {"n": len(vals), "p50": _nearest_rank(vals, 0.50),
+            "p95": _nearest_rank(vals, 0.95), "max": vals[-1]}
+
+
+def episode_slo_samples(result) -> list:
+    """Per-fault (kind, detect_ms, heal_ms, actions) samples from one
+    episode's deterministic timeline. Each injected fault is matched to the
+    first unconsumed handled anomaly of its expected type at/after the
+    injection time; heal time is the tick the matching FIX finished (the
+    loop records anomalies post-execution on simulated time)."""
+    timeline = result.timeline
+    injects = [(e["t"], e["event"].split("(", 1)[0])
+               for e in timeline if e["kind"] == "inject"]
+    anomalies = [e for e in timeline if e["kind"] == "anomaly"]
+    consumed_detect: set[int] = set()
+    consumed_heal: set[int] = set()
+    samples = []
+    for t, kind in injects:
+        atype = FAULT_ANOMALY_TYPE.get(kind)
+        if atype is None:
+            continue
+        detect = heal = actions = None
+        for i, e in enumerate(anomalies):
+            if (i not in consumed_detect and e["type"] == atype
+                    and e["detected_t"] >= t):
+                consumed_detect.add(i)
+                detect = round(e["detected_t"] - t, 1)
+                break
+        for i, e in enumerate(anomalies):
+            fix = e.get("fix")
+            if (i not in consumed_heal and e["type"] == atype
+                    and e["action"] == "FIX" and fix
+                    and (fix.get("executed")
+                         or fix.get("numPartitionsChanged"))
+                    and e["t"] >= t):
+                consumed_heal.add(i)
+                heal = round(e["t"] - t, 1)
+                actions = (fix.get("numReplicaMovements", 0)
+                           + fix.get("numLeaderMovements", 0)
+                           + fix.get("numPartitionsChanged", 0))
+                break
+        samples.append({"kind": kind, "detect_ms": detect,
+                        "heal_ms": heal, "actions": actions})
+    return samples
+
+
+def aggregate_slos(episode_results: list) -> dict:
+    """Per-fault-kind SLO distributions (nearest-rank p50/p95/max) over
+    every episode of a campaign."""
+    by_kind: dict[str, dict] = {}
+    for r in episode_results:
+        for s in episode_slo_samples(r):
+            slot = by_kind.setdefault(
+                s["kind"], {"detect": [], "heal": [], "actions": [],
+                            "undetected": 0, "unhealed": 0})
+            if s["detect_ms"] is None:
+                slot["undetected"] += 1
+            else:
+                slot["detect"].append(s["detect_ms"])
+            if s["heal_ms"] is None:
+                slot["unhealed"] += 1
+            else:
+                slot["heal"].append(s["heal_ms"])
+            if s["actions"] is not None:
+                slot["actions"].append(s["actions"])
+    return {
+        kind: {
+            "time_to_detect_ms": _dist(v["detect"]),
+            "time_to_heal_ms": _dist(v["heal"]),
+            "actions_per_heal": _dist(v["actions"]),
+            "undetected": v["undetected"],
+            "unhealed": v["unhealed"],
+        }
+        for kind, v in sorted(by_kind.items())
+    }
+
+
+# ------------------------------------------------------------------- runner
+@dataclasses.dataclass
+class CampaignResult:
+    name: str
+    seed: int
+    episodes: list            # ScenarioResult per episode
+    scenarios: list           # the generated Scenario per episode
+
+    @property
+    def failures(self) -> list:
+        out = []
+        for i, r in enumerate(self.episodes):
+            out.extend(f"episode {i} ({r.name}): {f}" for f in r.failures)
+        return out
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+    def assert_ok(self) -> None:
+        if self.failures:
+            raise AssertionError(
+                f"campaign {self.name!r} (seed {self.seed}) failed:\n  "
+                + "\n  ".join(self.failures))
+
+    def slo_json(self) -> dict:
+        return aggregate_slos(self.episodes)
+
+    def to_json(self) -> dict:
+        """Deterministic campaign document: per-episode results (each with
+        its replay payload) + aggregated SLO distributions."""
+        return {
+            "campaign": self.name,
+            "seed": self.seed,
+            "num_episodes": len(self.episodes),
+            "converged_episodes": sum(1 for r in self.episodes if r.converged),
+            "episodes": [r.to_json() for r in self.episodes],
+            "slo": self.slo_json(),
+            "total_verified_optimizations": sum(
+                r.verified_optimizations for r in self.episodes),
+            "total_verifier_violations": sum(
+                len(r.verifier_violations) for r in self.episodes),
+            "total_invariant_violations": sum(
+                len(r.invariant_violations) for r in self.episodes),
+            "total_concurrency_adjustments": sum(
+                r.concurrency_adjustments for r in self.episodes),
+            "provision_actions": [a for r in self.episodes
+                                  for a in r.provision_actions],
+            "failures": self.failures,
+        }
+
+    def episode_log_json(self) -> dict:
+        """The FULL bit-identical episode log: to_json plus every episode's
+        timeline — what the determinism tests and tools/campaign_view.py
+        consume."""
+        out = self.to_json()
+        for entry, r in zip(out["episodes"], self.episodes):
+            entry["timeline"] = list(r.timeline)
+        return out
+
+
+class CampaignRunner:
+    """Run every episode of a campaign through the scenario engine."""
+
+    def __init__(self, spec, seed: int = 0):
+        if isinstance(spec, str):
+            spec = CAMPAIGNS[spec]
+        self.spec = spec
+        self.seed = seed
+
+    def run(self) -> CampaignResult:
+        from cruise_control_tpu.sim.runner import ScenarioRunner
+        episodes, scenarios = [], []
+        for i in range(self.spec.episodes):
+            sc = generate_episode(self.spec, self.seed, i)
+            scenarios.append(sc)
+            # episode variation comes entirely from the generated scenario
+            # (cluster seed + schedule); the runner seed stays 0 so the
+            # recorded replay payload reproduces the episode as-is
+            episodes.append(ScenarioRunner(sc, seed=0).run())
+        return CampaignResult(name=self.spec.name, seed=self.seed,
+                              episodes=episodes, scenarios=scenarios)
+
+
+def run_campaign(spec, seed: int = 0) -> CampaignResult:
+    return CampaignRunner(spec, seed=seed).run()
+
+
+# ------------------------------------------------------------------ catalog
+_MICRO_CLUSTER = ClusterSpec(num_brokers=12, num_racks=3,
+                             topics=(("t0", 60, 2), ("t1", 60, 2)),
+                             logdirs_per_broker=2)
+
+# tier-1 micro campaign: 2 episodes (provision closure + one compound draw)
+# on the 12-broker cluster inside the shared small-fixture compile bucket;
+# run with 2 seeds by the fast tier. The full matrices are slow-tier.
+MICRO = CampaignSpec(name="micro", cluster=_MICRO_CLUSTER, episodes=2,
+                     min_faults=2, max_faults=3, provision_episode=True,
+                     duration_ms=2_400_000.0)
+
+# broader fuzz on the same rung: more episodes, denser schedules
+SMALL = CampaignSpec(name="small", cluster=_MICRO_CLUSTER, episodes=6,
+                     min_faults=2, max_faults=4, provision_episode=True,
+                     duration_ms=3_000_000.0)
+
+# the 50-broker rung (the scenario catalog's larger ladder step)
+BROAD_50B = CampaignSpec(
+    name="broad-50b",
+    cluster=ClusterSpec(num_brokers=50, num_racks=5,
+                        topics=(("t0", 250, 2), ("t1", 250, 2),
+                                ("t2", 250, 2), ("t3", 250, 2)),
+                        logdirs_per_broker=2),
+    episodes=3, min_faults=2, max_faults=4,
+    duration_ms=3_000_000.0, tick_ms=15_000.0)
+
+CAMPAIGNS = {c.name: c for c in (MICRO, SMALL, BROAD_50B)}

@@ -17,7 +17,8 @@ from __future__ import annotations
 
 from cruise_control_tpu.sim.scenario import (
     ClusterSpec, Scenario, broker_death, clear_slow_broker, disk_failure,
-    maintenance_event, metric_gap, slow_broker, topic_creation,
+    load_surge, maintenance_event, metric_gap, rf_drop, slow_broker,
+    topic_creation,
 )
 
 GV_OFF = ("goal.violation.detection.interval.ms", 10_000_000_000)
@@ -128,6 +129,47 @@ TOPIC_CREATION = Scenario(
     settle_ticks=2,
 )
 
+TOPIC_RF_REPAIR = Scenario(
+    name="topic-rf-repair",
+    cluster=_SMALL,
+    # drop t0 to RF 1: the TopicReplicationFactorAnomalyFinder must detect
+    # the under-replication and the repair PLAN must execute through the
+    # executor (replica adds on least-loaded alive brokers, task-accounted)
+    events=(rf_drop(0.0, "t0", 1),),
+    duration_ms=900_000.0,
+    tick_ms=15_000.0,
+    config=(GV_OFF,
+            ("self.healing.target.topic.replication.factor", 2),
+            ("topic.anomaly.detection.interval.ms", 60_000)),
+    max_detect_ms=120_000.0,
+    max_heal_ms=300_000.0,
+    expect_detect_types=("TOPIC_ANOMALY",),
+)
+
+UNDER_PROVISION_SURGE = Scenario(
+    name="under-provision-surge",
+    cluster=_SMALL,
+    # 1.7x load surge against calibrated-low NW_IN capacity (see the chaos
+    # campaign's calibrated twin, sim/campaign._provision_episode): the
+    # GoalViolationDetector's capacity math must go UNDER_PROVISIONED, the
+    # verdict must actuate a simulated broker add (SimulatedProvisioner),
+    # and the loop must re-converge RIGHT_SIZED after the resize
+    events=(load_surge(0.0, 1.7),),
+    duration_ms=2_400_000.0,
+    tick_ms=15_000.0,
+    config=(("default.broker.capacity.nw.in", 2200.0),
+            ("provisioner.class",
+             "cruise_control_tpu.detector.provisioner.SimulatedProvisioner"),
+            ("provision.actuation.cooldown.ms", 300_000),
+            ("provision.max.added.brokers", 4),
+            ("anomaly.detection.goals",
+             "NetworkInboundCapacityGoal,DiskCapacityGoal,"
+             "ReplicaDistributionGoal"),
+            ("goal.violation.detection.interval.ms", 120_000)),
+    expect_detect_types=("GOAL_VIOLATION",),
+    expect_provision=("add_broker",),
+)
+
 COMPOUND_CASCADE = Scenario(
     name="compound-cascade",
     cluster=ClusterSpec(num_brokers=16, num_racks=4,
@@ -159,6 +201,7 @@ COMPOUND_CASCADE = Scenario(
 SCENARIOS = {
     s.name: s for s in (
         BROKER_DEATH_SMOKE, BROKER_DEATH_50B, DISK_FAILURE, SLOW_BROKER,
-        METRIC_GAP, MAINTENANCE_REMOVE, TOPIC_CREATION, COMPOUND_CASCADE,
+        METRIC_GAP, MAINTENANCE_REMOVE, TOPIC_CREATION, TOPIC_RF_REPAIR,
+        UNDER_PROVISION_SURGE, COMPOUND_CASCADE,
     )
 }

@@ -77,6 +77,16 @@ class ScenarioResult:
     timeline: list = dataclasses.field(default_factory=list)
     invariant_violations: list = dataclasses.field(default_factory=list)
     failures: list = dataclasses.field(default_factory=list)
+    # OptimizationVerifier pass over every optimization the loop ran
+    verified_optimizations: int = 0
+    verifier_violations: list = dataclasses.field(default_factory=list)
+    # Provisioner.rightsize actuations observed during the run
+    provision_actions: list = dataclasses.field(default_factory=list)
+    # ConcurrencyAdjuster AIMD adjustments made during heal executions
+    concurrency_adjustments: int = 0
+    # replay payload: everything needed to rebuild the exact Scenario
+    # (scenario_from_json) — cluster spec, events, config overrides, contract
+    scenario_spec: dict = dataclasses.field(default_factory=dict)
     # flight-recorder consumption: the app's RoundTrace ring (timestamps on
     # SIMULATED time) and the final sensor snapshot — the same records the
     # service serves via /state?substates=ROUND_TRACES and GET /metrics,
@@ -99,8 +109,13 @@ class ScenarioResult:
                 + "\n  ".join(json.dumps(e) for e in self.timeline))
 
     def to_json(self) -> dict:
+        """Deterministic result document. Carries the FULL replay payload
+        (``scenario`` spec incl. the effective seed, events and config
+        overrides) so any campaign episode artifact can be re-run
+        byte-for-byte from its JSON alone (scenario_from_json)."""
         return {
             "scenario": self.name, "seed": self.seed,
+            "scenario_spec": self.scenario_spec,
             "converged": self.converged,
             "time_to_detect_ms": self.time_to_detect_ms,
             "time_to_heal_ms": self.time_to_heal_ms,
@@ -111,6 +126,10 @@ class ScenarioResult:
             "sim_duration_ms": self.sim_duration_ms,
             "num_invariant_violations": len(self.invariant_violations),
             "num_round_traces": len(self.round_traces),
+            "verified_optimizations": self.verified_optimizations,
+            "verifier_violations": list(self.verifier_violations),
+            "provision_actions": list(self.provision_actions),
+            "concurrency_adjustments": self.concurrency_adjustments,
             "failures": list(self.failures),
         }
 
@@ -140,6 +159,12 @@ class ScenarioRunner:
         sc = self.scenario
         spec = dataclasses.replace(sc.cluster, seed=sc.cluster.seed + self.seed)
         self.backend = build_backend(spec)
+        # replay payload: the scenario with its EFFECTIVE cluster seed (this
+        # runner's seed already folded in), so (scenario_from_json(payload),
+        # seed=payload seed) reproduces this episode bit-identically
+        from cruise_control_tpu.sim.scenario import scenario_to_json
+        self.result.scenario_spec = scenario_to_json(
+            dataclasses.replace(sc, cluster=spec), seed=0)
         props = dict(BASE_CONFIG)
         props.update(sc.config_dict())
         if any(e.kind == "maintenance_event" for e in sc.events) \
@@ -153,6 +178,23 @@ class ScenarioRunner:
         self.cc.start_up()
         self.expected_rf = {tp: len(set(info.replicas))
                             for tp, info in self.backend.partitions().items()}
+        # OptimizationVerifier pass on EVERY optimization the loop runs
+        # (RandomSelfHealingTest + OptimizationVerifier role): regression,
+        # structural proposal validity, no adds onto dead hardware. Verdicts
+        # are deterministic functions of the optimization result, so they are
+        # part of the reproducible episode record.
+        from cruise_control_tpu.analyzer.verifier import verify_operation_result
+
+        def _verify(operation, reason, res, executed):
+            self.result.verified_optimizations += 1
+            viols = verify_operation_result(operation, res)
+            if viols:
+                self.result.verifier_violations.extend(
+                    f"{operation}: {v}" for v in viols)
+                self._record("verifier_violation", self._now(),
+                             operation=operation, violations=viols)
+        self.cc.optimization_observers.append(_verify)
+        self._provision_cursor = 0
 
     def _now(self) -> float:
         return self.backend.now_ms()
@@ -219,6 +261,10 @@ class ScenarioRunner:
                                     bytes_out_rate=p["size_mb"] / 5.0,
                                     cpu_util=p["size_mb"] / 300.0)
                 self.expected_rf[(p["topic"], i)] = rf
+        elif ev.kind == "rf_drop":
+            be.shrink_replicas(p["topic"], p["target_rf"])
+        elif ev.kind == "load_surge":
+            be.scale_partition_load(p["factor"], topics=p.get("topics"))
         elif ev.kind == "maintenance_event":
             spool = os.path.join(self._spool_dir, "maintenance_events.jsonl")
             with open(spool, "a") as f:
@@ -257,6 +303,7 @@ class ScenarioRunner:
             now = self._now()
             lm.sample_once(now_ms=now)
             ad.run_due(now)
+            self._record_provision_actions()
             for h in ad.handle_anomalies(now):
                 self._record_handled(h, self._now())
             now = self._now()   # a FIX execution advances simulated time
@@ -283,8 +330,42 @@ class ScenarioRunner:
         self._finalize(heal_candidate_ms)
         return self.result
 
+    def _record_provision_actions(self) -> None:
+        """Fold Provisioner.rightsize actuations (SimulatedProvisioner
+        history, stamped on the backend clock inside the detection round)
+        into the timeline + result as they appear."""
+        prov = getattr(self.cc, "provisioner", None)
+        history = getattr(prov, "history", None)
+        if not history:
+            return
+        for entry in history[self._provision_cursor:]:
+            self.result.provision_actions.append(dict(entry))
+            self._record("provision", entry["ms"], action=entry["action"],
+                         broker=entry["broker"], reason=entry["reason"])
+        self._provision_cursor = len(history)
+
     def _extra_convergence_checks(self) -> list:
         out = []
+        # a scenario hasn't finished its story while an expected detection or
+        # provisioner actuation is still outstanding: structural quiet before
+        # the detector reacted (e.g. a load surge breaks no metadata) must
+        # not count as convergence
+        handled = {e["type"] for e in self.result.timeline
+                   if e["kind"] == "anomaly"}
+        for t in self.scenario.expect_detect_types:
+            if t not in handled:
+                out.append(f"expected anomaly type {t} not handled yet")
+        actions_seen = {a["action"] for a in self.result.provision_actions}
+        for action in self.scenario.expect_provision:
+            if action not in actions_seen:
+                out.append(f"provisioner action {action!r} not actuated yet")
+        if actions_seen and self.scenario.expect_provision:
+            # re-convergence after resize: the detector must re-assess the
+            # RESIZED cluster as right-sized (one more GV round post-add)
+            rec = getattr(self.cc.goal_violation_detector, "last_provision",
+                          None)
+            if rec is None or rec.status.value != "RIGHT_SIZED":
+                out.append("provision status not RIGHT_SIZED after resize")
         for b in self.scenario.expect_empty_brokers:
             n = invariants.replicas_on(self.backend, b)
             if n:
@@ -331,11 +412,14 @@ class ScenarioRunner:
                 and heal_candidate_ms is not None:
             r.time_to_heal_ms = round(
                 max(heal_candidate_ms - self._first_fault_ms, 0.0), 1)
+        self._record_provision_actions()   # actions after the last tick
         r.proposals = sum(op["numProposals"]
                           for op in self.cc.ops_history if op["executed"])
         est = self.cc.executor.state_json()
         r.executor_tasks = est.get("numPlannedTasksTotal", 0)
         r.executions = est.get("numExecutions", 0)
+        r.concurrency_adjustments = est.get(
+            "concurrencyAdjuster", {}).get("numAdjustments", 0)
         # ------------------------------------------- the scenario contract
         if sc.expects_heal and not r.converged:
             r.failures.append(
@@ -370,6 +454,16 @@ class ScenarioRunner:
         if fix_errors:
             r.failures.append(f"{len(fix_errors)} self-healing fixes raised "
                               f"(first: {fix_errors[0]['fixError']})")
+        if r.verifier_violations:
+            r.failures.append(
+                f"{len(r.verifier_violations)} OptimizationVerifier "
+                f"violations (first: {r.verifier_violations[0]})")
+        actions_seen = {a["action"] for a in r.provision_actions}
+        for action in sc.expect_provision:
+            if action not in actions_seen:
+                r.failures.append(
+                    f"expected provisioner action {action!r} never actuated "
+                    f"(saw: {sorted(actions_seen) or 'none'})")
         # detect/heal latency TIMERS (simulated seconds): scenario runs
         # populate the same sensor catalog chaos campaigns will aggregate
         if r.time_to_detect_ms is not None:

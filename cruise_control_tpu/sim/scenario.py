@@ -80,6 +80,23 @@ def topic_creation(at_ms: float, topic: str, partitions: int, rf: int,
                           "rf": int(rf), "size_mb": float(size_mb)})
 
 
+def rf_drop(at_ms: float, topic: str, target_rf: int) -> ScenarioEvent:
+    """Shrink a topic's partitions to ``target_rf`` replicas — the
+    under-replicated-topic fault TopicReplicationFactorAnomalyFinder must
+    detect and repair through the executor (TOPIC_ANOMALY heal path)."""
+    return ScenarioEvent(at_ms, "rf_drop",
+                         {"topic": topic, "target_rf": int(target_rf)})
+
+
+def load_surge(at_ms: float, factor: float, topics=None) -> ScenarioEvent:
+    """Multiply cpu/network partition load by ``factor`` — the traffic surge
+    that drives GoalViolationDetector's provision math UNDER_PROVISIONED and
+    exercises Provisioner.rightsize actuation."""
+    return ScenarioEvent(at_ms, "load_surge",
+                         {"factor": float(factor),
+                          "topics": sorted(topics) if topics else None})
+
+
 def maintenance_event(at_ms: float, plan_type: str, brokers=(),
                       topics=None) -> ScenarioEvent:
     """Spool an operator maintenance plan (MaintenanceEventDetector path)."""
@@ -126,10 +143,59 @@ class Scenario:
     forbid_detect_types: tuple = ()
     expect_empty_brokers: tuple = ()      # brokers hosting 0 replicas at end
     expect_nonleader_brokers: tuple = ()  # brokers leading 0 partitions at end
+    expect_provision: tuple = ()          # provisioner actions that must have
+                                          # actuated ("add_broker"/"remove_broker")
     settle_ticks: int = 2                 # convergence must hold N ticks
 
     def config_dict(self) -> dict:
         return {k: v for k, v in self.config}
+
+
+def scenario_to_json(sc: Scenario, seed: int = 0) -> dict:
+    """Full replay payload: everything ``scenario_from_json`` needs to
+    rebuild THIS exact scenario (cluster spec, events, config overrides and
+    the convergence contract). Stamped into every ScenarioResult so a
+    campaign episode artifact is replayable byte-for-byte from JSON alone."""
+    cluster = dataclasses.asdict(sc.cluster)
+    cluster["topics"] = [list(t) for t in sc.cluster.topics]
+    return {
+        "name": sc.name, "seed": int(seed), "cluster": cluster,
+        "events": [{"at_ms": e.at_ms, "kind": e.kind,
+                    "params": dict(e.params)} for e in sc.events],
+        "duration_ms": sc.duration_ms, "tick_ms": sc.tick_ms,
+        "config": [[k, v] for k, v in sc.config],
+        "expects_heal": sc.expects_heal,
+        "max_detect_ms": sc.max_detect_ms, "max_heal_ms": sc.max_heal_ms,
+        "expect_detect_types": list(sc.expect_detect_types),
+        "forbid_detect_types": list(sc.forbid_detect_types),
+        "expect_empty_brokers": list(sc.expect_empty_brokers),
+        "expect_nonleader_brokers": list(sc.expect_nonleader_brokers),
+        "expect_provision": list(sc.expect_provision),
+        "settle_ticks": sc.settle_ticks,
+    }
+
+
+def scenario_from_json(d: dict) -> tuple:
+    """Inverse of :func:`scenario_to_json`: ``(Scenario, seed)``. Running the
+    returned scenario with the returned seed reproduces the original episode
+    timeline bit-identically."""
+    c = dict(d["cluster"])
+    c["topics"] = tuple(tuple(t) for t in c["topics"])
+    sc = Scenario(
+        name=d["name"], cluster=ClusterSpec(**c),
+        events=tuple(ScenarioEvent(e["at_ms"], e["kind"], dict(e["params"]))
+                     for e in d["events"]),
+        duration_ms=d["duration_ms"], tick_ms=d["tick_ms"],
+        config=tuple((k, v) for k, v in d["config"]),
+        expects_heal=d["expects_heal"],
+        max_detect_ms=d["max_detect_ms"], max_heal_ms=d["max_heal_ms"],
+        expect_detect_types=tuple(d["expect_detect_types"]),
+        forbid_detect_types=tuple(d["forbid_detect_types"]),
+        expect_empty_brokers=tuple(d["expect_empty_brokers"]),
+        expect_nonleader_brokers=tuple(d["expect_nonleader_brokers"]),
+        expect_provision=tuple(d.get("expect_provision", ())),
+        settle_ticks=d["settle_ticks"])
+    return sc, int(d.get("seed", 0))
 
 
 def build_backend(spec: ClusterSpec, metric_noise: float = 0.0):

@@ -28,7 +28,11 @@ def _backend(n_brokers=4, rf=2, n_parts=8):
 def _cc(be, extra_config=None):
     props = {"self.healing.enabled": True,
              "broker.failure.alert.threshold.ms": 100,
-             "broker.failure.self.healing.threshold.ms": 200}
+             "broker.failure.self.healing.threshold.ms": 200,
+             # the RF-2 fixture must not be "repaired" to the RF-3 default
+             # underneath the broker-failure tests — the RF fix executes for
+             # real through the executor now (sim BASE_CONFIG does the same)
+             "self.healing.target.topic.replication.factor": 2}
     props.update(extra_config or {})
     cc = CruiseControl(be, cruise_control_config(props))
     cc.start_up()

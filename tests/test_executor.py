@@ -208,6 +208,49 @@ def test_concurrency_adjuster_in_execution():
     assert ex._cfg.per_broker_cap > before
 
 
+def test_concurrency_adjuster_backoff_and_recovery_mid_execution():
+    """AIMD dynamics inside ONE long throttled execution: a slow broker
+    injected mid-flight backs the per-broker cap off multiplicatively on the
+    adjuster's own cadence (concurrency.adjuster.interval.ms), and clearing
+    the slowness recovers it additively before the execution finishes — the
+    throttle back-off/recovery cycle chaos campaigns ride on."""
+    from cruise_control_tpu.config import cruise_control_config
+    be = _backend()
+    cfg = cruise_control_config({
+        "concurrency.adjuster.enabled": True,
+        "num.concurrent.partition.movements.per.broker": 8,
+        "concurrency.adjuster.interval.ms": 5_000,
+        "execution.progress.check.interval.ms": 1_000,
+        # ~2 MB/s: the 100-350 MB copies take minutes of simulated time, so
+        # the mid-flight metric flips land inside the movement phase
+        "default.replication.throttle": 2 * 1024 * 1024,
+    })
+    # slow from t=10s, healthy again from t=60s (fires from inside the
+    # executor's own progress sleeps)
+    be.schedule_at(10_000.0, lambda now: be.override_broker_metric(
+        2, "BROKER_LOG_FLUSH_TIME_MS_999TH", 50_000.0))
+    be.schedule_at(60_000.0, lambda now: be.override_broker_metric(
+        2, "BROKER_LOG_FLUSH_TIME_MS_999TH", None))
+    ex = Executor(be, config=cfg)
+    ex.execute_proposals([
+        _move("t", 0, [0, 1], [3, 1], old_leader=0, new_leader=3),
+        _move("t", 1, [1, 2], [3, 2], old_leader=1, new_leader=3),
+        _move("t", 2, [2, 0], [1, 0], old_leader=2, new_leader=1),
+    ])
+    adjustments = [a for a in ex._adjuster.history
+                   if a["type"] == "INTER_BROKER_REPLICA"]
+    assert adjustments, "adjuster never ran during the execution"
+    caps = [a["to"] for a in adjustments]
+    assert min(caps) < 8, f"no multiplicative back-off observed: {caps}"
+    # recovery: after the slow window the cap climbed back above its floor
+    assert caps[-1] > min(caps), f"no additive recovery observed: {caps}"
+    # the slow window is also visible in the over-limit evidence
+    assert any(a["overLimit"] for a in adjustments)
+    assert all(t.state is TaskState.COMPLETED
+               for t in ex._current_planner.all_tasks
+               if t.task_type is TaskType.INTER_BROKER_REPLICA_ACTION)
+
+
 def test_per_topic_throttled_replica_lists_set_and_cleaned():
     """ReplicationThrottleHelper.java:28-46,159,200 parity: during an
     execution the moved topics carry leader/follower throttled-replica lists
@@ -287,16 +330,50 @@ def test_removal_history_retention_expires():
     assert ex.recently_demoted_brokers() == set()
 
 
-def test_leadership_timeout_marks_dead():
-    """leader.movement.timeout.ms: an election the cluster never applies is
-    abandoned as DEAD instead of hanging the leadership phase."""
+def test_leadership_timeout_abandons_as_aborted():
+    """leader.movement.timeout.ms: an election the cluster applies too slowly
+    (simulated slow-election latency past the timeout) is abandoned
+    IN_PROGRESS -> ABORTING -> ABORTED, and state_json carries the correct
+    numAbortedTasks census (every task in exactly one state, counts summing
+    to the plan)."""
     from cruise_control_tpu.config import cruise_control_config
-    cfg = cruise_control_config({"leader.movement.timeout.ms": 5000})
+    cfg = cruise_control_config({"leader.movement.timeout.ms": 5000,
+                                 "execution.progress.check.interval.ms": 1000})
     be = _backend()
-    be.elect_leaders = lambda elections: None   # cluster ignores elections
+    be.set_leadership_latency_ms(60_000.0)   # lands long after the timeout
+    ex = Executor(be, config=cfg)
+    ex.execute_proposals([
+        _move("t", 2, [2, 0], [2, 0], old_leader=2, new_leader=0),
+        _move("t", 1, [1, 2], [1, 2], old_leader=1, new_leader=2),
+    ])
+    lead = [t for t in ex._current_planner.all_tasks
+            if t.task_type is TaskType.LEADER_ACTION]
+    assert [t.state for t in lead] == [TaskState.ABORTED, TaskState.ABORTED]
+    st = ex.state_json()
+    assert st["numAbortedTasks"] == 2
+    assert st["numTasksByState"]["ABORTED"] == 2
+    assert sum(st["numTasksByState"].values()) == st["numTotalTasks"]
+    from cruise_control_tpu.sim.invariants import check_executor_accounting
+    assert check_executor_accounting(ex) == []
+    # the abandoned elections eventually land backend-side (a late election
+    # is late, not lost) without disturbing the executor's finished census
+    be.advance(120_000.0)
+    assert be.partitions()[("t", 2)].leader == 0
+
+
+def test_leadership_latency_under_timeout_completes():
+    """Slow-but-in-budget elections complete: the await loop polls through
+    the injected latency and lands COMPLETED, not ABORTED."""
+    from cruise_control_tpu.config import cruise_control_config
+    cfg = cruise_control_config({"leader.movement.timeout.ms": 60_000,
+                                 "execution.progress.check.interval.ms": 1000})
+    be = _backend()
+    be.set_leadership_latency_ms(3_000.0)
     ex = Executor(be, config=cfg)
     ex.execute_proposals([_move("t", 2, [2, 0], [2, 0], old_leader=2,
                                 new_leader=0)])
     lead = [t for t in ex._current_planner.all_tasks
             if t.task_type is TaskType.LEADER_ACTION]
-    assert [t.state for t in lead] == [TaskState.DEAD]
+    assert [t.state for t in lead] == [TaskState.COMPLETED]
+    assert be.partitions()[("t", 2)].leader == 0
+    assert ex.state_json()["numTasksByState"].get("ABORTED", 0) == 0

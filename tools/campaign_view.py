@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Render chaos-campaign results: episode logs + SLO distribution tables.
+
+Input (file path or ``-`` for stdin), any of:
+  - a CampaignResult JSON (``run_campaign(...).to_json()`` /
+    ``episode_log_json()`` — what bench writes to CAMPAIGN_<name>_s<seed>.json)
+  - a bench summary carrying a ``campaign`` block (BENCH_*.json /
+    BENCH_partial.json / the compact final line)
+  - a single ScenarioResult JSON (an episode entry)
+
+Usage:
+  tools/campaign_view.py CAMPAIGN.json [--episodes] [--timeline N]
+
+  --episodes     per-episode one-liners (faults, convergence, latencies)
+  --timeline N   dump episode N's full timeline (requires a log with
+                 timelines, i.e. episode_log_json output)
+
+Default output: the campaign header (episodes converged, verifier and
+invariant verdicts, provisioner actuations) and the per-fault-type SLO
+table — time-to-detect / time-to-heal / actions-per-heal p50/p95/max in
+simulated ms.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _find_campaign(doc) -> dict | None:
+    if not isinstance(doc, dict):
+        return None
+    if "slo" in doc and ("episodes" in doc or "campaign" in doc):
+        return doc
+    if isinstance(doc.get("campaign"), dict):
+        return doc["campaign"]
+    return None
+
+
+def _fmt_ms(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v / 1000.0:.1f}s" if v >= 1000 else f"{v:.0f}ms"
+
+
+def render_slo_table(slo: dict) -> str:
+    if not slo:
+        return "  (no SLO samples)"
+    head = (f"  {'fault':<18} {'n':>3} | {'detect p50':>10} {'p95':>10} "
+            f"{'max':>10} | {'heal p50':>10} {'p95':>10} {'max':>10} "
+            f"| {'acts p50':>8} {'max':>6} | miss")
+    lines = [head, "  " + "-" * (len(head) - 2)]
+    for kind, d in slo.items():
+        det, heal, acts = (d["time_to_detect_ms"], d["time_to_heal_ms"],
+                           d["actions_per_heal"])
+        miss = []
+        if d.get("undetected"):
+            miss.append(f"{d['undetected']}D")
+        if d.get("unhealed"):
+            miss.append(f"{d['unhealed']}H")
+        lines.append(
+            f"  {kind:<18} {det['n']:>3} | {_fmt_ms(det['p50']):>10} "
+            f"{_fmt_ms(det['p95']):>10} {_fmt_ms(det['max']):>10} | "
+            f"{_fmt_ms(heal['p50']):>10} {_fmt_ms(heal['p95']):>10} "
+            f"{_fmt_ms(heal['max']):>10} | "
+            f"{acts['p50'] if acts['p50'] is not None else '-':>8} "
+            f"{acts['max'] if acts['max'] is not None else '-':>6} | "
+            f"{','.join(miss) or '-'}")
+    return "\n".join(lines)
+
+
+def render_episode_line(i: int, ep: dict) -> str:
+    spec = ep.get("scenario_spec", {})
+    events = ",".join(e["kind"] for e in spec.get("events", [])) or "?"
+    flags = []
+    if ep.get("verifier_violations"):
+        flags.append(f"VERIFIER x{len(ep['verifier_violations'])}")
+    if ep.get("num_invariant_violations"):
+        flags.append(f"INVARIANT x{ep['num_invariant_violations']}")
+    prov = ",".join(a["action"] for a in ep.get("provision_actions", []))
+    return (f"  ep{i} {ep.get('scenario'):<28} [{events}] "
+            f"{'OK ' if ep.get('converged') and not ep.get('failures') else 'FAIL'}"
+            f" detect={_fmt_ms(ep.get('time_to_detect_ms'))}"
+            f" heal={_fmt_ms(ep.get('time_to_heal_ms'))}"
+            f" verified={ep.get('verified_optimizations', 0)}"
+            f" adjust={ep.get('concurrency_adjustments', 0)}"
+            + (f" provision={prov}" if prov else "")
+            + (f"  !! {' '.join(flags)}" if flags else ""))
+
+
+def render(doc: dict, show_episodes: bool = False,
+           timeline_of: int | None = None) -> str:
+    lines = []
+    name = doc.get("campaign") if isinstance(doc.get("campaign"), str) \
+        else doc.get("name", "?")
+    lines.append(
+        f"campaign {name} · seed {doc.get('seed')} · "
+        f"{doc.get('converged_episodes')}/{doc.get('num_episodes')} episodes "
+        f"converged · {doc.get('total_verified_optimizations', 0)} "
+        f"optimizations verified "
+        f"({doc.get('total_verifier_violations', 0)} verifier / "
+        f"{doc.get('total_invariant_violations', 0)} invariant violations)")
+    prov = doc.get("provision_actions") or []
+    if prov:
+        lines.append("  provision: " + "; ".join(
+            f"{a['action']}(broker {a['broker']}) @ {_fmt_ms(a['ms'])}"
+            for a in prov))
+    for f in doc.get("failures", []):
+        lines.append(f"  FAILURE: {f}")
+    lines.append("")
+    lines.append(render_slo_table(doc.get("slo", {})))
+    episodes = doc.get("episodes", [])
+    if show_episodes and episodes:
+        lines.append("")
+        for i, ep in enumerate(episodes):
+            lines.append(render_episode_line(i, ep))
+    if timeline_of is not None:
+        if timeline_of >= len(episodes):
+            lines.append(f"\n(no episode {timeline_of})")
+        else:
+            tl = episodes[timeline_of].get("timeline")
+            lines.append(f"\nepisode {timeline_of} timeline:")
+            if tl is None:
+                lines.append("  (document carries no timelines — use the "
+                             "CAMPAIGN_*.json episode log, not the summary)")
+            else:
+                for e in tl:
+                    lines.append("  " + json.dumps(e))
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    timeline_of = None
+    if "--timeline" in argv:
+        timeline_of = int(argv[argv.index("--timeline") + 1])
+        args = [a for a in args if a != str(timeline_of)]
+    if not args:
+        print(__doc__, file=sys.stderr)
+        return 2
+    raw = sys.stdin.read() if args[0] == "-" else open(args[0]).read()
+    doc = None
+    for line in [raw] + raw.strip().splitlines()[::-1]:
+        try:
+            candidate = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        doc = _find_campaign(candidate)
+        if doc is not None:
+            break
+    if doc is None:
+        print("no campaign document found", file=sys.stderr)
+        return 1
+    print(render(doc, show_episodes="--episodes" in argv,
+                 timeline_of=timeline_of))
+    return 0
+
+
+if __name__ == "__main__":
+    import signal
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    raise SystemExit(main(sys.argv[1:]))
