@@ -257,14 +257,26 @@ def remaining_budget() -> float:
     return WALL_BUDGET_S - (time.monotonic() - T_START)
 
 
+# --mesh N: shard-explicit mesh size for every rung optimizer (0 = off).
+# On this CPU container the mesh is virtual (xla_force_host_platform_
+# device_count) and proves correctness/collective budget, not speedup.
+MESH_DEVICES = 0
+
+
 def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
              profile: bool = False, all_warm: bool = False,
-             profile_level: str | None = None) -> dict:
+             profile_level: str | None = None,
+             mesh_devices: int = 0) -> dict:
     """``all_warm``: every run hits a warm cache (--skip-cold), so the
     reported wall is the min over ALL runs, not runs[1:].
     ``profile_level``: analyzer.profile.level for the rung's optimizer
     (--profile-level pass|stage; pass is the zero-cost counters level the
-    PERF round-8 overhead claim is measured against)."""
+    PERF round-8 overhead claim is measured against).
+    ``mesh_devices`` (--mesh N): run the rung's optimizer on an N-device
+    shard-explicit mesh (tpu.mesh.axis.brokers; requires N devices —
+    virtual via xla_force_host_platform_device_count on CPU). Results are
+    bit-identical to meshless by the shard_map engine's contract; the rung
+    records the actual mesh size used."""
     import dataclasses
 
     from cruise_control_tpu.analyzer.engine import EngineParams
@@ -274,7 +286,14 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
     ov = os.environ.get("CC_ENGINE_OVERRIDES")
     params = (dataclasses.replace(EngineParams(), **json.loads(ov))
               if ov else None)
-    opt = GoalOptimizer(engine_params=params, profile_level=profile_level)
+    if mesh_devices == 0:
+        mesh_devices = MESH_DEVICES
+    cfg = None
+    if mesh_devices > 1:
+        from cruise_control_tpu.config import cruise_control_config
+        cfg = cruise_control_config({"tpu.mesh.axis.brokers": mesh_devices})
+    opt = GoalOptimizer(config=cfg, engine_params=params,
+                        profile_level=profile_level)
     walls = []
     res = None
     warm_skip_reason = None
@@ -303,6 +322,10 @@ def run_rung(name: str, ct, meta, goal_names=None, repeats: int = 2,
     warm_walls = walls if all_warm else (walls[1:] or walls)
     rung = {
         "config": name,
+        # shard-explicit mesh actually used (1 = single-device; --mesh N
+        # shrinks to the available device count — virtual on CPU)
+        "mesh_devices": (int(opt._mesh.devices.size)
+                         if getattr(opt, "_mesh", None) is not None else 1),
         "wall_s_cold": round(walls[0], 3),
         "wall_s": round(min(warm_walls), 3),
         "warm_measured": all_warm or len(walls) > 1,
@@ -428,6 +451,18 @@ def main() -> None:
             argv = argv[:i] + argv[i + 1:]
             continue
         profile_level = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    # --mesh N: every rung optimizer runs the shard-explicit engine on an
+    # N-device mesh (tpu.mesh.axis.brokers; results bit-identical to
+    # meshless — the A/B is wall/bytes, not outcomes)
+    global MESH_DEVICES
+    while "--mesh" in argv:
+        i = argv.index("--mesh")
+        if i + 1 >= len(argv) or argv[i + 1].startswith("--"):
+            log("--mesh requires a device count")
+            argv = argv[:i] + argv[i + 1:]
+            continue
+        MESH_DEVICES = int(argv[i + 1])
         argv = argv[:i] + argv[i + 2:]
     # --rung NAME (repeatable): explicit single-rung filter for same-day
     # A/Bs; equivalent to the positional rung-id form

@@ -378,6 +378,22 @@ class EngineParams:
     # callers, tools) runs f32. Explicit "float32"/"bfloat16" — including
     # via CC_ENGINE_OVERRIDES — pins the mode.
     compute_dtype: str = "auto"
+    # ---- shard-explicit engine (PR 9) ----
+    # Device mesh of the shard-explicit engine (a 1-D jax.sharding.Mesh over
+    # BROKER_AXIS, or None): with a mesh of size > 1, the hot per-iteration
+    # kernels — the O(R) candidate keyings + top-k, the [K, B]/[KL, F]/
+    # [K1, K2]/[K, D] score fusions, the segment-parallel per-segment
+    # argmaxes and the finisher's exhaustive certificate scans — run under
+    # jax.shard_map with the candidate/replica ROW axes sharded and all
+    # broker-level state replicated (parallel/shard_ops.py). Only per-row
+    # RESULTS cross devices (one [K]-sized all-gather per admission wave, a
+    # top-k merge per keying, one pmax per certificate scan), and no
+    # cross-device float addition exists, so sharded results are
+    # BIT-IDENTICAL to the single-device program (test-certified;
+    # dryrun_multichip stage 4 asserts it chain-wide). STATIC aux field
+    # (hashable Mesh is part of the compiled program); None — the default —
+    # and meshes of size 1 compile exactly the pre-mesh engine.
+    mesh: object = None
 
 
 # EngineParams is a JAX PYTREE: the pure BUDGET fields (loop caps, gain
@@ -433,6 +449,39 @@ except ValueError:
     # already registered: importlib.reload / repeated-import pytest modes
     # re-execute this module against the live registry
     pass
+
+
+def _engine_mesh(params: "EngineParams"):
+    """The shard-explicit mesh, or None. A mesh of size 1 is the identity
+    decomposition — it compiles the exact single-device engine so the
+    mesh-threading machinery (optimizer/session placement) can stay on
+    unconditionally without forking the compiled program."""
+    m = params.mesh
+    if m is None or int(m.devices.size) <= 1:
+        return None
+    return m
+
+
+def _sharded_key_select(mesh, key_fn, env_sc: ClusterEnv, st_sc: EngineState,
+                        k: int, stall: Array, salt: int = 0,
+                        salted: bool = True):
+    """Mesh path of candidate selection: the O(R) keying runs shard-local
+    over the replica axis (each device keys its own replica shard against
+    the replicated broker tables — bitwise the unsharded sweep's values,
+    incl. the stall salt, which hashes GLOBAL replica ids) and per-shard
+    exact top-k lists merge into the global top-k with identical
+    tie-breaking (shard_ops.replica_key_select). Always exact — where the
+    unsharded path would run approx_max_k (soft goals on TPU) this is an
+    exactness upgrade, the compact_keying contract."""
+    from cruise_control_tpu.parallel import shard_ops
+
+    def body(e, s, gidx):
+        key = key_fn(e, s)
+        if not salted:
+            return key
+        return _stall_explore(key, stall, salt=salt, idx=gidx)
+
+    return shard_ops.replica_key_select(mesh, body, env_sc, st_sc, k)
 
 
 def _wave_budget_capable(g: GoalKernel, leadership: bool = False) -> bool:
@@ -649,7 +698,6 @@ def _move_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     scan just found, and certificate convergence would stall)."""
     K = cand.shape[0]
     B = env.num_brokers
-    mask = legit_move_mask(env, st, cand, goal.options)
     d_rows = _move_delta_rows(env, st, cand)                        # [K, 8]
     src_b = st.replica_broker[cand]
     if params.chain_cache:
@@ -657,18 +705,40 @@ def _move_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         # ONE combined per-dim comparison ([B]-level rooms, refreshed per
         # applied wave) instead of a [K, B] mask per goal
         rooms, custom = _combined_move_rooms(prev_goals, env, st)
-        if rooms:
-            mask = mask & _rooms_move_mask(rooms, d_rows, src_b)
     else:
+        rooms = {}
         custom = tuple(g for g in prev_goals
                        if type(g).accept_move is not GoalKernel.accept_move)
-    for g in custom:
-        mask = mask & g.accept_move(env, st, cand)
-    if env_sw is not None:
-        score = goal.move_score(env_sw, _sweep_state(st, params), cand)
-    else:
-        score = goal.move_score(env, st, cand)          # exact (f32) mode
-    score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
+    T = min(params.num_dst_choices, B)
+    Bp = -(-B // T) * T
+    mesh = _engine_mesh(params)
+
+    def _score_class_rows(cand_l: Array, kv_l: Array):
+        """Per-candidate-row [*, B] masking + scoring + per-class reduction
+        (the whole O(K*B) stage of the wave). Shard-local under the mesh —
+        rows compute against the full replicated env/state, so their values
+        are bitwise the unsharded fusion's — and the inline single-device
+        stage below. Returns per-row per-class best (value, strided q index)
+        over the T destination-affinity classes; the row's global best is
+        recovered from them exactly (max over classes; argmax tie-break =
+        lowest column among max-achieving classes)."""
+        mask = legit_move_mask(env, st, cand_l, goal.options)
+        if rooms:
+            mask = mask & _rooms_move_mask(
+                rooms, _move_delta_rows(env, st, cand_l),
+                st.replica_broker[cand_l])
+        for g in custom:
+            mask = mask & g.accept_move(env, st, cand_l)
+        if env_sw is not None:
+            sc = goal.move_score(env_sw, _sweep_state(st, params), cand_l)
+        else:
+            sc = goal.move_score(env, st, cand_l)       # exact (f32) mode
+        sc = jnp.where(mask & (kv_l > NEG_INF)[:, None], sc, NEG_INF)
+        scp = (jnp.pad(sc, ((0, 0), (0, Bp - B)), constant_values=NEG_INF)
+               if Bp > B else sc)
+        sv = scp.reshape(cand_l.shape[0], Bp // T, T)   # [k, B/T, T]
+        return (jnp.max(sv, axis=1),                    # [k, T] class best
+                jnp.argmax(sv, axis=1).astype(jnp.int32))
 
     # ---- stage 2: independent-wave selection in score order ----
     # per-row destination spread: the row at sorted position j prefers its
@@ -684,20 +754,35 @@ def _move_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     # masked full-width sweep — the former sorted-space pipeline's gather +
     # two full [K, B] sweeps were the single largest per-pass cost.
     posn = jnp.arange(K, dtype=jnp.int32)
-    glob_dst = jnp.argmax(score, axis=1).astype(jnp.int32)
-    best_val = score[posn, glob_dst]                                # == max
-    order = jnp.argsort(-best_val)                                  # best first
-    rank = jnp.zeros(K, jnp.int32).at[order].set(posn)              # inv perm
-    T = min(params.num_dst_choices, B)
-    cls = rank % T
-    Bp = -(-B // T) * T
-    scp = (jnp.pad(score, ((0, 0), (0, Bp - B)), constant_values=NEG_INF)
-           if Bp > B else score)
-    aff = jnp.take_along_axis(scp.reshape(K, Bp // T, T),
-                              cls[:, None, None], axis=2)[..., 0]   # [K, B/T]
-    aff_j = jnp.argmax(aff, axis=1).astype(jnp.int32)
-    aff_val = aff[posn, aff_j]
-    aff_dst = aff_j * T + cls            # strided col j*T + cls == class col
+    if mesh is not None:
+        # shard-explicit: the [K, B] fusion splits over candidate rows; only
+        # the [K, T] class-best tables cross devices (the wave's one small
+        # all-gather) and every downstream [K]-level stage runs replicated
+        from cruise_control_tpu.parallel import shard_ops
+        cls_val, cls_q = shard_ops.rows_sharded(
+            mesh, _score_class_rows, (cand, kv), (jnp.int32(0), NEG_INF))
+        best_val = jnp.max(cls_val, axis=1)
+        cols = cls_q * T + jnp.arange(T, dtype=jnp.int32)[None, :]
+        # exact argmax reconstruction: lowest column among the classes
+        # achieving the row max (== jnp.argmax's tie-break on the full row)
+        glob_dst = jnp.min(jnp.where(cls_val == best_val[:, None], cols, Bp),
+                           axis=1).astype(jnp.int32)
+        order = jnp.argsort(-best_val)                              # best first
+        rank = jnp.zeros(K, jnp.int32).at[order].set(posn)          # inv perm
+        cls = rank % T
+        aff_val = cls_val[posn, cls]
+        aff_dst = cls_q[posn, cls] * T + cls
+    else:
+        cls_val, cls_q = _score_class_rows(cand, kv)
+        cols = cls_q * T + jnp.arange(T, dtype=jnp.int32)[None, :]
+        best_val = jnp.max(cls_val, axis=1)
+        glob_dst = jnp.min(jnp.where(cls_val == best_val[:, None], cols, Bp),
+                           axis=1).astype(jnp.int32)
+        order = jnp.argsort(-best_val)                              # best first
+        rank = jnp.zeros(K, jnp.int32).at[order].set(posn)          # inv perm
+        cls = rank % T
+        aff_val = cls_val[posn, cls]                                # [K]
+        aff_dst = cls_q[posn, cls] * T + cls  # strided col q*T + cls
     use_aff = aff_val > params.min_gain
     dst_u = jnp.where(use_aff, aff_dst, glob_dst)
     val_u = jnp.where(use_aff, aff_val, best_val)
@@ -792,12 +877,19 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     # candidate keying runs in the compute dtype (an [R]-sized sweep); the
     # severity argument stays the f32 measure — goals mix it in comparisons,
     # never into applied values
-    if env_sw is not None:
-        key = goal.replica_key(env_sw, _sweep_state(st, params), severity)
+    env_k = env_sw if env_sw is not None else env
+    st_k = _sweep_state(st, params) if env_sw is not None else st
+    mesh = _engine_mesh(params)
+    if mesh is not None and env.num_replicas % int(mesh.devices.size) == 0:
+        # shard-explicit: the O(R) keying runs on local replica shards and
+        # per-shard exact top-k lists merge (one small all-gather per pass)
+        kv_all, cand_all = _sharded_key_select(
+            mesh, lambda e, s: goal.replica_key(e, s, severity),
+            env_k, st_k, K * W, stall)
     else:
-        key = goal.replica_key(env, st, severity)
-    kv_all, cand_all = _select_candidates(key, K * W, stall, goal.is_hard,
-                                          params)
+        key = goal.replica_key(env_k, st_k, severity)
+        kv_all, cand_all = _select_candidates(key, K * W, stall, goal.is_hard,
+                                              params)
     if W == 1:
         st, n = _move_wave(env, st, goal, prev_goals, params, cand_all,
                            kv_all, env_sw)
@@ -837,24 +929,40 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
     mode (see _move_wave)."""
     env_sc = env_sw if env_sw is not None else env
     st_sw = _sweep_state(st, params) if env_sw is not None else st
+    mesh = _engine_mesh(params)
     if cand is None:
-        lkey = goal.leader_key(env_sc, st_sw, severity)
-        lkv, lcand = _select_candidates(lkey,
-                                        min(params.num_leader_candidates,
-                                            env.num_replicas),
-                                        stall, goal.is_hard, params)
+        kl = min(params.num_leader_candidates, env.num_replicas)
+        if mesh is not None and env.num_replicas % int(mesh.devices.size) == 0:
+            lkv, lcand = _sharded_key_select(
+                mesh, lambda e, s: goal.leader_key(e, s, severity),
+                env_sc, st_sw, kl, stall)
+        else:
+            lkey = goal.leader_key(env_sc, st_sw, severity)
+            lkv, lcand = _select_candidates(lkey, kl, stall, goal.is_hard,
+                                            params)
     else:
         lkv, lcand = kv, cand
-    lmask = legit_leadership_mask(env, st, lcand)
-    for g in prev_goals:
-        lmask = lmask & g.accept_leadership(env, st, lcand)
-    # [KL, F] score fusion in the compute dtype; acceptance masks above and
-    # the sequential re-score fallback below stay on the true f32 state
-    lscore = goal.leadership_score(env_sc, st_sw, lcand)
-    lscore = jnp.where(lmask & (lkv > NEG_INF)[:, None], lscore, NEG_INF)
-    best_val = jnp.max(lscore, axis=1)
+    KL = lcand.shape[0]
+
+    def _lead_rows(lcand_l: Array, lkv_l: Array):
+        """[*, F] leadership masking + scoring + per-row best — the O(KL*F)
+        stage, shard-local under the mesh (rows vs full replicated state)."""
+        m = legit_leadership_mask(env, st, lcand_l)
+        for g in prev_goals:
+            m = m & g.accept_leadership(env, st, lcand_l)
+        # [KL, F] score fusion in the compute dtype; acceptance masks above
+        # and the sequential re-score fallback stay on the true f32 state
+        sc = goal.leadership_score(env_sc, st_sw, lcand_l)
+        sc = jnp.where(m & (lkv_l > NEG_INF)[:, None], sc, NEG_INF)
+        return jnp.max(sc, axis=1), jnp.argmax(sc, axis=1).astype(jnp.int32)
+
+    if mesh is not None:
+        from cruise_control_tpu.parallel import shard_ops
+        best_val, f_all = shard_ops.rows_sharded(
+            mesh, _lead_rows, (lcand, lkv), (jnp.int32(0), NEG_INF))
+    else:
+        best_val, f_all = _lead_rows(lcand, lkv)
     order = jnp.argsort(-best_val)
-    KL = lscore.shape[0]
 
     def seq_body(i, carry):
         """Re-score one candidate row against the live state and apply."""
@@ -882,7 +990,7 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
     # ---- budgeted wave ----
     posn = jnp.arange(KL, dtype=jnp.int32)
     r_sorted = lcand[order]
-    f_best = jnp.argmax(lscore, axis=1)[order]
+    f_best = f_all[order]
     members = env.partition_replicas[env.replica_partition[r_sorted]]
     dst_rep = jnp.clip(members[posn, f_best], 0)
     val_s = best_val[order]
@@ -939,23 +1047,45 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     k = min(params.num_swap_candidates, env.num_replicas, 128)
     env_sc = env_sw if env_sw is not None else env
     st_sw = _sweep_state(st, params) if env_sw is not None else st
-    okey = goal.swap_out_key(env_sc, st_sw, severity)
-    ikey = goal.swap_in_key(env_sc, st_sw, severity)
-    okv, cand_out = _select_candidates(okey, k, stall, goal.is_hard, params)
-    ikv, cand_in = _select_candidates(ikey, k, stall, goal.is_hard, params,
-                                      salt=101)   # decorrelate from okey
-    mask = legit_swap_mask(env, st, cand_out, cand_in)
-    for g in prev_goals:
-        mask = mask & g.accept_swap(env, st, cand_out, cand_in)
-    # [K1, K2] pair scoring in the compute dtype; acceptance + admission +
-    # the batched apply stay on the true f32 state
-    score = goal.swap_score(env_sc, st_sw, cand_out, cand_in)
-    score = jnp.where(mask & (okv > NEG_INF)[:, None] & (ikv > NEG_INF)[None, :],
-                      score, NEG_INF)
-    K1, K2 = score.shape
+    mesh = _engine_mesh(params)
+    if mesh is not None and env.num_replicas % int(mesh.devices.size) == 0:
+        okv, cand_out = _sharded_key_select(
+            mesh, lambda e, s: goal.swap_out_key(e, s, severity),
+            env_sc, st_sw, k, stall)
+        ikv, cand_in = _sharded_key_select(
+            mesh, lambda e, s: goal.swap_in_key(e, s, severity),
+            env_sc, st_sw, k, stall, salt=101)   # decorrelate from okey
+    else:
+        okey = goal.swap_out_key(env_sc, st_sw, severity)
+        ikey = goal.swap_in_key(env_sc, st_sw, severity)
+        okv, cand_out = _select_candidates(okey, k, stall, goal.is_hard,
+                                           params)
+        ikv, cand_in = _select_candidates(ikey, k, stall, goal.is_hard,
+                                          params, salt=101)
+    K1 = cand_out.shape[0]
+    K2 = cand_in.shape[0]
 
-    best_j = jnp.argmax(score, axis=1).astype(jnp.int32)          # [K1]
-    best_val = score[jnp.arange(K1), best_j]
+    def _swap_rows(co_l: Array, okv_l: Array):
+        """[*, K2] pair masking + scoring + per-row best counterparty — the
+        O(K1*K2) stage, shard-local over the OUT rows under the mesh (the
+        full in-candidate list rides replicated by closure)."""
+        m = legit_swap_mask(env, st, co_l, cand_in)
+        for g in prev_goals:
+            m = m & g.accept_swap(env, st, co_l, cand_in)
+        # [K1, K2] pair scoring in the compute dtype; acceptance + admission
+        # + the batched apply stay on the true f32 state
+        sc = goal.swap_score(env_sc, st_sw, co_l, cand_in)
+        sc = jnp.where(m & (okv_l > NEG_INF)[:, None]
+                       & (ikv > NEG_INF)[None, :], sc, NEG_INF)
+        bj = jnp.argmax(sc, axis=1).astype(jnp.int32)
+        return sc[jnp.arange(co_l.shape[0]), bj], bj
+
+    if mesh is not None:
+        from cruise_control_tpu.parallel import shard_ops
+        best_val, best_j = shard_ops.rows_sharded(
+            mesh, _swap_rows, (cand_out, okv), (jnp.int32(0), NEG_INF))
+    else:
+        best_val, best_j = _swap_rows(cand_out, okv)
     order = jnp.argsort(-best_val)
     posn = jnp.arange(K1, dtype=jnp.int32)
     r_out = cand_out[order]
@@ -1014,15 +1144,32 @@ def _disk_move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel
     re-score (_rescore_disk_move_row) re-validates in f32."""
     env_sc = env_sw if env_sw is not None else env
     st_sw = _sweep_state(st, params) if env_sw is not None else st
-    key = _stall_explore(goal.replica_key(env_sc, st_sw, severity), stall)
-    kv, cand = _top_candidates(key, min(params.num_candidates, env.num_replicas),
-                               exact=goal.is_hard)
-    mask = legit_disk_move_mask(env, st, cand)
-    for g in prev_goals:
-        mask = mask & g.accept_disk_move(env, st, cand)
-    score = goal.disk_move_score(env_sc, st_sw, cand)
-    score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
-    best_val = jnp.max(score, axis=1)
+    mesh = _engine_mesh(params)
+    kd = min(params.num_candidates, env.num_replicas)
+    if mesh is not None and env.num_replicas % int(mesh.devices.size) == 0:
+        kv, cand = _sharded_key_select(
+            mesh, lambda e, s: goal.replica_key(e, s, severity),
+            env_sc, st_sw, kd, stall)
+    else:
+        key = _stall_explore(goal.replica_key(env_sc, st_sw, severity), stall)
+        kv, cand = _top_candidates(key, kd, exact=goal.is_hard)
+
+    def _disk_rows(cand_l: Array, kv_l: Array):
+        """[*, D] disk masking + scoring + per-row best — shard-local under
+        the mesh; the sequential applies below re-validate in f32 anyway."""
+        m = legit_disk_move_mask(env, st, cand_l)
+        for g in prev_goals:
+            m = m & g.accept_disk_move(env, st, cand_l)
+        sc = goal.disk_move_score(env_sc, st_sw, cand_l)
+        sc = jnp.where(m & (kv_l > NEG_INF)[:, None], sc, NEG_INF)
+        return (jnp.max(sc, axis=1),)
+
+    if mesh is not None:
+        from cruise_control_tpu.parallel import shard_ops
+        (best_val,) = shard_ops.rows_sharded(
+            mesh, _disk_rows, (cand, kv), (jnp.int32(0), NEG_INF))
+    else:
+        (best_val,) = _disk_rows(cand, kv)
     order = jnp.argsort(-best_val)
 
     def body(i, carry):
@@ -1035,7 +1182,7 @@ def _disk_move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel
         st = apply_disk_move(env, st, r, d, enabled=ok)
         return st, n_applied + ok.astype(jnp.int32)
 
-    K = score.shape[0]
+    K = cand.shape[0]
     n_pos = jnp.sum(best_val > params.min_gain).astype(jnp.int32)
     st, n_applied = jax.lax.fori_loop(0, jnp.minimum(n_pos, K), body,
                                       (st, jnp.int32(0)))
@@ -1057,7 +1204,7 @@ def _compact_eligible(eligible: Array, pad_len: int):
 
 def _exhaustive_move_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                           prev_goals: tuple, chunk: int,
-                          chain_cache: bool = True):
+                          chain_cache: bool = True, mesh=None):
     """(gain f32[R], dst i32[R]) — every replica's best single-move gain
     over ALL destinations under full legitimacy + chain acceptance (NEG_INF
     where none exists). Unlike the budgeted passes' top-K windows this scan
@@ -1087,10 +1234,11 @@ def _exhaustive_move_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
             g for g in prev_goals
             if type(g).accept_move is not GoalKernel.accept_move)
 
-    def body(i, carry):
-        gain, dst = carry
-        base = i * chunk
-        idx = jax.lax.dynamic_slice(order, (base,), (chunk,))
+    def rows(idx):
+        """(v f32[chunk], d i32[chunk]) for one block of global row ids —
+        the whole per-chunk [chunk, B] sweep; shared verbatim by the
+        sequential loop and the mesh's shard-local scan, so sharded and
+        unsharded certificate values are bitwise identical."""
         cand = jnp.minimum(idx, R - 1)
         mask = legit_move_mask(env, st, cand, goal.options)
         mask = mask & (idx < R)[:, None]     # sentinel / padded rows
@@ -1101,7 +1249,18 @@ def _exhaustive_move_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
             mask = mask & g.accept_move(env, st, cand)
         score = jnp.where(mask, goal.move_score(env, st, cand), NEG_INF)
         d = jnp.argmax(score, axis=1).astype(jnp.int32)
-        v = score[jnp.arange(chunk), d]
+        return score[jnp.arange(chunk), d], d
+
+    if mesh is not None:
+        # shard-explicit: each device sweeps its striped share of the
+        # eligible rows; one pmax merges the single-writer-per-row buffers
+        from cruise_control_tpu.parallel import shard_ops
+        return shard_ops.scan_sharded(mesh, rows, order, n_eligible, chunk, R)
+
+    def body(i, carry):
+        gain, dst = carry
+        idx = jax.lax.dynamic_slice(order, (i * chunk,), (chunk,))
+        v, d = rows(idx)
         # rows are scattered replica ids now — write back by id (sentinel
         # rows index R -> dropped)
         gain = gain.at[idx].set(v, mode="drop")
@@ -1115,21 +1274,19 @@ def _exhaustive_move_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
 
 def _exhaustive_lead_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-                          prev_goals: tuple, chunk: int):
+                          prev_goals: tuple, chunk: int, mesh=None):
     """(gain f32[R], dst_rep i32[R]) — every leader's best leadership-
     transfer gain over ALL its followers (exhaustive analogue of the
     [KL, F] leadership branch). Compacted over the goal's leader-key
-    eligible set exactly like `_exhaustive_move_scan`."""
+    eligible set exactly like `_exhaustive_move_scan`, and shard-local on a
+    mesh the same way."""
     R = env.num_replicas
     chunk = min(chunk, R)
     # same eligibility contract as the move scan, via the goal's leader key
     eligible = goal.leader_key(env, st, goal.broker_severity(env, st)) > NEG_INF
     order, n_eligible = _compact_eligible(eligible, -(-R // chunk) * chunk)
 
-    def body(i, carry):
-        gain, dst = carry
-        base = i * chunk
-        idx = jax.lax.dynamic_slice(order, (base,), (chunk,))
+    def rows(idx):
         cand = jnp.minimum(idx, R - 1)
         mask = legit_leadership_mask(env, st, cand)
         mask = mask & (idx < R)[:, None]
@@ -1140,6 +1297,16 @@ def _exhaustive_lead_scan(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         v = score[jnp.arange(chunk), f]
         members = env.partition_replicas[env.replica_partition[cand]]
         d = jnp.clip(members[jnp.arange(chunk), f], 0)
+        return v, d
+
+    if mesh is not None:
+        from cruise_control_tpu.parallel import shard_ops
+        return shard_ops.scan_sharded(mesh, rows, order, n_eligible, chunk, R)
+
+    def body(i, carry):
+        gain, dst = carry
+        idx = jax.lax.dynamic_slice(order, (i * chunk,), (chunk,))
+        v, d = rows(idx)
         gain = gain.at[idx].set(v, mode="drop")
         dst = dst.at[idx].set(d, mode="drop")
         return gain, dst
@@ -1160,17 +1327,39 @@ def _swap_window_positives(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     nothing' is the matching claim."""
     severity = goal.broker_severity(env, st)
     k = min(params.num_swap_candidates, env.num_replicas, 128)
-    okv, cand_out = _top_candidates(goal.swap_out_key(env, st, severity), k,
-                                    exact=goal.is_hard)
-    ikv, cand_in = _top_candidates(goal.swap_in_key(env, st, severity), k,
-                                   exact=goal.is_hard)
-    mask = legit_swap_mask(env, st, cand_out, cand_in)
-    for g in prev_goals:
-        mask = mask & g.accept_swap(env, st, cand_out, cand_in)
-    score = goal.swap_score(env, st, cand_out, cand_in)
-    score = jnp.where(mask & (okv > NEG_INF)[:, None] & (ikv > NEG_INF)[None, :],
-                      score, NEG_INF)
-    return jnp.sum(score > params.min_gain).astype(jnp.int32)
+    mesh = _engine_mesh(params)
+    if mesh is not None and env.num_replicas % int(mesh.devices.size) == 0:
+        # shard-explicit: unsalted sharded keyings + the [K1, K2] window
+        # counted per OUT row shard-locally; the int row-count sum is exact
+        # in any order, so the certificate clause is bit-identical
+        okv, cand_out = _sharded_key_select(
+            mesh, lambda e, s: goal.swap_out_key(e, s, severity),
+            env, st, k, jnp.int32(0), salted=False)
+        ikv, cand_in = _sharded_key_select(
+            mesh, lambda e, s: goal.swap_in_key(e, s, severity),
+            env, st, k, jnp.int32(0), salted=False)
+    else:
+        okv, cand_out = _top_candidates(goal.swap_out_key(env, st, severity),
+                                        k, exact=goal.is_hard)
+        ikv, cand_in = _top_candidates(goal.swap_in_key(env, st, severity),
+                                       k, exact=goal.is_hard)
+
+    def _window_rows(co_l: Array, okv_l: Array):
+        m = legit_swap_mask(env, st, co_l, cand_in)
+        for g in prev_goals:
+            m = m & g.accept_swap(env, st, co_l, cand_in)
+        sc = goal.swap_score(env, st, co_l, cand_in)
+        sc = jnp.where(m & (okv_l > NEG_INF)[:, None]
+                       & (ikv > NEG_INF)[None, :], sc, NEG_INF)
+        return (jnp.sum(sc > params.min_gain, axis=1).astype(jnp.int32),)
+
+    if mesh is not None:
+        from cruise_control_tpu.parallel import shard_ops
+        (counts,) = shard_ops.rows_sharded(
+            mesh, _window_rows, (cand_out, okv), (jnp.int32(0), NEG_INF))
+    else:
+        (counts,) = _window_rows(cand_out, okv)
+    return jnp.sum(counts).astype(jnp.int32)
 
 
 def _segment_broker_order(env: ClusterEnv, st: EngineState, goal: GoalKernel,
@@ -1232,32 +1421,46 @@ def _segment_move_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     K = cand.shape[0]
     B = env.num_brokers
     S = max(2, min(params.max_finisher_segments, B))
-    mask = legit_move_mask(env, st, cand, goal.options)
     d_rows = _move_delta_rows(env, st, cand)                      # [K, 8]
     src_b = st.replica_broker[cand]
     if params.chain_cache:
         rooms, custom = _combined_move_rooms(prev_goals, env, st)
-        if rooms:
-            mask = mask & _rooms_move_mask(rooms, d_rows, src_b)
     else:
+        rooms = {}
         custom = tuple(g for g in prev_goals
                        if type(g).accept_move is not GoalKernel.accept_move)
-    for g in custom:
-        mask = mask & g.accept_move(env, st, cand)
-    score = goal.move_score(env, st, cand)         # finisher: exact f32
-    score = jnp.where(mask & (kv > NEG_INF)[:, None], score, NEG_INF)
-
     # per-segment best destination via the room-ordered strided view:
-    # ordered column q*S + s belongs to segment s
+    # ordered column q*S + s belongs to segment s. The coloring itself is
+    # [B]-level (replicated under the mesh); the O(K*B) mask/score/argmax
+    # stage below is shard-local over candidate rows.
     order_b = _segment_broker_order(env, st, goal, prev_goals, params, S)
     Bp = order_b.shape[0]
-    scp = (jnp.pad(score, ((0, 0), (0, Bp - B)), constant_values=NEG_INF)
-           if Bp > B else score)
-    scp = scp[:, order_b]                                         # [K, Bp]
-    seg_view = scp.reshape(K, Bp // S, S)
-    q_best = jnp.argmax(seg_view, axis=1).astype(jnp.int32)       # [K, S]
-    vals = jnp.take_along_axis(seg_view, q_best[:, None, :],
-                               axis=1)[:, 0, :]                   # [K, S]
+
+    def _seg_move_rows(cand_l: Array, kv_l: Array):
+        mask = legit_move_mask(env, st, cand_l, goal.options)
+        if rooms:
+            mask = mask & _rooms_move_mask(
+                rooms, _move_delta_rows(env, st, cand_l),
+                st.replica_broker[cand_l])
+        for g in custom:
+            mask = mask & g.accept_move(env, st, cand_l)
+        sc = goal.move_score(env, st, cand_l)      # finisher: exact f32
+        sc = jnp.where(mask & (kv_l > NEG_INF)[:, None], sc, NEG_INF)
+        scp = (jnp.pad(sc, ((0, 0), (0, Bp - B)), constant_values=NEG_INF)
+               if Bp > B else sc)
+        scp = scp[:, order_b]                                     # [k, Bp]
+        seg_view = scp.reshape(cand_l.shape[0], Bp // S, S)
+        q = jnp.argmax(seg_view, axis=1).astype(jnp.int32)        # [k, S]
+        v = jnp.take_along_axis(seg_view, q[:, None, :], axis=1)[:, 0, :]
+        return v, q
+
+    mesh = _engine_mesh(params)
+    if mesh is not None:
+        from cruise_control_tpu.parallel import shard_ops
+        vals, q_best = shard_ops.rows_sharded(
+            mesh, _seg_move_rows, (cand, kv), (jnp.int32(0), NEG_INF))
+    else:
+        vals, q_best = _seg_move_rows(cand, kv)                   # [K, S]
     dsts = order_b[q_best * S + jnp.arange(S, dtype=jnp.int32)[None, :]]
     # active segment count is a traced budget leaf: inactive segments' rows
     # mask to -inf (same compiled program for any setting)
@@ -1319,32 +1522,40 @@ def _segment_lead_wave(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     KL = cand.shape[0]
     B = env.num_brokers
     S = max(2, min(params.max_finisher_segments, B))
-    lmask = legit_leadership_mask(env, st, cand)
-    for g in prev_goals:
-        lmask = lmask & g.accept_leadership(env, st, cand)
-    lscore = goal.leadership_score(env, st, cand)  # finisher: exact f32
-    lscore = jnp.where(lmask & (kv > NEG_INF)[:, None], lscore, NEG_INF)
-    members = env.partition_replicas[env.replica_partition[cand]]  # [KL, F]
-    dst_rep_all = jnp.clip(members, 0)
-    dst_broker_all = st.replica_broker[dst_rep_all]                # [KL, F]
-
     order_b = _segment_broker_order(env, st, goal, prev_goals, params, S)
     Bp = order_b.shape[0]
     colrank = (jnp.zeros(Bp, jnp.int32)
                .at[order_b].set(jnp.arange(Bp, dtype=jnp.int32)))
     color = colrank % S                                            # [Bp]
-    seg_of = color[dst_broker_all]                                 # [KL, F]
     active = jnp.clip(params.finisher_segments, 1, S)
-    rows_v, rows_f = [], []
-    posn_k = jnp.arange(KL)
-    for s in range(S):              # S static, F small: S masked argmaxes
-        ms = jnp.where(seg_of == s, lscore, NEG_INF)
-        f = jnp.argmax(ms, axis=1).astype(jnp.int32)
-        v = jnp.where(s < active, ms[posn_k, f], NEG_INF)
-        rows_v.append(v)
-        rows_f.append(f)
-    vals = jnp.stack(rows_v, axis=1)                               # [KL, S]
-    fbest = jnp.stack(rows_f, axis=1)                              # [KL, S]
+
+    def _seg_lead_rows(cand_l: Array, kv_l: Array):
+        m = legit_leadership_mask(env, st, cand_l)
+        for g in prev_goals:
+            m = m & g.accept_leadership(env, st, cand_l)
+        sc = goal.leadership_score(env, st, cand_l)  # finisher: exact f32
+        sc = jnp.where(m & (kv_l > NEG_INF)[:, None], sc, NEG_INF)
+        mem = env.partition_replicas[env.replica_partition[cand_l]]  # [k, F]
+        seg_of = color[st.replica_broker[jnp.clip(mem, 0)]]          # [k, F]
+        rows_v, rows_f = [], []
+        posn_k = jnp.arange(cand_l.shape[0])
+        for s in range(S):          # S static, F small: S masked argmaxes
+            ms = jnp.where(seg_of == s, sc, NEG_INF)
+            f = jnp.argmax(ms, axis=1).astype(jnp.int32)
+            v = jnp.where(s < active, ms[posn_k, f], NEG_INF)
+            rows_v.append(v)
+            rows_f.append(f)
+        return jnp.stack(rows_v, axis=1), jnp.stack(rows_f, axis=1)
+
+    mesh = _engine_mesh(params)
+    if mesh is not None:
+        from cruise_control_tpu.parallel import shard_ops
+        vals, fbest = shard_ops.rows_sharded(
+            mesh, _seg_lead_rows, (cand, kv), (jnp.int32(0), NEG_INF))
+    else:
+        vals, fbest = _seg_lead_rows(cand, kv)                     # [KL, S]
+    members = env.partition_replicas[env.replica_partition[cand]]  # [KL, F]
+    dst_rep_all = jnp.clip(members, 0)
 
     KS = KL * S
     k_of = jnp.repeat(jnp.arange(KL, dtype=jnp.int32), S)
@@ -1492,7 +1703,8 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         if use_moves:
             gain, _ = _exhaustive_move_scan(env, st, goal, prev_goals,
                                             params.scan_chunk,
-                                            chain_cache=params.chain_cache)
+                                            chain_cache=params.chain_cache,
+                                            mesh=_engine_mesh(params))
             mleft = jnp.sum(gain > params.min_gain).astype(jnp.int32)
             st, n, nb = _finisher_wave(env, st, goal, prev_goals, params,
                                        gain, leadership=False)
@@ -1500,7 +1712,8 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
             bnd += nb
         if use_leads:
             gain, _ = _exhaustive_lead_scan(env, st, goal, prev_goals,
-                                            params.scan_chunk)
+                                            params.scan_chunk,
+                                            mesh=_engine_mesh(params))
             lleft = jnp.sum(gain > params.min_gain).astype(jnp.int32)
             st, n, nb = _finisher_wave(env, st, goal, prev_goals, params,
                                        gain, leadership=True)

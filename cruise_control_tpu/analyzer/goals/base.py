@@ -33,6 +33,7 @@ GoalUtils.filterReplicas.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 
 import jax
@@ -289,6 +290,27 @@ def broker_lookup(rb: Array, *cols: Array) -> Array:
     return table[rb][:, :k]
 
 
+# Shard-explicit keying hook (parallel/shard_ops.py): while a replica-sharded
+# keying body traces, this holds the shard's GLOBAL replica-id offset
+# (axis_index * R_local, a traced uint32 scalar) so that index-hashed helpers
+# — spread_jitter is the only one — reconstruct global ids from local iotas
+# and produce bit-identical values to the unsharded sweep's slice. None
+# outside a sharded keying region (the default, zero-cost path).
+_REPLICA_SHARD_OFFSET = None
+
+
+@contextlib.contextmanager
+def replica_shard_offset(offset):
+    """Publish the global replica-id offset of the shard being traced."""
+    global _REPLICA_SHARD_OFFSET
+    prev = _REPLICA_SHARD_OFFSET
+    _REPLICA_SHARD_OFFSET = offset
+    try:
+        yield
+    finally:
+        _REPLICA_SHARD_OFFSET = prev
+
+
 def spread_jitter(num_replicas: int, dtype=jnp.float32) -> Array:
     """[R] deterministic per-replica multiplier in [0.5, 1.0) used to mix
     candidate keys ACROSS brokers. Count-goal keys of the form
@@ -298,8 +320,16 @@ def spread_jitter(num_replicas: int, dtype=jnp.float32) -> Array:
     key by a hash-derived factor gives every broker top-k representation
     roughly proportional to its candidate count while still preferring
     lighter replicas. Pure elementwise — no gathers. ``dtype`` follows the
-    caller's compute dtype so a bf16 key sweep stays bf16 end to end."""
-    h = (jnp.arange(num_replicas, dtype=jnp.uint32) * jnp.uint32(2654435761))
+    caller's compute dtype so a bf16 key sweep stays bf16 end to end.
+
+    The hash input is the GLOBAL replica id: inside a replica-sharded keying
+    (shard_ops.replica_key_select) ``num_replicas`` is the LOCAL shard size
+    and the published shard offset re-bases the iota, so sharded and
+    unsharded sweeps hash identical ids."""
+    idx = jnp.arange(num_replicas, dtype=jnp.uint32)
+    if _REPLICA_SHARD_OFFSET is not None:
+        idx = idx + _REPLICA_SHARD_OFFSET
+    h = idx * jnp.uint32(2654435761)
     return (0.5 + (h >> 9).astype(jnp.float32) / jnp.float32(1 << 24)) \
         .astype(dtype)
 

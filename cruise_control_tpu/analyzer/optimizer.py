@@ -291,6 +291,15 @@ class GoalOptimizer:
         # tpu.mesh.axis.brokers: >1 shards the chain over a device mesh
         self._mesh_axis_brokers = (config.get_int("tpu.mesh.axis.brokers")
                                    if config is not None else 1)
+        # tpu.shard.map (default on): with a mesh, run the SHARD-EXPLICIT
+        # engine — broker state replicated, candidate/replica row axes
+        # shard_map'd, one small all-gather per admission wave
+        # (parallel/shard_ops.py; bit-identical to single-device). Off
+        # restores the legacy annotate-inputs GSPMD placement
+        # (shard_cluster), kept for A/B and the v1 placement tests.
+        self._shard_map = (config.get_boolean("tpu.shard.map")
+                           if config is not None else True)
+        self._mesh = None     # built lazily on first sharded optimization
         # analyzer.finisher.min.replicas: below this, goal programs compile
         # without the finisher subprogram (certificates at small scale are
         # covered by the host-side plateau-fixpoint proof; the subprogram
@@ -530,6 +539,11 @@ class GoalOptimizer:
             compute_dtype=_resolve_compute_dtype(
                 self._params.compute_dtype, self._compute_dtype,
                 num_replicas))
+        if session is not None and getattr(session, "mesh", None) is not None:
+            # shard-aware resident session: the resident env/state are
+            # already mesh-placed (replicated) — thread the session's mesh
+            # into the engine so the shard-explicit kernels run on it
+            params = dataclasses.replace(params, mesh=session.mesh)
 
         if session is None:
             tml = self._min_leader_mask(meta, min_leader_topic_pattern)
@@ -545,12 +559,21 @@ class GoalOptimizer:
             st = init_state(env, ct.replica_broker, ct.replica_is_leader,
                             ct.replica_offline, ct.replica_disk)
             if self._mesh_axis_brokers > 1:
-                # tpu.mesh.axis.brokers: place env+state on an n-device mesh
-                # so the same chain runs GSPMD-sharded (parallel/sharding.py;
-                # the multichip dryrun drives this path with virtual devices)
                 from cruise_control_tpu.parallel import make_mesh, shard_cluster
-                mesh = make_mesh(self._mesh_axis_brokers)
-                env, st = shard_cluster(env, st, mesh)
+                from cruise_control_tpu.parallel.sharding import replicate
+                if self._mesh is None:
+                    self._mesh = make_mesh(self._mesh_axis_brokers)
+                if self._shard_map:
+                    # shard-explicit engine (default): broker-level state
+                    # replicated on the mesh, the engine's row-axis kernels
+                    # shard_map'd (EngineParams.mesh) — sharded results are
+                    # bit-identical to the single-device program
+                    env, st = replicate(env, self._mesh), replicate(st, self._mesh)
+                    params = dataclasses.replace(params, mesh=self._mesh)
+                else:
+                    # legacy v1: place data, let GSPMD insert collectives
+                    # (semantically equivalent, not bit-identical)
+                    env, st = shard_cluster(env, st, self._mesh)
             # the initial assignment is exactly what init_state was given —
             # take the host copies instead of a ~6 MB device round-trip
             # (pad_cluster returns numpy; np.asarray is free there)

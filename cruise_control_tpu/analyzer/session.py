@@ -197,7 +197,7 @@ class ResidentClusterSession:
     come back via the backend and the next sync's deltas.
     """
 
-    def __init__(self, monitor, config=None):
+    def __init__(self, monitor, config=None, mesh=None):
         self._monitor = monitor
         if config is not None:
             self._max_delta_fraction = config.get_double(
@@ -208,12 +208,28 @@ class ResidentClusterSession:
                 "topics.with.min.leaders.per.broker")
             self._donation = config.get_boolean("analyzer.session.donation")
             self._compact = config.get_boolean("analyzer.compact.tables")
+            # shard-aware residency: with a shard-explicit mesh configured
+            # (tpu.mesh.axis.brokers > 1, tpu.shard.map on) the resident
+            # env/state live REPLICATED on the mesh — chosen here at session
+            # creation so every epoch (and every delta round's uploads) land
+            # with the same placement and steady rounds never re-shard; the
+            # optimizer threads session.mesh into EngineParams.mesh.
+            if mesh is None and config.get_boolean("tpu.shard.map"):
+                n = config.get_int("tpu.mesh.axis.brokers")
+                if n > 1:
+                    from cruise_control_tpu.parallel import make_mesh
+                    mesh = make_mesh(n)
         else:
             self._max_delta_fraction = DEFAULT_MAX_DELTA_FRACTION
             self._excluded_pattern = ""
             self._min_leader_pattern = ""
             self._donation = True
             self._compact = True
+        self.mesh = mesh
+        self._sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._sharding = NamedSharding(mesh, PartitionSpec())
         self.lock = threading.RLock()
         # resident device state + host companions
         self.env = None
@@ -329,6 +345,16 @@ class ResidentClusterSession:
             return {"env_bytes": tree_device_bytes(self.env),
                     "state_bytes": tree_device_bytes(self.state)}
 
+    # ------------------------------------------------- device placement
+    def _put(self, a):
+        """Host->device upload honoring the session's placement: replicated
+        on the shard-explicit mesh when one is configured (every resident
+        leaf and every per-round upload shares it — a steady delta round
+        moves ZERO re-shard bytes), plain device_put otherwise."""
+        if self._sharding is not None:
+            return jax.device_put(a, self._sharding)
+        return jnp.asarray(a)
+
     # ------------------------------------------------- state materialization
     def _ensure_state(self) -> None:
         """Rematerialize the resident state from the host mirrors if the
@@ -341,9 +367,9 @@ class ResidentClusterSession:
         leadership bit-packed) + load rows -> fresh resident (env, state)."""
         b_dt, d_dt, _ = state_index_dtypes(self.env)
         h = self._h
-        broker = jnp.asarray(h["replica_broker"].astype(b_dt))
-        disk = jnp.asarray(h["replica_disk"].astype(d_dt))
-        lead_packed = jnp.asarray(np.packbits(h["replica_is_leader"]))
+        broker = self._put(h["replica_broker"].astype(b_dt))
+        disk = self._put(h["replica_disk"].astype(d_dt))
+        lead_packed = self._put(np.packbits(h["replica_is_leader"]))
         self.env, self.state = _sync_finalize(
             self.env, broker, lead_packed, disk, leader_rows, follower_rows)
 
@@ -390,6 +416,13 @@ class ResidentClusterSession:
         tml = self._tml_mask(meta, ct.num_topics)
         env = make_env(ct, meta, topic_min_leaders_mask=tml,
                        partition_table=part_table, compact=self._compact)
+        if self._sharding is not None:
+            # shard-aware residency: the epoch's env moves onto the mesh
+            # BEFORE the prewarm scatters below, so the delta programs
+            # compile once for the mesh placement and steady rounds reuse
+            # them with zero re-shard transfers (epoch fallback re-places
+            # by construction — it passes through here)
+            env = jax.device_put(env, self._sharding)
         # pre-warm the env delta programs for this epoch's shapes with no-op
         # scatters (all indices out of bounds -> dropped): steady rounds —
         # including their FIRST real churn — then run with ZERO new XLA
@@ -541,7 +574,7 @@ class ResidentClusterSession:
             # dtype and force engine recompiles)
             self.env = dataclasses.replace(
                 self.env,
-                **{name: jnp.asarray(np.asarray(a).astype(
+                **{name: self._put(np.asarray(a).astype(
                     getattr(self.env, name).dtype))
                    for name, a in changed.items()})
         return None
@@ -644,6 +677,6 @@ class ResidentClusterSession:
         foll_p = np.zeros((Rp, foll.shape[1]), np.float32)
         lead_p[:Rv] = lead
         foll_p[:Rv] = foll
-        lead_dev = jax.device_put(lead_p)
-        foll_dev = jax.device_put(foll_p)
+        lead_dev = self._put(lead_p)
+        foll_dev = self._put(foll_p)
         self._materialize(lead_dev, foll_dev)

@@ -115,11 +115,15 @@ class CruiseControl:
         # optimize rounds — the steady-state precompute and self-healing FIX
         # rounds skip the snapshot->pad->upload rebuild (the reference's
         # continuously-updated ClusterModel role, GoalOptimizer.java:139-339).
-        # Disabled under a sharded mesh: the session pins single-device
-        # placement.
+        # Under a SHARD-EXPLICIT mesh (tpu.shard.map, the default) the
+        # session is shard-aware: resident state lives replicated on the
+        # mesh and the optimizer runs the shard_map engine from it. Only the
+        # legacy GSPMD placement mode (tpu.shard.map=false) still pins
+        # single-device sessions off.
         self.resident_session = None
         if (self.config.get_boolean("analyzer.resident.session.enabled")
-                and self.config.get_int("tpu.mesh.axis.brokers") <= 1):
+                and (self.config.get_int("tpu.mesh.axis.brokers") <= 1
+                     or self.config.get_boolean("tpu.shard.map"))):
             from cruise_control_tpu.analyzer.session import ResidentClusterSession
             self.resident_session = ResidentClusterSession(
                 self.load_monitor, config=self.config)

@@ -822,6 +822,14 @@ for _ep in _EndPoint:
 # --------------------------------------------------------------------------
 _D.define(name="tpu.mesh.axis.brokers", type=Type.INT, default=1, validator=at_least(1),
           doc="Device-mesh size along the candidate-destination (broker) axis for sharded scoring.")
+_D.define(name="tpu.shard.map", type=Type.BOOLEAN, default=True,
+          doc="With tpu.mesh.axis.brokers > 1: run the SHARD-EXPLICIT engine "
+              "(broker state replicated on the mesh, candidate/replica row "
+              "axes shard_map'd with one small all-gather per admission "
+              "wave; results bit-identical to single-device — "
+              "parallel/shard_ops.py). False restores the legacy "
+              "annotate-inputs GSPMD placement (shard_cluster), which is "
+              "only semantically equivalent.")
 _D.define(name="jax.compilation.cache.dir", type=Type.STRING,
           default="/tmp/jax_cache_cc_tpu",
           doc="Persistent XLA compilation cache directory, applied at "

@@ -1,5 +1,8 @@
 from cruise_control_tpu.parallel.sharding import (
-    BROKER_AXIS, make_mesh, shard_cluster,
+    BROKER_AXIS, count_collectives, committed_per_device_bytes, make_mesh,
+    replicate, shard_cluster,
 )
+from cruise_control_tpu.parallel import shard_ops
 
-__all__ = ["BROKER_AXIS", "make_mesh", "shard_cluster"]
+__all__ = ["BROKER_AXIS", "count_collectives", "committed_per_device_bytes",
+           "make_mesh", "replicate", "shard_cluster", "shard_ops"]

@@ -1,0 +1,220 @@
+"""Shard-explicit engine kernels: ``jax.shard_map`` wrappers over the 1-D
+``Mesh(("brokers",))``.
+
+This is the v2 of the multichip story. v1 (``sharding.py``) only PLACED data
+and hoped GSPMD would insert good collectives — it did, but the inserted
+cross-device float reductions re-ordered sums at the ulp level, so sharded
+runs could only ever be asserted *semantically* equivalent to unsharded runs
+(same verdicts, ~12% tie-break placement diffs — see the old
+``assert_sharded_matches`` notes in __graft_entry__.py). v2 makes the shard
+axis EXPLICIT and chooses a decomposition that is **bit-identical by
+construction**:
+
+- **Broker-level state stays replicated.** Every goal kernel computes its
+  balance limits from global broker reductions (``jnp.sum`` over ``[B]``
+  arrays inside ``_limits``), so per-device broker shards would silently
+  turn those into shard-local sums. ``[B]``/``[B, M]`` state is tiny
+  (~a few MB at 7k brokers) next to the ``[K, B]`` score fusions it feeds;
+  replicating it costs no meaningful HBM and keeps every reduction's
+  operand set — and therefore its bits — identical to the single-device
+  program.
+- **The row axes the engine owns are sharded.** Candidate rows of the wide
+  score fusions (``[K, B]`` moves, ``[KL, F]`` leadership, ``[K1, K2]``
+  swaps, ``[K, D]`` disk), the compacted row stream of the exhaustive
+  finisher scans, and the O(R) candidate keyings all split across devices.
+  Each device computes its rows from the full replicated env/state — the
+  exact same per-row operations, shapes and reduction orders as the
+  unsharded program — and only per-row RESULTS cross devices: an
+  all-gather of ``[K]``-sized best-value/destination vectors per admission
+  wave, a top-k merge of per-shard candidate lists per keying, and one
+  pmax of the scan's ``[R]`` gain buffer per finisher scan. No cross-device
+  FLOAT ADDITION exists anywhere on the path, which is what makes
+  sharded == unsharded bit-exact (test-certified in tests/test_sharding.py,
+  asserted chain-wide by dryrun stage 4).
+
+Tie-break exactness of the distributed top-k: ``jax.lax.top_k`` breaks value
+ties by lowest index. Per-shard top-k keeps, within each shard, exactly the
+lowest-indexed tied rows; the merge concatenates shards in axis order (so
+position order == global index order within ties) and re-runs top_k — the
+merged (values, indices) are bit-identical to a global top_k. The sharded
+selection is always EXACT; the unsharded path's ``approx_max_k`` for soft
+goals lowers to exact top_k on CPU (bit-identical there) and is a
+0.95-recall approximation on TPU, where sharding is an exactness upgrade —
+the same contract ``compact_keying`` documents.
+
+The keyings need one semantic hook: ``spread_jitter`` (goals/base.py) hashes
+the GLOBAL replica id, so the keying wrapper publishes
+``axis_index * R_local`` via ``base.replica_shard_offset`` while tracing the
+shard body — local iotas then reconstruct global ids and the hash values
+match the unsharded sweep's slice bit for bit.
+
+Engine callers pass body functions that CLOSE OVER the replicated values
+(env, state, params, room tables, severity) — shard_map treats closed-over
+tracers as replicated operands, which is exactly their placement under the
+shard-explicit engine; only the row-sharded operands are explicit arguments.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from cruise_control_tpu.analyzer.goals import base as _goals_base
+from cruise_control_tpu.parallel.sharding import (
+    _ENV_REPLICA_AXES, _STATE_REPLICA_AXES, BROKER_AXIS,
+)
+
+NEG_INF = -jnp.inf
+
+
+def mesh_size(mesh) -> int:
+    return int(mesh.devices.size)
+
+
+def _pad_rows(a, rows: int, fill):
+    if a.shape[0] == rows:
+        return a
+    widths = [(0, rows - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, widths, constant_values=fill)
+
+
+def rows_sharded(mesh, fn, row_args: tuple, row_fills: tuple):
+    """Run ``fn(*rows_local) -> tuple of [rows_local, ...]`` with the leading
+    axis of every ``row_args`` entry sharded across the mesh; everything else
+    the body needs (env, state, params, rooms) is closed over — replicated.
+    Rows pad up to a mesh multiple (``row_fills`` per arg; padded rows must
+    surface as -inf through ``fn``'s own key masking) and outputs slice back
+    to the true row count.
+
+    This is the engine's generic candidate-row decomposition: per-row
+    computation against full replicated state is bitwise what the unsharded
+    [K, ...] fusion computes for those rows, so the concatenated outputs are
+    bit-identical — the only collective is the implicit all-gather of the
+    [K]-sized per-row results at the region boundary."""
+    n = mesh_size(mesh)
+    K = row_args[0].shape[0]
+    Kp = -(-K // n) * n
+    rows = tuple(_pad_rows(a, Kp, f) for a, f in zip(row_args, row_fills))
+    in_specs = tuple(P(BROKER_AXIS) for _ in rows)
+    out = shard_map(fn, mesh=mesh, in_specs=in_specs,
+                    out_specs=P(BROKER_AXIS), check_rep=False)(*rows)
+    return tuple(o[:K] for o in out)
+
+
+# ---------------------------------------------------------------------------
+# replica-sharded candidate keying + distributed exact top-k
+# ---------------------------------------------------------------------------
+def _replica_axis_specs(obj, axes_map: dict):
+    """Spec tree shaped like ``obj`` (a registered-dataclass pytree):
+    replica-dim leaves sharded on their replica axis, everything else —
+    broker tables, membership tables, scalars — replicated."""
+    specs = {}
+    for f in dataclasses.fields(obj):
+        val = getattr(obj, f.name)
+        if not hasattr(val, "ndim"):
+            continue
+        axis = axes_map.get(f.name)
+        if axis is None:
+            specs[f.name] = P()
+        else:
+            parts = [None] * val.ndim
+            parts[axis] = BROKER_AXIS
+            specs[f.name] = P(*parts)
+    return dataclasses.replace(obj, **specs)
+
+
+def replica_key_select(mesh, body_fn, env, st, k: int):
+    """Distributed exact top-k candidate selection over a sharded keying.
+
+    ``body_fn(env_local, st_local, gidx_local) -> f32[R_local]`` computes
+    the (already stall-salted) candidate key for the local replica shard;
+    ``gidx_local`` is the shard's GLOBAL replica ids (i32) for id-dependent
+    salting; severity/stall/goal ride in by closure (replicated).
+    Replica-dim env/state leaves arrive sharded, broker/topic/partition
+    tables replicated, so per-replica key values are bitwise the unsharded
+    sweep's. While the body traces, ``base.replica_shard_offset`` publishes
+    the shard's global-id offset so ``spread_jitter`` hashes global ids.
+
+    Returns ``(kv f32[k], cand i32[k])`` — bit-identical to an exact global
+    ``top_k`` of the full key (see the module docstring's tie-break
+    argument)."""
+    n = mesh_size(mesh)
+    R = env.num_replicas
+    local = R // n
+    k = min(k, R)
+    kk = min(k, local)
+
+    def shard_body(e, s):
+        off = jax.lax.axis_index(BROKER_AXIS).astype(jnp.int32) * local
+        gidx = jnp.arange(local, dtype=jnp.int32) + off
+        with _goals_base.replica_shard_offset(off.astype(jnp.uint32)):
+            key = body_fn(e, s, gidx)
+        kv, pos = jax.lax.top_k(key, kk)
+        return kv, pos.astype(jnp.int32) + off
+
+    env_specs = _replica_axis_specs(env, _ENV_REPLICA_AXES)
+    st_specs = _replica_axis_specs(st, _STATE_REPLICA_AXES)
+    kv_all, gidx_all = shard_map(
+        shard_body, mesh=mesh, in_specs=(env_specs, st_specs),
+        out_specs=P(BROKER_AXIS), check_rep=False)(env, st)
+    # merge: [n * kk] per-shard lists, concatenated in axis order — top_k's
+    # position tie-break is then exactly global-index tie-break
+    kv, pos = jax.lax.top_k(kv_all, k)
+    return kv, gidx_all[pos]
+
+
+# ---------------------------------------------------------------------------
+# striped shard-local exhaustive scans (the finisher's certificate sweeps)
+# ---------------------------------------------------------------------------
+def stripe_rows(order, n: int, chunk: int, sentinel: int):
+    """Re-lay a compacted row stream so contiguous device slices interleave:
+    device d's slice of the striped array is ``order[d::n]``. The compaction
+    packs eligible rows to the FRONT, so contiguous sharding would hand
+    shard 0 all the work; striping balances it to within one row. Pads to a
+    multiple of ``n * chunk`` with ``sentinel`` (whose writes drop)."""
+    L = order.shape[0]
+    Lp = -(-L // (n * chunk)) * (n * chunk)
+    if Lp > L:
+        order = jnp.concatenate(
+            [order, jnp.full(Lp - L, sentinel, order.dtype)])
+    return jnp.swapaxes(order.reshape(Lp // n, n), 0, 1).reshape(Lp)
+
+
+def scan_sharded(mesh, row_fn, order, n_eligible, chunk: int, R: int):
+    """Shard-local exhaustive scan: each device sweeps its striped share of
+    the compacted eligible rows in ``[chunk, B]`` blocks (the same block
+    shape as the unsharded scan, so per-row values are bitwise identical)
+    and scatters into its own full-[R] gain/dst buffers; one ``pmax`` per
+    scan merges them — each row is written by exactly ONE device, and
+    NEG_INF / 0 are max-identities for the unwritten rows (gain init; dst
+    values are >= 0), so the merge is lossless. No cross-device float
+    addition anywhere.
+
+    ``row_fn(idx_chunk) -> (v f32[chunk], d i32[chunk])`` scores one block
+    of global row ids (sentinel ids >= R yield masked rows — the existing
+    scan bodies already handle them); env/state/goal/rooms ride in by
+    closure, replicated. Returns (gain f32[R], dst i32[R]), replicated."""
+    n = mesh_size(mesh)
+    striped = stripe_rows(order, n, chunk, sentinel=R)
+
+    def body(order_l):
+        gain = jnp.full(R, NEG_INF, jnp.float32)
+        dst = jnp.zeros(R, jnp.int32)
+
+        def step(i, carry):
+            g, d = carry
+            idx = jax.lax.dynamic_slice(order_l, (i * chunk,), (chunk,))
+            v, dd = row_fn(idx)
+            return (g.at[idx].set(v, mode="drop"),
+                    d.at[idx].set(dd, mode="drop"))
+
+        per_dev = jnp.maximum(-(-n_eligible // n), 0)
+        trips = jnp.minimum(-(-per_dev // chunk), order_l.shape[0] // chunk)
+        g, d = jax.lax.fori_loop(0, trips, step, (gain, dst))
+        return jax.lax.pmax(g, BROKER_AXIS), jax.lax.pmax(d, BROKER_AXIS)
+
+    return shard_map(body, mesh=mesh, in_specs=(P(BROKER_AXIS),),
+                     out_specs=P(), check_rep=False)(striped)

@@ -26,6 +26,15 @@ instead of replicating it.
 This module only *places* data: the engine code is unchanged — jit propagates
 input shardings through the whole while_loop (GSPMD), which is exactly the
 "annotate shardings, let XLA insert collectives" recipe.
+
+NOTE (PR 9): this GSPMD placement is now the LEGACY mode (``tpu.shard.map``
+off). The default multichip path is the SHARD-EXPLICIT engine in
+``shard_ops.py`` — broker state replicated, the engine's candidate/replica
+row axes shard_map'd, one small all-gather per admission wave — whose results
+are bit-identical to the single-device program (GSPMD's inserted float
+reductions are only semantically equivalent). The placement maps below stay
+the single source of truth for which leaves carry a replica axis; the
+shard-explicit keying reuses them for its in_specs.
 """
 from __future__ import annotations
 
@@ -154,3 +163,46 @@ def per_device_bytes(env: ClusterEnv, st: EngineState, mesh: Mesh,
 
 def replicate(tree, mesh: Mesh):
     return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
+# ---------------------------------------------------------------------------
+# multichip evidence helpers (dryrun_multichip / tools/shard_ab.py)
+# ---------------------------------------------------------------------------
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "collective-permute", "all-to-all")
+
+
+def count_collectives(hlo_text: str) -> dict:
+    """{op: count} of collective-instruction DEFINITIONS in a compiled
+    module's optimized HLO (``compiled.as_text()``) — the measured evidence
+    that the shard-explicit engine's cross-device traffic is the handful of
+    small all-gathers/reduces it claims, not a GSPMD surprise. ``-start``
+    variants count, ``-done`` halves don't (one op, two instructions)."""
+    import re
+    counts = {op: 0 for op in _COLLECTIVE_OPS}
+    defn = re.compile(
+        r"=\s+\S+\s+(" + "|".join(re.escape(op) for op in _COLLECTIVE_OPS)
+        + r")(-start)?\(")
+    for line in hlo_text.splitlines():
+        m = defn.search(line)
+        if m:
+            counts[m.group(1)] += 1
+    counts["total"] = sum(counts.values())
+    return counts
+
+
+def committed_per_device_bytes(tree) -> dict:
+    """{device_id: bytes} actually resident per device for a pytree of
+    committed jax.Arrays (``addressable_shards`` metadata only — no sync, no
+    copies). Replicated leaves count fully on every device; sharded leaves
+    count their shard — the honest per-device footprint of whatever
+    placement (GSPMD-sharded or shard-explicit replicated) is in use."""
+    per = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        for shard in leaf.addressable_shards:
+            d = shard.device.id
+            per[d] = per.get(d, 0) + int(np.prod(shard.data.shape)
+                                         * shard.data.dtype.itemsize)
+    return per

@@ -1,19 +1,29 @@
 """Multi-chip sharding tests on the 8-device virtual CPU mesh (conftest).
 
-Verifies the claims of cruise_control_tpu/parallel/sharding.py: placing the
-broker axis of every env/state tensor across a 1-D ``Mesh(("brokers",))``
-leaves the engine's results IDENTICAL to the unsharded run — jit propagates
-the input shardings through the whole while_loop (GSPMD) and XLA inserts the
-collectives. Reference analogue: the single-JVM thread-pool concurrency of
+Two generations under test:
+
+1. SHARD-EXPLICIT engine (PR 9, the default multichip mode,
+   ``EngineParams.mesh`` + parallel/shard_ops.py): candidate/replica row
+   axes shard_map'd, broker state replicated — results are BIT-IDENTICAL
+   to the single-device program (assignments, violations, certificates),
+   which the tier-1 smoke below asserts on a 2-device mesh and the slow
+   tier re-asserts with finishers on the full 8-device mesh. The
+   shard-aware ResidentClusterSession keeps the resident state mesh-placed
+   across delta rounds with zero new compiles (tier-1).
+2. LEGACY GSPMD placement (``shard_cluster``): placing the broker/replica
+   axes and letting XLA insert collectives. Still shipped
+   (``tpu.shard.map`` off) and still certified — those tests stay in the
+   slow tier (engine-path compile-heavy; the fast tier covers the engine
+   via test_model/test_analyzer_goals/test_optimizer).
+
+Reference analogue: the single-JVM thread-pool concurrency of
 GoalOptimizer.java:114-116 scales out here via the device mesh instead.
 """
+import dataclasses
+
 import jax
 import numpy as np
 import pytest
-
-# engine-path compile-heavy; the fast tier (-m 'not slow') covers the engine via
-# test_model/test_analyzer_goals/test_optimizer
-pytestmark = pytest.mark.slow
 
 from cruise_control_tpu.analyzer import (
     EngineParams, init_state, make_env, optimize_goal,
@@ -21,7 +31,7 @@ from cruise_control_tpu.analyzer import (
 from cruise_control_tpu.analyzer.goals import make_goal
 from cruise_control_tpu.model.builder import ClusterModelBuilder
 from cruise_control_tpu.parallel import BROKER_AXIS, make_mesh, shard_cluster
-from cruise_control_tpu.parallel.sharding import pad_brokers
+from cruise_control_tpu.parallel.sharding import pad_brokers, replicate
 
 
 def _skewed_cluster(num_brokers=16, partitions_per_broker=6):
@@ -84,6 +94,7 @@ def test_mesh_and_placement(mesh):
     np.testing.assert_array_equal(np.asarray(st_s.util), np.asarray(st.util))
 
 
+@pytest.mark.slow
 def test_replica_axis_sharding_placement_and_equality(mesh):
     """Default placement shards the replica axis too; the engine result is
     bit-identical to the unsharded run (the dryrun_multichip contract)."""
@@ -131,6 +142,7 @@ def test_pad_brokers():
     assert pad_brokers(None, 7001, 8) == 7008
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("goal_names", [
     ["DiskCapacityGoal"],
     ["DiskUsageDistributionGoal"],
@@ -158,6 +170,7 @@ def test_sharded_matches_unsharded(mesh, goal_names):
         assert int(a["iterations"]) == int(b["iterations"])
 
 
+@pytest.mark.slow
 def test_sharded_leadership_and_swaps(mesh):
     """Goals exercising the leadership and swap branches under sharding."""
     params = EngineParams(max_iters=64)
@@ -170,3 +183,174 @@ def test_sharded_leadership_and_swaps(mesh):
                              params)
     np.testing.assert_array_equal(np.asarray(st_ref.replica_is_leader),
                                   np.asarray(st_shard.replica_is_leader))
+
+
+# ---------------------------------------------------------------------------
+# shard-explicit engine (EngineParams.mesh + parallel/shard_ops.py)
+# ---------------------------------------------------------------------------
+_STATE_LEAVES = ("replica_broker", "replica_is_leader", "replica_disk",
+                 "util", "leader_util", "replica_count", "leader_count",
+                 "topic_broker_count", "topic_leader_count", "disk_util")
+
+
+def _tiny_cluster():
+    """8 brokers / 24 replicas — the shared tiny compile bucket: two small
+    goal programs per mode keep this inside the tier-1 budget."""
+    ct, meta = _skewed_cluster(num_brokers=8, partitions_per_broker=2)
+    env = make_env(ct, meta)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    return env, st
+
+
+def _assert_state_equal(st_a, st_b, infos_a=None, infos_b=None):
+    for name in _STATE_LEAVES:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st_a, name)), np.asarray(getattr(st_b, name)),
+            err_msg=f"state leaf {name}")
+    if infos_a is not None:
+        for ia, ib in zip(infos_a, infos_b):
+            for k in ("iterations", "violated_after", "fixpoint_proven",
+                      "moves_remaining", "leads_remaining"):
+                assert np.asarray(jax.device_get(ia[k])).tolist() \
+                    == np.asarray(jax.device_get(ib[k])).tolist(), k
+
+
+def test_shard_map_smoke_2dev_bit_identical():
+    """TIER-1 shard-explicit smoke: a 2-virtual-device mesh via
+    EngineParams.mesh runs the shard_map engine (sharded keyings, sharded
+    [K, B]/[KL, F] fusions) and the result — assignments, violations,
+    per-goal info — is BIT-IDENTICAL to the meshless program. Tiny shapes,
+    finisher off (the certificate machinery's sharded parity is certified
+    by the slow test below and dryrun stage 4)."""
+    goal_names = ["DiskCapacityGoal", "ReplicaDistributionGoal"]
+    params = EngineParams(max_iters=16, finisher_rounds=0)
+    env, st = _tiny_cluster()
+    st_ref, infos_ref = _run_chain(env, st, goal_names, params)
+
+    m2 = make_mesh(2)
+    env2, st2 = _tiny_cluster()
+    env_s, st_s = replicate(env2, m2), replicate(st2, m2)
+    st_sh, infos_sh = _run_chain(env_s, st_s, goal_names,
+                                 dataclasses.replace(params, mesh=m2))
+    _assert_state_equal(st_ref, st_sh)
+    for a, b in zip(infos_ref, infos_sh):
+        assert bool(a["violated_after"]) == bool(b["violated_after"])
+        assert int(a["iterations"]) == int(b["iterations"])
+    # the resident leaves really are mesh-placed (replicated on 2 devices)
+    assert len(st_sh.util.sharding.device_set) == 2
+
+
+def test_shard_map_mesh_size_one_is_identity():
+    """A 1-device mesh threads through EngineParams but compiles the exact
+    single-device engine (engine._engine_mesh returns None) — today's
+    programs, bit for bit."""
+    goal_names = ["DiskCapacityGoal"]
+    params = EngineParams(max_iters=16, finisher_rounds=0)
+    env, st = _tiny_cluster()
+    st_ref, _ = _run_chain(env, st, goal_names, params)
+    env2, st2 = _tiny_cluster()
+    st_one, _ = _run_chain(env2, st2, goal_names,
+                           dataclasses.replace(params, mesh=make_mesh(1)))
+    _assert_state_equal(st_ref, st_one)
+
+
+def test_shard_map_session_steady_zero_reshard():
+    """TIER-1 shard-aware resident session: a 2-device-mesh session serves
+    a steady delta round with ZERO new XLA compiles and every resident leaf
+    still replicated on the mesh (no re-shard transfers — placement chosen
+    at session creation, reused by every upload), and its optimization
+    results are bit-identical to a meshless session on the same backend."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.analyzer.session import ResidentClusterSession
+    from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+    from cruise_control_tpu.monitor.sampling.samplers import (
+        SimulatedMetricSampler,
+    )
+
+    def backend():
+        rng = np.random.default_rng(11)
+        be = SimulatedClusterBackend()
+        for b in range(6):
+            be.add_broker(b, f"r{b % 3}")
+        for p in range(24):
+            reps = [int(x) for x in rng.choice(6, size=2, replace=False)]
+            be.create_partition(f"t{p % 3}", p, reps,
+                                size_mb=float(rng.uniform(10, 200)),
+                                bytes_in_rate=float(rng.uniform(1, 20)),
+                                bytes_out_rate=float(rng.uniform(1, 40)),
+                                cpu_util=float(rng.uniform(0.1, 2)))
+        return be
+
+    def monitored(be, rounds=3, start=0):
+        lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be))
+        lm.start_up()
+        for i in range(start, start + rounds):
+            lm.sample_once(now_ms=i * 300_000.0)
+        return lm
+
+    goals = ["DiskCapacityGoal", "ReplicaDistributionGoal"]
+    m2 = make_mesh(2)
+    rep_sharding = NamedSharding(m2, PartitionSpec())
+
+    be = backend()
+    lm = monitored(be)
+    sess = ResidentClusterSession(lm, mesh=m2)
+    sess.sync()
+    opt = GoalOptimizer()
+    res1 = opt.optimizations(None, goal_names=goals, session=sess,
+                             raise_on_failure=False,
+                             skip_hard_goal_check=True)
+
+    # meshless reference on an identical backend/monitor
+    be_u = backend()
+    sess_u = ResidentClusterSession(monitored(be_u))
+    sess_u.sync()
+    res_u = GoalOptimizer().optimizations(None, goal_names=goals,
+                                          session=sess_u,
+                                          raise_on_failure=False,
+                                          skip_hard_goal_check=True)
+    np.testing.assert_array_equal(
+        np.asarray(res1.final_state.replica_broker),
+        np.asarray(res_u.final_state.replica_broker))
+    assert ([g.violated_after for g in res1.goal_results]
+            == [g.violated_after for g in res_u.goal_results])
+
+    # steady delta round: zero new compiles, placement unchanged
+    lm.sample_once(now_ms=3 * 300_000.0)
+    c0 = opt._compile_listener.count
+    info = sess.sync()
+    res2 = opt.optimizations(None, goal_names=goals, session=sess,
+                             raise_on_failure=False,
+                             skip_hard_goal_check=True)
+    jax.block_until_ready(res2.final_state.util)
+    assert info["mode"] == "delta"
+    assert opt._compile_listener.count - c0 == 0
+    for leaf in (sess.env.leader_load, sess.env.broker_capacity):
+        assert leaf.sharding == rep_sharding   # zero re-shard transfers
+
+
+@pytest.mark.slow
+def test_shard_map_full_mesh_certificates_bit_identical(mesh):
+    """8-device shard-explicit parity WITH the finisher: exhaustive scans,
+    segment waves, swap windows and the fixpoint certificates all run
+    sharded, and every verdict/certificate/state leaf is bit-identical to
+    the single-device chain (the dryrun stage-4 contract, in-tree)."""
+    goal_names = ["RackAwareGoal", "DiskCapacityGoal",
+                  "ReplicaDistributionGoal", "DiskUsageDistributionGoal",
+                  "LeaderReplicaDistributionGoal"]
+    params = EngineParams(max_iters=32, stall_retries=2, tail_pass_budget=8,
+                          tail_total_budget=24, finisher_rounds=3,
+                          finisher_candidates=64, finisher_waves=2,
+                          scan_chunk=128, finisher_segments=4,
+                          max_finisher_segments=4)
+    env, st = _setup()
+    st_ref, infos_ref = _run_chain(env, st, goal_names, params)
+    env2, st2 = _setup()
+    env_s, st_s = replicate(env2, mesh), replicate(st2, mesh)
+    st_sh, infos_sh = _run_chain(env_s, st_s, goal_names,
+                                 dataclasses.replace(params, mesh=mesh))
+    _assert_state_equal(st_ref, st_sh, infos_ref, infos_sh)

@@ -157,6 +157,31 @@ def test_engine_params_normalizes_numpy_leaves():
     assert leaves_py == leaves_np
 
 
+def test_engine_params_numpy_leaves_zero_retrace():
+    """ADVICE r5, the measured form: a jitted program taking EngineParams as
+    a pytree argument must NOT retrace when equivalent budgets arrive as
+    numpy scalars (config values) instead of Python ints/floats — the
+    normalized leaves hash to the same signature, cache size stays 1."""
+    import jax
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer.engine import EngineParams
+
+    @jax.jit
+    def prog(p: EngineParams):
+        return jnp.asarray(p.max_iters) + jnp.asarray(p.stall_retries)
+
+    prog(EngineParams(max_iters=64, min_gain=1e-9, stall_retries=8))
+    assert prog._cache_size() == 1
+    prog(EngineParams(max_iters=np.int64(64), min_gain=np.float64(1e-9),
+                      stall_retries=np.int32(8),
+                      tail_pass_budget=np.int16(64)))
+    assert prog._cache_size() == 1, "numpy-typed budget leaves forced a retrace"
+    # different budget VALUES reuse the executable too (traced leaves)
+    prog(EngineParams(max_iters=128, stall_retries=4))
+    assert prog._cache_size() == 1
+
+
 def test_engine_module_reload_safe():
     """ADVICE r5: module-level pytree registration must survive
     importlib.reload (ValueError on re-registration)."""

@@ -1,0 +1,151 @@
+"""Device-count A/B harness for the shard-explicit engine (PR 9):
+
+    {1, 2, 4, 8} virtual host devices x one kernel-coverage goal chain,
+
+one command, one subprocess per device count (the virtual device count must
+be fixed via XLA_FLAGS before the first JAX import, so cells cannot share a
+process). Per cell: cold + warm chain wall, violation verdicts, applied
+actions, real per-device committed bytes, and a digest of the final
+assignment — the parent asserts every mesh size's digest equals the
+1-device digest (the shard_map engine's bit-identity contract, measured
+here rather than assumed) and prints a pretty table plus ONE compact
+machine-parseable JSON last line in the bench.py style.
+
+Usage: shard_ab.py [--devices 1,2,4,8] [--brokers 32] [--partitions 600]
+"""
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GOALS = ["RackAwareGoal", "DiskCapacityGoal", "CpuCapacityGoal",
+         "ReplicaDistributionGoal", "DiskUsageDistributionGoal",
+         "LeaderReplicaDistributionGoal"]
+
+
+def _child(n: int, brokers: int, partitions: int) -> None:
+    """One cell: runs in its own process with n virtual devices."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from cruise_control_tpu.analyzer import (
+        EngineParams, init_state, make_env, optimize_goal,
+    )
+    from cruise_control_tpu.analyzer.goals import make_goals
+    from cruise_control_tpu.model.cluster_tensor import pad_cluster
+    from cruise_control_tpu.model.random_cluster import (
+        RandomClusterSpec, generate,
+    )
+    from cruise_control_tpu.parallel import make_mesh
+    from cruise_control_tpu.parallel.sharding import (
+        committed_per_device_bytes, replicate,
+    )
+
+    ct, meta = generate(RandomClusterSpec(
+        num_brokers=brokers, num_racks=4, num_topics=16,
+        num_partitions=partitions, max_replication=3, skew=1.2, seed=3143,
+        target_cpu_util=0.45))
+    ct, meta = pad_cluster(ct, meta)
+    env = make_env(ct, meta)
+    st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                    ct.replica_offline, ct.replica_disk)
+    params = EngineParams(max_iters=24, stall_retries=2, tail_pass_budget=8,
+                          tail_total_budget=24, finisher_rounds=2,
+                          finisher_candidates=64, finisher_waves=2,
+                          scan_chunk=256)
+    if n > 1:
+        mesh = make_mesh(n)
+        env, st0 = replicate(env, mesh), replicate(st, mesh)
+        params = dataclasses.replace(params, mesh=mesh)
+    else:
+        st0 = st
+    goals = make_goals(GOALS)
+
+    def run(s):
+        prev, viol, acts = (), [], 0
+        for g in goals:
+            s, info = optimize_goal(env, s, g, prev, params)
+            prev = prev + (g,)
+            viol.append(bool(jax.device_get(info["violated_after"])))
+            acts += int(jax.device_get(info["iterations"]))
+        jax.block_until_ready(s.util)
+        return s, viol, acts
+
+    t0 = time.monotonic()
+    _s, _v, _a = run(st0)
+    cold = round(time.monotonic() - t0, 2)
+    t0 = time.monotonic()
+    s, viol, acts = run(st0)
+    warm = round(time.monotonic() - t0, 2)
+    digest = hashlib.sha256(
+        np.asarray(s.replica_broker).tobytes()
+        + np.asarray(s.replica_is_leader).tobytes()).hexdigest()[:16]
+    print(json.dumps({
+        "n": n, "brokers": env.num_brokers, "replicas": env.num_replicas,
+        "wall_s_cold": cold, "wall_s_warm": warm, "actions": acts,
+        "violations_after": viol, "assignment_digest": digest,
+        "per_device_bytes": {str(d): int(v) for d, v in sorted(
+            committed_per_device_bytes((env, s)).items())},
+    }))
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--child":
+        _child(int(argv[1]), int(argv[2]), int(argv[3]))
+        return
+
+    def _opt(name, default):
+        return (argv[argv.index(name) + 1] if name in argv else default)
+
+    devices = [int(x) for x in _opt("--devices", "1,2,4,8").split(",")]
+    brokers = int(_opt("--brokers", "32"))
+    partitions = int(_opt("--partitions", "600"))
+    cells = []
+    for n in devices:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                         if "host_platform_device_count" not in f)
+        env["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_"
+                                    f"count={max(n, 1)}").strip()
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       f"/tmp/jax_cache_cc_multichip_{n}")
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             str(n), str(brokers), str(partitions)],
+            env=env, cwd=REPO, capture_output=True, text=True)
+        if proc.returncode != 0:
+            print(proc.stderr[-2000:], file=sys.stderr)
+            raise SystemExit(f"cell n={n} failed rc={proc.returncode}")
+        cell = json.loads(proc.stdout.strip().splitlines()[-1])
+        cells.append(cell)
+        mem = max(cell["per_device_bytes"].values())
+        print(f"  n={n}: warm={cell['wall_s_warm']}s cold={cell['wall_s_cold']}s "
+              f"actions={cell['actions']} "
+              f"viol={sum(cell['violations_after'])} "
+              f"per-dev={mem / 1e6:.2f}MB digest={cell['assignment_digest']}",
+              file=sys.stderr, flush=True)
+    ref = cells[0]["assignment_digest"]
+    parity = all(c["assignment_digest"] == ref for c in cells)
+    if not parity:
+        print("PARITY FAILURE: assignment digests differ across device "
+              "counts", file=sys.stderr)
+    print(json.dumps({"shard_ab": {
+        "goals": GOALS, "devices": devices, "parity": parity,
+        "cells": cells}}))
+    if not parity:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()
