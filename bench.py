@@ -46,6 +46,11 @@ Usage: bench.py [rung ...] [--profile] [--skip-cold] [--scenario [name]]
                and writes the full episode log to CAMPAIGN_<name>_s<seed>.json
   --campaign-seed  campaign seed (default 0); same (campaign, seed) =>
                bit-identical episode log
+  --fuzz [N]   with --campaign: run every episode with the seeded REST
+               fuzzer + FaultyBackend attached (sim/api_fuzz.py, fuzz seed
+               N, default 0); emits fuzz request/failure counts and writes
+               CAMPAIGN_<name>_s<seed>_f<N>.json — same (campaign, seed,
+               fuzz-seed) => bit-identical episode log incl. the fuzz log
   --rung NAME  run only the named rung(s) (repeatable; same ids as the
                positional form: 1..5, e2e, e2e7k, scenario) — the same-day
                A/B workflow's "rerun one rung without paying the ladder"
@@ -441,6 +446,20 @@ def main() -> None:
         i = argv.index("--campaign-seed")
         campaign_seed = int(argv[i + 1])
         argv = argv[:i] + argv[i + 2:]
+    fuzz_seed = None
+    if "--fuzz" in argv:
+        # --fuzz [N]: run the campaign episodes with the REST fuzzer +
+        # FaultyBackend attached (sim/api_fuzz.py); N is the fuzz seed
+        # (default 0). Same (campaign, seed, fuzz-seed) => bit-identical
+        # episode log incl. the fuzz log.
+        i = argv.index("--fuzz")
+        if i + 1 < len(argv) and not argv[i + 1].startswith("--") \
+                and argv[i + 1].isdigit():
+            fuzz_seed = int(argv[i + 1])
+            argv = argv[:i] + argv[i + 2:]
+        else:
+            fuzz_seed = 0
+            argv = argv[:i] + argv[i + 1:]
     # --profile-level off|pass|stage: analyzer.profile.level for every rung
     # optimizer (pass = zero-cost counters; stage = blocking per-segment)
     profile_level = None
@@ -572,8 +591,10 @@ def main() -> None:
 
         elif rung_id == "campaign":
             # seeded chaos campaign (sim/campaign.py): randomized compound
-            # fault schedules -> per-fault-type SLO distributions
-            rung = run_campaign_rung(campaign_name, campaign_seed)
+            # fault schedules -> per-fault-type SLO distributions; with
+            # --fuzz, the REST fuzzer + FaultyBackend ride every episode
+            rung = run_campaign_rung(campaign_name, campaign_seed,
+                                     fuzz_seed=fuzz_seed)
 
         elif rung_id == "e2e7k":
             # the full monitor path at HEADLINE scale: backend -> samples ->
@@ -626,13 +647,21 @@ def run_scenario_rung(name: str) -> dict:
     return rung
 
 
-def run_campaign_rung(name: str, seed: int = 0) -> dict:
+def run_campaign_rung(name: str, seed: int = 0,
+                      fuzz_seed: int | None = None) -> dict:
     """Run one seeded chaos campaign (sim/campaign.py) and report its SLO
     distributions: per fault type, time-to-detect / time-to-heal /
     actions-per-heal p50/p95/max in SIMULATED ms, plus verifier verdicts and
     provisioner actuations. Same (campaign, seed) => bit-identical episode
     log; the full log (with timelines) goes to CAMPAIGN_<name>_s<seed>.json
-    for tools/campaign_view.py."""
+    for tools/campaign_view.py.
+
+    ``fuzz_seed`` (--fuzz): every episode additionally runs the seeded REST
+    fuzzer against a live HTTP server while a FaultyBackend injects backend
+    faults (sim/api_fuzz.py); the log goes to
+    CAMPAIGN_<name>_s<seed>_f<fuzz>.json and the rung gains fuzz fields."""
+    if fuzz_seed is not None:
+        return _run_fuzz_campaign_rung(name, seed, fuzz_seed)
     from cruise_control_tpu.sim import run_campaign
 
     log(f"rung campaign: seeded chaos campaign ({name}, seed {seed})")
@@ -670,6 +699,43 @@ def run_campaign_rung(name: str, seed: int = 0) -> dict:
         f"episodes converged, "
         f"{doc['total_verified_optimizations']} optimizations verified "
         f"({doc['total_verifier_violations']} violations), wall={wall}s")
+    return rung
+
+
+def _run_fuzz_campaign_rung(name: str, seed: int, fuzz_seed: int) -> dict:
+    """Campaign episodes with the REST fuzzer + FaultyBackend attached."""
+    from cruise_control_tpu.sim import run_fuzz_campaign
+
+    log(f"rung campaign: chaos campaign + REST fuzz ({name}, seed {seed}, "
+        f"fuzz seed {fuzz_seed})")
+    t0 = time.monotonic()
+    doc = run_fuzz_campaign(name, seed=seed, fuzz_seed=fuzz_seed)
+    wall = round(time.monotonic() - t0, 2)
+    rung = {
+        "config": f"campaign-{name}-s{seed}-f{fuzz_seed}",
+        "wall_s": wall,
+        "num_episodes": doc["num_episodes"],
+        "converged_episodes": doc["converged_episodes"],
+        "fuzz_seed": fuzz_seed,
+        "fuzz_requests": doc["fuzz_requests"],
+        "failures": doc["failures"],
+        "slo": doc["slo"],
+    }
+    SUMMARY.campaign = {"name": name, "seed": seed, "wall_s": wall,
+                        "fuzz_seed": fuzz_seed,
+                        **{k: rung[k] for k in (
+                            "num_episodes", "converged_episodes",
+                            "fuzz_requests", "failures", "slo")}}
+    out_path = f"CAMPAIGN_{name}_s{seed}_f{fuzz_seed}.json"
+    try:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+        log(f"  [campaign] full fuzz episode log -> {out_path}")
+    except OSError:
+        pass
+    log(f"  [campaign] {doc['converged_episodes']}/{doc['num_episodes']} "
+        f"episodes converged under fuzz, {doc['fuzz_requests']} REST "
+        f"requests, {len(doc['failures'])} failures, wall={wall}s")
     return rung
 
 

@@ -34,7 +34,10 @@ from cruise_control_tpu.api.progress import (
 from cruise_control_tpu.api.purgatory import Purgatory
 from cruise_control_tpu.api.responses import error_json, wrap
 from cruise_control_tpu.api.security import AuthError, NoopSecurityProvider
-from cruise_control_tpu.api.user_tasks import USER_TASK_HEADER_NAME, UserTaskManager
+from cruise_control_tpu.api.user_tasks import (
+    USER_TASK_HEADER_NAME, UserTaskLimitError, UserTaskManager,
+)
+from cruise_control_tpu.common.retries import ServiceUnavailableError
 
 URL_PREFIX = "/kafkacruisecontrol"
 
@@ -331,13 +334,33 @@ class CruiseControlServer:
                 raise ParameterError(
                     f"rebalance_disk only accepts intra-broker goals; got {bad}"
                     f" (allowed: {intra})")
+        # degraded-mode write gate: a mutating request against an unhealthy
+        # backend boundary 503s up front (Retry-After = breaker reset)
+        # WITHOUT consuming a user-task slot; a resumption poll by header is
+        # a read of the existing task and passes through
+        if (method == "POST" and params.get("dryrun", True) is not True
+                and not task_id_header):
+            degraded = getattr(self.app, "degraded", None)
+            if degraded is not None and degraded():
+                raise ServiceUnavailableError(
+                    f"{endpoint.path} rejected: backend degraded (open "
+                    f"circuits: {self.app.fault_tolerance.open_circuits()})",
+                    retry_after_s=self.app.fault_tolerance.retry_after_s())
         work = self._async_work(endpoint, params)
         # non-dry-run ops mutate the cluster: a completed one must not be
         # replayed from the session cache for a fresh request
         idempotent = method == "GET" or params.get("dryrun", True) is True
-        task = self.user_tasks.get_or_create_task(
-            client, endpoint, method, params, work, task_id=task_id_header,
-            idempotent=idempotent)
+        try:
+            task = self.user_tasks.get_or_create_task(
+                client, endpoint, method, params, work, task_id=task_id_header,
+                idempotent=idempotent)
+        except UserTaskLimitError as e:
+            # the reference's servlet surfaces user-task overflow as 429 Too
+            # Many Requests with a Retry-After, never a generic error — the
+            # client backs off and resumes via User-Task-ID like a purgatory
+            # park (UserTaskManager.java wrapAndThrowTooManyRequests role)
+            headers["Retry-After"] = "1"
+            return 429, error_json(str(e)), headers
         headers[USER_TASK_HEADER_NAME] = task.task_id
         try:
             result = task.future.result(timeout=self.max_block_ms / 1000.0)
@@ -351,9 +374,26 @@ class CruiseControlServer:
         except TimeoutError:
             return 202, wrap({"progress": task.progress.to_json(),
                               "operation": endpoint.path}), headers
+        except ServiceUnavailableError as e:
+            # degraded-mode result: 503 + Retry-After, not a 500
+            headers["Retry-After"] = str(int(e.retry_after_s))
+            return 503, error_json(str(e)), headers
         except Exception as e:  # noqa: BLE001 — rendered as the error body
+            if self._is_degraded_read_error(e):
+                headers["Retry-After"] = "30"
+                return 503, error_json(f"{type(e).__name__}: {e}"), headers
             return 500, error_json(f"{type(e).__name__}: {e}",
                                    traceback.format_exc()), headers
+
+    @staticmethod
+    def _is_degraded_read_error(e: Exception) -> bool:
+        """Completeness gating / open-breaker failures are DECLARED
+        degradation (503 + Retry-After), never undeclared 500s."""
+        from cruise_control_tpu.common.retries import CircuitOpenError
+        from cruise_control_tpu.monitor.load_monitor import (
+            NotEnoughValidWindowsError,
+        )
+        return isinstance(e, (CircuitOpenError, NotEnoughValidWindowsError))
 
     def _async_work(self, endpoint: EndPoint, p: dict):
         """Build the callable for an async endpoint: runs on the user-task
@@ -387,11 +427,19 @@ class CruiseControlServer:
                             kafka_assigner_goal_names,
                         )
                         goals = kafka_assigner_goal_names(goals or [])
-                    res = app.cached_proposals(
+                    res, freshness = app.cached_proposals_verbose(
                         force_refresh=p["ignore_proposal_cache"],
                         goal_names=goals,
                         excluded_topics=p["excluded_topics"])
-                    return wrap({"summary": res.to_json()})
+                    body = {"summary": res.to_json(),
+                            "stale": freshness["stale"]}
+                    if freshness["stale"]:
+                        # degraded read: cached proposals with provenance
+                        # (model generation + age on the backend clock)
+                        body["staleGeneration"] = freshness["generation"]
+                        body["staleAgeMs"] = freshness["ageMs"]
+                        body["staleReason"] = freshness["reason"]
+                    return wrap(body)
                 if endpoint is EndPoint.REBALANCE:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
                     return wrap(app.rebalance(
@@ -728,7 +776,17 @@ def _make_handler(server: CruiseControlServer):
             except (ParameterError, KeyError, ValueError) as e:
                 self._send(400, error_json(str(e)), {})
                 return
+            except ServiceUnavailableError as e:
+                # degraded mode (writes while a breaker is open, reads with
+                # nothing cached): 503 + Retry-After, the declared signal
+                self._send(503, error_json(str(e)),
+                           {"Retry-After": str(int(e.retry_after_s))})
+                return
             except Exception as e:  # noqa: BLE001
+                if CruiseControlServer._is_degraded_read_error(e):
+                    self._send(503, error_json(f"{type(e).__name__}: {e}"),
+                               {"Retry-After": "30"})
+                    return
                 self._send(500, error_json(f"{type(e).__name__}: {e}",
                                            traceback.format_exc()), {})
                 return

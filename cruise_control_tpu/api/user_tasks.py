@@ -26,6 +26,11 @@ from cruise_control_tpu.api.progress import OperationProgress
 USER_TASK_HEADER_NAME = "User-Task-ID"
 
 
+class UserTaskLimitError(RuntimeError):
+    """max.active.user.tasks overflow — the servlet maps this to the
+    reference's 429 Too Many Requests (not a generic 500)."""
+
+
 class TaskState(enum.Enum):
     """UserTaskManager.TaskState (ACTIVE/IN_EXECUTION/COMPLETED/COMPLETED_WITH_ERROR)."""
     ACTIVE = "Active"
@@ -186,7 +191,7 @@ class UserTaskManager:
                 if task is not None and (idempotent or not task.done):
                     return task
             if len(self._active) >= self._max_active:
-                raise RuntimeError(
+                raise UserTaskLimitError(
                     f"there are already {len(self._active)} active user tasks, "
                     f"which has reached the limit {self._max_active}")
             tid = str(uuid_mod.uuid4())

@@ -77,14 +77,26 @@ class CruiseControl:
             capacity=self.config.get_int("flight.recorder.capacity"),
             clock_ms=self._now_ms)
         self.flight_recorder.register_gauges(self.sensors)
+        # ONE fault-tolerance layer at the backend boundary
+        # (common/retries.py): the executor, monitor and this facade consult
+        # the SAME per-operation-class circuit breakers, so a backend outage
+        # the executor observes also degrades REST serving (stale-flagged
+        # reads, 503 writes) and defers detector fixes. The injected clock is
+        # the backend clock — simulated campaigns keep bit-identical
+        # timelines with retries/backoff live.
+        from cruise_control_tpu.common.retries import BackendFaultTolerance
+        self.fault_tolerance = BackendFaultTolerance(
+            self.config, clock_ms=self._now_ms, sensors=self.sensors)
         self.load_monitor = LoadMonitor(config=self.config, backend=backend,
                                         sensors=self.sensors,
-                                        recorder=self.flight_recorder)
+                                        recorder=self.flight_recorder,
+                                        fault_tolerance=self.fault_tolerance)
         self.goal_optimizer = GoalOptimizer(config=self.config,
                                             sensors=self.sensors,
                                             recorder=self.flight_recorder)
         self.executor = Executor(backend, config=self.config,
-                                 sensors=self.sensors)
+                                 sensors=self.sensors,
+                                 fault_tolerance=self.fault_tolerance)
         oes = self.load_monitor.on_execution_store
         if oes is not None:
             # the on-execution store gates on the live executor
@@ -93,8 +105,20 @@ class CruiseControl:
         # (AnomalyDetectorConfig.java anomaly.notifier.class ->
         # getConfiguredInstance); default SelfHealingNotifier
         notifier = self.config.get_class("anomaly.notifier.class")()
-        notifier.configure(self.config,
-                           num_brokers_supplier=lambda: len(backend.brokers()))
+        # the notifier's broker-count read rides the shared breaker with a
+        # last-known fallback: a transient metadata failure must not crash
+        # anomaly handling mid-verdict
+        self._last_broker_count = 0
+
+        def _num_brokers() -> int:
+            try:
+                n = len(self.fault_tolerance.call("detector.metadata",
+                                                  backend.brokers))
+                self._last_broker_count = n
+                return n
+            except Exception:
+                return self._last_broker_count
+        notifier.configure(self.config, num_brokers_supplier=_num_brokers)
         clock = SimClock(backend) if hasattr(backend, "advance") else None
         self.anomaly_detector = AnomalyDetectorManager(
             notifier=notifier, cruise_control=self, clock=clock,
@@ -368,6 +392,31 @@ class CruiseControl:
         self.anomaly_detector.shutdown()
         self.load_monitor.shutdown()
 
+    # ------------------------------------------------------- degraded mode
+    def degraded(self) -> bool:
+        """True while any backend circuit breaker is not CLOSED: reads serve
+        stale caches, writes 503, the detector defers fixes."""
+        return self.fault_tolerance.degraded()
+
+    def degraded_json(self) -> dict:
+        return self.fault_tolerance.state_json()
+
+    def _check_writable(self, operation: str) -> None:
+        """Gate cluster-mutating operations while degraded: a write against
+        an unreachable backend would only start an execution that immediately
+        pauses — reject it up front with 503 + Retry-After instead
+        (api/server.py maps ServiceUnavailableError)."""
+        ft = self.fault_tolerance
+        if ft.degraded():
+            from cruise_control_tpu.common.retries import (
+                ServiceUnavailableError,
+            )
+            self.sensors.meter("degraded-write-rejections").mark()
+            raise ServiceUnavailableError(
+                f"{operation} rejected: backend degraded (open circuits: "
+                f"{ft.open_circuits()})",
+                retry_after_s=ft.retry_after_s())
+
     # ------------------------------------------------------------ helpers
     @property
     def ops_history(self) -> list:
@@ -506,8 +555,13 @@ class CruiseControl:
                              optimizer_result=res)
         if not dry_run and res.proposals:
             kw = dict(execute_kw or {})
-            sizes = {tp: info.size_mb
-                     for tp, info in self.backend.partitions().items()}
+            try:
+                sizes = {tp: info.size_mb
+                         for tp, info in self.backend.partitions().items()}
+            except Exception:
+                # strategy sort degrades without sizes; the execution itself
+                # retries/pauses through the executor's breakers
+                sizes = {}
             kw.setdefault("context", {"partition_size_mb": sizes,
                                       "operation": f"{operation}: {reason}"})
             self.executor.execute_proposals(res.proposals, **kw)
@@ -551,6 +605,8 @@ class CruiseControl:
             # fail before optimizing — a typo'd strategy must 400, not burn
             # an optimization then 500 at execute time
             self.executor.validate_strategies(replica_movement_strategies)
+        if not dry_run:
+            self._check_writable("REBALANCE")
         excl_rm, excl_dm = self._self_healing_exclusions(
             exclude_recently_removed_brokers, exclude_recently_demoted_brokers,
             self_healing)
@@ -602,6 +658,8 @@ class CruiseControl:
         """POST /remove_broker: drain the brokers, then (really) move load off
         (RemoveBrokersRunnable role). Marks brokers as move-excluded
         destinations and relocates everything they host."""
+        if not dry_run:
+            self._check_writable("REMOVE_BROKER")
         ct, meta = self._model()
         ct = self._apply_excluded_topics(ct, meta, excluded_topics)
         excl_rm, excl_dm = self._self_healing_exclusions(
@@ -635,8 +693,15 @@ class CruiseControl:
                     excluded_topics: str | None = None,
                     exclude_recently_removed_brokers: bool = False,
                     exclude_recently_demoted_brokers: bool = False,
+                    skip_hard_goal_check: bool = False,
                     reason: str = "add brokers") -> dict:
-        """POST /add_broker: rebalance load onto the (new) brokers."""
+        """POST /add_broker: rebalance load onto the (new) brokers.
+        ``skip_hard_goal_check``: self-healing contexts (the ADD_BROKER
+        maintenance plan firing mid-fault) balance onto the new hardware
+        best-effort instead of aborting on a transiently-unsatisfiable hard
+        goal."""
+        if not dry_run:
+            self._check_writable("ADD_BROKER")
         ct, meta = self._model()
         ct = self._apply_excluded_topics(ct, meta, excluded_topics)
         ct = self._apply_broker_exclusions(ct, meta,
@@ -648,7 +713,8 @@ class CruiseControl:
         import jax.numpy as jnp
         ct = dataclasses.replace(ct, broker_new=jnp.asarray(new))
         op = self._run_optimization("ADD_BROKER", reason, ct, meta, None,
-                                    OptimizationOptions(), dry_run=dry_run)
+                                    OptimizationOptions(), dry_run=dry_run,
+                                    skip_hard_goal_check=skip_hard_goal_check)
         return op.to_json()
 
     def demote_brokers(self, broker_ids: list, dry_run: bool = False,
@@ -662,6 +728,8 @@ class CruiseControl:
         veto destinations — a chaos campaign caught it parking replicas on
         co-rack brokers, a permanent hard-goal violation that offline-only
         heals can never repair."""
+        if not dry_run:
+            self._check_writable("DEMOTE_BROKER")
         ct, meta = self._model()
         demoted = np.asarray(ct.broker_demoted).copy()
         for b in broker_ids:
@@ -683,6 +751,8 @@ class CruiseControl:
                              exclude_recently_demoted_brokers: bool = False,
                              reason: str = "fix offline replicas") -> dict:
         """POST /fix_offline_replicas (FixOfflineReplicasRunnable role)."""
+        if not dry_run:
+            self._check_writable("FIX_OFFLINE_REPLICAS")
         excl_rm, excl_dm = self._self_healing_exclusions(
             exclude_recently_removed_brokers, exclude_recently_demoted_brokers,
             self_healing)
@@ -709,12 +779,17 @@ class CruiseControl:
         other fix (UpdateTopicConfigurationRunnable role) — throttled,
         concurrency-capped, task-accounted, visible in state_json instead of
         a raw metadata write behind the executor's back."""
+        self._check_writable("TOPIC_REPLICATION_FACTOR")
         from cruise_control_tpu.analyzer.proposals import ExecutionProposal
         default_rf = self.config.get_int("self.healing.target.topic.replication.factor")
         partitions = self.backend.partitions()
         brokers = self.backend.brokers()
-        # least-loaded first: replica count per alive broker, ties by id
-        counts = {b: 0 for b, n in brokers.items() if n.alive}
+        # candidate destinations: alive brokers WITHOUT dead logdirs (adding
+        # a replica lands on the broker's first logdir — placing onto dead
+        # hardware would mint fresh offline replicas mid-heal); least-loaded
+        # first, ties by id
+        counts = {b: 0 for b, n in brokers.items()
+                  if n.alive and not n.dead_logdirs}
         for info in partitions.values():
             for b in info.replicas:
                 if b in counts:
@@ -735,11 +810,24 @@ class CruiseControl:
                 target_rf = default_rf
             replicas = list(info.replicas)
             if len(replicas) < target_rf:
-                candidates = sorted((b for b in counts if b not in replicas),
-                                    key=lambda b: (counts[b], b))
-                need = target_rf - len(replicas)
-                for b in candidates[:need]:
+                # rack-aware placement (the PR-8 demote lesson, re-learned by
+                # a chaos campaign on THIS path): prefer racks the partition
+                # doesn't occupy yet — a co-rack add is a permanent
+                # RackAwareGoal violation that wedges every later
+                # offline-only heal; fall back to co-rack only when every
+                # rack is already used
+                racks_used = {brokers[b].rack for b in replicas
+                              if b in brokers}
+                for _ in range(target_rf - len(replicas)):
+                    candidates = sorted(
+                        (b for b in counts if b not in replicas),
+                        key=lambda b: (brokers[b].rack in racks_used,
+                                       counts[b], b))
+                    if not candidates:
+                        break
+                    b = candidates[0]
                     replicas.append(b)
+                    racks_used.add(brokers[b].rack)
                     counts[b] += 1
             elif len(replicas) > target_rf:
                 keep = [info.leader] + [b for b in replicas if b != info.leader]
@@ -857,6 +945,57 @@ class CruiseControl:
         (GoalOptimizer precompute/cache role, GoalOptimizer.java:219-339).
         A custom goal list bypasses the cache, like the reference does when
         ProposalsParameters carries non-default goals."""
+        return self.cached_proposals_verbose(
+            force_refresh=force_refresh, goal_names=goal_names,
+            excluded_topics=excluded_topics)[0]
+
+    def cached_proposals_verbose(self, force_refresh: bool = False,
+                                 goal_names=None,
+                                 excluded_topics: str | None = None):
+        """``(result, freshness)`` — the degraded-read contract: a refresh
+        that fails because the backend boundary is unhealthy (open breaker,
+        completeness gating, transient backend error) serves the CACHED
+        proposals flagged ``{"stale": True, "generation": ..., "ageMs": ...}``
+        instead of failing the read; with nothing cached the read surfaces
+        503 + Retry-After (ServiceUnavailableError). The REST layer emits the
+        freshness fields verbatim."""
+        from cruise_control_tpu.common.retries import ServiceUnavailableError
+        try:
+            res = self._cached_proposals_fresh(force_refresh, goal_names,
+                                               excluded_topics)
+            return res, {"stale": False}
+        except Exception as e:
+            # ServiceUnavailableError (a degraded metadata read) is
+            # deliberately fallback-eligible too: serving the stale cache
+            # beats a clean 503 when there is something to serve
+            if goal_names or excluded_topics:
+                raise    # custom-chain dry runs have no cache to fall back to
+            with self._cache_lock:
+                cached = self._proposal_cache
+                gen = self._proposal_cache_generation
+                age_ms = (self._now_ms() - self._proposal_cache_ms
+                          if cached is not None else None)
+            if cached is None:
+                # nothing to serve: a degraded read without a cache is a 503,
+                # never a raw 500 (the fuzzer's no-undeclared-500s invariant)
+                if isinstance(e, ServiceUnavailableError):
+                    raise
+                raise ServiceUnavailableError(
+                    f"proposals unavailable ({type(e).__name__}: {e}) and "
+                    f"no cached result to serve",
+                    retry_after_s=self.fault_tolerance.retry_after_s()) from e
+            self.sensors.meter("stale-proposals-served").mark()
+            import logging
+            logging.getLogger(__name__).warning(
+                "serving STALE cached proposals (generation %s, age %.0f ms):"
+                " %s: %s", gen, age_ms, type(e).__name__, e)
+            return cached, {"stale": True, "generation": list(gen),
+                            "ageMs": round(age_ms, 1),
+                            "reason": f"{type(e).__name__}: {e}"}
+
+    def _cached_proposals_fresh(self, force_refresh: bool = False,
+                                goal_names=None,
+                                excluded_topics: str | None = None) -> OptimizerResult:
         if goal_names or excluded_topics:
             # dry-run-only path: custom goal lists / exclusions bypass the
             # cache (the precompute always runs the full default chain)

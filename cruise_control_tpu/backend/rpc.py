@@ -48,13 +48,15 @@ class RpcClusterBackend:
 
     def __init__(self, argv: list[str] | None = None, proc=None,
                  admin_timeout_s: float = 180.0,
-                 logdir_timeout_s: float = 10.0):
+                 logdir_timeout_s: float = 10.0,
+                 max_respawns: int = 3, sensors=None):
+        self._argv = argv or [sys.executable, "-m",
+                              "cruise_control_tpu.backend.rpc"]
         if proc is None:
-            argv = argv or [sys.executable, "-m",
-                            "cruise_control_tpu.backend.rpc"]
-            proc = subprocess.Popen(argv, stdin=subprocess.PIPE,
-                                    stdout=subprocess.PIPE, text=True,
-                                    bufsize=1)
+            proc = self._spawn()
+        else:
+            # an injected proc (custom pipes in tests) can't be respawned
+            self._argv = None
         self._proc = proc
         self._lock = threading.Lock()
         self._next_id = 0
@@ -62,6 +64,17 @@ class RpcClusterBackend:
         # logdir.response.timeout.ms: how long one wire request may take
         self._admin_timeout_s = admin_timeout_s
         self._logdir_timeout_s = logdir_timeout_s
+        # bounded respawn-on-failure (backend.sidecar.max.respawns): a timed
+        # out or dead sidecar is relaunched instead of leaving this client
+        # permanently down ("sidecar terminated" for the process lifetime)
+        self._max_respawns = max_respawns
+        self.restarts = 0
+        self._sensors = sensors
+
+    def _spawn(self):
+        return subprocess.Popen(self._argv, stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE, text=True,
+                                bufsize=1)
 
     def configure(self, config, **extra):
         if config is not None:
@@ -69,6 +82,27 @@ class RpcClusterBackend:
                 config.get_int("admin.client.request.timeout.ms") / 1000.0)
             self._logdir_timeout_s = (
                 config.get_int("logdir.response.timeout.ms") / 1000.0)
+            self._max_respawns = config.get_int("backend.sidecar.max.respawns")
+        if extra.get("sensors") is not None:
+            self._sensors = extra["sensors"]
+
+    def _respawn_locked(self) -> None:
+        """Caller holds the lock; the current proc is dead or poisoned.
+        Relaunch within the bounded budget, else report the client down."""
+        if self._argv is None or self.restarts >= self._max_respawns:
+            raise RpcError(
+                f"sidecar is down (exit {self._proc.returncode}) and the "
+                f"respawn budget ({self._max_respawns}) is exhausted; "
+                f"recreate the backend client")
+        try:
+            self._proc.kill()
+            self._proc.wait(timeout=10)
+        except Exception:
+            pass
+        self._proc = self._spawn()
+        self.restarts += 1
+        if self._sensors is not None:
+            self._sensors.meter("sidecar-restarts").mark()
 
     def close(self) -> None:
         try:
@@ -81,27 +115,41 @@ class RpcClusterBackend:
         import select
         with self._lock:
             if self._proc.poll() is not None:
-                raise RpcError(f"sidecar is down (exit "
-                               f"{self._proc.returncode}); recreate the "
-                               f"backend client")
+                self._respawn_locked()
             self._next_id += 1
             req = {"jsonrpc": "2.0", "id": self._next_id, "method": method,
                    "params": params}
-            self._proc.stdin.write(json.dumps(req) + "\n")
-            self._proc.stdin.flush()
+            try:
+                self._proc.stdin.write(json.dumps(req) + "\n")
+                self._proc.stdin.flush()
+            except (BrokenPipeError, OSError) as e:
+                # the sidecar died between poll() and the write: respawn on
+                # the NEXT call; this one failed (the caller's retry layer
+                # re-drives it through the fresh sidecar)
+                raise RpcError(f"sidecar pipe broke during {method}: {e}") \
+                    from None
             timeout_s = (self._logdir_timeout_s if method == "describe_logdirs"
                          else self._admin_timeout_s)
             ready, _, _ = select.select([self._proc.stdout], [], [], timeout_s)
             if not ready:
                 # fail-stop: the late reply is still in the pipe — leaving it
                 # there would desynchronize every subsequent request/response
-                # pair (the next _call would read THIS call's answer), so the
-                # sidecar is killed and the client reports itself down
+                # pair (the next _call would read THIS call's answer). The
+                # poisoned sidecar is killed; within the bounded respawn
+                # budget a fresh one is launched so ONE slow request no
+                # longer takes the client down for the process lifetime.
                 self._proc.kill()
+                try:
+                    # reap synchronously so the next _call's poll() sees the
+                    # death and respawns instead of writing to a broken pipe
+                    self._proc.wait(timeout=10)
+                except Exception:
+                    pass
                 raise RpcError(
                     f"{method}: no response within {timeout_s:.0f}s "
                     f"(admin.client.request.timeout.ms / "
-                    f"logdir.response.timeout.ms); sidecar terminated")
+                    f"logdir.response.timeout.ms); sidecar terminated "
+                    f"(respawns on next call within budget)")
             line = self._proc.stdout.readline()
             if not line:
                 raise RpcError(f"sidecar died during {method}")
@@ -357,9 +405,34 @@ def _dispatch(backend, method: str, p: dict):
     raise ValueError(f"unknown method {method!r}")
 
 
+class _SlowBackend:
+    """Test/chaos shim: delays every dispatched method by ``delay_s`` wall
+    seconds — lets the client's timeout + respawn path (and wire-level
+    latency-spike chaos) run against a real subprocess sidecar."""
+
+    def __init__(self, inner, delay_s: float):
+        self._inner = inner
+        self._delay_s = delay_s
+
+    def __getattr__(self, name):
+        import time as _time
+        attr = getattr(self._inner, name)
+        if not callable(attr):
+            return attr
+
+        def slow(*a, **kw):
+            _time.sleep(self._delay_s)
+            return attr(*a, **kw)
+        return slow
+
+
 def main() -> None:
     from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
-    serve_backend(SimulatedClusterBackend(), sys.stdin, sys.stdout)
+    backend = SimulatedClusterBackend()
+    if "--slow-ms" in sys.argv:
+        backend = _SlowBackend(
+            backend, float(sys.argv[sys.argv.index("--slow-ms") + 1]) / 1000.0)
+    serve_backend(backend, sys.stdin, sys.stdout)
 
 
 if __name__ == "__main__":

@@ -479,6 +479,42 @@ _D.define(name="logdir.response.timeout.ms", type=Type.LONG, default=10_000,
           validator=at_least(1),
           doc="Timeout for backend logdir describe requests "
               "(ExecutorConfig logdir.response.timeout.ms).")
+# -- fault tolerance at the backend boundary (common/retries.py): retry
+# policy + per-operation-class circuit breakers wired into executor
+# submission/verification, monitor sampling and the RPC sidecar client --
+_D.define(name="backend.retry.max.attempts", type=Type.INT, default=4,
+          validator=at_least(1),
+          doc="Attempts per backend call before the failure propagates "
+              "(1 = no retries). Jittered exponential backoff between "
+              "attempts (common/retries.py RetryPolicy).")
+_D.define(name="backend.retry.base.backoff.ms", type=Type.LONG, default=100,
+          validator=at_least(0),
+          doc="First-retry backoff; doubles per retry up to "
+              "backend.retry.max.backoff.ms.")
+_D.define(name="backend.retry.max.backoff.ms", type=Type.LONG, default=10_000,
+          validator=at_least(0),
+          doc="Backoff ceiling for the exponential retry schedule.")
+_D.define(name="backend.retry.jitter", type=Type.DOUBLE, default=0.2,
+          validator=between(0.0, 1.0),
+          doc="Symmetric jitter fraction applied to each backoff (drawn "
+              "from the injected deterministic RNG).")
+_D.define(name="backend.circuit.failure.threshold", type=Type.INT, default=5,
+          validator=at_least(1),
+          doc="Consecutive failures of one operation class that OPEN its "
+              "circuit breaker (CLOSED->OPEN->HALF_OPEN; common/retries.py).")
+_D.define(name="backend.circuit.reset.timeout.ms", type=Type.LONG,
+          default=60_000, validator=at_least(1),
+          doc="Time an OPEN circuit waits before admitting HALF_OPEN probes.")
+_D.define(name="backend.circuit.half.open.probes", type=Type.INT, default=1,
+          validator=at_least(1),
+          doc="Concurrent probe calls a HALF_OPEN circuit admits; one "
+              "success closes it, one failure re-opens it.")
+_D.define(name="backend.sidecar.max.respawns", type=Type.INT, default=3,
+          validator=at_least(0),
+          doc="Bounded sidecar respawn budget for the RPC backend client: a "
+              "timed-out/dead sidecar is killed and relaunched at most this "
+              "many times per client (meter: sidecar-restarts) instead of "
+              "staying permanently down.")
 _D.define(name="executor.notifier.class", type=Type.CLASS,
           default="cruise_control_tpu.executor.notifier.LoggingExecutorNotifier",
           doc="ExecutorNotifier SPI: notified when a proposal execution "

@@ -133,7 +133,12 @@ class MaintenanceEvent(Anomaly):
         if pt == "REMOVE_BROKER":
             return cruise_control.remove_brokers(self.brokers, reason=reason)
         if pt == "ADD_BROKER":
-            return cruise_control.add_brokers(self.brokers, reason=reason)
+            # self-healing context: balance onto the new hardware
+            # best-effort — a transiently-unsatisfiable hard goal mid-fault
+            # must not abort the plan (campaigns caught the strict chain
+            # raising while a concurrent broker death was unhealed)
+            return cruise_control.add_brokers(self.brokers, reason=reason,
+                                              skip_hard_goal_check=True)
         if pt == "DEMOTE_BROKER":
             return cruise_control.demote_brokers(self.brokers, reason=reason)
         if pt == "REBALANCE":

@@ -125,6 +125,24 @@ class AnomalyDetectorManager:
                 n += 1
         return n
 
+    def _degraded(self) -> bool:
+        degraded = getattr(self._cc, "degraded", None)
+        return bool(degraded is not None and degraded())
+
+    def _backend_unavailable(self, e: Exception) -> bool:
+        """A fix failure that is really backend unavailability: an open/just
+        -tripped circuit, declared degradation, or completeness gating."""
+        from cruise_control_tpu.common.retries import (
+            CircuitOpenError, ServiceUnavailableError,
+        )
+        from cruise_control_tpu.monitor.load_monitor import (
+            NotEnoughValidWindowsError,
+        )
+        if isinstance(e, (CircuitOpenError, ServiceUnavailableError,
+                          NotEnoughValidWindowsError)):
+            return True
+        return self._degraded()
+
     def next_due_ms(self) -> float | None:
         """Earliest scheduled detector wake-up (None = nothing scheduled)."""
         dues = [slot[2] for slot in self._detectors.values()
@@ -148,7 +166,24 @@ class AnomalyDetectorManager:
                 break
             verdict = self._notifier.on_anomaly(anomaly, now_ms)
             entry = {"anomaly": anomaly.to_json(), "action": verdict.action.value}
-            if verdict.action is Action.FIX and self._cc is not None:
+            if (verdict.action is Action.FIX and self._cc is not None
+                    and self._degraded()):
+                # backend boundary unhealthy (open circuit breaker): firing
+                # the fix now would only burn consecutive self-healing
+                # failures against a backend that cannot actuate — defer it
+                # like a CHECK verdict until the breaker's reset timeout and
+                # re-enter the queue then (common/retries.py degradation
+                # contract)
+                delay_ms = max(
+                    self._cc.fault_tolerance.retry_after_s() * 1000.0, 1000.0)
+                entry["action"] = Action.CHECK.value
+                entry["deferred"] = "backend degraded"
+                sensors = getattr(self._cc, "sensors", None)
+                if sensors is not None:
+                    sensors.meter("self-healing-fix-deferrals").mark()
+                with self._lock:
+                    self._deferred.append((now_ms + delay_ms, anomaly))
+            elif verdict.action is Action.FIX and self._cc is not None:
                 sensors = getattr(self._cc, "sensors", None)
                 try:
                     if (anomaly.anomaly_type is AnomalyType.MAINTENANCE_EVENT
@@ -175,10 +210,25 @@ class AnomalyDetectorManager:
                         sensors.timer("anomaly-detection-to-fix-timer").record(
                             max(now_ms - anomaly.detected_ms, 0.0) / 1000.0)
                 except Exception as e:
-                    LOG.exception("self-healing fix failed for %s", anomaly)
-                    entry["fixError"] = str(e)
-                    if sensors is not None:
-                        sensors.meter("self-healing-fix-failures").mark()
+                    if self._backend_unavailable(e):
+                        # the fix failed BECAUSE the backend boundary is
+                        # unhealthy (the failure may itself have tripped the
+                        # breaker): defer and retry after the reset window
+                        # instead of burning a consecutive-failure count
+                        delay_ms = max(self._cc.fault_tolerance.retry_after_s()
+                                       * 1000.0, 1000.0)
+                        entry.pop("fixResult", None)
+                        entry["action"] = Action.CHECK.value
+                        entry["deferred"] = "backend degraded"
+                        if sensors is not None:
+                            sensors.meter("self-healing-fix-deferrals").mark()
+                        with self._lock:
+                            self._deferred.append((now_ms + delay_ms, anomaly))
+                    else:
+                        LOG.exception("self-healing fix failed for %s", anomaly)
+                        entry["fixError"] = str(e)
+                        if sensors is not None:
+                            sensors.meter("self-healing-fix-failures").mark()
             elif verdict.action is Action.CHECK:
                 with self._lock:
                     self._deferred.append((now_ms + verdict.delay_ms, anomaly))

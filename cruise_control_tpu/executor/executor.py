@@ -24,6 +24,7 @@ from collections import deque
 
 LOG = logging.getLogger(__name__)
 
+from cruise_control_tpu.common.retries import NON_RETRYABLE_ERRORS
 from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
 from cruise_control_tpu.executor.strategy import build_strategy
 from cruise_control_tpu.executor.task import ExecutionTask, TaskState, TaskType
@@ -301,7 +302,7 @@ class ConcurrencyAdjuster:
 
 class Executor:
     def __init__(self, backend, config=None, clock=None, strategy_names=None,
-                 sensors=None):
+                 sensors=None, fault_tolerance=None):
         from cruise_control_tpu.common.sensors import MetricRegistry
         self._sensors = sensors if sensors is not None else MetricRegistry()
         # Executor sensor catalog (Sensors.md): ongoing-execution gauge +
@@ -362,6 +363,50 @@ class Executor:
                                              clock=self._clock)
         self._last_adjust_ms = -1e18  # concurrency.adjuster.interval.ms gate
         self._slow_task_alerts: dict[int, float] = {}  # task_id -> last alert ms
+        # fault tolerance at the backend boundary (common/retries.py):
+        # movement submission and progress verification retry transient
+        # failures with jittered backoff ON THE INJECTED CLOCK and sit behind
+        # per-class circuit breakers ("executor.submit" / "executor.verify").
+        # When a breaker is open the execution PAUSES mid-batch — unsubmitted
+        # tasks stay PENDING, in-flight census untouched — and resumes via
+        # the breaker's half-open probe instead of wedging or crashing.
+        # app.py passes its shared instance so REST serving degrades on the
+        # same breaker state the executor observes.
+        if fault_tolerance is None:
+            from cruise_control_tpu.common.retries import BackendFaultTolerance
+            fault_tolerance = BackendFaultTolerance(
+                config, clock_ms=self._clock.now_ms, sensors=self._sensors)
+        self._ft = fault_tolerance
+        self._paused = False
+        self._pause_ticks = 0
+        self._pause_meter = self._sensors.meter("executor-backend-pauses")
+
+    @property
+    def fault_tolerance(self):
+        return self._ft
+
+    @property
+    def paused(self) -> bool:
+        """True while the current execution is waiting out a backend
+        failure/open breaker (mid-batch pause)."""
+        return self._paused
+
+    def _pause_tick(self, what: str) -> None:
+        """One paused progress tick: record it and sleep the progress
+        interval on the injected clock (the breaker's reset timeout runs on
+        the same clock, so the next tick may probe HALF_OPEN)."""
+        if not self._paused:
+            LOG.warning("execution paused: backend %s unavailable "
+                        "(breakers: %s)", what, self._ft.open_circuits())
+        self._paused = True
+        self._pause_ticks += 1
+        self._pause_meter.mark()
+        self._clock.sleep_ms(self._cfg.progress_check_interval_ms)
+
+    def _resume_if_paused(self) -> None:
+        if self._paused:
+            self._paused = False
+            LOG.info("execution resumed: backend reachable again")
 
     # ---------------------------------------------------------- reservation
     def reserve(self, owner: str) -> None:
@@ -516,7 +561,14 @@ class Executor:
         self._last_adjust_ms = -1e18
         planner = ExecutionTaskPlanner(strategy)
         if context is None:
-            sizes = {tp: info.size_mb for tp, info in self._backend.partitions().items()}
+            try:
+                partitions = self._ft.call("executor.verify",
+                                           self._backend.partitions)
+                sizes = {tp: info.size_mb for tp, info in partitions.items()}
+            except Exception:
+                # strategy sort degrades gracefully without sizes; the
+                # execution itself retries/pauses through the same breakers
+                sizes = {}
             context = {"partition_size_mb": sizes}
         self._operation = context.get("operation", "proposal execution")
         self._slow_task_alerts.clear()
@@ -533,6 +585,11 @@ class Executor:
         t = self._execution_thread
         if t is not None:
             t.join(timeout_s)
+            if not t.is_alive():
+                # drop the finished thread so repeated non-blocking
+                # executions can never accumulate handler-thread references
+                # (asserted by the REST fuzz thread-leak test)
+                self._execution_thread = None
 
     # ----------------------------------------------------------- throttling
     def _set_throttles(self, planner: ExecutionTaskPlanner) -> tuple:
@@ -543,7 +600,18 @@ class Executor:
         move of this execution."""
         if not self._cfg.throttle_bytes_per_sec:
             return False, []
-        self._backend.set_replication_throttle(self._cfg.throttle_bytes_per_sec)
+        try:
+            self._ft.call("executor.submit",
+                          self._backend.set_replication_throttle,
+                          self._cfg.throttle_bytes_per_sec,
+                          sleep_ms=self._clock.sleep_ms)
+        except Exception:
+            # an unreachable throttle config must not kill the execution; it
+            # proceeds unthrottled (the reference logs and continues too)
+            LOG.exception("failed to set replication throttle; "
+                          "executing unthrottled")
+            self._sensors.meter("throttle-set-failures").mark()
+            return False, []
         set_topic_config = getattr(self._backend, "set_topic_config", None)
         if set_topic_config is None:   # backend without topic-config support
             return True, []
@@ -558,29 +626,52 @@ class Executor:
             for b in p.replicas_to_add:
                 follower.setdefault(p.topic, set()).add(f"{p.partition}:{b}")
         topics = sorted(set(leader) | set(follower))
+        applied = []
         for topic in topics:
-            set_topic_config(topic, "leader.replication.throttled.replicas",
-                             ",".join(sorted(leader.get(topic, ()))))
-            set_topic_config(topic, "follower.replication.throttled.replicas",
-                             ",".join(sorted(follower.get(topic, ()))))
-        return True, topics
+            try:
+                set_topic_config(topic, "leader.replication.throttled.replicas",
+                                 ",".join(sorted(leader.get(topic, ()))))
+                set_topic_config(topic,
+                                 "follower.replication.throttled.replicas",
+                                 ",".join(sorted(follower.get(topic, ()))))
+            except Exception:
+                LOG.exception("failed to set throttled-replica lists for %s",
+                              topic)
+                self._sensors.meter("throttle-set-failures").mark()
+                continue
+            applied.append(topic)
+        return True, applied
 
     def _clear_throttles(self, throttled: bool, topics: list) -> None:
         """ReplicationThrottleHelper cleanup (:200): remove the rate and every
         per-topic list, including on stop/force-stop paths."""
         if not throttled:
             return
-        self._backend.set_replication_throttle(None)
+        try:
+            self._ft.call("executor.submit",
+                          self._backend.set_replication_throttle, None,
+                          sleep_ms=self._clock.sleep_ms)
+        except Exception:
+            LOG.exception("failed to clear the replication throttle")
+            self._sensors.meter("throttle-clear-failures").mark()
         set_topic_config = getattr(self._backend, "set_topic_config", None)
         if set_topic_config is None:
             return
         for topic in topics:
-            set_topic_config(topic, "leader.replication.throttled.replicas", None)
-            set_topic_config(topic, "follower.replication.throttled.replicas", None)
+            try:
+                set_topic_config(topic,
+                                 "leader.replication.throttled.replicas", None)
+                set_topic_config(topic,
+                                 "follower.replication.throttled.replicas", None)
+            except Exception:
+                LOG.exception("failed to clear throttled-replica lists for %s",
+                              topic)
+                self._sensors.meter("throttle-clear-failures").mark()
 
     # ------------------------------------------------------------ internals
     def _run_execution(self, planner: ExecutionTaskPlanner) -> None:
         throttled, throttled_topics = False, []
+        self._paused = False
         t0_ms = self._clock.now_ms()
         try:
             throttled, throttled_topics = self._set_throttles(planner)
@@ -603,6 +694,7 @@ class Executor:
             })
             with self._lock:
                 self._state = ExecutorState.NO_TASK_IN_PROGRESS
+                self._paused = False
             if self._notifier is not None:
                 # ExecutorNotifier SPI (executor.notifier.class): one
                 # notification per finished execution
@@ -631,15 +723,35 @@ class Executor:
             if self._stop_requested:
                 self._state = ExecutorState.STOPPING_EXECUTION
                 if self._force_stop and in_flight:
-                    self._backend.cancel_reassignments(list(in_flight))
+                    try:
+                        self._ft.call("executor.submit",
+                                      self._backend.cancel_reassignments,
+                                      list(in_flight),
+                                      sleep_ms=self._clock.sleep_ms)
+                    except NON_RETRYABLE_ERRORS:
+                        raise
+                    except Exception:
+                        # cancellation unreachable: the reassignments are
+                        # still running backend-side — keep polling instead
+                        # of faking an ABORTED census
+                        self._pause_tick("cancel")
+                        continue
                     for t in in_flight.values():
                         t.transition(TaskState.ABORTING, self._clock.now_ms())
                         t.transition(TaskState.ABORTED, self._clock.now_ms())
                     in_flight.clear()
                 if not in_flight:
                     return
-            # completion check
-            ongoing = self._backend.ongoing_reassignments()
+            # completion check — verification failures skip the tick with the
+            # census untouched (a task is only COMPLETED on positive evidence)
+            try:
+                ongoing = self._ft.call("executor.verify",
+                                        self._backend.ongoing_reassignments)
+            except NON_RETRYABLE_ERRORS:
+                raise
+            except Exception:
+                self._pause_tick("verification")
+                continue
             finished = [tp for tp in in_flight if tp not in ongoing]
             for tp in finished:
                 t = in_flight.pop(tp)
@@ -652,24 +764,44 @@ class Executor:
             # type (concurrency.adjuster.inter.broker.replica.enabled)
             if (self._cfg.adjuster_enabled and self._cfg.adjuster_replica_enabled
                     and self._adjuster_due()):
-                self._cfg.per_broker_cap = self._adjuster.recommend_replica_concurrency(
-                    self._cfg.per_broker_cap, self._backend.broker_metrics())
+                try:
+                    metrics = self._ft.call("executor.verify",
+                                            self._backend.broker_metrics)
+                    self._cfg.per_broker_cap = \
+                        self._adjuster.recommend_replica_concurrency(
+                            self._cfg.per_broker_cap, metrics)
+                except Exception:
+                    pass   # keep the current cap; metrics return next tick
             self._alert_slow_tasks(in_flight)
             if not self._stop_requested:
                 batch = planner.next_inter_broker_tasks(
                     in_flight_by_broker, self._cfg.per_broker_cap,
                     min(self._cfg.cluster_cap, self._cfg.total_movement_cap),
                     len(in_flight))
-                assignments = {}
-                for t in batch:
-                    target = [b for b, _ in t.proposal.new_replicas]
-                    assignments[t.tp] = target
-                    t.transition(TaskState.IN_PROGRESS, self._clock.now_ms())
-                    in_flight[t.tp] = t
-                    for b in t.brokers_involved:
-                        in_flight_by_broker[b] = in_flight_by_broker.get(b, 0) + 1
+                assignments = {t.tp: [b for b, _ in t.proposal.new_replicas]
+                               for t in batch}
                 if assignments:
-                    self._backend.alter_partition_reassignments(assignments)
+                    # submit BEFORE any state transition: a failed submission
+                    # leaves the batch PENDING (the planner re-picks it once
+                    # the breaker's half-open probe succeeds) — pause, not
+                    # wedge, and never a task marked IN_PROGRESS that the
+                    # backend never saw
+                    try:
+                        self._ft.call("executor.submit",
+                                      self._backend.alter_partition_reassignments,
+                                      assignments,
+                                      sleep_ms=self._clock.sleep_ms)
+                    except NON_RETRYABLE_ERRORS:
+                        raise
+                    except Exception:
+                        self._pause_tick("movement submission")
+                        continue
+                    for t in batch:
+                        t.transition(TaskState.IN_PROGRESS, self._clock.now_ms())
+                        in_flight[t.tp] = t
+                        for b in t.brokers_involved:
+                            in_flight_by_broker[b] = in_flight_by_broker.get(b, 0) + 1
+            self._resume_if_paused()
             if not in_flight and not planner.remaining_inter_broker:
                 return
             self._clock.sleep_ms(self._cfg.progress_check_interval_ms)
@@ -678,20 +810,66 @@ class Executor:
         self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT
         tasks = planner.next_intra_broker_tasks({}, self._cfg.intra_broker_cap)
         while tasks:
+            # re-validate against CURRENT metadata: a fault mid-execution
+            # (RF shrink, reassignment landing) may have moved a replica off
+            # the broker since the proposal was computed — submitting would
+            # only be rejected backend-side, so the task goes DEAD like an
+            # ineligible leadership election, and the rest of the batch
+            # proceeds instead of the whole execution aborting
+            try:
+                partitions = self._ft.call("executor.verify",
+                                           self._backend.partitions)
+            except NON_RETRYABLE_ERRORS:
+                raise
+            except Exception:
+                self._pause_tick("logdir move verification")
+                if self._stop_requested:
+                    return
+                continue
             moves = {}
+            live, dead = [], []
             for t in tasks:
                 old = dict(t.proposal.old_replicas)
+                info = partitions.get(t.tp)
+                t_moves = {}
                 for b, d in t.proposal.new_replicas:
                     if old.get(b) is not None and old[b] != d:
                         # logdir index -> name resolution happens backend-side;
                         # the proposal carries the index
-                        moves[(t.proposal.topic, t.proposal.partition, b)] = d
-                t.transition(TaskState.IN_PROGRESS, self._clock.now_ms())
+                        if info is None or b not in info.replicas:
+                            t_moves = None      # replica gone: task is dead
+                            break
+                        t_moves[(t.proposal.topic, t.proposal.partition, b)] = d
+                if t_moves is None:
+                    dead.append(t)
+                else:
+                    live.append(t)
+                    moves.update(t_moves)
             if moves:
-                resolved = self._resolve_logdirs(moves)
-                self._backend.alter_replica_logdirs(resolved)
-            for t in tasks:
-                t.transition(TaskState.COMPLETED, self._clock.now_ms())
+                # resolve + submit before transitioning: a failed batch stays
+                # PENDING and is re-picked once the backend returns
+                try:
+                    resolved = self._ft.call(
+                        "executor.verify", self._resolve_logdirs, moves)
+                    self._ft.call("executor.submit",
+                                  self._backend.alter_replica_logdirs,
+                                  resolved, sleep_ms=self._clock.sleep_ms)
+                except NON_RETRYABLE_ERRORS:
+                    raise
+                except Exception:
+                    self._pause_tick("logdir move submission")
+                    if self._stop_requested:
+                        return
+                    tasks = planner.next_intra_broker_tasks(
+                        {}, self._cfg.intra_broker_cap)
+                    continue
+            self._resume_if_paused()
+            now = self._clock.now_ms()
+            for t in dead:
+                t.transition(TaskState.DEAD, now)
+            for t in live:
+                t.transition(TaskState.IN_PROGRESS, now)
+                t.transition(TaskState.COMPLETED, now)
             if self._stop_requested:
                 return
             tasks = planner.next_intra_broker_tasks({}, self._cfg.intra_broker_cap)
@@ -720,18 +898,31 @@ class Executor:
             if (self._cfg.adjuster_enabled
                     and self._cfg.adjuster_leadership_enabled
                     and self._adjuster_due()):
-                self._cfg.leadership_cap = \
-                    self._adjuster.recommend_leadership_concurrency(
-                        self._cfg.leadership_cap, self._backend.broker_metrics())
+                try:
+                    metrics = self._ft.call("executor.verify",
+                                            self._backend.broker_metrics)
+                    self._cfg.leadership_cap = \
+                        self._adjuster.recommend_leadership_concurrency(
+                            self._cfg.leadership_cap, metrics)
+                except Exception:
+                    pass   # keep the current cap
             batch = planner.next_leadership_tasks(
                 min(self._cfg.leadership_cap, self._cfg.total_movement_cap))
             if not batch:
                 return
+            try:
+                partitions = self._ft.call("executor.verify",
+                                           self._backend.partitions)
+                brokers = self._ft.call("executor.verify",
+                                        self._backend.brokers)
+            except NON_RETRYABLE_ERRORS:
+                raise
+            except Exception:
+                self._pause_tick("leadership verification")
+                continue
             elections = {}
-            partitions = self._backend.partitions()
-            brokers = self._backend.brokers()
+            eligible, dead = [], []
             for t in batch:
-                t.transition(TaskState.IN_PROGRESS, self._clock.now_ms())
                 info = partitions.get(t.tp)
                 target = t.proposal.new_leader
                 # the target may have died since the proposal was computed
@@ -742,11 +933,31 @@ class Executor:
                         and brokers.get(target) is not None
                         and brokers[target].alive):
                     elections[t.tp] = target
+                    eligible.append(t)
                 else:
-                    t.transition(TaskState.DEAD, self._clock.now_ms())
+                    dead.append(t)
             if elections:
-                self._backend.elect_leaders(elections)
-                self._await_leadership(elections, planner, batch)
+                # submit before transitioning (pause/resume semantics as in
+                # the inter-broker phase: a failed election batch stays
+                # PENDING, including its DEAD candidates — re-derived from
+                # fresh metadata on resume)
+                try:
+                    self._ft.call("executor.submit",
+                                  self._backend.elect_leaders, elections,
+                                  sleep_ms=self._clock.sleep_ms)
+                except NON_RETRYABLE_ERRORS:
+                    raise
+                except Exception:
+                    self._pause_tick("leadership submission")
+                    continue
+            self._resume_if_paused()
+            now = self._clock.now_ms()
+            for t in dead:
+                t.transition(TaskState.DEAD, now)
+            for t in eligible:
+                t.transition(TaskState.IN_PROGRESS, now)
+            if elections:
+                self._await_leadership(elections, planner, eligible)
 
     def _await_leadership(self, elections: dict, planner, batch: list) -> None:
         """Wait for submitted elections to take effect, up to
@@ -760,7 +971,18 @@ class Executor:
         pending = {t.tp: t for t in batch if t.tp in elections}
         deadline = self._clock.now_ms() + self._cfg.leader_movement_timeout_ms
         while pending:
-            partitions = self._backend.partitions()
+            try:
+                partitions = self._ft.call("executor.verify",
+                                           self._backend.partitions)
+            except NON_RETRYABLE_ERRORS:
+                raise
+            except Exception:
+                # metadata unavailable: no landing evidence this poll; the
+                # deadline below still bounds the wait
+                if self._clock.now_ms() < deadline and not self._stop_requested:
+                    self._pause_tick("leadership progress check")
+                    continue
+                partitions = {}
             landed = [tp for tp, t in pending.items()
                       if getattr(partitions.get(tp), "leader", None)
                       == t.proposal.new_leader]
@@ -808,6 +1030,9 @@ class Executor:
         out["numCompletedTasksTotal"] = sum(h["numCompleted"]
                                             for h in self._history)
         out["numPlannedTasksTotal"] = sum(h["numTasks"] for h in self._history)
+        out["paused"] = self._paused
+        out["numPauseTicks"] = self._pause_ticks
+        out["backendFaultTolerance"] = self._ft.state_json()
         if self._cfg.adjuster_enabled:
             out["concurrencyAdjuster"] = {
                 "perBrokerCap": self._cfg.per_broker_cap,

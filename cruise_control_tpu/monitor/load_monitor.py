@@ -79,9 +79,17 @@ class LoadMonitorState:
 
 class LoadMonitor:
     def __init__(self, config=None, backend=None, sampler=None, sample_store=None,
-                 capacity_resolver=None, sensors=None, recorder=None):
+                 capacity_resolver=None, sensors=None, recorder=None,
+                 fault_tolerance=None):
         from cruise_control_tpu.common.sensors import MetricRegistry
         self._sensors = sensors if sensors is not None else MetricRegistry()
+        # backend fault tolerance (common/retries.py): sampling rounds retry
+        # transient backend failures and sit behind the shared
+        # "monitor.sample" circuit breaker — a flaky metrics endpoint skips
+        # rounds (windows age out, completeness gates serving) instead of
+        # crashing the sampling loop. app.py passes its shared instance.
+        self._ft = fault_tolerance
+        self._sampling_failures = self._sensors.meter("sampling-fetch-failures")
         # flight recorder (common/tracing.py): sampling rounds note their
         # seconds so the next optimization's RoundTrace carries sampling_s
         self._recorder = recorder
@@ -171,6 +179,20 @@ class LoadMonitor:
         self.on_execution_store = (config.get_configured_instance(
             "sample.partition.metric.store.on.execution.class")
             if config else None)
+
+    def _metadata_read(self, fn):
+        """One model-build metadata read through the shared breaker: raw
+        transient errors / open circuits become the DECLARED degraded-read
+        signal (ServiceUnavailableError) the REST layer maps to 503."""
+        if self._ft is None:
+            return fn()
+        from cruise_control_tpu.common.retries import ServiceUnavailableError
+        try:
+            return self._ft.call("monitor.sample", fn)
+        except Exception as e:
+            raise ServiceUnavailableError(
+                f"cluster metadata unavailable ({type(e).__name__}: {e})",
+                retry_after_s=self._ft.retry_after_s()) from e
 
     def _snapshot(self):
         """Columnar metadata: the backend's native ``snapshot()`` when it has
@@ -338,20 +360,37 @@ class LoadMonitor:
             return 0
         t0 = time.monotonic()
         now = now_ms if now_ms is not None else time.time() * 1000.0
-        # the fetcher pool splits the partition universe across concurrent
-        # fetchers (MetricFetcherManager + partition assignor role)
-        if self._fetchers is not None and self._backend is not None:
-            if (self._partition_list_cache is None
-                    or now - self._partition_list_ts >= self._metadata_max_age_ms):
-                # the columnar snapshot carries the sorted key list already —
-                # no need to materialize the PartitionInfo dict for it
-                self._partition_list_cache = (
-                    list(self._snapshot().partition_keys) if self._use_snapshot
-                    else list(self._backend.partitions()))
-                self._partition_list_ts = now
-            samples = self._fetchers.fetch_once(now, self._partition_list_cache)
-        else:
-            samples = self._sampler.get_samples(now)
+
+        def fetch():
+            # the fetcher pool splits the partition universe across concurrent
+            # fetchers (MetricFetcherManager + partition assignor role)
+            if self._fetchers is not None and self._backend is not None:
+                if (self._partition_list_cache is None
+                        or now - self._partition_list_ts
+                        >= self._metadata_max_age_ms):
+                    # the columnar snapshot carries the sorted key list
+                    # already — no need to materialize the PartitionInfo
+                    # dict for it
+                    self._partition_list_cache = (
+                        list(self._snapshot().partition_keys)
+                        if self._use_snapshot
+                        else list(self._backend.partitions()))
+                    self._partition_list_ts = now
+                return self._fetchers.fetch_once(now, self._partition_list_cache)
+            return self._sampler.get_samples(now)
+
+        try:
+            samples = (self._ft.call("monitor.sample", fetch)
+                       if self._ft is not None else fetch())
+        except Exception:
+            # a failed round is a SKIPPED round, not a crashed sampling loop:
+            # windows simply don't advance (completeness gating degrades
+            # serving if this persists past the window budget)
+            self._sampling_failures.mark()
+            import logging
+            logging.getLogger(__name__).warning(
+                "sampling round skipped: backend fetch failed", exc_info=True)
+            return 0
         n = self._ingest(samples)
         if self._store is not None:
             self._store.store_samples(samples)
@@ -463,9 +502,9 @@ class LoadMonitor:
         the resident session's broker-axis refresh so the two can never
         diverge on capacity/logdir semantics."""
         if brokers is None:
-            brokers = self._backend.brokers()
+            brokers = self._metadata_read(self._backend.brokers)
         if logdir_state is None:
-            logdir_state = self._backend.describe_logdirs()
+            logdir_state = self._metadata_read(self._backend.describe_logdirs)
         lds_by_broker: dict = {}     # broker id -> ordered logdir names
         dead_by_broker: dict = {}    # broker id -> set of dead names
         for b, node in brokers.items():
@@ -609,11 +648,19 @@ class LoadMonitor:
                     f"{req.min_required_num_windows}")
             snap = None
             partitions = None
+            # the build's metadata read shares the sampling breaker: a
+            # backend outage surfaces here as a DECLARED degraded read
+            # (ServiceUnavailableError -> 503 + Retry-After; the proposals
+            # path falls back to its stale cache) instead of a raw metadata
+            # error mid-build. NOTE: only this deterministic caller rides
+            # the breaker — the wall-clock-cached metadata-factor gauge
+            # keeps its direct read so scrape counts can never shift
+            # breaker state
             if use_snap:
-                snap = self._snapshot()
+                snap = self._metadata_read(self._snapshot)
                 num_partitions = snap.num_partitions
             else:
-                partitions = self._backend.partitions()
+                partitions = self._metadata_read(self._backend.partitions)
                 num_partitions = len(partitions)
             if num_partitions:
                 valid_frac = float(agg.entity_valid.sum()) / num_partitions
@@ -621,7 +668,7 @@ class LoadMonitor:
                     raise NotEnoughValidWindowsError(
                         f"monitored partition ratio {valid_frac:.3f} < required "
                         f"{req.min_monitored_partitions_percentage:.3f}")
-            brokers = self._backend.brokers()
+            brokers = self._metadata_read(self._backend.brokers)
             builder = ClusterModelBuilder()
             lds_by_broker, dead_by_broker = self.populate_brokers(
                 builder, brokers,

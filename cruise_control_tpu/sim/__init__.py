@@ -23,6 +23,10 @@ from cruise_control_tpu.sim.campaign import (
     CAMPAIGNS, CampaignResult, CampaignRunner, CampaignSpec,
     generate_episode, run_campaign,
 )
+from cruise_control_tpu.sim.api_fuzz import (
+    ApiFuzzer, FaultyBackend, FuzzEpisodeResult, FuzzSpec,
+    TransientBackendError, run_fuzz_campaign, run_fuzz_episode,
+)
 
 __all__ = [
     "SCENARIOS", "check_converged", "check_executor_accounting", "check_tick",
@@ -33,4 +37,6 @@ __all__ = [
     "scenario_from_json", "scenario_to_json", "slow_broker", "topic_creation",
     "CAMPAIGNS", "CampaignResult", "CampaignRunner", "CampaignSpec",
     "generate_episode", "run_campaign",
+    "ApiFuzzer", "FaultyBackend", "FuzzEpisodeResult", "FuzzSpec",
+    "TransientBackendError", "run_fuzz_campaign", "run_fuzz_episode",
 ]

@@ -1,0 +1,560 @@
+"""Concurrent REST fuzzing inside chaos campaigns.
+
+PR 8 built the fault *injector* (sim/campaign.py draws compound backend
+faults); this module turns the fuzzer on the service's own front door: a
+seeded REST fuzzer (:class:`ApiFuzzer`) drives user tasks — rebalance /
+stop / state / proposals, valid AND malformed parameters, User-Task-ID
+resumption races — against a LIVE :class:`~cruise_control_tpu.api.server.
+CruiseControlServer` over real HTTP, *while* a campaign episode injects
+faults through :class:`FaultyBackend` (seeded transient errors, latency
+spikes, partial responses at the backend boundary the PR's retry/breaker
+layer defends).
+
+Determinism contract (the campaign bar, extended to the REST surface):
+the fuzzer runs in LOCKSTEP with the scenario tick loop — its request
+schedule is a pure function of the fuzz seed, requests are issued
+sequentially from the tick hook, and mutating operations block to
+completion before the next request — so at any instant at most one thread
+advances the simulated clock. Same (campaign, fuzz-seed) therefore
+reproduces a bit-identical episode log: the scenario timeline, the fuzz
+log (endpoint, params, status bucket, staleness flags, dedup verdicts) and
+every invariant verdict. Wall-clock-dependent values (task UUIDs, start
+timestamps, latency) are deliberately never recorded.
+
+Invariants checked per episode (failures land in ``fuzz_failures``):
+
+- **no undeclared 500s** — every response status must be in the op's
+  declared set; degraded reads/writes are DECLARED as 503 + Retry-After,
+  parameter garbage as 400/404/405/429, everything else 2xx.
+- **user-task census consistent** — every task id the fuzzer ever saw in a
+  ``User-Task-ID`` response header is listed by GET /user_tasks.
+- **no duplicate executions from racing triggers** — resuming a completed
+  mutating task via its User-Task-ID (sequentially and from two racing
+  threads) returns the cached result and never re-executes (executor
+  ``numExecutions`` stays flat).
+"""
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import random
+import threading
+import urllib.parse
+import zlib
+
+
+class TransientBackendError(RuntimeError):
+    """The injected backend fault: callers must retry, not die."""
+
+
+def _hash01(key: str) -> float:
+    """crc32-based stable uniform draw in [0, 1): process-independent
+    (PYTHONHASHSEED-free) and stateless — the verdict for (method, time
+    bucket) never depends on HOW MANY calls happened before it, so
+    nondeterministic call counts (gauge scrapes, retries) can't shift the
+    fault schedule."""
+    return (zlib.crc32(key.encode("utf-8")) & 0xFFFFFFFF) / 2.0 ** 32
+
+
+class FaultyBackend:
+    """Seeded fault-injecting ClusterBackend wrapper.
+
+    Control-plane-facing reads/writes fail transiently, run slow (a
+    simulated-time latency spike) or return partial data inside the
+    configured fault windows; the simulation surface (clock, scheduling,
+    fault injection, ``inner``) always passes through untouched so the
+    scenario engine and its invariant oracle keep ground truth.
+    """
+
+    FAULTED_READS = (
+        "brokers", "partitions", "snapshot", "partition_metrics",
+        "partition_metrics_columnar", "broker_metrics", "describe_logdirs",
+        "ongoing_reassignments", "topic_configs",
+    )
+    FAULTED_WRITES = (
+        "alter_partition_reassignments", "elect_leaders",
+        "alter_replica_logdirs", "cancel_reassignments",
+        "set_replication_throttle", "set_topic_config",
+    )
+    # partial responses only make sense for per-broker maps; structural
+    # metadata stays whole (a partial partitions() would look like topic
+    # deletion, which is a different fault)
+    PARTIAL_CAPABLE = ("broker_metrics", "describe_logdirs")
+
+    def __init__(self, inner, seed: int = 0, windows=((0.0, float("inf")),),
+                 error_rate: float = 0.25, latency_rate: float = 0.0,
+                 partial_rate: float = 0.0, latency_ms: float = 200.0,
+                 bucket_ms: float = 1000.0):
+        self.inner = inner
+        self._seed = seed
+        self._windows = tuple((float(a), float(b)) for a, b in windows)
+        self._base_ms = 0.0          # arm() rebases windows to scenario start
+        self._error_rate = error_rate
+        self._latency_rate = latency_rate
+        self._partial_rate = partial_rate
+        self._latency_ms = latency_ms
+        self._bucket_ms = bucket_ms
+        self.fault_counts: dict[str, int] = {"error": 0, "latency": 0,
+                                             "partial": 0}
+        self._lock = threading.Lock()
+
+    def arm(self, t0_ms: float) -> None:
+        """Windows are relative to scenario start; the runner arms us at t0."""
+        self._base_ms = float(t0_ms)
+
+    def _in_window(self, now: float) -> bool:
+        rel = now - self._base_ms
+        return any(a <= rel < b for a, b in self._windows)
+
+    def _verdict(self, method: str) -> str | None:
+        now = float(self.inner.now_ms())
+        if not self._in_window(now):
+            return None
+        bucket = int(now // self._bucket_ms)
+        u = _hash01(f"{self._seed}/{method}/{bucket}")
+        if u < self._error_rate:
+            return "error"
+        if u < self._error_rate + self._latency_rate:
+            return "latency"
+        if (u < self._error_rate + self._latency_rate + self._partial_rate
+                and method in self.PARTIAL_CAPABLE):
+            return "partial"
+        return None
+
+    def _faulted(self, method: str, *args, **kwargs):
+        verdict = self._verdict(method)
+        if verdict == "error":
+            with self._lock:
+                self.fault_counts["error"] += 1
+            raise TransientBackendError(
+                f"injected transient fault: {method} at "
+                f"{self.inner.now_ms():.0f} ms")
+        if verdict == "latency":
+            with self._lock:
+                self.fault_counts["latency"] += 1
+            # a latency spike on SIMULATED time: the slow call burns sim
+            # milliseconds, racing the scenario's scheduled faults
+            self.inner.advance(self._latency_ms)
+        result = getattr(self.inner, method)(*args, **kwargs)
+        if verdict == "partial":
+            with self._lock:
+                self.fault_counts["partial"] += 1
+            bucket = int(float(self.inner.now_ms()) // self._bucket_ms)
+            result = {k: v for k, v in result.items()
+                      if _hash01(f"{self._seed}/partial/{k}/{bucket}") >= 0.5}
+        return result
+
+    def __getattr__(self, name):
+        inner_attr = getattr(self.inner, name)
+        if name in self.FAULTED_READS or name in self.FAULTED_WRITES:
+            def wrapped(*args, **kwargs):
+                return self._faulted(name, *args, **kwargs)
+            return wrapped
+        return inner_attr
+
+
+# --------------------------------------------------------------- the fuzzer
+@dataclasses.dataclass(frozen=True)
+class FuzzSpec:
+    """Seeded request-schedule shape. The schedule is a pure function of
+    (spec, fuzz_seed): op kinds drawn by weight, spread one-per-slot over
+    ``ticks`` ticks starting at ``start_tick``."""
+    ops: int = 16
+    start_tick: int = 1
+    ticks: int = 24
+    mutate: bool = True        # include non-dry-run rebalance triggers
+    weights: tuple = (
+        ("state", 2.0), ("proposals", 2.0), ("rebalance_dryrun", 1.5),
+        ("user_tasks", 1.0), ("metrics", 1.0), ("malformed", 2.0),
+        ("rebalance_execute", 1.0), ("stop", 0.5), ("resume_race", 1.0),
+    )
+
+
+# malformed-request catalog: (label, method, path+query, expected statuses).
+# Rotated deterministically by the schedule RNG.
+_MALFORMED = (
+    ("unknown_param", "GET", "/proposals?bogus_param=1", ("400",)),
+    ("bad_int", "POST",
+     "/rebalance?concurrent_leader_movements=banana&reason=fuzz", ("400",)),
+    ("unknown_endpoint", "GET", "/definitely_not_an_endpoint", ("404",)),
+    ("wrong_method", "GET", "/rebalance", ("405",)),
+    ("bad_regex", "POST", "/rebalance?excluded_topics=[&reason=fuzz",
+     ("400",)),
+    ("missing_required", "POST", "/topic_configuration?reason=fuzz",
+     ("400",)),
+    ("bad_anomaly_type", "POST",
+     "/admin?disable_self_healing_for=NOT_A_TYPE&reason=fuzz", ("400",)),
+    ("bad_strategy", "POST",
+     "/rebalance?replica_movement_strategies=NoSuchStrategy&reason=fuzz",
+     ("400",)),
+)
+
+
+def _bucket(status: int) -> str:
+    if 200 <= status < 300:
+        return "2xx"
+    return str(status)
+
+
+def _classify(status: int, body: dict | None) -> str:
+    """Status bucket with DECLARED application failures split out: a typed
+    OptimizationFailureError (e.g. hard goals unsatisfiable on a genuinely
+    under-provisioned cluster) is the reference's documented rebalance
+    failure mode, not an undeclared crash — only untyped 500s stay '500'."""
+    bucket = _bucket(status)
+    if bucket == "500" and body is not None and str(
+            body.get("errorMessage", "")).startswith(
+            "OptimizationFailureError"):
+        return "optfail"
+    return bucket
+
+
+class ApiFuzzer:
+    """Lockstep REST fuzzer bound to a ScenarioRunner via its tick hook.
+
+    Owns the live :class:`CruiseControlServer` (created lazily around the
+    runner's app on first tick, real HTTP on a loopback port) and the
+    deterministic request schedule. Results: ``log`` (bit-reproducible per
+    fuzz seed), ``failures`` (invariant violations), ``observed_task_ids``.
+    """
+
+    def __init__(self, spec: FuzzSpec | None = None, fuzz_seed: int = 0,
+                 name: str = "fuzz"):
+        self.spec = spec or FuzzSpec()
+        self.fuzz_seed = fuzz_seed
+        self.name = name
+        self.log: list[dict] = []
+        self.failures: list[str] = []
+        self.observed_task_ids: list[str] = []
+        self._completed_mutations: list[tuple[str, str]] = []  # (task_id, query)
+        self._server = None
+        self._port = None
+        self._schedule = self._draw_schedule()
+        self._tick_index = 0
+        self.requests = 0
+
+    # ------------------------------------------------------------- schedule
+    def _draw_schedule(self) -> dict[int, list]:
+        rng = random.Random(f"{self.name}/fuzz/{self.fuzz_seed}")
+        weights = [(k, w) for k, w in self.spec.weights
+                   if self.spec.mutate or k not in ("rebalance_execute",)]
+        total = sum(w for _, w in weights)
+        by_tick: dict[int, list] = {}
+        for i in range(self.spec.ops):
+            x = rng.uniform(0.0, total)
+            acc, kind = 0.0, weights[-1][0]
+            for k, w in weights:
+                acc += w
+                if x <= acc:
+                    kind = k
+                    break
+            detail = None
+            if kind == "malformed":
+                detail = rng.randrange(len(_MALFORMED))
+            tick = self.spec.start_tick + rng.randrange(self.spec.ticks)
+            by_tick.setdefault(tick, []).append((i, kind, detail))
+        for ops in by_tick.values():
+            ops.sort()           # issue in draw order within a tick
+        return by_tick
+
+    # ---------------------------------------------------------------- http
+    def _ensure_server(self, runner) -> None:
+        if self._server is not None:
+            return
+        from cruise_control_tpu.api.server import CruiseControlServer
+        # generous max_block: lockstep ops complete inside one request, so
+        # the clock has exactly one advancing thread at a time
+        self._server = CruiseControlServer(
+            runner.cc, host="127.0.0.1", port=0, max_block_ms=600_000.0,
+            config=runner.cc.config)
+        self._server.start()
+        self._port = self._server.port
+
+    def _request(self, method: str, path_query: str,
+                 task_id: str | None = None):
+        """One HTTP request; returns (status, body_dict|None, task_header)."""
+        conn = http.client.HTTPConnection("127.0.0.1", self._port, timeout=600)
+        try:
+            headers = {"Content-Length": "0"} if method == "POST" else {}
+            if task_id is not None:
+                headers["User-Task-ID"] = task_id
+            conn.request(method, "/kafkacruisecontrol" + path_query,
+                         headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            self.requests += 1
+            body = None
+            ctype = resp.getheader("Content-Type") or ""
+            if "json" in ctype:
+                try:
+                    body = json.loads(raw.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    body = None
+            tid = resp.getheader("User-Task-ID")
+            if tid and tid not in self.observed_task_ids:
+                self.observed_task_ids.append(tid)
+            return resp.status, body, tid
+        finally:
+            conn.close()
+
+    # ----------------------------------------------------------------- ops
+    def tick(self, runner, now_ms: float) -> None:
+        """ScenarioRunner tick hook: issue this tick's scheduled requests."""
+        self._ensure_server(runner)
+        self._tick_index += 1
+        for i, kind, detail in self._schedule.get(self._tick_index, ()):
+            entry = {"op": i, "kind": kind, "tick": self._tick_index}
+            try:
+                self._run_op(runner, kind, detail, i, entry)
+            except Exception as e:  # noqa: BLE001 — an op crash is a finding
+                entry["status"] = "client-error"
+                self.failures.append(
+                    f"op {i} ({kind}): client raised {type(e).__name__}: {e}")
+            self.log.append(entry)
+
+    def _expect(self, entry: dict, status: int, expected: tuple,
+                body: dict | None = None) -> None:
+        bucket = _classify(status, body)
+        entry["status"] = bucket
+        if bucket not in expected:
+            self.failures.append(
+                f"op {entry['op']} ({entry['kind']}): undeclared status "
+                f"{status} (declared: {expected})")
+
+    def _run_op(self, runner, kind: str, detail, i: int, entry: dict) -> None:
+        degraded_ok = ("2xx", "503")
+        # optimization surfaces may also fail with the TYPED hard-goal
+        # failure (see _classify) — declared, deterministic per schedule
+        optimize_ok = ("2xx", "503", "optfail")
+        if kind == "state":
+            status, _, _ = self._request(
+                "GET", "/state?substates=EXECUTOR,ANOMALY_DETECTOR")
+            self._expect(entry, status, ("2xx",))
+        elif kind == "proposals":
+            status, body, _ = self._request("GET", "/proposals")
+            self._expect(entry, status, degraded_ok, body)
+            if body is not None and "stale" in body:
+                entry["stale"] = bool(body["stale"])
+        elif kind == "rebalance_dryrun":
+            status, body, _ = self._request(
+                "POST", f"/rebalance?dryrun=true&reason=fuzz{i}")
+            self._expect(entry, status, optimize_ok, body)
+        elif kind == "rebalance_execute":
+            query = f"/rebalance?dryrun=false&reason=fuzz{i}"
+            status, body, tid = self._request("POST", query)
+            self._expect(entry, status, optimize_ok, body)
+            if status == 200 and tid:
+                entry["executed"] = bool((body or {}).get("executed"))
+                self._completed_mutations.append((tid, query))
+                # User-Task-ID resumption must replay the CACHED result:
+                # executor execution count stays flat (no duplicate
+                # execution from re-triggering a completed mutation)
+                before = runner.cc.executor.state_json()["numExecutions"]
+                rstatus, _, rtid = self._request("POST", query, task_id=tid)
+                after = runner.cc.executor.state_json()["numExecutions"]
+                entry["resume_status"] = _bucket(rstatus)
+                entry["resume_same_task"] = rtid == tid
+                entry["dup_execution"] = after != before
+                if after != before:
+                    self.failures.append(
+                        f"op {i} (rebalance_execute): resuming the completed "
+                        f"task re-executed ({before} -> {after})")
+                if rstatus != 200 or rtid != tid:
+                    self.failures.append(
+                        f"op {i} (rebalance_execute): resume returned "
+                        f"{rstatus} / different task")
+        elif kind == "stop":
+            status, _, _ = self._request(
+                "POST", f"/stop_proposal_execution?reason=fuzz{i}")
+            self._expect(entry, status, ("2xx",))
+        elif kind == "user_tasks":
+            status, _, _ = self._request("GET", "/user_tasks")
+            self._expect(entry, status, ("2xx",))
+        elif kind == "metrics":
+            status, _, _ = self._request("GET", "/metrics")
+            self._expect(entry, status, ("2xx",))
+        elif kind == "malformed":
+            label, method, pathq, expected = _MALFORMED[detail]
+            entry["malformed"] = label
+            status, _, _ = self._request(method, pathq)
+            self._expect(entry, status, expected)
+        elif kind == "resume_race":
+            if not self._completed_mutations:
+                entry["status"] = "skipped"   # deterministic: schedule-driven
+                return
+            tid, query = self._completed_mutations[-1]
+            before = runner.cc.executor.state_json()["numExecutions"]
+            results = [None, None]
+
+            def poll(slot):
+                results[slot] = self._request("POST", query, task_id=tid)
+
+            threads = [threading.Thread(target=poll, args=(s,))
+                       for s in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(600)
+            after = runner.cc.executor.state_json()["numExecutions"]
+            statuses = sorted(_bucket(r[0]) for r in results if r)
+            same_task = all(r and r[2] == tid for r in results)
+            entry["status"] = "/".join(statuses) or "client-error"
+            entry["race_same_task"] = same_task
+            entry["dup_execution"] = after != before
+            if statuses != ["2xx", "2xx"] or not same_task:
+                self.failures.append(
+                    f"op {i} (resume_race): racing resumptions returned "
+                    f"{statuses}, same_task={same_task}")
+            if after != before:
+                self.failures.append(
+                    f"op {i} (resume_race): racing resumptions re-executed "
+                    f"({before} -> {after})")
+        else:
+            raise ValueError(f"unknown fuzz op kind {kind!r}")
+
+    # ------------------------------------------------------------ finalize
+    def finalize(self) -> None:
+        """Post-episode invariants + server teardown."""
+        try:
+            if self._server is not None and self.observed_task_ids:
+                status, body, _ = self._request(
+                    "GET", "/user_tasks?entries=10000")
+                listed = {row.get("UserTaskId")
+                          for row in (body or {}).get("userTasks", ())}
+                if status != 200:
+                    self.failures.append(
+                        f"census: GET /user_tasks returned {status}")
+                else:
+                    missing = [t for t in self.observed_task_ids
+                               if t not in listed]
+                    if missing:
+                        self.failures.append(
+                            f"census: {len(missing)} task id(s) returned in "
+                            f"User-Task-ID headers are missing from "
+                            f"/user_tasks")
+        finally:
+            if self._server is not None:
+                self._server.stop()
+                self._server = None
+
+    def log_json(self) -> list[dict]:
+        return [dict(e) for e in self.log]
+
+
+# --------------------------------------------------------------- episodes
+@dataclasses.dataclass
+class FuzzEpisodeResult:
+    """One scenario run with the fuzzer attached. ``to_json()`` is the
+    bit-identical episode log: the scenario result + timeline, the fuzz log
+    and every invariant verdict."""
+    scenario_result: object
+    fuzz_seed: int
+    fuzz_log: list
+    fuzz_failures: list
+    requests: int
+    fault_counts: dict
+    # lifetime circuit trips per operation class (test surface for the
+    # "transient episode heals with retries, breaker never trips" contract)
+    breaker_open_counts: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def failures(self) -> list:
+        return list(self.scenario_result.failures) + list(self.fuzz_failures)
+
+    @property
+    def ok(self) -> bool:
+        return not self.failures
+
+    def assert_ok(self) -> None:
+        if self.failures:
+            raise AssertionError(
+                "fuzz episode failed:\n  " + "\n  ".join(self.failures))
+
+    def to_json(self) -> dict:
+        # NOTE: backend fault COUNTS are deliberately absent — wall-clock
+        # cached sensor gauges (metadata-factor) may probe the faulted
+        # backend a run-dependent number of times; the *schedule* is
+        # stateless per (method, time bucket), so every recorded outcome
+        # stays bit-identical, but raw hit counts would not
+        out = self.scenario_result.to_json()
+        out["timeline"] = list(self.scenario_result.timeline)
+        out["fuzz_seed"] = self.fuzz_seed
+        out["fuzz_log"] = [dict(e) for e in self.fuzz_log]
+        out["fuzz_failures"] = list(self.fuzz_failures)
+        out["fuzz_requests"] = self.requests
+        return out
+
+
+# default mid-episode fault window: opens after the first detections are in
+# flight, closes well before the scenario deadline so heals can land
+DEFAULT_FAULT_WINDOWS = ((45_000.0, 165_000.0),)
+
+
+def run_fuzz_episode(scenario, seed: int = 0, fuzz_seed: int = 0,
+                     fuzz_spec: FuzzSpec | None = None,
+                     fault_windows=DEFAULT_FAULT_WINDOWS,
+                     error_rate: float = 0.25, latency_rate: float = 0.1,
+                     partial_rate: float = 0.1,
+                     name: str | None = None) -> FuzzEpisodeResult:
+    """Run one scenario with the REST fuzzer + FaultyBackend attached.
+    Pure function of (scenario, seed, fuzz_seed, spec, windows, rates):
+    same inputs => bit-identical ``to_json()`` document."""
+    from cruise_control_tpu.sim.runner import ScenarioRunner
+
+    faulty: dict = {}
+
+    def wrap(backend):
+        fb = FaultyBackend(backend, seed=fuzz_seed, windows=fault_windows,
+                           error_rate=error_rate, latency_rate=latency_rate,
+                           partial_rate=partial_rate)
+        faulty["backend"] = fb
+        return fb
+
+    fuzzer = ApiFuzzer(fuzz_spec, fuzz_seed=fuzz_seed,
+                       name=name or scenario.name)
+    runner = ScenarioRunner(scenario, seed=seed, backend_wrap=wrap,
+                            tick_hook=fuzzer.tick)
+    try:
+        res = runner.run()
+    finally:
+        fuzzer.finalize()
+    fb = faulty.get("backend")
+    breakers = runner.cc.fault_tolerance.state_json()["breakers"]
+    return FuzzEpisodeResult(
+        scenario_result=res, fuzz_seed=fuzz_seed,
+        fuzz_log=fuzzer.log_json(), fuzz_failures=list(fuzzer.failures),
+        requests=fuzzer.requests,
+        fault_counts=dict(fb.fault_counts) if fb is not None else {},
+        breaker_open_counts={name: br["openCount"]
+                             for name, br in breakers.items()})
+
+
+def run_fuzz_campaign(spec, seed: int = 0, fuzz_seed: int = 0,
+                      fuzz_spec: FuzzSpec | None = None) -> dict:
+    """Every episode of a campaign with the fuzzer + FaultyBackend attached
+    (`bench.py --campaign <name> --fuzz`). Returns the aggregate document;
+    same (campaign, seed, fuzz_seed) => bit-identical output."""
+    from cruise_control_tpu.sim.campaign import (
+        CAMPAIGNS, aggregate_slos, generate_episode,
+    )
+    if isinstance(spec, str):
+        spec = CAMPAIGNS[spec]
+    episodes = []
+    for i in range(spec.episodes):
+        sc = generate_episode(spec, seed, i)
+        episodes.append(run_fuzz_episode(
+            sc, seed=0, fuzz_seed=fuzz_seed + i, fuzz_spec=fuzz_spec,
+            name=f"{spec.name}/{seed}"))
+    return {
+        "campaign": spec.name,
+        "seed": seed,
+        "fuzz_seed": fuzz_seed,
+        "num_episodes": len(episodes),
+        "converged_episodes": sum(
+            1 for e in episodes if e.scenario_result.converged),
+        "fuzz_requests": sum(e.requests for e in episodes),
+        "slo": aggregate_slos([e.scenario_result for e in episodes]),
+        "episodes": [e.to_json() for e in episodes],
+        "failures": [f for e in episodes for f in e.failures],
+    }

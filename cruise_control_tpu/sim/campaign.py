@@ -68,11 +68,15 @@ class CampaignSpec:
     min_faults: int = 1
     max_faults: int = 3
     # weighted fault mix the schedule generator draws from (each kind at most
-    # once per episode; weights are relative rates)
+    # once per episode; weights are relative rates). maintenance_add_broker /
+    # maintenance_topic_rf are the ADD_BROKER / TOPIC_REPLICATION_FACTOR
+    # maintenance-plan mix: they fuzz the provisioner-adjacent add-broker
+    # balance path and the RF-repair path THROUGH the executor.
     fault_weights: tuple = (
         ("broker_death", 3.0), ("disk_failure", 2.0), ("slow_broker", 1.5),
         ("metric_gap", 1.0), ("topic_creation", 1.0), ("rf_drop", 1.5),
-        ("maintenance_event", 1.5),
+        ("maintenance_event", 1.5), ("maintenance_add_broker", 1.0),
+        ("maintenance_topic_rf", 1.0),
     )
     # faults land inside this window from scenario start — short enough that
     # later faults overlap the heals (and throttled executions) of earlier
@@ -154,6 +158,19 @@ def generate_episode(spec: CampaignSpec, seed: int, episode: int) -> Scenario:
     B = cluster.num_brokers
     n_faults = rng.randint(spec.min_faults, spec.max_faults)
     kinds, pool = [], list(spec.fault_weights)
+    # Mutually-exclusive pairs per episode:
+    # - rf_drop arms the cluster-wide TopicReplicationFactorAnomalyFinder at
+    #   the BUILD RF; a TOPIC_REPLICATION_FACTOR plan raising a topic above
+    #   it would fight that finder forever (two controllers, two targets).
+    # - an ADD_BROKER plan firing while a broker death is still inside its
+    #   self-healing grace window hits a genuinely infeasible placement
+    #   (capacity hard goals unsatisfiable until the evacuation heals) — an
+    #   operator wouldn't schedule an expansion balance into a dying
+    #   cluster, and the campaign's contract is heals, not stuck plans.
+    conflicts = {"rf_drop": ("maintenance_topic_rf",),
+                 "maintenance_topic_rf": ("rf_drop",),
+                 "broker_death": ("maintenance_add_broker",),
+                 "maintenance_add_broker": ("broker_death",)}
     for _ in range(n_faults):
         if not pool:
             break
@@ -165,16 +182,30 @@ def generate_episode(spec: CampaignSpec, seed: int, episode: int) -> Scenario:
             if x <= acc:
                 kinds.append(k)
                 del pool[i]     # each kind at most once per episode
+                other = conflicts.get(k, ())
+                if other:
+                    pool = [(k2, w2) for k2, w2 in pool if k2 not in other]
                 break
     kinds.sort(key=lambda k: dict(spec.fault_weights)[k], reverse=True)
 
     used: set[int] = set()      # brokers already targeted by some fault
+    used_topics: set[str] = set()   # topics already owned by some fault
 
     def pick_brokers(n: int) -> list:
         free = [b for b in range(B) if b not in used]
         chosen = sorted(rng.sample(free, min(n, len(free))))
         used.update(chosen)
         return chosen
+
+    def pick_topic() -> tuple:
+        """One build topic not yet owned by another fault this episode —
+        rf_drop's repair target and maintenance_topic_rf's plan target on
+        the SAME topic would be contradictory convergence contracts."""
+        free = [t for t in spec.cluster.topics if t[0] not in used_topics]
+        pool_t = free or list(spec.cluster.topics)
+        topic = pool_t[rng.randrange(len(pool_t))]
+        used_topics.add(topic[0])
+        return topic
 
     events: list[ScenarioEvent] = []
     expect_types: set[str] = set()
@@ -215,8 +246,9 @@ def generate_episode(spec: CampaignSpec, seed: int, episode: int) -> Scenario:
             # the finder's consecutive-hit cadence (run_due fires once per
             # tick). The fault still perturbs — the AIMD adjuster sees the
             # slow broker's metrics during whatever executions run.
-            if not {"broker_death", "disk_failure",
-                    "maintenance_event"} & set(kinds):
+            if not {"broker_death", "disk_failure", "maintenance_event",
+                    "maintenance_add_broker",
+                    "maintenance_topic_rf"} & set(kinds):
                 expect_types.add("METRIC_ANOMALY")
             config.setdefault("metric.anomaly.detection.interval.ms", 30_000)
             config.setdefault("slow.broker.demotion.score", 2)
@@ -233,8 +265,7 @@ def generate_episode(spec: CampaignSpec, seed: int, episode: int) -> Scenario:
                 {"topic": f"chaos{episode}", "partitions": rng.randint(8, 16),
                  "rf": 2, "size_mb": 80.0}))
         elif kind == "rf_drop":
-            topic, _parts, rf = spec.cluster.topics[
-                rng.randrange(len(spec.cluster.topics))]
+            topic, _parts, rf = pick_topic()
             events.append(ScenarioEvent(
                 t_in_window(), "rf_drop",
                 {"topic": topic, "target_rf": max(int(rf) - 1, 1)}))
@@ -249,6 +280,30 @@ def generate_episode(spec: CampaignSpec, seed: int, episode: int) -> Scenario:
             events.append(ScenarioEvent(t_in_window(), "maintenance_event",
                                         {"plan_type": plan, "brokers": brokers,
                                          "topics": {}}))
+            expect_types.add("MAINTENANCE_EVENT")
+        elif kind == "maintenance_add_broker":
+            # ADD_BROKER plan: new hardware materializes in the backend at
+            # plan time (runner handles new_brokers) and the heal balances
+            # load onto it through add_brokers -> executor. New ids continue
+            # from B, staying inside the padded engine bucket.
+            nb = B
+            rack = f"r{nb % max(cluster.num_racks, 1)}"
+            events.append(ScenarioEvent(
+                t_in_window(), "maintenance_event",
+                {"plan_type": "ADD_BROKER", "brokers": [nb],
+                 "new_brokers": [[nb, rack]], "topics": {}}))
+            expect_types.add("MAINTENANCE_EVENT")
+        elif kind == "maintenance_topic_rf":
+            # TOPIC_REPLICATION_FACTOR plan: grow one build topic's RF by
+            # one — the repair builds ExecutionProposals and runs THROUGH
+            # the executor (task census, throttles), and the runner adopts
+            # the plan's target as the convergence contract
+            topic, _parts, rf = pick_topic()
+            target = min(int(rf) + 1, B)
+            events.append(ScenarioEvent(
+                t_in_window(), "maintenance_event",
+                {"plan_type": "TOPIC_REPLICATION_FACTOR", "brokers": [],
+                 "topics": {topic: target}}))
             expect_types.add("MAINTENANCE_EVENT")
         else:
             raise ValueError(f"unknown fault kind {kind!r}")

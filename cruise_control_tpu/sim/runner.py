@@ -136,12 +136,23 @@ class ScenarioResult:
 
 class ScenarioRunner:
     def __init__(self, scenario: Scenario, seed: int = 0,
-                 settle_ticks: int | None = None, workdir: str | None = None):
+                 settle_ticks: int | None = None, workdir: str | None = None,
+                 backend_wrap=None, tick_hook=None):
+        """``backend_wrap``: optional ``backend -> backend`` applied to the
+        built SimulatedClusterBackend before the app sees it — the chaos
+        fuzzer wraps a :class:`~cruise_control_tpu.sim.api_fuzz.FaultyBackend`
+        here so the CONTROL PLANE experiences injected backend faults while
+        the invariant checks keep reading ground truth via ``.inner``.
+        ``tick_hook``: optional ``(runner, now_ms) -> None`` invoked at the
+        end of every tick (after anomaly handling, before invariants) — the
+        REST fuzzer issues its lockstep request schedule from it."""
         self.scenario = scenario
         self.seed = seed
         self.settle_ticks = (settle_ticks if settle_ticks is not None
                              else scenario.settle_ticks)
         self._workdir = workdir
+        self._backend_wrap = backend_wrap
+        self._tick_hook = tick_hook
         self.backend = None
         self.cc = None
         self.result = ScenarioResult(name=scenario.name, seed=seed)
@@ -159,6 +170,11 @@ class ScenarioRunner:
         sc = self.scenario
         spec = dataclasses.replace(sc.cluster, seed=sc.cluster.seed + self.seed)
         self.backend = build_backend(spec)
+        if self._backend_wrap is not None:
+            self.backend = self._backend_wrap(self.backend)
+        # ground truth for invariant checks: injected backend faults
+        # (FaultyBackend) must perturb the CONTROL PLANE, not the oracle
+        self.truth = getattr(self.backend, "inner", self.backend)
         # replay payload: the scenario with its EFFECTIVE cluster seed (this
         # runner's seed already folded in), so (scenario_from_json(payload),
         # seed=payload seed) reproduces this episode bit-identically
@@ -177,7 +193,7 @@ class ScenarioRunner:
         # threads, the loop must be single-threaded to be deterministic
         self.cc.start_up()
         self.expected_rf = {tp: len(set(info.replicas))
-                            for tp, info in self.backend.partitions().items()}
+                            for tp, info in self.truth.partitions().items()}
         # OptimizationVerifier pass on EVERY optimization the loop runs
         # (RandomSelfHealingTest + OptimizationVerifier role): regression,
         # structural proposal validity, no adds onto dead hardware. Verdicts
@@ -266,6 +282,19 @@ class ScenarioRunner:
         elif ev.kind == "load_surge":
             be.scale_partition_load(p["factor"], topics=p.get("topics"))
         elif ev.kind == "maintenance_event":
+            # ADD_BROKER plans name hardware the operator has racked but the
+            # service hasn't balanced onto yet: materialize it in the backend
+            # at plan time, then spool the plan (the heal moves load onto it
+            # through add_brokers -> executor)
+            for b, rack in p.get("new_brokers", ()):
+                self.truth.add_broker(int(b), rack=rack)
+            if p["plan_type"] == "TOPIC_REPLICATION_FACTOR":
+                # the plan CHANGES the convergence contract: every partition
+                # of the named topics must end at the plan's target RF
+                for topic, rf in p["topics"].items():
+                    for tp in self.truth.partitions():
+                        if tp[0] == topic:
+                            self.expected_rf[tp] = int(rf)
             spool = os.path.join(self._spool_dir, "maintenance_events.jsonl")
             with open(spool, "a") as f:
                 f.write(json.dumps({"type": p["plan_type"],
@@ -288,6 +317,9 @@ class ScenarioRunner:
             self.backend.advance(window_ms)
             lm.sample_once(now_ms=self._now())
         self._t0 = self._now()
+        arm = getattr(self.backend, "arm", None)
+        if arm is not None:   # FaultyBackend windows are t0-relative
+            arm(self._t0)
         self._schedule_events()
 
         end = self._t0 + sc.duration_ms
@@ -306,15 +338,19 @@ class ScenarioRunner:
             self._record_provision_actions()
             for h in ad.handle_anomalies(now):
                 self._record_handled(h, self._now())
+            if self._tick_hook is not None:
+                # the REST fuzzer's lockstep slot: deterministic request
+                # schedules run here, racing detector heals in sim time
+                self._tick_hook(self, self._now())
             now = self._now()   # a FIX execution advances simulated time
-            viol = invariants.check_tick(self.backend, self.cc.executor)
+            viol = invariants.check_tick(self.truth, self.cc.executor)
             if viol:
                 self.result.invariant_violations.extend(
                     f"t={now - self._t0:.0f}: {v}" for v in viol)
                 self._record("invariant_violation", now, violations=viol)
             if (self._events_pending == 0 and now >= self._t0 + horizon_ms
                     and not self.cc.executor.has_ongoing_execution()):
-                conv = invariants.check_converged(self.backend,
+                conv = invariants.check_converged(self.truth,
                                                   self.expected_rf)
                 conv.extend(self._extra_convergence_checks())
                 if not conv:
@@ -367,11 +403,11 @@ class ScenarioRunner:
             if rec is None or rec.status.value != "RIGHT_SIZED":
                 out.append("provision status not RIGHT_SIZED after resize")
         for b in self.scenario.expect_empty_brokers:
-            n = invariants.replicas_on(self.backend, b)
+            n = invariants.replicas_on(self.truth, b)
             if n:
                 out.append(f"broker {b} still hosts {n} replicas")
         for b in self.scenario.expect_nonleader_brokers:
-            n = invariants.leaderships_on(self.backend, b)
+            n = invariants.leaderships_on(self.truth, b)
             if n:
                 out.append(f"broker {b} still leads {n} partitions")
         return out
@@ -425,7 +461,7 @@ class ScenarioRunner:
             r.failures.append(
                 "did not converge within "
                 f"{sc.duration_ms:.0f} simulated ms: "
-                + "; ".join(invariants.check_converged(self.backend,
+                + "; ".join(invariants.check_converged(self.truth,
                                                        self.expected_rf)
                             + self._extra_convergence_checks())[:2000])
         if r.invariant_violations:

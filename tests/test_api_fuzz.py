@@ -1,0 +1,301 @@
+"""REST fuzzing inside chaos episodes (sim/api_fuzz.py tentpole).
+
+Fast tier: FaultyBackend units, the lockstep fuzz smoke on the shared
+12-broker compile bucket (invariants: no undeclared 500s, user-task census,
+no duplicate executions), bit-identical (scenario, fuzz-seed) episode logs,
+the transient-regime contract (heals with retries, breaker never trips) and
+the sustained-failure contract (degraded serving mid-outage, recovery after
+clearance), plus the tools/slo_diff.py regression gate. Slow tier: the full
+micro campaign with the fuzzer on every episode.
+"""
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+from cruise_control_tpu.backend import SimulatedClusterBackend
+from cruise_control_tpu.common.retries import ServiceUnavailableError
+from cruise_control_tpu.sim import (
+    FaultyBackend, FuzzSpec, ScenarioRunner, TransientBackendError,
+    run_fuzz_episode,
+)
+from cruise_control_tpu.sim.scenario import ClusterSpec, Scenario, broker_death
+
+_SMALL = ClusterSpec(num_brokers=12, num_racks=3,
+                     topics=(("t0", 60, 2), ("t1", 60, 2)),
+                     logdirs_per_broker=2)
+
+# a short single-death scenario on the shared small-fixture compile bucket:
+# the fuzz tier-1 rung (3-goal healing chain like the smoke scenario)
+_FUZZ_SCENARIO = Scenario(
+    name="fuzz-smoke", cluster=_SMALL,
+    events=(broker_death(20_000.0, [3]),),
+    duration_ms=900_000.0, tick_ms=15_000.0,
+    config=(("goal.violation.detection.interval.ms", 10_000_000_000),
+            ("broker.failure.detection.backoff.ms", 120_000),
+            ("self.healing.goals",
+             "ReplicaCapacityGoal,DiskCapacityGoal,ReplicaDistributionGoal")),
+    expects_heal=True, expect_detect_types=("BROKER_FAILURE",))
+
+_FUZZ_SPEC = FuzzSpec(ops=22, ticks=26)
+
+
+# ------------------------------------------------------------- FaultyBackend
+def _tiny():
+    be = SimulatedClusterBackend()
+    be.add_broker(0, "r0").add_broker(1, "r1")
+    be.create_partition("t", 0, [0, 1], size_mb=10.0, bytes_in_rate=1.0)
+    return be
+
+
+def test_faulty_backend_verdicts_are_stateless_and_windowed():
+    inner = _tiny()
+    fb = FaultyBackend(inner, seed=3, windows=((100.0, 1_000.0),),
+                       error_rate=1.0)
+    # outside the window: clean passthrough
+    assert set(fb.brokers()) == {0, 1}
+    inner.advance(500.0)          # inside the window, error_rate 1.0
+    with pytest.raises(TransientBackendError):
+        fb.brokers()
+    # stateless: the verdict for (method, bucket) never shifts with call
+    # count — N failures in a bucket stay N failures
+    with pytest.raises(TransientBackendError):
+        fb.brokers()
+    inner.advance(1_000.0)        # past the window
+    assert set(fb.brokers()) == {0, 1}
+    # the simulation surface is never faulted
+    assert fb.now_ms() == inner.now_ms()
+    assert fb.inner is inner
+
+
+def test_faulty_backend_partial_responses_subset_per_broker_maps():
+    inner = _tiny()
+    fb = FaultyBackend(inner, seed=1, windows=((0.0, float("inf")),),
+                       error_rate=0.0, partial_rate=1.0)
+    full = inner.broker_metrics()
+    got = fb.broker_metrics()
+    assert set(got) <= set(full)   # a deterministic subset
+    assert got == fb.broker_metrics()   # stable within the bucket
+
+
+def test_faulty_backend_latency_spike_burns_simulated_time():
+    inner = _tiny()
+    fb = FaultyBackend(inner, seed=0, windows=((0.0, float("inf")),),
+                       error_rate=0.0, latency_rate=1.0, latency_ms=250.0)
+    t0 = inner.now_ms()
+    fb.partitions()
+    assert inner.now_ms() == t0 + 250.0
+
+
+# ----------------------------------------------------------- fuzz smoke tier
+@pytest.fixture(scope="module")
+def fuzz_smoke():
+    return run_fuzz_episode(_FUZZ_SCENARIO, fuzz_seed=1, fuzz_spec=_FUZZ_SPEC)
+
+
+def test_fuzz_smoke_invariants_hold(fuzz_smoke):
+    """No undeclared 500s, user-task census consistent, no duplicate
+    executions — and the chaos episode still converges under REST load."""
+    fuzz_smoke.assert_ok()
+    assert fuzz_smoke.scenario_result.converged
+    assert fuzz_smoke.requests > 0
+    statuses = {e["status"] for e in fuzz_smoke.fuzz_log}
+    assert "5xx" not in statuses and "500" not in statuses
+
+
+def test_fuzz_smoke_covers_the_surface(fuzz_smoke):
+    kinds = {e["kind"] for e in fuzz_smoke.fuzz_log}
+    # the schedule drew reads, mutating triggers and stop for this seed
+    assert {"state", "proposals", "rebalance_dryrun",
+            "rebalance_execute", "stop"} <= kinds
+    executed = [e for e in fuzz_smoke.fuzz_log
+                if e["kind"] == "rebalance_execute" and e["status"] == "2xx"]
+    assert executed, "no mutating trigger completed"
+    for e in executed:
+        # User-Task-ID resumption replayed the cached result: same task,
+        # 200, and the executor never re-executed
+        assert e["resume_status"] == "2xx"
+        assert e["resume_same_task"] is True
+        assert e["dup_execution"] is False
+
+
+def test_fuzz_episode_log_is_bit_identical(fuzz_smoke):
+    """Same (scenario, fuzz-seed) => bit-identical episode log: timeline,
+    fuzz log, verdicts — byte-for-byte over the JSON document."""
+    again = run_fuzz_episode(_FUZZ_SCENARIO, fuzz_seed=1,
+                             fuzz_spec=_FUZZ_SPEC)
+    assert (json.dumps(again.to_json(), sort_keys=True)
+            == json.dumps(fuzz_smoke.to_json(), sort_keys=True))
+
+
+def test_fuzz_different_seed_changes_the_schedule(fuzz_smoke):
+    other = ApiFuzzerScheduleProbe(0)
+    mine = ApiFuzzerScheduleProbe(1)
+    assert other.schedule != mine.schedule
+
+
+class ApiFuzzerScheduleProbe:
+    def __init__(self, seed):
+        from cruise_control_tpu.sim.api_fuzz import ApiFuzzer
+        self.schedule = ApiFuzzer(_FUZZ_SPEC, fuzz_seed=seed,
+                                  name="fuzz-smoke")._draw_schedule()
+
+
+# -------------------------------------------------- transient-regime contract
+def test_transient_fault_episode_heals_with_retries_breaker_never_trips():
+    """FaultyBackend transient-error regime: the retry layer absorbs every
+    injected failure (retries observed), NO circuit ever opens, and the
+    episode heals on schedule."""
+    holder = {}
+
+    def wrap(be):
+        fb = FaultyBackend(be, seed=5, windows=((30_000.0, 210_000.0),),
+                           error_rate=0.12, latency_rate=0.08,
+                           partial_rate=0.05)
+        holder["fb"] = fb
+        return fb
+
+    runner = ScenarioRunner(_FUZZ_SCENARIO, backend_wrap=wrap)
+    res = runner.run()
+    res.assert_ok()
+    assert res.converged
+    assert holder["fb"].fault_counts["error"] > 0     # faults really flew
+    breakers = runner.cc.fault_tolerance.state_json()["breakers"]
+    assert breakers, "no backend call ever rode the fault-tolerance layer"
+    assert all(br["openCount"] == 0 for br in breakers.values()), breakers
+    sensors = runner.cc.sensors.to_json()
+    retries = sum(v["count"] for k, v in sensors.items()
+                  if k.endswith("-backend-retries"))
+    assert retries > 0
+
+
+# ------------------------------------------------- sustained-failure contract
+def test_sustained_failure_degrades_then_recovers():
+    """Total backend outage mid-episode: reads serve the cached proposals
+    flagged stale, writes 503 with Retry-After, the detector defers its fix
+    instead of burning failures — and after fault clearance the episode
+    heals with zero self-healing failures."""
+    sc = Scenario(
+        name="sustained", cluster=_SMALL,
+        events=(broker_death(20_000.0, [3]),),
+        duration_ms=1_800_000.0, tick_ms=15_000.0,
+        config=_FUZZ_SCENARIO.config,
+        expects_heal=True, expect_detect_types=("BROKER_FAILURE",))
+    obs = {"primed": False, "degraded": False, "stale": False, "w503": False,
+           "retry_after": None}
+
+    def hook(runner, now):
+        rel = now - runner._t0
+        cc = runner.cc
+        if not obs["primed"] and rel < 45_000:
+            cc.cached_proposals()            # prime the cache pre-outage
+            obs["primed"] = True
+        if 120_000 <= rel <= 210_000 and cc.degraded() and not obs["w503"]:
+            obs["degraded"] = True
+            cached, fresh = cc.cached_proposals_verbose(force_refresh=True)
+            obs["stale"] = bool(fresh.get("stale"))
+            obs["stale_age_ok"] = fresh.get("ageMs", -1.0) >= 0.0
+            try:
+                cc.rebalance(dry_run=False, reason="should-503")
+            except ServiceUnavailableError as e:
+                obs["w503"] = True
+                obs["retry_after"] = e.retry_after_s
+
+    def wrap(be):
+        # window 1: outage before detection (degraded serving); window 2:
+        # outage landing on the heal attempt (fix deferral path)
+        return FaultyBackend(be, seed=7,
+                             windows=((60_000.0, 240_000.0),
+                                      (380_000.0, 430_000.0)),
+                             error_rate=1.0)
+
+    runner = ScenarioRunner(sc, backend_wrap=wrap, tick_hook=hook)
+    res = runner.run()
+    res.assert_ok()
+    assert res.converged
+    assert obs == {**obs, "primed": True, "degraded": True, "stale": True,
+                   "w503": True}
+    assert obs["stale_age_ok"] and obs["retry_after"] >= 1.0
+    sensors = runner.cc.sensors.to_json()
+    assert sensors.get("self-healing-fix-failures", {}).get("count", 0) == 0
+    assert sensors["self-healing-fix-deferrals"]["count"] >= 1
+    assert sensors["stale-proposals-served"]["count"] >= 1
+    # the monitor breaker tripped during the outage and recovered after
+    breakers = runner.cc.fault_tolerance.state_json()["breakers"]
+    assert breakers["monitor.sample"]["openCount"] >= 1
+    assert not runner.cc.degraded()
+
+
+# ------------------------------------------------------------------ slo_diff
+def _load_slo_diff():
+    path = pathlib.Path(__file__).resolve().parent.parent / "tools" / "slo_diff.py"
+    spec = importlib.util.spec_from_file_location("slo_diff", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _slo(kind_p95_heal, undetected=0):
+    return {"time_to_detect_ms": {"n": 2, "p50": 10.0, "p95": 20.0,
+                                  "max": 20.0},
+            "time_to_heal_ms": {"n": 2, "p50": kind_p95_heal / 2,
+                                "p95": kind_p95_heal, "max": kind_p95_heal},
+            "actions_per_heal": {"n": 2, "p50": 4, "p95": 6, "max": 6},
+            "undetected": undetected, "unhealed": 0}
+
+
+def test_slo_diff_flags_p95_regressions_and_coverage_loss():
+    mod = _load_slo_diff()
+    base = {"broker_death": _slo(100.0), "disk_failure": _slo(50.0)}
+    cand = {"broker_death": _slo(200.0),      # 2x heal p95 -> regression
+            "disk_failure": _slo(55.0)}       # inside the 25% envelope
+    rows, regs = mod.compare_slos(base, cand, threshold=0.25)
+    assert len(regs) == 1 and regs[0]["kind"] == "broker_death"
+    # undetected growth is a regression even with equal latencies
+    rows, regs = mod.compare_slos(
+        {"rf_drop": _slo(10.0)}, {"rf_drop": _slo(10.0, undetected=1)})
+    assert regs and regs[0]["field"] == "undetected"
+    # no candidate samples for a kind the baseline measured = coverage lost
+    gone = {"rf_drop": {"time_to_detect_ms": {"n": 0, "p50": None,
+                                              "p95": None, "max": None},
+                        "time_to_heal_ms": {"n": 0, "p50": None, "p95": None,
+                                            "max": None},
+                        "actions_per_heal": {"n": 0, "p50": None,
+                                             "p95": None, "max": None},
+                        "undetected": 0, "unhealed": 0}}
+    rows, regs = mod.compare_slos({"rf_drop": _slo(10.0)}, gone)
+    assert any("coverage lost" in r.get("regression", "") for r in regs)
+
+
+def test_slo_diff_cli_exit_codes(tmp_path):
+    mod = _load_slo_diff()
+    base = {"slo": {"broker_death": _slo(100.0)}}
+    good = {"slo": {"broker_death": _slo(110.0)}}
+    bad = {"slo": {"broker_death": _slo(300.0)}}
+    pb, pg, pbad = (tmp_path / n for n in ("b.json", "g.json", "r.json"))
+    pb.write_text(json.dumps(base))
+    pg.write_text(json.dumps(good))
+    pbad.write_text(json.dumps(bad))
+    assert mod.main([str(pb), str(pg)]) == 0
+    assert mod.main([str(pb), str(pbad)]) == 1
+    # bench summary documents (campaign block) are auto-detected
+    summary = {"campaign": {"name": "micro", "slo": {"broker_death":
+                                                     _slo(100.0)}}}
+    ps = tmp_path / "s.json"
+    ps.write_text(json.dumps(summary))
+    assert mod.main([str(ps), str(pg)]) == 0
+
+
+# ------------------------------------------------------------- slow matrices
+@pytest.mark.slow
+def test_fuzz_micro_campaign_matrix():
+    """The full micro campaign with the fuzzer + FaultyBackend on every
+    episode: invariants hold across the matrix and the document reproduces
+    bit-identically."""
+    from cruise_control_tpu.sim import run_fuzz_campaign
+    doc = run_fuzz_campaign("micro", seed=0, fuzz_seed=0)
+    assert doc["failures"] == []
+    assert doc["converged_episodes"] == doc["num_episodes"]
+    again = run_fuzz_campaign("micro", seed=0, fuzz_seed=0)
+    assert json.dumps(doc, sort_keys=True) == json.dumps(again, sort_keys=True)

@@ -315,3 +315,81 @@ def test_scenario_json_roundtrip_is_lossless():
     rebuilt, seed = scenario_from_json(payload)
     assert seed == 4
     assert rebuilt == dataclasses.replace(sc)    # frozen dataclass equality
+
+
+# ------------------------------- maintenance-plan fault mix (ADD_BROKER / RF)
+
+
+def test_generator_draws_maintenance_add_broker_and_topic_rf():
+    """The ADD_BROKER / TOPIC_REPLICATION_FACTOR maintenance-plan mix is in
+    the default fault pool: some (seed, episode) draws each, with well-formed
+    events (new broker materialization payload; RF target above build RF)."""
+    seen = {"ADD_BROKER": None, "TOPIC_REPLICATION_FACTOR": None}
+    for seed in range(12):
+        for ep in range(1, 3):
+            sc = generate_episode(
+                dataclasses.replace(MICRO, episodes=3, min_faults=3,
+                                    max_faults=5), seed, ep)
+            for e in sc.events:
+                if e.kind != "maintenance_event":
+                    continue
+                pt = e.params["plan_type"]
+                if pt in seen and seen[pt] is None:
+                    seen[pt] = e.params
+    add = seen["ADD_BROKER"]
+    assert add is not None, "ADD_BROKER plan never drawn"
+    assert add["new_brokers"] and add["brokers"] == [add["new_brokers"][0][0]]
+    rf = seen["TOPIC_REPLICATION_FACTOR"]
+    assert rf is not None, "TOPIC_REPLICATION_FACTOR plan never drawn"
+    (topic, target), = rf["topics"].items()
+    build_rf = dict((t, r) for t, _p, r in MICRO.cluster.topics)[topic]
+    assert target == build_rf + 1
+
+
+def test_maintenance_add_broker_plan_heals_through_executor():
+    """ADD_BROKER plan: the broker materializes in the backend at plan time
+    and the heal balances load onto it through add_brokers -> executor."""
+    from cruise_control_tpu.sim import ScenarioRunner, invariants
+    from cruise_control_tpu.sim.scenario import ClusterSpec, Scenario, ScenarioEvent
+    small = ClusterSpec(num_brokers=12, num_racks=3,
+                        topics=(("t0", 60, 2), ("t1", 60, 2)),
+                        logdirs_per_broker=2)
+    sc = Scenario(
+        name="maint-add-broker", cluster=small,
+        events=(ScenarioEvent(30_000.0, "maintenance_event",
+                              {"plan_type": "ADD_BROKER", "brokers": [12],
+                               "new_brokers": [[12, "r0"]], "topics": {}}),),
+        duration_ms=1_500_000.0, tick_ms=15_000.0,
+        config=(("goal.violation.detection.interval.ms", 10_000_000_000),),
+        expects_heal=True, expect_detect_types=("MAINTENANCE_EVENT",))
+    runner = ScenarioRunner(sc)
+    r = runner.run()
+    r.assert_ok()
+    assert invariants.replicas_on(runner.truth, 12) > 0
+    assert r.executions >= 1 and r.executor_tasks > 0
+
+
+def test_maintenance_topic_rf_plan_grows_rf_through_executor():
+    """TOPIC_REPLICATION_FACTOR plan: the runner adopts the plan's target RF
+    as the convergence contract and the repair executes THROUGH the executor
+    (task census), not as a raw metadata write."""
+    from cruise_control_tpu.sim import ScenarioRunner
+    from cruise_control_tpu.sim.scenario import ClusterSpec, Scenario, ScenarioEvent
+    small = ClusterSpec(num_brokers=12, num_racks=3,
+                        topics=(("t0", 60, 2), ("t1", 60, 2)),
+                        logdirs_per_broker=2)
+    sc = Scenario(
+        name="maint-topic-rf", cluster=small,
+        events=(ScenarioEvent(30_000.0, "maintenance_event",
+                              {"plan_type": "TOPIC_REPLICATION_FACTOR",
+                               "brokers": [], "topics": {"t1": 3}}),),
+        duration_ms=1_500_000.0, tick_ms=15_000.0,
+        config=(("goal.violation.detection.interval.ms", 10_000_000_000),),
+        expects_heal=True, expect_detect_types=("MAINTENANCE_EVENT",))
+    runner = ScenarioRunner(sc)
+    r = runner.run()
+    r.assert_ok()
+    rfs = {len(set(i.replicas))
+           for tp, i in runner.truth.partitions().items() if tp[0] == "t1"}
+    assert rfs == {3}
+    assert r.executions >= 1 and r.executor_tasks >= 60

@@ -1,0 +1,505 @@
+"""Fault-tolerant control plane (common/retries.py tentpole).
+
+Units: RetryPolicy backoff, CircuitBreaker state machine, the shared
+BackendFaultTolerance call wrapper. Integration: executor mid-batch backend
+failure (retry path: N failures then success; pause/resume path: failures
+past the breaker threshold with exact task census), monitor sampling
+survival, RPC sidecar respawn-on-failure, degraded-mode serving (stale
+proposals, 503 writes, detector deferral, 429 user-task overload, handler
+thread hygiene).
+"""
+import random
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.backend import SimulatedClusterBackend
+from cruise_control_tpu.common.retries import (
+    BackendFaultTolerance, CircuitBreaker, CircuitOpenError, RetryPolicy,
+    ServiceUnavailableError,
+)
+from cruise_control_tpu.config import cruise_control_config
+from cruise_control_tpu.executor import Executor, TaskState
+
+
+# ---------------------------------------------------------------- RetryPolicy
+def test_retry_policy_backoff_schedule_is_deterministic():
+    p = RetryPolicy(max_attempts=5, base_backoff_ms=100.0,
+                    max_backoff_ms=1000.0, jitter=0.2)
+    a = [p.backoff_ms(i, random.Random("x")) for i in range(1, 5)]
+    b = [p.backoff_ms(i, random.Random("x")) for i in range(1, 5)]
+    assert a == b                       # injected RNG => reproducible jitter
+    # exponential base doubles then clamps; jitter stays within +-20%
+    for i, ms in enumerate(a, start=1):
+        base = min(100.0 * 2 ** (i - 1), 1000.0)
+        assert 0.8 * base <= ms <= 1.2 * base
+
+
+def test_retry_policy_from_config_reads_backend_retry_keys():
+    cfg = cruise_control_config({"backend.retry.max.attempts": 7,
+                                 "backend.retry.base.backoff.ms": 50,
+                                 "backend.retry.jitter": 0.0})
+    p = RetryPolicy.from_config(cfg)
+    assert p.max_attempts == 7
+    assert p.backoff_ms(1, random.Random(0)) == 50.0
+
+
+# -------------------------------------------------------------- CircuitBreaker
+def test_circuit_breaker_state_machine():
+    clock = {"ms": 0.0}
+    br = CircuitBreaker("op", failure_threshold=3, reset_timeout_ms=1000.0,
+                        clock_ms=lambda: clock["ms"])
+    assert br.state == CircuitBreaker.CLOSED and br.allow()
+    br.on_failure(); br.on_failure()
+    assert br.state == CircuitBreaker.CLOSED      # below threshold
+    br.on_failure()
+    assert br.state == CircuitBreaker.OPEN        # threshold trips
+    assert not br.allow()
+    assert br.retry_after_ms() == 1000.0
+    clock["ms"] = 500.0
+    assert not br.allow()                         # still inside the timeout
+    clock["ms"] = 1000.0
+    assert br.state == CircuitBreaker.HALF_OPEN   # timeout elapsed on read
+    assert br.allow()                             # one probe admitted
+    assert not br.allow()                         # probe budget (1) exhausted
+    br.on_failure()                               # failed probe -> re-OPEN
+    assert br.state == CircuitBreaker.OPEN
+    assert br.open_count == 2
+    clock["ms"] = 2000.0
+    assert br.allow()                             # half-open again
+    br.on_success()
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow()
+
+
+def test_fault_tolerance_call_retries_then_succeeds():
+    ft = BackendFaultTolerance(clock_ms=lambda: 0.0)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert ft.call("x", flaky) == "ok"
+    assert calls["n"] == 3
+    assert ft.breaker("x").state == CircuitBreaker.CLOSED
+    assert not ft.degraded()
+
+
+def test_fault_tolerance_opens_circuit_and_rejects_without_calling():
+    clock = {"ms": 0.0}
+    cfg = cruise_control_config({"backend.circuit.failure.threshold": 4,
+                                 "backend.retry.max.attempts": 2,
+                                 "backend.circuit.reset.timeout.ms": 5_000})
+    ft = BackendFaultTolerance(cfg, clock_ms=lambda: clock["ms"])
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise RuntimeError("down")
+
+    for _ in range(2):                  # 2 calls x 2 attempts = threshold 4
+        with pytest.raises(RuntimeError):
+            ft.call("x", broken)
+    assert ft.breaker("x").state == CircuitBreaker.OPEN
+    assert ft.degraded() and ft.open_circuits() == ["x"]
+    n = calls["n"]
+    with pytest.raises(CircuitOpenError):
+        ft.call("x", broken)
+    assert calls["n"] == n              # breaker open => backend untouched
+    clock["ms"] = 5_000.0               # reset timeout -> half-open probe
+    assert not ft.degraded()            # HALF_OPEN admits the probing call
+    calls["ok"] = True
+    assert ft.call("x", lambda: "up") == "up"
+    assert ft.breaker("x").state == CircuitBreaker.CLOSED
+
+
+# --------------------------------------------------- executor: retry + pause
+class _FlakySubmitBackend:
+    """Delegating backend whose movement submission fails until a simulated
+    deadline (or for the first N calls)."""
+
+    def __init__(self, inner, fail_calls=0, fail_until_ms=None):
+        self.inner = inner
+        self.fail_calls = fail_calls
+        self.fail_until_ms = fail_until_ms
+        self.submit_attempts = 0
+
+    def alter_partition_reassignments(self, assignments):
+        self.submit_attempts += 1
+        if self.fail_calls > 0:
+            self.fail_calls -= 1
+            raise RuntimeError("injected submit failure")
+        if (self.fail_until_ms is not None
+                and self.inner.now_ms() < self.fail_until_ms):
+            raise RuntimeError("injected sustained submit failure")
+        return self.inner.alter_partition_reassignments(assignments)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def _cluster():
+    be = SimulatedClusterBackend()
+    for b, rack in ((0, "r0"), (1, "r0"), (2, "r1"), (3, "r1")):
+        be.add_broker(b, rack)
+    for p in range(4):
+        be.create_partition("t", p, [p % 3, (p + 1) % 3], size_mb=20.0,
+                            bytes_in_rate=5.0)
+    return be
+
+
+def _move(topic, part, old, new):
+    # leader stays put: these tests target the inter-broker movement path's
+    # fault tolerance, so the plans carry no leadership tasks
+    return ExecutionProposal(
+        topic=topic, partition=part, old_leader=old[0], new_leader=old[0],
+        old_replicas=tuple((b, 0) for b in old),
+        new_replicas=tuple((b, 0) for b in new))
+
+
+def test_executor_movement_submission_retries_then_succeeds():
+    """Retry path: the batch submission fails N < max attempts times, the
+    retry layer re-drives it inside ONE call, the breaker never trips, and
+    the census is all-COMPLETED."""
+    inner = _cluster()
+    be = _FlakySubmitBackend(inner, fail_calls=2)
+    cfg = cruise_control_config({"backend.retry.max.attempts": 4,
+                                 "backend.circuit.failure.threshold": 10})
+    ex = Executor(be, config=cfg)
+    ex.execute_proposals([_move("t", 0, [0, 1], [0, 3])])
+    assert be.submit_attempts == 3          # 2 failures + 1 success
+    st = ex.state_json()
+    assert st["numTasksByState"] == {"COMPLETED": 1}
+    assert st["numPauseTicks"] == 0
+    ftb = st["backendFaultTolerance"]["breakers"]["executor.submit"]
+    assert ftb["openCount"] == 0
+    assert sorted(inner.partitions()[("t", 0)].replicas) == [0, 3]
+
+
+def test_executor_pauses_past_breaker_threshold_then_resumes():
+    """Pause/resume path: sustained submission failure trips the breaker;
+    the execution pauses mid-batch with the batch still PENDING (exact
+    census), then the half-open probe resumes it once the backend heals, and
+    every task completes."""
+    inner = _cluster()
+    be = _FlakySubmitBackend(inner, fail_until_ms=120_000.0)
+    cfg = cruise_control_config({"backend.retry.max.attempts": 2,
+                                 "backend.circuit.failure.threshold": 4,
+                                 "backend.circuit.reset.timeout.ms": 30_000,
+                                 "execution.progress.check.interval.ms": 10_000})
+    ex = Executor(be, config=cfg)
+    census_during_pause = {}
+
+    def snoop(at_ms):
+        census_during_pause.update(ex.state_json().get("numTasksByState", {}))
+        census_during_pause["paused"] = ex.paused
+    inner.schedule_at(60_000.0, lambda now: snoop(now))
+
+    proposals = [_move("t", 0, [0, 1], [0, 3]), _move("t", 1, [1, 2], [1, 3])]
+    ex.execute_proposals(proposals)         # blocking; SimClock drives time
+    # mid-outage census: every task still PENDING (none falsely IN_PROGRESS),
+    # execution alive and paused — not wedged, not crashed
+    assert census_during_pause == {"PENDING": 2, "paused": True}
+    st = ex.state_json()
+    assert st["numTasksByState"] == {"COMPLETED": 2}
+    assert st["numPauseTicks"] > 0
+    assert st["paused"] is False
+    ftb = st["backendFaultTolerance"]["breakers"]["executor.submit"]
+    assert ftb["openCount"] >= 1            # the breaker DID trip
+    assert ftb["state"] == "CLOSED"         # ... and recovered
+    assert sorted(inner.partitions()[("t", 0)].replicas) == [0, 3]
+    assert sorted(inner.partitions()[("t", 1)].replicas) == [1, 3]
+
+
+def test_executor_verification_failure_skips_tick_without_census_damage():
+    """A failing progress poll (ongoing_reassignments) must never COMPLETE
+    a task on missing evidence — the tick is skipped and re-polled."""
+    inner = _cluster()
+
+    class _FlakyVerify:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def ongoing_reassignments(self):
+            if self.inner.now_ms() < 60_000.0:
+                raise RuntimeError("injected verify failure")
+            return self.inner.ongoing_reassignments()
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+    cfg = cruise_control_config({"backend.retry.max.attempts": 2,
+                                 "backend.circuit.failure.threshold": 4,
+                                 "backend.circuit.reset.timeout.ms": 20_000})
+    ex = Executor(_FlakyVerify(inner), config=cfg)
+    ex.execute_proposals([_move("t", 2, [2, 0], [2, 1])])
+    st = ex.state_json()
+    assert st["numTasksByState"] == {"COMPLETED": 1}
+    assert st["numPauseTicks"] > 0
+
+
+# ------------------------------------------------------------- monitor survive
+def test_monitor_sampling_round_survives_backend_failure():
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+
+    class _Sampler:
+        def __init__(self):
+            self.calls = 0
+
+        def get_samples(self, now):
+            self.calls += 1
+            raise RuntimeError("metrics endpoint down")
+
+        def close(self):
+            pass
+
+    ft = BackendFaultTolerance(
+        cruise_control_config({"backend.retry.max.attempts": 2}),
+        clock_ms=lambda: 0.0)
+    lm = LoadMonitor(sampler=_Sampler(), fault_tolerance=ft)
+    assert lm.sample_once(now_ms=0.0) == 0      # skipped, not crashed
+    assert lm._sensors.to_json()["sampling-fetch-failures"]["count"] == 1
+
+
+# ------------------------------------------------------------- sidecar respawn
+def test_rpc_sidecar_respawns_after_death():
+    from cruise_control_tpu.backend.rpc import RpcClusterBackend
+    from cruise_control_tpu.common.sensors import MetricRegistry
+    sensors = MetricRegistry()
+    be = RpcClusterBackend(max_respawns=2, sensors=sensors)
+    try:
+        be.add_broker(0, "r0")
+        assert set(be.brokers()) == {0}
+        be._proc.kill()
+        be._proc.wait(timeout=10)
+        # one dead sidecar no longer means permadeath: the next call
+        # respawns (fresh simulated state: the sidecar owns the cluster)
+        assert be.brokers() == {}
+        assert be.restarts == 1
+        assert sensors.to_json()["sidecar-restarts"]["count"] == 1
+    finally:
+        be.close()
+
+
+def test_rpc_sidecar_respawn_budget_is_bounded():
+    from cruise_control_tpu.backend.rpc import RpcClusterBackend, RpcError
+    be = RpcClusterBackend(max_respawns=1)
+    try:
+        assert be.brokers() == {}
+        be._proc.kill(); be._proc.wait(timeout=10)
+        assert be.brokers() == {}            # respawn 1 consumed
+        be._proc.kill(); be._proc.wait(timeout=10)
+        with pytest.raises(RpcError, match="respawn budget"):
+            be.brokers()
+    finally:
+        be.close()
+
+
+def test_rpc_timeout_kills_then_respawn_serves_next_call():
+    """One slow request terminates the poisoned sidecar (fail-stop), and the
+    NEXT call gets a fresh sidecar within the respawn budget — the
+    permadeath fix for the 'sidecar terminated' lifetime failure."""
+    import sys
+
+    from cruise_control_tpu.backend.rpc import RpcClusterBackend, RpcError
+    be = RpcClusterBackend(
+        argv=[sys.executable, "-m", "cruise_control_tpu.backend.rpc",
+              "--slow-ms", "400"],
+        admin_timeout_s=0.05, max_respawns=3)
+    try:
+        with pytest.raises(RpcError, match="sidecar terminated"):
+            be.brokers()
+        be._admin_timeout_s = 5.0            # operator widens the budget
+        assert be.brokers() == {}            # respawned + served
+        assert be.restarts == 1
+    finally:
+        be.close()
+
+
+# --------------------------------------------------------------- degraded app
+@pytest.fixture()
+def degraded_app():
+    from cruise_control_tpu.app import CruiseControl
+    be = SimulatedClusterBackend()
+    for b in range(4):
+        be.add_broker(b, f"r{b % 2}")
+    for p in range(8):
+        be.create_partition("t", p, [p % 4, (p + 1) % 4], size_mb=10.0,
+                            bytes_in_rate=2.0)
+    cc = CruiseControl(be, cruise_control_config({
+        "num.metrics.windows": 2, "min.samples.per.metrics.window": 1,
+        "goals": ["ReplicaDistributionGoal"],
+        "hard.goals": [], "anomaly.detection.goals": ["ReplicaDistributionGoal"],
+        "self.healing.enabled": True,
+    }))
+    cc.start_up()
+    cc.load_monitor.sample_once(now_ms=0.0)
+    be.advance(300_000.0)
+    cc.load_monitor.sample_once(now_ms=be.now_ms())
+    yield cc
+    cc.shutdown()
+
+
+def _trip(cc, op_class="executor.submit"):
+    br = cc.fault_tolerance.breaker(op_class)
+    for _ in range(10):
+        br.on_failure()
+    assert cc.degraded()
+    return br
+
+
+def test_degraded_writes_raise_503_and_reads_serve_stale(degraded_app, monkeypatch):
+    cc = degraded_app
+    res = cc.cached_proposals()                  # prime the cache (healthy)
+    assert res is not None
+    _trip(cc)
+    # writes: rejected with Retry-After semantics
+    with pytest.raises(ServiceUnavailableError) as ei:
+        cc.rebalance(dry_run=False, reason="should 503")
+    assert ei.value.retry_after_s >= 1.0
+    with pytest.raises(ServiceUnavailableError):
+        cc.fix_topic_replication_factor({"t": 3})
+    # dry-run optimization is still allowed while degraded (read path)
+    out = cc.rebalance(dry_run=True, reason="reads ok")
+    assert out["operation"] == "REBALANCE"
+    # reads: a failing refresh serves the cached result flagged stale with
+    # generation + age instead of raising
+    monkeypatch.setattr(cc.load_monitor, "cluster_model",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("model build down")))
+    if cc.resident_session is not None:
+        monkeypatch.setattr(cc.resident_session, "sync",
+                            lambda *a, **k: (_ for _ in ()).throw(
+                                RuntimeError("session sync down")))
+    got, fresh = cc.cached_proposals_verbose(force_refresh=True)
+    assert got is res
+    assert fresh["stale"] is True
+    assert isinstance(fresh["generation"], list)
+    assert fresh["ageMs"] >= 0.0
+
+
+def test_degraded_read_with_no_cache_is_503_not_500(degraded_app, monkeypatch):
+    cc = degraded_app
+    _trip(cc)
+    monkeypatch.setattr(cc.load_monitor, "cluster_model",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("model build down")))
+    if cc.resident_session is not None:
+        monkeypatch.setattr(cc.resident_session, "sync",
+                            lambda *a, **k: (_ for _ in ()).throw(
+                                RuntimeError("session sync down")))
+    with pytest.raises(ServiceUnavailableError):
+        cc.cached_proposals_verbose()
+
+
+def test_detector_defers_fix_while_degraded(degraded_app):
+    from cruise_control_tpu.detector.anomalies import (
+        AnomalyType, MaintenanceEvent,
+    )
+    cc = degraded_app
+    br = _trip(cc)
+    anomaly = MaintenanceEvent(anomaly_type=AnomalyType.MAINTENANCE_EVENT,
+                               detected_ms=cc.backend.now_ms(),
+                               plan_type="REBALANCE",
+                               description="maintenance plan REBALANCE")
+    cc.anomaly_detector.add_anomaly(anomaly)
+    handled = cc.anomaly_detector.handle_anomalies(cc.backend.now_ms())
+    assert len(handled) == 1
+    # deferred like a CHECK verdict, the fix did NOT fire, no failure burned
+    assert handled[0]["action"] == "CHECK"
+    assert handled[0]["deferred"] == "backend degraded"
+    assert cc.ops_history == []
+    sensors = cc.sensors.to_json()
+    assert sensors["self-healing-fix-deferrals"]["count"] == 1
+    assert "self-healing-fix-failures" not in sensors
+    # breaker closes -> the deferred anomaly re-enters and the fix fires
+    br.on_success()
+    later = cc.backend.now_ms() + 10 * 60_000.0
+    cc.backend.advance(10 * 60_000.0)
+    handled = cc.anomaly_detector.handle_anomalies(later)
+    assert len(handled) == 1 and handled[0]["action"] == "FIX"
+    assert [op["operation"] for op in cc.ops_history] == ["REBALANCE"]
+
+
+def test_server_maps_degraded_to_503_with_retry_after(degraded_app):
+    from cruise_control_tpu.api.server import CruiseControlServer
+    cc = degraded_app
+    cc.cached_proposals()
+    _trip(cc)
+    srv = CruiseControlServer(cc, max_block_ms=30_000.0)
+    srv.start()
+    try:
+        req = urllib.request.Request(
+            srv.base_url + "/rebalance?dryrun=false&reason=x", data=b"",
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert ei.value.code == 503
+        assert ei.value.headers["Retry-After"] is not None
+        # the stale read serves 200 with the stale flag
+        with urllib.request.urlopen(srv.base_url + "/proposals") as resp:
+            import json as _json
+            body = _json.loads(resp.read())
+        assert resp_status_ok(body)
+    finally:
+        srv.stop()
+
+
+def resp_status_ok(body: dict) -> bool:
+    return "summary" in body and "stale" in body
+
+
+def test_user_task_overflow_returns_429_with_retry_after():
+    from cruise_control_tpu.api.server import CruiseControlServer
+    from cruise_control_tpu.app import CruiseControl
+    be = SimulatedClusterBackend()
+    be.add_broker(0, "r0")
+    be.create_partition("t", 0, [0], size_mb=1.0)
+    cc = CruiseControl(be, cruise_control_config({"num.metrics.windows": 2}))
+    gate = threading.Event()
+    release = threading.Event()
+
+    def blocked(*a, **k):
+        gate.set()
+        release.wait(30.0)
+        return {"blocked": True}
+    cc.broker_load_json = blocked
+    srv = CruiseControlServer(cc, max_block_ms=100.0, max_active_user_tasks=1)
+    srv.start()
+    try:
+        # first request parks the single slot (202 progress poll)
+        resp = urllib.request.urlopen(srv.base_url + "/load")
+        assert resp.status == 202
+        assert gate.wait(10.0)
+        # second DISTINCT request overflows max_active_user_tasks -> the
+        # reference's 429 semantics with Retry-After, not a generic 500
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.base_url + "/load?capacity_only=true")
+        assert ei.value.code == 429
+        assert ei.value.headers["Retry-After"] is not None
+        assert "reached the limit" in ei.value.read().decode()
+    finally:
+        release.set()
+        srv.stop()
+        cc.shutdown()
+
+
+def test_wait_for_completion_does_not_leak_handler_threads():
+    inner = _cluster()
+    ex = Executor(inner)
+    for p in range(3):
+        ex.execute_proposals([_move("t", p, [p % 3, (p + 1) % 3],
+                                    [p % 3, 3])], blocking=False)
+        ex.wait_for_completion(timeout_s=60.0)
+        assert ex._execution_thread is None
+    alive = [t.name for t in threading.enumerate()
+             if t.name.startswith("Thread-") and t.is_alive()]
+    # the three executions reused no lingering handler threads
+    assert ex.state == "NO_TASK_IN_PROGRESS"
+    assert len(alive) <= 1      # at most the one just-joined finishing up

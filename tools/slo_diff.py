@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Diff two chaos-campaign SLO blocks; exit nonzero on p95 regression.
+
+Folds campaign SLO distributions into the trajectory-comparison workflow:
+``CAMPAIGN_<name>_s<seed>.json`` artifacts (bench.py --campaign) or bench
+summary documents (their ``campaign`` block) are compared per fault kind —
+time-to-detect / time-to-heal p95 (simulated ms) and the undetected /
+unhealed counts — and any candidate p95 more than ``--threshold`` (default
+25%) above the baseline, or any new undetected/unhealed fault, fails the
+diff with exit code 1.
+
+Usage:
+  tools/slo_diff.py BASELINE.json CANDIDATE.json [--threshold 0.25]
+                    [--fields time_to_heal_ms,time_to_detect_ms]
+
+Accepted documents (auto-detected): a campaign episode log / campaign doc
+with a top-level ``slo``, a bench summary with ``campaign.slo``, or a bare
+SLO mapping {kind: {time_to_detect_ms: {p50, p95, max}, ...}}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+DEFAULT_FIELDS = ("time_to_detect_ms", "time_to_heal_ms")
+
+
+def extract_slo(doc: dict) -> dict:
+    """Locate the per-fault-kind SLO mapping inside any supported artifact."""
+    if "slo" in doc:
+        return doc["slo"]
+    if "campaign" in doc and isinstance(doc["campaign"], dict) \
+            and "slo" in doc["campaign"]:
+        return doc["campaign"]["slo"]
+    # bare mapping: every value must look like an SLO row
+    if doc and all(isinstance(v, dict) and "time_to_detect_ms" in v
+                   for v in doc.values()):
+        return doc
+    raise ValueError("no SLO block found (expected 'slo', 'campaign.slo' "
+                     "or a bare kind->distributions mapping)")
+
+
+def compare_slos(base: dict, cand: dict, threshold: float = 0.25,
+                 fields=DEFAULT_FIELDS):
+    """Returns (rows, regressions). A row per (kind, field) present in both
+    documents; regressions is the subset failing the bar:
+
+    - candidate p95 > baseline p95 * (1 + threshold)
+    - candidate undetected/unhealed count above the baseline's
+    - a fault kind with measurements in the baseline but NONE in the
+      candidate (silent coverage loss)
+    """
+    rows, regressions = [], []
+    for kind in sorted(set(base) | set(cand)):
+        b, c = base.get(kind), cand.get(kind)
+        if b is None or c is None:
+            # a kind only one side drew is schedule drift, not a regression
+            rows.append({"kind": kind, "field": "-", "note":
+                         "only in " + ("baseline" if c is None else "candidate")})
+            continue
+        for field in fields:
+            bp = (b.get(field) or {}).get("p95")
+            cp = (c.get(field) or {}).get("p95")
+            row = {"kind": kind, "field": field, "base_p95": bp,
+                   "cand_p95": cp}
+            if bp is not None and cp is None:
+                row["regression"] = "coverage lost (no candidate samples)"
+                regressions.append(row)
+            elif bp is not None and cp is not None \
+                    and cp > bp * (1.0 + threshold):
+                row["regression"] = (f"p95 {cp:.1f} > {bp:.1f} "
+                                     f"* (1 + {threshold:g})")
+                regressions.append(row)
+            rows.append(row)
+        for counter in ("undetected", "unhealed"):
+            bn, cn = b.get(counter, 0), c.get(counter, 0)
+            if cn > bn:
+                row = {"kind": kind, "field": counter, "base_p95": bn,
+                       "cand_p95": cn,
+                       "regression": f"{counter} {bn} -> {cn}"}
+                regressions.append(row)
+                rows.append(row)
+    return rows, regressions
+
+
+def main(argv: list[str]) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    if len(args) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    threshold = 0.25
+    fields = DEFAULT_FIELDS
+    if "--threshold" in argv:
+        threshold = float(argv[argv.index("--threshold") + 1])
+        args = [a for a in args
+                if a != argv[argv.index("--threshold") + 1]]
+    if "--fields" in argv:
+        raw = argv[argv.index("--fields") + 1]
+        fields = tuple(f.strip() for f in raw.split(",") if f.strip())
+        args = [a for a in args if a != raw]
+    base_path, cand_path = args[:2]
+    with open(base_path) as f:
+        base = extract_slo(json.load(f))
+    with open(cand_path) as f:
+        cand = extract_slo(json.load(f))
+    rows, regressions = compare_slos(base, cand, threshold, fields)
+    w = max((len(r["kind"]) for r in rows), default=4)
+    print(f"{'kind':<{w}}  {'field':<20}  {'base p95':>12}  {'cand p95':>12}"
+          f"  verdict")
+    for r in rows:
+        if "note" in r:
+            print(f"{r['kind']:<{w}}  {'-':<20}  {'-':>12}  {'-':>12}  "
+                  f"{r['note']}")
+            continue
+        bp = "-" if r.get("base_p95") is None else f"{r['base_p95']:.1f}"
+        cp = "-" if r.get("cand_p95") is None else f"{r['cand_p95']:.1f}"
+        verdict = r.get("regression", "ok")
+        print(f"{r['kind']:<{w}}  {r['field']:<20}  {bp:>12}  {cp:>12}  "
+              f"{verdict}")
+    if regressions:
+        print(f"\n{len(regressions)} SLO regression(s) beyond "
+              f"threshold {threshold:g}", file=sys.stderr)
+        return 1
+    print("\nno SLO regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
