@@ -910,6 +910,67 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
                 f"({steady_compiles[-1]} XLA compiles) — recorded in the rung")
     if steady_skip_reason is not None:
         rung["steady_skip_reason"] = steady_skip_reason
+    # ---- pipelined-vs-blocking A/B (PR 11: the continuous pipelined loop) --
+    # Re-run the steady round through PipelinedServiceLoop.pipelined_round:
+    # round N's optimize on its own thread, round N+1's sampling fetch +
+    # session sync (the shadow-slot upload) overlapped UNDER it. The recorded
+    # RoundTrace carries the stage lanes + overlap fractions; the A/B
+    # contract is violation/certificate sets bit-identical to the blocking
+    # steady round, still delta-mode / 0 new compiles / donation intact.
+    if steady_walls:
+        pipe_est = steady_walls[-1] * 1.15 + sample_s / rounds
+        if pipe_est > remaining_budget():
+            rung["pipelined_skip_reason"] = (
+                f"wall budget: pipelined rounds (~{pipe_est:.0f}s est) > "
+                f"{remaining_budget():.0f}s remaining")
+            log(f"  [e2e] {rung['pipelined_skip_reason']}")
+        else:
+            from cruise_control_tpu.pipeline import PipelinedServiceLoop
+            pipe = PipelinedServiceLoop(cc)
+            p_walls, p_compiles, p_modes = [], [], []
+            p_out = None
+            for r in range(2):
+                with count_compiles() as pipe_cc:
+                    p_out = pipe.pipelined_round(
+                        now_ms=(rounds + 2 + r) * 300_000.0)
+                p_walls.append(p_out["wall_s"])
+                p_compiles.append(pipe_cc.count)
+                p_modes.append(p_out["sync_info"].get("mode"))
+                log(f"  [e2e] pipelined round {r}: {p_walls[-1]:.2f}s "
+                    f"mode={p_modes[-1]} compiles={pipe_cc.count}")
+
+            def goal_sets(res):
+                return [(g.name, bool(g.violated_after),
+                         bool(g.fixpoint_proven)) for g in res.goal_results]
+
+            p_res = p_out["result"]
+            trace = p_out["trace"]
+            ab_identical = goal_sets(p_res) == goal_sets(res2)
+            sess = cc.resident_session
+            rung["pipelined"] = {
+                "round_s_pipelined": round(p_walls[-1], 3),
+                "round_s_pipelined_runs": [round(w, 3) for w in p_walls],
+                "pipelined_compiles": p_compiles,
+                "pipelined_session_modes": p_modes,
+                # per-stage overlap summary from the last recorded trace:
+                # {stage: {dur_s, overlap_s, overlap_frac}} — the fraction of
+                # sampling/sync wall spent UNDER an in-flight optimize round
+                "overlap": dict(getattr(trace, "overlap", {}) or {}),
+                "donated": bool(getattr(trace, "donated", False)),
+                "shadow_syncs": (sess.shadow_syncs if sess is not None else 0),
+                # the acceptance contract: pipelined == blocking on
+                # violation + certificate sets and the proposal count
+                "ab_identical_sets": ab_identical,
+                "ab_identical_proposals":
+                    len(p_res.proposals) == len(res2.proposals),
+            }
+            ov = rung["pipelined"]["overlap"]
+            log(f"  [e2e] pipelined A/B: sets_identical={ab_identical} "
+                f"overlap={ {k: v.get('overlap_frac') for k, v in ov.items()} } "
+                f"shadow_syncs={rung['pipelined']['shadow_syncs']}")
+            if p_compiles[-1] > 0:
+                log(f"  [e2e] WARNING: last pipelined round recompiled "
+                    f"({p_compiles[-1]} XLA compiles) — recorded in the rung")
     if warmup_s is not None:
         rung["warmup_s"] = round(warmup_s, 2)
     # ---- restart recovery (durable sample store replay) ----
@@ -939,8 +1000,8 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
             t0 = time.monotonic()
             # two rounds: the aggregator only counts CLOSED windows, so the
             # second round is what makes the first replayable into a model
-            cc.load_monitor.sample_once(now_ms=(rounds + 2) * 300_000.0)
-            cc.load_monitor.sample_once(now_ms=(rounds + 3) * 300_000.0)
+            cc.load_monitor.sample_once(now_ms=(rounds + 4) * 300_000.0)
+            cc.load_monitor.sample_once(now_ms=(rounds + 5) * 300_000.0)
             store_round_s = (time.monotonic() - t0) / 2
             store.close()
             cc2 = CruiseControl(be, cruise_control_config({

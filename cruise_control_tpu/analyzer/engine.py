@@ -394,6 +394,23 @@ class EngineParams:
     # (hashable Mesh is part of the compiled program); None — the default —
     # and meshes of size 1 compile exactly the pre-mesh engine.
     mesh: object = None
+    # ---- finisher scan/apply overlap (PR 11, the PERF round-11 lever) ----
+    # Dispatch the finisher round's LEADERSHIP scan against the round-ENTRY
+    # state instead of the post-move-wave state: the exhaustive scan (pure
+    # read) and the move wave's apply chain then have no data dependency, so
+    # XLA schedules them concurrently — the scan's HBM sweep overlaps the
+    # apply's scatters (they touch disjoint state until admission). Selection
+    # from the overlapped scan is stale by at most one wave, but every
+    # application re-scores [K, B] exact against the LIVE state (the
+    # _finisher_wave banding argument), and the fixpoint CERTIFICATE is
+    # untouched: it is only claimed when the final round applied nothing —
+    # and a round whose move waves applied nothing left the entry state
+    # identical to the post-wave state, so the overlapped scan was exact.
+    # Outcome-parity exploration like pass_waves>1 (intermediate-round
+    # trajectories may differ; convergence certificates hold either way);
+    # rounds that prove their fixpoint at first scan are bit-identical.
+    # STATIC field: toggling recompiles (analyzer.finisher.overlap).
+    finisher_overlap: bool = False
 
 
 # EngineParams is a JAX PYTREE: the pure BUDGET fields (loop caps, gain
@@ -1697,6 +1714,7 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
     def round_body(carry):
         st, rounds, prev_m, prev_l, total, bnd, _done, _clean = carry
+        st_entry = st          # round-entry state (the overlap anchor)
         mleft = zero
         lleft = zero
         applied = zero
@@ -1711,7 +1729,14 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
             applied += n
             bnd += nb
         if use_leads:
-            gain, _ = _exhaustive_lead_scan(env, st, goal, prev_goals,
+            # finisher_overlap (PERF round-11 lever): scan against the
+            # round-ENTRY state so the exhaustive leadership sweep carries no
+            # data dependency on the move wave's apply chain — XLA overlaps
+            # them. Exact whenever the move waves applied nothing, which is
+            # the only case the certificate is claimed in (see EngineParams).
+            scan_st = (st_entry if (params.finisher_overlap and use_moves)
+                       else st)
+            gain, _ = _exhaustive_lead_scan(env, scan_st, goal, prev_goals,
                                             params.scan_chunk,
                                             mesh=_engine_mesh(params))
             lleft = jnp.sum(gain > params.min_gain).astype(jnp.int32)

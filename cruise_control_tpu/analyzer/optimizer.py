@@ -278,6 +278,11 @@ class GoalOptimizer:
                 finisher_segments=config.get_int("analyzer.finisher.segments"),
                 max_finisher_segments=config.get_int(
                     "analyzer.finisher.segments"),
+                # PERF round-11 lever: dispatch the finisher's leadership
+                # scan against the round-entry state so it overlaps the move
+                # wave's apply in the dataflow graph (engine._finisher)
+                finisher_overlap=config.get_boolean(
+                    "analyzer.finisher.overlap"),
             )
         self._params = engine_params or EngineParams()
         # analyzer.fused.chain.min.replicas: at/above this cluster size the
@@ -442,6 +447,10 @@ class GoalOptimizer:
                        min_leader_topic_pattern=None,
                        session=None) -> OptimizerResult:
         t_round = time.monotonic()
+        # pipelined-loop lanes: stage spans noted while this round is in
+        # flight (the sync thread's shadow-slot upload, the next sampling
+        # fetch) measure their overlap against [here, record_round]
+        self.recorder.note_optimize_start()
         compiles0 = self._compile_listener.count
         names = goal_names or self._default_goal_names
         # honour hard-goal enforcement (KafkaCruiseControl sanityCheckHardGoalPresence)

@@ -181,6 +181,10 @@ class CruiseControl:
         self._precompute_threads: list[threading.Thread] = []
         self._precompute_stop = threading.Event()
         self._ops_history: list[dict] = []
+        # the continuous pipelined service loop, when one drives this app
+        # (main.py service.pipeline.enabled / the sim's lockstep mode);
+        # surfaced via /state?substates=PIPELINE
+        self.service_pipeline = None
 
     # ------------------------------------------------------------- wiring
     def _wire_detectors(self):
@@ -931,7 +935,10 @@ class CruiseControl:
 
     def broker_load_json(self, populate_disk_info: bool = False,
                          capacity_only: bool = False) -> dict:
-        """GET /load (ClusterLoad/BrokerStats response)."""
+        """GET /load (ClusterLoad/BrokerStats response). The model build's
+        metadata reads ride the monitor's shared circuit breaker
+        (LoadMonitor._metadata_read): an outage degrades this read to a
+        declared 503 + Retry-After, never a raw backend error."""
         from cruise_control_tpu.api.responses import broker_stats_json
         ct, meta = self._model()
         return broker_stats_json(ct, meta, populate_disk_info=populate_disk_info,
@@ -1091,6 +1098,9 @@ class CruiseControl:
         if "ROUND_TRACES" in substates:
             # flight recorder: the bounded ring of per-round traces
             out["RoundTraces"] = self.flight_recorder.to_json()
+        if "PIPELINE" in substates and self.service_pipeline is not None:
+            # the continuous pipelined loop's stage/backpressure state
+            out["PipelineState"] = self.service_pipeline.state_json()
         return out
 
     def metrics_text(self) -> str:
@@ -1104,11 +1114,26 @@ class CruiseControl:
 
     def kafka_cluster_state(self, verbose: bool = False) -> dict:
         """GET /kafka_cluster_state
-        (servlet/response/KafkaClusterState.java schema)."""
+        (servlet/response/KafkaClusterState.java schema).
+
+        The backend reads ride the shared ``facade.read`` circuit breaker:
+        during an outage this read degrades to a DECLARED 503 + Retry-After
+        (ServiceUnavailableError) like the rest of the read family
+        (``/load`` and ``/partition_load`` ride the monitor's model-build
+        breaker), never a raw metadata error."""
         from cruise_control_tpu.api.responses import kafka_cluster_state_json
-        return kafka_cluster_state_json(self.backend.brokers(),
-                                        self.backend.partitions(),
-                                        verbose=verbose)
+        from cruise_control_tpu.common.retries import ServiceUnavailableError
+        ft = self.fault_tolerance
+        try:
+            brokers = ft.call("facade.read", self.backend.brokers)
+            partitions = ft.call("facade.read", self.backend.partitions)
+        except ServiceUnavailableError:
+            raise
+        except Exception as e:
+            raise ServiceUnavailableError(
+                f"cluster metadata unavailable ({type(e).__name__}: {e})",
+                retry_after_s=ft.retry_after_s()) from e
+        return kafka_cluster_state_json(brokers, partitions, verbose=verbose)
 
     def partition_load(self, sort_by: str = "DISK", limit: int = 50,
                        min_valid_partition_ratio: float | None = None) -> list:

@@ -180,6 +180,14 @@ class RoundTrace:
     num_replica_movements: int
     num_leadership_movements: int
     goals: list = dataclasses.field(default_factory=list)
+    # pipelined-service-loop lanes (PR 11): the ingest/sync/execute stage
+    # spans that PREPARED this round (noted by the pipeline before the round
+    # ran), each with the seconds it overlapped an in-flight optimize round —
+    # the flight-recorder proof that sampling/sync are off the critical path
+    stages: list = dataclasses.field(default_factory=list)
+    # per-stage summary {stage: {"dur_s", "overlap_s", "overlap_frac"}};
+    # empty on the blocking loop (nothing ever overlaps optimize there)
+    overlap: dict = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> dict:
         out = dataclasses.asdict(self)
@@ -233,6 +241,11 @@ class FlightRecorder:
         self._next_id = 0
         self._sampling_s: float | None = None
         self._tl = threading.local()
+        # pipelined-loop lane bookkeeping: stage spans noted since the last
+        # recorded round (they fed the NEXT round), and the monotonic start
+        # of the optimize round currently in flight (None = none in flight)
+        self._pending_stages: list[dict] = []
+        self._opt_t0: float | None = None
 
     # ------------------------------------------------------------ annotate
     def note_sampling(self, seconds: float) -> None:
@@ -246,6 +259,59 @@ class FlightRecorder:
         op = getattr(self._tl, "operation", None)
         self._tl.operation = None
         return op
+
+    # ------------------------------------------------------ pipeline lanes
+    def note_optimize_start(self) -> None:
+        """The optimizer marks its round's start so concurrently-noted stage
+        spans can measure how much of their wall ran UNDER the in-flight
+        round (the pipelined loop's overlap proof)."""
+        with self._lock:
+            self._opt_t0 = time.monotonic()
+
+    def optimize_in_flight(self) -> bool:
+        """True between note_optimize_start and the round's record_round —
+        the pipelined loop uses it to sequence its overlapped stages."""
+        with self._lock:
+            return self._opt_t0 is not None
+
+    def note_stage(self, stage: str, t0: float, t1: float, **extra) -> None:
+        """Record one pipeline stage span (monotonic seconds). ``overlap_s``
+        is the part of [t0, t1] spent while an optimize round was in flight —
+        computed here, at note time, because by the time the round records
+        its trace the concurrent span is history. Spans accumulate and attach
+        to the NEXT recorded round (the round they prepared)."""
+        t0, t1 = float(t0), float(t1)
+        with self._lock:
+            opt_t0 = self._opt_t0
+            now = time.monotonic()
+            overlap = 0.0
+            if opt_t0 is not None:
+                overlap = max(0.0, min(t1, now) - max(t0, opt_t0))
+            span = {"stage": stage, "dur_s": round(max(t1 - t0, 0.0), 4),
+                    "overlap_s": round(overlap, 4)}
+            span.update(extra)
+            self._pending_stages.append(span)
+            del self._pending_stages[:-64]   # bounded like the trace ring
+
+    def _take_stages(self) -> tuple[list, dict]:
+        """Consume pending stage spans; returns (stages, per-stage overlap
+        summary). Caller holds no lock."""
+        with self._lock:
+            stages = self._pending_stages
+            self._pending_stages = []
+            self._opt_t0 = None
+        summary: dict = {}
+        for s in stages:
+            agg = summary.setdefault(s["stage"],
+                                     {"dur_s": 0.0, "overlap_s": 0.0})
+            agg["dur_s"] += s["dur_s"]
+            agg["overlap_s"] += s["overlap_s"]
+        for agg in summary.values():
+            agg["dur_s"] = round(agg["dur_s"], 4)
+            agg["overlap_s"] = round(agg["overlap_s"], 4)
+            agg["overlap_frac"] = round(
+                agg["overlap_s"] / agg["dur_s"], 4) if agg["dur_s"] else 0.0
+        return stages, summary
 
     # -------------------------------------------------------------- record
     def next_round_id(self) -> int:
@@ -271,6 +337,7 @@ class FlightRecorder:
         info = session_info or {}
         with self._lock:
             sampling_s = self._sampling_s
+        stages, overlap = self._take_stages()
         try:
             trace = RoundTrace(
                 round_id=self.next_round_id(),
@@ -290,6 +357,8 @@ class FlightRecorder:
                 num_replica_movements=int(num_replica_movements),
                 num_leadership_movements=int(num_leadership_movements),
                 goals=goal_trace_rows(goal_results),
+                stages=stages,
+                overlap=overlap,
             )
         except Exception:  # noqa: BLE001 — tracing must never fail a round
             import logging

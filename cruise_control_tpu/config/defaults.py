@@ -287,6 +287,42 @@ _D.define(name="optimization.options.generator.class", type=Type.CLASS,
           default="cruise_control_tpu.analyzer.options.DefaultOptimizationOptionsGenerator",
           doc="Pluggable OptimizationOptions generator "
               "(AnalyzerConfig optimization.options.generator.class).")
+_D.define(name="analyzer.finisher.overlap", type=Type.BOOLEAN, default=False,
+          doc="TPU-specific (PERF round-11 lever): dispatch the exhaustive "
+              "finisher's leadership scan against the round-ENTRY state so "
+              "it overlaps the move wave's apply in the compiled dataflow "
+              "graph (they touch disjoint state until admission; every "
+              "application still re-scores exact against the live state). "
+              "Outcome-parity exploration like analyzer.pass.waves>1: "
+              "intermediate trajectories may differ, fixpoint certificates "
+              "are only ever claimed from an exact (apply-free) final round. "
+              "STATIC engine field: toggling recompiles the goal programs.")
+
+# --------------------------------------------------------------------------
+# Pipelined service loop (PR 11: overlap sampling/sync/optimize/execute)
+# --------------------------------------------------------------------------
+_D.define(name="service.pipeline.enabled", type=Type.BOOLEAN, default=True,
+          doc="Run the live service's steady loop as the four-stage pipeline "
+              "(cruise_control_tpu/pipeline.py): sampling ingest -> ring "
+              "buffer -> sync (shadow-slot device uploads overlapped with "
+              "the in-flight optimize round) -> optimize (backpressured by "
+              "meetCompletenessRequirements) -> async generation-tagged "
+              "execution drain. Off restores the blocking "
+              "sample->sync->optimize->execute round (main.py SamplingLoop "
+              "+ proposal precompute threads).")
+_D.define(name="service.pipeline.ring.capacity", type=Type.INT, default=8,
+          validator=at_least(1),
+          doc="Per-shape-bucket capacity of the ingest stage's host-side "
+              "sample ring buffer; a full bucket drops its OLDEST batch "
+              "(counted in pipeline-ring state) instead of blocking the "
+              "sampling thread.")
+_D.define(name="service.pipeline.min.windows", type=Type.INT, default=1,
+          validator=at_least(1),
+          doc="Completeness backpressure bar of the pipeline's optimize "
+              "stage: the stage STALLS (no error) until the monitor holds "
+              "at least this many valid windows, and releases on its own "
+              "once live sampling fills them (meetCompletenessRequirements "
+              "as the explicit backpressure signal, SURVEY §2.3).")
 
 # --------------------------------------------------------------------------
 # Monitor (reference: config/constants/MonitorConfig.java)

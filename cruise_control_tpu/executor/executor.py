@@ -344,6 +344,7 @@ class Executor:
         self._recently_removed_brokers: dict[int, float] = {}
         self._recently_demoted_brokers: dict[int, float] = {}
         self._execution_thread: threading.Thread | None = None
+        self._proposal_generation: int | None = None
         self._reservation = None
         min_isr_cache = None
         self._notifier = None
@@ -541,11 +542,15 @@ class Executor:
 
     def execute_proposals(self, proposals: list, blocking: bool = True,
                           context: dict | None = None,
-                          strategy_names: list | None = None) -> None:
+                          strategy_names: list | None = None,
+                          generation: int | None = None) -> None:
         """Run the 3-phase execution (Executor.executeProposals :567).
         ``strategy_names`` overrides the configured default movement-strategy
         chain for this execution (the REST replica_movement_strategies
-        parameter role)."""
+        parameter role). ``generation`` is the metadata generation the
+        proposals were computed against (the pipelined loop's staleness tag
+        — recorded for observability; the pipeline drops stale sets BEFORE
+        they reach here)."""
         strategy = (build_strategy(strategy_names, registry=self._strategy_registry)
                     if strategy_names else self._strategy)
         with self._lock:
@@ -554,6 +559,7 @@ class Executor:
             self._state = ExecutorState.STARTING_EXECUTION
             self._stop_requested = False
             self._force_stop = False
+            self._proposal_generation = generation
         self._execution_meter.mark()
         # a fresh execution consults the current broker metrics immediately
         # (the reference's adjuster thread runs continuously; ours only runs
@@ -1032,6 +1038,10 @@ class Executor:
         out["numPlannedTasksTotal"] = sum(h["numTasks"] for h in self._history)
         out["paused"] = self._paused
         out["numPauseTicks"] = self._pause_ticks
+        if getattr(self, "_proposal_generation", None) is not None:
+            # pipelined loop: the metadata generation this execution's
+            # proposals were computed against (staleness-tag observability)
+            out["proposalGeneration"] = self._proposal_generation
         out["backendFaultTolerance"] = self._ft.state_json()
         if self._cfg.adjuster_enabled:
             out["concurrencyAdjuster"] = {

@@ -281,19 +281,35 @@ def main(argv=None) -> int:
             seed_backend_from_spec(cc.backend, json.load(f))
 
     # startUp order mirrors KafkaCruiseControl.startUp (:201-207): monitor
-    # replay, sampling schedule, proposal precompute, anomaly detection,
-    # then the web server (KafkaCruiseControl.java:201-207 start order)
-    # startUp also spawns the analyzer.warmup.on.start background compile
-    # thread (CruiseControl.start_up) — off the serving critical path
-    cc.start_up(proposal_precompute=True)
-    sampling = build_sampling_loop(cc, config)
-    sampling.start()
+    # replay, steady-loop drive, anomaly detection, then the web server.
+    # service.pipeline.enabled (default): the steady loop is the four-stage
+    # CONTINUOUS pipeline (cruise_control_tpu/pipeline.py) — its optimize
+    # stage replaces the proposal-precompute threads (same cache, driven by
+    # synced generations + completeness backpressure instead of polling) and
+    # its ingest stage replaces the blocking SamplingLoop. Off restores the
+    # legacy blocking round.
+    pipelined = config.get_boolean("service.pipeline.enabled")
+    cc.start_up(proposal_precompute=not pipelined)
+    sampling = None
+    pipeline = None
+    if pipelined:
+        from cruise_control_tpu.pipeline import PipelinedServiceLoop
+        pipeline = PipelinedServiceLoop(cc, config)
+        cc.service_pipeline = pipeline
+        pipeline.start()
+        if config.get_boolean("analyzer.warmup.on.start"):
+            threading.Thread(target=cc._warmup_quietly,
+                             name="engine-warmup", daemon=True).start()
+    else:
+        sampling = build_sampling_loop(cc, config)
+        sampling.start()
     if not args.no_detection:
         cc.anomaly_detector.start_detection(
             config.get_int("anomaly.detection.interval.ms"))
     server = build_server(cc, config)
     server.start()
-    LOG.info("cruise-control-tpu serving on %s", server.base_url)
+    LOG.info("cruise-control-tpu serving on %s (%s loop)", server.base_url,
+             "pipelined" if pipelined else "blocking")
     try:
         while True:
             time.sleep(3600)
@@ -301,7 +317,10 @@ def main(argv=None) -> int:
         LOG.info("shutting down")
     finally:
         server.stop()
-        sampling.stop()
+        if pipeline is not None:
+            pipeline.stop()
+        if sampling is not None:
+            sampling.stop()
         cc.shutdown()
     return 0
 

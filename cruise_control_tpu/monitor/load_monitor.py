@@ -256,7 +256,10 @@ class LoadMonitor:
             self._state = LoadMonitorState.BOOTSTRAPPING
         wms = self._partition_agg.window_ms
         if end_ms is None:
-            end_ms = time.time() * 1000.0
+            # unified service-mode clock: the backfill range ends at the same
+            # clock live sampling stamps from, so a bootstrap can never roll
+            # the ring past (or short of) the windows live rounds fill
+            end_ms = self.now_ms()
         # samples older than the ring depth are discarded on ingest, so a
         # wider range would only burn sampler calls: clamp to the window span
         horizon = end_ms - self._partition_agg.num_windows * wms
@@ -298,7 +301,7 @@ class LoadMonitor:
         try:
             wms = self._broker_agg.window_ms
             if end_ms is None:
-                end_ms = time.time() * 1000.0
+                end_ms = self.now_ms()   # unified service-mode clock
             horizon = end_ms - self._broker_agg.num_windows * wms
             start_ms = horizon if start_ms is None else max(start_ms, horizon)
             cpu, b_in, b_out = [], [], []
@@ -353,13 +356,32 @@ class LoadMonitor:
         return self._pause_reason
 
     # ------------------------------------------------------------- sampling
-    def sample_once(self, now_ms: float | None = None) -> int:
-        """One sampling round (SamplingTask.run -> MetricFetcherManager
-        .fetchMetricSamples path). Returns #samples ingested."""
+    def now_ms(self) -> float:
+        """The monitor's UNIFIED service-mode clock: the backend's canonical
+        ``now_ms()`` when it has one (the sim clock in simulated deployments,
+        wall time in real ones), wall time otherwise. Sampling, bootstrap and
+        training all stamp from THIS clock, so aggregation windows form from
+        live sampling alone on the same timeline the detector, executor and
+        proposal cache already run on — before this, samples were stamped
+        with wall time regardless, so a service whose backend clock advanced
+        (sim deployments, tests, the bench) could never fill windows by
+        sampling and stayed completeness-gated until a GET /bootstrap
+        backfilled them."""
+        now = getattr(self._backend, "now_ms", None)
+        if now is None:
+            return time.time() * 1000.0
+        return float(now())
+
+    def fetch_samples(self, now_ms: float | None = None):
+        """Fetch one round of samples WITHOUT ingesting them — the pipelined
+        loop's ingest stage (the sampling thread pushes the result into the
+        ring buffer; the sync stage ingests). Returns ``(samples, now,
+        fetch_s)`` or ``None`` when paused / no sampler / the fetch failed
+        (a failed round is a SKIPPED round — windows simply don't advance)."""
         if self._state == LoadMonitorState.PAUSED or self._sampler is None:
-            return 0
+            return None
         t0 = time.monotonic()
-        now = now_ms if now_ms is not None else time.time() * 1000.0
+        now = now_ms if now_ms is not None else self.now_ms()
 
         def fetch():
             # the fetcher pool splits the partition universe across concurrent
@@ -383,14 +405,22 @@ class LoadMonitor:
             samples = (self._ft.call("monitor.sample", fetch)
                        if self._ft is not None else fetch())
         except Exception:
-            # a failed round is a SKIPPED round, not a crashed sampling loop:
             # windows simply don't advance (completeness gating degrades
             # serving if this persists past the window budget)
             self._sampling_failures.mark()
             import logging
             logging.getLogger(__name__).warning(
                 "sampling round skipped: backend fetch failed", exc_info=True)
-            return 0
+            return None
+        return samples, now, time.monotonic() - t0
+
+    def ingest_samples(self, samples: Samples, fetch_s: float = 0.0) -> int:
+        """Ingest one fetched round into the aggregators + stores — the
+        pipelined loop's sync-stage half of ``sample_once``. ``fetch_s``
+        (the ingest-stage fetch wall this round already paid) folds into the
+        ``metric-sampling-timer`` / flight-recorder sampling note so the
+        pipelined and blocking loops report the same per-round figure."""
+        t0 = time.monotonic()
         n = self._ingest(samples)
         if self._store is not None:
             self._store.store_samples(samples)
@@ -399,6 +429,25 @@ class LoadMonitor:
             # store that keeps only mid-execution samples (its own class
             # gates on executor.has_ongoing_execution)
             self.on_execution_store.store_samples(samples)
+        if fetch_s:
+            dur = fetch_s + (time.monotonic() - t0)
+            self._sampling_timer.record(dur)
+            if self._recorder is not None:
+                self._recorder.note_sampling(dur)
+        return n
+
+    def sample_once(self, now_ms: float | None = None) -> int:
+        """One BLOCKING sampling round (SamplingTask.run ->
+        MetricFetcherManager.fetchMetricSamples path): fetch + ingest in one
+        call. Returns #samples ingested. The pipelined service loop runs the
+        two halves (``fetch_samples`` / ``ingest_samples``) on separate
+        stages instead."""
+        t0 = time.monotonic()
+        fetched = self.fetch_samples(now_ms)
+        if fetched is None:
+            return 0
+        samples, _now, _fetch_s = fetched
+        n = self.ingest_samples(samples)
         dur = time.monotonic() - t0
         self._sampling_timer.record(dur)
         if self._recorder is not None:

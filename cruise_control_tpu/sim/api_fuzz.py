@@ -168,6 +168,12 @@ class FuzzSpec:
         ("state", 2.0), ("proposals", 2.0), ("rebalance_dryrun", 1.5),
         ("user_tasks", 1.0), ("metrics", 1.0), ("malformed", 2.0),
         ("rebalance_execute", 1.0), ("stop", 0.5), ("resume_race", 1.0),
+        # the monitor read family (PR 11): /load and /partition_load ride
+        # the monitor's model-build breaker, /kafka_cluster_state the
+        # facade.read breaker — all must degrade to DECLARED 503s, never
+        # raw 500s, under injected backend faults
+        ("load", 0.75), ("partition_load", 0.75),
+        ("kafka_cluster_state", 0.75),
     )
 
 
@@ -371,6 +377,18 @@ class ApiFuzzer:
         elif kind == "user_tasks":
             status, _, _ = self._request("GET", "/user_tasks")
             self._expect(entry, status, ("2xx",))
+        elif kind == "load":
+            # model-build read: degraded-mode contract is a declared 503
+            # (monitor breaker open / injected fault), never a raw 500
+            status, body, _ = self._request("GET", "/load")
+            self._expect(entry, status, degraded_ok, body)
+        elif kind == "partition_load":
+            status, body, _ = self._request(
+                "GET", "/partition_load?max_load=true")
+            self._expect(entry, status, degraded_ok, body)
+        elif kind == "kafka_cluster_state":
+            status, body, _ = self._request("GET", "/kafka_cluster_state")
+            self._expect(entry, status, degraded_ok, body)
         elif kind == "metrics":
             status, _, _ = self._request("GET", "/metrics")
             self._expect(entry, status, ("2xx",))

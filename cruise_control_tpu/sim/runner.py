@@ -95,6 +95,10 @@ class ScenarioResult:
     # bit-identical per (scenario, seed).
     round_traces: list = dataclasses.field(default_factory=list)
     sensors: dict = dataclasses.field(default_factory=dict)
+    # pipelined-mode counters (PipelinedServiceLoop.state_json, lockstep
+    # drive): deterministic stage/backpressure/staleness counts — part of
+    # the reproducible record when the runner drove the pipeline
+    pipeline: dict = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -131,13 +135,15 @@ class ScenarioResult:
             "provision_actions": list(self.provision_actions),
             "concurrency_adjustments": self.concurrency_adjustments,
             "failures": list(self.failures),
+            **({"pipeline": self.pipeline} if self.pipeline else {}),
         }
 
 
 class ScenarioRunner:
     def __init__(self, scenario: Scenario, seed: int = 0,
                  settle_ticks: int | None = None, workdir: str | None = None,
-                 backend_wrap=None, tick_hook=None):
+                 backend_wrap=None, tick_hook=None, pipelined: bool = False,
+                 optimize_every: int = 0):
         """``backend_wrap``: optional ``backend -> backend`` applied to the
         built SimulatedClusterBackend before the app sees it — the chaos
         fuzzer wraps a :class:`~cruise_control_tpu.sim.api_fuzz.FaultyBackend`
@@ -145,9 +151,21 @@ class ScenarioRunner:
         the invariant checks keep reading ground truth via ``.inner``.
         ``tick_hook``: optional ``(runner, now_ms) -> None`` invoked at the
         end of every tick (after anomaly handling, before invariants) — the
-        REST fuzzer issues its lockstep request schedule from it."""
+        REST fuzzer issues its lockstep request schedule from it.
+        ``pipelined``: drive sampling through the continuous pipelined
+        service loop's LOCKSTEP mode (PipelinedServiceLoop.step — ingest ->
+        ring -> sync per tick, hand-offs keyed by the tick clock, never wall
+        time) instead of the blocking ``sample_once``; the per-tick work is
+        deterministic, so the timeline stays bit-identical per (scenario,
+        seed) with pipelining ON (test-asserted). ``optimize_every``: with
+        pipelining, additionally run the pipeline's backpressured optimize
+        stage every N ticks (0 = never — detector heals stay the only
+        optimizations, exactly like the blocking loop)."""
         self.scenario = scenario
         self.seed = seed
+        self.pipelined = pipelined
+        self.optimize_every = optimize_every
+        self.pipe = None
         self.settle_ticks = (settle_ticks if settle_ticks is not None
                              else scenario.settle_ticks)
         self._workdir = workdir
@@ -311,11 +329,21 @@ class ScenarioRunner:
         sc = self.scenario
         self._build()
         lm, ad = self.cc.load_monitor, self.cc.anomaly_detector
+        if self.pipelined:
+            # lockstep pipelined mode: the runner's per-tick sampling drives
+            # the pipeline's ingest->ring->sync stages (deterministic: one
+            # unit of stage work per tick, keyed by the tick clock)
+            from cruise_control_tpu.pipeline import PipelinedServiceLoop
+            self.pipe = PipelinedServiceLoop(self.cc)
+            self.cc.service_pipeline = self.pipe
         window_ms = float(self.cc.config.get_int("metrics.window.ms"))
         warm_rounds = self.cc.config.get_int("num.metrics.windows") + 1
         for _ in range(warm_rounds):
             self.backend.advance(window_ms)
-            lm.sample_once(now_ms=self._now())
+            if self.pipe is not None:
+                self.pipe.step(self._now(), optimize=False)
+            else:
+                lm.sample_once(now_ms=self._now())
         self._t0 = self._now()
         arm = getattr(self.backend, "arm", None)
         if arm is not None:   # FaultyBackend windows are t0-relative
@@ -333,7 +361,12 @@ class ScenarioRunner:
             # nominal grid already; ticks are relative, not grid-aligned
             self.backend.advance(sc.tick_ms)
             now = self._now()
-            lm.sample_once(now_ms=now)
+            if self.pipe is not None:
+                run_opt = (self.optimize_every > 0
+                           and self.result.ticks % self.optimize_every == 0)
+                self.pipe.step(now, optimize=run_opt)
+            else:
+                lm.sample_once(now_ms=now)
             ad.run_due(now)
             self._record_provision_actions()
             for h in ad.handle_anomalies(now):
@@ -513,6 +546,8 @@ class ScenarioRunner:
         # runner bookkeeping
         r.round_traces = self.cc.flight_recorder.to_json()["traces"]
         r.sensors = self.cc.sensors.to_json()
+        if self.pipe is not None:
+            r.pipeline = self.pipe.state_json()
         self.cc.shutdown()
 
 

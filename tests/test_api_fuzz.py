@@ -38,7 +38,7 @@ _FUZZ_SCENARIO = Scenario(
              "ReplicaCapacityGoal,DiskCapacityGoal,ReplicaDistributionGoal")),
     expects_heal=True, expect_detect_types=("BROKER_FAILURE",))
 
-_FUZZ_SPEC = FuzzSpec(ops=22, ticks=26)
+_FUZZ_SPEC = FuzzSpec(ops=35, ticks=26)
 
 
 # ------------------------------------------------------------- FaultyBackend
@@ -106,9 +106,11 @@ def test_fuzz_smoke_invariants_hold(fuzz_smoke):
 
 def test_fuzz_smoke_covers_the_surface(fuzz_smoke):
     kinds = {e["kind"] for e in fuzz_smoke.fuzz_log}
-    # the schedule drew reads, mutating triggers and stop for this seed
+    # the schedule drew reads (incl. the PR-11 monitor read family),
+    # mutating triggers and stop for this seed
     assert {"state", "proposals", "rebalance_dryrun",
-            "rebalance_execute", "stop"} <= kinds
+            "rebalance_execute", "stop",
+            "load", "partition_load", "kafka_cluster_state"} <= kinds
     executed = [e for e in fuzz_smoke.fuzz_log
                 if e["kind"] == "rebalance_execute" and e["status"] == "2xx"]
     assert executed, "no mutating trigger completed"

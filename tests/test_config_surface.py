@@ -641,6 +641,11 @@ def test_every_canonical_key_is_consumed(tmp_path):
             be.create_partition("t", p, [p % 4, (p + 1) % 4], size_mb=10.0)
         cc.start_up()
         build_sampling_loop(cc, cfg)
+        # the pipelined steady loop (main.py service.pipeline.enabled branch)
+        # reads the service.pipeline.* family
+        if cfg.get_boolean("service.pipeline.enabled"):
+            from cruise_control_tpu.pipeline import PipelinedServiceLoop
+            PipelinedServiceLoop(cc, cfg)
         cc.load_monitor.sample_once(now_ms=0.0)
         cc.load_monitor.sample_once(now_ms=300000.0)
         # self-healing fix path reads the healing-goal + exclusion keys

@@ -1,0 +1,324 @@
+"""Continuous pipelined service loop certification (PR 11).
+
+Contracts:
+
+1. **Backpressure stall/release** — ``meetCompletenessRequirements`` is the
+   optimize stage's explicit backpressure signal: a cold monitor STALLS the
+   stage (no error, no round); live sampling alone fills the windows on the
+   UNIFIED service-mode clock (the backend's canonical ``now_ms``) and the
+   stage releases on its own — no ``GET /bootstrap`` backfill required
+   (the cold-start gating bug observed pre-PR-10).
+2. **Shadow-slot upload path** — the sync stage runs while the previous
+   round's fused chain is in flight on the DONATED resident state; the
+   finalize program lands in fresh buffers (``session.shadow_syncs``) with
+   ZERO new XLA compiles once warm, and steady rounds stay delta-mode /
+   donated.
+3. **Stale-generation drop** — a queued proposal round whose metadata
+   generation moved (or that a newer round superseded) is DROPPED, never
+   executed.
+4. **Pipelined == blocking** — a pipelined steady round produces the same
+   violation/certificate sets and proposal count as the blocking loop on
+   the same windows, with the recorded RoundTrace carrying stage lanes +
+   overlap fractions.
+5. **Determinism** — the sim's lockstep drive (stage hand-offs keyed by
+   tick, never wall clock): same (scenario, seed) => bit-identical timeline
+   with pipelining ON, and identical to the blocking loop's timeline.
+6. **Finisher scan/apply overlap** (the PERF round-11 engine lever):
+   outcome parity with the legacy round body on the seeded fixtures.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.app import CruiseControl
+from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+from cruise_control_tpu.config import cruise_control_config
+from cruise_control_tpu.pipeline import PipelinedServiceLoop, SampleRingBuffer
+
+WINDOW_MS = 60_000.0
+
+
+def _backend(brokers=8, partitions=60, seed=0):
+    be = SimulatedClusterBackend()
+    for b in range(brokers):
+        be.add_broker(b, f"r{b % 4}")
+    rng = np.random.default_rng(seed)
+    for p in range(partitions):
+        be.create_partition("t%d" % (p % 6), p,
+                            [int(p % brokers), int((p + 1) % brokers)],
+                            size_mb=float(rng.exponential(100.0)),
+                            bytes_in_rate=5.0, bytes_out_rate=3.0,
+                            cpu_util=0.2)
+    return be
+
+
+def _app(be, **props):
+    cfg = {"num.metrics.windows": 3, "min.samples.per.metrics.window": 1,
+           "metrics.window.ms": int(WINDOW_MS)}
+    cfg.update(props)
+    cc = CruiseControl(be, cruise_control_config(cfg))
+    cc.start_up()
+    return cc
+
+
+@pytest.fixture(scope="module")
+def warm_loop():
+    """One app + pipeline with windows filled and the first (epoch-paying)
+    rounds behind it — shared by the steady-path contracts."""
+    be = _backend()
+    cc = _app(be)
+    pipe = PipelinedServiceLoop(cc)
+    cc.service_pipeline = pipe
+    for _ in range(4):
+        be.advance(WINDOW_MS)
+        pipe.step(optimize=True)
+    return be, cc, pipe
+
+
+# ------------------------------------------------------------- ring buffer
+def test_ring_buffer_drops_oldest_and_preserves_order():
+    class Batch:
+        def __init__(self, n):
+            self.partition_samples = [None] * n
+            self.broker_samples = [None] * 4
+            self.partition_blocks = ()
+
+    ring = SampleRingBuffer(capacity=2)
+    keys = {ring.push(float(i), Batch(50)) for i in range(3)}
+    assert len(keys) == 1                     # one shape bucket
+    assert ring.dropped == 1 and ring.pushed == 3
+    drained = ring.drain()
+    # oldest batch dropped; arrival order preserved
+    assert [now for _seq, now, _s, _f in drained] == [1.0, 2.0]
+    assert len(ring) == 0
+    # a different shape lands in its own bucket lane
+    ring.push(9.0, Batch(50))
+    ring.push(10.0, Batch(5000))
+    assert len(ring.state_json()["buckets"]) == 2
+    assert [now for _seq, now, _s, _f in ring.drain()] == [9.0, 10.0]
+
+
+# ------------------------------------------- backpressure + unified clock
+def test_backpressure_stalls_then_releases_from_live_sampling_alone():
+    """Cold start: the optimize stage STALLS on completeness (no raise);
+    windows fill from live sampling on the backend clock alone — no
+    GET /bootstrap — and the stage releases."""
+    be = _backend()
+    cc = _app(be)
+    pipe = PipelinedServiceLoop(cc)
+    out = pipe.step(optimize=True)
+    assert out["optimize"] == {"stalled": True}
+    assert pipe.stalled and pipe.stall_count == 1
+    for _ in range(4):
+        be.advance(WINDOW_MS)
+        out = pipe.step(optimize=True)
+    assert out["optimize"].get("optimized") is True
+    assert not pipe.stalled and pipe.release_count == 1
+    # the proposal cache is genuinely servable now
+    assert cc.cached_proposals() is not None
+    cc.shutdown()
+
+
+def test_unified_clock_sampling_fills_windows_without_bootstrap():
+    """The cold-start gating fix: ``sample_once`` stamps from the backend's
+    canonical clock, so advancing the service's own clock fills windows.
+    (Before PR 11 samples were stamped with WALL time regardless — a
+    sim-clocked service could never fill windows by sampling and stayed
+    completeness-gated until a bootstrap backfilled them.)"""
+    from cruise_control_tpu.monitor.load_monitor import (
+        ModelCompletenessRequirements, NotEnoughValidWindowsError,
+    )
+    be = _backend()
+    cc = _app(be)
+    lm = cc.load_monitor
+    with pytest.raises(NotEnoughValidWindowsError):
+        lm.cluster_model()
+    for _ in range(3):
+        be.advance(WINDOW_MS)
+        lm.sample_once()            # no explicit now_ms: the unified clock
+    assert lm.meet_completeness_requirements(
+        ModelCompletenessRequirements(min_required_num_windows=2))
+    ct, _meta = lm.cluster_model()
+    assert int(np.asarray(ct.replica_valid).sum()) == 120
+    # bootstrap's default range ends on the SAME clock: backfilling now can
+    # only add samples to the same windows, never strand the live ones
+    out = cc.bootstrap(clear_metrics=False)
+    assert out["endMs"] == int(be.now_ms())
+    cc.shutdown()
+
+
+# --------------------------------------------------- shadow slot + compiles
+def test_shadow_slot_sync_runs_while_state_is_lent(warm_loop):
+    be, cc, pipe = warm_loop
+    sess = cc.resident_session
+    before = sess.shadow_syncs
+    be.advance(WINDOW_MS)
+    out = pipe.pipelined_round()
+    assert out["result"] is not None
+    # the overlapped sync ran while the optimize round held the donated
+    # state (shadow-slot path) and stayed delta-mode
+    assert sess.shadow_syncs > before
+    assert out["sync_info"].get("mode") == "delta"
+    assert sess.donated_rounds > 0
+
+
+def test_shadow_slot_upload_path_zero_new_compiles(warm_loop):
+    """Once warm, a pipelined round — optimize in flight + overlapped
+    shadow-slot sync — compiles NOTHING new."""
+    from cruise_control_tpu.common.tracing import count_compiles
+    be, cc, pipe = warm_loop
+    be.advance(WINDOW_MS)
+    pipe.pipelined_round()          # burn any first-round variance
+    be.advance(WINDOW_MS)
+    with count_compiles() as cnt:
+        out = pipe.pipelined_round()
+    assert cnt.count == 0, f"shadow-slot round compiled {cnt.count} programs"
+    assert out["sync_info"].get("mode") == "delta"
+
+
+def test_round_trace_carries_stage_lanes_and_overlap(warm_loop):
+    be, cc, pipe = warm_loop
+    be.advance(WINDOW_MS)
+    pipe.pipelined_round()
+    be.advance(WINDOW_MS)
+    out = pipe.pipelined_round()
+    trace = out["trace"]
+    stages = {s["stage"] for s in trace.stages}
+    assert "ingest" in stages and "sync" in stages
+    assert set(trace.overlap) >= {"ingest", "sync"}
+    for lane in trace.overlap.values():
+        assert 0.0 <= lane["overlap_frac"] <= 1.0
+    # the JSON document serves the lanes too (/state?substates=ROUND_TRACES)
+    doc = trace.to_json()
+    assert doc["stages"] and doc["overlap"]
+    # and the PIPELINE substate surfaces the loop's counters
+    state = cc.state_json(substates=["PIPELINE"])
+    assert state["PipelineState"]["optimizeRounds"] > 0
+
+
+def test_pipelined_round_matches_blocking_round(warm_loop):
+    """The A/B contract at test scale: same windows => the pipelined round's
+    violation/certificate sets and proposal count are identical to the
+    blocking loop's."""
+    be, cc, pipe = warm_loop
+
+    def sets(res):
+        return [(g.name, g.violated_before, g.violated_after,
+                 g.fixpoint_proven) for g in res.goal_results]
+
+    be.advance(WINDOW_MS)
+    # blocking round on the current windows
+    cc.load_monitor.sample_once()
+    blocking = cc.cached_proposals(force_refresh=True)
+    # pipelined round on the SAME windows (its overlapped ingest/sync only
+    # prepare the NEXT round; this round optimizes what the blocking round
+    # just saw)
+    piped = pipe.pipelined_round()["result"]
+    assert sets(piped) == sets(blocking)
+    assert len(piped.proposals) == len(blocking.proposals)
+
+
+def test_session_sync_memo_skips_unchanged_inputs(warm_loop):
+    be, cc, pipe = warm_loop
+    sess = cc.resident_session
+    be.advance(WINDOW_MS)
+    cc.load_monitor.sample_once()
+    first = sess.sync()
+    assert "memo" not in first
+    again = sess.sync()             # nothing changed since
+    assert again.get("memo") is True
+    assert again["mode"] == first["mode"]
+
+
+# -------------------------------------------------------- stale generations
+def test_stale_generation_round_dropped_not_executed(warm_loop):
+    be, cc, pipe = warm_loop
+    res = cc.cached_proposals()
+    assert res.proposals
+    execs_before = cc.executor.state_json()["numExecutions"]
+    dropped_before = pipe.stale_rounds_dropped
+    pipe.submit_execution(res.proposals[:2])
+    be.add_broker(90 + dropped_before, "r9")   # metadata generation bump
+    out = pipe.drain_executions()
+    assert out == {"executed": 0, "dropped": 1}
+    assert pipe.stale_rounds_dropped == dropped_before + 1
+    assert cc.executor.state_json()["numExecutions"] == execs_before
+
+
+def test_superseded_round_dropped_newest_executes(warm_loop):
+    be, cc, pipe = warm_loop
+    res = cc.cached_proposals(force_refresh=True)
+    assert len(res.proposals) >= 2
+    pipe.submit_execution(res.proposals[:1])
+    rnd = pipe.submit_execution(res.proposals[1:2])   # supersedes the first
+    dropped_before = pipe.stale_rounds_dropped
+    out = pipe.drain_executions()
+    assert out["dropped"] == 1 and out["executed"] == 1
+    assert pipe.stale_rounds_dropped == dropped_before + 1
+    st = cc.executor.state_json()
+    # the generation tag rides into the executor's state for observability
+    assert st["proposalGeneration"] == rnd.metadata_generation
+
+
+# ------------------------------------------------------------- determinism
+@pytest.mark.slow
+def test_sim_pipelined_timeline_bit_identical_and_matches_blocking():
+    """Lockstep pipelined drive: same (scenario, seed) => bit-identical
+    timeline with pipelining ON — and identical to the blocking loop's
+    timeline (per-tick stage work is a deterministic function of the tick
+    clock; ring hand-offs never reorder within a tick)."""
+    from cruise_control_tpu.sim.catalog import SCENARIOS
+    from cruise_control_tpu.sim.runner import ScenarioRunner
+    sc = SCENARIOS["broker-death-smoke"]
+
+    def timeline(pipelined):
+        r = ScenarioRunner(sc, seed=3, pipelined=pipelined).run()
+        r.assert_ok()
+        return json.dumps(r.timeline, sort_keys=True), r
+
+    t1, r1 = timeline(True)
+    t2, r2 = timeline(True)
+    assert t1 == t2
+    assert r1.pipeline == r2.pipeline
+    assert r1.pipeline["ingestRounds"] > 0
+    t0, _ = timeline(False)
+    assert t1 == t0
+
+
+# --------------------------------------------- finisher scan/apply overlap
+@pytest.mark.slow
+def test_finisher_overlap_outcome_parity():
+    """The PERF round-11 engine lever: overlap ON (leadership scan against
+    the round-entry state, overlapping the move wave's apply) == overlap OFF
+    on violation sets, certificate sets and proposal counts for the seeded
+    parity fixtures, finisher forced on."""
+    from cruise_control_tpu.analyzer.engine import EngineParams
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.model.random_cluster import (
+        RandomClusterSpec, generate,
+    )
+    chain = ["RackAwareGoal", "DiskCapacityGoal", "CpuCapacityGoal",
+             "ReplicaDistributionGoal", "DiskUsageDistributionGoal",
+             "LeaderReplicaDistributionGoal"]
+    cfg = cruise_control_config({"analyzer.finisher.min.replicas": 0})
+
+    def run(ct, meta, overlap):
+        opt = GoalOptimizer(config=cfg, engine_params=EngineParams(
+            finisher_overlap=overlap))
+        r = opt.optimizations(ct, meta, goal_names=chain,
+                              raise_on_failure=False,
+                              skip_hard_goal_check=True)
+        return ([(g.name, g.violated_after, g.fixpoint_proven)
+                 for g in r.goal_results], len(r.proposals))
+
+    for seed in (777, 881):
+        ct, meta = generate(RandomClusterSpec(
+            num_brokers=24, num_racks=4, num_topics=12, num_partitions=300,
+            max_replication=2, skew=2.0, seed=seed))
+        off_sets, off_props = run(ct, meta, False)
+        on_sets, on_props = run(ct, meta, True)
+        assert on_sets == off_sets
+        assert on_props == off_props

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Diff two chaos-campaign SLO blocks; exit nonzero on p95 regression.
+"""Diff two chaos-campaign SLO blocks and/or bench steady-round walls; exit
+nonzero on regression.
 
 Folds campaign SLO distributions into the trajectory-comparison workflow:
 ``CAMPAIGN_<name>_s<seed>.json`` artifacts (bench.py --campaign) or bench
@@ -9,13 +10,20 @@ unhealed counts — and any candidate p95 more than ``--threshold`` (default
 25%) above the baseline, or any new undetected/unhealed fault, fails the
 diff with exit code 1.
 
+Bench summaries (documents carrying ``rungs``) are ADDITIONALLY gated on the
+steady service round: per e2e rung, a candidate ``round_s_steady`` (or
+pipelined ``round_s_pipelined``) more than the threshold above the
+baseline's, a steady round that RECOMPILED when the baseline's didn't, or a
+pipelined A/B that lost set-identity, is a regression.
+
 Usage:
   tools/slo_diff.py BASELINE.json CANDIDATE.json [--threshold 0.25]
                     [--fields time_to_heal_ms,time_to_detect_ms]
 
 Accepted documents (auto-detected): a campaign episode log / campaign doc
-with a top-level ``slo``, a bench summary with ``campaign.slo``, or a bare
-SLO mapping {kind: {time_to_detect_ms: {p50, p95, max}, ...}}.
+with a top-level ``slo``, a bench summary with ``campaign.slo`` and/or
+``rungs``, or a bare SLO mapping
+{kind: {time_to_detect_ms: {p50, p95, max}, ...}}.
 """
 from __future__ import annotations
 
@@ -23,6 +31,7 @@ import json
 import sys
 
 DEFAULT_FIELDS = ("time_to_detect_ms", "time_to_heal_ms")
+STEADY_FIELDS = ("round_s_steady", "round_s_pipelined")
 
 
 def extract_slo(doc: dict) -> dict:
@@ -83,6 +92,58 @@ def compare_slos(base: dict, cand: dict, threshold: float = 0.25,
     return rows, regressions
 
 
+def extract_steady(doc: dict) -> dict:
+    """Per-rung steady-round figures from a bench summary: {config:
+    {round_s_steady, steady_recompiled, round_s_pipelined,
+    ab_identical_sets}} — empty when the document carries no rungs."""
+    out: dict = {}
+    for rung in doc.get("rungs", []) or []:
+        if not isinstance(rung, dict) or "round_s_steady" not in rung:
+            continue
+        row = {"round_s_steady": rung.get("round_s_steady"),
+               "steady_recompiled": bool(rung.get("steady_recompiled"))}
+        piped = rung.get("pipelined") or {}
+        if piped:
+            row["round_s_pipelined"] = piped.get("round_s_pipelined")
+            row["ab_identical_sets"] = piped.get("ab_identical_sets")
+        out[rung.get("config", "?")] = row
+    return out
+
+
+def compare_steady(base: dict, cand: dict, threshold: float = 0.25):
+    """Gate the steady service round between two bench summaries: wall
+    regressions beyond the threshold, fresh steady-round recompiles, and
+    pipelined A/B set-identity loss all fail."""
+    rows, regressions = [], []
+    for config in sorted(set(base) & set(cand)):
+        b, c = base[config], cand[config]
+        for field in STEADY_FIELDS:
+            bv, cv = b.get(field), c.get(field)
+            if bv is None or cv is None:
+                continue
+            row = {"kind": config, "field": field,
+                   "base_p95": bv, "cand_p95": cv}
+            if cv > bv * (1.0 + threshold):
+                row["regression"] = (f"steady wall {cv:.2f}s > {bv:.2f}s "
+                                     f"* (1 + {threshold:g})")
+                regressions.append(row)
+            rows.append(row)
+        if c.get("steady_recompiled") and not b.get("steady_recompiled"):
+            row = {"kind": config, "field": "steady_recompiled",
+                   "base_p95": 0, "cand_p95": 1,
+                   "regression": "steady round recompiled (baseline did not)"}
+            regressions.append(row)
+            rows.append(row)
+        if b.get("ab_identical_sets") and c.get("ab_identical_sets") is False:
+            row = {"kind": config, "field": "ab_identical_sets",
+                   "base_p95": 1, "cand_p95": 0,
+                   "regression": "pipelined A/B lost violation/certificate "
+                                 "set identity"}
+            regressions.append(row)
+            rows.append(row)
+    return rows, regressions
+
+
 def main(argv: list[str]) -> int:
     args = [a for a in argv if not a.startswith("--")]
     if len(args) != 2:
@@ -100,10 +161,30 @@ def main(argv: list[str]) -> int:
         args = [a for a in args if a != raw]
     base_path, cand_path = args[:2]
     with open(base_path) as f:
-        base = extract_slo(json.load(f))
+        base_doc = json.load(f)
     with open(cand_path) as f:
-        cand = extract_slo(json.load(f))
-    rows, regressions = compare_slos(base, cand, threshold, fields)
+        cand_doc = json.load(f)
+    rows: list = []
+    regressions: list = []
+    compared = False
+    try:
+        base, cand = extract_slo(base_doc), extract_slo(cand_doc)
+    except ValueError:
+        base = cand = None
+    if base is not None and cand is not None:
+        rows, regressions = compare_slos(base, cand, threshold, fields)
+        compared = True
+    # bench summaries additionally gate on the steady service round
+    sbase, scand = extract_steady(base_doc), extract_steady(cand_doc)
+    if sbase and scand:
+        srows, sregs = compare_steady(sbase, scand, threshold)
+        rows.extend(srows)
+        regressions.extend(sregs)
+        compared = True
+    if not compared:
+        print("no comparable SLO or steady-round blocks found in both "
+              "documents", file=sys.stderr)
+        return 2
     w = max((len(r["kind"]) for r in rows), default=4)
     print(f"{'kind':<{w}}  {'field':<20}  {'base p95':>12}  {'cand p95':>12}"
           f"  verdict")

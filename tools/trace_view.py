@@ -79,6 +79,29 @@ def render(trace: dict, width: int = 48) -> str:
     parts.append(f"state {_fmt_bytes(trace.get('state_bytes'))}")
     parts.append(f"{trace.get('num_proposals', 0)} proposals")
     lines.append("  " + " · ".join(parts))
+    # pipelined-loop stage lanes (PR 11): one bar per ingest/sync/execute
+    # span that PREPARED this round, the part spent UNDER an in-flight
+    # optimize round shaded solid (█ = overlapped, ░ = on the critical path)
+    stages = trace.get("stages") or []
+    if stages:
+        lines.append("  pipeline lanes (█ overlapped with optimize, ░ not):")
+        wall = max(trace.get("wall_s", 0) or 0,
+                   max(s.get("dur_s", 0) for s in stages), 1e-9)
+        lane_w = max((len(s["stage"]) for s in stages), default=5)
+        for s in stages:
+            dur = float(s.get("dur_s", 0) or 0)
+            ov = float(s.get("overlap_s", 0) or 0)
+            n = max(1, round(dur / wall * width)) if dur else 0
+            n_ov = min(n, round((ov / dur) * n)) if dur else 0
+            bar = "█" * n_ov + "░" * (n - n_ov) + "·" * (width - n)
+            frac = (ov / dur) if dur else 0.0
+            lines.append(f"  {s['stage']:<{lane_w}}    {bar} "
+                         f"{dur:8.3f}s  overlap {100 * frac:5.1f}%")
+        summary = trace.get("overlap") or {}
+        if summary:
+            lines.append("  overlap summary: " + " · ".join(
+                f"{k} {100 * v.get('overlap_frac', 0):.1f}%"
+                for k, v in sorted(summary.items())))
     goals = trace.get("goals", [])
     measured = bool(trace.get("durations_measured")) and any(
         g.get("duration_s", 0) > 0 for g in goals)
