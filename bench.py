@@ -127,6 +127,7 @@ BULKY_RUNG_KEYS = ("last_round_trace", "sensors", "pass_profile",
                    "steady_phases", "actions_remaining", "device_mem",
                    "steady_device_mem", "violated_goals_after",
                    "budget_exhausted", "fixpoint_proven", "latency_timers",
+                   "health",
                    # campaign rung: the SLO block lives in the top-level
                    # "campaign" summary; the per-rung copy is the bulky twin.
                    # scenario_spec is the scenario rung's replay payload —
@@ -829,6 +830,7 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
     steady_modes: list[str | None] = []
     steady_phases: list[dict] = []
     steady_skip_reason = None
+    journal_bytes0 = cc.journal.bytes_appended
     for r in range(2):
         # round 1 re-optimizes from the freshly-built session (~warm wall +
         # sampling); round 2 is the cheaper delta round — estimate with the
@@ -904,6 +906,15 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
             "steady_device_mem": sess_mem,
             "steady_donated_rounds": (sess.donated_rounds
                                       if sess is not None else 0),
+            # causal-journal cost of a steady service round (spans + round
+            # summaries + sampling roots; journal+spans are always on, so
+            # this is the price the zero-overhead contract already includes)
+            "journal_bytes_per_round": round(
+                (cc.journal.bytes_appended - journal_bytes0)
+                / max(len(steady_walls), 1)),
+            # live SLO evaluation snapshot (GET /health body): per-endpoint/
+            # heal SLO attainment + degradation state at rung end
+            "health": cc.health_json(),
         })
         if steady_compiles[-1] > 0:
             log(f"  [e2e] WARNING: last steady round recompiled "

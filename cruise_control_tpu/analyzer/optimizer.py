@@ -402,7 +402,7 @@ class GoalOptimizer:
                       raise_on_failure: bool = True,
                       measure_goal_durations: bool = False,
                       min_leader_topic_pattern: str | None = None,
-                      session=None) -> OptimizerResult:
+                      session=None, span=None) -> OptimizerResult:
         """``measure_goal_durations=True`` blocks after every goal to time it
         honestly (proposal-computation-timer per goal); the default pipelines
         all goal programs asynchronously — one device round-trip for the whole
@@ -420,13 +420,18 @@ class GoalOptimizer:
         make_env / init_state and their full H2D upload are all skipped, and
         the topic-exclusion + min-leaders masks are the ones baked into the
         resident env. This is the steady-state service fast path
-        (GoalOptimizer.java precompute thread over the live ClusterModel)."""
+        (GoalOptimizer.java precompute thread over the live ClusterModel).
+
+        ``span`` (common/tracing.Span): explicit causal parent handle — the
+        round opens an "optimize" child under it, the RoundTrace carries the
+        trace_id, and anomaly->heal lineage stays walkable. Host-side dict
+        work only: the device path is untouched."""
         with self._proposal_timer.time():
             return self._optimizations(ct, meta, goal_names, options,
                                        skip_hard_goal_check, raise_on_failure,
                                        measure_goal_durations,
                                        min_leader_topic_pattern,
-                                       session=session)
+                                       session=session, span=span)
 
     def _min_leader_mask(self, meta, pattern: str | None):
         """bool[T] mask of topics matching the min-leaders regex."""
@@ -445,12 +450,14 @@ class GoalOptimizer:
                        skip_hard_goal_check, raise_on_failure,
                        measure_goal_durations,
                        min_leader_topic_pattern=None,
-                       session=None) -> OptimizerResult:
+                       session=None, span=None) -> OptimizerResult:
         t_round = time.monotonic()
         # pipelined-loop lanes: stage spans noted while this round is in
         # flight (the sync thread's shadow-slot upload, the next sampling
-        # fetch) measure their overlap against [here, record_round]
-        self.recorder.note_optimize_start()
+        # fetch) measure their overlap against [here, record_round]; the
+        # returned GENERATION keys which pending stage notes belong to THIS
+        # round (a later round's notes stay pending for it)
+        opt_gen = self.recorder.note_optimize_start()
         compiles0 = self._compile_listener.count
         names = goal_names or self._default_goal_names
         # honour hard-goal enforcement (KafkaCruiseControl sanityCheckHardGoalPresence)
@@ -461,6 +468,10 @@ class GoalOptimizer:
                 raise ValueError(
                     f"hard goals {missing} missing from requested goals; "
                     f"pass skip_hard_goal_check=True to override")
+        # causal lineage: the round's "optimize" span under the caller's
+        # explicit parent handle (facade operation span)
+        round_span = span.child("optimize", "optimize-round") \
+            if span is not None else None
         known = [n for n in names if n != "PreferredLeaderElectionGoal"]
         goals = make_goals(known, self._constraint, options)
         run_preferred = "PreferredLeaderElectionGoal" in names
@@ -793,7 +804,14 @@ class GoalOptimizer:
             profile_level=self._profile_level,
             durations_measured=(measure_goal_durations
                                 or (use_fused
-                                    and self._profile_level == "stage")))
+                                    and self._profile_level == "stage")),
+            trace_id=(round_span.trace_id if round_span is not None else None),
+            opt_generation=opt_gen)
+        if round_span is not None:
+            round_span.end(
+                proposals=len(proposals), moves=n_moves, leads=n_lead,
+                round=(result.round_trace.round_id
+                       if result.round_trace is not None else None))
 
         if raise_on_failure:
             failed = [r.name + (" (iteration budget exhausted)" if r.hit_max_iters else "")

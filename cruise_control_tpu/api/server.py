@@ -241,16 +241,26 @@ class CruiseControlServer:
         import time as _time
         t0 = _time.monotonic()
         sensors = getattr(self.app, "sensors", None)
+        # causal journal: one ROOT span per REST request (endpoint + method
+        # + final status), on the app's clock — the per-endpoint latency
+        # record tools/slo_diff.py gates journal p99s from
+        tracer = getattr(self.app, "tracer", None)
+        span = (tracer.span("request", endpoint.path, method=method)
+                if tracer is not None else None)
         try:
             status, body, headers = self._handle(method, endpoint, params,
                                                  client, task_id_header)
-        except Exception:
+        except Exception as e:
             # parameter/validation errors raised mid-handling surface as
             # 4xx/5xx upstream — they are failed executions too
+            if span is not None:
+                span.end(error=type(e).__name__)
             if sensors is not None:
                 sensors.timer(f"{endpoint.path}-failed-request-execution-timer"
                               ).record(_time.monotonic() - t0)
             raise
+        if span is not None:
+            span.end(status=status)
         # per-endpoint success/failure timers (KafkaCruiseControlServlet
         # .java:64 successfulRequestExecutionTimer + its failed twin); 202
         # progress polls / purgatory parks are NEITHER completed NOR failed
@@ -666,6 +676,28 @@ def _make_handler(server: CruiseControlServer):
                 self._send_raw(
                     200, text.encode("utf-8"),
                     "text/plain; version=0.0.4; charset=utf-8", {})
+                return
+            if name == "health" and method == "GET":
+                # GET /health: live SLO attainment (detect/heal/request
+                # targets from health.slo.*) + breaker/pipeline degradation
+                # state, computed from the sensor registry. Like /metrics:
+                # not an EndPoint enum member, authorized as a STATE-level
+                # read, always 200 (the verdict is the body's "status").
+                try:
+                    _, role = server.security.authenticate(
+                        self.headers, client_ip=self.client_address[0])
+                    if not server.security.authorize(role, EndPoint.STATE,
+                                                     "GET"):
+                        raise AuthError(
+                            f"role {role} may not access GET /health", 403)
+                except AuthError as e:
+                    self._send(e.status, error_json(str(e)), {})
+                    return
+                try:
+                    self._send(200, server.app.health_json(), {})
+                except Exception as e:  # noqa: BLE001 — rendered as the error body
+                    self._send(500, error_json(f"{type(e).__name__}: {e}",
+                                               traceback.format_exc()), {})
                 return
             endpoint = EndPoint.from_path(name)
             if endpoint is None:

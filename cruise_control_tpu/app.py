@@ -62,20 +62,46 @@ class OperationResult:
 class CruiseControl:
     def __init__(self, backend, config=None):
         from cruise_control_tpu.common.sensors import MetricRegistry
-        from cruise_control_tpu.common.tracing import FlightRecorder
+        from cruise_control_tpu.common.tracing import (
+            EventJournal, FlightRecorder, SpanTracer,
+        )
         self.config = config or cruise_control_config()
         self.backend = backend
         # one registry for the whole app — the MetricRegistry -> JMX domain
         # kafka.cruisecontrol role (KafkaCruiseControlApp.java:29,40); exported
         # via /state?substates=SENSORS and GET /metrics (Prometheus text)
         self.sensors = MetricRegistry()
+        # one durable event journal + span tracer for the whole app
+        # (common/tracing.py): the recorder's round summaries, every causal
+        # span (detector verdict -> operation -> optimize round -> executor
+        # phases), executor task census transitions, breaker state changes
+        # and pipeline stage notes all write through the journal; spans are
+        # served as trees at /state?substates=TRACES. Clocked on the
+        # backend's canonical time — the sim's journal lives on simulated
+        # time and is byte-identical per (scenario, seed).
+        self.journal = EventJournal(
+            path=self.config.get_string("journal.path") or None,
+            max_bytes=self.config.get_int("journal.max.bytes.per.file"),
+            max_files=self.config.get_int("journal.max.files"),
+            fsync=self.config.get_string("journal.fsync"),
+            memory_lines=self.config.get_int("journal.memory.lines"),
+            clock_ms=self._now_ms)
+        self.tracer = SpanTracer(
+            clock_ms=self._now_ms, journal=self.journal,
+            capacity=self.config.get_int("journal.trace.capacity"))
+        # GET /health SLO targets (health.slo.*), read once at wiring time
+        self._health_slo_ms = {
+            "detect": float(self.config.get_int("health.slo.detect.p95.ms")),
+            "heal": float(self.config.get_int("health.slo.heal.p95.ms")),
+            "request": float(self.config.get_int("health.slo.request.p99.ms")),
+        }
         # one flight recorder for the whole app: every optimization round
         # leaves a RoundTrace (common/tracing.py), served by
         # /state?substates=ROUND_TRACES; traces carry the backend clock so
         # the sim's records live on simulated time
         self.flight_recorder = FlightRecorder(
             capacity=self.config.get_int("flight.recorder.capacity"),
-            clock_ms=self._now_ms)
+            clock_ms=self._now_ms, journal=self.journal)
         self.flight_recorder.register_gauges(self.sensors)
         # ONE fault-tolerance layer at the backend boundary
         # (common/retries.py): the executor, monitor and this facade consult
@@ -86,17 +112,20 @@ class CruiseControl:
         # timelines with retries/backoff live.
         from cruise_control_tpu.common.retries import BackendFaultTolerance
         self.fault_tolerance = BackendFaultTolerance(
-            self.config, clock_ms=self._now_ms, sensors=self.sensors)
+            self.config, clock_ms=self._now_ms, sensors=self.sensors,
+            journal=self.journal)
         self.load_monitor = LoadMonitor(config=self.config, backend=backend,
                                         sensors=self.sensors,
                                         recorder=self.flight_recorder,
-                                        fault_tolerance=self.fault_tolerance)
+                                        fault_tolerance=self.fault_tolerance,
+                                        tracer=self.tracer)
         self.goal_optimizer = GoalOptimizer(config=self.config,
                                             sensors=self.sensors,
                                             recorder=self.flight_recorder)
         self.executor = Executor(backend, config=self.config,
                                  sensors=self.sensors,
-                                 fault_tolerance=self.fault_tolerance)
+                                 fault_tolerance=self.fault_tolerance,
+                                 tracer=self.tracer, journal=self.journal)
         oes = self.load_monitor.on_execution_store
         if oes is not None:
             # the on-execution store gates on the live executor
@@ -395,6 +424,7 @@ class CruiseControl:
         self._precompute_threads.clear()
         self.anomaly_detector.shutdown()
         self.load_monitor.shutdown()
+        self.journal.close()
 
     # ------------------------------------------------------- degraded mode
     def degraded(self) -> bool:
@@ -545,16 +575,27 @@ class CruiseControl:
                           goal_names=None, options=OptimizationOptions(),
                           dry_run: bool = True, skip_hard_goal_check: bool = False,
                           execute_kw: dict | None = None,
-                          session=None) -> OperationResult:
+                          session=None, parent_span=None) -> OperationResult:
         goals = goal_names or effective_default_goals(self.config)
         # optimization.options.generator.class seam: deployments may rewrite
         # the options of any internally-triggered optimization
         options = self._options_generator.optimization_options(options, operation)
         # tag this thread's next round trace with the operation name
         self.flight_recorder.note_operation(operation)
-        res = self.goal_optimizer.optimizations(
-            ct, meta, goal_names=goals, options=options,
-            skip_hard_goal_check=skip_hard_goal_check, session=session)
+        # causal span: one "operation" span per facade optimization, parented
+        # on whatever handle the caller passed (a detector verdict span, a
+        # REST request span) — the optimizer round and the executor phases
+        # hang under it, so anomaly->heal is a walkable tree
+        op_span = self.tracer.span("operation", operation, parent=parent_span,
+                                   reason=reason, dry_run=bool(dry_run))
+        try:
+            res = self.goal_optimizer.optimizations(
+                ct, meta, goal_names=goals, options=options,
+                skip_hard_goal_check=skip_hard_goal_check, session=session,
+                span=op_span)
+        except Exception as e:
+            op_span.end(error=type(e).__name__)
+            raise
         op = OperationResult(operation=operation, reason=reason,
                              optimizer_result=res)
         if not dry_run and res.proposals:
@@ -568,8 +609,15 @@ class CruiseControl:
                 sizes = {}
             kw.setdefault("context", {"partition_size_mb": sizes,
                                       "operation": f"{operation}: {reason}"})
-            self.executor.execute_proposals(res.proposals, **kw)
+            try:
+                self.executor.execute_proposals(res.proposals,
+                                                parent_span=op_span, **kw)
+            except Exception as e:
+                op_span.end(error=type(e).__name__,
+                            proposals=len(res.proposals))
+                raise
             op.executed = True
+        op_span.end(executed=op.executed, proposals=len(res.proposals))
         self._ops_history.append({"operation": operation, "reason": reason,
                                   "ms": self._now_ms(),
                                   "numProposals": len(res.proposals),
@@ -598,7 +646,7 @@ class CruiseControl:
                   exclude_recently_removed_brokers: bool = False,
                   exclude_recently_demoted_brokers: bool = False,
                   replica_movement_strategies: list | None = None,
-                  reason: str = "rebalance") -> dict:
+                  reason: str = "rebalance", parent_span=None) -> dict:
         """POST /rebalance (RebalanceRunnable.java:30-115 role).
         ``rebalance_disk=True`` balances load across the logdirs of each
         broker with the intra-broker goal chain instead
@@ -650,7 +698,8 @@ class CruiseControl:
                                     dry_run=dry_run,
                                     skip_hard_goal_check=skip_hard_goal_check
                                     or self_healing,
-                                    execute_kw=execute_kw, session=session)
+                                    execute_kw=execute_kw, session=session,
+                                    parent_span=parent_span)
         return op.to_json()
 
     def remove_brokers(self, broker_ids: list, dry_run: bool = False,
@@ -658,7 +707,8 @@ class CruiseControl:
                        excluded_topics: str | None = None,
                        exclude_recently_removed_brokers: bool = False,
                        exclude_recently_demoted_brokers: bool = False,
-                       reason: str = "remove brokers") -> dict:
+                       reason: str = "remove brokers",
+                       parent_span=None) -> dict:
         """POST /remove_broker: drain the brokers, then (really) move load off
         (RemoveBrokersRunnable role). Marks brokers as move-excluded
         destinations and relocates everything they host."""
@@ -688,7 +738,8 @@ class CruiseControl:
         op = self._run_optimization("REMOVE_BROKER", reason, ct, meta,
                                     self._self_healing_goals(),
                                     OptimizationOptions(),
-                                    dry_run=dry_run, skip_hard_goal_check=True)
+                                    dry_run=dry_run, skip_hard_goal_check=True,
+                                    parent_span=parent_span)
         if op.executed:
             self.executor.note_removed_brokers(broker_ids)
         return op.to_json()
@@ -698,7 +749,7 @@ class CruiseControl:
                     exclude_recently_removed_brokers: bool = False,
                     exclude_recently_demoted_brokers: bool = False,
                     skip_hard_goal_check: bool = False,
-                    reason: str = "add brokers") -> dict:
+                    reason: str = "add brokers", parent_span=None) -> dict:
         """POST /add_broker: rebalance load onto the (new) brokers.
         ``skip_hard_goal_check``: self-healing contexts (the ADD_BROKER
         maintenance plan firing mid-fault) balance onto the new hardware
@@ -718,11 +769,13 @@ class CruiseControl:
         ct = dataclasses.replace(ct, broker_new=jnp.asarray(new))
         op = self._run_optimization("ADD_BROKER", reason, ct, meta, None,
                                     OptimizationOptions(), dry_run=dry_run,
-                                    skip_hard_goal_check=skip_hard_goal_check)
+                                    skip_hard_goal_check=skip_hard_goal_check,
+                                    parent_span=parent_span)
         return op.to_json()
 
     def demote_brokers(self, broker_ids: list, dry_run: bool = False,
-                       reason: str = "demote brokers") -> dict:
+                       reason: str = "demote brokers",
+                       parent_span=None) -> dict:
         """POST /demote_broker: move leadership away and prevent new leadership
         (DemoteBrokerRunnable + PreferredLeaderElectionGoal role).
 
@@ -743,7 +796,8 @@ class CruiseControl:
         op = self._run_optimization(
             "DEMOTE_BROKER", reason, ct, meta,
             ["PreferredLeaderElectionGoal"],
-            OptimizationOptions(), dry_run=dry_run, skip_hard_goal_check=True)
+            OptimizationOptions(), dry_run=dry_run, skip_hard_goal_check=True,
+            parent_span=parent_span)
         if op.executed:
             self.executor.note_demoted_brokers(broker_ids)
         return op.to_json()
@@ -753,7 +807,8 @@ class CruiseControl:
                              excluded_topics: str | None = None,
                              exclude_recently_removed_brokers: bool = False,
                              exclude_recently_demoted_brokers: bool = False,
-                             reason: str = "fix offline replicas") -> dict:
+                             reason: str = "fix offline replicas",
+                             parent_span=None) -> dict:
         """POST /fix_offline_replicas (FixOfflineReplicasRunnable role)."""
         if not dry_run:
             self._check_writable("FIX_OFFLINE_REPLICAS")
@@ -772,11 +827,13 @@ class CruiseControl:
         op = self._run_optimization(
             "FIX_OFFLINE_REPLICAS", reason, ct, meta, self._self_healing_goals(),
             OptimizationOptions(fix_offline_replicas_only=True),
-            dry_run=dry_run, skip_hard_goal_check=True, session=session)
+            dry_run=dry_run, skip_hard_goal_check=True, session=session,
+            parent_span=parent_span)
         return op.to_json()
 
     def fix_topic_replication_factor(self, bad_topics: dict,
-                                     reason: str = "fix topic RF") -> dict:
+                                     reason: str = "fix topic RF",
+                                     parent_span=None) -> dict:
         """Topic RF healing: under-replicated topics get replicas added on
         the least-loaded alive brokers, over-replicated ones shrink to
         target, and the repair PLAN executes through the executor like every
@@ -843,13 +900,21 @@ class CruiseControl:
                     old_replicas=tuple((b, 0) for b in info.replicas),
                     new_replicas=tuple((b, 0) for b in replicas)))
         executed = False
+        op_span = self.tracer.span("operation", "TOPIC_REPLICATION_FACTOR",
+                                   parent=parent_span, reason=reason)
         if proposals:
             sizes = {tp: i.size_mb for tp, i in partitions.items()}
-            self.executor.execute_proposals(
-                proposals,
-                context={"partition_size_mb": sizes,
-                         "operation": f"TOPIC_REPLICATION_FACTOR: {reason}"})
+            try:
+                self.executor.execute_proposals(
+                    proposals,
+                    context={"partition_size_mb": sizes,
+                             "operation": f"TOPIC_REPLICATION_FACTOR: {reason}"},
+                    parent_span=op_span)
+            except Exception as e:
+                op_span.end(error=type(e).__name__, proposals=len(proposals))
+                raise
             executed = True
+        op_span.end(executed=executed, proposals=len(proposals))
         self._ops_history.append({
             "operation": "TOPIC_REPLICATION_FACTOR", "reason": reason,
             "ms": self._now_ms(), "numProposals": len(proposals),
@@ -1098,10 +1163,79 @@ class CruiseControl:
         if "ROUND_TRACES" in substates:
             # flight recorder: the bounded ring of per-round traces
             out["RoundTraces"] = self.flight_recorder.to_json()
+        if "TRACES" in substates:
+            # causal span journal: recent trace TREES (verdict -> operation
+            # -> optimize round -> execution phases), nested by parent
+            out["Traces"] = self.tracer.to_json()
+            out["Traces"]["journal"] = self.journal.state_json()
         if "PIPELINE" in substates and self.service_pipeline is not None:
             # the continuous pipelined loop's stage/backpressure state
             out["PipelineState"] = self.service_pipeline.state_json()
         return out
+
+    def health_json(self) -> dict:
+        """GET /health: rolling SLO attainment + degradation state, computed
+        live from the sensor registry (no new instrumentation — the same
+        timers /metrics exports). ``status``: "ok" (every SLO with samples
+        attained, nothing degraded), "degraded" (an open breaker, a stalled
+        pipeline or a paused execution), "breach" (an SLO with samples over
+        its ``health.slo.*`` target). Percentiles are reservoir-rolling over
+        the recent observation window, exact buckets ride /metrics."""
+        snap = self.sensors.to_json()
+        detect_ms = self._health_slo_ms["detect"]
+        heal_ms = self._health_slo_ms["heal"]
+        req_ms = self._health_slo_ms["request"]
+
+        def row(timer_name: str, q_key: str, target_ms: float) -> dict:
+            t = snap.get(timer_name)
+            n = t.get("count", 0) if isinstance(t, dict) else 0
+            val_s = t.get(q_key) if isinstance(t, dict) else None
+            out = {"n": n, q_key: val_s, "targetMs": target_ms}
+            out["ok"] = (None if not n
+                         else bool(val_s * 1000.0 <= target_ms))
+            return out
+
+        detect = row("anomaly-detection-to-fix-timer", "p95Sec", detect_ms)
+        heal = {name.rsplit("-self-healing-fix-timer", 1)[0]:
+                row(name, "p95Sec", heal_ms)
+                for name in snap
+                if name.endswith("-self-healing-fix-timer")}
+        requests = {name.rsplit("-successful-request-execution-timer", 1)[0]:
+                    row(name, "p99Sec", req_ms)
+                    for name in snap
+                    if name.endswith("-successful-request-execution-timer")}
+        rows = [detect, *heal.values(), *requests.values()]
+        breached = [r for r in rows if r["ok"] is False]
+        ft = self.fault_tolerance.state_json()
+        pipeline = (self.service_pipeline.state_json()
+                    if self.service_pipeline is not None else None)
+        degraded = bool(ft["degraded"] or self.executor.paused
+                        or (pipeline or {}).get("stalled"))
+        status = ("breach" if breached
+                  else "degraded" if degraded else "ok")
+
+        def meter_count(name: str) -> int:
+            m = snap.get(name)
+            return m.get("count", 0) if isinstance(m, dict) else 0
+
+        return {
+            "status": status, "nowMs": self._now_ms(),
+            "slo": {"detect": detect, "heal": heal, "requests": requests,
+                    "breached": len(breached)},
+            "degraded": ft["degraded"],
+            "openCircuits": self.fault_tolerance.open_circuits(),
+            "breakers": ft["breakers"],
+            "executorPaused": self.executor.paused,
+            "pipeline": ({"stalled": pipeline["stalled"],
+                          "stallCount": pipeline["stallCount"],
+                          "staleRoundsDropped": pipeline["staleRoundsDropped"]}
+                         if pipeline is not None else None),
+            "selfHealing": {
+                "fixes": meter_count("execution-started"),
+                "failures": meter_count("self-healing-fix-failures"),
+                "deferrals": meter_count("self-healing-fix-deferrals")},
+            "journal": self.journal.state_json(),
+        }
 
     def metrics_text(self) -> str:
         """GET /metrics: the whole MetricRegistry — timers as summaries,

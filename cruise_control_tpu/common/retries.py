@@ -113,34 +113,68 @@ class CircuitBreaker:
         self._opened_ms = -1.0
         self._probes_in_flight = 0
         self.open_count = 0          # lifetime trips (sensor + test surface)
+        # optional observer ``(op_class, old_state, new_state)`` — the
+        # fault-tolerance facade journals every transition through it
+        # (called OUTSIDE the breaker lock, after the transition landed)
+        self.on_transition = None
+
+    def _set_state(self, new: str) -> tuple | None:
+        """Caller holds the lock; returns the (old, new) transition to flush
+        through ``on_transition`` after release, or None."""
+        old = self._state
+        if old == new:
+            return None
+        self._state = new
+        return (old, new)
+
+    def _flush(self, *transitions) -> None:
+        """Fire the observer for each real transition, lock NOT held."""
+        hook = self.on_transition
+        if hook is None:
+            return
+        for t in transitions:
+            if t is not None:
+                try:
+                    hook(self.op_class, t[0], t[1])
+                except Exception:  # noqa: BLE001 — observers must never break a call
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "breaker transition observer failed")
 
     @property
     def state(self) -> str:
         # surface the time-based OPEN -> HALF_OPEN transition on read
         with self._lock:
-            self._maybe_half_open()
-            return self._state
+            t = self._maybe_half_open()
+            out = self._state
+        self._flush(t)
+        return out
 
-    def _maybe_half_open(self) -> None:
+    def _maybe_half_open(self) -> tuple | None:
         """Caller holds the lock."""
         if (self._state == self.OPEN
                 and self._clock_ms() - self._opened_ms >= self._reset_timeout_ms):
-            self._state = self.HALF_OPEN
             self._probes_in_flight = 0
+            return self._set_state(self.HALF_OPEN)
+        return None
 
     def allow(self) -> bool:
         """May the caller attempt the backend right now? HALF_OPEN admits at
         most ``backend.circuit.half.open.probes`` concurrent probes."""
         with self._lock:
-            self._maybe_half_open()
+            t = self._maybe_half_open()
             if self._state == self.CLOSED:
-                return True
-            if self._state == self.HALF_OPEN:
+                out = True
+            elif self._state == self.HALF_OPEN:
                 if self._probes_in_flight < self._max_probes:
                     self._probes_in_flight += 1
-                    return True
-                return False
-            return False
+                    out = True
+                else:
+                    out = False
+            else:
+                out = False
+        self._flush(t)
+        return out
 
     def retry_after_ms(self) -> float:
         with self._lock:
@@ -153,23 +187,26 @@ class CircuitBreaker:
         with self._lock:
             self._consecutive_failures = 0
             self._probes_in_flight = 0
-            self._state = self.CLOSED
+            t = self._set_state(self.CLOSED)
+        self._flush(t)
 
     def on_failure(self) -> None:
+        t2 = None
         with self._lock:
-            self._maybe_half_open()
+            t1 = self._maybe_half_open()
             self._consecutive_failures += 1
             if self._state == self.HALF_OPEN:
                 # a failed probe re-opens immediately (and restarts the timer)
-                self._state = self.OPEN
+                t2 = self._set_state(self.OPEN)
                 self._opened_ms = self._clock_ms()
                 self.open_count += 1
                 self._probes_in_flight = 0
             elif (self._state == self.CLOSED
                     and self._consecutive_failures >= self._threshold):
-                self._state = self.OPEN
+                t2 = self._set_state(self.OPEN)
                 self._opened_ms = self._clock_ms()
                 self.open_count += 1
+        self._flush(t1, t2)
 
     def to_json(self) -> dict:
         return {"opClass": self.op_class, "state": self.state,
@@ -192,7 +229,11 @@ class BackendFaultTolerance:
     """
 
     def __init__(self, config=None, clock_ms=None, sensors=None,
-                 rng: random.Random | None = None):
+                 rng: random.Random | None = None, journal=None):
+        # durable event journal (common/tracing.EventJournal): every breaker
+        # state transition lands as a {"kind": "breaker"} event — the
+        # anomaly->heal lineage can then explain WHY a fix deferred
+        self._journal = journal
         self.policy = RetryPolicy.from_config(config)
         self._failure_threshold = (config.get_int(
             "backend.circuit.failure.threshold") if config is not None else 5)
@@ -218,6 +259,12 @@ class BackendFaultTolerance:
                     half_open_probes=self._half_open_probes,
                     clock_ms=self._clock_ms)
                 self._breakers[op_class] = br
+                if self._journal is not None:
+                    journal = self._journal
+
+                    def on_transition(op, old, new):
+                        journal.append("breaker", op=op, frm=old, to=new)
+                    br.on_transition = on_transition
                 if self._sensors is not None:
                     self._sensors.gauge(
                         f"backend-circuit-{op_class}-state",

@@ -28,9 +28,16 @@ OPERATION_LOGGER = logging.getLogger("operationLogger")
 
 
 class Timer:
-    """Wall-clock timer with a bounded reservoir for percentiles."""
+    """Wall-clock timer with a bounded reservoir for percentiles plus exact
+    fixed-bucket counters (rendered as a Prometheus histogram twin by
+    ``/metrics`` so percentiles aggregate across scrapes/instances — the
+    reservoir quantiles cannot)."""
 
     RESERVOIR = 1028
+    # fixed le-boundaries (seconds): sub-10ms request handling up through
+    # multi-minute heal executions; +Inf is implicit (= count)
+    BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+               10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -38,6 +45,7 @@ class Timer:
         self._total = 0.0
         self._max = 0.0
         self._values: list[float] = []
+        self._bucket_counts = [0] * len(self.BUCKETS)
         # per-timer seeded RNG for reservoir sampling: the hot path must not
         # touch the GLOBAL random module — perturbing its state from a timer
         # would break the sim's bit-identical (scenario, seed) timelines for
@@ -45,10 +53,17 @@ class Timer:
         self._rng = random.Random(self.RESERVOIR)
 
     def record(self, seconds: float) -> None:
+        import bisect
         with self._lock:
             self._count += 1
             self._total += seconds
             self._max = max(self._max, seconds)
+            # exact histogram: one increment in the first bucket whose upper
+            # bound admits the observation (values past the last bound land
+            # only in the implicit +Inf bucket = count)
+            b = bisect.bisect_left(self.BUCKETS, seconds)
+            if b < len(self._bucket_counts):
+                self._bucket_counts[b] += 1
             if len(self._values) < self.RESERVOIR:
                 self._values.append(seconds)
             else:  # vitter's algorithm R: uniform over the full history
@@ -71,6 +86,11 @@ class Timer:
         with self._lock:
             vals = sorted(self._values)
             count, total, mx = self._count, self._total, self._max
+            per_bucket = list(self._bucket_counts)
+        cum, cum_buckets = 0, []
+        for le, n in zip(self.BUCKETS, per_bucket):
+            cum += n
+            cum_buckets.append([le, cum])
         return {
             "type": "timer", "count": count,
             "meanSec": round(total / count, 6) if count else 0.0,
@@ -79,6 +99,9 @@ class Timer:
             "p50Sec": round(self._percentile(vals, 0.50), 6),
             "p95Sec": round(self._percentile(vals, 0.95), 6),
             "p99Sec": round(self._percentile(vals, 0.99), 6),
+            # cumulative le-bucket counts ([le_seconds, count<=le]); exact,
+            # not reservoir-sampled — the /metrics _bucket series
+            "bucketsSec": cum_buckets,
         }
 
 

@@ -270,6 +270,55 @@ _D.define(name="flight.recorder.capacity", type=Type.INT, default=64,
               "(common/tracing.py RoundTrace) are retained and served by "
               "/state?substates=ROUND_TRACES. Recording is always on; the "
               "buffer bound is the memory cap.")
+_D.define(name="journal.path", type=Type.STRING, default="",
+          doc="Durable event journal file (common/tracing.EventJournal): "
+              "append-only JSONL of spans, round summaries, executor task "
+              "census transitions, breaker state changes and pipeline stage "
+              "notes — the tail target an HA standby consumes. Empty "
+              "(default) keeps the journal in-memory only (the bounded ring "
+              "still feeds /state?substates=TRACES and the sim's episode "
+              "journal slices).")
+_D.define(name="journal.fsync", type=Type.STRING, default="never",
+          validator=in_set("never", "rotate", "always"),
+          validator_doc="one of: never, rotate, always",
+          doc="Journal durability policy: 'never' (OS page cache only), "
+              "'rotate' (fsync when a file fills), 'always' (fsync every "
+              "append — the HA-standby tail setting; costs one fsync per "
+              "control-plane event, never on the device path).")
+_D.define(name="journal.max.bytes.per.file", type=Type.INT, default=16_777_216,
+          validator=at_least(4096),
+          doc="Journal size rotation threshold: the active file rotates to "
+              "journal.path.1..N once it would exceed this many bytes.")
+_D.define(name="journal.max.files", type=Type.INT, default=8,
+          validator=at_least(1),
+          doc="How many rotated journal files to keep (journal.path.1 is "
+              "the most recently rotated; older files are deleted).")
+_D.define(name="journal.memory.lines", type=Type.INT, default=65_536,
+          validator=at_least(16),
+          doc="Bounded in-memory ring of recent journal lines (kept with or "
+              "without a journal.path) — what ScenarioResult.journal and "
+              "path-less deployments read.")
+_D.define(name="journal.trace.capacity", type=Type.INT, default=1024,
+          validator=at_least(16),
+          doc="Span-tracer ring size: how many FINISHED spans are retained "
+              "for /state?substates=TRACES trace-tree serving (the journal "
+              "keeps the full history; this bounds the live query surface).")
+_D.define(name="health.slo.detect.p95.ms", type=Type.INT, default=120_000,
+          validator=at_least(1),
+          doc="GET /health SLO target: p95 of anomaly-detection-to-fix-timer "
+              "(detection -> fix dispatched) must stay at/below this many "
+              "milliseconds for the detect SLO to count as attained.")
+_D.define(name="health.slo.heal.p95.ms", type=Type.INT, default=900_000,
+          validator=at_least(1),
+          doc="GET /health SLO target: p95 of every per-type "
+              "*-self-healing-fix-timer (detection -> heal execution "
+              "complete, injected-clock seconds) must stay at/below this "
+              "many milliseconds.")
+_D.define(name="health.slo.request.p99.ms", type=Type.INT, default=2_000,
+          validator=at_least(1),
+          doc="GET /health SLO target: p99 of each per-endpoint "
+              "*-successful-request-execution-timer must stay at/below this "
+              "many milliseconds.")
 _D.define(name="goal.balancedness.priority.weight", type=Type.DOUBLE, default=1.1,
           validator=at_least(1.0),
           doc="Balancedness score: weight step per goal priority rank "

@@ -38,9 +38,25 @@ class Anomaly:
     anomaly_id: int = dataclasses.field(default_factory=lambda: next(_seq))
     fixable: bool = True
 
+    # explicit causal-span handle for the fix path (common/tracing.Span):
+    # the detector manager sets it around fix() via fix_with_span so each
+    # fix can parent its facade operation span — an explicit handle on the
+    # anomaly object, never thread-local/context magic (class attribute,
+    # not a dataclass field: to_json and field order stay untouched)
+    fix_span = None
+
     def fix(self, cruise_control) -> dict | None:
         """Self-heal through the facade; returns an operation summary."""
         return None
+
+    def fix_with_span(self, cruise_control, span=None) -> dict | None:
+        """Run the fix with ``span`` (the manager's verdict span) as the
+        explicit parent handle for the operation it dispatches."""
+        self.fix_span = span
+        try:
+            return self.fix(cruise_control)
+        finally:
+            self.fix_span = None
 
     def sort_key(self):
         return (int(self.anomaly_type), self.detected_ms, self.anomaly_id)
@@ -60,7 +76,8 @@ class BrokerFailures(Anomaly):
         using self-healing goals."""
         return cruise_control.remove_brokers(
             sorted(self.failed_brokers), self_healing=True,
-            reason=f"self-healing broker failure: {sorted(self.failed_brokers)}")
+            reason=f"self-healing broker failure: {sorted(self.failed_brokers)}",
+            parent_span=self.fix_span)
 
 
 @dataclasses.dataclass
@@ -71,7 +88,8 @@ class DiskFailures(Anomaly):
         """FixOfflineReplicasRunnable role."""
         return cruise_control.fix_offline_replicas(
             self_healing=True,
-            reason=f"self-healing disk failure: {self.failed_disks}")
+            reason=f"self-healing disk failure: {self.failed_disks}",
+            parent_span=self.fix_span)
 
 
 @dataclasses.dataclass
@@ -84,7 +102,8 @@ class GoalViolations(Anomaly):
             return None
         return cruise_control.rebalance(
             self_healing=True, triggered_by_goal_violation=True,
-            reason=f"self-healing goal violation: {self.violated_goals_fixable}")
+            reason=f"self-healing goal violation: {self.violated_goals_fixable}",
+            parent_span=self.fix_span)
 
 
 @dataclasses.dataclass
@@ -106,9 +125,11 @@ class SlowBrokers(Anomaly):
         if self.remove:
             return cruise_control.remove_brokers(
                 brokers, self_healing=True,
-                reason=f"self-healing slow broker removal: {brokers}")
+                reason=f"self-healing slow broker removal: {brokers}",
+                parent_span=self.fix_span)
         return cruise_control.demote_brokers(
-            brokers, reason=f"self-healing slow broker demotion: {brokers}")
+            brokers, reason=f"self-healing slow broker demotion: {brokers}",
+            parent_span=self.fix_span)
 
 
 @dataclasses.dataclass
@@ -117,7 +138,8 @@ class TopicAnomaly(Anomaly):
 
     def fix(self, cruise_control):
         return cruise_control.fix_topic_replication_factor(
-            self.bad_topics, reason="self-healing topic replication factor")
+            self.bad_topics, reason="self-healing topic replication factor",
+            parent_span=self.fix_span)
 
 
 @dataclasses.dataclass
@@ -131,20 +153,26 @@ class MaintenanceEvent(Anomaly):
         pt = self.plan_type.upper()
         reason = f"maintenance event {pt}"
         if pt == "REMOVE_BROKER":
-            return cruise_control.remove_brokers(self.brokers, reason=reason)
+            return cruise_control.remove_brokers(self.brokers, reason=reason,
+                                                 parent_span=self.fix_span)
         if pt == "ADD_BROKER":
             # self-healing context: balance onto the new hardware
             # best-effort — a transiently-unsatisfiable hard goal mid-fault
             # must not abort the plan (campaigns caught the strict chain
             # raising while a concurrent broker death was unhealed)
             return cruise_control.add_brokers(self.brokers, reason=reason,
-                                              skip_hard_goal_check=True)
+                                              skip_hard_goal_check=True,
+                                              parent_span=self.fix_span)
         if pt == "DEMOTE_BROKER":
-            return cruise_control.demote_brokers(self.brokers, reason=reason)
+            return cruise_control.demote_brokers(self.brokers, reason=reason,
+                                                 parent_span=self.fix_span)
         if pt == "REBALANCE":
-            return cruise_control.rebalance(reason=reason)
+            return cruise_control.rebalance(reason=reason,
+                                            parent_span=self.fix_span)
         if pt == "FIX_OFFLINE_REPLICAS":
-            return cruise_control.fix_offline_replicas(reason=reason)
+            return cruise_control.fix_offline_replicas(
+                reason=reason, parent_span=self.fix_span)
         if pt == "TOPIC_REPLICATION_FACTOR":
-            return cruise_control.fix_topic_replication_factor(self.topics, reason=reason)
+            return cruise_control.fix_topic_replication_factor(
+                self.topics, reason=reason, parent_span=self.fix_span)
         raise ValueError(f"unknown maintenance plan type {self.plan_type!r}")

@@ -160,12 +160,22 @@ class AnomalyDetectorManager:
             self.add_anomaly(a)
 
         handled = []
+        tracer = getattr(self._cc, "tracer", None)
+        journal = getattr(self._cc, "journal", None)
         while True:
             anomaly = self._pop()
             if anomaly is None:
                 break
             verdict = self._notifier.on_anomaly(anomaly, now_ms)
             entry = {"anomaly": anomaly.to_json(), "action": verdict.action.value}
+            # causal journal: every non-FIX verdict is a lightweight event;
+            # a FIX verdict opens the trace's ROOT span (below) — the
+            # anomaly->heal lineage starts here. Deterministic fields only
+            # (type/action/detection time — never the process-global id).
+            if journal is not None and verdict.action is not Action.FIX:
+                journal.append("verdict", type=anomaly.anomaly_type.name,
+                               action=verdict.action.value,
+                               detected=round(anomaly.detected_ms, 1))
             if (verdict.action is Action.FIX and self._cc is not None
                     and self._degraded()):
                 # backend boundary unhealthy (open circuit breaker): firing
@@ -178,6 +188,10 @@ class AnomalyDetectorManager:
                     self._cc.fault_tolerance.retry_after_s() * 1000.0, 1000.0)
                 entry["action"] = Action.CHECK.value
                 entry["deferred"] = "backend degraded"
+                if journal is not None:
+                    journal.append("verdict", type=anomaly.anomaly_type.name,
+                                   action="FIX", deferred="backend degraded",
+                                   detected=round(anomaly.detected_ms, 1))
                 sensors = getattr(self._cc, "sensors", None)
                 if sensors is not None:
                     sensors.meter("self-healing-fix-deferrals").mark()
@@ -185,6 +199,18 @@ class AnomalyDetectorManager:
                     self._deferred.append((now_ms + delay_ms, anomaly))
             elif verdict.action is Action.FIX and self._cc is not None:
                 sensors = getattr(self._cc, "sensors", None)
+                # the trace ROOT: one "verdict" span per FIX, covering
+                # handling through heal completion (blocking executions
+                # advance the injected clock, so [t0, t1] is the full
+                # anomaly->heal extent on the backend's time base). The
+                # handle propagates EXPLICITLY: fix_with_span ->
+                # Anomaly.fix_span -> facade parent_span.
+                vspan = None
+                if tracer is not None:
+                    vspan = tracer.span(
+                        "verdict", anomaly.anomaly_type.name, action="FIX",
+                        detected_ms=round(anomaly.detected_ms, 1),
+                        description=anomaly.description[:160])
                 try:
                     if (anomaly.anomaly_type is AnomalyType.MAINTENANCE_EVENT
                             and self._maintenance_stops_ongoing
@@ -192,9 +218,12 @@ class AnomalyDetectorManager:
                         # maintenance.event.stop.ongoing.execution: the plan
                         # preempts whatever proposal execution is running
                         self._cc.stop_proposal_execution(force=False)
-                    result = anomaly.fix(self._cc)
+                    result = anomaly.fix_with_span(self._cc, vspan)
                     entry["fixResult"] = result
                     self._self_healing_actions += 1
+                    if vspan is not None:
+                        vspan.end(fixed=result is not None,
+                                  executed=bool((result or {}).get("executed")))
                     if sensors is not None:
                         # heal-latency timers (sensor catalog): detection ->
                         # FIX-complete per anomaly type, on the injected
@@ -220,6 +249,9 @@ class AnomalyDetectorManager:
                         entry.pop("fixResult", None)
                         entry["action"] = Action.CHECK.value
                         entry["deferred"] = "backend degraded"
+                        if vspan is not None:
+                            vspan.end(deferred="backend degraded",
+                                      error=type(e).__name__)
                         if sensors is not None:
                             sensors.meter("self-healing-fix-deferrals").mark()
                         with self._lock:
@@ -227,6 +259,8 @@ class AnomalyDetectorManager:
                     else:
                         LOG.exception("self-healing fix failed for %s", anomaly)
                         entry["fixError"] = str(e)
+                        if vspan is not None:
+                            vspan.end(error=type(e).__name__)
                         if sensors is not None:
                             sensors.meter("self-healing-fix-failures").mark()
             elif verdict.action is Action.CHECK:

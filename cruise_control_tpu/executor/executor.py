@@ -302,9 +302,17 @@ class ConcurrencyAdjuster:
 
 class Executor:
     def __init__(self, backend, config=None, clock=None, strategy_names=None,
-                 sensors=None, fault_tolerance=None):
+                 sensors=None, fault_tolerance=None, tracer=None,
+                 journal=None):
         from cruise_control_tpu.common.sensors import MetricRegistry
         self._sensors = sensors if sensors is not None else MetricRegistry()
+        # causal span journal (common/tracing.py): every execution opens an
+        # "execution" span under the caller's explicit parent handle (the
+        # facade's operation span), with one "phase" child per executor
+        # phase; every task-state transition lands as a {"kind": "task"}
+        # journal event tied to the execution span — the durable census.
+        self._tracer = tracer
+        self._journal = journal
         # Executor sensor catalog (Sensors.md): ongoing-execution gauge +
         # started/stopped execution meters + the proposal-execution-timer
         # (whole 3-phase execution wall, on the injected clock — simulated
@@ -543,14 +551,17 @@ class Executor:
     def execute_proposals(self, proposals: list, blocking: bool = True,
                           context: dict | None = None,
                           strategy_names: list | None = None,
-                          generation: int | None = None) -> None:
+                          generation: int | None = None,
+                          parent_span=None) -> None:
         """Run the 3-phase execution (Executor.executeProposals :567).
         ``strategy_names`` overrides the configured default movement-strategy
         chain for this execution (the REST replica_movement_strategies
         parameter role). ``generation`` is the metadata generation the
         proposals were computed against (the pipelined loop's staleness tag
         — recorded for observability; the pipeline drops stale sets BEFORE
-        they reach here)."""
+        they reach here). ``parent_span`` is the caller's explicit causal
+        handle: the execution span (and with it the whole task census)
+        hangs under the operation that computed the proposals."""
         strategy = (build_strategy(strategy_names, registry=self._strategy_registry)
                     if strategy_names else self._strategy)
         with self._lock:
@@ -580,11 +591,32 @@ class Executor:
         self._slow_task_alerts.clear()
         planner.add_proposals(proposals, context)
         self._current_planner = planner
+        # causal execution span + durable task census: transitions journal
+        # through ExecutionTask.on_transition keyed by the task's PLAN INDEX
+        # (tp + type + index are deterministic per (scenario, seed); the
+        # process-global task_id is not)
+        exec_span = None
+        if self._tracer is not None:
+            exec_span = self._tracer.span(
+                "execution", self._operation, parent=parent_span,
+                tasks=len(planner.all_tasks))
+        if self._journal is not None:
+            journal = self._journal
+            trace = exec_span.trace_id if exec_span is not None else None
+            span_id = exec_span.span_id if exec_span is not None else None
+            for i, t in enumerate(planner.all_tasks):
+                def on_transition(task, state, now, _i=i):
+                    journal.append(
+                        "task", i=_i, tp=list(task.tp),
+                        ty=task.task_type.value, st=state.name,
+                        trace=trace, span=span_id)
+                t.on_transition = on_transition
         if blocking:
-            self._run_execution(planner)
+            self._run_execution(planner, exec_span)
         else:
             self._execution_thread = threading.Thread(
-                target=self._run_execution, args=(planner,), daemon=True)
+                target=self._run_execution, args=(planner, exec_span),
+                daemon=True)
             self._execution_thread.start()
 
     def wait_for_completion(self, timeout_s: float = 60.0) -> None:
@@ -675,23 +707,45 @@ class Executor:
                 self._sensors.meter("throttle-clear-failures").mark()
 
     # ------------------------------------------------------------ internals
-    def _run_execution(self, planner: ExecutionTaskPlanner) -> None:
+    def _run_execution(self, planner: ExecutionTaskPlanner,
+                       exec_span=None) -> None:
         throttled, throttled_topics = False, []
         self._paused = False
         t0_ms = self._clock.now_ms()
+
+        def _phase(name):
+            return (exec_span.child("phase", name)
+                    if exec_span is not None else None)
         try:
             throttled, throttled_topics = self._set_throttles(planner)
+            ph = _phase("inter_broker")
             self._inter_broker_phase(planner)
+            if ph is not None:
+                ph.end()
             if not self._stop_requested:
+                ph = _phase("intra_broker")
                 self._intra_broker_phase(planner)
+                if ph is not None:
+                    ph.end()
             if not self._stop_requested:
+                ph = _phase("leadership")
                 self._leadership_phase(planner)
+                if ph is not None:
+                    ph.end()
         finally:
             self._clear_throttles(throttled, throttled_topics)
             self._execution_timer.record(
                 max(self._clock.now_ms() - t0_ms, 0.0) / 1000.0)
             done = sum(1 for t in planner.all_tasks
                        if t.state is TaskState.COMPLETED)
+            if exec_span is not None:
+                by_state: dict[str, int] = {}
+                for t in planner.all_tasks:
+                    by_state[t.state.name] = by_state.get(t.state.name, 0) + 1
+                exec_span.end(completed=done, total=len(planner.all_tasks),
+                              stopped=self._stop_requested,
+                              aborted=by_state.get("ABORTED", 0),
+                              dead=by_state.get("DEAD", 0))
             self._history.append({
                 "finishedMs": self._clock.now_ms(),
                 "numTasks": len(planner.all_tasks),

@@ -40,6 +40,12 @@ class ExecutionTask:
     start_ms: float = -1.0
     end_ms: float = -1.0
 
+    # optional census observer ``(task, new_state, now_ms)`` — the executor
+    # sets it per execution so every transition lands in the durable event
+    # journal (class attribute, not a dataclass field: to_json/asdict and
+    # the task's equality semantics stay untouched)
+    on_transition = None
+
     @property
     def tp(self) -> tuple:
         return (self.proposal.topic, self.proposal.partition)
@@ -65,6 +71,8 @@ class ExecutionTask:
             self.start_ms = now_ms
         if new_state in (TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD):
             self.end_ms = now_ms
+        if self.on_transition is not None:
+            self.on_transition(self, new_state, now_ms)
 
     def to_json(self) -> dict:
         return {"taskId": self.task_id, "type": self.task_type.value,

@@ -80,7 +80,7 @@ class LoadMonitorState:
 class LoadMonitor:
     def __init__(self, config=None, backend=None, sampler=None, sample_store=None,
                  capacity_resolver=None, sensors=None, recorder=None,
-                 fault_tolerance=None):
+                 fault_tolerance=None, tracer=None):
         from cruise_control_tpu.common.sensors import MetricRegistry
         self._sensors = sensors if sensors is not None else MetricRegistry()
         # backend fault tolerance (common/retries.py): sampling rounds retry
@@ -93,6 +93,10 @@ class LoadMonitor:
         # flight recorder (common/tracing.py): sampling rounds note their
         # seconds so the next optimization's RoundTrace carries sampling_s
         self._recorder = recorder
+        # span tracer: each ingested sampling batch is a ROOT span in the
+        # causal journal (the "sample-ingest batch" root event) — stamped on
+        # the backend clock, deterministic in the sim
+        self._tracer = tracer
         # sensor catalog (LoadMonitor.java:180-195 gauges + :173 timer)
         self._model_timer = self._sensors.timer("cluster-model-creation-timer")
         self._sampling_timer = self._sensors.timer("metric-sampling-timer")
@@ -422,6 +426,11 @@ class LoadMonitor:
         pipelined and blocking loops report the same per-round figure."""
         t0 = time.monotonic()
         n = self._ingest(samples)
+        if self._tracer is not None:
+            # one root span per ingested batch (zero-duration on the backend
+            # clock; the wall seconds ride the sampling timer, not the
+            # journal — journal bytes must stay (scenario, seed)-identical)
+            self._tracer.span("sampling", "sample-ingest", samples=n).end()
         if self._store is not None:
             self._store.store_samples(samples)
         if self.on_execution_store is not None:

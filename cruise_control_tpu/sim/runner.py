@@ -99,6 +99,13 @@ class ScenarioResult:
     # drive): deterministic stage/backpressure/staleness counts — part of
     # the reproducible record when the runner drove the pipeline
     pipeline: dict = dataclasses.field(default_factory=dict)
+    # the app's durable event journal slice (common/tracing.EventJournal
+    # lines: spans, round summaries, task census, breaker transitions) —
+    # everything is stamped on SIMULATED time and journals only
+    # deterministic fields, so the same (scenario, seed) yields BYTE-
+    # identical lines (test-asserted). Excluded from to_json() like
+    # round_traces; campaign episodes carry it for lineage reconstruction.
+    journal: list = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -546,6 +553,9 @@ class ScenarioRunner:
         # runner bookkeeping
         r.round_traces = self.cc.flight_recorder.to_json()["traces"]
         r.sensors = self.cc.sensors.to_json()
+        # the episode's journal slice: the full causal record (the HA
+        # standby's tail target; what the lineage/byte-identity tests read)
+        r.journal = self.cc.journal.lines()
         if self.pipe is not None:
             r.pipeline = self.pipe.state_json()
         self.cc.shutdown()

@@ -149,6 +149,97 @@ def test_record_round_assembles_from_engine_data():
     assert t2.operation is None and t2.sampling_s == 0.25
 
 
+def _record(rec, gen=None, **kw):
+    defaults = dict(wall_s=0.1, goal_results=[], compiles=0, env=None,
+                    state=None, num_proposals=0, num_replica_movements=0,
+                    num_leadership_movements=0, opt_generation=gen)
+    defaults.update(kw)
+    return rec.record_round(**defaults)
+
+
+def test_stage_notes_keyed_by_round_generation():
+    """The threaded-pipeline race, fixed: once the optimize interval rolls
+    (round G+1 starts before round G records), a stage noted under G+1 must
+    attach to G+1's trace — not be swallowed by G's record."""
+    rec = FlightRecorder(capacity=8, clock_ms=lambda: 0.0)
+    g1 = rec.note_optimize_start()
+    rec.note_stage("sync", 0.0, 0.1, batches=1)       # prepared under G
+    g2 = rec.note_optimize_start()                    # interval rolled
+    rec.note_stage("ingest", 0.2, 0.3)                # belongs to G+1
+    t1 = _record(rec, gen=g1)
+    assert [s["stage"] for s in t1.stages] == ["sync"]
+    # round G's record must NOT clear round G+1's in-flight marker
+    assert rec.optimize_in_flight()
+    t2 = _record(rec, gen=g2)
+    assert [s["stage"] for s in t2.stages] == ["ingest"]
+    assert not rec.optimize_in_flight()
+    # stages noted with NO round in flight attach to the next round
+    rec.note_stage("execute", 0.4, 0.5, executed=1)
+    g3 = rec.note_optimize_start()
+    t3 = _record(rec, gen=g3)
+    assert [s["stage"] for s in t3.stages] == ["execute"]
+
+
+def test_stage_notes_concurrent_writers_never_lost_or_double_taken():
+    """Concurrent stage writers against rolling rounds: every note lands in
+    EXACTLY one recorded trace (conservation), and never in a trace whose
+    generation predates the note's."""
+    rec = FlightRecorder(capacity=64, clock_ms=lambda: 0.0)
+    N_WRITERS, NOTES = 4, 50
+    gens: list[int] = []
+    gen_lock = threading.Lock()
+
+    def writer(w):
+        for i in range(NOTES):
+            rec.note_stage(f"w{w}", 0.0, 0.001, seq=i)
+
+    def roller():
+        for _ in range(20):
+            with gen_lock:
+                gens.append(rec.note_optimize_start())
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(N_WRITERS)] + [threading.Thread(target=roller)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    final_gen = rec.note_optimize_start()
+    traces = [_record(rec, gen=g) for g in [*gens, final_gen]]
+    taken = [s for t in traces for s in t.stages]
+    # conservation: the ring bounds pending notes at 64 — everything still
+    # pending at each record lands exactly once across the records
+    keys = [(s["stage"], s["seq"]) for s in taken]
+    assert len(keys) == len(set(keys))
+    assert taken, "no stage note survived"
+    assert len(rec._pending_stages) == 0
+
+
+def test_timer_bucket_histogram_round_trips():
+    """Timers carry exact cumulative le-bucket counts; /metrics renders them
+    as a histogram family that the ingest-side parser round-trips."""
+    reg = MetricRegistry()
+    t = reg.timer("state-successful-request-execution-timer")
+    for v in (0.004, 0.02, 0.02, 0.3, 7.0, 1000.0):
+        t.record(v)
+    snap = t.to_json()
+    buckets = dict((le, c) for le, c in snap["bucketsSec"])
+    assert buckets[0.005] == 1
+    assert buckets[0.025] == 3          # cumulative: 0.004 + 2x 0.02
+    assert buckets[0.5] == 4
+    assert buckets[10.0] == 5           # the 1000s outlier only in +Inf
+    assert buckets[600.0] == 5
+    # monotone non-decreasing
+    cums = [c for _, c in snap["bucketsSec"]]
+    assert cums == sorted(cums)
+    samples = parse_prometheus_text(render_prometheus(reg.to_json()))
+    base = "cc_state_successful_request_execution_timer_seconds_hist"
+    assert samples[(base + "_bucket", (("le", "0.025"),))] == 3
+    assert samples[(base + "_bucket", (("le", "+Inf"),))] == 6
+    assert samples[(base + "_count", ())] == 6
+    assert samples[(base + "_sum", ())] == pytest.approx(snap["totalSec"])
+
+
 def test_tree_device_bytes_none_and_metadata_only():
     assert tree_device_bytes(None) == 0
     import jax.numpy as jnp

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Reconstruct causal trace trees from a durable event journal and export
+Chrome trace-event JSON (Perfetto-loadable).
+
+Input: an EventJournal JSONL file (``journal.path``; pass the active file —
+rotated ``path.N`` siblings can be concatenated first), or ``-`` for stdin.
+Also accepts a JSON document carrying a journal slice (a campaign episode's
+``journal`` list) or a ``/state?substates=TRACES`` response.
+
+Usage:
+  tools/journal_view.py JOURNAL.jsonl                 # text trace trees
+  tools/journal_view.py JOURNAL.jsonl --perfetto OUT.json
+  tools/journal_view.py JOURNAL.jsonl --slo           # span-derived SLOs
+  tools/journal_view.py JOURNAL.jsonl --kind verdict  # filter root kind
+
+Tree mode prints each trace as an indented span tree (kind:name, [t0..t1]
+extent on the journal's clock — simulated ms for sim journals — and the
+attrs), with per-trace task-census and stage event counts folded in.
+
+Perfetto mode emits Chrome trace-event format: one complete ("X") event per
+span, microsecond timestamps, lanes (tid) = the root span's kind (verdict /
+request / sampling / ...) so detector lineage, REST traffic and sampling
+cadence land on separate tracks, spans nested by parent within the lane.
+Load via https://ui.perfetto.dev or chrome://tracing.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+from cruise_control_tpu.common.tracing import build_trace_trees
+
+# lane order: the control-plane story reads top-down in Perfetto
+_LANE_ORDER = ("verdict", "request", "operation", "optimize", "execution",
+               "sampling", "stage")
+
+
+def load_events(raw: str) -> list[dict]:
+    """Parse journal input: JSONL (one event per line), a JSON list of
+    events, or a document carrying one ({"journal": [...lines or events...]}
+    / a TRACES substate response)."""
+    raw = raw.strip()
+    if not raw:
+        return []
+    # whole-document JSON first (episode artifacts, /state responses)
+    try:
+        doc = json.loads(raw)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, list):
+        return [e if isinstance(e, dict) else json.loads(e) for e in doc]
+    if isinstance(doc, dict):
+        if "journal" in doc:
+            return load_events("\n".join(
+                e if isinstance(e, str) else json.dumps(e)
+                for e in doc["journal"]))
+        # TRACES substate: flatten the already-built trees back to records
+        trees = (doc.get("Traces") or doc).get("trees")
+        if trees:
+            out: list[dict] = []
+
+            def walk(node):
+                rec = {k: v for k, v in node.items() if k != "children"}
+                rec["kind"] = "span"
+                out.append(rec)
+                for c in node.get("children", ()):
+                    walk(c)
+            for t in trees:
+                for r in t.get("roots", ()) + t.get("orphans", ()):
+                    walk(r)
+            return out
+        return []
+    events = []
+    for line in raw.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            events.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return events
+
+
+def spans_of(events: list[dict]) -> list[dict]:
+    return [e for e in events if e.get("kind") == "span" and "span" in e]
+
+
+def _fmt_attrs(attrs: dict) -> str:
+    if not attrs:
+        return ""
+    return " " + " ".join(f"{k}={attrs[k]}" for k in sorted(attrs))
+
+
+def render_tree(tree: dict, events: list[dict]) -> str:
+    """One trace as an indented text tree + its journaled event counts."""
+    lines = [f"trace {tree['trace']}"]
+    tasks = [e for e in events
+             if e.get("kind") == "task" and e.get("trace") == tree["trace"]]
+
+    def walk(node, depth):
+        t0, t1 = node.get("t0"), node.get("t1")
+        extent = (f"[{t0:.0f}..{t1:.0f}] dur={t1 - t0:.0f}ms"
+                  if isinstance(t0, float) and isinstance(t1, float)
+                  else f"[{t0}..open]")
+        lines.append(f"{'  ' * depth}- {node['span_kind']}:{node['name']} "
+                     f"{extent}{_fmt_attrs(node.get('attrs') or {})}")
+        if node["span_kind"] == "execution" and tasks:
+            by_state: dict[str, int] = {}
+            for e in tasks:
+                if e.get("span") == node["span"]:
+                    by_state[e["st"]] = by_state.get(e["st"], 0) + 1
+            if by_state:
+                lines.append(f"{'  ' * (depth + 1)}task census: " + " ".join(
+                    f"{k}={v}" for k, v in sorted(by_state.items())))
+        for c in node.get("children", ()):
+            walk(c, depth + 1)
+
+    for r in tree["roots"]:
+        walk(r, 1)
+    for o in tree["orphans"]:
+        lines.append(f"  ORPHAN (parent {o.get('parent')} missing):")
+        walk(o, 2)
+    return "\n".join(lines)
+
+
+def perfetto_events(spans: list[dict]) -> list[dict]:
+    """Chrome trace-event JSON: complete ("X") events in µs, lane (tid) =
+    the trace's ROOT kind, nesting by parent within the lane."""
+    trees = build_trace_trees(spans)
+    lanes: dict[str, int] = {}
+    out: list[dict] = []
+
+    def lane_of(kind: str) -> int:
+        if kind not in lanes:
+            lanes[kind] = len(lanes) + 1
+        return lanes[kind]
+
+    # stable lane numbering: well-known kinds first
+    for kind in _LANE_ORDER:
+        if any(t["roots"] and t["roots"][0]["span_kind"] == kind
+               for t in trees):
+            lane_of(kind)
+
+    def emit(node, tid):
+        t0 = float(node.get("t0") or 0.0)
+        t1 = node.get("t1")
+        dur = max((float(t1) - t0) if t1 is not None else 0.0, 0.0)
+        out.append({
+            "name": f"{node['span_kind']}:{node['name']}",
+            "cat": node["span_kind"], "ph": "X",
+            "ts": t0 * 1000.0, "dur": dur * 1000.0,
+            "pid": 1, "tid": tid,
+            "args": dict(node.get("attrs") or {},
+                         trace=node["trace"], span=node["span"]),
+        })
+        for c in node.get("children", ()):
+            emit(c, tid)
+
+    for t in trees:
+        roots = t["roots"] or t["orphans"]
+        if not roots:
+            continue
+        tid = lane_of(roots[0]["span_kind"])
+        for r in roots:
+            emit(r, tid)
+    # named lanes (thread_name metadata events)
+    for kind, tid in lanes.items():
+        out.append({"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                    "args": {"name": kind}})
+    out.sort(key=lambda e: (e.get("ts", 0.0), e["tid"], e["name"]))
+    return out
+
+
+def _dist(vals: list, quantiles=(0.5, 0.95, 0.99)) -> dict:
+    import math
+    vals = sorted(v for v in vals if v is not None)
+    out = {"n": len(vals)}
+    for q in quantiles:
+        key = f"p{int(q * 100)}"
+        out[key] = (vals[min(max(0, math.ceil(q * len(vals)) - 1),
+                             len(vals) - 1)] if vals else None)
+    out["max"] = vals[-1] if vals else None
+    return out
+
+
+def journal_slo(events: list[dict]) -> dict:
+    """Span-derived SLO distributions: detect->heal latency per fault type
+    (verdict span end minus its recorded detection time) and per-endpoint
+    request latency (request span extent)."""
+    heal: dict[str, list] = {}
+    req: dict[str, list] = {}
+    for s in spans_of(events):
+        attrs = s.get("attrs") or {}
+        if s.get("span_kind") == "verdict" and s.get("t1") is not None \
+                and "detected_ms" in attrs:
+            heal.setdefault(s["name"], []).append(
+                float(s["t1"]) - float(attrs["detected_ms"]))
+        elif s.get("span_kind") == "request" and s.get("t1") is not None:
+            req.setdefault(s["name"], []).append(
+                float(s["t1"]) - float(s["t0"]))
+    out = {kind: {"detect_to_heal_ms": _dist(v)}
+           for kind, v in sorted(heal.items())}
+    out.update({f"endpoint:{name}": {"latency_ms": _dist(v)}
+                for name, v in sorted(req.items())})
+    return out
+
+
+def main(argv: list[str]) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    if not args:
+        print(__doc__, file=sys.stderr)
+        return 2
+    raw = sys.stdin.read() if args[0] == "-" else open(args[0]).read()
+    events = load_events(raw)
+    spans = spans_of(events)
+    if not events:
+        print("no journal events found", file=sys.stderr)
+        return 1
+    if "--slo" in argv:
+        print(json.dumps(journal_slo(events), indent=2))
+        return 0
+    if "--perfetto" in argv:
+        out_path = argv[argv.index("--perfetto") + 1]
+        doc = {"traceEvents": perfetto_events(spans),
+               "displayTimeUnit": "ms"}
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"wrote {len(doc['traceEvents'])} trace events to {out_path} "
+              f"(load in https://ui.perfetto.dev)")
+        return 0
+    kind_filter = (argv[argv.index("--kind") + 1] if "--kind" in argv
+                   else None)
+    trees = build_trace_trees(spans)
+    if kind_filter:
+        trees = [t for t in trees if t["roots"]
+                 and t["roots"][0]["span_kind"] == kind_filter]
+    if not trees:
+        print("no trace trees found", file=sys.stderr)
+        return 1
+    counts: dict[str, int] = {}
+    for e in events:
+        counts[e.get("kind", "?")] = counts.get(e.get("kind", "?"), 0) + 1
+    print(f"{len(events)} journal events "
+          f"({', '.join(f'{k}={v}' for k, v in sorted(counts.items()))}), "
+          f"{len(trees)} traces")
+    for t in trees:
+        print(render_tree(t, events))
+    return 0
+
+
+if __name__ == "__main__":
+    import signal
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    raise SystemExit(main(sys.argv[1:]))

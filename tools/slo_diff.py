@@ -24,6 +24,14 @@ Accepted documents (auto-detected): a campaign episode log / campaign doc
 with a top-level ``slo``, a bench summary with ``campaign.slo`` and/or
 ``rungs``, or a bare SLO mapping
 {kind: {time_to_detect_ms: {p50, p95, max}, ...}}.
+
+Journal inputs: an EventJournal JSONL file (``journal.path`` / a sim
+episode's journal slice written to disk) is ALSO accepted on either side —
+its SPAN-derived SLOs are gated instead: detect->heal latency per fault
+type (verdict span end minus recorded detection time, p95) and per-endpoint
+request latency (request span extent, p99). The same thresholds apply; a
+fault type / endpoint measured in the baseline journal but absent from the
+candidate's is coverage loss.
 """
 from __future__ import annotations
 
@@ -31,6 +39,10 @@ import json
 import sys
 
 DEFAULT_FIELDS = ("time_to_detect_ms", "time_to_heal_ms")
+# span-derived fields (journal inputs); latency gates on p99 per the
+# heavy-traffic item, heal on p95 like the campaign distributions
+JOURNAL_FIELDS = ("detect_to_heal_ms", "latency_ms")
+P99_FIELDS = ("latency_ms",)
 STEADY_FIELDS = ("round_s_steady", "round_s_pipelined")
 
 
@@ -68,8 +80,11 @@ def compare_slos(base: dict, cand: dict, threshold: float = 0.25,
                          "only in " + ("baseline" if c is None else "candidate")})
             continue
         for field in fields:
-            bp = (b.get(field) or {}).get("p95")
-            cp = (c.get(field) or {}).get("p95")
+            # span-derived request latencies gate on p99 (the heavy-traffic
+            # bar); everything else on p95 like the campaign distributions
+            q = "p99" if field in P99_FIELDS else "p95"
+            bp = (b.get(field) or {}).get(q)
+            cp = (c.get(field) or {}).get(q)
             row = {"kind": kind, "field": field, "base_p95": bp,
                    "cand_p95": cp}
             if bp is not None and cp is None:
@@ -77,7 +92,7 @@ def compare_slos(base: dict, cand: dict, threshold: float = 0.25,
                 regressions.append(row)
             elif bp is not None and cp is not None \
                     and cp > bp * (1.0 + threshold):
-                row["regression"] = (f"p95 {cp:.1f} > {bp:.1f} "
+                row["regression"] = (f"{q} {cp:.1f} > {bp:.1f} "
                                      f"* (1 + {threshold:g})")
                 regressions.append(row)
             rows.append(row)
@@ -144,13 +159,36 @@ def compare_steady(base: dict, cand: dict, threshold: float = 0.25):
     return rows, regressions
 
 
+def load_doc(path: str) -> tuple[dict, bool]:
+    """Load one input; returns (document, is_journal). A JSONL event
+    journal is detected by its per-line records and converted to a
+    ``{"slo": <span-derived distributions>}`` document via
+    tools/journal_view.py."""
+    with open(path) as f:
+        raw = f.read()
+    try:
+        return json.loads(raw), False
+    except json.JSONDecodeError:
+        pass
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        "journal_view", pathlib.Path(__file__).parent / "journal_view.py")
+    jv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(jv)
+    events = jv.load_events(raw)
+    if not events:
+        raise ValueError(f"{path}: neither JSON document nor event journal")
+    return {"slo": jv.journal_slo(events)}, True
+
+
 def main(argv: list[str]) -> int:
     args = [a for a in argv if not a.startswith("--")]
     if len(args) != 2:
         print(__doc__, file=sys.stderr)
         return 2
     threshold = 0.25
-    fields = DEFAULT_FIELDS
+    fields = None
     if "--threshold" in argv:
         threshold = float(argv[argv.index("--threshold") + 1])
         args = [a for a in args
@@ -160,10 +198,13 @@ def main(argv: list[str]) -> int:
         fields = tuple(f.strip() for f in raw.split(",") if f.strip())
         args = [a for a in args if a != raw]
     base_path, cand_path = args[:2]
-    with open(base_path) as f:
-        base_doc = json.load(f)
-    with open(cand_path) as f:
-        cand_doc = json.load(f)
+    base_doc, base_journal = load_doc(base_path)
+    cand_doc, cand_journal = load_doc(cand_path)
+    if fields is None:
+        # journal inputs gate their span-derived fields alongside the
+        # campaign distributions (a mixed pair compares whatever both carry)
+        fields = (DEFAULT_FIELDS + JOURNAL_FIELDS
+                  if (base_journal or cand_journal) else DEFAULT_FIELDS)
     rows: list = []
     regressions: list = []
     compared = False

@@ -9,6 +9,13 @@ Input (file path or ``-`` for stdin), any of:
 Usage:
   tools/trace_view.py TRACES.json [--last] [--width 48]
 
+Span mode: when the document carries causal spans instead of round traces —
+a ``/state?substates=TRACES`` response, an EventJournal JSONL file, or a
+campaign episode's ``journal`` slice — the spans are rendered as indented
+trace trees (kind:name, [t0..t1] extent, attrs). ``tools/journal_view.py``
+is the full-featured viewer (Perfetto export, SLOs); this mode is the quick
+look.
+
 Per trace it prints the round header (operation, wall, sampling/sync split,
 compiles, device bytes) and a per-goal table with bars: bar length tracks
 ``duration_s`` when the trace carries honest per-goal seconds
@@ -131,6 +138,25 @@ def render(trace: dict, width: int = 48) -> str:
     return "\n".join(lines)
 
 
+def render_span_trees(raw: str) -> str | None:
+    """Span mode: render causal trace trees when the input carries spans
+    (journal JSONL / TRACES substate / episode journal slice) — delegates
+    parsing + tree building to tools/journal_view.py's shared helpers."""
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        "journal_view", pathlib.Path(__file__).parent / "journal_view.py")
+    jv = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(jv)
+    events = jv.load_events(raw)
+    spans = jv.spans_of(events)
+    if not spans:
+        return None
+    from cruise_control_tpu.common.tracing import build_trace_trees
+    trees = build_trace_trees(spans)
+    return "\n".join(jv.render_tree(t, events) for t in trees)
+
+
 def main(argv: list[str]) -> int:
     args = [a for a in argv if not a.startswith("--")]
     width = 48
@@ -158,6 +184,12 @@ def main(argv: list[str]) -> int:
         traces = _collect(doc)
         if traces:
             break
+    if not traces:
+        # span mode: journals / TRACES substates carry spans, not rounds
+        spans_out = render_span_trees(raw)
+        if spans_out is not None:
+            print(spans_out)
+            return 0
     if not parsed_any:
         print("no parseable JSON document found", file=sys.stderr)
         return 1
