@@ -104,6 +104,7 @@ RUNG_COST_EST = {
     "e2e7k": (1600, 760),
     "scenario": (150, 60),
     "campaign": (300, 120),
+    "fleet": (300, 120),
 }
 
 
@@ -155,6 +156,7 @@ class Summary:
         self.headline: dict | None = None
         self.scenario: dict | None = None   # self-healing closed-loop latency
         self.campaign: dict | None = None   # chaos-campaign SLO distributions
+        self.fleet: dict | None = None      # batched multi-tenant figures
         self.headline_requested = True      # set from the requested rung list
 
     def emit(self, final: bool = False) -> None:
@@ -178,6 +180,10 @@ class Summary:
                           f"({self.campaign['name']}, "
                           f"{self.campaign['num_episodes']} episodes)")
                 value = self.campaign["wall_s"]
+            elif self.fleet is not None:
+                metric = (f"fleet batched round wall-clock "
+                          f"({self.fleet['tenants']} tenants, one launch)")
+                value = self.fleet["batched_warm_s"]
             elif ran:
                 metric = f"rebalance proposal wall-clock @ {ran[0]['config']}"
                 value = ran[0].get("wall_s")
@@ -205,6 +211,10 @@ class Summary:
             # chaos-campaign block (sim/campaign.py): per-fault-type SLO
             # distributions (p50/p95/max, SIMULATED ms) + verifier verdicts
             out["campaign"] = self.campaign
+        if self.fleet is not None:
+            # fleet block (cruise_control_tpu/fleet.py --fleet N): batched
+            # wall vs sum-of-solo, launches/round, parity, staleness, bytes
+            out["fleet"] = self.fleet
         # pretty block first (humans + trace_view's whole-file parse of
         # BENCH_partial.json), then ONE compact machine-parseable line —
         # always the last stdout line, small enough that the driver's tail
@@ -447,6 +457,19 @@ def main() -> None:
         i = argv.index("--campaign-seed")
         campaign_seed = int(argv[i + 1])
         argv = argv[:i] + argv[i + 2:]
+    fleet_tenants = 4
+    if "--fleet" in argv:
+        # --fleet [N]: run the batched multi-tenant rung — N same-bucket
+        # tenant clusters optimized in ONE vmapped launch per round
+        # (cruise_control_tpu/fleet.py), A/B'd against N solo warm rounds
+        i = argv.index("--fleet")
+        if i + 1 < len(argv) and not argv[i + 1].startswith("--") \
+                and argv[i + 1].isdigit():
+            fleet_tenants = int(argv[i + 1])
+            argv = argv[:i] + argv[i + 2:]
+        else:
+            argv = argv[:i] + argv[i + 1:]
+        argv.append("fleet")
     fuzz_seed = None
     if "--fuzz" in argv:
         # --fuzz [N]: run the campaign episodes with the REST fuzzer +
@@ -597,6 +620,11 @@ def main() -> None:
             rung = run_campaign_rung(campaign_name, campaign_seed,
                                      fuzz_seed=fuzz_seed)
 
+        elif rung_id == "fleet":
+            # batched multi-tenant rung: N tenants, one vmapped launch per
+            # round; batched wall vs sum-of-solo, parity, staleness, bytes
+            rung = run_fleet_rung(fleet_tenants)
+
         elif rung_id == "e2e7k":
             # the full monitor path at HEADLINE scale: backend -> samples ->
             # windows -> ClusterTensor at 7,000 brokers / 500k partitions /
@@ -611,6 +639,144 @@ def main() -> None:
 
     log(f"total bench time {time.monotonic() - T_START:.1f}s")
     SUMMARY.emit(final=True)
+
+
+def run_fleet_rung(n_tenants: int = 4, num_brokers: int = 16,
+                   num_partitions: int = 800, rf: int = 2) -> dict:
+    """Batched multi-tenant rung (--fleet N): N same-shape-bucket tenant
+    clusters on one device. Measures the fleet contract end to end:
+
+    - N solo warm rounds (one optimizer launch chain per tenant) vs the
+      SAME windows optimized in ONE vmapped launch (FleetScheduler round);
+    - per-tenant violation/certificate/proposal SET PARITY between the two;
+    - launches/round == #buckets (1 here — every tenant shares the bucket);
+    - a steady second batched round: delta-mode syncs, zero new compiles;
+    - per-tenant proposal-cache staleness p95 across rounds;
+    - fleet device bytes vs the configured budget + spill/readmit counts.
+    """
+    from cruise_control_tpu.app import CruiseControl
+    from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+    from cruise_control_tpu.config.defaults import cruise_control_config
+    from cruise_control_tpu.fleet import FleetScheduler
+
+    log(f"rung fleet: {n_tenants} tenants x {num_brokers}b/"
+        f"{num_partitions}p rf={rf}, one vmapped launch per round")
+    t0 = time.monotonic()
+
+    def tenant_backend(seed: int):
+        rng = np.random.default_rng(seed)
+        be = SimulatedClusterBackend()
+        for b in range(num_brokers):
+            be.add_broker(b, f"r{b % 4}")
+        for p in range(num_partitions):
+            reps = [int(x) for x in
+                    rng.choice(num_brokers, size=rf, replace=False)]
+            be.create_partition(f"t{p % 12}", p, reps,
+                                size_mb=float(rng.uniform(10, 500)),
+                                bytes_in_rate=float(rng.uniform(1, 50)),
+                                bytes_out_rate=float(rng.uniform(1, 100)),
+                                cpu_util=float(rng.uniform(0.1, 5)))
+        return be
+
+    def cfg():
+        return cruise_control_config(
+            {"anomaly.detection.interval.ms": 10_000_000})
+
+    def sample(cc, lo, hi):
+        for i in range(lo, hi):
+            cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+
+    fleet = FleetScheduler(config=cfg())
+    for k in range(n_tenants):
+        t = fleet.add_tenant(f"tenant-{k}", backend=tenant_backend(100 + k),
+                             config=cfg())
+        sample(t.cc, 0, 6)
+
+    def goal_sets(res):
+        return (
+            sorted(g.name for g in res.goal_results if g.violated_after),
+            sorted((g.name, g.fixpoint_proven, g.moves_remaining,
+                    g.leads_remaining, g.swap_window_remaining)
+                   for g in res.goal_results),
+            sorted((p.topic, p.partition, p.new_leader, p.new_replicas)
+                   for p in res.proposals))
+
+    # ---- solo half: warm each tenant, then time one solo round apiece ----
+    tenants = list(fleet.tenants.values())
+    solo_sets = []
+    solo_walls = []
+    for t in tenants:
+        t.session.sync()
+        # warm run: pays the per-goal program compiles once
+        fleet.optimizer.optimizations(None, None, raise_on_failure=False,
+                                      session=t.session)
+        t.session.sync()
+        ts = time.monotonic()
+        res = fleet.optimizer.optimizations(None, None,
+                                            raise_on_failure=False,
+                                            session=t.session)
+        solo_walls.append(time.monotonic() - ts)
+        solo_sets.append(goal_sets(res))
+    sum_solo_s = sum(solo_walls)
+
+    # ---- batched half: round 1 pays the vmapped-chain compile, round 2 is
+    # the steady measurement (same windows as the solo runs: the session
+    # memo re-syncs without new samples, so parity is exact) ----
+    r1 = fleet.run_round(now_ms=2_000_000.0)
+    cold_batched_s = time.monotonic() - t0
+    parity = all(
+        goal_sets(fleet.app_for(t.cluster_id).cached_proposals()) == ref
+        for t, ref in zip(tenants, solo_sets))
+    for t in tenants:
+        sample(t.cc, 6, 7)
+    with count_compiles() as cc_count:
+        ts = time.monotonic()
+        r2 = fleet.run_round(now_ms=2_300_000.0)
+        batched_warm_s = time.monotonic() - ts
+    steady_modes = [t.session.last_sync_info.get("mode") for t in tenants]
+
+    # a couple more sampled rounds so the staleness distribution has mass
+    for i in (7, 8):
+        for t in tenants:
+            sample(t.cc, i, i + 1)
+        fleet.run_round(now_ms=(2_300_000.0 + (i - 6) * 300_000.0))
+
+    # ---- memory budget: force one spill + readmit, prove the accounting --
+    bytes_resident = fleet.device_bytes()
+    fleet.memory_budget_bytes = max(bytes_resident - 1, 1)
+    spilled = fleet.enforce_memory_budget()
+    fleet.memory_budget_bytes = -1
+    for cid in spilled:
+        fleet.tenants[cid].session.readmit()
+
+    rung = {
+        "config": f"fleet-{n_tenants}x{num_brokers}b-{num_partitions}p",
+        "tenants": n_tenants,
+        "buckets": len(r1["buckets"]),
+        "launches_per_round": r2["launches"],
+        "sum_solo_warm_s": round(sum_solo_s, 3),
+        "batched_warm_s": round(batched_warm_s, 3),
+        "batched_speedup": round(sum_solo_s / max(batched_warm_s, 1e-9), 3),
+        "cold_batched_s": round(cold_batched_s, 3),
+        "parity_identical_sets": parity,
+        "steady_new_compiles": cc_count.count,
+        "steady_sync_modes": steady_modes,
+        "staleness_p95_ms": {t.cluster_id: t.staleness_p95_ms()
+                             for t in tenants},
+        "fleet_device_bytes": bytes_resident,
+        "budget_bytes": fleet.memory_budget_bytes,
+        "spills": len(spilled),
+        "readmits": sum(t.session.readmits for t in tenants),
+        "wall_s": round(time.monotonic() - t0, 2),
+    }
+    SUMMARY.fleet = dict(rung)
+    fleet.shutdown()
+    if not parity:
+        log("fleet rung: PARITY LOSS between batched and solo sets")
+    log(f"fleet rung: batched {batched_warm_s:.2f}s vs sum-of-solo "
+        f"{sum_solo_s:.2f}s, launches/round={r2['launches']}, "
+        f"steady compiles={cc_count.count}, parity={parity}")
+    return rung
 
 
 def run_scenario_rung(name: str) -> dict:

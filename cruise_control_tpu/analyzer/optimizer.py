@@ -116,6 +116,10 @@ class GoalResult:
     # cross-segment boundary rows re-validated by the budgeted admission
     finisher_segments: int = 0
     finisher_boundary: int = 0
+    # certificate-driven budget escalation (PR 13): how many times this
+    # goal's finisher was re-entered with widened windows after exiting
+    # violated-unproven with a small remaining-action count
+    escalations: int = 0
 
 
 @dataclasses.dataclass
@@ -159,6 +163,8 @@ class OptimizerResult:
         for g, entry in zip(self.goal_results, out["goalSummary"]):
             entry["iterations"] = g.iterations
             entry["budgetExhausted"] = g.hit_max_iters
+            if g.escalations:
+                entry["escalations"] = g.escalations
             if g.violated_after:
                 entry["fixpointProven"] = g.fixpoint_proven
                 if g.moves_remaining >= 0:
@@ -327,6 +333,20 @@ class GoalOptimizer:
         # device env/state (model/cluster_tensor.py compact policy)
         self._compact_tables = (config.get_boolean("analyzer.compact.tables")
                                 if config is not None else True)
+        # analyzer.finisher.escalation.*: certificate-driven budget
+        # escalation for the persistent violated-unproven tails — a goal
+        # whose finisher exits with a SMALL remaining-action count gets its
+        # finisher re-entered once, at the end of the chain, with widened
+        # windows (finisher_rounds/swap passes x factor) and EVERY other
+        # goal's acceptance veto in force, instead of returning the budget
+        self._escalation = (config.get_boolean("analyzer.finisher.escalation")
+                            if config is not None else True)
+        self._escalation_max_remaining = (
+            config.get_int("analyzer.finisher.escalation.max.remaining")
+            if config is not None else 2048)
+        self._escalation_factor = (
+            config.get_int("analyzer.finisher.escalation.factor")
+            if config is not None else 4)
         self._balancedness_priority_weight = (
             config.get_double("goal.balancedness.priority.weight")
             if config is not None else BALANCEDNESS_PRIORITY_WEIGHT)
@@ -394,6 +414,75 @@ class GoalOptimizer:
                           "partitions": ct.num_partitions,
                           "topics": ct.num_topics},
                 "goals": list(goal_names or self._default_goal_names)}
+
+    def scaled_params(self, num_replicas: int, num_brokers: int) -> EngineParams:
+        """Per-cluster engine-parameter scaling, resolved from the PADDED
+        shape bucket alone — the solo path and the fleet's batched launch
+        share this method, which is what makes batched results bit-identical
+        to solo runs (same bucket => same params => same compiled loops).
+
+        Scale the candidate set with cluster size: a wave lands up to K
+        moves, so K ~ B/4 keeps pass count (and wall clock) roughly flat;
+        candidate selection is an approx_max_k partial reduction, so a
+        larger K costs [K, B] scoring, not a bigger sort."""
+        return dataclasses.replace(
+            self._params,
+            # K scales with brokers AND replicas: at small B with many
+            # replicas, a B-derived K leaves most of the eligible set
+            # unexplored (search holes the plateau-fixpoint test measures)
+            # cap 1760: K=2048 move-branch programs reproducibly
+            # kernel-fault the TPU runtime at 1M-replica shapes (same
+            # failure mode as the swap-pool >=220 fault; 1760 is the
+            # largest bisect-proven-safe pool)
+            num_candidates=min(1760, max(self._params.num_candidates,
+                                         num_brokers // 4,
+                                         num_replicas // 64)),
+            num_leader_candidates=min(1024, max(self._params.num_leader_candidates,
+                                                num_brokers // 8)),
+            # swaps are the stall-breaking last resort: the [K1, K2] pair
+            # scoring is quadratic, so grow the pool sub-linearly (the
+            # TPU-fault hard clamp lives in engine._swap_branch_batched)
+            num_swap_candidates=max(self._params.num_swap_candidates,
+                                    num_brokers // 32),
+            # destination-affinity classes scale with broker count: at 7k
+            # brokers T=16 collapses the wave's destination variety (rung-4
+            # A/B: T=64 was 21% faster AND left one fewer goal violated)
+            num_dst_choices=min(128, max(self._params.num_dst_choices,
+                                         num_brokers // 100)),
+            # exploration budgets scale with how CHEAP a pass is: per-pass
+            # cost is ~linear in R, so smaller clusters afford far deeper
+            # stall/dribble tails. Measured at 100k replicas: 1024/32
+            # converts four more soft goals (10 -> 3 violated) for ~6 s;
+            # at 1M replicas tripling the tail bought nothing (PERF.md), so
+            # the headline rung keeps the lean 64/8.
+            tail_pass_budget=min(
+                1024,
+                self._params.tail_pass_budget * _budget_scale(num_replicas) ** 2),
+            stall_retries=min(
+                32, self._params.stall_retries * _budget_scale(num_replicas)),
+            # multi-wave passes engage where the O(R) per-pass keying is
+            # worth amortizing: at >= 256k replicas each budgeted pass runs
+            # up to max_pass_waves rank-banded admission waves off ONE
+            # keying + selection (engine._move_branch_batched). pass_waves
+            # is a TRACED leaf — this scaling never forces a recompile.
+            pass_waves=min(max(1, self._params.max_pass_waves),
+                           max(self._params.pass_waves,
+                               4 if num_replicas >= 262_144 else 1)),
+            # small clusters skip the finisher subprogram entirely
+            # (analyzer.finisher.min.replicas): the plateau-fixpoint proof
+            # covers certificates there, and the subprogram multiplies the
+            # small-fixture compile population's cost
+            finisher_rounds=(0 if (self._finisher_min_replicas >= 0
+                                   and num_replicas
+                                   < self._finisher_min_replicas)
+                             else self._params.finisher_rounds),
+            # precision policy: see _resolve_compute_dtype — "auto" now
+            # resolves to bfloat16 at >= 256k replicas (compensated
+            # accounting + the segment-parallel finisher closed the rung-4
+            # violation gap that held it back, docs/PERF.md round 9)
+            compute_dtype=_resolve_compute_dtype(
+                self._params.compute_dtype, self._compute_dtype,
+                num_replicas))
 
     def optimizations(self, ct: ClusterTensor | None, meta: ClusterMeta | None = None,
                       goal_names: list[str] | None = None,
@@ -497,68 +586,7 @@ class GoalOptimizer:
             ct, meta = pad_cluster(ct, meta)
             num_replicas = ct.num_replicas
             num_brokers = ct.num_brokers
-        # scale the candidate set with cluster size: a wave lands up to K
-        # moves, so K ~ B/4 keeps pass count (and wall clock) roughly flat;
-        # candidate selection is an approx_max_k partial reduction, so a
-        # larger K costs [K, B] scoring, not a bigger sort
-        params = dataclasses.replace(
-            self._params,
-            # K scales with brokers AND replicas: at small B with many
-            # replicas, a B-derived K leaves most of the eligible set
-            # unexplored (search holes the plateau-fixpoint test measures)
-            # cap 1760: K=2048 move-branch programs reproducibly
-            # kernel-fault the TPU runtime at 1M-replica shapes (same
-            # failure mode as the swap-pool >=220 fault; 1760 is the
-            # largest bisect-proven-safe pool)
-            num_candidates=min(1760, max(self._params.num_candidates,
-                                         num_brokers // 4,
-                                         num_replicas // 64)),
-            num_leader_candidates=min(1024, max(self._params.num_leader_candidates,
-                                                num_brokers // 8)),
-            # swaps are the stall-breaking last resort: the [K1, K2] pair
-            # scoring is quadratic, so grow the pool sub-linearly (the
-            # TPU-fault hard clamp lives in engine._swap_branch_batched)
-            num_swap_candidates=max(self._params.num_swap_candidates,
-                                    num_brokers // 32),
-            # destination-affinity classes scale with broker count: at 7k
-            # brokers T=16 collapses the wave's destination variety (rung-4
-            # A/B: T=64 was 21% faster AND left one fewer goal violated)
-            num_dst_choices=min(128, max(self._params.num_dst_choices,
-                                         num_brokers // 100)),
-            # exploration budgets scale with how CHEAP a pass is: per-pass
-            # cost is ~linear in R, so smaller clusters afford far deeper
-            # stall/dribble tails. Measured at 100k replicas: 1024/32
-            # converts four more soft goals (10 -> 3 violated) for ~6 s;
-            # at 1M replicas tripling the tail bought nothing (PERF.md), so
-            # the headline rung keeps the lean 64/8.
-            tail_pass_budget=min(
-                1024,
-                self._params.tail_pass_budget * _budget_scale(num_replicas) ** 2),
-            stall_retries=min(
-                32, self._params.stall_retries * _budget_scale(num_replicas)),
-            # multi-wave passes engage where the O(R) per-pass keying is
-            # worth amortizing: at >= 256k replicas each budgeted pass runs
-            # up to max_pass_waves rank-banded admission waves off ONE
-            # keying + selection (engine._move_branch_batched). pass_waves
-            # is a TRACED leaf — this scaling never forces a recompile.
-            pass_waves=min(max(1, self._params.max_pass_waves),
-                           max(self._params.pass_waves,
-                               4 if num_replicas >= 262_144 else 1)),
-            # small clusters skip the finisher subprogram entirely
-            # (analyzer.finisher.min.replicas): the plateau-fixpoint proof
-            # covers certificates there, and the subprogram multiplies the
-            # small-fixture compile population's cost
-            finisher_rounds=(0 if (self._finisher_min_replicas >= 0
-                                   and num_replicas
-                                   < self._finisher_min_replicas)
-                             else self._params.finisher_rounds),
-            # precision policy: see _resolve_compute_dtype — "auto" now
-            # resolves to bfloat16 at >= 256k replicas (compensated
-            # accounting + the segment-parallel finisher closed the rung-4
-            # violation gap that held it back, docs/PERF.md round 9)
-            compute_dtype=_resolve_compute_dtype(
-                self._params.compute_dtype, self._compute_dtype,
-                num_replicas))
+        params = self.scaled_params(num_replicas, num_brokers)
         if session is not None and getattr(session, "mesh", None) is not None:
             # shard-aware resident session: the resident env/state are
             # already mesh-placed (replicated) — thread the session's mesh
@@ -757,6 +785,16 @@ class GoalOptimizer:
         else:
             stats_after = cluster_stats_state(env, st)
             pb, plead, pdisk, data_mb = jax.device_get(_pack_final(env, st))
+        # certificate-driven budget escalation: goals that exited violated-
+        # unproven with a small remaining-action count re-enter their
+        # finisher with widened windows (and EVERY other goal's acceptance
+        # veto in force, so no other goal can regress); the packed final
+        # assignment and stats are recomputed only when something escalated
+        st_esc = self._escalate_unproven(env, st, goals, goal_results, params)
+        if st_esc is not None:
+            st = st_esc
+            stats_after = cluster_stats_state(env, st)
+            pb, plead, pdisk, data_mb = jax.device_get(_pack_final(env, st))
         R = env.num_replicas
         final_broker = np.asarray(pb, np.int32)
         final_leader = np.unpackbits(plead)[:R].astype(bool)
@@ -832,6 +870,306 @@ class GoalOptimizer:
                     f"[{rec.status.value}: {rec.reason}]",
                     recommendation=rec, result=result)
         return result
+
+    # ------------------------------------------------- budget escalation
+    def _escalate_unproven(self, env, st, goals, goal_results, params):
+        """Certificate-driven budget escalation (the BENCH_r05 Leader*/
+        LeaderBytesIn tail closer): a goal whose budgeted loop AND finisher
+        exited still-violated WITHOUT a fixpoint certificate, but with a
+        small remaining-action count (the scans measured < max.remaining
+        accepted positive-gain actions left), re-enters its finisher ONCE at
+        the end of the chain with widened windows — finisher_rounds and
+        finisher_swap_passes multiplied by the escalation factor, the
+        budgeted loop skipped outright (max_iters=0), and EVERY other chain
+        goal's acceptance veto in force, so no previously-optimized (or
+        later) goal can regress: outcome parity is one-sided by construction
+        (violation sets only shrink, certificates only appear). Returns the
+        escalated state, or None when nothing escalated (the caller then
+        keeps the already-packed results — escalation OFF or not-triggered
+        is bit-identical to the pre-escalation pipeline)."""
+        if not self._escalation or params.finisher_rounds <= 0:
+            return None
+        by_name = {g.name: g for g in goals}
+        candidates = []
+        for r in goal_results:
+            g = by_name.get(r.name)
+            if g is None or not r.violated_after or r.fixpoint_proven:
+                continue
+            if r.moves_remaining < 0 and r.leads_remaining < 0:
+                continue          # finisher never ran — nothing measured
+            remaining = (max(r.moves_remaining, 0) + max(r.leads_remaining, 0)
+                         + max(r.swap_window_remaining, 0))
+            if remaining > self._escalation_max_remaining:
+                continue
+            candidates.append((r, g))
+        if not candidates:
+            return None
+        factor = max(self._escalation_factor, 1)
+        esc_params = dataclasses.replace(
+            params, max_iters=0, stall_retries=0, tail_pass_budget=0,
+            tail_total_budget=0, sat_stall_retries=0, sat_tail_passes=0,
+            finisher_rounds=params.finisher_rounds * factor,
+            finisher_swap_passes=params.finisher_swap_passes * factor)
+        from cruise_control_tpu.common.sensors import OPERATION_LOGGER
+        for r, g in candidates:
+            prev = tuple(x for x in goals if x.name != r.name)
+            st, info = optimize_goal(env, st, g, prev, esc_params)
+            info = jax.device_get(info)
+            r.escalations += 1
+            r.violated_after = bool(info["violated_after"])
+            r.fixpoint_proven = bool(info["fixpoint_proven"])
+            r.hit_max_iters = r.violated_after and not r.fixpoint_proven
+            r.moves_remaining = int(info["moves_remaining"])
+            r.leads_remaining = int(info["leads_remaining"])
+            r.swap_window_remaining = int(info["swap_window_remaining"])
+            r.iterations += int(info["iterations"])
+            r.finisher_rounds += int(info["finisher_rounds"])
+            r.finisher_actions += int(info["finisher_actions"])
+            r.stat_after = float(info["stat"])
+            OPERATION_LOGGER.info(
+                "finisher escalation: %s re-entered with widened windows "
+                "(violated=%s proven=%s remaining=%d/%d/%d)", r.name,
+                r.violated_after, r.fixpoint_proven, r.moves_remaining,
+                r.leads_remaining, r.swap_window_remaining)
+        # escalated actions rode every goal's veto, so flags can only
+        # improve — refresh them all against the escalated state
+        viol = jax.device_get(_compiled_violations(tuple(goals))(env, st))
+        fresh = {g.name: bool(v) for g, v in zip(goals, viol)}
+        for r in goal_results:
+            if r.name in fresh and r.violated_after and not fresh[r.name]:
+                r.violated_after = False
+                r.hit_max_iters = False
+        return st
+
+    # ----------------------------------------------- fleet batched launch
+    def optimizations_batched(self, sessions: list, goal_names=None,
+                              options: OptimizationOptions = OptimizationOptions(),
+                              raise_on_failure: bool = False) -> list:
+        """ONE vmapped engine launch over K same-bucket resident sessions
+        (fleet mode, SURVEY §2.10's one-controller-per-cluster lifted): the
+        tenants' padded ``ClusterEnv``/``EngineState`` pytrees stack along a
+        leading tenant axis and the whole goal chain — per-goal loops with
+        finishers, the optional PreferredLeaderElection pass, before/after
+        stats and the packed final-assignment fetch — runs as a single
+        compiled program per (goal chain, shape bucket, K). Per-tenant
+        verdicts, certificates and proposal sets are BIT-IDENTICAL to K solo
+        runs (vmap preserves per-element semantics; the engine params come
+        from the same ``scaled_params`` resolution — certified in
+        tests/test_fleet.py). Sessions must be synced by the caller and
+        share one shape bucket; returns one ``OptimizerResult`` per session,
+        in order. Sessions ride their normal donation protocol (the stack
+        copies, the resident buffers are released, the next sync
+        rematerializes from host mirrors)."""
+        with self._proposal_timer.time():
+            return self._optimizations_batched(sessions, goal_names, options,
+                                               raise_on_failure)
+
+    def _optimizations_batched(self, sessions, goal_names, options,
+                               raise_on_failure) -> list:
+        t_round = time.monotonic()
+        opt_gen = self.recorder.note_optimize_start()
+        compiles0 = self._compile_listener.count
+        names = goal_names or self._default_goal_names
+        known = [n for n in names if n != "PreferredLeaderElectionGoal"]
+        goals = make_goals(known, self._constraint, options)
+        run_preferred = "PreferredLeaderElectionGoal" in names
+        ple = (PreferredLeaderElectionGoal(constraint=self._constraint,
+                                           options=options)
+               if run_preferred else None)
+
+        inputs = [s.optimizer_inputs() for s in sessions]
+        envs = [i[0] for i in inputs]
+        sts = [i[1] for i in inputs]
+        shape0 = jax.tree_util.tree_map(lambda a: (a.shape, a.dtype), envs[0])
+        for e in envs[1:]:
+            if jax.tree_util.tree_map(lambda a: (a.shape, a.dtype), e) != shape0:
+                raise ValueError(
+                    "optimizations_batched requires same-shape-bucket "
+                    "sessions (stack the fleet by bucket first)")
+        if any(getattr(s, "mesh", None) is not None for s in sessions):
+            raise ValueError("fleet batching requires single-device "
+                             "sessions (no shard-explicit mesh)")
+        num_replicas = envs[0].num_replicas
+        num_brokers = envs[0].num_brokers
+        params = self.scaled_params(num_replicas, num_brokers)
+
+        # stack along the leading tenant axis — ONE compiled program per
+        # (treedef, K) instead of ~2 eager dispatches per leaf, so the
+        # stacking overhead never eats the launch amortization the batch
+        # exists for; steady fleet rounds add zero compiles
+        env_b = _compiled_stack(len(envs))(*envs)
+        st_b = _compiled_stack(len(sts))(*sts)
+        fn = _compiled_fleet_chain(tuple(type(g) for g in goals),
+                                   tuple(goals), ple)
+        st_b, out = fn(env_b, st_b, params)
+        out = jax.device_get(out)
+
+        results = []
+        for i, (session, inp) in enumerate(zip(sessions, inputs)):
+            (env, _st0, meta, part_table, initial_broker, initial_leader,
+             initial_disk, host_valid, host_part) = inp
+            st_i = jax.tree_util.tree_map(lambda leaf: leaf[i], st_b)
+            infos = [{k: v[i] for k, v in info.items()}
+                     for info in out["infos"]]
+            violated_before = {g.name: bool(v[i])
+                               for g, v in zip(goals, out["viol_before"])}
+            goal_results = [
+                GoalResult(
+                    name=g.name,
+                    violated_before=violated_before[g.name],
+                    violated_after=bool(info["violated_after"]),
+                    iterations=int(info["iterations"]),
+                    duration_s=0.0,
+                    stat_after=float(info["stat"]),
+                    hit_max_iters=bool(info.get("hit_max_iters", False)),
+                    passes=int(info.get("passes", 0)),
+                    stat_before=float(info.get("stat_before", 0.0)),
+                    fixpoint_proven=bool(info.get("fixpoint_proven", False)),
+                    moves_remaining=int(info.get("moves_remaining", -1)),
+                    leads_remaining=int(info.get("leads_remaining", -1)),
+                    swap_window_remaining=int(
+                        info.get("swap_window_remaining", -1)),
+                    finisher_rounds=int(info.get("finisher_rounds", 0)),
+                    plateau_exit=bool(info.get("plateau_exit", False)),
+                    move_actions=int(info.get("move_actions", 0)),
+                    lead_actions=int(info.get("lead_actions", 0)),
+                    swap_actions=int(info.get("swap_actions", 0)),
+                    disk_actions=int(info.get("disk_actions", 0)),
+                    move_waves=int(info.get("move_waves", 0)),
+                    finisher_actions=int(info.get("finisher_actions", 0)),
+                    finisher_segments=int(info.get("finisher_segments", 0)),
+                    finisher_boundary=int(info.get("finisher_boundary", 0)),
+                )
+                for g, info in zip(goals, infos)
+            ]
+            if run_preferred:
+                goal_results.append(GoalResult(
+                    name="PreferredLeaderElectionGoal",
+                    violated_before=bool(out["ple_was"][i]),
+                    violated_after=bool(out["ple_still"][i]),
+                    iterations=1 if bool(out["ple_was"][i]) else 0,
+                    duration_s=0.0, stat_after=0.0))
+            stats_before = _stats_to_json(
+                jax.tree_util.tree_map(lambda leaf: leaf[i],
+                                       out["stats_before"]))
+            stats_after = _stats_to_json(
+                jax.tree_util.tree_map(lambda leaf: leaf[i],
+                                       out["stats_after"]))
+            pb, plead, pdisk, data_mb = (leaf[i] for leaf in out["packed"])
+            # the same post-chain escalation the solo path runs — per-tenant
+            # programs, only for tails the batched finisher left unproven,
+            # so batched-vs-solo parity survives escalation too
+            st_esc = self._escalate_unproven(env, st_i, goals, goal_results,
+                                             params)
+            if st_esc is not None:
+                st_i = st_esc
+                stats_after = cluster_stats_state(env, st_i)
+                pb, plead, pdisk, data_mb = jax.device_get(
+                    _pack_final(env, st_i))
+            R = env.num_replicas
+            final_broker = np.asarray(pb, np.int32)
+            final_leader = np.unpackbits(np.asarray(plead))[:R].astype(bool)
+            final_disk = np.asarray(pdisk, np.int32)
+            proposals = diff_proposals(
+                env, meta, initial_broker, initial_leader, initial_disk, st_i,
+                final=(final_broker, final_leader, final_disk),
+                host_statics=(part_table, host_valid, host_part))
+            viol_after = {g.name: g.violated_after for g in goal_results}
+            result = OptimizerResult(
+                goal_results=goal_results, proposals=proposals,
+                stats_before=stats_before, stats_after=stats_after,
+                balancedness_before=_balancedness(
+                    goals, violated_before,
+                    self._balancedness_priority_weight,
+                    self._balancedness_strictness_weight),
+                balancedness_after=_balancedness(
+                    goals, viol_after, self._balancedness_priority_weight,
+                    self._balancedness_strictness_weight),
+                num_replica_movements=proposals.num_replica_additions,
+                num_leadership_movements=proposals.num_leadership_changes,
+                data_to_move_mb=float(data_mb),
+            )
+            result.final_state = st_i
+            result.env = env
+            result.meta = meta
+            result.round_trace = None     # one fleet trace below, not K
+            results.append(result)
+            if raise_on_failure:
+                failed = [r.name for r, g in zip(goal_results, goals)
+                          if g.is_hard and r.violated_after]
+                if failed:
+                    raise OptimizationFailureError(
+                        f"hard goal(s) not satisfiable for tenant {i}: "
+                        f"{failed}", result=result)
+
+        # ONE RoundTrace for the whole launch (the fleet's unit of work):
+        # tenant-0's per-goal profile as the representative rows, proposal
+        # counts summed, session info marking the batch
+        trace = self.recorder.record_round(
+            wall_s=time.monotonic() - t_round,
+            goal_results=results[0].goal_results,
+            compiles=self._compile_listener.count - compiles0,
+            env=env_b, state=st_b,
+            num_proposals=sum(len(r.proposals) for r in results),
+            num_replica_movements=sum(r.num_replica_movements
+                                      for r in results),
+            num_leadership_movements=sum(r.num_leadership_movements
+                                         for r in results),
+            session_info={"mode": "fleet", "tenants": len(sessions)},
+            donated=all(bool(getattr(s, "_donation", False))
+                        for s in sessions),
+            profile_level=self._profile_level,
+            durations_measured=False,
+            opt_generation=opt_gen)
+        for r in results:
+            r.round_trace = trace
+        return results
+
+
+@lru_cache(maxsize=16)
+def _compiled_stack(n: int):
+    """One jitted leading-axis stack over n same-shape pytrees."""
+    @jax.jit
+    def run(*trees):
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+    return run
+
+
+@lru_cache(maxsize=32)
+def _compiled_fleet_chain(goal_classes: tuple, goals: tuple, ple):
+    """The fleet's one-launch-per-bucket program: the COMPLETE per-tenant
+    chain — every goal's ``_goal_loop`` (finisher included), the optional
+    PreferredLeaderElection pass, before/after stats and the packed final
+    fetch — vmapped over the leading tenant axis of the stacked env/state
+    pytrees. Each tenant's trajectory is computed exactly as K solo runs
+    would (vmap's per-element semantics; certified bit-identical in
+    tests/test_fleet.py); EngineParams broadcasts (in_axes=None) so budget
+    changes reuse the executable, and a new K compiles a new variant."""
+    from cruise_control_tpu.analyzer.engine import _goal_loop
+    del goal_classes  # cache key only
+
+    def one(env: ClusterEnv, st: EngineState, params: EngineParams):
+        out = {"stats_before": _stats_device(env, st),
+               "viol_before": [g.violated(env, st) for g in goals]}
+        infos = []
+        prev: tuple = ()
+        for g in goals:
+            st, info = _goal_loop(env, st, g, prev, params)
+            infos.append(info)
+            prev = prev + (g,)
+        if ple is not None:
+            out["ple_was"] = ple.violated(env, st)
+            st = ple.apply(env, st)
+            out["ple_still"] = ple.violated(env, st)
+        out["infos"] = infos
+        out["stats_after"] = _stats_device(env, st)
+        out["packed"] = _pack_final(env, st)
+        return st, out
+
+    # the stacked state is donated: it is a fresh copy made by the stack
+    # program that nothing else aliases, and at K tenants x 1M-replica
+    # buckets the saved duplicate is K x the PR 5 state footprint
+    return jax.jit(jax.vmap(one, in_axes=(0, 0, None)), donate_argnums=(1,))
 
 
 @lru_cache(maxsize=64)

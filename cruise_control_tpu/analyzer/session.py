@@ -250,6 +250,17 @@ class ResidentClusterSession:
         self.delta_rounds = 0
         self.donated_rounds = 0        # optimizer rounds served without a copy
         self.last_sync_info: dict = {}
+        # ---- fleet-mode spill/readmit (PR 13) ----
+        # a COLD tenant's resident device footprint can be reclaimed under
+        # the fleet's global memory budget: ``spill`` fetches the env to a
+        # host mirror and drops both device slots; the next ``sync`` (or an
+        # explicit ``readmit``) re-uploads the env and rematerializes the
+        # state through the SAME ``_sync_finalize`` program every sync runs
+        # — so a readmitted session is bit-identical to a never-spilled one
+        # and costs zero new XLA compiles within the epoch's shape bucket
+        self._spilled_env = None       # host (numpy) env pytree while spilled
+        self.spills = 0
+        self.readmits = 0
         # ---- pipelined-loop shadow slot (PR 11) ----
         # ``shadow_syncs`` counts syncs that ran while the resident state was
         # LENT to an in-flight optimize round (state is None at sync entry):
@@ -288,20 +299,40 @@ class ResidentClusterSession:
             if not agg.window_starts_ms:
                 raise NotEnoughValidWindowsError("0 valid windows < required 1")
             snap = mon._snapshot()
+            if self.env is None and self._spilled_env is not None:
+                # spilled tenant touched again: re-admit the resident slots
+                # from the host mirror, then take the normal delta path
+                self._readmit_locked()
             if self.env is None:
                 return self._rebuild("cold start", allow_capacity_estimation)
             # sync memo: unchanged (metadata, windows) since the last
-            # completed sync means the resident state already reflects the
+            # completed sync means the resident env already reflects the
             # observed cluster — skip the redundant metric re-upload (the
             # pipelined loop's optimize stage re-enters here right after the
             # sync stage ran; the blocking loop always sees a fresh
-            # aggregator generation and takes the full path). Only valid
-            # while the state is RESIDENT: a lent/donated state must be
-            # rematerialized by a real sync before the next round.
+            # aggregator generation and takes the full path). A state lent
+            # to (and donated by) the previous round is rematerialized from
+            # the host mirrors — bit-identical to the full refresh, since
+            # the [R, M] rows on the resident env ARE the rows the refresh
+            # would re-upload — WITHOUT advancing sync_generation: nothing
+            # new was observed, so the fleet's due-tenant logic (and the
+            # pipeline's optimize hand-off) must not see a fresh generation.
             key = (snap.generation, mon._partition_agg.generation)
-            if key == self._sync_key and self.state is not None:
-                info = dict(self.last_sync_info)
-                info["memo"] = True
+            if key == self._sync_key:
+                self._ensure_state()
+                # a memo IS a (trivially empty) delta round: report it as
+                # the cheap path, not as an echo of whatever the last real
+                # sync was (a memo right after an epoch rebuild must not
+                # read as a second rebuild)
+                info = {
+                    "mode": "delta",
+                    "epoch": self.epoch,
+                    "churn": 0,
+                    "cum_churn_fraction": round(
+                        self._cum_churn / max(self._epoch_replicas, 1), 4),
+                    "sync_s": round(time.monotonic() - t0, 4),
+                    "memo": True,
+                }
                 return info
             if self.state is None:
                 # shadow-slot path: the resident state is lent to an
@@ -367,7 +398,53 @@ class ResidentClusterSession:
         with self.lock:
             self.env = None
             self.state = None
+            self._spilled_env = None
             self._sync_key = None
+
+    # --------------------------------------------------- fleet spill/readmit
+    @property
+    def spilled(self) -> bool:
+        return self._spilled_env is not None
+
+    def spill(self) -> bool:
+        """Reclaim this tenant's device footprint (fleet memory budget):
+        fetch the resident env to a host mirror and drop both device slots.
+        The observed assignment already lives in the host mirrors, so the
+        state needs no fetch — the next sync's ``_sync_finalize`` (the same
+        program every sync runs) rebuilds it bit-identically. No-op while
+        cold or already spilled; returns whether a spill happened."""
+        with self.lock:
+            if self.env is None:
+                return False
+            self._ensure_state()     # a LENT state must be observed first:
+            #                          the mirrors already hold it, but the
+            #                          rematerialize keeps spill/readmit
+            #                          symmetric with a plain sync
+            self._spilled_env = jax.device_get(self.env)
+            self.env = None
+            self.state = None
+            self.spills += 1
+            return True
+
+    def readmit(self) -> bool:
+        """Re-admit a spilled session: upload the host env mirror and
+        rematerialize the state through ``_sync_finalize``. Returns whether
+        a readmission happened (``sync`` calls this implicitly)."""
+        with self.lock:
+            if self._spilled_env is None:
+                return False
+            self._readmit_locked()
+            return True
+
+    def _readmit_locked(self) -> None:
+        host_env = self._spilled_env
+        self._spilled_env = None
+        # leaf-wise upload preserves dtypes/shapes exactly (the device_get/
+        # device_put round trip is bitwise); placement follows the session's
+        # mesh policy like every other upload
+        self.env = jax.tree_util.tree_map(self._put, host_env)
+        self._materialize(self.env.leader_load, self.env.follower_load)
+        self.readmits += 1
 
     def state_json(self) -> dict:
         return {
@@ -377,6 +454,9 @@ class ResidentClusterSession:
             "donatedRounds": self.donated_rounds,
             "shadowSyncs": self.shadow_syncs,
             "syncGeneration": self.sync_generation,
+            "spilled": self.spilled,
+            "spills": self.spills,
+            "readmits": self.readmits,
             "lastSync": dict(self.last_sync_info),
         }
 

@@ -107,14 +107,24 @@ class CruiseControlServer:
                  security_provider=None, two_step_verification: bool = False,
                  max_block_ms: float = 10_000.0, max_active_user_tasks: int = 25,
                  completed_user_task_retention_ms: float = 24 * 3600 * 1000.0,
-                 ssl_context=None, config=None):
+                 ssl_context=None, config=None, fleet=None):
         """``ssl_context``: an ``ssl.SSLContext`` to serve HTTPS
         (KafkaCruiseControlApp.java:100-121 webserver.ssl.* role).
         ``config``: the framework Config — consumed for the webserver.* key
         families (CORS, access log, UI serving, reason requirement, session
         path, per-endpoint parameters/request class overrides, purgatory and
-        user-task cache caps)."""
+        user-task cache caps).
+        ``fleet``: a :class:`~cruise_control_tpu.fleet.FleetScheduler` —
+        enables cluster-scoped routing: every endpoint accepts
+        ``?cluster_id=<id>`` and dispatches to that tenant's facade with a
+        per-tenant user-task quota (fleet.max.active.user.tasks.per.tenant);
+        an unknown id is a declared 404, a malformed one a 400, and task ids
+        never resolve across tenants (each tenant has its own task manager).
+        ``app`` stays the default (un-scoped) facade."""
         self.app = app
+        self.fleet = fleet
+        self._tenant_user_tasks: dict[str, UserTaskManager] = {}
+        self._tenant_tasks_lock = threading.Lock()
         self.security = security_provider or NoopSecurityProvider()
         self.two_step = two_step_verification
         cfg = config if config is not None else getattr(app, "config", None)
@@ -149,6 +159,13 @@ class CruiseControlServer:
             max_cached_completed=(cfg.get_int(
                 "max.cached.completed.user.tasks") if cfg is not None else 100),
             max_cached_completed_by_type=by_type)
+        # cluster-scoped requests get a PER-TENANT task manager: quota
+        # isolation (one tenant's burst 429s alone) and no cross-tenant
+        # task-id resolution (wrong-tenant resumption is a 404)
+        self._tenant_task_quota = (
+            cfg.get_int("fleet.max.active.user.tasks.per.tenant")
+            if cfg is not None else 10)
+        self._tenant_task_retention_ms = completed_user_task_retention_ms
         self.max_block_ms = max_block_ms
         # webserver.http.cors.*: headers attached to every response (+ the
         # OPTIONS preflight) when enabled
@@ -231,25 +248,54 @@ class CruiseControlServer:
         self._httpd.shutdown()
         self._httpd.server_close()
         self.user_tasks.close()
+        with self._tenant_tasks_lock:
+            for ut in self._tenant_user_tasks.values():
+                ut.close()
+            self._tenant_user_tasks.clear()
         if self._access_log is not None:
             self._access_log.close()
 
+    # ------------------------------------------------------- fleet routing
+    def tenant_binding(self, cluster_id: str):
+        """(facade, task manager) for one tenant, or None when no fleet is
+        mounted / the id is unknown (the dispatcher's declared-404 signal).
+        Task managers are per tenant, created lazily with the per-tenant
+        quota — a task id from tenant A can never resume under tenant B."""
+        app = (self.fleet.app_for(cluster_id)
+               if self.fleet is not None else None)
+        if app is None:
+            return None
+        with self._tenant_tasks_lock:
+            ut = self._tenant_user_tasks.get(cluster_id)
+            if ut is None:
+                ut = UserTaskManager(
+                    max_active_tasks=self._tenant_task_quota,
+                    completed_task_retention_ms=self._tenant_task_retention_ms)
+                self._tenant_user_tasks[cluster_id] = ut
+        return app, ut
+
     # ----------------------------------------------------------- dispatch
     def handle(self, method: str, endpoint: EndPoint, params: dict,
-               client: str, task_id_header: str | None):
-        """Returns (status_code, body_dict, extra_headers)."""
+               client: str, task_id_header: str | None,
+               app=None, user_tasks=None):
+        """Returns (status_code, body_dict, extra_headers). ``app`` /
+        ``user_tasks`` select a fleet tenant's facade + task manager; None
+        = the default (un-scoped) instance."""
         import time as _time
+        app = app if app is not None else self.app
+        user_tasks = user_tasks if user_tasks is not None else self.user_tasks
         t0 = _time.monotonic()
-        sensors = getattr(self.app, "sensors", None)
+        sensors = getattr(app, "sensors", None)
         # causal journal: one ROOT span per REST request (endpoint + method
         # + final status), on the app's clock — the per-endpoint latency
         # record tools/slo_diff.py gates journal p99s from
-        tracer = getattr(self.app, "tracer", None)
+        tracer = getattr(app, "tracer", None)
         span = (tracer.span("request", endpoint.path, method=method)
                 if tracer is not None else None)
         try:
             status, body, headers = self._handle(method, endpoint, params,
-                                                 client, task_id_header)
+                                                 client, task_id_header,
+                                                 app, user_tasks)
         except Exception as e:
             # parameter/validation errors raised mid-handling surface as
             # 4xx/5xx upstream — they are failed executions too
@@ -274,8 +320,11 @@ class CruiseControlServer:
         return status, body, headers
 
     def _handle(self, method: str, endpoint: EndPoint, params: dict,
-                client: str, task_id_header: str | None):
+                client: str, task_id_header: str | None,
+                app=None, user_tasks=None):
         headers: dict[str, str] = {}
+        app = app if app is not None else self.app
+        user_tasks = user_tasks if user_tasks is not None else self.user_tasks
 
         # <endpoint>.request.class override: the configured handler replaces
         # the built-in request processing wholesale
@@ -305,18 +354,21 @@ class CruiseControlServer:
 
         if endpoint in ASYNC_ENDPOINTS:
             result = self._handle_async(method, endpoint, params, client,
-                                        task_id_header, headers)
+                                        task_id_header, headers, app,
+                                        user_tasks)
             if reviewed_rid is not None and result[0] in (200, 202):
                 self.purgatory.submit(reviewed_rid, endpoint)
             return result
-        result = 200, self._run_sync(endpoint, params), headers
+        result = 200, self._run_sync(endpoint, params, app), headers
         if reviewed_rid is not None:
             self.purgatory.submit(reviewed_rid, endpoint)
         return result
 
     # ------------------------------------------------------------- async
     def _handle_async(self, method, endpoint, params, client, task_id_header,
-                      headers):
+                      headers, app=None, user_tasks=None):
+        app = app if app is not None else self.app
+        user_tasks = user_tasks if user_tasks is not None else self.user_tasks
         # parameter problems must 400 before a task slot is consumed
         if params.get("excluded_topics"):
             import re
@@ -332,13 +384,13 @@ class CruiseControlServer:
                 "topic_configuration requires topic and replication_factor")
         if params.get("replica_movement_strategies"):
             try:
-                self.app.executor.validate_strategies(
+                app.executor.validate_strategies(
                     params["replica_movement_strategies"])
             except ValueError as e:
                 raise ParameterError(str(e)) from None
         if (endpoint in (EndPoint.REBALANCE, EndPoint.PROPOSALS)
                 and params.get("rebalance_disk") and params.get("goals")):
-            intra = self.app.config.get_list("intra.broker.goals")
+            intra = app.config.get_list("intra.broker.goals")
             bad = [g for g in params["goals"] if g not in intra]
             if bad:
                 raise ParameterError(
@@ -350,20 +402,26 @@ class CruiseControlServer:
         # a read of the existing task and passes through
         if (method == "POST" and params.get("dryrun", True) is not True
                 and not task_id_header):
-            degraded = getattr(self.app, "degraded", None)
+            degraded = getattr(app, "degraded", None)
             if degraded is not None and degraded():
                 raise ServiceUnavailableError(
                     f"{endpoint.path} rejected: backend degraded (open "
-                    f"circuits: {self.app.fault_tolerance.open_circuits()})",
-                    retry_after_s=self.app.fault_tolerance.retry_after_s())
-        work = self._async_work(endpoint, params)
+                    f"circuits: {app.fault_tolerance.open_circuits()})",
+                    retry_after_s=app.fault_tolerance.retry_after_s())
+        work = self._async_work(endpoint, params, app)
         # non-dry-run ops mutate the cluster: a completed one must not be
         # replayed from the session cache for a fresh request
         idempotent = method == "GET" or params.get("dryrun", True) is True
         try:
-            task = self.user_tasks.get_or_create_task(
+            task = user_tasks.get_or_create_task(
                 client, endpoint, method, params, work, task_id=task_id_header,
                 idempotent=idempotent)
+        except KeyError as e:
+            # unknown User-Task-ID: the task does not exist IN THIS SCOPE —
+            # for cluster-scoped requests that includes another tenant's
+            # task id (per-tenant managers never share ids). A declared
+            # 404, never a 500 and never cross-tenant data.
+            return 404, error_json(str(e)), headers
         except UserTaskLimitError as e:
             # the reference's servlet surfaces user-task overflow as 429 Too
             # Many Requests with a Retry-After, never a generic error — the
@@ -405,10 +463,10 @@ class CruiseControlServer:
         )
         return isinstance(e, (CircuitOpenError, NotEnoughValidWindowsError))
 
-    def _async_work(self, endpoint: EndPoint, p: dict):
+    def _async_work(self, endpoint: EndPoint, p: dict, app=None):
         """Build the callable for an async endpoint: runs on the user-task
         pool, reports progress, returns the response body dict."""
-        app = self.app
+        app = app if app is not None else self.app
 
         def run(progress):
             progress.add_step(PENDING)
@@ -511,10 +569,14 @@ class CruiseControlServer:
         return run
 
     # -------------------------------------------------------------- sync
-    def _run_sync(self, endpoint: EndPoint, p: dict) -> dict:
-        app = self.app
+    def _run_sync(self, endpoint: EndPoint, p: dict, app=None) -> dict:
+        app = app if app is not None else self.app
         if endpoint is EndPoint.STATE:
-            return wrap(app.state_json(substates=p["substates"] or None))
+            out = app.state_json(substates=p["substates"] or None)
+            if (self.fleet is not None
+                    and "FLEET" in [x.upper() for x in (p["substates"] or [])]):
+                out["FleetState"] = self.fleet.state_json()
+            return wrap(out)
         if endpoint is EndPoint.KAFKA_CLUSTER_STATE:
             return wrap(app.kafka_cluster_state(verbose=bool(p["verbose"])))
         if endpoint is EndPoint.PAUSE_SAMPLING:
@@ -641,6 +703,34 @@ def _make_handler(server: CruiseControlServer):
                 "Access-Control-Expose-Headers", "")
             self._send_raw(204, b"", "text/plain", headers)
 
+        def _resolve_cluster(self, cid: str):
+            """Resolve one ?cluster_id= value to (facade, task manager).
+            Sends the DECLARED error response itself and returns None when
+            the id is malformed (400) or unknown / no fleet mounted (404) —
+            wrong-tenant access is never a 500 and never another tenant's
+            data."""
+            from cruise_control_tpu.fleet import valid_cluster_id
+            if not valid_cluster_id(cid):
+                self._send(400, error_json(
+                    f"malformed cluster_id {cid!r}"), {})
+                return None
+            binding = server.tenant_binding(cid)
+            if binding is None:
+                self._send(404, error_json(
+                    f"unknown cluster_id {cid!r}"), {})
+                return None
+            return binding
+
+        def _scoped_app(self, parsed):
+            """The facade a pre-dispatch text endpoint (/metrics, /health)
+            serves: the tenant's when ?cluster_id= rides the query, else the
+            default app. None = an error response was already sent."""
+            vals = urllib.parse.parse_qs(parsed.query).get("cluster_id")
+            if not vals:
+                return server.app
+            binding = self._resolve_cluster(vals[-1])
+            return binding[0] if binding is not None else None
+
         def _dispatch(self, method: str):
             parsed = urllib.parse.urlparse(self.path)
             path = parsed.path
@@ -667,8 +757,11 @@ def _make_handler(server: CruiseControlServer):
                 except AuthError as e:
                     self._send(e.status, error_json(str(e)), {})
                     return
+                app = self._scoped_app(parsed)
+                if app is None:
+                    return
                 try:
-                    text = server.app.metrics_text()
+                    text = app.metrics_text()
                 except Exception as e:  # noqa: BLE001 — rendered as the error body
                     self._send(500, error_json(f"{type(e).__name__}: {e}",
                                                traceback.format_exc()), {})
@@ -693,8 +786,11 @@ def _make_handler(server: CruiseControlServer):
                 except AuthError as e:
                     self._send(e.status, error_json(str(e)), {})
                     return
+                app = self._scoped_app(parsed)
+                if app is None:
+                    return
                 try:
-                    self._send(200, server.app.health_json(), {})
+                    self._send(200, app.health_json(), {})
                 except Exception as e:  # noqa: BLE001 — rendered as the error body
                     self._send(500, error_json(f"{type(e).__name__}: {e}",
                                                traceback.format_exc()), {})
@@ -777,6 +873,16 @@ def _make_handler(server: CruiseControlServer):
                 except (ValueError, UnicodeDecodeError) as e:
                     self._send(400, error_json(f"malformed request body: {e}"), {})
                     return
+            scoped_app = scoped_tasks = None
+            cid_vals = query.pop("cluster_id", None)
+            if cid_vals:
+                # cluster-scoped routing (?cluster_id=): select the tenant's
+                # facade + per-tenant task manager before parameter parsing
+                # (the id is a routing selector, not an endpoint parameter)
+                binding = self._resolve_cluster(cid_vals[-1])
+                if binding is None:
+                    return
+                scoped_app, scoped_tasks = binding
             if (server._reason_required and method == "POST"
                     and not query.get("reason", [""])[0]):
                 # WebServerConfig request.reason.required
@@ -799,7 +905,8 @@ def _make_handler(server: CruiseControlServer):
             try:
                 status, body, headers = server.handle(
                     method, endpoint, params, client,
-                    self.headers.get(USER_TASK_HEADER_NAME))
+                    self.headers.get(USER_TASK_HEADER_NAME),
+                    app=scoped_app, user_tasks=scoped_tasks)
                 if new_session:
                     headers = dict(headers or {})
                     headers["Set-Cookie"] = (

@@ -60,13 +60,17 @@ class OperationResult:
 
 
 class CruiseControl:
-    def __init__(self, backend, config=None):
+    def __init__(self, backend, config=None, cluster_id=None):
         from cruise_control_tpu.common.sensors import MetricRegistry
         from cruise_control_tpu.common.tracing import (
             EventJournal, FlightRecorder, SpanTracer,
         )
         self.config = config or cruise_control_config()
         self.backend = backend
+        # fleet mode (PR 13): the tenant cluster this facade serves (None =
+        # single-tenant deployment); labels the monitor's per-tenant
+        # aggregators and the fleet's cluster-scoped routing
+        self.cluster_id = cluster_id
         # one registry for the whole app — the MetricRegistry -> JMX domain
         # kafka.cruisecontrol role (KafkaCruiseControlApp.java:29,40); exported
         # via /state?substates=SENSORS and GET /metrics (Prometheus text)
@@ -118,7 +122,8 @@ class CruiseControl:
                                         sensors=self.sensors,
                                         recorder=self.flight_recorder,
                                         fault_tolerance=self.fault_tolerance,
-                                        tracer=self.tracer)
+                                        tracer=self.tracer,
+                                        cluster_id=cluster_id)
         self.goal_optimizer = GoalOptimizer(config=self.config,
                                             sensors=self.sensors,
                                             recorder=self.flight_recorder)
@@ -214,6 +219,10 @@ class CruiseControl:
         # (main.py service.pipeline.enabled / the sim's lockstep mode);
         # surfaced via /state?substates=PIPELINE
         self.service_pipeline = None
+        # service.pipeline.route.fixes: whether self-healing FIX executions
+        # ride the THREADED pipeline's execute stage (_route_fixes_async)
+        self._route_fixes = self.config.get_boolean(
+            "service.pipeline.route.fixes")
 
     # ------------------------------------------------------------- wiring
     def _wire_detectors(self):
@@ -571,11 +580,22 @@ class CruiseControl:
                 "self.healing.exclude.recently.demoted.brokers")
         return excl_removed, excl_demoted
 
+    def _route_fixes_async(self) -> bool:
+        """Whether self-healing FIX executions should ride the pipeline's
+        execute stage instead of blocking the caller (PR 11 residual c: a
+        long heal must not block the detection thread). Only the THREADED
+        pipeline routes — the sim's lockstep mode keeps blocking heals so
+        (scenario, seed) timelines stay bit-identical."""
+        pipe = self.service_pipeline
+        return (pipe is not None and self._route_fixes
+                and pipe.accepts_fix_routing())
+
     def _run_optimization(self, operation: str, reason: str, ct, meta,
                           goal_names=None, options=OptimizationOptions(),
                           dry_run: bool = True, skip_hard_goal_check: bool = False,
                           execute_kw: dict | None = None,
-                          session=None, parent_span=None) -> OperationResult:
+                          session=None, parent_span=None,
+                          route_async: bool = False) -> OperationResult:
         goals = goal_names or effective_default_goals(self.config)
         # optimization.options.generator.class seam: deployments may rewrite
         # the options of any internally-triggered optimization
@@ -598,6 +618,7 @@ class CruiseControl:
             raise
         op = OperationResult(operation=operation, reason=reason,
                              optimizer_result=res)
+        routed = False
         if not dry_run and res.proposals:
             kw = dict(execute_kw or {})
             try:
@@ -609,15 +630,31 @@ class CruiseControl:
                 sizes = {}
             kw.setdefault("context", {"partition_size_mb": sizes,
                                       "operation": f"{operation}: {reason}"})
-            try:
-                self.executor.execute_proposals(res.proposals,
-                                                parent_span=op_span, **kw)
-            except Exception as e:
-                op_span.end(error=type(e).__name__,
-                            proposals=len(res.proposals))
-                raise
-            op.executed = True
-        op_span.end(executed=op.executed, proposals=len(res.proposals))
+            if route_async and self._route_fixes_async():
+                # PR 11 residual c: hand the heal to the pipeline's execute
+                # stage — the detection thread returns immediately, the
+                # execution drains async on the pipeline's thread, and the
+                # PR 12 span lineage survives the hand-off (the operation
+                # span rides into the executor as parent_span; the round is
+                # STICKY so a metadata-generation bump between submit and
+                # drain cannot silently drop a heal)
+                self.service_pipeline.submit_execution(
+                    res.proposals,
+                    execute_kw={**kw, "parent_span": op_span}, sticky=True)
+                op.executed = True
+                routed = True
+                self.sensors.meter("pipeline-routed-fixes").mark()
+            else:
+                try:
+                    self.executor.execute_proposals(res.proposals,
+                                                    parent_span=op_span, **kw)
+                except Exception as e:
+                    op_span.end(error=type(e).__name__,
+                                proposals=len(res.proposals))
+                    raise
+                op.executed = True
+        op_span.end(executed=op.executed, routed=routed,
+                    proposals=len(res.proposals))
         self._ops_history.append({"operation": operation, "reason": reason,
                                   "ms": self._now_ms(),
                                   "numProposals": len(res.proposals),
@@ -699,7 +736,8 @@ class CruiseControl:
                                     skip_hard_goal_check=skip_hard_goal_check
                                     or self_healing,
                                     execute_kw=execute_kw, session=session,
-                                    parent_span=parent_span)
+                                    parent_span=parent_span,
+                                    route_async=self_healing)
         return op.to_json()
 
     def remove_brokers(self, broker_ids: list, dry_run: bool = False,
@@ -739,7 +777,8 @@ class CruiseControl:
                                     self._self_healing_goals(),
                                     OptimizationOptions(),
                                     dry_run=dry_run, skip_hard_goal_check=True,
-                                    parent_span=parent_span)
+                                    parent_span=parent_span,
+                                    route_async=self_healing)
         if op.executed:
             self.executor.note_removed_brokers(broker_ids)
         return op.to_json()
@@ -828,7 +867,7 @@ class CruiseControl:
             "FIX_OFFLINE_REPLICAS", reason, ct, meta, self._self_healing_goals(),
             OptimizationOptions(fix_offline_replicas_only=True),
             dry_run=dry_run, skip_hard_goal_check=True, session=session,
-            parent_span=parent_span)
+            parent_span=parent_span, route_async=self_healing)
         return op.to_json()
 
     def fix_topic_replication_factor(self, bad_topics: dict,
@@ -1064,6 +1103,20 @@ class CruiseControl:
             return cached, {"stale": True, "generation": list(gen),
                             "ageMs": round(age_ms, 1),
                             "reason": f"{type(e).__name__}: {e}"}
+
+    def install_proposal_cache(self, res: OptimizerResult,
+                               generation=None, computed_ms=None) -> None:
+        """Install an externally-computed optimizer result as this app's
+        proposal cache (the fleet scheduler's batched rounds land here —
+        GET /proposals then serves it through the normal generation-checked
+        path)."""
+        gen = (generation if generation is not None
+               else self.load_monitor.model_generation().as_tuple())
+        with self._cache_lock:
+            self._proposal_cache = res
+            self._proposal_cache_generation = gen
+            self._proposal_cache_ms = (computed_ms if computed_ms is not None
+                                       else self._now_ms())
 
     def _cached_proposals_fresh(self, force_refresh: bool = False,
                                 goal_names=None,

@@ -336,6 +336,32 @@ _D.define(name="optimization.options.generator.class", type=Type.CLASS,
           default="cruise_control_tpu.analyzer.options.DefaultOptimizationOptionsGenerator",
           doc="Pluggable OptimizationOptions generator "
               "(AnalyzerConfig optimization.options.generator.class).")
+_D.define(name="analyzer.finisher.escalation", type=Type.BOOLEAN, default=True,
+          doc="Certificate-driven budget escalation (the BENCH_r05 Leader*/"
+              "LeaderBytesIn tail closer): a goal whose budgeted loop AND "
+              "finisher exit still-violated WITHOUT a fixpoint certificate, "
+              "but with a small measured remaining-action count, re-enters "
+              "its finisher once at the end of the chain with widened "
+              "windows (rounds/swap passes x the escalation factor) and "
+              "EVERY other chain goal's acceptance veto in force — so "
+              "violation sets only shrink and certificates only appear "
+              "(one-sided outcome parity, tests/test_escalation.py). "
+              "Engages only where the finisher runs at all "
+              "(analyzer.finisher.min.replicas).")
+_D.define(name="analyzer.finisher.escalation.max.remaining", type=Type.INT,
+          default=2048, validator=at_least(0),
+          doc="Escalate only goals whose finisher scans measured at most "
+              "this many remaining accepted positive-gain actions (moves + "
+              "transfers + swap-window pairs): a small count means the tail "
+              "is close and widened windows can close it; a large one means "
+              "the cluster genuinely cannot converge under the chain's "
+              "vetoes and more budget is waste.")
+_D.define(name="analyzer.finisher.escalation.factor", type=Type.INT, default=4,
+          validator=at_least(1),
+          doc="Window widening of an escalated finisher re-entry: "
+              "finisher_rounds and finisher_swap_passes are multiplied by "
+              "this factor (the budgeted loop is skipped outright — the "
+              "escalation is pure exhaustive-scan convergence).")
 _D.define(name="analyzer.finisher.overlap", type=Type.BOOLEAN, default=False,
           doc="TPU-specific (PERF round-11 lever): dispatch the exhaustive "
               "finisher's leadership scan against the round-ENTRY state so "
@@ -372,6 +398,44 @@ _D.define(name="service.pipeline.min.windows", type=Type.INT, default=1,
               "at least this many valid windows, and releases on its own "
               "once live sampling fills them (meetCompletenessRequirements "
               "as the explicit backpressure signal, SURVEY §2.3).")
+_D.define(name="service.pipeline.route.fixes", type=Type.BOOLEAN, default=True,
+          doc="Route self-healing FIX executions through the pipeline's "
+              "execute stage (PR 11 residual c): the detection thread "
+              "returns as soon as the heal is optimized + submitted, the "
+              "execution drains async on the pipeline's execute thread, and "
+              "the anomaly->heal span lineage survives the hand-off. Routed "
+              "heals are STICKY rounds (never dropped as stale/superseded). "
+              "Only the THREADED pipeline routes — the sim's lockstep mode "
+              "keeps heals blocking so (scenario, seed) timelines stay "
+              "bit-identical.")
+
+# --------------------------------------------------------------------------
+# Fleet mode (PR 13: batched multi-tenant optimization, one device)
+# --------------------------------------------------------------------------
+_D.define(name="fleet.device.memory.budget.bytes", type=Type.LONG, default=-1,
+          doc="Global device-memory budget for every fleet tenant's resident "
+              "env/state (cruise_control_tpu/fleet.py). When the fleet's "
+              "resident footprint exceeds it after a round, cold tenants are "
+              "LRU-spilled to host mirrors (paused tenants first, then "
+              "least-recently-optimized); a spilled tenant's next touch "
+              "re-admits it bit-identically through the session's own "
+              "_sync_finalize program with zero new compiles inside its "
+              "shape bucket. -1 = unlimited.")
+_D.define(name="fleet.max.active.user.tasks.per.tenant", type=Type.INT,
+          default=10, validator=at_least(1),
+          doc="Per-tenant active user-task quota for cluster-scoped REST "
+              "requests (?cluster_id=): each tenant gets its own "
+              "UserTaskManager with this cap, so one tenant's async-request "
+              "burst 429s (Too Many Requests + Retry-After) without starving "
+              "another tenant's slots — and a task id can never resume "
+              "across tenants (wrong-tenant access is a declared 404).")
+_D.define(name="fleet.precompute.interval.ms", type=Type.INT, default=30_000,
+          validator=at_least(100),
+          doc="Cadence of the fleet scheduler's precompute loop "
+              "(FleetScheduler.start_precompute): each round syncs every "
+              "unpaused tenant (delta path), batches the due ones per shape "
+              "bucket into ONE vmapped engine launch, installs per-tenant "
+              "proposal caches and enforces the memory budget.")
 
 # --------------------------------------------------------------------------
 # Monitor (reference: config/constants/MonitorConfig.java)

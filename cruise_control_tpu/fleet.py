@@ -1,0 +1,365 @@
+"""Fleet mode: batched multi-tenant optimization — N clusters, one device.
+
+The reference is hard-wired one-Cruise-Control-instance-per-cluster (SURVEY
+§2.10): serving a fleet means thousands of idle-most-of-the-time JVMs. Here
+every ingredient for multiplexing already exists — the engine is pure-tensor
+over padded shape buckets, resident sessions are ~108 MB/1M replicas (PR 5)
+and steady rounds are delta-mode/0-compile/donated (PR 11) — so this module
+stacks same-bucket tenants along a leading axis and optimizes the whole
+fleet in ONE vmapped engine launch per bucket
+(``GoalOptimizer.optimizations_batched``).
+
+Components:
+
+- :class:`FleetTenant` — one tenant cluster: its own ``CruiseControl`` app
+  (backend, monitor with per-tenant aggregators, executor, detectors) and
+  the app's :class:`ResidentClusterSession`; pause/resume and per-tenant
+  staleness ride the PR 11 generation machinery (a tenant is DUE when its
+  session's ``sync_generation`` advanced past the last optimized one).
+- :class:`FleetScheduler` — groups due tenants by shape bucket, launches
+  one batched optimization per bucket (launches/round ≈ #buckets, not
+  #tenants), installs each tenant's result into its app's proposal cache
+  (the precompute role, GoalOptimizer.java:139-339, fleet-wide), and
+  enforces a global device-memory budget by LRU-spilling cold tenants'
+  resident state to host mirrors (``ResidentClusterSession.spill`` — a
+  touched tenant re-admits through the same ``_sync_finalize`` program,
+  bit-identical, zero new compiles within its bucket).
+
+Parity contract (tests/test_fleet.py): K same-bucket tenants optimized in
+one launch produce per-tenant violation/certificate/proposal sets
+bit-identical to K solo runs. Steady fleet rounds stay delta-mode, zero new
+XLA compiles, donated.
+"""
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from collections import deque
+
+LOG = logging.getLogger(__name__)
+
+# cluster ids ride in URLs and file names: printable, bounded, no separators
+CLUSTER_ID_RE = re.compile(r"[A-Za-z0-9][A-Za-z0-9._-]{0,63}")
+
+
+def valid_cluster_id(cluster_id) -> bool:
+    return (isinstance(cluster_id, str)
+            and CLUSTER_ID_RE.fullmatch(cluster_id) is not None)
+
+
+class UnknownClusterError(KeyError):
+    """A cluster-scoped request named a tenant this fleet does not serve —
+    the REST layer maps it to a DECLARED 404 (never a 500, never another
+    tenant's data)."""
+
+
+class FleetTenant:
+    """One tenant cluster under the scheduler."""
+
+    def __init__(self, cluster_id: str, cc):
+        self.cluster_id = cluster_id
+        self.cc = cc
+        self.paused = False
+        # PR 11 generation staleness: the session's sync_generation at the
+        # last batched optimization this tenant rode
+        self.optimized_generation = -1
+        self.last_round_seq = 0        # LRU key for the memory-budget spill
+        self.last_refresh_ms: float | None = None
+        self.refreshes = 0
+        self.staleness_ms = deque(maxlen=512)   # cache age sampled per round
+
+    @property
+    def session(self):
+        return self.cc.resident_session
+
+    def staleness_p95_ms(self) -> float | None:
+        if not self.staleness_ms:
+            return None
+        xs = sorted(self.staleness_ms)
+        # nearest-rank p95, the campaign distributions' convention
+        return float(xs[max(0, -(-len(xs) * 95 // 100) - 1)])
+
+    def state_json(self) -> dict:
+        sess = self.session
+        return {
+            "clusterId": self.cluster_id,
+            "paused": self.paused,
+            "optimizedGeneration": self.optimized_generation,
+            "syncGeneration": sess.sync_generation if sess else None,
+            "spilled": bool(sess is not None and sess.spilled),
+            "refreshes": self.refreshes,
+            "stalenessP95Ms": self.staleness_p95_ms(),
+            "lastRoundSeq": self.last_round_seq,
+        }
+
+
+class FleetScheduler:
+    """Multiplex N tenant clusters onto one device: bucket-grouped batched
+    optimization, proposal-cache precompute, pause/resume, and a global
+    device-memory budget with LRU spill."""
+
+    def __init__(self, config=None, optimizer=None, sensors=None):
+        from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+        from cruise_control_tpu.common.sensors import MetricRegistry
+        from cruise_control_tpu.config.defaults import cruise_control_config
+        self.config = config or cruise_control_config()
+        self.sensors = sensors if sensors is not None else MetricRegistry()
+        # ONE optimizer serves every batched launch; its compiled programs
+        # are shared with the tenants' own apps anyway (the engine caches
+        # are module-level, keyed by goal/bucket, not per optimizer object)
+        self.optimizer = optimizer or GoalOptimizer(config=self.config,
+                                                    sensors=self.sensors)
+        self.memory_budget_bytes = self.config.get_int(
+            "fleet.device.memory.budget.bytes")
+        self.precompute_interval_ms = float(self.config.get_int(
+            "fleet.precompute.interval.ms"))
+        self._lock = threading.RLock()
+        self.tenants: dict[str, FleetTenant] = {}
+        self._round_seq = 0
+        self.rounds = 0
+        self.launches = 0              # batched program launches, lifetime
+        self.last_round: dict = {}
+        self._spill_meter = self.sensors.meter("fleet-spills")
+        self._staleness_timer = self.sensors.timer("fleet-staleness-timer")
+        self.sensors.gauge("fleet-tenants", lambda: len(self.tenants))
+        self.sensors.gauge("fleet-device-bytes", self.device_bytes)
+        self.sensors.gauge(
+            "fleet-spilled-tenants",
+            lambda: sum(1 for t in self.tenants.values()
+                        if t.session is not None and t.session.spilled))
+        # precompute loop (threaded service mode)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ tenants
+    def add_tenant(self, cluster_id: str, backend=None, config=None,
+                   cc=None) -> FleetTenant:
+        """Register one tenant cluster. Pass a backend (a full
+        ``CruiseControl`` app is built over it, resident session on) or a
+        pre-built ``cc``. Tenant apps should NOT run their own proposal
+        precompute threads — the scheduler's rounds are the precompute."""
+        if not valid_cluster_id(cluster_id):
+            raise ValueError(f"invalid cluster_id {cluster_id!r} "
+                             f"(expected {CLUSTER_ID_RE.pattern})")
+        with self._lock:
+            if cluster_id in self.tenants:
+                raise ValueError(f"cluster_id {cluster_id!r} already "
+                                 f"registered")
+            if cc is None:
+                from cruise_control_tpu.app import CruiseControl
+                cc = CruiseControl(backend, config=config or self.config,
+                                   cluster_id=cluster_id)
+            if cc.resident_session is None:
+                raise ValueError(
+                    "fleet tenants need a resident session "
+                    "(analyzer.resident.session.enabled)")
+            tenant = FleetTenant(cluster_id, cc)
+            self.tenants[cluster_id] = tenant
+            return tenant
+
+    def remove_tenant(self, cluster_id: str) -> None:
+        with self._lock:
+            tenant = self.tenants.pop(cluster_id, None)
+        if tenant is not None:
+            tenant.cc.shutdown()
+
+    def tenant(self, cluster_id: str) -> FleetTenant:
+        t = self.tenants.get(cluster_id)
+        if t is None:
+            raise UnknownClusterError(cluster_id)
+        return t
+
+    def app_for(self, cluster_id: str):
+        """The tenant's facade, or None for an unknown id (the REST layer's
+        404 signal)."""
+        t = self.tenants.get(cluster_id)
+        return t.cc if t is not None else None
+
+    @property
+    def cluster_ids(self) -> list[str]:
+        return list(self.tenants)
+
+    def pause(self, cluster_id: str) -> dict:
+        """Per-tenant pause: the tenant stops syncing/optimizing (its REST
+        surface keeps serving the cached proposals); a paused tenant is the
+        preferred spill victim under memory pressure."""
+        t = self.tenant(cluster_id)
+        t.paused = True
+        return {"clusterId": cluster_id, "paused": True}
+
+    def resume(self, cluster_id: str) -> dict:
+        t = self.tenant(cluster_id)
+        t.paused = False
+        return {"clusterId": cluster_id, "paused": False}
+
+    # ------------------------------------------------------------- buckets
+    @staticmethod
+    def bucket_key(session) -> tuple | None:
+        """The padded shape bucket a synced session occupies — the grouping
+        key for stacked launches (same key => stackable pytrees)."""
+        env = session.env
+        if env is None:
+            return None
+        return (env.num_replicas, env.num_brokers, env.num_partitions,
+                int(env.topic_excluded.shape[0]), env.max_rf,
+                int(env.broker_disk_capacity.shape[1]), env.num_racks)
+
+    # -------------------------------------------------------------- rounds
+    def run_round(self, now_ms: float | None = None) -> dict:
+        """One fleet optimization round: sync every unpaused tenant (delta
+        path; spilled tenants re-admit), group the DUE ones (sync_generation
+        advanced) by shape bucket, run ONE batched launch per bucket,
+        install per-tenant proposal caches, then enforce the memory budget.
+        """
+        from cruise_control_tpu.monitor.load_monitor import (
+            NotEnoughValidWindowsError,
+        )
+        with self._lock:
+            self._round_seq += 1
+            self.rounds += 1
+            due: list[FleetTenant] = []
+            skipped: dict[str, str] = {}
+            for cid, t in self.tenants.items():
+                if t.paused:
+                    skipped[cid] = "paused"
+                    continue
+                try:
+                    t.cc.resident_session.sync()
+                except NotEnoughValidWindowsError as e:
+                    skipped[cid] = f"backpressure: {e}"   # PR 11 semantics
+                    continue
+                except Exception as e:   # noqa: BLE001 — tenant isolation:
+                    # one tenant's sync failure must not starve the others
+                    LOG.exception("fleet sync failed for tenant %s", cid)
+                    t.cc.resident_session.invalidate()
+                    skipped[cid] = f"sync failed: {type(e).__name__}"
+                    continue
+                if t.session.sync_generation > t.optimized_generation:
+                    due.append(t)
+                else:
+                    skipped[cid] = "fresh"
+            buckets: dict[tuple, list[FleetTenant]] = {}
+            for t in due:
+                buckets.setdefault(self.bucket_key(t.session), []).append(t)
+            launches = 0
+            optimized: list[str] = []
+            for key, group in buckets.items():
+                sessions = [t.session for t in group]
+                gens = [t.session.sync_generation for t in group]
+                try:
+                    results = self.optimizer.optimizations_batched(sessions)
+                except Exception:   # noqa: BLE001 — bucket isolation
+                    LOG.exception(
+                        "fleet batched launch failed for bucket %s (%s)",
+                        key, [t.cluster_id for t in group])
+                    for t in group:
+                        skipped[t.cluster_id] = "launch failed"
+                    continue
+                launches += 1
+                for t, res, gen in zip(group, results, gens):
+                    now = now_ms if now_ms is not None else t.cc._now_ms()
+                    if t.last_refresh_ms is not None:
+                        age_ms = max(now - t.last_refresh_ms, 0.0)
+                        t.staleness_ms.append(age_ms)
+                        self._staleness_timer.record(age_ms / 1000.0)
+                    t.cc.install_proposal_cache(res, computed_ms=now)
+                    t.optimized_generation = gen
+                    t.last_round_seq = self._round_seq
+                    t.last_refresh_ms = now
+                    t.refreshes += 1
+                    optimized.append(t.cluster_id)
+            self.launches += launches
+            spilled = self.enforce_memory_budget()
+            report = {
+                "round": self._round_seq,
+                "launches": launches,
+                "buckets": {str(k): [t.cluster_id for t in g]
+                            for k, g in buckets.items()},
+                "optimized": optimized,
+                "skipped": skipped,
+                "spilled": spilled,
+                "deviceBytes": self.device_bytes(),
+            }
+            self.last_round = report
+            return report
+
+    # ------------------------------------------------------ memory budget
+    def device_bytes(self) -> int:
+        total = 0
+        for t in self.tenants.values():
+            sess = t.session
+            if sess is not None:
+                b = sess.device_bytes()
+                total += b["env_bytes"] + b["state_bytes"]
+        return total
+
+    def enforce_memory_budget(self) -> list[str]:
+        """LRU spill until the fleet's resident footprint fits the budget:
+        paused tenants first, then the least-recently-optimized. A spilled
+        tenant's next touch (sync) re-admits it bit-identically through the
+        session's own finalize program."""
+        budget = self.memory_budget_bytes
+        if budget is None or budget < 0:
+            return []
+        spilled: list[str] = []
+        while self.device_bytes() > budget:
+            victims = [t for t in self.tenants.values()
+                       if t.session is not None and t.session.env is not None]
+            if not victims:
+                break
+            victim = min(victims,
+                         key=lambda t: (not t.paused, t.last_round_seq))
+            if not victim.session.spill():
+                break
+            self._spill_meter.mark()
+            spilled.append(victim.cluster_id)
+            LOG.info("fleet memory budget: spilled tenant %s "
+                     "(%d bytes resident > %d budget)",
+                     victim.cluster_id, self.device_bytes(), budget)
+        return spilled
+
+    # --------------------------------------------------- precompute thread
+    def start_precompute(self, interval_ms: float | None = None) -> None:
+        """The fleet's precompute loop (threaded service mode): keep every
+        tenant's proposal cache fresh by running rounds on a cadence."""
+        if self._thread is not None:
+            return
+        if interval_ms is None:
+            interval_ms = self.precompute_interval_ms
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval_ms / 1000.0):
+                try:
+                    self.run_round()
+                except Exception:    # noqa: BLE001
+                    LOG.exception("fleet precompute round failed")
+
+        self._thread = threading.Thread(target=loop, name="fleet-precompute",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop_precompute(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(10.0)
+            self._thread = None
+
+    def shutdown(self) -> None:
+        self.stop_precompute()
+        for cid in list(self.tenants):
+            self.remove_tenant(cid)
+
+    # ---------------------------------------------------------------- state
+    def state_json(self) -> dict:
+        with self._lock:
+            return {
+                "tenants": {cid: t.state_json()
+                            for cid, t in self.tenants.items()},
+                "rounds": self.rounds,
+                "launches": self.launches,
+                "deviceBytes": self.device_bytes(),
+                "memoryBudgetBytes": self.memory_budget_bytes,
+                "lastRound": dict(self.last_round),
+            }

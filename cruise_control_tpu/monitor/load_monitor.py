@@ -80,9 +80,12 @@ class LoadMonitorState:
 class LoadMonitor:
     def __init__(self, config=None, backend=None, sampler=None, sample_store=None,
                  capacity_resolver=None, sensors=None, recorder=None,
-                 fault_tolerance=None, tracer=None):
+                 fault_tolerance=None, tracer=None, cluster_id=None):
         from cruise_control_tpu.common.sensors import MetricRegistry
         self._sensors = sensors if sensors is not None else MetricRegistry()
+        # fleet mode (PR 13): which tenant cluster this monitor (and its
+        # per-tenant aggregators) belongs to — a label for state/logs only
+        self.cluster_id = cluster_id
         # backend fault tolerance (common/retries.py): sampling rounds retry
         # transient backend failures and sit behind the shared
         # "monitor.sample" circuit breaker — a flaky metrics endpoint skips
@@ -850,6 +853,8 @@ class LoadMonitor:
             "totalNumPartitions": self._num_partitions(),
             "loadGeneration": self._partition_agg.generation,
         }
+        if self.cluster_id is not None:
+            out["clusterId"] = self.cluster_id
         if self._state == LoadMonitorState.BOOTSTRAPPING:
             # LoadMonitorState.java reports bootstrap progress while active
             out["bootstrapProgressPct"] = round(100.0 * self._bootstrap_progress, 1)

@@ -130,12 +130,18 @@ class SampleRingBuffer:
 
 @dataclasses.dataclass
 class ProposalRound:
-    """One generation-tagged execution submission."""
+    """One generation-tagged execution submission. ``sticky`` rounds are
+    self-healing FIX executions routed through the execute stage (PR 13):
+    they are never dropped as stale/superseded — a heal computed against a
+    slightly older metadata generation still beats no heal, and the
+    executor's own per-task re-validation DEADs anything that genuinely no
+    longer applies."""
     seq: int
     metadata_generation: int
     proposals: list
     execute_kw: dict = dataclasses.field(default_factory=dict)
     submitted_ms: float = 0.0
+    sticky: bool = False
 
 
 class PipelinedServiceLoop:
@@ -268,18 +274,27 @@ class PipelinedServiceLoop:
         return {"optimized": True, "generation": gen}
 
     # ------------------------------------------------------------ execute
-    def submit_execution(self, proposals: list, execute_kw: dict | None = None
-                         ) -> ProposalRound:
+    def accepts_fix_routing(self) -> bool:
+        """Whether self-healing FIX executions may be handed to this loop's
+        execute stage (app._route_fixes_async): only the THREADED mode — a
+        lockstep (sim) pipeline keeps heals blocking so scenario timelines
+        stay bit-identical per (scenario, seed)."""
+        return bool(self._threads)
+
+    def submit_execution(self, proposals: list, execute_kw: dict | None = None,
+                         sticky: bool = False) -> ProposalRound:
         """Queue one generation-tagged proposal set for async execution.
         The tag is the monitor's CURRENT metadata generation; the drain
         drops the set if the metadata generation moved (the cluster the plan
-        was computed against no longer exists) or a newer set superseded it."""
+        was computed against no longer exists) or a newer set superseded it.
+        ``sticky`` (routed FIX heals) exempts the round from both drops."""
         gen = self.monitor.model_generation().metadata_generation
         with self._exec_lock:
             rnd = ProposalRound(seq=self._exec_seq, metadata_generation=gen,
                                 proposals=list(proposals),
                                 execute_kw=dict(execute_kw or {}),
-                                submitted_ms=self.cc._now_ms())
+                                submitted_ms=self.cc._now_ms(),
+                                sticky=sticky)
             self._exec_seq += 1
             self._exec_queue.append(rnd)
         self._wake_exec.set()
@@ -298,10 +313,15 @@ class PipelinedServiceLoop:
         current_gen = self.monitor.model_generation().metadata_generation
         executed = 0
         dropped = 0
-        newest = pending[-1].seq
-        for rnd in pending:
-            stale = (rnd.metadata_generation != current_gen
-                     or rnd.seq != newest)
+        # sticky (routed-heal) rounds never supersede or get superseded by
+        # the precompute's rebalance rounds — newest-wins applies to the
+        # ordinary rounds only
+        ordinary = [r.seq for r in pending if not r.sticky]
+        newest = ordinary[-1] if ordinary else -1
+        for i, rnd in enumerate(pending):
+            stale = (not rnd.sticky
+                     and (rnd.metadata_generation != current_gen
+                          or rnd.seq != newest))
             if stale or not rnd.proposals:
                 if rnd.proposals:
                     dropped += 1
@@ -313,9 +333,13 @@ class PipelinedServiceLoop:
                         rnd.metadata_generation, current_gen, newest)
                 continue
             if self.cc.executor.has_ongoing_execution():
-                # keep it queued: an in-flight execution owns the executor
+                # an in-flight execution owns the executor: re-queue this
+                # round AND everything still unprocessed behind it (sticky
+                # heals made multi-execute drains possible — dropping the
+                # tail here would lose queued heals)
                 with self._exec_lock:
-                    self._exec_queue.appendleft(rnd)
+                    for r in reversed(pending[i:]):
+                        self._exec_queue.appendleft(r)
                 break
             self.cc.executor.execute_proposals(
                 rnd.proposals, blocking=blocking,

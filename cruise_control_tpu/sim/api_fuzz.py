@@ -460,6 +460,174 @@ class ApiFuzzer:
         return [dict(e) for e in self.log]
 
 
+# ----------------------------------------------------- cluster-scoped fuzz
+# malformed / unknown cluster_id catalog (PR 13 fleet routing): the invariant
+# is that wrong-tenant access is a DECLARED 404 and garbage a DECLARED 400 —
+# never a 500, never another tenant's data
+_MALFORMED_CLUSTER = (
+    ("traversal", "GET", "/state?cluster_id=..%2F..%2Fetc", ("400",)),
+    ("empty", "GET", "/proposals?cluster_id=", ("400",)),
+    ("overlong", "GET", "/state?cluster_id=" + "x" * 80, ("400",)),
+    ("spacey", "GET", "/state?cluster_id=a%20b", ("400",)),
+    ("unknown_state", "GET", "/state?cluster_id=no-such-tenant", ("404",)),
+    ("unknown_proposals", "GET", "/proposals?cluster_id=ghost", ("404",)),
+    ("unknown_rebalance", "POST",
+     "/rebalance?cluster_id=ghost&dryrun=true&reason=fuzz", ("404",)),
+    ("unknown_user_tasks", "GET", "/user_tasks?cluster_id=ghost", ("404",)),
+    ("unknown_metrics", "GET", "/metrics?cluster_id=ghost", ("404",)),
+    ("unknown_health", "GET", "/health?cluster_id=ghost", ("404",)),
+)
+
+
+class ClusterFuzzer:
+    """Seeded fuzzer for the fleet's cluster-scoped REST routes, run against
+    a live :class:`CruiseControlServer` mounted with a FleetScheduler.
+
+    Op kinds: valid-tenant reads (state/proposals/user_tasks/metrics),
+    valid-tenant dry-run rebalances, the malformed/unknown cluster_id
+    catalog, and cross-tenant user-task resumption — sequential AND a
+    two-thread race — whose invariant is a declared 404 on the WRONG tenant
+    plus zero duplicate executions on the right one. The schedule is a pure
+    function of (seed, ops); statuses/verdicts land in ``log`` and invariant
+    violations in ``failures``.
+    """
+
+    def __init__(self, server, cluster_ids, seed: int = 0, ops: int = 32):
+        self.server = server
+        self.cluster_ids = list(cluster_ids)
+        self.seed = seed
+        self.ops = ops
+        self.log: list[dict] = []
+        self.failures: list[str] = []
+        self.requests = 0
+
+    def _request(self, method: str, path_query: str,
+                 task_id: str | None = None):
+        conn = http.client.HTTPConnection("127.0.0.1", self.server.port,
+                                          timeout=600)
+        try:
+            headers = {"Content-Length": "0"} if method == "POST" else {}
+            if task_id is not None:
+                headers["User-Task-ID"] = task_id
+            conn.request(method, "/kafkacruisecontrol" + path_query,
+                         headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            self.requests += 1
+            body = None
+            if "json" in (resp.getheader("Content-Type") or ""):
+                try:
+                    body = json.loads(raw.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    body = None
+            return resp.status, body, resp.getheader("User-Task-ID")
+        finally:
+            conn.close()
+
+    def _expect(self, entry, status, expected, body=None) -> None:
+        bucket = _classify(status, body)
+        entry["status"] = bucket
+        if bucket not in expected:
+            self.failures.append(
+                f"cluster op {entry['op']} ({entry['kind']}): undeclared "
+                f"status {status} (declared: {expected})")
+
+    def run(self) -> dict:
+        rng = random.Random(f"cluster-fuzz/{self.seed}")
+        kinds = ("state", "proposals", "user_tasks", "metrics",
+                 "rebalance_dryrun", "malformed", "cross_resume",
+                 "cross_resume_race")
+        last_task: tuple[str, str, str] | None = None   # (cid, tid, query)
+        for i in range(self.ops):
+            kind = kinds[rng.randrange(len(kinds))]
+            cid = self.cluster_ids[rng.randrange(len(self.cluster_ids))]
+            entry = {"op": i, "kind": kind, "cluster": cid}
+            degraded_ok = ("2xx", "503")
+            optimize_ok = ("2xx", "503", "optfail")
+            if kind == "state":
+                st, _, _ = self._request(
+                    "GET", f"/state?cluster_id={cid}&substates=ANALYZER")
+                self._expect(entry, st, ("2xx",))
+            elif kind == "proposals":
+                st, body, _ = self._request(
+                    "GET", f"/proposals?cluster_id={cid}")
+                self._expect(entry, st, degraded_ok, body)
+            elif kind == "user_tasks":
+                st, _, _ = self._request(
+                    "GET", f"/user_tasks?cluster_id={cid}")
+                self._expect(entry, st, ("2xx",))
+            elif kind == "metrics":
+                st, _, _ = self._request(
+                    "GET", f"/metrics?cluster_id={cid}")
+                self._expect(entry, st, ("2xx",))
+            elif kind == "rebalance_dryrun":
+                q = f"/rebalance?cluster_id={cid}&dryrun=true&reason=cf{i}"
+                st, body, tid = self._request("POST", q)
+                self._expect(entry, st, optimize_ok, body)
+                if st == 200 and tid:
+                    last_task = (cid, tid, q)
+            elif kind == "malformed":
+                label, method, pathq, expected = _MALFORMED_CLUSTER[
+                    rng.randrange(len(_MALFORMED_CLUSTER))]
+                entry["malformed"] = label
+                st, _, _ = self._request(method, pathq)
+                self._expect(entry, st, expected)
+            elif kind in ("cross_resume", "cross_resume_race"):
+                if last_task is None:
+                    entry["status"] = "skipped"
+                    self.log.append(entry)
+                    continue
+                own_cid, tid, q = last_task
+                others = [c for c in self.cluster_ids if c != own_cid]
+                if not others:
+                    entry["status"] = "skipped"
+                    self.log.append(entry)
+                    continue
+                wrong = others[rng.randrange(len(others))]
+                wq = q.replace(f"cluster_id={own_cid}",
+                               f"cluster_id={wrong}")
+                app = self.server.fleet.app_for(own_cid)
+                before = app.executor.state_json()["numExecutions"]
+                if kind == "cross_resume":
+                    st, body, rtid = self._request("POST", wq, task_id=tid)
+                    self._expect(entry, st, ("404",), body)
+                    if rtid == tid:
+                        self.failures.append(
+                            f"cluster op {i}: tenant {wrong} resolved tenant "
+                            f"{own_cid}'s task id (data leak)")
+                else:
+                    results = [None, None]
+
+                    def poll(slot):
+                        results[slot] = self._request("POST", wq,
+                                                      task_id=tid)
+
+                    threads = [threading.Thread(target=poll, args=(s,))
+                               for s in range(2)]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join(600)
+                    statuses = sorted(_bucket(r[0])
+                                      for r in results if r)
+                    entry["status"] = "/".join(statuses) or "client-error"
+                    if statuses != ["404", "404"]:
+                        self.failures.append(
+                            f"cluster op {i} (cross_resume_race): racing "
+                            f"wrong-tenant resumptions returned {statuses} "
+                            f"(declared: 404/404)")
+                after = app.executor.state_json()["numExecutions"]
+                entry["dup_execution"] = after != before
+                if after != before:
+                    self.failures.append(
+                        f"cluster op {i} ({kind}): wrong-tenant resumption "
+                        f"executed ({before} -> {after})")
+            self.log.append(entry)
+        return {"seed": self.seed, "requests": self.requests,
+                "log": [dict(e) for e in self.log],
+                "failures": list(self.failures)}
+
+
 # --------------------------------------------------------------- episodes
 @dataclasses.dataclass
 class FuzzEpisodeResult:

@@ -646,6 +646,9 @@ def test_every_canonical_key_is_consumed(tmp_path):
         if cfg.get_boolean("service.pipeline.enabled"):
             from cruise_control_tpu.pipeline import PipelinedServiceLoop
             PipelinedServiceLoop(cc, cfg)
+        # fleet mode (PR 13): the scheduler reads the fleet.* family
+        from cruise_control_tpu.fleet import FleetScheduler
+        FleetScheduler(config=cfg).shutdown()
         cc.load_monitor.sample_once(now_ms=0.0)
         cc.load_monitor.sample_once(now_ms=300000.0)
         # self-healing fix path reads the healing-goal + exclusion keys

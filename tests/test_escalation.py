@@ -1,0 +1,126 @@
+"""Certificate-driven budget escalation (PR 13 satellite).
+
+The BENCH_r05 tail closer: a goal exiting violated-unproven with a SMALL
+measured remaining-action count re-enters its finisher once, at the end of
+the chain, with widened windows (finisher_rounds / finisher_swap_passes x
+factor) and EVERY other chain goal's acceptance veto in force.
+
+Outcome-parity certification, PR 4/5 style — here the parity is ONE-SIDED
+by construction (escalated moves ride every goal's veto):
+
+- escalation ON never grows the violated set and never loses a
+  certificate the un-escalated run proved;
+- with no candidates (threshold 0 / escalation off), results are
+  bit-identical to the pre-escalation pipeline — escalation is purely a
+  post-chain pass.
+"""
+from __future__ import annotations
+
+import pytest
+
+from cruise_control_tpu.analyzer.engine import EngineParams
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+from cruise_control_tpu.config import cruise_control_config
+from cruise_control_tpu.model.random_cluster import (
+    RandomClusterSpec, generate,
+)
+
+# budgets tiny enough that the distribution goals exit violated-unproven
+# with small measured remaining counts — the escalation trigger
+TINY = EngineParams(max_iters=2, stall_retries=0, tail_pass_budget=1,
+                    tail_total_budget=2, finisher_rounds=1,
+                    finisher_swap_passes=2)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    return generate(RandomClusterSpec(
+        num_brokers=12, num_racks=3, num_topics=8, num_partitions=400,
+        max_replication=3, seed=7, target_cpu_util=0.5))
+
+
+def _run(ct, meta, escalation: bool, max_remaining: int = 2048):
+    cfg = cruise_control_config({
+        "analyzer.finisher.min.replicas": -1,
+        "analyzer.finisher.escalation": escalation,
+        "analyzer.finisher.escalation.max.remaining": max_remaining,
+        "analyzer.finisher.escalation.factor": 8,
+    })
+    opt = GoalOptimizer(config=cfg, engine_params=TINY)
+    return opt.optimizations(ct, meta, raise_on_failure=False)
+
+
+def _rows(res):
+    return {g.name: g for g in res.goal_results}
+
+
+def test_escalation_closes_unproven_tails_never_worsens(cluster):
+    ct, meta = cluster
+    off = _rows(_run(ct, meta, escalation=False))
+    on = _rows(_run(ct, meta, escalation=True))
+
+    unproven_off = {n for n, g in off.items()
+                    if g.violated_after and not g.fixpoint_proven
+                    and g.moves_remaining >= 0}
+    assert unproven_off, "fixture no longer produces unproven tails"
+    escalated = {n for n, g in on.items() if g.escalations}
+    assert escalated, "escalation never fired"
+    # every escalated goal had a measured (finisher-ran) tail
+    assert escalated <= unproven_off
+
+    # one-sided parity: the violated set only shrinks ...
+    viol_off = {n for n, g in off.items() if g.violated_after}
+    viol_on = {n for n, g in on.items() if g.violated_after}
+    assert viol_on <= viol_off, (viol_on, viol_off)
+    # ... certificates only appear (nothing proven gets un-proven)
+    for n, g in off.items():
+        if g.fixpoint_proven:
+            assert on[n].violated_after is False or on[n].fixpoint_proven, n
+    # ... and the escalation made progress: fewer violated-unproven exits
+    unproven_on = {n for n, g in on.items()
+                   if g.violated_after and not g.fixpoint_proven}
+    assert len(unproven_on) < len(unproven_off), (unproven_on, unproven_off)
+    # hit_max_iters tracks the post-escalation truth
+    for n in escalated:
+        g = on[n]
+        if not g.violated_after or g.fixpoint_proven:
+            assert not g.hit_max_iters, n
+
+
+def test_escalation_with_zero_threshold_is_identical_to_off(cluster):
+    """max.remaining=0 admits only goals whose scans measured ZERO remaining
+    actions; everything else is bit-identical to escalation off — the
+    escalation is a pure post-chain pass."""
+    ct, meta = cluster
+    off = _run(ct, meta, escalation=False)
+    zero = _run(ct, meta, escalation=True, max_remaining=0)
+    esc = [g.name for g in zero.goal_results if g.escalations]
+    r_off, r_zero = _rows(off), _rows(zero)
+    for n, g in r_off.items():
+        if n in esc:
+            continue
+        z = r_zero[n]
+        assert (g.violated_after, g.fixpoint_proven, g.moves_remaining,
+                g.leads_remaining, g.swap_window_remaining,
+                g.iterations) == \
+               (z.violated_after, z.fixpoint_proven, z.moves_remaining,
+                z.leads_remaining, z.swap_window_remaining,
+                z.iterations), n
+    if not esc:
+        assert sorted((p.topic, p.partition, p.new_leader, p.new_replicas)
+                      for p in off.proposals) == \
+               sorted((p.topic, p.partition, p.new_leader, p.new_replicas)
+                      for p in zero.proposals)
+
+
+def test_escalation_skipped_when_finisher_never_ran(cluster):
+    """Small clusters under analyzer.finisher.min.replicas (the tier-1
+    default regime) measure no remaining counts — escalation must be inert
+    there (the default-on knob cannot perturb existing behavior)."""
+    ct, meta = cluster
+    cfg = cruise_control_config({"analyzer.finisher.escalation": True})
+    opt = GoalOptimizer(config=cfg, engine_params=TINY)
+    res = opt.optimizations(ct, meta, raise_on_failure=False)
+    assert all(g.escalations == 0 for g in res.goal_results)
+    assert all(g.moves_remaining < 0 or g.escalations == 0
+               for g in res.goal_results)

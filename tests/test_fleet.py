@@ -1,0 +1,378 @@
+"""Fleet mode certification (PR 13): batched multi-tenant optimization.
+
+The tentpole contracts:
+
+1. **Batched parity** — K same-bucket tenants optimized in ONE vmapped
+   launch produce per-tenant violation/certificate/proposal sets (and final
+   assignment arrays) BIT-IDENTICAL to K solo runs.
+2. **Steady fleet rounds** — the second batched round runs delta-mode
+   syncs, ZERO new XLA compiles and donated sessions; launches/round equals
+   #buckets, not #tenants.
+3. **Memory-budget eviction** — a cold tenant spilled to host mirrors and
+   re-admitted is bit-identical to never-spilled (leaf-by-leaf, including
+   the Kahan residual leaves) and re-admission of a same-bucket tenant
+   costs zero new XLA compiles.
+4. **Per-tenant pause/resume + generation staleness** — paused tenants are
+   skipped (still servable from cache), resumed ones ride the next round;
+   a tenant with nothing new synced is not re-optimized.
+5. **Cluster-scoped REST routing** — ``?cluster_id=`` dispatches to the
+   tenant's facade: unknown ids are a DECLARED 404, malformed ones 400,
+   per-tenant user-task quota overflow 429, and a task id can never be
+   resumed (or raced) across tenants — wrong-tenant access is a 404,
+   never a 500 and never another tenant's data.
+"""
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.app import CruiseControl
+from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+from cruise_control_tpu.common.tracing import XlaCompileListener
+from cruise_control_tpu.config import cruise_control_config
+from cruise_control_tpu.fleet import FleetScheduler, valid_cluster_id
+
+WINDOW_MS = 300_000.0
+
+
+def _backend(seed, num_brokers=10, num_partitions=60, rf=2):
+    rng = np.random.default_rng(seed)
+    be = SimulatedClusterBackend()
+    for b in range(num_brokers):
+        be.add_broker(b, f"r{b % 3}")
+    for p in range(num_partitions):
+        reps = [int(x) for x in rng.choice(num_brokers, size=rf,
+                                           replace=False)]
+        be.create_partition(f"t{p % 6}", p, reps,
+                            size_mb=float(rng.uniform(10, 500)),
+                            bytes_in_rate=float(rng.uniform(1, 50)),
+                            bytes_out_rate=float(rng.uniform(1, 100)),
+                            cpu_util=float(rng.uniform(0.1, 5)))
+    return be
+
+
+def _cfg(**over):
+    props = {"anomaly.detection.interval.ms": 10_000_000}
+    props.update(over)
+    return cruise_control_config(props)
+
+
+def _sample(cc, lo=0, hi=6):
+    for i in range(lo, hi):
+        cc.load_monitor.sample_once(now_ms=i * WINDOW_MS)
+
+
+def _goal_sets(res):
+    """(violated set, certificate rows, proposal rows) — the parity unit."""
+    return (
+        sorted(g.name for g in res.goal_results if g.violated_after),
+        sorted((g.name, g.fixpoint_proven, g.moves_remaining,
+                g.leads_remaining, g.swap_window_remaining)
+               for g in res.goal_results),
+        sorted((p.topic, p.partition, p.new_leader, p.new_replicas)
+               for p in res.proposals))
+
+
+SEEDS = (11, 12, 13)
+
+
+@pytest.fixture(scope="module")
+def fleet3():
+    """Three same-bucket tenants, sampled and already past their first
+    (epoch+compile-paying) batched round."""
+    fleet = FleetScheduler(config=_cfg())
+    for s in SEEDS:
+        t = fleet.add_tenant(f"tenant-{s}", backend=_backend(s),
+                             config=_cfg())
+        _sample(t.cc)
+    fleet.run_round(now_ms=2_000_000.0)
+    yield fleet
+    fleet.shutdown()
+
+
+# ----------------------------------------------------------- batched parity
+def test_batched_parity_bit_identical_to_solo():
+    """The tentpole certificate: per-tenant verdicts, certificates,
+    proposal sets and the final assignment arrays from one vmapped launch
+    equal three solo runs bitwise."""
+    solo = []
+    for s in SEEDS:
+        cc = CruiseControl(_backend(s), config=_cfg())
+        _sample(cc)
+        cc.resident_session.sync()
+        res = cc.goal_optimizer.optimizations(
+            None, None, raise_on_failure=False, session=cc.resident_session)
+        solo.append(res)
+
+    fleet = FleetScheduler(config=_cfg())
+    for s in SEEDS:
+        t = fleet.add_tenant(f"tenant-{s}", backend=_backend(s),
+                             config=_cfg())
+        _sample(t.cc)
+    report = fleet.run_round(now_ms=2_000_000.0)
+    assert report["launches"] == 1          # one bucket => ONE launch
+    assert len(report["buckets"]) == 1
+    assert sorted(report["optimized"]) == sorted(
+        f"tenant-{s}" for s in SEEDS)
+    for s, ref in zip(SEEDS, solo):
+        res = fleet.app_for(f"tenant-{s}").cached_proposals()
+        assert _goal_sets(res) == _goal_sets(ref), f"tenant {s}"
+        # final assignment arrays, bitwise
+        for leaf in ("replica_broker", "replica_is_leader", "replica_disk"):
+            a = np.asarray(getattr(ref.final_state, leaf))
+            b = np.asarray(getattr(res.final_state, leaf))
+            assert np.array_equal(a, b), f"tenant {s} {leaf}"
+    fleet.shutdown()
+
+
+def test_steady_round_zero_compiles_delta_donated(fleet3):
+    fleet = fleet3
+    for t in fleet.tenants.values():
+        t.cc.load_monitor.sample_once(now_ms=7 * WINDOW_MS)
+    donated0 = {cid: t.session.donated_rounds
+                for cid, t in fleet.tenants.items()}
+    listener = XlaCompileListener.install()
+    c0 = listener.count
+    report = fleet.run_round(now_ms=2_400_000.0)
+    assert listener.count - c0 == 0, "steady fleet round compiled"
+    assert report["launches"] == 1
+    for cid, t in fleet.tenants.items():
+        assert t.session.last_sync_info["mode"] == "delta", cid
+        assert t.session.donated_rounds == donated0[cid] + 1, cid
+
+
+def test_fresh_tenant_not_reoptimized(fleet3):
+    """Generation staleness: with nothing new synced, a round optimizes
+    nobody (and launches nothing)."""
+    fleet = fleet3
+    fleet.run_round(now_ms=2_500_000.0)       # drain any pending generation
+    report = fleet.run_round(now_ms=2_600_000.0)
+    assert report["launches"] == 0
+    assert report["optimized"] == []
+    assert all(v == "fresh" for v in report["skipped"].values())
+
+
+def test_pause_resume(fleet3):
+    fleet = fleet3
+    cid = f"tenant-{SEEDS[0]}"
+    fleet.pause(cid)
+    for t in fleet.tenants.values():
+        t.cc.load_monitor.sample_once(now_ms=8 * WINDOW_MS)
+    report = fleet.run_round(now_ms=2_700_000.0)
+    assert report["skipped"][cid] == "paused"
+    assert cid not in report["optimized"]
+    # still servable from the cached proposals while paused
+    assert fleet.app_for(cid).cached_proposals() is not None
+    fleet.resume(cid)
+    fleet.tenants[cid].cc.load_monitor.sample_once(now_ms=9 * WINDOW_MS)
+    report = fleet.run_round(now_ms=2_800_000.0)
+    assert cid in report["optimized"]
+
+
+# ------------------------------------------------- memory budget + spill
+def test_spill_readmit_bit_identical_and_zero_compiles(fleet3):
+    """Satellite: spill a cold tenant, re-admit it, assert the rebuilt
+    resident env/state is bit-identical to never-spilled — every leaf,
+    dtypes included, Kahan residuals included — and that re-admission of a
+    same-bucket tenant compiles nothing."""
+    fleet = fleet3
+    t = fleet.tenants[f"tenant-{SEEDS[1]}"]
+    sess = t.session
+    sess._ensure_state()
+    pre_env = {f.name: np.asarray(getattr(sess.env, f.name)).copy()
+               for f in dataclasses.fields(sess.env)}
+    pre_state = {f.name: np.asarray(getattr(sess.state, f.name)).copy()
+                 for f in dataclasses.fields(sess.state)}
+    assert "util_residual" in pre_state          # the Kahan leaves are in
+    assert sess.spill()
+    assert sess.spilled
+    b = sess.device_bytes()
+    assert b["env_bytes"] == 0 and b["state_bytes"] == 0
+    listener = XlaCompileListener.install()
+    c0 = listener.count
+    assert sess.readmit()
+    assert listener.count - c0 == 0, "readmit compiled"
+    for name, a in pre_env.items():
+        v = np.asarray(getattr(sess.env, name))
+        assert a.dtype == v.dtype and np.array_equal(a, v), f"env.{name}"
+    for name, a in pre_state.items():
+        v = np.asarray(getattr(sess.state, name))
+        assert a.dtype == v.dtype and np.array_equal(a, v), f"state.{name}"
+
+
+def test_memory_budget_lru_spills_coldest_and_sync_readmits(fleet3):
+    fleet = fleet3
+    # make tenant LRU ranks distinct: re-optimize everyone, then only the
+    # last two — tenant[0] becomes the coldest
+    ids = [f"tenant-{s}" for s in SEEDS]
+    for t in fleet.tenants.values():
+        t.cc.load_monitor.sample_once(now_ms=10 * WINDOW_MS)
+    fleet.run_round(now_ms=3_000_000.0)
+    for cid in ids[1:]:
+        fleet.tenants[cid].cc.load_monitor.sample_once(now_ms=11 * WINDOW_MS)
+    fleet.run_round(now_ms=3_100_000.0)
+    resident = fleet.device_bytes()
+    assert resident > 0
+    # budget that forces exactly one eviction
+    one_tenant = fleet.tenants[ids[0]].session.device_bytes()
+    one = one_tenant["env_bytes"] + one_tenant["state_bytes"]
+    fleet.memory_budget_bytes = resident - 1
+    spilled = fleet.enforce_memory_budget()
+    assert spilled == [ids[0]], spilled          # the coldest went first
+    assert fleet.device_bytes() <= resident - one
+    fleet.memory_budget_bytes = -1
+    # the next sync re-admits implicitly (the spilled tenant was touched)
+    sess = fleet.tenants[ids[0]].session
+    fleet.tenants[ids[0]].cc.load_monitor.sample_once(now_ms=12 * WINDOW_MS)
+    info = sess.sync()
+    assert info["mode"] == "delta"               # NOT a rebuild: re-admitted
+    assert not sess.spilled
+    assert sess.readmits >= 1
+    assert sess.state_json()["spills"] >= 1
+
+
+# --------------------------------------------------- cluster-scoped REST
+def _req(port, method, pathq, task_id=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        headers = {"Content-Length": "0"} if method == "POST" else {}
+        if task_id:
+            headers["User-Task-ID"] = task_id
+        conn.request(method, "/kafkacruisecontrol" + pathq, headers=headers)
+        r = conn.getresponse()
+        raw = r.read()
+        tid = r.getheader("User-Task-ID")
+        try:
+            return r.status, json.loads(raw.decode("utf-8")), tid
+        except ValueError:
+            return r.status, None, tid
+    finally:
+        conn.close()
+
+
+@pytest.fixture(scope="module")
+def fleet_server(fleet3):
+    from cruise_control_tpu.api.server import CruiseControlServer
+    default_cc = fleet3.app_for(f"tenant-{SEEDS[0]}")
+    server = CruiseControlServer(default_cc, config=default_cc.config,
+                                 fleet=fleet3)
+    server.start()
+    yield fleet3, server
+    server.stop()
+
+
+def test_cluster_id_valid_unknown_malformed(fleet_server):
+    fleet, server = fleet_server
+    port = server.port
+    cid = f"tenant-{SEEDS[1]}"
+    st, body, _ = _req(port, "GET", f"/state?cluster_id={cid}"
+                                    "&substates=ANALYZER,FLEET")
+    assert st == 200
+    assert body["AnalyzerState"]["isProposalReady"]
+    assert "FleetState" in body and cid in body["FleetState"]["tenants"]
+    st, _, _ = _req(port, "GET", f"/proposals?cluster_id={cid}")
+    assert st == 200
+    # unknown tenant: DECLARED 404 on reads, writes and the text endpoints
+    for pathq in ("/state?cluster_id=no-such-tenant",
+                  "/proposals?cluster_id=ghost",
+                  "/user_tasks?cluster_id=ghost",
+                  "/metrics?cluster_id=ghost",
+                  "/health?cluster_id=ghost"):
+        st, _, _ = _req(port, "GET", pathq)
+        assert st == 404, pathq
+    st, _, _ = _req(port, "POST",
+                    "/rebalance?cluster_id=ghost&dryrun=true&reason=x")
+    assert st == 404
+    # malformed ids: 400, never dispatched
+    assert not valid_cluster_id("../etc")
+    for bad in ("..%2F..%2Fetc", "", "a%20b", "x" * 80):
+        st, _, _ = _req(port, "GET", f"/state?cluster_id={bad}")
+        assert st == 400, bad
+    # cluster-scoped /metrics serves the TENANT's registry
+    st, _, _ = _req(port, "GET", f"/metrics?cluster_id={cid}")
+    assert st == 200
+
+
+def test_cross_tenant_task_resumption_is_404_and_never_executes(
+        fleet_server):
+    fleet, server = fleet_server
+    port = server.port
+    own, other = f"tenant-{SEEDS[1]}", f"tenant-{SEEDS[2]}"
+    q = f"/rebalance?cluster_id={own}&dryrun=true&reason=xt"
+    st, _, tid = _req(port, "POST", q)
+    assert st == 200 and tid
+    wrong_q = q.replace(own, other)
+    before = fleet.app_for(own).executor.state_json()["numExecutions"]
+    st, body, rtid = _req(port, "POST", wrong_q, task_id=tid)
+    assert st == 404, body                      # declared, not a 500
+    assert rtid != tid                          # no cross-tenant data leak
+    # ... and under a two-thread race
+    results = [None, None]
+
+    def poll(slot):
+        results[slot] = _req(port, "POST", wrong_q, task_id=tid)
+
+    threads = [threading.Thread(target=poll, args=(s,)) for s in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(60)
+    assert sorted(r[0] for r in results) == [404, 404]
+    after = fleet.app_for(own).executor.state_json()["numExecutions"]
+    assert after == before                      # nothing executed anywhere
+
+
+def test_per_tenant_user_task_quota_is_429_and_isolated(fleet_server):
+    fleet, server = fleet_server
+    port = server.port
+    own, other = f"tenant-{SEEDS[1]}", f"tenant-{SEEDS[2]}"
+    _, own_tasks = server.tenant_binding(own)
+    # fill the tenant's quota with blocking tasks (white-box: the quota is
+    # the manager's max_active)
+    release = threading.Event()
+    from cruise_control_tpu.api.endpoints import EndPoint
+    for i in range(server._tenant_task_quota):
+        own_tasks.get_or_create_task(
+            f"filler-{i}", EndPoint.PROPOSALS, "GET", {"i": i},
+            lambda progress: release.wait(60) and {})
+    try:
+        st, body, _ = _req(port, "POST",
+                           f"/rebalance?cluster_id={own}&dryrun=true"
+                           f"&reason=quota")
+        assert st == 429, body                  # declared quota overflow
+        # quota isolation: the OTHER tenant still has slots
+        st, _, _ = _req(port, "POST",
+                        f"/rebalance?cluster_id={other}&dryrun=true"
+                        f"&reason=quota-ok")
+        assert st == 200
+    finally:
+        release.set()
+
+
+def test_cluster_fuzzer_deterministic_and_clean(fleet_server):
+    """Satellite: the seeded cluster-scoped fuzzer (sim/api_fuzz.py) finds
+    no invariant violations, and the same seed reproduces the same log."""
+    from cruise_control_tpu.sim.api_fuzz import ClusterFuzzer
+    fleet, server = fleet_server
+    ids = fleet.cluster_ids
+    out1 = ClusterFuzzer(server, ids, seed=3, ops=24).run()
+    assert out1["failures"] == [], out1["failures"]
+    out2 = ClusterFuzzer(server, ids, seed=3, ops=24).run()
+    assert out1["log"] == out2["log"]
+
+
+# ----------------------------------------------------------- fleet state
+def test_fleet_state_and_staleness(fleet3):
+    state = fleet3.state_json()
+    assert state["rounds"] >= 2
+    assert state["launches"] >= 1
+    rows = state["tenants"]
+    assert set(rows) == {f"tenant-{s}" for s in SEEDS}
+    # staleness samples recorded at refreshes past the first
+    assert any(r["stalenessP95Ms"] is not None for r in rows.values())
+    assert state["deviceBytes"] > 0

@@ -263,6 +263,85 @@ def test_superseded_round_dropped_newest_executes(warm_loop):
     assert st["proposalGeneration"] == rnd.metadata_generation
 
 
+# ----------------------------------------------- routed FIX executions
+def test_fix_routed_through_execute_stage_with_span_lineage():
+    """PR 13 satellite (PR 11 residual c): with the THREADED pipeline, a
+    self-healing operation submits its execution to the execute stage and
+    returns immediately — the heal drains async on the pipeline's thread,
+    the round is STICKY (a metadata-generation bump cannot drop it), and
+    the PR 12 span lineage survives: the operation span has an "execution"
+    child in the trace tree."""
+    import time as _time
+    # skewed placement: every replica on brokers 0-2 of 8 — the
+    # self-healing chain (ReplicaDistributionGoal) must emit a real heal
+    be = SimulatedClusterBackend()
+    for b in range(8):
+        be.add_broker(b, f"r{b % 4}")
+    rng = np.random.default_rng(5)
+    for p in range(60):
+        be.create_partition("t%d" % (p % 6), p, [p % 3, (p + 1) % 3],
+                            size_mb=float(rng.exponential(100.0)),
+                            bytes_in_rate=5.0, bytes_out_rate=3.0,
+                            cpu_util=0.2)
+    cc = _app(be)
+    for _ in range(4):
+        be.advance(WINDOW_MS)
+        cc.load_monitor.sample_once()
+    pipe = PipelinedServiceLoop(cc)
+    cc.service_pipeline = pipe
+    # lockstep mode never routes (sim determinism) ...
+    assert not pipe.accepts_fix_routing()
+    assert not cc._route_fixes_async()
+    pipe.start()
+    try:
+        # ... the threaded pipeline does
+        assert pipe.accepts_fix_routing()
+        assert cc._route_fixes_async()
+        out = cc.rebalance(self_healing=True, dry_run=False,
+                           reason="routed heal")
+        assert out["executed"] is True
+        # the execution drains on the pipeline's execute thread
+        deadline = _time.monotonic() + 120.0
+        while _time.monotonic() < deadline:
+            st = cc.executor.state_json()
+            if (pipe.executions_drained >= 1 and st["numExecutions"] >= 1
+                    and not cc.executor.has_ongoing_execution()):
+                break
+            _time.sleep(0.05)
+        assert pipe.executions_drained >= 1
+        assert cc.executor.state_json()["numExecutions"] >= 1
+        assert cc.sensors.meter(
+            "pipeline-routed-fixes").to_json()["count"] == 1
+    finally:
+        pipe.stop()
+    # span lineage: operation span -> execution child, walkable in the tree
+    trees = cc.tracer.to_json()["trees"]
+    op_nodes = [n for t in trees for n in t["roots"]
+                if n["span_kind"] == "operation" and n["name"] == "REBALANCE"]
+    assert op_nodes, trees
+    kinds = {c["span_kind"] for n in op_nodes for c in n["children"]}
+    assert "execution" in kinds, op_nodes
+
+
+def test_sticky_round_survives_generation_bump():
+    """A routed heal (sticky) executes even after the metadata generation
+    moved; an ordinary round beside it is still dropped."""
+    be = _backend(seed=6)
+    cc = _app(be)
+    for _ in range(4):
+        be.advance(WINDOW_MS)
+        cc.load_monitor.sample_once()
+    pipe = PipelinedServiceLoop(cc)
+    cc.service_pipeline = pipe
+    res = cc.cached_proposals()
+    assert len(res.proposals) >= 2
+    pipe.submit_execution(res.proposals[:1])                  # ordinary
+    pipe.submit_execution(res.proposals[1:2], sticky=True)    # routed heal
+    be.add_broker(97, "r9")                  # metadata generation bump
+    out = pipe.drain_executions()
+    assert out == {"executed": 1, "dropped": 1}
+
+
 # ------------------------------------------------------------- determinism
 @pytest.mark.slow
 def test_sim_pipelined_timeline_bit_identical_and_matches_blocking():

@@ -159,6 +159,55 @@ def compare_steady(base: dict, cand: dict, threshold: float = 0.25):
     return rows, regressions
 
 
+def extract_fleet(doc: dict) -> dict:
+    """The bench summary's ``fleet`` block (bench.py --fleet N), or {}."""
+    fleet = doc.get("fleet")
+    return fleet if isinstance(fleet, dict) else {}
+
+
+def compare_fleet(base: dict, cand: dict, threshold: float = 0.25):
+    """Gate the fleet rung between two bench summaries: a batched warm wall
+    more than the threshold above the baseline's, a batched-vs-solo
+    set-identity loss, fresh steady-round compiles, or launches/round
+    growing past the baseline (batching degraded toward per-tenant
+    launches) all fail."""
+    rows, regressions = [], []
+    bw, cw = base.get("batched_warm_s"), cand.get("batched_warm_s")
+    if bw is not None and cw is not None:
+        row = {"kind": "fleet", "field": "batched_warm_s",
+               "base_p95": bw, "cand_p95": cw}
+        if cw > bw * (1.0 + threshold):
+            row["regression"] = (f"batched wall {cw:.2f}s > {bw:.2f}s "
+                                 f"* (1 + {threshold:g})")
+            regressions.append(row)
+        rows.append(row)
+    if base.get("parity_identical_sets") \
+            and cand.get("parity_identical_sets") is False:
+        row = {"kind": "fleet", "field": "parity_identical_sets",
+               "base_p95": 1, "cand_p95": 0,
+               "regression": "batched-vs-solo set identity lost"}
+        regressions.append(row)
+        rows.append(row)
+    bc = base.get("steady_new_compiles")
+    cc = cand.get("steady_new_compiles")
+    if bc == 0 and (cc or 0) > 0:
+        row = {"kind": "fleet", "field": "steady_new_compiles",
+               "base_p95": bc, "cand_p95": cc,
+               "regression": "steady fleet round recompiled "
+                             "(baseline did not)"}
+        regressions.append(row)
+        rows.append(row)
+    bl, cl = base.get("launches_per_round"), cand.get("launches_per_round")
+    if bl is not None and cl is not None and cl > bl:
+        row = {"kind": "fleet", "field": "launches_per_round",
+               "base_p95": bl, "cand_p95": cl,
+               "regression": f"launches/round {bl} -> {cl} "
+                             f"(batching degraded)"}
+        regressions.append(row)
+        rows.append(row)
+    return rows, regressions
+
+
 def load_doc(path: str) -> tuple[dict, bool]:
     """Load one input; returns (document, is_journal). A JSONL event
     journal is detected by its per-line records and converted to a
@@ -221,6 +270,13 @@ def main(argv: list[str]) -> int:
         srows, sregs = compare_steady(sbase, scand, threshold)
         rows.extend(srows)
         regressions.extend(sregs)
+        compared = True
+    # ... and on the fleet rung (batched wall / parity / compiles / launches)
+    fbase, fcand = extract_fleet(base_doc), extract_fleet(cand_doc)
+    if fbase and fcand:
+        frows, fregs = compare_fleet(fbase, fcand, threshold)
+        rows.extend(frows)
+        regressions.extend(fregs)
         compared = True
     if not compared:
         print("no comparable SLO or steady-round blocks found in both "
