@@ -28,7 +28,7 @@ timeout is a bench that doesn't exist):
   SIGTERM first).
 
 Usage: bench.py [rung ...] [--profile] [--skip-cold] [--scenario [name]]
-               [--campaign [name]] [--campaign-seed N]
+               [--campaign [name]] [--campaign-seed N] [--ha [name]]
                [--rung name] [--profile-level off|pass|stage]
   --profile    block per goal for honest per-goal seconds (adds tunnel
                round-trips; not for wall-clock claims)
@@ -46,6 +46,12 @@ Usage: bench.py [rung ...] [--profile] [--skip-cold] [--scenario [name]]
                and writes the full episode log to CAMPAIGN_<name>_s<seed>.json
   --campaign-seed  campaign seed (default 0); same (campaign, seed) =>
                bit-identical episode log
+  --ha [name]  run the HA failover rung (sim/ha.py two-controller runner
+               driving a leader_kill chaos campaign, default ha-micro);
+               emits an "ha" block with failover-time SLO distributions
+               (detect-lease-loss / promote / first-proposal p50/p95,
+               simulated ms), journal lag, adopted-task counts and the
+               single-controller parity verdict — slo_diff gates it
   --fuzz [N]   with --campaign: run every episode with the seeded REST
                fuzzer + FaultyBackend attached (sim/api_fuzz.py, fuzz seed
                N, default 0); emits fuzz request/failure counts and writes
@@ -105,6 +111,7 @@ RUNG_COST_EST = {
     "scenario": (150, 60),
     "campaign": (300, 120),
     "fleet": (300, 120),
+    "ha": (260, 130),
 }
 
 
@@ -157,6 +164,7 @@ class Summary:
         self.scenario: dict | None = None   # self-healing closed-loop latency
         self.campaign: dict | None = None   # chaos-campaign SLO distributions
         self.fleet: dict | None = None      # batched multi-tenant figures
+        self.ha: dict | None = None         # HA failover SLOs + parity
         self.headline_requested = True      # set from the requested rung list
 
     def emit(self, final: bool = False) -> None:
@@ -184,6 +192,10 @@ class Summary:
                 metric = (f"fleet batched round wall-clock "
                           f"({self.fleet['tenants']} tenants, one launch)")
                 value = self.fleet["batched_warm_s"]
+            elif self.ha is not None:
+                metric = (f"HA failover campaign wall-clock "
+                          f"({self.ha['name']}, leader kill mid-heal)")
+                value = self.ha["wall_s"]
             elif ran:
                 metric = f"rebalance proposal wall-clock @ {ran[0]['config']}"
                 value = ran[0].get("wall_s")
@@ -215,6 +227,12 @@ class Summary:
             # fleet block (cruise_control_tpu/fleet.py --fleet N): batched
             # wall vs sum-of-solo, launches/round, parity, staleness, bytes
             out["fleet"] = self.fleet
+        if self.ha is not None:
+            # HA block (sim/ha.py --ha): failover-time distributions
+            # (detect-lease-loss / promote / first-proposal, SIMULATED ms),
+            # adoption counts, adopt-not-abort, single-controller parity —
+            # tools/slo_diff.py gates it (extract_ha / compare_ha)
+            out["ha"] = self.ha
         # pretty block first (humans + trace_view's whole-file parse of
         # BENCH_partial.json), then ONE compact machine-parseable line —
         # always the last stdout line, small enough that the driver's tail
@@ -470,6 +488,19 @@ def main() -> None:
         else:
             argv = argv[:i] + argv[i + 1:]
         argv.append("fleet")
+    ha_campaign = "ha-micro"
+    if "--ha" in argv:
+        # --ha [name]: run the HA failover rung — a leader_kill campaign
+        # under the two-controller HaScenarioRunner (sim/ha.py): kill the
+        # leader mid-heal, promote the warm standby, certify outcome parity
+        # against the single-controller oracle run
+        i = argv.index("--ha")
+        if i + 1 < len(argv) and not argv[i + 1].startswith("--"):
+            ha_campaign = argv[i + 1]
+            argv = argv[:i] + argv[i + 2:]
+        else:
+            argv = argv[:i] + argv[i + 1:]
+        argv.append("ha")
     fuzz_seed = None
     if "--fuzz" in argv:
         # --fuzz [N]: run the campaign episodes with the REST fuzzer +
@@ -624,6 +655,11 @@ def main() -> None:
             # batched multi-tenant rung: N tenants, one vmapped launch per
             # round; batched wall vs sum-of-solo, parity, staleness, bytes
             rung = run_fleet_rung(fleet_tenants)
+
+        elif rung_id == "ha":
+            # HA failover rung: leader kill mid-heal under the
+            # two-controller runner -> failover SLOs + oracle parity
+            rung = run_ha_rung(ha_campaign, campaign_seed)
 
         elif rung_id == "e2e7k":
             # the full monitor path at HEADLINE scale: backend -> samples ->
@@ -903,6 +939,67 @@ def _run_fuzz_campaign_rung(name: str, seed: int, fuzz_seed: int) -> dict:
     log(f"  [campaign] {doc['converged_episodes']}/{doc['num_episodes']} "
         f"episodes converged under fuzz, {doc['fuzz_requests']} REST "
         f"requests, {len(doc['failures'])} failures, wall={wall}s")
+    return rung
+
+
+def run_ha_rung(name: str = "ha-micro", seed: int = 0) -> dict:
+    """HA failover rung (--ha [name]): run a leader_kill chaos campaign
+    under the two-controller HaScenarioRunner (sim/ha.py) — leader with a
+    durable journal + sample store, warm standby tailing both — and report
+    the failover story: failover-time distributions (detect-lease-loss /
+    promote / first-proposal, SIMULATED ms from the kill instant), journal
+    lag at promotion, adopted task counts, the adopt-not-abort guarantee,
+    and outcome parity with the single-controller oracle run of the same
+    (scenario, seed). tools/slo_diff.py gates the emitted "ha" block
+    (extract_ha / compare_ha)."""
+    from cruise_control_tpu.sim import run_campaign
+    from cruise_control_tpu.sim.campaign import aggregate_failover
+
+    log(f"rung ha: failover campaign ({name}, seed {seed}) — "
+        f"leader kill mid-heal, warm standby promotes")
+    t0 = time.monotonic()
+    res = run_campaign(name, seed=seed)
+    wall = round(time.monotonic() - t0, 2)
+    fo = aggregate_failover(res.episodes)
+    failures = [f for r in res.episodes for f in r.failures]
+
+    def p(block: str, q: str):
+        return (fo.get(block) or {}).get(q)
+
+    rung = {
+        "config": f"ha-{name}-s{seed}",
+        "wall_s": wall,
+        "episodes": len(res.episodes),
+        "failover_episodes": fo.get("episodes", 0),
+        "converged_episodes": sum(1 for r in res.episodes if r.converged),
+        # failover-time SLOs, simulated ms measured from the kill instant
+        "detect_lease_loss_ms_p50": p("detect_lease_loss_ms", "p50"),
+        "detect_lease_loss_ms_p95": p("detect_lease_loss_ms", "p95"),
+        "failover_ms_p50": p("promote_ms", "p50"),
+        "failover_ms_p95": p("promote_ms", "p95"),
+        "first_proposal_ms_p50": p("first_proposal_ms", "p50"),
+        "first_proposal_ms_p95": p("first_proposal_ms", "p95"),
+        "journal_lag_events": max(
+            (r.failover.get("journal_lag_events", 0)
+             for r in res.episodes if r.failover), default=0),
+        "adopted_tasks": p("adopted_tasks", "max"),
+        "adopted_in_flight": p("adopted_in_flight", "max"),
+        "aborted_by_failover": fo.get("aborted_by_failover", 0),
+        "parity_ok": bool(fo.get("parity_ok", False)),
+        "failures": failures,
+    }
+    # SUMMARY.ha carries the raw distribution blocks so slo_diff's
+    # extract_ha/compare_ha can gate p95s without re-deriving them
+    SUMMARY.ha = dict(fo, name=name, seed=seed, wall_s=wall,
+                      journal_lag_events=rung["journal_lag_events"],
+                      failures=failures)
+    log(f"  [ha] promote p95={rung['failover_ms_p95']}ms "
+        f"first-proposal p95={rung['first_proposal_ms_p95']}ms "
+        f"adopted={rung['adopted_tasks']} "
+        f"(in-flight {rung['adopted_in_flight']}) "
+        f"aborted={rung['aborted_by_failover']} "
+        f"journal_lag={rung['journal_lag_events']} "
+        f"parity_ok={rung['parity_ok']}, wall={wall}s")
     return rung
 
 

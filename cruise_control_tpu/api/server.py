@@ -402,6 +402,15 @@ class CruiseControlServer:
         # a read of the existing task and passes through
         if (method == "POST" and params.get("dryrun", True) is not True
                 and not task_id_header):
+            # HA write gate: only the lease-holding leader mutates the
+            # cluster — a standby 503s with Retry-After = its election
+            # cadence, without consuming a user-task slot
+            ha = getattr(app, "ha", None)
+            if ha is not None and ha.role != "leader":
+                raise ServiceUnavailableError(
+                    f"{endpoint.path} rejected: this instance is a "
+                    f"{ha.role}, not the leader",
+                    retry_after_s=ha.retry_after_s())
             degraded = getattr(app, "degraded", None)
             if degraded is not None and degraded():
                 raise ServiceUnavailableError(
@@ -571,14 +580,25 @@ class CruiseControlServer:
     # -------------------------------------------------------------- sync
     def _run_sync(self, endpoint: EndPoint, p: dict, app=None) -> dict:
         app = app if app is not None else self.app
+        # standby reads serve, but carry an explicit staleness marker: the
+        # mirror trails the leader by the journal/sample tail lag
+        ha = getattr(app, "ha", None)
+        standby = ha is not None and ha.role != "leader"
         if endpoint is EndPoint.STATE:
             out = app.state_json(substates=p["substates"] or None)
             if (self.fleet is not None
                     and "FLEET" in [x.upper() for x in (p["substates"] or [])]):
                 out["FleetState"] = self.fleet.state_json()
+            if standby:
+                out["stale"] = True
+                out["staleReason"] = "standby mirror"
             return wrap(out)
         if endpoint is EndPoint.KAFKA_CLUSTER_STATE:
-            return wrap(app.kafka_cluster_state(verbose=bool(p["verbose"])))
+            out = app.kafka_cluster_state(verbose=bool(p["verbose"]))
+            if standby:
+                out["stale"] = True
+                out["staleReason"] = "standby mirror"
+            return wrap(out)
         if endpoint is EndPoint.PAUSE_SAMPLING:
             return wrap(app.pause_sampling(p["reason"] or "operator request"))
         if endpoint is EndPoint.RESUME_SAMPLING:

@@ -75,6 +75,11 @@ class CruiseControl:
         # kafka.cruisecontrol role (KafkaCruiseControlApp.java:29,40); exported
         # via /state?substates=SENSORS and GET /metrics (Prometheus text)
         self.sensors = MetricRegistry()
+        # HA role handle (cruise_control_tpu/ha): a LeaderElector (this
+        # instance leads) or StandbyController (this instance tails a
+        # leader) attaches itself here. None = single-controller deployment,
+        # which serves as an implicit leader.
+        self.ha = None
         # one durable event journal + span tracer for the whole app
         # (common/tracing.py): the recorder's round summaries, every causal
         # span (detector verdict -> operation -> optimize round -> executor
@@ -449,6 +454,17 @@ class CruiseControl:
         an unreachable backend would only start an execution that immediately
         pauses — reject it up front with 503 + Retry-After instead
         (api/server.py maps ServiceUnavailableError)."""
+        if self.ha is not None and self.ha.role != "leader":
+            # standby instances serve stale-flagged reads only: a write here
+            # would race the leader's executor on the same backend
+            from cruise_control_tpu.common.retries import (
+                ServiceUnavailableError,
+            )
+            self.sensors.meter("standby-write-rejections").mark()
+            raise ServiceUnavailableError(
+                f"{operation} rejected: this instance is a "
+                f"{self.ha.role}, not the leader",
+                retry_after_s=self.ha.retry_after_s())
         ft = self.fault_tolerance
         if ft.degraded():
             from cruise_control_tpu.common.retries import (
@@ -1224,6 +1240,10 @@ class CruiseControl:
         if "PIPELINE" in substates and self.service_pipeline is not None:
             # the continuous pipelined loop's stage/backpressure state
             out["PipelineState"] = self.service_pipeline.state_json()
+        if self.ha is not None:
+            # always present when an HA role is attached: clients routing
+            # writes need the role regardless of which substates they asked
+            out["HaState"] = self.ha.state_json()
         return out
 
     def health_json(self) -> dict:
@@ -1271,8 +1291,16 @@ class CruiseControl:
             m = snap.get(name)
             return m.get("count", 0) if isinstance(m, dict) else 0
 
+        ha = None
+        if self.ha is not None:
+            hs = self.ha.state_json()
+            ha = {"role": hs.get("role"), "lease": hs.get("lease"),
+                  "journalLagEvents": hs.get("journalLagEvents")}
         return {
             "status": status, "nowMs": self._now_ms(),
+            # single-controller deployments are an implicit leader
+            "role": self.ha.role if self.ha is not None else "leader",
+            "ha": ha,
             "slo": {"detect": detect, "heal": heal, "requests": requests,
                     "breached": len(breached)},
             "degraded": ft["degraded"],

@@ -170,3 +170,13 @@ class ClusterBackend(Protocol):
     # deletes them (value None) after execution
     def set_topic_config(self, topic: str, key: str, value) -> None: ...
     def topic_configs(self) -> dict: ...
+
+    # -- coordination (ZK ephemeral-node / lease role) --
+    # atomic compare-and-swap lease: acquire grants when the key is free,
+    # expired on the backend clock, or already held by ``holder`` (renewal);
+    # the epoch is a fencing token that increments on every ownership change.
+    # Returns {"key", "holder", "expiresMs", "epoch", "acquired": bool} —
+    # on a refused acquire the CURRENT holder's row comes back.
+    def lease_acquire(self, key: str, holder: str, ttl_ms: float) -> dict: ...
+    def lease_release(self, key: str, holder: str) -> bool: ...
+    def lease_get(self, key: str) -> dict | None: ...

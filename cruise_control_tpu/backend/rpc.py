@@ -288,6 +288,17 @@ class RpcClusterBackend:
     def now_ms(self):
         return self._call("now_ms")
 
+    # -- coordination leases (ClusterBackend protocol; HA leader election) --
+    def lease_acquire(self, key: str, holder: str, ttl_ms: float) -> dict:
+        return self._call("lease_acquire", key=key, holder=holder,
+                          ttl_ms=ttl_ms)
+
+    def lease_release(self, key: str, holder: str) -> bool:
+        return bool(self._call("lease_release", key=key, holder=holder))
+
+    def lease_get(self, key: str):
+        return self._call("lease_get", key=key)
+
 
 # ------------------------------------------------------------------ server
 class DefaultBackendClientProvider:
@@ -397,6 +408,14 @@ def _dispatch(backend, method: str, p: dict):
         return None
     if method == "now_ms":
         return float(backend.now_ms())
+    # coordination leases: CAS runs inside the BACKEND (single authority),
+    # so two contenders racing over the wire still serialize on its lock
+    if method == "lease_acquire":
+        return backend.lease_acquire(p["key"], p["holder"], p["ttl_ms"])
+    if method == "lease_release":
+        return backend.lease_release(p["key"], p["holder"])
+    if method == "lease_get":
+        return backend.lease_get(p["key"])
     # simulated-cluster controls (fault injection / setup over the wire)
     if method in ("add_broker", "create_partition", "kill_broker",
                   "restart_broker", "fail_disk", "advance"):

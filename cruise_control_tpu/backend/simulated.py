@@ -56,6 +56,10 @@ class SimulatedClusterBackend:
         self._scheduled: list[tuple] = []
         self._sched_seq = 0
         self._topic_configs: dict[str, dict] = {}
+        # coordination leases (ZK-ephemeral-node role): key -> {holder,
+        # expiresMs, epoch}; expiry is judged on the SIMULATED clock, so
+        # election/renewal/failover in sim campaigns is bit-reproducible
+        self._leases: dict[str, dict] = {}
         self._partitions_snapshot: tuple | None = None   # (meta_gen, dict)
         # --- incremental columnar state (ClusterSnapshot source) ---
         # one row per partition in CREATION order; every partition mutator
@@ -325,6 +329,44 @@ class SimulatedClusterBackend:
                 self._silenced.add(broker_id)
             else:
                 self._silenced.discard(broker_id)
+
+    # ---------------------------------------------------------------- leases
+    def lease_acquire(self, key: str, holder: str, ttl_ms: float) -> dict:
+        """Atomic compare-and-swap lease (ClusterBackend protocol): grant
+        when the key is free, the current lease has expired on the backend
+        clock, or ``holder`` already owns it (renewal). The epoch is a
+        fencing token: it increments only when OWNERSHIP changes."""
+        with self._lock:
+            now = self._now_ms
+            cur = self._leases.get(key)
+            if cur is not None and cur["holder"] != holder \
+                    and cur["expiresMs"] > now:
+                out = dict(cur, key=key, acquired=False)
+                return out
+            epoch = (cur["epoch"] if cur is not None
+                     and cur["holder"] == holder and cur["expiresMs"] > now
+                     else (cur["epoch"] + 1 if cur is not None else 1))
+            self._leases[key] = {"holder": holder,
+                                 "expiresMs": now + float(ttl_ms),
+                                 "epoch": epoch}
+            return dict(self._leases[key], key=key, acquired=True)
+
+    def lease_release(self, key: str, holder: str) -> bool:
+        """Voluntary release; a no-op unless ``holder`` owns the lease."""
+        with self._lock:
+            cur = self._leases.get(key)
+            if cur is None or cur["holder"] != holder:
+                return False
+            del self._leases[key]
+            return True
+
+    def lease_get(self, key: str) -> dict | None:
+        with self._lock:
+            cur = self._leases.get(key)
+            if cur is None:
+                return None
+            return dict(cur, key=key,
+                        expired=cur["expiresMs"] <= self._now_ms)
 
     # ---------------------------------------------------------------- clock
     def now_ms(self) -> float:

@@ -293,6 +293,20 @@ class EventJournal:
         with self._lock:
             return list(self._mem)
 
+    def tail(self, cursor: int = 0) -> tuple[int, list[str], int]:
+        """Follow API: events appended at/after absolute event index
+        ``cursor``. Returns ``(new_cursor, lines, dropped)`` where
+        ``new_cursor`` is the next cursor to pass and ``dropped`` counts
+        events the bounded memory ring already evicted (a tailer that keeps
+        up sees 0). In-process standbys tail this; file followers tailing
+        another process's journal use :class:`JournalTailer` instead."""
+        with self._lock:
+            first = self.events_appended - len(self._mem)
+            start = max(int(cursor), first)
+            dropped = start - int(cursor) if cursor < first else 0
+            mem = list(self._mem)
+            return self.events_appended, mem[start - first:], dropped
+
     def state_json(self) -> dict:
         with self._lock:
             return {"path": self.path, "events": self.events_appended,
@@ -301,6 +315,102 @@ class EventJournal:
                     "memoryLines": len(self._mem),
                     "droppedFromMemory": self.dropped_from_memory,
                     "fsync": self.fsync}
+
+
+class JournalTailer:
+    """Seam-safe follower of another process's on-disk journal file.
+
+    Rotation renames ``path`` -> ``path.1`` (shifting older suffixes up) and
+    reopens a fresh ``path``; a naive reader holding an open fd at an offset
+    would keep reading the renamed file and never see the new one (drop), or
+    reopen ``path`` and reread it from 0 (duplicate). The tailer remembers
+    the INODE of the file it is reading: on each poll, if ``path`` now names
+    a different inode, it (1) drains the previously-open fd to EOF — the
+    rename preserved the inode so nothing written before the rotate is lost,
+    (2) drains any ``path.K`` rotated files NEWER than the one it was
+    reading (several rotations may land between polls; ``path.K-1`` rotated
+    after ``path.K``), then (3) switches to the new ``path`` at offset 0.
+    Partial (torn) tail lines are retained in a buffer until their newline
+    arrives, so a line is never emitted twice nor split."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+        self._ino = None
+        self._buf = ""
+
+    def _open(self, path: str):
+        f = open(path, "r", encoding="utf-8")
+        return f, os.fstat(f.fileno()).st_ino
+
+    def _drain(self, f) -> list[str]:
+        chunk = f.read()
+        if not chunk:
+            return []
+        self._buf += chunk
+        *complete, self._buf = self._buf.split("\n")
+        return [ln for ln in complete if ln]
+
+    def poll(self) -> list[str]:
+        """New complete journal lines since the previous poll ([] when
+        nothing landed or the file does not exist yet)."""
+        out: list[str] = []
+        try:
+            cur_ino = os.stat(self.path).st_ino
+        except OSError:
+            return out
+        if self._f is None:
+            try:
+                self._f, self._ino = self._open(self.path)
+            except OSError:
+                return out
+        if self._ino != cur_ino:
+            # rotated underneath us: finish the renamed file (same inode),
+            # then any newer-rotated siblings, oldest first
+            out.extend(self._drain(self._f))
+            self._buf = ""       # a torn tail at rotate can't complete: the
+            self._f.close()      # writer fsyncs whole lines before rotating
+            rotated = []         # path.K newer than the inode we were on
+            k = 1
+            while True:
+                p = f"{self.path}.{k}"
+                try:
+                    ino = os.stat(p).st_ino
+                except OSError:
+                    break
+                if ino == self._ino:
+                    break
+                rotated.append(p)
+                k += 1
+            for p in reversed(rotated):   # oldest rotation first
+                try:
+                    f, _ = self._open(p)
+                except OSError:
+                    continue
+                out.extend(self._drain(f))
+                f.close()
+                self._buf = ""
+            try:
+                self._f, self._ino = self._open(self.path)
+            except OSError:
+                self._f = None
+                return out
+        out.extend(self._drain(self._f))
+        return out
+
+    def pending_bytes(self) -> int:
+        """Unread bytes in the CURRENT file (a lag estimate for gauges)."""
+        if self._f is None:
+            return 0
+        try:
+            return max(os.stat(self.path).st_size - self._f.tell(), 0)
+        except OSError:
+            return 0
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
 
 
 # ---------------------------------------------------------------------------

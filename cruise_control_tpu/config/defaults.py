@@ -298,6 +298,24 @@ _D.define(name="journal.memory.lines", type=Type.INT, default=65_536,
           doc="Bounded in-memory ring of recent journal lines (kept with or "
               "without a journal.path) — what ScenarioResult.journal and "
               "path-less deployments read.")
+_D.define(name="ha.lease.key", type=Type.STRING,
+          default="cruise-control/leader",
+          doc="Coordination-lease key for HA leader election "
+              "(cruise_control_tpu/ha/): one lease per served cluster, "
+              "compare-and-swapped in the backend (ClusterBackend."
+              "lease_acquire) so at most one controller holds the leader "
+              "role at any backend-clock instant.")
+_D.define(name="ha.lease.ttl.ms", type=Type.LONG, default=30_000,
+          validator=at_least(1),
+          doc="Leader lease time-to-live on the backend clock: a leader "
+              "that fails to renew within this window loses the lease and a "
+              "standby's next acquire attempt wins. Failover detection time "
+              "is bounded by this TTL plus the standby's tick cadence.")
+_D.define(name="ha.lease.renew.ms", type=Type.LONG, default=10_000,
+          validator=at_least(1),
+          doc="How often the leader renews its lease (must be well under "
+              "ha.lease.ttl.ms; renewal is a same-holder lease_acquire, so "
+              "the fencing epoch is unchanged while leadership holds).")
 _D.define(name="journal.trace.capacity", type=Type.INT, default=1024,
           validator=at_least(16),
           doc="Span-tracer ring size: how many FINISHED spans are retained "
@@ -436,6 +454,15 @@ _D.define(name="fleet.precompute.interval.ms", type=Type.INT, default=30_000,
               "unpaused tenant (delta path), batches the due ones per shape "
               "bucket into ONE vmapped engine launch, installs per-tenant "
               "proposal caches and enforces the memory budget.")
+_D.define(name="fleet.cluster.ids", type=Type.LIST, default=[],
+          doc="Service-mode multi-tenant boot (main.py): cluster ids to "
+              "register as fleet tenants behind one server. Non-empty "
+              "builds a FleetScheduler over per-tenant CruiseControl apps "
+              "(resident sessions on) and serves them via ?cluster_id= "
+              "routing; per-tenant config overlays come from "
+              "fleet.tenant.<id>.<key> properties. The base backend serves "
+              "the first id; additional tenants need overlay-provided "
+              "backends (backend.client.provider args) or share the base.")
 
 # --------------------------------------------------------------------------
 # Monitor (reference: config/constants/MonitorConfig.java)

@@ -239,6 +239,15 @@ class AnomalyDetectorManager:
                         sensors.timer("anomaly-detection-to-fix-timer").record(
                             max(now_ms - anomaly.detected_ms, 0.0) / 1000.0)
                 except Exception as e:
+                    from cruise_control_tpu.executor.executor import (
+                        ExecutorKilledError,
+                    )
+                    if isinstance(e, ExecutorKilledError):
+                        # the controller "process" died mid-fix (HA
+                        # leader-kill): not a fix failure to record — the
+                        # kill propagates so the harness tears this
+                        # controller down and the standby takes over
+                        raise
                     if self._backend_unavailable(e):
                         # the fix failed BECAUSE the backend boundary is
                         # unhealthy (the failure may itself have tripped the

@@ -30,6 +30,15 @@ from cruise_control_tpu.executor.strategy import build_strategy
 from cruise_control_tpu.executor.task import ExecutionTask, TaskState, TaskType
 
 
+class ExecutorKilledError(RuntimeError):
+    """Raised inside an execution when :meth:`Executor.kill` severed the
+    controller mid-batch (HA leader-kill fault). Unlike a stop, NOTHING is
+    cleaned up — in-flight reassignments keep running backend-side, throttles
+    stay set, the execution span never ends — so the journaled task census
+    freezes at exactly the kill point and a promoted standby can adopt the
+    execution from it (``Executor.adopt_census``)."""
+
+
 class WallClock:
     def __init__(self):
         self._t0 = _time.time()
@@ -389,6 +398,14 @@ class Executor:
         self._paused = False
         self._pause_ticks = 0
         self._pause_meter = self._sensors.meter("executor-backend-pauses")
+        # HA leader-kill switch: kill() flips it (typically from a backend
+        # schedule_at callback firing inside a progress sleep); every phase
+        # loop polls it and raises ExecutorKilledError, freezing the census
+        self._killed = False
+        # failover adoption seed: adopt_census() stages the dead leader's
+        # already-submitted inter-broker moves here; _inter_broker_phase
+        # enters its loop with them as in-flight
+        self._adopted_in_flight: list[ExecutionTask] = []
 
     @property
     def fault_tolerance(self):
@@ -416,6 +433,25 @@ class Executor:
         if self._paused:
             self._paused = False
             LOG.info("execution resumed: backend reachable again")
+
+    # -------------------------------------------------------- HA leader-kill
+    def kill(self) -> None:
+        """Simulate the controller process dying mid-execution. No cleanup
+        runs: the next kill-check in any phase loop raises
+        ExecutorKilledError and the finish path is skipped entirely, so the
+        journal's last word on this execution is the true mid-batch census.
+        A killed executor refuses all further executions."""
+        self._killed = True
+
+    @property
+    def killed(self) -> bool:
+        return self._killed
+
+    def _check_killed(self) -> None:
+        if self._killed:
+            raise ExecutorKilledError(
+                "executor killed mid-execution "
+                f"(operation={getattr(self, '_operation', None)!r})")
 
     # ---------------------------------------------------------- reservation
     def reserve(self, owner: str) -> None:
@@ -565,6 +601,9 @@ class Executor:
         strategy = (build_strategy(strategy_names, registry=self._strategy_registry)
                     if strategy_names else self._strategy)
         with self._lock:
+            if self._killed:
+                raise ExecutorKilledError("executor killed; refusing new "
+                                          "executions")
             if self._state != ExecutorState.NO_TASK_IN_PROGRESS:
                 raise RuntimeError("an execution is already in progress")
             self._state = ExecutorState.STARTING_EXECUTION
@@ -611,6 +650,17 @@ class Executor:
                         ty=task.task_type.value, st=state.name,
                         trace=trace, span=span_id)
                 t.on_transition = on_transition
+                # initial census row: tasks are born PENDING (never via a
+                # transition), carrying enough proposal payload for a
+                # standby to rebuild the ExecutionProposal and adopt the
+                # execution after a leader kill — all fields deterministic
+                p = t.proposal
+                journal.append(
+                    "task", i=i, tp=list(t.tp), ty=t.task_type.value,
+                    st="PENDING", trace=trace, span=span_id,
+                    ol=p.old_leader, nl=p.new_leader,
+                    orp=[list(r) for r in p.old_replicas],
+                    nrp=[list(r) for r in p.new_replicas])
         if blocking:
             self._run_execution(planner, exec_span)
         else:
@@ -628,6 +678,96 @@ class Executor:
                 # executions can never accumulate handler-thread references
                 # (asserted by the REST fuzz thread-leak test)
                 self._execution_thread = None
+
+    def adopt_census(self, records: list, context: dict | None = None,
+                     parent_span=None, blocking: bool = True) -> dict:
+        """Failover adoption (HA takeover): resume a dead leader's execution
+        from its journaled task census instead of aborting it.
+
+        ``records`` carries one dict per plan-index task — the LAST
+        journaled state plus the proposal payload from the initial PENDING
+        row ({"i","tp","ty","st","ol","nl","orp","nrp"}). Terminal tasks
+        (COMPLETED/ABORTED/DEAD) are skipped; PENDING tasks re-enter a fresh
+        planner in their journaled order (the dead leader's strategy sort is
+        baked into the plan indexes, so no re-sort); IN_PROGRESS
+        inter-broker moves are adopted as in-flight — the backend still
+        holds their reassignments and the normal completion polling finishes
+        them, so failover ABORTS NOTHING. IN_PROGRESS leadership moves
+        re-arm as PENDING (elections are idempotent; re-submitting one that
+        already landed completes on the next progress check)."""
+        from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+        with self._lock:
+            if self._killed:
+                raise ExecutorKilledError("executor killed; refusing "
+                                          "census adoption")
+            if self._state != ExecutorState.NO_TASK_IN_PROGRESS:
+                raise RuntimeError("an execution is already in progress")
+            self._state = ExecutorState.STARTING_EXECUTION
+            self._stop_requested = False
+            self._force_stop = False
+            self._proposal_generation = None
+        self._execution_meter.mark()
+        self._last_adjust_ms = -1e18
+        self._operation = (context or {}).get("operation", "census adoption")
+        self._slow_task_alerts.clear()
+        planner = ExecutionTaskPlanner(self._strategy)
+        by_type: dict[TaskType, list] = {}
+        in_flight_tasks: list[ExecutionTask] = []
+        for r in sorted(records, key=lambda r: int(r["i"])):
+            st = r["st"]
+            if st not in ("PENDING", "IN_PROGRESS"):
+                continue
+            ty = TaskType(r["ty"])
+            p = ExecutionProposal(
+                topic=r["tp"][0], partition=int(r["tp"][1]),
+                old_leader=int(r["ol"]), new_leader=int(r["nl"]),
+                old_replicas=tuple((int(b), int(d)) for b, d in r["orp"]),
+                new_replicas=tuple((int(b), int(d)) for b, d in r["nrp"]))
+            t = ExecutionTask(p, ty)
+            by_type.setdefault(ty, []).append(t)
+            if st == "IN_PROGRESS" and ty is TaskType.INTER_BROKER_REPLICA_ACTION:
+                in_flight_tasks.append(t)
+        planner.adopt_tasks(by_type)
+        self._current_planner = planner
+        exec_span = None
+        if self._tracer is not None:
+            exec_span = self._tracer.span(
+                "execution", self._operation, parent=parent_span,
+                tasks=len(planner.all_tasks), adopted=True)
+        if self._journal is not None:
+            journal = self._journal
+            trace = exec_span.trace_id if exec_span is not None else None
+            span_id = exec_span.span_id if exec_span is not None else None
+            for i, t in enumerate(planner.all_tasks):
+                def on_transition(task, state, now, _i=i):
+                    journal.append(
+                        "task", i=_i, tp=list(task.tp),
+                        ty=task.task_type.value, st=state.name,
+                        trace=trace, span=span_id)
+                t.on_transition = on_transition
+                p = t.proposal
+                journal.append(
+                    "task", i=i, tp=list(t.tp), ty=t.task_type.value,
+                    st="PENDING", trace=trace, span=span_id, adopted=True,
+                    ol=p.old_leader, nl=p.new_leader,
+                    orp=[list(r) for r in p.old_replicas],
+                    nrp=[list(r) for r in p.new_replicas])
+        # re-arm adopted in-flight moves before the phase loop: the
+        # transition lands in the NEW leader's journal, and the phase entry
+        # below treats them as already-submitted
+        now = self._clock.now_ms()
+        for t in in_flight_tasks:
+            t.transition(TaskState.IN_PROGRESS, now)
+        self._adopted_in_flight = list(in_flight_tasks)
+        if blocking:
+            self._run_execution(planner, exec_span)
+        else:
+            self._execution_thread = threading.Thread(
+                target=self._run_execution, args=(planner, exec_span),
+                daemon=True)
+            self._execution_thread.start()
+        n_total = len(planner.all_tasks)
+        return {"adopted": n_total, "inFlight": len(in_flight_tasks)}
 
     # ----------------------------------------------------------- throttling
     def _set_throttles(self, planner: ExecutionTaskPlanner) -> tuple:
@@ -733,53 +873,71 @@ class Executor:
                 if ph is not None:
                     ph.end()
         finally:
-            self._clear_throttles(throttled, throttled_topics)
-            self._execution_timer.record(
-                max(self._clock.now_ms() - t0_ms, 0.0) / 1000.0)
-            done = sum(1 for t in planner.all_tasks
-                       if t.state is TaskState.COMPLETED)
-            if exec_span is not None:
-                by_state: dict[str, int] = {}
-                for t in planner.all_tasks:
-                    by_state[t.state.name] = by_state.get(t.state.name, 0) + 1
-                exec_span.end(completed=done, total=len(planner.all_tasks),
-                              stopped=self._stop_requested,
-                              aborted=by_state.get("ABORTED", 0),
-                              dead=by_state.get("DEAD", 0))
-            self._history.append({
-                "finishedMs": self._clock.now_ms(),
-                "numTasks": len(planner.all_tasks),
-                "numCompleted": done,
-                "stopped": self._stop_requested,
-            })
-            with self._lock:
-                self._state = ExecutorState.NO_TASK_IN_PROGRESS
-                self._paused = False
-            if self._notifier is not None:
-                # ExecutorNotifier SPI (executor.notifier.class): one
-                # notification per finished execution
-                from cruise_control_tpu.executor.notifier import (
-                    ExecutorNotification,
-                )
-                n_lead = sum(1 for t in planner.all_tasks
-                             if t.task_type is TaskType.LEADER_ACTION
-                             and t.state is TaskState.COMPLETED)
-                try:
-                    self._notifier.on_execution_finished(ExecutorNotification(
-                        operation=self._operation,
-                        success=not self._stop_requested
-                        and done == len(planner.all_tasks),
-                        stopped_by_user=self._stop_requested,
-                        num_replica_movements=done - n_lead,
-                        num_leadership_movements=n_lead))
-                except Exception:
-                    LOG.exception("executor notifier failed")
+            if self._killed:
+                # leader-kill freeze: no throttle cleanup, no timer/history
+                # entry, no execution-span end, state stays mid-execution —
+                # the journal ends where the process "died" and the standby
+                # adopts exactly that census (ExecutorKilledError is already
+                # propagating out of this frame)
+                pass
+            else:
+                self._clear_throttles(throttled, throttled_topics)
+                self._execution_timer.record(
+                    max(self._clock.now_ms() - t0_ms, 0.0) / 1000.0)
+                done = sum(1 for t in planner.all_tasks
+                           if t.state is TaskState.COMPLETED)
+                if exec_span is not None:
+                    by_state: dict[str, int] = {}
+                    for t in planner.all_tasks:
+                        by_state[t.state.name] = by_state.get(t.state.name, 0) + 1
+                    exec_span.end(completed=done, total=len(planner.all_tasks),
+                                  stopped=self._stop_requested,
+                                  aborted=by_state.get("ABORTED", 0),
+                                  dead=by_state.get("DEAD", 0))
+                self._history.append({
+                    "finishedMs": self._clock.now_ms(),
+                    "numTasks": len(planner.all_tasks),
+                    "numCompleted": done,
+                    "stopped": self._stop_requested,
+                })
+                with self._lock:
+                    self._state = ExecutorState.NO_TASK_IN_PROGRESS
+                    self._paused = False
+                if self._notifier is not None:
+                    # ExecutorNotifier SPI (executor.notifier.class): one
+                    # notification per finished execution
+                    from cruise_control_tpu.executor.notifier import (
+                        ExecutorNotification,
+                    )
+                    n_lead = sum(1 for t in planner.all_tasks
+                                 if t.task_type is TaskType.LEADER_ACTION
+                                 and t.state is TaskState.COMPLETED)
+                    try:
+                        self._notifier.on_execution_finished(ExecutorNotification(
+                            operation=self._operation,
+                            success=not self._stop_requested
+                            and done == len(planner.all_tasks),
+                            stopped_by_user=self._stop_requested,
+                            num_replica_movements=done - n_lead,
+                            num_leadership_movements=n_lead))
+                    except Exception:
+                        LOG.exception("executor notifier failed")
 
     def _inter_broker_phase(self, planner: ExecutionTaskPlanner) -> None:
         self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT
         in_flight: dict[tuple, ExecutionTask] = {}
         in_flight_by_broker: dict[int, int] = {}
+        # failover adoption: moves the dead leader already submitted enter
+        # the loop as in-flight — the backend still holds the reassignments,
+        # so the normal completion polling finishes them (never re-submitted,
+        # never aborted)
+        for t in self._adopted_in_flight:
+            in_flight[t.tp] = t
+            for b in t.brokers_involved:
+                in_flight_by_broker[b] = in_flight_by_broker.get(b, 0) + 1
+        self._adopted_in_flight = []
         while True:
+            self._check_killed()
             if self._stop_requested:
                 self._state = ExecutorState.STOPPING_EXECUTION
                 if self._force_stop and in_flight:
@@ -870,6 +1028,7 @@ class Executor:
         self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT
         tasks = planner.next_intra_broker_tasks({}, self._cfg.intra_broker_cap)
         while tasks:
+            self._check_killed()
             # re-validate against CURRENT metadata: a fault mid-execution
             # (RF shrink, reassignment landing) may have moved a replica off
             # the broker since the proposal was computed — submitting would
@@ -953,6 +1112,7 @@ class Executor:
     def _leadership_phase(self, planner: ExecutionTaskPlanner) -> None:
         self._state = ExecutorState.LEADER_MOVEMENT
         while True:
+            self._check_killed()
             if self._stop_requested:
                 return
             if (self._cfg.adjuster_enabled
@@ -1031,6 +1191,7 @@ class Executor:
         pending = {t.tp: t for t in batch if t.tp in elections}
         deadline = self._clock.now_ms() + self._cfg.leader_movement_timeout_ms
         while pending:
+            self._check_killed()
             try:
                 partitions = self._ft.call("executor.verify",
                                            self._backend.partitions)
@@ -1092,6 +1253,7 @@ class Executor:
         out["numPlannedTasksTotal"] = sum(h["numTasks"] for h in self._history)
         out["paused"] = self._paused
         out["numPauseTicks"] = self._pause_ticks
+        out["killed"] = self._killed
         if getattr(self, "_proposal_generation", None) is not None:
             # pipelined loop: the metadata generation this execution's
             # proposals were computed against (staleness-tag observability)

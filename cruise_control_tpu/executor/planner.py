@@ -35,6 +35,15 @@ class ExecutionTaskPlanner:
                 self._leader.append(ExecutionTask(p, TaskType.LEADER_ACTION))
         self._inter = sort_tasks(self._inter, self._strategy, context)
 
+    def adopt_tasks(self, tasks_by_type: dict) -> None:
+        """HA failover adoption: file pre-built tasks directly, in the order
+        given. The dead leader's strategy sort is already baked into the
+        journaled plan indexes the caller sorted by, so re-sorting here
+        would only diverge the adopted order from the census."""
+        self._inter.extend(tasks_by_type.get(TaskType.INTER_BROKER_REPLICA_ACTION, []))
+        self._intra.extend(tasks_by_type.get(TaskType.INTRA_BROKER_REPLICA_ACTION, []))
+        self._leader.extend(tasks_by_type.get(TaskType.LEADER_ACTION, []))
+
     @staticmethod
     def _has_logdir_change(p: ExecutionProposal) -> bool:
         old = dict(p.old_replicas)

@@ -1,0 +1,17 @@
+"""High-availability controller pair: lease-based leader election over the
+ClusterBackend CAS primitive, a journal-tailing warm standby, and
+census-adopting deterministic failover.
+
+Reference: the reference deployment gets HA from ZooKeeper ephemeral nodes
+(one active controller, cold standbys re-bootstrapping from the sample
+store). This package keeps the election (backend-keyed lease with a fencing
+epoch) but makes the standby WARM: it tails the leader's durable event
+journal and sample store, replays samples into its own LoadMonitor, keeps a
+ResidentClusterSession synced, and mirrors the leader's execution state from
+the journaled task census — so takeover ADOPTS the in-flight execution
+mid-batch instead of aborting it.
+"""
+from cruise_control_tpu.ha.lease import LeaderElector
+from cruise_control_tpu.ha.standby import SampleTailer, StandbyController
+
+__all__ = ["LeaderElector", "SampleTailer", "StandbyController"]

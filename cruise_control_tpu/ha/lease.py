@@ -1,0 +1,122 @@
+"""Lease-based leader election (ZK ephemeral-node role).
+
+The backend owns the only mutable state: an atomic compare-and-swap lease
+(``ClusterBackend.lease_acquire``) keyed by ``ha.lease.key``. A contender
+acquires when the key is free, expired on the BACKEND clock, or already its
+own (renewal); ownership changes bump the ``epoch`` fencing token. Two
+contenders racing — even over the rpc shim — serialize on the backend's
+lock, so a double leader is impossible by construction (asserted in
+tests/test_ha.py).
+
+The elector is tick-driven, never threaded: the service loop (or the sim
+harness) calls :meth:`tick` on its cadence, the leader renews every
+``ha.lease.renew.ms``, and a standby's acquire attempt doubles as its
+expiry detection — the CAS only grants once the leader has missed renewals
+for a full ``ha.lease.ttl.ms``.
+"""
+from __future__ import annotations
+
+
+class LeaderElector:
+    ROLE_LEADER = "leader"
+    ROLE_STANDBY = "standby"
+
+    def __init__(self, backend, holder: str,
+                 key: str = "cruise-control/leader",
+                 ttl_ms: float = 30_000.0, renew_ms: float = 10_000.0,
+                 journal=None, sensors=None):
+        self._backend = backend
+        self.holder = holder
+        self.key = key
+        self.ttl_ms = float(ttl_ms)
+        self.renew_ms = float(renew_ms)
+        self._journal = journal
+        self.role = self.ROLE_STANDBY
+        self.epoch: int | None = None
+        self.lease: dict | None = None    # last CAS/observation row
+        self.elected_ms: float | None = None
+        self.lost_ms: float | None = None
+        self._last_renew_ms = -1e18
+        self._renewals = 0
+        if sensors is not None:
+            self._m_elect = sensors.meter("ha-elections")
+            self._m_renew = sensors.meter("ha-lease-renewals")
+            self._m_lost = sensors.meter("ha-lease-losses")
+        else:
+            self._m_elect = self._m_renew = self._m_lost = None
+
+    @classmethod
+    def from_config(cls, backend, holder: str, config, journal=None,
+                    sensors=None) -> "LeaderElector":
+        return cls(backend, holder,
+                   key=config.get_string("ha.lease.key"),
+                   ttl_ms=float(config.get_int("ha.lease.ttl.ms")),
+                   renew_ms=float(config.get_int("ha.lease.renew.ms")),
+                   journal=journal, sensors=sensors)
+
+    # ------------------------------------------------------------- election
+    def tick(self) -> str:
+        """One election step on the backend clock; returns the role after.
+        Leader: renew when due (a refused renewal means the lease lapsed and
+        someone else fenced us out — step down, do not split-brain).
+        Standby: attempt the CAS — it only grants on a free/expired lease."""
+        now = float(self._backend.now_ms())
+        if self.role == self.ROLE_LEADER:
+            if now - self._last_renew_ms < self.renew_ms:
+                return self.role
+            out = self._backend.lease_acquire(self.key, self.holder,
+                                              self.ttl_ms)
+            self.lease = out
+            if out.get("acquired"):
+                self._last_renew_ms = now
+                self._renewals += 1
+                if self._m_renew is not None:
+                    self._m_renew.mark()
+            else:
+                self.role = self.ROLE_STANDBY
+                self.lost_ms = now
+                if self._m_lost is not None:
+                    self._m_lost.mark()
+                if self._journal is not None:
+                    self._journal.append("ha", ev="lease_lost",
+                                         holder=self.holder,
+                                         to=out.get("holder"),
+                                         epoch=out.get("epoch"))
+            return self.role
+        out = self._backend.lease_acquire(self.key, self.holder, self.ttl_ms)
+        self.lease = out
+        if out.get("acquired"):
+            self.role = self.ROLE_LEADER
+            self.epoch = int(out["epoch"])
+            self.elected_ms = now
+            self._last_renew_ms = now
+            if self._m_elect is not None:
+                self._m_elect.mark()
+            if self._journal is not None:
+                self._journal.append("ha", ev="elected", holder=self.holder,
+                                     epoch=self.epoch)
+        return self.role
+
+    def resign(self) -> None:
+        """Voluntary step-down (clean shutdown): release the lease so a
+        standby can take over without waiting out the TTL."""
+        if self.role != self.ROLE_LEADER:
+            return
+        self._backend.lease_release(self.key, self.holder)
+        self.role = self.ROLE_STANDBY
+        if self._journal is not None:
+            self._journal.append("ha", ev="resigned", holder=self.holder,
+                                 epoch=self.epoch)
+
+    def retry_after_s(self) -> float:
+        return max(self.renew_ms / 1000.0, 1.0)
+
+    def state_json(self) -> dict:
+        lease = self.lease or {}
+        return {"role": self.role, "holder": self.holder, "key": self.key,
+                "epoch": self.epoch, "ttlMs": self.ttl_ms,
+                "renewMs": self.renew_ms, "renewals": self._renewals,
+                "electedMs": self.elected_ms, "lostMs": self.lost_ms,
+                "lease": {"holder": lease.get("holder"),
+                          "expiresMs": lease.get("expiresMs"),
+                          "epoch": lease.get("epoch")}}

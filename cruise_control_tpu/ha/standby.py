@@ -1,0 +1,260 @@
+"""Warm standby controller: journal-tailing state mirror + census adoption.
+
+The standby owns a full CruiseControl facade over the SAME backend as the
+leader but with its own (empty) journal and no sample store of its own. It
+stays warm by tailing two leader artifacts:
+
+- the leader's **event journal** — in-process via ``EventJournal.tail()``
+  (cursor = absolute event index) or cross-process via ``JournalTailer``
+  (rotation-seam-safe file follower). Task-census rows ({"kind": "task"})
+  accumulate into a per-execution-span mirror; an execution whose span-end
+  event ({"kind": "span", "span_kind": "execution"}) never arrives is, by
+  construction, the one the leader died inside.
+- the leader's **FileSampleStore** JSONL files — replayed through the
+  monitor's ``_ingest`` (the same store-replay path ``start_up`` uses), so
+  the standby's aggregator windows are bit-identical to a monitor that
+  loaded the same prefix (asserted at arbitrary offsets in tests/test_ha.py).
+
+On promotion the standby re-drains both tails one final time, hands the
+frozen census of the incomplete execution to ``Executor.adopt_census``
+(in-flight moves resume mid-batch — zero failover aborts), and flips the
+facade's role so REST writes open up.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from cruise_control_tpu.common.tracing import JournalTailer
+from cruise_control_tpu.monitor.sampling.sample_store import FileSampleStore
+from cruise_control_tpu.monitor.sampling.samplers import (
+    BrokerSample, PartitionSample, Samples,
+)
+
+
+class SampleTailer:
+    """Incremental follower of a leader's FileSampleStore directory.
+
+    Byte-offset based: each poll reads only the appended suffix of the two
+    JSONL files, holding torn tail lines in a buffer until their newline
+    arrives (the leader's appends are line-atomic but flushes are not)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pos = {FileSampleStore.PARTITION_FILE: 0,
+                     FileSampleStore.BROKER_FILE: 0}
+        self._buf = {FileSampleStore.PARTITION_FILE: "",
+                     FileSampleStore.BROKER_FILE: ""}
+
+    def _read_new(self, fname: str) -> list:
+        full = os.path.join(self.path, fname)
+        try:
+            with open(full, encoding="utf-8") as f:
+                f.seek(self._pos[fname])
+                chunk = f.read()
+                self._pos[fname] = f.tell()
+        except OSError:
+            return []
+        if not chunk:
+            return []
+        data = self._buf[fname] + chunk
+        lines = data.split("\n")
+        self._buf[fname] = lines.pop()
+        return [ln for ln in lines if ln]
+
+    def poll(self) -> Samples | None:
+        """New complete sample rows since the last poll, or None."""
+        psamples = []
+        for ln in self._read_new(FileSampleStore.PARTITION_FILE):
+            try:
+                d = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            psamples.append(PartitionSample(topic=d["t"], partition=d["p"],
+                                            ts_ms=d["ts"], values=d["v"]))
+        bsamples = []
+        for ln in self._read_new(FileSampleStore.BROKER_FILE):
+            try:
+                d = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            bsamples.append(BrokerSample(broker_id=d["b"], ts_ms=d["ts"],
+                                         values=d["v"]))
+        if not psamples and not bsamples:
+            return None
+        return Samples(psamples, bsamples)
+
+
+class StandbyController:
+    """Tick-driven warm standby over a fully-wired CruiseControl facade."""
+
+    def __init__(self, cc, leader_journal=None,
+                 leader_journal_path: str | None = None,
+                 leader_sample_path: str | None = None, elector=None,
+                 sync_interval_ms: float = 30_000.0):
+        if leader_journal is None and leader_journal_path is None:
+            raise ValueError("standby needs a leader journal to tail "
+                             "(in-process object or file path)")
+        self.cc = cc
+        cc.ha = self
+        self.elector = elector
+        self._mem_journal = leader_journal
+        self._cursor = 0              # EventJournal.tail absolute event index
+        self._tailer = (JournalTailer(leader_journal_path)
+                        if leader_journal is None else None)
+        self._samples = (SampleTailer(leader_sample_path)
+                         if leader_sample_path else None)
+        # census mirror: execution-span id -> {plan index -> merged row}
+        # (first row per index carries the proposal payload; later rows only
+        # advance "st")
+        self._census: dict = {}
+        self._census_order: list = []
+        self._ended_execs: set = set()
+        self.events_seen = 0
+        self.dropped_events = 0       # bounded-ring evictions (in-process)
+        self.samples_replayed = 0
+        self.role = "standby"
+        self.promoted_ms: float | None = None
+        self.adoption: dict | None = None
+        self._sync_interval_ms = float(sync_interval_ms)
+        self._last_sync_ms = -1e18
+        cc.sensors.gauge("ha-journal-lag-events",
+                         lambda: self.journal_lag_events())
+        cc.sensors.gauge("ha-standby-events-seen", lambda: self.events_seen)
+
+    # -------------------------------------------------------------- tailing
+    def journal_lag_events(self) -> int:
+        """Events the leader has journaled that this standby has not yet
+        consumed (exact in-process; file followers report pending complete
+        lines as 0 between polls — see ``pending_bytes`` in state_json)."""
+        if self._mem_journal is not None:
+            return max(int(self._mem_journal.events_appended) - self._cursor,
+                       0)
+        return 0
+
+    def _drain_journal(self) -> int:
+        if self._mem_journal is not None:
+            self._cursor, lines, dropped = self._mem_journal.tail(self._cursor)
+            self.dropped_events += dropped
+        else:
+            lines = self._tailer.poll()
+        for ln in lines:
+            self._consume(ln)
+        return len(lines)
+
+    def _consume(self, line: str) -> None:
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            return                      # torn tail write (file follower)
+        self.events_seen += 1
+        kind = rec.get("kind")
+        if kind == "task":
+            span = rec.get("span")
+            rows = self._census.get(span)
+            if rows is None:
+                rows = self._census[span] = {}
+                self._census_order.append(span)
+            i = int(rec["i"])
+            row = rows.get(i)
+            if row is None:
+                rows[i] = dict(rec)
+            else:
+                row["st"] = rec.get("st", row.get("st"))
+        elif kind == "span" and rec.get("span_kind") == "execution":
+            # the execution finished cleanly — a killed leader never
+            # journals this, which is exactly how promote() finds the
+            # execution to adopt
+            self._ended_execs.add(rec.get("span"))
+
+    def _replay_samples(self) -> int:
+        if self._samples is None:
+            return 0
+        batch = self._samples.poll()
+        if batch is None:
+            return 0
+        # _ingest is the store-replay path (start_up uses it): no timers, no
+        # tracer noise — the standby's aggregators stay bit-identical to a
+        # fresh monitor loading the same prefix
+        n = self.cc.load_monitor._ingest(batch)
+        self.samples_replayed += n
+        return n
+
+    # ----------------------------------------------------------------- tick
+    def tick(self) -> dict:
+        """One standby step: tail the journal, replay new samples, keep the
+        resident session warm, and run the election. Returns the promote()
+        result when this tick won the lease."""
+        drained = self._drain_journal()
+        replayed = self._replay_samples()
+        sess = self.cc.resident_session
+        now = float(self.cc.backend.now_ms())
+        if sess is not None and now - self._last_sync_ms >= self._sync_interval_ms:
+            self._last_sync_ms = now
+            try:
+                sess.sync()
+            except Exception:
+                # warmth is best-effort pre-promotion (the monitor may not
+                # have enough windows yet); correctness is asserted on the
+                # monitor/optimizer inputs, not on early sync attempts
+                pass
+        if self.elector is not None and self.role == "standby":
+            if self.elector.tick() == "leader":
+                return self.promote()
+        return {"promoted": False, "events": drained, "samples": replayed}
+
+    # -------------------------------------------------------------- takeover
+    def _incomplete_execution(self):
+        """Latest execution span with census rows but no span-end event —
+        the one the dead leader was inside. Returns (found, span_id)."""
+        for span in reversed(self._census_order):
+            if span in self._ended_execs:
+                continue
+            rows = self._census[span]
+            if any(r.get("st") in ("PENDING", "IN_PROGRESS")
+                   for r in rows.values()):
+                return True, span
+        return False, None
+
+    def promote(self) -> dict:
+        """Take over: final tail catch-up, adopt the frozen census (zero
+        aborts — in-flight moves resume mid-batch), flip the role."""
+        self._drain_journal()
+        self._replay_samples()
+        self.role = "leader"
+        self.promoted_ms = float(self.cc.backend.now_ms())
+        self.cc.journal.append("ha", ev="promoted",
+                               holder=getattr(self.elector, "holder", None),
+                               epoch=getattr(self.elector, "epoch", None))
+        adoption = None
+        found, span = self._incomplete_execution()
+        if found:
+            # rows tailed from mid-execution offsets may lack the proposal
+            # payload (initial PENDING row already evicted); only payloaded
+            # rows are adoptable — a standby that tailed from the start
+            # always has all of them
+            records = [dict(r) for r in self._census[span].values()
+                       if "ol" in r]
+            if records:
+                adoption = self.cc.executor.adopt_census(
+                    records,
+                    context={"operation": "failover census adoption"})
+        self.adoption = adoption
+        return {"promoted": True, "adoption": adoption}
+
+    def retry_after_s(self) -> float:
+        if self.elector is not None:
+            return self.elector.retry_after_s()
+        return 1.0
+
+    def state_json(self) -> dict:
+        out = {"role": self.role, "eventsSeen": self.events_seen,
+               "droppedEvents": self.dropped_events,
+               "journalLagEvents": self.journal_lag_events(),
+               "samplesReplayed": self.samples_replayed,
+               "promotedMs": self.promoted_ms, "adoption": self.adoption,
+               "lease": (self.elector.state_json()
+                         if self.elector is not None else None)}
+        if self._tailer is not None:
+            out["pendingBytes"] = self._tailer.pending_bytes()
+        return out

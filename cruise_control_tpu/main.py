@@ -69,6 +69,62 @@ def seed_backend_from_spec(backend, spec: dict) -> None:
             cpu_util=float(p.get("cpuUtil", 0.0)))
 
 
+def split_fleet_overlays(props: dict) -> tuple:
+    """Pop ``fleet.tenant.<id>.<key>`` overlay properties out of the raw
+    props (the config schema rejects unknown keys, and these are per-tenant,
+    not service-wide) and group them by cluster id. Ids may contain dots, so
+    the split resolves against the declared ``fleet.cluster.ids`` — longest
+    declared id wins. Returns (base_props, {cluster_id: {key: value}})."""
+    prefix = "fleet.tenant."
+    base = {k: v for k, v in props.items() if not k.startswith(prefix)}
+    raw_ids = props.get("fleet.cluster.ids", "")
+    if isinstance(raw_ids, str):
+        raw_ids = raw_ids.split(",")
+    ids = [str(s).strip() for s in raw_ids if str(s).strip()]
+    overlays: dict = {cid: {} for cid in ids}
+    for k, v in props.items():
+        if not k.startswith(prefix):
+            continue
+        rest = k[len(prefix):]
+        cid = next((c for c in sorted(ids, key=len, reverse=True)
+                    if rest.startswith(c + ".")), None)
+        if cid is None:
+            raise ValueError(
+                f"fleet.tenant property {k!r} matches no declared "
+                f"fleet.cluster.ids entry (declared: {ids or 'none'})")
+        overlays[cid][rest[len(cid) + 1:]] = v
+    return base, overlays
+
+
+def build_fleet(cc, config, base_props: dict, overlays: dict):
+    """``fleet.cluster.ids`` -> a started FleetScheduler behind the server:
+    one tenant facade per declared cluster, each over its own configured
+    backend, with the service's base properties plus that tenant's
+    ``fleet.tenant.<id>.*`` overlay. Returns None when no ids are declared
+    (single-tenant service, no fleet surface mounted)."""
+    ids = [str(s).strip() for s in config.get_list("fleet.cluster.ids")
+           if str(s).strip()]
+    if not ids:
+        return None
+    from cruise_control_tpu.config import cruise_control_config
+    from cruise_control_tpu.fleet import FleetScheduler
+    fleet = FleetScheduler(config=config, sensors=cc.sensors)
+    for cid in ids:
+        tprops = dict(base_props)
+        tprops.pop("fleet.cluster.ids", None)
+        # batched fleet rounds install into resident sessions; a tenant
+        # overlay may tune anything else but not opt out of the session
+        tprops["analyzer.resident.session.enabled"] = True
+        tprops.update(overlays.get(cid, {}))
+        tconfig = cruise_control_config(tprops)
+        backend = tconfig.get_configured_instance("executor.backend.class")
+        tenant = fleet.add_tenant(cid, backend=backend, config=tconfig)
+        # bare start_up: monitor replay only — the scheduler's rounds are
+        # the tenants' precompute, they must not spawn their own threads
+        tenant.cc.start_up()
+    return fleet
+
+
 def build_app(config, backend=None):
     """Construct backend + facade (KafkaCruiseControl wiring order)."""
     from cruise_control_tpu.app import CruiseControl
@@ -87,7 +143,7 @@ def build_app(config, backend=None):
     return CruiseControl(backend, config)
 
 
-def build_server(cc, config):
+def build_server(cc, config, fleet=None):
     """Mount the REST layer per the webserver.* config surface
     (KafkaCruiseControlApp.java:45-61 Jetty bootstrap role)."""
     from cruise_control_tpu.api import CruiseControlServer
@@ -165,7 +221,7 @@ def build_server(cc, config):
         max_active_user_tasks=config.get_int("max.active.user.tasks"),
         completed_user_task_retention_ms=float(
             config.get_int("completed.user.task.retention.time.ms")),
-        config=config)
+        config=config, fleet=fleet)
 
 
 def build_ssl_context(config):
@@ -274,7 +330,9 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
 
     from cruise_control_tpu.config import cruise_control_config
-    config = cruise_control_config(load_properties(args.properties))
+    base_props, overlays = split_fleet_overlays(
+        load_properties(args.properties))
+    config = cruise_control_config(base_props)
     cc = build_app(config)
     if args.cluster_spec:
         with open(args.cluster_spec) as f:
@@ -306,10 +364,16 @@ def main(argv=None) -> int:
     if not args.no_detection:
         cc.anomaly_detector.start_detection(
             config.get_int("anomaly.detection.interval.ms"))
-    server = build_server(cc, config)
+    # fleet.cluster.ids declared -> multi-tenant: one FleetScheduler behind
+    # the server (cluster-scoped REST routing + batched precompute rounds)
+    fleet = build_fleet(cc, config, base_props, overlays)
+    if fleet is not None:
+        fleet.start_precompute()
+    server = build_server(cc, config, fleet=fleet)
     server.start()
-    LOG.info("cruise-control-tpu serving on %s (%s loop)", server.base_url,
-             "pipelined" if pipelined else "blocking")
+    LOG.info("cruise-control-tpu serving on %s (%s loop%s)", server.base_url,
+             "pipelined" if pipelined else "blocking",
+             f", {len(fleet.cluster_ids)} fleet tenants" if fleet else "")
     try:
         while True:
             time.sleep(3600)
@@ -317,6 +381,8 @@ def main(argv=None) -> int:
         LOG.info("shutting down")
     finally:
         server.stop()
+        if fleet is not None:
+            fleet.shutdown()
         if pipeline is not None:
             pipeline.stop()
         if sampling is not None:

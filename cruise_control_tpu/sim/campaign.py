@@ -89,6 +89,11 @@ class CampaignSpec:
     provision_episode: bool = False
     surge_factor: float = 1.7
     pre_surge_utilization: float = 0.65
+    # LAST episode = HA failover certification: broker death -> throttled
+    # heal -> leader_kill mid-execution, run under the two-controller
+    # HaScenarioRunner and checked for outcome parity against a single-
+    # controller run of the identical schedule with the kill stripped
+    leader_kill_episode: bool = False
 
     def config_dict(self) -> dict:
         return {k: v for k, v in self.config}
@@ -146,6 +151,37 @@ def _provision_episode(spec: CampaignSpec, cluster: ClusterSpec,
         settle_ticks=2)
 
 
+def _leader_kill_episode(spec: CampaignSpec, cluster: ClusterSpec,
+                         episode: int, rng: random.Random) -> Scenario:
+    """The HA certification draw: one broker death, a throttled multi-minute
+    evacuation heal, and a ``leader_kill`` timed to land INSIDE that heal
+    (detection fires ~60-90s after the death on the scenario-speed grace
+    ladder; the throttled evacuation then spans simulated minutes, so a kill
+    150-210s later is mid-execution). Fault jitter and target come from the
+    episode RNG like every other draw."""
+    config = dict(spec.config_dict())
+    # throttled copies stretch the heal so the kill lands mid-batch
+    config.setdefault("default.replication.throttle", 2 * 1024 * 1024)
+    config.setdefault("goal.violation.detection.interval.ms", 10_000_000_000)
+    # lease timing on the scenario grid: the leader renews every tick, the
+    # standby detects the loss within one TTL of the kill
+    config.setdefault("ha.lease.ttl.ms", 30_000)
+    config.setdefault("ha.lease.renew.ms", 10_000)
+    death_t = round(rng.uniform(0.0, 30_000.0), 1)
+    kill_t = round(death_t + rng.uniform(150_000.0, 210_000.0), 1)
+    b = rng.randrange(cluster.num_brokers)
+    return Scenario(
+        name=f"{spec.name}-ep{episode}-leaderkill",
+        cluster=cluster,
+        events=(ScenarioEvent(death_t, "broker_death", {"brokers": [b]}),
+                ScenarioEvent(kill_t, "leader_kill", {})),
+        duration_ms=spec.duration_ms, tick_ms=spec.tick_ms,
+        config=tuple(sorted(config.items())),
+        expects_heal=True,
+        expect_detect_types=("BROKER_FAILURE",),
+        settle_ticks=2)
+
+
 def generate_episode(spec: CampaignSpec, seed: int, episode: int) -> Scenario:
     """Draw one episode's compound fault schedule from the campaign's seeded
     RNG. Pure function of (spec, seed, episode)."""
@@ -154,6 +190,8 @@ def generate_episode(spec: CampaignSpec, seed: int, episode: int) -> Scenario:
         spec.cluster, seed=spec.cluster.seed + rng.randrange(1 << 20))
     if spec.provision_episode and episode == 0:
         return _provision_episode(spec, cluster, episode)
+    if spec.leader_kill_episode and episode == spec.episodes - 1:
+        return _leader_kill_episode(spec, cluster, episode, rng)
 
     B = cluster.num_brokers
     n_faults = rng.randint(spec.min_faults, spec.max_faults)
@@ -389,6 +427,30 @@ def episode_slo_samples(result) -> list:
     return samples
 
 
+def aggregate_failover(episode_results: list) -> dict:
+    """Failover-time SLO distributions over a campaign's leader_kill
+    episodes (HaScenarioRunner fills ``ScenarioResult.failover``): how fast
+    the standby noticed the lease lapse, promoted, and produced its first
+    own proposal — plus adoption/abort accounting and the parity verdict."""
+    samples = [r.failover for r in episode_results if r.failover]
+    if not samples:
+        return {}
+    return {
+        "episodes": len(samples),
+        "detect_lease_loss_ms": _dist(
+            [s.get("detect_lease_loss_ms") for s in samples]),
+        "promote_ms": _dist([s.get("promote_ms") for s in samples]),
+        "first_proposal_ms": _dist(
+            [s.get("first_proposal_ms") for s in samples]),
+        "adopted_tasks": _dist([s.get("adopted_tasks") for s in samples]),
+        "adopted_in_flight": _dist(
+            [s.get("adopted_in_flight") for s in samples]),
+        "aborted_by_failover": sum(s.get("aborted_tasks", 0)
+                                   for s in samples),
+        "parity_ok": all(s.get("parity_ok", False) for s in samples),
+    }
+
+
 def aggregate_slos(episode_results: list) -> dict:
     """Per-fault-kind SLO distributions (nearest-rank p50/p95/max) over
     every episode of a campaign."""
@@ -469,6 +531,8 @@ class CampaignResult:
             "provision_actions": [a for r in self.episodes
                                   for a in r.provision_actions],
             "failures": self.failures,
+            **({"failover": fo}
+               if (fo := aggregate_failover(self.episodes)) else {}),
         }
 
     def episode_log_json(self) -> dict:
@@ -499,9 +563,35 @@ class CampaignRunner:
             # episode variation comes entirely from the generated scenario
             # (cluster seed + schedule); the runner seed stays 0 so the
             # recorded replay payload reproduces the episode as-is
-            episodes.append(ScenarioRunner(sc, seed=0).run())
+            if any(e.kind == "leader_kill" for e in sc.events):
+                episodes.append(self._run_ha_episode(sc))
+            else:
+                episodes.append(ScenarioRunner(sc, seed=0).run())
         return CampaignResult(name=self.spec.name, seed=self.seed,
                               episodes=episodes, scenarios=scenarios)
+
+    @staticmethod
+    def _run_ha_episode(sc: Scenario):
+        """Run a leader_kill episode under the two-controller runner, then
+        certify it against the single-controller ORACLE run: the same
+        schedule with the kill stripped must produce the same verdict set,
+        convergence, and final ground-truth assignment. Parity failures
+        land on the HA episode's result so the campaign surfaces them."""
+        from cruise_control_tpu.sim.ha import (
+            HaScenarioRunner, failover_parity_failures,
+        )
+        from cruise_control_tpu.sim.runner import ScenarioRunner
+        r = HaScenarioRunner(sc, seed=0).run()
+        solo_sc = dataclasses.replace(
+            sc, name=sc.name + "-solo",
+            events=tuple(e for e in sc.events if e.kind != "leader_kill"))
+        solo = ScenarioRunner(solo_sc, seed=0).run()
+        parity = failover_parity_failures(r, solo)
+        r.failures.extend(parity)
+        r.failures.extend(f"oracle run: {f}" for f in solo.failures)
+        if r.failover:
+            r.failover["parity_ok"] = not parity
+        return r
 
 
 def run_campaign(spec, seed: int = 0) -> CampaignResult:
@@ -525,6 +615,12 @@ SMALL = CampaignSpec(name="small", cluster=_MICRO_CLUSTER, episodes=6,
                      min_faults=2, max_faults=4, provision_episode=True,
                      duration_ms=3_000_000.0)
 
+# HA failover certification rung: one leader_kill episode on the micro
+# cluster — kill the leader mid-heal, promote the journal-tailing standby,
+# certify outcome parity against the single-controller oracle run
+HA_MICRO = CampaignSpec(name="ha-micro", cluster=_MICRO_CLUSTER, episodes=1,
+                        leader_kill_episode=True, duration_ms=3_000_000.0)
+
 # the 50-broker rung (the scenario catalog's larger ladder step)
 BROAD_50B = CampaignSpec(
     name="broad-50b",
@@ -535,4 +631,4 @@ BROAD_50B = CampaignSpec(
     episodes=3, min_faults=2, max_faults=4,
     duration_ms=3_000_000.0, tick_ms=15_000.0)
 
-CAMPAIGNS = {c.name: c for c in (MICRO, SMALL, BROAD_50B)}
+CAMPAIGNS = {c.name: c for c in (MICRO, SMALL, HA_MICRO, BROAD_50B)}

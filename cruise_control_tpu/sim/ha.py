@@ -1,0 +1,260 @@
+"""HaScenarioRunner: two controllers, one simulated backend, leader kill.
+
+Extends the deterministic scenario loop with the HA controller pair from
+``cruise_control_tpu.ha``:
+
+- the **leader** is the base runner's facade (``self.cc``), configured with
+  a durable file journal (``journal.fsync=always``) and a FileSampleStore —
+  the two artifacts a real standby would tail across processes;
+- a **standby** facade is built over the SAME ``SimulatedClusterBackend``
+  (same metadata/metric oracle, its own monitor/analyzer/executor state),
+  kept warm by a :class:`~cruise_control_tpu.ha.standby.StandbyController`
+  tailing the leader's journal in-process and its sample store on disk;
+- both run a :class:`~cruise_control_tpu.ha.lease.LeaderElector` against
+  the backend's CAS lease, ticked on the scenario grid.
+
+The ``leader_kill`` scenario event freezes the leader exactly like a
+process death: ``Executor.kill()`` makes the next executor loop iteration
+raise without running ANY cleanup (no throttle removal, no state reset, no
+journal span-end), and the runner stops driving the leader's control loop.
+The lease then lapses on the backend clock, the standby's CAS acquire
+succeeds, and ``StandbyController.promote()`` adopts the frozen task census
+— in-flight reassignments (still progressing inside the backend) resume
+mid-batch with zero aborts. From the promotion tick on, the base loop's
+``_drive_tick`` drives the promoted facade, so detection/heal continue on
+the survivor.
+
+Failover SLOs (all on simulated time, measured from the kill instant) land
+in ``ScenarioResult.failover``: detect-lease-loss, promote, first-proposal,
+adopted task counts. :func:`failover_parity_failures` is the campaign's
+certification check — the promoted run must converge to the same verdict
+set and the same final ground-truth assignment as a single-controller run
+of the identical (scenario, seed) with the kill stripped.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+
+from cruise_control_tpu.executor.executor import ExecutorKilledError
+from cruise_control_tpu.sim.runner import BASE_CONFIG, ScenarioRunner
+
+# config keys the HA runner injects for the leader only; stripped from the
+# recorded replay payload (the paths are process-dependent temp dirs — a
+# replay injects its own)
+_INJECTED_PATH_KEYS = ("journal.path", "sample.store.path")
+
+
+def final_assignment(backend) -> dict:
+    """Ground-truth ``{"topic-p": [leader, sorted replicas]}`` snapshot —
+    the object failover parity compares across runs."""
+    return {f"{t}-{p}": [info.leader, sorted(info.replicas)]
+            for (t, p), info in sorted(backend.partitions().items())}
+
+
+def verdict_set(result) -> set:
+    """The run's anomaly verdicts as an order-free set of (type, action)."""
+    return {(e["type"], e["action"]) for e in result.timeline
+            if e["kind"] == "anomaly"}
+
+
+def failover_parity_failures(ha_result, solo_result) -> list:
+    """Certification: the HA run (leader killed mid-heal, standby promoted)
+    must be outcome-equivalent to the single-controller run of the same
+    (scenario, seed). Returns failure strings (empty = parity holds)."""
+    out = []
+    fo = ha_result.failover
+    if not fo.get("promoted"):
+        out.append("standby never promoted after leader kill")
+        return out
+    if fo.get("aborted_tasks", 0):
+        out.append(f"{fo['aborted_tasks']} tasks aborted/dead on the "
+                   "promoted controller — failover must adopt, not abort")
+    hv, sv = verdict_set(ha_result), verdict_set(solo_result)
+    if hv != sv:
+        out.append(f"verdict sets diverge: ha-only={sorted(hv - sv)} "
+                   f"solo-only={sorted(sv - hv)}")
+    if ha_result.converged != solo_result.converged:
+        out.append(f"convergence diverges: ha={ha_result.converged} "
+                   f"solo={solo_result.converged}")
+    if ha_result.final_assignment != solo_result.final_assignment:
+        diff = [tp for tp in (set(ha_result.final_assignment)
+                              | set(solo_result.final_assignment))
+                if ha_result.final_assignment.get(tp)
+                != solo_result.final_assignment.get(tp)]
+        out.append(f"final assignments diverge on {len(diff)} partitions "
+                   f"(first: {sorted(diff)[:3]})")
+    return out
+
+
+class HaScenarioRunner(ScenarioRunner):
+    """Leader + warm standby over one backend; handles ``leader_kill``."""
+
+    def __init__(self, scenario, seed: int = 0, **kw):
+        if kw.get("pipelined"):
+            raise ValueError("HaScenarioRunner drives the blocking loop; "
+                             "pipelined mode is single-controller only")
+        self._ha_dir = tempfile.mkdtemp(prefix="cc_sim_ha_")
+        cfg = dict(scenario.config_dict())
+        cfg["journal.path"] = os.path.join(self._ha_dir, "journal.jsonl")
+        cfg.setdefault("journal.fsync", "always")
+        cfg["sample.store.path"] = os.path.join(self._ha_dir, "samples")
+        scenario = dataclasses.replace(scenario,
+                                       config=tuple(sorted(cfg.items())))
+        super().__init__(scenario, seed=seed, **kw)
+        self.leader_cc = None
+        self.standby_cc = None
+        self.standby = None
+        self._leader_elector = None
+        self._leader_dead = False
+        self._promoted = False
+        self._kill_ms: float | None = None
+        self._first_proposal_ms: float | None = None
+
+    # ------------------------------------------------------------- wiring
+    def _build(self):
+        from cruise_control_tpu.app import CruiseControl
+        from cruise_control_tpu.config import cruise_control_config
+        from cruise_control_tpu.ha import LeaderElector, StandbyController
+
+        super()._build()
+        # replay payload determinism: drop the injected temp-dir paths
+        self.result.scenario_spec["config"] = [
+            [k, v] for k, v in self.result.scenario_spec["config"]
+            if k not in _INJECTED_PATH_KEYS]
+        self.leader_cc = self.cc
+        self._leader_elector = LeaderElector.from_config(
+            self.backend, "cc-a", self.leader_cc.config,
+            journal=self.leader_cc.journal, sensors=self.leader_cc.sensors)
+        self.leader_cc.ha = self._leader_elector
+        if self._leader_elector.tick() != "leader":
+            raise RuntimeError("initial election lost on a free lease")
+        # the standby facade: SAME backend, its own in-memory journal, no
+        # sample store of its own — state arrives only via the tails, which
+        # is what makes the bit-identity claim meaningful
+        props = dict(BASE_CONFIG)
+        props.update(self.scenario.config_dict())
+        props["journal.path"] = ""
+        props["journal.fsync"] = "never"
+        props["sample.store.path"] = ""
+        self.standby_cc = CruiseControl(self.backend,
+                                        cruise_control_config(props))
+        self.standby_cc.start_up()
+        self._attach_verifier(self.standby_cc)
+
+        def _first_prop(operation, reason, res, executed):
+            if self._promoted and self._first_proposal_ms is None:
+                self._first_proposal_ms = float(self._now())
+        self.standby_cc.optimization_observers.append(_first_prop)
+
+        elector = LeaderElector.from_config(
+            self.backend, "cc-b", self.standby_cc.config,
+            journal=self.standby_cc.journal, sensors=self.standby_cc.sensors)
+        self.standby = StandbyController(
+            self.standby_cc,
+            leader_journal=self.leader_cc.journal,
+            leader_sample_path=os.path.join(self._ha_dir, "samples"),
+            elector=elector,
+            sync_interval_ms=self.scenario.tick_ms)
+
+    # ----------------------------------------------------------- the events
+    def _fire_custom(self, ev, now: float) -> None:
+        if ev.kind != "leader_kill":
+            super()._fire_custom(ev, now)
+            return
+        # process death, not shutdown: the executor freezes without cleanup
+        # (throttles stay set, the census stays open in the journal), and
+        # this runner never ticks the leader's loop or elector again — so
+        # the lease lapses on the backend clock
+        self._kill_ms = now
+        self._leader_dead = True
+        self.leader_cc.executor.kill()
+
+    # ------------------------------------------------------------- the loop
+    def _drive_tick(self, now: float) -> None:
+        if not self._leader_dead:
+            self._leader_elector.tick()
+            try:
+                super()._drive_tick(now)
+            except ExecutorKilledError:
+                # leader_kill fired inside this tick's blocking heal: the
+                # leader "process" is gone mid-execution, exactly the
+                # mid-batch freeze the standby must adopt
+                self._record("leader_dead", self._now())
+            else:
+                # a blocking heal can swallow many renew intervals of
+                # simulated time; re-assert the lease the moment it returns
+                # (re-acquiring an expired lease you still own is legal CAS)
+                # so the standby can only win while the leader is truly dead
+                self._leader_elector.tick()
+        elif self._promoted:
+            super()._drive_tick(now)
+        if not self._promoted:
+            out = self.standby.tick()
+            if out.get("promoted"):
+                self._promoted = True
+                self.cc = self.standby_cc          # the loop follows the survivor
+                self._provision_cursor = 0
+                self._record("ha_promoted", self._now(),
+                             adoption=out.get("adoption"))
+
+    def _extra_convergence_checks(self) -> list:
+        out = super()._extra_convergence_checks()
+        if self._kill_ms is not None:
+            # certification gates after a kill: the standby must take over,
+            # and the SURVIVOR must re-run detection all the way to its own
+            # FIX verdict on the original fault before the episode settles —
+            # adoption alone (finishing the dead leader's batch) is not
+            # "resumed detection and optimization"
+            if not self._promoted:
+                out.append("standby not promoted after leader kill yet")
+            else:
+                t_prom = self.standby.promoted_ms - self._t0
+                if not any(e["kind"] == "anomaly" and e["action"] == "FIX"
+                           and e["t"] >= round(t_prom, 1)
+                           for e in self.result.timeline):
+                    out.append("promoted controller has not passed a FIX "
+                               "verdict post-takeover yet")
+        return out
+
+    # ------------------------------------------------------------- finalize
+    def _finalize(self, heal_candidate_ms) -> None:
+        if self._kill_ms is not None:
+            fo = {"promoted": self._promoted}
+            el = self.standby.elector
+            if el.elected_ms is not None:
+                fo["detect_lease_loss_ms"] = round(
+                    el.elected_ms - self._kill_ms, 1)
+            if self.standby.promoted_ms is not None:
+                fo["promote_ms"] = round(
+                    self.standby.promoted_ms - self._kill_ms, 1)
+            if self._first_proposal_ms is not None:
+                fo["first_proposal_ms"] = round(
+                    self._first_proposal_ms - self._kill_ms, 1)
+            adoption = self.standby.adoption or {}
+            fo["adopted_tasks"] = adoption.get("adopted", 0)
+            fo["adopted_in_flight"] = adoption.get("inFlight", 0)
+            fo["journal_lag_events"] = self.standby.journal_lag_events()
+            fo["dropped_events"] = self.standby.dropped_events
+            by_state = self.standby_cc.executor.state_json().get(
+                "numTasksByState", {})
+            fo["aborted_tasks"] = int(by_state.get("ABORTED", 0)
+                                      + by_state.get("ABORTING", 0)
+                                      + by_state.get("DEAD", 0))
+            self.result.failover = fo
+        super()._finalize(heal_candidate_ms)
+        # the base finalize shut down ``self.cc`` (the survivor); release the
+        # other facade's resources too — the dead leader's journal file
+        # handle and sample store, or the never-promoted standby
+        for cc in (self.leader_cc, self.standby_cc):
+            if cc is not None and cc is not self.cc:
+                try:
+                    cc.shutdown()
+                except Exception:
+                    pass
+
+
+def run_ha_scenario(scenario, seed: int = 0):
+    """Build + run one scenario under the leader/standby pair."""
+    return HaScenarioRunner(scenario, seed=seed).run()

@@ -99,6 +99,15 @@ class ScenarioResult:
     # drive): deterministic stage/backpressure/staleness counts — part of
     # the reproducible record when the runner drove the pipeline
     pipeline: dict = dataclasses.field(default_factory=dict)
+    # HA failover SLO samples (sim/ha.py HaScenarioRunner only): detect-
+    # lease-loss / promote / first-proposal latencies from the leader-kill
+    # instant, plus adopted-task counts — all on SIMULATED time
+    failover: dict = dataclasses.field(default_factory=dict)
+    # final ground-truth assignment {"topic-p": {"leader", "replicas"}} —
+    # the campaign's failover-parity check compares this against a single-
+    # controller run of the same (scenario, seed). Excluded from to_json()
+    # (can be large; parity runs in-memory).
+    final_assignment: dict = dataclasses.field(default_factory=dict)
     # the app's durable event journal slice (common/tracing.EventJournal
     # lines: spans, round summaries, task census, breaker transitions) —
     # everything is stamped on SIMULATED time and journals only
@@ -143,6 +152,7 @@ class ScenarioResult:
             "concurrency_adjustments": self.concurrency_adjustments,
             "failures": list(self.failures),
             **({"pipeline": self.pipeline} if self.pipeline else {}),
+            **({"failover": self.failover} if self.failover else {}),
         }
 
 
@@ -224,6 +234,13 @@ class ScenarioRunner:
         # structural proposal validity, no adds onto dead hardware. Verdicts
         # are deterministic functions of the optimization result, so they are
         # part of the reproducible episode record.
+        self._attach_verifier(self.cc)
+        self._provision_cursor = 0
+
+    def _attach_verifier(self, cc) -> None:
+        """Verify every optimization ``cc`` runs (the HA runner attaches
+        this to BOTH controllers — the promoted standby's heals are held to
+        the same structural bar as the leader's)."""
         from cruise_control_tpu.analyzer.verifier import verify_operation_result
 
         def _verify(operation, reason, res, executed):
@@ -234,8 +251,7 @@ class ScenarioRunner:
                     f"{operation}: {v}" for v in viols)
                 self._record("verifier_violation", self._now(),
                              operation=operation, violations=viols)
-        self.cc.optimization_observers.append(_verify)
-        self._provision_cursor = 0
+        cc.optimization_observers.append(_verify)
 
     def _now(self) -> float:
         return self.backend.now_ms()
@@ -326,16 +342,21 @@ class ScenarioRunner:
                                     "brokers": p["brokers"],
                                     "topics": p["topics"]}) + "\n")
         else:
-            raise ValueError(f"unknown scenario event kind {ev.kind!r}")
+            self._fire_custom(ev, now)
         self._events_pending -= 1
         self._record("inject", now, event=ev.label(),
                      during_execution=self.cc.executor.has_ongoing_execution())
+
+    def _fire_custom(self, ev, now: float) -> None:
+        """Extension point for subclass-specific event kinds (sim/ha.py
+        handles ``leader_kill`` here); the base runner knows none."""
+        raise ValueError(f"unknown scenario event kind {ev.kind!r}")
 
     # -------------------------------------------------------------- the loop
     def run(self) -> ScenarioResult:
         sc = self.scenario
         self._build()
-        lm, ad = self.cc.load_monitor, self.cc.anomaly_detector
+        lm = self.cc.load_monitor
         if self.pipelined:
             # lockstep pipelined mode: the runner's per-tick sampling drives
             # the pipeline's ingest->ring->sync stages (deterministic: one
@@ -368,16 +389,7 @@ class ScenarioRunner:
             # nominal grid already; ticks are relative, not grid-aligned
             self.backend.advance(sc.tick_ms)
             now = self._now()
-            if self.pipe is not None:
-                run_opt = (self.optimize_every > 0
-                           and self.result.ticks % self.optimize_every == 0)
-                self.pipe.step(now, optimize=run_opt)
-            else:
-                lm.sample_once(now_ms=now)
-            ad.run_due(now)
-            self._record_provision_actions()
-            for h in ad.handle_anomalies(now):
-                self._record_handled(h, self._now())
+            self._drive_tick(now)
             if self._tick_hook is not None:
                 # the REST fuzzer's lockstep slot: deterministic request
                 # schedules run here, racing detector heals in sim time
@@ -405,6 +417,23 @@ class ScenarioRunner:
                     settled = 0
         self._finalize(heal_candidate_ms)
         return self.result
+
+    def _drive_tick(self, now: float) -> None:
+        """One control-plane tick: sampling round -> due detection ->
+        anomaly handling. Binds the monitor/detector from ``self.cc`` EVERY
+        tick — the HA runner (sim/ha.py) swaps the facade on failover and
+        the loop must follow the promoted controller, not the dead one."""
+        lm, ad = self.cc.load_monitor, self.cc.anomaly_detector
+        if self.pipe is not None:
+            run_opt = (self.optimize_every > 0
+                       and self.result.ticks % self.optimize_every == 0)
+            self.pipe.step(now, optimize=run_opt)
+        else:
+            lm.sample_once(now_ms=now)
+        ad.run_due(now)
+        self._record_provision_actions()
+        for h in ad.handle_anomalies(now):
+            self._record_handled(h, self._now())
 
     def _record_provision_actions(self) -> None:
         """Fold Provisioner.rightsize actuations (SimulatedProvisioner
@@ -548,6 +577,10 @@ class ScenarioRunner:
         if r.time_to_heal_ms is not None:
             self.cc.sensors.timer("time-to-heal-timer").record(
                 r.time_to_heal_ms / 1000.0)
+        # ground-truth snapshot for the HA failover-parity check (sim/ha.py
+        # compares this across the promoted and single-controller runs)
+        from cruise_control_tpu.sim.ha import final_assignment
+        r.final_assignment = final_assignment(self.truth)
         # hand the flight recorder's rounds + the sensor snapshot to the
         # caller — bench --scenario and the tests read THESE, not private
         # runner bookkeeping

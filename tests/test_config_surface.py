@@ -649,6 +649,12 @@ def test_every_canonical_key_is_consumed(tmp_path):
         # fleet mode (PR 13): the scheduler reads the fleet.* family
         from cruise_control_tpu.fleet import FleetScheduler
         FleetScheduler(config=cfg).shutdown()
+        # fleet-in-main + HA (PR 15): the multi-tenant boot reads
+        # fleet.cluster.ids, the leader elector reads ha.lease.*
+        from cruise_control_tpu.main import build_fleet
+        build_fleet(cc, cfg, {}, {})
+        from cruise_control_tpu.ha import LeaderElector
+        LeaderElector.from_config(be, "config-surface", cfg)
         cc.load_monitor.sample_once(now_ms=0.0)
         cc.load_monitor.sample_once(now_ms=300000.0)
         # self-healing fix path reads the healing-goal + exclusion keys

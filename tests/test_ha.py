@@ -1,0 +1,475 @@
+"""HA controller tests (cruise_control_tpu/ha): lease-based leader
+election on the backend CAS, journal-tailing warm standby, census-adopting
+failover, and the leader_kill chaos certification.
+
+Fast units first — double-leader impossibility, epoch fencing, the journal
+tail/rotation seams, census mirroring, adopt_census semantics, the tool
+surfaces — then one full ha-micro campaign episode: kill the leader
+mid-heal and prove the promoted standby converges to the same verdicts and
+final assignment as a single-controller run (zero aborted-by-failover
+tasks), which is the PR's acceptance gate."""
+import importlib.util
+import io
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.backend import SimulatedClusterBackend
+from cruise_control_tpu.common.tracing import EventJournal, JournalTailer
+from cruise_control_tpu.executor import Executor
+from cruise_control_tpu.ha import LeaderElector, StandbyController
+from cruise_control_tpu.monitor import LoadMonitor
+from cruise_control_tpu.monitor.sampling.sample_store import FileSampleStore
+from cruise_control_tpu.monitor.sampling.samplers import SimulatedMetricSampler
+
+
+def _tool(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name, pathlib.Path(__file__).parent.parent / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _backend():
+    be = SimulatedClusterBackend()
+    for b, rack in ((0, "r0"), (1, "r0"), (2, "r1"), (3, "r1")):
+        be.add_broker(b, rack)
+    be.create_partition("t", 0, [0, 1], size_mb=100.0, bytes_in_rate=10)
+    be.create_partition("t", 1, [1, 2], size_mb=200.0, bytes_in_rate=10)
+    be.create_partition("t", 2, [2, 0], size_mb=50.0, bytes_in_rate=10)
+    return be
+
+
+# ------------------------------------------------------------ lease election
+
+def test_double_leader_impossible_under_cas_race():
+    """Two contenders racing the same key: the backend CAS serializes them,
+    so at every instant at most one elector holds the leader role."""
+    be = _backend()
+    a = LeaderElector(be, "cc-a", ttl_ms=30_000, renew_ms=10_000)
+    b = LeaderElector(be, "cc-b", ttl_ms=30_000, renew_ms=10_000)
+    assert a.tick() == "leader"
+    assert b.tick() == "standby"
+    for _ in range(20):
+        be.advance(5_000.0)
+        roles = {a.tick(), b.tick()}
+        assert [a.role, b.role].count("leader") == 1
+        assert "leader" in roles       # someone always holds the lease
+    assert a.role == "leader" and b.role == "standby"
+
+
+def test_lease_expiry_promotes_standby_and_fences_old_leader():
+    be = _backend()
+    a = LeaderElector(be, "cc-a", ttl_ms=30_000, renew_ms=10_000)
+    b = LeaderElector(be, "cc-b", ttl_ms=30_000, renew_ms=10_000)
+    assert a.tick() == "leader"
+    assert a.epoch == 1
+    # a stops renewing (process death); b's acquire only grants after a
+    # full TTL on the BACKEND clock
+    be.advance(29_000.0)
+    assert b.tick() == "standby"
+    be.advance(2_000.0)
+    assert b.tick() == "leader"
+    assert b.epoch == 2                      # ownership change bumps epoch
+    assert b.elected_ms == be.now_ms()
+    # the zombie leader's next renew is refused: it steps down, never
+    # split-brains
+    assert a.tick() == "standby"
+    assert a.lost_ms == be.now_ms()
+    assert be.lease_get(a.key)["holder"] == "cc-b"
+
+
+def test_leader_renewal_keeps_epoch_stable():
+    """Renewals (and re-acquiring your own expired lease after a long
+    blocking heal) never hand the lease away; only ownership CHANGES bump
+    the fencing epoch."""
+    be = _backend()
+    a = LeaderElector(be, "cc-a", ttl_ms=30_000, renew_ms=10_000)
+    assert a.tick() == "leader"
+    for _ in range(5):
+        be.advance(10_000.0)
+        assert a.tick() == "leader"
+    assert be.lease_get(a.key)["epoch"] == 1
+    # lapse without a contender: the owner re-acquires and stays leader
+    be.advance(120_000.0)
+    assert a.tick() == "leader"
+    assert a.role == "leader"
+
+
+def test_resign_releases_lease_immediately():
+    be = _backend()
+    a = LeaderElector(be, "cc-a", ttl_ms=30_000, renew_ms=10_000)
+    b = LeaderElector(be, "cc-b", ttl_ms=30_000, renew_ms=10_000)
+    assert a.tick() == "leader"
+    a.resign()
+    # no TTL wait: the standby's very next tick wins the freed lease
+    assert b.tick() == "leader"
+    assert be.lease_get(b.key)["holder"] == "cc-b"
+
+
+# ----------------------------------------------------------- journal tailing
+
+def test_event_journal_tail_from_arbitrary_offsets():
+    clock = [0.0]
+    j = EventJournal(clock_ms=lambda: clock[0], memory_lines=64)
+    for i in range(10):
+        j.append("task", i=i)
+    cur, lines, dropped = j.tail(0)
+    assert (cur, len(lines), dropped) == (10, 10, 0)
+    # arbitrary mid-stream cursor: exactly the suffix, no drops
+    cur, lines, dropped = j.tail(7)
+    assert dropped == 0
+    assert [json.loads(ln)["i"] for ln in lines] == [7, 8, 9]
+    # caught up: empty
+    assert j.tail(cur) == (10, [], 0)
+
+
+def test_event_journal_tail_reports_ring_evictions():
+    j = EventJournal(memory_lines=16)        # floor of the bounded ring
+    for i in range(40):
+        j.append("task", i=i)
+    cur, lines, dropped = j.tail(0)
+    assert cur == 40
+    assert dropped == 24                     # evicted before the tail began
+    assert [json.loads(ln)["i"] for ln in lines] == list(range(24, 40))
+
+
+def test_journal_tailer_survives_rotations_without_drop_or_dup(tmp_path):
+    """Satellite (f): the file follower across ``journal.max.bytes.per.file``
+    rotation seams — every appended line is delivered exactly once even when
+    several rotations land between polls."""
+    clock = [0.0]
+    path = str(tmp_path / "journal.jsonl")
+    j = EventJournal(path=path, max_bytes=4096, max_files=8, fsync="always",
+                     clock_ms=lambda: clock[0])
+    tailer = JournalTailer(path)
+    assert tailer.poll() == []      # attach at offset 0, before any appends
+    seen = []
+    for i in range(400):
+        clock[0] += 1.0
+        j.append("task", i=i, pad="x" * 80)   # ~37 lines per 4 KiB file
+        if i % 100 == 99:                     # ≥2 rotations between polls
+            seen.extend(tailer.poll())
+    j.close()
+    seen.extend(tailer.poll())
+    tailer.close()
+    assert j.rotations >= 5
+    assert [json.loads(ln)["i"] for ln in seen] == list(range(400))
+
+
+def test_journal_view_follow_prints_tailed_events(tmp_path):
+    jv = _tool("journal_view")
+    path = str(tmp_path / "journal.jsonl")
+    j = EventJournal(path=path, clock_ms=lambda: 1000.0)
+    j.append("task", i=0, st="PENDING")
+    j.append("ha", ev="promoted", holder="cc-b")
+    j.close()
+    buf = io.StringIO()
+    assert jv.follow(path, max_events=2, out=buf) == 0
+    lines = buf.getvalue().strip().splitlines()
+    assert len(lines) == 2
+    assert "task" in lines[0] and "st=PENDING" in lines[0]
+    assert "ha" in lines[1] and "ev=promoted" in lines[1]
+    # drained before max_events: returns instead of blocking
+    buf2 = io.StringIO()
+    assert jv.follow(path, max_events=10, out=buf2) == 0
+    assert len(buf2.getvalue().strip().splitlines()) == 2
+
+
+# ------------------------------------------------- standby mirror + adoption
+
+class _StubSensors:
+    def gauge(self, name, fn):
+        return None
+
+
+class _StubExecutor:
+    def __init__(self):
+        self.records = None
+
+    def adopt_census(self, records, context=None):
+        self.records = records
+        return {"adopted": len(records), "inFlight": sum(
+            1 for r in records if r["st"] == "IN_PROGRESS")}
+
+
+class _StubCC:
+    """The minimal facade surface StandbyController touches."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self.sensors = _StubSensors()
+        self.resident_session = None
+        self.load_monitor = None
+        self.journal = EventJournal(clock_ms=backend.now_ms)
+        self.executor = _StubExecutor()
+        self.ha = None
+
+
+def _task_row(j, span, i, st, payload=True, **extra):
+    fields = dict(i=i, tp=["t", i], ty="INTER_BROKER_REPLICA_ACTION",
+                  st=st, span=span, trace="tr", **extra)
+    if payload:
+        fields.update(ol=0, nl=1, orp=[[0, 0], [1, 0]], nrp=[[1, 0], [2, 0]])
+    j.append("task", **fields)
+
+
+def test_standby_census_adopts_only_the_incomplete_execution():
+    """Span-end events mark executions that finished cleanly; a killed
+    leader never journals one, which is how promote() finds the execution
+    to adopt — with the rows' LAST journaled states merged in."""
+    be = _backend()
+    leader_j = EventJournal(clock_ms=be.now_ms)
+    cc = _StubCC(be)
+    sb = StandbyController(cc, leader_journal=leader_j,
+                           elector=None, sync_interval_ms=1e18)
+    # execution e1 completed cleanly (span end journaled)
+    _task_row(leader_j, "e1", 0, "PENDING")
+    _task_row(leader_j, "e1", 0, "COMPLETED", payload=False)
+    leader_j.append("span", span="e1", span_kind="execution", name="op")
+    # execution e2: the leader died inside it — no span end
+    _task_row(leader_j, "e2", 0, "PENDING")
+    _task_row(leader_j, "e2", 0, "COMPLETED", payload=False)
+    _task_row(leader_j, "e2", 1, "PENDING")
+    _task_row(leader_j, "e2", 1, "IN_PROGRESS", payload=False)
+    _task_row(leader_j, "e2", 2, "PENDING")
+    out = sb.tick()
+    assert out == {"promoted": False, "events": 8, "samples": 0}
+    assert sb.journal_lag_events() == 0
+    res = sb.promote()
+    assert res["promoted"] is True
+    assert res["adoption"] == {"adopted": 3, "inFlight": 1}
+    by_i = {r["i"]: r["st"] for r in cc.executor.records}
+    # merged census: payload row + latest state, one record per plan index
+    assert by_i == {0: "COMPLETED", 1: "IN_PROGRESS", 2: "PENDING"}
+    assert sb.role == "leader"
+
+
+def test_standby_tail_from_mid_stream_counts_drops_and_skips_adoption():
+    """A standby attached after the ring evicted the payload rows reports
+    the loss and refuses to adopt partial censuses (payload-less rows are
+    not adoptable)."""
+    be = _backend()
+    leader_j = EventJournal(clock_ms=be.now_ms, memory_lines=16)
+    for i in range(30):                       # evicts the early rows
+        _task_row(leader_j, "e1", i, "PENDING", payload=(i < 10))
+    cc = _StubCC(be)
+    sb = StandbyController(cc, leader_journal=leader_j,
+                           elector=None, sync_interval_ms=1e18)
+    sb.tick()
+    assert sb.dropped_events == 14            # 30 appended - 16 ring slots
+    res = sb.promote()
+    # the surviving rows are all payload-less -> nothing adoptable
+    assert res["adoption"] is None
+    assert cc.executor.records is None
+
+
+def test_standby_promotes_via_elector_when_lease_lapses():
+    be = _backend()
+    leader_j = EventJournal(clock_ms=be.now_ms)
+    leader = LeaderElector(be, "cc-a", ttl_ms=30_000, renew_ms=10_000)
+    assert leader.tick() == "leader"
+    cc = _StubCC(be)
+    elector = LeaderElector(be, "cc-b", ttl_ms=30_000, renew_ms=10_000)
+    sb = StandbyController(cc, leader_journal=leader_j, elector=elector,
+                           sync_interval_ms=1e18)
+    # leader alive and renewing: the standby stays warm, never promotes
+    for _ in range(3):
+        be.advance(10_000.0)
+        leader.tick()
+        assert sb.tick()["promoted"] is False
+    # leader dies; the standby's tick wins the lease once the TTL lapses
+    be.advance(31_000.0)
+    out = sb.tick()
+    assert out["promoted"] is True
+    assert sb.promoted_ms == be.now_ms()
+    assert elector.role == "leader" and elector.epoch == 2
+    # the takeover is journaled on the STANDBY's own journal
+    ha_events = [json.loads(ln) for ln in cc.journal.lines()]
+    assert any(e["kind"] == "ha" and e["ev"] == "promoted"
+               for e in ha_events)
+
+
+def test_adopt_census_resumes_exactly_pending_and_in_progress():
+    """Satellite (c): terminal rows are skipped, PENDING rows re-enter a
+    fresh planner, IN_PROGRESS inter-broker moves resume mid-batch off the
+    backend's still-live reassignment — nothing is aborted."""
+    be = _backend()
+    # the dead leader's in-flight move: the backend still holds it
+    be.alter_partition_reassignments({("t", 1): [3, 2]})
+    records = [
+        {"i": 0, "tp": ["t", 0], "ty": "INTER_BROKER_REPLICA_ACTION",
+         "st": "COMPLETED", "ol": 0, "nl": 0,
+         "orp": [[0, 0], [1, 0]], "nrp": [[0, 0], [1, 0]]},
+        {"i": 1, "tp": ["t", 1], "ty": "INTER_BROKER_REPLICA_ACTION",
+         "st": "IN_PROGRESS", "ol": 1, "nl": 3,
+         "orp": [[1, 0], [2, 0]], "nrp": [[3, 0], [2, 0]]},
+        {"i": 2, "tp": ["t", 2], "ty": "INTER_BROKER_REPLICA_ACTION",
+         "st": "PENDING", "ol": 2, "nl": 1,
+         "orp": [[2, 0], [0, 0]], "nrp": [[1, 0], [0, 0]]},
+    ]
+    ex = Executor(be)
+    out = ex.adopt_census(records,
+                          context={"operation": "failover census adoption"})
+    assert out == {"adopted": 2, "inFlight": 1}
+    parts = be.partitions()
+    assert sorted(parts[("t", 1)].replicas) == [2, 3]   # adopted in-flight
+    assert parts[("t", 1)].leader == 3
+    assert sorted(parts[("t", 2)].replicas) == [0, 1]   # adopted pending
+    assert sorted(parts[("t", 0)].replicas) == [0, 1]   # terminal: untouched
+    st = ex.state_json()
+    by_state = st.get("numTasksByState", {})
+    assert by_state.get("COMPLETED") == 2
+    for bad in ("ABORTED", "ABORTING", "DEAD"):
+        assert not by_state.get(bad)
+
+
+def test_adopt_census_refuses_concurrent_execution():
+    be = _backend()
+    ex = Executor(be)
+    rec = [{"i": 0, "tp": ["t", 0], "ty": "LEADER_ACTION", "st": "PENDING",
+            "ol": 0, "nl": 1, "orp": [[0, 0], [1, 0]],
+            "nrp": [[0, 0], [1, 0]]}]
+    from cruise_control_tpu.executor.executor import ExecutorState
+    ex._state = ExecutorState.STARTING_EXECUTION
+    with pytest.raises(RuntimeError):
+        ex.adopt_census(rec)
+
+
+# --------------------------------------------------- sample-tail bit-identity
+
+def test_standby_monitor_is_bit_identical_to_fresh_store_replay(tmp_path):
+    """The standby's aggregators after tailing the leader's FileSampleStore
+    at arbitrary chunk boundaries are bit-identical to a fresh monitor
+    replaying the same files in one shot — same windows, same model."""
+    from cruise_control_tpu.ha.standby import SampleTailer
+
+    be = _backend()
+    store = FileSampleStore(str(tmp_path))
+    store.configure(None)
+    leader = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be),
+                         sample_store=store)
+    leader.start_up()
+    standby = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be))
+    standby.start_up()
+    tailer = SampleTailer(str(tmp_path))
+    for i in range(20):
+        leader.sample_once(now_ms=i * 60_000.0)
+        if i % 3 == 2:                        # arbitrary tail offsets
+            batch = tailer.poll()
+            if batch is not None:
+                standby._ingest(batch)
+    batch = tailer.poll()                     # final catch-up
+    if batch is not None:
+        standby._ingest(batch)
+    leader.shutdown()
+    # the oracle: a fresh monitor replaying the same store prefix at once
+    store2 = FileSampleStore(str(tmp_path))
+    store2.configure(None)
+    fresh = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be),
+                        sample_store=store2)
+    fresh.start_up()
+    assert standby.num_valid_windows == fresh.num_valid_windows
+    ct_s, _ = standby.cluster_model()
+    ct_f, _ = fresh.cluster_model()
+    np.testing.assert_array_equal(np.asarray(ct_s.broker_utilization()),
+                                  np.asarray(ct_f.broker_utilization()))
+    np.testing.assert_array_equal(np.asarray(ct_s.leader_load),
+                                  np.asarray(ct_f.leader_load))
+    standby.shutdown()
+    fresh.shutdown()
+
+
+# -------------------------------------------------------------- tool gating
+
+def _ha_doc(promote_p95=5000.0, first_p95=40_000.0, parity=True, aborted=0):
+    return {"episodes": 1,
+            "detect_lease_loss_ms": {"n": 1, "p50": promote_p95,
+                                     "p95": promote_p95, "max": promote_p95},
+            "promote_ms": {"n": 1, "p50": promote_p95, "p95": promote_p95,
+                           "max": promote_p95},
+            "first_proposal_ms": {"n": 1, "p50": first_p95, "p95": first_p95,
+                                  "max": first_p95},
+            "parity_ok": parity, "aborted_by_failover": aborted}
+
+
+def test_slo_diff_extract_and_compare_ha():
+    sd = _tool("slo_diff")
+    base = _ha_doc()
+    assert sd.extract_ha({"ha": base}) == base
+    assert sd.extract_ha({"failover": base}) == base
+    assert sd.extract_ha({"campaign": {"failover": base}}) == base
+    assert sd.extract_ha({}) == {}
+    # within threshold: no regression
+    rows, regs = sd.compare_ha(base, _ha_doc(promote_p95=6000.0))
+    assert regs == []
+    assert len(rows) == 3
+    # p95 blowout, parity loss, and failover aborts all gate
+    _, regs = sd.compare_ha(base, _ha_doc(promote_p95=12_000.0))
+    assert any(r["field"] in ("promote_ms", "detect_lease_loss_ms")
+               for r in regs)
+    _, regs = sd.compare_ha(base, _ha_doc(parity=False))
+    assert any(r["field"] == "parity_ok" for r in regs)
+    _, regs = sd.compare_ha(base, _ha_doc(aborted=3))
+    assert any(r["field"] == "aborted_by_failover" for r in regs)
+    # coverage lost: the candidate stopped measuring a failover SLO
+    cand = _ha_doc()
+    del cand["first_proposal_ms"]
+    _, regs = sd.compare_ha(base, cand)
+    assert any(r["field"] == "first_proposal_ms" for r in regs)
+
+
+# ------------------------------------------- leader_kill chaos certification
+
+@pytest.fixture(scope="module")
+def ha_campaign():
+    """One ha-micro campaign: broker death, leader killed mid-heal, standby
+    promotes, plus the single-controller oracle run for the parity gate."""
+    from cruise_control_tpu.sim import run_campaign
+    return run_campaign("ha-micro", seed=0)
+
+
+def test_leader_kill_episode_converges_with_zero_aborts(ha_campaign):
+    assert len(ha_campaign.episodes) == 1
+    r = ha_campaign.episodes[0]
+    r.assert_ok()
+    assert r.converged
+    fo = r.failover
+    assert fo["promoted"] is True
+    assert fo["aborted_tasks"] == 0           # adopt, never abort
+    assert fo["adopted_tasks"] > 0
+    assert fo["parity_ok"] is True            # same verdicts + assignment
+    # the failover SLO chain is ordered and bounded by the lease TTL window
+    assert 0.0 < fo["detect_lease_loss_ms"] <= fo["promote_ms"]
+    assert fo["promote_ms"] < fo["first_proposal_ms"]
+    assert fo["journal_lag_events"] == 0      # caught up at promotion
+    assert fo["dropped_events"] == 0
+
+
+def test_leader_kill_episode_timeline_records_takeover(ha_campaign):
+    r = ha_campaign.episodes[0]
+    kinds = [e["kind"] for e in r.timeline]
+    assert "ha_promoted" in kinds
+    # the promoted controller re-ran detection to its own FIX verdict
+    t_prom = next(e["t"] for e in r.timeline if e["kind"] == "ha_promoted")
+    assert any(e["kind"] == "anomaly" and e["action"] == "FIX"
+               and e["t"] >= t_prom for e in r.timeline)
+
+
+def test_campaign_json_carries_failover_distributions(ha_campaign):
+    doc = ha_campaign.to_json()
+    fo = doc["failover"]
+    assert fo["episodes"] == 1
+    for field in ("detect_lease_loss_ms", "promote_ms", "first_proposal_ms"):
+        d = fo[field]
+        assert d["n"] == 1 and d["p95"] is not None and d["p95"] > 0
+    assert fo["aborted_by_failover"] == 0
+    assert fo["parity_ok"] is True
+    # the slo_diff gate consumes exactly this block
+    sd = _tool("slo_diff")
+    assert sd.extract_ha(doc) == fo
+    _, regs = sd.compare_ha(fo, fo)
+    assert regs == []

@@ -12,6 +12,12 @@ Usage:
   tools/journal_view.py JOURNAL.jsonl --perfetto OUT.json
   tools/journal_view.py JOURNAL.jsonl --slo           # span-derived SLOs
   tools/journal_view.py JOURNAL.jsonl --kind verdict  # filter root kind
+  tools/journal_view.py JOURNAL.jsonl --follow        # live tail (Ctrl-C ends)
+
+Follow mode runs the same rotation-seam-safe file follower a warm standby
+uses (``JournalTailer``): it survives ``journal.max.bytes.per.file``
+rotations mid-tail and prints one compact line per event as the leader
+appends it.
 
 Tree mode prints each trace as an indented span tree (kind:name, [t0..t1]
 extent on the journal's clock — simulated ms for sim journals — and the
@@ -206,11 +212,61 @@ def journal_slo(events: list[dict]) -> dict:
     return out
 
 
+def _fmt_event_line(e: dict) -> str:
+    """One journal event as a compact single line for --follow output."""
+    kind = str(e.get("kind", "?"))
+    ts = e.get("ts")
+    head = (f"{float(ts):>12.1f} {kind:<8}"
+            if isinstance(ts, (int, float)) else f"{'?':>12} {kind:<8}")
+    rest = {k: v for k, v in e.items() if k not in ("kind", "ts")}
+    return head + " " + " ".join(f"{k}={rest[k]}" for k in sorted(rest))
+
+
+def follow(path: str, interval_s: float = 0.5, max_events: int | None = None,
+           out=None) -> int:
+    """Live-tail a journal file across rotations (``--follow``).
+
+    ``max_events``/``out`` exist for tests: stop after N events instead of
+    tailing forever, and write somewhere other than stdout."""
+    import time
+
+    from cruise_control_tpu.common.tracing import JournalTailer
+    out = out if out is not None else sys.stdout
+    tailer = JournalTailer(path)
+    seen = 0
+    try:
+        while True:
+            lines = tailer.poll()
+            for ln in lines:
+                try:
+                    e = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                print(_fmt_event_line(e), file=out, flush=True)
+                seen += 1
+                if max_events is not None and seen >= max_events:
+                    return 0
+            if not lines:
+                if max_events is not None:
+                    return 0   # test mode: drained, don't wait
+                time.sleep(interval_s)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        tailer.close()
+
+
 def main(argv: list[str]) -> int:
     args = [a for a in argv if not a.startswith("--")]
     if not args:
         print(__doc__, file=sys.stderr)
         return 2
+    if "--follow" in argv:
+        if args[0] == "-":
+            print("--follow needs a journal file path, not stdin",
+                  file=sys.stderr)
+            return 2
+        return follow(args[0])
     raw = sys.stdin.read() if args[0] == "-" else open(args[0]).read()
     events = load_events(raw)
     spans = spans_of(events)

@@ -208,6 +208,66 @@ def compare_fleet(base: dict, cand: dict, threshold: float = 0.25):
     return rows, regressions
 
 
+def extract_ha(doc: dict) -> dict:
+    """The HA failover block: a bench summary's ``ha`` rung (bench.py --ha),
+    a campaign document's aggregated ``failover`` distributions, or {}."""
+    ha = doc.get("ha")
+    if isinstance(ha, dict) and ha:
+        return ha
+    fo = doc.get("failover")
+    if isinstance(fo, dict) and fo:
+        return fo
+    camp = doc.get("campaign")
+    if isinstance(camp, dict) and isinstance(camp.get("failover"), dict):
+        return camp["failover"]
+    return {}
+
+
+# failover-time distributions gated at p95 like the campaign SLOs
+HA_FIELDS = ("detect_lease_loss_ms", "promote_ms", "first_proposal_ms")
+
+
+def compare_ha(base: dict, cand: dict, threshold: float = 0.25):
+    """Gate the HA failover rung between two documents: a failover-time p95
+    (detect-lease-loss / promote / first-proposal) more than the threshold
+    above the baseline's, lost outcome parity with the single-controller
+    oracle, or any task aborted by failover when the baseline had none, all
+    fail."""
+    rows, regressions = [], []
+    for field in HA_FIELDS:
+        bp = (base.get(field) or {}).get("p95")
+        cp = (cand.get(field) or {}).get("p95")
+        if bp is None and cp is None:
+            continue
+        row = {"kind": "ha", "field": field, "base_p95": bp, "cand_p95": cp}
+        if bp is not None and cp is None:
+            row["regression"] = "coverage lost (no candidate samples)"
+            regressions.append(row)
+        elif bp is not None and cp is not None \
+                and cp > bp * (1.0 + threshold):
+            row["regression"] = (f"p95 {cp:.1f} > {bp:.1f} "
+                                 f"* (1 + {threshold:g})")
+            regressions.append(row)
+        rows.append(row)
+    if base.get("parity_ok") and cand.get("parity_ok") is False:
+        row = {"kind": "ha", "field": "parity_ok", "base_p95": 1,
+               "cand_p95": 0,
+               "regression": "failover lost outcome parity with the "
+                             "single-controller oracle"}
+        regressions.append(row)
+        rows.append(row)
+    ba = base.get("aborted_by_failover", 0) or 0
+    ca = cand.get("aborted_by_failover", 0) or 0
+    if ca > ba:
+        row = {"kind": "ha", "field": "aborted_by_failover",
+               "base_p95": ba, "cand_p95": ca,
+               "regression": f"aborted-by-failover {ba} -> {ca} "
+                             f"(takeover must adopt, not abort)"}
+        regressions.append(row)
+        rows.append(row)
+    return rows, regressions
+
+
 def load_doc(path: str) -> tuple[dict, bool]:
     """Load one input; returns (document, is_journal). A JSONL event
     journal is detected by its per-line records and converted to a
@@ -277,6 +337,13 @@ def main(argv: list[str]) -> int:
         frows, fregs = compare_fleet(fbase, fcand, threshold)
         rows.extend(frows)
         regressions.extend(fregs)
+        compared = True
+    # ... and on the HA rung (failover-time p95s / parity / adopt-not-abort)
+    hbase, hcand = extract_ha(base_doc), extract_ha(cand_doc)
+    if hbase and hcand:
+        hrows, hregs = compare_ha(hbase, hcand, threshold)
+        rows.extend(hrows)
+        regressions.extend(hregs)
         compared = True
     if not compared:
         print("no comparable SLO or steady-round blocks found in both "
