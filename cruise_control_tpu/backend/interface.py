@@ -161,6 +161,9 @@ class ClusterBackend(Protocol):
     def ongoing_reassignments(self) -> dict: ...
     def cancel_reassignments(self, tps: list) -> None: ...
     def elect_leaders(self, tps_to_leader: dict) -> None: ...
+    # declarative/idempotent: assigns each (topic, part, broker) replica to a
+    # target log dir — re-submitting a move that already landed re-asserts
+    # the same assignment (census adoption after failover relies on this)
     def alter_replica_logdirs(self, moves: dict) -> None: ...
     def describe_logdirs(self) -> dict: ...              # broker -> {logdir: alive}
     def set_replication_throttle(self, rate_bytes_per_sec: int | None) -> None: ...

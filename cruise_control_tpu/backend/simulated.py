@@ -334,8 +334,10 @@ class SimulatedClusterBackend:
     def lease_acquire(self, key: str, holder: str, ttl_ms: float) -> dict:
         """Atomic compare-and-swap lease (ClusterBackend protocol): grant
         when the key is free, the current lease has expired on the backend
-        clock, or ``holder`` already owns it (renewal). The epoch is a
-        fencing token: it increments only when OWNERSHIP changes."""
+        clock, or ``holder`` already owns it (renewal — including
+        re-asserting its own EXPIRED lease after e.g. a long blocking heal).
+        The epoch is a fencing token: it increments only when OWNERSHIP
+        changes, never on a same-holder renewal or re-assert."""
         with self._lock:
             now = self._now_ms
             cur = self._leases.get(key)
@@ -344,7 +346,7 @@ class SimulatedClusterBackend:
                 out = dict(cur, key=key, acquired=False)
                 return out
             epoch = (cur["epoch"] if cur is not None
-                     and cur["holder"] == holder and cur["expiresMs"] > now
+                     and cur["holder"] == holder
                      else (cur["epoch"] + 1 if cur is not None else 1))
             self._leases[key] = {"holder": holder,
                                  "expiresMs": now + float(ttl_ms),

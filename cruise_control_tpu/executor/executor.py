@@ -694,7 +694,15 @@ class Executor:
         holds their reassignments and the normal completion polling finishes
         them, so failover ABORTS NOTHING. IN_PROGRESS leadership moves
         re-arm as PENDING (elections are idempotent; re-submitting one that
-        already landed completes on the next progress check)."""
+        already landed completes on the next progress check). IN_PROGRESS
+        intra-broker (log-dir) moves also re-arm as PENDING: a journaled
+        IN_PROGRESS row means the dead leader's ``alter_replica_logdirs``
+        call had already returned (the transition is only journaled after
+        the submit), and the call is declarative by ClusterBackend contract
+        — it assigns replicas to target log dirs, so re-submitting a move
+        that already landed re-asserts the same assignment (the phase also
+        re-validates against current metadata first; asserted in
+        tests/test_ha.py)."""
         from cruise_control_tpu.analyzer.proposals import ExecutionProposal
         with self._lock:
             if self._killed:

@@ -70,6 +70,10 @@ class LeaderElector:
             if out.get("acquired"):
                 self._last_renew_ms = now
                 self._renewals += 1
+                # mirror the backend's fencing token on EVERY grant, not
+                # just the standby->leader transition, so journal rows never
+                # carry a stale epoch after a lapsed-lease re-assert
+                self.epoch = int(out["epoch"])
                 if self._m_renew is not None:
                     self._m_renew.mark()
             else:

@@ -184,12 +184,23 @@ class StandbyController:
     def tick(self) -> dict:
         """One standby step: tail the journal, replay new samples, keep the
         resident session warm, and run the election. Returns the promote()
-        result when this tick won the lease."""
+        result when this tick won the lease, and the demote() result when a
+        PROMOTED instance's renewal was refused (it has been fenced).
+
+        Ticking must continue after promotion: the leader role is only held
+        while the lease keeps being renewed. A promoted instance that
+        stopped ticking would let its lease lapse, hand the CAS to any other
+        contender (a restarted old leader, a third node), and keep accepting
+        writes with role=='leader' — exactly the split brain the lease
+        exists to prevent."""
         drained = self._drain_journal()
         replayed = self._replay_samples()
         sess = self.cc.resident_session
         now = float(self.cc.backend.now_ms())
-        if sess is not None and now - self._last_sync_ms >= self._sync_interval_ms:
+        if (self.role == "standby" and sess is not None
+                and now - self._last_sync_ms >= self._sync_interval_ms):
+            # warmth is a STANDBY concern; once promoted the live control
+            # loop owns the session's sync cadence
             self._last_sync_ms = now
             try:
                 sess.sync()
@@ -198,9 +209,15 @@ class StandbyController:
                 # have enough windows yet); correctness is asserted on the
                 # monitor/optimizer inputs, not on early sync attempts
                 pass
-        if self.elector is not None and self.role == "standby":
-            if self.elector.tick() == "leader":
-                return self.promote()
+        if self.elector is not None:
+            if self.role == "standby":
+                if self.elector.tick() == "leader":
+                    return self.promote()
+            elif self.elector.tick() != "leader":
+                # refused renewal: someone else won the CAS while this
+                # instance held the role (e.g. it froze past the TTL) —
+                # step down, never split-brain
+                return self.demote()
         return {"promoted": False, "events": drained, "samples": replayed}
 
     # -------------------------------------------------------------- takeover
@@ -241,6 +258,23 @@ class StandbyController:
                     context={"operation": "failover census adoption"})
         self.adoption = adoption
         return {"promoted": True, "adoption": adoption}
+
+    def demote(self) -> dict:
+        """Step down after being fenced: a refused renewal means another
+        contender now holds the lease. Writes close immediately (the
+        facade's role gate reads ``self.role``) and the executor stops
+        GRACEFULLY — no further task submissions, but in-flight backend
+        moves are left for the new leader to adopt from the census, not
+        cancelled out from under it."""
+        self.role = "standby"
+        self.promoted_ms = None
+        self.cc.executor.stop_execution(force=False)
+        lease = (self.elector.lease or {}) if self.elector is not None else {}
+        self.cc.journal.append("ha", ev="demoted",
+                               holder=getattr(self.elector, "holder", None),
+                               to=lease.get("holder"),
+                               epoch=lease.get("epoch"))
+        return {"promoted": False, "demoted": True}
 
     def retry_after_s(self) -> float:
         if self.elector is not None:

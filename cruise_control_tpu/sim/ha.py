@@ -189,7 +189,19 @@ class HaScenarioRunner(ScenarioRunner):
                 # so the standby can only win while the leader is truly dead
                 self._leader_elector.tick()
         elif self._promoted:
+            # the survivor leads now: SAME lease discipline as the original
+            # leader — renew on the grid, and re-assert the moment a
+            # blocking heal (which can swallow many renew intervals of
+            # simulated time) returns. standby.tick() with role=='leader'
+            # ticks the elector; without this the promoted node's lease
+            # would lapse and a restarted contender could split-brain it.
+            self.standby.tick()
             super()._drive_tick(now)
+            out = self.standby.tick()
+            if out.get("demoted"):
+                # impossible while the old leader stays dead; surfaced in
+                # the timeline (and by convergence failing) if it ever fires
+                self._record("ha_demoted", self._now())
         if not self._promoted:
             out = self.standby.tick()
             if out.get("promoted"):

@@ -93,10 +93,14 @@ def test_leader_renewal_keeps_epoch_stable():
         be.advance(10_000.0)
         assert a.tick() == "leader"
     assert be.lease_get(a.key)["epoch"] == 1
-    # lapse without a contender: the owner re-acquires and stays leader
+    # lapse without a contender: the owner re-acquires and stays leader,
+    # and the fencing token does NOT move (no ownership change) — nor does
+    # the elector report a stale one
     be.advance(120_000.0)
     assert a.tick() == "leader"
     assert a.role == "leader"
+    assert be.lease_get(a.key)["epoch"] == 1
+    assert a.epoch == 1
 
 
 def test_resign_releases_lease_immediately():
@@ -189,11 +193,15 @@ class _StubSensors:
 class _StubExecutor:
     def __init__(self):
         self.records = None
+        self.stopped = None
 
     def adopt_census(self, records, context=None):
         self.records = records
         return {"adopted": len(records), "inFlight": sum(
             1 for r in records if r["st"] == "IN_PROGRESS")}
+
+    def stop_execution(self, force=False):
+        self.stopped = {"force": force}
 
 
 class _StubCC:
@@ -293,6 +301,49 @@ def test_standby_promotes_via_elector_when_lease_lapses():
                for e in ha_events)
 
 
+def test_promoted_standby_keeps_renewing_and_steps_down_when_fenced():
+    """The leader role is only held while the lease keeps being renewed:
+    post-promotion ticks renew it (a restarted old leader can never win
+    against a live survivor), and a survivor that froze past the TTL steps
+    down on its first refused renewal instead of split-braining."""
+    be = _backend()
+    leader_j = EventJournal(clock_ms=be.now_ms)
+    cc = _StubCC(be)
+    elector = LeaderElector(be, "cc-b", ttl_ms=30_000, renew_ms=10_000)
+    sb = StandbyController(cc, leader_journal=leader_j, elector=elector,
+                           sync_interval_ms=1e18)
+    assert sb.tick()["promoted"] is True        # free lease: first tick wins
+    # the dead leader restarts as a fresh contender; while the promoted
+    # node keeps ticking, its renewals hold the lease across many TTLs
+    old = LeaderElector(be, "cc-a", ttl_ms=30_000, renew_ms=10_000)
+    for _ in range(8):
+        be.advance(10_000.0)
+        assert sb.tick() == {"promoted": False, "events": 0, "samples": 0}
+        assert old.tick() == "standby"
+    assert sb.role == "leader"
+    assert be.lease_get(elector.key)["holder"] == "cc-b"
+    # the survivor freezes (no ticks) past a full TTL: the contender takes
+    # over, and the zombie's next tick learns it was fenced and steps down
+    be.advance(31_000.0)
+    assert old.tick() == "leader"
+    out = sb.tick()
+    assert out == {"promoted": False, "demoted": True}
+    assert sb.role == "standby" and elector.role == "standby"
+    assert sb.promoted_ms is None
+    # fencing stops the executor gracefully — in-flight backend moves are
+    # the NEW leader's to adopt, not cancelled out from under it
+    assert cc.executor.stopped == {"force": False}
+    ha_events = [json.loads(ln) for ln in cc.journal.lines()]
+    demoted = [e for e in ha_events
+               if e["kind"] == "ha" and e["ev"] == "demoted"]
+    assert demoted and demoted[-1]["to"] == "cc-a"
+    # fenced standby resumes contending: once the new leader lapses, it
+    # can promote again through the normal path
+    be.advance(62_000.0)
+    assert sb.tick()["promoted"] is True
+    assert sb.role == "leader"
+
+
 def test_adopt_census_resumes_exactly_pending_and_in_progress():
     """Satellite (c): terminal rows are skipped, PENDING rows re-enter a
     fresh planner, IN_PROGRESS inter-broker moves resume mid-batch off the
@@ -337,6 +388,35 @@ def test_adopt_census_refuses_concurrent_execution():
     ex._state = ExecutorState.STARTING_EXECUTION
     with pytest.raises(RuntimeError):
         ex.adopt_census(rec)
+
+
+def test_adopt_census_resubmits_in_progress_logdir_move_idempotently():
+    """An IN_PROGRESS intra-broker row is only journaled AFTER the dead
+    leader's alter_replica_logdirs returned, so the move already landed
+    backend-side. Adoption re-arms it as PENDING and re-submits — the call
+    is declarative (assigns the replica to a target log dir), so the
+    re-submission re-asserts the same assignment: no error, no abort."""
+    be = SimulatedClusterBackend()
+    dirs = {"/d0": 500_000.0, "/d1": 500_000.0}
+    for b, rack in ((0, "r0"), (1, "r1")):
+        be.add_broker(b, rack, logdirs=dict(dirs))
+    be.create_partition("t", 0, [0, 1], size_mb=100.0, bytes_in_rate=10)
+    # the dead leader's submission already took effect
+    be.alter_replica_logdirs({("t", 0, 0): "/d1"})
+    records = [
+        {"i": 0, "tp": ["t", 0], "ty": "INTRA_BROKER_REPLICA_ACTION",
+         "st": "IN_PROGRESS", "ol": 0, "nl": 0,
+         "orp": [[0, 0], [1, 0]], "nrp": [[0, 1], [1, 0]]},
+    ]
+    ex = Executor(be)
+    out = ex.adopt_census(records,
+                          context={"operation": "failover census adoption"})
+    assert out == {"adopted": 1, "inFlight": 0}
+    assert be.partitions()[("t", 0)].logdir_by_broker[0] == "/d1"
+    by_state = ex.state_json().get("numTasksByState", {})
+    assert by_state.get("COMPLETED") == 1
+    for bad in ("ABORTED", "ABORTING", "DEAD"):
+        assert not by_state.get(bad)
 
 
 # --------------------------------------------------- sample-tail bit-identity
