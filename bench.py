@@ -1203,6 +1203,12 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
             pipe = PipelinedServiceLoop(cc)
             p_walls, p_compiles, p_modes = [], [], []
             p_out = None
+            # hold the certificate memo OFF for this A/B: these rounds exist
+            # to measure the overlapped FULL round (the memo would carry the
+            # result and measure nothing). Value-only toggle — no recompiles.
+            # The memo path gets its own churn-sweep cells below.
+            _reval = cc.goal_optimizer._revalidate
+            cc.goal_optimizer._revalidate = False
             for r in range(2):
                 with count_compiles() as pipe_cc:
                     p_out = pipe.pipelined_round(
@@ -1212,6 +1218,7 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
                 p_modes.append(p_out["sync_info"].get("mode"))
                 log(f"  [e2e] pipelined round {r}: {p_walls[-1]:.2f}s "
                     f"mode={p_modes[-1]} compiles={pipe_cc.count}")
+            cc.goal_optimizer._revalidate = _reval
 
             def goal_sets(res):
                 return [(g.name, bool(g.violated_after),
@@ -1247,6 +1254,102 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
                     f"({p_compiles[-1]} XLA compiles) — recorded in the rung")
     if warmup_s is not None:
         rung["warmup_s"] = round(warmup_s, 2)
+    # ---- churn sweep (PR 16: incremental re-optimization) ----
+    # Steady-round cost as a function of metadata churn. Zero churn must
+    # take the whole-round certificate memo (0 goals re-executed: every
+    # per-goal fixpoint certificate re-checked with ONE compiled violation
+    # reduction); low churn rides the dirty-seeded reduced chain; epoch-scale
+    # churn (broker-set change) falls back to a rebuild + full round. Every
+    # cell records its round mode, so a memo that failed to fire (load
+    # drift, budget, knob) is visible in the rung, not silently absorbed.
+    if steady_walls:
+        churn_est = 4 * (steady_walls[-1] * 1.15 + sample_s / rounds)
+        if churn_est > remaining_budget():
+            rung["churn_sweep_skip_reason"] = (
+                f"wall budget: churn sweep (~{churn_est:.0f}s est) > "
+                f"{remaining_budget():.0f}s remaining")
+            log(f"  [e2e] {rung['churn_sweep_skip_reason']}")
+        else:
+            opt = cc.goal_optimizer
+            sweep: dict = {}
+            modes_seen: list[str] = []
+
+            def _service_round(now_idx):
+                with count_compiles() as ccnt:
+                    t0 = time.monotonic()
+                    cc.load_monitor.sample_once(now_ms=now_idx * 300_000.0)
+                    r = cc.cached_proposals(force_refresh=True)
+                    w = time.monotonic() - t0
+                sess_i = cc.resident_session
+                inf = dict(sess_i.last_sync_info) if sess_i is not None else {}
+                modes_seen.append(r.round_mode)
+                return r, w, ccnt.count, inf
+
+            base = rounds + 4
+            # zero churn, up to 2 rounds: the pipelined A/B's shadow syncs
+            # dropped the drift baseline (conservative by design), so round
+            # 0 re-establishes it full; round 1 must take the memo
+            for i in range(2):
+                res_c, w, nc, inf = _service_round(base + i)
+                if res_c.round_mode == "revalidated":
+                    break
+            reval_goals = sum(1 for g in res_c.goal_results
+                              if g.mode == "revalidated")
+            sweep["zero"] = {
+                "round_s": round(w, 3), "compiles": nc,
+                "round_mode": res_c.round_mode,
+                "revalidated_goals": reval_goals,
+                "revalidate_s": round(res_c.revalidate_s, 4),
+                "goals_reexecuted": len(res_c.goal_results) - reval_goals,
+            }
+            if res_c.round_mode == "revalidated":
+                rung["round_s_revalidated"] = round(w, 3)
+                rung["revalidated_goals"] = reval_goals
+            log(f"  [e2e] churn=0: {w:.3f}s mode={res_c.round_mode} "
+                f"revalidated_goals={reval_goals} compiles={nc}")
+
+            # low churn: flip leadership on a handful of partitions and run
+            # the dirty-seeded reduced chain. Value-only knob — the masked
+            # programs compiled by the full rounds above are reused as-is.
+            flips = {}
+            for tp, pin in be.partitions().items():
+                if len(flips) >= 8:
+                    break
+                if len(pin.replicas) > 1 and pin.leader == pin.replicas[0]:
+                    flips[tp] = pin.replicas[1]
+            be.elect_leaders(flips)
+            _seed = opt._seed_dirty
+            opt._seed_dirty = True
+            res_c, w, nc, inf = _service_round(base + 2)
+            opt._seed_dirty = _seed
+            sweep["low"] = {
+                "round_s": round(w, 3), "compiles": nc,
+                "churn": inf.get("churn"),
+                "round_mode": res_c.round_mode,
+                "reduced_goals": sum(1 for g in res_c.goal_results
+                                     if g.mode == "reduced"),
+                "fallback_goals": res_c.fallback_goals,
+            }
+            log(f"  [e2e] churn=low({inf.get('churn')}): {w:.3f}s "
+                f"mode={res_c.round_mode} "
+                f"reduced_goals={sweep['low']['reduced_goals']} "
+                f"fallback_goals={res_c.fallback_goals} compiles={nc}")
+
+            # epoch-scale churn: a broker-set change forces the rebuild
+            # epoch — the carryover is invalidated and the round runs full
+            be.add_broker(num_brokers, f"r{num_brokers % 20}")
+            res_c, w, nc, inf = _service_round(base + 3)
+            sweep["epoch"] = {
+                "round_s": round(w, 3), "compiles": nc,
+                "sync_mode": inf.get("mode"),
+                "round_mode": res_c.round_mode,
+            }
+            log(f"  [e2e] churn=epoch: {w:.3f}s sync={inf.get('mode')} "
+                f"mode={res_c.round_mode} compiles={nc}")
+            rung["churn_sweep"] = sweep
+            rung["revalidated_rounds"] = modes_seen.count("revalidated")
+            rung["reduced_rounds"] = modes_seen.count("reduced")
+            rung["fallback_rounds"] = modes_seen.count("full")
     # ---- restart recovery (durable sample store replay) ----
     # record ONE final sampling round into a FileSampleStore (attached late
     # so the timed sampling figures above stay store-free), then boot a
@@ -1274,8 +1377,8 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
             t0 = time.monotonic()
             # two rounds: the aggregator only counts CLOSED windows, so the
             # second round is what makes the first replayable into a model
-            cc.load_monitor.sample_once(now_ms=(rounds + 4) * 300_000.0)
-            cc.load_monitor.sample_once(now_ms=(rounds + 5) * 300_000.0)
+            cc.load_monitor.sample_once(now_ms=(rounds + 8) * 300_000.0)
+            cc.load_monitor.sample_once(now_ms=(rounds + 9) * 300_000.0)
             store_round_s = (time.monotonic() - t0) / 2
             store.close()
             cc2 = CruiseControl(be, cruise_control_config({

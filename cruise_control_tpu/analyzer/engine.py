@@ -150,6 +150,19 @@ def _stall_explore(key: Array, stall: Array, salt: int = 0,
     return jnp.where(stall > 0, salted, key)
 
 
+def _mask_key(key: Array, seed_mask: Array | None) -> Array:
+    """Dirty-set candidate seeding (incremental re-optimization): replicas
+    outside ``seed_mask`` rank NEG_INF, so they never enter the budgeted
+    selection pools. Masked rows stay NEG_INF under _stall_explore's salting
+    and fall out of the compacted eligible prefix, so a tight dirty set makes
+    selection cost track the churn, not R. The exhaustive finisher scans and
+    the swap IN-side pool stay unmasked — fixpoint certificates remain
+    full-R proofs and swap counterparties can live anywhere."""
+    if seed_mask is None:
+        return key
+    return jnp.where(seed_mask, key, NEG_INF)
+
+
 def _top_candidates(key: Array, k: int, exact: bool = False):
     """Candidate selection. Soft goals use approximate top-k
     (jax.lax.approx_max_k, recall 0.95) — the TPU-native partial reduction is
@@ -843,7 +856,8 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                          prev_goals: tuple, params: EngineParams,
                          severity: Array, stall: Array,
                          cand: Array | None = None, kv: Array | None = None,
-                         env_sw: ClusterEnv | None = None):
+                         env_sw: ClusterEnv | None = None,
+                         seed_mask: Array | None = None):
     """Key once, wave-apply up to ``pass_waves`` rank-banded admission waves.
 
     A pass is three stages:
@@ -897,14 +911,15 @@ def _move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     env_k = env_sw if env_sw is not None else env
     st_k = _sweep_state(st, params) if env_sw is not None else st
     mesh = _engine_mesh(params)
-    if mesh is not None and env.num_replicas % int(mesh.devices.size) == 0:
+    if (mesh is not None and seed_mask is None
+            and env.num_replicas % int(mesh.devices.size) == 0):
         # shard-explicit: the O(R) keying runs on local replica shards and
         # per-shard exact top-k lists merge (one small all-gather per pass)
         kv_all, cand_all = _sharded_key_select(
             mesh, lambda e, s: goal.replica_key(e, s, severity),
             env_k, st_k, K * W, stall)
     else:
-        key = goal.replica_key(env_k, st_k, severity)
+        key = _mask_key(goal.replica_key(env_k, st_k, severity), seed_mask)
         kv_all, cand_all = _select_candidates(key, K * W, stall, goal.is_hard,
                                               params)
     if W == 1:
@@ -934,7 +949,8 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
                                severity: Array, stall: Array,
                                cand: Array | None = None,
                                kv: Array | None = None,
-                               env_sw: ClusterEnv | None = None):
+                               env_sw: ClusterEnv | None = None,
+                               seed_mask: Array | None = None):
     """Leadership analogue of _move_branch_batched: one [KL, F] scoring pass,
     then budgeted wave admission (each candidate is a distinct partition's
     leader, so rows never conflict on partition state; per-broker cumulative
@@ -949,12 +965,14 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
     mesh = _engine_mesh(params)
     if cand is None:
         kl = min(params.num_leader_candidates, env.num_replicas)
-        if mesh is not None and env.num_replicas % int(mesh.devices.size) == 0:
+        if (mesh is not None and seed_mask is None
+                and env.num_replicas % int(mesh.devices.size) == 0):
             lkv, lcand = _sharded_key_select(
                 mesh, lambda e, s: goal.leader_key(e, s, severity),
                 env_sc, st_sw, kl, stall)
         else:
-            lkey = goal.leader_key(env_sc, st_sw, severity)
+            lkey = _mask_key(goal.leader_key(env_sc, st_sw, severity),
+                             seed_mask)
             lkv, lcand = _select_candidates(lkey, kl, stall, goal.is_hard,
                                             params)
     else:
@@ -1041,7 +1059,8 @@ def _leadership_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKerne
 def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                          prev_goals: tuple, params: EngineParams,
                          severity: Array, stall: Array,
-                         env_sw: ClusterEnv | None = None):
+                         env_sw: ClusterEnv | None = None,
+                         seed_mask: Array | None = None):
     """Swap analogue of _move_branch_batched: one [K1, K2] scoring pass, then
     a WAVE of independent swaps applies in one batched update. Admission, in
     score order, pairs each out-candidate with its best counterparty and
@@ -1065,7 +1084,8 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     env_sc = env_sw if env_sw is not None else env
     st_sw = _sweep_state(st, params) if env_sw is not None else st
     mesh = _engine_mesh(params)
-    if mesh is not None and env.num_replicas % int(mesh.devices.size) == 0:
+    if (mesh is not None and seed_mask is None
+            and env.num_replicas % int(mesh.devices.size) == 0):
         okv, cand_out = _sharded_key_select(
             mesh, lambda e, s: goal.swap_out_key(e, s, severity),
             env_sc, st_sw, k, stall)
@@ -1073,7 +1093,10 @@ def _swap_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
             mesh, lambda e, s: goal.swap_in_key(e, s, severity),
             env_sc, st_sw, k, stall, salt=101)   # decorrelate from okey
     else:
-        okey = goal.swap_out_key(env_sc, st_sw, severity)
+        # seeding masks only the OUT side: the counterparty of a dirty
+        # replica's swap can legitimately live anywhere in the cluster
+        okey = _mask_key(goal.swap_out_key(env_sc, st_sw, severity),
+                         seed_mask)
         ikey = goal.swap_in_key(env_sc, st_sw, severity)
         okv, cand_out = _select_candidates(okey, k, stall, goal.is_hard,
                                            params)
@@ -1153,7 +1176,8 @@ def _rescore_disk_move_row(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 def _disk_move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                               prev_goals: tuple, params: EngineParams,
                               severity: Array, stall: Array,
-                              env_sw: ClusterEnv | None = None):
+                              env_sw: ClusterEnv | None = None,
+                              seed_mask: Array | None = None):
     """Intra-broker analogue of _move_branch_batched: destinations are the D
     logdirs of each candidate's own broker (IntraBrokerDiskUsageDistribution
     Goal.java:518 hot loop role). [K, D] scoring, per-move [1, D] re-score.
@@ -1163,12 +1187,15 @@ def _disk_move_branch_batched(env: ClusterEnv, st: EngineState, goal: GoalKernel
     st_sw = _sweep_state(st, params) if env_sw is not None else st
     mesh = _engine_mesh(params)
     kd = min(params.num_candidates, env.num_replicas)
-    if mesh is not None and env.num_replicas % int(mesh.devices.size) == 0:
+    if (mesh is not None and seed_mask is None
+            and env.num_replicas % int(mesh.devices.size) == 0):
         kv, cand = _sharded_key_select(
             mesh, lambda e, s: goal.replica_key(e, s, severity),
             env_sc, st_sw, kd, stall)
     else:
-        key = _stall_explore(goal.replica_key(env_sc, st_sw, severity), stall)
+        key = _stall_explore(
+            _mask_key(goal.replica_key(env_sc, st_sw, severity), seed_mask),
+            stall)
         kv, cand = _top_candidates(key, kd, exact=goal.is_hard)
 
     def _disk_rows(cand_l: Array, kv_l: Array):
@@ -1832,21 +1859,31 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
 
 def optimize_goal(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                   prev_goals: tuple = (), params: EngineParams = EngineParams(),
-                  donate_state: bool = False):
+                  donate_state: bool = False,
+                  seed_mask: Array | None = None):
     """Run one goal to completion. Returns (state, info dict).
 
     ``donate_state=True`` donates the input state's buffers to the program —
     the caller must not touch ``st`` afterwards. The optimizer chain passes
     it because each goal consumes the previous goal's output; without
     donation XLA preserves the inputs, which costs a full state copy
-    (~hundreds of MB) per goal at 1M-replica scale."""
-    fn = _compiled_optimize(type(goal), goal, tuple(prev_goals), donate_state)
-    return fn(env, st, params)
+    (~hundreds of MB) per goal at 1M-replica scale.
+
+    ``seed_mask`` (bool[R] or None) keys the budgeted selection pools from a
+    dirty subset (_mask_key). It is a TRACED argument of a separate compiled
+    variant: an all-ones mask is bit-identical to the unmasked program, so
+    the incremental optimizer always passes a mask array and full<->reduced
+    rounds are a value toggle, never a recompile."""
+    fn = _compiled_optimize(type(goal), goal, tuple(prev_goals), donate_state,
+                            seed_mask is not None)
+    if seed_mask is None:
+        return fn(env, st, params)
+    return fn(env, st, params, seed_mask)
 
 
 @lru_cache(maxsize=256)
 def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
-                       donate_state: bool = False):
+                       donate_state: bool = False, masked: bool = False):
     """Build + cache the jitted loop for a (goal, prev_goals) combo.
 
     Goals are frozen dataclasses, hashable by value, so the cache key is the
@@ -1854,20 +1891,28 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
     setup, paid once per goal config per process. EngineParams rides in as a
     pytree ARGUMENT: its budget leaves are traced (budget changes reuse the
     executable), its shape fields are static treedef data (jit retraces on
-    change).
+    change). ``masked=True`` compiles the seed-masked variant, whose bool[R]
+    mask is a traced argument (see optimize_goal).
     """
     del goal_cls  # participates in the cache key only
 
-    @partial(jax.jit, donate_argnums=(1,) if donate_state else ())
-    def run(env: ClusterEnv, st: EngineState, params: EngineParams):
-        return _goal_loop(env, st, goal, prev_goals, params)
+    if masked:
+        @partial(jax.jit, donate_argnums=(1,) if donate_state else ())
+        def run(env: ClusterEnv, st: EngineState, params: EngineParams,
+                seed_mask: Array):
+            return _goal_loop(env, st, goal, prev_goals, params,
+                              seed_mask=seed_mask)
+    else:
+        @partial(jax.jit, donate_argnums=(1,) if donate_state else ())
+        def run(env: ClusterEnv, st: EngineState, params: EngineParams):
+            return _goal_loop(env, st, goal, prev_goals, params)
 
     return run
 
 
 def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                prev_goals: tuple, params: EngineParams,
-               finisher: bool = True):
+               finisher: bool = True, seed_mask: Array | None = None):
     """One goal's full optimization loop (traced; shared by the per-goal
     program and the fused prefix-chain program). ``finisher=False`` compiles
     the loop WITHOUT the exhaustive finisher phase — the fused prefix
@@ -1908,7 +1953,8 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
             st, n_disk = _disk_move_branch_batched(env, st, goal,
                                                    prev_goals, params,
                                                    severity, explore,
-                                                   env_sw=env_sw)
+                                                   env_sw=env_sw,
+                                                   seed_mask=seed_mask)
 
         lead_first = goal.uses_leadership_moves and goal.leadership_primary
 
@@ -1920,7 +1966,7 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
         if lead_first:
             st, n_leads = _leadership_branch_batched(
                 env, st, goal, prev_goals, params, severity, explore,
-                env_sw=env_sw)
+                env_sw=env_sw, seed_mask=seed_mask)
 
         # 1b. replica moves (cheapest per unit of work on TPU: one scoring
         #     pass lands up to K moves); for leadership-primary goals they
@@ -1939,14 +1985,14 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                     s, _n, _w = carry
                     return _move_branch_batched(
                         env, s, goal, prev_goals, params, severity, explore,
-                        env_sw=env_sw)
+                        env_sw=env_sw, seed_mask=seed_mask)
                 st, n_moves, n_waves = jax.lax.fori_loop(
                     0, jnp.where(n_leads == 0, 1, 0), move_body,
                     (st, jnp.int32(0), jnp.int32(0)))
             else:
                 st, n_moves, n_waves = _move_branch_batched(
                     env, st, goal, prev_goals, params, severity, explore,
-                    env_sw=env_sw)
+                    env_sw=env_sw, seed_mask=seed_mask)
 
         # 2. leadership transfers — only when no move landed; same
         #    zero/one trip-count gating (and the same severity-reuse
@@ -1956,7 +2002,7 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                 s, _n = carry
                 return _leadership_branch_batched(
                     env, s, goal, prev_goals, params, severity, explore,
-                    env_sw=env_sw)
+                    env_sw=env_sw, seed_mask=seed_mask)
             st, n_leads = jax.lax.fori_loop(
                 0, jnp.where(n_moves == 0, 1, 0), lead_body,
                 (st, jnp.int32(0)))
@@ -1969,7 +2015,8 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                 s, _n = carry
                 return _swap_branch_batched(env, s, goal, prev_goals,
                                             params, severity, explore,
-                                            env_sw=env_sw)
+                                            env_sw=env_sw,
+                                            seed_mask=seed_mask)
             st, n_swaps = jax.lax.fori_loop(
                 0, jnp.where((n_moves + n_leads) == 0, 1, 0), swap_body,
                 (st, jnp.int32(0)))

@@ -120,6 +120,13 @@ class GoalResult:
     # goal's finisher was re-entered with widened windows after exiting
     # violated-unproven with a small remaining-action count
     escalations: int = 0
+    # incremental round mode (PR 16): how this goal's verdict was produced —
+    # "full" (the complete budgeted program over all R replicas), "reduced"
+    # (dirty-set-seeded candidate keying; any certificate is still a genuine
+    # full-R proof — the finisher's exhaustive scans are never masked), or
+    # "revalidated" (carried from the previous round after the whole-round
+    # certificate re-check matched; the goal program never ran)
+    mode: str = "full"
 
 
 @dataclasses.dataclass
@@ -136,6 +143,13 @@ class OptimizerResult:
     num_leadership_movements: int = 0
     data_to_move_mb: float = 0.0
     durations_measured: bool = False   # duration_s is honest only when True
+    # incremental re-optimization (PR 16): how this round was produced —
+    # "full" | "reduced" (dirty-set-seeded) | "revalidated" (whole-round
+    # certificate memo); revalidate_s is the memo re-check's wall seconds,
+    # fallback_goals counts reduced goals that re-ran at full R
+    round_mode: str = "full"
+    revalidate_s: float = 0.0
+    fallback_goals: int = 0
 
     @property
     def violated_goals_before(self) -> list[str]:
@@ -173,6 +187,29 @@ class OptimizerResult:
                         "leaderships": g.leads_remaining,
                         "swapWindow": g.swap_window_remaining}
         return out
+
+
+@dataclasses.dataclass
+class IncrementalCarryover:
+    """One completed full/reduced round's verdicts + result, persisted on the
+    ``ResidentClusterSession`` (PR 16): the certificate re-validation memo
+    returns ``result`` re-stamped when nothing relevant changed since, and
+    dirty-set seeding keys the next reduced round off ``violated_after``.
+    Host-side data except ``result.final_state`` — one pinned state copy is
+    the price of the memo (``analyzer.incremental.revalidate=false`` plus a
+    dropped carryover releases it). Cleared by the session on every epoch
+    rebuild / invalidate, so broker-set changes and epoch fallback can never
+    serve a stale memo."""
+    chain_key: tuple       # (goal-name tuple, options repr): chain identity
+    violated_before: tuple  # bool per chain goal at round START — the memo
+    #                         re-check's comparison target (equal verdicts on
+    #                         a zero-churn, drift-bounded state prove the
+    #                         deterministic chain would replay identically)
+    violated_after: dict   # name -> bool at round END (seeding: goals still
+    #                        violated keep all-ones masks — their work is
+    #                        global, not churn-local)
+    proven: dict           # name -> fixpoint_proven at round END
+    result: object         # the carried OptimizerResult
 
 
 def _balancedness(goals, results_violated: dict,
@@ -347,6 +384,25 @@ class GoalOptimizer:
         self._escalation_factor = (
             config.get_int("analyzer.finisher.escalation.factor")
             if config is not None else 4)
+        # analyzer.incremental.*: churn-proportional steady rounds (PR 16).
+        # ``enabled`` arms the session's delta/carryover tracking and threads
+        # a bool[R] seed mask (all-ones on full rounds) through every chain
+        # program, so reduced<->full flips are VALUE-only — zero new XLA
+        # compiles; ``revalidate`` is the whole-round certificate memo;
+        # ``seed.dirty`` opts into dirty-set candidate seeding (one-sided
+        # parity, the escalation precedent)
+        self._incremental = (config.get_boolean("analyzer.incremental.enabled")
+                             if config is not None else True)
+        self._revalidate = (
+            config.get_boolean("analyzer.incremental.revalidate")
+            if config is not None else True)
+        self._reval_tol = (
+            config.get_double("analyzer.incremental.revalidate.tolerance")
+            if config is not None else 0.0)
+        self._seed_dirty = (
+            config.get_boolean("analyzer.incremental.seed.dirty")
+            if config is not None else False)
+        self._ones_masks: dict = {}   # num_replicas -> resident all-ones mask
         self._balancedness_priority_weight = (
             config.get_double("goal.balancedness.priority.weight")
             if config is not None else BALANCEDNESS_PRIORITY_WEIGHT)
@@ -568,6 +624,29 @@ class GoalOptimizer:
         session_info = dict(session.last_sync_info) if session is not None else None
         donated = session is not None and bool(getattr(session, "_donation",
                                                        False))
+        # -- incremental round bookkeeping (PR 16): consume the session's
+        # round-delta accumulator BEFORE optimizer_inputs below (which may
+        # donate the resident state), then try the whole-round certificate
+        # memo — eligibility is purely structural (zero churn, no broker
+        # flips, load rows within tolerance of the carried baseline, at
+        # least one REAL sync since the carried round so a forced re-run of
+        # an unchanged model still exercises the full program), and the
+        # memo itself re-checks every verdict before trusting the carryover
+        incremental = self._incremental and session is not None
+        chain_key = (tuple(names), repr(options))
+        rd = session.consume_round_delta() if incremental else None
+        if (incremental and self._revalidate and not measure_goal_durations
+                and session.carryover is not None
+                and session.carryover.chain_key == chain_key
+                and rd["syncs"] >= 1 and rd["churn"] == 0
+                and not rd["broker_flips"] and not rd["rebuilt"]
+                and rd["load_drift"] <= self._reval_tol):
+            memo = self._revalidated_round(
+                session, goals, session_info, opt_gen, compiles0, t_round,
+                round_span, raise_on_failure)
+            if memo is not None:
+                return memo
+        taken_gen = None
         if session is not None:
             # resident fast path: the session owns the padded device env +
             # observed engine state; the snapshot->pad->upload rebuild is
@@ -578,6 +657,11 @@ class GoalOptimizer:
             # sync; with donation off it is a defensive device copy.
             (env, st, meta, part_table, initial_broker, initial_leader,
              initial_disk, host_valid, host_part) = session.optimizer_inputs()
+            # the sync generation the round's inputs reflect: a shadow sync
+            # landing mid-round advances it, and note_carryover then drops
+            # the drift baseline (the refreshed rows are not the rows this
+            # round optimized)
+            taken_gen = session.sync_generation
             num_replicas = env.num_replicas
             num_brokers = env.num_brokers
         else:
@@ -592,6 +676,50 @@ class GoalOptimizer:
             # already mesh-placed (replicated) — thread the session's mesh
             # into the engine so the shard-explicit kernels run on it
             params = dataclasses.replace(params, mesh=session.mesh)
+
+        # -- candidate seed masks (PR 16): with incremental tracking armed
+        # and no shard mesh, EVERY chain invocation takes a bool[R] seed
+        # mask per goal — all-ones on full rounds, the dirty-replica set on
+        # reduced rounds — so reduced<->full flips are VALUE-only (zero new
+        # XLA compiles on the toggle). seed_mask=None (incremental off, or
+        # sharded engine) compiles the legacy unmasked variants instead.
+        use_masks = incremental and params.mesh is None
+        seed_masks = None
+        mask_modes = None
+        reduced_names: set = set()
+        if use_masks:
+            ones = self._ones_mask(num_replicas)
+            seed_masks = [ones] * len(goals)
+            mask_modes = ["full"] * len(goals)
+            co = session.carryover
+            budget = (getattr(session, "_max_delta_fraction", 0.25)
+                      * max(num_replicas, 1))
+            if (self._seed_dirty and rd is not None and co is not None
+                    and co.chain_key == chain_key
+                    and rd["syncs"] >= 1 and not rd["rebuilt"]
+                    and not rd["broker_flips"]
+                    and 0 < rd["churn"] <= budget):
+                np_dirty = session.dirty_replica_mask(rd["dirty_brokers"],
+                                                      rd["dirty_topics"])
+                if np_dirty.any():
+                    dirty = jnp.asarray(np_dirty)
+                    # a goal is dirty-seedable only when BOTH hold: the
+                    # carried round ended it satisfied AND it still reads
+                    # satisfied on the churned round-START state (one warm
+                    # [B]-level reduction). Churn that already flipped a
+                    # goal's verdict — leadership flips moving leader
+                    # net/cpu load, say — means its repair is global, and
+                    # confining it to the dirty set only manufactures
+                    # fallback work (measured: the distribution goals end
+                    # violated where the full chain converges)
+                    viol_now = jax.device_get(
+                        _compiled_violations(tuple(goals))(env, st))
+                    for i, g in enumerate(goals):
+                        if (not co.violated_after.get(g.name, True)
+                                and not bool(viol_now[i])):
+                            seed_masks[i] = dirty
+                            mask_modes[i] = "reduced"
+                            reduced_names.add(g.name)
 
         if session is None:
             tml = self._min_leader_mask(meta, min_leader_topic_pattern)
@@ -671,17 +799,25 @@ class GoalOptimizer:
                     _tick.t0 = now
             _tick.t0 = time.monotonic()
 
-            st, out_dev = _compiled_prefix_chain(
-                gclasses, tuple(goals), split)(env, st, params)
+            if seed_masks is not None:
+                st, out_dev = _compiled_prefix_chain(
+                    gclasses, tuple(goals), split, masked=True)(
+                        env, st, params, tuple(seed_masks[:split]))
+            else:
+                st, out_dev = _compiled_prefix_chain(
+                    gclasses, tuple(goals), split)(env, st, params)
             _tick(f"prefix({split})")
             tail_infos_dev = []
             prev = tuple(goals[:split])
-            for g in goals[split:]:
+            for gi, g in enumerate(goals[split:], start=split):
                 # finisher inline at the goal's chain position (running it
                 # deferred measured 6x-inflated remaining-action counts);
                 # non-donating: programs pipeline async
                 st, info = optimize_goal(env, st, g, prev, params,
-                                         donate_state=self._donate_state)
+                                         donate_state=self._donate_state,
+                                         seed_mask=(seed_masks[gi]
+                                                    if seed_masks is not None
+                                                    else None))
                 tail_infos_dev.append(info)
                 prev = prev + (g,)
                 _tick(g.name)
@@ -719,7 +855,7 @@ class GoalOptimizer:
             infos = []
             durations = []
             prev: list = []
-            for g in goals:
+            for gi, g in enumerate(goals):
                 t0 = time.monotonic()
                 # NOTE: donate_state measured SLOWER here — buffer ownership
                 # transfer serializes the async dispatch pipeline on the
@@ -727,7 +863,10 @@ class GoalOptimizer:
                 # programs in flight. tpu.donate.state opts in for
                 # HBM-constrained deployments.
                 st, info = optimize_goal(env, st, g, tuple(prev), params,
-                                         donate_state=self._donate_state)
+                                         donate_state=self._donate_state,
+                                         seed_mask=(seed_masks[gi]
+                                                    if seed_masks is not None
+                                                    else None))
                 if measure_goal_durations:
                     jax.block_until_ready(st.util)   # block per goal: honest
                 durations.append(time.monotonic() - t0)
@@ -773,6 +912,9 @@ class GoalOptimizer:
             )
             for g, info, dur in zip(goals, infos, durations)
         ]
+        if mask_modes is not None:
+            for r, m in zip(goal_results, mask_modes):
+                r.mode = m
         if run_preferred:
             was, still = jax.device_get((was, still))
             goal_results.append(GoalResult(
@@ -785,12 +927,29 @@ class GoalOptimizer:
         else:
             stats_after = cluster_stats_state(env, st)
             pb, plead, pdisk, data_mb = jax.device_get(_pack_final(env, st))
+        # reduced-round full-R fallback (PR 16): a chain-ordered repair
+        # sweep re-runs, with the all-ones mask and the goal's chain-prefix
+        # veto, every goal the dirty-seeded chain left violated without a
+        # live certificate — before escalation ever looks at it, so seeding
+        # can only ever trade wall clock, never verdicts
+        st_fb, fallbacks = (
+            self._reseed_fallback(env, st, goals, goal_results, params,
+                                  reduced_names,
+                                  self._ones_mask(num_replicas),
+                                  carried_violated=co.violated_after)
+            if reduced_names else (None, 0))
+        if st_fb is not None:
+            st = st_fb
+            stats_after = cluster_stats_state(env, st)
+            pb, plead, pdisk, data_mb = jax.device_get(_pack_final(env, st))
         # certificate-driven budget escalation: goals that exited violated-
         # unproven with a small remaining-action count re-enter their
         # finisher with widened windows (and EVERY other goal's acceptance
         # veto in force, so no other goal can regress); the packed final
         # assignment and stats are recomputed only when something escalated
-        st_esc = self._escalate_unproven(env, st, goals, goal_results, params)
+        st_esc = self._escalate_unproven(
+            env, st, goals, goal_results, params,
+            seed_mask=(self._ones_mask(num_replicas) if use_masks else None))
         if st_esc is not None:
             st = st_esc
             stats_after = cluster_stats_state(env, st)
@@ -820,6 +979,8 @@ class GoalOptimizer:
             num_replica_movements=n_moves, num_leadership_movements=n_lead,
             data_to_move_mb=data_mb,
             durations_measured=measure_goal_durations,
+            round_mode="reduced" if reduced_names else "full",
+            fallback_goals=fallbacks,
         )
         result.final_state = st          # for executor / tests
         result.env = env
@@ -844,12 +1005,34 @@ class GoalOptimizer:
                                 or (use_fused
                                     and self._profile_level == "stage")),
             trace_id=(round_span.trace_id if round_span is not None else None),
-            opt_generation=opt_gen)
+            opt_generation=opt_gen,
+            round_mode=result.round_mode)
         if round_span is not None:
             round_span.end(
                 proposals=len(proposals), moves=n_moves, leads=n_lead,
                 round=(result.round_trace.round_id
                        if result.round_trace is not None else None))
+
+        # persist the round's carryover BEFORE the hard-goal raise: the
+        # consumed round-delta is gone either way, so a raising round that
+        # failed to save would leave the next memo comparing against a
+        # round it never saw (stale-memo hazard)
+        if incremental:
+            if self._revalidate:
+                # prime the memo's one-program verdict re-check NOW (a full
+                # round that already paid its compiles) so the next round's
+                # fast path compiles nothing; async dispatch, never blocked
+                _compiled_violations(tuple(goals))(env, st)
+            session.note_carryover(
+                IncrementalCarryover(
+                    chain_key=chain_key,
+                    violated_before=tuple(bool(violated_before[g.name])
+                                          for g in goals),
+                    violated_after={r.name: r.violated_after
+                                    for r in goal_results},
+                    proven={r.name: r.fixpoint_proven for r in goal_results},
+                    result=result),
+                taken_generation=taken_gen)
 
         if raise_on_failure:
             failed = [r.name + (" (iteration budget exhausted)" if r.hit_max_iters else "")
@@ -871,8 +1054,169 @@ class GoalOptimizer:
                     recommendation=rec, result=result)
         return result
 
+    # ------------------------------------------- incremental round modes
+    def _ones_mask(self, num_replicas: int):
+        """Resident all-ones seed mask for this replica-axis width: ONE
+        1-byte-per-replica upload per process per shape bucket, not one per
+        chain argument per round (12 goals x 1M replicas would re-ship 12 MB
+        a round over a tunneled link)."""
+        m = self._ones_masks.get(num_replicas)
+        if m is None:
+            m = jnp.ones((num_replicas,), bool)
+            self._ones_masks[num_replicas] = m
+        return m
+
+    def _revalidated_round(self, session, goals, session_info, opt_gen,
+                           compiles0, t_round, round_span, raise_on_failure):
+        """Certificate re-validation fast path (PR 16 tentpole a): the
+        carried round is structurally valid — zero churn, no broker-axis
+        flips, no rebuild, load rows within tolerance of the carried
+        baseline — so ONE compiled [B]-level violation reduction re-checks
+        every goal's verdict against the resident state (peeked, never
+        donated). All verdicts matching the carried round's START verdicts
+        proves the chain would replay bit-identically: the engine is
+        deterministic in (env, state, params), and with the default
+        tolerance 0.0 the inputs are bit-stable. The carried result returns
+        re-stamped in milliseconds. Any mismatch returns None and the
+        caller falls through to the full program — correctness never
+        depends on the memo applying."""
+        co = session.carryover
+        t0 = time.monotonic()
+        env, st = session.revalidation_view()
+        viol = jax.device_get(_compiled_violations(tuple(goals))(env, st))
+        if tuple(bool(v) for v in viol) != co.violated_before:
+            return None
+        reval_s = time.monotonic() - t0
+        grs = [dataclasses.replace(r, duration_s=0.0, mode="revalidated")
+               for r in co.result.goal_results]
+        result = dataclasses.replace(
+            co.result, goal_results=grs, round_mode="revalidated",
+            revalidate_s=reval_s, durations_measured=False, fallback_goals=0)
+        result.final_state = getattr(co.result, "final_state", None)
+        result.env = getattr(co.result, "env", None)
+        result.meta = getattr(co.result, "meta", None)
+        session.note_revalidated()
+        result.round_trace = self.recorder.record_round(
+            wall_s=time.monotonic() - t_round,
+            goal_results=grs,
+            compiles=self._compile_listener.count - compiles0,
+            env=env, state=st,
+            num_proposals=len(result.proposals),
+            num_replica_movements=result.num_replica_movements,
+            num_leadership_movements=result.num_leadership_movements,
+            session_info=session_info, donated=False,
+            profile_level=self._profile_level,
+            durations_measured=False,
+            trace_id=(round_span.trace_id if round_span is not None
+                      else None),
+            opt_generation=opt_gen,
+            round_mode="revalidated", revalidate_s=reval_s)
+        if round_span is not None:
+            round_span.end(
+                proposals=len(result.proposals),
+                moves=result.num_replica_movements,
+                leads=result.num_leadership_movements,
+                round=(result.round_trace.round_id
+                       if result.round_trace is not None else None))
+        if raise_on_failure:
+            failed = [r.name for r, g in zip(grs, goals)
+                      if g.is_hard and r.violated_after]
+            if failed:
+                raise OptimizationFailureError(
+                    f"hard goal(s) not satisfiable: {failed} "
+                    f"[revalidated round]", result=result)
+        return result
+
+    def _reseed_fallback(self, env, st, goals, goal_results, params,
+                         reduced_names, ones_mask, carried_violated=None):
+        """Full-R traced fallback for the dirty-seeded chain (PR 16
+        tentpole b): a chain-ordered repair sweep that re-runs, with the
+        all-ones mask, every goal whose verdict the reduced round left
+        WORSE than it should be — violated without a fixpoint certificate
+        (any mode: an all-ones goal downstream of a dirty-seeded one saw a
+        different intermediate state than the full chain would have), or
+        violated with a certificate when the carried round ended it
+        satisfied (the mask confined it to a local fixpoint). Each re-run
+        uses the goal's CHAIN-PREFIX acceptance veto — the same veto (and
+        for post-split goals the same compiled executable) the full chain
+        gives that goal — not the stricter all-others veto: from a
+        half-repaired state the all-others veto blocks exactly the global
+        moves the repair needs (measured: RackAwareGoal unfixable under it
+        where the full chain converges). Sweeping in chain order lets later
+        re-runs see the repaired prefix. This keeps the seeding contract
+        one-sided in practice (violations only shrink, certificates only
+        appear vs the full path — churn_ab.py and slo_diff.py gate it);
+        escalation then handles whatever remains violated-unproven.
+        Returns (new state, fallback count), or (None, 0) when no verdict
+        needs repair."""
+        carried_violated = carried_violated or {}
+        order = {g.name: i for i, g in enumerate(goals)}
+        # persistent proven violations (violated at the carried round's end
+        # too) are true fixpoints the full chain also leaves standing — the
+        # sweep never touches them
+        exempt = {r.name for r in goal_results
+                  if r.violated_after and r.fixpoint_proven
+                  and carried_violated.get(r.name) is not False}
+        todo = [r for r in goal_results
+                if r.name in order and r.violated_after
+                and r.name not in exempt]
+        if not todo:
+            return None, 0
+        from cruise_control_tpu.common.sensors import OPERATION_LOGGER
+        swept: set = set()
+        # bounded worklist: a prefix-veto re-run of goal i may break a
+        # later satisfied goal j — in the full chain j runs after i and
+        # repairs itself, so the sweep gives it the same second chance
+        for _sweep in range(2):
+            todo.sort(key=lambda r: order[r.name])
+            for r in todo:
+                gi = order[r.name]
+                g = goals[gi]
+                st, info = optimize_goal(env, st, g, tuple(goals[:gi]),
+                                         params, seed_mask=ones_mask)
+                info = jax.device_get(info)
+                r.violated_after = bool(info["violated_after"])
+                r.fixpoint_proven = bool(info["fixpoint_proven"])
+                r.hit_max_iters = r.violated_after and not r.fixpoint_proven
+                r.iterations += int(info["iterations"])
+                r.passes += int(info.get("passes", 0))
+                r.moves_remaining = int(info["moves_remaining"])
+                r.leads_remaining = int(info["leads_remaining"])
+                r.swap_window_remaining = int(info["swap_window_remaining"])
+                r.finisher_rounds += int(info.get("finisher_rounds", 0))
+                r.finisher_actions += int(info.get("finisher_actions", 0))
+                r.stat_after = float(info["stat"])
+                r.mode = "full"    # honest: the goal DID run at full R
+                swept.add(r.name)
+                OPERATION_LOGGER.info(
+                    "reduced-round fallback: %s re-ran at full R "
+                    "(violated=%s proven=%s)", r.name, r.violated_after,
+                    r.fixpoint_proven)
+            # honest re-verdict of EVERY goal against the swept state:
+            # earlier re-runs may have repaired — or broken — goals the
+            # sweep didn't touch
+            viol = jax.device_get(
+                _compiled_violations(tuple(goals))(env, st))
+            fresh = {g.name: bool(v) for g, v in zip(goals, viol)}
+            for r in goal_results:
+                if r.name not in fresh or r.violated_after == fresh[r.name]:
+                    continue
+                r.violated_after = fresh[r.name]
+                if r.violated_after:
+                    # a certificate proven against a pre-sweep state is
+                    # stale once the goal reads violated again
+                    r.fixpoint_proven = False
+                r.hit_max_iters = r.violated_after and not r.fixpoint_proven
+            todo = [r for r in goal_results
+                    if r.name in order and r.violated_after
+                    and r.name not in swept and r.name not in exempt]
+            if not todo:
+                break
+        return st, len(swept)
+
     # ------------------------------------------------- budget escalation
-    def _escalate_unproven(self, env, st, goals, goal_results, params):
+    def _escalate_unproven(self, env, st, goals, goal_results, params,
+                           seed_mask=None):
         """Certificate-driven budget escalation (the BENCH_r05 Leader*/
         LeaderBytesIn tail closer): a goal whose budgeted loop AND finisher
         exited still-violated WITHOUT a fixpoint certificate, but with a
@@ -913,7 +1257,8 @@ class GoalOptimizer:
         from cruise_control_tpu.common.sensors import OPERATION_LOGGER
         for r, g in candidates:
             prev = tuple(x for x in goals if x.name != r.name)
-            st, info = optimize_goal(env, st, g, prev, esc_params)
+            st, info = optimize_goal(env, st, g, prev, esc_params,
+                                     seed_mask=seed_mask)
             info = jax.device_get(info)
             r.escalations += 1
             r.violated_after = bool(info["violated_after"])
@@ -977,7 +1322,21 @@ class GoalOptimizer:
                                            options=options)
                if run_preferred else None)
 
+        # -- incremental fleet bookkeeping (PR 16): consume every tenant's
+        # round-delta BEFORE the donating input take, then try the
+        # whole-fleet certificate memo (all-or-nothing: subsetting the
+        # stack would compile a new K variant per subset)
+        chain_key = (tuple(names), repr(options))
+        rds = ([s.consume_round_delta() for s in sessions]
+               if self._incremental else [None] * len(sessions))
+        if self._incremental and self._revalidate:
+            memo = self._revalidated_fleet(sessions, goals, rds, chain_key,
+                                           opt_gen, compiles0, t_round)
+            if memo is not None:
+                return memo
+
         inputs = [s.optimizer_inputs() for s in sessions]
+        gens = [s.sync_generation for s in sessions]
         envs = [i[0] for i in inputs]
         sts = [i[1] for i in inputs]
         shape0 = jax.tree_util.tree_map(lambda a: (a.shape, a.dtype), envs[0])
@@ -993,15 +1352,58 @@ class GoalOptimizer:
         num_brokers = envs[0].num_brokers
         params = self.scaled_params(num_replicas, num_brokers)
 
+        # per-tenant seed masks (PR 16): with incremental armed the masked
+        # fleet chain always runs — all-ones rows for full tenants, dirty
+        # rows for churn-budgeted tenants with carryover — stacked [K, R]
+        # per goal so reduced<->full stays value-only across the fleet
+        reduced_by_tenant: list[set] = [set() for _ in sessions]
+        masks_b = None
+        if self._incremental:
+            ones_np = np.ones((num_replicas,), bool)
+            per_tenant: list[list] = []
+            for k, (s, rd) in enumerate(zip(sessions, rds)):
+                co = s.carryover
+                masks_k = [ones_np] * len(goals)
+                budget = (getattr(s, "_max_delta_fraction", 0.25)
+                          * max(num_replicas, 1))
+                if (self._seed_dirty and rd is not None and co is not None
+                        and co.chain_key == chain_key
+                        and rd["syncs"] >= 1 and not rd["rebuilt"]
+                        and not rd["broker_flips"]
+                        and 0 < rd["churn"] <= budget):
+                    np_dirty = s.dirty_replica_mask(rd["dirty_brokers"],
+                                                    rd["dirty_topics"])
+                    if np_dirty.any():
+                        # same two-sided eligibility as the solo path: the
+                        # carried round ended the goal satisfied AND the
+                        # churned round-START state still reads satisfied
+                        viol_now = jax.device_get(_compiled_violations(
+                            tuple(goals))(envs[k], sts[k]))
+                        for i, g in enumerate(goals):
+                            if (not co.violated_after.get(g.name, True)
+                                    and not bool(viol_now[i])):
+                                masks_k[i] = np_dirty
+                                reduced_by_tenant[k].add(g.name)
+                per_tenant.append(masks_k)
+            masks_b = tuple(
+                jnp.asarray(np.stack([per_tenant[k][i]
+                                      for k in range(len(sessions))]))
+                for i in range(len(goals)))
+
         # stack along the leading tenant axis — ONE compiled program per
         # (treedef, K) instead of ~2 eager dispatches per leaf, so the
         # stacking overhead never eats the launch amortization the batch
         # exists for; steady fleet rounds add zero compiles
         env_b = _compiled_stack(len(envs))(*envs)
         st_b = _compiled_stack(len(sts))(*sts)
-        fn = _compiled_fleet_chain(tuple(type(g) for g in goals),
-                                   tuple(goals), ple)
-        st_b, out = fn(env_b, st_b, params)
+        if masks_b is not None:
+            fn = _compiled_fleet_chain(tuple(type(g) for g in goals),
+                                       tuple(goals), ple, masked=True)
+            st_b, out = fn(env_b, st_b, params, masks_b)
+        else:
+            fn = _compiled_fleet_chain(tuple(type(g) for g in goals),
+                                       tuple(goals), ple)
+            st_b, out = fn(env_b, st_b, params)
         out = jax.device_get(out)
 
         results = []
@@ -1042,6 +1444,9 @@ class GoalOptimizer:
                 )
                 for g, info in zip(goals, infos)
             ]
+            for r in goal_results:
+                if r.name in reduced_by_tenant[i]:
+                    r.mode = "reduced"
             if run_preferred:
                 goal_results.append(GoalResult(
                     name="PreferredLeaderElectionGoal",
@@ -1056,11 +1461,28 @@ class GoalOptimizer:
                 jax.tree_util.tree_map(lambda leaf: leaf[i],
                                        out["stats_after"]))
             pb, plead, pdisk, data_mb = (leaf[i] for leaf in out["packed"])
-            # the same post-chain escalation the solo path runs — per-tenant
-            # programs, only for tails the batched finisher left unproven,
-            # so batched-vs-solo parity survives escalation too
-            st_esc = self._escalate_unproven(env, st_i, goals, goal_results,
-                                             params)
+            # per-tenant full-R fallback for dirty-seeded goals that ended
+            # violated-unproven (the solo path's one-sided contract, per
+            # tenant), then the same post-chain escalation the solo path
+            # runs — per-tenant programs, only for tails the batched
+            # finisher left unproven, so batched-vs-solo parity survives
+            st_fb, n_fb = (
+                self._reseed_fallback(env, st_i, goals, goal_results, params,
+                                      reduced_by_tenant[i],
+                                      self._ones_mask(num_replicas),
+                                      carried_violated=(
+                                          session.carryover.violated_after
+                                          if session.carryover else None))
+                if reduced_by_tenant[i] else (None, 0))
+            if st_fb is not None:
+                st_i = st_fb
+                stats_after = cluster_stats_state(env, st_i)
+                pb, plead, pdisk, data_mb = jax.device_get(
+                    _pack_final(env, st_i))
+            st_esc = self._escalate_unproven(
+                env, st_i, goals, goal_results, params,
+                seed_mask=(self._ones_mask(num_replicas)
+                           if self._incremental else None))
             if st_esc is not None:
                 st_i = st_esc
                 stats_after = cluster_stats_state(env, st_i)
@@ -1088,12 +1510,28 @@ class GoalOptimizer:
                 num_replica_movements=proposals.num_replica_additions,
                 num_leadership_movements=proposals.num_leadership_changes,
                 data_to_move_mb=float(data_mb),
+                round_mode=("reduced" if reduced_by_tenant[i] else "full"),
+                fallback_goals=n_fb,
             )
             result.final_state = st_i
             result.env = env
             result.meta = meta
             result.round_trace = None     # one fleet trace below, not K
             results.append(result)
+            if self._incremental:
+                # per-tenant carryover, saved before any per-tenant raise
+                # (the consumed delta is gone either way)
+                session.note_carryover(
+                    IncrementalCarryover(
+                        chain_key=chain_key,
+                        violated_before=tuple(
+                            bool(violated_before[g.name]) for g in goals),
+                        violated_after={r.name: r.violated_after
+                                        for r in goal_results},
+                        proven={r.name: r.fixpoint_proven
+                                for r in goal_results},
+                        result=result),
+                    taken_generation=gens[i])
             if raise_on_failure:
                 failed = [r.name for r, g in zip(goal_results, goals)
                           if g.is_hard and r.violated_after]
@@ -1101,6 +1539,12 @@ class GoalOptimizer:
                     raise OptimizationFailureError(
                         f"hard goal(s) not satisfiable for tenant {i}: "
                         f"{failed}", result=result)
+
+        if self._incremental and self._revalidate and results:
+            # prime the solo-shaped verdict re-check program (one compile
+            # per shape bucket) so next round's fleet memo compiles nothing
+            _compiled_violations(tuple(goals))(results[0].env,
+                                               results[0].final_state)
 
         # ONE RoundTrace for the whole launch (the fleet's unit of work):
         # tenant-0's per-goal profile as the representative rows, proposal
@@ -1120,7 +1564,67 @@ class GoalOptimizer:
                         for s in sessions),
             profile_level=self._profile_level,
             durations_measured=False,
-            opt_generation=opt_gen)
+            opt_generation=opt_gen,
+            round_mode=("reduced" if any(reduced_by_tenant) else "full"))
+        for r in results:
+            r.round_trace = trace
+        return results
+
+    def _revalidated_fleet(self, sessions, goals, rds, chain_key, opt_gen,
+                           compiles0, t_round):
+        """Whole-fleet certificate memo (PR 16): when EVERY tenant is
+        structurally eligible AND every tenant's one-program verdict
+        re-check matches its carried round, the batched launch is skipped
+        outright and each tenant's carried result returns re-stamped. A
+        single ineligible tenant sends the WHOLE fleet down the batched
+        chain — subsetting the stack would compile a new K variant per
+        subset, so the memo is all-or-nothing by design. Returns None when
+        ineligible (the caller runs the full batched round)."""
+        for s, rd in zip(sessions, rds):
+            co = s.carryover
+            if (rd is None or co is None or co.chain_key != chain_key
+                    or rd["syncs"] < 1 or rd["churn"] != 0
+                    or rd["broker_flips"] or rd["rebuilt"]
+                    or rd["load_drift"] > self._reval_tol):
+                return None
+        t0 = time.monotonic()
+        checked = []
+        for s in sessions:
+            env, st = s.revalidation_view()
+            viol = jax.device_get(_compiled_violations(tuple(goals))(env, st))
+            if tuple(bool(v) for v in viol) != s.carryover.violated_before:
+                return None
+            checked.append((s, env, st))
+        reval_s = time.monotonic() - t0
+        results = []
+        for s, env, st in checked:
+            co = s.carryover
+            grs = [dataclasses.replace(r, duration_s=0.0, mode="revalidated")
+                   for r in co.result.goal_results]
+            result = dataclasses.replace(
+                co.result, goal_results=grs, round_mode="revalidated",
+                revalidate_s=reval_s, durations_measured=False,
+                fallback_goals=0)
+            result.final_state = getattr(co.result, "final_state", None)
+            result.env = getattr(co.result, "env", None)
+            result.meta = getattr(co.result, "meta", None)
+            s.note_revalidated()
+            results.append(result)
+        trace = self.recorder.record_round(
+            wall_s=time.monotonic() - t_round,
+            goal_results=results[0].goal_results,
+            compiles=self._compile_listener.count - compiles0,
+            env=checked[0][1], state=checked[0][2],
+            num_proposals=sum(len(r.proposals) for r in results),
+            num_replica_movements=sum(r.num_replica_movements
+                                      for r in results),
+            num_leadership_movements=sum(r.num_leadership_movements
+                                         for r in results),
+            session_info={"mode": "fleet", "tenants": len(sessions),
+                          "revalidated": True},
+            donated=False, profile_level=self._profile_level,
+            durations_measured=False, opt_generation=opt_gen,
+            round_mode="revalidated", revalidate_s=reval_s)
         for r in results:
             r.round_trace = trace
         return results
@@ -1136,7 +1640,8 @@ def _compiled_stack(n: int):
 
 
 @lru_cache(maxsize=32)
-def _compiled_fleet_chain(goal_classes: tuple, goals: tuple, ple):
+def _compiled_fleet_chain(goal_classes: tuple, goals: tuple, ple,
+                          masked: bool = False):
     """The fleet's one-launch-per-bucket program: the COMPLETE per-tenant
     chain — every goal's ``_goal_loop`` (finisher included), the optional
     PreferredLeaderElection pass, before/after stats and the packed final
@@ -1144,17 +1649,26 @@ def _compiled_fleet_chain(goal_classes: tuple, goals: tuple, ple):
     pytrees. Each tenant's trajectory is computed exactly as K solo runs
     would (vmap's per-element semantics; certified bit-identical in
     tests/test_fleet.py); EngineParams broadcasts (in_axes=None) so budget
-    changes reuse the executable, and a new K compiles a new variant."""
+    changes reuse the executable, and a new K compiles a new variant.
+
+    ``masked=True`` (incremental, PR 16) adds a per-goal [K, R] seed-mask
+    tuple vmapped alongside the tenants (in_axes 0): reduced tenants ride
+    dirty rows, full tenants all-ones rows, in ONE executable — the
+    reduced<->full flip is value-only across the whole fleet."""
     from cruise_control_tpu.analyzer.engine import _goal_loop
     del goal_classes  # cache key only
 
-    def one(env: ClusterEnv, st: EngineState, params: EngineParams):
+    def one(env: ClusterEnv, st: EngineState, params: EngineParams,
+            seed_masks=None):
         out = {"stats_before": _stats_device(env, st),
                "viol_before": [g.violated(env, st) for g in goals]}
         infos = []
         prev: tuple = ()
-        for g in goals:
-            st, info = _goal_loop(env, st, g, prev, params)
+        for i, g in enumerate(goals):
+            st, info = _goal_loop(env, st, g, prev, params,
+                                  seed_mask=(seed_masks[i]
+                                             if seed_masks is not None
+                                             else None))
             infos.append(info)
             prev = prev + (g,)
         if ple is not None:
@@ -1169,19 +1683,45 @@ def _compiled_fleet_chain(goal_classes: tuple, goals: tuple, ple):
     # the stacked state is donated: it is a fresh copy made by the stack
     # program that nothing else aliases, and at K tenants x 1M-replica
     # buckets the saved duplicate is K x the PR 5 state footprint
+    if masked:
+        return jax.jit(jax.vmap(one, in_axes=(0, 0, None, 0)),
+                       donate_argnums=(1,))
     return jax.jit(jax.vmap(one, in_axes=(0, 0, None)), donate_argnums=(1,))
 
 
 @lru_cache(maxsize=64)
-def _compiled_prefix_chain(goal_classes: tuple, goals: tuple, split: int):
+def _compiled_prefix_chain(goal_classes: tuple, goals: tuple, split: int,
+                           masked: bool = False):
     """ONE jitted program for the chain's head: initial stats + EVERY
     goal's violated-before flag, then the loops of goals[:split] (the
     goals without deep tails — they converge in bounded passes).
     EngineParams arrives as a traced-pytree argument (see engine.py): budget
     changes — including the optimizer's per-cluster scaling — reuse the
-    compiled executable."""
+    compiled executable. ``masked=True`` (incremental, PR 16) adds a
+    per-prefix-goal tuple of bool[R] seed masks as a traced argument —
+    all-ones values reproduce the unmasked program's trajectory exactly,
+    so full<->reduced rounds share this one executable."""
     from cruise_control_tpu.analyzer.engine import _goal_loop
     del goal_classes  # cache key only
+
+    if masked:
+        @partial(jax.jit, donate_argnums=(1,))
+        def run_masked(env: ClusterEnv, st: EngineState,
+                       params: EngineParams, seed_masks: tuple):
+            out = {"stats_before": _stats_device(env, st),
+                   "viol_before": [g.violated(env, st) for g in goals]}
+            infos = []
+            prev: tuple = ()
+            for g, m in zip(goals[:split], seed_masks):
+                st2, info = _goal_loop(env, st, g, prev, params,
+                                       finisher=False, seed_mask=m)
+                st = st2
+                infos.append(info)
+                prev = prev + (g,)
+            out["infos"] = infos
+            return st, out
+
+        return run_masked
 
     @partial(jax.jit, donate_argnums=(1,))
     def run(env: ClusterEnv, st: EngineState, params: EngineParams):

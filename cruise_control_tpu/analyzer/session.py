@@ -68,12 +68,31 @@ from cruise_control_tpu.analyzer.state import (
 )
 from cruise_control_tpu.model.cluster_tensor import bucket_size, pad_cluster
 from cruise_control_tpu.model.delta import (
-    SnapshotDelta, diff_snapshots, replica_slot_values,
+    SnapshotDelta, diff_snapshots, dirty_replica_sets, replica_slot_values,
 )
 
 LOG = logging.getLogger(__name__)
 
 DEFAULT_MAX_DELTA_FRACTION = 0.25
+
+
+def _rows_drift(rows: tuple, base: tuple | None) -> float:
+    """Global relative load-row drift: max |new - base| over both [Rv, M]
+    row sets, normalized by the baseline's max magnitude. inf when there is
+    no baseline (or the valid-replica count changed — appended rows make the
+    carried round's loads incomparable). 0.0 iff bit-stable, which is what
+    the default revalidate tolerance (0.0) requires."""
+    if base is None:
+        return float("inf")
+    worst = 0.0
+    for new, old in zip(rows, base):
+        if new.shape != old.shape:
+            return float("inf")
+        d = float(np.abs(new - old).max()) if new.size else 0.0
+        if d:
+            scale = max(float(np.abs(old).max()), 1e-9)
+            worst = max(worst, d / scale)
+    return worst
 
 
 # ---------------------------------------------------------------------------
@@ -208,6 +227,8 @@ class ResidentClusterSession:
                 "topics.with.min.leaders.per.broker")
             self._donation = config.get_boolean("analyzer.session.donation")
             self._compact = config.get_boolean("analyzer.compact.tables")
+            self._track_deltas = config.get_boolean(
+                "analyzer.incremental.enabled")
             # shard-aware residency: with a shard-explicit mesh configured
             # (tpu.mesh.axis.brokers > 1, tpu.shard.map on) the resident
             # env/state live REPLICATED on the mesh — chosen here at session
@@ -225,6 +246,7 @@ class ResidentClusterSession:
             self._min_leader_pattern = ""
             self._donation = True
             self._compact = True
+            self._track_deltas = True
         self.mesh = mesh
         self._sharding = None
         if mesh is not None:
@@ -277,6 +299,20 @@ class ResidentClusterSession:
         # optimize stage re-entering after the sync stage already ran) is a
         # no-op instead of a redundant [R, M] re-upload
         self._sync_key: tuple | None = None
+        # ---- incremental re-optimization carryover (PR 16) ----
+        # the previous optimize round's violation verdicts + fixpoint
+        # certificates + carried result, persisted HOST-side on the session
+        # (optimizer.IncrementalCarryover) so it trivially survives
+        # donation, shadow syncs and spill/readmit; cleared on every epoch
+        # fallback (_rebuild) and explicit invalidate. ``_round_delta``
+        # accumulates what changed since the last optimize consumed it:
+        # structural churn, dirty broker/topic indices, broker-axis flips
+        # and load-row drift vs the rows the carried round optimized.
+        self.carryover = None
+        self._round_delta = self._fresh_round_delta()
+        self._load_baseline = None     # (lead, foll) rows carryover reflects
+        self._last_rows = None         # (lead, foll) rows of the last refresh
+        self.revalidated_rounds = 0
         # double-buffered host staging for the per-round [R, M] load rows:
         # two alternating buffer pairs so assembling round N+1's upload never
         # rewrites the pinned pages round N's (possibly still in-flight)
@@ -347,9 +383,20 @@ class ResidentClusterSession:
                 if reason is not None:
                     return self._rebuild(reason, allow_capacity_estimation)
                 self._apply_topology_delta(snap, delta)
+                if self._track_deltas and not delta.is_noop:
+                    dirty = dirty_replica_sets(self._prev_snapshot, snap,
+                                               delta)
+                    rd = self._round_delta
+                    rd["churn"] += delta.churn
+                    rd["dirty_brokers"].update(
+                        int(b) for b in dirty["brokers"])
+                    rd["dirty_topics"].update(
+                        int(t) for t in dirty["topics"])
                 self._cum_churn += delta.churn
                 self._prev_snapshot = snap
             self._refresh_metrics(agg, snap)
+            if self._track_deltas:
+                self._round_delta["syncs"] += 1
             self.delta_rounds += 1
             self._sync_key = key
             self.sync_generation += 1
@@ -400,6 +447,83 @@ class ResidentClusterSession:
             self.state = None
             self._spilled_env = None
             self._sync_key = None
+            self.carryover = None
+            self._load_baseline = None
+
+    # ------------------------------------- incremental carryover (PR 16)
+    def _fresh_round_delta(self) -> dict:
+        return {"churn": 0, "syncs": 0, "dirty_brokers": set(),
+                "dirty_topics": set(), "broker_flips": False,
+                "load_drift": 0.0, "rebuilt": False}
+
+    def consume_round_delta(self) -> dict:
+        """Everything that changed since the last optimize round consumed
+        this accumulator (the optimizer calls it once at round start to
+        decide revalidated / reduced / full): structural churn count, dirty
+        broker/topic index sets, broker-axis flips, accumulated load-row
+        drift vs the carried round's baseline (inf = no baseline), and
+        whether an epoch rebuild happened."""
+        with self.lock:
+            rd = self._round_delta
+            self._round_delta = self._fresh_round_delta()
+            return rd
+
+    def note_carryover(self, carryover, taken_generation=None) -> None:
+        """Persist a full/reduced round's carryover. ``taken_generation`` is
+        the sync_generation at input-take time: when a shadow sync landed
+        mid-round, the last-refreshed rows are NOT the rows the carried
+        result optimized, so the drift baseline is dropped (conservative —
+        the next round runs full and re-establishes it)."""
+        with self.lock:
+            self.carryover = carryover
+            if (taken_generation is not None
+                    and taken_generation != self.sync_generation):
+                self._load_baseline = None
+            else:
+                self._load_baseline = self._last_rows
+
+    def revalidation_view(self) -> tuple:
+        """(env, state) for the certificate re-check WITHOUT donation: the
+        resident state is peeked (rematerialized if lent/spilled), never
+        taken, so a revalidated round leaves the session untouched."""
+        with self.lock:
+            if self.env is None and self._spilled_env is not None:
+                self._readmit_locked()
+            self._ensure_state()
+            return self.env, self.state
+
+    def note_revalidated(self) -> None:
+        with self.lock:
+            self.revalidated_rounds += 1
+
+    def dirty_replica_mask(self, dirty_brokers, dirty_topics) -> np.ndarray:
+        """bool[R_padded]: replicas living on a dirty broker or in a dirty
+        topic — the reduced round's candidate seed (optimizer dirty-set
+        seeding). Built from the host mirrors: broker values are padded
+        broker-axis indices (the sorted broker axis is the padded axis'
+        prefix), topics resolve through replica_partition -> the latest
+        snapshot's partition_topic (padded partition order keeps the
+        snapshot's sorted-key order as its prefix). Padding slots are
+        always excluded."""
+        with self.lock:
+            rb = self._h["replica_broker"]
+            rp = self._h["replica_partition"]
+            valid = self._h["replica_valid"]
+            mask = np.zeros(rb.shape[0], bool)
+            if dirty_brokers:
+                mask |= np.isin(
+                    rb, np.fromiter(dirty_brokers, np.int64,
+                                    len(dirty_brokers)))
+            if dirty_topics and self._prev_snapshot is not None:
+                pt = np.asarray(self._prev_snapshot.partition_topic)
+                if pt.size:
+                    safe = np.clip(rp, 0, pt.size - 1)
+                    topic_of = np.where((rp >= 0) & (rp < pt.size),
+                                        pt[safe], -1)
+                    mask |= np.isin(
+                        topic_of, np.fromiter(dirty_topics, np.int64,
+                                              len(dirty_topics)))
+            return mask & valid
 
     # --------------------------------------------------- fleet spill/readmit
     @property
@@ -446,6 +570,21 @@ class ResidentClusterSession:
         self._materialize(self.env.leader_load, self.env.follower_load)
         self.readmits += 1
 
+    def pending_delta_json(self) -> dict:
+        """What the NEXT optimize round will see in its round-delta: the
+        sync->optimize hand-off summary (pipeline sync stage surfaces it,
+        /state renders it)."""
+        rd = self._round_delta
+        return {
+            "churn": rd["churn"],
+            "syncs": rd["syncs"],
+            "dirtyBrokers": len(rd["dirty_brokers"]),
+            "dirtyTopics": len(rd["dirty_topics"]),
+            "brokerFlips": rd["broker_flips"],
+            "loadDrift": rd["load_drift"],
+            "rebuilt": rd["rebuilt"],
+        }
+
     def state_json(self) -> dict:
         return {
             "epoch": self.epoch,
@@ -457,6 +596,9 @@ class ResidentClusterSession:
             "spilled": self.spilled,
             "spills": self.spills,
             "readmits": self.readmits,
+            "revalidatedRounds": self.revalidated_rounds,
+            "carryover": self.carryover is not None,
+            "pendingDelta": self.pending_delta_json(),
             "lastSync": dict(self.last_sync_info),
         }
 
@@ -588,6 +730,13 @@ class ResidentClusterSession:
         self._prev_snapshot = snap
         self._epoch_replicas = Rv
         self._cum_churn = 0
+        # epoch fallback invalidates the incremental carryover: the padded
+        # shapes, slot order and broker axis may all have changed
+        self.carryover = None
+        self._load_baseline = None
+        self._last_rows = None
+        self._round_delta = self._fresh_round_delta()
+        self._round_delta["rebuilt"] = True
         self.epoch += 1
         self.rebuild_rounds += 1
         self._sync_key = (snap.generation, mon._partition_agg.generation)
@@ -690,11 +839,25 @@ class ResidentClusterSession:
                          (cap, rack, alive, new, demoted, excl_move,
                           excl_lead, disk_cap, disk_alive)))
         changed = {}
+        flipped: set = set()
         for name, fill in self._BROKER_FIELDS:
             padded = self._pad_b(dense[name], Bp, fill)
-            if not np.array_equal(padded, self._broker_mirror[name]):
+            old = self._broker_mirror[name]
+            if not np.array_equal(padded, old):
                 changed[name] = padded
+                if self._track_deltas:
+                    neq = padded != old
+                    if neq.ndim > 1:
+                        neq = neq.any(axis=tuple(range(1, neq.ndim)))
+                    flipped.update(int(b) for b in np.flatnonzero(neq))
         if changed:
+            if self._track_deltas:
+                # a broker-axis flip (capacity, liveness, exclusion, rack)
+                # changes goal inputs globally: it blocks re-validation and
+                # marks the flipped brokers dirty for seeding
+                rd = self._round_delta
+                rd["broker_flips"] = True
+                rd["dirty_brokers"].update(flipped)
             self._broker_mirror.update(changed)
             # upload in the RESIDENT leaf's dtype (compact tables keep e.g.
             # broker_rack int16 — a stray int32 upload would flip the leaf
@@ -798,6 +961,16 @@ class ResidentClusterSession:
         cols = mon.partition_load_columns(snap.partition_keys,
                                           snap.generation, agg=agg)
         lead, foll = mon.replica_load_rows(cols, self._rep_part)
+        if self._track_deltas:
+            # load-row drift vs the rows the carried round optimized —
+            # measured against the BASELINE directly (not successive
+            # diffs), so it is exactly "how far have the loads moved since
+            # the carryover's round" regardless of how many syncs ran
+            rd = self._round_delta
+            base = self._load_baseline
+            rd["load_drift"] = max(rd["load_drift"],
+                                   _rows_drift((lead, foll), base))
+            self._last_rows = (lead.copy(), foll.copy())
         Rp = self.env.num_replicas
         Rv = lead.shape[0]
         # DOUBLE-BUFFERED staging: two alternating host buffer pairs, so

@@ -611,6 +611,12 @@ class RoundTrace:
     # explicit span handle reached the optimizer (detector verdict ->
     # operation -> this round); None for unparented rounds
     trace_id: str | None = None
+    # incremental re-optimization (PR 16): how the round was produced —
+    # "full" | "reduced" (dirty-set-seeded chain) | "revalidated" (the
+    # whole-round certificate memo; revalidate_s is the re-check's wall
+    # seconds, the round's only device work)
+    round_mode: str = "full"
+    revalidate_s: float = 0.0
 
     def to_json(self) -> dict:
         out = dataclasses.asdict(self)
@@ -640,6 +646,9 @@ def goal_trace_rows(goal_results) -> list[dict]:
         # cross-segment boundary rows re-validated by the budgeted admission
         "fin_segments": getattr(g, "finisher_segments", 0),
         "fin_boundary": getattr(g, "finisher_boundary", 0),
+        # incremental round mode (PR 16): full | reduced | revalidated —
+        # the flamegraph's which-goals-did-the-fast-path-skip signal
+        "mode": getattr(g, "mode", "full"),
     } for g in goal_results]
 
 
@@ -781,7 +790,9 @@ class FlightRecorder:
                      profile_level: str = "off",
                      durations_measured: bool = False,
                      trace_id: str | None = None,
-                     opt_generation: int | None = None) -> RoundTrace:
+                     opt_generation: int | None = None,
+                     round_mode: str = "full",
+                     revalidate_s: float = 0.0) -> RoundTrace:
         """Assemble + record one round from what the optimizer already holds.
         ``opt_generation`` (from this round's ``note_optimize_start``) keys
         which pending stage notes belong to it. Never raises into the
@@ -812,6 +823,8 @@ class FlightRecorder:
                 stages=stages,
                 overlap=overlap,
                 trace_id=trace_id,
+                round_mode=round_mode,
+                revalidate_s=round(float(revalidate_s), 4),
             )
         except Exception:  # noqa: BLE001 — tracing must never fail a round
             import logging

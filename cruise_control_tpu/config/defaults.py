@@ -249,6 +249,48 @@ _D.define(name="analyzer.session.max.delta.fraction", type=Type.DOUBLE, default=
               "by deltas since the epoch's rebuild exceed this fraction of "
               "the cluster's replicas, the next round rebuilds from scratch "
               "(a fresh epoch) instead of applying further deltas.")
+_D.define(name="analyzer.incremental.enabled", type=Type.BOOLEAN, default=True,
+          doc="Incremental re-optimization master switch: the resident "
+              "session tracks per-round deltas (dirty brokers/topics, load "
+              "drift, broker-axis flips) and persists the previous round's "
+              "violation verdicts + fixpoint certificates as host-side "
+              "carryover, and the optimizer compiles its chain programs with "
+              "a traced bool[R] seed-mask argument (all-ones on full rounds "
+              "— bit-identical to the unmasked program) so the revalidate/"
+              "seeding knobs below toggle without recompiling. Off = "
+              "pre-PR-16 behavior: every round re-runs the full chain.")
+_D.define(name="analyzer.incremental.revalidate", type=Type.BOOLEAN, default=True,
+          doc="Certificate re-validation fast path: a steady round whose "
+              "deltas since the last optimize carry ZERO structural churn, "
+              "no broker-axis change, and load-row drift within "
+              "analyzer.incremental.revalidate.tolerance re-checks every "
+              "goal's carried verdict with ONE [B]-level violation reduction "
+              "per goal (no donation, no selection/passes/finisher) and, "
+              "when all verdicts match, returns the carried result — "
+              "sub-second instead of the full chain. Any mismatch falls "
+              "through to the full goal programs. Requires at least one real "
+              "delta sync since the last optimize (forced re-runs of an "
+              "unchanged model stay full rounds).")
+_D.define(name="analyzer.incremental.revalidate.tolerance", type=Type.DOUBLE,
+          default=0.0, validator=at_least(0.0),
+          doc="Max accumulated relative load-row drift (vs the rows the "
+              "carried round optimized) a re-validated round may carry. 0.0 "
+              "= bit-stable loads only, which keeps the fast path exact: the "
+              "carried result was computed on an identical state. Nonzero "
+              "values trade exactness for hit rate under jittery metrics — "
+              "the verdict re-check still guards every goal.")
+_D.define(name="analyzer.incremental.seed.dirty", type=Type.BOOLEAN, default=False,
+          doc="Dirty-set candidate seeding: on delta rounds under the churn "
+              "budget, goals that were SATISFIED last round key their "
+              "budgeted selection pools only from replicas on brokers/topics "
+              "touched by the delta (engine._mask_key); goals violated last "
+              "round and the exhaustive finisher scans stay full-R, and any "
+              "seeded goal that ends violated without a certificate re-runs "
+              "unmasked (traced fallback), so parity is one-sided: "
+              "violations only shrink, certificates only appear (the PR 13 "
+              "escalation precedent; gated by tools/churn_ab.py + "
+              "tools/slo_diff.py). Off by default like compact keying: an "
+              "opt-in perf lever with a documented contract.")
 _D.define(name="analyzer.profile.level", type=Type.STRING, default="off",
           validator=in_set("off", "pass", "stage"),
           validator_doc="one of: off, pass, stage",

@@ -123,6 +123,44 @@ def replica_slot_values(snap: ClusterSnapshot, slots: np.ndarray,
     }
 
 
+def dirty_replica_sets(prev: ClusterSnapshot, new: ClusterSnapshot,
+                       delta: SnapshotDelta) -> dict:
+    """Brokers and topics a compatible delta touches — the incremental
+    optimizer's dirty-set seed (analyzer/optimizer.py).
+
+    Returns ``{"brokers": i64[], "topics": i64[]}``: broker INDICES into the
+    sorted broker axis (both the OLD and NEW broker of every changed slot —
+    a vacated broker's balance changes too) and topic indices (into the NEW
+    snapshot's topic list) of every changed or appended replica's partition.
+    O(churn) host time."""
+    brokers: list = []
+    topics: list = []
+    if delta.num_changed:
+        slots = delta.changed_slots
+        old_bid = prev.rep_bid[slots]
+        new_bid = new.rep_bid[slots]
+        brokers.append(old_bid)
+        brokers.append(new_bid)
+        part = np.searchsorted(prev.rep_ptr, slots, side="right") - 1
+        topics.append(new.partition_topic[part])
+    if delta.num_appended_replicas:
+        lo = delta.num_replicas_before
+        brokers.append(new.rep_bid[lo:])
+        part = (np.searchsorted(new.rep_ptr, np.arange(lo, new.num_replicas),
+                                side="right") - 1)
+        topics.append(new.partition_topic[part])
+    if brokers:
+        bid = np.unique(np.concatenate(brokers))
+        bidx = np.searchsorted(new.broker_ids, bid)
+        bidx = np.clip(bidx, 0, len(new.broker_ids) - 1)
+        bidx = bidx[new.broker_ids[bidx] == bid]
+    else:
+        bidx = np.zeros(0, np.int64)
+    tidx = (np.unique(np.concatenate(topics)) if topics
+            else np.zeros(0, np.int64))
+    return {"brokers": bidx.astype(np.int64), "topics": tidx}
+
+
 def appended_partition_slots(snap: ClusterSnapshot, p_lo: int) -> np.ndarray:
     """i64[P_new - p_lo + 1]: rep_ptr suffix for partitions ``p_lo:`` —
     the CSR ranges the appended partitions occupy."""

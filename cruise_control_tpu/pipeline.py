@@ -224,6 +224,10 @@ class PipelinedServiceLoop:
             try:
                 info.update(sess.sync())
                 self._synced_generation = sess.sync_generation
+                # the sync -> optimize hand-off (PR 16): what the next
+                # optimize round's incremental eligibility check will see —
+                # accumulated churn, dirty-set sizes, load drift
+                info["pending_delta"] = sess.pending_delta_json()
             except NotEnoughValidWindowsError as e:
                 info["skipped"] = str(e)    # backpressure: windows not filled
         else:
@@ -263,7 +267,7 @@ class PipelinedServiceLoop:
             return {"skipped": "nothing new synced"}
         gen = self._synced_generation
         try:
-            self.cc.cached_proposals(force_refresh=force_refresh)
+            res = self.cc.cached_proposals(force_refresh=force_refresh)
         except NotEnoughValidWindowsError:
             # raced a window roll-out between the check and the build: treat
             # exactly like backpressure (stall, retry next step)
@@ -271,7 +275,11 @@ class PipelinedServiceLoop:
             return {"stalled": True}
         self._optimized_generation = gen
         self.optimize_rounds += 1
-        return {"optimized": True, "generation": gen}
+        out = {"optimized": True, "generation": gen}
+        mode = getattr(res, "round_mode", None)
+        if mode is not None:
+            out["round_mode"] = mode      # full | reduced | revalidated
+        return out
 
     # ------------------------------------------------------------ execute
     def accepts_fix_routing(self) -> bool:

@@ -157,21 +157,58 @@ def test_fresh_tenant_not_reoptimized(fleet3):
     assert all(v == "fresh" for v in report["skipped"].values())
 
 
-def test_pause_resume(fleet3):
-    fleet = fleet3
-    cid = f"tenant-{SEEDS[0]}"
+@pytest.fixture()
+def pause_fleet():
+    """Isolation pin for the pause/resume contract.
+
+    test_pause_resume was observed failing once in a full tier-1 run while
+    passing in isolation (PR 15). Two cross-test couplings can do that, and
+    both route through the shared module fixture:
+
+    - ``fleet3`` is MUTATED by every test that touches it (round sequence,
+      window high-water marks, sync/optimized generations — and tenant-11
+      specifically is both the tenant this test pauses and the one
+      test_memory_budget_* spills), so this test's preconditions silently
+      depend on which tests ran before it and in what order;
+    - a full single-process run accumulates hundreds of XLA:CPU executables
+      (see pytest.ini's xdist rationale); a compiler abort inside a
+      shared-fixture round is swallowed by run_round's tenant/bucket
+      isolation (``skipped: "launch failed"``) and then surfaces HERE as
+      the resumed tenant mysteriously absent from ``report["optimized"]``.
+
+    A private same-bucket fleet makes every precondition this test consumes
+    built by this test. The backends reuse SEEDS, so the already-compiled
+    batched chain serves the epoch round — the pin costs one warm round,
+    not new compiles, and any launch failure now fails THIS test's own
+    setup with the report attached instead of poisoning a shared fixture
+    mid-module."""
+    fleet = FleetScheduler(config=_cfg())
+    for s in SEEDS:
+        t = fleet.add_tenant(f"pause-{s}", backend=_backend(s),
+                             config=_cfg())
+        _sample(t.cc)
+    report = fleet.run_round(now_ms=2_000_000.0)
+    assert sorted(report["optimized"]) == sorted(
+        f"pause-{s}" for s in SEEDS), report
+    yield fleet
+    fleet.shutdown()
+
+
+def test_pause_resume(pause_fleet):
+    fleet = pause_fleet
+    cid = f"pause-{SEEDS[0]}"
     fleet.pause(cid)
     for t in fleet.tenants.values():
         t.cc.load_monitor.sample_once(now_ms=8 * WINDOW_MS)
     report = fleet.run_round(now_ms=2_700_000.0)
-    assert report["skipped"][cid] == "paused"
-    assert cid not in report["optimized"]
+    assert report["skipped"][cid] == "paused", report
+    assert cid not in report["optimized"], report
     # still servable from the cached proposals while paused
     assert fleet.app_for(cid).cached_proposals() is not None
     fleet.resume(cid)
     fleet.tenants[cid].cc.load_monitor.sample_once(now_ms=9 * WINDOW_MS)
     report = fleet.run_round(now_ms=2_800_000.0)
-    assert cid in report["optimized"]
+    assert cid in report["optimized"], report
 
 
 # ------------------------------------------------- memory budget + spill

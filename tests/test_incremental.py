@@ -1,0 +1,360 @@
+"""Incremental re-optimization (PR 16): certificate re-validation memo,
+dirty-set candidate seeding, and the session-lifecycle carryover contract.
+
+The invariants:
+1. A zero-churn, drift-free steady round after a full round takes the
+   whole-round certificate memo — 0 goals re-executed, zero new compiles,
+   result identical to re-running the chain, no donation.
+2. The carryover survives donation and fleet spill/readmit, drops its
+   drift baseline on a shadow sync (conservative: one full round
+   re-establishes it), and is INVALIDATED on epoch fallback (broker-set
+   change) — a stale memo can never be served.
+3. Dirty-set seeding (opt-in) keeps the one-sided parity contract vs the
+   full path: violations only shrink, certificates only appear; the
+   reduced<->full flip and the revalidate toggle add zero new XLA compiles
+   (the masks are traced values).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from cruise_control_tpu.analyzer.session import ResidentClusterSession
+from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+from cruise_control_tpu.config import cruise_control_config
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.sampling.samplers import SimulatedMetricSampler
+
+GOALS = ["ReplicaCapacityGoal", "ReplicaDistributionGoal",
+         "LeaderReplicaDistributionGoal"]
+
+
+def _backend(seed=0, num_brokers=10, num_partitions=60, rf=2):
+    rng = np.random.default_rng(seed)
+    be = SimulatedClusterBackend()
+    for b in range(num_brokers):
+        be.add_broker(b, f"r{b % 3}")
+    for p in range(num_partitions):
+        reps = [int(x) for x in rng.choice(num_brokers, size=rf,
+                                           replace=False)]
+        be.create_partition(f"t{p % 6}", p, reps,
+                            size_mb=float(rng.uniform(10, 500)),
+                            bytes_in_rate=float(rng.uniform(1, 50)),
+                            bytes_out_rate=float(rng.uniform(1, 100)),
+                            cpu_util=float(rng.uniform(0.1, 5)))
+    return be
+
+
+def _monitored(be, rounds=6, start_round=0):
+    lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    for i in range(start_round, start_round + rounds):
+        lm.sample_once(now_ms=i * 300_000.0)
+    return lm
+
+
+def _optimizer(extra=None):
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    cfg = {"goals": ",".join(GOALS), "hard.goals": "ReplicaCapacityGoal"}
+    cfg.update(extra or {})
+    return GoalOptimizer(config=cruise_control_config(cfg))
+
+
+def _round(opt, sess):
+    return opt.optimizations(None, session=sess, goal_names=GOALS,
+                             raise_on_failure=False,
+                             skip_hard_goal_check=True)
+
+
+def _steady(opt, sess, lm, t):
+    """sample -> sync -> optimize: one steady service round."""
+    lm.sample_once(now_ms=t * 300_000.0)
+    info = sess.sync()
+    return info, _round(opt, sess)
+
+
+def test_zero_churn_round_revalidates():
+    """The tentpole: round 1 rebuild+full, round 2 delta+full (establishes
+    the drift baseline), round 3 zero-churn -> whole-round memo with every
+    goal revalidated, zero compiles, verdicts/proposals identical, and the
+    resident session untouched (no donation)."""
+    be = _backend()
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    opt = _optimizer()
+
+    assert sess.sync()["mode"] == "rebuild"
+    r1 = _round(opt, sess)
+    assert r1.round_mode == "full"          # rebuilt round never memoizes
+
+    info, r2 = _steady(opt, sess, lm, 6)
+    assert info["mode"] == "delta"
+    assert r2.round_mode == "full"          # no baseline yet -> drift inf
+
+    donated_before = sess.donated_rounds
+    info, r3 = _steady(opt, sess, lm, 7)
+    assert info["mode"] == "delta"
+    assert r3.round_mode == "revalidated", (
+        sess.pending_delta_json(), r3.round_mode)
+    assert sess.revalidated_rounds == 1
+    # no donation: the memo only peeked at the resident state
+    assert sess.donated_rounds == donated_before
+    # 0 goals re-executed, all carried
+    assert all(g.mode == "revalidated" for g in r3.goal_results)
+    # verdict + proposal identity with the carried full round
+    assert r3.violated_goals_after == r2.violated_goals_after
+    assert r3.num_replica_movements == r2.num_replica_movements
+    assert len(r3.proposals) == len(r2.proposals)
+    # zero new XLA compiles and the re-check cost is recorded
+    assert r3.round_trace.compiles == 0
+    assert r3.round_trace.round_mode == "revalidated"
+    assert r3.revalidate_s >= 0.0
+    assert r3.round_trace.goals[0]["mode"] == "revalidated"
+
+    # memo rounds keep memoizing while nothing changes
+    _, r4 = _steady(opt, sess, lm, 8)
+    assert r4.round_mode == "revalidated"
+    assert sess.revalidated_rounds == 2
+
+
+def test_forced_rerun_without_sync_stays_full():
+    """A re-run of an unchanged model (no sync between optimizes) must NOT
+    memoize: rd['syncs'] == 0 keeps forced refreshes honest."""
+    be = _backend(seed=5)
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    opt = _optimizer()
+    sess.sync()
+    _round(opt, sess)
+    _steady(opt, sess, lm, 6)               # establish baseline
+    r = _round(opt, sess)                   # optimize again, NO sync
+    assert r.round_mode == "full"
+    assert sess.revalidated_rounds == 0
+
+
+def test_churn_invalidates_memo_and_leadership_roundtrip():
+    """Real churn falls back to the full program; once the disturbance is
+    optimized through and the stream goes quiet again, the memo resumes."""
+    be = _backend(seed=1)
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    opt = _optimizer()
+    sess.sync()
+    _round(opt, sess)
+    _steady(opt, sess, lm, 6)
+
+    # leadership flip = churn > 0 -> full round
+    info = be.partitions()[("t1", 1)]
+    be.elect_leaders({("t1", 1): info.replicas[-1]})
+    inf, r = _steady(opt, sess, lm, 7)
+    assert inf["churn"] > 0
+    assert r.round_mode == "full"
+
+    # quiet again: the churn round itself re-baselined (it was a full
+    # round), so the memo resumes on the very next quiet round
+    _, r = _steady(opt, sess, lm, 8)
+    assert r.round_mode == "revalidated"
+
+
+def test_carryover_survives_spill_readmit():
+    """Fleet spill/readmit: the carryover is host-side and the memo's
+    revalidation view readmits the spilled env — a spilled steady tenant
+    still revalidates."""
+    be = _backend(seed=2)
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    opt = _optimizer()
+    sess.sync()
+    _round(opt, sess)
+    _steady(opt, sess, lm, 6)
+    _, r = _steady(opt, sess, lm, 7)
+    assert r.round_mode == "revalidated"
+
+    assert sess.spill()
+    assert sess.carryover is not None        # carryover is host-side
+    _, r = _steady(opt, sess, lm, 8)         # sync readmits, then memo
+    assert r.round_mode == "revalidated"
+    assert sess.readmits >= 1
+
+
+def test_epoch_fallback_invalidates_carryover():
+    """Broker-set change -> rebuild (new epoch) -> carryover cleared; the
+    next round runs full and can never serve the stale memo."""
+    be = _backend(seed=3)
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    opt = _optimizer()
+    sess.sync()
+    _round(opt, sess)
+    _steady(opt, sess, lm, 6)
+    _, r = _steady(opt, sess, lm, 7)
+    assert r.round_mode == "revalidated"
+    assert sess.carryover is not None
+
+    be.add_broker(99, "r0")
+    lm.sample_once(now_ms=8 * 300_000.0)
+    info = sess.sync()
+    assert info["mode"] == "rebuild"
+    assert sess.carryover is None
+    r = _round(opt, sess)
+    assert r.round_mode == "full"
+
+    # invalidate() clears it too
+    sess.note_carryover(object())
+    assert sess.carryover is not None
+    sess.invalidate()
+    assert sess.carryover is None
+
+
+def test_shadow_sync_drops_drift_baseline():
+    """note_carryover with a stale taken_generation (a shadow sync landed
+    mid-round) drops the drift baseline: the carryover survives but the
+    next round's drift reads inf -> full round (conservative)."""
+    be = _backend(seed=4)
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    opt = _optimizer()
+    sess.sync()
+    _round(opt, sess)
+    _steady(opt, sess, lm, 6)
+    assert sess.carryover is not None
+
+    # emulate the shadow race: save a carryover against a generation that
+    # is no longer current
+    sess.note_carryover(sess.carryover,
+                        taken_generation=sess.sync_generation - 1)
+    _, r = _steady(opt, sess, lm, 7)
+    assert r.round_mode == "full"            # baseline dropped -> drift inf
+    _, r = _steady(opt, sess, lm, 8)
+    assert r.round_mode == "revalidated"     # re-established
+
+
+def test_chain_change_misses_memo():
+    """A different goal chain must not reuse the carried round."""
+    be = _backend(seed=6)
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    opt = _optimizer()
+    sess.sync()
+    _round(opt, sess)
+    _steady(opt, sess, lm, 6)
+    lm.sample_once(now_ms=7 * 300_000.0)
+    sess.sync()
+    r = opt.optimizations(None, session=sess,
+                          goal_names=GOALS[:2], raise_on_failure=False,
+                          skip_hard_goal_check=True)
+    assert r.round_mode == "full"
+
+
+def test_revalidate_off_runs_full_rounds():
+    be = _backend(seed=7)
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    opt = _optimizer({"analyzer.incremental.revalidate": False})
+    sess.sync()
+    _round(opt, sess)
+    _steady(opt, sess, lm, 6)
+    _, r = _steady(opt, sess, lm, 7)
+    assert r.round_mode == "full"
+    assert sess.revalidated_rounds == 0
+
+
+def test_seed_dirty_reduced_round_one_sided_parity():
+    """Dirty-set seeding (opt-in): a small-churn round runs reduced on the
+    goals the carried round left satisfied, with full-R fallback for any
+    reduced goal ending violated-unproven. Parity vs the seed-off path is
+    one-sided by construction: violations only shrink, certificates only
+    appear."""
+    results = {}
+    for label, extra in (("full", {}),
+                         ("reduced",
+                          {"analyzer.incremental.seed.dirty": True})):
+        be = _backend(seed=8)
+        lm = _monitored(be)
+        sess = ResidentClusterSession(lm)
+        opt = _optimizer(extra)
+        sess.sync()
+        _round(opt, sess)
+        # small churn: one leadership flip + one reassignment
+        info = be.partitions()[("t2", 2)]
+        be.elect_leaders({("t2", 2): info.replicas[-1]})
+        lm.sample_once(now_ms=6 * 300_000.0)
+        inf = sess.sync()
+        assert inf["churn"] > 0
+        results[label] = _round(opt, sess)
+
+    full, red = results["full"], results["reduced"]
+    assert full.round_mode == "full"
+    # the reduced round is reduced only if some goal was satisfied at the
+    # carried round's end; with this fixture at least one is
+    assert red.round_mode == "reduced"
+    assert any(g.mode == "reduced" for g in red.goal_results)
+    viol_full = set(full.violated_goals_after)
+    viol_red = set(red.violated_goals_after)
+    assert viol_red.issubset(viol_full), (viol_red, viol_full)
+    certs_full = {g.name for g in full.goal_results if g.fixpoint_proven}
+    certs_red = {g.name for g in red.goal_results if g.fixpoint_proven}
+    assert certs_full.issubset(certs_red), (certs_full, certs_red)
+
+
+def test_knob_toggles_add_zero_compiles():
+    """The parity contract's compile clause: with incremental enabled, the
+    seed.dirty and revalidate toggles are VALUE-only — after the masked
+    programs are warm, flipping either knob compiles nothing new."""
+    be = _backend(seed=9)
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    opt = _optimizer()
+    sess.sync()
+    r = _round(opt, sess)          # warms the masked chain (all-ones)
+    _steady(opt, sess, lm, 6)      # warms memo re-check priming
+
+    listener = opt._compile_listener
+    n0 = listener.count
+    # revalidate toggle: memo on (round 3) ...
+    _, r = _steady(opt, sess, lm, 7)
+    assert r.round_mode == "revalidated"
+    # ... then off: the full masked chain re-runs, same executables
+    opt._revalidate = False
+    _, r = _steady(opt, sess, lm, 8)
+    assert r.round_mode == "full"
+    opt._revalidate = True
+    # seed.dirty toggle: the dirty masks ride the SAME masked programs
+    opt._seed_dirty = True
+    info = be.partitions()[("t1", 1)]
+    be.elect_leaders({("t1", 1): info.replicas[-1]})
+    lm.sample_once(now_ms=9 * 300_000.0)
+    sess.sync()
+    r = _round(opt, sess)
+    # the reduced chain itself must add nothing; only a triggered full-R
+    # fallback may compile its per-goal program (first trigger only)
+    if r.fallback_goals == 0:
+        assert listener.count == n0, (listener.count, n0)
+    opt._seed_dirty = False
+    _, r = _steady(opt, sess, lm, 10)
+    assert r.round_mode in ("full", "revalidated")
+
+
+def test_dirty_replica_mask_targets_touched_sets():
+    """dirty_replica_mask flags exactly the replicas on dirty brokers or in
+    dirty topics, never the padding slots."""
+    be = _backend(seed=10)
+    lm = _monitored(be)
+    sess = ResidentClusterSession(lm)
+    sess.sync()
+    rb = sess._h["replica_broker"]
+    valid = sess._h["replica_valid"]
+
+    mask = sess.dirty_replica_mask({0}, set())
+    assert mask.dtype == bool and mask.shape == rb.shape
+    np.testing.assert_array_equal(mask, (rb == 0) & valid)
+    assert not mask[~valid].any()
+
+    mask_t = sess.dirty_replica_mask(set(), {0})
+    pt = np.asarray(sess._prev_snapshot.partition_topic)
+    rp = sess._h["replica_partition"]
+    in_topic0 = np.zeros_like(valid)
+    ok = (rp >= 0) & (rp < pt.size)
+    in_topic0[ok] = pt[rp[ok]] == 0
+    np.testing.assert_array_equal(mask_t, in_topic0 & valid)
+
+    assert not sess.dirty_replica_mask(set(), set()).any()

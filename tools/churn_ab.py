@@ -1,0 +1,233 @@
+"""Knob-grid A/B harness for incremental re-optimization (PR 16):
+
+    {analyzer.incremental.revalidate} x {analyzer.incremental.seed.dirty}
+      x churn in {0, low}
+
+per cell: a fresh resident session runs rebuild -> baseline -> (churn
+injection) -> measured steady round -> quiet round, reporting round modes,
+walls, XLA compiles, and the PARITY CONTRACT against the knobs-off
+reference cell of the same churn level:
+
+  - churn=0 + revalidate: the memo round's violation/certificate sets must
+    be IDENTICAL to the reference (the memo carries the full round's own
+    result — anything else is a soundness bug).
+  - churn=low + seed.dirty: one-sided by construction — violations may
+    only SHRINK vs the reference and certificates may only APPEAR (the
+    PR 13 escalation precedent; the full-R fallback enforces it).
+  - toggle-compile clause: every cell after the first must add ZERO new
+    XLA compiles, except a seed cell whose full-R fallback fired for the
+    first time (recorded as fallback_goals — the one legitimate first-
+    trigger compile).
+
+Violations of any clause are printed AND returned in the JSON
+(``parity_failures``); exit code 1 when any cell fails.
+
+Usage: churn_ab.py [small|r2] [--cells rv,sd;...] [--churn 0;low]
+  e.g.  churn_ab.py small
+        churn_ab.py r2 --cells on,off;on,on --churn 0
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_cc_tpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+
+import numpy as np  # noqa: E402
+
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer  # noqa: E402
+from cruise_control_tpu.analyzer.session import (  # noqa: E402
+    ResidentClusterSession,
+)
+from cruise_control_tpu.backend.simulated import (  # noqa: E402
+    SimulatedClusterBackend,
+)
+from cruise_control_tpu.config import cruise_control_config  # noqa: E402
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor  # noqa: E402
+from cruise_control_tpu.monitor.sampling.samplers import (  # noqa: E402
+    SimulatedMetricSampler,
+)
+
+SHAPES = {
+    "small": (60, 900),
+    "r2": (100, 5000),
+}
+
+
+def _backend(num_brokers: int, num_partitions: int):
+    rng = np.random.default_rng(3141)
+    be = SimulatedClusterBackend()
+    for b in range(num_brokers):
+        be.add_broker(b, f"r{b % 10}")
+    for p in range(num_partitions):
+        reps = [int(x) for x in rng.choice(num_brokers, size=2,
+                                           replace=False)]
+        be.create_partition(f"t{p % 50}", p, reps,
+                            size_mb=float(rng.exponential(200.0)),
+                            bytes_in_rate=float(rng.uniform(1, 50)),
+                            bytes_out_rate=float(rng.uniform(1, 100)),
+                            cpu_util=float(rng.uniform(0.1, 5)))
+    return be
+
+
+def _inject_low_churn(be, n_flips: int = 8) -> None:
+    """Deterministic small churn: flip leadership on the first n eligible
+    partitions (same backend seed => same flips in every cell)."""
+    flips = {}
+    for tp, pin in sorted(be.partitions().items()):
+        if len(flips) >= n_flips:
+            break
+        if len(pin.replicas) > 1 and pin.leader == pin.replicas[0]:
+            flips[tp] = pin.replicas[1]
+    be.elect_leaders(flips)
+
+
+def _sets(res):
+    viol = {g.name for g in res.goal_results if g.violated_after}
+    certs = {g.name for g in res.goal_results if g.fixpoint_proven}
+    return viol, certs
+
+
+def run_cell(shape, revalidate: bool, seed_dirty: bool, churn: str) -> dict:
+    num_brokers, num_partitions = shape
+    be = _backend(num_brokers, num_partitions)
+    lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    for i in range(5):
+        lm.sample_once(now_ms=i * 300_000.0)
+    cfg = cruise_control_config({
+        "analyzer.incremental.revalidate": revalidate,
+        "analyzer.incremental.seed.dirty": seed_dirty,
+    })
+    sess = ResidentClusterSession(lm, config=cfg)
+    opt = GoalOptimizer(config=cfg)
+    compiles0 = opt._compile_listener.count
+
+    def service_round(t):
+        lm.sample_once(now_ms=t * 300_000.0)
+        sess.sync()
+        t0 = time.monotonic()
+        r = opt.optimizations(None, session=sess, raise_on_failure=False,
+                              skip_hard_goal_check=True)
+        return r, time.monotonic() - t0
+
+    sess.sync()
+    opt.optimizations(None, session=sess, raise_on_failure=False,
+                      skip_hard_goal_check=True)       # rebuild (cold)
+    service_round(5)                                   # baseline
+    if churn == "low":
+        _inject_low_churn(be)
+    warm_compiles = opt._compile_listener.count
+    res, wall = service_round(6)                       # the measured round
+    quiet, quiet_wall = service_round(7)               # memo check
+    viol, certs = _sets(res)
+    return {
+        "cell": {"revalidate": revalidate, "seed_dirty": seed_dirty,
+                 "churn": churn},
+        "round_s": round(wall, 3),
+        "round_mode": res.round_mode,
+        "quiet_round_s": round(quiet_wall, 3),
+        "quiet_round_mode": quiet.round_mode,
+        "revalidate_s": round(res.revalidate_s, 4),
+        "revalidated_goals": sum(1 for g in res.goal_results
+                                 if g.mode == "revalidated"),
+        "reduced_goals": sum(1 for g in res.goal_results
+                             if g.mode == "reduced"),
+        "fallback_goals": res.fallback_goals,
+        "violated_goals_after": sorted(viol),
+        "fixpoint_proven": sorted(certs),
+        "num_replica_movements": res.num_replica_movements,
+        "compiles_total": opt._compile_listener.count - compiles0,
+        "compiles_measured_rounds": opt._compile_listener.count
+        - warm_compiles,
+    }
+
+
+def check_parity(cells: list) -> list:
+    """The parity contract, checked per churn level against the knobs-off
+    reference cell. Returns a list of failure strings (empty = pass)."""
+    failures = []
+    by_churn: dict = {}
+    for c in cells:
+        by_churn.setdefault(c["cell"]["churn"], []).append(c)
+    for churn, group in by_churn.items():
+        ref = next((c for c in group
+                    if not c["cell"]["revalidate"]
+                    and not c["cell"]["seed_dirty"]), None)
+        if ref is None:
+            continue
+        rv, rc = set(ref["violated_goals_after"]), set(ref["fixpoint_proven"])
+        for c in group:
+            if c is ref:
+                continue
+            name = (f"churn={churn} rv={int(c['cell']['revalidate'])} "
+                    f"sd={int(c['cell']['seed_dirty'])}")
+            cv = set(c["violated_goals_after"])
+            cc = set(c["fixpoint_proven"])
+            if c["round_mode"] == "revalidated":
+                # the memo carries the reference round's own sets
+                if cv != rv or cc != rc:
+                    failures.append(
+                        f"{name}: memo sets differ from reference "
+                        f"(viol {sorted(cv)} vs {sorted(rv)}, "
+                        f"certs {sorted(cc)} vs {sorted(rc)})")
+            else:
+                # one-sided: violations only shrink, certificates only
+                # appear
+                if not cv.issubset(rv):
+                    failures.append(f"{name}: NEW violations vs reference: "
+                                    f"{sorted(cv - rv)}")
+                if not rc.issubset(cc):
+                    failures.append(f"{name}: LOST certificates vs "
+                                    f"reference: {sorted(rc - cc)}")
+            # toggle-compile clause (cell 0 warms the programs)
+            if cells.index(c) > 0 and c["compiles_measured_rounds"] > 0 \
+                    and c["fallback_goals"] == 0:
+                failures.append(
+                    f"{name}: {c['compiles_measured_rounds']} new XLA "
+                    f"compiles on a warm knob toggle (no fallback fired)")
+    return failures
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    shape_name = argv[0] if argv and not argv[0].startswith("--") else "small"
+    shape = SHAPES[shape_name]
+    knob_cells = [(False, False), (True, False), (False, True), (True, True)]
+    if "--cells" in argv:
+        spec = argv[argv.index("--cells") + 1]
+        knob_cells = [(a == "on", b == "on")
+                      for a, b in (c.split(",") for c in spec.split(";"))]
+    churns = ["0", "low"]
+    if "--churn" in argv:
+        churns = argv[argv.index("--churn") + 1].split(";")
+    out = []
+    # knobs-off reference first per churn level: it warms every program the
+    # toggled cells are then required to reuse compile-free
+    for churn in churns:
+        for rv, sd in knob_cells:
+            cell = run_cell(shape, rv, sd, churn)
+            out.append(cell)
+            print(f"  churn={churn} rv={int(rv)} sd={int(sd)}: "
+                  f"{cell['round_s']}s mode={cell['round_mode']} "
+                  f"quiet={cell['quiet_round_mode']} "
+                  f"reval_goals={cell['revalidated_goals']} "
+                  f"reduced={cell['reduced_goals']} "
+                  f"fallback={cell['fallback_goals']} "
+                  f"compiles={cell['compiles_measured_rounds']}",
+                  file=sys.stderr, flush=True)
+    failures = check_parity(out)
+    for f in failures:
+        print(f"PARITY FAILURE: {f}", file=sys.stderr, flush=True)
+    print(json.dumps({"shape": shape_name, "cells": out,
+                      "parity_failures": failures}))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -43,7 +43,10 @@ DEFAULT_FIELDS = ("time_to_detect_ms", "time_to_heal_ms")
 # heavy-traffic item, heal on p95 like the campaign distributions
 JOURNAL_FIELDS = ("detect_to_heal_ms", "latency_ms")
 P99_FIELDS = ("latency_ms",)
-STEADY_FIELDS = ("round_s_steady", "round_s_pipelined")
+STEADY_FIELDS = ("round_s_steady", "round_s_pipelined",
+                 # PR 16: the zero-churn certificate-memo round is gated
+                 # like any other steady wall
+                 "round_s_revalidated")
 
 
 def extract_slo(doc: dict) -> dict:
@@ -121,6 +124,14 @@ def extract_steady(doc: dict) -> dict:
         if piped:
             row["round_s_pipelined"] = piped.get("round_s_pipelined")
             row["ab_identical_sets"] = piped.get("ab_identical_sets")
+        # PR 16 churn sweep: the zero-churn memo round's wall + whether the
+        # memo actually fired (0 goals re-executed)
+        if "round_s_revalidated" in rung:
+            row["round_s_revalidated"] = rung["round_s_revalidated"]
+        zero = (rung.get("churn_sweep") or {}).get("zero") or {}
+        if zero:
+            row["zero_churn_mode"] = zero.get("round_mode")
+            row["zero_churn_goals_reexecuted"] = zero.get("goals_reexecuted")
         out[rung.get("config", "?")] = row
     return out
 
@@ -154,6 +165,26 @@ def compare_steady(base: dict, cand: dict, threshold: float = 0.25):
                    "base_p95": 1, "cand_p95": 0,
                    "regression": "pipelined A/B lost violation/certificate "
                                  "set identity"}
+            regressions.append(row)
+            rows.append(row)
+        # PR 16: a zero-churn round that took the memo in the baseline but
+        # re-ran goals in the candidate is a regression — either the memo
+        # stopped firing (mode != revalidated) or it fired partially
+        if b.get("zero_churn_mode") == "revalidated" \
+                and c.get("zero_churn_mode") not in (None, "revalidated"):
+            row = {"kind": config, "field": "zero_churn_mode",
+                   "base_p95": 1, "cand_p95": 0,
+                   "regression": "zero-churn memo stopped firing "
+                                 f"(candidate mode: {c['zero_churn_mode']})"}
+            regressions.append(row)
+            rows.append(row)
+        bz = b.get("zero_churn_goals_reexecuted")
+        cz = c.get("zero_churn_goals_reexecuted")
+        if bz == 0 and (cz or 0) > 0:
+            row = {"kind": config, "field": "zero_churn_goals_reexecuted",
+                   "base_p95": bz, "cand_p95": cz,
+                   "regression": f"zero-churn round re-executed {cz} goals "
+                                 f"(baseline re-executed none)"}
             regressions.append(row)
             rows.append(row)
     return rows, regressions
@@ -205,6 +236,61 @@ def compare_fleet(base: dict, cand: dict, threshold: float = 0.25):
                              f"(batching degraded)"}
         regressions.append(row)
         rows.append(row)
+    return rows, regressions
+
+
+def extract_churn(doc: dict) -> dict:
+    """A tools/churn_ab.py document ({cells, parity_failures}), or {}."""
+    if isinstance(doc.get("cells"), list) and "parity_failures" in doc:
+        return doc
+    return {}
+
+
+def compare_churn(base: dict, cand: dict, threshold: float = 0.25):
+    """Gate two churn_ab.py knob-grid documents (PR 16): any candidate
+    parity failure (memo set identity lost, one-sided reduced/full parity
+    broken, warm knob toggle recompiled), a memo cell whose round no longer
+    revalidates, or a revalidated-cell wall beyond the threshold, all
+    fail."""
+    rows, regressions = [], []
+    for f in cand.get("parity_failures") or []:
+        row = {"kind": "churn_ab", "field": "parity", "base_p95": 0,
+               "cand_p95": 1, "regression": f}
+        regressions.append(row)
+        rows.append(row)
+
+    def key(c):
+        cell = c["cell"]
+        return (cell["churn"], bool(cell["revalidate"]),
+                bool(cell["seed_dirty"]))
+
+    bcells = {key(c): c for c in base.get("cells") or []}
+    for c in cand.get("cells") or []:
+        b = bcells.get(key(c))
+        if b is None:
+            continue
+        name = "churn={churn} rv={revalidate} sd={seed_dirty}".format(
+            **c["cell"])
+        if b.get("round_mode") == "revalidated" \
+                and c.get("round_mode") != "revalidated":
+            row = {"kind": name, "field": "round_mode", "base_p95": 1,
+                   "cand_p95": 0,
+                   "regression": "memo cell no longer revalidates "
+                                 f"(now {c.get('round_mode')})"}
+            regressions.append(row)
+            rows.append(row)
+        bw, cw = b.get("round_s"), c.get("round_s")
+        if b.get("round_mode") == "revalidated" and bw and cw \
+                and cw > bw * (1.0 + threshold):
+            row = {"kind": name, "field": "round_s", "base_p95": bw,
+                   "cand_p95": cw,
+                   "regression": f"revalidated round {cw:.3f}s > {bw:.3f}s "
+                                 f"* (1 + {threshold:g})"}
+            regressions.append(row)
+            rows.append(row)
+    if not rows:
+        rows.append({"kind": "churn_ab", "field": "parity", "base_p95": 0,
+                     "cand_p95": 0})
     return rows, regressions
 
 
@@ -279,6 +365,29 @@ def load_doc(path: str) -> tuple[dict, bool]:
         return json.loads(raw), False
     except json.JSONDecodeError:
         pass
+    # BENCH files are one JSON document per line (pretty block + compact
+    # final line); scan from the last line back and take the first
+    # parseable document, preferring one that carries rungs. JSONL event
+    # journals ALSO parse line-by-line — their per-event records carry a
+    # ``kind`` discriminator, so their presence routes the file to the
+    # journal path below instead of being mistaken for a bench document.
+    docs = []
+    journal_lines = False
+    for line in raw.strip().splitlines()[::-1]:
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(d, dict):
+            if "kind" in d or "span_kind" in d:
+                journal_lines = True
+            else:
+                docs.append(d)
+    for d in docs:
+        if d.get("rungs") or d.get("cells"):
+            return d, False
+    if docs and not journal_lines:
+        return docs[0], False
     import importlib.util
     import pathlib
     spec = importlib.util.spec_from_file_location(
@@ -337,6 +446,13 @@ def main(argv: list[str]) -> int:
         frows, fregs = compare_fleet(fbase, fcand, threshold)
         rows.extend(frows)
         regressions.extend(fregs)
+        compared = True
+    # ... and on the churn_ab knob grid (memo + reduced/full parity)
+    cbase, ccand = extract_churn(base_doc), extract_churn(cand_doc)
+    if cbase and ccand:
+        crows, cregs = compare_churn(cbase, ccand, threshold)
+        rows.extend(crows)
+        regressions.extend(cregs)
         compared = True
     # ... and on the HA rung (failover-time p95s / parity / adopt-not-abort)
     hbase, hcand = extract_ha(base_doc), extract_ha(cand_doc)

@@ -75,6 +75,13 @@ def render(trace: dict, width: int = 48) -> str:
             f" · wall {trace.get('wall_s', 0):.3f}s"
             f" · {trace.get('compiles', 0)} compiles"
             f" · profile={trace.get('profile_level', 'off')}")
+    # incremental rounds (PR 16): surface the memo / dirty-seeded modes and
+    # what the certificate re-check itself cost
+    mode = trace.get("round_mode") or "full"
+    if mode != "full":
+        head += f" · {mode}"
+        if trace.get("revalidate_s"):
+            head += f" ({trace['revalidate_s']:.3f}s re-check)"
     lines.append(head)
     parts = []
     if trace.get("sampling_s") is not None:
@@ -122,7 +129,10 @@ def render(trace: dict, width: int = 48) -> str:
         v = g.get(metric, 0) or 0
         flags = "".join((
             "V" if g.get("violated_after") else "·",
-            "v" if g.get("violated_before") else "·"))
+            "v" if g.get("violated_before") else "·",
+            # per-goal execution mode: R=revalidated (carried, not re-run),
+            # r=reduced (dirty-seeded candidates), ·=full
+            {"revalidated": "R", "reduced": "r"}.get(g.get("mode"), "·")))
         detail = (f"p={g.get('passes', 0):<4} w={g.get('waves', 0):<4} "
                   f"m={g.get('moves', 0)} l={g.get('leads', 0)} "
                   f"s={g.get('swaps', 0)} d={g.get('disk', 0)} "
