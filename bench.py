@@ -52,6 +52,12 @@ Usage: bench.py [rung ...] [--profile] [--skip-cold] [--scenario [name]]
                (detect-lease-loss / promote / first-proposal p50/p95,
                simulated ms), journal lag, adopted-task counts and the
                single-controller parity verdict — slo_diff gates it
+  --forecast   run the predictive-control rung (sim/catalog.py moving
+               diurnal + flash-crowd pair with forecasting enabled); emits
+               a "forecast" block with forecast_s (warm per-call wall of
+               the jitted vmapped forecaster), predicted / prevented /
+               reacted violation counts, time under violation and the
+               speculative proposal hit rate — tools/slo_diff.py gates it
   --fuzz [N]   with --campaign: run every episode with the seeded REST
                fuzzer + FaultyBackend attached (sim/api_fuzz.py, fuzz seed
                N, default 0); emits fuzz request/failure counts and writes
@@ -112,6 +118,7 @@ RUNG_COST_EST = {
     "campaign": (300, 120),
     "fleet": (300, 120),
     "ha": (260, 130),
+    "forecast": (180, 60),
 }
 
 
@@ -165,6 +172,7 @@ class Summary:
         self.campaign: dict | None = None   # chaos-campaign SLO distributions
         self.fleet: dict | None = None      # batched multi-tenant figures
         self.ha: dict | None = None         # HA failover SLOs + parity
+        self.forecast: dict | None = None   # predictive-control SLOs
         self.headline_requested = True      # set from the requested rung list
 
     def emit(self, final: bool = False) -> None:
@@ -196,6 +204,10 @@ class Summary:
                 metric = (f"HA failover campaign wall-clock "
                           f"({self.ha['name']}, leader kill mid-heal)")
                 value = self.ha["wall_s"]
+            elif self.forecast is not None:
+                metric = (f"predictive-control campaign wall-clock "
+                          f"({self.forecast['name']})")
+                value = self.forecast["wall_s"]
             elif ran:
                 metric = f"rebalance proposal wall-clock @ {ran[0]['config']}"
                 value = ran[0].get("wall_s")
@@ -233,6 +245,12 @@ class Summary:
             # adoption counts, adopt-not-abort, single-controller parity —
             # tools/slo_diff.py gates it (extract_ha / compare_ha)
             out["ha"] = self.ha
+        if self.forecast is not None:
+            # predictive-control block (sim/catalog.py moving pack):
+            # prevented-vs-reacted counts, time under violation, speculative
+            # proposal hit rate — slo_diff gates it (extract_forecast /
+            # compare_forecast)
+            out["forecast"] = self.forecast
         # pretty block first (humans + trace_view's whole-file parse of
         # BENCH_partial.json), then ONE compact machine-parseable line —
         # always the last stdout line, small enough that the driver's tail
@@ -501,6 +519,13 @@ def main() -> None:
         else:
             argv = argv[:i] + argv[i + 1:]
         argv.append("ha")
+    if "--forecast" in argv:
+        # --forecast: run the predictive-control rung — the moving diurnal +
+        # flash-crowd pair with forecasting enabled (prevented-vs-reacted
+        # counts, time under violation, speculative hit rate)
+        i = argv.index("--forecast")
+        argv = argv[:i] + argv[i + 1:]
+        argv.append("forecast")
     fuzz_seed = None
     if "--fuzz" in argv:
         # --fuzz [N]: run the campaign episodes with the REST fuzzer +
@@ -660,6 +685,12 @@ def main() -> None:
             # HA failover rung: leader kill mid-heal under the
             # two-controller runner -> failover SLOs + oracle parity
             rung = run_ha_rung(ha_campaign, campaign_seed)
+
+        elif rung_id == "forecast":
+            # predictive-control rung: moving diurnal + flash-crowd with
+            # forecasting on -> prevented/reacted counts, time under
+            # violation, speculative proposal hit rate
+            rung = run_forecast_rung(campaign_seed)
 
         elif rung_id == "e2e7k":
             # the full monitor path at HEADLINE scale: backend -> samples ->
@@ -1000,6 +1031,80 @@ def run_ha_rung(name: str = "ha-micro", seed: int = 0) -> dict:
         f"aborted={rung['aborted_by_failover']} "
         f"journal_lag={rung['journal_lag_events']} "
         f"parity_ok={rung['parity_ok']}, wall={wall}s")
+    return rung
+
+
+def run_forecast_rung(seed: int = 0) -> dict:
+    """Predictive-control rung (--forecast): run the moving-workload A/B
+    pair (diurnal sine + flash crowd, sim/catalog.py) with forecasting
+    enabled and report the prevented-vs-reacted story: how many violations
+    the pre-breach detector healed before the reactive detector ever saw
+    them, how many were breach-first heals, and the time the cluster spent
+    in violation. forecast_s is the forecaster's OWN warm per-call wall
+    (the jitted vmapped Holt/EWMA program at a representative bucket
+    shape) — the per-tick cost the control plane pays for prediction.
+    tools/slo_diff.py gates the emitted "forecast" block
+    (extract_forecast / compare_forecast)."""
+    from cruise_control_tpu.forecast.forecaster import forecast_batch
+    from cruise_control_tpu.monitor.metricdef import PARTITION_METRIC_DEF
+    from cruise_control_tpu.sim.campaign import (
+        aggregate_forecast, run_moving_workload_campaign,
+    )
+    import jax.numpy as jnp
+
+    names = ("moving-diurnal", "moving-flash-crowd")
+    log(f"rung forecast: predictive control plane ({', '.join(names)}, "
+        f"seed {seed})")
+    t0 = time.monotonic()
+    res = run_moving_workload_campaign(seed=seed, scenario_names=names)
+    wall = round(time.monotonic() - t0, 2)
+    fc = aggregate_forecast(res.episodes) or {}
+    failures = [f for r in res.episodes for f in r.failures]
+
+    # the forecaster's own wall: one jitted vmapped call at the shared
+    # compile-bucket partition shape (256 entities x 5 windows x M metrics);
+    # deterministic synthetic history — this times the program, not the data
+    M = PARTITION_METRIC_DEF.num_metrics
+    hist = (np.arange(256 * 5 * M, dtype=np.float32)
+            .reshape(256, 5, M) % np.float32(97.0))
+    wmask = np.ones((256, 5), bool)
+    knobs = (jnp.float32(0.45), jnp.float32(0.25), jnp.float32(0.5),
+             jnp.float32(5.0))
+    tc = time.monotonic()
+    np.asarray(forecast_batch(hist, wmask, *knobs))
+    forecast_cold_s = round(time.monotonic() - tc, 4)
+    tw = time.monotonic()
+    np.asarray(forecast_batch(hist, wmask, *knobs))
+    forecast_s = round(time.monotonic() - tw, 4)
+
+    rung = {
+        "config": f"forecast-moving-s{seed}",
+        "wall_s": wall,
+        "forecast_cold_s": forecast_cold_s,
+        "forecast_s": forecast_s,
+        "episodes": len(res.episodes),
+        "converged_episodes": sum(1 for r in res.episodes if r.converged),
+        "predicted_violations": fc.get("predicted_violations", 0),
+        "prevented_violations": fc.get("prevented_violations", 0),
+        "reacted_violations": fc.get("reacted_violations", 0),
+        "time_under_violation_ms": fc.get("time_under_violation_ms"),
+        "speculative_installs": fc.get("speculative_installs", 0),
+        "speculative_hits": fc.get("speculative_hits", 0),
+        "speculative_hit_rate": fc.get("speculative_hit_rate", 0.0),
+        "failures": failures,
+    }
+    # SUMMARY.forecast carries the full rollup (incl. the time-under-
+    # violation distribution) so slo_diff gates it without re-deriving
+    SUMMARY.forecast = dict(fc, name="moving-workload", seed=seed,
+                            wall_s=wall, forecast_s=forecast_s,
+                            forecast_cold_s=forecast_cold_s,
+                            failures=failures)
+    log(f"  [forecast] prevented={rung['prevented_violations']} "
+        f"predicted={rung['predicted_violations']} "
+        f"reacted={rung['reacted_violations']} "
+        f"tuv={rung['time_under_violation_ms']}ms "
+        f"spec_hit_rate={rung['speculative_hit_rate']} "
+        f"forecast={forecast_s}s (cold {forecast_cold_s}s), wall={wall}s")
     return rung
 
 

@@ -591,6 +591,32 @@ class GoalOptimizer:
         return np.asarray([bool(rx.fullmatch(t)) for t in meta.topic_names],
                           bool)
 
+    def violated_goals(self, ct: ClusterTensor, meta: ClusterMeta,
+                       goal_names: list[str] | None = None,
+                       options: OptimizationOptions = OptimizationOptions(),
+                       ) -> list[str]:
+        """Names of the goals violated on ``ct`` AS-IS — no optimization, no
+        proposals: pad to the shared shape bucket, upload, and run the
+        lru-cached compiled ``violated()`` batch program once. This is the
+        predicted-violation detector's pre-breach guard (is the *current*
+        state still clean?) and the sim's time-under-violation probe; on the
+        steady path it reuses the same compiled program every call."""
+        names = goal_names or self._default_goal_names
+        known = [n for n in names if n != "PreferredLeaderElectionGoal"]
+        goals = make_goals(known, self._constraint, options)
+        ct, meta = pad_cluster(ct, meta)
+        tml = self._min_leader_mask(meta, None)
+        if tml is not None and tml.shape[0] < ct.num_topics:
+            tml = np.pad(tml, (0, ct.num_topics - tml.shape[0]))
+        part_table = padded_partition_table(ct)
+        env = make_env(ct, meta, topic_min_leaders_mask=tml,
+                       partition_table=part_table,
+                       compact=self._compact_tables)
+        st = init_state(env, ct.replica_broker, ct.replica_is_leader,
+                        ct.replica_offline, ct.replica_disk)
+        viol = jax.device_get(_compiled_violations(tuple(goals))(env, st))
+        return [g.name for g, v in zip(goals, viol) if bool(v)]
+
     def _optimizations(self, ct, meta, goal_names, options,
                        skip_hard_goal_check, raise_on_failure,
                        measure_goal_durations,

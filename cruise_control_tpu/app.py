@@ -21,7 +21,7 @@ from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerResult
 from cruise_control_tpu.config.defaults import cruise_control_config, effective_default_goals
 from cruise_control_tpu.detector.detectors import (
     BrokerFailureDetector, DiskFailureDetector, GoalViolationDetector,
-    SlowBrokerFinder,
+    PredictedGoalViolationDetector, SlowBrokerFinder,
 )
 from cruise_control_tpu.detector.maintenance import (
     IdempotenceCache, TopicMaintenanceEventReader,
@@ -214,6 +214,15 @@ class CruiseControl:
         self._proposal_cache: OptimizerResult | None = None
         self._proposal_cache_generation = None
         self._proposal_cache_ms: float = -1.0   # computation time (backend clock)
+        # speculative precompute accounting (forecast subsystem): a
+        # speculative install stamps _spec_generation with the cache
+        # generation it rode in on; the first fresh cache hit at that
+        # generation counts as a speculative hit, a refresh that replaces
+        # it before any hit counts as stale (the prediction didn't hold)
+        self._spec_installs = 0
+        self._spec_hits = 0
+        self._spec_stale = 0
+        self._spec_generation = None
         self._cache_lock = threading.Lock()
         # one party refreshes at a time; readers fall back to waiting on it
         self._refresh_lock = threading.Lock()
@@ -249,6 +258,15 @@ class CruiseControl:
             max_added_brokers=self.config.get_int(
                 "provision.max.added.brokers"))
         self.provisioner = provisioner
+        allow_est = self.config.get_boolean(
+            "anomaly.detection.allow.capacity.estimation")
+        # detection rounds ride the resident session when one exists: a
+        # zero-churn re-check (the CHECK-verdict loop) then re-serves the
+        # PR 16 carried verdicts after one compiled violation re-validation
+        session_supplier = None
+        if self.config.get_boolean("anomaly.detection.use.resident.session"):
+            session_supplier = (lambda: self._usable_session(
+                None, False, False, allow_capacity_estimation=allow_est))
         goal_vd = GoalViolationDetector(
             self.goal_optimizer, self.load_monitor,
             self.config.get_list("anomaly.detection.goals"),
@@ -256,8 +274,8 @@ class CruiseControl:
             provision_floors=ProvisionFloors.from_config(self.config),
             sensors=self.sensors,
             anomaly_cls=self.config.get_class("goal.violations.class"),
-            allow_capacity_estimation=self.config.get_boolean(
-                "anomaly.detection.allow.capacity.estimation"))
+            allow_capacity_estimation=allow_est,
+            session_supplier=session_supplier)
         slow = SlowBrokerFinder()
         slow.configure(self.config)
         # metric.anomaly.finder.class (MetricAnomalyFinder SPI): percentile
@@ -333,6 +351,42 @@ class CruiseControl:
                               if not idem.seen_before(
                                   f"{e.plan_type}:{e.brokers}:{e.topics}", now)],
                  interval_ms=base_ms)
+
+        # predictive control plane (forecast.enabled): vmapped workload
+        # forecaster over the monitor's zero-copy window view + the
+        # pre-breach goal-violation detector. After each forecast heal the
+        # fix path refreshes the /proposals cache speculatively
+        # (refresh_speculative_proposals) — the existing generation rules
+        # drop it as stale if the prediction does not hold.
+        self.forecaster = None
+        self.predicted_goal_violation_detector = None
+        self.speculative_proposals_enabled = False
+        # cached at wiring for the sim runner's per-tick SLO probe — the
+        # baseline leg of a prevented-vs-reacted A/B tracks time under
+        # violation with forecasting itself OFF
+        self.forecast_slo_tracking = self.config.get_boolean(
+            "forecast.slo.tracking.enabled")
+        if self.config.get_boolean("forecast.enabled"):
+            from cruise_control_tpu.forecast import (ForecastKnobs,
+                                                     WorkloadForecaster)
+            knobs = ForecastKnobs(
+                alpha=self.config.get_double("forecast.ewma.alpha"),
+                beta=self.config.get_double("forecast.trend.beta"),
+                blend=self.config.get_double("forecast.blend"),
+                horizon_ms=self.config.get_int("forecast.horizon.ms"),
+                max_scale=self.config.get_double("forecast.max.scale"))
+            self.forecaster = WorkloadForecaster(self.load_monitor, knobs)
+            self.speculative_proposals_enabled = self.config.get_boolean(
+                "forecast.speculative.proposals")
+            pred = PredictedGoalViolationDetector(
+                self.goal_optimizer, self.load_monitor, self.forecaster,
+                self.config.get_list("anomaly.detection.goals"),
+                sensors=self.sensors,
+                allow_capacity_estimation=allow_est)
+            self.predicted_goal_violation_detector = pred
+            register("PredictedGoalViolationDetector", pred.run_once,
+                     interval_ms=interval(
+                         "predicted.goal.violation.detection.interval.ms"))
 
     def start_up(self, proposal_precompute: bool = False) -> None:
         """Monitor replay + (optionally) the background proposal-precompute
@@ -690,6 +744,73 @@ class CruiseControl:
                 "leadership moves)", operation, reason, len(res.proposals),
                 res.num_replica_movements, res.num_leadership_movements)
         return op
+
+    def execute_precomputed(self, res, operation: str = "EXECUTE_PRECOMPUTED",
+                            reason: str = "precomputed proposals",
+                            self_healing: bool = False,
+                            parent_span=None) -> dict:
+        """Execute an already-computed :class:`OptimizerResult` through the
+        normal operation-span -> pipeline/executor path, WITHOUT a fresh
+        optimization round.
+
+        The predicted-goal-violation fix rides this: its proposals were
+        optimized against the forecast-horizon model, so re-optimizing the
+        current (still clean) state would discard them for a no-op. Span
+        lineage matches `_run_optimization`'s execute half exactly — the
+        operation span parents the executor phases (or rides the pipeline's
+        sticky execute stage when fixes route async)."""
+        self._check_writable(operation)
+        self.flight_recorder.note_operation(operation)
+        op_span = self.tracer.span("operation", operation, parent=parent_span,
+                                   reason=reason, dry_run=False,
+                                   precomputed=True)
+        op = OperationResult(operation=operation, reason=reason,
+                             optimizer_result=res)
+        routed = False
+        if res.proposals:
+            try:
+                sizes = {tp: info.size_mb
+                         for tp, info in self.backend.partitions().items()}
+            except Exception:
+                sizes = {}
+            kw = {"context": {"partition_size_mb": sizes,
+                              "operation": f"{operation}: {reason}"}}
+            if self_healing and self._route_fixes_async():
+                self.service_pipeline.submit_execution(
+                    res.proposals,
+                    execute_kw={**kw, "parent_span": op_span}, sticky=True)
+                op.executed = True
+                routed = True
+                self.sensors.meter("pipeline-routed-fixes").mark()
+            else:
+                try:
+                    self.executor.execute_proposals(res.proposals,
+                                                    parent_span=op_span, **kw)
+                except Exception as e:
+                    op_span.end(error=type(e).__name__,
+                                proposals=len(res.proposals))
+                    raise
+                op.executed = True
+        op_span.end(executed=op.executed, routed=routed,
+                    proposals=len(res.proposals))
+        self._ops_history.append({"operation": operation, "reason": reason,
+                                  "ms": self._now_ms(),
+                                  "numProposals": len(res.proposals),
+                                  "executed": op.executed})
+        for observer in self.optimization_observers:
+            try:
+                observer(operation, reason, res, op.executed)
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "optimization observer failed for %s", operation)
+        if op.executed:
+            from cruise_control_tpu.common.sensors import OPERATION_LOGGER
+            OPERATION_LOGGER.info(
+                "%s (%s): executed %d proposals (%d replica moves, %d "
+                "leadership moves)", operation, reason, len(res.proposals),
+                res.num_replica_movements, res.num_leadership_movements)
+        return op.to_json()
 
     # ---------------------------------------------------------- operations
     def rebalance(self, goal_names=None, dry_run: bool = False,
@@ -1134,6 +1255,52 @@ class CruiseControl:
             self._proposal_cache_ms = (computed_ms if computed_ms is not None
                                        else self._now_ms())
 
+    def refresh_speculative_proposals(self) -> None:
+        """Speculative proposal precompute (forecast subsystem): right after
+        a forecast heal lands, recompute proposals ONCE on the just-healed
+        state and stamp the install speculative. If the prediction holds —
+        no generation bump before the next /proposals read — the cached
+        result serves instantly (a speculative hit). If the world moves
+        first, the existing generation rules drop it as stale; no
+        special-case invalidation is needed."""
+        try:
+            self._cached_proposals_fresh(force_refresh=True)
+        except Exception:
+            return   # degraded boundary: no speculation, the read decides
+        with self._cache_lock:
+            self._spec_installs += 1
+            self._spec_generation = self._proposal_cache_generation
+        self.sensors.meter("speculative-proposals-installed").mark()
+
+    def speculative_pending(self) -> bool:
+        """True while a speculative install awaits its first /proposals
+        read — the read that decides hit (generation held) vs stale."""
+        with self._cache_lock:
+            return self._spec_generation is not None
+
+    def _note_speculative_hit(self) -> None:
+        with self._cache_lock:
+            if (self._spec_generation is not None
+                    and self._proposal_cache_generation
+                    == self._spec_generation):
+                self._spec_hits += 1
+                self._spec_generation = None
+                self.sensors.meter("speculative-proposals-hit").mark()
+
+    def _note_speculative_stale(self) -> None:
+        with self._cache_lock:
+            if self._spec_generation is not None:
+                self._spec_stale += 1
+                self._spec_generation = None
+                self.sensors.meter("speculative-proposals-stale").mark()
+
+    def speculative_state_json(self) -> dict:
+        with self._cache_lock:
+            installs, hits, stale = (self._spec_installs, self._spec_hits,
+                                     self._spec_stale)
+        return {"installs": installs, "hits": hits, "stale": stale,
+                "hitRate": round(hits / max(installs, 1), 3)}
+
     def _cached_proposals_fresh(self, force_refresh: bool = False,
                                 goal_names=None,
                                 excluded_topics: str | None = None) -> OptimizerResult:
@@ -1160,12 +1327,17 @@ class CruiseControl:
 
         hit = fresh()
         if hit is not None:
+            self._note_speculative_hit()
             return hit
         with self._refresh_lock:
             # the precompute thread may have refreshed while we waited
             hit = fresh()
             if hit is not None:
+                self._note_speculative_hit()
                 return hit
+            # a pending speculative install that forced a recompute was a
+            # missed prediction — the generation moved before it was served
+            self._note_speculative_stale()
             computed_ms = self._now_ms()
             # generation is read BEFORE the (multi-second at scale) model
             # build: a concurrent sampling tick bumping it mid-build must
@@ -1240,6 +1412,14 @@ class CruiseControl:
         if "PIPELINE" in substates and self.service_pipeline is not None:
             # the continuous pipelined loop's stage/backpressure state
             out["PipelineState"] = self.service_pipeline.state_json()
+        if "FORECAST" in substates:
+            fstate = {"enabled": self.forecaster is not None}
+            if self.forecaster is not None:
+                fstate.update(self.forecaster.state_json())
+                fstate["detector"] = \
+                    self.predicted_goal_violation_detector.state_json()
+                fstate["speculative"] = self.speculative_state_json()
+            out["ForecastState"] = fstate
         if self.ha is not None:
             # always present when an HA role is attached: clients routing
             # writes need the role regardless of which substates they asked

@@ -299,6 +299,23 @@ class SimulatedClusterBackend:
                 self._c_update(tp)
             self._meta_gen += 1
 
+    def scale_rack_load(self, factor: float, rack: str) -> None:
+        """Fault injection: multiply the cpu/bytes rates of every partition
+        with a replica on ``rack``'s brokers — a correlated failure-domain
+        surge (one rack's tenants get hot together). Like
+        :meth:`scale_partition_load`, load only; disk size untouched."""
+        with self._lock:
+            rack_brokers = {b for b, info in self._brokers.items()
+                            if info.rack == rack}
+            for tp, info in self._partitions.items():
+                if rack_brokers.isdisjoint(info.replicas):
+                    continue
+                info.cpu_util *= factor
+                info.bytes_in_rate *= factor
+                info.bytes_out_rate *= factor
+                self._c_update(tp)
+            self._meta_gen += 1
+
     def decommission_broker(self, broker_id: int) -> None:
         """Remove an EMPTY broker from the cluster (the provisioner's
         OVER_PROVISIONED actuation; the reference delegates this to a cloud

@@ -779,6 +779,15 @@ _D.define(name="goal.violation.detection.interval.ms", type=Type.LONG, default=-
 _D.define(name="metric.anomaly.detection.interval.ms", type=Type.LONG, default=-1)
 _D.define(name="disk.failure.detection.interval.ms", type=Type.LONG, default=-1)
 _D.define(name="topic.anomaly.detection.interval.ms", type=Type.LONG, default=-1)
+_D.define(name="predicted.goal.violation.detection.interval.ms", type=Type.LONG, default=-1,
+          doc="Cadence of the forecast-driven pre-breach detector; "
+              "-1 = use anomaly.detection.interval.ms.")
+_D.define(name="anomaly.detection.use.resident.session", type=Type.BOOLEAN, default=True,
+          doc="Route GoalViolationDetector rounds through the synced resident "
+              "session when one is enabled: repeated zero-churn re-checks then "
+              "ride the incremental revalidation memo (one compiled violation "
+              "re-check re-serves the carried verdicts) instead of re-running "
+              "the full goal chain.")
 _D.define(name="broker.failure.detection.backoff.ms", type=Type.LONG, default=300_000)
 _D.define(name="anomaly.notifier.class", type=Type.CLASS,
           default="cruise_control_tpu.detector.notifier.SelfHealingNotifier",
@@ -806,6 +815,40 @@ _D.define(name="disk.failures.self.healing.enabled", type=Type.BOOLEAN, default=
 _D.define(name="metric.anomaly.self.healing.enabled", type=Type.BOOLEAN, default=None)
 _D.define(name="topic.anomaly.self.healing.enabled", type=Type.BOOLEAN, default=None)
 _D.define(name="maintenance.event.self.healing.enabled", type=Type.BOOLEAN, default=None)
+_D.define(name="predicted.goal.violations.self.healing.enabled", type=Type.BOOLEAN, default=None,
+          doc="Tri-state like the other per-type switches: whether PREDICTED "
+              "goal-violation verdicts may execute their precomputed heal "
+              "before the breach exists.")
+# --------------------------------------------------------------------------
+# Predictive control plane (forecast/, DESIGN §21)
+# --------------------------------------------------------------------------
+_D.define(name="forecast.enabled", type=Type.BOOLEAN, default=False,
+          doc="Master switch for the predictive control plane: the workload "
+              "forecaster + PredictedGoalViolationDetector.")
+_D.define(name="forecast.horizon.ms", type=Type.LONG, default=300_000,
+          validator=at_least(1),
+          doc="How far ahead the forecaster projects each partition's load.")
+_D.define(name="forecast.ewma.alpha", type=Type.DOUBLE, default=0.45,
+          validator=between(0.0, 1.0),
+          doc="Level/EWMA smoothing weight (traced leaf: no recompile).")
+_D.define(name="forecast.trend.beta", type=Type.DOUBLE, default=0.25,
+          validator=between(0.0, 1.0),
+          doc="Holt trend smoothing weight (traced leaf: no recompile).")
+_D.define(name="forecast.blend", type=Type.DOUBLE, default=0.5,
+          validator=between(0.0, 1.0),
+          doc="Weight of the Holt (level+trend) term vs the flat EWMA term.")
+_D.define(name="forecast.max.scale", type=Type.DOUBLE, default=8.0,
+          validator=at_least(1.0),
+          doc="Clamp on predicted forecast/current load ratios — a noisy "
+              "series cannot project an unbounded surge.")
+_D.define(name="forecast.speculative.proposals", type=Type.BOOLEAN, default=True,
+          doc="Install the predicted-violation heal as the speculative "
+              "proposal cache, keyed on the model generation at install "
+              "time; the existing staleness rules drop it if the "
+              "prediction does not hold.")
+_D.define(name="forecast.slo.tracking.enabled", type=Type.BOOLEAN, default=False,
+          doc="Sim-only: probe goal violations each tick to measure "
+              "time-under-violation and prevented-vs-reacted SLOs.")
 _D.define(name="broker.failure.alert.threshold.ms", type=Type.LONG, default=900_000,
           doc="SelfHealingNotifier grace: alert after this long.")
 _D.define(name="broker.failure.self.healing.threshold.ms", type=Type.LONG, default=1_800_000,

@@ -18,13 +18,19 @@ import time
 
 
 class AnomalyType(enum.IntEnum):
-    """Smaller value = higher handling priority (KafkaAnomalyType.java:32-42)."""
+    """Smaller value = higher handling priority (KafkaAnomalyType.java:32-42).
+
+    PREDICTED_GOAL_VIOLATION is ours (no reference analogue): a goal breach
+    the forecast subsystem expects within the horizon but which does not
+    exist yet. Deliberately the LOWEST priority — every real, present
+    anomaly heals before a speculative one."""
     BROKER_FAILURE = 0
     MAINTENANCE_EVENT = 1
     DISK_FAILURE = 2
     METRIC_ANOMALY = 3
     GOAL_VIOLATION = 4
     TOPIC_ANOMALY = 5
+    PREDICTED_GOAL_VIOLATION = 6
 
 
 _seq = itertools.count()
@@ -104,6 +110,38 @@ class GoalViolations(Anomaly):
             self_healing=True, triggered_by_goal_violation=True,
             reason=f"self-healing goal violation: {self.violated_goals_fixable}",
             parent_span=self.fix_span)
+
+
+@dataclasses.dataclass
+class PredictedGoalViolations(Anomaly):
+    """A forecast-horizon goal breach that does not exist yet.
+
+    Unlike :class:`GoalViolations` the fix does NOT re-optimize the current
+    (still clean) state — that round would be a no-op. The detector already
+    optimized the forecast-scaled model when it emitted this anomaly; the
+    fix executes those precomputed proposals through the facade's normal
+    operation-span -> pipeline/executor path, so the heal lands BEFORE the
+    breach with full span lineage."""
+    violated_goals_fixable: list = dataclasses.field(default_factory=list)
+    violated_goals_unfixable: list = dataclasses.field(default_factory=list)
+    optimizer_result: object = None   # OptimizerResult on the forecast state
+    forecast_generation: tuple = ()   # (load_generation, num_windows) stamp
+    horizon_ms: int = 0
+
+    def fix(self, cruise_control):
+        if not self.violated_goals_fixable or self.optimizer_result is None:
+            return None
+        out = cruise_control.execute_precomputed(
+            self.optimizer_result, operation="forecast_heal",
+            reason=(f"pre-breach heal, predicted violation in "
+                    f"{self.horizon_ms} ms: {self.violated_goals_fixable}"),
+            self_healing=True, parent_span=self.fix_span)
+        if cruise_control.speculative_proposals_enabled:
+            # speculative precompute: the post-heal state is the best guess
+            # at the next /proposals answer — install it now, stamped; the
+            # generation rules drop it if the prediction does not hold
+            cruise_control.refresh_speculative_proposals()
+        return out
 
 
 @dataclasses.dataclass

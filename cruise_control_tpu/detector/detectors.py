@@ -19,7 +19,8 @@ import os
 import numpy as np
 
 from cruise_control_tpu.detector.anomalies import (
-    AnomalyType, BrokerFailures, DiskFailures, GoalViolations, SlowBrokers,
+    AnomalyType, BrokerFailures, DiskFailures, GoalViolations,
+    PredictedGoalViolations, SlowBrokers,
 )
 from cruise_control_tpu.detector.provisioner import (
     ProvisionRecommendation, ProvisionStatus,
@@ -30,7 +31,8 @@ class GoalViolationDetector:
     def __init__(self, goal_optimizer, load_monitor, detection_goals: list,
                  provisioner=None, provision_floors=None, sensors=None,
                  anomaly_cls=GoalViolations,
-                 allow_capacity_estimation: bool = True):
+                 allow_capacity_estimation: bool = True,
+                 session_supplier=None):
         self._optimizer = goal_optimizer
         self._monitor = load_monitor
         self._goals = list(detection_goals)
@@ -39,6 +41,12 @@ class GoalViolationDetector:
         # goal.violations.class: pluggable anomaly materialization
         self._anomaly_cls = anomaly_cls
         self._allow_capacity_estimation = allow_capacity_estimation
+        # optional () -> ResidentClusterSession | None: with a synced resident
+        # session the detection round rides the PR 16 IncrementalCarryover
+        # machinery — a zero-churn re-check re-serves the carried verdicts
+        # after one compiled violation re-validation instead of re-running
+        # the full goal chain (the CHECK-verdict fast path)
+        self._session_supplier = session_supplier
         self.last_balancedness: float = 100.0
         self.last_provision: ProvisionRecommendation | None = None
         if sensors is not None:
@@ -61,9 +69,19 @@ class GoalViolationDetector:
     def _run_once(self, now_ms: float) -> list:
         from cruise_control_tpu.analyzer.env import OptimizationOptions
         from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
+        # A synced resident session (when wired) both skips the model
+        # rebuild AND makes repeated detection rounds memo-eligible: same
+        # goal chain + same options = stable chain_key, so a zero-churn
+        # re-check returns the PR 16 revalidated carryover after one
+        # compiled violation re-validation instead of a full chain run.
+        session = None
+        ct = meta = None
         try:
-            ct, meta = self._monitor.cluster_model(
-                allow_capacity_estimation=self._allow_capacity_estimation)
+            if self._session_supplier is not None:
+                session = self._session_supplier()
+            if session is None:
+                ct, meta = self._monitor.cluster_model(
+                    allow_capacity_estimation=self._allow_capacity_estimation)
         except NotEnoughValidWindowsError:
             return []   # not enough data yet — detector skips this round
         # raise_on_failure=False: the detector *assesses* violations — an
@@ -71,7 +89,8 @@ class GoalViolationDetector:
         res = self._optimizer.optimizations(
             ct, meta, goal_names=self._goals,
             options=OptimizationOptions(triggered_by_goal_violation=True),
-            skip_hard_goal_check=True, raise_on_failure=False)
+            skip_hard_goal_check=True, raise_on_failure=False,
+            session=session)
         self.last_balancedness = res.balancedness_before
         fixable = [g.name for g in res.goal_results
                    if g.violated_before and not g.violated_after]
@@ -98,6 +117,113 @@ class GoalViolationDetector:
             violated_goals_fixable=fixable, violated_goals_unfixable=unfixable,
             fixable=bool(fixable),
             description=f"violated goals fixable={fixable} unfixable={unfixable}")]
+
+
+class PredictedGoalViolationDetector:
+    """Pre-breach goal-violation detection (docs/DESIGN.md §21).
+
+    Each round: read the forecaster's horizon-ahead projection; when it
+    predicts rising load AND the current state is still clean, materialize a
+    forecast-horizon model (the current ClusterTensor with per-partition
+    load rows scaled by the predicted forecast/current ratios) and run the
+    SAME detection goal chain against it. A violation on the projected state
+    — none on the current one — emits a PREDICTED verdict carrying the
+    optimizer's precomputed heal, which the manager schedules through the
+    normal verdict-span -> operation -> pipeline execute path BEFORE the
+    breach exists.
+
+    Steady path (no predicted rise, or the forecast generation already
+    handled): returns after one memoized forecast read — no model build, no
+    optimizer work, zero new compiles."""
+
+    def __init__(self, goal_optimizer, load_monitor, forecaster,
+                 detection_goals: list, sensors=None,
+                 allow_capacity_estimation: bool = True):
+        self._optimizer = goal_optimizer
+        self._monitor = load_monitor
+        self._forecaster = forecaster
+        self._goals = list(detection_goals)
+        self._allow_capacity_estimation = allow_capacity_estimation
+        self.predictions = 0           # PREDICTED verdicts emitted
+        self.rounds = 0
+        self.last_predicted: list = []
+        self._last_emitted_gen = None  # one verdict per forecast generation
+        if sensors is not None:
+            sensors.gauge("predicted-goal-violations", lambda: self.predictions)
+            self._detection_timer = sensors.timer(
+                "predicted-goal-violation-detection-timer")
+        else:
+            from cruise_control_tpu.common.sensors import Timer
+            self._detection_timer = Timer()
+
+    def run_once(self, now_ms: float) -> list:
+        with self._detection_timer.time():
+            return self._run_once(now_ms)
+
+    @staticmethod
+    def forecast_scaled(ct, meta, fres):
+        """The forecast-horizon model: ``ct`` with every replica's load rows
+        scaled by its partition's predicted per-resource ratio. Topology,
+        capacities and leadership are untouched — the projection moves load,
+        not metadata."""
+        import dataclasses as _dc
+        P = ct.num_partitions
+        scale_p = np.ones((P, ct.leader_load.shape[1]))
+        row_of = {e: i for i, e in enumerate(fres.entities)}
+        for pi, tp in enumerate(meta.partition_ids):
+            r = row_of.get(tp)
+            if r is not None:
+                scale_p[pi] = fres.scale[r]
+        rep_scale = scale_p[np.asarray(ct.replica_partition)].astype(np.float32)
+        return _dc.replace(
+            ct,
+            leader_load=np.asarray(ct.leader_load) * rep_scale,
+            follower_load=np.asarray(ct.follower_load) * rep_scale)
+
+    def _run_once(self, now_ms: float) -> list:
+        from cruise_control_tpu.analyzer.env import OptimizationOptions
+        from cruise_control_tpu.monitor.load_monitor import NotEnoughValidWindowsError
+        self.rounds += 1
+        fres = self._forecaster.forecast()
+        if fres is None or not fres.rising:
+            return []    # steady path: memoized forecast read, nothing else
+        if fres.generation == self._last_emitted_gen:
+            return []    # this forecast generation already produced a verdict
+        try:
+            ct, meta = self._monitor.cluster_model(
+                allow_capacity_estimation=self._allow_capacity_estimation)
+        except NotEnoughValidWindowsError:
+            return []
+        options = OptimizationOptions(triggered_by_goal_violation=True)
+        # pre-breach guard: an ALREADY-violated state belongs to the reactive
+        # detector — predicting what exists would double-heal
+        if self._optimizer.violated_goals(ct, meta, self._goals, options):
+            return []
+        res = self._optimizer.optimizations(
+            self.forecast_scaled(ct, meta, fres), meta,
+            goal_names=self._goals, options=options,
+            skip_hard_goal_check=True, raise_on_failure=False)
+        fixable = [g.name for g in res.goal_results
+                   if g.violated_before and not g.violated_after]
+        unfixable = [g.name for g in res.goal_results
+                     if g.violated_before and g.violated_after]
+        self.last_predicted = fixable + unfixable
+        if not fixable and not unfixable:
+            return []
+        self._last_emitted_gen = fres.generation
+        self.predictions += 1
+        return [PredictedGoalViolations(
+            anomaly_type=AnomalyType.PREDICTED_GOAL_VIOLATION,
+            detected_ms=now_ms,
+            violated_goals_fixable=fixable, violated_goals_unfixable=unfixable,
+            optimizer_result=res, forecast_generation=fres.generation,
+            horizon_ms=fres.horizon_ms, fixable=bool(fixable),
+            description=(f"predicted violation within {fres.horizon_ms} ms: "
+                         f"fixable={fixable} unfixable={unfixable}"))]
+
+    def state_json(self) -> dict:
+        return {"rounds": self.rounds, "predictions": self.predictions,
+                "lastPredicted": list(self.last_predicted)}
 
 
 class BrokerFailureDetector:

@@ -69,6 +69,8 @@ class SelfHealingNotifier:
                 AnomalyType.METRIC_ANOMALY: "metric.anomaly.self.healing.enabled",
                 AnomalyType.TOPIC_ANOMALY: "topic.anomaly.self.healing.enabled",
                 AnomalyType.MAINTENANCE_EVENT: "maintenance.event.self.healing.enabled",
+                AnomalyType.PREDICTED_GOAL_VIOLATION:
+                    "predicted.goal.violations.self.healing.enabled",
             }
             for t, key in per_type.items():
                 explicit = config.get(key)

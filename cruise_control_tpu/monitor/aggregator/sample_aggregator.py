@@ -276,6 +276,28 @@ class MetricSampleAggregator:
             self._agg_cache[num_windows] = result
             return result
 
+    def window_view(self, num_windows: int | None = None
+                    ) -> tuple[AggregationResult, int]:
+        """Zero-copy windowed history view: ``(result, generation)``.
+
+        Hands out the memoized :class:`AggregationResult` arrays directly —
+        no re-copy — stamped with the generation they were computed under, so
+        a consumer (the forecaster) can key its own caches on the stamp and
+        skip recompute entirely while no new window has rolled. The pair is
+        read under one lock acquisition: the stamp can never describe a
+        different ring state than the arrays. Callers must treat the arrays
+        as immutable."""
+        with self._lock:
+            gen = self._generation
+            if self._dirty:
+                self._agg_cache.clear()
+                self._dirty = False
+            cached = self._agg_cache.get(num_windows)
+            if cached is None:
+                cached = self._aggregate_locked(num_windows)
+                self._agg_cache[num_windows] = cached
+            return cached, gen
+
     def _aggregate_locked(self, num_windows: int | None = None) -> AggregationResult:
         """Full aggregation pass; caller holds the lock."""
         W = min(num_windows or self._num_windows, self._num_windows)

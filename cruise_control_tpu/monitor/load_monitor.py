@@ -517,6 +517,16 @@ class LoadMonitor:
                                  if self._backend else -1),
             load_generation=self._partition_agg.generation)
 
+    def partition_window_view(self):
+        """Zero-copy ``(AggregationResult, load_generation)`` over the
+        partition aggregator's completed-window history — the forecast
+        subsystem's read seam. The arrays are the aggregator's own memoized
+        buffers (f64[E, W, M] values + u8[E, W] extrapolations), handed out
+        without copying so a per-tick consumer costs nothing while no new
+        window has rolled; consumers key their caches on the stamp and must
+        not mutate the arrays."""
+        return self._partition_agg.window_view()
+
     @property
     def num_valid_windows(self) -> int:
         return len(self._partition_agg.aggregate().window_starts_ms)

@@ -451,6 +451,34 @@ def aggregate_failover(episode_results: list) -> dict:
     }
 
 
+def aggregate_forecast(episode_results: list) -> dict:
+    """Predictive-control SLO rollup over the episodes that tracked it
+    (forecast.* scenarios): summed prevented/reacted/predicted heal counts,
+    total + per-episode time-under-violation, and the speculative proposal
+    hit rate. Empty when no episode carried forecast data."""
+    eps = [r for r in episode_results
+           if r.forecast or r.time_under_violation_ms is not None]
+    if not eps:
+        return {}
+    tuv = [r.time_under_violation_ms for r in eps
+           if r.time_under_violation_ms is not None]
+    spec_installs = sum(r.forecast.get("speculative", {}).get("installs", 0)
+                        for r in eps)
+    spec_hits = sum(r.forecast.get("speculative", {}).get("hits", 0)
+                    for r in eps)
+    return {
+        "episodes": len(eps),
+        "predicted_violations": sum(r.predicted_violations for r in eps),
+        "prevented_violations": sum(r.prevented_violations for r in eps),
+        "reacted_violations": sum(r.reacted_violations for r in eps),
+        "time_under_violation_ms": sum(tuv) if tuv else None,
+        "time_under_violation_dist": _dist(tuv),
+        "speculative_installs": spec_installs,
+        "speculative_hits": spec_hits,
+        "speculative_hit_rate": round(spec_hits / max(spec_installs, 1), 3),
+    }
+
+
 def aggregate_slos(episode_results: list) -> dict:
     """Per-fault-kind SLO distributions (nearest-rank p50/p95/max) over
     every episode of a campaign."""
@@ -533,6 +561,8 @@ class CampaignResult:
             "failures": self.failures,
             **({"failover": fo}
                if (fo := aggregate_failover(self.episodes)) else {}),
+            **({"forecast": fc}
+               if (fc := aggregate_forecast(self.episodes)) else {}),
         }
 
     def episode_log_json(self) -> dict:
@@ -596,6 +626,25 @@ class CampaignRunner:
 
 def run_campaign(spec, seed: int = 0) -> CampaignResult:
     return CampaignRunner(spec, seed=seed).run()
+
+
+def run_moving_workload_campaign(seed: int = 0,
+                                 scenario_names=None) -> CampaignResult:
+    """The predictive-control measurement rung: run the moving-workload
+    scenario pack (sim/catalog.py — diurnal sine, flash crowd, hotspot
+    drift, correlated rack surge) with forecasting ON, so the campaign
+    document carries prevented-vs-reacted counts and time-under-violation
+    as first-class SLOs (``to_json()["forecast"]``). Deterministic per
+    (scenario set, seed) like every other campaign."""
+    from cruise_control_tpu.sim import catalog
+    from cruise_control_tpu.sim.runner import ScenarioRunner
+    names = list(scenario_names or ("moving-diurnal", "moving-flash-crowd",
+                                    "moving-hotspot-drift",
+                                    "moving-rack-surge"))
+    scenarios = [catalog.SCENARIOS[n] for n in names]
+    episodes = [ScenarioRunner(sc, seed=seed).run() for sc in scenarios]
+    return CampaignResult(name="moving-workload", seed=seed,
+                          episodes=episodes, scenarios=scenarios)
 
 
 # ------------------------------------------------------------------ catalog

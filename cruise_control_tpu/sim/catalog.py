@@ -17,8 +17,8 @@ from __future__ import annotations
 
 from cruise_control_tpu.sim.scenario import (
     ClusterSpec, Scenario, broker_death, clear_slow_broker, disk_failure,
-    load_surge, maintenance_event, metric_gap, rf_drop, slow_broker,
-    topic_creation,
+    load_surge, maintenance_event, metric_gap, rack_surge, rf_drop,
+    slow_broker, topic_creation,
 )
 
 GV_OFF = ("goal.violation.detection.interval.ms", 10_000_000_000)
@@ -198,10 +198,138 @@ COMPOUND_CASCADE = Scenario(
     expect_empty_brokers=(2,),
 )
 
+# ------------------------------------------------------ moving workloads
+# The predictive-control scenario pack: load PROFILES instead of step
+# faults. Events are emitted as ratio-factor surges (the backend API is
+# multiplicative), every minute so each metric window sees one step of the
+# profile — a coherent trend the forecaster can extrapolate. Capacity is
+# calibrated low on NW_IN (like UNDER_PROVISION_SURGE) but the surge hits a
+# topic/rack SUBSET: the breach is an imbalance a rebalance fixes, so both
+# the reactive heal (baseline) and the pre-breach predicted heal
+# (forecast.enabled) have real work, and campaigns can score
+# prevented-vs-reacted counts + time-under-violation per mode.
+
+
+def _profile_events(levels, topics=None, every_ms=60_000.0, offset_ms=0.0):
+    """Absolute load profile [lvl0, lvl1, ...] (multiples of the base load,
+    one step per metric window) -> ratio-factor load_surge events."""
+    evs, prev = [], 1.0
+    for i, level in enumerate(levels):
+        evs.append(load_surge(offset_ms + i * every_ms,
+                              round(level / prev, 6), topics=topics))
+        prev = level
+    return tuple(evs)
+
+
+# forecast-on control plane: detection goals with calibrated NW_IN capacity,
+# predictive detector each minute, sim-side ground-truth SLO probe on
+_FORECAST_CFG = (
+    ("forecast.enabled", True),
+    ("forecast.horizon.ms", 300_000),
+    ("forecast.slo.tracking.enabled", True),
+    ("predicted.goal.violation.detection.interval.ms", 60_000),
+    ("goal.violation.detection.interval.ms", 120_000),
+    ("anomaly.detection.goals",
+     "NetworkInboundCapacityGoal,DiskCapacityGoal,ReplicaDistributionGoal"),
+    # calibrated so the hottest broker crosses the 0.8 utilization line at
+    # ~2.2x of the surged topic's base load — LATE in every profile's ramp
+    # (the forecaster has 3+ windows of visible trend by then), while the
+    # per-broker AVERAGE at peak stays under the line, keeping the breach
+    # rebalance-fixable rather than a provisioning deficit
+    ("default.broker.capacity.nw.in", 3000.0),
+)
+
+# two diurnal half-cycles on t0 (sine-shaped, peak 2.5x, 20 min period)
+_DIURNAL_LEVELS = (1.0, 1.38, 1.75, 2.06, 2.31, 2.45, 2.5, 2.45, 2.31,
+                   2.06, 1.75, 1.38, 1.0, 1.0,
+                   1.0, 1.38, 1.75, 2.06, 2.31, 2.45, 2.5, 2.45, 2.31,
+                   2.06, 1.75, 1.38, 1.0)
+
+MOVING_DIURNAL = Scenario(
+    name="moving-diurnal",
+    cluster=_SMALL,
+    events=_profile_events(_DIURNAL_LEVELS, topics=["t0"]),
+    duration_ms=3_600_000.0,
+    tick_ms=15_000.0,
+    config=_FORECAST_CFG,
+    expects_heal=True,
+    settle_ticks=2,
+)
+
+# flash crowd: a building ramp to 2.6x on t0, a 4-minute plateau, fast
+# decay — the early sub-breach windows are the forecaster's signal
+_FLASH_LEVELS = (1.0, 1.15, 1.35, 1.6, 1.9, 2.2, 2.6, 2.6, 2.6, 2.6,
+                 1.8, 1.2, 1.0)
+
+MOVING_FLASH_CROWD = Scenario(
+    name="moving-flash-crowd",
+    cluster=_SMALL,
+    events=_profile_events(_FLASH_LEVELS, topics=["t0"]),
+    duration_ms=2_400_000.0,
+    tick_ms=15_000.0,
+    config=_FORECAST_CFG,
+    expects_heal=True,
+    settle_ticks=2,
+)
+
+# hotspot drift: the surge MOVES across topics — t0 ramps hot then cools
+# while t1 ramps, then t2. The forecaster must track per-entity trends
+# (a global trend would cancel out).
+MOVING_HOTSPOT_DRIFT = Scenario(
+    name="moving-hotspot-drift",
+    cluster=ClusterSpec(num_brokers=12, num_racks=3,
+                        topics=(("t0", 40, 2), ("t1", 40, 2), ("t2", 40, 2))),
+    events=(_profile_events((1.0, 1.5, 2.0, 2.4, 2.4, 1.6, 1.0),
+                            topics=["t0"])
+            + _profile_events((1.0, 1.5, 2.0, 2.4, 2.4, 1.6, 1.0),
+                              topics=["t1"], offset_ms=300_000.0)
+            + _profile_events((1.0, 1.5, 2.0, 2.4, 2.4, 1.6, 1.0),
+                              topics=["t2"], offset_ms=600_000.0)),
+    duration_ms=2_700_000.0,
+    tick_ms=15_000.0,
+    config=_FORECAST_CFG,
+    expects_heal=True,
+    settle_ticks=2,
+)
+
+# correlated rack-level surge: every partition replicated on rack r1 heats
+# together (ratio steps compound to ~2.3x, then decay) — the failure-domain
+# pattern where many entities trend up in lockstep
+MOVING_RACK_SURGE = Scenario(
+    name="moving-rack-surge",
+    cluster=_SMALL,
+    events=tuple(rack_surge(i * 60_000.0, f, "r1")
+                 for i, f in enumerate((1.15, 1.15, 1.15, 1.15, 1.15, 1.15,
+                                        1.0, 1.0,
+                                        0.869565, 0.869565, 0.869565,
+                                        0.869565, 0.869565, 0.869565))),
+    duration_ms=2_400_000.0,
+    tick_ms=15_000.0,
+    config=_FORECAST_CFG,
+    expects_heal=True,
+    settle_ticks=2,
+)
+
+# tier-1 smoke: the shortest profile that still yields a PREDICTED verdict —
+# rides the shared 12-broker compile bucket like broker-death-smoke
+FORECAST_SMOKE = Scenario(
+    name="forecast-smoke",
+    cluster=_SMALL,
+    events=_profile_events((1.0, 1.45, 1.9, 2.3, 2.6, 2.6), topics=["t0"]),
+    duration_ms=1_200_000.0,
+    tick_ms=15_000.0,
+    config=_FORECAST_CFG,
+    expects_heal=True,
+    expect_detect_types=("PREDICTED_GOAL_VIOLATION",),
+    settle_ticks=2,
+)
+
 SCENARIOS = {
     s.name: s for s in (
         BROKER_DEATH_SMOKE, BROKER_DEATH_50B, DISK_FAILURE, SLOW_BROKER,
         METRIC_GAP, MAINTENANCE_REMOVE, TOPIC_CREATION, TOPIC_RF_REPAIR,
         UNDER_PROVISION_SURGE, COMPOUND_CASCADE,
+        MOVING_DIURNAL, MOVING_FLASH_CROWD, MOVING_HOTSPOT_DRIFT,
+        MOVING_RACK_SURGE, FORECAST_SMOKE,
     )
 }

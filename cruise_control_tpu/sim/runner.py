@@ -103,6 +103,20 @@ class ScenarioResult:
     # lease-loss / promote / first-proposal latencies from the leader-kill
     # instant, plus adopted-task counts — all on SIMULATED time
     failover: dict = dataclasses.field(default_factory=dict)
+    # predictive-control SLOs (forecast subsystem): counts derived from the
+    # deterministic timeline — predicted heals executed, reactive
+    # GOAL_VIOLATION heals executed, and predicted heals after which no
+    # real breach was ever detected (= prevented). time_under_violation_ms
+    # comes from the per-tick goal probe (forecast.slo.tracking.enabled
+    # only; None otherwise) — ticks with >=1 violated detection goal on the
+    # ground-truth model, times tick_ms.
+    predicted_violations: int = 0
+    reacted_violations: int = 0
+    prevented_violations: int = 0
+    time_under_violation_ms: float | None = None
+    # /state?substates=FORECAST snapshot at run end (forecaster, detector
+    # and speculative-precompute counters)
+    forecast: dict = dataclasses.field(default_factory=dict)
     # final ground-truth assignment {"topic-p": {"leader", "replicas"}} —
     # the campaign's failover-parity check compares this against a single-
     # controller run of the same (scenario, seed). Excluded from to_json()
@@ -153,6 +167,13 @@ class ScenarioResult:
             "failures": list(self.failures),
             **({"pipeline": self.pipeline} if self.pipeline else {}),
             **({"failover": self.failover} if self.failover else {}),
+            **({"predicted_violations": self.predicted_violations,
+                "reacted_violations": self.reacted_violations,
+                "prevented_violations": self.prevented_violations,
+                "time_under_violation_ms": self.time_under_violation_ms,
+                "forecast": self.forecast}
+               if self.forecast or self.time_under_violation_ms is not None
+               else {}),
         }
 
 
@@ -236,6 +257,10 @@ class ScenarioRunner:
         # part of the reproducible episode record.
         self._attach_verifier(self.cc)
         self._provision_cursor = 0
+        # forecast.slo.tracking.enabled: per-tick ground-truth goal probe
+        # behind time_under_violation_ms (sim-only; off by default)
+        self._slo_track = self.cc.forecast_slo_tracking
+        self._tuv_ticks = 0
 
     def _attach_verifier(self, cc) -> None:
         """Verify every optimization ``cc`` runs (the HA runner attaches
@@ -322,6 +347,8 @@ class ScenarioRunner:
             be.shrink_replicas(p["topic"], p["target_rf"])
         elif ev.kind == "load_surge":
             be.scale_partition_load(p["factor"], topics=p.get("topics"))
+        elif ev.kind == "rack_surge":
+            be.scale_rack_load(p["factor"], p["rack"])
         elif ev.kind == "maintenance_event":
             # ADD_BROKER plans name hardware the operator has racked but the
             # service hasn't balanced onto yet: materialize it in the backend
@@ -395,6 +422,16 @@ class ScenarioRunner:
                 # schedules run here, racing detector heals in sim time
                 self._tick_hook(self, self._now())
             now = self._now()   # a FIX execution advances simulated time
+            if self._slo_track:
+                self._probe_violation(now)
+                if self.cc.speculative_pending():
+                    # a forecast heal left a speculative install behind:
+                    # the next /proposals read decides hit (generation
+                    # held — served instantly) vs stale (world moved first)
+                    try:
+                        self.cc.cached_proposals()
+                    except Exception:
+                        pass  # degraded read: the counters already settled
             viol = invariants.check_tick(self.truth, self.cc.executor)
             if viol:
                 self.result.invariant_violations.extend(
@@ -434,6 +471,23 @@ class ScenarioRunner:
         self._record_provision_actions()
         for h in ad.handle_anomalies(now):
             self._record_handled(h, self._now())
+
+    def _probe_violation(self, now: float) -> None:
+        """Ground-truth SLO probe (forecast.slo.tracking.enabled): does the
+        CURRENT state violate any detection goal this tick? Violated ticks
+        accumulate into time_under_violation_ms — the metric predictive
+        heals must shrink versus the reactive baseline. Read-only: one
+        memoized model build + one compiled violation check, never an
+        optimization round."""
+        from cruise_control_tpu.monitor.load_monitor import \
+            NotEnoughValidWindowsError
+        try:
+            ct, meta = self.cc.load_monitor.cluster_model()
+        except NotEnoughValidWindowsError:
+            return
+        goals = self.cc.config.get_list("anomaly.detection.goals")
+        if self.cc.goal_optimizer.violated_goals(ct, meta, goals):
+            self._tuv_ticks += 1
 
     def _record_provision_actions(self) -> None:
         """Fold Provisioner.rightsize actuations (SimulatedProvisioner
@@ -591,6 +645,29 @@ class ScenarioRunner:
         r.journal = self.cc.journal.lines()
         if self.pipe is not None:
             r.pipeline = self.pipe.state_json()
+        # predictive-control SLOs, derived from the deterministic timeline:
+        # a predicted heal PREVENTED a breach iff no real GOAL_VIOLATION was
+        # ever detected at-or-after it (the reactive detector never had to
+        # react to what the forecast healed ahead of time)
+        pred_heals = [e for e in r.timeline
+                      if e["kind"] == "anomaly"
+                      and e["type"] == "PREDICTED_GOAL_VIOLATION"
+                      and e.get("fix", {}).get("executed")]
+        gv_detections = [e["detected_t"] for e in r.timeline
+                         if e["kind"] == "anomaly"
+                         and e["type"] == "GOAL_VIOLATION"]
+        r.predicted_violations = len(pred_heals)
+        r.reacted_violations = sum(
+            1 for e in r.timeline
+            if e["kind"] == "anomaly" and e["type"] == "GOAL_VIOLATION"
+            and e.get("fix", {}).get("executed"))
+        r.prevented_violations = sum(
+            1 for e in pred_heals
+            if not any(t >= e["detected_t"] for t in gv_detections))
+        if self._slo_track:
+            r.time_under_violation_ms = round(self._tuv_ticks * sc.tick_ms, 1)
+        if self.cc.forecaster is not None or self._slo_track:
+            r.forecast = self.cc.state_json(["FORECAST"])["ForecastState"]
         self.cc.shutdown()
 
 

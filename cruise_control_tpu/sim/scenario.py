@@ -97,6 +97,14 @@ def load_surge(at_ms: float, factor: float, topics=None) -> ScenarioEvent:
                           "topics": sorted(topics) if topics else None})
 
 
+def rack_surge(at_ms: float, factor: float, rack: str) -> ScenarioEvent:
+    """Multiply cpu/network load on every partition replicated on ``rack``'s
+    brokers — a correlated failure-domain surge (a rack-local traffic shift)
+    the forecaster should see coming as a coherent rising trend."""
+    return ScenarioEvent(at_ms, "rack_surge",
+                         {"factor": float(factor), "rack": str(rack)})
+
+
 def maintenance_event(at_ms: float, plan_type: str, brokers=(),
                       topics=None) -> ScenarioEvent:
     """Spool an operator maintenance plan (MaintenanceEventDetector path)."""

@@ -614,6 +614,9 @@ def test_every_canonical_key_is_consumed(tmp_path):
             "sample.store.path": tmp,
             "maintenance.event.topic.path": f"{tmp}/maint.jsonl",
             "two.step.verification.enabled": True,
+            # predictive control plane (PR 17): the forecast wiring reads
+            # the forecast.* knob family + the predicted-detector cadence
+            "forecast.enabled": True,
             "broker.failure.alert.threshold.ms": 0,
             "broker.failure.self.healing.threshold.ms": 0,
             "num.metrics.windows": 2,

@@ -1,0 +1,328 @@
+"""Predictive control plane (forecast subsystem): forecaster determinism +
+vmap parity + traced-knob compile stability, the zero-copy window-view seam,
+the forecast-smoke scenario (PREDICTED verdicts heal BEFORE the breach, span
+lineage complete, byte-identical reruns, warm rerun adds zero compiles), the
+detector CHECK path riding the PR 16 revalidation memo, and the campaign /
+slo_diff forecast SLO plumbing. The full moving-workload prevention A/B
+(predictive prevents >=50% of the violations the reactive baseline merely
+heals) is the slow-tier quality proof."""
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.forecast import (
+    ForecastKnobs, WorkloadForecaster, forecast_batch, forecast_reference,
+)
+
+# ------------------------------------------------------- forecaster kernel
+
+
+def _history(seed=0, E=7, W=5, M=4, holes=True):
+    rng = np.random.default_rng(seed)
+    vals = rng.uniform(0.0, 100.0, size=(E, W, M)).astype(np.float32)
+    mask = np.ones((E, W), bool)
+    if holes:
+        # NO_VALID_EXTRAPOLATION holes: leading, trailing and interior
+        mask[0, 0] = False
+        mask[1, -1] = False
+        mask[2, 2] = False
+        mask[3, :] = False          # a series with no valid window at all
+    return vals, mask
+
+
+def test_forecast_batch_bit_identical_repeat():
+    """Pure function of the history — same input => identical BITS, twice
+    in-process and across fresh device arrays (no RNG anywhere)."""
+    vals, mask = _history()
+    import jax.numpy as jnp
+    knobs = (jnp.float32(0.45), jnp.float32(0.25), jnp.float32(0.5),
+             jnp.float32(5.0))
+    a = np.asarray(forecast_batch(vals, mask, *knobs))
+    b = np.asarray(forecast_batch(vals.copy(), mask.copy(), *knobs))
+    assert a.tobytes() == b.tobytes()
+
+
+def test_vmapped_forecast_matches_per_series_reference():
+    """The jitted vmap-over-entities/metrics program == the python
+    per-series Holt/EWMA loop, masked holes included."""
+    vals, mask = _history(seed=3)
+    import jax.numpy as jnp
+    got = np.asarray(forecast_batch(
+        vals, mask, jnp.float32(0.45), jnp.float32(0.25), jnp.float32(0.5),
+        jnp.float32(5.0)))
+    want = forecast_reference(vals, mask, 0.45, 0.25, 0.5, 5.0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    # the all-holes series forecasts 0 (never seen), not garbage
+    assert (got[3] == 0.0).all()
+
+
+def test_knob_toggles_add_zero_new_compiles():
+    """alpha/beta/blend/horizon are TRACED leaves: after one warm call per
+    [E, W, M] shape, any knob change re-runs the same compiled program."""
+    from cruise_control_tpu.common.tracing import count_compiles
+    import jax.numpy as jnp
+    vals, mask = _history(seed=5)
+    forecast_batch(vals, mask, jnp.float32(0.45), jnp.float32(0.25),
+                   jnp.float32(0.5), jnp.float32(5.0))   # warm the shape
+    with count_compiles() as cnt:
+        for alpha, beta, blend, hw in ((0.9, 0.1, 0.2, 2.0),
+                                       (0.2, 0.5, 0.8, 20.0),
+                                       (0.45, 0.25, 0.5, 1.0)):
+            forecast_batch(vals, mask, jnp.float32(alpha), jnp.float32(beta),
+                           jnp.float32(blend), jnp.float32(hw))
+    assert cnt.count == 0
+
+
+# -------------------------------------------- monitor window-view seam
+
+
+def _monitored_backend(seed=0, rounds=6):
+    from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+    from cruise_control_tpu.monitor.sampling.samplers import (
+        SimulatedMetricSampler,
+    )
+    rng = np.random.default_rng(seed)
+    be = SimulatedClusterBackend()
+    for b in range(6):
+        be.add_broker(b, f"r{b % 3}")
+    for p in range(30):
+        reps = [int(x) for x in rng.choice(6, size=2, replace=False)]
+        be.create_partition(f"t{p % 3}", p, reps,
+                            size_mb=float(rng.uniform(10, 500)),
+                            bytes_in_rate=float(rng.uniform(1, 50)),
+                            bytes_out_rate=float(rng.uniform(1, 100)),
+                            cpu_util=float(rng.uniform(0.1, 5)))
+    lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    for i in range(rounds):
+        lm.sample_once(now_ms=i * 300_000.0)
+    return be, lm
+
+
+def test_window_view_is_zero_copy_and_generation_stamped():
+    """Per-tick reads while no new window rolled hand out the SAME memoized
+    arrays (identity, not equality) under the same generation stamp; a new
+    window moves the stamp."""
+    be, lm = _monitored_backend()
+    agg1, gen1 = lm.partition_window_view()
+    agg2, gen2 = lm.partition_window_view()
+    assert agg1.values is agg2.values
+    assert agg1.extrapolations is agg2.extrapolations
+    assert gen1 == gen2
+    lm.sample_once(now_ms=6 * 300_000.0)
+    _, gen3 = lm.partition_window_view()
+    assert gen3 != gen1
+
+
+def test_forecaster_memoizes_per_generation_and_projects_a_ramp():
+    """The forecaster memo keys on (generation, knobs): same window state =>
+    cache hit returning the SAME result object; on a rising series the
+    horizon projection exceeds the window mean (scale > 1, rising=True)."""
+    be, lm = _monitored_backend()
+    # drive a clean ramp: scale all loads up each sampling round
+    for i in range(6, 10):
+        be.scale_partition_load(1.3)
+        lm.sample_once(now_ms=i * 300_000.0)
+    fc = WorkloadForecaster(lm, ForecastKnobs(horizon_ms=600_000))
+    r1 = fc.forecast()
+    r2 = fc.forecast()
+    assert r1 is r2 and fc.cache_hits == 1 and fc.forecasts_computed == 1
+    assert r1.rising
+    assert float(r1.max_scale_per_resource().max()) > 1.02
+    # knob change invalidates the memo (new math), not the program
+    fc.set_knobs(ForecastKnobs(horizon_ms=60_000))
+    r3 = fc.forecast()
+    assert r3 is not r1 and fc.forecasts_computed == 2
+
+
+# ------------------------------- detector CHECK path rides the PR 16 memo
+
+
+def test_goal_violation_check_rides_revalidation_memo():
+    """Satellite (a): with a synced resident session supplied, repeated
+    zero-churn detection rounds re-serve the carried verdicts through the
+    IncrementalCarryover memo — one compiled violation re-check instead of
+    a full chain run (session.revalidated_rounds advances)."""
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.analyzer.session import ResidentClusterSession
+    from cruise_control_tpu.config import cruise_control_config
+    from cruise_control_tpu.detector.detectors import GoalViolationDetector
+    goals = ["ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+    be, lm = _monitored_backend()
+    sess = ResidentClusterSession(lm)
+    opt = GoalOptimizer(config=cruise_control_config(
+        {"goals": ",".join(goals), "hard.goals": "ReplicaCapacityGoal"}))
+    det = GoalViolationDetector(opt, lm, goals,
+                                session_supplier=lambda: sess)
+    assert sess.sync()["mode"] == "rebuild"
+    det.run_once(0.0)                       # rebuilt round: full
+    lm.sample_once(now_ms=6 * 300_000.0)
+    sess.sync()
+    det.run_once(1.0)                       # establishes the drift baseline
+    assert sess.revalidated_rounds == 0
+    lm.sample_once(now_ms=7 * 300_000.0)
+    sess.sync()
+    det.run_once(2.0)                       # zero churn -> memo fires
+    assert sess.revalidated_rounds == 1
+
+
+# ------------------------------------------------ forecast-smoke scenario
+
+
+@pytest.fixture(scope="module")
+def forecast_smoke_runs():
+    """The forecast-smoke scenario twice with the same seed; the second run
+    is wrapped in a compile counter — same shapes + warm program caches mean
+    the steady predictive path must add ZERO new XLA compiles."""
+    from cruise_control_tpu.common.tracing import count_compiles
+    from cruise_control_tpu.sim.catalog import SCENARIOS
+    from cruise_control_tpu.sim.runner import run_scenario
+    sc = SCENARIOS["forecast-smoke"]
+    r1 = run_scenario(sc, seed=0)
+    with count_compiles() as cnt:
+        r2 = run_scenario(sc, seed=0)
+    return r1, r2, cnt.count
+
+
+def test_smoke_predicts_and_heals_before_breach(forecast_smoke_runs):
+    r, _, _ = forecast_smoke_runs
+    r.assert_ok()
+    assert r.converged
+    pred = [e for e in r.timeline if e["kind"] == "anomaly"
+            and e["type"] == "PREDICTED_GOAL_VIOLATION"]
+    assert pred and any(e.get("fix", {}).get("executed") for e in pred)
+    # the pre-breach story: at least one predicted heal landed with NO
+    # reactive GOAL_VIOLATION ever firing at/after it
+    assert r.predicted_violations >= 1
+    assert r.prevented_violations >= 1
+    # SLO tracking measured the run (zero time in violation on the smoke)
+    assert r.time_under_violation_ms == 0.0
+
+
+def test_smoke_forecast_state_block(forecast_smoke_runs):
+    """The FORECAST substate rides the result document: forecaster figures,
+    detector counters and the speculative cache protocol's verdicts."""
+    r, _, _ = forecast_smoke_runs
+    f = r.forecast
+    assert f["enabled"] is True
+    assert f["forecastsComputed"] >= 1
+    assert f["detector"]["predictions"] >= 1
+    spec = f["speculative"]
+    assert spec["installs"] >= 1
+    # the runner's /proposals poll after each predicted heal settles every
+    # pending install into a hit (prediction held) or a stale drop
+    assert spec["hits"] + spec["stale"] == spec["installs"]
+    assert spec["hits"] >= 1 and spec["hitRate"] > 0.0
+
+
+def test_smoke_bit_identical_and_zero_steady_compiles(forecast_smoke_runs):
+    """Same (scenario, seed) => bit-identical result; the warm rerun —
+    forecasting enabled the whole way — compiled NOTHING new."""
+    r1, r2, compiles = forecast_smoke_runs
+    assert r1.timeline == r2.timeline
+    assert r1.to_json() == r2.to_json()
+    assert r1.journal == r2.journal
+    assert compiles == 0
+
+
+def test_smoke_predicted_span_tree_complete(forecast_smoke_runs):
+    """PR 12 lineage: the PREDICTED verdict is a complete orphan-free tree
+    in the journal — verdict root -> forecast_heal operation -> optimize +
+    execution spans."""
+    from cruise_control_tpu.common.tracing import build_trace_trees
+    r, _, _ = forecast_smoke_runs
+    events = [json.loads(line) for line in r.journal]
+    spans = [e for e in events if e["kind"] == "span"]
+    trees = build_trace_trees(spans)
+    pred = [t for t in trees if t["roots"]
+            and t["roots"][0]["span_kind"] == "verdict"
+            and t["roots"][0]["name"] == "PREDICTED_GOAL_VIOLATION"]
+    assert pred, "no PREDICTED_GOAL_VIOLATION verdict tree in the journal"
+    tree = pred[0]
+    assert not tree["orphans"]
+    v = tree["roots"][0]
+    assert v["attrs"]["executed"] is True
+    ops = [c for c in v["children"] if c["span_kind"] == "operation"]
+    assert ops and ops[0]["name"] == "forecast_heal"
+    kinds = {c["span_kind"] for c in ops[0]["children"]}
+    assert "execution" in kinds
+    execution = next(c for c in ops[0]["children"]
+                     if c["span_kind"] == "execution")
+    assert v["t1"] >= execution["t1"] >= execution["t0"] >= v["t0"]
+
+
+# ------------------------------------------- campaign + slo_diff plumbing
+
+
+def test_aggregate_forecast_rollup_and_compare_gate():
+    """aggregate_forecast sums the per-episode story; compare_forecast
+    fails a candidate that prevents fewer / reacts more / sits longer in
+    violation, and passes an equal-or-better one."""
+    import importlib.util
+    import pathlib
+    from cruise_control_tpu.sim.campaign import aggregate_forecast
+    from cruise_control_tpu.sim.runner import ScenarioResult
+
+    def ep(prevented, reacted, tuv):
+        return ScenarioResult(
+            name="x", seed=0, predicted_violations=prevented,
+            prevented_violations=prevented, reacted_violations=reacted,
+            time_under_violation_ms=tuv, forecast={"enabled": True,
+            "speculative": {"installs": 2, "hits": 1, "stale": 1}})
+
+    base = aggregate_forecast([ep(2, 0, 0.0), ep(1, 1, 30_000.0)])
+    assert base["prevented_violations"] == 3
+    assert base["reacted_violations"] == 1
+    assert base["time_under_violation_ms"] == 30_000.0
+    assert base["speculative_installs"] == 4
+    assert base["speculative_hit_rate"] == 0.5
+
+    spec = importlib.util.spec_from_file_location(
+        "slo_diff", pathlib.Path(__file__).parent.parent
+        / "tools" / "slo_diff.py")
+    sd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sd)
+    worse = aggregate_forecast([ep(0, 2, 90_000.0), ep(1, 1, 30_000.0)])
+    _, regs = sd.compare_forecast(base, worse)
+    fields = {r["field"] for r in regs}
+    assert "prevented_violations" in fields
+    assert "time_under_violation_ms" in fields
+    _, regs_ok = sd.compare_forecast(base, dict(base))
+    assert regs_ok == []
+    # both documents route through extract_forecast's campaign envelope
+    assert sd.extract_forecast({"campaign": {"forecast": base}}) == base
+    assert sd.extract_forecast({"forecast": base}) == base
+
+
+# ----------------------------------------- slow tier: the prevention A/B
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ["moving-diurnal", "moving-flash-crowd"])
+def test_predictive_prevents_majority_of_baseline_violations(name):
+    """The acceptance bar: on the same (scenario, seed), predictive mode
+    prevents >=50% of the violations the reactive baseline merely heals,
+    with strictly less time under violation — and reruns bit-identically."""
+    from cruise_control_tpu.sim.catalog import SCENARIOS
+    from cruise_control_tpu.sim.runner import run_scenario
+    sc = SCENARIOS[name]
+    baseline_sc = dataclasses.replace(
+        sc,
+        config=tuple(kv for kv in sc.config if kv[0] != "forecast.enabled")
+        + (("forecast.enabled", False),),
+        expect_detect_types=())
+    base = run_scenario(baseline_sc, seed=0)
+    pred = run_scenario(sc, seed=0)
+    base.assert_ok()
+    pred.assert_ok()
+    assert base.reacted_violations >= 1, "baseline drew no violations"
+    assert pred.prevented_violations * 2 >= base.reacted_violations
+    assert pred.time_under_violation_ms < base.time_under_violation_ms
+    rerun = run_scenario(sc, seed=0)
+    assert rerun.to_json() == pred.to_json()
+    assert rerun.journal == pred.journal

@@ -68,6 +68,26 @@ def render_slo_table(slo: dict) -> str:
     return "\n".join(lines)
 
 
+def render_forecast_block(fc: dict) -> str:
+    """The predictive-control rollup (sim/campaign.aggregate_forecast):
+    prevented-vs-reacted counts, time under violation, speculative hits."""
+    dist = fc.get("time_under_violation_dist") or {}
+    lines = [
+        f"  forecast ({fc.get('episodes', 0)} episodes): "
+        f"prevented={fc.get('prevented_violations', 0)} "
+        f"predicted={fc.get('predicted_violations', 0)} "
+        f"reacted={fc.get('reacted_violations', 0)}",
+        f"    time under violation: total "
+        f"{_fmt_ms(fc.get('time_under_violation_ms'))}"
+        + (f" · p50 {_fmt_ms(dist.get('p50'))} p95 {_fmt_ms(dist.get('p95'))}"
+           f" max {_fmt_ms(dist.get('max'))}" if dist.get("n") else ""),
+        f"    speculative proposals: {fc.get('speculative_hits', 0)}/"
+        f"{fc.get('speculative_installs', 0)} hits "
+        f"(rate {fc.get('speculative_hit_rate', 0.0)})",
+    ]
+    return "\n".join(lines)
+
+
 def render_episode_line(i: int, ep: dict) -> str:
     spec = ep.get("scenario_spec", {})
     events = ",".join(e["kind"] for e in spec.get("events", [])) or "?"
@@ -83,6 +103,11 @@ def render_episode_line(i: int, ep: dict) -> str:
             f" heal={_fmt_ms(ep.get('time_to_heal_ms'))}"
             f" verified={ep.get('verified_optimizations', 0)}"
             f" adjust={ep.get('concurrency_adjustments', 0)}"
+            + (f" prevented={ep.get('prevented_violations', 0)}"
+               f" reacted={ep.get('reacted_violations', 0)}"
+               f" tuv={_fmt_ms(ep.get('time_under_violation_ms'))}"
+               if ep.get("forecast")
+               or ep.get("time_under_violation_ms") is not None else "")
             + (f" provision={prov}" if prov else "")
             + (f"  !! {' '.join(flags)}" if flags else ""))
 
@@ -108,6 +133,10 @@ def render(doc: dict, show_episodes: bool = False,
         lines.append(f"  FAILURE: {f}")
     lines.append("")
     lines.append(render_slo_table(doc.get("slo", {})))
+    fc = doc.get("forecast")
+    if isinstance(fc, dict) and fc:
+        lines.append("")
+        lines.append(render_forecast_block(fc))
     episodes = doc.get("episodes", [])
     if show_episodes and episodes:
         lines.append("")

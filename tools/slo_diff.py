@@ -354,6 +354,66 @@ def compare_ha(base: dict, cand: dict, threshold: float = 0.25):
     return rows, regressions
 
 
+def extract_forecast(doc: dict) -> dict:
+    """The predictive-control SLO block: a campaign document's aggregated
+    ``forecast`` rollup (sim/campaign.aggregate_forecast), a bench summary's
+    ``forecast`` rung (bench.py --forecast), or {}."""
+    fc = doc.get("forecast")
+    if isinstance(fc, dict) and "prevented_violations" in fc:
+        return fc
+    camp = doc.get("campaign")
+    if isinstance(camp, dict) and isinstance(camp.get("forecast"), dict):
+        return camp["forecast"]
+    return {}
+
+
+def compare_forecast(base: dict, cand: dict, threshold: float = 0.25):
+    """Gate the predictive-control rung between two documents: fewer
+    prevented violations than the baseline, more reacted (breach-first)
+    heals beyond the threshold, time-under-violation growing beyond the
+    threshold (with a one-tick absolute floor so a single extra probed tick
+    doesn't fail the diff), or a speculative hit rate collapsing to zero,
+    all fail."""
+    rows, regressions = [], []
+    bp, cp = base.get("prevented_violations"), cand.get("prevented_violations")
+    if bp is not None and cp is not None:
+        row = {"kind": "forecast", "field": "prevented_violations",
+               "base_p95": bp, "cand_p95": cp}
+        if cp < bp:
+            row["regression"] = (f"prevented violations {bp} -> {cp} "
+                                 f"(predictive coverage lost)")
+            regressions.append(row)
+        rows.append(row)
+    br, cr = base.get("reacted_violations"), cand.get("reacted_violations")
+    if br is not None and cr is not None:
+        row = {"kind": "forecast", "field": "reacted_violations",
+               "base_p95": br, "cand_p95": cr}
+        if cr > max(br * (1.0 + threshold), br + 1):
+            row["regression"] = (f"reacted (breach-first) heals {br} -> {cr}")
+            regressions.append(row)
+        rows.append(row)
+    bt, ct = (base.get("time_under_violation_ms"),
+              cand.get("time_under_violation_ms"))
+    if bt is not None and ct is not None:
+        row = {"kind": "forecast", "field": "time_under_violation_ms",
+               "base_p95": bt, "cand_p95": ct}
+        if ct > bt * (1.0 + threshold) and ct - bt > 15_000.0:
+            row["regression"] = (f"time under violation {bt:.0f} -> {ct:.0f} "
+                                 f"ms (> +{threshold:g})")
+            regressions.append(row)
+        rows.append(row)
+    bh = base.get("speculative_hit_rate")
+    ch = cand.get("speculative_hit_rate")
+    if bh is not None and ch is not None:
+        row = {"kind": "forecast", "field": "speculative_hit_rate",
+               "base_p95": bh, "cand_p95": ch}
+        if bh > 0 and ch == 0:
+            row["regression"] = "speculative proposal hit rate collapsed to 0"
+            regressions.append(row)
+        rows.append(row)
+    return rows, regressions
+
+
 def load_doc(path: str) -> tuple[dict, bool]:
     """Load one input; returns (document, is_journal). A JSONL event
     journal is detected by its per-line records and converted to a
@@ -460,6 +520,14 @@ def main(argv: list[str]) -> int:
         hrows, hregs = compare_ha(hbase, hcand, threshold)
         rows.extend(hrows)
         regressions.extend(hregs)
+        compared = True
+    # ... and on the predictive-control rung (prevented/reacted counts,
+    # time under violation, speculative proposal hit rate)
+    fcb, fcc = extract_forecast(base_doc), extract_forecast(cand_doc)
+    if fcb and fcc:
+        fcrows, fcregs = compare_forecast(fcb, fcc, threshold)
+        rows.extend(fcrows)
+        regressions.extend(fcregs)
         compared = True
     if not compared:
         print("no comparable SLO or steady-round blocks found in both "
