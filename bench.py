@@ -58,6 +58,13 @@ Usage: bench.py [rung ...] [--profile] [--skip-cold] [--scenario [name]]
                the jitted vmapped forecaster), predicted / prevented /
                reacted violation counts, time under violation and the
                speculative proposal hit rate — tools/slo_diff.py gates it
+  --serving [N]  run the serving-load rung (sim/runner.ServingLoadDriver):
+               N tenants (default 50) under a seeded Poisson heal/rebalance
+               arrival stream, request-admission engine vs the static
+               bucket round on the SAME stream; emits a "serving" block
+               with proposals/sec, heal-admission p50/p95 (simulated ms),
+               the engine-vs-static speedups, zero-pressure bit parity and
+               the lane/K-toggle compile count — tools/slo_diff.py gates it
   --fuzz [N]   with --campaign: run every episode with the seeded REST
                fuzzer + FaultyBackend attached (sim/api_fuzz.py, fuzz seed
                N, default 0); emits fuzz request/failure counts and writes
@@ -119,6 +126,7 @@ RUNG_COST_EST = {
     "fleet": (300, 120),
     "ha": (260, 130),
     "forecast": (180, 60),
+    "serving": (420, 200),
 }
 
 
@@ -173,6 +181,7 @@ class Summary:
         self.fleet: dict | None = None      # batched multi-tenant figures
         self.ha: dict | None = None         # HA failover SLOs + parity
         self.forecast: dict | None = None   # predictive-control SLOs
+        self.serving: dict | None = None    # request-admission serving SLOs
         self.headline_requested = True      # set from the requested rung list
 
     def emit(self, final: bool = False) -> None:
@@ -208,6 +217,11 @@ class Summary:
                 metric = (f"predictive-control campaign wall-clock "
                           f"({self.forecast['name']})")
                 value = self.forecast["wall_s"]
+            elif self.serving is not None:
+                metric = (f"serving-load engine proposals/sec "
+                          f"({self.serving['tenants']} tenants, Poisson)")
+                value = (self.serving.get("engine") or {}).get(
+                    "proposalsPerSec")
             elif ran:
                 metric = f"rebalance proposal wall-clock @ {ran[0]['config']}"
                 value = ran[0].get("wall_s")
@@ -251,6 +265,13 @@ class Summary:
             # proposal hit rate — slo_diff gates it (extract_forecast /
             # compare_forecast)
             out["forecast"] = self.forecast
+        if self.serving is not None:
+            # serving block (bench.py --serving N): request-admission
+            # engine vs static round on one Poisson stream — proposals/sec,
+            # heal-admission p95, zero-pressure parity, lane/K-toggle
+            # compiles — slo_diff gates it (extract_serving /
+            # compare_serving)
+            out["serving"] = self.serving
         # pretty block first (humans + trace_view's whole-file parse of
         # BENCH_partial.json), then ONE compact machine-parseable line —
         # always the last stdout line, small enough that the driver's tail
@@ -526,6 +547,19 @@ def main() -> None:
         i = argv.index("--forecast")
         argv = argv[:i] + argv[i + 1:]
         argv.append("forecast")
+    serving_tenants = 50
+    if "--serving" in argv:
+        # --serving [N]: run the serving-load rung — N tenants (default 50,
+        # the ISSUE's floor) under a seeded Poisson arrival stream, the
+        # request-admission engine A/B'd against the static round
+        i = argv.index("--serving")
+        if i + 1 < len(argv) and not argv[i + 1].startswith("--") \
+                and argv[i + 1].isdigit():
+            serving_tenants = int(argv[i + 1])
+            argv = argv[:i] + argv[i + 2:]
+        else:
+            argv = argv[:i] + argv[i + 1:]
+        argv.append("serving")
     fuzz_seed = None
     if "--fuzz" in argv:
         # --fuzz [N]: run the campaign episodes with the REST fuzzer +
@@ -691,6 +725,11 @@ def main() -> None:
             # forecasting on -> prevented/reacted counts, time under
             # violation, speculative proposal hit rate
             rung = run_forecast_rung(campaign_seed)
+
+        elif rung_id == "serving":
+            # serving-load rung: request-admission engine vs static round
+            # on one seeded Poisson stream -> proposals/sec + heal p95
+            rung = run_serving_rung(serving_tenants, campaign_seed)
 
         elif rung_id == "e2e7k":
             # the full monitor path at HEADLINE scale: backend -> samples ->
@@ -1105,6 +1144,109 @@ def run_forecast_rung(seed: int = 0) -> dict:
         f"tuv={rung['time_under_violation_ms']}ms "
         f"spec_hit_rate={rung['speculative_hit_rate']} "
         f"forecast={forecast_s}s (cold {forecast_cold_s}s), wall={wall}s")
+    return rung
+
+
+def run_serving_rung(n_tenants: int = 50, seed: int = 0,
+                     duration_ms: float = 120_000.0) -> dict:
+    """Serving-load rung (--serving N): the request-admission engine
+    (DESIGN §22) vs the static bucket round on the SAME seeded Poisson
+    heal/rebalance stream at ``n_tenants`` tenants — proposals/sec and
+    heal-admission latency (enqueue -> install, SIMULATED ms) per mode.
+
+    Two cheap contract checks ride ahead of the load measurement on a
+    3-tenant same-bucket fleet pair:
+    - zero-pressure parity: one admission round vs one static round over
+      identical tenants must install bit-identical proposal sets;
+    - lane/K toggles must stay inside the compiled power-of-two K ladder —
+      re-dispatching a heal/rebalance mix with max_batch toggled across
+      warmed ladder steps must add ZERO XLA compiles.
+
+    tools/slo_diff.py gates the emitted "serving" block (extract_serving /
+    compare_serving): proposals/sec, heal p95, strict engine-vs-static
+    advantage, parity, toggle compiles."""
+    from cruise_control_tpu.pipeline import LANE_HEAL, LANE_REBALANCE
+    from cruise_control_tpu.sim.campaign import (
+        build_serving_fleet, run_serving_campaign,
+    )
+
+    log(f"rung serving: request-admission engine vs static round, "
+        f"{n_tenants} tenants under Poisson load, seed {seed}")
+    t0 = time.monotonic()
+
+    def goal_sets(res):
+        return (
+            sorted(g.name for g in res.goal_results if g.violated_after),
+            sorted((g.name, g.fixpoint_proven, g.moves_remaining,
+                    g.leads_remaining, g.swap_window_remaining)
+                   for g in res.goal_results),
+            sorted((p.topic, p.partition, p.new_leader, p.new_replicas)
+                   for p in res.proposals))
+
+    fa = build_serving_fleet(3, seed=seed, admission=True)
+    fb = build_serving_fleet(3, seed=seed, admission=False)
+    try:
+        t_round = 10_000_000.0
+        fa.run_round(now_ms=t_round)
+        fb.run_round(now_ms=t_round)
+        parity = all(
+            goal_sets(fa.app_for(cid).cached_proposals())
+            == goal_sets(fb.app_for(cid).cached_proposals())
+            for cid in fa.tenants)
+        # the quantized first round warmed ladder steps K=2 and K=1; a
+        # heal/rebalance mix re-dispatched across those steps must not
+        # compile anything new
+        cids = list(fa.tenants)
+        with count_compiles() as tc:
+            fa.max_batch = 2
+            for i, cid in enumerate(cids):
+                fa.enqueue(cid, LANE_HEAL if i % 2 == 0 else LANE_REBALANCE,
+                           "toggle probe", now_ms=t_round + 1_000.0)
+            for _ in range(2 * len(cids)):
+                d = fa.dispatch_once(now_ms=t_round + 2_000.0)
+                if d is None or (d["launches"] == 0 and not d["failed"]):
+                    break
+            fa.max_batch = 1
+            fa.enqueue(cids[0], LANE_REBALANCE, "K toggle",
+                       now_ms=t_round + 3_000.0)
+            fa.dispatch_once(now_ms=t_round + 4_000.0)
+        toggle_new_compiles = tc.count
+    finally:
+        fa.shutdown()
+        fb.shutdown()
+    log(f"  [serving] zero-pressure parity={parity}, "
+        f"lane/K toggle compiles={toggle_new_compiles}")
+
+    camp = run_serving_campaign(num_tenants=n_tenants, seed=seed,
+                                duration_ms=duration_ms)
+    wall = round(time.monotonic() - t0, 2)
+    eng, base = camp["engine"], camp["baseline"]
+    rung = {
+        "config": f"serving-{n_tenants}t-s{seed}",
+        "tenants": n_tenants,
+        "proposals_per_sec_engine": eng.get("proposalsPerSec"),
+        "proposals_per_sec_static": base.get("proposalsPerSec"),
+        "proposals_per_sec_speedup": camp.get("proposalsPerSecSpeedup"),
+        "heal_p95_ms_engine": (eng.get("healAdmissionMs") or {}).get("p95"),
+        "heal_p95_ms_static": (base.get("healAdmissionMs") or {}).get("p95"),
+        "heal_p95_improvement_x": camp.get("healP95ImprovementX"),
+        "parity_identical": parity,
+        "toggle_new_compiles": toggle_new_compiles,
+        "wall_s": wall,
+    }
+    # SUMMARY.serving carries the full campaign document (both legs'
+    # request/install/launch tallies + the engine's admission state) plus
+    # the contract verdicts — slo_diff gates it without re-deriving
+    SUMMARY.serving = dict(camp, parity_identical=parity,
+                           toggle_new_compiles=toggle_new_compiles,
+                           wall_s=wall)
+    log(f"serving rung: engine {rung['proposals_per_sec_engine']} "
+        f"proposals/s vs static {rung['proposals_per_sec_static']} "
+        f"({rung['proposals_per_sec_speedup']}x), heal p95 "
+        f"{rung['heal_p95_ms_engine']} ms vs "
+        f"{rung['heal_p95_ms_static']} ms "
+        f"({rung['heal_p95_improvement_x']}x better), parity={parity}, "
+        f"toggle compiles={toggle_new_compiles}, wall={wall}s")
     return rung
 
 

@@ -283,6 +283,14 @@ class ResidentClusterSession:
         self._spilled_env = None       # host (numpy) env pytree while spilled
         self.spills = 0
         self.readmits = 0
+        # ---- fleet pad-to-join (PR 18) ----
+        # extra pad floors for the next rebuild (``{"min_replicas": ...,
+        # "min_brokers": ..., "min_partitions": ..., "min_topics": ...}``):
+        # the fleet admission engine sets these to a NEAR bucket's dims and
+        # invalidates, so the rebuilt session lands in the larger bucket and
+        # stacks into the same vmapped launch. Sticky until cleared — the
+        # join survives later epoch fallbacks.
+        self.bucket_floors: dict | None = None
         # ---- pipelined-loop shadow slot (PR 11) ----
         # ``shadow_syncs`` counts syncs that ran while the resident state was
         # LENT to an in-flight optimize round (state is None at sync entry):
@@ -677,7 +685,7 @@ class ResidentClusterSession:
             if mon._snapshot().generation == snap.generation:
                 break
         ct = self._apply_excluded_pattern(ct, meta)
-        ct, meta = pad_cluster(ct, meta)
+        ct, meta = pad_cluster(ct, meta, **(self.bucket_floors or {}))
         part_table = padded_partition_table(ct)
         tml = self._tml_mask(meta, ct.num_topics)
         env = make_env(ct, meta, topic_min_leaders_mask=tml,

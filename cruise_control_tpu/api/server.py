@@ -519,6 +519,14 @@ class CruiseControlServer:
                     return wrap(body)
                 if endpoint is EndPoint.REBALANCE:
                     progress.add_step(OPTIMIZATION_FOR_GOAL)
+                    if app.fleet_request_sink is not None:
+                        # fleet admission engine (PR 18): a user rebalance
+                        # also queues a rebalance-lane request, so the
+                        # tenant's NEXT cache refresh preempts background
+                        # precompute (heals still outrank it)
+                        from cruise_control_tpu.pipeline import LANE_REBALANCE
+                        app.fleet_request_sink(
+                            LANE_REBALANCE, p["reason"] or "rebalance request")
                     return wrap(app.rebalance(
                         goal_names=p["goals"] or None, dry_run=p["dryrun"],
                         skip_hard_goal_check=p["skip_hard_goal_check"],

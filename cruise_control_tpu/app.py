@@ -71,6 +71,10 @@ class CruiseControl:
         # single-tenant deployment); labels the monitor's per-tenant
         # aggregators and the fleet's cluster-scoped routing
         self.cluster_id = cluster_id
+        # fleet admission engine (PR 18): (lane, reason, now_ms) -> dict,
+        # set by FleetScheduler.add_tenant — detector FIX/PREDICTED verdicts
+        # and user rebalances enqueue optimization requests through it
+        self.fleet_request_sink = None
         # one registry for the whole app — the MetricRegistry -> JMX domain
         # kafka.cruisecontrol role (KafkaCruiseControlApp.java:29,40); exported
         # via /state?substates=SENSORS and GET /metrics (Prometheus text)
@@ -275,7 +279,8 @@ class CruiseControl:
             sensors=self.sensors,
             anomaly_cls=self.config.get_class("goal.violations.class"),
             allow_capacity_estimation=allow_est,
-            session_supplier=session_supplier)
+            session_supplier=session_supplier,
+            admission_sink=self._heal_admission_sink)
         slow = SlowBrokerFinder()
         slow.configure(self.config)
         # metric.anomaly.finder.class (MetricAnomalyFinder SPI): percentile
@@ -382,7 +387,8 @@ class CruiseControl:
                 self.goal_optimizer, self.load_monitor, self.forecaster,
                 self.config.get_list("anomaly.detection.goals"),
                 sensors=self.sensors,
-                allow_capacity_estimation=allow_est)
+                allow_capacity_estimation=allow_est,
+                admission_sink=self._heal_admission_sink)
             self.predicted_goal_violation_detector = pred
             register("PredictedGoalViolationDetector", pred.run_once,
                      interval_ms=interval(
@@ -659,6 +665,24 @@ class CruiseControl:
         pipe = self.service_pipeline
         return (pipe is not None and self._route_fixes
                 and pipe.accepts_fix_routing())
+
+    def _heal_admission_sink(self, reason: str,
+                             now_ms: float | None = None) -> None:
+        """Detector seam into the fleet admission engine (PR 18): a
+        FIX/PREDICTED verdict on a fleet-managed tenant enqueues a
+        HEAL-lane optimization request, so the fix's proposal refresh
+        preempts queued hygiene rebalances and background precompute.
+        Single-tenant deployments (no sink) are a no-op."""
+        sink = self.fleet_request_sink
+        if sink is None:
+            return
+        from cruise_control_tpu.pipeline import LANE_HEAL
+        try:
+            sink(LANE_HEAL, reason, now_ms)
+        except Exception:   # noqa: BLE001 — enqueue must never break a
+            # detection round; the verdict's own fix path still runs
+            logging.getLogger(__name__).exception(
+                "fleet heal-lane enqueue failed for %s", self.cluster_id)
 
     def _run_optimization(self, operation: str, reason: str, ct, meta,
                           goal_names=None, options=OptimizationOptions(),

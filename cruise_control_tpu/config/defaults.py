@@ -496,6 +496,45 @@ _D.define(name="fleet.precompute.interval.ms", type=Type.INT, default=30_000,
               "unpaused tenant (delta path), batches the due ones per shape "
               "bucket into ONE vmapped engine launch, installs per-tenant "
               "proposal caches and enforces the memory budget.")
+_D.define(name="fleet.admission.enabled", type=Type.BOOLEAN, default=True,
+          doc="Request-admission engine (PR 18, DESIGN §22): fleet rounds "
+              "drain per-tenant priority-lane request queues (heal < "
+              "rebalance < refresh) with up to fleet.admission.max.batch "
+              "tenants admitted per vmapped launch, instead of the legacy "
+              "static bucket sweep. At zero queue pressure a round is "
+              "bit-identical to the static sweep; off = legacy sweep only. "
+              "Host-side policy: toggling never creates new compiles "
+              "within a shape bucket.")
+_D.define(name="fleet.admission.max.batch", type=Type.INT, default=16,
+          validator=at_least(1),
+          doc="K: max tenants admitted into one vmapped launch at dispatch "
+              "time (continuous-batching admission). Queued requests beyond "
+              "K ride the NEXT dispatch, keeping heal-lane latency bounded "
+              "by one launch instead of one full round. Host-side policy "
+              "leaf — changing it reuses the per-(chain, bucket, K) "
+              "compiled programs, no new compiles for already-seen K.")
+_D.define(name="fleet.admission.quantize.batch", type=Type.BOOLEAN,
+          default=False,
+          doc="Quantize the admitted launch size to a power-of-two ladder "
+              "(1, 2, 4, ... max.batch), bounding the compiled K-variants a "
+              "long-tail arrival mix can create within a bucket (the "
+              "serving bench turns this on). Off admits min(pending, K) "
+              "exactly — the static-sweep-parity grouping.")
+_D.define(name="fleet.admission.near.join.pressure", type=Type.INT,
+          default=4, validator=at_least(1),
+          doc="Pad-to-join vs split-launch policy for NEAR shape buckets "
+              "(same max_rf/disks/racks, every dim <= and <= 2x): when the "
+              "combined queued-tenant pressure of a NEAR pair reaches this "
+              "threshold, the smaller bucket's tenants rebuild with the "
+              "larger bucket's dims as pad floors (session.bucket_floors) "
+              "and join its launches; below it they split-launch (no "
+              "rebuild cost).")
+_D.define(name="fleet.admission.heal.retry.limit", type=Type.INT, default=2,
+          validator=at_least(0),
+          doc="Launch-failure isolation: heal-lane requests of a failed "
+              "batched launch re-enqueue up to this many times (a dropped "
+              "heal is a stranded anomaly); rebalance/refresh requests "
+              "drop with the failure surfaced in the round report.")
 _D.define(name="fleet.cluster.ids", type=Type.LIST, default=[],
           doc="Service-mode multi-tenant boot (main.py): cluster ids to "
               "register as fleet tenants behind one server. Non-empty "

@@ -32,12 +32,16 @@ class GoalViolationDetector:
                  provisioner=None, provision_floors=None, sensors=None,
                  anomaly_cls=GoalViolations,
                  allow_capacity_estimation: bool = True,
-                 session_supplier=None):
+                 session_supplier=None, admission_sink=None):
         self._optimizer = goal_optimizer
         self._monitor = load_monitor
         self._goals = list(detection_goals)
         self._provisioner = provisioner
         self._provision_floors = provision_floors  # overprovisioned.* floors
+        # optional (reason, now_ms) -> None: a FIXABLE verdict enqueues a
+        # heal-lane request on the fleet admission engine, so the fix's
+        # proposal refresh preempts queued hygiene/background work
+        self._admission_sink = admission_sink
         # goal.violations.class: pluggable anomaly materialization
         self._anomaly_cls = anomaly_cls
         self._allow_capacity_estimation = allow_capacity_estimation
@@ -112,6 +116,9 @@ class GoalViolationDetector:
                                     "balancedness": res.balancedness_before})
         if not fixable and not unfixable:
             return []
+        if fixable and self._admission_sink is not None:
+            self._admission_sink(f"goal violation: {','.join(fixable)}",
+                                 now_ms)
         return [self._anomaly_cls(
             anomaly_type=AnomalyType.GOAL_VIOLATION, detected_ms=now_ms,
             violated_goals_fixable=fixable, violated_goals_unfixable=unfixable,
@@ -138,10 +145,15 @@ class PredictedGoalViolationDetector:
 
     def __init__(self, goal_optimizer, load_monitor, forecaster,
                  detection_goals: list, sensors=None,
-                 allow_capacity_estimation: bool = True):
+                 allow_capacity_estimation: bool = True,
+                 admission_sink=None):
         self._optimizer = goal_optimizer
         self._monitor = load_monitor
         self._forecaster = forecaster
+        # optional (reason, now_ms) -> None: PREDICTED verdicts pre-position
+        # a heal-lane request on the fleet admission engine (see
+        # GoalViolationDetector)
+        self._admission_sink = admission_sink
         self._goals = list(detection_goals)
         self._allow_capacity_estimation = allow_capacity_estimation
         self.predictions = 0           # PREDICTED verdicts emitted
@@ -212,6 +224,9 @@ class PredictedGoalViolationDetector:
             return []
         self._last_emitted_gen = fres.generation
         self.predictions += 1
+        if fixable and self._admission_sink is not None:
+            self._admission_sink(
+                f"predicted violation: {','.join(fixable)}", now_ms)
         return [PredictedGoalViolations(
             anomaly_type=AnomalyType.PREDICTED_GOAL_VIOLATION,
             detected_ms=now_ms,

@@ -29,13 +29,34 @@ Parity contract (tests/test_fleet.py): K same-bucket tenants optimized in
 one launch produce per-tenant violation/certificate/proposal sets
 bit-identical to K solo runs. Steady fleet rounds stay delta-mode, zero new
 XLA compiles, donated.
+
+Request-admission engine (PR 18, DESIGN §22): the static round sweep is the
+fallback (``fleet.admission.enabled`` off); the default serving path is a
+continuous-batching queue. Optimization requests — tenant delta syncs going
+due, detector FIX/PREDICTED verdicts, user-initiated rebalances — enter
+per-tenant queues with priority lanes (heal < rebalance < refresh, lower
+drains first); each dispatch groups the queued tenants by shape bucket,
+admits up to ``fleet.admission.max.batch`` of the hottest bucket in
+(lane, seq) order and runs ONE vmapped launch; NEAR buckets under measured
+queue pressure pad-to-join (session ``bucket_floors`` + rebuild) instead of
+split-launching. Completed results install through the tenant pipeline's
+execute stage (``submit_install``) when one is running, so the next launch
+starts while installs land. At zero queue pressure a round is bit-identical
+to the static sweep; admission order is deterministic per (scenario, seed);
+lane/K knobs are host-side policy — zero new compiles within a bucket.
 """
 from __future__ import annotations
 
+import dataclasses
 import logging
 import re
 import threading
+import time
 from collections import deque
+
+from cruise_control_tpu.pipeline import (
+    LANE_HEAL, LANE_NAMES, LANE_REBALANCE, LANE_REFRESH,
+)
 
 LOG = logging.getLogger(__name__)
 
@@ -94,9 +115,35 @@ class FleetTenant:
         }
 
 
+@dataclasses.dataclass
+class OptimizationRequest:
+    """One queued optimization demand on a fleet tenant.
+
+    ``seq`` is the global enqueue order — admission is deterministic by
+    (lane, seq), so identical request streams admit identical launch sets.
+    One request is outstanding per (tenant, lane): duplicates coalesce onto
+    the queued one (counted). A fresh proposal cache satisfies EVERY queued
+    lane of the tenant, so an admitted tenant completes all its requests.
+    """
+    seq: int
+    cluster_id: str
+    lane: int
+    reason: str = ""
+    enqueued_ms: float = 0.0
+    retries: int = 0
+    coalesced: int = 0
+
+    def state_json(self) -> dict:
+        return {"seq": self.seq, "clusterId": self.cluster_id,
+                "lane": LANE_NAMES[self.lane], "reason": self.reason,
+                "enqueuedMs": self.enqueued_ms, "retries": self.retries,
+                "coalesced": self.coalesced}
+
+
 class FleetScheduler:
-    """Multiplex N tenant clusters onto one device: bucket-grouped batched
-    optimization, proposal-cache precompute, pause/resume, and a global
+    """Multiplex N tenant clusters onto one device: request-admission
+    engine (priority lanes, bucket-grouped vmapped launches, pad-to-join
+    under pressure), proposal-cache precompute, pause/resume, and a global
     device-memory budget with LRU spill."""
 
     def __init__(self, config=None, optimizer=None, sensors=None):
@@ -120,6 +167,41 @@ class FleetScheduler:
         self.rounds = 0
         self.launches = 0              # batched program launches, lifetime
         self.last_round: dict = {}
+        # ---- request-admission engine (PR 18) ----
+        self.admission_enabled = self.config.get_boolean(
+            "fleet.admission.enabled")
+        self.max_batch = max(1, self.config.get_int(
+            "fleet.admission.max.batch"))
+        self.quantize_batch = self.config.get_boolean(
+            "fleet.admission.quantize.batch")
+        self.join_pressure = self.config.get_int(
+            "fleet.admission.near.join.pressure")
+        self.heal_retries = self.config.get_int(
+            "fleet.admission.heal.retry.limit")
+        self._requests: dict[str, dict[int, OptimizationRequest]] = {}
+        self._req_seq = 0
+        self.requests_enqueued = 0
+        self.requests_coalesced = 0
+        self.requests_admitted = 0
+        self.requests_requeued = 0
+        self.requests_failed = 0
+        self.dispatches = 0
+        self.joins = 0
+        self.splits = 0
+        self.last_dispatch: dict = {}
+        # heal-admission latency: enqueue -> install, the serving SLO
+        self.heal_admission_ms = deque(maxlen=4096)
+        self._heal_admission_timer = self.sensors.timer(
+            "fleet-heal-admission-timer")
+        self._admit_meter = self.sensors.meter("fleet-requests-admitted")
+        self.sensors.gauge("fleet-queue-depth", self.queue_depth)
+        # admission trace journal (tools/queue_view.py): in-memory ring by
+        # default; ts rides the last injected round/dispatch clock so the
+        # event stream is deterministic per (scenario, seed)
+        from cruise_control_tpu.common.tracing import EventJournal
+        self._clock_ms = 0.0
+        self.journal = EventJournal(clock_ms=lambda: self._clock_ms)
+        self._work = threading.Event()   # enqueue -> serving-loop wakeup
         self._spill_meter = self.sensors.meter("fleet-spills")
         self._staleness_timer = self.sensors.timer("fleet-staleness-timer")
         self.sensors.gauge("fleet-tenants", lambda: len(self.tenants))
@@ -156,11 +238,17 @@ class FleetScheduler:
                     "(analyzer.resident.session.enabled)")
             tenant = FleetTenant(cluster_id, cc)
             self.tenants[cluster_id] = tenant
+            # detector/user request seam: the tenant app's FIX/PREDICTED
+            # verdicts and rebalances enqueue on this scheduler's lanes
+            cc.fleet_request_sink = (
+                lambda lane, reason, now_ms=None, _cid=cluster_id:
+                self.enqueue(_cid, lane, reason, now_ms=now_ms))
             return tenant
 
     def remove_tenant(self, cluster_id: str) -> None:
         with self._lock:
             tenant = self.tenants.pop(cluster_id, None)
+            self._requests.pop(cluster_id, None)
         if tenant is not None:
             tenant.cc.shutdown()
 
@@ -205,13 +293,406 @@ class FleetScheduler:
                 int(env.topic_excluded.shape[0]), env.max_rf,
                 int(env.broker_disk_capacity.shape[1]), env.num_racks)
 
+    # ----------------------------------------------------- admission queue
+    def _now_for(self, now_ms, tenant=None) -> float:
+        """Resolve the operation clock (injected sim/round clock wins) and
+        remember it for journal timestamps."""
+        if now_ms is not None:
+            now = float(now_ms)
+        elif tenant is not None:
+            now = float(tenant.cc._now_ms())
+        else:
+            now = time.time() * 1000.0
+        self._clock_ms = now
+        return now
+
+    def enqueue(self, cluster_id: str, lane: int = LANE_REFRESH,
+                reason: str = "", now_ms: float | None = None) -> dict:
+        """Queue one optimization request for a tenant. Lanes: heal (0,
+        detector FIX/PREDICTED verdicts) preempts rebalance (1, user
+        hygiene) preempts refresh (2, background precompute). One request
+        is outstanding per (tenant, lane): a duplicate coalesces (counted)
+        onto the queued one. Returns the request's state_json."""
+        with self._lock:
+            t = self.tenant(cluster_id)
+            lane = min(max(int(lane), LANE_HEAL), LANE_REFRESH)
+            now = self._now_for(now_ms, t)
+            per_lane = self._requests.setdefault(cluster_id, {})
+            req = per_lane.get(lane)
+            if req is not None:
+                req.coalesced += 1
+                self.requests_coalesced += 1
+                self.journal.append("admission", ev="coalesce",
+                                    cid=cluster_id, lane=LANE_NAMES[lane],
+                                    seq=req.seq)
+                return req.state_json()
+            self._req_seq += 1
+            req = OptimizationRequest(seq=self._req_seq,
+                                      cluster_id=cluster_id, lane=lane,
+                                      reason=reason, enqueued_ms=now)
+            per_lane[lane] = req
+            self.requests_enqueued += 1
+            self.journal.append("admission", ev="enqueue", cid=cluster_id,
+                                lane=LANE_NAMES[lane], seq=req.seq,
+                                reason=reason)
+            self._work.set()
+            return req.state_json()
+
+    def queue_depth(self) -> int:
+        return sum(len(lanes) for lanes in self._requests.values())
+
+    def queue_pressure(self) -> int:
+        """Distinct tenants with queued work — the NEAR-bucket join signal."""
+        return sum(1 for lanes in self._requests.values() if lanes)
+
+    def _pending(self) -> list[OptimizationRequest]:
+        out = [r for lanes in self._requests.values() for r in lanes.values()]
+        out.sort(key=lambda r: (r.lane, r.seq))
+        return out
+
+    def _fail_tenant_requests(self, cid: str, reason: str,
+                              failed: dict) -> None:
+        """Per-tenant failure surfacing: heal-lane requests re-enqueue with
+        a bounded retry budget (a dropped heal is a stranded anomaly);
+        rebalance/refresh requests drop with the reason recorded."""
+        lanes = self._requests.get(cid) or {}
+        keep: dict[int, OptimizationRequest] = {}
+        for lane, r in lanes.items():
+            if lane == LANE_HEAL and r.retries < self.heal_retries:
+                r.retries += 1
+                keep[lane] = r
+                self.requests_requeued += 1
+                self.journal.append("admission", ev="requeue", cid=cid,
+                                    lane="heal", seq=r.seq,
+                                    retries=r.retries, reason=reason)
+            else:
+                self.requests_failed += 1
+                self.journal.append("admission", ev="fail", cid=cid,
+                                    lane=LANE_NAMES[lane], seq=r.seq,
+                                    reason=reason)
+        if keep:
+            self._requests[cid] = keep
+        else:
+            self._requests.pop(cid, None)
+        failed[cid] = reason
+
+    # ------------------------------------------------------ NEAR buckets
+    @staticmethod
+    def near_buckets(small: tuple | None, large: tuple | None) -> bool:
+        """Pad-to-join candidacy: identical (max_rf, disks, racks) tail —
+        padding cannot change those — every padded dim of ``small`` <=
+        ``large``, and no dim more than doubles (past 2x the padded compute
+        outweighs the saved launch)."""
+        if small is None or large is None or small == large:
+            return False
+        if small[4:] != large[4:]:
+            return False
+        if not all(x <= y for x, y in zip(small[:4], large[:4])):
+            return False
+        return all(y <= 2 * max(x, 1) for x, y in zip(small[:4], large[:4]))
+
+    def _join_bucket(self, cands: list, target_key: tuple) -> list:
+        """Pad-to-join: rebuild the smaller-bucket tenants with the target
+        bucket's dims as pad floors (session.bucket_floors) so they stack
+        into the target's launches. Floors are sticky — sustained pressure
+        keeps the tenants co-bucketed; the rebuild cost is one-time."""
+        moved = []
+        for r, t in cands:
+            sess = t.session
+            try:
+                sess.bucket_floors = {
+                    "min_replicas": target_key[0],
+                    "min_brokers": target_key[1],
+                    "min_partitions": target_key[2],
+                    "min_topics": target_key[3],
+                }
+                sess.invalidate()
+                sess.sync()
+            except Exception:   # noqa: BLE001 — tenant isolation
+                LOG.exception("pad-to-join rebuild failed for tenant %s",
+                              t.cluster_id)
+                sess.bucket_floors = None
+                sess.invalidate()
+                continue
+            if self.bucket_key(sess) == target_key:
+                moved.append((r, t))
+                self.journal.append("admission", ev="join",
+                                    cid=t.cluster_id, bucket=str(target_key))
+            else:
+                # raw dims outgrew the target mid-join: undo, leave queued
+                sess.bucket_floors = None
+        return moved
+
+    # ------------------------------------------------------------ dispatch
+    def dispatch_once(self, now_ms: float | None = None) -> dict | None:
+        """One admission dispatch: sync the queued tenants, pick the bucket
+        holding the globally highest-priority request, apply the
+        pad-to-join vs split-launch policy against NEAR buckets, admit up
+        to ``fleet.admission.max.batch`` tenants in (lane, seq) order, run
+        ONE vmapped launch and install/complete their requests. Returns the
+        dispatch report, or None when nothing is queued."""
+        with self._lock:
+            return self._dispatch_locked(now_ms)
+
+    def _dispatch_locked(self, now_ms: float | None) -> dict | None:
+        from cruise_control_tpu.monitor.load_monitor import (
+            NotEnoughValidWindowsError,
+        )
+        pending = self._pending()
+        if not pending:
+            return None
+        # one candidate per tenant: its highest-priority queued request
+        best: dict[str, OptimizationRequest] = {}
+        for r in pending:
+            best.setdefault(r.cluster_id, r)
+        skipped: dict[str, str] = {}
+        failed: dict[str, str] = {}
+        ready: list[tuple] = []
+        for cid, r in best.items():
+            t = self.tenants.get(cid)
+            if t is None:                 # tenant removed under its requests
+                self._requests.pop(cid, None)
+                continue
+            if t.paused:
+                skipped[cid] = "paused"   # stays queued for resume
+                continue
+            try:
+                t.session.sync()          # memo-hit when the round synced
+            except NotEnoughValidWindowsError as e:
+                skipped[cid] = f"backpressure: {e}"   # stays queued
+                continue
+            except Exception as e:   # noqa: BLE001 — tenant isolation
+                LOG.exception("fleet sync failed for tenant %s", cid)
+                t.session.invalidate()
+                skipped[cid] = f"sync failed: {type(e).__name__}"
+                self._fail_tenant_requests(
+                    cid, f"sync failed: {type(e).__name__}", failed)
+                continue
+            ready.append((r, t))
+        empty = {"bucket": None, "admitted": [], "lanes": {}, "launches": 0,
+                 "optimized": [], "skipped": skipped, "failed": failed,
+                 "joined": [], "split": False}
+        if not ready:
+            return empty if (skipped or failed) else None
+        groups: dict[tuple, list] = {}
+        for r, t in ready:
+            key = self.bucket_key(t.session)
+            if key is not None:
+                groups.setdefault(key, []).append((r, t))
+        if not groups:
+            return empty
+
+        def head(key):
+            r0, _t0 = groups[key][0]
+            return (r0.lane, r0.seq)
+
+        target = min(groups, key=head)
+        joined: list[str] = []
+        split = False
+        if len(groups) > 1:
+            # NEAR-bucket policy (the fleet residual (b) decision): measured
+            # queue pressure decides pad-to-join vs split-launch for the
+            # best-headed NEAR neighbour
+            for other in sorted((k for k in groups if k != target), key=head):
+                pair = ((other, target)
+                        if self.near_buckets(other, target)
+                        else (target, other))
+                small, large = pair
+                if not self.near_buckets(small, large):
+                    continue
+                pressure = len(groups[small]) + len(groups[large])
+                if pressure >= self.join_pressure:
+                    moved = self._join_bucket(groups[small], large)
+                    moved_ids = {t.cluster_id for _r, t in moved}
+                    rest = [rt for rt in groups[small]
+                            if rt[1].cluster_id not in moved_ids]
+                    if rest:
+                        groups[small] = rest
+                    else:
+                        groups.pop(small, None)
+                    if moved:
+                        groups.setdefault(large, []).extend(moved)
+                        groups[large].sort(
+                            key=lambda rt: (rt[0].lane, rt[0].seq))
+                        target = large
+                        joined = sorted(moved_ids)
+                        self.joins += 1
+                else:
+                    split = True
+                    self.splits += 1
+                    self.journal.append(
+                        "admission", ev="split", small=str(small),
+                        large=str(large), pressure=pressure,
+                        threshold=self.join_pressure)
+                break
+        if target not in groups:
+            return empty
+        cands = groups[target]
+        k = min(len(cands), self.max_batch)
+        if self.quantize_batch and k > 1:
+            # power-of-two launch ladder: bounds the compiled K-variants a
+            # long-tail arrival mix can create within a bucket
+            q = 1
+            while q * 2 <= k:
+                q *= 2
+            k = q
+        admitted = cands[:k]
+        now = self._now_for(now_ms, admitted[0][1])
+        self.dispatches += 1
+        lanes_count: dict[str, int] = {}
+        for r, _t in admitted:
+            name = LANE_NAMES[r.lane]
+            lanes_count[name] = lanes_count.get(name, 0) + 1
+        self.journal.append(
+            "admission", ev="dispatch", bucket=str(target),
+            k=len(admitted), cids=[t.cluster_id for _r, t in admitted],
+            seqs=[r.seq for r, _t in admitted], lanes=lanes_count)
+        sessions = [t.session for _r, t in admitted]
+        gens = [t.session.sync_generation for _r, t in admitted]
+        report = {"bucket": str(target),
+                  "admitted": [t.cluster_id for _r, t in admitted],
+                  "lanes": lanes_count, "launches": 0, "optimized": [],
+                  "skipped": skipped, "failed": failed, "joined": joined,
+                  "split": split}
+        try:
+            results = self.optimizer.optimizations_batched(sessions)
+        except Exception as e:   # noqa: BLE001 — bucket isolation: surface
+            # per-tenant failure and re-enqueue heal-lane requests instead
+            # of silently dropping the whole group
+            LOG.exception("fleet batched launch failed for bucket %s (%s)",
+                          target, [t.cluster_id for _r, t in admitted])
+            for _r, t in admitted:
+                self._fail_tenant_requests(
+                    t.cluster_id, f"launch failed: {type(e).__name__}",
+                    failed)
+            self.last_dispatch = report
+            return report
+        self.launches += 1
+        report["launches"] = 1
+        for (r, t), res, gen in zip(admitted, results, gens):
+            self._install_tenant(t, res, gen, now)
+            report["optimized"].append(t.cluster_id)
+            # a fresh proposal cache satisfies EVERY queued lane: complete
+            # all of the tenant's requests, stamping heal-admission latency
+            for lr in (self._requests.pop(t.cluster_id, {}) or {}).values():
+                self.requests_admitted += 1
+                self._admit_meter.mark()
+                wait = max(now - lr.enqueued_ms, 0.0)
+                if lr.lane == LANE_HEAL:
+                    self.heal_admission_ms.append(wait)
+                    self._heal_admission_timer.record(wait / 1000.0)
+                self.journal.append("admission", ev="install",
+                                    cid=t.cluster_id,
+                                    lane=LANE_NAMES[lr.lane], seq=lr.seq,
+                                    waitMs=round(wait, 3))
+        self.last_dispatch = report
+        return report
+
+    def _install_tenant(self, t: FleetTenant, res, gen: int,
+                        now: float) -> None:
+        """Install one tenant's batched result. When the tenant runs a
+        THREADED pipeline, the install rides its execute stage
+        (``submit_install``) so the scheduler's next launch starts while
+        results land; lockstep/sim tenants install inline (deterministic)."""
+        if t.last_refresh_ms is not None:
+            age_ms = max(now - t.last_refresh_ms, 0.0)
+            t.staleness_ms.append(age_ms)
+            self._staleness_timer.record(age_ms / 1000.0)
+        pipe = getattr(t.cc, "service_pipeline", None)
+        if pipe is not None and pipe.accepts_fix_routing():
+            pipe.submit_install(res, computed_ms=now)
+        else:
+            t.cc.install_proposal_cache(res, computed_ms=now)
+        t.optimized_generation = gen
+        t.last_round_seq = self._round_seq
+        t.last_refresh_ms = now
+        t.refreshes += 1
+
     # -------------------------------------------------------------- rounds
     def run_round(self, now_ms: float | None = None) -> dict:
-        """One fleet optimization round: sync every unpaused tenant (delta
-        path; spilled tenants re-admit), group the DUE ones (sync_generation
-        advanced) by shape bucket, run ONE batched launch per bucket,
-        install per-tenant proposal caches, then enforce the memory budget.
-        """
+        """One fleet optimization round. Admission mode (default): sync
+        every unpaused tenant, enqueue a refresh-lane request for each DUE
+        one (sync_generation advanced), then dispatch launches until the
+        queues drain. At zero queue pressure this is bit-identical to the
+        static sweep (one launch per bucket, every due tenant admitted);
+        queued heal/rebalance requests ride the same dispatches with
+        priority. ``fleet.admission.enabled`` off runs the legacy sweep."""
+        if not self.admission_enabled:
+            return self._static_round(now_ms)
+        from cruise_control_tpu.monitor.load_monitor import (
+            NotEnoughValidWindowsError,
+        )
+        with self._lock:
+            self._round_seq += 1
+            self.rounds += 1
+            skipped: dict[str, str] = {}
+            for cid, t in self.tenants.items():
+                if t.paused:
+                    skipped[cid] = "paused"
+                    continue
+                try:
+                    t.cc.resident_session.sync()
+                except NotEnoughValidWindowsError as e:
+                    skipped[cid] = f"backpressure: {e}"   # PR 11 semantics
+                    continue
+                except Exception as e:   # noqa: BLE001 — tenant isolation:
+                    # one tenant's sync failure must not starve the others
+                    LOG.exception("fleet sync failed for tenant %s", cid)
+                    t.cc.resident_session.invalidate()
+                    skipped[cid] = f"sync failed: {type(e).__name__}"
+                    continue
+                if t.session.sync_generation > t.optimized_generation:
+                    self.enqueue(cid, LANE_REFRESH, reason="due",
+                                 now_ms=now_ms)
+                elif not self._requests.get(cid):
+                    skipped[cid] = "fresh"
+            launches = 0
+            optimized: list[str] = []
+            failed: dict[str, str] = {}
+            buckets: dict[str, list[str]] = {}
+            admission = {"dispatches": 0, "joined": [], "splits": 0,
+                         "lanes": {}}
+            # bounded drain: heal retries are finite, so the loop always
+            # terminates; the bound is a belt against pathological churn
+            for _ in range(4 * (len(self.tenants) + 1)):
+                d = self._dispatch_locked(now_ms)
+                if d is None:
+                    break
+                admission["dispatches"] += 1
+                launches += d["launches"]
+                optimized += d["optimized"]
+                failed.update(d["failed"])
+                for cid, why in d["skipped"].items():
+                    skipped.setdefault(cid, why)
+                if d["launches"]:
+                    buckets.setdefault(d["bucket"], []).extend(d["admitted"])
+                admission["joined"] += d["joined"]
+                admission["splits"] += 1 if d["split"] else 0
+                for name, c in d["lanes"].items():
+                    admission["lanes"][name] = (
+                        admission["lanes"].get(name, 0) + c)
+                if d["launches"] == 0 and not d["failed"]:
+                    break      # only unlaunchable (paused/backpressured) left
+            spilled = self.enforce_memory_budget()
+            report = {
+                "round": self._round_seq,
+                "launches": launches,
+                "buckets": buckets,
+                "optimized": optimized,
+                "skipped": skipped,
+                "failed": failed,
+                "spilled": spilled,
+                "deviceBytes": self.device_bytes(),
+                "admission": admission,
+            }
+            self.last_round = report
+            return report
+
+    def _static_round(self, now_ms: float | None = None) -> dict:
+        """The legacy synchronous sweep (``fleet.admission.enabled`` off):
+        sync every unpaused tenant, group the DUE ones by shape bucket, ONE
+        batched launch per bucket — the admission engine's zero-pressure
+        parity baseline."""
         from cruise_control_tpu.monitor.load_monitor import (
             NotEnoughValidWindowsError,
         )
@@ -220,6 +701,7 @@ class FleetScheduler:
             self.rounds += 1
             due: list[FleetTenant] = []
             skipped: dict[str, str] = {}
+            failed: dict[str, str] = {}
             for cid, t in self.tenants.items():
                 if t.paused:
                     skipped[cid] = "paused"
@@ -249,25 +731,19 @@ class FleetScheduler:
                 gens = [t.session.sync_generation for t in group]
                 try:
                     results = self.optimizer.optimizations_batched(sessions)
-                except Exception:   # noqa: BLE001 — bucket isolation
+                except Exception as e:   # noqa: BLE001 — bucket isolation
                     LOG.exception(
                         "fleet batched launch failed for bucket %s (%s)",
                         key, [t.cluster_id for t in group])
                     for t in group:
                         skipped[t.cluster_id] = "launch failed"
+                        failed[t.cluster_id] = (
+                            f"launch failed: {type(e).__name__}")
                     continue
                 launches += 1
                 for t, res, gen in zip(group, results, gens):
                     now = now_ms if now_ms is not None else t.cc._now_ms()
-                    if t.last_refresh_ms is not None:
-                        age_ms = max(now - t.last_refresh_ms, 0.0)
-                        t.staleness_ms.append(age_ms)
-                        self._staleness_timer.record(age_ms / 1000.0)
-                    t.cc.install_proposal_cache(res, computed_ms=now)
-                    t.optimized_generation = gen
-                    t.last_round_seq = self._round_seq
-                    t.last_refresh_ms = now
-                    t.refreshes += 1
+                    self._install_tenant(t, res, gen, now)
                     optimized.append(t.cluster_id)
             self.launches += launches
             spilled = self.enforce_memory_budget()
@@ -278,6 +754,7 @@ class FleetScheduler:
                             for k, g in buckets.items()},
                 "optimized": optimized,
                 "skipped": skipped,
+                "failed": failed,
                 "spilled": spilled,
                 "deviceBytes": self.device_bytes(),
             }
@@ -321,8 +798,11 @@ class FleetScheduler:
 
     # --------------------------------------------------- precompute thread
     def start_precompute(self, interval_ms: float | None = None) -> None:
-        """The fleet's precompute loop (threaded service mode): keep every
-        tenant's proposal cache fresh by running rounds on a cadence."""
+        """The fleet's serving loop (threaded service mode): full rounds on
+        the precompute cadence keep every tenant's cache fresh, and an
+        enqueued request (detector heal, user rebalance) WAKES the loop for
+        an immediate dispatch instead of waiting out the interval — the
+        continuous-batching half of the admission engine."""
         if self._thread is not None:
             return
         if interval_ms is None:
@@ -330,9 +810,22 @@ class FleetScheduler:
         self._stop.clear()
 
         def loop():
-            while not self._stop.wait(interval_ms / 1000.0):
+            while not self._stop.is_set():
+                woken = self._work.wait(interval_ms / 1000.0)
+                if self._stop.is_set():
+                    return
+                self._work.clear()
                 try:
-                    self.run_round()
+                    if woken and self.admission_enabled:
+                        # drain just the queued requests (low latency);
+                        # the next interval expiry still runs a full round
+                        for _ in range(len(self.tenants) + 4):
+                            d = self.dispatch_once()
+                            if d is None or (d["launches"] == 0
+                                             and not d["failed"]):
+                                break
+                    else:
+                        self.run_round()
                 except Exception:    # noqa: BLE001
                     LOG.exception("fleet precompute round failed")
 
@@ -352,6 +845,49 @@ class FleetScheduler:
             self.remove_tenant(cid)
 
     # ---------------------------------------------------------------- state
+    def admission_state_json(self) -> dict:
+        """Queue depth / lane occupancy / serving SLOs — served under the
+        REST ``/state`` FLEET substate and consumed by tools/queue_view.py."""
+        with self._lock:
+            now = self._clock_ms
+            lanes = {name: {"depth": 0, "oldestSeq": None,
+                            "oldestAgeMs": None} for name in LANE_NAMES}
+            for per_lane in self._requests.values():
+                for lane, r in per_lane.items():
+                    d = lanes[LANE_NAMES[lane]]
+                    d["depth"] += 1
+                    if d["oldestSeq"] is None or r.seq < d["oldestSeq"]:
+                        d["oldestSeq"] = r.seq
+                        d["oldestAgeMs"] = (max(now - r.enqueued_ms, 0.0)
+                                            if now else None)
+            heal = sorted(self.heal_admission_ms)
+
+            def _pct(p):
+                if not heal:
+                    return None
+                return float(heal[max(0, -(-len(heal) * p // 100) - 1)])
+
+            return {
+                "enabled": self.admission_enabled,
+                "maxBatch": self.max_batch,
+                "quantizeBatch": self.quantize_batch,
+                "nearJoinPressure": self.join_pressure,
+                "queueDepth": self.queue_depth(),
+                "queuePressure": self.queue_pressure(),
+                "lanes": lanes,
+                "enqueued": self.requests_enqueued,
+                "coalesced": self.requests_coalesced,
+                "admitted": self.requests_admitted,
+                "requeued": self.requests_requeued,
+                "failed": self.requests_failed,
+                "dispatches": self.dispatches,
+                "joins": self.joins,
+                "splits": self.splits,
+                "healAdmissionP50Ms": _pct(50),
+                "healAdmissionP95Ms": _pct(95),
+                "lastDispatch": dict(self.last_dispatch),
+            }
+
     def state_json(self) -> dict:
         with self._lock:
             return {
@@ -362,4 +898,5 @@ class FleetScheduler:
                 "deviceBytes": self.device_bytes(),
                 "memoryBudgetBytes": self.memory_budget_bytes,
                 "lastRound": dict(self.last_round),
+                "admission": self.admission_state_json(),
             }

@@ -59,6 +59,14 @@ from cruise_control_tpu.monitor.load_monitor import (
 
 LOG = logging.getLogger(__name__)
 
+# Priority lanes shared with the fleet admission engine (fleet.py): lower
+# value drains first. Heals (detector FIX/PREDICTED verdicts) preempt
+# user-initiated hygiene rebalances, which preempt background refresh.
+LANE_HEAL = 0
+LANE_REBALANCE = 1
+LANE_REFRESH = 2
+LANE_NAMES = ("heal", "rebalance", "refresh")
+
 
 def _bucket(n: int, minimum: int = 64) -> int:
     """Power-of-two shape bucket (the model's bucketing policy, host-side)."""
@@ -142,6 +150,14 @@ class ProposalRound:
     execute_kw: dict = dataclasses.field(default_factory=dict)
     submitted_ms: float = 0.0
     sticky: bool = False
+    # admission-lane priority (LANE_*): drain order is (lane, seq) so a
+    # re-queued refresh round can never jump ahead of a queued heal
+    lane: int = LANE_REFRESH
+    # launch-in-flight install seam (fleet admission engine): when set to
+    # (result, computed_ms), the drain installs the proposal cache instead
+    # of executing — exempt from staleness/supersede drops (idempotent
+    # cache write, the install itself records its generation)
+    install: tuple | None = None
 
 
 class PipelinedServiceLoop:
@@ -186,6 +202,7 @@ class PipelinedServiceLoop:
         self._exec_lock = threading.Lock()
         self.stale_rounds_dropped = 0
         self.executions_drained = 0
+        self.installs_drained = 0
         self._last_exec_seq = -1
         # threaded mode
         self._stop = threading.Event()
@@ -290,19 +307,41 @@ class PipelinedServiceLoop:
         return bool(self._threads)
 
     def submit_execution(self, proposals: list, execute_kw: dict | None = None,
-                         sticky: bool = False) -> ProposalRound:
+                         sticky: bool = False,
+                         lane: int | None = None) -> ProposalRound:
         """Queue one generation-tagged proposal set for async execution.
         The tag is the monitor's CURRENT metadata generation; the drain
         drops the set if the metadata generation moved (the cluster the plan
         was computed against no longer exists) or a newer set superseded it.
-        ``sticky`` (routed FIX heals) exempts the round from both drops."""
+        ``sticky`` (routed FIX heals) exempts the round from both drops.
+        ``lane`` defaults to the heal lane for sticky rounds and the refresh
+        lane otherwise; the drain processes (lane, seq) order."""
         gen = self.monitor.model_generation().metadata_generation
+        if lane is None:
+            lane = LANE_HEAL if sticky else LANE_REFRESH
         with self._exec_lock:
             rnd = ProposalRound(seq=self._exec_seq, metadata_generation=gen,
                                 proposals=list(proposals),
                                 execute_kw=dict(execute_kw or {}),
                                 submitted_ms=self.cc._now_ms(),
-                                sticky=sticky)
+                                sticky=sticky, lane=int(lane))
+            self._exec_seq += 1
+            self._exec_queue.append(rnd)
+        self._wake_exec.set()
+        return rnd
+
+    def submit_install(self, result, computed_ms: float | None = None,
+                       lane: int = LANE_REFRESH) -> ProposalRound:
+        """Queue a proposal-cache install to ride the execute stage — the
+        fleet admission engine's launch-in-flight seam: the scheduler hands
+        a completed tenant's batched result here and starts its next vmapped
+        launch immediately; the install lands on this loop's thread."""
+        with self._exec_lock:
+            rnd = ProposalRound(seq=self._exec_seq, metadata_generation=-1,
+                                proposals=[],
+                                submitted_ms=self.cc._now_ms(),
+                                lane=int(lane),
+                                install=(result, computed_ms))
             self._exec_seq += 1
             self._exec_queue.append(rnd)
         self._wake_exec.set()
@@ -317,16 +356,28 @@ class PipelinedServiceLoop:
             pending = list(self._exec_queue)
             self._exec_queue.clear()
         if not pending:
-            return {"executed": 0, "dropped": 0}
+            return {"executed": 0, "dropped": 0, "installed": 0}
+        # lane-aware drain order: heals before hygiene rebalances before
+        # background refresh, seq within a lane — so a round re-queued while
+        # an execution owned the executor can never jump ahead of a heal
+        # that arrived after it
+        pending.sort(key=lambda r: (r.lane, r.seq))
         current_gen = self.monitor.model_generation().metadata_generation
         executed = 0
         dropped = 0
+        installed = 0
         # sticky (routed-heal) rounds never supersede or get superseded by
         # the precompute's rebalance rounds — newest-wins applies to the
-        # ordinary rounds only
-        ordinary = [r.seq for r in pending if not r.sticky]
-        newest = ordinary[-1] if ordinary else -1
+        # ordinary rounds only; install rounds are cache writes, exempt
+        ordinary = [r.seq for r in pending if not r.sticky and r.install is None]
+        newest = max(ordinary) if ordinary else -1
         for i, rnd in enumerate(pending):
+            if rnd.install is not None:
+                res, computed_ms = rnd.install
+                self.cc.install_proposal_cache(res, computed_ms=computed_ms)
+                installed += 1
+                self.installs_drained += 1
+                continue
             stale = (not rnd.sticky
                      and (rnd.metadata_generation != current_gen
                           or rnd.seq != newest))
@@ -356,10 +407,12 @@ class PipelinedServiceLoop:
             self.executions_drained += 1
             self._exec_meter.mark()
             self._last_exec_seq = rnd.seq
-        if executed or dropped:
+        if executed or dropped or installed:
             self.recorder.note_stage("execute", t0, time.monotonic(),
-                                     executed=executed, dropped=dropped)
-        return {"executed": executed, "dropped": dropped}
+                                     executed=executed, dropped=dropped,
+                                     installed=installed)
+        return {"executed": executed, "dropped": dropped,
+                "installed": installed}
 
     # ----------------------------------------------------------- lockstep
     def step(self, now_ms: float | None = None, optimize: bool = True) -> dict:
@@ -499,6 +552,7 @@ class PipelinedServiceLoop:
             "syncRounds": self.sync_rounds,
             "optimizeRounds": self.optimize_rounds,
             "executionsDrained": self.executions_drained,
+            "installsDrained": self.installs_drained,
             "staleRoundsDropped": self.stale_rounds_dropped,
             "syncedGeneration": self._synced_generation,
             "optimizedGeneration": self._optimized_generation,

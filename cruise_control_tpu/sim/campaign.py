@@ -647,6 +647,131 @@ def run_moving_workload_campaign(seed: int = 0,
                           episodes=episodes, scenarios=scenarios)
 
 
+# ----------------------------------------------------------------- serving
+def _serving_backend(seed: int, num_brokers: int = 6,
+                     num_partitions: int = 24, rf: int = 2):
+    """One tiny tenant cluster — small enough that every tenant pads into
+    the SAME default shape bucket (one compiled program pool fleet-wide)."""
+    import numpy as np
+    from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+    rng = np.random.default_rng(seed)
+    be = SimulatedClusterBackend()
+    for b in range(num_brokers):
+        be.add_broker(b, f"r{b % 3}")
+    for p in range(num_partitions):
+        reps = [int(x) for x in rng.choice(num_brokers, size=rf,
+                                           replace=False)]
+        be.create_partition(f"t{p % 4}", p, reps,
+                            size_mb=float(rng.uniform(10, 400)),
+                            bytes_in_rate=float(rng.uniform(1, 40)),
+                            bytes_out_rate=float(rng.uniform(1, 80)),
+                            cpu_util=float(rng.uniform(0.1, 4)))
+    return be
+
+
+SERVING_GOALS = ["ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+
+
+def build_serving_fleet(num_tenants: int, seed: int = 0,
+                        admission: bool = True, config_over=None):
+    """A fleet of ``num_tenants`` same-bucket tenants with filled metric
+    windows, ready for the serving drive. Short 2-goal chain keeps the
+    per-(chain, bucket, K) compile pool cheap; quantized admission bounds
+    the K-variants to the power-of-two ladder."""
+    from cruise_control_tpu.config import cruise_control_config
+    from cruise_control_tpu.fleet import FleetScheduler
+    props = {
+        "anomaly.detection.interval.ms": 10_000_000,
+        "goals": ",".join(SERVING_GOALS),
+        "hard.goals": "ReplicaCapacityGoal",
+        "fleet.admission.enabled": admission,
+        "fleet.admission.quantize.batch": True,
+    }
+    props.update(config_over or {})
+    fleet = FleetScheduler(config=cruise_control_config(dict(props)))
+    for i in range(num_tenants):
+        t = fleet.add_tenant(f"tenant-{i:03d}",
+                             backend=_serving_backend(seed * 1000 + i),
+                             config=cruise_control_config(dict(props)))
+        for w in range(6):
+            t.cc.load_monitor.sample_once(now_ms=w * 300_000.0)
+    return fleet
+
+
+def run_serving_load(num_tenants: int = 50, seed: int = 0,
+                     duration_ms: float = 120_000.0, mode: str = "admission",
+                     heal_rate_per_min: float = 12.0,
+                     rebalance_rate_per_min: float = 6.0,
+                     refresh_interval_ms: float = 15_000.0,
+                     dispatch_interval_ms: float = 1_000.0,
+                     round_interval_ms: float = 30_000.0,
+                     config_over=None) -> dict:
+    """One serving leg: build the fleet, warm the compile pool, then drive
+    the Poisson request load (sim/runner.ServingLoadDriver) through either
+    the admission engine or the static-round baseline. The measured phase
+    starts after warmup, so proposals/sec and heal-admission latency
+    reflect the steady serving regime, not compiles."""
+    from cruise_control_tpu.sim.runner import ServingLoadDriver
+    fleet = build_serving_fleet(num_tenants, seed=seed,
+                                admission=(mode == "admission"),
+                                config_over=config_over)
+    try:
+        t0 = 2_000_000.0
+        if mode == "admission":
+            # prewarm the power-of-two launch ladder so the measured phase
+            # reuses compiled K-variants (zero new compiles in steady state)
+            cids = fleet.cluster_ids
+            k = 1
+            ladder = []
+            while k <= min(fleet.max_batch, num_tenants):
+                ladder.append(k)
+                k *= 2
+            for k in reversed(ladder):
+                for cid in cids[:k]:
+                    fleet.enqueue(cid, reason="warmup", now_ms=t0)
+                fleet.dispatch_once(now_ms=t0)
+            fleet.run_round(now_ms=t0 + 1.0)   # drain the remainder
+        else:
+            fleet.run_round(now_ms=t0)         # one static sweep, all due
+        driver = ServingLoadDriver(
+            fleet, fleet.cluster_ids, seed=seed,
+            heal_rate_per_min=heal_rate_per_min,
+            rebalance_rate_per_min=rebalance_rate_per_min,
+            refresh_interval_ms=refresh_interval_ms,
+            dispatch_interval_ms=dispatch_interval_ms,
+            round_interval_ms=round_interval_ms)
+        out = driver.run(mode, t0_ms=t0 + 10_000.0, duration_ms=duration_ms)
+        if mode == "admission":
+            out["admission"] = fleet.admission_state_json()
+        return out
+    finally:
+        fleet.shutdown()
+
+
+def run_serving_campaign(num_tenants: int = 50, seed: int = 0,
+                         duration_ms: float = 120_000.0, **kw) -> dict:
+    """The serving A/B (bench.py --serving): identical Poisson request
+    stream through the admission engine and the static-round baseline.
+    Deltas are the ISSUE-18 acceptance axis — sustained proposals/sec up,
+    p95 heal-admission latency below the baseline's full-round wait."""
+    engine = run_serving_load(num_tenants, seed, duration_ms,
+                              mode="admission", **kw)
+    baseline = run_serving_load(num_tenants, seed, duration_ms,
+                                mode="static", **kw)
+    e95 = (engine["healAdmissionMs"]["p95"] or 0.0)
+    b95 = (baseline["healAdmissionMs"]["p95"] or 0.0)
+    return {
+        "tenants": num_tenants,
+        "seed": seed,
+        "engine": engine,
+        "baseline": baseline,
+        "proposalsPerSecSpeedup": round(
+            engine["proposalsPerSec"] / max(baseline["proposalsPerSec"],
+                                            1e-9), 3),
+        "healP95ImprovementX": round(b95 / max(e95, 1e-9), 3),
+    }
+
+
 # ------------------------------------------------------------------ catalog
 _MICRO_CLUSTER = ClusterSpec(num_brokers=12, num_racks=3,
                              topics=(("t0", 60, 2), ("t1", 60, 2)),

@@ -676,3 +676,171 @@ def run_scenario(scenario: Scenario, seed: int = 0,
     """Build + run one scenario; returns the (deterministic) result."""
     return ScenarioRunner(scenario, seed=seed,
                           settle_ticks=settle_ticks).run()
+
+
+# ---------------------------------------------------------------- serving
+class ServingLoadDriver:
+    """Poisson request-load driver for the fleet admission engine (PR 18).
+
+    Generates a seeded, merge-sorted stream of optimization-request
+    arrivals on SIMULATED time — heal-lane (detector verdicts) and
+    rebalance-lane (user hygiene) events as independent Poisson processes,
+    plus a fixed-cadence per-tenant sampling schedule (the "delta sync
+    going due" refresh source) — and drives a
+    :class:`~cruise_control_tpu.fleet.FleetScheduler` through it in one of
+    two modes:
+
+    - ``admission``: arrivals enqueue on the engine's lanes as they land;
+      one ``dispatch_once`` per tick (continuous batching). Heal-admission
+      latency comes from the scheduler's own enqueue->install accounting.
+    - ``static``: arrivals wait for the legacy sweep; ``run_round`` fires
+      on the round cadence and a request completes when its tenant next
+      appears in ``report["optimized"]`` — the full-round-wait baseline.
+
+    Determinism: arrivals and tick clocks derive only from (seed, rates,
+    duration); same inputs => identical admitted sets and event stream.
+    """
+
+    def __init__(self, fleet, tenant_ids: list, seed: int = 0,
+                 heal_rate_per_min: float = 12.0,
+                 rebalance_rate_per_min: float = 6.0,
+                 refresh_interval_ms: float = 15_000.0,
+                 dispatch_interval_ms: float = 1_000.0,
+                 round_interval_ms: float = 30_000.0):
+        import random
+        from cruise_control_tpu.pipeline import LANE_HEAL, LANE_REBALANCE
+        self.fleet = fleet
+        self.tenant_ids = list(tenant_ids)
+        self.rng = random.Random(seed)
+        self.heal_rate_per_min = float(heal_rate_per_min)
+        self.rebalance_rate_per_min = float(rebalance_rate_per_min)
+        self.refresh_interval_ms = float(refresh_interval_ms)
+        self.dispatch_interval_ms = float(dispatch_interval_ms)
+        self.round_interval_ms = float(round_interval_ms)
+        self._lane_heal = LANE_HEAL
+        self._lane_rebalance = LANE_REBALANCE
+
+    def arrivals(self, t0_ms: float, duration_ms: float) -> list:
+        """The merged (t_ms, lane, cluster_id) stream: two independent
+        exponential-interarrival processes, tenants drawn uniformly."""
+        out = []
+        for lane, per_min in ((self._lane_heal, self.heal_rate_per_min),
+                              (self._lane_rebalance,
+                               self.rebalance_rate_per_min)):
+            if per_min <= 0:
+                continue
+            mean_ms = 60_000.0 / per_min
+            t = t0_ms
+            while True:
+                t += self.rng.expovariate(1.0 / mean_ms) * 1.0
+                if t >= t0_ms + duration_ms:
+                    break
+                out.append((t, lane, self.rng.choice(self.tenant_ids)))
+        out.sort(key=lambda e: (e[0], e[1], e[2]))
+        return out
+
+    def run(self, mode: str, t0_ms: float, duration_ms: float) -> dict:
+        """Drive one measured phase; returns the serving metrics."""
+        import time as _time
+        from cruise_control_tpu.pipeline import LANE_NAMES, LANE_REFRESH
+        fleet = self.fleet
+        events = self.arrivals(t0_ms, duration_ms)
+        ev_i = 0
+        next_sample = {cid: t0_ms + self.refresh_interval_ms
+                       for cid in self.tenant_ids}
+        installs0 = sum(fleet.tenants[c].refreshes for c in self.tenant_ids)
+        launches0 = fleet.launches
+        heal0 = len(fleet.heal_admission_ms)
+        lane_counts = {name: 0 for name in LANE_NAMES}
+        pending: dict[str, list] = {}    # static mode: cid -> [(t, lane)]
+        heal_waits: list = []            # static mode driver accounting
+        dispatches = 0
+        now = t0_ms
+        next_round = t0_ms + self.round_interval_ms
+        t_end = t0_ms + duration_ms
+        wall0 = _time.monotonic()
+        while now < t_end:
+            now = min(now + self.dispatch_interval_ms, t_end)
+            # the refresh source: per-tenant sampling cadence goes due
+            for cid, ts in next_sample.items():
+                if ts <= now:
+                    t = fleet.tenants[cid]
+                    t.cc.load_monitor.sample_once(now_ms=ts)
+                    next_sample[cid] = ts + self.refresh_interval_ms
+                    if mode == "admission":
+                        fleet.enqueue(cid, LANE_REFRESH, reason="due",
+                                      now_ms=ts)
+                        lane_counts["refresh"] += 1
+            # Poisson arrivals landing in this tick
+            while ev_i < len(events) and events[ev_i][0] <= now:
+                t_arr, lane, cid = events[ev_i]
+                ev_i += 1
+                lane_counts[LANE_NAMES[lane]] += 1
+                if mode == "admission":
+                    fleet.enqueue(cid, lane, reason="poisson", now_ms=t_arr)
+                else:
+                    pending.setdefault(cid, []).append((t_arr, lane))
+            if mode == "admission":
+                d = fleet.dispatch_once(now_ms=now)
+                if d is not None and d["launches"]:
+                    dispatches += 1
+            elif now >= next_round or now >= t_end:
+                report = fleet.run_round(now_ms=now)
+                dispatches += 1
+                for cid in report["optimized"]:
+                    for t_arr, lane in pending.pop(cid, []):
+                        if lane == self._lane_heal:
+                            heal_waits.append(max(now - t_arr, 0.0))
+                while next_round <= now:
+                    next_round += self.round_interval_ms
+        if mode != "admission":
+            # flush: stragglers wait out further full rounds (honest tail —
+            # a static sweep only serves a tenant once it goes due again)
+            for _ in range(4):
+                if not any(pending.values()):
+                    break
+                now += self.round_interval_ms
+                for cid in list(pending):
+                    t = fleet.tenants[cid]
+                    t.cc.load_monitor.sample_once(now_ms=now)
+                report = fleet.run_round(now_ms=now)
+                for cid in report["optimized"]:
+                    for t_arr, lane in pending.pop(cid, []):
+                        if lane == self._lane_heal:
+                            heal_waits.append(max(now - t_arr, 0.0))
+        else:
+            # flush the engine's remaining queue (bounded)
+            for _ in range(len(self.tenant_ids) + 4):
+                now += self.dispatch_interval_ms
+                d = fleet.dispatch_once(now_ms=now)
+                if d is None or (d["launches"] == 0 and not d["failed"]):
+                    break
+                dispatches += 1
+            heal_waits = list(fleet.heal_admission_ms)[heal0:]
+        wall_s = _time.monotonic() - wall0
+        installs = (sum(fleet.tenants[c].refreshes for c in self.tenant_ids)
+                    - installs0)
+        heal_sorted = sorted(heal_waits)
+
+        def _pct(p):
+            if not heal_sorted:
+                return None
+            return float(
+                heal_sorted[max(0, -(-len(heal_sorted) * p // 100) - 1)])
+
+        return {
+            "mode": mode,
+            "tenants": len(self.tenant_ids),
+            "simDurationMs": duration_ms,
+            "requests": lane_counts,
+            "installs": installs,
+            "launches": fleet.launches - launches0,
+            "dispatches": dispatches,
+            "wallS": round(wall_s, 3),
+            "proposalsPerSec": round(installs / max(wall_s, 1e-9), 3),
+            "healAdmissionMs": {"n": len(heal_sorted), "p50": _pct(50),
+                                "p95": _pct(95),
+                                "max": (heal_sorted[-1]
+                                        if heal_sorted else None)},
+            "queueDepthEnd": fleet.queue_depth(),
+        }

@@ -243,7 +243,7 @@ def test_stale_generation_round_dropped_not_executed(warm_loop):
     pipe.submit_execution(res.proposals[:2])
     be.add_broker(90 + dropped_before, "r9")   # metadata generation bump
     out = pipe.drain_executions()
-    assert out == {"executed": 0, "dropped": 1}
+    assert out == {"executed": 0, "dropped": 1, "installed": 0}
     assert pipe.stale_rounds_dropped == dropped_before + 1
     assert cc.executor.state_json()["numExecutions"] == execs_before
 
@@ -339,7 +339,7 @@ def test_sticky_round_survives_generation_bump():
     pipe.submit_execution(res.proposals[1:2], sticky=True)    # routed heal
     be.add_broker(97, "r9")                  # metadata generation bump
     out = pipe.drain_executions()
-    assert out == {"executed": 1, "dropped": 1}
+    assert out == {"executed": 1, "dropped": 1, "installed": 0}
 
 
 # ------------------------------------------------------------- determinism

@@ -1,0 +1,332 @@
+"""Serving certification (PR 18): the request-admission engine.
+
+The continuous-batching contracts:
+
+1. **Zero-pressure parity** — with no queue pressure the admission round
+   (poll -> enqueue due -> drain) installs per-tenant violation/
+   certificate/proposal sets and final assignment arrays BIT-IDENTICAL to
+   the legacy static bucket round (``fleet.admission.enabled`` off).
+2. **Admission determinism** — the admitted set and the admission journal
+   are pure functions of (scenario, seed): the same scripted arrival
+   stream replayed into a fresh fleet reproduces them exactly, and the
+   Poisson driver's arrival stream is seed-stable.
+3. **Priority lanes** — a heal request enqueued LAST preempts earlier
+   hygiene rebalances, which preempt background refreshes; lane dispatch
+   across the prewarmed K ladder costs ZERO new XLA compiles.
+4. **Mid-launch arrivals** — a request arriving after a dispatch admitted
+   its batch is NOT lost: it rides the next dispatch.
+5. **Pad-to-join vs split-launch** — NEAR buckets join (the smaller
+   tenants rebuild with pad floors into the larger bucket, one launch)
+   exactly when measured queue pressure reaches the threshold, and split
+   into per-bucket launches below it.
+6. **Launch-failure surfacing** — a failed batched launch lands in the
+   report's ``failed`` map; heal-lane requests re-enqueue with a bounded
+   retry budget instead of being dropped.
+
+Shapes and the 2-goal chain are deliberately tiny and shared across every
+test so the whole module rides a handful of compiled programs.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.tracing import XlaCompileListener
+from cruise_control_tpu.config import cruise_control_config
+from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+from cruise_control_tpu.fleet import FleetScheduler
+from cruise_control_tpu.pipeline import (
+    LANE_HEAL, LANE_REBALANCE, LANE_REFRESH,
+)
+from cruise_control_tpu.sim.runner import ServingLoadDriver
+
+WINDOW_MS = 300_000.0
+T0 = 2_000_000.0
+GOALS = ["ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+SEEDS = (21, 22, 23)
+
+
+def _backend(seed, num_brokers=10, num_partitions=60, rf=2):
+    rng = np.random.default_rng(seed)
+    be = SimulatedClusterBackend()
+    for b in range(num_brokers):
+        be.add_broker(b, f"r{b % 3}")
+    for p in range(num_partitions):
+        reps = [int(x) for x in rng.choice(num_brokers, size=rf,
+                                           replace=False)]
+        be.create_partition(f"t{p % 6}", p, reps,
+                            size_mb=float(rng.uniform(10, 500)),
+                            bytes_in_rate=float(rng.uniform(1, 50)),
+                            bytes_out_rate=float(rng.uniform(1, 100)),
+                            cpu_util=float(rng.uniform(0.1, 5)))
+    return be
+
+
+def _cfg(**over):
+    props = {"anomaly.detection.interval.ms": 10_000_000,
+             "goals": ",".join(GOALS),
+             "hard.goals": "ReplicaCapacityGoal"}
+    props.update(over)
+    return cruise_control_config(props)
+
+
+def _sample(cc, lo=0, hi=6):
+    for i in range(lo, hi):
+        cc.load_monitor.sample_once(now_ms=i * WINDOW_MS)
+
+
+def _goal_sets(res):
+    """(violated set, certificate rows, proposal rows) — the parity unit."""
+    return (
+        sorted(g.name for g in res.goal_results if g.violated_after),
+        sorted((g.name, g.fixpoint_proven, g.moves_remaining,
+                g.leads_remaining, g.swap_window_remaining)
+               for g in res.goal_results),
+        sorted((p.topic, p.partition, p.new_leader, p.new_replicas)
+               for p in res.proposals))
+
+
+def _build_fleet(prefix: str, seeds=SEEDS, **cfg_over):
+    fleet = FleetScheduler(config=_cfg(**cfg_over))
+    for s in seeds:
+        t = fleet.add_tenant(f"{prefix}-{s}", backend=_backend(s),
+                             config=_cfg(**cfg_over))
+        _sample(t.cc)
+    return fleet
+
+
+@pytest.fixture(scope="module")
+def engine3():
+    """Three same-bucket tenants past their first admission round, with the
+    K in {3, 2, 1} launch variants prewarmed so lane tests compile nothing."""
+    fleet = _build_fleet("tenant")
+    report = fleet.run_round(now_ms=T0)
+    assert sorted(report["optimized"]) == sorted(
+        f"tenant-{s}" for s in SEEDS), report
+    for k in (2, 1):
+        for s in SEEDS[:k]:
+            fleet.enqueue(f"tenant-{s}", LANE_REFRESH, "prewarm",
+                          now_ms=T0 + 1_000.0)
+        d = fleet.dispatch_once(now_ms=T0 + 2_000.0)
+        assert d is not None and len(d["admitted"]) == k, d
+    yield fleet
+    fleet.shutdown()
+
+
+# ------------------------------------------------------ zero-pressure parity
+def test_zero_pressure_bit_parity_vs_static_round():
+    """Contract 1: no queue pressure => the admission round is the static
+    round — same launches/optimized report, bit-identical installs."""
+    fa = _build_fleet("par")                                  # admission on
+    fb = _build_fleet("par", **{"fleet.admission.enabled": False})
+    try:
+        ra = fa.run_round(now_ms=T0)
+        rb = fb.run_round(now_ms=T0)
+        assert ra["launches"] == rb["launches"] == 1
+        assert sorted(ra["buckets"]) == sorted(rb["buckets"])
+        assert sorted(ra["optimized"]) == sorted(rb["optimized"])
+        assert ra["skipped"] == rb["skipped"] == {}
+        for s in SEEDS:
+            a = fa.app_for(f"par-{s}").cached_proposals()
+            b = fb.app_for(f"par-{s}").cached_proposals()
+            assert _goal_sets(a) == _goal_sets(b), f"tenant {s}"
+            for leaf in ("replica_broker", "replica_is_leader",
+                         "replica_disk"):
+                va = np.asarray(getattr(a.final_state, leaf))
+                vb = np.asarray(getattr(b.final_state, leaf))
+                assert np.array_equal(va, vb), f"tenant {s} {leaf}"
+        # a second zero-pressure round skips everybody identically
+        assert fa.run_round(now_ms=T0 + 100.0)["skipped"] \
+            == fb.run_round(now_ms=T0 + 100.0)["skipped"]
+    finally:
+        fa.shutdown()
+        fb.shutdown()
+
+
+# --------------------------------------------------- admission determinism
+def _scripted_drive(fleet, prefix: str) -> tuple[list[str], dict]:
+    cids = [f"{prefix}-{s}" for s in SEEDS]
+    fleet.max_batch = 2
+    fleet.enqueue(cids[0], LANE_HEAL, "verdict", now_ms=T0 + 100.0)
+    fleet.enqueue(cids[1], LANE_REBALANCE, "hygiene", now_ms=T0 + 200.0)
+    fleet.enqueue(cids[2], LANE_REFRESH, "due", now_ms=T0 + 300.0)
+    fleet.enqueue(cids[1], LANE_HEAL, "verdict", now_ms=T0 + 400.0)
+    fleet.enqueue(cids[0], LANE_HEAL, "verdict dup", now_ms=T0 + 500.0)
+    for _ in range(6):
+        d = fleet.dispatch_once(now_ms=T0 + 1_000.0)
+        if d is None or (d["launches"] == 0 and not d["failed"]):
+            break
+    lines = [ln for ln in fleet.journal.lines() if '"admission"' in ln]
+    adm = fleet.admission_state_json()
+    return lines, adm
+
+
+def test_admission_deterministic_per_seed():
+    """Contract 2: identical scripted streams into fresh fleets reproduce
+    the admission journal and counters exactly; the Poisson driver's
+    arrival stream is a pure function of its seed."""
+    d7a = ServingLoadDriver(None, ["a", "b", "c"], seed=7)
+    d7b = ServingLoadDriver(None, ["a", "b", "c"], seed=7)
+    d8 = ServingLoadDriver(None, ["a", "b", "c"], seed=8)
+    ev7a = d7a.arrivals(0.0, 120_000.0)
+    assert ev7a == d7b.arrivals(0.0, 120_000.0)
+    assert ev7a != d8.arrivals(0.0, 120_000.0)
+    assert ev7a, "empty arrival stream"
+
+    f1 = _build_fleet("det")
+    f2 = _build_fleet("det")
+    try:
+        f1.run_round(now_ms=T0)
+        f2.run_round(now_ms=T0)
+        lines1, adm1 = _scripted_drive(f1, "det")
+        lines2, adm2 = _scripted_drive(f2, "det")
+        assert lines1 == lines2
+        assert any('"ev":"coalesce"' in ln for ln in lines1)
+        for key in ("enqueued", "coalesced", "admitted", "dispatches",
+                    "queueDepth", "healAdmissionP95Ms"):
+            assert adm1[key] == adm2[key], key
+        assert adm1["queueDepth"] == 0
+    finally:
+        f1.shutdown()
+        f2.shutdown()
+
+
+# ------------------------------------------------------------ priority lanes
+def test_heal_preempts_hygiene_preempts_refresh(engine3):
+    """Contract 3: admission order is (lane, seq) — the LAST-enqueued heal
+    dispatches first — and the prewarmed ladder keeps toggles compile-free."""
+    fleet = engine3
+    cids = [f"tenant-{s}" for s in SEEDS]
+    old_k = fleet.max_batch
+    listener = XlaCompileListener.install()
+    c0 = listener.count
+    try:
+        fleet.max_batch = 1
+        fleet.enqueue(cids[2], LANE_REFRESH, "due", now_ms=T0 + 10_000.0)
+        fleet.enqueue(cids[1], LANE_REBALANCE, "hygiene",
+                      now_ms=T0 + 11_000.0)
+        fleet.enqueue(cids[0], LANE_HEAL, "verdict", now_ms=T0 + 12_000.0)
+        order = []
+        for _ in range(3):
+            d = fleet.dispatch_once(now_ms=T0 + 13_000.0)
+            order.extend(d["admitted"])
+        assert order == [cids[0], cids[1], cids[2]]
+        assert fleet.queue_depth() == 0
+    finally:
+        fleet.max_batch = old_k
+    assert listener.count - c0 == 0, "lane/K toggle dispatches compiled"
+
+
+# --------------------------------------------------------- mid-launch arrival
+def test_mid_launch_arrival_rides_next_dispatch(engine3):
+    """Contract 4: a request landing after a batch was admitted is picked
+    up by the NEXT dispatch, not dropped and not joined retroactively."""
+    fleet = engine3
+    cids = [f"tenant-{s}" for s in SEEDS]
+    old_k = fleet.max_batch
+    try:
+        fleet.max_batch = 2
+        fleet.enqueue(cids[0], LANE_REFRESH, "due", now_ms=T0 + 20_000.0)
+        fleet.enqueue(cids[1], LANE_REFRESH, "due", now_ms=T0 + 20_500.0)
+        d1 = fleet.dispatch_once(now_ms=T0 + 21_000.0)
+        assert sorted(d1["admitted"]) == sorted(cids[:2])
+        # "mid-launch": lands while d1's batch installs
+        fleet.enqueue(cids[2], LANE_HEAL, "verdict", now_ms=T0 + 21_500.0)
+        assert fleet.queue_depth() == 1
+        d2 = fleet.dispatch_once(now_ms=T0 + 22_000.0)
+        assert d2["admitted"] == [cids[2]]
+        assert fleet.queue_depth() == 0
+    finally:
+        fleet.max_batch = old_k
+
+
+# ------------------------------------------------- pad-to-join vs split
+def test_near_join_vs_split_both_sides_of_threshold():
+    """Contract 5: below the pressure threshold NEAR buckets split-launch;
+    at the threshold the smaller bucket's tenants pad-to-join the larger
+    one and ride a single launch."""
+    assert FleetScheduler.near_buckets(
+        (1024, 16, 256, 16, 2, 1, 3), (1024, 20, 256, 16, 2, 1, 3))
+    assert not FleetScheduler.near_buckets(      # tail differs: racks
+        (1024, 16, 256, 16, 2, 1, 3), (1024, 20, 256, 16, 2, 1, 4))
+    assert not FleetScheduler.near_buckets(      # > 2x on a padded dim
+        (1024, 16, 256, 16, 2, 1, 3), (1024, 40, 256, 16, 2, 1, 3))
+
+    fleet = FleetScheduler(
+        config=_cfg(**{"fleet.admission.near.join.pressure": 3}))
+    a, b = f"near-{SEEDS[0]}", f"near-{SEEDS[1]}"
+    c = "near-wide"
+    for cid, seed, brokers in ((a, SEEDS[0], 10), (b, SEEDS[1], 10),
+                               (c, 24, 17)):     # 17 brokers -> B=20 bucket
+        t = fleet.add_tenant(cid, backend=_backend(seed,
+                                                   num_brokers=brokers),
+                             config=_cfg())
+        _sample(t.cc)
+    try:
+        for cid in (a, b, c):
+            fleet.tenants[cid].session.sync()
+        small = fleet.bucket_key(fleet.tenants[a].session)
+        large = fleet.bucket_key(fleet.tenants[c].session)
+        assert small[1] == 16 and large[1] == 20
+        assert FleetScheduler.near_buckets(small, large)
+
+        # below threshold (pressure 2 < 3): split-launch per bucket
+        fleet.enqueue(a, LANE_REFRESH, "due", now_ms=T0)
+        fleet.enqueue(c, LANE_REFRESH, "due", now_ms=T0 + 100.0)
+        d1 = fleet.dispatch_once(now_ms=T0 + 1_000.0)
+        assert d1["split"] is True and d1["joined"] == []
+        assert d1["admitted"] == [a]
+        d2 = fleet.dispatch_once(now_ms=T0 + 2_000.0)
+        assert d2["admitted"] == [c]
+        assert fleet.splits == 1 and fleet.joins == 0
+
+        # at threshold (pressure 3): pad-to-join into the large bucket
+        fleet.enqueue(a, LANE_REFRESH, "due", now_ms=T0 + 10_000.0)
+        fleet.enqueue(b, LANE_REFRESH, "due", now_ms=T0 + 10_100.0)
+        fleet.enqueue(c, LANE_REFRESH, "due", now_ms=T0 + 10_200.0)
+        d3 = fleet.dispatch_once(now_ms=T0 + 11_000.0)
+        assert d3["joined"] == sorted([a, b]), d3
+        assert sorted(d3["admitted"]) == sorted([a, b, c])
+        assert d3["launches"] == 1
+        assert fleet.joins == 1
+        # sticky floors: the joined tenants now LIVE in the large bucket
+        for cid in (a, b):
+            sess = fleet.tenants[cid].session
+            assert sess.bucket_floors == {"min_replicas": large[0],
+                                          "min_brokers": large[1],
+                                          "min_partitions": large[2],
+                                          "min_topics": large[3]}
+            assert fleet.bucket_key(sess) == large
+    finally:
+        fleet.shutdown()
+
+
+# ------------------------------------------------- launch-failure surfacing
+def test_launch_failure_surfaced_and_heal_requeued(engine3):
+    """Contract 6: a batched launch failure surfaces per tenant in the
+    report's ``failed`` map; the heal request survives with a retry budget
+    and installs on the next healthy dispatch."""
+    fleet = engine3
+    cid = f"tenant-{SEEDS[0]}"
+    real = fleet.optimizer.optimizations_batched
+
+    def boom(sessions):
+        raise RuntimeError("injected launch failure")
+
+    fleet.optimizer.optimizations_batched = boom
+    try:
+        fleet.enqueue(cid, LANE_HEAL, "verdict", now_ms=T0 + 30_000.0)
+        fleet.enqueue(f"tenant-{SEEDS[1]}", LANE_REFRESH, "due",
+                      now_ms=T0 + 30_100.0)
+        d = fleet.dispatch_once(now_ms=T0 + 31_000.0)
+        assert d["launches"] == 0
+        assert d["failed"].get(cid) == "launch failed: RuntimeError"
+        # heal re-enqueued (retries bumped); the refresh request dropped
+        assert fleet.queue_depth() == 1
+        req = fleet._requests[cid][LANE_HEAL]
+        assert req.retries == 1
+    finally:
+        fleet.optimizer.optimizations_batched = real
+    d = fleet.dispatch_once(now_ms=T0 + 32_000.0)
+    assert d["admitted"] == [cid] and d["launches"] == 1
+    assert fleet.queue_depth() == 0

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Render the request-admission engine's queues: per-lane depth/age tables
+and per-request admission traces (DESIGN §22).
+
+Input (auto-detected):
+  - a fleet state document — ``/state?substates=FLEET`` response, a bare
+    ``fleet.state_json()``, or its ``admission`` block alone — renders the
+    live lane table (depth, oldest request, age) and the engine counters;
+  - an EventJournal JSONL file (``journal.path``, a sim episode's journal
+    slice, or ``-`` for stdin) — reconstructs each request's lifecycle
+    (enqueue -> coalesce* -> dispatch -> install | requeue* -> fail) from
+    the ``kind:"admission"`` events and prints per-lane wait distributions
+    plus the dispatch/join/split tally;
+  - a serving campaign document (sim/campaign.run_serving_campaign output
+    or a bench summary's ``serving`` block) — renders its engine-side
+    admission state.
+
+Usage:
+  tools/queue_view.py STATE.json              # lane table + counters
+  tools/queue_view.py JOURNAL.jsonl           # per-lane admission rollup
+  tools/queue_view.py JOURNAL.jsonl --trace   # per-request event timelines
+  tools/queue_view.py IN --json               # machine-readable rollup
+
+Timestamps are the journal's clock — simulated ms for sim journals — so
+waits read in sim time, matching the serving bench's heal-admission SLO.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+LANE_ORDER = ("heal", "rebalance", "refresh")
+
+
+def _pctl(values: list[float], q: float) -> float | None:
+    """Nearest-rank percentile, matching fleet.admission_state_json."""
+    if not values:
+        return None
+    s = sorted(values)
+    idx = max(0, min(len(s) - 1, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+def load_input(raw: str) -> tuple[dict | None, list[dict]]:
+    """Returns (state_doc, admission_events). Exactly one side is
+    populated: a JSON document routes to the state path (after digging out
+    its admission block), JSONL routes to the journal path."""
+    raw = raw.strip()
+    if not raw:
+        return None, []
+    try:
+        doc = json.loads(raw)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict):
+        # journal slices travel inside documents too
+        if isinstance(doc.get("journal"), list):
+            events = [e if isinstance(e, dict) else json.loads(e)
+                      for e in doc["journal"]]
+            return None, [e for e in events if e.get("kind") == "admission"]
+        return find_admission(doc), []
+    if isinstance(doc, list):
+        events = [e if isinstance(e, dict) else json.loads(e) for e in doc]
+        return None, [e for e in events if e.get("kind") == "admission"]
+    events = []
+    for line in raw.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            e = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(e, dict) and e.get("kind") == "admission":
+            events.append(e)
+    return None, events
+
+
+def find_admission(doc: dict) -> dict | None:
+    """Dig the admission state block out of any supported document shape."""
+    if "lanes" in doc and "queueDepth" in doc:
+        return doc
+    for key in ("admission", "fleet", "FLEET", "engine", "serving"):
+        sub = doc.get(key)
+        if isinstance(sub, dict):
+            found = find_admission(sub)
+            if found is not None:
+                return found
+    return None
+
+
+def render_state(adm: dict) -> None:
+    print("request-admission engine "
+          f"({'enabled' if adm.get('enabled') else 'disabled'}; "
+          f"K={adm.get('maxBatch')}, quantize={adm.get('quantizeBatch')}, "
+          f"join pressure>={adm.get('nearJoinPressure')})")
+    lanes = adm.get("lanes") or {}
+    print(f"\n{'lane':<10}  {'depth':>5}  {'oldest seq':>10}  "
+          f"{'oldest age ms':>13}")
+    for name in LANE_ORDER:
+        row = lanes.get(name) or {}
+        seq = row.get("oldestSeq")
+        age = row.get("oldestAgeMs")
+        print(f"{name:<10}  {row.get('depth', 0):>5}  "
+              f"{'-' if seq is None else seq:>10}  "
+              f"{'-' if age is None else format(age, '.1f'):>13}")
+    print(f"\nqueue depth {adm.get('queueDepth')} across "
+          f"{adm.get('queuePressure')} tenant(s)")
+    print(f"enqueued {adm.get('enqueued')}  coalesced {adm.get('coalesced')}"
+          f"  admitted {adm.get('admitted')}  requeued {adm.get('requeued')}"
+          f"  failed {adm.get('failed')}")
+    print(f"dispatches {adm.get('dispatches')}  joins {adm.get('joins')}  "
+          f"splits {adm.get('splits')}")
+    p50, p95 = adm.get("healAdmissionP50Ms"), adm.get("healAdmissionP95Ms")
+    if p50 is not None:
+        print(f"heal admission p50 {p50:.1f} ms  p95 {p95:.1f} ms")
+
+
+def rollup(events: list[dict]) -> dict:
+    """Per-lane lifecycle rollup from admission journal events. Requests
+    are keyed (cid, seq); installs carry the authoritative waitMs."""
+    lanes: dict[str, dict] = {
+        name: {"enqueued": 0, "coalesced": 0, "installed": 0,
+               "requeued": 0, "failed": 0, "waits_ms": []}
+        for name in LANE_ORDER}
+    dispatches, joins, splits, ks = 0, 0, 0, []
+    requests: dict[tuple, dict] = {}
+    for e in events:
+        ev, lane = e.get("ev"), e.get("lane")
+        row = lanes.get(lane) if lane in lanes else None
+        key = (e.get("cid"), e.get("seq"))
+        if ev == "enqueue" and row is not None:
+            row["enqueued"] += 1
+            requests[key] = {"lane": lane, "cid": e.get("cid"),
+                             "seq": e.get("seq"), "t0": e.get("ts"),
+                             "reason": e.get("reason", ""), "events": []}
+        elif ev == "coalesce" and row is not None:
+            row["coalesced"] += 1
+        elif ev == "install" and row is not None:
+            row["installed"] += 1
+            wait = e.get("waitMs")
+            if wait is not None:
+                row["waits_ms"].append(float(wait))
+        elif ev == "requeue" and row is not None:
+            row["requeued"] += 1
+        elif ev == "fail" and row is not None:
+            row["failed"] += 1
+        elif ev == "dispatch":
+            dispatches += 1
+            ks.append(e.get("k", 0))
+        elif ev == "join":
+            joins += 1
+        elif ev == "split":
+            splits += 1
+        if key in requests and ev != "enqueue":
+            requests[key]["events"].append(e)
+    out = {"dispatches": dispatches, "joins": joins, "splits": splits,
+           "mean_k": (sum(ks) / len(ks)) if ks else None, "lanes": {}}
+    for name, row in lanes.items():
+        waits = row.pop("waits_ms")
+        row["wait_ms"] = {"n": len(waits), "p50": _pctl(waits, 0.50),
+                          "p95": _pctl(waits, 0.95),
+                          "max": max(waits) if waits else None}
+        out["lanes"][name] = row
+    out["_requests"] = requests
+    return out
+
+
+def render_rollup(roll: dict) -> None:
+    print(f"{'lane':<10}  {'enq':>5}  {'coal':>5}  {'inst':>5}  {'requ':>5}"
+          f"  {'fail':>5}  {'wait p50 ms':>11}  {'wait p95 ms':>11}")
+    for name in LANE_ORDER:
+        row = roll["lanes"][name]
+        w = row["wait_ms"]
+        p50 = "-" if w["p50"] is None else f"{w['p50']:.1f}"
+        p95 = "-" if w["p95"] is None else f"{w['p95']:.1f}"
+        print(f"{name:<10}  {row['enqueued']:>5}  {row['coalesced']:>5}  "
+              f"{row['installed']:>5}  {row['requeued']:>5}  "
+              f"{row['failed']:>5}  {p50:>11}  {p95:>11}")
+    mk = "-" if roll["mean_k"] is None else f"{roll['mean_k']:.1f}"
+    print(f"\ndispatches {roll['dispatches']} (mean K {mk})  "
+          f"joins {roll['joins']}  splits {roll['splits']}")
+
+
+def render_traces(roll: dict) -> None:
+    reqs = sorted(roll["_requests"].values(),
+                  key=lambda r: (r["t0"] or 0, r["seq"] or 0))
+    for r in reqs:
+        head = (f"#{r['seq']} {r['lane']}/{r['cid']} @ {r['t0']:.1f} ms")
+        if r["reason"]:
+            head += f"  ({r['reason']})"
+        print(head)
+        for e in r["events"]:
+            ev = e["ev"]
+            extra = ""
+            if ev == "install":
+                extra = f"  wait {e.get('waitMs')} ms"
+            elif ev == "requeue":
+                extra = f"  retry {e.get('retries')}: {e.get('reason')}"
+            elif ev == "fail":
+                extra = f"  {e.get('reason')}"
+            print(f"  {e.get('ts', 0):>10.1f}  {ev}{extra}")
+
+
+def main(argv: list[str]) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    if len(args) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    raw = sys.stdin.read() if args[0] == "-" else open(args[0]).read()
+    state, events = load_input(raw)
+    if state is not None:
+        if "--json" in argv:
+            print(json.dumps(state, indent=1))
+        else:
+            render_state(state)
+        return 0
+    if not events:
+        print("no admission state or admission journal events found",
+              file=sys.stderr)
+        return 2
+    roll = rollup(events)
+    if "--json" in argv:
+        out = {k: v for k, v in roll.items() if k != "_requests"}
+        print(json.dumps(out, indent=1))
+        return 0
+    render_rollup(roll)
+    if "--trace" in argv:
+        print()
+        render_traces(roll)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main(sys.argv[1:]))
+    except BrokenPipeError:   # `queue_view ... | head` closing the pipe
+        sys.exit(0)

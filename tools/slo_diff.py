@@ -25,6 +25,13 @@ with a top-level ``slo``, a bench summary with ``campaign.slo`` and/or
 ``rungs``, or a bare SLO mapping
 {kind: {time_to_detect_ms: {p50, p95, max}, ...}}.
 
+Serving inputs (PR 18): documents carrying a ``serving`` block (bench.py
+--serving) or a bare run_serving_campaign artifact are gated on the
+continuous-batching SLOs — engine proposals/sec dropping past the
+threshold, heal-admission p95 growing past it, the engine losing its
+strict (>1x) advantage over the static round on either axis,
+zero-pressure bit-parity loss, and fresh lane/K-toggle compiles.
+
 Journal inputs: an EventJournal JSONL file (``journal.path`` / a sim
 episode's journal slice written to disk) is ALSO accepted on either side —
 its SPAN-derived SLOs are gated instead: detect->heal latency per fault
@@ -414,6 +421,80 @@ def compare_forecast(base: dict, cand: dict, threshold: float = 0.25):
     return rows, regressions
 
 
+def extract_serving(doc: dict) -> dict:
+    """The serving-load block: a bench summary's ``serving`` rung
+    (bench.py --serving), a sim/campaign.run_serving_campaign document, or
+    {}."""
+    sv = doc.get("serving")
+    if isinstance(sv, dict) and "proposalsPerSecSpeedup" in sv:
+        return sv
+    if "proposalsPerSecSpeedup" in doc and "engine" in doc:
+        return doc
+    return {}
+
+
+def compare_serving(base: dict, cand: dict, threshold: float = 0.25):
+    """Gate the serving rung between two documents (PR 18): the engine's
+    proposals/sec falling more than the threshold below the baseline run's,
+    its heal-admission p95 growing past the threshold, the engine losing
+    its strict advantage over the static round (speedup or heal-p95
+    improvement dropping below 1x), zero-pressure bit-parity loss, or a
+    lane/K toggle that recompiled when the baseline's didn't, all fail."""
+    rows, regressions = [], []
+    be = base.get("engine") or {}
+    ce = cand.get("engine") or {}
+    bp, cp = be.get("proposalsPerSec"), ce.get("proposalsPerSec")
+    if bp is not None and cp is not None:
+        row = {"kind": "serving", "field": "proposalsPerSec",
+               "base_p95": bp, "cand_p95": cp}
+        if cp < bp * (1.0 - threshold):
+            row["regression"] = (f"proposals/sec {cp:.1f} < {bp:.1f} "
+                                 f"* (1 - {threshold:g})")
+            regressions.append(row)
+        rows.append(row)
+    bh = (be.get("healAdmissionMs") or {}).get("p95")
+    ch = (ce.get("healAdmissionMs") or {}).get("p95")
+    if bh is not None and ch is not None:
+        row = {"kind": "serving", "field": "heal_admission_ms",
+               "base_p95": bh, "cand_p95": ch}
+        if ch > bh * (1.0 + threshold):
+            row["regression"] = (f"heal-admission p95 {ch:.1f} > {bh:.1f} "
+                                 f"* (1 + {threshold:g})")
+            regressions.append(row)
+        rows.append(row)
+    # the acceptance bar is absolute, not relative: the engine must stay
+    # STRICTLY better than the static round on both serving SLOs
+    for field, label in (("proposalsPerSecSpeedup", "proposals/sec speedup"),
+                         ("healP95ImprovementX", "heal-p95 improvement")):
+        bv, cv = base.get(field), cand.get(field)
+        if cv is None:
+            continue
+        row = {"kind": "serving", "field": field,
+               "base_p95": bv, "cand_p95": cv}
+        if cv <= 1.0:
+            row["regression"] = (f"{label} {cv:.2f}x <= 1x — engine no "
+                                 f"longer beats the static round")
+            regressions.append(row)
+        rows.append(row)
+    if base.get("parity_identical") and cand.get("parity_identical") is False:
+        row = {"kind": "serving", "field": "parity_identical",
+               "base_p95": 1, "cand_p95": 0,
+               "regression": "zero-pressure admission round lost bit parity "
+                             "with the static round"}
+        regressions.append(row)
+        rows.append(row)
+    bc = base.get("toggle_new_compiles")
+    cc = cand.get("toggle_new_compiles")
+    if bc == 0 and (cc or 0) > 0:
+        row = {"kind": "serving", "field": "toggle_new_compiles",
+               "base_p95": bc, "cand_p95": cc,
+               "regression": "lane/K toggle recompiled within the bucket "
+                             "(baseline did not)"}
+        regressions.append(row)
+        rows.append(row)
+    return rows, regressions
+
+
 def load_doc(path: str) -> tuple[dict, bool]:
     """Load one input; returns (document, is_journal). A JSONL event
     journal is detected by its per-line records and converted to a
@@ -528,6 +609,14 @@ def main(argv: list[str]) -> int:
         fcrows, fcregs = compare_forecast(fcb, fcc, threshold)
         rows.extend(fcrows)
         regressions.extend(fcregs)
+        compared = True
+    # ... and on the serving rung (proposals/sec, heal-admission p95,
+    # strict engine-vs-static advantage, zero-pressure parity, K toggles)
+    svb, svc = extract_serving(base_doc), extract_serving(cand_doc)
+    if svb and svc:
+        svrows, svregs = compare_serving(svb, svc, threshold)
+        rows.extend(svrows)
+        regressions.extend(svregs)
         compared = True
     if not compared:
         print("no comparable SLO or steady-round blocks found in both "
