@@ -1510,7 +1510,7 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
     # cell records its round mode, so a memo that failed to fire (load
     # drift, budget, knob) is visible in the rung, not silently absorbed.
     if steady_walls:
-        churn_est = 4 * (steady_walls[-1] * 1.15 + sample_s / rounds)
+        churn_est = 5 * (steady_walls[-1] * 1.15 + sample_s / rounds)
         if churn_est > remaining_budget():
             rung["churn_sweep_skip_reason"] = (
                 f"wall budget: churn sweep (~{churn_est:.0f}s est) > "
@@ -1555,6 +1555,28 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
             log(f"  [e2e] churn=0: {w:.3f}s mode={res_c.round_mode} "
                 f"revalidated_goals={reval_goals} compiles={nc}")
 
+            # converge the backend (PR 19): execute the round's proposals,
+            # so the cluster actually REACHES the optimizer's target, then
+            # run one full round against the converged placement. Every
+            # earlier cell measured steady rounds against a cluster that
+            # never executes — each round re-derives the same ~46k
+            # movements of REAL work from the same imbalanced state, which
+            # no pass scheduler can (or should) skip. The converged round
+            # lays down the carryover verdicts + certificates, and the
+            # low-churn cell below measures the round a real deployment
+            # sits in between anomalies.
+            n_exec = be.apply_assignment(res_c.proposals)
+            res_c, w, nc, inf = _service_round(base + 2)
+            sweep["converged"] = {
+                "round_s": round(w, 3), "compiles": nc,
+                "proposals_executed": n_exec,
+                "round_mode": res_c.round_mode,
+                "num_replica_movements": res_c.num_replica_movements,
+            }
+            log(f"  [e2e] churn=converge({n_exec} executed): {w:.3f}s "
+                f"mode={res_c.round_mode} "
+                f"residual_moves={res_c.num_replica_movements} compiles={nc}")
+
             # low churn: flip leadership on a handful of partitions and run
             # the dirty-seeded reduced chain. Value-only knob — the masked
             # programs compiled by the full rounds above are reused as-is.
@@ -1567,7 +1589,7 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
             be.elect_leaders(flips)
             _seed = opt._seed_dirty
             opt._seed_dirty = True
-            res_c, w, nc, inf = _service_round(base + 2)
+            res_c, w, nc, inf = _service_round(base + 3)
             opt._seed_dirty = _seed
             sweep["low"] = {
                 "round_s": round(w, 3), "compiles": nc,
@@ -1576,16 +1598,32 @@ def run_e2e_rung(num_brokers: int = 1000, num_partitions: int = 50_000,
                 "reduced_goals": sum(1 for g in res_c.goal_results
                                      if g.mode == "reduced"),
                 "fallback_goals": res_c.fallback_goals,
+                # convergence-gated pass scheduling (PR 19): budgeted pass
+                # slots actually dispatched vs provably avoided by the
+                # quiesce gate, plus the goals that early-exited or were
+                # short-circuited to a single [B] probe
+                "passes_dispatched": res_c.passes_dispatched,
+                "passes_skipped": res_c.passes_skipped,
+                "early_exit_goals": res_c.early_exit_goals,
+                "skipped_goals": res_c.skipped_goals,
             }
+            if res_c.round_mode == "reduced":
+                rung["round_s_reduced"] = round(w, 3)
+                rung["passes_dispatched"] = res_c.passes_dispatched
+                rung["passes_skipped"] = res_c.passes_skipped
             log(f"  [e2e] churn=low({inf.get('churn')}): {w:.3f}s "
                 f"mode={res_c.round_mode} "
                 f"reduced_goals={sweep['low']['reduced_goals']} "
-                f"fallback_goals={res_c.fallback_goals} compiles={nc}")
+                f"fallback_goals={res_c.fallback_goals} "
+                f"passes={res_c.passes_dispatched}"
+                f"(+{res_c.passes_skipped} skipped) "
+                f"early_exit={res_c.early_exit_goals} "
+                f"short_circuit={res_c.skipped_goals} compiles={nc}")
 
             # epoch-scale churn: a broker-set change forces the rebuild
             # epoch — the carryover is invalidated and the round runs full
             be.add_broker(num_brokers, f"r{num_brokers % 20}")
-            res_c, w, nc, inf = _service_round(base + 3)
+            res_c, w, nc, inf = _service_round(base + 4)
             sweep["epoch"] = {
                 "round_s": round(w, 3), "compiles": nc,
                 "sync_mode": inf.get("mode"),

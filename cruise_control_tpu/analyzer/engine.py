@@ -282,6 +282,14 @@ class EngineParams:
     # loop's top-K windows can miss the last scattered positive actions for
     # dozens of passes; the scan lands exactly them.
     finisher_rounds: int = 12
+    # ``finisher_rounds`` is a TRACED budget leaf (PR 19): churn-adaptive
+    # budgets clamp it per reduced goal and escalation widens it, all without
+    # recompiling. ``max_finisher_rounds`` is the STATIC subprogram gate the
+    # old static-0 value used to provide — 0 compiles the goal program
+    # WITHOUT the finisher subprogram at all (small clusters below
+    # analyzer.finisher.min.replicas keep their lean programs; the traced
+    # round budget cannot gate compilation).
+    max_finisher_rounds: int = 12
     finisher_candidates: int = 1760   # wave width; the bisect-proven TPU cap
     finisher_waves: int = 6           # rank-banded waves per exhaustive scan:
     #                                   wave w takes true-gain ranks
@@ -312,6 +320,19 @@ class EngineParams:
     # tests/test_pass_pipeline.py).
     pass_waves: int = 1
     max_pass_waves: int = 4
+    # ---- convergence-gated pass scheduling (PR 19) ----
+    # CHUNKED EARLY-EXIT DISPATCH: passes per host-dispatched chunk of the
+    # budgeted loop (optimize_goal_chunked). The chunk program shares
+    # _loop_fns with the monolithic loop — a chunk sequence that runs to the
+    # loop's own exit is bit-identical to one monolithic while_loop — but
+    # after each chunk ONE cheap device->host probe (4 scalars) lets the
+    # host stop dispatching as soon as the goal QUIESCES (a whole chunk
+    # admitted zero actions: the state is bit-unchanged, so the remaining
+    # salted-exploration budget provably re-ranks the same starved pools).
+    # TRACED leaf: resizing the chunk reuses the compiled chunk program.
+    # The optimizer gates WHICH dispatch mode runs host-side
+    # (analyzer.pass.chunk / analyzer.pass.chunk.min.replicas).
+    pass_chunk: int = 8
     # ELIGIBLE-SET-COMPACTED KEYING: run the stall-salt + top-k candidate
     # selection over the goal's compacted eligible prefix (key > -inf rows,
     # _compact_eligible) whenever it fits the static pool — selection cost
@@ -438,7 +459,7 @@ class EngineParams:
 _DYN_FIELDS = ("max_iters", "min_gain", "stall_retries", "tail_pass_budget",
                "tail_total_budget", "sat_stall_retries", "sat_tail_passes",
                "stat_window", "stat_slope_min", "pass_waves",
-               "finisher_segments")
+               "finisher_segments", "finisher_rounds", "pass_chunk")
 _STATIC_FIELDS = tuple(f.name for f in dataclasses.fields(EngineParams)
                        if f.name not in _DYN_FIELDS)
 
@@ -1735,7 +1756,9 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
     use_moves = goal.uses_replica_moves
     use_leads = goal.uses_leadership_moves
     zero = jnp.int32(0)
-    if params.finisher_rounds <= 0 or not (use_moves or use_leads):
+    # the STATIC gate rides max_finisher_rounds (finisher_rounds is a traced
+    # budget leaf since PR 19 and cannot gate compilation of the subprogram)
+    if params.max_finisher_rounds <= 0 or not (use_moves or use_leads):
         return (st, jnp.bool_(False), jnp.int32(-1), jnp.int32(-1),
                 jnp.int32(-1), zero, zero, zero, zero)
 
@@ -1826,12 +1849,18 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
      clean) = jax.lax.while_loop(
         cond, round_body, (st, zero, big, big, zero, zero, jnp.bool_(False),
                            jnp.bool_(False)))
-    mleft = jnp.where(run, mleft, -1)   # -1 = finisher did not run
-    lleft = jnp.where(run, lleft, -1)
+    # ``ran`` guards the reports against a TRACED finisher_rounds of 0 (the
+    # loop never tripped, so mleft/lleft still hold the ``big`` sentinel):
+    # run & no-trip must report exactly what the old static-0 early return
+    # reported (-1 counts, 0 segments, proven False — clean inits False, so
+    # proven needs no extra guard)
+    ran = run & (rounds > 0)
+    mleft = jnp.where(ran, mleft, -1)   # -1 = finisher did not run
+    lleft = jnp.where(ran, lleft, -1)
     moves_proven = (mleft == 0) | jnp.bool_(not use_moves)
     leads_proven = (lleft == 0) | jnp.bool_(not use_leads)
     if goal.uses_swaps:
-        swleft = jnp.where(run, _swap_window_positives(
+        swleft = jnp.where(ran, _swap_window_positives(
             env, st, goal, prev_goals, params), -1)
         swaps_proven = swleft == 0
     else:
@@ -1848,7 +1877,7 @@ def _finisher(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                               for g in (goal, *prev_goals)))))
     if seg_capable:
         segments = jnp.where(
-            run, jnp.clip(params.finisher_segments, 1,
+            ran, jnp.clip(params.finisher_segments, 1,
                           max(2, min(params.max_finisher_segments,
                                      env.num_brokers))), 0).astype(jnp.int32)
     else:
@@ -1910,22 +1939,16 @@ def _compiled_optimize(goal_cls, goal: GoalKernel, prev_goals: tuple,
     return run
 
 
-def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
-               prev_goals: tuple, params: EngineParams,
-               finisher: bool = True, seed_mask: Array | None = None):
-    """One goal's full optimization loop (traced; shared by the per-goal
-    program and the fused prefix-chain program). ``finisher=False`` compiles
-    the loop WITHOUT the exhaustive finisher phase — the fused prefix
-    program uses it (optimizer._compiled_prefix_chain): its goals converge
-    inside their budgets, and many inlined finisher subprograms bloat one
-    program's compile and execution enough to trip the axon runtime's
-    watchdog at the 1M rung. Deep-tail goals run as their own per-goal
-    programs with the finisher inline at their chain position."""
-    stat_before = goal.stat(env, st)
-    # precision policy: the env's float leaves are cast to the compute dtype
-    # ONCE per program (loop-invariant — XLA hoists the casts out of the
-    # while_loop); identity under the default f32 policy
-    env_sw = _sweep_env(env, params)
+def _loop_fns(env: ClusterEnv, env_sw: ClusterEnv, goal: GoalKernel,
+              prev_goals: tuple, params: EngineParams,
+              seed_mask: Array | None):
+    """step/cond of one goal's budgeted pass loop over the 15-tuple carry
+    ``(st, it, n_applied, stall, dribble, sat, win_stat, win_dribble,
+    plateau, tailp, b_moves, b_leads, b_swaps, b_disk, b_waves)`` — shared
+    by the monolithic while_loop (_goal_loop) and the chunked early-exit
+    dispatch (_goal_chunk), so a chunk sequence that runs to the loop's own
+    exit applies the SAME step sequence the monolithic program applies,
+    bit-identically."""
 
     def step(carry):
         (st, it, n_applied, stall, dribble, _sat, win_stat, win_dribble,
@@ -2066,18 +2089,46 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                 & (it < params.max_iters)
                 & ~plateau)
 
+    return step, cond_fn
+
+
+def _loop_scalar_init():
+    """Initial values of the budgeted loop's 14 SCALAR carries (everything
+    but the state): ``(it, n_applied, stall, dribble, sat, win_stat,
+    win_dribble, plateau, tailp, b_moves, b_leads, b_swaps, b_disk,
+    b_waves)``. Shared by _goal_loop and the chunked dispatch so a chunk
+    sequence resumes bit-exactly where the previous chunk left off."""
+    z = jnp.int32(0)
+    return (z, z, z, z, jnp.bool_(False),
+            # stat-window carry in the ACCOUNTING dtype by policy (goal.stat
+            # is an f32 measure; the plateau exit must never inherit a sweep
+            # dtype)
+            jnp.asarray(jnp.inf, ACCT_DTYPE),
+            z, jnp.bool_(False), z, z, z, z, z, z)
+
+
+def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+               prev_goals: tuple, params: EngineParams,
+               finisher: bool = True, seed_mask: Array | None = None):
+    """One goal's full optimization loop (traced; shared by the per-goal
+    program and the fused prefix-chain program). ``finisher=False`` compiles
+    the loop WITHOUT the exhaustive finisher phase — the fused prefix
+    program uses it (optimizer._compiled_prefix_chain): its goals converge
+    inside their budgets, and many inlined finisher subprograms bloat one
+    program's compile and execution enough to trip the axon runtime's
+    watchdog at the 1M rung. Deep-tail goals run as their own per-goal
+    programs with the finisher inline at their chain position."""
+    stat_before = goal.stat(env, st)
+    # precision policy: the env's float leaves are cast to the compute dtype
+    # ONCE per program (loop-invariant — XLA hoists the casts out of the
+    # while_loop); identity under the default f32 policy
+    env_sw = _sweep_env(env, params)
+    step, cond_fn = _loop_fns(env, env_sw, goal, prev_goals, params,
+                              seed_mask)
     (st, iters, n_applied, stall, dribble, _sat, _ws, _wd,
      plateau, tailp, b_moves, b_leads, b_swaps, b_disk,
-     b_waves) = jax.lax.while_loop(
-        cond_fn, step, (st, jnp.int32(0), jnp.int32(0), jnp.int32(0),
-                        jnp.int32(0), jnp.bool_(False),
-                        # stat-window carry in the ACCOUNTING dtype by policy
-                        # (goal.stat is an f32 measure; the plateau exit must
-                        # never inherit a sweep dtype)
-                        jnp.asarray(jnp.inf, ACCT_DTYPE),
-                        jnp.int32(0), jnp.bool_(False), jnp.int32(0),
-                        jnp.int32(0), jnp.int32(0), jnp.int32(0),
-                        jnp.int32(0), jnp.int32(0)))
+     b_waves) = jax.lax.while_loop(cond_fn, step,
+                                   (st,) + _loop_scalar_init())
     # FINISHER: a goal still violated at budget exit gets exhaustive-scan
     # rounds that either converge it to a machine-checked single-action
     # fixpoint (proven) or land the true best remaining actions trying
@@ -2130,4 +2181,309 @@ def _goal_loop(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                 "finisher_segments": fin_segments,
                 "finisher_boundary": fin_boundary,
                 "stat": goal.stat(env, st)}
+
+
+# ---------------------------------------------------------------------------
+# Convergence-gated pass scheduling (PR 19): chunked early-exit dispatch
+# ---------------------------------------------------------------------------
+# The budgeted loop's exits are conservative: once the tail regime starts,
+# stall/dribble/tail budgets allow dozens-to-hundreds of salted exploration
+# passes per goal even when the goal quiesced after its first wave (measured
+# at the 1000b/50000p rung: the 16-flip reduced round still cost 56 s because
+# pass COUNT, not candidate count, dominates on CPU). The chunked dispatch
+# splits the same loop into host-dispatched chunks of ``params.pass_chunk``
+# passes; after each chunk one cheap device->host probe (4 scalars) gates the
+# next dispatch. QUIESCE predicate: a whole chunk admitted ZERO actions while
+# the loop's own cond still held. Zero admissions leave every state leaf
+# bit-unchanged (masked scatters are no-ops), so the goal's violation verdict
+# is provably unchanged too — the conservative form of "zero actions in the
+# last wave AND violation count unchanged" — and the remaining budget would
+# only re-rank the same starved pools with fresh salts. The paper's greedy
+# optimizer stops exactly here (no improving action exists); for goals still
+# VIOLATED at the stop, the exhaustive finisher remains the convergence
+# safety net and certificate authority (dispatched as its own program).
+
+
+def _goal_chunk(env: ClusterEnv, st: EngineState, scalars: tuple,
+                goal: GoalKernel, prev_goals: tuple, params: EngineParams,
+                seed_mask: Array | None = None, frozen: Array | None = None):
+    """Resume one goal's budgeted loop for up to ``params.pass_chunk`` more
+    passes from the carried scalar tuple (see _loop_scalar_init). Returns
+    ``(state, scalars', probe)`` where probe holds the host-gating scalars:
+    ``active`` (the loop's own cond still true), cumulative ``applied``,
+    the goal's live ``violated``/``stat``, and ``stat_entry`` (the stat of
+    the INPUT state — chunk 0's value is the goal's stat_before).
+
+    ``frozen`` (fleet lanes): a True lane runs zero passes this chunk — the
+    vmapped while_loop's batching rule masks its carry updates — so a
+    quiesced tenant stays bit-frozen while other lanes keep working, which
+    is exactly the solo chunked dispatch's early stop, per lane."""
+    env_sw = _sweep_env(env, params)
+    step, cond_fn = _loop_fns(env, env_sw, goal, prev_goals, params,
+                              seed_mask)
+    stat_entry = goal.stat(env, st)
+    lim = scalars[0] + jnp.maximum(params.pass_chunk, 1)
+
+    def chunk_cond(carry):
+        ok = cond_fn(carry) & (carry[1] < lim)
+        if frozen is not None:
+            ok = ok & ~frozen
+        return ok
+
+    carry = jax.lax.while_loop(chunk_cond, step, (st,) + tuple(scalars))
+    st = carry[0]
+    probe = {"active": cond_fn(carry),
+             "applied": carry[2],
+             "violated": goal.violated(env, st),
+             "stat": goal.stat(env, st),
+             "stat_entry": stat_entry}
+    return st, carry[1:], probe
+
+
+def _goal_finish(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                 prev_goals: tuple, params: EngineParams):
+    """The budgeted loop's post-exit phase as its own program: the finisher
+    (gated on the goal still being violated, exactly like _goal_loop's
+    inline call) plus the final verdict/stat reads."""
+    viol_pre = goal.violated(env, st)
+    (st, fin_proven, moves_left, leads_left, swaps_left, fin_rounds,
+     fin_applied, fin_boundary, fin_segments) = _finisher(
+        env, st, goal, prev_goals, params, viol_pre)
+    return st, {"violated_after": goal.violated(env, st),
+                "fixpoint_proven": fin_proven,
+                "moves_remaining": moves_left,
+                "leads_remaining": leads_left,
+                "swap_window_remaining": swaps_left,
+                "finisher_rounds": fin_rounds,
+                "finisher_actions": fin_applied,
+                "finisher_boundary": fin_boundary,
+                "finisher_segments": fin_segments,
+                "stat": goal.stat(env, st)}
+
+
+@lru_cache(maxsize=256)
+def _compiled_goal_chunk(goal_cls, goal: GoalKernel, prev_goals: tuple,
+                         masked: bool = False):
+    """Jitted chunk program per (goal, prev_goals). The scalar carries and
+    EngineParams budgets are traced arguments: every chunk of every round —
+    any chunk size, reduced or full masks, adaptive or static budgets —
+    reuses this one executable."""
+    del goal_cls  # cache key only
+
+    if masked:
+        @jax.jit
+        def run(env: ClusterEnv, st: EngineState, scalars: tuple,
+                params: EngineParams, seed_mask: Array):
+            return _goal_chunk(env, st, scalars, goal, prev_goals, params,
+                               seed_mask=seed_mask)
+    else:
+        @jax.jit
+        def run(env: ClusterEnv, st: EngineState, scalars: tuple,
+                params: EngineParams):
+            return _goal_chunk(env, st, scalars, goal, prev_goals, params)
+    return run
+
+
+@lru_cache(maxsize=256)
+def _compiled_goal_finish(goal_cls, goal: GoalKernel, prev_goals: tuple):
+    del goal_cls  # cache key only
+
+    @jax.jit
+    def run(env: ClusterEnv, st: EngineState, params: EngineParams):
+        return _goal_finish(env, st, goal, prev_goals, params)
+    return run
+
+
+@lru_cache(maxsize=256)
+def _compiled_goal_probe(goal_cls, goal: GoalKernel):
+    """One-dispatch short-circuit probe (PR 19 tentpole c): the goal's live
+    verdict plus whether ANY seed-mask candidate ranks eligible for any
+    action kind the goal uses. ``violated=False & has_work=False`` proves
+    running the full goal program would be a bit-exact no-op: every
+    selection pool the budgeted loop builds from the masked keys is
+    all-NEG_INF (and stays so under stall salting — _stall_explore maps
+    NEG_INF to NEG_INF), zero actions admit, every scatter is a no-op, and
+    the finisher's run gate (violated at budget exit) stays False."""
+    del goal_cls  # cache key only
+
+    @jax.jit
+    def run(env: ClusterEnv, st: EngineState, seed_mask: Array):
+        return {"violated": goal.violated(env, st),
+                "has_work": goal.seeded_work_probe(env, st, seed_mask),
+                "stat": goal.stat(env, st)}
+    return run
+
+
+def _fleet_scalar_init(num_tenants: int):
+    """[K]-batched _loop_scalar_init for the vmapped chunk program."""
+    return tuple(jnp.broadcast_to(x, (num_tenants,))
+                 for x in _loop_scalar_init())
+
+
+@lru_cache(maxsize=64)
+def _compiled_fleet_chunk(goal_cls, goal: GoalKernel, prev_goals: tuple,
+                          masked: bool = False):
+    """Vmapped chunk program for the fleet's batched launch: per-lane scalar
+    carries and a per-lane ``frozen`` flag (quiesced tenants run zero
+    passes — their carries are masked by the vmapped while_loop — while
+    active lanes keep stepping, preserving per-lane parity with K solo
+    chunked dispatches)."""
+    del goal_cls  # cache key only
+
+    if masked:
+        def one(env, st, scalars, params, seed_mask, frozen):
+            return _goal_chunk(env, st, scalars, goal, prev_goals, params,
+                               seed_mask=seed_mask, frozen=frozen)
+        return jax.jit(jax.vmap(one, in_axes=(0, 0, 0, None, 0, 0)))
+
+    def one(env, st, scalars, params, frozen):
+        return _goal_chunk(env, st, scalars, goal, prev_goals, params,
+                           frozen=frozen)
+    return jax.jit(jax.vmap(one, in_axes=(0, 0, 0, None, 0)))
+
+
+@lru_cache(maxsize=64)
+def _compiled_fleet_finish(goal_cls, goal: GoalKernel, prev_goals: tuple):
+    del goal_cls  # cache key only
+
+    def one(env, st, params):
+        return _goal_finish(env, st, goal, prev_goals, params)
+    return jax.jit(jax.vmap(one, in_axes=(0, 0, None)))
+
+
+def optimize_goal_chunked(env: ClusterEnv, st: EngineState, goal: GoalKernel,
+                          prev_goals: tuple = (),
+                          params: EngineParams = EngineParams(),
+                          seed_mask: Array | None = None,
+                          allow_cert_skip: bool = False):
+    """Chunked early-exit counterpart of optimize_goal. Same compiled pass
+    program semantics (shared _loop_fns), fewer invocations: the host stops
+    dispatching as soon as the loop's own cond exits OR the goal quiesces
+    (a whole chunk admitted zero actions — see the module comment for the
+    soundness argument). Returns (state, HOST info dict) with the
+    monolithic info keys plus the PR 19 counters: ``passes_skipped`` (upper
+    bound on the budgeted passes the early exit avoided), ``quiesce_chunk``
+    (chunk index that quiesced, -1 = ran to its own exit), ``chunks``, and
+    ``finisher_skipped``.
+
+    ``allow_cert_skip=True`` (caller-established: the carried round proved
+    this goal a persistent violated fixpoint and the round's prefix applied
+    nothing) skips the finisher dispatch for a goal that quiesced with ZERO
+    actions applied: the state it would scan is bit-identical to the state
+    the carried certificate was proven against, so the certificate IS the
+    proof no work remains (DESIGN §23). The caller patches the certificate
+    fields from the carryover; this function reports ``fixpoint_proven
+    False`` plus ``finisher_skipped True``."""
+    prev_goals = tuple(prev_goals)
+    chunk_fn = _compiled_goal_chunk(type(goal), goal, prev_goals,
+                                    seed_mask is not None)
+    scalars = _loop_scalar_init()
+    stat_before = 0.0
+    quiesce_chunk = -1
+    chunks = 0
+    applied_prev = 0
+    probe = None
+    while True:
+        if seed_mask is None:
+            st, scalars, probe_dev = chunk_fn(env, st, scalars, params)
+        else:
+            st, scalars, probe_dev = chunk_fn(env, st, scalars, params,
+                                              seed_mask)
+        probe = jax.device_get(probe_dev)   # the gating sync: 5 scalars
+        if chunks == 0:
+            stat_before = float(probe["stat_entry"])
+        chunks += 1
+        applied_now = int(probe["applied"])
+        if not bool(probe["active"]):
+            break
+        if applied_now == applied_prev:
+            quiesce_chunk = chunks - 1
+            break
+        applied_prev = applied_now
+
+    sc = jax.device_get(scalars)
+    it, n_applied, stall, dribble = (int(sc[0]), int(sc[1]), int(sc[2]),
+                                     int(sc[3]))
+    plateau, tailp = bool(sc[7]), int(sc[8])
+    b_moves, b_leads, b_swaps, b_disk, b_waves = (int(x) for x in sc[9:14])
+    viol_pre = bool(probe["violated"])
+
+    # estimate of the budgeted passes the early exit avoided, mirroring the
+    # cond's caps over the carried scalars: if no further action ever admits
+    # (the quiesced common case) every extra pass bumps stall and tailp by 1
+    # until the tightest of the stall / tail-total / max_iters budgets binds
+    sat = bool(sc[4])
+    passes_skipped = 0
+    if quiesce_chunk >= 0:
+        stall_cap = (min(int(params.stall_retries),
+                         int(params.sat_stall_retries))
+                     if sat else int(params.stall_retries))
+        passes_skipped = max(0, min(int(params.max_iters) - it,
+                                    int(params.tail_total_budget) + 1 - tailp,
+                                    stall_cap + 1 - stall))
+
+    finisher_skipped = False
+    if not viol_pre:
+        # satisfied at exit: the finisher's run gate is False — _finisher
+        # would touch nothing and report sentinel counts; synthesize them
+        # without paying the dispatch
+        fin = {"fixpoint_proven": False, "moves_remaining": -1,
+               "leads_remaining": -1, "swap_window_remaining": -1,
+               "finisher_rounds": 0, "finisher_actions": 0,
+               "finisher_boundary": 0, "finisher_segments": 0}
+        violated = False
+        stat_after = float(probe["stat"])
+    elif allow_cert_skip and quiesce_chunk >= 0 and n_applied == 0:
+        # certificate-gated skip: violated, zero actions this round, carried
+        # certificate valid (caller-checked) — the exhaustive scans would
+        # re-prove the carried fixpoint against a bit-identical state
+        finisher_skipped = True
+        fin = {"fixpoint_proven": False, "moves_remaining": -1,
+               "leads_remaining": -1, "swap_window_remaining": -1,
+               "finisher_rounds": 0, "finisher_actions": 0,
+               "finisher_boundary": 0, "finisher_segments": 0}
+        violated = True
+        stat_after = float(probe["stat"])
+    else:
+        fin_fn = _compiled_goal_finish(type(goal), goal, prev_goals)
+        st, fin_dev = fin_fn(env, st, params)
+        fin = jax.device_get(fin_dev)
+        violated = bool(fin.pop("violated_after"))
+        stat_after = float(fin.pop("stat"))
+        fin = {k: (bool(v) if k == "fixpoint_proven" else int(v))
+               for k, v in fin.items()}
+
+    # host mirrors of the monolithic exit flags (same formulas over the same
+    # carried scalars)
+    budget_exit = (it >= int(params.max_iters)
+                   or dribble > int(params.tail_pass_budget)
+                   or tailp > int(params.tail_total_budget)
+                   or plateau)
+    hit_max_iters = (stall <= int(params.stall_retries) and budget_exit
+                     and violated and not fin["fixpoint_proven"])
+    info = {"iterations": n_applied + fin["finisher_actions"],
+            "passes": it,
+            "violated_after": violated,
+            "hit_max_iters": hit_max_iters,
+            "plateau_exit": plateau,
+            "fixpoint_proven": fin["fixpoint_proven"],
+            "finisher_rounds": fin["finisher_rounds"],
+            "moves_remaining": fin["moves_remaining"],
+            "leads_remaining": fin["leads_remaining"],
+            "swap_window_remaining": fin["swap_window_remaining"],
+            "stat_before": stat_before,
+            "move_actions": b_moves,
+            "lead_actions": b_leads,
+            "swap_actions": b_swaps,
+            "disk_actions": b_disk,
+            "move_waves": b_waves,
+            "finisher_actions": fin["finisher_actions"],
+            "finisher_segments": fin["finisher_segments"],
+            "finisher_boundary": fin["finisher_boundary"],
+            "stat": stat_after,
+            "passes_skipped": passes_skipped,
+            "quiesce_chunk": quiesce_chunk,
+            "chunks": chunks,
+            "finisher_skipped": finisher_skipped}
+    return st, info
 

@@ -265,6 +265,32 @@ class GoalKernel:
         """Scalar the goal tries to reduce; optimizer asserts no increase."""
         return jnp.sum(jnp.maximum(self.broker_severity(env, st), 0.0))
 
+    def seeded_work_probe(self, env: ClusterEnv, st: EngineState,
+                          seed_mask: Array) -> Array:
+        """bool[]: would ANY seed-mask candidate rank eligible (> NEG_INF)
+        for ANY action kind this goal uses? The engine's reduced-round
+        candidate selection masks each key array by the seed mask before
+        top-k, so ``False`` here proves every selection pool the goal's
+        pass loop could build is all-NEG_INF: zero actions can admit and
+        the goal program is a bit-exact no-op on its state (the PR 19
+        chain-level short-circuit's one [B]-reduction probe, paired with
+        ``violated``). Conservative by construction — it reuses the exact
+        key kernels the engine ranks with (the swap probe checks only the
+        OUT side, matching the engine's seed-mask placement)."""
+        sev = self.broker_severity(env, st)
+
+        def masked_any(key):
+            return jnp.any(jnp.where(seed_mask, key, NEG_INF) > NEG_INF)
+
+        has = jnp.bool_(False)
+        if self.uses_replica_moves or self.uses_disk_moves:
+            has = has | masked_any(self.replica_key(env, st, sev))
+        if self.uses_leadership_moves:
+            has = has | masked_any(self.leader_key(env, st, sev))
+        if self.uses_swaps:
+            has = has | masked_any(self.swap_out_key(env, st, sev))
+        return has
+
 
 def broker_lookup(rb: Array, *cols: Array) -> Array:
     """f32[R, len(cols)]: per-broker columns gathered at replica positions in

@@ -22,7 +22,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cruise_control_tpu.analyzer.engine import EngineParams, optimize_goal
+from cruise_control_tpu.analyzer.engine import (
+    EngineParams, _compiled_fleet_chunk, _compiled_fleet_finish,
+    _compiled_goal_probe, _fleet_scalar_init, optimize_goal,
+    optimize_goal_chunked,
+)
 from cruise_control_tpu.analyzer.env import (
     BalancingConstraint, ClusterEnv, OptimizationOptions, make_env,
     padded_partition_table,
@@ -120,12 +124,23 @@ class GoalResult:
     # goal's finisher was re-entered with widened windows after exiting
     # violated-unproven with a small remaining-action count
     escalations: int = 0
+    # convergence-gated pass scheduling (PR 19): budgeted passes the chunked
+    # dispatch's early exit avoided (an estimate mirroring the loop's own
+    # stall/tail/max-iter caps), the chunk index at which the goal quiesced
+    # (-1 = ran to the loop's own exit, or chunking off), and whether the
+    # finisher dispatch was certificate-skipped (the carried fixpoint proof
+    # stood in for the exhaustive scans)
+    passes_skipped: int = 0
+    quiesce_chunk: int = -1
+    finisher_skipped: bool = False
     # incremental round mode (PR 16): how this goal's verdict was produced —
     # "full" (the complete budgeted program over all R replicas), "reduced"
     # (dirty-set-seeded candidate keying; any certificate is still a genuine
     # full-R proof — the finisher's exhaustive scans are never masked), or
     # "revalidated" (carried from the previous round after the whole-round
-    # certificate re-check matched; the goal program never ran)
+    # certificate re-check matched; the goal program never ran), or
+    # "skipped" (PR 19 chain-level short-circuit: a reduced goal entering
+    # the chain satisfied with zero seeded work ran only the one [B] probe)
     mode: str = "full"
 
 
@@ -150,6 +165,14 @@ class OptimizerResult:
     round_mode: str = "full"
     revalidate_s: float = 0.0
     fallback_goals: int = 0
+    # convergence-gated pass scheduling (PR 19): chain totals of budgeted
+    # passes actually dispatched vs provably-avoidable, goals whose chunked
+    # loop quiesced before its budgets (early exit), and reduced goals
+    # short-circuited to one probe (GoalResult.mode == "skipped")
+    passes_dispatched: int = 0
+    passes_skipped: int = 0
+    early_exit_goals: int = 0
+    skipped_goals: int = 0
 
     @property
     def violated_goals_before(self) -> list[str]:
@@ -174,6 +197,11 @@ class OptimizerResult:
                                              1.0))
         out["summary"]["violatedGoalsBefore"] = self.violated_goals_before
         out["summary"]["violatedGoalsAfter"] = self.violated_goals_after
+        if self.passes_dispatched or self.passes_skipped:
+            out["summary"]["passesDispatched"] = self.passes_dispatched
+            out["summary"]["passesSkipped"] = self.passes_skipped
+            out["summary"]["earlyExitGoals"] = self.early_exit_goals
+            out["summary"]["skippedGoals"] = self.skipped_goals
         for g, entry in zip(self.goal_results, out["goalSummary"]):
             entry["iterations"] = g.iterations
             entry["budgetExhausted"] = g.hit_max_iters
@@ -326,6 +354,10 @@ class GoalOptimizer:
                 # wave's apply in the dataflow graph (engine._finisher)
                 finisher_overlap=config.get_boolean(
                     "analyzer.finisher.overlap"),
+                # convergence-gated dispatch (PR 19): chunk size of the
+                # host-gated pass loop (traced leaf — resizing never
+                # recompiles)
+                pass_chunk=config.get_int("analyzer.pass.chunk"),
             )
         self._params = engine_params or EngineParams()
         # analyzer.fused.chain.min.replicas: at/above this cluster size the
@@ -402,6 +434,38 @@ class GoalOptimizer:
         self._seed_dirty = (
             config.get_boolean("analyzer.incremental.seed.dirty")
             if config is not None else False)
+        # analyzer.pass.*: convergence-gated pass scheduling (PR 19).
+        # ``chunk`` > 0 splits each goal's budgeted loop into host-gated
+        # chunks of that many passes (0 = legacy monolithic dispatch);
+        # ``chunk.min.replicas`` keeps small fixtures on the single-dispatch
+        # program (the per-chunk host sync only pays for itself where a
+        # pass is expensive); ``adaptive.budgets`` derives reduced-round
+        # budgets from the measured dirty-set size (traced leaves — zero
+        # recompile, static budgets stay the floor on fallback re-runs);
+        # ``certificate.skip`` lets a quiesced zero-action violated goal
+        # reuse its carried fixpoint certificate instead of re-running the
+        # finisher scans; ``goal.shortcircuit`` runs untouched satisfied
+        # reduced goals as ONE [B]-level probe
+        self._pass_chunk = (config.get_int("analyzer.pass.chunk")
+                            if config is not None else 8)
+        self._chunk_min_replicas = (
+            config.get_int("analyzer.pass.chunk.min.replicas")
+            if config is not None else 8192)
+        self._adaptive_budgets = (
+            config.get_boolean("analyzer.pass.adaptive.budgets")
+            if config is not None else True)
+        self._adaptive_floor = (
+            config.get_int("analyzer.pass.adaptive.floor.passes")
+            if config is not None else 4)
+        self._cert_skip = (
+            config.get_boolean("analyzer.pass.certificate.skip")
+            if config is not None else True)
+        self._shortcircuit = (
+            config.get_boolean("analyzer.pass.goal.shortcircuit")
+            if config is not None else True)
+        # (chain_key, num_replicas) whose short-circuit probes were warmed
+        # during a full chunked round — reduced rounds then compile nothing
+        self._probe_warmed: set = set()
         self._ones_masks: dict = {}   # num_replicas -> resident all-ones mask
         self._balancedness_priority_weight = (
             config.get_double("goal.balancedness.priority.weight")
@@ -457,7 +521,7 @@ class GoalOptimizer:
         self._params = dataclasses.replace(
             saved, max_iters=1, stall_retries=0, tail_pass_budget=1,
             tail_total_budget=1, sat_stall_retries=0, sat_tail_passes=1,
-            stat_window=1)
+            stat_window=1, finisher_rounds=min(saved.finisher_rounds, 1))
         try:
             self.optimizations(ct, meta, goal_names=goal_names,
                                raise_on_failure=False,
@@ -532,6 +596,14 @@ class GoalOptimizer:
                                    and num_replicas
                                    < self._finisher_min_replicas)
                              else self._params.finisher_rounds),
+            # the STATIC companion gate must match: finisher_rounds is a
+            # traced leaf (PR 19 — adaptive clamps and escalation widen it
+            # without recompiling), so only max_finisher_rounds <= 0 keeps
+            # the finisher subprogram out of small-fixture compiles
+            max_finisher_rounds=(0 if (self._finisher_min_replicas >= 0
+                                       and num_replicas
+                                       < self._finisher_min_replicas)
+                                 else self._params.max_finisher_rounds),
             # precision policy: see _resolve_compute_dtype — "auto" now
             # resolves to bfloat16 at >= 256k replicas (compensated
             # accounting + the segment-parallel finisher closed the rung-4
@@ -713,6 +785,7 @@ class GoalOptimizer:
         seed_masks = None
         mask_modes = None
         reduced_names: set = set()
+        dirty_count = 0
         if use_masks:
             ones = self._ones_mask(num_replicas)
             seed_masks = [ones] * len(goals)
@@ -728,6 +801,7 @@ class GoalOptimizer:
                 np_dirty = session.dirty_replica_mask(rd["dirty_brokers"],
                                                       rd["dirty_topics"])
                 if np_dirty.any():
+                    dirty_count = int(np_dirty.sum())
                     dirty = jnp.asarray(np_dirty)
                     # a goal is dirty-seedable only when BOTH hold: the
                     # carried round ended it satisfied AND it still reads
@@ -785,6 +859,77 @@ class GoalOptimizer:
             host_valid = np.asarray(ct.replica_valid, bool)
             host_part = np.asarray(ct.replica_partition, np.int32)
 
+        # -- convergence-gated dispatch (PR 19): at/above the chunk
+        # threshold every per-goal dispatch — the fused path's deep-tail
+        # segments, the unfused chain, and the reduced-round fallback
+        # sweep — runs the chunked early-exit programs. Full/cold rounds
+        # warm the chunk + finish (and probe) executables, so
+        # reduced<->full flips and knob toggles stay zero-compile; reduced
+        # goals additionally get the one-probe chain short-circuit,
+        # churn-adaptive budget clamps and the certificate-gated finisher
+        # skip. The per-chunk host sync serializes the async goal
+        # pipeline, which only pays for itself where a pass is expensive
+        # (chunk.min.replicas floor); the sharded engine and the
+        # honest-timing path keep the monolithic dispatch.
+        use_chunked = (self._pass_chunk > 0 and params.pass_chunk > 0
+                       and num_replicas >= self._chunk_min_replicas
+                       and not measure_goal_durations
+                       and params.mesh is None)
+        adaptive_params = params
+        if (use_chunked and self._adaptive_budgets and dirty_count > 0
+                and reduced_names):
+            # churn-adaptive budgets (tentpole b): a reduced goal's
+            # candidate pool holds at most the dirty set, so
+            # ceil(D / K) + 1 passes drain it once and one extra pass
+            # proves quiescence; the floor keeps salted exploration
+            # alive on pathological seeds. The clamps apply ONLY to
+            # dirty-seeded goals: clamping a violated full-mask goal
+            # truncates PRODUCTIVE trickle work mid-stream, lands it
+            # violated-unproven, and the fallback re-runs it at the
+            # static budgets — measured net-WORSE (DESIGN §23). Every
+            # clamped field is a TRACED leaf — the clamps reuse the
+            # full round's executables bit-for-bit.
+            need = max(self._adaptive_floor,
+                       -(-dirty_count
+                         // max(int(params.num_candidates), 1)) + 1)
+            adaptive_params = dataclasses.replace(
+                params,
+                stall_retries=min(int(params.stall_retries), need),
+                sat_stall_retries=min(int(params.sat_stall_retries),
+                                      need),
+                tail_pass_budget=min(int(params.tail_pass_budget),
+                                     4 * need),
+                sat_tail_passes=min(int(params.sat_tail_passes),
+                                    4 * need),
+                tail_total_budget=min(int(params.tail_total_budget),
+                                      8 * need),
+                finisher_rounds=min(int(params.finisher_rounds),
+                                    max(2, need)))
+        # certificate-skip eligibility (carryover half): same structural
+        # window as dirty seeding — the carried certificates are live
+        # only while churn stayed within the reduced-round budget
+        co_cert = session.carryover if incremental else None
+        cert_carry_ok = False
+        if (use_chunked and self._cert_skip and use_masks
+                and rd is not None and co_cert is not None
+                and co_cert.chain_key == chain_key
+                and rd["syncs"] >= 1 and not rd["rebuilt"]
+                and not rd["broker_flips"]):
+            cert_budget = (getattr(session, "_max_delta_fraction", 0.25)
+                           * max(num_replicas, 1))
+            cert_carry_ok = 0 <= rd["churn"] <= cert_budget
+        carried_map = ({r.name: r for r in co_cert.result.goal_results}
+                       if cert_carry_ok else {})
+        if (use_chunked and use_masks and self._shortcircuit
+                and (chain_key, num_replicas) not in self._probe_warmed):
+            # warm the short-circuit probes on this full/cold chunked
+            # round (async, results discarded): the first REDUCED round
+            # then compiles nothing
+            ones = self._ones_mask(num_replicas)
+            for g in goals:
+                _compiled_goal_probe(type(g), g)(env, st, ones)
+            self._probe_warmed.add((chain_key, num_replicas))
+
         use_fused = (not measure_goal_durations
                      and self._fused_min_replicas >= 0
                      and num_replicas >= self._fused_min_replicas)
@@ -835,22 +980,82 @@ class GoalOptimizer:
             _tick(f"prefix({split})")
             tail_infos_dev = []
             prev = tuple(goals[:split])
+            out = None
+            actions_so_far = 0
+            if use_chunked and cert_carry_ok:
+                # cert-skip needs the prefix segment's applied-action count;
+                # the chunked dispatch below host-syncs per chunk anyway, so
+                # fetching the prefix infos here costs no extra pipelining
+                out = jax.device_get(out_dev)
+                actions_so_far = sum(int(i["iterations"])
+                                     for i in out["infos"])
             for gi, g in enumerate(goals[split:], start=split):
-                # finisher inline at the goal's chain position (running it
-                # deferred measured 6x-inflated remaining-action counts);
-                # non-donating: programs pipeline async
-                st, info = optimize_goal(env, st, g, prev, params,
-                                         donate_state=self._donate_state,
-                                         seed_mask=(seed_masks[gi]
-                                                    if seed_masks is not None
-                                                    else None))
+                reduced_goal = (mask_modes is not None
+                                and mask_modes[gi] == "reduced")
+                if use_chunked and reduced_goal and self._shortcircuit:
+                    # chain-level short-circuit (tentpole c), fused-tail
+                    # flavor: probed at the goal's own chain position, so
+                    # the prefix segment's mutations are in the probed state
+                    pr = jax.device_get(_compiled_goal_probe(type(g), g)(
+                        env, st, seed_masks[gi]))
+                    if not bool(pr["violated"]) and not bool(pr["has_work"]):
+                        s0 = float(pr["stat"])
+                        tail_infos_dev.append({
+                            "iterations": 0, "passes": 0,
+                            "violated_after": False, "hit_max_iters": False,
+                            "plateau_exit": False, "fixpoint_proven": False,
+                            "finisher_rounds": 0, "moves_remaining": -1,
+                            "leads_remaining": -1,
+                            "swap_window_remaining": -1,
+                            "stat_before": s0, "stat": s0,
+                            "move_actions": 0, "lead_actions": 0,
+                            "swap_actions": 0, "disk_actions": 0,
+                            "move_waves": 0, "finisher_actions": 0,
+                            "finisher_segments": 0, "finisher_boundary": 0,
+                            "passes_skipped": 0, "quiesce_chunk": -1,
+                            "finisher_skipped": False})
+                        mask_modes[gi] = "skipped"
+                        prev = prev + (g,)
+                        _tick(g.name)
+                        continue
+                if use_chunked:
+                    allow_skip = (
+                        cert_carry_ok and actions_so_far == 0
+                        and g.name in carried_map
+                        and co_cert.violated_after.get(g.name) is True
+                        and co_cert.proven.get(g.name) is True)
+                    gp = adaptive_params if reduced_goal else params
+                    st, info = optimize_goal_chunked(
+                        env, st, g, prev, gp,
+                        seed_mask=(seed_masks[gi]
+                                   if seed_masks is not None else None),
+                        allow_cert_skip=allow_skip)
+                    if info["finisher_skipped"]:
+                        cr = carried_map[g.name]
+                        info["fixpoint_proven"] = True
+                        info["moves_remaining"] = cr.moves_remaining
+                        info["leads_remaining"] = cr.leads_remaining
+                        info["swap_window_remaining"] = \
+                            cr.swap_window_remaining
+                    actions_so_far += int(info["iterations"])
+                else:
+                    # finisher inline at the goal's chain position (running
+                    # it deferred measured 6x-inflated remaining-action
+                    # counts); non-donating: programs pipeline async
+                    st, info = optimize_goal(env, st, g, prev, params,
+                                             donate_state=self._donate_state,
+                                             seed_mask=(seed_masks[gi]
+                                                        if seed_masks
+                                                        is not None
+                                                        else None))
                 tail_infos_dev.append(info)
                 prev = prev + (g,)
                 _tick(g.name)
             st, fin_dev = _compiled_chain_final(gclasses, tuple(goals),
                                                 ple)(env, st)
             _tick("final")
-            out = jax.device_get(out_dev)
+            if out is None:
+                out = jax.device_get(out_dev)
             fin = jax.device_get(fin_dev)
             infos = out["infos"] + jax.device_get(tail_infos_dev)
             # fused segments carry no per-pass timing unless profiling
@@ -881,18 +1086,74 @@ class GoalOptimizer:
             infos = []
             durations = []
             prev: list = []
+            actions_so_far = 0
             for gi, g in enumerate(goals):
                 t0 = time.monotonic()
-                # NOTE: donate_state measured SLOWER here — buffer ownership
-                # transfer serializes the async dispatch pipeline on the
-                # tunneled TPU; the non-donating chain keeps all goal
-                # programs in flight. tpu.donate.state opts in for
-                # HBM-constrained deployments.
-                st, info = optimize_goal(env, st, g, tuple(prev), params,
-                                         donate_state=self._donate_state,
-                                         seed_mask=(seed_masks[gi]
-                                                    if seed_masks is not None
-                                                    else None))
+                reduced_goal = (mask_modes is not None
+                                and mask_modes[gi] == "reduced")
+                if use_chunked and reduced_goal and self._shortcircuit:
+                    # chain-level short-circuit (tentpole c): a reduced goal
+                    # is by construction satisfied entering the round; when
+                    # its seeded keys also rank zero dirty candidates the
+                    # whole goal program is a proven bit-exact no-op — one
+                    # [B] probe replaces it. Probed at the goal's own chain
+                    # position, so prefix mutations are in the probed state.
+                    pr = jax.device_get(_compiled_goal_probe(type(g), g)(
+                        env, st, seed_masks[gi]))
+                    if not bool(pr["violated"]) and not bool(pr["has_work"]):
+                        s0 = float(pr["stat"])
+                        infos.append({
+                            "iterations": 0, "passes": 0,
+                            "violated_after": False, "hit_max_iters": False,
+                            "plateau_exit": False, "fixpoint_proven": False,
+                            "finisher_rounds": 0, "moves_remaining": -1,
+                            "leads_remaining": -1,
+                            "swap_window_remaining": -1,
+                            "stat_before": s0, "stat": s0,
+                            "move_actions": 0, "lead_actions": 0,
+                            "swap_actions": 0, "disk_actions": 0,
+                            "move_waves": 0, "finisher_actions": 0,
+                            "finisher_segments": 0, "finisher_boundary": 0,
+                            "passes_skipped": 0, "quiesce_chunk": -1,
+                            "finisher_skipped": False})
+                        mask_modes[gi] = "skipped"
+                        durations.append(time.monotonic() - t0)
+                        prev.append(g)
+                        continue
+                if use_chunked:
+                    allow_skip = (
+                        cert_carry_ok and actions_so_far == 0
+                        and g.name in carried_map
+                        and co_cert.violated_after.get(g.name) is True
+                        and co_cert.proven.get(g.name) is True)
+                    gp = adaptive_params if reduced_goal else params
+                    st, info = optimize_goal_chunked(
+                        env, st, g, tuple(prev), gp,
+                        seed_mask=(seed_masks[gi]
+                                   if seed_masks is not None else None),
+                        allow_cert_skip=allow_skip)
+                    if info["finisher_skipped"]:
+                        # the carried certificate stands in for the skipped
+                        # scans: patch its proof + measured remaining counts
+                        cr = carried_map[g.name]
+                        info["fixpoint_proven"] = True
+                        info["moves_remaining"] = cr.moves_remaining
+                        info["leads_remaining"] = cr.leads_remaining
+                        info["swap_window_remaining"] = \
+                            cr.swap_window_remaining
+                    actions_so_far += int(info["iterations"])
+                else:
+                    # NOTE: donate_state measured SLOWER here — buffer
+                    # ownership transfer serializes the async dispatch
+                    # pipeline on the tunneled TPU; the non-donating chain
+                    # keeps all goal programs in flight. tpu.donate.state
+                    # opts in for HBM-constrained deployments.
+                    st, info = optimize_goal(env, st, g, tuple(prev), params,
+                                             donate_state=self._donate_state,
+                                             seed_mask=(seed_masks[gi]
+                                                        if seed_masks
+                                                        is not None
+                                                        else None))
                 if measure_goal_durations:
                     jax.block_until_ready(st.util)   # block per goal: honest
                 durations.append(time.monotonic() - t0)
@@ -935,6 +1196,9 @@ class GoalOptimizer:
                 finisher_actions=int(info.get("finisher_actions", 0)),
                 finisher_segments=int(info.get("finisher_segments", 0)),
                 finisher_boundary=int(info.get("finisher_boundary", 0)),
+                passes_skipped=int(info.get("passes_skipped", 0)),
+                quiesce_chunk=int(info.get("quiesce_chunk", -1)),
+                finisher_skipped=bool(info.get("finisher_skipped", False)),
             )
             for g, info, dur in zip(goals, infos, durations)
         ]
@@ -962,7 +1226,8 @@ class GoalOptimizer:
             self._reseed_fallback(env, st, goals, goal_results, params,
                                   reduced_names,
                                   self._ones_mask(num_replicas),
-                                  carried_violated=co.violated_after)
+                                  carried_violated=co.violated_after,
+                                  use_chunked=use_chunked)
             if reduced_names else (None, 0))
         if st_fb is not None:
             st = st_fb
@@ -1007,6 +1272,12 @@ class GoalOptimizer:
             durations_measured=measure_goal_durations,
             round_mode="reduced" if reduced_names else "full",
             fallback_goals=fallbacks,
+            passes_dispatched=sum(r.passes for r in goal_results),
+            passes_skipped=sum(r.passes_skipped for r in goal_results),
+            early_exit_goals=sum(1 for r in goal_results
+                                 if r.quiesce_chunk >= 0),
+            skipped_goals=sum(1 for r in goal_results
+                              if r.mode == "skipped"),
         )
         result.final_state = st          # for executor / tests
         result.env = env
@@ -1032,7 +1303,11 @@ class GoalOptimizer:
                                     and self._profile_level == "stage")),
             trace_id=(round_span.trace_id if round_span is not None else None),
             opt_generation=opt_gen,
-            round_mode=result.round_mode)
+            round_mode=result.round_mode,
+            passes_dispatched=result.passes_dispatched,
+            passes_skipped=result.passes_skipped,
+            early_exit_goals=result.early_exit_goals,
+            skipped_goals=result.skipped_goals)
         if round_span is not None:
             round_span.end(
                 proposals=len(proposals), moves=n_moves, leads=n_lead,
@@ -1154,7 +1429,8 @@ class GoalOptimizer:
         return result
 
     def _reseed_fallback(self, env, st, goals, goal_results, params,
-                         reduced_names, ones_mask, carried_violated=None):
+                         reduced_names, ones_mask, carried_violated=None,
+                         use_chunked=False):
         """Full-R traced fallback for the dirty-seeded chain (PR 16
         tentpole b): a chain-ordered repair sweep that re-runs, with the
         all-ones mask, every goal whose verdict the reduced round left
@@ -1198,9 +1474,22 @@ class GoalOptimizer:
             for r in todo:
                 gi = order[r.name]
                 g = goals[gi]
-                st, info = optimize_goal(env, st, g, tuple(goals[:gi]),
-                                         params, seed_mask=ones_mask)
-                info = jax.device_get(info)
+                # re-runs use the STATIC budgets (params as passed) — the
+                # adaptive clamps never reach the fallback, which is what
+                # makes clamped persistent-fixpoint goals safe: an unproven
+                # clamp lands here and gets the full exploration tail back.
+                # Chunked dispatch only trims provably-quiesced passes.
+                if use_chunked:
+                    st, info = optimize_goal_chunked(
+                        env, st, g, tuple(goals[:gi]), params,
+                        seed_mask=ones_mask)
+                    r.passes_skipped += int(info.get("passes_skipped", 0))
+                    if r.quiesce_chunk < 0:
+                        r.quiesce_chunk = int(info.get("quiesce_chunk", -1))
+                else:
+                    st, info = optimize_goal(env, st, g, tuple(goals[:gi]),
+                                             params, seed_mask=ones_mask)
+                    info = jax.device_get(info)
                 r.violated_after = bool(info["violated_after"])
                 r.fixpoint_proven = bool(info["fixpoint_proven"])
                 r.hit_max_iters = r.violated_after and not r.fixpoint_proven
@@ -1422,7 +1711,19 @@ class GoalOptimizer:
         # exists for; steady fleet rounds add zero compiles
         env_b = _compiled_stack(len(envs))(*envs)
         st_b = _compiled_stack(len(sts))(*sts)
-        if masks_b is not None:
+        # convergence-gated dispatch (PR 19): at/above the chunk threshold
+        # the fleet launch runs per-goal vmapped CHUNK programs with
+        # per-lane freeze flags — a quiesced tenant's lane runs zero passes
+        # while active lanes keep stepping (bit-exact per-lane early exit) —
+        # instead of one monolithic chain program. Adaptive budgets /
+        # cert-skip / short-circuit stay solo-only: they are per-tenant
+        # decisions a shared broadcast EngineParams cannot express.
+        use_chunked = (self._pass_chunk > 0 and params.pass_chunk > 0
+                       and num_replicas >= self._chunk_min_replicas)
+        if use_chunked:
+            st_b, out = self._fleet_chain_chunked(env_b, st_b, goals, ple,
+                                                  params, masks_b)
+        elif masks_b is not None:
             fn = _compiled_fleet_chain(tuple(type(g) for g in goals),
                                        tuple(goals), ple, masked=True)
             st_b, out = fn(env_b, st_b, params, masks_b)
@@ -1467,6 +1768,8 @@ class GoalOptimizer:
                     finisher_actions=int(info.get("finisher_actions", 0)),
                     finisher_segments=int(info.get("finisher_segments", 0)),
                     finisher_boundary=int(info.get("finisher_boundary", 0)),
+                    passes_skipped=int(info.get("passes_skipped", 0)),
+                    quiesce_chunk=int(info.get("quiesce_chunk", -1)),
                 )
                 for g, info in zip(goals, infos)
             ]
@@ -1498,7 +1801,8 @@ class GoalOptimizer:
                                       self._ones_mask(num_replicas),
                                       carried_violated=(
                                           session.carryover.violated_after
-                                          if session.carryover else None))
+                                          if session.carryover else None),
+                                      use_chunked=use_chunked)
                 if reduced_by_tenant[i] else (None, 0))
             if st_fb is not None:
                 st_i = st_fb
@@ -1538,6 +1842,12 @@ class GoalOptimizer:
                 data_to_move_mb=float(data_mb),
                 round_mode=("reduced" if reduced_by_tenant[i] else "full"),
                 fallback_goals=n_fb,
+                passes_dispatched=sum(r.passes for r in goal_results),
+                passes_skipped=sum(r.passes_skipped for r in goal_results),
+                early_exit_goals=sum(1 for r in goal_results
+                                     if r.quiesce_chunk >= 0),
+                skipped_goals=sum(1 for r in goal_results
+                                  if r.mode == "skipped"),
             )
             result.final_state = st_i
             result.env = env
@@ -1591,10 +1901,127 @@ class GoalOptimizer:
             profile_level=self._profile_level,
             durations_measured=False,
             opt_generation=opt_gen,
-            round_mode=("reduced" if any(reduced_by_tenant) else "full"))
+            round_mode=("reduced" if any(reduced_by_tenant) else "full"),
+            passes_dispatched=sum(r.passes_dispatched for r in results),
+            passes_skipped=sum(r.passes_skipped for r in results),
+            early_exit_goals=sum(r.early_exit_goals for r in results),
+            skipped_goals=sum(r.skipped_goals for r in results))
         for r in results:
             r.round_trace = trace
         return results
+
+    def _fleet_chain_chunked(self, env_b, st_b, goals, ple, params, masks_b):
+        """Chunked early-exit fleet launch (PR 19): the legacy one-program
+        chain split into a vmapped head (stats + violated-before), per-goal
+        vmapped chunk loops host-gated on PER-LANE quiescence, per-goal
+        vmapped finishers, and a vmapped final program — returning the SAME
+        ``out`` dict shape ``_compiled_fleet_chain`` produces, so the
+        per-tenant unpack downstream is unchanged. A lane quiesces exactly
+        like the solo dispatch (a whole chunk admitted zero actions while
+        its loop cond held); its ``frozen`` flag then zeroes its chunk cond
+        so the vmapped while_loop's batching rule masks every carry update —
+        the lane stays bit-frozen while other lanes keep working. No
+        donation on this path: the host loop re-reads the stacked state
+        across dispatches."""
+        K = jax.tree_util.tree_leaves(st_b)[0].shape[0]
+        gclasses = tuple(type(g) for g in goals)
+        head = _compiled_fleet_head(gclasses, tuple(goals))(env_b, st_b)
+        max_iters = int(params.max_iters)
+        stall_retries = int(params.stall_retries)
+        sat_stall = min(stall_retries, int(params.sat_stall_retries))
+        tail_pass = int(params.tail_pass_budget)
+        tail_total = int(params.tail_total_budget)
+        infos = []
+        prev: tuple = ()
+        for i, g in enumerate(goals):
+            chunk_fn = _compiled_fleet_chunk(type(g), g, prev,
+                                             masks_b is not None)
+            scalars = _fleet_scalar_init(K)
+            frozen_np = np.zeros((K,), bool)
+            applied_prev = np.zeros((K,), np.int64)
+            quiesce = np.full((K,), -1, np.int32)
+            chunks = 0
+            stat_entry0 = None
+            while True:
+                frozen = jnp.asarray(frozen_np)
+                if masks_b is not None:
+                    st_b, scalars, probe_dev = chunk_fn(
+                        env_b, st_b, scalars, params, masks_b[i], frozen)
+                else:
+                    st_b, scalars, probe_dev = chunk_fn(
+                        env_b, st_b, scalars, params, frozen)
+                probe = jax.device_get(probe_dev)
+                if chunks == 0:
+                    stat_entry0 = np.asarray(probe["stat_entry"])
+                chunks += 1
+                active = np.asarray(probe["active"])
+                applied = np.asarray(probe["applied"], np.int64)
+                newly = (~frozen_np) & active & (applied == applied_prev)
+                quiesce[newly] = chunks - 1
+                frozen_np |= newly
+                applied_prev = applied
+                if np.all(~active | frozen_np):
+                    break
+            # one vmapped finisher dispatch for the goal: lanes satisfied at
+            # exit run it inert (run-gate False reports the same sentinel
+            # counts the solo path synthesizes)
+            st_b, fin_dev = _compiled_fleet_finish(type(g), g, prev)(
+                env_b, st_b, params)
+            sc = jax.device_get(scalars)
+            fin = jax.device_get(fin_dev)
+            it = np.asarray(sc[0], np.int64)
+            n_applied = np.asarray(sc[1], np.int64)
+            stall = np.asarray(sc[2], np.int64)
+            dribble = np.asarray(sc[3], np.int64)
+            sat = np.asarray(sc[4], bool)
+            plateau = np.asarray(sc[7], bool)
+            tailp = np.asarray(sc[8], np.int64)
+            violated = np.asarray(fin["violated_after"], bool)
+            proven = np.asarray(fin["fixpoint_proven"], bool)
+            budget_exit = ((it >= max_iters) | (dribble > tail_pass)
+                           | (tailp > tail_total) | plateau)
+            stall_cap = np.where(sat, sat_stall, stall_retries)
+            skipped = np.where(
+                quiesce >= 0,
+                np.maximum(0, np.minimum(np.minimum(max_iters - it,
+                                                    tail_total + 1 - tailp),
+                                         stall_cap + 1 - stall)),
+                0)
+            infos.append({
+                "iterations": n_applied + np.asarray(fin["finisher_actions"],
+                                                     np.int64),
+                "passes": it,
+                "violated_after": violated,
+                "hit_max_iters": ((stall <= stall_retries) & budget_exit
+                                  & violated & ~proven),
+                "plateau_exit": plateau,
+                "fixpoint_proven": proven,
+                "finisher_rounds": fin["finisher_rounds"],
+                "moves_remaining": fin["moves_remaining"],
+                "leads_remaining": fin["leads_remaining"],
+                "swap_window_remaining": fin["swap_window_remaining"],
+                "stat_before": stat_entry0,
+                "stat": fin["stat"],
+                "move_actions": sc[9], "lead_actions": sc[10],
+                "swap_actions": sc[11], "disk_actions": sc[12],
+                "move_waves": sc[13],
+                "finisher_actions": fin["finisher_actions"],
+                "finisher_segments": fin["finisher_segments"],
+                "finisher_boundary": fin["finisher_boundary"],
+                "passes_skipped": skipped,
+                "quiesce_chunk": quiesce,
+            })
+            prev = prev + (g,)
+        st_b, fin_out = _compiled_fleet_final(gclasses, ple)(env_b, st_b)
+        out = {"stats_before": head["stats_before"],
+               "viol_before": head["viol_before"],
+               "infos": infos,
+               "stats_after": fin_out["stats_after"],
+               "packed": fin_out["packed"]}
+        if ple is not None:
+            out["ple_was"] = fin_out["ple_was"]
+            out["ple_still"] = fin_out["ple_still"]
+        return st_b, out
 
     def _revalidated_fleet(self, sessions, goals, rds, chain_key, opt_gen,
                            compiles0, t_round):
@@ -1713,6 +2140,37 @@ def _compiled_fleet_chain(goal_classes: tuple, goals: tuple, ple,
         return jax.jit(jax.vmap(one, in_axes=(0, 0, None, 0)),
                        donate_argnums=(1,))
     return jax.jit(jax.vmap(one, in_axes=(0, 0, None)), donate_argnums=(1,))
+
+
+@lru_cache(maxsize=32)
+def _compiled_fleet_head(goal_classes: tuple, goals: tuple):
+    """The chunked fleet launch's opening program (PR 19): vmapped initial
+    stats + every goal's violated-before flag — the head the monolithic
+    fleet chain computed inline."""
+    del goal_classes  # cache key only
+
+    def one(env: ClusterEnv, st: EngineState):
+        return {"stats_before": _stats_device(env, st),
+                "viol_before": [g.violated(env, st) for g in goals]}
+    return jax.jit(jax.vmap(one))
+
+
+@lru_cache(maxsize=16)
+def _compiled_fleet_final(goal_classes: tuple, ple):
+    """The chunked fleet launch's closing program (PR 19): the optional
+    vmapped PreferredLeaderElection pass, final stats, packed final fetch."""
+    del goal_classes  # cache key only
+
+    def one(env: ClusterEnv, st: EngineState):
+        out = {}
+        if ple is not None:
+            out["ple_was"] = ple.violated(env, st)
+            st = ple.apply(env, st)
+            out["ple_still"] = ple.violated(env, st)
+        out["stats_after"] = _stats_device(env, st)
+        out["packed"] = _pack_final(env, st)
+        return st, out
+    return jax.jit(jax.vmap(one))
 
 
 @lru_cache(maxsize=64)

@@ -609,6 +609,46 @@ class SimulatedClusterBackend:
                     pass
             self._meta_gen += 1
 
+    def apply_assignment(self, proposals) -> int:
+        """Instantly complete an execution-proposal set: the cluster jumps
+        to the proposals' target placement (replica sets, leadership,
+        logdirs) as if every reassignment had finished — the bench/test
+        convergence helper for measuring steady-state rounds against a
+        cluster that actually REACHED the optimizer's target, without
+        simulating hours of copy throttling. Partitions with an in-flight
+        reassignment are skipped (their replica list is owned by the copy
+        machinery). Returns the number of partitions touched."""
+        with self._lock:
+            n = 0
+            for p in proposals:
+                tp = (p.topic, p.partition)
+                info = self._partitions.get(tp)
+                if info is None or tp in self._inflight:
+                    continue
+                new_b = [b for b, _ in p.new_replicas]
+                if any(b not in self._brokers for b in new_b):
+                    raise ValueError(f"unknown broker in target for {tp}")
+                removed = [b for b in info.replicas if b not in new_b]
+                info.replicas = new_b
+                for b, ld in p.new_replicas:
+                    lds = list(self._brokers[b].logdirs)
+                    info.logdir_by_broker[b] = (
+                        lds[ld] if 0 <= ld < len(lds) else lds[0])
+                for b in removed:
+                    info.logdir_by_broker.pop(b, None)
+                leader = p.new_leader
+                if (leader not in info.replicas
+                        or not self._brokers[leader].alive):
+                    alive = [b for b in info.replicas
+                             if self._brokers[b].alive]
+                    leader = alive[0] if alive else -1
+                info.leader = leader
+                self._c_update(tp)
+                n += 1
+            if n:
+                self._meta_gen += 1
+            return n
+
     def ongoing_reassignments(self) -> dict:
         with self._lock:
             return {tp: {"adding": list(fl.adding), "target": list(fl.target)}

@@ -617,6 +617,13 @@ class RoundTrace:
     # seconds, the round's only device work)
     round_mode: str = "full"
     revalidate_s: float = 0.0
+    # convergence-gated pass scheduling (PR 19): chain totals of budgeted
+    # passes dispatched vs avoided by the chunked early exit, goals whose
+    # chunk loop quiesced, and reduced goals short-circuited to one probe
+    passes_dispatched: int = 0
+    passes_skipped: int = 0
+    early_exit_goals: int = 0
+    skipped_goals: int = 0
 
     def to_json(self) -> dict:
         out = dataclasses.asdict(self)
@@ -646,9 +653,15 @@ def goal_trace_rows(goal_results) -> list[dict]:
         # cross-segment boundary rows re-validated by the budgeted admission
         "fin_segments": getattr(g, "finisher_segments", 0),
         "fin_boundary": getattr(g, "finisher_boundary", 0),
-        # incremental round mode (PR 16): full | reduced | revalidated —
-        # the flamegraph's which-goals-did-the-fast-path-skip signal
+        # incremental round mode (PR 16): full | reduced | revalidated |
+        # skipped — the flamegraph's which-goals-did-the-fast-path-skip
+        # signal
         "mode": getattr(g, "mode", "full"),
+        # convergence-gated dispatch (PR 19): budgeted passes the chunked
+        # early exit avoided and the quiescing chunk index (-1 = ran to the
+        # loop's own exit / chunking off)
+        "passes_skipped": getattr(g, "passes_skipped", 0),
+        "quiesce_chunk": getattr(g, "quiesce_chunk", -1),
     } for g in goal_results]
 
 
@@ -792,7 +805,11 @@ class FlightRecorder:
                      trace_id: str | None = None,
                      opt_generation: int | None = None,
                      round_mode: str = "full",
-                     revalidate_s: float = 0.0) -> RoundTrace:
+                     revalidate_s: float = 0.0,
+                     passes_dispatched: int = 0,
+                     passes_skipped: int = 0,
+                     early_exit_goals: int = 0,
+                     skipped_goals: int = 0) -> RoundTrace:
         """Assemble + record one round from what the optimizer already holds.
         ``opt_generation`` (from this round's ``note_optimize_start``) keys
         which pending stage notes belong to it. Never raises into the
@@ -825,6 +842,10 @@ class FlightRecorder:
                 trace_id=trace_id,
                 round_mode=round_mode,
                 revalidate_s=round(float(revalidate_s), 4),
+                passes_dispatched=int(passes_dispatched),
+                passes_skipped=int(passes_skipped),
+                early_exit_goals=int(early_exit_goals),
+                skipped_goals=int(skipped_goals),
             )
         except Exception:  # noqa: BLE001 — tracing must never fail a round
             import logging

@@ -291,6 +291,59 @@ _D.define(name="analyzer.incremental.seed.dirty", type=Type.BOOLEAN, default=Fal
               "escalation precedent; gated by tools/churn_ab.py + "
               "tools/slo_diff.py). Off by default like compact keying: an "
               "opt-in perf lever with a documented contract.")
+_D.define(name="analyzer.pass.chunk", type=Type.INT, default=8,
+          validator=at_least(0),
+          doc="Convergence-gated pass scheduling (PR 19): dispatch each "
+              "goal's budgeted loop in host-gated chunks of this many "
+              "passes; after each chunk one cheap device->host probe stops "
+              "dispatching as soon as the goal QUIESCES (a whole chunk "
+              "admitted zero actions while the loop's own exit condition "
+              "still held — provably bit-identical state, so the remaining "
+              "salted budget could only re-rank the same starved pools). "
+              "Same compiled pass program, fewer invocations; 0 restores "
+              "the monolithic single-dispatch loop. Traced budget leaf: "
+              "resizing the chunk reuses compiled programs.")
+_D.define(name="analyzer.pass.chunk.min.replicas", type=Type.INT, default=8192,
+          validator=at_least(-1),
+          doc="Cluster-size floor for chunked dispatch: below this many "
+              "(padded) replicas the per-chunk host sync costs more than "
+              "the passes it saves and goals run the legacy monolithic "
+              "program; -1 disables chunking everywhere. The sharded "
+              "engine and the measured-durations debug path always use "
+              "the monolithic dispatch.")
+_D.define(name="analyzer.pass.adaptive.budgets", type=Type.BOOLEAN, default=True,
+          doc="Churn-adaptive budgets (PR 19): on dirty-seeded reduced "
+              "rounds, clamp each reduced goal's stall/tail/finisher-round "
+              "budgets to what the MEASURED dirty-set size can need "
+              "(ceil(dirty / candidate pool) + 1 passes drain the set once "
+              "and one more proves quiescence), floored at "
+              "analyzer.pass.adaptive.floor.passes. Every clamped field is "
+              "a traced leaf — reduced<->full flips reuse the compiled "
+              "programs — and fallback re-runs keep the static budgets as "
+              "their floor, so the one-sided seeding contract is untouched.")
+_D.define(name="analyzer.pass.adaptive.floor.passes", type=Type.INT, default=4,
+          validator=at_least(1),
+          doc="Minimum per-goal stall/pass budget an adaptive reduced round "
+              "may clamp down to (keeps salted exploration alive on "
+              "pathological seeds).")
+_D.define(name="analyzer.pass.certificate.skip", type=Type.BOOLEAN, default=True,
+          doc="Certificate-gated finisher skip (PR 19): a goal that carried "
+              "a violated-at-fixpoint certificate from the previous round, "
+              "quiesced with ZERO actions this reduced round, and saw zero "
+              "actions from earlier chain goals skips the exhaustive "
+              "finisher scans — the carried certificate (re-stamped with "
+              "its measured remaining counts) stands in as the proof no "
+              "work remains, the DESIGN §20 memo argument at per-goal "
+              "granularity. The full-R fallback sweep and escalation treat "
+              "the goal exactly like any persistent proven violation.")
+_D.define(name="analyzer.pass.goal.shortcircuit", type=Type.BOOLEAN, default=True,
+          doc="Chain-level short-circuit (PR 19): a reduced-round goal that "
+              "enters the chain SATISFIED and whose seeded candidate keys "
+              "rank zero dirty replicas eligible for any of its action "
+              "kinds runs as ONE [B]-level probe instead of its full "
+              "program (GoalResult.mode == 'skipped'). Bit-exact by "
+              "construction: all-NEG_INF selection pools admit nothing, so "
+              "the skipped program could only no-op.")
 _D.define(name="analyzer.profile.level", type=Type.STRING, default="off",
           validator=in_set("off", "pass", "stage"),
           validator_doc="one of: off, pass, stage",

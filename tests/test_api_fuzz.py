@@ -289,6 +289,41 @@ def test_slo_diff_cli_exit_codes(tmp_path):
     assert mod.main([str(ps), str(pg)]) == 0
 
 
+def test_slo_diff_pass_gating_gates(tmp_path):
+    """PR 19 gates: a reduced-round wall regression past the threshold and
+    a pass early-exit that stopped firing both fail the diff; a candidate
+    that still skips passes (or early-exits goals) passes."""
+    mod = _load_slo_diff()
+
+    def rung(reduced_s, skipped, early, mode="reduced"):
+        return {"rungs": [{
+            "config": "e2e-1000b-50000p",
+            "round_s_steady": 40.0,
+            "round_s_reduced": reduced_s,
+            "churn_sweep": {"low": {"round_s": reduced_s,
+                                    "round_mode": mode,
+                                    "passes_skipped": skipped,
+                                    "early_exit_goals": early,
+                                    "skipped_goals": 0}}}]}
+
+    base = mod.extract_steady(rung(12.0, 400, 3))
+    ok = mod.extract_steady(rung(13.0, 380, 3))
+    rows, regs = mod.compare_steady(base, ok, threshold=0.25)
+    assert not regs, regs
+    # wall regression on the reduced round
+    slow = mod.extract_steady(rung(56.0, 400, 3))
+    rows, regs = mod.compare_steady(base, slow, threshold=0.25)
+    assert any(r["field"] == "round_s_reduced" for r in regs), regs
+    # the convergence gate stopped firing: zero skipped, zero early exits
+    dead = mod.extract_steady(rung(12.0, 0, 0))
+    rows, regs = mod.compare_steady(base, dead, threshold=0.25)
+    assert any(r["field"] == "low_churn_passes_skipped" for r in regs), regs
+    # the reduced chain itself stopped firing
+    full = mod.extract_steady(rung(12.0, 0, 0, mode="full"))
+    rows, regs = mod.compare_steady(base, full, threshold=0.25)
+    assert any(r["field"] == "low_churn_mode" for r in regs), regs
+
+
 # ------------------------------------------------------------- slow matrices
 @pytest.mark.slow
 def test_fuzz_micro_campaign_matrix():

@@ -23,6 +23,54 @@ from cruise_control_tpu.config.defaults import (
 
 
 # ---------------------------------------------------------------- definitions
+def test_every_key_read_in_source_is_registered():
+    """The inverse of the consumption guard: every config key the source
+    tree reads by literal name must be DEFINED in defaults.py (canonical or
+    alias). A `config.get_*("some.new.key")` without a matching
+    `_D.define(...)` — e.g. an analyzer.pass.* knob added without
+    registration — fails this test."""
+    import pathlib
+    import re
+
+    root = pathlib.Path(__file__).resolve().parents[1] / "cruise_control_tpu"
+    pat = re.compile(
+        r"""\.get_(?:int|long|boolean|double|string|list|"""
+        r"""configured_instances?)\(\s*\n?\s*["']([a-z0-9._]+)["']""")
+    read = set()
+    for p in root.rglob("*.py"):
+        read |= set(pat.findall(p.read_text()))
+    assert len(read) >= 240, "literal-key scan regressed"
+    unknown = sorted(read - set(CRUISE_CONTROL_CONFIG_DEF.keys()))
+    assert not unknown, (
+        f"{len(unknown)} keys read in source but never defined: {unknown}")
+
+
+def test_pass_gating_keys_defined_with_guardrails():
+    """The convergence-gated scheduling family (PR 19): registered, typed,
+    defaulted, and validator-guarded."""
+    keys = CRUISE_CONTROL_CONFIG_DEF.keys()
+    expect = {
+        "analyzer.pass.chunk": 8,
+        "analyzer.pass.chunk.min.replicas": 8192,
+        "analyzer.pass.adaptive.budgets": True,
+        "analyzer.pass.adaptive.floor.passes": 4,
+        "analyzer.pass.certificate.skip": True,
+        "analyzer.pass.goal.shortcircuit": True,
+    }
+    cfg = cruise_control_config()
+    for name, default in expect.items():
+        assert name in keys, name
+        if isinstance(default, bool):
+            assert cfg.get_boolean(name) is default, name
+        else:
+            assert cfg.get_int(name) == default, name
+    # validator floors: a negative chunk is rejected at load time
+    with pytest.raises(ConfigException):
+        cruise_control_config({"analyzer.pass.chunk": -1})
+    with pytest.raises(ConfigException):
+        cruise_control_config({"analyzer.pass.adaptive.floor.passes": 0})
+
+
 def test_key_surface_size_matches_reference_scale():
     keys = CRUISE_CONTROL_CONFIG_DEF.keys()
     canonical = [k for k in keys.values() if k.alias_of is None]

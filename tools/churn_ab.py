@@ -22,12 +22,22 @@ reference cell of the same churn level:
 Violations of any clause are printed AND returned in the JSON
 (``parity_failures``); exit code 1 when any cell fails.
 
+``--fresh-cache`` runs one SUBPROCESS per cell (the tools/shard_ab.py
+pattern), each with its own JAX compilation-cache directory: no cell can
+ride programs another cell warmed, so the walls are honest cold-process
+figures and a knob whose flip silently depends on cross-cell warm state is
+exposed. The toggle-compile clause is skipped in this mode (every process
+legitimately pays its own compiles); the set-parity clauses still apply.
+
 Usage: churn_ab.py [small|r2] [--cells rv,sd;...] [--churn 0;low]
+                   [--fresh-cache]
   e.g.  churn_ab.py small
         churn_ab.py r2 --cells on,off;on,on --churn 0
+        churn_ab.py small --fresh-cache
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -119,6 +129,15 @@ def run_cell(shape, revalidate: bool, seed_dirty: bool, churn: str) -> dict:
     sess.sync()
     opt.optimizations(None, session=sess, raise_on_failure=False,
                       skip_hard_goal_check=True)       # rebuild (cold)
+    # NOTE: the grid deliberately does NOT converge the backend between the
+    # cold round and the cells (bench.py's churn sweep does, for honest
+    # walls). The one-sided seeding contract is defined and pinned on this
+    # never-executing protocol; on a CONVERGED placement a masked reduced
+    # round can end with violations the full round fixes — earlier goals'
+    # moves land outside the dirty mask and knock over later goals the
+    # seeded pass can then not reach (same limitation PERF round 14 records)
+    # — so a converged grid would gate the seeding heuristic's known gap,
+    # not a regression.
     service_round(5)                                   # baseline
     if churn == "low":
         _inject_low_churn(be)
@@ -142,6 +161,13 @@ def run_cell(shape, revalidate: bool, seed_dirty: bool, churn: str) -> dict:
         "violated_goals_after": sorted(viol),
         "fixpoint_proven": sorted(certs),
         "num_replica_movements": res.num_replica_movements,
+        # convergence-gated pass scheduling (PR 19): budgeted pass slots
+        # dispatched vs provably avoided on the measured round, plus the
+        # goals that early-exited or were short-circuited to one [B] probe
+        "passes_dispatched": res.passes_dispatched,
+        "passes_skipped": res.passes_skipped,
+        "early_exit_goals": res.early_exit_goals,
+        "skipped_goals": res.skipped_goals,
         "compiles_total": opt._compile_listener.count - compiles0,
         "compiles_measured_rounds": opt._compile_listener.count
         - warm_compiles,
@@ -185,8 +211,11 @@ def check_parity(cells: list) -> list:
                 if not rc.issubset(cc):
                     failures.append(f"{name}: LOST certificates vs "
                                     f"reference: {sorted(rc - cc)}")
-            # toggle-compile clause (cell 0 warms the programs)
-            if cells.index(c) > 0 and c["compiles_measured_rounds"] > 0 \
+            # toggle-compile clause (cell 0 warms the programs); not
+            # applicable under --fresh-cache, where every cell is its own
+            # cold process and pays its own compiles by design
+            if not c.get("fresh_cache") and cells.index(c) > 0 \
+                    and c["compiles_measured_rounds"] > 0 \
                     and c["fallback_goals"] == 0:
                 failures.append(
                     f"{name}: {c['compiles_measured_rounds']} new XLA "
@@ -194,10 +223,38 @@ def check_parity(cells: list) -> list:
     return failures
 
 
+def _run_cell_subprocess(shape_name, rv, sd, churn) -> dict:
+    """One cell in its own process with a private compilation cache (the
+    tools/shard_ab.py pattern): nothing warmed by another cell survives."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["JAX_COMPILATION_CACHE_DIR"] = (
+        f"/tmp/jax_cache_cc_churn_{shape_name}_{int(rv)}{int(sd)}_{churn}")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", shape_name,
+         str(int(rv)), str(int(sd)), churn],
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        print(proc.stderr[-2000:], file=sys.stderr)
+        raise SystemExit(f"cell rv={rv} sd={sd} churn={churn} failed "
+                         f"rc={proc.returncode}")
+    cell = json.loads(proc.stdout.strip().splitlines()[-1])
+    cell["fresh_cache"] = True
+    return cell
+
+
 def main() -> int:
     argv = sys.argv[1:]
+    if argv and argv[0] == "--child":
+        shape_name, rv, sd, churn = argv[1], argv[2], argv[3], argv[4]
+        print(json.dumps(run_cell(SHAPES[shape_name], rv == "1",
+                                  sd == "1", churn)))
+        return 0
     shape_name = argv[0] if argv and not argv[0].startswith("--") else "small"
     shape = SHAPES[shape_name]
+    fresh_cache = "--fresh-cache" in argv
     knob_cells = [(False, False), (True, False), (False, True), (True, True)]
     if "--cells" in argv:
         spec = argv[argv.index("--cells") + 1]
@@ -208,10 +265,14 @@ def main() -> int:
         churns = argv[argv.index("--churn") + 1].split(";")
     out = []
     # knobs-off reference first per churn level: it warms every program the
-    # toggled cells are then required to reuse compile-free
+    # toggled cells are then required to reuse compile-free (in-process
+    # mode; --fresh-cache isolates cells instead)
     for churn in churns:
         for rv, sd in knob_cells:
-            cell = run_cell(shape, rv, sd, churn)
+            if fresh_cache:
+                cell = _run_cell_subprocess(shape_name, rv, sd, churn)
+            else:
+                cell = run_cell(shape, rv, sd, churn)
             out.append(cell)
             print(f"  churn={churn} rv={int(rv)} sd={int(sd)}: "
                   f"{cell['round_s']}s mode={cell['round_mode']} "
@@ -219,13 +280,15 @@ def main() -> int:
                   f"reval_goals={cell['revalidated_goals']} "
                   f"reduced={cell['reduced_goals']} "
                   f"fallback={cell['fallback_goals']} "
+                  f"passes={cell['passes_dispatched']}"
+                  f"(+{cell['passes_skipped']} skipped) "
                   f"compiles={cell['compiles_measured_rounds']}",
                   file=sys.stderr, flush=True)
     failures = check_parity(out)
     for f in failures:
         print(f"PARITY FAILURE: {f}", file=sys.stderr, flush=True)
-    print(json.dumps({"shape": shape_name, "cells": out,
-                      "parity_failures": failures}))
+    print(json.dumps({"shape": shape_name, "fresh_cache": fresh_cache,
+                      "cells": out, "parity_failures": failures}))
     return 1 if failures else 0
 
 

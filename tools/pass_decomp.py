@@ -209,3 +209,30 @@ _st2, n_seg, n_bnd = bench(f"stage: segment wave S={params.max_finisher_segments
 _st3, n_leg, _w = bench("stage: legacy wave S=1", stage_legacy_wave, env, st, gain)
 print(f"segment wave applied {int(n_seg)} ({int(n_bnd)} boundary) vs "
       f"legacy {int(n_leg)} per re-score", flush=True)
+
+
+# ---- chunked early-exit dispatch (PR 19): the same pass program dispatched
+# in host-gated chunks of pass_chunk — whole-goal wall vs the monolithic
+# while_loop, and the pass budget the quiesce gate retires at this shape ----
+def goal_mono(env, st):
+    s, info = E.optimize_goal(env, st, goal, prev, params)
+    jax.block_until_ready(s.util)
+    return int(info["passes"]), 0
+
+
+def goal_chunked(env, st):
+    s, info = E.optimize_goal_chunked(env, st, goal, prev, params)
+    jax.block_until_ready(s.util)
+    return int(info["passes"]), int(info["passes_skipped"])
+
+
+for name, fn in (("GOAL monolithic", goal_mono),
+                 ("GOAL chunked", goal_chunked)):
+    fn(env, st)                                   # warm the programs
+    t0 = time.monotonic()
+    ran, skipped = fn(env, st)
+    wall = time.monotonic() - t0
+    print(f"{name:28s} {wall * 1e3:8.2f} ms  passes={ran}"
+          f"{f' (+{skipped} skipped)' if skipped else ''}"
+          f"{f' chunk={int(params.pass_chunk)}' if 'chunked' in name else ''}",
+          flush=True)

@@ -53,7 +53,11 @@ P99_FIELDS = ("latency_ms",)
 STEADY_FIELDS = ("round_s_steady", "round_s_pipelined",
                  # PR 16: the zero-churn certificate-memo round is gated
                  # like any other steady wall
-                 "round_s_revalidated")
+                 "round_s_revalidated",
+                 # PR 19: the low-churn dirty-seeded reduced round is gated
+                 # too — convergence-gated pass scheduling must keep it
+                 # churn-proportional, not pass-budget-proportional
+                 "round_s_reduced")
 
 
 def extract_slo(doc: dict) -> dict:
@@ -139,6 +143,18 @@ def extract_steady(doc: dict) -> dict:
         if zero:
             row["zero_churn_mode"] = zero.get("round_mode")
             row["zero_churn_goals_reexecuted"] = zero.get("goals_reexecuted")
+        # PR 19 churn sweep: the low-churn reduced round's wall and whether
+        # the convergence gate actually fired (passes skipped / goals
+        # early-exited or short-circuited)
+        if "round_s_reduced" in rung:
+            row["round_s_reduced"] = rung["round_s_reduced"]
+        low = (rung.get("churn_sweep") or {}).get("low") or {}
+        if low:
+            row["low_churn_mode"] = low.get("round_mode")
+            row["low_churn_passes_skipped"] = low.get("passes_skipped")
+            row["low_churn_early_exit_goals"] = (
+                (low.get("early_exit_goals") or 0)
+                + (low.get("skipped_goals") or 0))
         out[rung.get("config", "?")] = row
     return out
 
@@ -192,6 +208,31 @@ def compare_steady(base: dict, cand: dict, threshold: float = 0.25):
                    "base_p95": bz, "cand_p95": cz,
                    "regression": f"zero-churn round re-executed {cz} goals "
                                  f"(baseline re-executed none)"}
+            regressions.append(row)
+            rows.append(row)
+        # PR 19: a low-churn round that rode the reduced chain in the
+        # baseline but fell back to a full round in the candidate lost the
+        # churn-proportional path
+        if b.get("low_churn_mode") == "reduced" \
+                and c.get("low_churn_mode") not in (None, "reduced"):
+            row = {"kind": config, "field": "low_churn_mode",
+                   "base_p95": 1, "cand_p95": 0,
+                   "regression": "low-churn reduced round stopped firing "
+                                 f"(candidate mode: {c['low_churn_mode']})"}
+            regressions.append(row)
+            rows.append(row)
+        # ... and a convergence gate that skipped passes in the baseline but
+        # skipped none in the candidate stopped firing: the reduced round is
+        # back to paying the full static pass budget
+        bs = b.get("low_churn_passes_skipped")
+        cs = c.get("low_churn_passes_skipped")
+        if (bs or 0) > 0 and cs == 0 \
+                and (c.get("low_churn_early_exit_goals") or 0) == 0:
+            row = {"kind": config, "field": "low_churn_passes_skipped",
+                   "base_p95": bs, "cand_p95": cs,
+                   "regression": "pass early-exit stopped firing on the "
+                                 "low-churn round (baseline skipped "
+                                 f"{bs} passes)"}
             regressions.append(row)
             rows.append(row)
     return rows, regressions

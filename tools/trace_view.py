@@ -82,6 +82,14 @@ def render(trace: dict, width: int = 48) -> str:
         head += f" · {mode}"
         if trace.get("revalidate_s"):
             head += f" ({trace['revalidate_s']:.3f}s re-check)"
+    # convergence-gated pass scheduling (PR 19): dispatched vs quiesce-
+    # skipped pass budget and the goals the gate retired early
+    if trace.get("passes_skipped") or trace.get("early_exit_goals") \
+            or trace.get("skipped_goals"):
+        head += (f" · passes {trace.get('passes_dispatched', 0)}"
+                 f"(+{trace.get('passes_skipped', 0)} skipped,"
+                 f" {trace.get('early_exit_goals', 0)} early-exit,"
+                 f" {trace.get('skipped_goals', 0)} short-circuit)")
     lines.append(head)
     parts = []
     if trace.get("sampling_s") is not None:
@@ -131,12 +139,19 @@ def render(trace: dict, width: int = 48) -> str:
             "V" if g.get("violated_after") else "·",
             "v" if g.get("violated_before") else "·",
             # per-goal execution mode: R=revalidated (carried, not re-run),
-            # r=reduced (dirty-seeded candidates), ·=full
-            {"revalidated": "R", "reduced": "r"}.get(g.get("mode"), "·")))
+            # r=reduced (dirty-seeded candidates), S=short-circuited to one
+            # [B] probe (PR 19), ·=full
+            {"revalidated": "R", "reduced": "r",
+             "skipped": "S"}.get(g.get("mode"), "·")))
         detail = (f"p={g.get('passes', 0):<4} w={g.get('waves', 0):<4} "
                   f"m={g.get('moves', 0)} l={g.get('leads', 0)} "
                   f"s={g.get('swaps', 0)} d={g.get('disk', 0)} "
                   f"f={g.get('finisher', 0)}")
+        # convergence gate (PR 19): passes the quiesce break avoided and the
+        # chunk index it fired at — only where the gate actually fired
+        if g.get("passes_skipped"):
+            detail += (f" skip={g['passes_skipped']}"
+                       f"@c{g.get('quiesce_chunk', -1)}")
         # segment-parallel finisher phase (fin_segments=0 = legacy waves):
         # show segments + boundary re-validations only where the phase ran
         if g.get("fin_segments"):
