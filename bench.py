@@ -1167,7 +1167,7 @@ def run_serving_rung(n_tenants: int = 50, seed: int = 0,
     advantage, parity, toggle compiles."""
     from cruise_control_tpu.pipeline import LANE_HEAL, LANE_REBALANCE
     from cruise_control_tpu.sim.campaign import (
-        build_serving_fleet, run_serving_campaign,
+        build_serving_fleet, run_churn_skew_cell, run_serving_campaign,
     )
 
     log(f"rung serving: request-admission engine vs static round, "
@@ -1219,6 +1219,28 @@ def run_serving_rung(n_tenants: int = 50, seed: int = 0,
 
     camp = run_serving_campaign(num_tenants=n_tenants, seed=seed,
                                 duration_ms=duration_ms)
+
+    # churn-skew fleet-gating cell (PR 20): gated vs ungated batched
+    # launches on bit-identical churn-skewed streams (1 hot + 7 near-idle
+    # tenants). tools/slo_diff.py gates the emitted "fleet_gating" block
+    # (extract_fleet_gating / compare_fleet_gating).
+    log("  [serving] churn-skew fleet-gating cell: 8 tenants "
+        "(1 hot), gated vs ungated")
+    # 6000 partitions (12000 replicas/tenant) puts per-chunk [K, R]
+    # compute — not host dispatch — on the critical path, the regime the
+    # compaction targets (below ~4000 replicas/tenant gating is a wash,
+    # DESIGN §24); 4 measured rounds so the p95 is not a single max
+    cell = run_churn_skew_cell(num_tenants=8, seed=seed, rounds=4,
+                               num_partitions=6000)
+    log(f"  [fleet_gating] parity={cell['per_tenant_parity']}, "
+        f"wall {cell['wall_s']['ungated']}s -> {cell['wall_s']['gated']}s "
+        f"({cell['wall_speedup_x']}x), hot heal p95 "
+        f"{cell['heal_p95_improvement_x']}x better, "
+        f"compactions={cell['compactions']}, "
+        f"parked={cell['parked_rounds']}, "
+        f"early installs={cell['early_installs']}, "
+        f"toggle compiles={cell['budget_toggle_new_compiles']}")
+
     wall = round(time.monotonic() - t0, 2)
     eng, base = camp["engine"], camp["baseline"]
     rung = {
@@ -1232,6 +1254,10 @@ def run_serving_rung(n_tenants: int = 50, seed: int = 0,
         "heal_p95_improvement_x": camp.get("healP95ImprovementX"),
         "parity_identical": parity,
         "toggle_new_compiles": toggle_new_compiles,
+        "gating_wall_speedup_x": cell["wall_speedup_x"],
+        "gating_heal_p95_improvement_x": cell["heal_p95_improvement_x"],
+        "gating_compactions": cell["compactions"],
+        "gating_toggle_new_compiles": cell["budget_toggle_new_compiles"],
         "wall_s": wall,
     }
     # SUMMARY.serving carries the full campaign document (both legs'
@@ -1239,6 +1265,7 @@ def run_serving_rung(n_tenants: int = 50, seed: int = 0,
     # the contract verdicts — slo_diff gates it without re-deriving
     SUMMARY.serving = dict(camp, parity_identical=parity,
                            toggle_new_compiles=toggle_new_compiles,
+                           fleet_gating=cell,
                            wall_s=wall)
     log(f"serving rung: engine {rung['proposals_per_sec_engine']} "
         f"proposals/s vs static {rung['proposals_per_sec_static']} "

@@ -2351,6 +2351,104 @@ def _compiled_fleet_finish(goal_cls, goal: GoalKernel, prev_goals: tuple):
     return jax.jit(jax.vmap(one, in_axes=(0, 0, None)))
 
 
+# ---------------------------------------------------------------------------
+# Ragged fleet gating (PR 20): per-lane traced budgets
+# ---------------------------------------------------------------------------
+# The six convergence budgets that PR 19's solo adaptive clamp rewrites per
+# round. On the batched path they ride the TENANT axis: each arrives as an
+# int32[K] vmapped operand and is rebound into the (broadcast) EngineParams
+# inside the per-lane function body, so lane k's while_loop conds, finisher
+# scan lengths and stall caps all read ITS clamped budget. EngineParams is a
+# registered pytree whose _norm_leaf passes tracers through untouched, so the
+# dataclasses.replace below keys the SAME cached executable for any budget
+# values — the zero-recompile property of PR 19's traced scalars, per lane.
+_LANE_BUDGET_FIELDS = ("stall_retries", "sat_stall_retries",
+                       "tail_pass_budget", "sat_tail_passes",
+                       "tail_total_budget", "finisher_rounds")
+
+
+def _lane_params(params: EngineParams, lane_budgets: tuple) -> EngineParams:
+    """Rebind the six gating budgets from this lane's traced scalars."""
+    return dataclasses.replace(
+        params, **dict(zip(_LANE_BUDGET_FIELDS, lane_budgets)))
+
+
+@lru_cache(maxsize=256)
+def _compiled_fleet_probe(goal_cls, goal: GoalKernel):
+    """Vmapped chain-level short-circuit probe (_compiled_goal_probe per
+    lane): one dispatch answers, for every tenant at once, whether this goal
+    is a provable bit-exact no-op against that lane's dirty set."""
+    del goal_cls  # cache key only
+
+    def one(env, st, seed_mask):
+        return {"violated": goal.violated(env, st),
+                "has_work": goal.seeded_work_probe(env, st, seed_mask),
+                "stat": goal.stat(env, st)}
+    return jax.jit(jax.vmap(one))
+
+
+@lru_cache(maxsize=64)
+def _compiled_fleet_chunk_gated(goal_cls, goal: GoalKernel,
+                                prev_goals: tuple):
+    """Gated variant of _compiled_fleet_chunk: identical per-lane chunk body,
+    but the pass/stall/tail budgets are per-lane vmapped operands (see
+    _LANE_BUDGET_FIELDS). A lane whose budgets were churn-clamped low exits
+    its while_loop early and coasts bit-frozen (the batching rule masks its
+    carry) while wide-budget lanes keep stepping — solo adaptive gating,
+    per lane, in one executable. Masked-only: gating requires seed masks
+    (the dirty counts that derive the budgets come from the same masks)."""
+    del goal_cls  # cache key only
+
+    def one(env, st, scalars, params, lane_budgets, seed_mask, frozen):
+        return _goal_chunk(env, st, scalars, goal, prev_goals,
+                           _lane_params(params, lane_budgets),
+                           seed_mask=seed_mask, frozen=frozen)
+    return jax.jit(jax.vmap(one, in_axes=(0, 0, 0, None, 0, 0, 0)))
+
+
+@lru_cache(maxsize=64)
+def _compiled_fleet_finish_gated(goal_cls, goal: GoalKernel,
+                                 prev_goals: tuple):
+    """Gated variant of _compiled_fleet_finish: per-lane finisher budgets
+    plus a per-lane ``skip`` flag. A skip lane (satisfied at budget exit, or
+    carrying a valid certificate) runs ``_finisher`` with run=False — the
+    scan is masked to a no-op and the sentinel outputs (proven=False,
+    remaining=-1, rounds=0) are EXACTLY what the solo chunked dispatch
+    synthesizes on the host when it elides the finisher program, so per-lane
+    parity holds whether the fleet dispatches this program or not."""
+    del goal_cls  # cache key only
+
+    def one(env, st, params, lane_budgets, skip):
+        p = _lane_params(params, lane_budgets)
+        viol_pre = goal.violated(env, st)
+        run = viol_pre & ~skip
+        (st2, fin_proven, moves_left, leads_left, swaps_left, fin_rounds,
+         fin_applied, fin_boundary, fin_segments) = _finisher(
+            env, st, goal, prev_goals, p, run)
+        return st2, {"violated_after": goal.violated(env, st2),
+                     "fixpoint_proven": fin_proven,
+                     "moves_remaining": moves_left,
+                     "leads_remaining": leads_left,
+                     "swap_window_remaining": swaps_left,
+                     "finisher_rounds": fin_rounds,
+                     "finisher_actions": fin_applied,
+                     "finisher_boundary": fin_boundary,
+                     "finisher_segments": fin_segments,
+                     "stat": goal.stat(env, st2)}
+    return jax.jit(jax.vmap(one, in_axes=(0, 0, None, 0, 0)))
+
+
+@jax.jit
+def _fleet_take(tree, idx: Array):
+    """Jitted row gather along the tenant axis for quiesced-lane compaction:
+    one fused program re-stacks the still-active (or parked) lane subset of
+    a [K, ...] pytree. ``idx`` may repeat rows (pad-by-repetition up the
+    pow2 ladder); pads are marked frozen by the caller and their outputs
+    discarded."""
+    return jax.tree_util.tree_map(lambda leaf: jnp.take(leaf, idx, axis=0),
+                                  tree)
+
+
 def optimize_goal_chunked(env: ClusterEnv, st: EngineState, goal: GoalKernel,
                           prev_goals: tuple = (),
                           params: EngineParams = EngineParams(),

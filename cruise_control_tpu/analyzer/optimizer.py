@@ -23,9 +23,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from cruise_control_tpu.analyzer.engine import (
-    EngineParams, _compiled_fleet_chunk, _compiled_fleet_finish,
-    _compiled_goal_probe, _fleet_scalar_init, optimize_goal,
-    optimize_goal_chunked,
+    EngineParams, _compiled_fleet_chunk, _compiled_fleet_chunk_gated,
+    _compiled_fleet_finish, _compiled_fleet_finish_gated,
+    _compiled_fleet_probe, _compiled_goal_probe, _fleet_scalar_init,
+    _fleet_take, optimize_goal, optimize_goal_chunked,
 )
 from cruise_control_tpu.analyzer.env import (
     BalancingConstraint, ClusterEnv, OptimizationOptions, make_env,
@@ -173,6 +174,12 @@ class OptimizerResult:
     passes_skipped: int = 0
     early_exit_goals: int = 0
     skipped_goals: int = 0
+    # ragged fleet gating (PR 20, batched launches only): parked_early means
+    # this tenant's lane quiesced at a goal boundary and finished ahead of
+    # the launch (early install eligible); compacted_out means its frozen
+    # lane was dropped from the working stack by quiesced-lane compaction
+    parked_early: bool = False
+    compacted_out: bool = False
 
     @property
     def violated_goals_before(self) -> list[str]:
@@ -202,6 +209,9 @@ class OptimizerResult:
             out["summary"]["passesSkipped"] = self.passes_skipped
             out["summary"]["earlyExitGoals"] = self.early_exit_goals
             out["summary"]["skippedGoals"] = self.skipped_goals
+        if self.parked_early or self.compacted_out:
+            out["summary"]["parkedEarly"] = self.parked_early
+            out["summary"]["compactedOut"] = self.compacted_out
         for g, entry in zip(self.goal_results, out["goalSummary"]):
             entry["iterations"] = g.iterations
             entry["budgetExhausted"] = g.hit_max_iters
@@ -462,6 +472,18 @@ class GoalOptimizer:
             if config is not None else True)
         self._shortcircuit = (
             config.get_boolean("analyzer.pass.goal.shortcircuit")
+            if config is not None else True)
+        # fleet.pass.*: ragged fleet convergence gating (PR 20). ``gating``
+        # promotes the adaptive budgets / chain short-circuit / certificate
+        # finisher-skip to per-lane vmapped operands on the batched chunked
+        # path (off = the PR 19 per-lane-freeze path, verbatim);
+        # ``compaction`` re-stacks the still-active tenant subset between
+        # chunks once enough lanes quiesce to drop a pow2 rung
+        self._fleet_gating = (
+            config.get_boolean("fleet.pass.gating.enabled")
+            if config is not None else True)
+        self._fleet_compaction = (
+            config.get_boolean("fleet.pass.compaction.enabled")
             if config is not None else True)
         # (chain_key, num_replicas) whose short-circuit probes were warmed
         # during a full chunked round — reduced rounds then compile nothing
@@ -791,8 +813,7 @@ class GoalOptimizer:
             seed_masks = [ones] * len(goals)
             mask_modes = ["full"] * len(goals)
             co = session.carryover
-            budget = (getattr(session, "_max_delta_fraction", 0.25)
-                      * max(num_replicas, 1))
+            budget = session.seed_budget_replicas(num_replicas)
             if (self._seed_dirty and rd is not None and co is not None
                     and co.chain_key == chain_key
                     and rd["syncs"] >= 1 and not rd["rebuilt"]
@@ -915,8 +936,7 @@ class GoalOptimizer:
                 and co_cert.chain_key == chain_key
                 and rd["syncs"] >= 1 and not rd["rebuilt"]
                 and not rd["broker_flips"]):
-            cert_budget = (getattr(session, "_max_delta_fraction", 0.25)
-                           * max(num_replicas, 1))
+            cert_budget = session.seed_budget_replicas(num_replicas)
             cert_carry_ok = 0 <= rd["churn"] <= cert_budget
         carried_map = ({r.name: r for r in co_cert.result.goal_results}
                        if cert_carry_ok else {})
@@ -1604,7 +1624,8 @@ class GoalOptimizer:
     # ----------------------------------------------- fleet batched launch
     def optimizations_batched(self, sessions: list, goal_names=None,
                               options: OptimizationOptions = OptimizationOptions(),
-                              raise_on_failure: bool = False) -> list:
+                              raise_on_failure: bool = False,
+                              on_result=None) -> list:
         """ONE vmapped engine launch over K same-bucket resident sessions
         (fleet mode, SURVEY §2.10's one-controller-per-cluster lifted): the
         tenants' padded ``ClusterEnv``/``EngineState`` pytrees stack along a
@@ -1622,10 +1643,10 @@ class GoalOptimizer:
         rematerializes from host mirrors)."""
         with self._proposal_timer.time():
             return self._optimizations_batched(sessions, goal_names, options,
-                                               raise_on_failure)
+                                               raise_on_failure, on_result)
 
     def _optimizations_batched(self, sessions, goal_names, options,
-                               raise_on_failure) -> list:
+                               raise_on_failure, on_result=None) -> list:
         t_round = time.monotonic()
         opt_gen = self.recorder.note_optimize_start()
         compiles0 = self._compile_listener.count
@@ -1672,6 +1693,7 @@ class GoalOptimizer:
         # rows for churn-budgeted tenants with carryover — stacked [K, R]
         # per goal so reduced<->full stays value-only across the fleet
         reduced_by_tenant: list[set] = [set() for _ in sessions]
+        dirty_counts = [0] * len(sessions)
         masks_b = None
         if self._incremental:
             ones_np = np.ones((num_replicas,), bool)
@@ -1679,8 +1701,7 @@ class GoalOptimizer:
             for k, (s, rd) in enumerate(zip(sessions, rds)):
                 co = s.carryover
                 masks_k = [ones_np] * len(goals)
-                budget = (getattr(s, "_max_delta_fraction", 0.25)
-                          * max(num_replicas, 1))
+                budget = s.seed_budget_replicas(num_replicas)
                 if (self._seed_dirty and rd is not None and co is not None
                         and co.chain_key == chain_key
                         and rd["syncs"] >= 1 and not rd["rebuilt"]
@@ -1689,6 +1710,7 @@ class GoalOptimizer:
                     np_dirty = s.dirty_replica_mask(rd["dirty_brokers"],
                                                     rd["dirty_topics"])
                     if np_dirty.any():
+                        dirty_counts[k] = int(np_dirty.sum())
                         # same two-sided eligibility as the solo path: the
                         # carried round ended the goal satisfied AND the
                         # churned round-START state still reads satisfied
@@ -1711,37 +1733,71 @@ class GoalOptimizer:
         # exists for; steady fleet rounds add zero compiles
         env_b = _compiled_stack(len(envs))(*envs)
         st_b = _compiled_stack(len(sts))(*sts)
-        # convergence-gated dispatch (PR 19): at/above the chunk threshold
-        # the fleet launch runs per-goal vmapped CHUNK programs with
-        # per-lane freeze flags — a quiesced tenant's lane runs zero passes
-        # while active lanes keep stepping (bit-exact per-lane early exit) —
-        # instead of one monolithic chain program. Adaptive budgets /
-        # cert-skip / short-circuit stay solo-only: they are per-tenant
-        # decisions a shared broadcast EngineParams cannot express.
+        # convergence-gated dispatch (PR 19/20): at/above the chunk
+        # threshold the fleet launch runs per-goal vmapped CHUNK programs
+        # with per-lane freeze flags — a quiesced tenant's lane runs zero
+        # passes while active lanes keep stepping (bit-exact per-lane early
+        # exit) — instead of one monolithic chain program. With
+        # fleet.pass.gating.enabled (PR 20) and seed masks armed, the PR 19
+        # solo-only levers — churn-adaptive budgets, chain-level
+        # short-circuit, certificate finisher-skip — additionally ride the
+        # tenant axis as per-lane traced operands, plus quiesced-lane
+        # compaction and early per-lane result landing; gating off keeps
+        # the PR 19 per-lane-freeze path verbatim.
         use_chunked = (self._pass_chunk > 0 and params.pass_chunk > 0
                        and num_replicas >= self._chunk_min_replicas)
-        if use_chunked:
-            st_b, out = self._fleet_chain_chunked(env_b, st_b, goals, ple,
-                                                  params, masks_b)
-        elif masks_b is not None:
-            fn = _compiled_fleet_chain(tuple(type(g) for g in goals),
-                                       tuple(goals), ple, masked=True)
-            st_b, out = fn(env_b, st_b, params, masks_b)
-        else:
-            fn = _compiled_fleet_chain(tuple(type(g) for g in goals),
-                                       tuple(goals), ple)
-            st_b, out = fn(env_b, st_b, params)
-        out = jax.device_get(out)
+        gating = (use_chunked and self._fleet_gating
+                  and masks_b is not None)
 
-        results = []
-        for i, (session, inp) in enumerate(zip(sessions, inputs)):
+        # per-lane gating metadata: the same per-round host decisions the
+        # solo gated path makes (adaptive budget need from the measured
+        # dirty count, certificate-carry window, carried-result map for the
+        # finisher-skip patch), resolved per tenant
+        lane_need = np.zeros(len(sessions), np.int64)
+        reduced_flags = np.zeros((len(sessions), len(goals)), bool)
+        cert_goal = np.zeros((len(sessions), len(goals)), bool)
+        carried_maps: list[dict] = [{} for _ in sessions]
+        if gating:
+            for k, (s, rd) in enumerate(zip(sessions, rds)):
+                for gi, g in enumerate(goals):
+                    reduced_flags[k, gi] = g.name in reduced_by_tenant[k]
+                if (self._adaptive_budgets and dirty_counts[k] > 0
+                        and reduced_by_tenant[k]):
+                    lane_need[k] = max(
+                        self._adaptive_floor,
+                        -(-dirty_counts[k]
+                          // max(int(params.num_candidates), 1)) + 1)
+                co = s.carryover
+                if (self._cert_skip and rd is not None and co is not None
+                        and co.chain_key == chain_key
+                        and rd["syncs"] >= 1 and not rd["rebuilt"]
+                        and not rd["broker_flips"]
+                        and 0 <= rd["churn"]
+                        <= s.seed_budget_replicas(num_replicas)):
+                    carried_maps[k] = {r.name: r
+                                       for r in co.result.goal_results}
+                    for gi, g in enumerate(goals):
+                        cert_goal[k, gi] = (
+                            co.violated_after.get(g.name) is True
+                            and co.proven.get(g.name) is True)
+
+        results_by_idx: dict[int, OptimizerResult] = {}
+        failed_hard: list[tuple] = []
+
+        def finalize_tenant(i, payload):
+            """Build tenant i's OptimizerResult from its per-lane host
+            payload — shared by the ungated unpack loop and the gated
+            chain's early-landing callback, so the two paths cannot
+            drift. Runs the per-tenant fallback/escalation programs, diffs
+            proposals, stamps carryover and fires ``on_result``."""
+            session, inp = sessions[i], inputs[i]
             (env, _st0, meta, part_table, initial_broker, initial_leader,
              initial_disk, host_valid, host_part) = inp
-            st_i = jax.tree_util.tree_map(lambda leaf: leaf[i], st_b)
-            infos = [{k: v[i] for k, v in info.items()}
-                     for info in out["infos"]]
-            violated_before = {g.name: bool(v[i])
-                               for g, v in zip(goals, out["viol_before"])}
+            st_i = payload["state"]
+            infos = payload["infos"]
+            violated_before = {g.name: bool(v)
+                               for g, v in zip(goals,
+                                               payload["viol_before"])}
             goal_results = [
                 GoalResult(
                     name=g.name,
@@ -1770,26 +1826,37 @@ class GoalOptimizer:
                     finisher_boundary=int(info.get("finisher_boundary", 0)),
                     passes_skipped=int(info.get("passes_skipped", 0)),
                     quiesce_chunk=int(info.get("quiesce_chunk", -1)),
+                    finisher_skipped=bool(info.get("finisher_skipped",
+                                                   False)),
                 )
                 for g, info in zip(goals, infos)
             ]
+            carried_map_i = carried_maps[i]
             for r in goal_results:
-                if r.name in reduced_by_tenant[i]:
+                if r.finisher_skipped and r.name in carried_map_i:
+                    # the carried certificate stands in for the skipped
+                    # scans (solo parity: patch proof + remaining counts)
+                    cr = carried_map_i[r.name]
+                    r.fixpoint_proven = True
+                    r.moves_remaining = cr.moves_remaining
+                    r.leads_remaining = cr.leads_remaining
+                    r.swap_window_remaining = cr.swap_window_remaining
+            skipped_names = payload.get("skipped_names") or set()
+            for r in goal_results:
+                if r.name in skipped_names:
+                    r.mode = "skipped"
+                elif r.name in reduced_by_tenant[i]:
                     r.mode = "reduced"
             if run_preferred:
                 goal_results.append(GoalResult(
                     name="PreferredLeaderElectionGoal",
-                    violated_before=bool(out["ple_was"][i]),
-                    violated_after=bool(out["ple_still"][i]),
-                    iterations=1 if bool(out["ple_was"][i]) else 0,
+                    violated_before=bool(payload["ple_was"]),
+                    violated_after=bool(payload["ple_still"]),
+                    iterations=1 if bool(payload["ple_was"]) else 0,
                     duration_s=0.0, stat_after=0.0))
-            stats_before = _stats_to_json(
-                jax.tree_util.tree_map(lambda leaf: leaf[i],
-                                       out["stats_before"]))
-            stats_after = _stats_to_json(
-                jax.tree_util.tree_map(lambda leaf: leaf[i],
-                                       out["stats_after"]))
-            pb, plead, pdisk, data_mb = (leaf[i] for leaf in out["packed"])
+            stats_before = _stats_to_json(payload["stats_before"])
+            stats_after = _stats_to_json(payload["stats_after"])
+            pb, plead, pdisk, data_mb = payload["packed"]
             # per-tenant full-R fallback for dirty-seeded goals that ended
             # violated-unproven (the solo path's one-sided contract, per
             # tenant), then the same post-chain escalation the solo path
@@ -1848,12 +1915,14 @@ class GoalOptimizer:
                                      if r.quiesce_chunk >= 0),
                 skipped_goals=sum(1 for r in goal_results
                                   if r.mode == "skipped"),
+                parked_early=bool(payload.get("parked_early", False)),
+                compacted_out=bool(payload.get("compacted_out", False)),
             )
             result.final_state = st_i
             result.env = env
             result.meta = meta
             result.round_trace = None     # one fleet trace below, not K
-            results.append(result)
+            results_by_idx[i] = result
             if self._incremental:
                 # per-tenant carryover, saved before any per-tenant raise
                 # (the consumed delta is gone either way)
@@ -1872,9 +1941,57 @@ class GoalOptimizer:
                 failed = [r.name for r, g in zip(goal_results, goals)
                           if g.is_hard and r.violated_after]
                 if failed:
-                    raise OptimizationFailureError(
-                        f"hard goal(s) not satisfiable for tenant {i}: "
-                        f"{failed}", result=result)
+                    failed_hard.append((i, result, failed))
+            if on_result is not None:
+                # early per-lane landing (PR 20): the fleet scheduler
+                # installs this tenant's proposals NOW, while other lanes
+                # are still being optimized
+                on_result(i, result)
+            return result
+
+        fleet_stats = None
+        if gating:
+            env_b, st_b, fleet_stats = self._fleet_chain_gated(
+                env_b, st_b, goals, ple, params, masks_b, lane_need,
+                reduced_flags, cert_goal, finalize_tenant)
+        else:
+            if use_chunked:
+                st_b, out = self._fleet_chain_chunked(env_b, st_b, goals,
+                                                      ple, params, masks_b)
+            elif masks_b is not None:
+                fn = _compiled_fleet_chain(tuple(type(g) for g in goals),
+                                           tuple(goals), ple, masked=True)
+                st_b, out = fn(env_b, st_b, params, masks_b)
+            else:
+                fn = _compiled_fleet_chain(tuple(type(g) for g in goals),
+                                           tuple(goals), ple)
+                st_b, out = fn(env_b, st_b, params)
+            out = jax.device_get(out)
+            for i in range(len(sessions)):
+                payload = {
+                    "state": jax.tree_util.tree_map(lambda leaf: leaf[i],
+                                                    st_b),
+                    "viol_before": [v[i] for v in out["viol_before"]],
+                    "stats_before": jax.tree_util.tree_map(
+                        lambda leaf: leaf[i], out["stats_before"]),
+                    "infos": [{k2: v[i] for k2, v in info.items()}
+                              for info in out["infos"]],
+                    "stats_after": jax.tree_util.tree_map(
+                        lambda leaf: leaf[i], out["stats_after"]),
+                    "packed": tuple(leaf[i] for leaf in out["packed"]),
+                }
+                if run_preferred:
+                    payload["ple_was"] = out["ple_was"][i]
+                    payload["ple_still"] = out["ple_still"][i]
+                finalize_tenant(i, payload)
+                if raise_on_failure and failed_hard:
+                    break
+        if raise_on_failure and failed_hard:
+            i, result, failed = min(failed_hard, key=lambda t: t[0])
+            raise OptimizationFailureError(
+                f"hard goal(s) not satisfiable for tenant {i}: "
+                f"{failed}", result=result)
+        results = [results_by_idx[i] for i in range(len(sessions))]
 
         if self._incremental and self._revalidate and results:
             # prime the solo-shaped verdict re-check program (one compile
@@ -1884,7 +2001,21 @@ class GoalOptimizer:
 
         # ONE RoundTrace for the whole launch (the fleet's unit of work):
         # tenant-0's per-goal profile as the representative rows, proposal
-        # counts summed, session info marking the batch
+        # counts summed, session info marking the batch, per-lane gating
+        # counters as fleet_lanes rows (PR 20 observability)
+        session_info = {"mode": "fleet", "tenants": len(sessions)}
+        if fleet_stats is not None:
+            session_info["gated"] = True
+            session_info.update(fleet_stats)
+        lane_rows = [{"tenant": i,
+                      "round_mode": r.round_mode,
+                      "passes_dispatched": r.passes_dispatched,
+                      "passes_skipped": r.passes_skipped,
+                      "early_exit_goals": r.early_exit_goals,
+                      "skipped_goals": r.skipped_goals,
+                      "parked_early": r.parked_early,
+                      "compacted_out": r.compacted_out}
+                     for i, r in enumerate(results)]
         trace = self.recorder.record_round(
             wall_s=time.monotonic() - t_round,
             goal_results=results[0].goal_results,
@@ -1895,7 +2026,7 @@ class GoalOptimizer:
                                       for r in results),
             num_leadership_movements=sum(r.num_leadership_movements
                                          for r in results),
-            session_info={"mode": "fleet", "tenants": len(sessions)},
+            session_info=session_info,
             donated=all(bool(getattr(s, "_donation", False))
                         for s in sessions),
             profile_level=self._profile_level,
@@ -1905,7 +2036,8 @@ class GoalOptimizer:
             passes_dispatched=sum(r.passes_dispatched for r in results),
             passes_skipped=sum(r.passes_skipped for r in results),
             early_exit_goals=sum(r.early_exit_goals for r in results),
-            skipped_goals=sum(r.skipped_goals for r in results))
+            skipped_goals=sum(r.skipped_goals for r in results),
+            fleet_lanes=lane_rows)
         for r in results:
             r.round_trace = trace
         return results
@@ -2022,6 +2154,341 @@ class GoalOptimizer:
             out["ple_was"] = fin_out["ple_was"]
             out["ple_still"] = fin_out["ple_still"]
         return st_b, out
+
+    @staticmethod
+    def _skipped_info(s0: float) -> dict:
+        """The short-circuited goal's synthesized host info — byte-for-byte
+        the dict the solo gated chain records when one [B] probe replaces
+        the whole goal program (optimizer.py solo chain; DESIGN §23)."""
+        return {"iterations": 0, "passes": 0,
+                "violated_after": False, "hit_max_iters": False,
+                "plateau_exit": False, "fixpoint_proven": False,
+                "finisher_rounds": 0, "moves_remaining": -1,
+                "leads_remaining": -1, "swap_window_remaining": -1,
+                "stat_before": s0, "stat": s0,
+                "move_actions": 0, "lead_actions": 0,
+                "swap_actions": 0, "disk_actions": 0,
+                "move_waves": 0, "finisher_actions": 0,
+                "finisher_segments": 0, "finisher_boundary": 0,
+                "passes_skipped": 0, "quiesce_chunk": -1,
+                "finisher_skipped": False}
+
+    def _fleet_chain_gated(self, env_b, st_b, goals, ple, params, masks_b,
+                           lane_need, reduced_flags, cert_goal, finalize):
+        """Ragged fleet convergence gating (PR 20 tentpole): the chunked
+        fleet launch with the PR 19 solo-only levers promoted to per-lane
+        vmapped operands, plus quiesced-lane compaction and early per-lane
+        landing.
+
+        Per goal: one vmapped probe short-circuits lanes whose dirty-seeded
+        goal is a provable no-op (they enter the chunk loop frozen — the
+        exact zeros/sentinels the solo path synthesizes fall out of the
+        frozen carries); the chunk loop runs with each lane's churn-clamped
+        budgets as int32[K] traced columns (``_LANE_BUDGET_FIELDS``); the
+        gated finisher takes a per-lane ``skip`` flag covering both the
+        satisfied-at-exit synthesis and the certificate finisher-skip. At
+        goal boundaries a lane whose every REMAINING goal probes as a
+        dirty-seeded no-op is PARKED: its remaining goals synthesize
+        "skipped" infos, its final program (PLE + stats + packed fetch) runs
+        on a pow2-padded sub-stack and ``finalize`` fires immediately —
+        early install landing. When enough lanes park to drop a pow2 rung,
+        the host re-stacks the still-active subset (quiesced-lane
+        compaction) so later chunks pay for active lanes only.
+
+        Soundness of parking: each remaining goal's probe shows
+        ``~violated & ~has_work`` against the lane's CURRENT state; a
+        probed no-op goal leaves the state bit-unchanged, so by induction
+        every later probe is evaluated at exactly the state that goal would
+        see at its chain position — the solo short-circuit's argument,
+        chain-composed. Per-lane results are bit-identical to K gated solo
+        runs either way.
+
+        Returns ``(env_b, st_b, stats)`` — the (possibly compacted)
+        working stack for trace metadata plus launch-level gating stats."""
+        K0 = jax.tree_util.tree_leaves(st_b)[0].shape[0]
+        G = len(goals)
+        gclasses = tuple(type(g) for g in goals)
+        head = jax.device_get(
+            _compiled_fleet_head(gclasses, tuple(goals))(env_b, st_b))
+        viol_before_h = [np.asarray(v) for v in head["viol_before"]]
+        max_iters = int(params.max_iters)
+        static = {"stall_retries": int(params.stall_retries),
+                  "sat_stall_retries": int(params.sat_stall_retries),
+                  "tail_pass_budget": int(params.tail_pass_budget),
+                  "sat_tail_passes": int(params.sat_tail_passes),
+                  "tail_total_budget": int(params.tail_total_budget),
+                  "finisher_rounds": int(params.finisher_rounds)}
+        need0 = np.asarray(lane_need, np.int64)
+
+        def goal_budgets(gi, orig):
+            """int32 columns per budget field for this goal over the
+            CURRENT stack rows: churn-clamped (the solo adaptive formulas)
+            on lanes where this goal is dirty-seeded, static elsewhere."""
+            n = need0[orig]
+            red = reduced_flags[orig, gi] & (n > 0)
+            cols = []
+            for f, cap in (("stall_retries", n),
+                           ("sat_stall_retries", n),
+                           ("tail_pass_budget", 4 * n),
+                           ("sat_tail_passes", 4 * n),
+                           ("tail_total_budget", 8 * n),
+                           ("finisher_rounds", np.maximum(2, n))):
+                cols.append(np.where(red, np.minimum(static[f], cap),
+                                     static[f]).astype(np.int32))
+            return cols
+
+        orig = np.arange(K0)            # stack row -> original tenant
+        pad = np.zeros(K0, bool)        # pow2 pad rows (outputs discarded)
+        done = np.zeros(K0, bool)       # parked lanes still in the stack
+        actions_total = np.zeros(K0, np.int64)      # ORIGINAL-indexed
+        lane_infos: list[list] = [[] for _ in range(K0)]
+        skipped_names: list[set] = [set() for _ in range(K0)]
+        parked_flag = np.zeros(K0, bool)
+        compacted_flag = np.zeros(K0, bool)
+        stats = {"parked": 0, "compactions": 0, "compacted_out": 0}
+
+        def finalize_rows(rows):
+            """Run the closing program (PLE + stats + packed fetch) on the
+            given stack rows and finalize their tenants. Sub-stacks gather
+            to the pow2 ceiling (pad-by-repetition, outputs discarded) so
+            the number of compiled final variants stays bounded."""
+            if not rows:
+                return
+            kc = orig.shape[0]
+            if len(rows) == kc:
+                env_sub, st_sub = env_b, st_b
+                jmap = {row: row for row in rows}
+            else:
+                kq = 1 << (len(rows) - 1).bit_length()
+                idx = list(rows) + [rows[0]] * (kq - len(rows))
+                idx_dev = jnp.asarray(np.asarray(idx, np.int32))
+                env_sub = _fleet_take(env_b, idx_dev)
+                st_sub = _fleet_take(st_b, idx_dev)
+                jmap = {row: j for j, row in enumerate(rows)}
+            st_f, fin_out = _compiled_fleet_final(gclasses, ple)(env_sub,
+                                                                 st_sub)
+            fin_h = jax.device_get(fin_out)
+            for row in rows:
+                j, ok = jmap[row], int(orig[row])
+                payload = {
+                    "state": jax.tree_util.tree_map(
+                        lambda leaf: leaf[j], st_f),
+                    "viol_before": [bool(v[ok]) for v in viol_before_h],
+                    "stats_before": jax.tree_util.tree_map(
+                        lambda leaf: leaf[ok], head["stats_before"]),
+                    "infos": lane_infos[ok],
+                    "stats_after": jax.tree_util.tree_map(
+                        lambda leaf: leaf[j], fin_h["stats_after"]),
+                    "packed": tuple(leaf[j] for leaf in fin_h["packed"]),
+                    "skipped_names": skipped_names[ok],
+                    "parked_early": bool(parked_flag[ok]),
+                    "compacted_out": bool(compacted_flag[ok]),
+                }
+                if ple is not None:
+                    payload["ple_was"] = bool(fin_h["ple_was"][j])
+                    payload["ple_still"] = bool(fin_h["ple_still"][j])
+                finalize(ok, payload)
+
+        prev: tuple = ()
+        for gi, g in enumerate(goals):
+            Kc = orig.shape[0]
+            alive = ~done & ~pad
+            budgets_np = goal_budgets(gi, orig)
+            lane_budgets = tuple(jnp.asarray(c) for c in budgets_np)
+            # chain-level short-circuit, per lane: ONE vmapped [B] probe
+            # answers which dirty-seeded lanes can skip this goal outright
+            probe0 = jax.device_get(_compiled_fleet_probe(type(g), g)(
+                env_b, st_b, masks_b[gi]))
+            p_stat = np.asarray(probe0["stat"])
+            sc_col = np.zeros(Kc, bool)
+            if self._shortcircuit:
+                sc_col = (alive & reduced_flags[orig, gi]
+                          & ~np.asarray(probe0["violated"])
+                          & ~np.asarray(probe0["has_work"]))
+                for row in np.flatnonzero(sc_col):
+                    ok = int(orig[row])
+                    lane_infos[ok].append(
+                        self._skipped_info(float(p_stat[row])))
+                    skipped_names[ok].add(g.name)
+            run_rows = alive & ~sc_col
+            if np.any(run_rows):
+                chunk_fn = _compiled_fleet_chunk_gated(type(g), g, prev)
+                scalars = _fleet_scalar_init(Kc)
+                frozen_np = ~run_rows
+                applied_prev = np.zeros(Kc, np.int64)
+                quiesce = np.full(Kc, -1, np.int32)
+                chunks = 0
+                stat_entry0 = None
+                probe = None
+                while True:
+                    st_b, scalars, probe_dev = chunk_fn(
+                        env_b, st_b, scalars, params, lane_budgets,
+                        masks_b[gi], jnp.asarray(frozen_np))
+                    probe = jax.device_get(probe_dev)
+                    if chunks == 0:
+                        stat_entry0 = np.asarray(probe["stat_entry"])
+                    chunks += 1
+                    active = np.asarray(probe["active"])
+                    applied = np.asarray(probe["applied"], np.int64)
+                    newly = ((~frozen_np) & active
+                             & (applied == applied_prev))
+                    quiesce[newly] = chunks - 1
+                    frozen_np = frozen_np | newly
+                    applied_prev = applied
+                    if np.all(~active | frozen_np):
+                        break
+                sc = jax.device_get(scalars)
+                it = np.asarray(sc[0], np.int64)
+                n_applied = np.asarray(sc[1], np.int64)
+                stall = np.asarray(sc[2], np.int64)
+                dribble = np.asarray(sc[3], np.int64)
+                sat = np.asarray(sc[4], bool)
+                plateau = np.asarray(sc[7], bool)
+                tailp = np.asarray(sc[8], np.int64)
+                viol_exit = np.asarray(probe["violated"])
+                (stall_col, sat_stall_col, tail_pass_col, _sat_tail_col,
+                 tail_total_col, _fin_col) = (c.astype(np.int64)
+                                              for c in budgets_np)
+                # certificate finisher-skip, per lane: quiesced, zero
+                # actions this round, zero chain-prefix actions, carried
+                # cert valid (the solo allow_skip condition, per lane)
+                fs_col = np.zeros(Kc, bool)
+                if self._cert_skip:
+                    fs_col = (run_rows & cert_goal[orig, gi]
+                              & (actions_total[orig] == 0)
+                              & (quiesce >= 0) & (n_applied == 0))
+                skip = ~run_rows | fs_col
+                if np.any(run_rows & viol_exit & ~fs_col):
+                    st_b, fin_dev = _compiled_fleet_finish_gated(
+                        type(g), g, prev)(env_b, st_b, params,
+                                          lane_budgets, jnp.asarray(skip))
+                    fin = jax.device_get(fin_dev)
+                    violated = np.asarray(fin["violated_after"], bool)
+                    proven = np.asarray(fin["fixpoint_proven"], bool)
+                    stat_after_col = np.asarray(fin["stat"])
+                else:
+                    # no lane needs a real finisher run: synthesize every
+                    # lane's sentinels on the host (solo's satisfied /
+                    # cert-skip synthesis, fleet-wide — zero dispatches)
+                    fin = None
+                    violated = viol_exit.copy()
+                    proven = np.zeros(Kc, bool)
+                    stat_after_col = np.asarray(probe["stat"])
+                stall_cap = np.where(sat,
+                                     np.minimum(stall_col, sat_stall_col),
+                                     stall_col)
+                budget_exit = ((it >= max_iters) | (dribble > tail_pass_col)
+                               | (tailp > tail_total_col) | plateau)
+                skipped_passes = np.where(
+                    quiesce >= 0,
+                    np.maximum(0, np.minimum(
+                        np.minimum(max_iters - it,
+                                   tail_total_col + 1 - tailp),
+                        stall_cap + 1 - stall)),
+                    0)
+                hit_max = ((stall <= stall_col) & budget_exit & violated
+                           & ~proven)
+
+                def fin_at(key, row, default):
+                    return int(fin[key][row]) if fin is not None else default
+
+                for row in np.flatnonzero(run_rows):
+                    ok = int(orig[row])
+                    info = {
+                        "iterations": (int(n_applied[row])
+                                       + fin_at("finisher_actions", row, 0)),
+                        "passes": int(it[row]),
+                        "violated_after": bool(violated[row]),
+                        "hit_max_iters": bool(hit_max[row]),
+                        "plateau_exit": bool(plateau[row]),
+                        "fixpoint_proven": bool(proven[row]),
+                        "finisher_rounds": fin_at("finisher_rounds", row, 0),
+                        "moves_remaining": fin_at("moves_remaining",
+                                                  row, -1),
+                        "leads_remaining": fin_at("leads_remaining",
+                                                  row, -1),
+                        "swap_window_remaining": fin_at(
+                            "swap_window_remaining", row, -1),
+                        "stat_before": float(stat_entry0[row]),
+                        "stat": float(stat_after_col[row]),
+                        "move_actions": int(sc[9][row]),
+                        "lead_actions": int(sc[10][row]),
+                        "swap_actions": int(sc[11][row]),
+                        "disk_actions": int(sc[12][row]),
+                        "move_waves": int(sc[13][row]),
+                        "finisher_actions": fin_at("finisher_actions",
+                                                   row, 0),
+                        "finisher_segments": fin_at("finisher_segments",
+                                                    row, 0),
+                        "finisher_boundary": fin_at("finisher_boundary",
+                                                    row, 0),
+                        "passes_skipped": int(skipped_passes[row]),
+                        "quiesce_chunk": int(quiesce[row]),
+                        "finisher_skipped": bool(fs_col[row]),
+                    }
+                    lane_infos[ok].append(info)
+                    actions_total[ok] += int(info["iterations"])
+            prev = prev + (g,)
+
+            # boundary parking + compaction (tentpole b/c): a lane whose
+            # EVERY remaining goal is dirty-seeded and probes as a no-op
+            # finishes the chain right here
+            if gi >= G - 1 or not self._shortcircuit:
+                continue
+            cand = ~done & ~pad
+            for gj in range(gi + 1, G):
+                cand &= reduced_flags[orig, gj]
+            if not np.any(cand):
+                continue
+            park = cand.copy()
+            probes_rest = []
+            for gj in range(gi + 1, G):
+                pr = jax.device_get(_compiled_fleet_probe(
+                    type(goals[gj]), goals[gj])(env_b, st_b, masks_b[gj]))
+                probes_rest.append(pr)
+                park &= (~np.asarray(pr["violated"])
+                         & ~np.asarray(pr["has_work"]))
+                if not np.any(park):
+                    break
+            if not np.any(park):
+                continue
+            for row in np.flatnonzero(park):
+                ok = int(orig[row])
+                for gj, pr in zip(range(gi + 1, G), probes_rest):
+                    lane_infos[ok].append(self._skipped_info(
+                        float(np.asarray(pr["stat"])[row])))
+                    skipped_names[ok].add(goals[gj].name)
+                parked_flag[ok] = True
+            stats["parked"] += int(park.sum())
+            # decide compaction BEFORE finalizing (the payload records
+            # whether the lane left the working stack)
+            will_drop = (done | park) & ~pad
+            alive_rows = np.flatnonzero(~done & ~park & ~pad)
+            kq = (1 << (int(alive_rows.size) - 1).bit_length()
+                  if alive_rows.size else 0)
+            compact = (self._fleet_compaction and alive_rows.size > 0
+                       and kq < orig.shape[0])
+            if compact:
+                for ok in orig[will_drop]:
+                    compacted_flag[int(ok)] = True
+                stats["compactions"] += 1
+                stats["compacted_out"] += int(will_drop.sum())
+            finalize_rows([int(r) for r in np.flatnonzero(park)])
+            done = done | park
+            if compact:
+                rows = (list(alive_rows)
+                        + [int(alive_rows[0])] * (kq - alive_rows.size))
+                idx_dev = jnp.asarray(np.asarray(rows, np.int32))
+                env_b = _fleet_take(env_b, idx_dev)
+                st_b = _fleet_take(st_b, idx_dev)
+                masks_b = _fleet_take(masks_b, idx_dev)
+                orig = orig[np.asarray(rows)]
+                pad = np.zeros(kq, bool)
+                pad[alive_rows.size:] = True
+                done = np.zeros(kq, bool)
+
+        finalize_rows([int(r) for r in np.flatnonzero(~done & ~pad)])
+        return env_b, st_b, stats
 
     def _revalidated_fleet(self, sessions, goals, rds, chain_key, opt_gen,
                            compiles0, t_round):

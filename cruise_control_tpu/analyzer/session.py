@@ -504,6 +504,15 @@ class ResidentClusterSession:
         with self.lock:
             self.revalidated_rounds += 1
 
+    def seed_budget_replicas(self, num_replicas: int) -> float:
+        """This session's churn budget in replicas: the ceiling under which
+        a round's accumulated structural churn still qualifies for dirty-set
+        seeding and certificate carryover (PR 16/19/20 — the solo gated
+        path, the fleet's per-lane gating metadata and the cert-skip window
+        all resolve against this one number)."""
+        return (getattr(self, "_max_delta_fraction", 0.25)
+                * max(num_replicas, 1))
+
     def dirty_replica_mask(self, dirty_brokers, dirty_topics) -> np.ndarray:
         """bool[R_padded]: replicas living on a dirty broker or in a dirty
         topic — the reduced round's candidate seed (optimizer dirty-set

@@ -624,6 +624,10 @@ class RoundTrace:
     passes_skipped: int = 0
     early_exit_goals: int = 0
     skipped_goals: int = 0
+    # ragged fleet gating (PR 20): one row per tenant lane of a batched
+    # launch (tenant index, round_mode, pass/skip counters, parked_early,
+    # compacted_out) — empty for solo rounds / ungated fleets
+    fleet_lanes: list = dataclasses.field(default_factory=list)
 
     def to_json(self) -> dict:
         out = dataclasses.asdict(self)
@@ -809,7 +813,8 @@ class FlightRecorder:
                      passes_dispatched: int = 0,
                      passes_skipped: int = 0,
                      early_exit_goals: int = 0,
-                     skipped_goals: int = 0) -> RoundTrace:
+                     skipped_goals: int = 0,
+                     fleet_lanes: list | None = None) -> RoundTrace:
         """Assemble + record one round from what the optimizer already holds.
         ``opt_generation`` (from this round's ``note_optimize_start``) keys
         which pending stage notes belong to it. Never raises into the
@@ -846,6 +851,7 @@ class FlightRecorder:
                 passes_skipped=int(passes_skipped),
                 early_exit_goals=int(early_exit_goals),
                 skipped_goals=int(skipped_goals),
+                fleet_lanes=list(fleet_lanes or []),
             )
         except Exception:  # noqa: BLE001 — tracing must never fail a round
             import logging

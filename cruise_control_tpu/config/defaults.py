@@ -588,6 +588,37 @@ _D.define(name="fleet.admission.heal.retry.limit", type=Type.INT, default=2,
               "batched launch re-enqueue up to this many times (a dropped "
               "heal is a stranded anomaly); rebalance/refresh requests "
               "drop with the failure surfaced in the round report.")
+_D.define(name="fleet.pass.gating.enabled", type=Type.BOOLEAN, default=True,
+          doc="Ragged fleet convergence gating (PR 20): promote the PR 19 "
+              "solo-only levers — churn-adaptive pass budgets, chain-level "
+              "short-circuit probes, certificate finisher-skip — to "
+              "per-lane traced operands of the batched launch, so each "
+              "tenant's lane gates independently inside one compiled "
+              "program (bit-identical per tenant to K gated solo runs; "
+              "zero new compiles on budget/mask value changes). Off "
+              "restores the PR 19 per-lane-freeze chunked path verbatim. "
+              "Requires analyzer.incremental.seed.dirty (the per-lane "
+              "budgets derive from the per-tenant dirty counts).")
+_D.define(name="fleet.pass.compaction.enabled", type=Type.BOOLEAN,
+          default=True,
+          doc="Quiesced-lane compaction (PR 20): when parked/quiesced "
+              "lanes let the batched launch drop a rung on the pow2 K "
+              "ladder, re-stack the still-active tenant subset between "
+              "goals so later chunk programs pay for active lanes only. "
+              "Value-only: the gathered lanes' results are bit-identical; "
+              "sub-stack programs compile once per (chain, bucket, K) "
+              "like any other fleet variant. No-op without "
+              "fleet.pass.gating.enabled.")
+_D.define(name="fleet.pass.early.install.enabled", type=Type.BOOLEAN,
+          default=True,
+          doc="Early install landing (PR 20): dispatch_once installs a "
+              "tenant's proposals the moment its lane finishes (parked at "
+              "a goal boundary or the launch unwinds), riding the "
+              "existing submit_install install-only rounds, instead of "
+              "waiting for the whole batched launch — a low-churn "
+              "tenant's heal-admission latency stops being hostage to a "
+              "high-churn bucket-mate. Install order still respects "
+              "(lane, seq) within each tenant.")
 _D.define(name="fleet.cluster.ids", type=Type.LIST, default=[],
           doc="Service-mode multi-tenant boot (main.py): cluster ids to "
               "register as fleet tenants behind one server. Non-empty "

@@ -89,6 +89,17 @@ class FleetTenant:
         self.last_refresh_ms: float | None = None
         self.refreshes = 0
         self.staleness_ms = deque(maxlen=512)   # cache age sampled per round
+        # ragged fleet gating (PR 20): lifetime per-tenant counters of how
+        # this tenant's LANE behaved inside batched launches — the
+        # per-tenant half of the launch-level gating stats
+        self.passes_dispatched = 0
+        self.passes_skipped = 0
+        self.early_exit_goals = 0
+        self.skipped_goals = 0
+        self.parked_rounds = 0         # lane finished before the launch did
+        self.compacted_rounds = 0      # lane left the working stack early
+        self.early_installs = 0        # results landed before launch unwind
+        self.last_install_wall = 0.0   # monotonic stamp of the last landing
 
     @property
     def session(self):
@@ -101,6 +112,29 @@ class FleetTenant:
         # nearest-rank p95, the campaign distributions' convention
         return float(xs[max(0, -(-len(xs) * 95 // 100) - 1)])
 
+    def note_gating(self, res) -> None:
+        """Accumulate one batched round's per-lane gating counters from
+        this tenant's OptimizerResult."""
+        self.passes_dispatched += int(getattr(res, "passes_dispatched", 0))
+        self.passes_skipped += int(getattr(res, "passes_skipped", 0))
+        self.early_exit_goals += int(getattr(res, "early_exit_goals", 0))
+        self.skipped_goals += int(getattr(res, "skipped_goals", 0))
+        if getattr(res, "parked_early", False):
+            self.parked_rounds += 1
+        if getattr(res, "compacted_out", False):
+            self.compacted_rounds += 1
+
+    def gating_json(self) -> dict:
+        return {
+            "passesDispatched": self.passes_dispatched,
+            "passesSkipped": self.passes_skipped,
+            "earlyExitGoals": self.early_exit_goals,
+            "skippedGoals": self.skipped_goals,
+            "parkedRounds": self.parked_rounds,
+            "compactedRounds": self.compacted_rounds,
+            "earlyInstalls": self.early_installs,
+        }
+
     def state_json(self) -> dict:
         sess = self.session
         return {
@@ -112,6 +146,7 @@ class FleetTenant:
             "refreshes": self.refreshes,
             "stalenessP95Ms": self.staleness_p95_ms(),
             "lastRoundSeq": self.last_round_seq,
+            "gating": self.gating_json(),
         }
 
 
@@ -130,6 +165,10 @@ class OptimizationRequest:
     lane: int
     reason: str = ""
     enqueued_ms: float = 0.0
+    # host wall clock at enqueue (time.monotonic, seconds): the sim/round
+    # clock above resolves ONCE per launch, so the early-install win (a lane
+    # landing mid-launch) is only measurable on this axis
+    enqueued_wall: float = 0.0
     retries: int = 0
     coalesced: int = 0
 
@@ -193,6 +232,15 @@ class FleetScheduler:
         self.heal_admission_ms = deque(maxlen=4096)
         self._heal_admission_timer = self.sensors.timer(
             "fleet-heal-admission-timer")
+        # ---- ragged fleet gating (PR 20): early install landing ----
+        # results land per lane as they finish; the injected round clock
+        # resolves once per launch so the mid-launch win only shows on the
+        # host wall axis (time.monotonic) — kept as separate deques
+        self.early_install = self.config.get_boolean(
+            "fleet.pass.early.install.enabled")
+        self.early_installs = 0
+        self.heal_admission_wall_ms = deque(maxlen=4096)
+        self.install_lag_wall_ms = deque(maxlen=4096)
         self._admit_meter = self.sensors.meter("fleet-requests-admitted")
         self.sensors.gauge("fleet-queue-depth", self.queue_depth)
         # admission trace journal (tools/queue_view.py): in-memory ring by
@@ -329,7 +377,8 @@ class FleetScheduler:
             self._req_seq += 1
             req = OptimizationRequest(seq=self._req_seq,
                                       cluster_id=cluster_id, lane=lane,
-                                      reason=reason, enqueued_ms=now)
+                                      reason=reason, enqueued_ms=now,
+                                      enqueued_wall=time.monotonic())
             per_lane[lane] = req
             self.requests_enqueued += 1
             self.journal.append("admission", ev="enqueue", cid=cluster_id,
@@ -554,14 +603,35 @@ class FleetScheduler:
                   "lanes": lanes_count, "launches": 0, "optimized": [],
                   "skipped": skipped, "failed": failed, "joined": joined,
                   "split": split}
+        landed: set[int] = set()
+        launch_wall0 = time.monotonic()
+
+        def land(i: int, res) -> None:
+            """Install tenant i's result + complete its queued requests —
+            the landing half of a launch. With early install landing on,
+            this fires from INSIDE the batched call the moment the lane
+            finishes (parked at a goal boundary), so a low-churn tenant's
+            proposals install while high-churn lanes are still stepping."""
+            if i in landed:
+                return
+            landed.add(i)
+            _r, t = admitted[i]
+            self._land_tenant(t, res, gens[i], now,
+                              launch_wall0=launch_wall0)
+            report["optimized"].append(t.cluster_id)
+
         try:
-            results = self.optimizer.optimizations_batched(sessions)
+            results = self.optimizer.optimizations_batched(
+                sessions, on_result=land if self.early_install else None)
         except Exception as e:   # noqa: BLE001 — bucket isolation: surface
             # per-tenant failure and re-enqueue heal-lane requests instead
-            # of silently dropping the whole group
+            # of silently dropping the whole group — tenants whose lanes
+            # already LANDED keep their installed results
             LOG.exception("fleet batched launch failed for bucket %s (%s)",
                           target, [t.cluster_id for _r, t in admitted])
-            for _r, t in admitted:
+            for i, (_r, t) in enumerate(admitted):
+                if i in landed:
+                    continue
                 self._fail_tenant_requests(
                     t.cluster_id, f"launch failed: {type(e).__name__}",
                     failed)
@@ -569,24 +639,48 @@ class FleetScheduler:
             return report
         self.launches += 1
         report["launches"] = 1
-        for (r, t), res, gen in zip(admitted, results, gens):
-            self._install_tenant(t, res, gen, now)
-            report["optimized"].append(t.cluster_id)
-            # a fresh proposal cache satisfies EVERY queued lane: complete
-            # all of the tenant's requests, stamping heal-admission latency
-            for lr in (self._requests.pop(t.cluster_id, {}) or {}).values():
-                self.requests_admitted += 1
-                self._admit_meter.mark()
-                wait = max(now - lr.enqueued_ms, 0.0)
-                if lr.lane == LANE_HEAL:
-                    self.heal_admission_ms.append(wait)
-                    self._heal_admission_timer.record(wait / 1000.0)
-                self.journal.append("admission", ev="install",
-                                    cid=t.cluster_id,
-                                    lane=LANE_NAMES[lr.lane], seq=lr.seq,
-                                    waitMs=round(wait, 3))
+        for i, res in enumerate(results):
+            land(i, res)
         self.last_dispatch = report
         return report
+
+    def _land_tenant(self, t: FleetTenant, res, gen: int, now: float,
+                     launch_wall0: float | None = None) -> None:
+        """Install one tenant's result and complete all its queued requests
+        (a fresh proposal cache satisfies every lane), stamping
+        heal-admission latency on both clocks: the injected round clock
+        (deterministic, resolves once per launch) and the host wall clock
+        (the axis where early landing is visible). Requests complete in
+        (lane, seq) order. Early landings (result.parked_early) count
+        toward the early-install meters."""
+        self._install_tenant(t, res, gen, now)
+        t.note_gating(res)
+        early = bool(getattr(res, "parked_early", False))
+        if early:
+            self.early_installs += 1
+            t.early_installs += 1
+        wall_now = time.monotonic()
+        t.last_install_wall = wall_now
+        if launch_wall0 is not None:
+            self.install_lag_wall_ms.append(
+                max(wall_now - launch_wall0, 0.0) * 1000.0)
+        reqs = sorted((self._requests.pop(t.cluster_id, {}) or {}).values(),
+                      key=lambda lr: (lr.lane, lr.seq))
+        for lr in reqs:
+            self.requests_admitted += 1
+            self._admit_meter.mark()
+            wait = max(now - lr.enqueued_ms, 0.0)
+            if lr.lane == LANE_HEAL:
+                self.heal_admission_ms.append(wait)
+                self._heal_admission_timer.record(wait / 1000.0)
+                if lr.enqueued_wall:
+                    self.heal_admission_wall_ms.append(
+                        max(wall_now - lr.enqueued_wall, 0.0) * 1000.0)
+            extra = {"early": True} if early else {}
+            self.journal.append("admission", ev="install",
+                                cid=t.cluster_id,
+                                lane=LANE_NAMES[lr.lane], seq=lr.seq,
+                                waitMs=round(wait, 3), **extra)
 
     def _install_tenant(self, t: FleetTenant, res, gen: int,
                         now: float) -> None:
@@ -744,6 +838,7 @@ class FleetScheduler:
                 for t, res, gen in zip(group, results, gens):
                     now = now_ms if now_ms is not None else t.cc._now_ms()
                     self._install_tenant(t, res, gen, now)
+                    t.note_gating(res)
                     optimized.append(t.cluster_id)
             self.launches += launches
             spilled = self.enforce_memory_budget()
@@ -862,11 +957,14 @@ class FleetScheduler:
                                             if now else None)
             heal = sorted(self.heal_admission_ms)
 
-            def _pct(p):
-                if not heal:
+            def _pct(p, xs=None):
+                xs = heal if xs is None else xs
+                if not xs:
                     return None
-                return float(heal[max(0, -(-len(heal) * p // 100) - 1)])
+                return float(xs[max(0, -(-len(xs) * p // 100) - 1)])
 
+            heal_wall = sorted(self.heal_admission_wall_ms)
+            lag_wall = sorted(self.install_lag_wall_ms)
             return {
                 "enabled": self.admission_enabled,
                 "maxBatch": self.max_batch,
@@ -885,6 +983,18 @@ class FleetScheduler:
                 "splits": self.splits,
                 "healAdmissionP50Ms": _pct(50),
                 "healAdmissionP95Ms": _pct(95),
+                # ragged fleet gating (PR 20): wall-clock serving SLOs (the
+                # axis where early landing shows) + per-tenant lane counters
+                "gating": {
+                    "earlyInstallEnabled": self.early_install,
+                    "earlyInstalls": self.early_installs,
+                    "healAdmissionWallP50Ms": _pct(50, heal_wall),
+                    "healAdmissionWallP95Ms": _pct(95, heal_wall),
+                    "installLagWallP50Ms": _pct(50, lag_wall),
+                    "installLagWallP95Ms": _pct(95, lag_wall),
+                    "tenants": {cid: t.gating_json()
+                                for cid, t in self.tenants.items()},
+                },
                 "lastDispatch": dict(self.last_dispatch),
             }
 

@@ -772,6 +772,297 @@ def run_serving_campaign(num_tenants: int = 50, seed: int = 0,
     }
 
 
+# ------------------------------------------------- churn-skew cell (PR 20)
+# The ragged-fleet gating measurement: 1 HOT tenant (replica reassignment
+# churn past the dirty-seed budget -> full-budget lanes) + N-1 near-idle
+# tenants (one small replica move each -> reduced lanes that short-circuit,
+# park at the goal boundary and get compacted out of the working stack).
+# The gated batched launch is A/B'd against the ungated (PR 19 uniform-
+# budget) fleet path on bit-identical per-tenant request streams.
+
+# Every goal in this chain provably re-converges after each churn round at
+# the cell's scale; that matters because a lane only PARKS when every
+# remaining goal's carried certificate reads satisfied — a chain with a
+# permanently violated member (e.g. the leader/topic distribution goals
+# that plateau unproven at thousands of replicas) disables the
+# park/compact machinery entirely. The two capacity goals sit satisfied
+# under the generated load (production chains run ~10 goals, most
+# satisfied in steady state) — the ungated fleet still pays their full
+# [K, R] pass schedule every round, while a parked lane skips them
+# outright and the compacted stack runs them for the survivors only.
+# (CpuCapacityGoal is deliberately absent: the synthetic per-replica CPU
+# load sums past the 100% default broker capacity, which would plant a
+# permanently violated goal.)
+SKEW_GOALS = ["ReplicaCapacityGoal", "DiskCapacityGoal",
+              "NetworkInboundCapacityGoal", "ReplicaDistributionGoal"]
+
+_SKEW_BROKERS = 12
+_SKEW_HOT_SPREAD = 4           # hot churn concentrates onto this many brokers
+
+
+def _skew_backend(seed: int, num_brokers: int = _SKEW_BROKERS,
+                  num_partitions: int = 2000, rf: int = 2):
+    """Much bigger than the serving tenant (4000 replicas by default) so
+    per-chunk compute — not host dispatch overhead — dominates the
+    lane-count axis the compaction optimizes. The ungated fleet pays the
+    full [K, R] tensor for EVERY chunk of the hot lane's tail; the gated
+    fleet re-stacks to the surviving lane after the idle lanes park.
+
+    Placement is round-robin (balanced by construction) so the seed
+    cluster SATISFIES the goal chain: the cell's violations come from the
+    churn stream, not from an unhealable random start. The seed only
+    varies the load metrics."""
+    import numpy as np
+    from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+    rng = np.random.default_rng(seed)
+    be = SimulatedClusterBackend()
+    for b in range(num_brokers):
+        be.add_broker(b, f"r{b % 3}")
+    for p in range(num_partitions):
+        reps = [(p * rf + r) % num_brokers for r in range(rf)]
+        be.create_partition(f"t{p % 5}", p, reps,
+                            size_mb=float(rng.uniform(10, 400)),
+                            bytes_in_rate=float(rng.uniform(1, 40)),
+                            bytes_out_rate=float(rng.uniform(1, 80)),
+                            cpu_util=float(rng.uniform(0.1, 4)))
+    return be
+
+
+def build_skew_fleet(num_tenants: int, seed: int = 0, gating: bool = True,
+                     num_partitions: int = 2000, config_over=None):
+    """A fleet for the churn-skew cell: capacity + distribution goal chain
+    (one goal boundary for the park/compact machinery), chunked dispatch
+    forced on, dirty-set seeding armed so churn classifies lanes."""
+    from cruise_control_tpu.config import cruise_control_config
+    from cruise_control_tpu.fleet import FleetScheduler
+    props = {
+        "anomaly.detection.interval.ms": 10_000_000,
+        "goals": ",".join(SKEW_GOALS),
+        "hard.goals": "ReplicaCapacityGoal",
+        "fleet.admission.enabled": True,
+        "fleet.admission.quantize.batch": True,
+        "analyzer.pass.chunk.min.replicas": 0,
+        "analyzer.incremental.seed.dirty": True,
+        "fleet.pass.gating.enabled": gating,
+    }
+    props.update(config_over or {})
+    fleet = FleetScheduler(config=cruise_control_config(dict(props)))
+    for i in range(num_tenants):
+        t = fleet.add_tenant(
+            f"tenant-{i:03d}",
+            backend=_skew_backend(seed * 1000 + i,
+                                  num_partitions=num_partitions),
+            config=cruise_control_config(dict(props)))
+        for w in range(6):
+            t.cc.load_monitor.sample_once(now_ms=w * 300_000.0)
+    return fleet
+
+
+def _move(be, moves):
+    """Instantly re-home partitions: ``{(topic, part): [brokers]}`` applied
+    through the backend's apply_assignment (the instant-convergence
+    actuator) — deterministic structural churn with no in-flight copy."""
+    from types import SimpleNamespace
+    props = [SimpleNamespace(topic=tp[0], partition=tp[1],
+                             new_replicas=[(b, 0) for b in target],
+                             new_leader=target[0])
+             for tp, target in moves.items()]
+    be.apply_assignment(props)
+
+
+def _skew_churn(fleet, rnd: int, hot_flips: int, idle_flips: int = 1):
+    """Apply one round of deterministic skewed churn and re-sample: tenant 0
+    re-homes ``hot_flips`` partitions onto a ``_SKEW_HOT_SPREAD``-broker
+    quartet (a distribution breach whose structural churn is well past the
+    25% dirty-seed budget -> full-budget lanes), every other tenant moves
+    one replica of ``idle_flips`` partitions a single hop (within budget ->
+    reduced lanes). Rotating targets per round keep every round's churn
+    real after the previous heal was applied."""
+    for i, cid in enumerate(fleet.cluster_ids):
+        t = fleet.tenants[cid]
+        be = t.cc.backend
+        parts = sorted(be.partitions())
+        moves = {}
+        if i == 0:
+            for j, tp in enumerate(parts[:hot_flips]):
+                c0 = (j + rnd) % _SKEW_HOT_SPREAD
+                c1 = (c0 + 1) % _SKEW_HOT_SPREAD
+                moves[tp] = [c0, c1]
+        else:
+            info_all = be.partitions()
+            for tp in parts[:idle_flips]:
+                reps = list(info_all[tp].replicas)
+                nxt = (reps[-1] + 1 + rnd) % _SKEW_BROKERS
+                while nxt in reps[:-1]:
+                    nxt = (nxt + 1) % _SKEW_BROKERS
+                reps[-1] = nxt
+                moves[tp] = reps
+        _move(be, moves)
+        t.cc.load_monitor.sample_once(now_ms=(6 + rnd) * 300_000.0)
+
+
+def _goal_sets(res):
+    """(violated set, certificate rows, proposal rows) — the parity unit."""
+    return (
+        sorted(g.name for g in res.goal_results if g.violated_after),
+        sorted((g.name, g.fixpoint_proven, g.moves_remaining,
+                g.leads_remaining, g.swap_window_remaining)
+               for g in res.goal_results),
+        sorted((p.topic, p.partition, p.new_leader, p.new_replicas)
+               for p in res.proposals))
+
+
+def _pctl(xs, q):
+    if not xs:
+        return None
+    s = sorted(xs)
+    return float(s[max(0, min(len(s) - 1, int(round(q * (len(s) - 1)))))])
+
+
+def run_churn_skew_cell(num_tenants: int = 8, seed: int = 0,
+                        rounds: int = 4, num_partitions: int = 2000) -> dict:
+    """The PR 20 acceptance cell (bench.py --serving rides it): gated vs
+    ungated fleet launches on bit-identical churn-skewed request streams.
+
+    Per measured round both fleets get the same churn (1 hot + N-1 idle),
+    the same heal-lane enqueues, and one drained dispatch; the cell
+    records the batched dispatch wall, the hot tenant's enqueue->install
+    wall, the all-tenant heal-admission wall, and the gated fleet's
+    park/compact/early-install meters. After the measured rounds a
+    budget/mask VALUE change (different churn magnitudes, same lane
+    classification) is re-dispatched under a compile counter — the gated
+    program pool must serve it with ZERO new XLA compiles.
+
+    Emits the ``fleet_gating`` block tools/slo_diff.py gates
+    (extract_fleet_gating / compare_fleet_gating)."""
+    import time as _time
+
+    from cruise_control_tpu.common.tracing import count_compiles
+    from cruise_control_tpu.pipeline import LANE_HEAL
+
+    fg = build_skew_fleet(num_tenants, seed=seed, gating=True,
+                          num_partitions=num_partitions)
+    fu = build_skew_fleet(num_tenants, seed=seed, gating=False,
+                          num_partitions=num_partitions)
+    # hot churn: well past the 25% dirty-seed budget (full-budget lanes);
+    # idle churn: one flip (reduced lanes)
+    hot_flips = max(1, (num_partitions * 3) // 5)
+    try:
+        t0 = 2_000_000.0
+        walls = {"gated": [], "ungated": []}
+        hot_wall_ms = {"gated": [], "ungated": []}
+        all_wall_ms = {"gated": [], "ungated": []}
+        parity = True
+
+        def drive(fleet, rnd):
+            """One churn round: apply the previously installed proposals
+            to the backend (the executor's job in a real serving loop —
+            without it every round re-reads the unhealed cluster and no
+            lane ever quiesces enough to park), then flips + resample,
+            heal-enqueue every tenant, drain the dispatcher; returns
+            (dispatch wall s, {cid: enqueue->install wall ms})."""
+            for cid in fleet.cluster_ids:
+                if fleet.tenants[cid].refreshes:
+                    res = fleet.app_for(cid).cached_proposals()
+                    fleet.tenants[cid].cc.backend.apply_assignment(
+                        res.proposals)
+            _skew_churn(fleet, rnd, hot_flips=hot_flips)
+            now = t0 + (rnd + 1) * 30_000.0
+            enq_wall = {}
+            for cid in fleet.cluster_ids:
+                enq_wall[cid] = _time.monotonic()
+                fleet.enqueue(cid, LANE_HEAL, "skew-heal", now_ms=now)
+            w0 = _time.monotonic()
+            for _ in range(4 * num_tenants):
+                d = fleet.dispatch_once(now_ms=now + 1_000.0)
+                if d is None or (d["launches"] == 0 and not d["failed"]):
+                    break
+            wall = _time.monotonic() - w0
+            inst = {cid: max(fleet.tenants[cid].last_install_wall
+                             - enq_wall[cid], 0.0) * 1000.0
+                    for cid in fleet.cluster_ids}
+            return wall, inst
+
+        # warm: one full static round (pays the K=N compiles + plants the
+        # carryover certificates), then TWO unmeasured churn rounds — the
+        # first absorbs the warm heal's apply-churn (over budget for every
+        # lane), the second is the first true skew round and compiles the
+        # gated fleet's compaction sub-stack ladder before the clock starts
+        for fleet in (fg, fu):
+            fleet.run_round(now_ms=t0)
+        for rnd in (0, 1):
+            drive(fg, rnd)
+            drive(fu, rnd)
+
+        hot = fg.cluster_ids[0]
+        for r in range(2, rounds + 2):
+            for name, fleet in (("gated", fg), ("ungated", fu)):
+                wall, inst = drive(fleet, r)
+                walls[name].append(wall)
+                hot_wall_ms[name].append(inst[hot])
+                all_wall_ms[name].extend(inst.values())
+            sets_g = {cid: _goal_sets(fg.app_for(cid).cached_proposals())
+                      for cid in fg.cluster_ids}
+            sets_u = {cid: _goal_sets(fu.app_for(cid).cached_proposals())
+                      for cid in fu.cluster_ids}
+            parity = parity and sets_g == sets_u
+
+        # budget/mask value toggle: different churn magnitudes, identical
+        # lane classification (hot stays over budget, idle stays under) —
+        # traced-operand budgets must make this a VALUE-only relaunch
+        for cid in fg.cluster_ids:
+            res = fg.app_for(cid).cached_proposals()
+            fg.tenants[cid].cc.backend.apply_assignment(res.proposals)
+        with count_compiles() as tc:
+            _skew_churn(fg, rounds + 2, hot_flips=max(1, hot_flips - 100),
+                        idle_flips=2)
+            now = t0 + (rounds + 3) * 30_000.0
+            for cid in fg.cluster_ids:
+                fg.enqueue(cid, LANE_HEAL, "toggle", now_ms=now)
+            for _ in range(4 * num_tenants):
+                d = fg.dispatch_once(now_ms=now + 1_000.0)
+                if d is None or (d["launches"] == 0 and not d["failed"]):
+                    break
+        toggle_compiles = tc.count
+
+        gated_s, ungated_s = sum(walls["gated"]), sum(walls["ungated"])
+        g95 = _pctl(hot_wall_ms["gated"], 0.95) or 0.0
+        u95 = _pctl(hot_wall_ms["ungated"], 0.95) or 0.0
+        tenants_g = [fg.tenants[cid] for cid in fg.cluster_ids]
+        return {
+            "tenants": num_tenants,
+            "seed": seed,
+            "rounds": rounds,
+            "per_tenant_parity": bool(parity),
+            "compactions": int(sum(t.compacted_rounds for t in tenants_g)),
+            "parked_rounds": int(sum(t.parked_rounds for t in tenants_g)),
+            "early_installs": int(fg.early_installs),
+            "wall_s": {"gated": round(gated_s, 4),
+                       "ungated": round(ungated_s, 4)},
+            "wall_rounds_s": {
+                "gated": [round(w, 4) for w in walls["gated"]],
+                "ungated": [round(w, 4) for w in walls["ungated"]]},
+            "hotHealRoundsMs": {
+                "gated": [round(w, 1) for w in hot_wall_ms["gated"]],
+                "ungated": [round(w, 1) for w in hot_wall_ms["ungated"]]},
+            "healWallMs": {"p50": _pctl(hot_wall_ms["gated"], 0.5),
+                           "p95": g95},
+            "healWallMsUngated": {"p50": _pctl(hot_wall_ms["ungated"], 0.5),
+                                  "p95": u95},
+            "allTenantHealWallMs": {
+                "gated_p95": _pctl(all_wall_ms["gated"], 0.95),
+                "ungated_p95": _pctl(all_wall_ms["ungated"], 0.95)},
+            "budget_toggle_new_compiles": int(toggle_compiles),
+            "wall_speedup_x": round(ungated_s / max(gated_s, 1e-9), 3),
+            "heal_p95_improvement_x": round(u95 / max(g95, 1e-9), 3),
+            "gating": {cid: fg.tenants[cid].gating_json()
+                       for cid in fg.cluster_ids},
+        }
+    finally:
+        fg.shutdown()
+        fu.shutdown()
+
+
 # ------------------------------------------------------------------ catalog
 _MICRO_CLUSTER = ClusterSpec(num_brokers=12, num_racks=3,
                              topics=(("t0", 60, 2), ("t1", 60, 2)),

@@ -71,6 +71,30 @@ def test_pass_gating_keys_defined_with_guardrails():
         cruise_control_config({"analyzer.pass.adaptive.floor.passes": 0})
 
 
+def test_fleet_gating_keys_defined_with_guardrails():
+    """The ragged fleet gating family (PR 20): registered, BOOLEAN-typed,
+    on by default, and type-guarded at load time."""
+    keys = CRUISE_CONTROL_CONFIG_DEF.keys()
+    expect = {
+        "fleet.pass.gating.enabled": True,
+        "fleet.pass.compaction.enabled": True,
+        "fleet.pass.early.install.enabled": True,
+    }
+    cfg = cruise_control_config()
+    for name, default in expect.items():
+        assert name in keys, name
+        assert cfg.get_boolean(name) is default, name
+    # a non-boolean value is rejected at load time
+    with pytest.raises(ConfigException):
+        cruise_control_config({"fleet.pass.gating.enabled": "sometimes"})
+    # off-toggles load cleanly (the PR 19 parity baseline)
+    off = cruise_control_config({"fleet.pass.gating.enabled": False,
+                                 "fleet.pass.compaction.enabled": False,
+                                 "fleet.pass.early.install.enabled": False})
+    for name in expect:
+        assert off.get_boolean(name) is False, name
+
+
 def test_key_surface_size_matches_reference_scale():
     keys = CRUISE_CONTROL_CONFIG_DEF.keys()
     canonical = [k for k in keys.values() if k.alias_of is None]

@@ -1,0 +1,398 @@
+"""Ragged fleet convergence gating (PR 20): per-lane traced pass budgets,
+quiesced-lane compaction and early install landing in batched launches.
+
+The invariants:
+1. **Per-lane parity** — a gated batched launch over a churn-skewed fleet
+   (1 hot tenant past the dirty-seed budget + idle tenants under it) is
+   bitwise identical PER TENANT to the same tenants run through the gated
+   solo path: violation sets, certificate rows, proposal sets, final
+   assignment arrays.
+2. **Ungated toggle** — ``fleet.pass.gating.enabled: false`` restores the
+   PR 19 uniform-budget fleet path and still produces the same per-tenant
+   result sets (gating is a scheduling change, not a policy change); the
+   ungated fleet never parks, never compacts and never lands early.
+3. **Compaction fires where lanes quiesce and is inert** — idle lanes park
+   at the first goal boundary and are compacted out of the working stack
+   (counters prove it) without changing any tenant's results (invariant 1
+   covers the values); under UNIFORM hot churn no lane parks and the
+   compactor never fires.
+4. **Early install ordering** — parked lanes land mid-launch (journal
+   ``early`` installs) BEFORE the hot lane's landing, and each tenant's
+   queued requests complete in (lane, seq) order.
+5. **Traced budgets** — re-dispatching after a budget/mask VALUE change
+   (different churn magnitudes, same lane classification) compiles
+   nothing new.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.app import CruiseControl
+from cruise_control_tpu.backend.simulated import SimulatedClusterBackend
+from cruise_control_tpu.common.tracing import count_compiles
+from cruise_control_tpu.config import cruise_control_config
+from cruise_control_tpu.fleet import FleetScheduler
+from cruise_control_tpu.pipeline import LANE_HEAL, LANE_REBALANCE
+
+WINDOW_MS = 300_000.0
+GOALS = ["ReplicaCapacityGoal", "ReplicaDistributionGoal",
+         "LeaderReplicaDistributionGoal"]
+SEEDS = (21, 22, 23)          # index 0 is the HOT tenant
+
+
+def _backend(seed, num_brokers=10, num_partitions=60, rf=2):
+    rng = np.random.default_rng(seed)
+    be = SimulatedClusterBackend()
+    for b in range(num_brokers):
+        be.add_broker(b, f"r{b % 3}")
+    for p in range(num_partitions):
+        reps = [int(x) for x in rng.choice(num_brokers, size=rf,
+                                           replace=False)]
+        be.create_partition(f"t{p % 6}", p, reps,
+                            size_mb=float(rng.uniform(10, 500)),
+                            bytes_in_rate=float(rng.uniform(1, 50)),
+                            bytes_out_rate=float(rng.uniform(1, 100)),
+                            cpu_util=float(rng.uniform(0.1, 5)))
+    return be
+
+
+def _cfg(**over):
+    props = {
+        "anomaly.detection.interval.ms": 10_000_000,
+        "goals": ",".join(GOALS),
+        "hard.goals": "ReplicaCapacityGoal",
+        # force chunked dispatch on the small fixture + arm lane
+        # classification (dirty-set seeding)
+        "analyzer.pass.chunk.min.replicas": 0,
+        "analyzer.incremental.seed.dirty": True,
+    }
+    props.update(over)
+    return cruise_control_config(props)
+
+
+def _sample(cc, lo=0, hi=6):
+    for i in range(lo, hi):
+        cc.load_monitor.sample_once(now_ms=i * WINDOW_MS)
+
+
+def _flip(be, n):
+    """Flip the leaders of the first ``n`` partitions (sorted order) to the
+    other end of their replica list — deterministic structural churn."""
+    flips = {}
+    for tp in sorted(be.partitions())[:n]:
+        info = be.partitions()[tp]
+        flips[tp] = (info.replicas[-1] if info.leader == info.replicas[0]
+                     else info.replicas[0])
+    be.elect_leaders(flips)
+
+
+# 60 partitions * rf2 = 120 replicas; dirty-seed budget = 30 replicas.
+# 45 flips puts the hot tenant PAST the budget (full lanes), 1 small
+# replica move keeps the idle tenants under it (reduced lanes).
+HOT_FLIPS, IDLE_FLIPS = 45, 1
+
+
+def _nudge(be, n):
+    """Move the last replica of the first ``n`` partitions one broker over
+    (instant apply_assignment) — a small structural churn that dirties the
+    EARLY distribution goals but leaves leadership intact, so a reduced
+    idle lane can quiesce before the chain's last goal and PARK at a goal
+    boundary (a leader flip would dirty the final goal and keep the lane
+    in the stack to the end)."""
+    from types import SimpleNamespace
+    parts = be.partitions()
+    brokers = sorted({b for info in parts.values() for b in info.replicas
+                      } | {info.leader for info in parts.values()})
+    nb = max(brokers) + 1
+    props = []
+    for tp in sorted(parts)[:n]:
+        reps = list(parts[tp].replicas)
+        leader = parts[tp].leader
+        # move a NON-leader replica so leadership stays put
+        mv = max(j for j, b in enumerate(reps) if b != leader)
+        nxt = (reps[mv] + 1) % nb
+        while nxt in reps:
+            nxt = (nxt + 1) % nb
+        reps[mv] = nxt
+        props.append(SimpleNamespace(
+            topic=tp[0], partition=tp[1],
+            new_replicas=[(b, 0) for b in reps],
+            new_leader=leader))
+    be.apply_assignment(props)
+
+
+def _churn(backends, hot=HOT_FLIPS, idle=IDLE_FLIPS):
+    for i, be in enumerate(backends):
+        if i == 0:
+            _flip(be, hot)
+        else:
+            _nudge(be, idle)
+
+
+def _sets(res):
+    """(violated set, certificate rows, proposal rows) — the parity unit."""
+    return (
+        sorted(g.name for g in res.goal_results if g.violated_after),
+        sorted((g.name, g.fixpoint_proven, g.moves_remaining,
+                g.leads_remaining, g.swap_window_remaining)
+               for g in res.goal_results),
+        sorted((p.topic, p.partition, p.new_leader, p.new_replicas)
+               for p in res.proposals))
+
+
+def _assert_state_equal(a_res, b_res, who=""):
+    for leaf in ("replica_broker", "replica_is_leader", "replica_disk"):
+        a = np.asarray(getattr(a_res.final_state, leaf))
+        b = np.asarray(getattr(b_res.final_state, leaf))
+        assert np.array_equal(a, b), f"{who}:{leaf}"
+
+
+def _build_fleet(gating: bool):
+    fleet = FleetScheduler(config=_cfg(**{
+        "fleet.pass.gating.enabled": gating}))
+    for s in SEEDS:
+        t = fleet.add_tenant(f"tenant-{s}", backend=_backend(s),
+                             config=_cfg(**{
+                                 "fleet.pass.gating.enabled": gating}))
+        _sample(t.cc)
+    return fleet
+
+
+def _fleet_results(fleet):
+    return {cid: fleet.app_for(cid).cached_proposals()
+            for cid in fleet.cluster_ids}
+
+
+def _apply_installed(fleet):
+    """Apply each tenant's installed proposal cache to its backend — the
+    executor's role in a real serving loop. Without it every round
+    re-reads the unhealed cluster, later goals stay violated at round
+    start, and no lane can ever quiesce enough to park."""
+    for cid in fleet.cluster_ids:
+        res = fleet.app_for(cid).cached_proposals()
+        fleet.tenants[cid].cc.backend.apply_assignment(res.proposals)
+
+
+@pytest.fixture(scope="module")
+def skew():
+    """The whole drive, run ONCE: solo reference rounds, a gated and an
+    ungated fleet through the same full + settle + churn-skewed rounds
+    (proposals applied between rounds, executor-style), then (gated fleet
+    only) an admission-lane round for the early-install ordering, a budget
+    VALUE toggle under a compile counter, and a uniform-churn round for
+    compaction inertness."""
+    out = {}
+
+    # ---- solo gated reference (per-tenant ground truth): full round,
+    # apply, settle round (absorbs the apply churn), apply, one skewed
+    # churn round
+    solo_r3 = {}
+    for s in SEEDS:
+        cc = CruiseControl(_backend(s), config=_cfg())
+        _sample(cc)
+        sess = cc.resident_session
+        sess.sync()
+        r1 = cc.goal_optimizer.optimizations(
+            None, None, raise_on_failure=False, session=sess)
+        cc.backend.apply_assignment(r1.proposals)
+        cc.load_monitor.sample_once(now_ms=6 * WINDOW_MS)
+        sess.sync()
+        r2 = cc.goal_optimizer.optimizations(
+            None, None, raise_on_failure=False, session=sess)
+        cc.backend.apply_assignment(r2.proposals)
+        if s == SEEDS[0]:
+            _flip(cc.backend, HOT_FLIPS)
+        else:
+            _nudge(cc.backend, IDLE_FLIPS)
+        cc.load_monitor.sample_once(now_ms=7 * WINDOW_MS)
+        sess.sync()
+        solo_r3[f"tenant-{s}"] = cc.goal_optimizer.optimizations(
+            None, None, raise_on_failure=False, session=sess)
+    out["solo_r3"] = solo_r3
+
+    # ---- gated + ungated fleets through the same cadence
+    fg, fu = _build_fleet(True), _build_fleet(False)
+    for fleet in (fg, fu):
+        fleet.run_round(now_ms=2_000_000.0)
+        _apply_installed(fleet)
+        for cid in fleet.cluster_ids:
+            fleet.tenants[cid].cc.load_monitor.sample_once(
+                now_ms=6 * WINDOW_MS)
+        fleet.run_round(now_ms=2_030_000.0)
+        _apply_installed(fleet)
+        backends = [fleet.tenants[cid].cc.backend
+                    for cid in fleet.cluster_ids]
+        if fleet is fg:
+            out["gated_counters_pre_r3"] = {
+                cid: fg.tenants[cid].gating_json()
+                for cid in fg.cluster_ids}
+        _churn(backends)
+        for cid in fleet.cluster_ids:
+            fleet.tenants[cid].cc.load_monitor.sample_once(
+                now_ms=7 * WINDOW_MS)
+        fleet.run_round(now_ms=2_060_000.0)
+    out["gated_r3"] = _fleet_results(fg)
+    out["ungated_r3"] = _fleet_results(fu)
+    out["gated_counters_r3"] = {cid: fg.tenants[cid].gating_json()
+                                for cid in fg.cluster_ids}
+    out["ungated_counters_r3"] = {cid: fu.tenants[cid].gating_json()
+                                  for cid in fu.cluster_ids}
+
+    # ---- round 4 on the gated fleet: heal+rebalance lanes through the
+    # admission engine, journal slice captured for the ordering contract
+    _apply_installed(fg)
+    backends = [fg.tenants[cid].cc.backend for cid in fg.cluster_ids]
+    _churn(backends)
+    for cid in fg.cluster_ids:
+        fg.tenants[cid].cc.load_monitor.sample_once(now_ms=8 * WINDOW_MS)
+    mark = len(fg.journal.lines())
+    hot = fg.cluster_ids[0]
+    for cid in fg.cluster_ids:
+        fg.enqueue(cid, LANE_HEAL, "skew-heal", now_ms=2_090_000.0)
+    fg.enqueue(hot, LANE_REBALANCE, "skew-rebalance", now_ms=2_090_000.0)
+    for _ in range(8):
+        d = fg.dispatch_once(now_ms=2_091_000.0)
+        if d is None or (d["launches"] == 0 and not d["failed"]):
+            break
+    out["r4_journal"] = [json.loads(x) for x in fg.journal.lines()[mark:]]
+    out["hot"] = hot
+
+    # ---- budget/mask VALUE toggle: one warm heal-lane dispatch fills the
+    # last pool gap (the heal chain's boundary-probe programs, first hit
+    # on this classification), then a second dispatch with DIFFERENT churn
+    # magnitudes but identical lane classification must relaunch with
+    # zero new compiles — budgets and seed masks are traced VALUES
+    def heal_dispatch(hot_n, idle_n, w, now):
+        _apply_installed(fg)
+        # flips for ALL lanes (idles stay reduced — small churn — but the
+        # final leader goal stays dirty so no lane PARKS): which goal
+        # boundary a lane parks at is a cluster-state VALUE, and a park
+        # profile the ladder hasn't seen (K=3 -> 2 -> 1 instead of
+        # 3 -> 1) compiles its pow2 rung once like any new shape — that
+        # is warm-up, not a budget-value recompile, so the toggle holds
+        # the park profile fixed (no parks) and varies only the values
+        for i, be in enumerate(backends):
+            _flip(be, hot_n if i == 0 else idle_n)
+        for cid in fg.cluster_ids:
+            fg.tenants[cid].cc.load_monitor.sample_once(now_ms=w * WINDOW_MS)
+        for cid in fg.cluster_ids:
+            fg.enqueue(cid, LANE_HEAL, "toggle", now_ms=now)
+        for _ in range(8):
+            d = fg.dispatch_once(now_ms=now + 1_000.0)
+            if d is None or (d["launches"] == 0 and not d["failed"]):
+                break
+
+    # same idle magnitude both times: the toggle varies budget/mask VALUES
+    # (hot churn size, which replicas are dirty), not the lane
+    # classification — a different idle magnitude can legitimately change
+    # which boundary a lane parks at (a different compaction rung = a
+    # different program, compiled once like any ladder step)
+    heal_dispatch(HOT_FLIPS - 5, 2, 9, 2_120_000.0)
+    with count_compiles() as tc:
+        heal_dispatch(HOT_FLIPS - 7, 2, 10, 2_150_000.0)
+    out["toggle_compiles"] = tc.count
+
+    # ---- uniform churn: EVERY lane hot -> nobody parks, compactor inert
+    before = {cid: fg.tenants[cid].gating_json() for cid in fg.cluster_ids}
+    _apply_installed(fg)
+    _churn(backends, hot=HOT_FLIPS, idle=HOT_FLIPS)
+    for cid in fg.cluster_ids:
+        fg.tenants[cid].cc.load_monitor.sample_once(now_ms=11 * WINDOW_MS)
+    fg.run_round(now_ms=2_180_000.0)
+    out["uniform_before"] = before
+    out["uniform_after"] = {cid: fg.tenants[cid].gating_json()
+                            for cid in fg.cluster_ids}
+
+    yield out
+    fg.shutdown()
+    fu.shutdown()
+
+
+def test_gated_batched_parity_bit_identical_to_gated_solo(skew):
+    """Invariant 1: per-tenant verdicts, certificates, proposal sets and
+    final assignment arrays of the gated batched churn round equal the
+    gated solo runs bitwise — full-budget hot lane and reduced idle lanes
+    alike."""
+    for cid, solo in skew["solo_r3"].items():
+        batched = skew["gated_r3"][cid]
+        assert _sets(batched) == _sets(solo), cid
+        _assert_state_equal(batched, solo, cid)
+
+
+def test_gating_off_restores_pr19_path_same_sets(skew):
+    """Invariant 2: the ungated fleet (PR 19 uniform-budget path) yields
+    the same per-tenant result sets, and its lanes never park, compact or
+    land early."""
+    for cid, gated in skew["gated_r3"].items():
+        assert _sets(gated) == _sets(skew["ungated_r3"][cid]), cid
+        _assert_state_equal(gated, skew["ungated_r3"][cid], cid)
+    for cid, c in skew["ungated_counters_r3"].items():
+        assert c["parkedRounds"] == 0, cid
+        assert c["compactedRounds"] == 0, cid
+        assert c["earlyInstalls"] == 0, cid
+
+
+def test_idle_lanes_park_and_compact_hot_lane_does_not(skew):
+    """Invariant 3 (firing half): the churn-skewed round (r3 counter
+    deltas — the settle round may legitimately park EVERY lane, hot
+    included, since applying the warm heal leaves all lanes low-churn)
+    parked and compacted every idle lane; the hot lane stayed in the
+    working stack to the end. Invariant 1 already proved the values
+    unchanged."""
+    hot = skew["hot"]
+    for cid, c in skew["gated_counters_r3"].items():
+        pre = skew["gated_counters_pre_r3"][cid]
+        d_park = c["parkedRounds"] - pre["parkedRounds"]
+        d_comp = c["compactedRounds"] - pre["compactedRounds"]
+        if cid == hot:
+            assert d_park == 0, cid
+            assert d_comp == 0, cid
+        else:
+            assert d_park >= 1, cid
+            assert d_comp >= 1, cid
+            assert c["skippedGoals"] >= 1, cid
+
+
+def test_uniform_churn_never_parks_or_compacts(skew):
+    """Invariant 3 (inert half): with every lane past the budget (uniform
+    hot churn) no lane is reduced, so nobody parks and the compactor never
+    fires."""
+    for cid in skew["uniform_after"]:
+        delta_park = (skew["uniform_after"][cid]["parkedRounds"]
+                      - skew["uniform_before"][cid]["parkedRounds"])
+        delta_comp = (skew["uniform_after"][cid]["compactedRounds"]
+                      - skew["uniform_before"][cid]["compactedRounds"])
+        assert delta_park == 0, cid
+        assert delta_comp == 0, cid
+
+
+def test_early_install_lands_parked_lanes_first_in_lane_seq_order(skew):
+    """Invariant 4: the journal's install stream for the heal round shows
+    (a) every parked idle lane landing EARLY and BEFORE the hot lane's
+    landing, and (b) each tenant's queued requests completing in
+    (lane, seq) order (the hot tenant's heal precedes its rebalance)."""
+    installs = [e for e in skew["r4_journal"]
+                if e.get("kind") == "admission" and e.get("ev") == "install"]
+    assert installs, "no install events journaled"
+    hot = skew["hot"]
+    hot_pos = [i for i, e in enumerate(installs) if e["cid"] == hot]
+    idle_pos = [i for i, e in enumerate(installs) if e["cid"] != hot]
+    assert hot_pos and idle_pos
+    # parked lanes landed before the hot lane's unwind...
+    assert max(idle_pos) < min(hot_pos)
+    # ...and were flagged as early landings
+    for i in idle_pos:
+        assert installs[i].get("early") is True, installs[i]
+    # the hot tenant's requests completed in (lane, seq) order
+    hot_lanes = [installs[i]["lane"] for i in hot_pos]
+    assert hot_lanes == ["heal", "rebalance"]
+
+
+def test_budget_value_toggle_compiles_nothing(skew):
+    """Invariant 5: per-lane budgets and seed masks are traced operands —
+    changing their VALUES (new churn magnitudes, same classification)
+    relaunches entirely from the warmed program pool."""
+    assert skew["toggle_compiles"] == 0

@@ -310,7 +310,7 @@ def test_launch_failure_surfaced_and_heal_requeued(engine3):
     cid = f"tenant-{SEEDS[0]}"
     real = fleet.optimizer.optimizations_batched
 
-    def boom(sessions):
+    def boom(sessions, **kw):
         raise RuntimeError("injected launch failure")
 
     fleet.optimizer.optimizations_batched = boom

@@ -114,6 +114,40 @@ def render_state(adm: dict) -> None:
     p50, p95 = adm.get("healAdmissionP50Ms"), adm.get("healAdmissionP95Ms")
     if p50 is not None:
         print(f"heal admission p50 {p50:.1f} ms  p95 {p95:.1f} ms")
+    render_gating(adm.get("gating") or {})
+
+
+def render_gating(g: dict) -> None:
+    """Ragged fleet gating block (PR 20): early-install meters plus the
+    per-tenant lane gating table — how each tenant's lane behaved inside
+    batched launches (passes dispatched vs skipped, goals short-circuited,
+    rounds parked/compacted, early installs)."""
+    if not g:
+        return
+    print(f"\nfleet gating: early install "
+          f"{'on' if g.get('earlyInstallEnabled') else 'off'}, "
+          f"{g.get('earlyInstalls', 0)} early install(s)")
+    hw50, hw95 = (g.get("healAdmissionWallP50Ms"),
+                  g.get("healAdmissionWallP95Ms"))
+    if hw50 is not None:
+        print(f"heal admission (wall) p50 {hw50:.1f} ms  p95 {hw95:.1f} ms")
+    lw50, lw95 = g.get("installLagWallP50Ms"), g.get("installLagWallP95Ms")
+    if lw50 is not None:
+        print(f"install lag (wall)    p50 {lw50:.1f} ms  p95 {lw95:.1f} ms")
+    tenants = g.get("tenants") or {}
+    if not tenants:
+        return
+    print(f"\n{'tenant':<20}  {'disp':>6}  {'skip':>6}  {'early':>5}  "
+          f"{'scgoal':>6}  {'park':>5}  {'compact':>7}  {'einst':>5}")
+    for cid in sorted(tenants):
+        t = tenants[cid] or {}
+        print(f"{cid:<20}  {t.get('passesDispatched', 0):>6}  "
+              f"{t.get('passesSkipped', 0):>6}  "
+              f"{t.get('earlyExitGoals', 0):>5}  "
+              f"{t.get('skippedGoals', 0):>6}  "
+              f"{t.get('parkedRounds', 0):>5}  "
+              f"{t.get('compactedRounds', 0):>7}  "
+              f"{t.get('earlyInstalls', 0):>5}")
 
 
 def rollup(events: list[dict]) -> dict:
@@ -121,7 +155,8 @@ def rollup(events: list[dict]) -> dict:
     are keyed (cid, seq); installs carry the authoritative waitMs."""
     lanes: dict[str, dict] = {
         name: {"enqueued": 0, "coalesced": 0, "installed": 0,
-               "requeued": 0, "failed": 0, "waits_ms": []}
+               "early_installed": 0, "requeued": 0, "failed": 0,
+               "waits_ms": []}
         for name in LANE_ORDER}
     dispatches, joins, splits, ks = 0, 0, 0, []
     requests: dict[tuple, dict] = {}
@@ -138,6 +173,8 @@ def rollup(events: list[dict]) -> dict:
             row["coalesced"] += 1
         elif ev == "install" and row is not None:
             row["installed"] += 1
+            if e.get("early"):    # landed mid-launch (PR 20)
+                row["early_installed"] += 1
             wait = e.get("waitMs")
             if wait is not None:
                 row["waits_ms"].append(float(wait))
@@ -167,15 +204,17 @@ def rollup(events: list[dict]) -> dict:
 
 
 def render_rollup(roll: dict) -> None:
-    print(f"{'lane':<10}  {'enq':>5}  {'coal':>5}  {'inst':>5}  {'requ':>5}"
-          f"  {'fail':>5}  {'wait p50 ms':>11}  {'wait p95 ms':>11}")
+    print(f"{'lane':<10}  {'enq':>5}  {'coal':>5}  {'inst':>5}  {'early':>5}"
+          f"  {'requ':>5}  {'fail':>5}  {'wait p50 ms':>11}  "
+          f"{'wait p95 ms':>11}")
     for name in LANE_ORDER:
         row = roll["lanes"][name]
         w = row["wait_ms"]
         p50 = "-" if w["p50"] is None else f"{w['p50']:.1f}"
         p95 = "-" if w["p95"] is None else f"{w['p95']:.1f}"
         print(f"{name:<10}  {row['enqueued']:>5}  {row['coalesced']:>5}  "
-              f"{row['installed']:>5}  {row['requeued']:>5}  "
+              f"{row['installed']:>5}  {row['early_installed']:>5}  "
+              f"{row['requeued']:>5}  "
               f"{row['failed']:>5}  {p50:>11}  {p95:>11}")
     mk = "-" if roll["mean_k"] is None else f"{roll['mean_k']:.1f}"
     print(f"\ndispatches {roll['dispatches']} (mean K {mk})  "

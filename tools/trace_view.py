@@ -124,6 +124,24 @@ def render(trace: dict, width: int = 48) -> str:
             lines.append("  overlap summary: " + " · ".join(
                 f"{k} {100 * v.get('overlap_frac', 0):.1f}%"
                 for k, v in sorted(summary.items())))
+    # ragged fleet gating (PR 20): one row per tenant lane of a batched
+    # launch — which lanes ran reduced, how much pass budget each skipped,
+    # and which parked early / were compacted out of the working stack
+    lanes = trace.get("fleet_lanes") or []
+    if lanes:
+        lines.append("  fleet lanes (disp=passes dispatched, "
+                     "skip=passes skipped, sc=short-circuited goals):")
+        for ln in lanes:
+            marks = "".join((
+                "P" if ln.get("parked_early") else "·",
+                "C" if ln.get("compacted_out") else "·"))
+            lines.append(
+                f"  lane {ln.get('tenant', '?'):>3} {marks} "
+                f"{ln.get('round_mode', 'full'):<8} "
+                f"disp={ln.get('passes_dispatched', 0):<5} "
+                f"skip={ln.get('passes_skipped', 0):<5} "
+                f"early-exit={ln.get('early_exit_goals', 0)} "
+                f"sc={ln.get('skipped_goals', 0)}")
     goals = trace.get("goals", [])
     measured = bool(trace.get("durations_measured")) and any(
         g.get("duration_s", 0) > 0 for g in goals)
